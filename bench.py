@@ -1,0 +1,52 @@
+"""Framework benchmark — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md): ResourceClaim-to-Running p50 latency through
+the full node-side prepare path (flock -> checkpoint -> device config ->
+CDI spec write), the reference's `nvidia_dra_request_duration_seconds`
+analog. vs_baseline compares against the reference's designed-for envelope
+floor: the first histogram bucket (50 ms) of
+/root/reference/pkg/metrics/dra_requests.go:29 — values > 1.0 mean our p50
+beats the smallest latency bucket the reference's instrumentation expects.
+
+Until the DeviceState machine lands, this reports flagship train-step
+throughput as a placeholder.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_flagship_step(iters: int = 20) -> dict:
+    import jax
+
+    from k8s_dra_driver_tpu.models.flagship import SliceProofConfig, make_sharded_train_step
+
+    cfg = SliceProofConfig.tiny()
+    devices = jax.devices()
+    step, state, batch = make_sharded_train_step(cfg, devices)
+    state, loss = step(state, batch)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tokens = batch["tokens"].size
+    return {
+        "metric": "flagship_train_step_tokens_per_s",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "n_devices": len(devices),
+        "platform": devices[0].platform,
+    }
+
+
+def main() -> None:
+    print(json.dumps(bench_flagship_step()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""k8s_dra_driver_tpu — a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A from-scratch framework with the capabilities of the NVIDIA DRA GPU driver
+(see SURVEY.md): a ``tpu-kubelet-plugin`` that enumerates ``tpu.google.com``
+devices (whole chips and ICI subslices as KEP-4815 partitionable devices) and
+publishes them as ResourceSlices, prepares claims by CDI-injecting
+``/dev/accel*`` plus libtpu topology environment into containers, and a
+ComputeDomain stack — controller, per-domain slice agent, kubelet plugin —
+that assembles multi-host ICI pod slices follow-the-workload style.
+
+Layer map (mirrors SURVEY.md §1, TPU-native):
+
+    L5  controller/            ComputeDomain reconciler (+ webhook/)
+    L4  api/, k8s/             CRD + config types, API machinery
+    L3  plugins/tpu/,          DRA kubelet plugins
+        plugins/computedomain/
+    L2  daemon/                per-domain slice agent (ICI bootstrap/health)
+    L1  pkg/                   featuregates, flock, workqueue, metrics, bootid
+    L0  tpulib/ + native/      C++ enumeration shim + mock backend
+
+The JAX side (models/, ops/, parallel/) is the workload half: the proof-of-
+function training step and allreduce benchmark that run on a prepared slice,
+analogous to the reference's nvbandwidth test jobs.
+"""
+
+__version__ = "0.1.0"

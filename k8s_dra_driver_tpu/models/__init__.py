@@ -1,0 +1,16 @@
+"""Workload models that run on claimed TPU slices.
+
+The flagship ``SliceProof`` transformer is the framework's proof-of-function
+workload: the job a user schedules onto a ComputeDomain to validate that a
+freshly assembled multi-host ICI slice trains at expected throughput —
+the role the reference fills with nvbandwidth test jobs
+(/root/reference/demo/specs/imex/nvbandwidth-test-job.yaml), upgraded to a
+real sharded training step.
+"""
+
+from k8s_dra_driver_tpu.models.flagship import (  # noqa: F401
+    SliceProofConfig,
+    forward,
+    init_params,
+    make_sharded_train_step,
+)

@@ -1,0 +1,204 @@
+"""SliceProof: the flagship sharded-training workload.
+
+A compact decoder-only transformer written TPU-first:
+
+- matmuls run in bfloat16 so XLA tiles them onto the MXU; master params and
+  the loss stay float32,
+- a static Python layer loop (layer count is compile-time constant) with no
+  data-dependent control flow, so everything fuses under one ``jit``,
+- tensor parallelism shards attention heads and the FFN hidden dim over the
+  ``model`` mesh axis; data parallelism shards the batch over ``data``.
+  Shardings are expressed with ``NamedSharding`` on the inputs plus
+  ``with_sharding_constraint`` pins on activations — XLA inserts the
+  all-reduces (over ICI on real slices) itself.
+
+This is the workload the ComputeDomain e2e schedules to prove an assembled
+slice trains at rate (role of the reference's nvbandwidth job,
+/root/reference/demo/specs/imex/nvbandwidth-test-job.yaml).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_dra_driver_tpu.parallel.mesh import build_mesh, choose_dp_tp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class SliceProofConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 64
+    learning_rate: float = 1e-3
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "SliceProofConfig":
+        return cls()
+
+
+def init_params(cfg: SliceProofConfig, seed: int = 0) -> Params:
+    k = jax.random.PRNGKey(seed)
+    keys = jax.random.split(k, 2 + cfg.n_layers)
+    scale = 0.02
+
+    def dense(key, *shape):
+        return scale * jax.random.normal(key, shape, dtype=jnp.float32)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[2 + i], 6)
+        layers.append(
+            {
+                # Heads as an explicit axis so tp sharding is a plain
+                # PartitionSpec on axis 1.
+                "wqkv": dense(lk[0], cfg.d_model, 3, cfg.n_heads, cfg.head_dim),
+                "wo": dense(lk[1], cfg.n_heads, cfg.head_dim, cfg.d_model),
+                "w1": dense(lk[2], cfg.d_model, cfg.d_ff),
+                "w2": dense(lk[3], cfg.d_ff, cfg.d_model),
+                "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+                "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+            }
+        )
+    return {
+        "embed": dense(keys[0], cfg.vocab, cfg.d_model),
+        "unembed": dense(keys[1], cfg.d_model, cfg.vocab),
+        "layers": layers,
+    }
+
+
+def param_pspecs(cfg: SliceProofConfig) -> Params:
+    """PartitionSpecs mirroring init_params: tp over heads / ffn-hidden."""
+    layer = {
+        "wqkv": P(None, None, "model", None),
+        "wo": P("model", None, None),
+        "w1": P(None, "model"),
+        "w2": P("model", None),
+        "ln1": P(None),
+        "ln2": P(None),
+    }
+    return {
+        "embed": P(None, None),
+        "unembed": P(None, None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _pin(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding-constrain x when a mesh context is active; no-op single-chip,
+    so the same forward serves entry() (one device) and the sharded step."""
+    if jax.sharding.get_abstract_mesh().empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+    return (x * g).astype(jnp.bfloat16)
+
+
+def _block(cfg: SliceProofConfig, p: Params, x: jax.Array) -> jax.Array:
+    b, s, _ = x.shape
+    h = _rmsnorm(x, p["ln1"])
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
+    q, kk, v = qkv[0], qkv[1], qkv[2]
+    q = _pin(q, P("data", None, "model", None))
+    scores = jnp.einsum("bshk,bthk->bhst", q, kk) / np.sqrt(cfg.head_dim)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+
+    h = _rmsnorm(x, p["ln2"])
+    ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
+    ff = _pin(ff, P("data", None, "model"))
+    x = x + jnp.einsum("bsf,fd->bsd", ff, p["w2"].astype(jnp.bfloat16))
+    return x
+
+
+def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """tokens [b, s] int32 -> logits [b, s, vocab] float32."""
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    for p in params["layers"]:
+        x = _block(cfg, p, x)
+    return jnp.einsum("bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)).astype(
+        jnp.float32
+    )
+
+
+def loss_fn(cfg: SliceProofConfig, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+    logits = forward(cfg, params, batch["tokens"])
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = batch["tokens"][:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def sgd_train_step(cfg: SliceProofConfig, state: Dict[str, Any], batch: Dict[str, jax.Array]):
+    """One full training step: fwd, bwd, momentum-SGD update."""
+    params, mom = state["params"], state["momentum"]
+    loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, batch)
+    new_mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom, grads)
+    new_params = jax.tree.map(lambda p, m: p - cfg.learning_rate * m, params, new_mom)
+    return {"params": new_params, "momentum": new_mom}, loss
+
+
+def make_sharded_train_step(
+    cfg: SliceProofConfig,
+    devices: Sequence,
+    *,
+    batch_per_replica: int = 2,
+    seed: int = 0,
+):
+    """Build (jitted_step, sharded_state, sharded_batch) over a dp×tp mesh."""
+    dp, tp = choose_dp_tp(len(devices), max_tp=min(8, cfg.n_heads))
+    mesh = build_mesh(devices, dp, tp)
+
+    params = init_params(cfg, seed=seed)
+    pspecs = param_pspecs(cfg)
+
+    def shard(tree, specs):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            tree,
+            specs,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray),
+        )
+
+    state = {
+        "params": shard(params, pspecs),
+        "momentum": shard(jax.tree.map(jnp.zeros_like, params), pspecs),
+    }
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab, size=(dp * batch_per_replica, cfg.seq_len))
+    batch = {
+        "tokens": jax.device_put(
+            jnp.asarray(tokens, dtype=jnp.int32), NamedSharding(mesh, P("data", None))
+        )
+    }
+
+    jitted = jax.jit(partial(sgd_train_step, cfg), donate_argnums=(0,))
+
+    def step(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    return step, state, batch

@@ -1,0 +1,3 @@
+"""Mesh/sharding helpers for workloads running on claimed TPU slices."""
+
+from k8s_dra_driver_tpu.parallel.mesh import build_mesh, mesh_from_topology  # noqa: F401

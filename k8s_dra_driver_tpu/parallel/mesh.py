@@ -1,0 +1,60 @@
+"""Device-mesh construction for claimed slices.
+
+Maps a slice topology (as the ComputeDomain stack hands it to the workload
+via CDI-injected env: TPU_TOPOLOGY, TPU_WORKER_ID, ...) onto a
+``jax.sharding.Mesh`` whose axis order keeps collectives on ICI: the
+innermost (fastest-varying) mesh axes correspond to physically adjacent
+chips, so ``psum`` over the model axis rides intra-host ICI links and the
+data axis spans hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def build_mesh(devices: Sequence, dp: int, tp: int, *, axis_names: Tuple[str, str] = ("data", "model")):
+    """Build a dp×tp Mesh over ``devices`` (len must equal dp*tp).
+
+    ``model`` is the innermost axis: on real slices consecutive device ids
+    are ICI neighbors, so tensor-parallel collectives stay on the fastest
+    links while data-parallel gradient sync crosses hosts.
+    """
+    from jax.sharding import Mesh
+
+    if dp * tp != len(devices):
+        raise ValueError(f"dp*tp={dp * tp} != len(devices)={len(devices)}")
+    arr = np.asarray(devices, dtype=object).reshape(dp, tp)
+    return Mesh(arr, axis_names=axis_names)
+
+
+def choose_dp_tp(n_devices: int, max_tp: int = 8) -> Tuple[int, int]:
+    """Pick a dp×tp factorization: largest power-of-two tp ≤ max_tp dividing n."""
+    tp = 1
+    while tp * 2 <= max_tp and n_devices % (tp * 2) == 0:
+        tp *= 2
+    return n_devices // tp, tp
+
+
+def mesh_from_topology(topology: str, devices: Optional[Sequence] = None):
+    """Build a mesh shaped like a physical topology string, e.g. "4x4".
+
+    Axis names are ("x", "y") [or ("x","y","z") for 3D tori like v4/v5p].
+    Used by workloads that want physically-faithful meshes rather than the
+    logical dp×tp view.
+    """
+    from jax.sharding import Mesh
+
+    dims = tuple(int(d) for d in topology.lower().split("x"))
+    n = int(np.prod(dims))
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"topology {topology} needs {n} devices, have {len(devices)}")
+    names = ("x", "y", "z")[: len(dims)]
+    arr = np.asarray(devices[:n], dtype=object).reshape(dims)
+    return Mesh(arr, axis_names=names)

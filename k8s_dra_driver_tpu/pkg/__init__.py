@@ -1,0 +1,5 @@
+"""Shared libraries (L1): featuregates, flock, workqueue, metrics, bootid.
+
+TPU-native re-design of the reference's pkg/{featuregates,flags,metrics,
+flock,workqueue,bootid} (see SURVEY.md §2.3).
+"""

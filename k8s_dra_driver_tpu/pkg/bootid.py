@@ -1,0 +1,22 @@
+"""Node boot-ID reader — checkpoint invalidation across reboots.
+
+A checkpoint written before a node reboot describes device state that no
+longer exists; comparing the recorded boot ID against the live one lets the
+plugin discard it (reference: /root/reference/pkg/bootid/bootid.go:10-22 and
+cmd/gpu-kubelet-plugin/device_state.go:246-284).
+"""
+
+from __future__ import annotations
+
+import os
+
+BOOT_ID_PATH = "/proc/sys/kernel/random/boot_id"
+# Test/mock seam, same pattern as the reference's ALT_PROC_DEVICES_PATH
+# (internal/common/nvcaps.go:33-56): redirect the boot-id source file.
+ALT_BOOT_ID_PATH_ENV = "ALT_TPU_BOOT_ID_PATH"
+
+
+def read_boot_id() -> str:
+    path = os.environ.get(ALT_BOOT_ID_PATH_ENV, BOOT_ID_PATH)
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read().strip()

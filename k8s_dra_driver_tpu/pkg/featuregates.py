@@ -1,0 +1,167 @@
+"""Versioned feature gates with cross-gate dependency validation.
+
+The TPU-native gate set mirrors the reference's eleven gates
+(/root/reference/pkg/featuregates/featuregates.go:47-262), with GPU-specific
+concepts mapped to their TPU analogs (MIG -> ICI subslice, NVLink fabric ->
+ICI fabric, IMEX daemon -> slice agent, MPS -> premapped-buffer sharing).
+Gates are set via the ``FEATURE_GATES`` env var or a ``Gate=true,Other=false``
+flag string; dependency validation rejects configurations that enable a gate
+whose prerequisites are disabled.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+
+class Stage(Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    name: str
+    default: bool
+    stage: Stage
+    description: str = ""
+    lock_to_default: bool = False
+    # Gates that must be enabled for this gate to be enabled.
+    requires: Tuple[str, ...] = ()
+
+
+# The TPU-native gate registry. One-to-one with the reference's set where an
+# analog exists; names keep the reference's casing convention.
+FEATURES: Tuple[FeatureSpec, ...] = (
+    FeatureSpec(
+        "TimeSlicingSettings", False, Stage.ALPHA,
+        "Per-claim time-slicing interval config on shared TPU chips.",
+    ),
+    FeatureSpec(
+        "PremappedBufferSharing", False, Stage.ALPHA,
+        "Multi-process sharing of one chip via premapped HBM buffer limits "
+        "(the MPS analog; TPUs have no MPS control daemon).",
+        requires=("TimeSlicingSettings",),
+    ),
+    FeatureSpec(
+        "SliceAgentsWithDNSNames", True, Stage.BETA,
+        "Slice agents peer via stable per-index DNS names instead of raw pod "
+        "IPs, so agent restarts keep their identity.",
+    ),
+    FeatureSpec(
+        "PassthroughSupport", False, Stage.ALPHA,
+        "Advertise whole hosts as VFIO passthrough devices for untrusted "
+        "workloads (binds accel devices to vfio-pci).",
+    ),
+    FeatureSpec(
+        "TPUDeviceHealthCheck", False, Stage.ALPHA,
+        "Subscribe to libtpu/device health events and taint unhealthy "
+        "devices in published ResourceSlices.",
+    ),
+    FeatureSpec(
+        "DynamicSubslice", False, Stage.ALPHA,
+        "Create ICI subslice partitions on demand at Prepare time instead of "
+        "advertising a static partition set (the DynamicMIG analog).",
+    ),
+    FeatureSpec(
+        "ComputeDomainCliques", True, Stage.BETA,
+        "Track per-ICI-domain membership via ComputeDomainClique objects.",
+    ),
+    FeatureSpec(
+        "CrashOnICIFabricErrors", True, Stage.BETA,
+        "Refuse to start (rather than degrade) when ICI fabric state cannot "
+        "be read or reports an error.",
+    ),
+    FeatureSpec(
+        "DeviceMetadata", False, Stage.ALPHA,
+        "Attach vendor metadata (serial, firmware, wrap-link map) to "
+        "published devices.",
+    ),
+    FeatureSpec(
+        "ICIPartitioning", False, Stage.ALPHA,
+        "Program ICI mesh partitions for passthrough device groups (the "
+        "NVSwitch/FabricManager partitioning analog).",
+        requires=("PassthroughSupport",),
+    ),
+    FeatureSpec(
+        "HostManagedSliceAgent", False, Stage.ALPHA,
+        "Assume slice agents are managed by the host OS image rather than a "
+        "driver-managed DaemonSet.",
+        requires=("ComputeDomainCliques",),
+    ),
+)
+
+_SPECS: Dict[str, FeatureSpec] = {f.name: f for f in FEATURES}
+
+ENV_VAR = "FEATURE_GATES"
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+@dataclass
+class FeatureGates:
+    """An immutable-ish view of resolved gate values."""
+
+    _values: Dict[str, bool] = field(default_factory=dict)
+
+    def enabled(self, name: str) -> bool:
+        if name not in _SPECS:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        return self._values.get(name, _SPECS[name].default)
+
+    def as_dict(self) -> Dict[str, bool]:
+        return {f.name: self.enabled(f.name) for f in FEATURES}
+
+    def validate(self) -> None:
+        """Reject configurations whose dependency graph is unsatisfied."""
+        for f in FEATURES:
+            if self.enabled(f.name):
+                for dep in f.requires:
+                    if not self.enabled(dep):
+                        raise FeatureGateError(
+                            f"feature gate {f.name} requires {dep} to be enabled"
+                        )
+
+    def __str__(self) -> str:
+        return ",".join(f"{k}={str(v).lower()}" for k, v in sorted(self.as_dict().items()))
+
+
+def parse(spec: str) -> FeatureGates:
+    """Parse ``Gate=true,Other=false`` (k8s component-base syntax)."""
+    values: Dict[str, bool] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            raise FeatureGateError(f"malformed feature gate entry {part!r} (want Name=bool)")
+        name, _, raw = part.partition("=")
+        name = name.strip()
+        if name not in _SPECS:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        raw = raw.strip().lower()
+        if raw not in ("true", "false"):
+            raise FeatureGateError(f"invalid value {raw!r} for feature gate {name}")
+        value = raw == "true"
+        spec_ = _SPECS[name]
+        if spec_.lock_to_default and value != spec_.default:
+            raise FeatureGateError(f"feature gate {name} is locked to {spec_.default}")
+        values[name] = value
+    return FeatureGates(values)
+
+
+def from_environment(env: Optional[Mapping[str, str]] = None) -> FeatureGates:
+    env = env if env is not None else os.environ
+    return parse(env.get(ENV_VAR, ""))
+
+
+def validate_feature_gates(gates: FeatureGates) -> FeatureGates:
+    gates.validate()
+    return gates
+
+
+def known_features() -> List[str]:
+    return [f"{f.name}={f.default} ({f.stage.value})" for f in FEATURES]

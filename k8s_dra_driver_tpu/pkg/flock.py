@@ -1,0 +1,92 @@
+"""File-based advisory locks with timeout/poll semantics.
+
+Used for the node-global prepare/unprepare lock (``pu.lock``) that serializes
+claim preparation across plugin *processes*, and the checkpoint lock
+(``cp.lock``) guarding read-modify-write of the checkpoint file.
+
+Reference behavior: /root/reference/pkg/flock/flock.go:27-136 (syscall flock
+with timeout/poll options); lock usage at
+/root/reference/cmd/gpu-kubelet-plugin/driver.go:43-46,388-395.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+class FlockTimeoutError(TimeoutError):
+    """Raised when the lock could not be acquired within the timeout."""
+
+
+@dataclass
+class Flock:
+    """An advisory exclusive lock on a filesystem path.
+
+    The lock file is created if missing and never deleted (deleting a lock
+    file while another process holds its fd open would split the lock).
+    """
+
+    path: str
+    poll_interval: float = 0.05
+
+    def __post_init__(self) -> None:
+        self._fd: Optional[int] = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self, timeout: Optional[float] = None) -> None:
+        """Acquire the lock, blocking up to ``timeout`` seconds.
+
+        ``timeout=None`` blocks indefinitely; ``timeout=0`` is a single
+        non-blocking attempt.
+        """
+        if self._fd is not None:
+            raise RuntimeError(f"flock {self.path!r} already held by this object")
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            if timeout is None:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            else:
+                deadline = time.monotonic() + timeout
+                while True:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        break
+                    except OSError as e:
+                        if e.errno not in (errno.EAGAIN, errno.EACCES):
+                            raise
+                        if time.monotonic() >= deadline:
+                            raise FlockTimeoutError(
+                                f"timed out after {timeout}s acquiring {self.path!r}"
+                            ) from None
+                        time.sleep(self.poll_interval)
+        except BaseException:
+            os.close(fd)
+            raise
+        self._fd = fd
+
+    def release(self) -> None:
+        if self._fd is None:
+            raise RuntimeError(f"flock {self.path!r} not held")
+        fd, self._fd = self._fd, None
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+
+    @contextmanager
+    def hold(self, timeout: Optional[float] = None) -> Iterator["Flock"]:
+        self.acquire(timeout=timeout)
+        try:
+            yield self
+        finally:
+            self.release()

@@ -1,0 +1,302 @@
+"""Prometheus-style metrics: DRA request instrumentation + ComputeDomain status.
+
+A minimal dependency-free registry (Counter/Gauge/Histogram with labels,
+text exposition format, threaded HTTP server) carrying the reference's metric
+surface (/root/reference/pkg/metrics/dra_requests.go:27-151,
+computedomain_cluster.go:26-94, prometheus_httpserver.go:37-75), renamed to
+the ``tpu_dra_*`` namespace:
+
+- ``tpu_dra_requests_total{driver,method}``
+- ``tpu_dra_request_duration_seconds{driver,method}`` — exponential buckets
+  0.05s * 2^k, k=0..8 (the designed-for prepare-latency envelope)
+- ``tpu_dra_requests_in_flight{driver}``
+- ``tpu_dra_prepared_devices{driver,device_type}``
+- ``tpu_dra_request_errors_total{driver,method}``
+- ``tpu_dra_compute_domain_status{namespace,name,status}`` — state-exclusive
+  labels with explicit Forget on deletion
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+from bisect import bisect_left
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+LabelValues = Tuple[str, ...]
+
+
+def _fmt_labels(names: Sequence[str], values: LabelValues, extra: str = "") -> str:
+    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    if extra:
+        pairs.append(extra)
+    return ("{" + ",".join(pairs) + "}") if pairs else ""
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+
+    def collect(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[LabelValues, float] = {}
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        if len(labels) != len(self.label_names):
+            raise ValueError(f"{self.name}: want {len(self.label_names)} labels, got {labels}")
+        with self._mu:
+            self._values[labels] = self._values.get(labels, 0.0) + by
+
+    def value(self, *labels: str) -> float:
+        with self._mu:
+            return self._values.get(labels, 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._mu:
+            for labels, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, *labels: str, value: float) -> None:
+        with self._mu:
+            self._values[labels] = value
+
+    def dec(self, *labels: str, by: float = 1.0) -> None:
+        self.inc(*labels, by=-by)
+
+    def forget(self, *labels: str) -> None:
+        """Drop a label series entirely (the reference's Forget-on-deletion)."""
+        with self._mu:
+            self._values.pop(labels, None)
+
+    def forget_matching(self, **fixed: str) -> None:
+        """Drop every series whose named labels match ``fixed``."""
+        idx = {n: i for i, n in enumerate(self.label_names)}
+        with self._mu:
+            doomed = [
+                lv
+                for lv in self._values
+                if all(lv[idx[n]] == v for n, v in fixed.items())
+            ]
+            for lv in doomed:
+                del self._values[lv]
+
+
+# The reference's bucket envelope: 0.05s * 2^k for k=0..8 (0.05s .. 12.8s).
+DRA_DURATION_BUCKETS: Tuple[float, ...] = tuple(0.05 * (2**k) for k in range(9))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DRA_DURATION_BUCKETS,
+    ):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+
+    def observe(self, *labels: str, value: float) -> None:
+        if len(labels) != len(self.label_names):
+            raise ValueError(f"{self.name}: want {len(self.label_names)} labels, got {labels}")
+        with self._mu:
+            counts = self._counts.setdefault(labels, [0] * len(self.buckets))
+            i = bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+
+    @contextmanager
+    def time(self, *labels: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(*labels, value=time.perf_counter() - t0)
+
+    def count(self, *labels: str) -> int:
+        with self._mu:
+            return self._totals.get(labels, 0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._mu:
+            for labels in sorted(self._counts):
+                cum = 0
+                for ub, c in zip(self.buckets, self._counts[labels]):
+                    cum += c
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels(self.label_names, labels, f'le=\"{ub}\"')} {cum}"
+                    )
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, labels, 'le=\"+Inf\"')} {self._totals[labels]}"
+                )
+                out.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {self._sums[labels]}")
+                out.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {self._totals[labels]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._mu = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._mu:
+            if metric.name in self._metrics:
+                raise ValueError(f"metric {metric.name} already registered")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def expose(self) -> str:
+        with self._mu:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+@dataclass
+class DRARequestMetrics:
+    """The per-plugin DRA request instrumentation bundle."""
+
+    driver: str
+    registry: Registry
+    requests_total: Counter = field(init=False)
+    request_errors_total: Counter = field(init=False)
+    request_duration: Histogram = field(init=False)
+    in_flight: Gauge = field(init=False)
+    prepared_devices: Gauge = field(init=False)
+
+    def __post_init__(self) -> None:
+        r = self.registry
+        self.requests_total = r.register(
+            Counter("tpu_dra_requests_total", "DRA requests served.", ("driver", "method"))
+        )
+        self.request_errors_total = r.register(
+            Counter("tpu_dra_request_errors_total", "DRA requests that failed.", ("driver", "method"))
+        )
+        self.request_duration = r.register(
+            Histogram(
+                "tpu_dra_request_duration_seconds",
+                "DRA request latency.",
+                ("driver", "method"),
+            )
+        )
+        self.in_flight = r.register(
+            Gauge("tpu_dra_requests_in_flight", "DRA requests currently in flight.", ("driver",))
+        )
+        self.prepared_devices = r.register(
+            Gauge(
+                "tpu_dra_prepared_devices",
+                "Devices currently prepared, by type.",
+                ("driver", "device_type"),
+            )
+        )
+
+    @contextmanager
+    def track(self, method: str) -> Iterator[None]:
+        self.requests_total.inc(self.driver, method)
+        self.in_flight.inc(self.driver)
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self.request_errors_total.inc(self.driver, method)
+            raise
+        finally:
+            self.in_flight.dec(self.driver)
+            self.request_duration.observe(self.driver, method, value=time.perf_counter() - t0)
+
+
+COMPUTE_DOMAIN_STATES = ("NotReady", "Ready", "Deleting")
+
+
+class ComputeDomainStatusMetric:
+    """Cluster-level ComputeDomain status gauge with state-exclusive labels:
+    exactly one of the per-state series is 1 for a live domain."""
+
+    def __init__(self, registry: Registry):
+        self.gauge = registry.register(
+            Gauge(
+                "tpu_dra_compute_domain_status",
+                "ComputeDomain status (state-exclusive).",
+                ("namespace", "name", "status"),
+            )
+        )
+
+    def set(self, namespace: str, name: str, status: str) -> None:
+        if status not in COMPUTE_DOMAIN_STATES:
+            raise ValueError(f"unknown ComputeDomain status {status!r}")
+        for s in COMPUTE_DOMAIN_STATES:
+            self.gauge.set(namespace, name, s, value=1.0 if s == status else 0.0)
+
+    def forget(self, namespace: str, name: str) -> None:
+        self.gauge.forget_matching(namespace=namespace, name=name)
+
+
+class MetricsServer:
+    """Threaded /metrics HTTP server over a Registry."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
+        registry_ref = registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = registry_ref.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: object) -> None:
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

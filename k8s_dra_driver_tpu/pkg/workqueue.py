@@ -1,0 +1,221 @@
+"""Rate-limited reconcile work queues.
+
+A de-duplicating delayed queue with pluggable per-item rate limiters, used by
+every reconcile loop in the framework (controller, compute-domain managers,
+cleanup managers). Failed items are retried with exponential backoff; jitter
+decorrelates retry storms across nodes.
+
+Reference behavior: /root/reference/pkg/workqueue/workqueue.go:49-67
+(prep/unprep 5s->10m exponential limiters) and jitterlimiter.go:31-66
+(±factor jitter).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class RateLimiter:
+    """Maps an item key to its next retry delay (seconds)."""
+
+    def when(self, key: Hashable) -> float:
+        raise NotImplementedError
+
+    def forget(self, key: Hashable) -> None:
+        raise NotImplementedError
+
+
+class ExponentialRateLimiter(RateLimiter):
+    """base * 2^failures, capped at max — the k8s ItemExponentialFailureRateLimiter shape."""
+
+    def __init__(self, base: float = 0.005, cap: float = 1000.0):
+        self.base = base
+        self.cap = cap
+        self._failures: Dict[Hashable, int] = {}
+        self._mu = threading.Lock()
+
+    def when(self, key: Hashable) -> float:
+        with self._mu:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        return min(self.base * (2**n), self.cap)
+
+    def failures(self, key: Hashable) -> int:
+        with self._mu:
+            return self._failures.get(key, 0)
+
+    def forget(self, key: Hashable) -> None:
+        with self._mu:
+            self._failures.pop(key, None)
+
+
+class JitterRateLimiter(RateLimiter):
+    """Wraps another limiter, scaling each delay by a random factor in
+    [1-factor, 1+factor] to decorrelate thundering herds of retries."""
+
+    def __init__(self, inner: RateLimiter, factor: float = 0.2, rng: Optional[random.Random] = None):
+        if not 0.0 <= factor < 1.0:
+            raise ValueError(f"jitter factor must be in [0,1), got {factor}")
+        self.inner = inner
+        self.factor = factor
+        self._rng = rng or random.Random()
+
+    def when(self, key: Hashable) -> float:
+        delay = self.inner.when(key)
+        return delay * (1.0 + self.factor * (2.0 * self._rng.random() - 1.0))
+
+    def forget(self, key: Hashable) -> None:
+        self.inner.forget(key)
+
+
+def default_controller_rate_limiter() -> RateLimiter:
+    return JitterRateLimiter(ExponentialRateLimiter(base=0.005, cap=1000.0))
+
+
+def prepare_unprepare_rate_limiter() -> RateLimiter:
+    """The reference's dedicated prepare/unprepare limiter: 5s -> 10min."""
+    return JitterRateLimiter(ExponentialRateLimiter(base=5.0, cap=600.0))
+
+
+@dataclass(order=True)
+class _Scheduled:
+    ready_at: float
+    seq: int
+    key: Hashable = field(compare=False)
+
+
+class WorkQueue:
+    """De-duplicating delayed reconcile queue.
+
+    ``enqueue(key, obj)`` schedules ``handler(key, obj)`` on a worker thread.
+    While a key is queued or being processed, further enqueues coalesce into a
+    single re-run with the latest object. A handler exception requeues the key
+    after ``rate_limiter.when(key)``; success calls ``forget``.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[Hashable, Any], None],
+        rate_limiter: Optional[RateLimiter] = None,
+        name: str = "workqueue",
+        max_retries: Optional[int] = None,
+    ):
+        self._handler = handler
+        self._rl = rate_limiter or default_controller_rate_limiter()
+        self.name = name
+        self._max_retries = max_retries
+        self._mu = threading.Condition()
+        self._heap: list[_Scheduled] = []
+        self._seq = 0
+        self._latest: Dict[Hashable, Any] = {}
+        self._queued: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._dirty: set[Hashable] = set()  # re-enqueued while processing
+        self._retry_count: Dict[Hashable, int] = {}
+        self._stopped = False
+        self._threads: list[threading.Thread] = []
+
+    def enqueue(self, key: Hashable, obj: Any = None, delay: float = 0.0) -> None:
+        with self._mu:
+            if self._stopped:
+                return
+            self._latest[key] = obj
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            self._push_locked(key, delay)
+
+    def _push_locked(self, key: Hashable, delay: float) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, _Scheduled(time.monotonic() + delay, self._seq, key))
+        self._mu.notify_all()
+
+    def start(self, workers: int = 1) -> None:
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, name=f"{self.name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        with self._mu:
+            self._stopped = True
+            self._mu.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until the queue is empty and nothing is processing. For tests."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            while self._heap or self._processing or self._dirty:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._mu.wait(timeout=min(remaining, 0.1))
+        return True
+
+    def _pop(self) -> Optional[Hashable]:
+        with self._mu:
+            while not self._stopped:
+                if self._heap:
+                    item = self._heap[0]
+                    now = time.monotonic()
+                    if item.ready_at <= now:
+                        heapq.heappop(self._heap)
+                        self._queued.discard(item.key)
+                        self._processing.add(item.key)
+                        return item.key
+                    self._mu.wait(timeout=min(item.ready_at - now, 0.5))
+                else:
+                    self._mu.wait(timeout=0.5)
+            return None
+
+    def _worker(self) -> None:
+        while True:
+            key = self._pop()
+            if key is None:
+                return
+            obj = self._latest.get(key)
+            try:
+                self._handler(key, obj)
+            except Exception:  # noqa: BLE001 — reconcile errors retry by design
+                log.exception("%s: handler failed for %r", self.name, key)
+                self._finish(key, failed=True)
+            else:
+                self._finish(key, failed=False)
+
+    def _finish(self, key: Hashable, failed: bool) -> None:
+        with self._mu:
+            self._processing.discard(key)
+            if failed:
+                n = self._retry_count.get(key, 0) + 1
+                self._retry_count[key] = n
+                if self._max_retries is not None and n > self._max_retries:
+                    log.error("%s: dropping %r after %d retries", self.name, key, n - 1)
+                    self._rl.forget(key)
+                    self._retry_count.pop(key, None)
+                    self._dirty.discard(key)
+                else:
+                    self._dirty.discard(key)
+                    self._queued.add(key)
+                    self._push_locked(key, self._rl.when(key))
+            else:
+                self._rl.forget(key)
+                self._retry_count.pop(key, None)
+                if key in self._dirty:
+                    self._dirty.discard(key)
+                    self._queued.add(key)
+                    self._push_locked(key, 0.0)
+            self._mu.notify_all()

@@ -1,0 +1,33 @@
+"""Test configuration.
+
+JAX-facing tests run on a virtual 8-device CPU mesh so multi-chip sharding
+logic is exercised without TPU hardware (the reference's analogous seam is
+the mock-NVML driver root, SURVEY.md §4.2). The axon sitecustomize pins the
+platform to the tunneled TPU at interpreter start, so env vars alone are not
+enough — we also force the platform via jax.config after import.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) >= 8, devs
+    return devs

@@ -1,0 +1,50 @@
+"""Feature gates: parsing, defaults, dependency validation."""
+
+import pytest
+
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+
+
+def test_defaults():
+    gates = fg.parse("")
+    assert gates.enabled("SliceAgentsWithDNSNames")
+    assert gates.enabled("ComputeDomainCliques")
+    assert gates.enabled("CrashOnICIFabricErrors")
+    assert not gates.enabled("DynamicSubslice")
+    gates.validate()  # default set must always validate
+
+
+def test_parse_overrides():
+    gates = fg.parse("DynamicSubslice=true, ComputeDomainCliques=false")
+    assert gates.enabled("DynamicSubslice")
+    assert not gates.enabled("ComputeDomainCliques")
+
+
+@pytest.mark.parametrize("bad", ["Nope=true", "DynamicSubslice", "DynamicSubslice=maybe"])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(fg.FeatureGateError):
+        fg.parse(bad)
+
+
+def test_dependency_validation():
+    # ICIPartitioning requires PassthroughSupport.
+    gates = fg.parse("ICIPartitioning=true")
+    with pytest.raises(fg.FeatureGateError, match="requires PassthroughSupport"):
+        gates.validate()
+    fg.parse("ICIPartitioning=true,PassthroughSupport=true").validate()
+
+    # HostManagedSliceAgent requires ComputeDomainCliques (default-on, so
+    # disabling the dependency breaks it).
+    gates = fg.parse("HostManagedSliceAgent=true,ComputeDomainCliques=false")
+    with pytest.raises(fg.FeatureGateError, match="requires ComputeDomainCliques"):
+        gates.validate()
+
+
+def test_from_environment(monkeypatch):
+    monkeypatch.setenv(fg.ENV_VAR, "TPUDeviceHealthCheck=true")
+    assert fg.from_environment().enabled("TPUDeviceHealthCheck")
+
+
+def test_unknown_gate_query_raises():
+    with pytest.raises(fg.FeatureGateError):
+        fg.parse("").enabled("NotAGate")

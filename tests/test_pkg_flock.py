@@ -1,0 +1,73 @@
+"""Flock semantics: exclusion across processes, timeout, reentrancy guard.
+
+Mirrors the reference's pkg/flock tests (SURVEY.md §4 tier 1).
+"""
+
+import multiprocessing
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
+
+
+def _hold_lock(path, hold_s, acquired_evt):
+    lock = Flock(str(path))
+    lock.acquire(timeout=5)
+    acquired_evt.set()
+    time.sleep(hold_s)
+    lock.release()
+
+
+def test_acquire_release(tmp_path):
+    lock = Flock(str(tmp_path / "a.lock"))
+    assert not lock.held
+    lock.acquire(timeout=1)
+    assert lock.held
+    lock.release()
+    assert not lock.held
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_timeout_when_held_by_other_process(tmp_path):
+    path = tmp_path / "pu.lock"
+    # fork (not spawn): the child must inherit sys.path to import this module.
+    ctx = multiprocessing.get_context("fork")
+    evt = ctx.Event()
+    p = ctx.Process(target=_hold_lock, args=(path, 1.5, evt))
+    p.start()
+    try:
+        assert evt.wait(timeout=5)
+        lock = Flock(str(path))
+        t0 = time.monotonic()
+        with pytest.raises(FlockTimeoutError):
+            lock.acquire(timeout=0.3)
+        assert 0.2 <= time.monotonic() - t0 < 1.5
+        # After the holder exits, acquisition succeeds.
+        lock.acquire(timeout=5)
+        lock.release()
+    finally:
+        p.join(timeout=5)
+
+
+def test_double_acquire_rejected(tmp_path):
+    lock = Flock(str(tmp_path / "a.lock"))
+    with lock.hold(timeout=1):
+        with pytest.raises(RuntimeError):
+            lock.acquire(timeout=0)
+
+
+def test_hold_context_releases_on_error(tmp_path):
+    lock = Flock(str(tmp_path / "a.lock"))
+    with pytest.raises(ValueError):
+        with lock.hold(timeout=1):
+            raise ValueError("boom")
+    assert not lock.held
+    lock.acquire(timeout=0)
+    lock.release()
+
+
+def test_creates_parent_dir(tmp_path):
+    lock = Flock(str(tmp_path / "nested" / "dir" / "a.lock"))
+    with lock.hold(timeout=1):
+        pass

@@ -1,0 +1,76 @@
+"""Metrics: DRA request bundle, histogram buckets, CD status exclusivity, HTTP server."""
+
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.pkg.metrics import (
+    ComputeDomainStatusMetric,
+    DRA_DURATION_BUCKETS,
+    DRARequestMetrics,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+def test_duration_buckets_match_reference_envelope():
+    # 0.05s * 2^k, k=0..8 (reference pkg/metrics/dra_requests.go:29).
+    assert DRA_DURATION_BUCKETS[0] == 0.05
+    assert DRA_DURATION_BUCKETS[-1] == pytest.approx(12.8)
+    assert len(DRA_DURATION_BUCKETS) == 9
+
+
+def test_dra_request_tracking():
+    reg = Registry()
+    m = DRARequestMetrics(driver="tpu.google.com", registry=reg)
+    with m.track("PrepareResourceClaims"):
+        pass
+    with pytest.raises(RuntimeError):
+        with m.track("PrepareResourceClaims"):
+            raise RuntimeError("boom")
+    assert m.requests_total.value("tpu.google.com", "PrepareResourceClaims") == 2
+    assert m.request_errors_total.value("tpu.google.com", "PrepareResourceClaims") == 1
+    assert m.in_flight.value("tpu.google.com") == 0
+    assert m.request_duration.count("tpu.google.com", "PrepareResourceClaims") == 2
+
+
+def test_histogram_bucket_counts():
+    h = Histogram("h", "help", ("l",), buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe("x", value=v)
+    text = "\n".join(h.collect())
+    assert 'h_bucket{l="x",le="1.0"} 1' in text
+    assert 'h_bucket{l="x",le="2.0"} 2' in text
+    assert 'h_bucket{l="x",le="4.0"} 3' in text
+    assert 'h_bucket{l="x",le="+Inf"} 4' in text
+    assert 'h_count{l="x"} 4' in text
+
+
+def test_compute_domain_status_exclusive_and_forget():
+    reg = Registry()
+    cd = ComputeDomainStatusMetric(reg)
+    cd.set("ns", "dom", "NotReady")
+    cd.set("ns", "dom", "Ready")
+    assert cd.gauge.value("ns", "dom", "Ready") == 1.0
+    assert cd.gauge.value("ns", "dom", "NotReady") == 0.0
+    cd.forget("ns", "dom")
+    text = reg.expose()
+    assert 'name="dom"' not in text
+
+
+def test_metrics_http_server():
+    reg = Registry()
+    m = DRARequestMetrics(driver="tpu.google.com", registry=reg)
+    with m.track("NodePrepareResources"):
+        pass
+    srv = MetricsServer(reg, port=0)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert "tpu_dra_requests_total" in body
+        assert 'method="NodePrepareResources"' in body
+    finally:
+        srv.stop()

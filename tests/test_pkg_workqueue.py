@@ -1,0 +1,111 @@
+"""WorkQueue: dedupe/coalesce, retry with backoff, jitter limiter bounds."""
+
+import random
+import threading
+import time
+
+from k8s_dra_driver_tpu.pkg.workqueue import (
+    ExponentialRateLimiter,
+    JitterRateLimiter,
+    WorkQueue,
+)
+
+
+def test_exponential_rate_limiter_doubles_and_caps():
+    rl = ExponentialRateLimiter(base=1.0, cap=8.0)
+    assert [rl.when("k") for _ in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+    rl.forget("k")
+    assert rl.when("k") == 1.0
+    # Keys are independent.
+    assert rl.when("other") == 1.0
+
+
+def test_jitter_limiter_stays_within_factor():
+    rl = JitterRateLimiter(ExponentialRateLimiter(base=10.0, cap=10.0), factor=0.2,
+                           rng=random.Random(42))
+    for _ in range(200):
+        d = rl.when("k")
+        assert 8.0 <= d <= 12.0
+
+
+def test_workqueue_processes_and_coalesces():
+    seen = []
+    done = threading.Event()
+
+    def handler(key, obj):
+        seen.append((key, obj))
+        if obj == "final":
+            done.set()
+        time.sleep(0.05)
+
+    q = WorkQueue(handler, name="t")
+    q.start(workers=1)
+    try:
+        q.enqueue("a", "v1")
+        # These land while "a" may be queued/processing; they coalesce.
+        q.enqueue("a", "v2")
+        q.enqueue("a", "final")
+        assert done.wait(timeout=5)
+        assert q.drain(timeout=5)
+    finally:
+        q.stop()
+    # First run sees some version, a coalesced re-run sees the latest.
+    assert seen[-1] == ("a", "final")
+    assert len(seen) <= 3
+
+
+def test_workqueue_retries_on_failure_then_succeeds():
+    attempts = []
+    done = threading.Event()
+
+    def handler(key, obj):
+        attempts.append(time.monotonic())
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        done.set()
+
+    q = WorkQueue(handler, rate_limiter=ExponentialRateLimiter(base=0.01, cap=0.05), name="t")
+    q.start(workers=1)
+    try:
+        q.enqueue("k", None)
+        assert done.wait(timeout=5)
+    finally:
+        q.stop()
+    assert len(attempts) == 3
+
+
+def test_workqueue_drops_after_max_retries():
+    n = [0]
+
+    def handler(key, obj):
+        n[0] += 1
+        raise RuntimeError("permanent")
+
+    q = WorkQueue(handler, rate_limiter=ExponentialRateLimiter(base=0.005, cap=0.01),
+                  name="t", max_retries=2)
+    q.start(workers=1)
+    try:
+        q.enqueue("k", None)
+        assert q.drain(timeout=5)
+    finally:
+        q.stop()
+    assert n[0] == 3  # initial + 2 retries
+
+
+def test_workqueue_multiple_keys_parallel_workers():
+    seen = set()
+    lock = threading.Lock()
+
+    def handler(key, obj):
+        with lock:
+            seen.add(key)
+
+    q = WorkQueue(handler, name="t")
+    q.start(workers=4)
+    try:
+        for i in range(50):
+            q.enqueue(f"k{i}")
+        assert q.drain(timeout=5)
+    finally:
+        q.stop()
+    assert seen == {f"k{i}" for i in range(50)}
