@@ -169,9 +169,22 @@ class Registry:
         self._mu = threading.Lock()
 
     def register(self, metric: _Metric) -> _Metric:
+        """Register, or return the existing metric of the same name/shape.
+
+        Get-or-create so several plugin bundles (tpu + computedomain) can
+        share one registry — series stay distinct via the `driver` label.
+        """
         with self._mu:
-            if metric.name in self._metrics:
-                raise ValueError(f"metric {metric.name} already registered")
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if (
+                    type(existing) is not type(metric)
+                    or existing.label_names != metric.label_names
+                ):
+                    raise ValueError(
+                        f"metric {metric.name} already registered with a different shape"
+                    )
+                return existing
             self._metrics[metric.name] = metric
         return metric
 

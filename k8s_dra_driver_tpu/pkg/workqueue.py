@@ -206,7 +206,12 @@ class WorkQueue:
                     log.error("%s: dropping %r after %d retries", self.name, key, n - 1)
                     self._rl.forget(key)
                     self._retry_count.pop(key, None)
-                    self._dirty.discard(key)
+                    if key in self._dirty:
+                        # A newer object arrived mid-failure: that's fresh
+                        # work, not part of the exhausted retry series.
+                        self._dirty.discard(key)
+                        self._queued.add(key)
+                        self._push_locked(key, 0.0)
                 else:
                     self._dirty.discard(key)
                     self._queued.add(key)
@@ -218,4 +223,8 @@ class WorkQueue:
                     self._dirty.discard(key)
                     self._queued.add(key)
                     self._push_locked(key, 0.0)
+            if key not in self._queued and key not in self._processing:
+                # Nothing further scheduled for this key: drop its payload so
+                # churning keys don't pin dead objects forever.
+                self._latest.pop(key, None)
             self._mu.notify_all()

@@ -1,0 +1,29 @@
+"""tpulib — L0 device enumeration: the deviceLib analog.
+
+The reference binds NVML via cgo (`deviceLib`,
+/root/reference/cmd/gpu-kubelet-plugin/nvlib.go:43-103) with a mock-NVML
+seam for CPU-only CI. Here the same split is:
+
+- ``RealTpuLib``: backed by the C++ shim (native/tpulib.cc -> libtpulib.so,
+  ctypes) that scans ``/dev/accel*`` / ``/dev/vfio`` and sysfs for Google
+  TPU PCI functions, plus the GKE TPU VM environment conventions
+  (TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, TPU_WORKER_ID, ...).
+- ``MockTpuLib``: driven by named topology profiles (v5e-4, v5e-16, ...)
+  selected via the ``ALT_TPU_TOPOLOGY`` env seam — the equivalent of the
+  reference's ALT_PROC_DEVICES_PATH + mock-NVML profiles (SURVEY.md §4.2).
+
+``new_tpulib()`` picks the backend: mock iff ALT_TPU_TOPOLOGY is set.
+"""
+
+from k8s_dra_driver_tpu.tpulib.types import (  # noqa: F401
+    ChipHealth,
+    ChipInfo,
+    HostInventory,
+    SubslicePlacement,
+    SubsliceProfile,
+    TpuGen,
+)
+from k8s_dra_driver_tpu.tpulib.profiles import GENS, PROFILES, SliceProfile  # noqa: F401
+from k8s_dra_driver_tpu.tpulib.lib import ALT_TPU_TOPOLOGY_ENV, TpuLib, new_tpulib  # noqa: F401
+from k8s_dra_driver_tpu.tpulib.mock import MockTpuLib  # noqa: F401
+from k8s_dra_driver_tpu.tpulib.real import RealTpuLib  # noqa: F401

@@ -1,0 +1,86 @@
+"""tpu-info — the nvidia-smi-style debug CLI over tpulib.
+
+Usage:
+    python -m k8s_dra_driver_tpu.tpulib.cli info [--json]
+    python -m k8s_dra_driver_tpu.tpulib.cli health <chip-index>
+
+(Reference role: nvidia-smi as invoked for debug/persistence-mode at
+/root/reference/cmd/gpu-kubelet-plugin/root.go:57.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from enum import Enum
+
+from k8s_dra_driver_tpu.tpulib.lib import new_tpulib, using_mock_tpulib
+
+
+def _to_jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {str(k): _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def cmd_info(args: argparse.Namespace) -> int:
+    lib = new_tpulib()
+    inv = lib.enumerate()
+    if args.json:
+        print(json.dumps(_to_jsonable(inv), indent=2))
+        return 0
+    backend = "mock" if using_mock_tpulib() else "real"
+    print(f"backend: {backend}")
+    print(f"accelerator: {inv.accelerator_type} ({inv.gen.value})")
+    print(f"slice: {inv.slice_topology} over {inv.num_hosts} host(s); "
+          f"this host: worker {inv.worker_id}, {inv.host_topology}")
+    print(f"ici domain: {inv.ici_domain}")
+    print(f"{'IDX':<4}{'DEVICE':<14}{'COORDS':<12}{'HBM':<8}{'NUMA':<6}{'HEALTH':<10}SERIAL")
+    for c in inv.chips:
+        hbm = f"{c.hbm_bytes // (1024**3)}G"
+        print(f"{c.index:<4}{c.dev_path:<14}{str(c.coords):<12}{hbm:<8}"
+              f"{c.numa_node:<6}{c.health.value:<10}{c.serial}")
+    if inv.subslice_profiles:
+        profs = ", ".join(
+            f"{p.name}({len(p.placements)} placements)" for p in inv.subslice_profiles
+        )
+        print(f"subslice profiles: {profs}")
+    return 0
+
+
+def cmd_health(args: argparse.Namespace) -> int:
+    from k8s_dra_driver_tpu.tpulib.real import RealTpuLib
+
+    lib = new_tpulib()
+    if isinstance(lib, RealTpuLib):
+        h = lib.chip_health(args.chip)
+    else:
+        inv = lib.enumerate()
+        h = inv.chip_by_index(args.chip).health
+    print(h.value)
+    return 0 if h.value == "healthy" else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-info")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_info = sub.add_parser("info", help="enumerate this host")
+    p_info.add_argument("--json", action="store_true")
+    p_info.set_defaults(fn=cmd_info)
+    p_health = sub.add_parser("health", help="probe one chip")
+    p_health.add_argument("chip", type=int)
+    p_health.set_defaults(fn=cmd_health)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

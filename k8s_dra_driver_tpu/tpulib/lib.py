@@ -1,0 +1,39 @@
+"""TpuLib interface + backend factory.
+
+``new_tpulib()`` is the single construction point every binary uses
+(plugins, daemon, CLI): mock when ``ALT_TPU_TOPOLOGY`` is set, real
+otherwise — mirroring how the reference flips between real NVML and
+mock-NVML via the driver root + ALT_PROC_DEVICES_PATH seams without any
+code change (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol, runtime_checkable
+
+from k8s_dra_driver_tpu.tpulib.types import ChipHealth, HostInventory
+
+ALT_TPU_TOPOLOGY_ENV = "ALT_TPU_TOPOLOGY"
+
+
+@runtime_checkable
+class TpuLib(Protocol):
+    def enumerate(self) -> HostInventory: ...
+
+
+def using_mock_tpulib(env: Optional[dict] = None) -> bool:
+    env = env if env is not None else os.environ
+    return bool(env.get(ALT_TPU_TOPOLOGY_ENV))
+
+
+def new_tpulib(env: Optional[dict] = None) -> TpuLib:
+    env = dict(env) if env is not None else dict(os.environ)
+    profile = env.get(ALT_TPU_TOPOLOGY_ENV)
+    if profile:
+        from k8s_dra_driver_tpu.tpulib.mock import MockTpuLib
+
+        return MockTpuLib(profile)
+    from k8s_dra_driver_tpu.tpulib.real import RealTpuLib
+
+    return RealTpuLib(env=env)

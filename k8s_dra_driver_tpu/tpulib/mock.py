@@ -1,0 +1,162 @@
+"""Mock tpulib backend — CPU-only CI's stand-in for real TPU hosts.
+
+Equivalent of the reference's mock-NVML (SURVEY.md §4.2): a named profile
+(``ALT_TPU_TOPOLOGY=v5e-16``) plus a worker id (``ALT_TPU_WORKER_ID=1``)
+fully determine what this "host" sees. Health can be injected per chip for
+taint/republish tests (``ALT_TPU_UNHEALTHY_CHIPS=0,2`` or ``set_health``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.tpulib.profiles import (
+    GENS,
+    PROFILES,
+    SliceProfile,
+    compute_subslice_profiles,
+    host_chip_coords,
+)
+from k8s_dra_driver_tpu.tpulib.types import (
+    ChipHealth,
+    ChipInfo,
+    HostInventory,
+    IciLink,
+    parse_topology,
+)
+
+ALT_TPU_WORKER_ID_ENV = "ALT_TPU_WORKER_ID"
+ALT_TPU_SLICE_UID_ENV = "ALT_TPU_SLICE_UID"
+ALT_TPU_UNHEALTHY_CHIPS_ENV = "ALT_TPU_UNHEALTHY_CHIPS"
+
+
+def _host_block_origin(profile: SliceProfile, worker_id: int) -> Tuple[int, ...]:
+    """Global coords of this host's chip block, hosts tiling row-major."""
+    grid = profile.host_grid
+    host_dims = parse_topology(profile.host_topology)
+    host_dims = host_dims + (1,) * (len(grid) - len(host_dims))
+    rem = worker_id
+    pos = []
+    for g in reversed(grid):
+        pos.append(rem % g)
+        rem //= g
+    pos.reverse()
+    return tuple(p * h for p, h in zip(pos, host_dims))
+
+
+class MockTpuLib:
+    """A fake host within a fake slice."""
+
+    def __init__(
+        self,
+        profile: str | SliceProfile,
+        worker_id: Optional[int] = None,
+        slice_uid: Optional[str] = None,
+        unhealthy: Optional[List[int]] = None,
+    ):
+        if isinstance(profile, str):
+            if profile not in PROFILES:
+                raise ValueError(
+                    f"unknown topology profile {profile!r}; known: {sorted(PROFILES)}"
+                )
+            profile = PROFILES[profile]
+        self.profile = profile
+        if worker_id is None:
+            worker_id = int(os.environ.get(ALT_TPU_WORKER_ID_ENV, "0"))
+        if not 0 <= worker_id < profile.num_hosts:
+            raise ValueError(
+                f"worker_id {worker_id} out of range for {profile.name} "
+                f"({profile.num_hosts} hosts)"
+            )
+        self.worker_id = worker_id
+        self.slice_uid = slice_uid or os.environ.get(
+            ALT_TPU_SLICE_UID_ENV, f"mock-slice-{profile.name}"
+        )
+        self._health: Dict[int, ChipHealth] = {}
+        env_unhealthy = os.environ.get(ALT_TPU_UNHEALTHY_CHIPS_ENV, "")
+        for tok in filter(None, (t.strip() for t in env_unhealthy.split(","))):
+            self._health[int(tok)] = ChipHealth.UNHEALTHY
+        for idx in unhealthy or ():
+            self._health[idx] = ChipHealth.UNHEALTHY
+        self._health_listeners: List = []
+
+    # -- health injection ---------------------------------------------------
+
+    def set_health(self, chip_index: int, health: ChipHealth) -> None:
+        self._health[chip_index] = health
+        for cb in list(self._health_listeners):
+            cb(chip_index, health)
+
+    def watch_health(self, callback) -> None:
+        """Register callback(chip_index, health) — the NVML event-set analog
+        (/root/reference/cmd/gpu-kubelet-plugin/device_health.go:103-274)."""
+        self._health_listeners.append(callback)
+
+    # -- enumeration --------------------------------------------------------
+
+    def _serial(self, global_coords: Tuple[int, ...]) -> str:
+        h = hashlib.sha1(
+            f"{self.slice_uid}:{global_coords}".encode(), usedforsecurity=False
+        ).hexdigest()
+        return h[:12]
+
+    def enumerate(self) -> HostInventory:
+        p = self.profile
+        gen = GENS[p.gen]
+        host_dims = parse_topology(p.host_topology)
+        origin = _host_block_origin(p, self.worker_id)
+        chips: List[ChipInfo] = []
+        local_coords = host_chip_coords(host_dims)
+        for idx, lc in enumerate(local_coords):
+            lc3 = lc + (0,) * (3 - len(lc))
+            gc = tuple(o + c for o, c in zip(origin + (0,) * (3 - len(origin)), lc3))
+            chips.append(
+                ChipInfo(
+                    index=idx,
+                    dev_path=f"/dev/accel{idx}",
+                    pci_address=f"0000:00:{4 + idx:02x}.0",
+                    gen=p.gen,
+                    coords=gc,  # type: ignore[arg-type]
+                    serial=self._serial(gc),
+                    hbm_bytes=gen.hbm_bytes,
+                    cores=gen.cores_per_chip,
+                    numa_node=0 if idx < len(local_coords) // 2 or len(local_coords) == 1 else 1,
+                    health=self._health.get(idx, ChipHealth.HEALTHY),
+                )
+            )
+        links = self._intra_host_links(chips, gen.ici_gbps_per_link)
+        return HostInventory(
+            gen=p.gen,
+            accelerator_type=p.accelerator_type,
+            slice_topology=p.slice_topology,
+            host_topology=p.host_topology,
+            worker_id=self.worker_id,
+            num_hosts=p.num_hosts,
+            chips=chips,
+            links=links,
+            subslice_profiles=compute_subslice_profiles(p.host_topology),
+            ici_domain=f"{self.slice_uid}.0",
+        )
+
+    @staticmethod
+    def _intra_host_links(chips: List[ChipInfo], gbps: float) -> List[IciLink]:
+        by_coords = {c.coords: c for c in chips}
+        links: List[IciLink] = []
+        for c in chips:
+            for axis in range(3):
+                nb = list(c.coords)
+                nb[axis] += 1
+                nb_t = tuple(nb)
+                if nb_t in by_coords:
+                    links.append(IciLink(a=c.coords, b=nb_t, gbps=gbps))  # type: ignore[arg-type]
+        return links
+
+    # -- identity / bootstrap ----------------------------------------------
+
+    def worker_hostnames(self) -> List[str]:
+        """Stable DNS-ish names of every host in the slice."""
+        return [
+            f"worker-{i}.{self.slice_uid}.tpu.internal" for i in range(self.profile.num_hosts)
+        ]

@@ -1,0 +1,147 @@
+"""Generation facts and named slice profiles.
+
+The mock backend's profile table plays the role of the reference's mock-NVML
+GPU profiles (a100/h100/gb200..., /root/reference/hack/ci/mock-nvml/
+setup-mock-gpu.sh:16-35): a named catalog of hardware shapes CI can
+impersonate. Subslice profiles are computed, not listed — legality is
+"axis-aligned block whose dims divide the host topology", generalized from
+the MIG profile+placement walk (/root/reference/cmd/gpu-kubelet-plugin/
+nvlib.go:466-642).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from k8s_dra_driver_tpu.tpulib.types import (
+    GenSpec,
+    SubslicePlacement,
+    SubsliceProfile,
+    TpuGen,
+    format_topology,
+    parse_topology,
+    topology_chips,
+)
+
+GiB = 1024**3
+
+GENS: Dict[TpuGen, GenSpec] = {
+    TpuGen.V4: GenSpec(TpuGen.V4, hbm_bytes=32 * GiB, cores_per_chip=2,
+                       topology_dims=3, peak_bf16_tflops=275.0, ici_gbps_per_link=50.0),
+    TpuGen.V5E: GenSpec(TpuGen.V5E, hbm_bytes=16 * GiB, cores_per_chip=1,
+                        topology_dims=2, peak_bf16_tflops=197.0, ici_gbps_per_link=45.0),
+    TpuGen.V5P: GenSpec(TpuGen.V5P, hbm_bytes=95 * GiB, cores_per_chip=2,
+                        topology_dims=3, peak_bf16_tflops=459.0, ici_gbps_per_link=90.0),
+    TpuGen.V6E: GenSpec(TpuGen.V6E, hbm_bytes=32 * GiB, cores_per_chip=1,
+                        topology_dims=2, peak_bf16_tflops=918.0, ici_gbps_per_link=90.0),
+}
+
+
+@dataclass(frozen=True)
+class SliceProfile:
+    """A named whole-slice shape the mock can impersonate."""
+
+    name: str               # "v5e-16"
+    gen: TpuGen
+    accelerator_type: str   # GKE-style name, e.g. "v5litepod-16"
+    slice_topology: str     # "4x4"
+    host_topology: str      # "2x2" — chips on one host
+
+    @property
+    def num_chips(self) -> int:
+        return topology_chips(self.slice_topology)
+
+    @property
+    def chips_per_host(self) -> int:
+        return topology_chips(self.host_topology)
+
+    @property
+    def num_hosts(self) -> int:
+        assert self.num_chips % self.chips_per_host == 0
+        return self.num_chips // self.chips_per_host
+
+    @property
+    def host_grid(self) -> Tuple[int, ...]:
+        """How host blocks tile the slice grid."""
+        s = parse_topology(self.slice_topology)
+        h = parse_topology(self.host_topology)
+        h = h + (1,) * (len(s) - len(h))
+        assert all(sd % hd == 0 for sd, hd in zip(s, h)), (s, h)
+        return tuple(sd // hd for sd, hd in zip(s, h))
+
+
+def _p(name: str, gen: TpuGen, acc: str, slice_topo: str, host_topo: str) -> SliceProfile:
+    return SliceProfile(name, gen, acc, slice_topo, host_topo)
+
+
+PROFILES: Dict[str, SliceProfile] = {
+    p.name: p
+    for p in (
+        _p("v5e-1", TpuGen.V5E, "v5litepod-1", "1x1", "1x1"),
+        _p("v5e-4", TpuGen.V5E, "v5litepod-4", "2x2", "2x2"),
+        _p("v5e-8", TpuGen.V5E, "v5litepod-8", "2x4", "2x2"),
+        _p("v5e-16", TpuGen.V5E, "v5litepod-16", "4x4", "2x2"),
+        _p("v5e-32", TpuGen.V5E, "v5litepod-32", "4x8", "2x2"),
+        _p("v5e-64", TpuGen.V5E, "v5litepod-64", "8x8", "2x2"),
+        _p("v6e-4", TpuGen.V6E, "v6e-4", "2x2", "2x2"),
+        _p("v6e-16", TpuGen.V6E, "v6e-16", "4x4", "2x2"),
+        _p("v4-8", TpuGen.V4, "v4-8", "2x2x2", "2x2x1"),
+        _p("v4-16", TpuGen.V4, "v4-16", "2x2x4", "2x2x1"),
+        _p("v5p-8", TpuGen.V5P, "v5p-8", "2x2x2", "2x2x1"),
+        _p("v5p-16", TpuGen.V5P, "v5p-16", "2x2x4", "2x2x1"),
+    )
+}
+
+
+def host_chip_coords(host_topo: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+    """Host-local chip coords, row-major; chip index == position in list."""
+    return [c for c in itertools.product(*(range(d) for d in host_topo))]
+
+
+def compute_subslice_profiles(host_topology: str) -> List[SubsliceProfile]:
+    """All proper subslice shapes of a host topology, with placements.
+
+    A shape is legal when each dim divides the host dim (so placements tile
+    the grid without overlap — the scheduler-enforced counter model needs
+    placements at fixed offsets, like MIG memory-slice placements,
+    /root/reference/cmd/gpu-kubelet-plugin/partitions.go:53-246).
+    The whole-host shape is excluded: that's just the host device itself.
+    """
+    dims = parse_topology(host_topology)
+    coords = host_chip_coords(dims)
+    index_of = {c: i for i, c in enumerate(coords)}
+
+    def divisors(n: int) -> List[int]:
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    profiles: List[SubsliceProfile] = []
+    for shape in itertools.product(*(divisors(d) for d in dims)):
+        if shape == dims:
+            continue  # whole host
+        name = format_topology(shape)
+        placements = []
+        origins = itertools.product(
+            *(range(0, d, s) for d, s in zip(dims, shape))
+        )
+        for origin in origins:
+            cells = itertools.product(
+                *(range(o, o + s) for o, s in zip(origin, shape))
+            )
+            chip_indices = tuple(sorted(index_of[c] for c in cells))
+            start = tuple(origin) + (0,) * (3 - len(origin))
+            placements.append(
+                SubslicePlacement(profile=name, start=start, chip_indices=chip_indices)  # type: ignore[arg-type]
+            )
+        profiles.append(
+            SubsliceProfile(
+                name=name,
+                shape=shape,
+                chips=topology_chips(name),
+                placements=tuple(placements),
+            )
+        )
+    # Largest first: nicer for humans, and dedupes nothing.
+    profiles.sort(key=lambda p: (-p.chips, p.name))
+    return profiles

@@ -1,0 +1,262 @@
+"""Real tpulib backend — C++ shim over /dev + sysfs, GKE TPU VM env conventions.
+
+The native library does the kernel-facing scan (native/tpulib.cc); this
+module binds it with ctypes (the cgo analog, explicit library path like the
+reference's nvml.New(libpath), /root/reference/cmd/gpu-kubelet-plugin/
+nvlib.go:57-103), merges in slice identity from the TPU VM environment
+(TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, TPU_WORKER_ID, TPU_WORKER_HOSTNAMES —
+the conventions libtpu itself consumes), and falls back to a pure-Python
+scan when the shared library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.tpulib.profiles import GENS, compute_subslice_profiles
+from k8s_dra_driver_tpu.tpulib.types import (
+    ChipHealth,
+    ChipInfo,
+    HostInventory,
+    TpuGen,
+    format_topology,
+    parse_topology,
+    topology_chips,
+)
+
+log = logging.getLogger(__name__)
+
+TPULIB_PATH_ENV = "TPULIB_PATH"
+ALT_TPU_DEV_ROOT_ENV = "ALT_TPU_DEV_ROOT"
+ALT_TPU_SYSFS_ROOT_ENV = "ALT_TPU_SYSFS_ROOT"
+
+_DEFAULT_LIB_LOCATIONS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libtpulib.so"),
+    "/usr/local/lib/libtpulib.so",
+    "libtpulib.so",
+)
+
+
+def _load_shim(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    candidates = [path] if path else [os.environ.get(TPULIB_PATH_ENV), *_DEFAULT_LIB_LOCATIONS]
+    for cand in candidates:
+        if not cand:
+            continue
+        try:
+            lib = ctypes.CDLL(os.path.abspath(cand) if os.path.sep in cand else cand)
+        except OSError:
+            continue
+        lib.tpulib_enumerate.restype = ctypes.c_int
+        lib.tpulib_enumerate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tpulib_chip_health.restype = ctypes.c_int
+        lib.tpulib_chip_health.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tpulib_version.restype = ctypes.c_char_p
+        return lib
+    return None
+
+
+_ACCEL_RE = re.compile(r"^accel(\d+)$")
+
+
+def _py_scan(dev_root: str, sysfs_root: str) -> List[dict]:
+    """Pure-Python fallback mirroring native/tpulib.cc ScanChips."""
+    chips = []
+    try:
+        entries = os.listdir(dev_root)
+    except OSError:
+        return chips
+    for name in entries:
+        m = _ACCEL_RE.match(name)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        dev_path = os.path.join(dev_root, name)
+        pci_dir = os.path.join(sysfs_root, "class", "accel", f"accel{idx}", "device")
+        pci_address, numa, serial, vendor = "", 0, "", ""
+        if os.path.exists(pci_dir):
+            real = os.path.realpath(pci_dir)
+            pci_address = os.path.basename(real)
+            for fname, cast in (("numa_node", int), ("unique_id", str), ("vendor", str)):
+                p = os.path.join(real, fname)
+                if os.path.exists(p):
+                    with open(p) as f:
+                        v = f.read().strip()
+                    if fname == "numa_node":
+                        numa = max(0, cast(v))
+                    elif fname == "unique_id":
+                        serial = v
+                    else:
+                        vendor = v
+        chips.append(
+            {
+                "index": idx,
+                "dev_path": dev_path,
+                "pci_address": pci_address,
+                "numa_node": numa,
+                "vendor": vendor,
+                "serial": serial or pci_address or name,
+                "vfio_group": "",
+                "openable": os.access(dev_path, os.R_OK),
+            }
+        )
+    chips.sort(key=lambda c: c["index"])
+    return chips
+
+
+def _gen_from_accelerator_type(acc: str) -> TpuGen:
+    acc = acc.lower()
+    if acc.startswith("v5litepod") or acc.startswith("v5e"):
+        return TpuGen.V5E
+    if acc.startswith("v5p"):
+        return TpuGen.V5P
+    if acc.startswith("v6e") or acc.startswith("trillium"):
+        return TpuGen.V6E
+    if acc.startswith("v4"):
+        return TpuGen.V4
+    log.warning("unknown accelerator type %r, assuming v5e", acc)
+    return TpuGen.V5E
+
+
+class RealTpuLib:
+    """Enumerates the actual host. Slice identity comes from the TPU VM env;
+    a host with no slice env is treated as a single-host slice."""
+
+    def __init__(
+        self,
+        lib_path: Optional[str] = None,
+        dev_root: Optional[str] = None,
+        sysfs_root: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self._lib = _load_shim(lib_path)
+        self.dev_root = dev_root or os.environ.get(ALT_TPU_DEV_ROOT_ENV, "/dev")
+        self.sysfs_root = sysfs_root or os.environ.get(ALT_TPU_SYSFS_ROOT_ENV, "/sys")
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.native = self._lib is not None
+
+    def shim_version(self) -> str:
+        if self._lib is None:
+            return "python-fallback"
+        return self._lib.tpulib_version().decode()
+
+    def _scan(self) -> List[dict]:
+        if self._lib is None:
+            return _py_scan(self.dev_root, self.sysfs_root)
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tpulib_enumerate(
+                self.dev_root.encode(), self.sysfs_root.encode(), buf, cap
+            )
+            if n >= 0:
+                return json.loads(buf.value.decode())["chips"]
+            needed = -n
+            if needed <= cap:
+                raise RuntimeError(f"tpulib_enumerate error: {buf.value[:200]!r}")
+            cap = needed
+
+    def chip_health(self, index: int) -> ChipHealth:
+        if self._lib is not None:
+            rc = self._lib.tpulib_chip_health(self.dev_root.encode(), index)
+            return ChipHealth.HEALTHY if rc == 0 else ChipHealth.UNHEALTHY
+        path = os.path.join(self.dev_root, f"accel{index}")
+        return ChipHealth.HEALTHY if os.path.exists(path) else ChipHealth.UNHEALTHY
+
+    def enumerate(self) -> HostInventory:
+        raw = self._scan()
+        n_local = len(raw)
+
+        acc_type = self.env.get("TPU_ACCELERATOR_TYPE", "")
+        slice_topology = self.env.get("TPU_TOPOLOGY", "")
+        worker_id = int(self.env.get("TPU_WORKER_ID", "0") or "0")
+        hostnames = [h for h in self.env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+        num_hosts = max(len(hostnames), 1)
+
+        gen = _gen_from_accelerator_type(acc_type) if acc_type else TpuGen.V5E
+        gspec = GENS[gen]
+
+        if slice_topology:
+            total = topology_chips(slice_topology)
+            if n_local and total % n_local == 0 and num_hosts == 1:
+                num_hosts = total // n_local
+        else:
+            # Host-only view: model the local chips as the entire slice.
+            if n_local in (0, 1):
+                slice_topology = "1x1"
+            else:
+                dims = (2, n_local // 2) if n_local % 2 == 0 else (1, n_local)
+                slice_topology = format_topology(dims)
+            num_hosts = 1
+
+        host_topology = self._host_topology(slice_topology, n_local, num_hosts)
+
+        chips: List[ChipInfo] = []
+        for i, c in enumerate(raw):
+            coords = self._local_coords(host_topology, i, worker_id, slice_topology)
+            chips.append(
+                ChipInfo(
+                    index=c["index"],
+                    dev_path=c["dev_path"],
+                    pci_address=c["pci_address"],
+                    gen=gen,
+                    coords=coords,
+                    serial=c["serial"],
+                    hbm_bytes=gspec.hbm_bytes,
+                    cores=gspec.cores_per_chip,
+                    numa_node=c["numa_node"],
+                    health=ChipHealth.HEALTHY if c.get("openable", True) else ChipHealth.UNHEALTHY,
+                )
+            )
+        slice_uid = self.env.get("TPU_SLICE_UID", "") or (
+            f"host-{chips[0].serial}" if chips else "host-empty"
+        )
+        return HostInventory(
+            gen=gen,
+            accelerator_type=acc_type or f"{gen.value}-{n_local}",
+            slice_topology=slice_topology,
+            host_topology=host_topology,
+            worker_id=worker_id,
+            num_hosts=num_hosts,
+            chips=chips,
+            links=[],
+            subslice_profiles=compute_subslice_profiles(host_topology) if n_local else [],
+            ici_domain=f"{slice_uid}.0",
+            vfio_devices={
+                c["index"]: f"/dev/vfio/{c['vfio_group']}" for c in raw if c.get("vfio_group")
+            },
+        )
+
+    @staticmethod
+    def _host_topology(slice_topology: str, n_local: int, num_hosts: int) -> str:
+        if num_hosts == 1:
+            return slice_topology
+        if n_local == 4:
+            return "2x2" if len(parse_topology(slice_topology)) == 2 else "2x2x1"
+        if n_local == 1:
+            return "1x1"
+        if n_local == 8:
+            return "2x4"
+        return format_topology((1, max(n_local, 1)))
+
+    @staticmethod
+    def _local_coords(host_topology: str, i: int, worker_id: int, slice_topology: str):
+        from k8s_dra_driver_tpu.tpulib.mock import _host_block_origin
+        from k8s_dra_driver_tpu.tpulib.profiles import SliceProfile, host_chip_coords
+
+        dims = parse_topology(host_topology)
+        local = host_chip_coords(dims)[min(i, len(host_chip_coords(dims)) - 1)]
+        local3 = local + (0,) * (3 - len(local))
+        try:
+            prof = SliceProfile("adhoc", TpuGen.V5E, "adhoc", slice_topology, host_topology)
+            origin = _host_block_origin(prof, worker_id)
+        except Exception:  # noqa: BLE001 — fall back to host-local coords
+            origin = (0, 0, 0)
+        origin3 = tuple(origin) + (0,) * (3 - len(origin))
+        return tuple(o + c for o, c in zip(origin3, local3))

@@ -1,0 +1,149 @@
+"""Core tpulib data model.
+
+The TPU-native re-design of the reference's GpuInfo/MigDeviceInfo world
+(/root/reference/cmd/gpu-kubelet-plugin/deviceinfo.go): chips instead of
+GPUs, ICI subslices instead of MIG partitions, the ICI domain id instead of
+the NVLink clique (clusterUUID.cliqueID,
+/root/reference/cmd/compute-domain-kubelet-plugin/nvlib.go:196-364).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+Coord = Tuple[int, int, int]
+
+
+class TpuGen(str, Enum):
+    V4 = "v4"
+    V5E = "v5e"
+    V5P = "v5p"
+    V6E = "v6e"
+
+
+class ChipHealth(str, Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"   # e.g. ICI link flap, correctable HBM errors
+    UNHEALTHY = "unhealthy"  # device lost / uncorrectable
+
+
+@dataclass(frozen=True)
+class GenSpec:
+    """Per-generation silicon facts (public numbers)."""
+
+    gen: TpuGen
+    hbm_bytes: int
+    cores_per_chip: int
+    topology_dims: int          # 2 for v5e/v6e meshes, 3 for v4/v5p tori
+    peak_bf16_tflops: float
+    ici_gbps_per_link: float    # per-direction per-link
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    """One TPU chip on this host."""
+
+    index: int                   # host-local index; /dev/accel<index>
+    dev_path: str                # /dev/accel0 ...
+    pci_address: str             # 0000:00:04.0 style
+    gen: TpuGen
+    coords: Coord                # global coords within the slice
+    serial: str
+    hbm_bytes: int
+    cores: int
+    numa_node: int = 0
+    health: ChipHealth = ChipHealth.HEALTHY
+
+    @property
+    def uuid(self) -> str:
+        """Stable canonical identity, GPU-UUID analog."""
+        return f"tpu-{self.gen.value}-{self.serial}"
+
+
+@dataclass(frozen=True)
+class IciLink:
+    """A physical ICI link between two chips (by global coords)."""
+
+    a: Coord
+    b: Coord
+    gbps: float
+    wraparound: bool = False
+
+
+@dataclass(frozen=True)
+class SubslicePlacement:
+    """A concrete placement of a subslice profile on this host's chip grid —
+    the MIG placement analog (/root/reference/cmd/gpu-kubelet-plugin/mig.go:111-223).
+    """
+
+    profile: str                 # e.g. "1x2"
+    start: Coord                 # host-local origin
+    chip_indices: Tuple[int, ...]  # host-local chip indices consumed
+
+    @property
+    def name_suffix(self) -> str:
+        x, y, _ = self.start
+        return f"{self.profile}-at-{x}x{y}"
+
+
+@dataclass(frozen=True)
+class SubsliceProfile:
+    """A subslice shape this host topology can carve out (MIG profile analog)."""
+
+    name: str                    # "1x1", "1x2", "2x2", ...
+    shape: Tuple[int, ...]
+    chips: int
+    placements: Tuple[SubslicePlacement, ...] = ()
+
+
+@dataclass
+class HostInventory:
+    """Everything tpulib knows about this host — the result of enumeration,
+    `GetPerGpuAllocatableDevices` analog (/root/reference/cmd/gpu-kubelet-plugin/nvlib.go:205-348).
+    """
+
+    gen: TpuGen
+    accelerator_type: str        # e.g. "v5litepod-16"
+    slice_topology: str          # e.g. "4x4" — the whole (multi-host) slice
+    host_topology: str           # e.g. "2x2" — this host's chips
+    worker_id: int               # index of this host within the slice
+    num_hosts: int
+    chips: List[ChipInfo] = field(default_factory=list)
+    links: List[IciLink] = field(default_factory=list)
+    subslice_profiles: List[SubsliceProfile] = field(default_factory=list)
+    ici_domain: str = ""         # sliceUUID.partition — clique-id analog
+    vfio_devices: Dict[int, str] = field(default_factory=dict)  # chip idx -> /dev/vfio/<grp>
+
+    @property
+    def chips_per_host(self) -> int:
+        return len(self.chips)
+
+    def chip_by_index(self, index: int) -> ChipInfo:
+        for c in self.chips:
+            if c.index == index:
+                return c
+        raise KeyError(f"no chip with index {index}")
+
+
+_TOPO_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """'4x4' -> (4, 4); '2x2x2' -> (2, 2, 2)."""
+    if not _TOPO_RE.match(topology):
+        raise ValueError(f"malformed topology {topology!r}")
+    return tuple(int(d) for d in topology.split("x"))
+
+
+def topology_chips(topology: str) -> int:
+    n = 1
+    for d in parse_topology(topology):
+        n *= d
+    return n
+
+
+def format_topology(dims: Tuple[int, ...]) -> str:
+    return "x".join(str(d) for d in dims)

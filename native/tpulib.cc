@@ -1,0 +1,232 @@
+// tpulib — native TPU host enumeration shim.
+//
+// The TPU-native counterpart of the reference's cgo->libnvidia-ml.so.1
+// boundary (/root/reference/cmd/gpu-kubelet-plugin/nvlib.go:57-103): a thin
+// C-ABI library the Python driver loads at an explicit path, doing the
+// kernel-facing work natively — scanning accel character devices, resolving
+// their PCI functions through sysfs, reading NUMA affinity and VFIO group
+// membership. Roots are parameters (not hardcoded /dev, /sys) so tests can
+// point the shim at fixture trees, the same seam the reference builds with
+// ALT_PROC_DEVICES_PATH (internal/common/nvcaps.go:33-56).
+//
+// ABI: everything returns JSON into a caller buffer. Return value is the
+// number of bytes written (excluding NUL); if the buffer is too small the
+// required size is returned as a negative number. Hard errors return
+// TPULIB_ERR (-1) and write a {"error": ...} object when space allows.
+
+#include <cctype>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+#include <dirent.h>
+
+namespace {
+
+constexpr const char* kVersion = "tpulib 0.1.0";
+constexpr int TPULIB_ERR = -1;
+// Google vendor id on TPU PCI functions.
+constexpr const char* kGoogleVendor = "0x1ae0";
+
+std::string ReadFileTrim(const std::string& path) {
+  FILE* f = std::fopen(path.c_str(), "re");
+  if (!f) return "";
+  char buf[256];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  std::string s(buf);
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' || s.back() == ' '))
+    s.pop_back();
+  return s;
+}
+
+std::string JsonEscape(const std::string& in) {
+  std::string out;
+  out.reserve(in.size() + 8);
+  for (char c : in) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char esc[8];
+          std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+          out += esc;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+struct Chip {
+  int index = -1;
+  std::string dev_path;
+  std::string pci_address;
+  int numa_node = 0;
+  std::string vendor;
+  std::string serial;
+  std::string vfio_group;
+  bool openable = false;
+};
+
+// Resolve the PCI device dir for accelN:
+//   <sysfs>/class/accel/accelN/device -> ../../devices/pci.../<bdf>
+// Falls back to empty when sysfs has no entry (bare fixture trees).
+std::string PciDirFor(const std::string& sysfs_root, int index) {
+  std::string link = sysfs_root + "/class/accel/accel" + std::to_string(index) + "/device";
+  char target[4096];
+  ssize_t n = ::readlink(link.c_str(), target, sizeof(target) - 1);
+  if (n < 0) {
+    // Also accept a plain directory (fixtures that can't make symlinks).
+    struct stat st;
+    if (::stat(link.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) return link;
+    return "";
+  }
+  target[n] = '\0';
+  // Absolute target stands alone; relative resolves against the link's dir.
+  std::string resolved;
+  if (target[0] == '/') {
+    resolved = target;
+  } else {
+    std::string base = link.substr(0, link.rfind('/'));
+    resolved = base + "/" + target;
+  }
+  char real[4096];
+  if (::realpath(resolved.c_str(), real)) return std::string(real);
+  return resolved;
+}
+
+std::string Basename(const std::string& p) {
+  auto pos = p.rfind('/');
+  return pos == std::string::npos ? p : p.substr(pos + 1);
+}
+
+// Find this PCI function's VFIO group, if bound to vfio-pci:
+// <pci_dir>/iommu_group -> .../kernel/iommu_groups/<N>
+std::string VfioGroupFor(const std::string& pci_dir) {
+  if (pci_dir.empty()) return "";
+  std::string link = pci_dir + "/iommu_group";
+  char target[4096];
+  ssize_t n = ::readlink(link.c_str(), target, sizeof(target) - 1);
+  if (n < 0) return "";
+  target[n] = '\0';
+  std::string driver = pci_dir + "/driver";
+  char drv[4096];
+  ssize_t dn = ::readlink(driver.c_str(), drv, sizeof(drv) - 1);
+  if (dn < 0) return "";
+  drv[dn] = '\0';
+  if (Basename(drv) != "vfio-pci") return "";
+  return Basename(target);
+}
+
+std::vector<Chip> ScanChips(const std::string& dev_root, const std::string& sysfs_root) {
+  std::vector<Chip> chips;
+  DIR* d = ::opendir(dev_root.c_str());
+  if (!d) return chips;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    const char* name = ent->d_name;
+    if (std::strncmp(name, "accel", 5) != 0) continue;
+    const char* digits = name + 5;
+    if (*digits == '\0') continue;
+    bool all_digits = true;
+    for (const char* p = digits; *p; ++p)
+      if (!std::isdigit(static_cast<unsigned char>(*p))) { all_digits = false; break; }
+    if (!all_digits) continue;
+
+    Chip c;
+    c.index = std::atoi(digits);
+    c.dev_path = dev_root + "/" + name;
+    int fd = ::open(c.dev_path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+    c.openable = fd >= 0;
+    if (fd >= 0) ::close(fd);
+
+    std::string pci_dir = PciDirFor(sysfs_root, c.index);
+    if (!pci_dir.empty()) {
+      c.pci_address = Basename(pci_dir);
+      c.vendor = ReadFileTrim(pci_dir + "/vendor");
+      std::string numa = ReadFileTrim(pci_dir + "/numa_node");
+      if (!numa.empty()) {
+        int n = std::atoi(numa.c_str());
+        c.numa_node = n < 0 ? 0 : n;
+      }
+      c.serial = ReadFileTrim(pci_dir + "/unique_id");
+      c.vfio_group = VfioGroupFor(pci_dir);
+    }
+    if (c.serial.empty()) {
+      // Stable fallback identity: PCI address, else dev path.
+      c.serial = !c.pci_address.empty() ? c.pci_address : Basename(c.dev_path);
+    }
+    chips.push_back(std::move(c));
+  }
+  ::closedir(d);
+  // Sort by index for deterministic output.
+  for (size_t i = 0; i + 1 < chips.size(); ++i)
+    for (size_t j = i + 1; j < chips.size(); ++j)
+      if (chips[j].index < chips[i].index) std::swap(chips[i], chips[j]);
+  return chips;
+}
+
+int WriteOut(const std::string& s, char* out, int cap) {
+  int need = static_cast<int>(s.size());
+  if (out == nullptr || cap <= need) return -(need + 1);
+  std::memcpy(out, s.c_str(), need + 1);
+  return need;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tpulib_version() { return kVersion; }
+
+// Enumerate accel devices under dev_root, enriching from sysfs_root.
+// JSON shape: {"chips":[{"index":..,"dev_path":..,"pci_address":..,
+//                        "numa_node":..,"vendor":..,"serial":..,
+//                        "vfio_group":..,"openable":..}, ...]}
+int tpulib_enumerate(const char* dev_root, const char* sysfs_root,
+                     char* out, int cap) {
+  if (dev_root == nullptr || sysfs_root == nullptr) {
+    return WriteOut("{\"error\":\"null root\"}", out, cap) >= 0 ? TPULIB_ERR : TPULIB_ERR;
+  }
+  std::vector<Chip> chips = ScanChips(dev_root, sysfs_root);
+  std::string json = "{\"chips\":[";
+  for (size_t i = 0; i < chips.size(); ++i) {
+    const Chip& c = chips[i];
+    if (i) json += ",";
+    json += "{\"index\":" + std::to_string(c.index);
+    json += ",\"dev_path\":\"" + JsonEscape(c.dev_path) + "\"";
+    json += ",\"pci_address\":\"" + JsonEscape(c.pci_address) + "\"";
+    json += ",\"numa_node\":" + std::to_string(c.numa_node);
+    json += ",\"vendor\":\"" + JsonEscape(c.vendor) + "\"";
+    json += ",\"serial\":\"" + JsonEscape(c.serial) + "\"";
+    json += ",\"vfio_group\":\"" + JsonEscape(c.vfio_group) + "\"";
+    json += std::string(",\"openable\":") + (c.openable ? "true" : "false");
+    json += "}";
+  }
+  json += "]}";
+  return WriteOut(json, out, cap);
+}
+
+// Liveness probe for one chip: 0 healthy (device node openable),
+// 1 unhealthy, TPULIB_ERR on bad args.
+int tpulib_chip_health(const char* dev_root, int index) {
+  if (dev_root == nullptr || index < 0) return TPULIB_ERR;
+  std::string path = std::string(dev_root) + "/accel" + std::to_string(index);
+  int fd = ::open(path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd < 0) return 1;
+  ::close(fd);
+  return 0;
+}
+
+}  // extern "C"

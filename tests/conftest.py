@@ -11,17 +11,13 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _ensure_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_ensure_devices(8)
+
+import jax  # noqa: E402
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,245 @@
+"""tpulib: profiles, subslice legality, mock enumeration, real backend + C++ shim."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from k8s_dra_driver_tpu.tpulib import (
+    ChipHealth,
+    MockTpuLib,
+    PROFILES,
+    RealTpuLib,
+    TpuGen,
+    new_tpulib,
+)
+from k8s_dra_driver_tpu.tpulib.profiles import compute_subslice_profiles
+from k8s_dra_driver_tpu.tpulib.types import parse_topology, topology_chips
+
+
+# -- profiles ----------------------------------------------------------------
+
+def test_profile_host_math():
+    p = PROFILES["v5e-16"]
+    assert p.num_chips == 16
+    assert p.chips_per_host == 4
+    assert p.num_hosts == 4
+    assert p.host_grid == (2, 2)
+
+
+def test_profile_3d():
+    p = PROFILES["v5p-16"]
+    assert p.num_chips == 16
+    assert p.chips_per_host == 4
+    assert p.num_hosts == 4
+    assert p.host_grid == (1, 1, 4)
+
+
+def test_parse_topology_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_topology("4by4")
+    assert topology_chips("2x2x2") == 8
+
+
+# -- subslice profiles (MIG analog) -----------------------------------------
+
+def test_subslice_profiles_2x2():
+    profs = {p.name: p for p in compute_subslice_profiles("2x2")}
+    # Whole host (2x2) excluded; divisor shapes of (2,2) minus itself.
+    assert set(profs) == {"1x1", "1x2", "2x1"}
+    assert len(profs["1x1"].placements) == 4
+    assert len(profs["1x2"].placements) == 2
+    assert len(profs["2x1"].placements) == 2
+    # Placements tile without overlap.
+    seen = [i for pl in profs["1x2"].placements for i in pl.chip_indices]
+    assert sorted(seen) == [0, 1, 2, 3]
+
+
+def test_subslice_profiles_single_chip_host():
+    assert compute_subslice_profiles("1x1") == []
+
+
+def test_subslice_profiles_3d_host():
+    profs = {p.name: p for p in compute_subslice_profiles("2x2x1")}
+    assert "1x1x1" in profs
+    assert len(profs["1x1x1"].placements) == 4
+
+
+# -- mock backend ------------------------------------------------------------
+
+def test_mock_enumerate_v5e16_worker1():
+    lib = MockTpuLib("v5e-16", worker_id=1)
+    inv = lib.enumerate()
+    assert inv.gen == TpuGen.V5E
+    assert inv.num_hosts == 4
+    assert inv.worker_id == 1
+    assert len(inv.chips) == 4
+    # Worker 1's block origin is (0, 2) in the 4x4 grid (row-major host tiling).
+    assert {c.coords for c in inv.chips} == {(0, 2, 0), (0, 3, 0), (1, 2, 0), (1, 3, 0)}
+    assert all(c.hbm_bytes == 16 * 1024**3 for c in inv.chips)
+    assert inv.ici_domain == "mock-slice-v5e-16.0"
+    # 2x2 block has 4 intra-host links.
+    assert len(inv.links) == 4
+
+
+def test_mock_workers_disjoint_coords():
+    seen = set()
+    for w in range(4):
+        inv = MockTpuLib("v5e-16", worker_id=w).enumerate()
+        coords = {c.coords for c in inv.chips}
+        assert not (coords & seen)
+        seen |= coords
+    assert len(seen) == 16
+
+
+def test_mock_serials_stable_and_unique():
+    a = MockTpuLib("v5e-4").enumerate()
+    b = MockTpuLib("v5e-4").enumerate()
+    assert [c.serial for c in a.chips] == [c.serial for c in b.chips]
+    assert len({c.serial for c in a.chips}) == 4
+
+
+def test_mock_health_injection_and_watch():
+    lib = MockTpuLib("v5e-4")
+    events = []
+    lib.watch_health(lambda idx, h: events.append((idx, h)))
+    lib.set_health(2, ChipHealth.UNHEALTHY)
+    inv = lib.enumerate()
+    assert inv.chip_by_index(2).health == ChipHealth.UNHEALTHY
+    assert inv.chip_by_index(0).health == ChipHealth.HEALTHY
+    assert events == [(2, ChipHealth.UNHEALTHY)]
+
+
+def test_mock_worker_id_out_of_range():
+    with pytest.raises(ValueError):
+        MockTpuLib("v5e-4", worker_id=1)
+
+
+def test_factory_env_seam(monkeypatch):
+    monkeypatch.setenv("ALT_TPU_TOPOLOGY", "v5e-8")
+    monkeypatch.setenv("ALT_TPU_WORKER_ID", "1")
+    lib = new_tpulib()
+    inv = lib.enumerate()
+    assert inv.accelerator_type == "v5litepod-8"
+    assert inv.worker_id == 1
+
+
+# -- real backend + C++ shim -------------------------------------------------
+
+SHIM = os.path.join(os.path.dirname(__file__), "..", "native", "build", "libtpulib.so")
+
+
+def _make_fixture(tmp_path, n=4, with_sysfs=True):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    sysfs = tmp_path / "sys"
+    for i in range(n):
+        (dev / f"accel{i}").write_bytes(b"")
+        if with_sysfs:
+            pci = sysfs / "devices" / f"pci0000:00" / f"0000:00:{4+i:02x}.0"
+            pci.mkdir(parents=True)
+            (pci / "vendor").write_text("0x1ae0\n")
+            (pci / "numa_node").write_text("0\n" if i < n // 2 else "1\n")
+            (pci / "unique_id").write_text(f"serial-{i}\n")
+            cls = sysfs / "class" / "accel" / f"accel{i}"
+            cls.mkdir(parents=True)
+            os.symlink(pci, cls / "device")
+    (dev / "accelerators").write_bytes(b"")  # non-numeric suffix: ignored
+    (dev / "null0").write_bytes(b"")         # non-accel: ignored
+    return str(dev), str(sysfs)
+
+
+@pytest.mark.skipif(not os.path.exists(SHIM), reason="C++ shim not built")
+def test_cpp_shim_enumerates_fixture(tmp_path):
+    dev, sysfs = _make_fixture(tmp_path)
+    lib = RealTpuLib(lib_path=SHIM, dev_root=dev, sysfs_root=sysfs,
+                     env={"TPU_ACCELERATOR_TYPE": "v5litepod-4", "TPU_TOPOLOGY": "2x2"})
+    assert lib.native
+    assert lib.shim_version().startswith("tpulib")
+    inv = lib.enumerate()
+    assert len(inv.chips) == 4
+    assert inv.gen == TpuGen.V5E
+    assert [c.serial for c in inv.chips] == [f"serial-{i}" for i in range(4)]
+    assert inv.chips[0].pci_address == "0000:00:04.0"
+    assert inv.chips[3].numa_node == 1
+    assert inv.host_topology == "2x2"
+    assert {p.name for p in inv.subslice_profiles} == {"1x1", "1x2", "2x1"}
+
+
+@pytest.mark.skipif(not os.path.exists(SHIM), reason="C++ shim not built")
+def test_cpp_shim_health_probe(tmp_path):
+    dev, sysfs = _make_fixture(tmp_path, n=2)
+    lib = RealTpuLib(lib_path=SHIM, dev_root=dev, sysfs_root=sysfs, env={})
+    assert lib.chip_health(0) == ChipHealth.HEALTHY
+    assert lib.chip_health(9) == ChipHealth.UNHEALTHY
+
+
+def test_python_fallback_scan_matches_shim(tmp_path):
+    dev, sysfs = _make_fixture(tmp_path)
+    py = RealTpuLib(lib_path="/nonexistent/libtpulib.so", dev_root=dev,
+                    sysfs_root=sysfs, env={"TPU_ACCELERATOR_TYPE": "v5litepod-4",
+                                           "TPU_TOPOLOGY": "2x2"})
+    assert not py.native
+    inv_py = py.enumerate()
+    assert len(inv_py.chips) == 4
+    if os.path.exists(SHIM):
+        cc = RealTpuLib(lib_path=SHIM, dev_root=dev, sysfs_root=sysfs,
+                        env={"TPU_ACCELERATOR_TYPE": "v5litepod-4", "TPU_TOPOLOGY": "2x2"})
+        inv_cc = cc.enumerate()
+        assert [(c.index, c.dev_path, c.pci_address, c.serial, c.numa_node)
+                for c in inv_py.chips] == \
+               [(c.index, c.dev_path, c.pci_address, c.serial, c.numa_node)
+                for c in inv_cc.chips]
+
+
+def test_real_backend_empty_host(tmp_path):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    lib = RealTpuLib(lib_path="/nonexistent", dev_root=str(dev),
+                     sysfs_root=str(tmp_path / "sys"), env={})
+    inv = lib.enumerate()
+    assert inv.chips == []
+    assert inv.num_hosts == 1
+
+
+def test_multihost_env_identity(tmp_path):
+    dev, sysfs = _make_fixture(tmp_path)
+    env = {
+        "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+        "TPU_TOPOLOGY": "4x4",
+        "TPU_WORKER_ID": "2",
+        "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3",
+        "TPU_SLICE_UID": "slice-abc",
+    }
+    lib = RealTpuLib(lib_path="/nonexistent", dev_root=dev, sysfs_root=sysfs, env=env)
+    inv = lib.enumerate()
+    assert inv.num_hosts == 4
+    assert inv.worker_id == 2
+    assert inv.ici_domain == "slice-abc.0"
+    assert inv.host_topology == "2x2"
+    # Worker 2's origin in row-major host tiling of 4x4 by 2x2 blocks: (2, 0).
+    assert {c.coords for c in inv.chips} == {(2, 0, 0), (2, 1, 0), (3, 0, 0), (3, 1, 0)}
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_info_mock(monkeypatch, capsys):
+    from k8s_dra_driver_tpu.tpulib import cli
+
+    monkeypatch.setenv("ALT_TPU_TOPOLOGY", "v5e-4")
+    assert cli.main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "backend: mock" in out
+    assert "/dev/accel0" in out
+    assert "subslice profiles" in out
+
+
+def test_cli_info_json(monkeypatch, capsys):
+    from k8s_dra_driver_tpu.tpulib import cli
+
+    monkeypatch.setenv("ALT_TPU_TOPOLOGY", "v5e-4")
+    assert cli.main(["info", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert len(data["chips"]) == 4
+    assert data["gen"] == "v5e"
