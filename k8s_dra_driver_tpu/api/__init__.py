@@ -1,0 +1,36 @@
+"""resource.tpu.google.com/v1beta1 — CRDs and opaque device configs.
+
+The TPU-native counterpart of /root/reference/api/nvidia.com/resource/
+v1beta1: ComputeDomain + ComputeDomainClique CRD types, and the opaque
+per-claim config taxonomy (TpuConfig, SubsliceConfig, VfioTpuConfig,
+ComputeDomain{Channel,Daemon}Config, sharing) with Normalize/Validate and
+strict/nonstrict decoding.
+"""
+
+from k8s_dra_driver_tpu.api.configs import (  # noqa: F401
+    API_GROUP,
+    API_VERSION,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    DecodeError,
+    DeviceConfig,
+    MpsLikePremappedConfig,
+    SharingConfig,
+    SubsliceConfig,
+    TimeSlicingConfig,
+    TpuConfig,
+    ValidationError,
+    VfioTpuConfig,
+    decode_config,
+    nonstrict_decode,
+    strict_decode,
+)
+from k8s_dra_driver_tpu.api.computedomain import (  # noqa: F401
+    COMPUTE_DOMAIN_FINALIZER,
+    ComputeDomain,
+    ComputeDomainClique,
+    ComputeDomainDaemonInfo,
+    ComputeDomainNode,
+    ComputeDomainSpec,
+    ComputeDomainStatus,
+)

@@ -1,0 +1,104 @@
+"""ComputeDomain + ComputeDomainClique CRD types.
+
+Reference shapes: ComputeDomain{Spec,Status,Node}
+(/root/reference/api/nvidia.com/resource/v1beta1/computedomain.go:39-143,
+numNodes semantics 63-93) and ComputeDomainClique + DaemonInfo
+(computedomainclique.go:30-57). TPU re-interpretation: a ComputeDomain
+assembles a multi-host ICI pod slice; cliques key on the ICI domain id
+(sliceUID.partition) instead of the NVLink clusterUUID.cliqueID.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE
+from k8s_dra_driver_tpu.k8s.objects import K8sObject
+
+COMPUTE_DOMAIN_FINALIZER = "resource.tpu.google.com/computedomain"
+
+# Node label key the CD plugin sets (value = CD uid) at workload Prepare
+# time; the controller's DaemonSet node-selects on it (follow-the-workload,
+# /root/reference/cmd/compute-domain-kubelet-plugin/computedomain.go:372-400).
+COMPUTE_DOMAIN_NODE_LABEL = "resource.tpu.google.com/computeDomain"
+
+
+CD_STATUS_READY = "Ready"
+CD_STATUS_NOT_READY = "NotReady"
+
+# Default cap on hosts per domain, the 18-node IMEX-domain analog
+# (/root/reference/cmd/compute-domain-controller/main.go:55-60). A v5e pod
+# slice tops out at 64 hosts (v5e-256 = 64 hosts x 4 chips).
+DEFAULT_MAX_NODES_PER_DOMAIN = 64
+
+
+@dataclass
+class ComputeDomainChannelSpec:
+    resource_claim_template_name: str = ""
+
+
+@dataclass
+class ComputeDomainSpec:
+    # Number of hosts the domain must span before it reports Ready.
+    # 0 means "size follows the workload" (the deprecated-numNodes semantics
+    # the reference converged on, computedomain.go:63-93).
+    num_nodes: int = 0
+    # Optional requested slice shape, e.g. "4x4"; validated against what the
+    # member hosts actually report.
+    topology: str = ""
+    channel: ComputeDomainChannelSpec = field(default_factory=ComputeDomainChannelSpec)
+
+
+@dataclass
+class ComputeDomainNode:
+    name: str = ""
+    ip_address: str = ""
+    ici_domain: str = ""     # cliqueID analog
+    worker_id: int = -1
+    status: str = CD_STATUS_NOT_READY
+
+
+@dataclass
+class ComputeDomainStatus:
+    status: str = CD_STATUS_NOT_READY
+    nodes: List[ComputeDomainNode] = field(default_factory=list)
+
+
+@dataclass
+class ComputeDomain(K8sObject):
+    kind: str = COMPUTE_DOMAIN
+    spec: ComputeDomainSpec = field(default_factory=ComputeDomainSpec)
+    status: ComputeDomainStatus = field(default_factory=ComputeDomainStatus)
+
+
+@dataclass
+class ComputeDomainDaemonInfo:
+    node_name: str = ""
+    ip_address: str = ""
+    dns_name: str = ""
+    # Stable per-domain index, CAS-allocated on the clique
+    # (/root/reference/cmd/compute-domain-daemon/cdclique.go:350-372);
+    # becomes TPU_WORKER_ID for the workload.
+    index: int = -1
+    ready: bool = False
+
+
+@dataclass
+class ComputeDomainClique(K8sObject):
+    """Membership record for one ICI domain within one ComputeDomain.
+    Named ``<cd-uid>.<ici-domain-hash>``."""
+
+    kind: str = COMPUTE_DOMAIN_CLIQUE
+    domain_uid: str = ""
+    ici_domain: str = ""
+    nodes: List[ComputeDomainDaemonInfo] = field(default_factory=list)
+
+    def node_info(self, node_name: str) -> Optional[ComputeDomainDaemonInfo]:
+        for n in self.nodes:
+            if n.node_name == node_name:
+                return n
+        return None
+
+    def used_indices(self) -> Dict[int, str]:
+        return {n.index: n.node_name for n in self.nodes if n.index >= 0}

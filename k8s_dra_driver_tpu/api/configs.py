@@ -1,0 +1,270 @@
+"""Opaque per-claim device configs with Normalize/Validate + strict decoding.
+
+Mirrors the reference's config taxonomy and its two-phase hygiene
+(/root/reference/api/nvidia.com/resource/v1beta1/api.go:41-58): the webhook
+strict-decodes at admission so bad configs fail fast; the kubelet plugin
+re-decodes strictly at Prepare. Config classes:
+
+- TpuConfig       (GpuConfig analog, gpuconfig.go:29-83): sharing policy.
+- SubsliceConfig  (MigDeviceConfig analog, migconfig.go:28-70).
+- VfioTpuConfig   (VfioDeviceConfig analog, vfiodeviceconfig.go:29-85).
+- ComputeDomainChannelConfig / ComputeDomainDaemonConfig
+  (computedomainconfig.go:28-86).
+- Sharing: TimeSlicingConfig (Default/Short/Medium/Long) and
+  MpsLikePremappedConfig — the TPU analog of MPS pinned-memory limits is a
+  premapped-HBM budget per chip (sharing.go:28-260).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields as dc_fields
+from typing import Any, Dict, Optional, Type
+
+API_GROUP = "resource.tpu.google.com"
+API_VERSION = f"{API_GROUP}/v1beta1"
+
+TPU_DRIVER_NAME = "tpu.google.com"
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.google.com"
+
+
+class DecodeError(ValueError):
+    pass
+
+
+class ValidationError(ValueError):
+    pass
+
+
+TIME_SLICE_INTERVALS = ("Default", "Short", "Medium", "Long")
+
+
+@dataclass
+class TimeSlicingConfig:
+    interval: str = "Default"
+
+    def normalize(self) -> None:
+        if not self.interval:
+            self.interval = "Default"
+
+    def validate(self) -> None:
+        if self.interval not in TIME_SLICE_INTERVALS:
+            raise ValidationError(
+                f"unknown time-slice interval {self.interval!r}; "
+                f"want one of {TIME_SLICE_INTERVALS}"
+            )
+
+
+@dataclass
+class MpsLikePremappedConfig:
+    """Multi-process chip sharing via premapped HBM budgets.
+
+    default_premapped_hbm_bytes applies to every sharing process; per-chip
+    overrides key by chip index (the per-device pinned-memory-limit shape of
+    the reference's MPS config, sharing.go:175-260).
+    """
+
+    default_premapped_hbm_bytes: int = 0
+    per_chip_premapped_hbm_bytes: Dict[int, int] = field(default_factory=dict)
+
+    def normalize(self) -> None:
+        self.per_chip_premapped_hbm_bytes = {
+            int(k): int(v) for k, v in self.per_chip_premapped_hbm_bytes.items()
+        }
+
+    def validate(self) -> None:
+        if self.default_premapped_hbm_bytes < 0:
+            raise ValidationError("default_premapped_hbm_bytes must be >= 0")
+        for idx, v in self.per_chip_premapped_hbm_bytes.items():
+            if idx < 0 or v < 0:
+                raise ValidationError(
+                    f"per_chip_premapped_hbm_bytes[{idx}]={v} must be >= 0"
+                )
+
+
+SHARING_STRATEGIES = ("TimeSlicing", "Premapped")
+
+
+@dataclass
+class SharingConfig:
+    strategy: str = "TimeSlicing"
+    time_slicing: Optional[TimeSlicingConfig] = None
+    premapped: Optional[MpsLikePremappedConfig] = None
+
+    def normalize(self) -> None:
+        if self.strategy == "TimeSlicing" and self.time_slicing is None:
+            self.time_slicing = TimeSlicingConfig()
+        if self.time_slicing:
+            self.time_slicing.normalize()
+        if self.premapped:
+            self.premapped.normalize()
+
+    def validate(self) -> None:
+        if self.strategy not in SHARING_STRATEGIES:
+            raise ValidationError(
+                f"unknown sharing strategy {self.strategy!r}; want one of {SHARING_STRATEGIES}"
+            )
+        if self.strategy == "TimeSlicing":
+            if self.premapped is not None:
+                raise ValidationError("premapped config set but strategy is TimeSlicing")
+            assert self.time_slicing is not None
+            self.time_slicing.validate()
+        else:
+            if self.time_slicing is not None and self.time_slicing.interval != "Default":
+                raise ValidationError("time_slicing config set but strategy is Premapped")
+            if self.premapped is None:
+                raise ValidationError("strategy Premapped requires a premapped config")
+            self.premapped.validate()
+
+
+@dataclass
+class DeviceConfig:
+    """Base: every opaque config carries kind + normalize/validate."""
+
+    def normalize(self) -> None:  # pragma: no cover — overridden
+        pass
+
+    def validate(self) -> None:  # pragma: no cover — overridden
+        pass
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class TpuConfig(DeviceConfig):
+    sharing: Optional[SharingConfig] = None
+
+    def normalize(self) -> None:
+        if self.sharing:
+            self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.sharing:
+            self.sharing.validate()
+
+
+@dataclass
+class SubsliceConfig(DeviceConfig):
+    """Config for dynamically-carved ICI subslices (DynamicSubslice gate)."""
+
+    profile: str = ""        # e.g. "1x2"; empty = as allocated
+    sharing: Optional[SharingConfig] = None
+
+    def normalize(self) -> None:
+        if self.sharing:
+            self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.profile:
+            from k8s_dra_driver_tpu.tpulib.types import parse_topology
+
+            try:
+                parse_topology(self.profile)
+            except ValueError as e:
+                raise ValidationError(str(e)) from None
+        if self.sharing:
+            self.sharing.validate()
+
+
+IOMMU_MODES = ("auto", "legacy", "iommufd")
+
+
+@dataclass
+class VfioTpuConfig(DeviceConfig):
+    """Passthrough config (PassthroughSupport gate)."""
+
+    iommu_mode: str = "auto"
+
+    def normalize(self) -> None:
+        if not self.iommu_mode:
+            self.iommu_mode = "auto"
+        self.iommu_mode = self.iommu_mode.lower()
+
+    def validate(self) -> None:
+        if self.iommu_mode not in IOMMU_MODES:
+            raise ValidationError(
+                f"unknown iommu_mode {self.iommu_mode!r}; want one of {IOMMU_MODES}"
+            )
+
+
+@dataclass
+class ComputeDomainChannelConfig(DeviceConfig):
+    domain_id: str = ""  # uid of the ComputeDomain this channel belongs to
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ValidationError("domain_id is required")
+
+
+@dataclass
+class ComputeDomainDaemonConfig(DeviceConfig):
+    domain_id: str = ""
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ValidationError("domain_id is required")
+
+
+_KINDS: Dict[str, Type[DeviceConfig]] = {
+    "TpuConfig": TpuConfig,
+    "SubsliceConfig": SubsliceConfig,
+    "VfioTpuConfig": VfioTpuConfig,
+    "ComputeDomainChannelConfig": ComputeDomainChannelConfig,
+    "ComputeDomainDaemonConfig": ComputeDomainDaemonConfig,
+}
+
+_NESTED: Dict[str, Type] = {
+    "sharing": SharingConfig,
+    "time_slicing": TimeSlicingConfig,
+    "premapped": MpsLikePremappedConfig,
+}
+
+
+def _build(cls: Type, data: Dict[str, Any], strict: bool, path: str):
+    known = {f.name: f for f in dc_fields(cls)}
+    kwargs: Dict[str, Any] = {}
+    for k, v in data.items():
+        if k not in known:
+            if strict:
+                raise DecodeError(f"unknown field {path + k!r} for {cls.__name__}")
+            continue
+        if k in _NESTED and isinstance(v, dict):
+            kwargs[k] = _build(_NESTED[k], v, strict, f"{path}{k}.")
+        else:
+            kwargs[k] = v
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise DecodeError(f"bad config for {cls.__name__}: {e}") from None
+
+
+def decode_config(parameters: Dict[str, Any], strict: bool) -> DeviceConfig:
+    """Decode an opaque ``parameters`` blob into a typed config.
+
+    Expects ``apiVersion`` = resource.tpu.google.com/v1beta1 and a known
+    ``kind``; remaining keys are the config body.
+    """
+    if not isinstance(parameters, dict):
+        raise DecodeError(f"opaque parameters must be an object, got {type(parameters)}")
+    api_version = parameters.get("apiVersion", "")
+    if api_version != API_VERSION:
+        raise DecodeError(
+            f"unsupported apiVersion {api_version!r}; want {API_VERSION}"
+        )
+    kind = parameters.get("kind", "")
+    cls = _KINDS.get(kind)
+    if cls is None:
+        raise DecodeError(f"unknown config kind {kind!r}; known: {sorted(_KINDS)}")
+    body = {k: v for k, v in parameters.items() if k not in ("apiVersion", "kind")}
+    cfg = _build(cls, body, strict, path="")
+    cfg.normalize()
+    return cfg
+
+
+def strict_decode(parameters: Dict[str, Any]) -> DeviceConfig:
+    return decode_config(parameters, strict=True)
+
+
+def nonstrict_decode(parameters: Dict[str, Any]) -> DeviceConfig:
+    return decode_config(parameters, strict=False)

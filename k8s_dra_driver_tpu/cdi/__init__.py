@@ -1,0 +1,8 @@
+"""CDI (Container Device Interface) spec generation."""
+
+from k8s_dra_driver_tpu.cdi.handler import (  # noqa: F401
+    CDIHandler,
+    ContainerEdits,
+    CDI_VERSION,
+    CLAIM_SPEC_KIND,
+)

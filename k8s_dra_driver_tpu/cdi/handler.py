@@ -1,0 +1,117 @@
+"""Claim-scoped CDI spec files.
+
+CDI is the only mechanism by which devices reach containers — the plugin
+never touches the container itself (SURVEY.md §1 L3→runtime;
+/root/reference/cmd/gpu-kubelet-plugin/cdi.go:44-49,181-307). Shape kept
+from the reference: one spec file per claim UID under the CDI root, spec
+kind ``k8s.tpu.google.com/claim``, fully-qualified device ids
+``k8s.tpu.google.com/claim=<uid>-<device>`` returned to the kubelet.
+
+The default root is /var/run/cdi — the runtime's default scan dir (the
+reference's chart sets CDI_ROOT there; /etc/cdi is only its CLI default).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+CDI_VERSION = "0.6.0"
+CLAIM_SPEC_KIND = "k8s.tpu.google.com/claim"
+DEFAULT_CDI_ROOT = "/var/run/cdi"
+
+
+@dataclass
+class ContainerEdits:
+    device_nodes: List[str] = field(default_factory=list)   # host paths
+    env: Dict[str, str] = field(default_factory=dict)
+    mounts: List[Dict[str, str]] = field(default_factory=list)  # {host_path, container_path, [options]}
+    hooks: List[Dict[str, object]] = field(default_factory=list)
+
+    def merged(self, other: "ContainerEdits") -> "ContainerEdits":
+        return ContainerEdits(
+            device_nodes=[*self.device_nodes, *other.device_nodes],
+            env={**self.env, **other.env},
+            mounts=[*self.mounts, *other.mounts],
+            hooks=[*self.hooks, *other.hooks],
+        )
+
+    def to_cdi(self) -> dict:
+        out: dict = {}
+        if self.device_nodes:
+            out["deviceNodes"] = [{"path": p} for p in self.device_nodes]
+        if self.env:
+            out["env"] = [f"{k}={v}" for k, v in sorted(self.env.items())]
+        if self.mounts:
+            out["mounts"] = [
+                {
+                    "hostPath": m["host_path"],
+                    "containerPath": m["container_path"],
+                    "options": m.get("options", "rw,bind").split(","),
+                }
+                for m in self.mounts
+            ]
+        if self.hooks:
+            out["hooks"] = list(self.hooks)
+        return out
+
+
+class CDIHandler:
+    def __init__(self, cdi_root: Optional[str] = None):
+        self.cdi_root = cdi_root or os.environ.get("CDI_ROOT", DEFAULT_CDI_ROOT)
+
+    def _spec_path(self, claim_uid: str) -> str:
+        return os.path.join(
+            self.cdi_root, f"{CLAIM_SPEC_KIND.replace('/', '-')}_{claim_uid}.yaml"
+        )
+
+    @staticmethod
+    def device_id(claim_uid: str, device_name: str) -> str:
+        return f"{CLAIM_SPEC_KIND}={claim_uid}-{device_name}"
+
+    def create_claim_spec_file(
+        self,
+        claim_uid: str,
+        per_device_edits: Dict[str, ContainerEdits],
+        common_edits: Optional[ContainerEdits] = None,
+    ) -> List[str]:
+        """Write the claim's spec; returns fully-qualified CDI device ids."""
+        devices = []
+        ids = []
+        for device_name in sorted(per_device_edits):
+            edits = per_device_edits[device_name]
+            if common_edits is not None:
+                edits = common_edits.merged(edits)
+            devices.append(
+                {"name": f"{claim_uid}-{device_name}", "containerEdits": edits.to_cdi()}
+            )
+            ids.append(self.device_id(claim_uid, device_name))
+        spec = {"cdiVersion": CDI_VERSION, "kind": CLAIM_SPEC_KIND, "devices": devices}
+        os.makedirs(self.cdi_root, exist_ok=True)
+        path = self._spec_path(claim_uid)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            yaml.safe_dump(spec, f, sort_keys=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return ids
+
+    def delete_claim_spec_file(self, claim_uid: str) -> None:
+        try:
+            os.unlink(self._spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def claim_spec_exists(self, claim_uid: str) -> bool:
+        return os.path.exists(self._spec_path(claim_uid))
+
+    def read_claim_spec(self, claim_uid: str) -> Optional[dict]:
+        try:
+            with open(self._spec_path(claim_uid), "r", encoding="utf-8") as f:
+                return yaml.safe_load(f)
+        except FileNotFoundError:
+            return None

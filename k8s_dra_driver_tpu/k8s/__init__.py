@@ -1,0 +1,23 @@
+"""In-memory Kubernetes API machinery.
+
+The reference drives a real API server through generated clientsets and
+tests against a generated fake (/root/reference/pkg/nvidia.com/clientset/
+versioned/fake/). This package is the analog for a Python driver with no
+cluster in the loop: a faithful-enough API server core — namespaced stores,
+resourceVersion optimistic concurrency, finalizer-aware deletion, watches —
+plus informers/listers on top. Controllers and plugins are written against
+these interfaces only, so pointing them at a real API server later is an
+adapter, not a rewrite.
+"""
+
+from k8s_dra_driver_tpu.k8s.objects import (  # noqa: F401
+    ApiError,
+    ConflictError,
+    AlreadyExistsError,
+    NotFoundError,
+    K8sObject,
+    ObjectMeta,
+    OwnerReference,
+)
+from k8s_dra_driver_tpu.k8s.store import APIServer, WatchEvent  # noqa: F401
+from k8s_dra_driver_tpu.k8s.informer import Informer  # noqa: F401

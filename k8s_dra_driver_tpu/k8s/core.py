@@ -1,0 +1,230 @@
+"""Core + DRA object kinds (the subset the driver exercises).
+
+Models the resource.k8s.io v1beta1 DRA surface the reference programs
+against — ResourceSlice/ResourceClaim/DeviceClass with KEP-4815 counter
+sets — plus the core kinds (Pod, Node, DaemonSet) the ComputeDomain stack
+manipulates. Field names follow the k8s API in snake_case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from k8s_dra_driver_tpu.k8s.objects import K8sObject, ObjectMeta
+
+# Kind names --------------------------------------------------------------
+
+POD = "Pod"
+NODE = "Node"
+DAEMON_SET = "DaemonSet"
+DEPLOYMENT = "Deployment"
+RESOURCE_CLAIM = "ResourceClaim"
+RESOURCE_CLAIM_TEMPLATE = "ResourceClaimTemplate"
+RESOURCE_SLICE = "ResourceSlice"
+DEVICE_CLASS = "DeviceClass"
+COMPUTE_DOMAIN = "ComputeDomain"
+COMPUTE_DOMAIN_CLIQUE = "ComputeDomainClique"
+
+
+# -- DRA building blocks ---------------------------------------------------
+
+@dataclass
+class OpaqueDeviceConfig:
+    """Per-driver opaque config blob attached to a request."""
+
+    driver: str = ""
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DeviceClaimConfig:
+    requests: List[str] = field(default_factory=list)  # empty = all requests
+    opaque: Optional[OpaqueDeviceConfig] = None
+    # Where this config came from: "claim" or "class" — drives precedence
+    # (/root/reference/cmd/gpu-kubelet-plugin/device_state.go:1399-1463).
+    source: str = "claim"
+
+
+@dataclass
+class DeviceRequest:
+    name: str = ""
+    device_class_name: str = ""
+    allocation_mode: str = "ExactCount"  # or "All"
+    count: int = 1
+    selectors: List[str] = field(default_factory=list)  # CEL-ish exprs, unused in fake
+
+
+@dataclass
+class DeviceTaint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"  # or NoExecute
+
+
+@dataclass
+class Counter:
+    value: int = 0
+
+
+@dataclass
+class CounterSet:
+    """KEP-4815 shared counters on a ResourceSlice."""
+
+    name: str = ""
+    counters: Dict[str, Counter] = field(default_factory=dict)
+
+
+@dataclass
+class DeviceCounterConsumption:
+    counter_set: str = ""
+    counters: Dict[str, Counter] = field(default_factory=dict)
+
+
+@dataclass
+class Device:
+    name: str = ""
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    capacity: Dict[str, Any] = field(default_factory=dict)
+    taints: List[DeviceTaint] = field(default_factory=list)
+    consumes_counters: List[DeviceCounterConsumption] = field(default_factory=list)
+
+
+@dataclass
+class ResourcePool:
+    name: str = ""
+    generation: int = 0
+    resource_slice_count: int = 1
+
+
+@dataclass
+class DeviceRequestAllocationResult:
+    request: str = ""
+    driver: str = ""
+    pool: str = ""
+    device: str = ""
+
+
+@dataclass
+class AllocationResult:
+    devices: List[DeviceRequestAllocationResult] = field(default_factory=list)
+    node_name: str = ""
+
+
+@dataclass
+class ResourceClaimConsumer:
+    kind: str = "Pod"
+    name: str = ""
+    uid: str = ""
+
+
+# -- kinds ------------------------------------------------------------------
+
+@dataclass
+class ResourceClaim(K8sObject):
+    kind: str = RESOURCE_CLAIM
+    requests: List[DeviceRequest] = field(default_factory=list)
+    config: List[DeviceClaimConfig] = field(default_factory=list)
+    allocation: Optional[AllocationResult] = None
+    reserved_for: List[ResourceClaimConsumer] = field(default_factory=list)
+
+
+@dataclass
+class ResourceClaimTemplate(K8sObject):
+    kind: str = RESOURCE_CLAIM_TEMPLATE
+    spec_meta_labels: Dict[str, str] = field(default_factory=dict)
+    spec_meta_annotations: Dict[str, str] = field(default_factory=dict)
+    requests: List[DeviceRequest] = field(default_factory=list)
+    config: List[DeviceClaimConfig] = field(default_factory=list)
+
+
+@dataclass
+class ResourceSlice(K8sObject):
+    kind: str = RESOURCE_SLICE
+    driver: str = ""
+    node_name: str = ""
+    pool: ResourcePool = field(default_factory=ResourcePool)
+    devices: List[Device] = field(default_factory=list)
+    shared_counters: List[CounterSet] = field(default_factory=list)
+
+
+@dataclass
+class DeviceClass(K8sObject):
+    kind: str = DEVICE_CLASS
+    driver: str = ""  # selector: device.driver == driver
+    config: List[DeviceClaimConfig] = field(default_factory=list)
+
+
+@dataclass
+class PodResourceClaimRef:
+    name: str = ""                         # name within the pod spec
+    resource_claim_name: str = ""          # direct claim reference
+    resource_claim_template_name: str = "" # template to instantiate
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = "False"
+
+
+@dataclass
+class Pod(K8sObject):
+    kind: str = POD
+    node_name: str = ""
+    containers: List[Container] = field(default_factory=list)
+    resource_claims: List[PodResourceClaimRef] = field(default_factory=list)
+    phase: str = "Pending"
+    pod_ip: str = ""
+    ready: bool = False
+    conditions: List[PodCondition] = field(default_factory=list)
+
+
+@dataclass
+class NodeTaint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class Node(K8sObject):
+    kind: str = NODE
+    taints: List[NodeTaint] = field(default_factory=list)
+    addresses: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PodTemplate:
+    labels: Dict[str, str] = field(default_factory=dict)
+    containers: List[Container] = field(default_factory=list)
+    resource_claims: List[PodResourceClaimRef] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DaemonSet(K8sObject):
+    kind: str = DAEMON_SET
+    selector: Dict[str, str] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplate = field(default_factory=PodTemplate)
+    desired: int = 0
+    ready: int = 0
+
+
+@dataclass
+class Deployment(K8sObject):
+    kind: str = DEPLOYMENT
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplate = field(default_factory=PodTemplate)
+    ready: int = 0

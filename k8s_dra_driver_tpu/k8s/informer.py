@@ -1,0 +1,140 @@
+"""Informer: list+watch cache with event handlers and listers.
+
+The shape of client-go's SharedInformer the reference leans on
+(/root/reference/pkg/nvidia.com/informers/...): a thread consumes the watch
+stream into a local cache; handlers fire on add/update/delete; listers read
+the cache without touching the API server.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+from k8s_dra_driver_tpu.k8s.objects import K8sObject
+from k8s_dra_driver_tpu.k8s.store import APIServer, WatchEvent
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Optional[K8sObject], K8sObject], None]
+# add: (None, new); update: (old, new); delete: (old, old)
+
+
+class Informer:
+    def __init__(self, api: APIServer, kind: str):
+        self.api = api
+        self.kind = kind
+        self._cache: Dict[str, K8sObject] = {}
+        self._mu = threading.RLock()
+        self._on_add: List[Handler] = []
+        self._on_update: List[Handler] = []
+        self._on_delete: List[Handler] = []
+        self._thread: Optional[threading.Thread] = None
+        self._queue: Optional["queue.Queue[WatchEvent]"] = None
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+
+    def add_event_handler(
+        self,
+        on_add: Optional[Handler] = None,
+        on_update: Optional[Handler] = None,
+        on_delete: Optional[Handler] = None,
+    ) -> None:
+        if on_add:
+            self._on_add.append(on_add)
+        if on_update:
+            self._on_update.append(on_update)
+        if on_delete:
+            self._on_delete.append(on_delete)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("informer already started")
+        objs, self._queue = self.api.list_and_watch(self.kind)
+        with self._mu:
+            for o in objs:
+                self._cache[o.key] = o
+        for o in objs:
+            self._dispatch(self._on_add, None, o)
+        self._synced.set()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._queue is not None:
+            self.api.stop_watch(self.kind, self._queue)
+            self._queue.put(None)  # type: ignore[arg-type] — wake the loop
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def _run(self) -> None:
+        assert self._queue is not None
+        while not self._stop.is_set():
+            try:
+                ev = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if ev is None:
+                break
+            self._handle(ev)
+
+    def _handle(self, ev: WatchEvent) -> None:
+        key = ev.obj.key
+        with self._mu:
+            old = self._cache.get(key)
+            if ev.type == "DELETED":
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = ev.obj
+        if ev.type == "ADDED" and old is None:
+            self._dispatch(self._on_add, None, ev.obj)
+        elif ev.type == "DELETED":
+            self._dispatch(self._on_delete, old or ev.obj, old or ev.obj)
+        else:
+            self._dispatch(self._on_update, old, ev.obj)
+
+    @staticmethod
+    def _dispatch(handlers: List[Handler], old: Optional[K8sObject], new: K8sObject) -> None:
+        for h in handlers:
+            try:
+                h(old, new)
+            except Exception:  # noqa: BLE001 — handler bugs must not kill the informer
+                log.exception("informer handler failed for %s", new.key)
+
+    # -- lister ------------------------------------------------------------
+
+    def get(self, name: str, namespace: str = "") -> Optional[K8sObject]:
+        key = f"{namespace}/{name}" if namespace else name
+        with self._mu:
+            obj = self._cache.get(key)
+            return obj.deepcopy() if obj else None
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[K8sObject]:
+        with self._mu:
+            out = []
+            for obj in self._cache.values():
+                if namespace is not None and obj.meta.namespace != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.meta.labels.get(k) == v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(obj.deepcopy())
+            out.sort(key=lambda o: o.key)
+            return out

@@ -1,0 +1,110 @@
+"""Object model: metadata, ownership, errors.
+
+Deliberately small: the fields the driver actually exercises (the same
+subset the reference touches through client-go) — names/namespaces/uids,
+labels, optimistic-concurrency resourceVersions, finalizers + deletion
+timestamps, and owner references.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch — the CAS failure callers retry on."""
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+
+
+@dataclass
+class K8sObject:
+    """Base for every stored object. ``kind`` is the type key; subclasses
+    add ``spec``/``status``-shaped fields (plain dataclasses or dicts)."""
+
+    kind: str = ""
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+    # -- convenience -------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def namespace(self) -> str:
+        return self.meta.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.meta.uid
+
+    @property
+    def key(self) -> str:
+        return f"{self.meta.namespace}/{self.meta.name}" if self.meta.namespace else self.meta.name
+
+    @property
+    def deleting(self) -> bool:
+        return self.meta.deletion_timestamp is not None
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+    def owned_by(self, owner: "K8sObject") -> bool:
+        return any(r.uid == owner.uid for r in self.meta.owner_references)
+
+    def add_owner(self, owner: "K8sObject") -> None:
+        if not self.owned_by(owner):
+            self.meta.owner_references.append(
+                OwnerReference(kind=owner.kind, name=owner.name, uid=owner.uid)
+            )
+
+
+def new_meta(name: str, namespace: str = "", labels: Optional[Dict[str, str]] = None,
+             **kw: Any) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {}), **kw)
+
+
+def fresh_uid() -> str:
+    return uuid.uuid4().hex
+
+
+def now() -> float:
+    return time.time()

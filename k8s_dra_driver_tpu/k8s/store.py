@@ -1,0 +1,225 @@
+"""APIServer: namespaced stores with optimistic concurrency and watches.
+
+Semantics kept faithful to the pieces the driver depends on:
+
+- create/update/delete return deep copies; callers never share memory with
+  the store (a real API server serializes through the wire).
+- update() is CAS on metadata.resourceVersion → ConflictError on mismatch.
+  This is what the daemon's clique index allocation relies on
+  (/root/reference/cmd/compute-domain-daemon/cdclique.go:350-372).
+- delete() on an object with finalizers sets deletionTimestamp and emits
+  MODIFIED; the object is only removed once an update drops the last
+  finalizer — the controller's finalizer dance (computedomain.go:316-330).
+- watch() streams ADDED/MODIFIED/DELETED events from the moment of
+  subscription; informers do list+watch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.objects import (
+    AlreadyExistsError,
+    ConflictError,
+    K8sObject,
+    NotFoundError,
+    fresh_uid,
+    now,
+)
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str            # ADDED | MODIFIED | DELETED
+    obj: K8sObject
+
+
+_Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def _match_labels(obj: K8sObject, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(obj.meta.labels.get(k) == v for k, v in selector.items())
+
+
+class APIServer:
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._objects: Dict[_Key, K8sObject] = {}
+        self._rv = 0
+        self._watchers: Dict[str, List["queue.Queue[WatchEvent]"]] = {}
+
+    # -- internal ----------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _emit(self, kind: str, event: WatchEvent) -> None:
+        for q in self._watchers.get(kind, []):
+            q.put(event)
+
+    @staticmethod
+    def _key(obj: K8sObject) -> _Key:
+        return (obj.kind, obj.meta.namespace, obj.meta.name)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: K8sObject) -> K8sObject:
+        if not obj.kind or not obj.meta.name:
+            raise ApiValueError("object needs kind and metadata.name")
+        with self._mu:
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            stored = obj.deepcopy()
+            stored.meta.uid = stored.meta.uid or fresh_uid()
+            stored.meta.resource_version = self._next_rv()
+            stored.meta.generation = 1
+            stored.meta.creation_timestamp = stored.meta.creation_timestamp or now()
+            stored.meta.deletion_timestamp = None
+            self._objects[key] = stored
+            out = stored.deepcopy()
+            self._emit(obj.kind, WatchEvent("ADDED", stored.deepcopy()))
+            return out
+
+    def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
+        with self._mu:
+            key = (kind, namespace, name)
+            try:
+                return self._objects[key].deepcopy()
+            except KeyError:
+                raise NotFoundError(f"{key} not found") from None
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[K8sObject]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[K8sObject]:
+        with self._mu:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if not _match_labels(obj, label_selector):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    def update(self, obj: K8sObject) -> K8sObject:
+        """CAS write. The stored object is replaced wholesale; finalizer
+        removal on a deleting object completes its deletion."""
+        with self._mu:
+            key = self._key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            if obj.meta.resource_version != cur.meta.resource_version:
+                raise ConflictError(
+                    f"{key}: resourceVersion {obj.meta.resource_version} != "
+                    f"{cur.meta.resource_version}"
+                )
+            stored = obj.deepcopy()
+            stored.meta.uid = cur.meta.uid
+            stored.meta.creation_timestamp = cur.meta.creation_timestamp
+            stored.meta.deletion_timestamp = cur.meta.deletion_timestamp
+            stored.meta.resource_version = self._next_rv()
+            stored.meta.generation = cur.meta.generation + 1
+            if stored.meta.deletion_timestamp is not None and not stored.meta.finalizers:
+                del self._objects[key]
+                self._emit(obj.kind, WatchEvent("DELETED", stored.deepcopy()))
+                return stored.deepcopy()
+            self._objects[key] = stored
+            self._emit(obj.kind, WatchEvent("MODIFIED", stored.deepcopy()))
+            return stored.deepcopy()
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._mu:
+            key = (kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            if cur.meta.finalizers:
+                if cur.meta.deletion_timestamp is None:
+                    cur.meta.deletion_timestamp = now()
+                    cur.meta.resource_version = self._next_rv()
+                    self._emit(kind, WatchEvent("MODIFIED", cur.deepcopy()))
+                return
+            del self._objects[key]
+            self._emit(kind, WatchEvent("DELETED", cur.deepcopy()))
+
+    # -- helpers -----------------------------------------------------------
+
+    def update_with_retry(
+        self, kind: str, name: str, namespace: str, mutate: Callable[[K8sObject], None],
+        attempts: int = 10,
+    ) -> K8sObject:
+        """Get-mutate-update loop absorbing CAS conflicts."""
+        last: Optional[ConflictError] = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    def watch(self, kind: str) -> "queue.Queue[WatchEvent]":
+        with self._mu:
+            q: "queue.Queue[WatchEvent]" = queue.Queue()
+            self._watchers.setdefault(kind, []).append(q)
+            return q
+
+    def stop_watch(self, kind: str, q: "queue.Queue[WatchEvent]") -> None:
+        with self._mu:
+            try:
+                self._watchers.get(kind, []).remove(q)
+            except ValueError:
+                pass
+
+    def list_and_watch(self, kind: str) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
+        """Atomic snapshot + subscription — informer bootstrap."""
+        with self._mu:
+            q = self.watch(kind)
+            return self.list(kind), q
+
+    # -- garbage collection -------------------------------------------------
+
+    def collect_orphans(self, kinds: Iterable[str]) -> int:
+        """One GC pass: delete objects whose controller owner is gone —
+        the cluster-side behavior the reference's CleanupManager compensates
+        for when owner refs can't be used (cleanup.go:35-146)."""
+        doomed: List[K8sObject] = []
+        with self._mu:
+            uids = {o.meta.uid for o in self._objects.values()}
+            for (k, _, _), obj in list(self._objects.items()):
+                if k not in kinds:
+                    continue
+                for ref in obj.meta.owner_references:
+                    if ref.controller and ref.uid not in uids:
+                        doomed.append(obj)
+                        break
+        for obj in doomed:
+            try:
+                self.delete(obj.kind, obj.meta.name, obj.meta.namespace)
+            except NotFoundError:
+                pass
+        return len(doomed)
+
+
+class ApiValueError(ValueError):
+    pass

@@ -180,6 +180,7 @@ class Registry:
                 if (
                     type(existing) is not type(metric)
                     or existing.label_names != metric.label_names
+                    or getattr(existing, "buckets", None) != getattr(metric, "buckets", None)
                 ):
                     raise ValueError(
                         f"metric {metric.name} already registered with a different shape"

@@ -1,0 +1,1 @@
+"""DRA kubelet plugins (L3): tpu + computedomain."""

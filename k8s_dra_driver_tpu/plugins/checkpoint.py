@@ -1,0 +1,158 @@
+"""Crash-consistent claim checkpoint, shared by both kubelet plugins.
+
+Carries the reference's checkpoint semantics wholesale — they encode years
+of crash-consistency fixes (SURVEY.md §5-checkpoint;
+/root/reference/cmd/gpu-kubelet-plugin/checkpoint.go:26-140,
+checkpointv.go:59-133, device_state.go:246-302,740-805):
+
+- versioned schema with migration to latest (V1 had no boot id; loading it
+  yields an empty boot id, which mismatches the live one and recreates);
+- checksum over the canonical payload; on mismatch a unified diff of
+  on-disk vs re-marshaled JSON is raised for operators;
+- node boot-id invalidation across reboots;
+- claim states PrepareStarted -> PrepareCompleted, plus the PrepareAborted
+  tombstone (TTL'd) the compute-domain plugin uses;
+- every write is atomic (tmp + fsync + rename).
+"""
+
+from __future__ import annotations
+
+import difflib
+import json
+import os
+import time
+import zlib
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+LATEST_VERSION = "v2"
+
+PREPARE_STARTED = "PrepareStarted"
+PREPARE_COMPLETED = "PrepareCompleted"
+PREPARE_ABORTED = "PrepareAborted"
+
+# TTL for PrepareAborted tombstones (reference:
+# cmd/compute-domain-kubelet-plugin/cleanup.go:35-37).
+ABORTED_TTL_S = 10 * 60.0
+
+
+class CorruptCheckpointError(Exception):
+    def __init__(self, path: str, diff: str):
+        super().__init__(f"checkpoint {path} failed checksum; diff:\n{diff}")
+        self.diff = diff
+
+
+@dataclass
+class PreparedDevice:
+    name: str = ""                      # canonical device name (tpu-0, ...)
+    device_type: str = ""               # tpu | subslice | vfio | channel | daemon
+    chip_indices: List[int] = field(default_factory=list)
+    cdi_device_ids: List[str] = field(default_factory=list)
+    request: str = ""                   # claim request this satisfied
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PreparedClaim:
+    claim_uid: str = ""
+    namespace: str = ""
+    name: str = ""
+    state: str = PREPARE_STARTED
+    devices: List[PreparedDevice] = field(default_factory=list)
+    started_at: float = 0.0
+    completed_at: float = 0.0
+    aborted_at: float = 0.0
+
+    def aborted_expired(self, now: Optional[float] = None) -> bool:
+        if self.state != PREPARE_ABORTED:
+            return False
+        return (now if now is not None else time.time()) - self.aborted_at > ABORTED_TTL_S
+
+
+@dataclass
+class Checkpoint:
+    node_boot_id: str = ""
+    claims: Dict[str, PreparedClaim] = field(default_factory=dict)
+
+
+def _to_payload(cp: Checkpoint) -> Dict[str, Any]:
+    return {"node_boot_id": cp.node_boot_id,
+            "claims": {uid: asdict(c) for uid, c in cp.claims.items()}}
+
+
+def _from_payload(data: Dict[str, Any]) -> Checkpoint:
+    claims = {}
+    for uid, c in data.get("claims", {}).items():
+        devices = [PreparedDevice(**d) for d in c.pop("devices", [])]
+        claims[uid] = PreparedClaim(**{**c, "devices": devices})
+    return Checkpoint(node_boot_id=data.get("node_boot_id", ""), claims=claims)
+
+
+def _canonical(payload: Dict[str, Any]) -> str:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+class CheckpointManager:
+    """Atomic load/save of the checkpoint file. Callers serialize access via
+    the cp flock (device_state owns that)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[Checkpoint]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        doc = json.loads(raw)
+        version = doc.get("version", "v1")
+        payload = doc.get("data", {})
+        if "checksum" in doc:
+            want = doc["checksum"]
+            got = zlib.crc32(_canonical(payload).encode())
+            if want != got:
+                remarshaled = json.dumps(
+                    {"version": version, "checksum": got, "data": payload},
+                    sort_keys=True, indent=1,
+                )
+                diff = "\n".join(
+                    difflib.unified_diff(
+                        raw.splitlines(), remarshaled.splitlines(),
+                        fromfile="on-disk", tofile="re-marshaled", lineterm="",
+                    )
+                )
+                raise CorruptCheckpointError(self.path, diff)
+        return self._migrate(version, payload)
+
+    @staticmethod
+    def _migrate(version: str, payload: Dict[str, Any]) -> Checkpoint:
+        if version == "v1":
+            # v1 had no boot id: leave it empty so it never matches a live
+            # boot id and state is rebuilt (ToLatestVersion analog).
+            payload = dict(payload)
+            payload.setdefault("node_boot_id", "")
+        elif version != LATEST_VERSION:
+            raise ValueError(f"unknown checkpoint version {version!r}")
+        return _from_payload(payload)
+
+    def save(self, cp: Checkpoint) -> None:
+        payload = _to_payload(cp)
+        doc = {
+            "version": LATEST_VERSION,
+            "checksum": zlib.crc32(_canonical(payload).encode()),
+            "data": payload,
+        }
+        tmp = f"{self.path}.tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def delete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,7 @@
+"""tpu-kubelet-plugin — per-node DRA plugin for driver ``tpu.google.com``.
+
+Role of the reference's gpu-kubelet-plugin (SURVEY.md §2.1, §2.4):
+enumerate chips/subslices/VFIO devices via tpulib, publish ResourceSlices
+with KEP-4815 counters, Prepare/Unprepare claims through a crash-consistent
+checkpointed state machine, inject devices via CDI.
+"""

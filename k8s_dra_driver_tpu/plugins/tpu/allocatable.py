@@ -1,0 +1,151 @@
+"""Allocatable devices: the chip/subslice/VFIO sum type + canonical naming.
+
+Reference analog: the AllocatableDevice sum type keyed by canonical name
+(/root/reference/cmd/gpu-kubelet-plugin/allocatable.go:37-120) and MIG
+canonical naming gpu-<minor>-mig-<profile>-<placement> (mig.go:111-223).
+TPU naming:
+
+    tpu-<index>                      one chip
+    tpu-subslice-<profile>-at-<x>x<y>  an ICI subslice placement
+    tpu-<index>-vfio                 a chip's VFIO passthrough sibling
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+from k8s_dra_driver_tpu.tpulib.types import (
+    ChipInfo,
+    HostInventory,
+    SubslicePlacement,
+)
+
+DEVICE_TYPE_TPU = "tpu"
+DEVICE_TYPE_SUBSLICE = "subslice"
+DEVICE_TYPE_VFIO = "vfio"
+
+
+def tpu_device_name(index: int) -> str:
+    return f"tpu-{index}"
+
+
+def vfio_device_name(index: int) -> str:
+    return f"tpu-{index}-vfio"
+
+
+def subslice_device_name(placement: SubslicePlacement) -> str:
+    return f"tpu-subslice-{placement.name_suffix}"
+
+
+_SUBSLICE_RE = re.compile(r"^tpu-subslice-(\d+x\d+(?:x\d+)?)-at-(\d+(?:x\d+)*)$")
+_TPU_RE = re.compile(r"^tpu-(\d+)$")
+_VFIO_RE = re.compile(r"^tpu-(\d+)-vfio$")
+
+
+def parse_device_name(name: str) -> Tuple[str, dict]:
+    """Return (device_type, details). Raises ValueError on unknown names."""
+    m = _TPU_RE.match(name)
+    if m:
+        return DEVICE_TYPE_TPU, {"index": int(m.group(1))}
+    m = _VFIO_RE.match(name)
+    if m:
+        return DEVICE_TYPE_VFIO, {"index": int(m.group(1))}
+    m = _SUBSLICE_RE.match(name)
+    if m:
+        return DEVICE_TYPE_SUBSLICE, {
+            "profile": m.group(1),
+            "start": tuple(int(v) for v in m.group(2).split("x")),
+        }
+    raise ValueError(f"unparseable device name {name!r}")
+
+
+@dataclass(frozen=True)
+class TpuDevice:
+    chip: ChipInfo
+
+    @property
+    def name(self) -> str:
+        return tpu_device_name(self.chip.index)
+
+    @property
+    def device_type(self) -> str:
+        return DEVICE_TYPE_TPU
+
+    @property
+    def chip_indices(self) -> Tuple[int, ...]:
+        return (self.chip.index,)
+
+
+@dataclass(frozen=True)
+class SubsliceDevice:
+    placement: SubslicePlacement
+    chips: Tuple[ChipInfo, ...]
+
+    @property
+    def name(self) -> str:
+        return subslice_device_name(self.placement)
+
+    @property
+    def device_type(self) -> str:
+        return DEVICE_TYPE_SUBSLICE
+
+    @property
+    def chip_indices(self) -> Tuple[int, ...]:
+        return self.placement.chip_indices
+
+
+@dataclass(frozen=True)
+class VfioDevice:
+    chip: ChipInfo
+    vfio_group_path: str  # /dev/vfio/<group>, empty until bound
+
+    @property
+    def name(self) -> str:
+        return vfio_device_name(self.chip.index)
+
+    @property
+    def device_type(self) -> str:
+        return DEVICE_TYPE_VFIO
+
+    @property
+    def chip_indices(self) -> Tuple[int, ...]:
+        return (self.chip.index,)
+
+
+AllocatableDevice = Union[TpuDevice, SubsliceDevice, VfioDevice]
+
+
+def enumerate_allocatable(
+    inventory: HostInventory,
+    *,
+    with_subslices: bool = True,
+    with_vfio: bool = False,
+) -> Dict[str, AllocatableDevice]:
+    """All devices this host can advertise, keyed by canonical name.
+
+    Chips and their VFIO siblings are alternative views of the same silicon
+    (the vfio<->gpu sibling flip, allocatable.go:224-318); subslices overlap
+    chips by construction — the scheduler's counter bookkeeping enforces
+    exclusivity, not this map.
+    """
+    out: Dict[str, AllocatableDevice] = {}
+    for chip in inventory.chips:
+        dev = TpuDevice(chip=chip)
+        out[dev.name] = dev
+        if with_vfio:
+            vdev = VfioDevice(
+                chip=chip, vfio_group_path=inventory.vfio_devices.get(chip.index, "")
+            )
+            out[vdev.name] = vdev
+    if with_subslices:
+        by_index = {c.index: c for c in inventory.chips}
+        for prof in inventory.subslice_profiles:
+            for pl in prof.placements:
+                dev = SubsliceDevice(
+                    placement=pl,
+                    chips=tuple(by_index[i] for i in pl.chip_indices),
+                )
+                out[dev.name] = dev
+    return out

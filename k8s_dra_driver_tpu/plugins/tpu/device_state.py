@@ -1,0 +1,398 @@
+"""DeviceState: the checkpointed Prepare/Unprepare state machine.
+
+Port of the *semantics* (not the code) of
+/root/reference/cmd/gpu-kubelet-plugin/device_state.go — the crash
+consistency corners are the hard part (SURVEY.md §7):
+
+- idempotent Prepare: a PrepareCompleted claim returns its cached devices
+  (device_state.go:309-316);
+- overlap guard: preparing a claim whose devices are already held by a
+  different claim fails before any mutation (1482-1520);
+- stale PrepareStarted entries (plugin died mid-prepare) are rolled back
+  before re-preparing (332-337, 612-700);
+- PrepareStarted is checkpointed *before* touching devices, PrepareCompleted
+  *after* the CDI spec exists (340-392);
+- partial failures roll back device-by-device, then the claim entry is
+  dropped (612-700);
+- unprepare is idempotent and removes the CDI spec before the entry.
+
+Config resolution follows GetOpaqueDeviceConfigs precedence
+(1399-1463): class-sourced configs apply before claim-sourced, and
+all-requests configs before request-specific ones, so the most specific
+config wins by applying last.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.api.configs import (
+    DeviceConfig,
+    SharingConfig,
+    SubsliceConfig,
+    TpuConfig,
+    VfioTpuConfig,
+    nonstrict_decode,
+    TPU_DRIVER_NAME,
+)
+from k8s_dra_driver_tpu.cdi import CDIHandler, ContainerEdits
+from k8s_dra_driver_tpu.k8s.core import ResourceClaim
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
+from k8s_dra_driver_tpu.pkg.flock import Flock
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    PreparedClaim,
+    PreparedDevice,
+)
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
+    AllocatableDevice,
+    SubsliceDevice,
+    TpuDevice,
+    VfioDevice,
+    enumerate_allocatable,
+)
+from k8s_dra_driver_tpu.plugins.tpu.sharing import SharingManager
+from k8s_dra_driver_tpu.tpulib.lib import TpuLib
+from k8s_dra_driver_tpu.tpulib.types import HostInventory, parse_topology
+
+log = logging.getLogger(__name__)
+
+
+class PrepareError(Exception):
+    pass
+
+
+class OverlapError(PrepareError):
+    pass
+
+
+@dataclass
+class PrepareResult:
+    claim_uid: str
+    cdi_device_ids: List[str] = field(default_factory=list)
+    devices: List[PreparedDevice] = field(default_factory=list)
+
+
+class DeviceState:
+    def __init__(
+        self,
+        tpulib: TpuLib,
+        plugin_dir: str,
+        cdi_root: Optional[str] = None,
+        gates: Optional[fg.FeatureGates] = None,
+        driver_name: str = TPU_DRIVER_NAME,
+    ):
+        self.gates = gates or fg.FeatureGates()
+        self.driver_name = driver_name
+        self.tpulib = tpulib
+        self.inventory: HostInventory = tpulib.enumerate()
+        self.allocatable: Dict[str, AllocatableDevice] = enumerate_allocatable(
+            self.inventory,
+            with_subslices=True,
+            with_vfio=self.gates.enabled("PassthroughSupport"),
+        )
+        self.cdi = CDIHandler(cdi_root)
+        self.sharing = SharingManager(plugin_dir)
+        self.plugin_dir = plugin_dir
+        os.makedirs(plugin_dir, exist_ok=True)
+        self._mutex = threading.Lock()
+        self._cp_lock = Flock(os.path.join(plugin_dir, "cp.lock"))
+        self._cp = CheckpointManager(os.path.join(plugin_dir, "checkpoint.json"))
+        self._init_checkpoint()
+
+    # -- checkpoint plumbing ------------------------------------------------
+
+    def _init_checkpoint(self) -> None:
+        boot_id = read_boot_id()
+        with self._cp_lock.hold(timeout=10):
+            cp = self._cp.load()
+            if cp is None:
+                cp = Checkpoint(node_boot_id=boot_id)
+                self._cp.save(cp)
+                return
+            if cp.node_boot_id != boot_id:
+                log.warning(
+                    "checkpoint boot id %r != live %r; discarding %d claims "
+                    "(node rebooted, device state is gone)",
+                    cp.node_boot_id, boot_id, len(cp.claims),
+                )
+                # Claim spec files from before the reboot are stale too.
+                for uid in cp.claims:
+                    self.cdi.delete_claim_spec_file(uid)
+                self._cp.save(Checkpoint(node_boot_id=boot_id))
+
+    def _get_checkpoint(self) -> Checkpoint:
+        with self._cp_lock.hold(timeout=10):
+            cp = self._cp.load()
+            assert cp is not None, "checkpoint disappeared"
+            return cp
+
+    def _save_checkpoint(self, cp: Checkpoint) -> None:
+        with self._cp_lock.hold(timeout=10):
+            self._cp.save(cp)
+
+    # -- public state machine ----------------------------------------------
+
+    def prepare(self, claim: ResourceClaim) -> PrepareResult:
+        """Prepare one claim; returns CDI device ids for the kubelet."""
+        with self._mutex:
+            t0 = time.perf_counter()
+            cp = self._get_checkpoint()
+            uid = claim.uid
+            entry = cp.claims.get(uid)
+            if entry is not None and entry.state == PREPARE_COMPLETED:
+                return PrepareResult(
+                    claim_uid=uid,
+                    cdi_device_ids=[i for d in entry.devices for i in d.cdi_device_ids],
+                    devices=list(entry.devices),
+                )
+            if entry is not None and entry.state == PREPARE_STARTED:
+                log.warning("claim %s has a stale PrepareStarted entry; rolling back", uid)
+                self._rollback(entry)
+                del cp.claims[uid]
+                self._save_checkpoint(cp)
+
+            requested = self._allocated_device_names(claim)
+            self._validate_no_overlap(cp, uid, requested)
+
+            cp.claims[uid] = PreparedClaim(
+                claim_uid=uid,
+                namespace=claim.namespace,
+                name=claim.name,
+                state=PREPARE_STARTED,
+                started_at=time.time(),
+            )
+            self._save_checkpoint(cp)
+
+            prepared: List[PreparedDevice] = []
+            try:
+                # _prepare_devices rolls back its own partial work on failure.
+                prepared = self._prepare_devices(claim)
+                per_dev = {d.name: self._edits_for(d) for d in prepared}
+                ids = self.cdi.create_claim_spec_file(
+                    uid, per_dev, common_edits=self._common_edits(prepared)
+                )
+                id_by_name = dict(zip(sorted(per_dev), ids))
+                for d in prepared:
+                    d.cdi_device_ids = [id_by_name[d.name]]
+            except Exception:
+                for d in prepared:  # device work succeeded but CDI write failed
+                    self._rollback_device(uid, d)
+                self.cdi.delete_claim_spec_file(uid)
+                del cp.claims[uid]
+                self._save_checkpoint(cp)
+                raise
+
+            entry = cp.claims[uid]
+            entry.devices = prepared
+            entry.state = PREPARE_COMPLETED
+            entry.completed_at = time.time()
+            self._save_checkpoint(cp)
+            log.debug("t_prep=%0.4fs claim=%s", time.perf_counter() - t0, uid)
+            return PrepareResult(
+                claim_uid=uid,
+                cdi_device_ids=[i for d in prepared for i in d.cdi_device_ids],
+                devices=list(prepared),
+            )
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._mutex:
+            cp = self._get_checkpoint()
+            entry = cp.claims.get(claim_uid)
+            if entry is None:
+                self.cdi.delete_claim_spec_file(claim_uid)  # belt and braces
+                return
+            self._rollback(entry)
+            self.cdi.delete_claim_spec_file(claim_uid)
+            del cp.claims[claim_uid]
+            self._save_checkpoint(cp)
+
+    def prepared_claims(self) -> Dict[str, PreparedClaim]:
+        return dict(self._get_checkpoint().claims)
+
+    # -- internals ----------------------------------------------------------
+
+    def _allocated_device_names(self, claim: ResourceClaim) -> List[str]:
+        if claim.allocation is None:
+            raise PrepareError(f"claim {claim.key} has no allocation")
+        names = [
+            r.device for r in claim.allocation.devices if r.driver == self.driver_name
+        ]
+        if not names:
+            raise PrepareError(
+                f"claim {claim.key} allocation has no devices for driver {self.driver_name}"
+            )
+        for n in names:
+            if n not in self.allocatable:
+                raise PrepareError(f"claim {claim.key}: unknown device {n!r}")
+        return names
+
+    def _validate_no_overlap(
+        self, cp: Checkpoint, uid: str, requested: Sequence[str]
+    ) -> None:
+        """No chip may be held by two claims (device_state.go:1482-1520).
+        Overlap is computed on chip indices, so a subslice conflicts with
+        its member chips even though the device names differ."""
+        want: set = set()
+        for name in requested:
+            want |= set(self.allocatable[name].chip_indices)
+        for other_uid, entry in cp.claims.items():
+            if other_uid == uid:
+                continue
+            held = {i for d in entry.devices for i in d.chip_indices}
+            both = want & held
+            if both:
+                raise OverlapError(
+                    f"devices overlap with claim {other_uid} on chips {sorted(both)}"
+                )
+
+    def _prepare_devices(self, claim: ResourceClaim) -> List[PreparedDevice]:
+        configs = self._resolve_configs(claim)
+        prepared: List[PreparedDevice] = []
+        try:
+            for result in claim.allocation.devices:  # type: ignore[union-attr]
+                if result.driver != self.driver_name:
+                    continue
+                dev = self.allocatable[result.device]
+                for cfg in configs.get(result.request, []):
+                    self._apply_config(cfg, claim.uid, dev)
+                prepared.append(
+                    PreparedDevice(
+                        name=dev.name,
+                        device_type=dev.device_type,
+                        chip_indices=list(dev.chip_indices),
+                        request=result.request,
+                    )
+                )
+        except Exception:
+            for d in prepared:
+                self._rollback_device(claim.uid, d)
+            raise
+        return prepared
+
+    def _resolve_configs(self, claim: ResourceClaim) -> Dict[str, List[DeviceConfig]]:
+        """request name -> configs in apply order (most specific last)."""
+        buckets: List[Tuple[int, List[str], DeviceConfig]] = []
+        for cc in claim.config:
+            if cc.opaque is None or cc.opaque.driver != self.driver_name:
+                continue
+            cfg = nonstrict_decode(cc.opaque.parameters)
+            cfg.validate()
+            source_rank = 0 if cc.source == "class" else 1
+            specific_rank = 0 if not cc.requests else 1
+            buckets.append((source_rank * 2 + specific_rank, cc.requests, cfg))
+        buckets.sort(key=lambda b: b[0])
+        out: Dict[str, List[DeviceConfig]] = {}
+        request_names = {r.request for r in (claim.allocation.devices if claim.allocation else [])}
+        for _, requests, cfg in buckets:
+            targets = requests or sorted(request_names)
+            for r in targets:
+                out.setdefault(r, []).append(cfg)
+        return out
+
+    def _apply_config(self, cfg: DeviceConfig, claim_uid: str, dev: AllocatableDevice) -> None:
+        if isinstance(cfg, TpuConfig):
+            if cfg.sharing is not None:
+                self._apply_sharing(cfg.sharing, claim_uid, dev)
+        elif isinstance(cfg, SubsliceConfig):
+            if not isinstance(dev, SubsliceDevice):
+                raise PrepareError(
+                    f"SubsliceConfig targets non-subslice device {dev.name}"
+                )
+            if cfg.profile and cfg.profile != dev.placement.profile:
+                raise PrepareError(
+                    f"config profile {cfg.profile} != allocated {dev.placement.profile}"
+                )
+            if cfg.sharing is not None:
+                self._apply_sharing(cfg.sharing, claim_uid, dev)
+        elif isinstance(cfg, VfioTpuConfig):
+            if not self.gates.enabled("PassthroughSupport"):
+                raise PrepareError("VfioTpuConfig requires PassthroughSupport gate")
+            if not isinstance(dev, VfioDevice):
+                raise PrepareError(f"VfioTpuConfig targets non-vfio device {dev.name}")
+        else:
+            raise PrepareError(f"config kind {cfg.kind} not valid for driver {self.driver_name}")
+
+    def _apply_sharing(self, sharing: SharingConfig, claim_uid: str, dev: AllocatableDevice) -> None:
+        if sharing.strategy == "TimeSlicing":
+            if not self.gates.enabled("TimeSlicingSettings") and (
+                sharing.time_slicing and sharing.time_slicing.interval != "Default"
+            ):
+                raise PrepareError("TimeSlicingSettings feature gate is disabled")
+            self.sharing.set_time_slice(
+                claim_uid, dev.chip_indices,
+                sharing.time_slicing.interval if sharing.time_slicing else "Default",
+            )
+        else:
+            if not self.gates.enabled("PremappedBufferSharing"):
+                raise PrepareError("PremappedBufferSharing feature gate is disabled")
+            assert sharing.premapped is not None
+            self.sharing.set_premapped(
+                claim_uid, dev.chip_indices, sharing.premapped
+            )
+
+    def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
+        try:
+            self.sharing.clear(claim_uid, tuple(d.chip_indices))
+        except Exception:  # noqa: BLE001 — rollback is best effort
+            log.exception("rollback of %s for claim %s failed", d.name, claim_uid)
+
+    def _rollback(self, entry: PreparedClaim) -> None:
+        for d in entry.devices:
+            self._rollback_device(entry.claim_uid, d)
+        self.sharing.clear_claim(entry.claim_uid)
+
+    # -- CDI edits ----------------------------------------------------------
+
+    def _edits_for(self, d: PreparedDevice) -> ContainerEdits:
+        dev = self.allocatable[d.name]
+        edits = ContainerEdits()
+        if isinstance(dev, VfioDevice):
+            if dev.vfio_group_path:
+                edits.device_nodes.append(dev.vfio_group_path)
+            edits.env["TPU_VFIO_PCI_ADDRESS"] = dev.chip.pci_address
+            return edits
+        chips = (
+            (dev.chip,) if isinstance(dev, TpuDevice) else dev.chips  # type: ignore[union-attr]
+        )
+        for chip in chips:
+            edits.device_nodes.append(chip.dev_path)
+        indices = ",".join(str(c.index) for c in chips)
+        edits.env["TPU_VISIBLE_CHIPS"] = indices
+        edits.env["TPU_VISIBLE_DEVICES"] = indices
+        if isinstance(dev, SubsliceDevice):
+            shape = parse_topology(dev.placement.profile)
+            shape3 = tuple(shape) + (1,) * (3 - len(shape))
+            edits.env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(map(str, shape3))
+            edits.env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        sharing_env = self.sharing.env_for(dev.chip_indices)
+        edits.env.update(sharing_env)
+        return edits
+
+    def _common_edits(self, prepared: List[PreparedDevice]) -> ContainerEdits:
+        inv = self.inventory
+        edits = ContainerEdits()
+        edits.env["TPU_ACCELERATOR_TYPE"] = inv.accelerator_type
+        edits.env["TPU_SKIP_MDS_QUERY"] = "true"
+        all_chips = sorted({i for d in prepared for i in d.chip_indices})
+        whole_host = len(all_chips) == len(inv.chips)
+        if whole_host:
+            # Whole-host claim: expose the real slice identity so multi-host
+            # JAX initializes over ICI (single-host slices get worker 0/1-host).
+            edits.env["TPU_TOPOLOGY"] = inv.slice_topology
+            edits.env["TPU_WORKER_ID"] = str(inv.worker_id)
+            edits.env["TPU_HOST_BOUNDS"] = inv.host_topology
+        else:
+            # Partial host: the workload sees only its chips.
+            edits.env["TPU_TOPOLOGY"] = ""
+            edits.env["TPU_WORKER_ID"] = "0"
+        return edits

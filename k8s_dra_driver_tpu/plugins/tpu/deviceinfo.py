@@ -1,0 +1,117 @@
+"""AllocatableDevice -> ResourceSlice Device conversion.
+
+Reference analog: GpuInfo/MigDeviceInfo -> resourceapi.Device with
+attributes (/root/reference/cmd/gpu-kubelet-plugin/deviceinfo.go:170-328)
+plus the KEP-4815 per-host CounterSet for subslice exclusivity
+(partitions.go:53-246): every chip is a counter; a chip device consumes its
+own counter and a subslice consumes all of its chips' counters, so the
+scheduler can never hand out overlapping silicon.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from k8s_dra_driver_tpu.k8s.core import (
+    Counter,
+    CounterSet,
+    Device,
+    DeviceCounterConsumption,
+    ResourcePool,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
+    AllocatableDevice,
+    SubsliceDevice,
+    TpuDevice,
+    VfioDevice,
+)
+from k8s_dra_driver_tpu.tpulib.types import HostInventory
+
+HOST_COUNTER_SET = "tpu-host-chips"
+
+
+def chip_counter_name(index: int) -> str:
+    return f"chip-{index}"
+
+
+def device_to_api(dev: AllocatableDevice, inv: HostInventory) -> Device:
+    common = {
+        "tpu.google.com/gen": inv.gen.value,
+        "tpu.google.com/acceleratorType": inv.accelerator_type,
+        "tpu.google.com/iciDomain": inv.ici_domain,
+        "tpu.google.com/sliceTopology": inv.slice_topology,
+        "tpu.google.com/hostTopology": inv.host_topology,
+        "tpu.google.com/workerId": inv.worker_id,
+        "type": dev.device_type,
+    }
+    if isinstance(dev, TpuDevice):
+        c = dev.chip
+        attrs = {
+            **common,
+            "uuid": c.uuid,
+            "index": c.index,
+            "coords": "x".join(str(v) for v in c.coords),
+            "numaNode": c.numa_node,
+            "serial": c.serial,
+        }
+        capacity = {"hbm": c.hbm_bytes, "cores": c.cores}
+    elif isinstance(dev, SubsliceDevice):
+        attrs = {
+            **common,
+            "profile": dev.placement.profile,
+            "chips": ",".join(str(i) for i in dev.chip_indices),
+        }
+        capacity = {
+            "hbm": sum(c.hbm_bytes for c in dev.chips),
+            "cores": sum(c.cores for c in dev.chips),
+            "chips": len(dev.chips),
+        }
+    elif isinstance(dev, VfioDevice):
+        c = dev.chip
+        attrs = {
+            **common,
+            "uuid": c.uuid,
+            "index": c.index,
+            "pciAddress": c.pci_address,
+        }
+        capacity = {"hbm": c.hbm_bytes}
+    else:  # pragma: no cover
+        raise TypeError(f"unknown device {dev}")
+    return Device(
+        name=dev.name,
+        attributes=attrs,
+        capacity=capacity,
+        consumes_counters=[
+            DeviceCounterConsumption(
+                counter_set=HOST_COUNTER_SET,
+                counters={chip_counter_name(i): Counter(1) for i in dev.chip_indices},
+            )
+        ],
+    )
+
+
+def build_resource_slice(
+    node_name: str,
+    driver: str,
+    devices: Dict[str, AllocatableDevice],
+    inv: HostInventory,
+    pool_generation: int = 1,
+) -> ResourceSlice:
+    """One ResourceSlice advertising every allocatable device on this node."""
+    api_devices: List[Device] = [
+        device_to_api(devices[name], inv) for name in sorted(devices)
+    ]
+    counters = CounterSet(
+        name=HOST_COUNTER_SET,
+        counters={chip_counter_name(c.index): Counter(1) for c in inv.chips},
+    )
+    return ResourceSlice(
+        meta=new_meta(f"{node_name}-{driver}"),
+        driver=driver,
+        node_name=node_name,
+        pool=ResourcePool(name=node_name, generation=pool_generation),
+        devices=api_devices,
+        shared_counters=[counters],
+    )

@@ -1,0 +1,182 @@
+"""TpuDriver — the tpu-kubelet-plugin binary's core.
+
+The reference driver's lifecycle (SURVEY.md §3.1-3.2,
+/root/reference/cmd/gpu-kubelet-plugin/driver.go): construct DeviceState,
+publish ResourceSlices, serve Prepare/Unprepare under the node-global pu
+flock with metrics, watch device health into taints + republish, and run the
+periodic stale-claim cleanup loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
+from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, RESOURCE_SLICE, ResourceClaim
+from k8s_dra_driver_tpu.k8s.core import DeviceTaint
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
+from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
+from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState, PrepareResult
+from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+from k8s_dra_driver_tpu.tpulib.lib import TpuLib
+from k8s_dra_driver_tpu.tpulib.types import ChipHealth
+
+log = logging.getLogger(__name__)
+
+PU_LOCK_TIMEOUT_S = 10.0  # reference budget (driver.go:388,430)
+CLEANUP_INTERVAL_S = 600.0  # reference 10 min (cleanup.go:34-36)
+
+UNHEALTHY_TAINT_KEY = "tpu.google.com/unhealthy"
+
+
+class TpuDriver:
+    def __init__(
+        self,
+        api: APIServer,
+        node_name: str,
+        tpulib: TpuLib,
+        plugin_dir: str,
+        cdi_root: Optional[str] = None,
+        gates: Optional[fg.FeatureGates] = None,
+        metrics_registry: Optional[Registry] = None,
+        cleanup_interval_s: float = CLEANUP_INTERVAL_S,
+        driver_name: str = TPU_DRIVER_NAME,
+    ):
+        self.api = api
+        self.node_name = node_name
+        self.driver_name = driver_name
+        self.gates = gates or fg.FeatureGates()
+        self.state = DeviceState(
+            tpulib, plugin_dir, cdi_root=cdi_root, gates=self.gates,
+            driver_name=driver_name,
+        )
+        self.metrics = DRARequestMetrics(
+            driver=driver_name, registry=metrics_registry or Registry()
+        )
+        self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
+        self._pool_generation = 1
+        self._tainted_chips: Dict[int, ChipHealth] = {}
+        self._cleanup_interval = cleanup_interval_s
+        self._stop = threading.Event()
+        self._cleanup_thread: Optional[threading.Thread] = None
+        self._registered = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.gates.enabled("TPUDeviceHealthCheck") and hasattr(
+            self.state.tpulib, "watch_health"
+        ):
+            self.state.tpulib.watch_health(self._on_health_event)
+        self.publish_resources()
+        self._cleanup_thread = threading.Thread(
+            target=self._cleanup_loop, name="checkpoint-cleanup", daemon=True
+        )
+        self._cleanup_thread.start()
+        self._registered = True
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._cleanup_thread:
+            self._cleanup_thread.join(timeout=5)
+        self._registered = False
+
+    def healthy(self) -> bool:
+        """gRPC healthcheck analog (health.go:39-148)."""
+        return self._registered and not self._stop.is_set()
+
+    # -- ResourceSlice publishing -------------------------------------------
+
+    def publish_resources(self) -> None:
+        rs = build_resource_slice(
+            self.node_name,
+            self.driver_name,
+            self.state.allocatable,
+            self.state.inventory,
+            pool_generation=self._pool_generation,
+        )
+        self._pool_generation += 1
+        # Apply current taints before publishing.
+        for dev in rs.devices:
+            chips = self.state.allocatable[dev.name].chip_indices
+            if any(c in self._tainted_chips for c in chips):
+                dev.taints.append(
+                    DeviceTaint(key=UNHEALTHY_TAINT_KEY, value="true", effect="NoSchedule")
+                )
+        existing = self.api.try_get(RESOURCE_SLICE, rs.meta.name)
+        if existing is None:
+            self.api.create(rs)
+        else:
+            rs.meta = existing.meta
+            self.api.update(rs)
+
+    # -- health -> taints ----------------------------------------------------
+
+    def _on_health_event(self, chip_index: int, health: ChipHealth) -> None:
+        log.warning("chip %d health -> %s", chip_index, health.value)
+        if health == ChipHealth.HEALTHY:
+            self._tainted_chips.pop(chip_index, None)
+        else:
+            self._tainted_chips[chip_index] = health
+        self.publish_resources()
+
+    # -- DRA service --------------------------------------------------------
+
+    def prepare_resource_claims(
+        self, claims: List[ResourceClaim]
+    ) -> Dict[str, PrepareResult | Exception]:
+        out: Dict[str, PrepareResult | Exception] = {}
+        for claim in claims:
+            with self.metrics.track("PrepareResourceClaims"):
+                try:
+                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                        out[claim.uid] = self.state.prepare(claim)
+                except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
+                    log.warning("prepare %s failed: %s", claim.key, e)
+                    out[claim.uid] = e
+        return out
+
+    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
+        out: Dict[str, Optional[Exception]] = {}
+        for uid in claim_uids:
+            with self.metrics.track("UnprepareResourceClaims"):
+                try:
+                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                        self.state.unprepare(uid)
+                    out[uid] = None
+                except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
+                    log.warning("unprepare %s failed: %s", uid, e)
+                    out[uid] = e
+        return out
+
+    # -- stale-claim cleanup -------------------------------------------------
+
+    def cleanup_stale_claims(self) -> int:
+        """Unprepare claims whose ResourceClaim no longer exists
+        (cleanup.go:149-259). Returns how many were cleaned."""
+        cleaned = 0
+        for uid, entry in self.state.prepared_claims().items():
+            obj = self.api.try_get(RESOURCE_CLAIM, entry.name, entry.namespace)
+            if obj is not None and obj.uid == uid:
+                continue
+            log.info("cleaning stale claim %s/%s uid=%s", entry.namespace, entry.name, uid)
+            try:
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                    self.state.unprepare(uid)
+                cleaned += 1
+            except Exception:  # noqa: BLE001
+                log.exception("stale cleanup of %s failed", uid)
+        return cleaned
+
+    def _cleanup_loop(self) -> None:
+        while not self._stop.wait(self._cleanup_interval):
+            try:
+                self.cleanup_stale_claims()
+            except Exception:  # noqa: BLE001
+                log.exception("cleanup pass failed")

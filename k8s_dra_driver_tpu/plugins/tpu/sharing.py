@@ -1,0 +1,107 @@
+"""Chip-sharing bookkeeping: time-slicing intervals and premapped budgets.
+
+The reference programs sharing into the device (NVML SetTimeSlice) or runs
+an MPS control daemon (/root/reference/cmd/gpu-kubelet-plugin/
+sharing.go:139-474). TPUs have neither knob: sharing is realized as runtime
+environment handed to the workload (scheduler hints + premapped HBM
+budgets), so this manager is authoritative bookkeeping — persisted next to
+the checkpoint so rollback works across plugin restarts — plus the env
+edits the CDI spec carries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.api.configs import MpsLikePremappedConfig
+
+# Interval name -> microseconds hint handed to the runtime.
+TIME_SLICE_US = {"Default": 0, "Short": 2000, "Medium": 10000, "Long": 50000}
+
+
+class SharingConflictError(Exception):
+    pass
+
+
+class SharingManager:
+    def __init__(self, plugin_dir: str):
+        self._path = os.path.join(plugin_dir, "sharing.json")
+        self._mu = threading.Lock()
+        self._state: Dict[str, dict] = {}  # "claim_uid:chip" -> record
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self._path, "r", encoding="utf-8") as f:
+                self._state = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            self._state = {}
+
+    def _save(self) -> None:
+        tmp = self._path + ".tmp"
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._state, f, sort_keys=True)
+        os.replace(tmp, self._path)
+
+    @staticmethod
+    def _key(claim_uid: str, chip: int) -> str:
+        return f"{claim_uid}:{chip}"
+
+    def set_time_slice(self, claim_uid: str, chips: Sequence[int], interval: str) -> None:
+        if interval not in TIME_SLICE_US:
+            raise ValueError(f"unknown interval {interval!r}")
+        with self._mu:
+            for c in chips:
+                self._state[self._key(claim_uid, c)] = {
+                    "mode": "timeslice", "interval": interval, "chip": c,
+                }
+            self._save()
+
+    def set_premapped(
+        self, claim_uid: str, chips: Sequence[int], cfg: MpsLikePremappedConfig
+    ) -> None:
+        with self._mu:
+            for c in chips:
+                budget = cfg.per_chip_premapped_hbm_bytes.get(
+                    c, cfg.default_premapped_hbm_bytes
+                )
+                self._state[self._key(claim_uid, c)] = {
+                    "mode": "premapped", "bytes": budget, "chip": c,
+                }
+            self._save()
+
+    def clear(self, claim_uid: str, chips: Sequence[int]) -> None:
+        with self._mu:
+            for c in chips:
+                self._state.pop(self._key(claim_uid, c), None)
+            self._save()
+
+    def clear_claim(self, claim_uid: str) -> None:
+        with self._mu:
+            doomed = [k for k in self._state if k.startswith(f"{claim_uid}:")]
+            for k in doomed:
+                del self._state[k]
+            if doomed:
+                self._save()
+
+    def records_for(self, chips: Sequence[int]) -> list:
+        with self._mu:
+            return [r for r in self._state.values() if r["chip"] in set(chips)]
+
+    def env_for(self, chips: Sequence[int]) -> Dict[str, str]:
+        """Runtime env for a device's chips (empty when unshared)."""
+        recs = self.records_for(chips)
+        env: Dict[str, str] = {}
+        ts = [r for r in recs if r["mode"] == "timeslice" and r["interval"] != "Default"]
+        if ts:
+            env["TPU_TIMESLICE_US"] = str(
+                max(TIME_SLICE_US[r["interval"]] for r in ts)
+            )
+        pm = [r for r in recs if r["mode"] == "premapped"]
+        if pm:
+            env["TPU_PREMAPPED_BUFFER_BYTES"] = str(min(r["bytes"] for r in pm))
+        return env

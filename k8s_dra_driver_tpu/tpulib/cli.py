@@ -59,12 +59,17 @@ def cmd_info(args: argparse.Namespace) -> int:
 def cmd_health(args: argparse.Namespace) -> int:
     from k8s_dra_driver_tpu.tpulib.real import RealTpuLib
 
+    from k8s_dra_driver_tpu.tpulib.types import ChipHealth
+
     lib = new_tpulib()
     if isinstance(lib, RealTpuLib):
         h = lib.chip_health(args.chip)
     else:
         inv = lib.enumerate()
-        h = inv.chip_by_index(args.chip).health
+        try:
+            h = inv.chip_by_index(args.chip).health
+        except KeyError:
+            h = ChipHealth.UNHEALTHY
     print(h.value)
     return 0 if h.value == "healthy" else 1
 

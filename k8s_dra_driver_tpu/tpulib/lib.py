@@ -33,7 +33,7 @@ def new_tpulib(env: Optional[dict] = None) -> TpuLib:
     if profile:
         from k8s_dra_driver_tpu.tpulib.mock import MockTpuLib
 
-        return MockTpuLib(profile)
+        return MockTpuLib(profile, env=env)
     from k8s_dra_driver_tpu.tpulib.real import RealTpuLib
 
     return RealTpuLib(env=env)

@@ -55,7 +55,9 @@ class MockTpuLib:
         worker_id: Optional[int] = None,
         slice_uid: Optional[str] = None,
         unhealthy: Optional[List[int]] = None,
+        env: Optional[Dict[str, str]] = None,
     ):
+        env = dict(env) if env is not None else dict(os.environ)
         if isinstance(profile, str):
             if profile not in PROFILES:
                 raise ValueError(
@@ -64,18 +66,18 @@ class MockTpuLib:
             profile = PROFILES[profile]
         self.profile = profile
         if worker_id is None:
-            worker_id = int(os.environ.get(ALT_TPU_WORKER_ID_ENV, "0"))
+            worker_id = int(env.get(ALT_TPU_WORKER_ID_ENV, "0"))
         if not 0 <= worker_id < profile.num_hosts:
             raise ValueError(
                 f"worker_id {worker_id} out of range for {profile.name} "
                 f"({profile.num_hosts} hosts)"
             )
         self.worker_id = worker_id
-        self.slice_uid = slice_uid or os.environ.get(
+        self.slice_uid = slice_uid or env.get(
             ALT_TPU_SLICE_UID_ENV, f"mock-slice-{profile.name}"
         )
         self._health: Dict[int, ChipHealth] = {}
-        env_unhealthy = os.environ.get(ALT_TPU_UNHEALTHY_CHIPS_ENV, "")
+        env_unhealthy = env.get(ALT_TPU_UNHEALTHY_CHIPS_ENV, "")
         for tok in filter(None, (t.strip() for t in env_unhealthy.split(","))):
             self._health[int(tok)] = ChipHealth.UNHEALTHY
         for idx in unhealthy or ():

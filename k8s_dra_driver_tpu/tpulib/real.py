@@ -103,7 +103,9 @@ def _py_scan(dev_root: str, sysfs_root: str) -> List[dict]:
                 "vendor": vendor,
                 "serial": serial or pci_address or name,
                 "vfio_group": "",
-                "openable": os.access(dev_path, os.R_OK),
+                # Existence, not readability: a busy/permission-denied node
+                # is a live chip (single-open semantics).
+                "openable": os.path.exists(dev_path),
             }
         )
     chips.sort(key=lambda c: c["index"])

@@ -85,8 +85,11 @@ class SubslicePlacement:
 
     @property
     def name_suffix(self) -> str:
-        x, y, _ = self.start
-        return f"{self.profile}-at-{x}x{y}"
+        # Keep as many origin coords as the profile has dims so 3D hosts
+        # (v4/v5p) don't mint colliding names for placements differing in z.
+        ndim = len(self.profile.split("x"))
+        coords = "x".join(str(c) for c in self.start[:ndim])
+        return f"{self.profile}-at-{coords}"
 
 
 @dataclass(frozen=True)

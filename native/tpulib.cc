@@ -129,6 +129,19 @@ std::string VfioGroupFor(const std::string& pci_dir) {
   return Basename(target);
 }
 
+// A chip is "alive" if its node can be opened OR open fails because the
+// device is merely busy/forbidden: TPU accel devices are single-open, so a
+// chip exclusively held by a running workload returns EBUSY — the healthiest
+// possible state, not a failure. Only missing/IO-dead nodes are unhealthy.
+bool ProbeDevice(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd >= 0) {
+    ::close(fd);
+    return true;
+  }
+  return errno == EBUSY || errno == EPERM || errno == EACCES;
+}
+
 std::vector<Chip> ScanChips(const std::string& dev_root, const std::string& sysfs_root) {
   std::vector<Chip> chips;
   DIR* d = ::opendir(dev_root.c_str());
@@ -147,9 +160,7 @@ std::vector<Chip> ScanChips(const std::string& dev_root, const std::string& sysf
     Chip c;
     c.index = std::atoi(digits);
     c.dev_path = dev_root + "/" + name;
-    int fd = ::open(c.dev_path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
-    c.openable = fd >= 0;
-    if (fd >= 0) ::close(fd);
+    c.openable = ProbeDevice(c.dev_path);
 
     std::string pci_dir = PciDirFor(sysfs_root, c.index);
     if (!pci_dir.empty()) {
@@ -223,10 +234,7 @@ int tpulib_enumerate(const char* dev_root, const char* sysfs_root,
 int tpulib_chip_health(const char* dev_root, int index) {
   if (dev_root == nullptr || index < 0) return TPULIB_ERR;
   std::string path = std::string(dev_root) + "/accel" + std::to_string(index);
-  int fd = ::open(path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
-  if (fd < 0) return 1;
-  ::close(fd);
-  return 0;
+  return ProbeDevice(path) ? 0 : 1;
 }
 
 }  // extern "C"

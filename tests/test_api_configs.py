@@ -1,0 +1,110 @@
+"""Opaque config decoding: strict vs nonstrict, normalize, validate."""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import (
+    API_VERSION,
+    ComputeDomainChannelConfig,
+    DecodeError,
+    SubsliceConfig,
+    TpuConfig,
+    ValidationError,
+    VfioTpuConfig,
+    nonstrict_decode,
+    strict_decode,
+)
+
+
+def blob(kind, **body):
+    return {"apiVersion": API_VERSION, "kind": kind, **body}
+
+
+def test_decode_tpu_config_with_sharing():
+    cfg = strict_decode(blob("TpuConfig", sharing={"strategy": "TimeSlicing",
+                                                  "time_slicing": {"interval": "Short"}}))
+    assert isinstance(cfg, TpuConfig)
+    assert cfg.sharing.time_slicing.interval == "Short"
+    cfg.validate()
+
+
+def test_decode_defaults_and_normalize():
+    cfg = strict_decode(blob("TpuConfig", sharing={"strategy": "TimeSlicing"}))
+    # normalize fills the default interval sub-config.
+    assert cfg.sharing.time_slicing.interval == "Default"
+    cfg.validate()
+
+
+def test_strict_rejects_unknown_fields():
+    with pytest.raises(DecodeError, match="unknown field 'sharingg'"):
+        strict_decode(blob("TpuConfig", sharingg={}))
+    with pytest.raises(DecodeError, match="unknown field 'sharing.time_slicing.interval_typo'"):
+        strict_decode(blob("TpuConfig",
+                           sharing={"strategy": "TimeSlicing",
+                                    "time_slicing": {"interval_typo": "Short"}}))
+
+
+def test_nonstrict_drops_unknown_fields():
+    cfg = nonstrict_decode(blob("TpuConfig", sharingg={}, extra=1))
+    assert isinstance(cfg, TpuConfig)
+    assert cfg.sharing is None
+
+
+def test_decode_rejects_bad_envelope():
+    with pytest.raises(DecodeError, match="apiVersion"):
+        strict_decode({"kind": "TpuConfig"})
+    with pytest.raises(DecodeError, match="unknown config kind"):
+        strict_decode(blob("GpuConfig"))
+
+
+def test_validate_sharing_cross_field():
+    cfg = strict_decode(blob("TpuConfig", sharing={
+        "strategy": "TimeSlicing",
+        "premapped": {"default_premapped_hbm_bytes": 1},
+    }))
+    with pytest.raises(ValidationError, match="premapped config set"):
+        cfg.validate()
+    cfg2 = strict_decode(blob("TpuConfig", sharing={"strategy": "Premapped"}))
+    with pytest.raises(ValidationError, match="requires a premapped config"):
+        cfg2.validate()
+    cfg3 = strict_decode(blob("TpuConfig", sharing={
+        "strategy": "Premapped",
+        "premapped": {"default_premapped_hbm_bytes": 1 << 30,
+                      "per_chip_premapped_hbm_bytes": {"0": 1 << 29}},
+    }))
+    cfg3.validate()
+    # normalize coerced string chip keys to ints.
+    assert cfg3.sharing.premapped.per_chip_premapped_hbm_bytes == {0: 1 << 29}
+
+
+def test_validate_bad_interval():
+    cfg = strict_decode(blob("TpuConfig", sharing={
+        "strategy": "TimeSlicing", "time_slicing": {"interval": "Forever"}}))
+    with pytest.raises(ValidationError, match="Forever"):
+        cfg.validate()
+
+
+def test_subslice_config():
+    cfg = strict_decode(blob("SubsliceConfig", profile="1x2"))
+    assert isinstance(cfg, SubsliceConfig)
+    cfg.validate()
+    bad = strict_decode(blob("SubsliceConfig", profile="2by2"))
+    with pytest.raises(ValidationError):
+        bad.validate()
+
+
+def test_vfio_config_normalizes_case():
+    cfg = strict_decode(blob("VfioTpuConfig", iommu_mode="IOMMUFD"))
+    assert isinstance(cfg, VfioTpuConfig)
+    assert cfg.iommu_mode == "iommufd"
+    cfg.validate()
+    bad = strict_decode(blob("VfioTpuConfig", iommu_mode="none"))
+    with pytest.raises(ValidationError):
+        bad.validate()
+
+
+def test_channel_config_requires_domain():
+    cfg = strict_decode(blob("ComputeDomainChannelConfig", domain_id="abc"))
+    assert isinstance(cfg, ComputeDomainChannelConfig)
+    cfg.validate()
+    with pytest.raises(ValidationError, match="domain_id"):
+        strict_decode(blob("ComputeDomainChannelConfig")).validate()

@@ -1,0 +1,196 @@
+"""APIServer semantics: CAS, finalizers, watches, informers, GC."""
+
+import threading
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import (
+    APIServer,
+    AlreadyExistsError,
+    ConflictError,
+    Informer,
+    K8sObject,
+    NotFoundError,
+)
+from k8s_dra_driver_tpu.k8s.core import Pod, ResourceClaim
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+
+def make_pod(name, ns="default", **kw):
+    return Pod(meta=new_meta(name, ns, **kw))
+
+
+def test_create_get_roundtrip_and_isolation():
+    api = APIServer()
+    p = make_pod("a")
+    created = api.create(p)
+    assert created.meta.uid and created.meta.resource_version > 0
+    # Mutating the caller's object does not affect the store.
+    p.node_name = "mutated"
+    got = api.get("Pod", "a", "default")
+    assert got.node_name == ""
+    # Mutating what get() returned doesn't either.
+    got.node_name = "also-mutated"
+    assert api.get("Pod", "a", "default").node_name == ""
+
+
+def test_create_duplicate_rejected():
+    api = APIServer()
+    api.create(make_pod("a"))
+    with pytest.raises(AlreadyExistsError):
+        api.create(make_pod("a"))
+    api.create(make_pod("a", ns="other"))  # different namespace is fine
+
+
+def test_update_cas_conflict():
+    api = APIServer()
+    api.create(make_pod("a"))
+    fresh = api.get("Pod", "a", "default")
+    stale = api.get("Pod", "a", "default")
+    fresh.node_name = "n1"
+    api.update(fresh)
+    stale.node_name = "n2"
+    with pytest.raises(ConflictError):
+        api.update(stale)
+    assert api.get("Pod", "a", "default").node_name == "n1"
+
+
+def test_update_with_retry_absorbs_conflicts():
+    api = APIServer()
+    api.create(make_pod("a"))
+    errs = []
+
+    def bump(tag):
+        def mutate(obj):
+            obj.meta.labels[tag] = "1"
+        try:
+            api.update_with_retry("Pod", "a", "default", mutate)
+        except ConflictError as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=bump, args=(f"t{i}",)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    labels = api.get("Pod", "a", "default").meta.labels
+    assert all(labels.get(f"t{i}") == "1" for i in range(8))
+
+
+def test_finalizer_deletion_dance():
+    api = APIServer()
+    api.create(make_pod("a", finalizers=["dra.tpu.google.com/finalizer"]))
+    api.delete("Pod", "a", "default")
+    # Still present, now deleting.
+    obj = api.get("Pod", "a", "default")
+    assert obj.deleting
+    # Second delete is a no-op.
+    api.delete("Pod", "a", "default")
+    # Removing the finalizer completes deletion.
+    obj.meta.finalizers = []
+    api.update(obj)
+    with pytest.raises(NotFoundError):
+        api.get("Pod", "a", "default")
+
+
+def test_delete_without_finalizers_is_immediate():
+    api = APIServer()
+    api.create(make_pod("a"))
+    api.delete("Pod", "a", "default")
+    assert api.try_get("Pod", "a", "default") is None
+
+
+def test_list_with_selectors():
+    api = APIServer()
+    api.create(make_pod("a", labels={"app": "x"}))
+    api.create(make_pod("b", labels={"app": "y"}))
+    api.create(make_pod("c", ns="other", labels={"app": "x"}))
+    assert [o.name for o in api.list("Pod", label_selector={"app": "x"})] == ["a", "c"]
+    assert [o.name for o in api.list("Pod", namespace="default")] == ["a", "b"]
+
+
+def test_watch_stream():
+    api = APIServer()
+    q = api.watch("Pod")
+    api.create(make_pod("a"))
+    obj = api.get("Pod", "a", "default")
+    obj.node_name = "n"
+    api.update(obj)
+    api.delete("Pod", "a", "default")
+    events = [q.get(timeout=1) for _ in range(3)]
+    assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+    assert events[1].obj.node_name == "n"
+
+
+def test_informer_cache_handlers_and_lister():
+    api = APIServer()
+    api.create(make_pod("pre", labels={"app": "x"}))
+    inf = Informer(api, "Pod")
+    adds, updates, deletes = [], [], []
+    inf.add_event_handler(
+        on_add=lambda old, new: adds.append(new.name),
+        on_update=lambda old, new: updates.append((old.node_name, new.node_name)),
+        on_delete=lambda old, new: deletes.append(new.name),
+    )
+    inf.start()
+    try:
+        assert inf.wait_for_cache_sync()
+        assert adds == ["pre"]
+        api.create(make_pod("post"))
+        obj = api.get("Pod", "post", "default")
+        obj.node_name = "n9"
+        api.update(obj)
+        api.delete("Pod", "post", "default")
+
+        deadline = threading.Event()
+        for _ in range(100):
+            if deletes:
+                break
+            deadline.wait(0.05)
+        assert adds == ["pre", "post"]
+        assert updates == [("", "n9")]
+        assert deletes == ["post"]
+        assert [o.name for o in inf.list(label_selector={"app": "x"})] == ["pre"]
+        assert inf.get("pre", "default") is not None
+        assert inf.get("post", "default") is None
+    finally:
+        inf.stop()
+
+
+def test_informer_handler_exception_does_not_kill_stream():
+    api = APIServer()
+    inf = Informer(api, "Pod")
+    seen = []
+
+    def bad_handler(old, new):
+        raise RuntimeError("boom")
+
+    inf.add_event_handler(on_add=bad_handler)
+    inf.add_event_handler(on_add=lambda old, new: seen.append(new.name))
+    inf.start()
+    try:
+        api.create(make_pod("a"))
+        api.create(make_pod("b"))
+        for _ in range(100):
+            if len(seen) == 2:
+                break
+            threading.Event().wait(0.05)
+        assert seen == ["a", "b"]
+    finally:
+        inf.stop()
+
+
+def test_orphan_gc():
+    api = APIServer()
+    owner = api.create(ResourceClaim(meta=new_meta("cd", "default")))
+    child = Pod(meta=new_meta("child", "default"))
+    child.add_owner(owner)
+    api.create(child)
+    independent = api.create(make_pod("indep"))
+    assert api.collect_orphans(["Pod"]) == 0
+    api.delete("ResourceClaim", "cd", "default")
+    assert api.collect_orphans(["Pod"]) == 1
+    assert api.try_get("Pod", "child", "default") is None
+    assert api.try_get("Pod", "indep", "default") is not None
+    assert independent is not None

@@ -1,0 +1,358 @@
+"""tpu-kubelet-plugin: publishing, prepare/unprepare state machine, crash
+consistency, config precedence, health taints, stale cleanup.
+
+Models the reference's unit tier (SURVEY.md §4.1): checkpoint state machine
+(device_state_test.go:379-505), publishing rules (driver_test.go:37-53),
+config precedence (device_state_test.go:78-216), health->taint mapping
+(device_health_test.go:44-235).
+"""
+
+import os
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    DeviceClaimConfig,
+    DeviceRequestAllocationResult,
+    OpaqueDeviceConfig,
+    RESOURCE_SLICE,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    CheckpointManager,
+    CorruptCheckpointError,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+)
+from k8s_dra_driver_tpu.plugins.tpu.device_state import OverlapError, PrepareError
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import ChipHealth, MockTpuLib
+
+NODE = "node-0"
+
+
+@pytest.fixture
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+    return p
+
+
+@pytest.fixture
+def env(tmp_path, boot_id):
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    driver = TpuDriver(
+        api=api,
+        node_name=NODE,
+        tpulib=lib,
+        plugin_dir=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("TimeSlicingSettings=true,PremappedBufferSharing=true,"
+                       "TPUDeviceHealthCheck=true"),
+    )
+    driver.start()
+    yield api, lib, driver, tmp_path
+    driver.shutdown()
+
+
+def make_claim(devices, name="claim-a", ns="default", configs=None, requests=None):
+    uid = fresh_uid()
+    claim = ResourceClaim(meta=new_meta(name, ns))
+    claim.meta.uid = uid
+    claim.allocation = AllocationResult(
+        devices=[
+            DeviceRequestAllocationResult(
+                request=(requests or ["r0"] * len(devices))[i],
+                driver=TPU_DRIVER_NAME,
+                pool=NODE,
+                device=d,
+            )
+            for i, d in enumerate(devices)
+        ],
+        node_name=NODE,
+    )
+    claim.config = configs or []
+    return claim
+
+
+def sharing_cfg(interval, source="claim", requests=None):
+    return DeviceClaimConfig(
+        requests=requests or [],
+        source=source,
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters={
+                "apiVersion": API_VERSION,
+                "kind": "TpuConfig",
+                "sharing": {"strategy": "TimeSlicing",
+                            "time_slicing": {"interval": interval}},
+            },
+        ),
+    )
+
+
+# -- publishing --------------------------------------------------------------
+
+def test_publish_resource_slice(env):
+    api, _, driver, _ = env
+    slices = api.list(RESOURCE_SLICE)
+    assert len(slices) == 1
+    rs = slices[0]
+    assert rs.driver == TPU_DRIVER_NAME
+    assert rs.node_name == NODE
+    names = [d.name for d in rs.devices]
+    assert [n for n in names if n.startswith("tpu-") and "-subslice-" not in n] == \
+        ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    # 2x2 host: 1x2 x2 + 2x1 x2 + 1x1 x4 = 8 subslice placements.
+    assert len([n for n in names if "subslice" in n]) == 8
+    # Counter set covers 4 chips; every device consumes its chips.
+    assert len(rs.shared_counters) == 1
+    assert set(rs.shared_counters[0].counters) == {f"chip-{i}" for i in range(4)}
+    by_name = {d.name: d for d in rs.devices}
+    assert set(by_name["tpu-subslice-1x2-at-0x0"].consumes_counters[0].counters) == \
+        {"chip-0", "chip-1"}
+    assert by_name["tpu-0"].attributes["tpu.google.com/iciDomain"].startswith("mock-slice")
+
+
+# -- prepare / unprepare -----------------------------------------------------
+
+def test_prepare_single_chip(env):
+    api, _, driver, tmp = env
+    claim = make_claim(["tpu-0"])
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert not isinstance(res, Exception)
+    assert res.cdi_device_ids == [f"k8s.tpu.google.com/claim={claim.uid}-tpu-0"]
+    spec = driver.state.cdi.read_claim_spec(claim.uid)
+    edits = spec["devices"][0]["containerEdits"]
+    assert {"path": "/dev/accel0"} in edits["deviceNodes"]
+    env_map = dict(e.split("=", 1) for e in edits["env"])
+    assert env_map["TPU_VISIBLE_CHIPS"] == "0"
+    assert env_map["TPU_SKIP_MDS_QUERY"] == "true"
+    cp = driver.state.prepared_claims()
+    assert cp[claim.uid].state == PREPARE_COMPLETED
+
+
+def test_prepare_idempotent(env):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-1"])
+    r1 = driver.prepare_resource_claims([claim])[claim.uid]
+    r2 = driver.prepare_resource_claims([claim])[claim.uid]
+    assert r1.cdi_device_ids == r2.cdi_device_ids
+    assert len(driver.state.prepared_claims()) == 1
+
+
+def test_overlap_rejected_chip_vs_chip_and_subslice(env):
+    _, _, driver, _ = env
+    a = make_claim(["tpu-0"])
+    assert not isinstance(driver.prepare_resource_claims([a])[a.uid], Exception)
+    b = make_claim(["tpu-0"], name="claim-b")
+    res = driver.prepare_resource_claims([b])[b.uid]
+    assert isinstance(res, OverlapError)
+    # A subslice containing chip 0 also conflicts.
+    c = make_claim(["tpu-subslice-1x2-at-0x0"], name="claim-c")
+    res = driver.prepare_resource_claims([c])[c.uid]
+    assert isinstance(res, OverlapError)
+    # A disjoint subslice is fine.
+    d = make_claim(["tpu-subslice-1x2-at-1x0"], name="claim-d")
+    assert not isinstance(driver.prepare_resource_claims([d])[d.uid], Exception)
+
+
+def test_unprepare_idempotent_and_cleans(env):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-0"])
+    driver.prepare_resource_claims([claim])
+    assert driver.state.cdi.claim_spec_exists(claim.uid)
+    assert driver.unprepare_resource_claims([claim.uid])[claim.uid] is None
+    assert not driver.state.cdi.claim_spec_exists(claim.uid)
+    assert driver.state.prepared_claims() == {}
+    # Unprepare of unknown uid is fine.
+    assert driver.unprepare_resource_claims(["nope"])["nope"] is None
+
+
+def test_prepare_unknown_device_rejected(env):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-99"])
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PrepareError)
+    assert driver.state.prepared_claims() == {}
+
+
+def test_stale_prepare_started_rolled_back(env, tmp_path):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-2"])
+    # Simulate a crash mid-prepare: entry stuck at PrepareStarted.
+    cp = driver.state._get_checkpoint()
+    from k8s_dra_driver_tpu.plugins.checkpoint import PreparedClaim
+
+    cp.claims[claim.uid] = PreparedClaim(
+        claim_uid=claim.uid, namespace="default", name="claim-a",
+        state=PREPARE_STARTED,
+    )
+    driver.state._save_checkpoint(cp)
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert not isinstance(res, Exception)
+    assert driver.state.prepared_claims()[claim.uid].state == PREPARE_COMPLETED
+
+
+# -- crash consistency -------------------------------------------------------
+
+def test_boot_id_invalidation(tmp_path, boot_id):
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    plugin_dir = str(tmp_path / "plugin")
+    cdi_root = str(tmp_path / "cdi")
+    d1 = TpuDriver(api=api, node_name=NODE, tpulib=lib, plugin_dir=plugin_dir,
+                   cdi_root=cdi_root)
+    claim = make_claim(["tpu-0"])
+    d1.prepare_resource_claims([claim])
+    assert d1.state.cdi.claim_spec_exists(claim.uid)
+    # Reboot: boot id changes; a fresh DeviceState must discard everything.
+    boot_id.write_text("boot-2\n")
+    d2 = TpuDriver(api=api, node_name=NODE, tpulib=lib, plugin_dir=plugin_dir,
+                   cdi_root=cdi_root)
+    assert d2.state.prepared_claims() == {}
+    assert not d2.state.cdi.claim_spec_exists(claim.uid)
+
+
+def test_checkpoint_survives_restart(tmp_path, boot_id):
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    plugin_dir = str(tmp_path / "plugin")
+    d1 = TpuDriver(api=api, node_name=NODE, tpulib=lib, plugin_dir=plugin_dir,
+                   cdi_root=str(tmp_path / "cdi"))
+    claim = make_claim(["tpu-0"])
+    ids1 = d1.prepare_resource_claims([claim])[claim.uid].cdi_device_ids
+    d2 = TpuDriver(api=api, node_name=NODE, tpulib=lib, plugin_dir=plugin_dir,
+                   cdi_root=str(tmp_path / "cdi"))
+    # Same boot: the prepared claim is remembered and idempotently returned.
+    ids2 = d2.prepare_resource_claims([claim])[claim.uid].cdi_device_ids
+    assert ids1 == ids2
+    # And its chips still conflict for other claims.
+    other = make_claim(["tpu-0"], name="other")
+    assert isinstance(d2.prepare_resource_claims([other])[other.uid], OverlapError)
+
+
+def test_corrupt_checkpoint_raises_with_diff(tmp_path, boot_id):
+    plugin_dir = tmp_path / "plugin"
+    plugin_dir.mkdir()
+    path = plugin_dir / "checkpoint.json"
+    mgr = CheckpointManager(str(path))
+    from k8s_dra_driver_tpu.plugins.checkpoint import Checkpoint
+
+    mgr.save(Checkpoint(node_boot_id="boot-1"))
+    # Flip a byte in the payload.
+    raw = path.read_text().replace("boot-1", "boot-X")
+    path.write_text(raw)
+    with pytest.raises(CorruptCheckpointError) as ei:
+        mgr.load()
+    assert "on-disk" in str(ei.value) and "re-marshaled" in str(ei.value)
+
+
+def test_checkpoint_v1_migration(tmp_path):
+    path = tmp_path / "checkpoint.json"
+    path.write_text('{"version": "v1", "data": {"claims": {}}}')
+    cp = CheckpointManager(str(path)).load()
+    assert cp is not None and cp.node_boot_id == ""
+
+
+# -- configs -----------------------------------------------------------------
+
+def test_sharing_config_applies_env(env):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-0"], configs=[sharing_cfg("Short")])
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert not isinstance(res, Exception)
+    spec = driver.state.cdi.read_claim_spec(claim.uid)
+    env_map = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+    assert env_map["TPU_TIMESLICE_US"] == "2000"
+
+
+def test_claim_config_overrides_class_config(env):
+    _, _, driver, _ = env
+    claim = make_claim(
+        ["tpu-0"],
+        configs=[sharing_cfg("Long", source="class"), sharing_cfg("Short", source="claim")],
+    )
+    driver.prepare_resource_claims([claim])
+    recs = driver.state.sharing.records_for([0])
+    assert [r["interval"] for r in recs] == ["Short"]
+
+
+def test_time_slicing_gate_enforced(tmp_path, boot_id):
+    driver = TpuDriver(
+        api=APIServer(), node_name=NODE, tpulib=MockTpuLib("v5e-4"),
+        plugin_dir=str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse(""),  # TimeSlicingSettings off
+    )
+    claim = make_claim(["tpu-0"], configs=[sharing_cfg("Short")])
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PrepareError)
+    # Failed prepare leaves no residue.
+    assert driver.state.prepared_claims() == {}
+    assert not driver.state.cdi.claim_spec_exists(claim.uid)
+    assert driver.state.sharing.records_for([0]) == []
+
+
+def test_subslice_env_bounds(env):
+    _, _, driver, _ = env
+    claim = make_claim(["tpu-subslice-1x2-at-0x0"])
+    driver.prepare_resource_claims([claim])
+    spec = driver.state.cdi.read_claim_spec(claim.uid)
+    env_map = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+    assert env_map["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+    assert env_map["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    assert env_map["TPU_VISIBLE_CHIPS"] == "0,1"
+    # Partial host: no slice identity leaked.
+    assert env_map["TPU_TOPOLOGY"] == ""
+
+
+def test_whole_host_claim_gets_slice_identity(env):
+    _, _, driver, _ = env
+    claim = make_claim([f"tpu-{i}" for i in range(4)])
+    driver.prepare_resource_claims([claim])
+    spec = driver.state.cdi.read_claim_spec(claim.uid)
+    env_map = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+    assert env_map["TPU_TOPOLOGY"] == "2x2"
+    assert env_map["TPU_WORKER_ID"] == "0"
+    assert env_map["TPU_ACCELERATOR_TYPE"] == "v5litepod-4"
+
+
+# -- health ------------------------------------------------------------------
+
+def test_health_event_taints_and_republishes(env):
+    api, lib, driver, _ = env
+    lib.set_health(0, ChipHealth.UNHEALTHY)
+    rs = api.list(RESOURCE_SLICE)[0]
+    tainted = {d.name for d in rs.devices if d.taints}
+    # Chip 0 and every subslice containing chip 0 are tainted.
+    assert "tpu-0" in tainted
+    assert "tpu-subslice-1x2-at-0x0" in tainted
+    assert "tpu-1" not in tainted
+    # Recovery clears the taints.
+    lib.set_health(0, ChipHealth.HEALTHY)
+    rs = api.list(RESOURCE_SLICE)[0]
+    assert not any(d.taints for d in rs.devices)
+
+
+# -- stale cleanup ------------------------------------------------------------
+
+def test_cleanup_stale_claims(env):
+    api, _, driver, _ = env
+    claim = make_claim(["tpu-0"])
+    api.create(claim)
+    stored = api.get("ResourceClaim", claim.name, claim.namespace)
+    claim.meta.uid = stored.uid
+    driver.prepare_resource_claims([claim])
+    # Claim still exists: nothing cleaned.
+    assert driver.cleanup_stale_claims() == 0
+    api.delete("ResourceClaim", claim.name, claim.namespace)
+    assert driver.cleanup_stale_claims() == 1
+    assert driver.state.prepared_claims() == {}

@@ -243,3 +243,50 @@ def test_cli_info_json(monkeypatch, capsys):
     data = json.loads(capsys.readouterr().out)
     assert len(data["chips"]) == 4
     assert data["gen"] == "v5e"
+
+
+# -- review regression tests -------------------------------------------------
+
+def test_3d_subslice_names_unique():
+    from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
+        enumerate_allocatable, parse_device_name,
+    )
+
+    inv = MockTpuLib("v4-8", worker_id=0).enumerate()
+    devs = enumerate_allocatable(inv)
+    subs = [n for n in devs if "subslice" in n]
+    assert len(subs) == len(set(subs))
+    # 2x2x1 host: 1x1x1 x4 + 1x2x1 x2 + 2x1x1 x2 = 8 distinct placements.
+    assert len(subs) == 8
+    for n in subs:
+        t, info = parse_device_name(n)
+        assert t == "subslice" and len(info["start"]) == 3
+
+
+def test_factory_mock_honors_explicit_env(monkeypatch):
+    monkeypatch.setenv("ALT_TPU_WORKER_ID", "3")  # hostile ambient env
+    lib = new_tpulib(env={"ALT_TPU_TOPOLOGY": "v5e-4"})
+    assert lib.enumerate().worker_id == 0
+
+
+def test_busy_device_is_healthy(tmp_path):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").write_bytes(b"")
+    (dev / "accel0").chmod(0o000)  # EACCES on open = alive but held
+    try:
+        lib = RealTpuLib(lib_path=SHIM if os.path.exists(SHIM) else "/nonexistent",
+                         dev_root=str(dev), sysfs_root=str(tmp_path / "sys"), env={})
+        assert lib.chip_health(0) == ChipHealth.HEALTHY
+        inv = lib.enumerate()
+        assert inv.chips[0].health == ChipHealth.HEALTHY
+    finally:
+        (dev / "accel0").chmod(0o644)
+
+
+def test_cli_health_out_of_range_mock(monkeypatch, capsys):
+    from k8s_dra_driver_tpu.tpulib import cli
+
+    monkeypatch.setenv("ALT_TPU_TOPOLOGY", "v5e-4")
+    assert cli.main(["health", "9"]) == 1
+    assert capsys.readouterr().out.strip() == "unhealthy"
