@@ -1,0 +1,8 @@
+"""compute-domain-controller (L5) — the cluster-scoped ComputeDomain reconciler."""
+
+from k8s_dra_driver_tpu.controller.controller import Controller  # noqa: F401
+from k8s_dra_driver_tpu.controller.templates import (  # noqa: F401
+    daemon_resource_claim_template,
+    daemon_set_for_domain,
+    workload_resource_claim_template,
+)

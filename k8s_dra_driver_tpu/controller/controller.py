@@ -1,0 +1,324 @@
+"""The ComputeDomain reconciler.
+
+Reference: /root/reference/cmd/compute-domain-controller/ (SURVEY.md §3.3).
+Per ComputeDomain it owns: the per-CD slice-agent DaemonSet (node-selected
+on the CD label so it follows the workload), the daemon + workload
+ResourceClaimTemplates, aggregated status from cliques, stale node-label
+removal, orphan cleanup, and leader election around the whole loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    CD_STATUS_NOT_READY,
+    CD_STATUS_READY,
+    COMPUTE_DOMAIN_FINALIZER,
+    COMPUTE_DOMAIN_NODE_LABEL,
+    ComputeDomain,
+    ComputeDomainNode,
+    ComputeDomainStatus,
+)
+from k8s_dra_driver_tpu.controller.templates import (
+    daemon_resource_claim_template,
+    daemon_set_for_domain,
+    workload_resource_claim_template,
+)
+from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, Informer, NotFoundError
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    COMPUTE_DOMAIN_CLIQUE,
+    DAEMON_SET,
+    NODE,
+    RESOURCE_CLAIM_TEMPLATE,
+)
+from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
+from k8s_dra_driver_tpu.pkg.metrics import ComputeDomainStatusMetric, Registry
+from k8s_dra_driver_tpu.pkg.workqueue import WorkQueue, default_controller_rate_limiter
+
+log = logging.getLogger(__name__)
+
+
+class Controller:
+    def __init__(
+        self,
+        api: APIServer,
+        driver_namespace: str = "tpu-dra-driver",
+        identity: str = "controller-0",
+        leader_elect: bool = False,
+        metrics_registry: Optional[Registry] = None,
+        cleanup_interval_s: float = 600.0,
+    ):
+        self.api = api
+        self.driver_namespace = driver_namespace
+        self.identity = identity
+        self.metric = ComputeDomainStatusMetric(metrics_registry or Registry())
+        self._queue = WorkQueue(
+            self._reconcile_key, default_controller_rate_limiter(), name="cd-controller"
+        )
+        self._cd_informer = Informer(api, COMPUTE_DOMAIN)
+        self._clique_informer = Informer(api, COMPUTE_DOMAIN_CLIQUE)
+        self._cd_informer.add_event_handler(
+            on_add=lambda old, new: self._enqueue(new),
+            on_update=lambda old, new: self._enqueue(new),
+            on_delete=lambda old, new: self._enqueue(new),
+        )
+        self._clique_informer.add_event_handler(
+            on_add=lambda old, new: self._enqueue_for_clique(new),
+            on_update=lambda old, new: self._enqueue_for_clique(new),
+            on_delete=lambda old, new: self._enqueue_for_clique(new),
+        )
+        self._elector: Optional[LeaderElector] = None
+        if leader_elect:
+            self._elector = LeaderElector(
+                api, "tpu-dra-compute-domain-controller", identity,
+                on_started_leading=self._start_workers,
+                on_stopped_leading=self._stop_workers,
+            )
+        self._cleanup_interval = cleanup_interval_s
+        self._stop = threading.Event()
+        self._cleanup_thread: Optional[threading.Thread] = None
+        self._workers_running = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._cd_informer.start()
+        self._clique_informer.start()
+        if self._elector is not None:
+            self._elector.start()
+        else:
+            self._start_workers()
+        self._cleanup_thread = threading.Thread(
+            target=self._cleanup_loop, name="cd-cleanup", daemon=True
+        )
+        self._cleanup_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._elector is not None:
+            self._elector.stop()
+        self._stop_workers()
+        self._cd_informer.stop()
+        self._clique_informer.stop()
+        if self._cleanup_thread:
+            self._cleanup_thread.join(timeout=5)
+
+    def _start_workers(self) -> None:
+        if not self._workers_running:
+            self._queue.start(workers=1)
+            self._workers_running = True
+            # Reconcile everything known at takeover.
+            for cd in self._cd_informer.list():
+                self._enqueue(cd)
+
+    def _stop_workers(self) -> None:
+        if self._workers_running:
+            self._queue.stop()
+            self._workers_running = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._elector.is_leader if self._elector else True
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        return self._queue.drain(timeout)
+
+    # -- queue plumbing --------------------------------------------------------
+
+    def _enqueue(self, cd) -> None:
+        self._queue.enqueue((cd.namespace, cd.name))
+
+    def _enqueue_for_clique(self, clique) -> None:
+        for cd in self._cd_informer.list(namespace=clique.meta.namespace):
+            if cd.uid == getattr(clique, "domain_uid", None):
+                self._enqueue(cd)
+
+    def _reconcile_key(self, key, _obj) -> None:
+        namespace, name = key
+        cd = self.api.try_get(COMPUTE_DOMAIN, name, namespace)
+        if cd is None:
+            self._cleanup_orphans()
+            return
+        self.reconcile(cd)  # type: ignore[arg-type]
+
+    # -- reconcile -------------------------------------------------------------
+
+    def reconcile(self, cd: ComputeDomain) -> None:
+        if cd.deleting:
+            self._teardown(cd)
+            return
+        self._ensure_finalizer(cd)
+        self._ensure_owned_objects(cd)
+        self._update_status(cd)
+
+    def _ensure_finalizer(self, cd: ComputeDomain) -> None:
+        if COMPUTE_DOMAIN_FINALIZER in cd.meta.finalizers:
+            return
+        def mutate(obj):
+            if COMPUTE_DOMAIN_FINALIZER not in obj.meta.finalizers:
+                obj.meta.finalizers.append(COMPUTE_DOMAIN_FINALIZER)
+        self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
+
+    def _ensure_owned_objects(self, cd: ComputeDomain) -> None:
+        cd = self.api.get(COMPUTE_DOMAIN, cd.name, cd.namespace)  # fresh uid/rv
+        rct_daemon = daemon_resource_claim_template(cd, self.driver_namespace)
+        rct_workload = workload_resource_claim_template(cd)
+        ds = daemon_set_for_domain(cd, self.driver_namespace)
+        for obj in (rct_daemon, rct_workload, ds):
+            existing = self.api.try_get(obj.kind, obj.meta.name, obj.meta.namespace)
+            if existing is None:
+                self.api.create(obj)
+            elif not existing.owned_by(cd):
+                raise RuntimeError(
+                    f"{obj.kind} {obj.key} exists but is not owned by ComputeDomain "
+                    f"{cd.key} — refusing to adopt"
+                )
+
+    # -- status ---------------------------------------------------------------
+
+    def _collect_nodes(self, cd: ComputeDomain) -> List[ComputeDomainNode]:
+        nodes: List[ComputeDomainNode] = []
+        for clique in self.api.list(COMPUTE_DOMAIN_CLIQUE, namespace=cd.namespace):
+            if clique.domain_uid != cd.uid:
+                continue
+            for info in clique.nodes:
+                nodes.append(
+                    ComputeDomainNode(
+                        name=info.node_name,
+                        ip_address=info.ip_address,
+                        ici_domain=clique.ici_domain,
+                        worker_id=info.index,
+                        status=CD_STATUS_READY if info.ready else CD_STATUS_NOT_READY,
+                    )
+                )
+        nodes.sort(key=lambda n: (n.ici_domain, n.worker_id))
+        return nodes
+
+    def _calculate_global_status(self, cd: ComputeDomain, nodes: List[ComputeDomainNode]) -> str:
+        ready = [n for n in nodes if n.status == CD_STATUS_READY]
+        want = cd.spec.num_nodes
+        if want > 0:
+            return CD_STATUS_READY if len(ready) >= want else CD_STATUS_NOT_READY
+        # Size-follows-workload: ready when at least one node exists and all
+        # registered nodes are ready.
+        return (
+            CD_STATUS_READY
+            if nodes and len(ready) == len(nodes)
+            else CD_STATUS_NOT_READY
+        )
+
+    def _update_status(self, cd: ComputeDomain) -> None:
+        nodes = self._collect_nodes(cd)
+        status = self._calculate_global_status(cd, nodes)
+        desired = ComputeDomainStatus(status=status, nodes=nodes)
+        # Only write on change: an unconditional write emits MODIFIED, which
+        # re-enqueues this CD, which writes again — a full-speed loop.
+        fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
+        if fresh is None:
+            return
+        if fresh.status == desired:
+            self.metric.set(cd.namespace, cd.name, status)
+            return
+
+        def mutate(obj):
+            obj.status = ComputeDomainStatus(status=status, nodes=nodes)
+
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
+        except NotFoundError:
+            return
+        self.metric.set(cd.namespace, cd.name, status)
+
+    # -- deletion --------------------------------------------------------------
+
+    def _teardown(self, cd: ComputeDomain) -> None:
+        for kind, name, ns in (
+            (DAEMON_SET, f"{cd.name}-slice-agent", self.driver_namespace),
+            (RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-daemon-claim", self.driver_namespace),
+            (RESOURCE_CLAIM_TEMPLATE,
+             cd.spec.channel.resource_claim_template_name or f"{cd.name}-channel",
+             cd.namespace),
+        ):
+            obj = self.api.try_get(kind, name, ns)
+            if obj is not None and obj.owned_by(cd):
+                try:
+                    self.api.delete(kind, name, ns)
+                except NotFoundError:
+                    pass
+        for clique in self.api.list(COMPUTE_DOMAIN_CLIQUE, namespace=cd.namespace):
+            if clique.domain_uid == cd.uid:
+                try:
+                    self.api.delete(COMPUTE_DOMAIN_CLIQUE, clique.name, clique.namespace)
+                except NotFoundError:
+                    pass
+        self._remove_node_labels(cd.uid)
+        self.metric.forget(cd.namespace, cd.name)
+
+        def drop_finalizer(obj):
+            obj.meta.finalizers = [
+                f for f in obj.meta.finalizers if f != COMPUTE_DOMAIN_FINALIZER
+            ]
+
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, drop_finalizer)
+        except NotFoundError:
+            pass
+
+    def _remove_node_labels(self, cd_uid: str) -> None:
+        for node in self.api.list(NODE, label_selector={COMPUTE_DOMAIN_NODE_LABEL: cd_uid}):
+            def mutate(obj):
+                if obj.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL) == cd_uid:
+                    del obj.meta.labels[COMPUTE_DOMAIN_NODE_LABEL]
+            try:
+                self.api.update_with_retry(NODE, node.name, "", mutate)
+            except NotFoundError:
+                pass
+
+    # -- orphan cleanup -----------------------------------------------------------
+
+    def _cleanup_orphans(self) -> int:
+        """Remove DS/RCTs/cliques/labels whose owning CD is gone — the
+        CleanupManager[T] analog (cleanup.go:35-146)."""
+        live_uids = {cd.uid for cd in self.api.list(COMPUTE_DOMAIN)}
+        removed = 0
+        for kind in (DAEMON_SET, RESOURCE_CLAIM_TEMPLATE):
+            for obj in self.api.list(kind):
+                refs = [r for r in obj.meta.owner_references if r.kind == COMPUTE_DOMAIN]
+                if refs and all(r.uid not in live_uids for r in refs):
+                    try:
+                        self.api.delete(kind, obj.meta.name, obj.meta.namespace)
+                        removed += 1
+                    except NotFoundError:
+                        pass
+        for clique in self.api.list(COMPUTE_DOMAIN_CLIQUE):
+            if clique.domain_uid and clique.domain_uid not in live_uids:
+                try:
+                    self.api.delete(COMPUTE_DOMAIN_CLIQUE, clique.name, clique.namespace)
+                    removed += 1
+                except NotFoundError:
+                    pass
+        for node in self.api.list(NODE):
+            uid = node.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL)
+            if uid and uid not in live_uids:
+                def mutate(obj, uid=uid):
+                    if obj.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL) == uid:
+                        del obj.meta.labels[COMPUTE_DOMAIN_NODE_LABEL]
+                try:
+                    self.api.update_with_retry(NODE, node.name, "", mutate)
+                    removed += 1
+                except NotFoundError:
+                    pass
+        return removed
+
+    def _cleanup_loop(self) -> None:
+        while not self._stop.wait(self._cleanup_interval):
+            if not self.is_leader:
+                continue
+            try:
+                self._cleanup_orphans()
+            except Exception:  # noqa: BLE001
+                log.exception("orphan cleanup failed")

@@ -1,0 +1,102 @@
+"""Rendered objects the controller creates per ComputeDomain.
+
+Code-level equivalents of the reference's Go templates
+(/root/reference/templates/compute-domain-daemon.tmpl.yaml and the RCT
+construction in cmd/compute-domain-controller/resourceclaimtemplate.go):
+the per-CD DaemonSet node-selected on the CD label, its daemon
+ResourceClaimTemplate, and the workload channel ResourceClaimTemplate.
+"""
+
+from __future__ import annotations
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    COMPUTE_DOMAIN_NODE_LABEL,
+    ComputeDomain,
+)
+from k8s_dra_driver_tpu.api.configs import (
+    API_VERSION,
+    COMPUTE_DOMAIN_DRIVER_NAME,
+)
+from k8s_dra_driver_tpu.k8s.core import (
+    Container,
+    DaemonSet,
+    DeviceClaimConfig,
+    DeviceRequest,
+    OpaqueDeviceConfig,
+    PodResourceClaimRef,
+    PodTemplate,
+    ResourceClaimTemplate,
+)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+# User-facing DeviceClass names (the reference's deviceclass-*.yaml set).
+DEVICE_CLASS_TPU = "tpu.google.com"
+DEVICE_CLASS_CHANNEL = "compute-domain-default-channel.tpu.google.com"
+DEVICE_CLASS_DAEMON = "compute-domain-daemon.tpu.google.com"
+
+DAEMON_SET_LABEL = "resource.tpu.google.com/slice-agent"
+
+
+def _opaque(kind: str, cd: ComputeDomain) -> DeviceClaimConfig:
+    return DeviceClaimConfig(
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=COMPUTE_DOMAIN_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION, "kind": kind, "domain_id": cd.uid},
+        ),
+    )
+
+
+def daemon_resource_claim_template(cd: ComputeDomain, driver_namespace: str) -> ResourceClaimTemplate:
+    rct = ResourceClaimTemplate(
+        meta=new_meta(f"{cd.name}-daemon-claim", driver_namespace),
+        requests=[DeviceRequest(name="daemon", device_class_name=DEVICE_CLASS_DAEMON)],
+        config=[_opaque("ComputeDomainDaemonConfig", cd)],
+    )
+    rct.add_owner(cd)
+    return rct
+
+
+def workload_resource_claim_template(cd: ComputeDomain) -> ResourceClaimTemplate:
+    name = cd.spec.channel.resource_claim_template_name or f"{cd.name}-channel"
+    rct = ResourceClaimTemplate(
+        meta=new_meta(name, cd.namespace),
+        requests=[DeviceRequest(name="channel", device_class_name=DEVICE_CLASS_CHANNEL)],
+        config=[_opaque("ComputeDomainChannelConfig", cd)],
+    )
+    rct.add_owner(cd)
+    return rct
+
+
+def daemon_set_for_domain(cd: ComputeDomain, driver_namespace: str) -> DaemonSet:
+    """The slice-agent DaemonSet that follows the workload via the CD node
+    label the plugin sets at Prepare time."""
+    labels = {DAEMON_SET_LABEL: cd.uid}
+    ds = DaemonSet(
+        meta=new_meta(f"{cd.name}-slice-agent", driver_namespace, labels=labels),
+        selector=dict(labels),
+        node_selector={COMPUTE_DOMAIN_NODE_LABEL: cd.uid},
+        template=PodTemplate(
+            labels=dict(labels),
+            containers=[
+                Container(
+                    name="slice-agent",
+                    image="tpu-dra-driver:latest",
+                    command=["compute-domain-daemon"],
+                    env={
+                        "COMPUTE_DOMAIN_UUID": cd.uid,
+                        "COMPUTE_DOMAIN_NAMESPACE": cd.namespace,
+                        "COMPUTE_DOMAIN_NAME": cd.name,
+                    },
+                )
+            ],
+            resource_claims=[
+                PodResourceClaimRef(
+                    name="daemon",
+                    resource_claim_template_name=f"{cd.name}-daemon-claim",
+                )
+            ],
+        ),
+    )
+    ds.add_owner(cd)
+    return ds

@@ -1,0 +1,12 @@
+"""Slice agent — the per-ComputeDomain node daemon (L2).
+
+Role of the reference's compute-domain-daemon (SURVEY.md §2.1, §3.4): runs
+inside the per-CD DaemonSet pod on every member node, registers the node in
+the domain's clique with a CAS-allocated stable index, maintains the peer
+set, supervises the native bootstrap child process, and answers readiness
+probes that gate the workload's Prepare.
+"""
+
+from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager, clique_name  # noqa: F401
+from k8s_dra_driver_tpu.daemon.process import ProcessManager  # noqa: F401
+from k8s_dra_driver_tpu.daemon.agent import SliceAgent  # noqa: F401

@@ -1,0 +1,196 @@
+"""SliceAgent — the per-node domain daemon run loop.
+
+Reference: /root/reference/cmd/compute-domain-daemon/main.go:212-459. On a
+member node it (a) discovers the node's ICI domain via tpulib, (b) registers
+in the clique and gets its stable worker index, (c) writes the peer config
+file, (d) supervises the native bootstrap child, signaling it on peer-set
+changes, and (e) answers the readiness probe (`check`) that ultimately
+releases the workload: ready ⇔ every expected peer is registered and the
+child is alive — the `nvidia-imex-ctl -q` == READY analog.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager
+from k8s_dra_driver_tpu.daemon.process import ProcessManager
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.tpulib.lib import TpuLib
+
+log = logging.getLogger(__name__)
+
+# A real deployment runs the native bootstrap worker; tests and single-host
+# runs use this inert stand-in (sleeps forever, exits cleanly on SIGTERM).
+DEFAULT_CHILD_ARGV = [
+    sys.executable, "-c",
+    "import signal,time\n"
+    "signal.signal(signal.SIGUSR1, lambda *a: None)\n"
+    "signal.signal(signal.SIGTERM, lambda *a: exit(0))\n"
+    "time.sleep(1e9)",
+]
+
+
+class SliceAgent:
+    def __init__(
+        self,
+        api: APIServer,
+        namespace: str,
+        domain_uid: str,
+        node_name: str,
+        pod_ip: str,
+        tpulib: TpuLib,
+        workdir: str,
+        expected_nodes: int = 0,
+        gates: Optional[fg.FeatureGates] = None,
+        child_argv: Optional[List[str]] = None,
+    ):
+        if not domain_uid:
+            raise ValueError("domain_uid (COMPUTE_DOMAIN_UUID) is required")
+        self.api = api
+        self.namespace = namespace
+        self.domain_uid = domain_uid
+        self.node_name = node_name
+        self.pod_ip = pod_ip
+        self.gates = gates or fg.FeatureGates()
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.inventory = tpulib.enumerate()
+        self.ici_domain = self.inventory.ici_domain
+        # 0 = size follows the slice this node belongs to.
+        self.expected_nodes = expected_nodes or self.inventory.num_hosts
+        self.clique: Optional[CliqueManager] = None
+        self.index = -1
+        self.process = ProcessManager(child_argv or DEFAULT_CHILD_ARGV)
+        self._last_peers: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def dns_name(self) -> str:
+        """Stable per-index name (SliceAgentsWithDNSNames), the
+        <idx>.<clique-hash>.imex.nvidia.com analog."""
+        short = self.ici_domain.replace("/", "-").replace(".", "-")
+        return f"{self.index}.{short}.slice.tpu.internal"
+
+    @property
+    def idle(self) -> bool:
+        """Non-fabric node: no ICI domain to assemble (reference idles,
+        main.go:244-250)."""
+        return not self.ici_domain or not self.inventory.chips
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def startup(self) -> None:
+        if self.idle:
+            log.info("no ICI domain on this node; idling")
+            return
+        self.clique = CliqueManager(
+            self.api, self.namespace, self.domain_uid, self.ici_domain
+        )
+        self.index = self.clique.register(self.node_name, self.pod_ip)
+        if self.gates.enabled("SliceAgentsWithDNSNames"):
+            # The DNS name embeds the index, which only exists post-register.
+            self.clique.register(self.node_name, self.pod_ip, dns_name=self.dns_name)
+        self.sync()
+
+    def sync(self) -> None:
+        """One reconcile pass: refresh peer config, supervise child, update
+        readiness. Deterministic for tests; run_forever() loops it."""
+        if self.idle or self.clique is None:
+            return
+        members = self.clique.members()
+        peers = self._peer_addresses(members)
+        if peers != self._last_peers:
+            self._write_peer_config(members)
+            spawned = self.process.ensure_started()
+            if not spawned:
+                self.process.signal_reload()
+            self._last_peers = peers
+        else:
+            self.process.ensure_started()
+        self.clique.set_ready(self.node_name, self.check())
+
+    def check(self) -> bool:
+        """The readiness probe (`tpu-slice-ctl -q` analog)."""
+        if self.idle or self.clique is None:
+            return False
+        members = self.clique.members()
+        return len(members) >= self.expected_nodes and self.process.running
+
+    def run_forever(self, interval_s: float = 1.0) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.sync()
+            except Exception:  # noqa: BLE001 — reconcile errors retry next tick
+                log.exception("slice agent sync failed")
+
+    def start(self, interval_s: float = 1.0) -> None:
+        self.startup()
+        self._thread = threading.Thread(
+            target=self.run_forever, args=(interval_s,), daemon=True,
+            name=f"slice-agent-{self.node_name}",
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        try:
+            if self.clique is not None:
+                self.clique.set_ready(self.node_name, False)
+        except Exception:  # noqa: BLE001 — API may already be gone
+            pass
+        self.process.stop()
+
+    # -- peer config ---------------------------------------------------------
+
+    def _peer_addresses(self, members) -> List[str]:
+        use_dns = self.gates.enabled("SliceAgentsWithDNSNames")
+        return [
+            (m.dns_name if use_dns and m.dns_name else m.ip_address) for m in members
+        ]
+
+    @property
+    def peer_config_path(self) -> str:
+        return os.path.join(self.workdir, "peers.json")
+
+    @property
+    def hosts_file_path(self) -> str:
+        return os.path.join(self.workdir, "hosts")
+
+    def _write_peer_config(self, members) -> None:
+        """nodes-config + /etc/hosts analog
+        (/root/reference/cmd/compute-domain-daemon/dnsnames.go:133-204)."""
+        cfg = {
+            "ici_domain": self.ici_domain,
+            "expected_nodes": self.expected_nodes,
+            "self_index": self.index,
+            "peers": [
+                {
+                    "index": m.index,
+                    "node": m.node_name,
+                    "ip": m.ip_address,
+                    "dns": m.dns_name,
+                }
+                for m in members
+            ],
+        }
+        tmp = self.peer_config_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.peer_config_path)
+        with open(self.hosts_file_path + ".tmp", "w", encoding="utf-8") as f:
+            for m in members:
+                if m.dns_name:
+                    f.write(f"{m.ip_address}\t{m.dns_name}\n")
+        os.replace(self.hosts_file_path + ".tmp", self.hosts_file_path)

@@ -1,0 +1,145 @@
+"""Clique membership with CAS index allocation.
+
+Reference behavior: /root/reference/cmd/compute-domain-daemon/
+cdclique.go:277-479 — each daemon upserts its DaemonInfo into the
+ComputeDomainClique for (domain uid, fabric clique); the stable per-domain
+index is allocated compare-and-swap style on the clique object (350-372), so
+two daemons racing for the same index collide on resourceVersion and retry.
+The index becomes TPU_WORKER_ID for every workload container in the domain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import List, Optional
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomainClique,
+    ComputeDomainDaemonInfo,
+)
+from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN_CLIQUE
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+log = logging.getLogger(__name__)
+
+
+def clique_name(domain_uid: str, ici_domain: str) -> str:
+    h = hashlib.sha1(ici_domain.encode(), usedforsecurity=False).hexdigest()[:10]
+    return f"{domain_uid}.{h}"
+
+
+class CliqueManager:
+    def __init__(self, api: APIServer, namespace: str, domain_uid: str, ici_domain: str):
+        self.api = api
+        self.namespace = namespace
+        self.domain_uid = domain_uid
+        self.ici_domain = ici_domain
+        self.name = clique_name(domain_uid, ici_domain)
+
+    # -- registration -------------------------------------------------------
+
+    def register(
+        self, node_name: str, ip_address: str, dns_name: str = "", attempts: int = 20
+    ) -> int:
+        """Upsert this node's DaemonInfo; returns the allocated index."""
+        for _ in range(attempts):
+            clique = self._get_or_create()
+            info = clique.node_info(node_name)
+            if info is not None:
+                if info.ip_address != ip_address or info.dns_name != dns_name:
+                    info.ip_address = ip_address
+                    info.dns_name = dns_name
+                    try:
+                        self.api.update(clique)
+                    except ConflictError:
+                        continue
+                return info.index
+            used = set(clique.used_indices())
+            index = next(i for i in range(len(clique.nodes) + 1) if i not in used)
+            clique.nodes.append(
+                ComputeDomainDaemonInfo(
+                    node_name=node_name,
+                    ip_address=ip_address,
+                    dns_name=dns_name,
+                    index=index,
+                    ready=False,
+                )
+            )
+            try:
+                self.api.update(clique)
+                return index
+            except ConflictError:
+                continue  # someone else won this index; re-read and retry
+        raise RuntimeError(f"could not register {node_name} in clique {self.name}")
+
+    def set_ready(self, node_name: str, ready: bool, attempts: int = 20) -> None:
+        for _ in range(attempts):
+            clique = self._get()
+            if clique is None:
+                raise NotFoundError(f"clique {self.name} missing")
+            info = clique.node_info(node_name)
+            if info is None:
+                raise NotFoundError(f"{node_name} not in clique {self.name}")
+            if info.ready == ready:
+                return
+            info.ready = ready
+            try:
+                self.api.update(clique)
+                return
+            except ConflictError:
+                continue
+        raise RuntimeError(f"could not set ready={ready} for {node_name}")
+
+    def deregister(self, node_name: str, attempts: int = 20) -> None:
+        for _ in range(attempts):
+            clique = self._get()
+            if clique is None:
+                return
+            before = len(clique.nodes)
+            clique.nodes = [n for n in clique.nodes if n.node_name != node_name]
+            if len(clique.nodes) == before:
+                return
+            try:
+                self.api.update(clique)
+                return
+            except ConflictError:
+                continue
+        raise RuntimeError(f"could not deregister {node_name}")
+
+    # -- reads --------------------------------------------------------------
+
+    def members(self) -> List[ComputeDomainDaemonInfo]:
+        clique = self._get()
+        if clique is None:
+            return []
+        return sorted(clique.nodes, key=lambda n: n.index)
+
+    def node_ready(self, node_name: str) -> bool:
+        clique = self._get()
+        if clique is None:
+            return False
+        info = clique.node_info(node_name)
+        return bool(info and info.ready)
+
+    def _get(self) -> Optional[ComputeDomainClique]:
+        obj = self.api.try_get(COMPUTE_DOMAIN_CLIQUE, self.name, self.namespace)
+        return obj  # type: ignore[return-value]
+
+    def _get_or_create(self) -> ComputeDomainClique:
+        obj = self._get()
+        if obj is not None:
+            return obj
+        clique = ComputeDomainClique(
+            meta=new_meta(self.name, self.namespace),
+            domain_uid=self.domain_uid,
+            ici_domain=self.ici_domain,
+        )
+        try:
+            self.api.create(clique)
+        except Exception:  # noqa: BLE001 — racing creator; re-read below
+            pass
+        got = self._get()
+        assert got is not None
+        return got

@@ -1,0 +1,120 @@
+"""ProcessManager — supervised native child process with watchdog restart.
+
+Reference: /root/reference/cmd/compute-domain-daemon/process.go:32-204. The
+slice agent's bootstrap worker (the nvidia-imex analog) runs as a child
+process; the manager starts it on demand, signals it to reload peers
+(SIGUSR1), restarts it if it dies unexpectedly, and tears it down cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class ProcessManager:
+    def __init__(
+        self,
+        argv: List[str],
+        restart_backoff_s: float = 1.0,
+        on_restart: Optional[Callable[[int], None]] = None,
+    ):
+        self.argv = list(argv)
+        self.restart_backoff_s = restart_backoff_s
+        self.on_restart = on_restart
+        self._proc: Optional[subprocess.Popen] = None
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    @property
+    def running(self) -> bool:
+        with self._mu:
+            return self._proc is not None and self._proc.poll() is None
+
+    @property
+    def pid(self) -> Optional[int]:
+        with self._mu:
+            return self._proc.pid if self._proc and self._proc.poll() is None else None
+
+    def ensure_started(self) -> bool:
+        """Start the child if needed; returns True when it was just spawned
+        (callers must not signal_reload a fresh child: SIGUSR1 delivered
+        before its handler installs would kill it — it reads current config
+        at startup anyway)."""
+        spawned = False
+        with self._mu:
+            if self._proc is None or self._proc.poll() is not None:
+                self._proc = self._spawn()
+                log.info("started %s pid=%d", self.argv[0], self._proc.pid)
+                spawned = True
+        if self._watchdog is None:
+            self._stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watch, name="slice-agent-watchdog", daemon=True
+            )
+            self._watchdog.start()
+        return spawned
+
+    def _spawn(self) -> subprocess.Popen:
+        # Start with SIGUSR1 ignored: the ignored disposition survives exec,
+        # so a reload signal arriving before the child installs its real
+        # handler is dropped instead of killing it (default SIGUSR1 action
+        # is terminate).
+        def preexec() -> None:
+            signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+
+        return subprocess.Popen(self.argv, preexec_fn=preexec)
+
+    def signal_reload(self) -> None:
+        """SIGUSR1: re-read peer config (the reference's re-resolve signal,
+        main.go:384-431)."""
+        with self._mu:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.send_signal(signal.SIGUSR1)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=timeout)
+            self._watchdog = None
+        with self._mu:
+            proc, self._proc = self._proc, None
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=timeout)
+
+    def _watch(self) -> None:
+        while not self._stop.is_set():
+            with self._mu:
+                proc = self._proc
+            if proc is None:
+                return
+            rc = proc.poll()
+            if rc is not None:
+                if self._stop.is_set():
+                    return
+                log.warning("child exited rc=%s; restarting in %.1fs", rc, self.restart_backoff_s)
+                if self._stop.wait(self.restart_backoff_s):
+                    return
+                with self._mu:
+                    if self._stop.is_set() or self._proc is not proc:
+                        continue
+                    self._proc = self._spawn()
+                    self.restarts += 1
+                    pid = self._proc.pid
+                if self.on_restart:
+                    self.on_restart(pid)
+            else:
+                self._stop.wait(0.2)

@@ -1,0 +1,130 @@
+"""Lease-based leader election over the API server.
+
+The controller runs active-passive replicas; only the lease holder
+reconciles, and the lease is released on clean shutdown so failover is
+immediate (ReleaseOnCancel, /root/reference/cmd/compute-domain-controller/
+main.go:313-414).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
+from k8s_dra_driver_tpu.k8s.objects import K8sObject, new_meta
+
+log = logging.getLogger(__name__)
+
+LEASE = "Lease"
+
+
+@dataclass
+class Lease(K8sObject):
+    kind: str = LEASE
+    holder: str = ""
+    acquired_at: float = 0.0
+    renewed_at: float = 0.0
+    lease_duration_s: float = 15.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        api: APIServer,
+        lease_name: str,
+        identity: str,
+        namespace: str = "kube-system",
+        lease_duration_s: float = 15.0,
+        retry_period_s: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.api = api
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        self.retry_period_s = retry_period_s
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        lease = self.api.try_get(LEASE, self.lease_name, self.namespace)
+        if lease is None:
+            try:
+                self.api.create(Lease(
+                    meta=new_meta(self.lease_name, self.namespace),
+                    holder=self.identity, acquired_at=now, renewed_at=now,
+                    lease_duration_s=self.lease_duration_s,
+                ))
+                return True
+            except Exception:  # noqa: BLE001 — racing creator
+                return False
+        expired = now - lease.renewed_at > lease.lease_duration_s
+        if lease.holder != self.identity and not expired and lease.holder:
+            return False
+        lease.holder = self.identity
+        lease.renewed_at = now
+        if expired:
+            lease.acquired_at = now
+        try:
+            self.api.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def release(self) -> None:
+        lease = self.api.try_get(LEASE, self.lease_name, self.namespace)
+        if lease is not None and lease.holder == self.identity:
+            lease.holder = ""
+            lease.renewed_at = 0.0
+            try:
+                self.api.update(lease)
+            except (ConflictError, NotFoundError):
+                pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"leaderelect-{self.lease_name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self._leading.is_set():
+            self._leading.clear()
+            self.release()
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def _run(self) -> None:
+        renew_period = min(self.retry_period_s, self.lease_duration_s / 3)
+        while not self._stop.is_set():
+            got = self.try_acquire_or_renew()
+            if got and not self._leading.is_set():
+                self._leading.set()
+                log.info("%s became leader of %s", self.identity, self.lease_name)
+                if self.on_started_leading:
+                    self.on_started_leading()
+            elif not got and self._leading.is_set():
+                # Lost the lease (e.g. clock slip / partition): crash-only
+                # controllers exit here; we flag and call back.
+                self._leading.clear()
+                log.warning("%s lost leadership of %s", self.identity, self.lease_name)
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            self._stop.wait(renew_period if got else self.retry_period_s)

@@ -92,6 +92,41 @@ def _canonical(payload: Dict[str, Any]) -> str:
     return json.dumps(payload, sort_keys=True, separators=(",", ":"))
 
 
+class CheckpointStore:
+    """Locked checkpoint access + boot-id invalidation, shared by both
+    kubelet plugins so crash-consistency fixes land once.
+
+    ``on_discard(uid)`` runs for every claim dropped by boot-id invalidation
+    (CDI spec removal, sharing-state cleanup, ...).
+    """
+
+    def __init__(self, plugin_dir, flock_factory, boot_id: str, on_discard=None):
+        import os
+
+        os.makedirs(plugin_dir, exist_ok=True)
+        self._lock = flock_factory(os.path.join(plugin_dir, "cp.lock"))
+        self._mgr = CheckpointManager(os.path.join(plugin_dir, "checkpoint.json"))
+        with self._lock.hold(timeout=10):
+            cp = self._mgr.load()
+            if cp is None:
+                self._mgr.save(Checkpoint(node_boot_id=boot_id))
+            elif cp.node_boot_id != boot_id:
+                for uid in cp.claims:
+                    if on_discard:
+                        on_discard(uid)
+                self._mgr.save(Checkpoint(node_boot_id=boot_id))
+
+    def get(self) -> "Checkpoint":
+        with self._lock.hold(timeout=10):
+            cp = self._mgr.load()
+            assert cp is not None, "checkpoint disappeared"
+            return cp
+
+    def save(self, cp: "Checkpoint") -> None:
+        with self._lock.hold(timeout=10):
+            self._mgr.save(cp)
+
+
 class CheckpointManager:
     """Atomic load/save of the checkpoint file. Callers serialize access via
     the cp flock (device_state owns that)."""

@@ -1,0 +1,8 @@
+"""compute-domain-kubelet-plugin — DRA plugin for driver
+``compute-domain.tpu.google.com``.
+
+Role of the reference's compute-domain-kubelet-plugin (SURVEY.md §2.1,
+§2.5, §3.5): publishes exactly one channel device + one daemon device per
+node, gates workload Prepare on domain readiness via the retry-until-ready
+loop, and labels the node so the per-CD DaemonSet follows the workload.
+"""

@@ -1,0 +1,140 @@
+"""ComputeDomainManager — the plugin's view of domains, cliques and labels.
+
+Reference: /root/reference/cmd/compute-domain-kubelet-plugin/
+computedomain.go:61-107 (manager), 298-354 (AssertComputeDomainReady),
+356 (namespace anti-spoof), 372-400 (AddNodeLabel → DaemonSet follows).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    COMPUTE_DOMAIN_NODE_LABEL,
+    ComputeDomainClique,
+)
+from k8s_dra_driver_tpu.daemon.cliquemanager import clique_name
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE, NODE
+from k8s_dra_driver_tpu.tpulib.types import HostInventory
+
+log = logging.getLogger(__name__)
+
+MEGASCALE_COORDINATOR_PORT = 8476
+
+
+class RetryableError(Exception):
+    """Prepare must be retried by the kubelet; the pod stays
+    ContainerCreating (the mechanism that serializes domain-up before
+    workload-start, SURVEY.md §3.5)."""
+
+
+class PermanentError(Exception):
+    """Prepare must NOT be retried (namespace spoof, bad config)."""
+
+
+class ComputeDomainManager:
+    def __init__(self, api: APIServer, node_name: str, inventory: HostInventory):
+        self.api = api
+        self.node_name = node_name
+        self.inventory = inventory
+
+    # -- lookups ------------------------------------------------------------
+
+    def get_domain_by_uid(self, cd_uid: str):
+        """One cluster-wide scan; callers resolve once per prepare and pass
+        the object down (three scans per prepare otherwise)."""
+        for cd in self.api.list(COMPUTE_DOMAIN):
+            if cd.uid == cd_uid:
+                return cd
+        return None
+
+    def resolve(self, cd_uid: str):
+        """(domain, clique-or-None) for this node's ICI domain; retryable
+        while the domain doesn't exist yet."""
+        cd = self.get_domain_by_uid(cd_uid)
+        if cd is None:
+            raise RetryableError(f"ComputeDomain {cd_uid} not found (yet)")
+        return cd, self.get_clique(cd)
+
+    def get_clique(self, cd) -> Optional[ComputeDomainClique]:
+        name = clique_name(cd.uid, self.inventory.ici_domain)
+        return self.api.try_get(COMPUTE_DOMAIN_CLIQUE, name, cd.namespace)  # type: ignore[return-value]
+
+    @staticmethod
+    def assert_domain_namespace(cd, claim_namespace: str) -> None:
+        """Anti-spoof: the claim's namespace must be the CD's namespace, so a
+        claim in namespace A cannot join a domain in namespace B."""
+        if cd.namespace != claim_namespace:
+            raise PermanentError(
+                f"claim namespace {claim_namespace!r} does not match "
+                f"ComputeDomain namespace {cd.namespace!r}"
+            )
+
+    # -- the readiness gate --------------------------------------------------
+
+    def assert_domain_ready(self, cd, clique: Optional[ComputeDomainClique]) -> None:
+        """Local daemon Ready in this node's clique, else retryable."""
+        if clique is None:
+            raise RetryableError(
+                f"clique for domain {cd.uid} on {self.inventory.ici_domain} not created yet"
+            )
+        info = clique.node_info(self.node_name)
+        if info is None:
+            raise RetryableError(f"slice agent on {self.node_name} not registered yet")
+        if not info.ready:
+            raise RetryableError(f"slice agent on {self.node_name} not ready yet")
+
+    # -- node labels ---------------------------------------------------------
+
+    def add_node_label(self, cd_uid: str) -> None:
+        """Label this node for the domain. A node can host at most one
+        domain's DaemonSet: overwriting another domain's label would evict
+        its agent under a running workload, so that's an error (reference
+        AddNodeLabel guard, computedomain.go:372-400)."""
+
+        def mutate(node):
+            current = node.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL)
+            if current and current != cd_uid:
+                raise RetryableError(
+                    f"node {self.node_name} already belongs to ComputeDomain "
+                    f"{current}; wait for it to release"
+                )
+            node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL] = cd_uid
+
+        self.api.update_with_retry(NODE, self.node_name, "", mutate)
+
+    def remove_node_label(self, cd_uid: str) -> None:
+        def mutate(node):
+            if node.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL) == cd_uid:
+                del node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL]
+
+        self.api.update_with_retry(NODE, self.node_name, "", mutate)
+
+    # -- workload bootstrap env ----------------------------------------------
+
+    def bootstrap_env(self, cd_uid: str, clique: ComputeDomainClique) -> Dict[str, str]:
+        """The slice-identity environment the channel device injects: worker
+        id, ordered peer hostnames, coordinator address — what libtpu/JAX
+        need to initialize the multi-host slice (the IMEX channel +
+        /imexd-config analog, device_state.go:681-733)."""
+        members = sorted(clique.nodes, key=lambda n: n.index)
+        self_info = clique.node_info(self.node_name)
+        if self_info is None:
+            raise RetryableError(f"{self.node_name} missing from clique")
+        hostnames = [m.dns_name or m.ip_address for m in members]
+        coordinator = hostnames[0] if hostnames else ""
+        return {
+            "TPU_WORKER_ID": str(self_info.index),
+            "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+            "TPU_TOPOLOGY": self.inventory.slice_topology,
+            "TPU_ACCELERATOR_TYPE": self.inventory.accelerator_type,
+            "TPU_HOST_BOUNDS": self.inventory.host_topology,
+            "MEGASCALE_COORDINATOR_ADDRESS": (
+                f"{coordinator}:{MEGASCALE_COORDINATOR_PORT}" if coordinator else ""
+            ),
+            "MEGASCALE_NUM_SLICES": "1",
+            "MEGASCALE_SLICE_ID": "0",
+            "COMPUTE_DOMAIN_UUID": cd_uid,
+        }

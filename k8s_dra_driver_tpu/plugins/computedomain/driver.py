@@ -1,0 +1,315 @@
+"""ComputeDomainDriver — the compute-domain-kubelet-plugin core.
+
+Publishes channel-0 + one daemon device (reference driver.go:46-58), and
+implements the workload gate chain of SURVEY.md §3.5:
+
+    assert channel unallocated -> assert CD namespace (anti-spoof)
+    -> add node label (DaemonSet follows) -> assert domain ready
+    (retryable) -> CDI edits with slice bootstrap env
+
+plus the PrepareAborted tombstone state
+(/root/reference/cmd/compute-domain-kubelet-plugin/device_state.go:206-208,
+430-446): after HandleError aborts a claim, re-preparing it fails
+permanently until the tombstone ages out.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.configs import (
+    COMPUTE_DOMAIN_DRIVER_NAME,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    nonstrict_decode,
+)
+from k8s_dra_driver_tpu.cdi import CDIHandler, ContainerEdits
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    Device,
+    RESOURCE_CLAIM,
+    RESOURCE_SLICE,
+    ResourceClaim,
+    ResourcePool,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
+from k8s_dra_driver_tpu.pkg.flock import Flock
+from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    Checkpoint,
+    CheckpointStore,
+    PREPARE_ABORTED,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    PreparedClaim,
+    PreparedDevice,
+)
+from k8s_dra_driver_tpu.plugins.computedomain.computedomain import (
+    ComputeDomainManager,
+    PermanentError,
+    RetryableError,
+)
+from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import create_or_update_slice
+from k8s_dra_driver_tpu.tpulib.lib import TpuLib
+
+log = logging.getLogger(__name__)
+
+CHANNEL_DEVICE = "channel-0"
+DAEMON_DEVICE = "daemon"
+PU_LOCK_TIMEOUT_S = 10.0
+
+
+class ComputeDomainDriver:
+    def __init__(
+        self,
+        api: APIServer,
+        node_name: str,
+        tpulib: TpuLib,
+        plugin_dir: str,
+        cdi_root: Optional[str] = None,
+        gates: Optional[fg.FeatureGates] = None,
+        metrics_registry: Optional[Registry] = None,
+        driver_name: str = COMPUTE_DOMAIN_DRIVER_NAME,
+    ):
+        self.api = api
+        self.node_name = node_name
+        self.driver_name = driver_name
+        self.gates = gates or fg.FeatureGates()
+        self.inventory = tpulib.enumerate()
+        self.cd = ComputeDomainManager(api, node_name, self.inventory)
+        self.cdi = CDIHandler(cdi_root)
+        self.metrics = DRARequestMetrics(
+            driver=driver_name, registry=metrics_registry or Registry()
+        )
+        os.makedirs(plugin_dir, exist_ok=True)
+        self._mutex = threading.Lock()
+        self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
+        self._pool_generation = 1
+        self._store = CheckpointStore(
+            plugin_dir, Flock, read_boot_id(),
+            on_discard=self.cdi.delete_claim_spec_file,
+        )
+
+    def _get_checkpoint(self) -> Checkpoint:
+        return self._store.get()
+
+    def _save_checkpoint(self, cp: Checkpoint) -> None:
+        self._store.save(cp)
+
+    # -- publishing ----------------------------------------------------------
+
+    def publish_resources(self) -> None:
+        devices = [
+            Device(
+                name=CHANNEL_DEVICE,
+                attributes={
+                    "type": "channel",
+                    "tpu.google.com/iciDomain": self.inventory.ici_domain,
+                },
+            ),
+            Device(
+                name=DAEMON_DEVICE,
+                attributes={
+                    "type": "daemon",
+                    "tpu.google.com/iciDomain": self.inventory.ici_domain,
+                },
+            ),
+        ]
+        rs = ResourceSlice(
+            meta=new_meta(f"{self.node_name}-{self.driver_name}"),
+            driver=self.driver_name,
+            node_name=self.node_name,
+            pool=ResourcePool(name=self.node_name, generation=self._pool_generation),
+            devices=devices,
+        )
+        self._pool_generation += 1
+        create_or_update_slice(self.api, rs)
+
+    def start(self) -> None:
+        self.publish_resources()
+
+    # -- DRA service ----------------------------------------------------------
+
+    def prepare_resource_claims(
+        self, claims: List[ResourceClaim]
+    ) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for claim in claims:
+            with self.metrics.track("PrepareResourceClaims"):
+                try:
+                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                        out[claim.uid] = self._prepare(claim)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("cd prepare %s failed: %s", claim.key, e)
+                    out[claim.uid] = e
+        return out
+
+    def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
+        out: Dict[str, Optional[Exception]] = {}
+        for uid in claim_uids:
+            with self.metrics.track("UnprepareResourceClaims"):
+                try:
+                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                        self._unprepare(uid)
+                    out[uid] = None
+                except Exception as e:  # noqa: BLE001
+                    out[uid] = e
+        return out
+
+    def handle_error(self, claim_uid: str) -> None:
+        """Abort a claim (kubeletplugin HandleError analog): mark the
+        tombstone so future Prepares reject it until the TTL expires."""
+        with self._mutex:
+            cp = self._get_checkpoint()
+            entry = cp.claims.get(claim_uid)
+            if entry is None:
+                entry = cp.claims[claim_uid] = PreparedClaim(claim_uid=claim_uid)
+            entry.state = PREPARE_ABORTED
+            entry.aborted_at = time.time()
+            self._save_checkpoint(cp)
+            self.cdi.delete_claim_spec_file(claim_uid)
+
+    def expire_aborted(self) -> int:
+        """Drop expired PrepareAborted tombstones (cleanup loop tier,
+        reference cleanup.go:35-37). Returns count removed."""
+        with self._mutex:
+            cp = self._get_checkpoint()
+            doomed = [u for u, e in cp.claims.items() if e.aborted_expired()]
+            for u in doomed:
+                del cp.claims[u]
+            if doomed:
+                self._save_checkpoint(cp)
+            return len(doomed)
+
+    # -- prepare internals ----------------------------------------------------
+
+    def _decode_config(self, claim: ResourceClaim):
+        for cc in claim.config:
+            if cc.opaque is None or cc.opaque.driver != self.driver_name:
+                continue
+            cfg = nonstrict_decode(cc.opaque.parameters)
+            cfg.validate()
+            return cfg
+        raise PermanentError(f"claim {claim.key} has no {self.driver_name} config")
+
+    def _prepare(self, claim: ResourceClaim):
+        with self._mutex:
+            cp = self._get_checkpoint()
+            uid = claim.uid
+            entry = cp.claims.get(uid)
+            if entry is not None and entry.state == PREPARE_COMPLETED:
+                return [i for d in entry.devices for i in d.cdi_device_ids]
+            if entry is not None and entry.state == PREPARE_ABORTED:
+                if not entry.aborted_expired():
+                    raise PermanentError(f"claim {uid} was aborted; refusing to re-prepare")
+                del cp.claims[uid]
+                self._save_checkpoint(cp)
+            devices = [
+                r.device for r in (claim.allocation.devices if claim.allocation else [])
+                if r.driver == self.driver_name
+            ]
+            if not devices:
+                raise PermanentError(f"claim {claim.key}: no {self.driver_name} devices")
+            cfg = self._decode_config(claim)
+
+            cp.claims[uid] = PreparedClaim(
+                claim_uid=uid, namespace=claim.namespace, name=claim.name,
+                state=PREPARE_STARTED, started_at=time.time(),
+            )
+            self._save_checkpoint(cp)
+            try:
+                if isinstance(cfg, ComputeDomainDaemonConfig):
+                    prepared = self._prepare_daemon(claim, cfg, devices)
+                elif isinstance(cfg, ComputeDomainChannelConfig):
+                    prepared = self._prepare_channel(claim, cfg, devices)
+                else:
+                    raise PermanentError(f"config kind {cfg.kind} not valid here")
+            except Exception:
+                # Retryable or not, this attempt is over: clear the Started
+                # entry so the next Prepare starts clean.
+                cp = self._get_checkpoint()
+                cp.claims.pop(uid, None)
+                self._save_checkpoint(cp)
+                self.cdi.delete_claim_spec_file(uid)
+                raise
+            entry = cp.claims[uid]
+            entry.devices = prepared
+            entry.state = PREPARE_COMPLETED
+            entry.completed_at = time.time()
+            self._save_checkpoint(cp)
+            return [i for d in prepared for i in d.cdi_device_ids]
+
+    def _prepare_daemon(
+        self, claim: ResourceClaim, cfg: ComputeDomainDaemonConfig, devices: List[str]
+    ) -> List[PreparedDevice]:
+        if devices != [DAEMON_DEVICE]:
+            raise PermanentError(f"daemon claim must allocate exactly [{DAEMON_DEVICE}]")
+        edits = ContainerEdits(env={
+            "COMPUTE_DOMAIN_UUID": cfg.domain_id,
+            "COMPUTE_DOMAIN_NAMESPACE": claim.namespace,
+            "NODE_NAME": self.node_name,
+            "ICI_DOMAIN": self.inventory.ici_domain,
+        })
+        ids = self.cdi.create_claim_spec_file(claim.uid, {DAEMON_DEVICE: edits})
+        return [PreparedDevice(
+            name=DAEMON_DEVICE, device_type="daemon", cdi_device_ids=ids,
+            extra={"domain": cfg.domain_id},
+        )]
+
+    def _prepare_channel(
+        self, claim: ResourceClaim, cfg: ComputeDomainChannelConfig, devices: List[str]
+    ) -> List[PreparedDevice]:
+        if devices != [CHANNEL_DEVICE]:
+            raise PermanentError(f"channel claim must allocate exactly [{CHANNEL_DEVICE}]")
+        cd_uid = cfg.domain_id
+        # The gate chain (§3.5) — order matters: anti-spoof before any
+        # mutation; label before the ready check so the DaemonSet can land.
+        domain, clique = self.cd.resolve(cd_uid)
+        self.cd.assert_domain_namespace(domain, claim.namespace)
+        self.cd.add_node_label(cd_uid)
+        # Re-read the clique: it may have appeared since resolve().
+        clique = self.cd.get_clique(domain)
+        self.cd.assert_domain_ready(domain, clique)
+        env = self.cd.bootstrap_env(cd_uid, clique)
+        edits = ContainerEdits(env=env)
+        ids = self.cdi.create_claim_spec_file(claim.uid, {CHANNEL_DEVICE: edits})
+        return [PreparedDevice(
+            name=CHANNEL_DEVICE, device_type="channel", cdi_device_ids=ids,
+            extra={"domain": cd_uid},
+        )]
+
+    def _unprepare(self, claim_uid: str) -> None:
+        with self._mutex:
+            cp = self._get_checkpoint()
+            entry = cp.claims.get(claim_uid)
+            if entry is None:
+                self.cdi.delete_claim_spec_file(claim_uid)
+                return
+            domains = {d.extra.get("domain") for d in entry.devices
+                       if d.device_type == "channel"}
+            del cp.claims[claim_uid]
+            self._save_checkpoint(cp)
+            self.cdi.delete_claim_spec_file(claim_uid)
+            # Last channel claim for a domain on this node: drop the label so
+            # the DaemonSet can leave with the workload.
+            for cd_uid in filter(None, domains):
+                still_used = any(
+                    d.extra.get("domain") == cd_uid
+                    for e in cp.claims.values() for d in e.devices
+                    if d.device_type == "channel"
+                )
+                if not still_used:
+                    try:
+                        self.cd.remove_node_label(cd_uid)
+                    except Exception:  # noqa: BLE001 — controller also sweeps
+                        log.exception("label removal for %s failed", cd_uid)
+
+    def prepared_claims(self) -> Dict[str, PreparedClaim]:
+        return dict(self._get_checkpoint().claims)

@@ -47,7 +47,7 @@ from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
 from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.plugins.checkpoint import (
     Checkpoint,
-    CheckpointManager,
+    CheckpointStore,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     PreparedClaim,
@@ -105,40 +105,21 @@ class DeviceState:
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
         self._mutex = threading.Lock()
-        self._cp_lock = Flock(os.path.join(plugin_dir, "cp.lock"))
-        self._cp = CheckpointManager(os.path.join(plugin_dir, "checkpoint.json"))
-        self._init_checkpoint()
 
-    # -- checkpoint plumbing ------------------------------------------------
+        def on_discard(uid: str) -> None:
+            # Pre-reboot claim: its CDI spec and sharing records are stale.
+            self.cdi.delete_claim_spec_file(uid)
+            self.sharing.clear_claim(uid)
 
-    def _init_checkpoint(self) -> None:
-        boot_id = read_boot_id()
-        with self._cp_lock.hold(timeout=10):
-            cp = self._cp.load()
-            if cp is None:
-                cp = Checkpoint(node_boot_id=boot_id)
-                self._cp.save(cp)
-                return
-            if cp.node_boot_id != boot_id:
-                log.warning(
-                    "checkpoint boot id %r != live %r; discarding %d claims "
-                    "(node rebooted, device state is gone)",
-                    cp.node_boot_id, boot_id, len(cp.claims),
-                )
-                # Claim spec files from before the reboot are stale too.
-                for uid in cp.claims:
-                    self.cdi.delete_claim_spec_file(uid)
-                self._cp.save(Checkpoint(node_boot_id=boot_id))
+        self._store = CheckpointStore(
+            plugin_dir, Flock, read_boot_id(), on_discard=on_discard
+        )
 
     def _get_checkpoint(self) -> Checkpoint:
-        with self._cp_lock.hold(timeout=10):
-            cp = self._cp.load()
-            assert cp is not None, "checkpoint disappeared"
-            return cp
+        return self._store.get()
 
     def _save_checkpoint(self, cp: Checkpoint) -> None:
-        with self._cp_lock.hold(timeout=10):
-            self._cp.save(cp)
+        self._store.save(cp)
 
     # -- public state machine ----------------------------------------------
 

@@ -92,6 +92,16 @@ def device_to_api(dev: AllocatableDevice, inv: HostInventory) -> Device:
     )
 
 
+def create_or_update_slice(api, rs: ResourceSlice) -> None:
+    """Publish a ResourceSlice: create, or overwrite the existing one."""
+    existing = api.try_get(rs.kind, rs.meta.name)
+    if existing is None:
+        api.create(rs)
+    else:
+        rs.meta = existing.meta
+        api.update(rs)
+
+
 def build_resource_slice(
     node_name: str,
     driver: str,

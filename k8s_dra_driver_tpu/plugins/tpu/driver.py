@@ -23,7 +23,10 @@ from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
 from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState, PrepareResult
-from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import (
+    build_resource_slice,
+    create_or_update_slice,
+)
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 from k8s_dra_driver_tpu.tpulib.types import ChipHealth
 
@@ -109,12 +112,7 @@ class TpuDriver:
                 dev.taints.append(
                     DeviceTaint(key=UNHEALTHY_TAINT_KEY, value="true", effect="NoSchedule")
                 )
-        existing = self.api.try_get(RESOURCE_SLICE, rs.meta.name)
-        if existing is None:
-            self.api.create(rs)
-        else:
-            rs.meta = existing.meta
-            self.api.update(rs)
+        create_or_update_slice(self.api, rs)
 
     # -- health -> taints ----------------------------------------------------
 

@@ -1,0 +1,499 @@
+"""ComputeDomain stack: clique CAS indices, slice agent, plugin gate chain,
+controller reconcile/teardown, leader election.
+
+Reference test models: cdclique index allocation (cdclique.go:350-372),
+device_state_test.go PrepareAborted behavior, controller status calculus
+(computedomain_test.go:28-60).
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.api import (
+    API_VERSION,
+    ComputeDomain,
+    ComputeDomainSpec,
+)
+from k8s_dra_driver_tpu.api.computedomain import (
+    CD_STATUS_NOT_READY,
+    CD_STATUS_READY,
+    COMPUTE_DOMAIN_FINALIZER,
+    COMPUTE_DOMAIN_NODE_LABEL,
+    ComputeDomainChannelSpec,
+)
+from k8s_dra_driver_tpu.api.configs import COMPUTE_DOMAIN_DRIVER_NAME
+from k8s_dra_driver_tpu.controller import Controller
+from k8s_dra_driver_tpu.daemon import CliqueManager, SliceAgent
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    COMPUTE_DOMAIN_CLIQUE,
+    DAEMON_SET,
+    DeviceClaimConfig,
+    DeviceRequestAllocationResult,
+    Node,
+    OpaqueDeviceConfig,
+    RESOURCE_CLAIM_TEMPLATE,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
+from k8s_dra_driver_tpu.plugins.computedomain.computedomain import (
+    PermanentError,
+    RetryableError,
+)
+from k8s_dra_driver_tpu.plugins.computedomain.driver import (
+    CHANNEL_DEVICE,
+    ComputeDomainDriver,
+    DAEMON_DEVICE,
+)
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+NS = "user-ns"
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+    return p
+
+
+def make_cd(api, name="cd-a", ns=NS, num_nodes=0):
+    cd = ComputeDomain(
+        meta=new_meta(name, ns),
+        spec=ComputeDomainSpec(
+            num_nodes=num_nodes,
+            channel=ComputeDomainChannelSpec(resource_claim_template_name=f"{name}-channel"),
+        ),
+    )
+    return api.create(cd)
+
+
+def channel_claim(cd, device=CHANNEL_DEVICE, ns=None, name="wl-claim"):
+    claim = ResourceClaim(meta=new_meta(name, ns if ns is not None else cd.namespace))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(devices=[
+        DeviceRequestAllocationResult(request="channel",
+                                      driver=COMPUTE_DOMAIN_DRIVER_NAME,
+                                      pool="n0", device=device)
+    ])
+    claim.config = [DeviceClaimConfig(
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=COMPUTE_DOMAIN_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION,
+                        "kind": "ComputeDomainChannelConfig",
+                        "domain_id": cd.uid},
+        ),
+    )]
+    return claim
+
+
+def daemon_claim(cd, ns="tpu-dra-driver", name="daemon-claim"):
+    claim = ResourceClaim(meta=new_meta(name, ns))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(devices=[
+        DeviceRequestAllocationResult(request="daemon",
+                                      driver=COMPUTE_DOMAIN_DRIVER_NAME,
+                                      pool="n0", device=DAEMON_DEVICE)
+    ])
+    claim.config = [DeviceClaimConfig(
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=COMPUTE_DOMAIN_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION,
+                        "kind": "ComputeDomainDaemonConfig",
+                        "domain_id": cd.uid},
+        ),
+    )]
+    return claim
+
+
+# -- clique ------------------------------------------------------------------
+
+def test_clique_index_allocation_race():
+    api = APIServer()
+    results = {}
+    threads = []
+
+    def register(i):
+        mgr = CliqueManager(api, NS, "cd-uid", "slice-x.0")
+        results[f"node-{i}"] = mgr.register(f"node-{i}", f"10.0.0.{i}")
+
+    for i in range(8):
+        t = threading.Thread(target=register, args=(i,))
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    # All 8 nodes got distinct indices 0..7.
+    assert sorted(results.values()) == list(range(8))
+    # Registration is stable: re-register returns the same index.
+    mgr = CliqueManager(api, NS, "cd-uid", "slice-x.0")
+    assert mgr.register("node-3", "10.0.0.3") == results["node-3"]
+
+
+def test_clique_ready_and_deregister():
+    api = APIServer()
+    mgr = CliqueManager(api, NS, "cd-uid", "slice-x.0")
+    mgr.register("n0", "10.0.0.1")
+    assert not mgr.node_ready("n0")
+    mgr.set_ready("n0", True)
+    assert mgr.node_ready("n0")
+    mgr.deregister("n0")
+    assert mgr.members() == []
+
+
+# -- slice agent --------------------------------------------------------------
+
+def test_slice_agent_lifecycle(tmp_path):
+    api = APIServer()
+    agents = []
+    try:
+        for w in range(4):
+            lib = MockTpuLib("v5e-16", worker_id=w)
+            a = SliceAgent(api, NS, "cd-uid", f"node-{w}", f"10.0.0.{w}",
+                           lib, str(tmp_path / f"agent{w}"))
+            a.startup()
+            agents.append(a)
+        # Before everyone syncs, readiness requires all 4 members present.
+        for a in agents:
+            a.sync()
+        assert all(a.check() for a in agents)
+        mgr = CliqueManager(api, NS, "cd-uid", agents[0].ici_domain)
+        members = mgr.members()
+        assert [m.index for m in members] == [0, 1, 2, 3]
+        assert all(m.ready for m in members)
+        # Peer config written with all members.
+        import json
+
+        cfg = json.loads(open(agents[0].peer_config_path).read())
+        assert len(cfg["peers"]) == 4
+        assert cfg["expected_nodes"] == 4
+        # DNS names in the hosts file (SliceAgentsWithDNSNames default on).
+        hosts = open(agents[0].hosts_file_path).read()
+        assert ".slice.tpu.internal" in hosts
+    finally:
+        for a in agents:
+            a.shutdown()
+
+
+def test_slice_agent_not_ready_until_all_register(tmp_path):
+    api = APIServer()
+    lib = MockTpuLib("v5e-16", worker_id=0)
+    a = SliceAgent(api, NS, "cd-uid", "node-0", "10.0.0.0", lib,
+                   str(tmp_path / "a0"))
+    try:
+        a.startup()
+        a.sync()
+        assert not a.check()  # 1 of 4 expected hosts
+    finally:
+        a.shutdown()
+
+
+def test_slice_agent_child_watchdog(tmp_path):
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    a = SliceAgent(api, NS, "cd-uid", "n0", "10.0.0.1", lib, str(tmp_path / "a"))
+    a.process.restart_backoff_s = 0.05
+    try:
+        a.startup()
+        a.sync()
+        assert a.check()
+        pid = a.process.pid
+        import os
+        import signal
+
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not (
+            a.process.running and a.process.pid != pid
+        ):
+            time.sleep(0.05)
+        assert a.process.running and a.process.pid != pid
+        assert a.process.restarts >= 1
+    finally:
+        a.shutdown()
+
+
+# -- CD kubelet plugin ---------------------------------------------------------
+
+@pytest.fixture
+def cd_env(tmp_path, boot_id):
+    api = APIServer()
+    api.create(Node(meta=new_meta("n0")))
+    lib = MockTpuLib("v5e-4")
+    driver = ComputeDomainDriver(
+        api=api, node_name="n0", tpulib=lib,
+        plugin_dir=str(tmp_path / "cd-plugin"), cdi_root=str(tmp_path / "cdi"),
+    )
+    driver.start()
+    return api, lib, driver, tmp_path
+
+
+def test_cd_plugin_publishes_channel_and_daemon(cd_env):
+    api, _, driver, _ = cd_env
+    slices = [s for s in api.list("ResourceSlice") if s.driver == COMPUTE_DOMAIN_DRIVER_NAME]
+    assert len(slices) == 1
+    assert {d.name for d in slices[0].devices} == {CHANNEL_DEVICE, DAEMON_DEVICE}
+
+
+def test_channel_prepare_gate_chain(cd_env, tmp_path):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = channel_claim(cd)
+    # 1. Domain exists but no agent yet: retryable, node gets labeled anyway.
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, RetryableError)
+    node = api.get("Node", "n0")
+    assert node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL] == cd.uid
+    # 2. Agent registers + becomes ready -> prepare succeeds with bootstrap env.
+    agent = SliceAgent(api, NS, cd.uid, "n0", "10.9.9.9", lib, str(tmp_path / "agent"))
+    try:
+        agent.startup()
+        agent.sync()
+        assert agent.check()
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert not isinstance(res, Exception), res
+        spec = driver.cdi.read_claim_spec(claim.uid)
+        env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["COMPUTE_DOMAIN_UUID"] == cd.uid
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8476")
+        assert env["TPU_TOPOLOGY"] == "2x2"
+    finally:
+        agent.shutdown()
+
+
+def test_channel_prepare_namespace_antispoof(cd_env):
+    api, _, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = channel_claim(cd, ns="attacker-ns")
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PermanentError)
+    # No label was added.
+    assert COMPUTE_DOMAIN_NODE_LABEL not in api.get("Node", "n0").meta.labels
+
+
+def test_daemon_prepare(cd_env):
+    api, _, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = daemon_claim(cd)
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert not isinstance(res, Exception), res
+    spec = driver.cdi.read_claim_spec(claim.uid)
+    env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+    assert env["COMPUTE_DOMAIN_UUID"] == cd.uid
+    assert env["NODE_NAME"] == "n0"
+
+
+def test_unprepare_last_channel_removes_label(cd_env, tmp_path):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = SliceAgent(api, NS, cd.uid, "n0", "10.9.9.9", lib, str(tmp_path / "ag"))
+    try:
+        agent.startup()
+        agent.sync()
+        claim = channel_claim(cd)
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert not isinstance(res, Exception)
+        assert COMPUTE_DOMAIN_NODE_LABEL in api.get("Node", "n0").meta.labels
+        driver.unprepare_resource_claims([claim.uid])
+        assert COMPUTE_DOMAIN_NODE_LABEL not in api.get("Node", "n0").meta.labels
+    finally:
+        agent.shutdown()
+
+
+def test_prepare_aborted_tombstone(cd_env):
+    api, _, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = channel_claim(cd)
+    driver.handle_error(claim.uid)
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PermanentError)
+    assert "aborted" in str(res)
+    # Expiring the tombstone clears the way.
+    cp = driver._get_checkpoint()
+    cp.claims[claim.uid].aborted_at = time.time() - 3600
+    driver._save_checkpoint(cp)
+    assert driver.expire_aborted() == 1
+
+
+# -- controller ----------------------------------------------------------------
+
+def test_controller_creates_owned_objects_and_status():
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = make_cd(api, num_nodes=2)
+        wait_for(
+            lambda: COMPUTE_DOMAIN_FINALIZER
+            in api.get("ComputeDomain", cd.name, NS).meta.finalizers,
+            msg="finalizer",
+        )
+        cd_live = api.get("ComputeDomain", cd.name, NS)
+        # DaemonSet node-selects on the CD label.
+        ds = api.get(DAEMON_SET, f"{cd.name}-slice-agent", "tpu-dra-driver")
+        assert ds.node_selector == {COMPUTE_DOMAIN_NODE_LABEL: cd.uid}
+        assert ds.owned_by(cd_live)
+        # Both RCTs exist.
+        assert api.try_get(RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-daemon-claim",
+                           "tpu-dra-driver") is not None
+        assert api.try_get(RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-channel", NS) is not None
+        # Status: no nodes yet -> NotReady.
+        assert cd_live.status.status == CD_STATUS_NOT_READY
+
+        # Two agents register + ready -> controller aggregates Ready.
+        mgr = CliqueManager(api, NS, cd.uid, "slice-z.0")
+        mgr.register("n0", "10.0.0.1")
+        mgr.register("n1", "10.0.0.2")
+        mgr.set_ready("n0", True)
+        mgr.set_ready("n1", True)
+        wait_for(
+            lambda: api.get("ComputeDomain", cd.name, NS).status.status == CD_STATUS_READY,
+            msg="CD Ready",
+        )
+        cd_live = api.get("ComputeDomain", cd.name, NS)
+        assert [n.worker_id for n in cd_live.status.nodes] == [0, 1]
+    finally:
+        ctrl.stop()
+
+
+def test_controller_teardown_on_delete():
+    api = APIServer()
+    api.create(Node(meta=new_meta("n0")))
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = make_cd(api)
+        wait_for(
+            lambda: COMPUTE_DOMAIN_FINALIZER
+            in api.get("ComputeDomain", cd.name, NS).meta.finalizers,
+            msg="finalizer",
+        )
+        # Simulate plugin having labeled the node and a clique existing.
+        node = api.get("Node", "n0")
+        node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL] = cd.uid
+        api.update(node)
+        CliqueManager(api, NS, cd.uid, "slice-z.0").register("n0", "10.0.0.1")
+
+        api.delete("ComputeDomain", cd.name, NS)
+        wait_for(lambda: api.try_get("ComputeDomain", cd.name, NS) is None,
+                 msg="CD deletion")
+        # Finalizer removed -> CD gone; owned objects and labels cleaned.
+        assert api.try_get(DAEMON_SET, f"{cd.name}-slice-agent", "tpu-dra-driver") is None
+        assert api.try_get(RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-channel", NS) is None
+        assert api.list(COMPUTE_DOMAIN_CLIQUE, namespace=NS) == []
+        assert COMPUTE_DOMAIN_NODE_LABEL not in api.get("Node", "n0").meta.labels
+    finally:
+        ctrl.stop()
+
+
+def test_controller_refuses_to_adopt_unowned_objects():
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    # Pre-existing unowned DaemonSet with the same name.
+    from k8s_dra_driver_tpu.k8s.core import DaemonSet
+
+    api.create(DaemonSet(meta=new_meta("cd-a-slice-agent", "tpu-dra-driver")))
+    cd = make_cd(api)
+    with pytest.raises(RuntimeError, match="refusing to adopt"):
+        ctrl.reconcile(api.get("ComputeDomain", cd.name, NS))
+
+
+# -- leader election ------------------------------------------------------------
+
+def test_leader_election_single_holder_and_failover():
+    api = APIServer()
+    a = LeaderElector(api, "lease-x", "a", lease_duration_s=0.5, retry_period_s=0.05)
+    b = LeaderElector(api, "lease-x", "b", lease_duration_s=0.5, retry_period_s=0.05)
+    a.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not a.is_leader:
+            time.sleep(0.02)
+        assert a.is_leader
+        b.start()
+        time.sleep(0.3)
+        assert not b.is_leader  # a holds and renews
+        a.stop()  # releases the lease
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not b.is_leader:
+            time.sleep(0.02)
+        assert b.is_leader
+    finally:
+        a.stop()
+        b.stop()
+
+
+# -- review regression tests ---------------------------------------------------
+
+def test_controller_status_updates_converge():
+    """An idle CD must not be rewritten in a loop (review finding: ~1.5k
+    writes/sec when status was written unconditionally)."""
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = make_cd(api)
+        wait_for(
+            lambda: COMPUTE_DOMAIN_FINALIZER
+            in api.get("ComputeDomain", cd.name, NS).meta.finalizers,
+            msg="finalizer",
+        )
+        time.sleep(0.3)  # let any loop spin up
+        rv1 = api.get("ComputeDomain", cd.name, NS).meta.resource_version
+        time.sleep(0.5)
+        rv2 = api.get("ComputeDomain", cd.name, NS).meta.resource_version
+        assert rv2 == rv1, f"CD rewritten {rv2 - rv1} times while idle"
+    finally:
+        ctrl.stop()
+
+
+def test_node_label_conflict_between_domains(cd_env):
+    api, _, driver, _ = cd_env
+    cd_a = make_cd(api, name="cd-a")
+    cd_b = make_cd(api, name="cd-b")
+    claim_a = channel_claim(cd_a, name="wl-a")
+    claim_b = channel_claim(cd_b, name="wl-b")
+    # A labels the node (retryable: no agent yet). B must NOT steal the label.
+    driver.prepare_resource_claims([claim_a])
+    assert api.get("Node", "n0").meta.labels[COMPUTE_DOMAIN_NODE_LABEL] == cd_a.uid
+    res = driver.prepare_resource_claims([claim_b])[claim_b.uid]
+    assert isinstance(res, RetryableError)
+    assert "already belongs" in str(res)
+    assert api.get("Node", "n0").meta.labels[COMPUTE_DOMAIN_NODE_LABEL] == cd_a.uid
+
+
+def test_reboot_clears_sharing_records(tmp_path, boot_id):
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+    from tests.test_tpu_plugin import make_claim, sharing_cfg
+
+    api = APIServer()
+    plugin_dir = str(tmp_path / "plugin")
+    gates = fg.parse("TimeSlicingSettings=true")
+    d1 = TpuDriver(api=api, node_name="n0", tpulib=MockTpuLib("v5e-4"),
+                   plugin_dir=plugin_dir, cdi_root=str(tmp_path / "cdi"), gates=gates)
+    claim = make_claim(["tpu-0"], configs=[sharing_cfg("Short")])
+    d1.prepare_resource_claims([claim])
+    assert d1.state.sharing.records_for([0])
+    boot_id.write_text("boot-2\n")
+    d2 = TpuDriver(api=api, node_name="n0", tpulib=MockTpuLib("v5e-4"),
+                   plugin_dir=plugin_dir, cdi_root=str(tmp_path / "cdi"), gates=gates)
+    # Post-reboot: no ghost sharing records throttling new claims.
+    assert d2.state.sharing.records_for([0]) == []
