@@ -27,7 +27,7 @@ from k8s_dra_driver_tpu.controller.templates import (
     daemon_set_for_domain,
     workload_resource_claim_template,
 )
-from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, Informer, NotFoundError
+from k8s_dra_driver_tpu.k8s import APIServer, Informer, NotFoundError
 from k8s_dra_driver_tpu.k8s.core import (
     COMPUTE_DOMAIN,
     COMPUTE_DOMAIN_CLIQUE,

@@ -48,9 +48,13 @@ class CliqueManager:
             clique = self._get_or_create()
             info = clique.node_info(node_name)
             if info is not None:
-                if info.ip_address != ip_address or info.dns_name != dns_name:
+                # Never blank an existing DNS name with the default "": the
+                # startup sequence registers ip-first (index unknown), and a
+                # transient empty dns would churn every peer's config.
+                new_dns = dns_name or info.dns_name
+                if info.ip_address != ip_address or info.dns_name != new_dns:
                     info.ip_address = ip_address
-                    info.dns_name = dns_name
+                    info.dns_name = new_dns
                     try:
                         self.api.update(clique)
                     except ConflictError:

@@ -1,0 +1,193 @@
+"""End-to-end scenarios over the simulated cluster — the e2e/bats tier.
+
+Runs the shipped demo manifests (demo/specs/) against a SimCluster whose
+plugins/controller/daemons are the real code, printing what each workload
+pod actually received. Mirrors the reference's quickstart walkthrough
+(gpu-test1..5 + ComputeDomain single/multi, SURVEY.md §4 tiers 2-4).
+
+Usage:
+    python -m k8s_dra_driver_tpu.e2e                 # run every scenario
+    python -m k8s_dra_driver_tpu.e2e tpu-test1 cd-multi-host
+    python -m k8s_dra_driver_tpu.e2e --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+from dataclasses import dataclass
+from typing import Callable, Dict, List
+
+from k8s_dra_driver_tpu.k8s.core import POD
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import apply_file
+
+SPECS_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "demo", "specs")
+
+
+@dataclass
+class Scenario:
+    name: str
+    spec: str                 # path under demo/specs/
+    profile: str = "v5e-16"
+    gates: str = ""
+    check: Callable[["SimCluster", List], None] = lambda sim, pods: None
+
+
+class E2EFailure(AssertionError):
+    pass
+
+
+def _expect(cond: bool, msg: str) -> None:
+    if not cond:
+        raise E2EFailure(msg)
+
+
+def _running_pods(sim: SimCluster, ns: str) -> List:
+    pods = sim.api.list(POD, namespace=ns)
+    _expect(bool(pods), f"no pods found in {ns}")
+    not_running = [(p.meta.name, p.phase, p.meta.annotations.get("failure", ""))
+                   for p in pods if p.phase != "Running"]
+    _expect(not not_running, f"pods not Running: {not_running}")
+    return pods
+
+
+def check_test1(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test1")
+    p = pods[0]
+    _expect(len(p.injected_devices) == 1 and p.injected_devices[0].startswith("/dev/accel"),
+            f"expected one accel device, got {p.injected_devices}")
+    _expect(p.injected_env.get("TPU_VISIBLE_CHIPS", "").isdigit(),
+            f"bad TPU_VISIBLE_CHIPS: {p.injected_env.get('TPU_VISIBLE_CHIPS')}")
+
+
+def check_test2(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test2")
+    _expect(len(pods) == 2, f"want 2 pods, got {len(pods)}")
+    _expect(pods[0].node_name == pods[1].node_name,
+            "shared claim must pin both pods to one node")
+    _expect(pods[0].injected_devices == pods[1].injected_devices,
+            "both pods must see the same chip")
+
+
+def check_test3(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test3")
+    env = pods[0].injected_env
+    _expect(env.get("TPU_CHIPS_PER_PROCESS_BOUNDS") == "1,2,1",
+            f"bad subslice bounds: {env.get('TPU_CHIPS_PER_PROCESS_BOUNDS')}")
+    _expect(len(pods[0].injected_devices) == 2, "1x2 subslice = 2 device nodes")
+
+
+def check_test4(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test4")
+    for p in pods:
+        _expect(p.injected_env.get("TPU_TIMESLICE_US") == "2000",
+                f"{p.meta.name}: missing time-slice env: {p.injected_env.get('TPU_TIMESLICE_US')}")
+
+
+def check_test5(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test5")
+    env = pods[0].injected_env
+    _expect(len(pods[0].injected_devices) == 4, "whole host = 4 device nodes")
+    _expect(env.get("TPU_TOPOLOGY") == "4x4", f"bad topology {env.get('TPU_TOPOLOGY')}")
+
+
+def check_cd_single(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "cd-single")
+    env = pods[0].injected_env
+    _expect(env.get("TPU_WORKER_ID") == "0", f"worker id {env.get('TPU_WORKER_ID')}")
+    _expect(env.get("MEGASCALE_COORDINATOR_ADDRESS", "").endswith(":8476"),
+            "missing coordinator address")
+
+
+def check_cd_multi(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "cd-multi")
+    workers = sorted(
+        (p for p in pods if p.meta.name.startswith("worker-")),
+        key=lambda p: p.meta.name,
+    )
+    _expect(len(workers) == 4, f"want 4 workers, got {len(workers)}")
+    ids = sorted(int(p.injected_env["TPU_WORKER_ID"]) for p in workers)
+    _expect(ids == [0, 1, 2, 3], f"worker ids {ids}")
+    hostnames = {p.injected_env["TPU_WORKER_HOSTNAMES"] for p in workers}
+    _expect(len(hostnames) == 1, "all workers must agree on the hostname list")
+    _expect(len(next(iter(hostnames)).split(",")) == 4, "4 hostnames expected")
+    coords = {p.injected_env["MEGASCALE_COORDINATOR_ADDRESS"] for p in workers}
+    _expect(len(coords) == 1, "all workers must agree on the coordinator")
+    nodes = {p.node_name for p in workers}
+    _expect(len(nodes) == 4, f"workers must spread over 4 hosts, got {nodes}")
+    for p in workers:
+        _expect(len(p.injected_devices) == 4, "each worker holds its whole host")
+        _expect(p.injected_env.get("TPU_TOPOLOGY") == "4x4", "slice topology")
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario("tpu-test1", "quickstart/tpu-test1.yaml", check=check_test1),
+        Scenario("tpu-test2", "quickstart/tpu-test2.yaml", check=check_test2),
+        Scenario("tpu-test3", "quickstart/tpu-test3.yaml", check=check_test3),
+        Scenario("tpu-test4", "quickstart/tpu-test4.yaml",
+                 gates="TimeSlicingSettings=true", check=check_test4),
+        Scenario("tpu-test5", "quickstart/tpu-test5.yaml", check=check_test5),
+        Scenario("cd-single-host", "computedomain/cd-single-host.yaml",
+                 profile="v5e-4", check=check_cd_single),
+        Scenario("cd-multi-host", "computedomain/cd-multi-host.yaml",
+                 check=check_cd_multi),
+    )
+}
+
+
+def run_scenario(scenario: Scenario, workdir: str, verbose: bool = True) -> None:
+    sim = SimCluster(workdir=workdir, profile=scenario.profile, gates=scenario.gates)
+    sim.start()
+    try:
+        created = apply_file(sim.api, os.path.join(SPECS_DIR, scenario.spec))
+        sim.settle()
+        scenario.check(sim, created)
+        if verbose:
+            for pod in sim.api.list(POD):
+                if pod.namespace.startswith(("tpu-test", "cd-")):
+                    env_keys = ",".join(sorted(k for k in pod.injected_env
+                                               if k.startswith(("TPU_", "MEGASCALE"))))
+                    print(f"    {pod.namespace}/{pod.meta.name} on {pod.node_name}: "
+                          f"{pod.phase}; devices={len(pod.injected_devices)}; env[{env_keys}]")
+    finally:
+        sim.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-dra-e2e", description=__doc__)
+    parser.add_argument("scenarios", nargs="*", default=[])
+    parser.add_argument("--list", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list:
+        for name in SCENARIOS:
+            print(name)
+        return 0
+    names = args.scenarios or list(SCENARIOS)
+    failed = []
+    for name in names:
+        if name not in SCENARIOS:
+            print(f"unknown scenario {name!r}; --list shows options")
+            return 2
+        print(f"=== {name} ===")
+        with tempfile.TemporaryDirectory() as tmp:
+            try:
+                run_scenario(SCENARIOS[name], tmp)
+                print(f"    PASS {name}")
+            except Exception as e:  # noqa: BLE001
+                failed.append(name)
+                print(f"    FAIL {name}: {e}")
+    if failed:
+        print(f"FAILED: {failed}")
+        return 1
+    print(f"all {len(names)} scenario(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

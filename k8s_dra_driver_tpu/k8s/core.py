@@ -152,6 +152,8 @@ class ResourceSlice(K8sObject):
 class DeviceClass(K8sObject):
     kind: str = DEVICE_CLASS
     driver: str = ""  # selector: device.driver == driver
+    # Attribute equality selectors, the CEL-expression stand-in.
+    match_attributes: Dict[str, Any] = field(default_factory=dict)
     config: List[DeviceClaimConfig] = field(default_factory=list)
 
 
@@ -186,6 +188,9 @@ class Pod(K8sObject):
     pod_ip: str = ""
     ready: bool = False
     conditions: List[PodCondition] = field(default_factory=list)
+    # What the container runtime materialized from CDI specs (sim kubelet).
+    injected_env: Dict[str, str] = field(default_factory=dict)
+    injected_devices: List[str] = field(default_factory=list)
 
 
 @dataclass

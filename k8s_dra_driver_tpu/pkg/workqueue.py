@@ -142,6 +142,11 @@ class WorkQueue:
         self._mu.notify_all()
 
     def start(self, workers: int = 1) -> None:
+        with self._mu:
+            # A queue may be stopped and started again (leadership lost then
+            # regained); clear the stop flag or workers exit immediately and
+            # enqueues are silently dropped.
+            self._stopped = False
         for i in range(workers):
             t = threading.Thread(target=self._worker, name=f"{self.name}-{i}", daemon=True)
             t.start()

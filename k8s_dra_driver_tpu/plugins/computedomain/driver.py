@@ -132,8 +132,28 @@ class ComputeDomainDriver:
         self._pool_generation += 1
         create_or_update_slice(self.api, rs)
 
-    def start(self) -> None:
+    def start(self, cleanup_interval_s: float = 600.0) -> None:
         self.publish_resources()
+        self._stop_evt = threading.Event()
+        self._cleanup_thread = threading.Thread(
+            target=self._cleanup_loop, args=(cleanup_interval_s,),
+            name="cd-tombstone-cleanup", daemon=True,
+        )
+        self._cleanup_thread.start()
+
+    def shutdown(self) -> None:
+        if getattr(self, "_stop_evt", None) is not None:
+            self._stop_evt.set()
+            self._cleanup_thread.join(timeout=5)
+
+    def _cleanup_loop(self, interval_s: float) -> None:
+        """Periodic tombstone expiry (the reference's cleanup manager runs
+        this tier, cleanup.go:99-141)."""
+        while not self._stop_evt.wait(interval_s):
+            try:
+                self.expire_aborted()
+            except Exception:  # noqa: BLE001
+                log.exception("tombstone expiry failed")
 
     # -- DRA service ----------------------------------------------------------
 
@@ -290,6 +310,12 @@ class ComputeDomainDriver:
             cp = self._get_checkpoint()
             entry = cp.claims.get(claim_uid)
             if entry is None:
+                self.cdi.delete_claim_spec_file(claim_uid)
+                return
+            if entry.state == PREPARE_ABORTED:
+                # Keep the tombstone: it guards against a stale Prepare retry
+                # arriving after this Unprepare (reference device_state.go:
+                # 328-329); TTL expiry removes it.
                 self.cdi.delete_claim_spec_file(claim_uid)
                 return
             domains = {d.extra.get("domain") for d in entry.devices

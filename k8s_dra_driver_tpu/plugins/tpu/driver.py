@@ -17,7 +17,7 @@ from typing import Dict, List, Optional
 
 from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
 from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
-from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, RESOURCE_SLICE, ResourceClaim
+from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, ResourceClaim
 from k8s_dra_driver_tpu.k8s.core import DeviceTaint
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError

@@ -1,0 +1,14 @@
+"""Cluster simulation — the mock-NVML-kind-CI analog (SURVEY.md §4.2).
+
+The reference tests multi-node behavior on CPU-only CI by running the real
+driver against a kind cluster with a mock NVML. With no cluster available
+at all, this package emulates the *cluster half* instead: a DRA
+structured-parameters allocator (what the scheduler does with
+ResourceSlices + counters), pod scheduling/binding, a kubelet that calls
+the real plugins' Prepare/Unprepare and materializes CDI env, and a
+DaemonSet controller. The driver code under test is the real thing; only
+Kubernetes itself is simulated.
+"""
+
+from k8s_dra_driver_tpu.sim.allocator import AllocationError, Allocator  # noqa: F401
+from k8s_dra_driver_tpu.sim.cluster import SimCluster  # noqa: F401

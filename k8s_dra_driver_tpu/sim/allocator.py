@@ -1,0 +1,159 @@
+"""DRA structured-parameters allocator.
+
+Implements what the Kubernetes scheduler's DRA plugin does with published
+ResourceSlices: match claim requests to devices via DeviceClass + selectors,
+honoring KEP-4815 counter consumption so overlapping devices (chips vs the
+subslices containing them) are never double-allocated — the property the
+reference encodes for MIG memory slices
+(/root/reference/cmd/gpu-kubelet-plugin/partitions.go:53-246).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    DEVICE_CLASS,
+    Device,
+    DeviceRequestAllocationResult,
+    RESOURCE_CLAIM,
+    RESOURCE_SLICE,
+    ResourceClaim,
+    ResourceSlice,
+)
+
+log = logging.getLogger(__name__)
+
+
+class AllocationError(Exception):
+    pass
+
+
+def _device_matches(dev: Device, match_attributes: Dict[str, object],
+                    selectors: List[str]) -> bool:
+    for k, v in match_attributes.items():
+        if dev.attributes.get(k) != v:
+            return False
+    for sel in selectors:
+        if "=" not in sel:
+            raise AllocationError(f"malformed selector {sel!r} (want attr=value)")
+        k, _, v = sel.partition("=")
+        if str(dev.attributes.get(k.strip())) != v.strip():
+            return False
+    return True
+
+
+class Allocator:
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    # -- counter accounting --------------------------------------------------
+
+    def _consumed_counters(self, node_name: str) -> Dict[str, Dict[str, int]]:
+        """counter_set -> counter -> consumed, over all allocated claims on
+        this node."""
+        slices = {
+            (s.driver, s.node_name): s
+            for s in self.api.list(RESOURCE_SLICE)
+        }
+        consumed: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        for claim in self.api.list(RESOURCE_CLAIM):
+            if claim.allocation is None or claim.allocation.node_name != node_name:
+                continue
+            for r in claim.allocation.devices:
+                rs = slices.get((r.driver, node_name))
+                if rs is None:
+                    continue
+                dev = next((d for d in rs.devices if d.name == r.device), None)
+                if dev is None:
+                    continue
+                for cc in dev.consumes_counters:
+                    for cname, ctr in cc.counters.items():
+                        consumed[cc.counter_set][cname] += ctr.value
+        return consumed
+
+    def _fits(self, rs: ResourceSlice, dev: Device,
+              consumed: Dict[str, Dict[str, int]],
+              pending: Dict[str, Dict[str, int]]) -> bool:
+        available = {cs.name: cs.counters for cs in rs.shared_counters}
+        for cc in dev.consumes_counters:
+            caps = available.get(cc.counter_set)
+            if caps is None:
+                # Device consumes a counter set the slice doesn't share:
+                # treat as unconstrained (e.g. channel/daemon devices).
+                continue
+            for cname, ctr in cc.counters.items():
+                cap = caps.get(cname)
+                if cap is None:
+                    return False
+                used = consumed[cc.counter_set][cname] + pending[cc.counter_set][cname]
+                if used + ctr.value > cap.value:
+                    return False
+        return True
+
+    # -- allocation -----------------------------------------------------------
+
+    def _class_info(self, class_name: str) -> Tuple[str, Dict[str, object]]:
+        dc = self.api.try_get(DEVICE_CLASS, class_name)
+        if dc is None:
+            raise AllocationError(f"DeviceClass {class_name!r} not found")
+        return dc.driver, getattr(dc, "match_attributes", {})
+
+    def allocate_on_node(self, claim: ResourceClaim, node_name: str) -> Optional[AllocationResult]:
+        """Try to satisfy every request of the claim on one node; returns the
+        allocation or None when it doesn't fit."""
+        slices_by_driver = {
+            s.driver: s
+            for s in self.api.list(RESOURCE_SLICE)
+            if s.node_name == node_name
+        }
+        consumed = self._consumed_counters(node_name)
+        pending: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        picked: List[DeviceRequestAllocationResult] = []
+        picked_names: set = set()
+        for req in claim.requests:
+            driver, match_attrs = self._class_info(req.device_class_name)
+            rs = slices_by_driver.get(driver)
+            if rs is None:
+                return None
+            candidates = [
+                d for d in rs.devices
+                if d.name not in picked_names
+                and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
+                and _device_matches(d, match_attrs, req.selectors)
+            ]
+            want = len(candidates) if req.allocation_mode == "All" else req.count
+            chosen: List[Device] = []
+            for dev in candidates:
+                if len(chosen) >= want:
+                    break
+                if self._fits(rs, dev, consumed, pending):
+                    chosen.append(dev)
+                    for cc in dev.consumes_counters:
+                        for cname, ctr in cc.counters.items():
+                            pending[cc.counter_set][cname] += ctr.value
+            if len(chosen) < want or (req.allocation_mode == "All" and not chosen):
+                return None
+            for dev in chosen:
+                picked_names.add(dev.name)
+                picked.append(
+                    DeviceRequestAllocationResult(
+                        request=req.name, driver=driver,
+                        pool=rs.pool.name, device=dev.name,
+                    )
+                )
+        return AllocationResult(devices=picked, node_name=node_name)
+
+    def allocate(self, claim: ResourceClaim, candidate_nodes: List[str]) -> AllocationResult:
+        for node in candidate_nodes:
+            result = self.allocate_on_node(claim, node)
+            if result is not None:
+                return result
+        raise AllocationError(
+            f"claim {claim.key}: no node among {candidate_nodes} can satisfy "
+            f"requests {[r.name for r in claim.requests]}"
+        )

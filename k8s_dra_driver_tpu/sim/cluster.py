@@ -1,0 +1,455 @@
+"""SimCluster: nodes with real plugins + emulated scheduler/kubelet/DaemonSet.
+
+Composes everything into a runnable in-process cluster:
+
+- N "TPU hosts", each with a real TpuDriver + ComputeDomainDriver over a
+  MockTpuLib worker of one slice profile;
+- the compute-domain Controller;
+- a scheduler pass (claims from templates, structured-parameters
+  allocation, node binding);
+- a kubelet pass per node (Prepare via the real plugins, CDI env
+  materialized onto the pod, retry on RetryableError);
+- a DaemonSet controller pass (pods follow node labels), which also *runs*
+  slice-agent pods as in-process SliceAgents — the container the DaemonSet
+  would start.
+
+Deterministic by design: drive with ``step()`` until convergence instead of
+background threads, so e2e tests never race.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.configs import (
+    COMPUTE_DOMAIN_DRIVER_NAME,
+    TPU_DRIVER_NAME,
+)
+from k8s_dra_driver_tpu.controller import Controller
+from k8s_dra_driver_tpu.controller.templates import (
+    DEVICE_CLASS_CHANNEL,
+    DEVICE_CLASS_DAEMON,
+    DEVICE_CLASS_TPU,
+)
+from k8s_dra_driver_tpu.daemon import SliceAgent
+from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
+from k8s_dra_driver_tpu.k8s.core import (
+    DAEMON_SET,
+    DEVICE_CLASS,
+    DeviceClass,
+    Node,
+    POD,
+    Pod,
+    RESOURCE_CLAIM,
+    RESOURCE_CLAIM_TEMPLATE,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
+from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.sim.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+log = logging.getLogger(__name__)
+
+DRIVER_NAMESPACE = "tpu-dra-driver"
+DEVICE_CLASS_SUBSLICE = "subslice.tpu.google.com"
+DEVICE_CLASS_VFIO = "vfio.tpu.google.com"
+
+
+@dataclass
+class SimNode:
+    name: str
+    tpulib: MockTpuLib
+    tpu_driver: TpuDriver
+    cd_driver: ComputeDomainDriver
+    agents: Dict[str, SliceAgent] = field(default_factory=dict)  # pod name -> agent
+
+
+class SimCluster:
+    def __init__(
+        self,
+        workdir: str,
+        profile: str = "v5e-16",
+        num_hosts: Optional[int] = None,
+        gates: str = "",
+    ):
+        self.api = APIServer()
+        self.workdir = workdir
+        self.gates = fg.parse(gates)
+        self.allocator = Allocator(self.api)
+        self.profile = profile
+        self.nodes: Dict[str, SimNode] = {}
+        self.controller = Controller(
+            self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600
+        )
+        self._install_device_classes()
+        lib_probe = MockTpuLib(profile, worker_id=0)
+        n = num_hosts if num_hosts is not None else lib_probe.profile.num_hosts
+        for w in range(n):
+            self._add_node(f"tpu-node-{w}", w)
+
+    # -- bootstrap -------------------------------------------------------------
+
+    def _install_device_classes(self) -> None:
+        for name, driver, match in (
+            (DEVICE_CLASS_TPU, TPU_DRIVER_NAME, {"type": "tpu"}),
+            (DEVICE_CLASS_SUBSLICE, TPU_DRIVER_NAME, {"type": "subslice"}),
+            (DEVICE_CLASS_VFIO, TPU_DRIVER_NAME, {"type": "vfio"}),
+            (DEVICE_CLASS_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "channel"}),
+            (DEVICE_CLASS_DAEMON, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "daemon"}),
+        ):
+            self.api.create(DeviceClass(
+                meta=new_meta(name), driver=driver, match_attributes=match,
+            ))
+
+    def _add_node(self, name: str, worker_id: int) -> None:
+        self.api.create(Node(meta=new_meta(name)))
+        lib = MockTpuLib(self.profile, worker_id=worker_id)
+        base = os.path.join(self.workdir, name)
+        tpu = TpuDriver(
+            api=self.api, node_name=name, tpulib=lib,
+            plugin_dir=os.path.join(base, "tpu-plugin"),
+            cdi_root=os.path.join(base, "cdi"),
+            gates=self.gates,
+        )
+        cd = ComputeDomainDriver(
+            api=self.api, node_name=name, tpulib=lib,
+            plugin_dir=os.path.join(base, "cd-plugin"),
+            cdi_root=os.path.join(base, "cdi"),
+            gates=self.gates,
+        )
+        tpu.start()
+        cd.start()
+        self.nodes[name] = SimNode(name=name, tpulib=lib, tpu_driver=tpu, cd_driver=cd)
+
+    def start(self) -> None:
+        self.controller.start()
+
+    def stop(self) -> None:
+        for node in self.nodes.values():
+            for agent in node.agents.values():
+                agent.shutdown()
+            node.tpu_driver.shutdown()
+        self.controller.stop()
+
+    # -- control loop passes ----------------------------------------------------
+
+    def step(self) -> None:
+        """One pass of every emulated control loop."""
+        self.controller.drain(timeout=5)
+        self._daemonset_pass()
+        self._scheduler_pass()
+        self._agent_pass()
+        self.controller.drain(timeout=5)
+        self._kubelet_pass()
+
+    def settle(self, max_steps: int = 20) -> None:
+        """Step until every pod reached a terminal-ish state or cap hit."""
+        for _ in range(max_steps):
+            self.step()
+            pods = self.api.list(POD)
+            if all(p.phase in ("Running", "Failed") for p in pods):
+                return
+
+    # -- DaemonSet controller ----------------------------------------------------
+
+    def _daemonset_pass(self) -> None:
+        for ds in self.api.list(DAEMON_SET):
+            matching = self.api.list("Node", label_selector=ds.node_selector)
+            want = {n.name for n in matching}
+            have = {
+                p.node_name: p
+                for p in self.api.list(POD, namespace=ds.namespace)
+                if p.owned_by(ds)
+            }
+            for node_name in want - have.keys():
+                pod = Pod(
+                    meta=new_meta(
+                        f"{ds.meta.name}-{node_name}", ds.namespace,
+                        labels=dict(ds.template.labels),
+                    ),
+                    node_name=node_name,  # DS pods bypass the scheduler
+                    containers=[c for c in ds.template.containers],
+                    resource_claims=list(ds.template.resource_claims),
+                )
+                pod.add_owner(ds)
+                self.api.create(pod)
+            for node_name in have.keys() - want:
+                pod = have[node_name]
+                self._teardown_pod(pod)
+                try:
+                    self.api.delete(POD, pod.meta.name, pod.namespace)
+                except NotFoundError:
+                    pass
+            def set_counts(obj, desired=len(want)):
+                obj.desired = desired
+                obj.ready = sum(
+                    1 for p in self.api.list(POD, namespace=ds.namespace)
+                    if p.owned_by(ds) and p.ready
+                )
+            try:
+                self.api.update_with_retry(DAEMON_SET, ds.meta.name, ds.namespace, set_counts)
+            except NotFoundError:
+                pass
+
+    # -- scheduler ----------------------------------------------------------------
+
+    def _ensure_claims_for_pod(self, pod: Pod) -> Dict[str, ResourceClaim]:
+        claims: Dict[str, ResourceClaim] = {}
+        for ref in pod.resource_claims:
+            if ref.resource_claim_name:
+                obj = self.api.try_get(RESOURCE_CLAIM, ref.resource_claim_name, pod.namespace)
+                if obj is None:
+                    raise AllocationError(
+                        f"pod {pod.key}: claim {ref.resource_claim_name} missing"
+                    )
+            else:
+                name = f"{pod.meta.name}-{ref.name}"
+                obj = self.api.try_get(RESOURCE_CLAIM, name, pod.namespace)
+                if obj is None:
+                    rct = self.api.try_get(
+                        RESOURCE_CLAIM_TEMPLATE, ref.resource_claim_template_name,
+                        pod.namespace,
+                    )
+                    if rct is None:
+                        raise AllocationError(
+                            f"pod {pod.key}: RCT {ref.resource_claim_template_name} missing"
+                        )
+                    claim = ResourceClaim(
+                        meta=new_meta(name, pod.namespace),
+                        requests=list(rct.requests),
+                        config=list(rct.config),
+                    )
+                    claim.add_owner(pod)
+                    obj = self.api.create(claim)
+            claims[ref.name] = obj  # type: ignore[assignment]
+        return claims
+
+    def _scheduler_pass(self) -> None:
+        for pod in self.api.list(POD):
+            if pod.phase != "Pending":
+                continue
+            try:
+                claims = self._ensure_claims_for_pod(pod)
+            except AllocationError as e:
+                log.debug("pod %s: %s", pod.key, e)
+                continue
+            unallocated = [c for c in claims.values() if c.allocation is None]
+            allocated_nodes = {
+                c.allocation.node_name for c in claims.values()
+                if c.allocation is not None and c.allocation.node_name
+            }
+            if len(allocated_nodes) > 1:
+                self._fail_pod(pod, f"claims allocated on different nodes: {allocated_nodes}")
+                continue
+            if pod.node_name:
+                candidates = [pod.node_name]
+            elif allocated_nodes:
+                # A shared, already-allocated claim pins the pod to its node.
+                candidates = [next(iter(allocated_nodes))]
+            else:
+                candidates = sorted(self.nodes)
+            chosen = pod.node_name
+            if unallocated:
+                placed = False
+                for node in candidates:
+                    results = []
+                    ok = True
+                    for c in unallocated:
+                        r = self.allocator.allocate_on_node(c, node)
+                        if r is None:
+                            ok = False
+                            break
+                        results.append((c, r))
+                    if ok:
+                        for c, r in results:
+                            def set_alloc(obj, r=r, pod=pod):
+                                obj.allocation = r
+                                from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
+
+                                obj.reserved_for = [ResourceClaimConsumer(
+                                    kind=POD, name=pod.meta.name, uid=pod.uid,
+                                )]
+                            self.api.update_with_retry(
+                                RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
+                            )
+                        chosen = node
+                        placed = True
+                        break
+                if not placed:
+                    log.debug("pod %s: unschedulable this pass", pod.key)
+                    continue
+            if not chosen:
+                chosen = candidates[0] if candidates else ""
+            def bind(obj, chosen=chosen):
+                obj.node_name = chosen
+            try:
+                self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
+            except NotFoundError:
+                continue
+
+    # -- kubelet -------------------------------------------------------------------
+
+    def _kubelet_pass(self) -> None:
+        for pod in self.api.list(POD):
+            if not pod.node_name or pod.phase == "Running":
+                continue
+            node = self.nodes.get(pod.node_name)
+            if node is None:
+                continue
+            try:
+                claims = self._ensure_claims_for_pod(pod)
+            except AllocationError:
+                continue
+            if any(c.allocation is None for c in claims.values()):
+                continue
+            env: Dict[str, str] = {}
+            devices: List[str] = []
+            outcome = "ready"
+            for claim in claims.values():
+                for driver_name in sorted({r.driver for r in claim.allocation.devices}):
+                    plugin = (
+                        node.tpu_driver if driver_name == TPU_DRIVER_NAME
+                        else node.cd_driver
+                    )
+                    res = plugin.prepare_resource_claims([claim])[claim.uid]
+                    if isinstance(res, RetryableError):
+                        outcome = "retry"  # pod stays ContainerCreating
+                    elif isinstance(res, Exception):
+                        self._fail_pod(pod, str(res))
+                        outcome = "failed"
+                        break
+                    else:
+                        cdi = plugin.state.cdi if hasattr(plugin, "state") else plugin.cdi
+                        spec = cdi.read_claim_spec(claim.uid)
+                        for dev in (spec or {}).get("devices", []):
+                            edits = dev.get("containerEdits", {})
+                            for e in edits.get("env", []):
+                                k, _, v = e.partition("=")
+                                env[k] = v
+                            for dn in edits.get("deviceNodes", []):
+                                devices.append(dn["path"])
+                if outcome == "failed":
+                    break
+            if outcome != "ready":
+                continue
+
+            def run(obj, env=env, devices=devices):
+                obj.phase = "Running"
+                obj.ready = True
+                obj.pod_ip = obj.pod_ip or f"10.1.{abs(hash(obj.meta.name)) % 250}.{abs(hash(obj.namespace)) % 250}"
+                obj.injected_env = env
+                obj.injected_devices = sorted(set(devices))
+            try:
+                self.api.update_with_retry(POD, pod.meta.name, pod.namespace, run)
+            except NotFoundError:
+                continue
+
+    def _fail_pod(self, pod: Pod, message: str) -> None:
+        def mutate(obj, message=message):
+            obj.phase = "Failed"
+            obj.ready = False
+            obj.meta.annotations["failure"] = message[:400]
+        try:
+            self.api.update_with_retry(POD, pod.meta.name, pod.namespace, mutate)
+        except NotFoundError:
+            pass
+
+    # -- slice-agent pods ------------------------------------------------------------
+
+    def _agent_pass(self) -> None:
+        """Run/stop SliceAgents for slice-agent pods — the 'container' the
+        DaemonSet started."""
+        agent_pods = {}
+        for pod in self.api.list(POD):
+            cmds = [c.command for c in pod.containers]
+            if any(cmd and cmd[0] == "compute-domain-daemon" for cmd in cmds):
+                agent_pods[(pod.node_name, pod.meta.name)] = pod
+        for (node_name, pod_name), pod in agent_pods.items():
+            node = self.nodes.get(node_name)
+            if node is None or pod_name in node.agents:
+                continue
+            env = next(
+                (c.env for c in pod.containers if c.command and c.command[0] == "compute-domain-daemon"),
+                {},
+            )
+            agent = SliceAgent(
+                api=self.api,
+                namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace),
+                domain_uid=env.get("COMPUTE_DOMAIN_UUID", ""),
+                node_name=node_name,
+                pod_ip=f"10.2.0.{len(node.agents) + 1}",
+                tpulib=node.tpulib,
+                workdir=os.path.join(self.workdir, node_name, "agent", pod_name),
+                gates=self.gates,
+            )
+            agent.startup()
+            node.agents[pod_name] = agent
+        # Sync all agents; mark their pods ready per probe result.
+        for node in self.nodes.values():
+            live = set()
+            for pod_name, agent in list(node.agents.items()):
+                pod = next(
+                    (p for p in self.api.list(POD) if p.meta.name == pod_name
+                     and p.node_name == node.name),
+                    None,
+                )
+                if pod is None:
+                    agent.shutdown()
+                    del node.agents[pod_name]
+                    continue
+                live.add(pod_name)
+                agent.sync()
+                ready = agent.check()
+
+                def set_ready(obj, ready=ready):
+                    obj.ready = ready
+                    obj.phase = "Running"
+                try:
+                    self.api.update_with_retry(POD, pod.meta.name, pod.namespace, set_ready)
+                except NotFoundError:
+                    pass
+
+    def _teardown_pod(self, pod: Pod) -> None:
+        node = self.nodes.get(pod.node_name)
+        if node and pod.meta.name in node.agents:
+            node.agents[pod.meta.name].shutdown()
+            del node.agents[pod.meta.name]
+
+    # -- pod-deletion driven unprepare -------------------------------------------------
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        """Delete a pod kubelet-style: unprepare its claims, then remove the
+        pod and its generated claims."""
+        pod = self.api.try_get(POD, name, namespace)
+        if pod is None:
+            return
+        self._teardown_pod(pod)
+        for ref in pod.resource_claims:
+            cname = ref.resource_claim_name or f"{name}-{ref.name}"
+            claim = self.api.try_get(RESOURCE_CLAIM, cname, namespace)
+            if claim is None:
+                continue
+            node = self.nodes.get(pod.node_name)
+            if node is not None and claim.allocation is not None:
+                for driver_name in {r.driver for r in claim.allocation.devices}:
+                    plugin = (
+                        node.tpu_driver if driver_name == TPU_DRIVER_NAME
+                        else node.cd_driver
+                    )
+                    plugin.unprepare_resource_claims([claim.uid])
+            if not ref.resource_claim_name:
+                try:
+                    self.api.delete(RESOURCE_CLAIM, cname, namespace)
+                except NotFoundError:
+                    pass
+        try:
+            self.api.delete(POD, name, namespace)
+        except NotFoundError:
+            pass

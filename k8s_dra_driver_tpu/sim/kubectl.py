@@ -1,0 +1,158 @@
+"""kubectl-apply analog: load k8s-shaped YAML manifests into the sim API.
+
+Parses the same manifest shapes the reference ships under
+demo/specs/quickstart (Pods + ResourceClaims/Templates with DRA device
+requests, plus the ComputeDomain CRD) so the demo specs are real YAML a
+user could port to a live cluster, not test fixtures.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import yaml
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomain,
+    ComputeDomainChannelSpec,
+    ComputeDomainSpec,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    Container,
+    DeviceClaimConfig,
+    DeviceRequest,
+    OpaqueDeviceConfig,
+    Pod,
+    PodResourceClaimRef,
+    ResourceClaim,
+    ResourceClaimTemplate,
+)
+from k8s_dra_driver_tpu.k8s.objects import K8sObject, new_meta
+
+
+class ManifestError(ValueError):
+    pass
+
+
+def _meta(doc: Dict[str, Any]):
+    md = doc.get("metadata", {})
+    if "name" not in md:
+        raise ManifestError(f"manifest {doc.get('kind')} missing metadata.name")
+    return new_meta(md["name"], md.get("namespace", "default"),
+                    labels=md.get("labels", {}))
+
+
+def _device_requests(spec: Dict[str, Any]) -> List[DeviceRequest]:
+    out = []
+    for r in spec.get("devices", {}).get("requests", []):
+        out.append(DeviceRequest(
+            name=r.get("name", "device"),
+            device_class_name=r.get("deviceClassName", ""),
+            allocation_mode=r.get("allocationMode", "ExactCount"),
+            count=r.get("count", 1),
+            selectors=r.get("selectors", []),
+        ))
+    return out
+
+
+def _device_configs(spec: Dict[str, Any]) -> List[DeviceClaimConfig]:
+    out = []
+    for c in spec.get("devices", {}).get("config", []):
+        opaque = c.get("opaque")
+        out.append(DeviceClaimConfig(
+            requests=c.get("requests", []),
+            opaque=OpaqueDeviceConfig(
+                driver=opaque.get("driver", ""),
+                parameters=opaque.get("parameters", {}),
+            ) if opaque else None,
+        ))
+    return out
+
+
+def _pod(doc: Dict[str, Any]) -> Pod:
+    spec = doc.get("spec", {})
+    containers = [
+        Container(
+            name=c.get("name", "main"),
+            image=c.get("image", ""),
+            command=c.get("command", []),
+            env={e["name"]: str(e.get("value", "")) for e in c.get("env", [])},
+        )
+        for c in spec.get("containers", [])
+    ]
+    claims = [
+        PodResourceClaimRef(
+            name=rc.get("name", "claim"),
+            resource_claim_name=rc.get("resourceClaimName", ""),
+            resource_claim_template_name=rc.get("resourceClaimTemplateName", ""),
+        )
+        for rc in spec.get("resourceClaims", [])
+    ]
+    return Pod(meta=_meta(doc), containers=containers, resource_claims=claims)
+
+
+def _claim(doc: Dict[str, Any]) -> ResourceClaim:
+    spec = doc.get("spec", {})
+    return ResourceClaim(
+        meta=_meta(doc),
+        requests=_device_requests(spec),
+        config=_device_configs(spec),
+    )
+
+
+def _claim_template(doc: Dict[str, Any]) -> ResourceClaimTemplate:
+    spec = doc.get("spec", {}).get("spec", doc.get("spec", {}))
+    return ResourceClaimTemplate(
+        meta=_meta(doc),
+        requests=_device_requests(spec),
+        config=_device_configs(spec),
+    )
+
+
+def _compute_domain(doc: Dict[str, Any]) -> ComputeDomain:
+    spec = doc.get("spec", {})
+    channel = spec.get("channel", {}) or {}
+    rct = channel.get("resourceClaimTemplate", {}) or {}
+    return ComputeDomain(
+        meta=_meta(doc),
+        spec=ComputeDomainSpec(
+            num_nodes=spec.get("numNodes", 0),
+            topology=spec.get("topology", ""),
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name=rct.get("name", ""),
+            ),
+        ),
+    )
+
+
+_KIND_BUILDERS = {
+    "Pod": _pod,
+    "ResourceClaim": _claim,
+    "ResourceClaimTemplate": _claim_template,
+    "ComputeDomain": _compute_domain,
+}
+
+
+def load_manifests(text: str) -> List[K8sObject]:
+    objs: List[K8sObject] = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        kind = doc.get("kind", "")
+        if kind == "Namespace":
+            continue  # namespaces are implicit in the fake API
+        builder = _KIND_BUILDERS.get(kind)
+        if builder is None:
+            raise ManifestError(f"unsupported manifest kind {kind!r}")
+        objs.append(builder(doc))
+    return objs
+
+
+def apply_file(api: APIServer, path: str) -> List[K8sObject]:
+    with open(path, "r", encoding="utf-8") as f:
+        objs = load_manifests(f.read())
+    created = []
+    for obj in objs:
+        created.append(api.create(obj))
+    return created

@@ -1,0 +1,7 @@
+"""Validating admission webhook for opaque device configs."""
+
+from k8s_dra_driver_tpu.webhook.admission import (  # noqa: F401
+    AdmissionRequest,
+    AdmissionResponse,
+    AdmissionWebhook,
+)

@@ -1,0 +1,174 @@
+"""Validating admission: strict-decode opaque configs at CREATE/UPDATE.
+
+Reference: /root/reference/cmd/webhook/main.go:131-230 + resource.go:82-151.
+Bad configs fail at admission with a precise message instead of surfacing
+later as a node-side Prepare error. Also served over HTTP with the k8s
+AdmissionReview JSON shapes so it can sit behind a real apiserver webhook.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from k8s_dra_driver_tpu.api.configs import (
+    API_GROUP,
+    COMPUTE_DOMAIN_DRIVER_NAME,
+    DecodeError,
+    TPU_DRIVER_NAME,
+    ValidationError,
+    strict_decode,
+)
+from k8s_dra_driver_tpu.k8s.core import (
+    RESOURCE_CLAIM,
+    RESOURCE_CLAIM_TEMPLATE,
+    ResourceClaim,
+    ResourceClaimTemplate,
+)
+
+log = logging.getLogger(__name__)
+
+OUR_DRIVERS = (TPU_DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME)
+
+
+@dataclass
+class AdmissionRequest:
+    uid: str = ""
+    kind: str = ""
+    operation: str = "CREATE"
+    object: Optional[object] = None  # ResourceClaim | ResourceClaimTemplate
+
+
+@dataclass
+class AdmissionResponse:
+    uid: str = ""
+    allowed: bool = True
+    message: str = ""
+
+
+class AdmissionWebhook:
+    """Validates every opaque config owned by one of our drivers."""
+
+    def admit(self, req: AdmissionRequest) -> AdmissionResponse:
+        if req.kind not in (RESOURCE_CLAIM, RESOURCE_CLAIM_TEMPLATE):
+            return AdmissionResponse(uid=req.uid, allowed=True)
+        obj = req.object
+        if obj is None:
+            return AdmissionResponse(uid=req.uid, allowed=False, message="no object")
+        errors: List[str] = []
+        for i, cc in enumerate(getattr(obj, "config", [])):
+            if cc.opaque is None or cc.opaque.driver not in OUR_DRIVERS:
+                continue
+            try:
+                cfg = strict_decode(cc.opaque.parameters)
+                cfg.validate()
+            except (DecodeError, ValidationError) as e:
+                errors.append(f"config[{i}] ({cc.opaque.driver}): {e}")
+        if errors:
+            return AdmissionResponse(
+                uid=req.uid, allowed=False, message="; ".join(errors)
+            )
+        return AdmissionResponse(uid=req.uid, allowed=True)
+
+    # -- AdmissionReview (JSON, HTTP) ---------------------------------------
+
+    def review(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Consume/produce k8s AdmissionReview JSON."""
+        req = body.get("request", {})
+        raw_obj = req.get("object") or {}
+        kind = req.get("kind", {}).get("kind", "")
+        obj = _object_from_json(kind, raw_obj)
+        resp = self.admit(
+            AdmissionRequest(
+                uid=req.get("uid", ""), kind=kind,
+                operation=req.get("operation", "CREATE"), object=obj,
+            )
+        )
+        out: Dict[str, Any] = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {"uid": resp.uid, "allowed": resp.allowed},
+        }
+        if not resp.allowed:
+            out["response"]["status"] = {"message": resp.message, "code": 400}
+        return out
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> "WebhookServer":
+        return WebhookServer(self, host, port)
+
+
+def _object_from_json(kind: str, raw: Dict[str, Any]):
+    """Minimal JSON -> object mapping for the config fields we validate."""
+    from k8s_dra_driver_tpu.k8s.core import DeviceClaimConfig, OpaqueDeviceConfig
+
+    if kind == RESOURCE_CLAIM:
+        obj: Any = ResourceClaim()
+    elif kind == RESOURCE_CLAIM_TEMPLATE:
+        obj = ResourceClaimTemplate()
+    else:
+        return None
+    spec = raw.get("spec", {})
+    if kind == RESOURCE_CLAIM_TEMPLATE:
+        spec = spec.get("spec", spec)
+    for c in spec.get("devices", {}).get("config", []):
+        opaque = c.get("opaque")
+        if not opaque:
+            continue
+        obj.config.append(
+            DeviceClaimConfig(
+                requests=c.get("requests", []),
+                opaque=OpaqueDeviceConfig(
+                    driver=opaque.get("driver", ""),
+                    parameters=opaque.get("parameters", {}),
+                ),
+            )
+        )
+    return obj
+
+
+class WebhookServer:
+    def __init__(self, webhook: AdmissionWebhook, host: str, port: int):
+        hook = webhook
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802
+                if self.path.rstrip("/") != "/validate-resource-claim-parameters":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    out = hook.review(body)
+                except Exception as e:  # noqa: BLE001 — malformed review
+                    self.send_error(400, str(e)[:200])
+                    return
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args: object) -> None:
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -497,3 +497,40 @@ def test_reboot_clears_sharing_records(tmp_path, boot_id):
                    plugin_dir=plugin_dir, cdi_root=str(tmp_path / "cdi"), gates=gates)
     # Post-reboot: no ghost sharing records throttling new claims.
     assert d2.state.sharing.records_for([0]) == []
+
+
+def test_workqueue_restart_after_leadership_cycle():
+    """Queue must process items after stop() -> start() (leadership regained)."""
+    from k8s_dra_driver_tpu.pkg.workqueue import WorkQueue
+
+    seen = []
+    q = WorkQueue(lambda k, o: seen.append(k), name="t")
+    q.start()
+    q.enqueue("a")
+    assert q.drain(timeout=5)
+    q.stop()
+    q.start()
+    q.enqueue("b")
+    assert q.drain(timeout=5)
+    q.stop()
+    assert seen == ["a", "b"]
+
+
+def test_unprepare_keeps_aborted_tombstone(cd_env):
+    api, _, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = channel_claim(cd)
+    driver.handle_error(claim.uid)
+    driver.unprepare_resource_claims([claim.uid])
+    # Tombstone survived the unprepare; a stale prepare retry still fails.
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PermanentError)
+
+
+def test_reregister_preserves_dns_name():
+    api = APIServer()
+    mgr = CliqueManager(api, NS, "cd-uid", "slice-x.0")
+    mgr.register("n0", "10.0.0.1", dns_name="0.slice.internal")
+    # Restarted agent registers ip-first (no dns yet): must not blank it.
+    mgr.register("n0", "10.0.0.1")
+    assert mgr.members()[0].dns_name == "0.slice.internal"

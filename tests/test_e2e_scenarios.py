@@ -1,0 +1,141 @@
+"""E2E tier: every shipped demo scenario must pass on the simulated cluster,
+plus failure-path scenarios not covered by the quickstart specs."""
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e import SCENARIOS, run_scenario
+from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario(name, tmp_path):
+    run_scenario(SCENARIOS[name], str(tmp_path), verbose=False)
+
+
+def test_oversubscription_is_unschedulable(tmp_path):
+    """5 whole-host pods on 4 hosts: exactly one must stay Pending."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16")
+    sim.start()
+    try:
+        manifest = "\n---\n".join(
+            f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: p{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: tpus, resourceClaimTemplateName: whole}}]
+"""
+            for i in range(5)
+        ) + """
+---
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, allocationMode: All}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle(max_steps=8)
+        pods = sim.api.list(POD, namespace="default")
+        phases = sorted(p.phase for p in pods)
+        assert phases.count("Running") == 4
+        assert phases.count("Pending") == 1
+    finally:
+        sim.stop()
+
+
+def test_counter_exclusion_chip_vs_subslice(tmp_path):
+    """A claimed chip blocks subslices containing it via shared counters."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        manifest = """
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: chip, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpu, deviceClassName: tpu.google.com, count: 3}]
+---
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: sub, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: s, deviceClassName: subslice.tpu.google.com, selectors: ["profile=1x2"]}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: chips, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpu, resourceClaimTemplateName: chip}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: subpod, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: s, resourceClaimTemplateName: sub}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle(max_steps=8)
+        pods = {p.meta.name: p for p in sim.api.list(POD, namespace="default")}
+        # 3 of 4 chips taken; no 1x2 subslice has both chips free on this
+        # 1-host cluster, so the subslice pod must stay Pending.
+        assert pods["chips"].phase == "Running"
+        assert pods["subpod"].phase == "Pending"
+    finally:
+        sim.stop()
+
+
+def test_pod_deletion_unprepares_and_frees(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        manifest = """
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, allocationMode: All}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: first, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle(max_steps=6)
+        assert sim.api.get(POD, "first", "default").phase == "Running"
+        sim.delete_pod("first", "default")
+        assert sim.api.list(RESOURCE_CLAIM, namespace="default") == []
+        # The freed host accepts a new whole-host pod.
+        for obj in load_manifests(manifest.replace("first", "second")):
+            if obj.kind == POD:
+                sim.api.create(obj)
+        sim.settle(max_steps=6)
+        assert sim.api.get(POD, "second", "default").phase == "Running"
+    finally:
+        sim.stop()

@@ -1,0 +1,98 @@
+"""Webhook admission: strict decode at the door, HTTP AdmissionReview."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s.core import (
+    DeviceClaimConfig,
+    OpaqueDeviceConfig,
+    RESOURCE_CLAIM,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.webhook import AdmissionRequest, AdmissionWebhook
+
+
+def claim_with(params):
+    claim = ResourceClaim()
+    claim.config = [DeviceClaimConfig(
+        opaque=OpaqueDeviceConfig(driver=TPU_DRIVER_NAME, parameters=params),
+    )]
+    return claim
+
+
+def test_admits_valid_config():
+    hook = AdmissionWebhook()
+    req = AdmissionRequest(uid="1", kind=RESOURCE_CLAIM, object=claim_with({
+        "apiVersion": API_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "TimeSlicing", "time_slicing": {"interval": "Short"}},
+    }))
+    resp = hook.admit(req)
+    assert resp.allowed
+
+
+def test_rejects_unknown_field_with_message():
+    hook = AdmissionWebhook()
+    req = AdmissionRequest(uid="1", kind=RESOURCE_CLAIM, object=claim_with({
+        "apiVersion": API_VERSION, "kind": "TpuConfig", "sharign": {},
+    }))
+    resp = hook.admit(req)
+    assert not resp.allowed
+    assert "sharign" in resp.message
+
+
+def test_rejects_invalid_value():
+    hook = AdmissionWebhook()
+    req = AdmissionRequest(uid="1", kind=RESOURCE_CLAIM, object=claim_with({
+        "apiVersion": API_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "Sometimes"},
+    }))
+    resp = hook.admit(req)
+    assert not resp.allowed and "Sometimes" in resp.message
+
+
+def test_ignores_other_drivers():
+    hook = AdmissionWebhook()
+    claim = ResourceClaim()
+    claim.config = [DeviceClaimConfig(
+        opaque=OpaqueDeviceConfig(driver="gpu.nvidia.com", parameters={"bogus": 1}),
+    )]
+    assert hook.admit(AdmissionRequest(uid="1", kind=RESOURCE_CLAIM, object=claim)).allowed
+
+
+def test_http_admission_review_roundtrip():
+    hook = AdmissionWebhook()
+    srv = hook.serve(port=0)
+    srv.start()
+    try:
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "abc",
+                "kind": {"kind": "ResourceClaim"},
+                "operation": "CREATE",
+                "object": {
+                    "spec": {"devices": {"config": [{
+                        "opaque": {
+                            "driver": TPU_DRIVER_NAME,
+                            "parameters": {"apiVersion": API_VERSION,
+                                           "kind": "TpuConfig", "typo": True},
+                        },
+                    }]}}
+                },
+            },
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/validate-resource-claim-parameters",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert out["response"]["uid"] == "abc"
+        assert out["response"]["allowed"] is False
+        assert "typo" in out["response"]["status"]["message"]
+    finally:
+        srv.stop()
