@@ -1,0 +1,53 @@
+"""Shared JSON/YAML manifest parsing for DRA device specs.
+
+Single decoder for the ``spec.devices.{requests,config}`` shape (and the
+RCT ``spec.spec`` unwrap) used by both the kubectl-apply loader
+(sim/kubectl.py) and the admission webhook — one place to evolve when the
+manifest schema grows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from k8s_dra_driver_tpu.k8s.core import (
+    DeviceClaimConfig,
+    DeviceRequest,
+    OpaqueDeviceConfig,
+)
+
+
+def unwrap_template_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """ResourceClaimTemplate nests the claim spec at spec.spec."""
+    return spec.get("spec", spec)
+
+
+def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
+    return [
+        DeviceRequest(
+            name=r.get("name", "device"),
+            device_class_name=r.get("deviceClassName", ""),
+            allocation_mode=r.get("allocationMode", "ExactCount"),
+            count=r.get("count", 1),
+            selectors=r.get("selectors", []),
+        )
+        for r in spec.get("devices", {}).get("requests", [])
+    ]
+
+
+def device_configs_from_spec(spec: Dict[str, Any]) -> List[DeviceClaimConfig]:
+    out = []
+    for c in spec.get("devices", {}).get("config", []):
+        opaque = c.get("opaque")
+        out.append(
+            DeviceClaimConfig(
+                requests=c.get("requests", []),
+                opaque=OpaqueDeviceConfig(
+                    driver=opaque.get("driver", ""),
+                    parameters=opaque.get("parameters", {}),
+                )
+                if opaque
+                else None,
+            )
+        )
+    return out

@@ -1,0 +1,8 @@
+"""Workload-side ops: collective benchmarks and TPU kernels.
+
+These run *inside* claimed containers — the proof-of-function jobs the
+framework schedules onto prepared slices, playing the role of the
+reference's nvbandwidth test jobs (demo/specs/imex/nvbandwidth-test-job.yaml).
+"""
+
+from k8s_dra_driver_tpu.ops.allreduce_bench import psum_bandwidth  # noqa: F401

@@ -1,0 +1,84 @@
+"""jax.psum allreduce bandwidth benchmark — the nvbandwidth analog.
+
+The job a user schedules onto a freshly assembled ComputeDomain to prove the
+ICI fabric delivers (BASELINE.md: "jax.psum GB/s on the allocated slice").
+Runs under ``shard_map`` over every available device; algorithmic bus
+bandwidth uses the ring-allreduce factor 2(n-1)/n, the convention NCCL
+benchmarks report, so numbers compare 1:1 with the reference ecosystem's
+nvbandwidth/nccl-tests figures.
+
+Usage (inside a claimed container, or anywhere JAX sees devices):
+    python -m k8s_dra_driver_tpu.ops.allreduce_bench [--size-mib 256] [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+from typing import Optional, Sequence
+
+
+def psum_bandwidth(
+    size_mib: float = 64.0,
+    iters: int = 20,
+    devices: Optional[Sequence] = None,
+    warmup: int = 3,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("d",))
+    per_device_elems = int(size_mib * (1 << 20) // 4)
+    x = jax.device_put(
+        jnp.ones((n, per_device_elems), jnp.float32),
+        NamedSharding(mesh, P("d", None)),
+    )
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None))
+    def allreduce(x):
+        return jax.lax.psum(x, "d")[None]
+
+    # At least one untimed call: compilation must stay out of the timing.
+    for _ in range(max(1, warmup)):
+        out = allreduce(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    bytes_per_shard = per_device_elems * 4
+    # Ring-allreduce algorithmic bus bandwidth (the NCCL busBw convention):
+    # each device moves 2(n-1)/n * shard bytes over the fabric per allreduce.
+    bus_bytes = 2 * (n - 1) / n * bytes_per_shard if n > 1 else bytes_per_shard
+    return {
+        "metric": "psum_allreduce_bus_bandwidth",
+        "value": round(bus_bytes / dt / 1e9, 3),
+        "unit": "GB/s",
+        "n_devices": n,
+        "size_mib_per_device": size_mib,
+        "time_per_allreduce_ms": round(dt * 1e3, 4),
+        "platform": devices[0].platform,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="allreduce-bench")
+    parser.add_argument("--size-mib", type=float, default=64.0)
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args(argv)
+    print(json.dumps(psum_bandwidth(size_mib=args.size_mib, iters=args.iters)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

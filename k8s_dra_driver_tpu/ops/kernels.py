@@ -1,0 +1,107 @@
+"""Pallas TPU kernels for the workload hot path.
+
+Two fused kernels the flagship workload leans on, written against the MXU/
+VMEM model from the Pallas TPU guide: a fused RMSNorm (one VMEM round-trip
+instead of three HBM-bound elementwise passes) and a tiled matmul with
+float32 accumulation feeding the MXU in (8,128)-aligned blocks. Off-TPU the
+kernels run in interpreter mode so CPU CI tests the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — no backend at all
+        return False
+
+
+# -- fused RMSNorm -----------------------------------------------------------
+
+def _rmsnorm_kernel(x_ref, g_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    o_ref[...] = (x * r * g_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "eps", "interpret"))
+def rmsnorm(
+    x: jax.Array,
+    gain: jax.Array,
+    *,
+    block_rows: int = 256,
+    eps: float = 1e-6,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """RMSNorm over the last dim. x: [..., d]; gain: [d]."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for s in orig_shape[:-1]:
+        rows *= s
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows != 0:
+        block_rows = 1  # degenerate fallback keeps the grid exact
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x2, gain)
+    return out.reshape(orig_shape)
+
+
+# -- tiled matmul ------------------------------------------------------------
+
+def _matmul_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jnp.dot(
+        a_ref[...], b_ref[...], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def tiled_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    bm: int = 256,
+    bn: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """a[M,K] @ b[K,N] with f32 accumulation, tiled (bm, bn) for the MXU."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    bm = min(bm, m)
+    bn = min(bn, n)
+    if m % bm != 0 or n % bn != 0:
+        # Shape not tileable: let XLA handle it (still fused fine).
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(m // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        interpret=interpret,
+    )(a, b)

@@ -147,13 +147,3 @@ class Allocator:
                     )
                 )
         return AllocationResult(devices=picked, node_name=node_name)
-
-    def allocate(self, claim: ResourceClaim, candidate_nodes: List[str]) -> AllocationResult:
-        for node in candidate_nodes:
-            result = self.allocate_on_node(claim, node)
-            if result is not None:
-                return result
-        raise AllocationError(
-            f"claim {claim.key}: no node among {candidate_nodes} can satisfy "
-            f"requests {[r.name for r in claim.requests]}"
-        )

@@ -136,6 +136,7 @@ class SimCluster:
             for agent in node.agents.values():
                 agent.shutdown()
             node.tpu_driver.shutdown()
+            node.cd_driver.shutdown()
         self.controller.stop()
 
     # -- control loop passes ----------------------------------------------------
@@ -293,6 +294,22 @@ class SimCluster:
                 self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
             except NotFoundError:
                 continue
+            # Every consumer of a claim is recorded (shared claims have
+            # several); unprepare only happens when the last one is gone.
+            from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
+
+            for c in claims.values():
+                def reserve(obj, pod=pod):
+                    if not any(r.uid == pod.uid for r in obj.reserved_for):
+                        obj.reserved_for.append(ResourceClaimConsumer(
+                            kind=POD, name=pod.meta.name, uid=pod.uid,
+                        ))
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, reserve
+                    )
+                except NotFoundError:
+                    pass
 
     # -- kubelet -------------------------------------------------------------------
 
@@ -435,6 +452,18 @@ class SimCluster:
             cname = ref.resource_claim_name or f"{name}-{ref.name}"
             claim = self.api.try_get(RESOURCE_CLAIM, cname, namespace)
             if claim is None:
+                continue
+            # Drop this pod from the consumer list; a shared claim stays
+            # prepared while any other consumer remains.
+            def release(obj, pod=pod):
+                obj.reserved_for = [r for r in obj.reserved_for if r.uid != pod.uid]
+            try:
+                claim = self.api.update_with_retry(
+                    RESOURCE_CLAIM, cname, namespace, release
+                )
+            except NotFoundError:
+                continue
+            if claim.reserved_for:
                 continue
             node = self.nodes.get(pod.node_name)
             if node is not None and claim.allocation is not None:

@@ -43,31 +43,11 @@ def _meta(doc: Dict[str, Any]):
                     labels=md.get("labels", {}))
 
 
-def _device_requests(spec: Dict[str, Any]) -> List[DeviceRequest]:
-    out = []
-    for r in spec.get("devices", {}).get("requests", []):
-        out.append(DeviceRequest(
-            name=r.get("name", "device"),
-            device_class_name=r.get("deviceClassName", ""),
-            allocation_mode=r.get("allocationMode", "ExactCount"),
-            count=r.get("count", 1),
-            selectors=r.get("selectors", []),
-        ))
-    return out
-
-
-def _device_configs(spec: Dict[str, Any]) -> List[DeviceClaimConfig]:
-    out = []
-    for c in spec.get("devices", {}).get("config", []):
-        opaque = c.get("opaque")
-        out.append(DeviceClaimConfig(
-            requests=c.get("requests", []),
-            opaque=OpaqueDeviceConfig(
-                driver=opaque.get("driver", ""),
-                parameters=opaque.get("parameters", {}),
-            ) if opaque else None,
-        ))
-    return out
+from k8s_dra_driver_tpu.k8s.manifest import (
+    device_configs_from_spec as _device_configs,
+    device_requests_from_spec as _device_requests,
+    unwrap_template_spec,
+)
 
 
 def _pod(doc: Dict[str, Any]) -> Pod:
@@ -102,7 +82,7 @@ def _claim(doc: Dict[str, Any]) -> ResourceClaim:
 
 
 def _claim_template(doc: Dict[str, Any]) -> ResourceClaimTemplate:
-    spec = doc.get("spec", {}).get("spec", doc.get("spec", {}))
+    spec = unwrap_template_spec(doc.get("spec", {}))
     return ResourceClaimTemplate(
         meta=_meta(doc),
         requests=_device_requests(spec),

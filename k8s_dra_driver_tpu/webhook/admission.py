@@ -103,30 +103,20 @@ class AdmissionWebhook:
 
 def _object_from_json(kind: str, raw: Dict[str, Any]):
     """Minimal JSON -> object mapping for the config fields we validate."""
-    from k8s_dra_driver_tpu.k8s.core import DeviceClaimConfig, OpaqueDeviceConfig
+    from k8s_dra_driver_tpu.k8s.manifest import (
+        device_configs_from_spec,
+        unwrap_template_spec,
+    )
 
     if kind == RESOURCE_CLAIM:
         obj: Any = ResourceClaim()
+        spec = raw.get("spec", {})
     elif kind == RESOURCE_CLAIM_TEMPLATE:
         obj = ResourceClaimTemplate()
+        spec = unwrap_template_spec(raw.get("spec", {}))
     else:
         return None
-    spec = raw.get("spec", {})
-    if kind == RESOURCE_CLAIM_TEMPLATE:
-        spec = spec.get("spec", spec)
-    for c in spec.get("devices", {}).get("config", []):
-        opaque = c.get("opaque")
-        if not opaque:
-            continue
-        obj.config.append(
-            DeviceClaimConfig(
-                requests=c.get("requests", []),
-                opaque=OpaqueDeviceConfig(
-                    driver=opaque.get("driver", ""),
-                    parameters=opaque.get("parameters", {}),
-                ),
-            )
-        )
+    obj.config = device_configs_from_spec(spec)
     return obj
 
 

@@ -139,3 +139,31 @@ spec:
         assert sim.api.get(POD, "second", "default").phase == "Running"
     finally:
         sim.stop()
+
+
+def test_shared_claim_survives_first_pod_deletion(tmp_path):
+    """Review regression: deleting one consumer of a shared claim must not
+    unprepare it while the other pod runs."""
+    from k8s_dra_driver_tpu.e2e import SCENARIOS, SPECS_DIR
+    import os
+
+    from k8s_dra_driver_tpu.sim.kubectl import apply_file
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16")
+    sim.start()
+    try:
+        apply_file(sim.api, os.path.join(SPECS_DIR, "quickstart/tpu-test2.yaml"))
+        sim.settle()
+        pods = sim.api.list(POD, namespace="tpu-test2")
+        assert all(p.phase == "Running" for p in pods)
+        node = sim.nodes[pods[0].node_name]
+        claim = sim.api.get(RESOURCE_CLAIM, "shared-tpu", "tpu-test2")
+        sim.delete_pod("pod0", "tpu-test2")
+        # Claim still prepared: checkpoint entry + CDI spec intact for pod1.
+        assert claim.uid in node.tpu_driver.state.prepared_claims()
+        assert node.tpu_driver.state.cdi.claim_spec_exists(claim.uid)
+        # Last consumer goes -> unprepared.
+        sim.delete_pod("pod1", "tpu-test2")
+        assert claim.uid not in node.tpu_driver.state.prepared_claims()
+    finally:
+        sim.stop()
