@@ -1,0 +1,22 @@
+# Build/test entry points (reference Makefile analog).
+
+.PHONY: all native test e2e bench clean
+
+all: native test
+
+native:
+	cmake -S native -B native/build -G Ninja
+	cmake --build native/build
+
+test: native
+	python -m pytest tests/ -x -q
+
+e2e:
+	python -m k8s_dra_driver_tpu.e2e
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf native/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

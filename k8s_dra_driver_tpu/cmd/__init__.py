@@ -1,0 +1,39 @@
+"""Binary entrypoints — the cmd/ tier (five binaries, SURVEY.md §2.1):
+
+    python -m k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin
+    python -m k8s_dra_driver_tpu.cmd.compute_domain_kubelet_plugin
+    python -m k8s_dra_driver_tpu.cmd.compute_domain_controller
+    python -m k8s_dra_driver_tpu.cmd.compute_domain_daemon
+    python -m k8s_dra_driver_tpu.cmd.webhook
+
+Each wires the shared flag bundles (pkg/flags), logging, feature gates,
+metrics, and debug handlers around the corresponding component. The
+``--api-backend sim`` mode runs against an in-process API server (demo /
+development); ``kubernetes`` mode is the seam where a real client-go-style
+adapter implements the same APIServer interface.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from k8s_dra_driver_tpu.k8s import APIServer
+
+
+def resolve_api(args: argparse.Namespace) -> APIServer:
+    if args.api_backend == "sim":
+        return APIServer()
+    # Operator-facing: a clean error, not a traceback.
+    raise SystemExit(
+        "error: api-backend 'kubernetes' requires a real-cluster adapter "
+        "implementing k8s_dra_driver_tpu.k8s.APIServer's interface "
+        "(create/get/list/update/delete/watch); run with --api-backend sim "
+        "or embed the components with your own APIServer"
+    )
+
+
+def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--api-backend", choices=("sim", "kubernetes"), default="sim",
+        help="API server backend: in-process sim or a real cluster adapter",
+    )

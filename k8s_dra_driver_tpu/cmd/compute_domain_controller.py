@@ -1,0 +1,68 @@
+"""compute-domain-controller binary (reference cmd analog): leader-elected
+cluster reconciler for the ComputeDomain CRD."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import sys
+import threading
+
+from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
+from k8s_dra_driver_tpu.controller import Controller
+from k8s_dra_driver_tpu.pkg import flags as flagpkg
+from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
+from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
+
+log = logging.getLogger("compute-domain-controller")
+
+
+def main(argv=None) -> int:
+    parser = flagpkg.build_parser(
+        "compute-domain-controller",
+        "cluster-scoped ComputeDomain reconciler",
+        [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(),
+         flagpkg.LeaderElectionFlags(), flagpkg.KubeClientFlags()],
+    )
+    add_api_backend_flag(parser)
+    parser.add_argument("--driver-namespace", default="tpu-dra-driver")
+    parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--version", action="store_true")
+    args = parser.parse_args(argv)
+    if args.version:
+        print(version_string("compute-domain-controller"))
+        return 0
+    flagpkg.LoggingFlags.configure(args)
+    flagpkg.log_startup_config(args, log)
+    flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    start_debug_signal_handlers()
+
+    api = resolve_api(args)
+    registry = Registry()
+    controller = Controller(
+        api, driver_namespace=args.driver_namespace,
+        identity=f"{socket.gethostname()}-controller",
+        leader_elect=args.leader_elect, metrics_registry=registry,
+    )
+    controller.start()
+    log.info("%s running (leader_elect=%s)",
+             version_string("compute-domain-controller"), args.leader_elect)
+
+    metrics_srv = None
+    if args.metrics_port:
+        metrics_srv = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
+        metrics_srv.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    controller.stop()
+    if metrics_srv:
+        metrics_srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

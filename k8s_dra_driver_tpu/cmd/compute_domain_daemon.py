@@ -1,0 +1,96 @@
+"""compute-domain-daemon binary: runs the SliceAgent inside the per-CD
+DaemonSet pod (reference cmd/compute-domain-daemon, SURVEY.md §3.4).
+
+Subcommands:
+    run    — the agent loop (default)
+    check  — readiness probe; exit 0 iff the local agent reports READY
+             (the nvidia-imex-ctl -q analog, main.go:433-459)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+
+from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
+from k8s_dra_driver_tpu.daemon import SliceAgent
+from k8s_dra_driver_tpu.pkg import flags as flagpkg
+from k8s_dra_driver_tpu.tpulib import new_tpulib
+from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
+
+log = logging.getLogger("compute-domain-daemon")
+
+READY_FILE = "ready"
+
+
+def main(argv=None) -> int:
+    parser = flagpkg.build_parser(
+        "compute-domain-daemon", "per-domain slice agent",
+        [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(), flagpkg.KubeClientFlags()],
+    )
+    add_api_backend_flag(parser)
+    parser.add_argument("command", nargs="?", default="run", choices=("run", "check"))
+    parser.add_argument("--workdir", default=os.environ.get("SLICE_AGENT_WORKDIR",
+                                                            "/var/run/tpu-slice-agent"))
+    parser.add_argument("--version", action="store_true")
+    args = parser.parse_args(argv)
+    if args.version:
+        print(version_string("compute-domain-daemon"))
+        return 0
+    flagpkg.LoggingFlags.configure(args)
+
+    if args.command == "check":
+        # Probe the running agent via its ready file (written by run loop).
+        path = os.path.join(args.workdir, READY_FILE)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                ready = f.read().strip() == "READY"
+        except OSError:
+            ready = False
+        print("READY" if ready else "NOT_READY")
+        return 0 if ready else 1
+
+    gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    start_debug_signal_handlers()
+    domain_uid = os.environ.get("COMPUTE_DOMAIN_UUID", "")
+    if not domain_uid:
+        # Guard: without the CDI-injected env the daemon claim wasn't
+        # prepared (reference main.go:217-219).
+        log.error("COMPUTE_DOMAIN_UUID not set; was the daemon claim prepared?")
+        return 1
+
+    api = resolve_api(args)
+    agent = SliceAgent(
+        api=api,
+        namespace=os.environ.get("COMPUTE_DOMAIN_NAMESPACE", "default"),
+        domain_uid=domain_uid,
+        node_name=os.environ.get("NODE_NAME", os.uname().nodename),
+        pod_ip=os.environ.get("POD_IP", "127.0.0.1"),
+        tpulib=new_tpulib(),
+        workdir=args.workdir,
+        gates=gates,
+    )
+    agent.startup()
+    log.info("%s registered: index=%d ici=%s",
+             version_string("compute-domain-daemon"), agent.index, agent.ici_domain)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    ready_path = os.path.join(args.workdir, READY_FILE)
+    while not stop.wait(1.0):
+        try:
+            agent.sync()
+            with open(ready_path, "w", encoding="utf-8") as f:
+                f.write("READY" if agent.check() else "NOT_READY")
+        except Exception:  # noqa: BLE001 — retry next tick
+            log.exception("agent sync failed")
+    agent.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""compute-domain-kubelet-plugin binary (reference cmd analog)."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import sys
+import threading
+
+from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
+from k8s_dra_driver_tpu.pkg import flags as flagpkg
+from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
+from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
+from k8s_dra_driver_tpu.tpulib import new_tpulib
+from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
+
+log = logging.getLogger("compute-domain-kubelet-plugin")
+
+
+def main(argv=None) -> int:
+    parser = flagpkg.build_parser(
+        "compute-domain-kubelet-plugin",
+        "DRA kubelet plugin for compute-domain.tpu.google.com",
+        [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(), flagpkg.PluginFlags(),
+         flagpkg.KubeClientFlags()],
+    )
+    add_api_backend_flag(parser)
+    parser.add_argument("--version", action="store_true")
+    args = parser.parse_args(argv)
+    if args.version:
+        print(version_string("compute-domain-kubelet-plugin"))
+        return 0
+    flagpkg.LoggingFlags.configure(args)
+    flagpkg.log_startup_config(args, log)
+    gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    start_debug_signal_handlers()
+
+    api = resolve_api(args)
+    registry = Registry()
+    driver = ComputeDomainDriver(
+        api=api, node_name=args.node_name or socket.gethostname(),
+        tpulib=new_tpulib(), plugin_dir=args.plugin_dir,
+        cdi_root=args.cdi_root, gates=gates, metrics_registry=registry,
+    )
+    driver.start()
+    log.info("%s serving", version_string("compute-domain-kubelet-plugin"))
+
+    metrics_srv = None
+    if args.metrics_port:
+        metrics_srv = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
+        metrics_srv.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    driver.shutdown()
+    if metrics_srv:
+        metrics_srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""webhook binary: serves the validating admission endpoint."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+
+from k8s_dra_driver_tpu.pkg import flags as flagpkg
+from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
+from k8s_dra_driver_tpu.webhook import AdmissionWebhook
+
+log = logging.getLogger("webhook")
+
+
+def main(argv=None) -> int:
+    parser = flagpkg.build_parser(
+        "webhook", "validating admission webhook for opaque device configs",
+        [flagpkg.LoggingFlags()],
+    )
+    parser.add_argument("--bind", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--version", action="store_true")
+    args = parser.parse_args(argv)
+    if args.version:
+        print(version_string("webhook"))
+        return 0
+    flagpkg.LoggingFlags.configure(args)
+    start_debug_signal_handlers()
+
+    srv = AdmissionWebhook().serve(host=args.bind, port=args.port)
+    srv.start()
+    log.info("%s listening on %s:%d", version_string("webhook"), args.bind, srv.port)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,8 @@ def rmsnorm(
     rows = 1
     for s in orig_shape[:-1]:
         rows *= s
+    if rows == 0 or d == 0:
+        return x
     x2 = x.reshape(rows, d)
     block_rows = min(block_rows, rows)
     if rows % block_rows != 0:
@@ -91,7 +93,7 @@ def tiled_matmul(
     assert k == k2, (a.shape, b.shape)
     bm = min(bm, m)
     bn = min(bn, n)
-    if m % bm != 0 or n % bn != 0:
+    if m == 0 or n == 0 or m % bm != 0 or n % bn != 0:
         # Shape not tileable: let XLA handle it (still fused fine).
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
     return pl.pallas_call(

@@ -1,0 +1,141 @@
+"""Composable CLI flag bundles with environment-variable mirrors.
+
+The reference builds every binary's CLI from shared urfave/cli bundles where
+each flag also reads an env var (/root/reference/pkg/flags/,
+cmd/gpu-kubelet-plugin/main.go:94-214). Python analog over argparse: each
+bundle contributes flags whose defaults resolve from the environment, so
+container deployments configure via env and humans via flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+
+
+def _env_default(env: str, default: Any, cast=str):
+    raw = os.environ.get(env)
+    if raw is None:
+        return default
+    try:
+        if cast is bool:
+            return raw.lower() in ("1", "true", "yes")
+        return cast(raw)
+    except ValueError:
+        return default
+
+
+class FlagBundle:
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class KubeClientFlags(FlagBundle):
+    """--kubeconfig / --kube-api-qps / --kube-api-burst (KUBECONFIG, ...)."""
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("kubernetes client")
+        g.add_argument("--kubeconfig", default=_env_default("KUBECONFIG", ""),
+                       help="path to kubeconfig (in-cluster when empty) [KUBECONFIG]")
+        g.add_argument("--kube-api-qps", type=float,
+                       default=_env_default("KUBE_API_QPS", 5.0, float),
+                       help="client QPS [KUBE_API_QPS]")
+        g.add_argument("--kube-api-burst", type=int,
+                       default=_env_default("KUBE_API_BURST", 10, int),
+                       help="client burst [KUBE_API_BURST]")
+
+
+@dataclass
+class LoggingFlags(FlagBundle):
+    """-v verbosity + --log-json (LOG_VERBOSITY, LOG_JSON)."""
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("logging")
+        g.add_argument("-v", "--verbosity", type=int,
+                       default=_env_default("LOG_VERBOSITY", 0, int),
+                       help="log verbosity (0=info, >=6 debug timings) [LOG_VERBOSITY]")
+        g.add_argument("--log-json", action="store_true",
+                       default=_env_default("LOG_JSON", False, bool),
+                       help="JSON log lines [LOG_JSON]")
+
+    @staticmethod
+    def configure(args: argparse.Namespace) -> None:
+        level = logging.DEBUG if args.verbosity >= 6 else logging.INFO
+        fmt = (
+            '{"ts":"%(asctime)s","lvl":"%(levelname)s","logger":"%(name)s","msg":%(message)r}'
+            if args.log_json
+            else "%(asctime)s %(levelname)s %(name)s: %(message)s"
+        )
+        logging.basicConfig(level=level, format=fmt)
+
+
+@dataclass
+class FeatureGateFlags(FlagBundle):
+    """--feature-gates (FEATURE_GATES), validated with dependencies."""
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        parser.add_argument(
+            "--feature-gates",
+            default=_env_default(fg.ENV_VAR, ""),
+            help=f"Gate=bool,... known: {', '.join(fg.known_features())} [{fg.ENV_VAR}]",
+        )
+
+    @staticmethod
+    def resolve(args: argparse.Namespace, exit_on_error: bool = False) -> fg.FeatureGates:
+        try:
+            gates = fg.parse(args.feature_gates)
+            gates.validate()
+        except fg.FeatureGateError as e:
+            if exit_on_error:
+                raise SystemExit(f"error: --feature-gates: {e}") from None
+            raise
+        return gates
+
+
+@dataclass
+class LeaderElectionFlags(FlagBundle):
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("leader election")
+        g.add_argument("--leader-elect", action="store_true",
+                       default=_env_default("LEADER_ELECT", False, bool),
+                       help="enable leader election [LEADER_ELECT]")
+        g.add_argument("--leader-elect-lease-duration", type=float,
+                       default=_env_default("LEADER_ELECT_LEASE_DURATION", 15.0, float))
+
+
+@dataclass
+class PluginFlags(FlagBundle):
+    """Node-plugin common flags: node name, plugin dir, CDI root, metrics."""
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("plugin")
+        g.add_argument("--node-name", default=_env_default("NODE_NAME", ""),
+                       help="this node's name [NODE_NAME]")
+        g.add_argument("--plugin-dir",
+                       default=_env_default("PLUGIN_DIR",
+                                            "/var/lib/kubelet/plugins/tpu.google.com"),
+                       help="checkpoint/lock dir [PLUGIN_DIR]")
+        g.add_argument("--cdi-root", default=_env_default("CDI_ROOT", "/var/run/cdi"),
+                       help="CDI spec dir [CDI_ROOT]")
+        g.add_argument("--metrics-port", type=int,
+                       default=_env_default("METRICS_PORT", 0, int),
+                       help="serve /metrics on this port; 0 disables [METRICS_PORT]")
+
+
+def build_parser(prog: str, description: str, bundles: Sequence[FlagBundle]) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog=prog, description=description)
+    for b in bundles:
+        b.add_to(parser)
+    return parser
+
+
+def log_startup_config(args: argparse.Namespace, log: logging.Logger) -> None:
+    """Dump the resolved config at startup (LogStartupConfig analog)."""
+    for k, v in sorted(vars(args).items()):
+        log.info("config %s=%r", k, v)

@@ -1,0 +1,121 @@
+"""ICI mesh partitioner — the pkg/fabricmanager analog.
+
+The reference programs NVSwitch partitions for passthrough device groups
+(/root/reference/pkg/fabricmanager/manager.go:27-272) through a cgo client
+with a stub for tests (client.go:87-103). TPU counterpart: legal ICI
+subslice partitions of a host topology are computed (not queried), and
+activation programs the partition through a client interface — real
+implementations talk to the platform (via the C++ shim / libtpu), the stub
+records calls for tests. Activate/Deactivate are idempotent, as the
+reference's are.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from k8s_dra_driver_tpu.tpulib.profiles import compute_subslice_profiles
+from k8s_dra_driver_tpu.tpulib.types import SubslicePlacement
+
+log = logging.getLogger(__name__)
+
+
+class PartitionError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Partition:
+    """A legal, activatable ICI partition: one subslice placement."""
+
+    id: str                      # e.g. "1x2-at-0x0"
+    profile: str
+    chip_indices: Tuple[int, ...]
+
+
+class PartitionClient(Protocol):
+    def activate(self, partition: Partition) -> None: ...
+    def deactivate(self, partition: Partition) -> None: ...
+
+
+class StubPartitionClient:
+    """Records calls; the test double (reference stubClient pattern)."""
+
+    def __init__(self) -> None:
+        self.active: Dict[str, Partition] = {}
+        self.calls: List[Tuple[str, str]] = []
+
+    def activate(self, partition: Partition) -> None:
+        self.calls.append(("activate", partition.id))
+        self.active[partition.id] = partition
+
+    def deactivate(self, partition: Partition) -> None:
+        self.calls.append(("deactivate", partition.id))
+        self.active.pop(partition.id, None)
+
+
+class PartitionManager:
+    """Caches supported partitions for a host topology; activates and
+    deactivates idempotently; refuses overlapping activations (two active
+    partitions may not share a chip)."""
+
+    def __init__(self, host_topology: str, client: Optional[PartitionClient] = None):
+        self.host_topology = host_topology
+        self.client = client if client is not None else StubPartitionClient()
+        self._mu = threading.Lock()
+        self._active: Dict[str, Partition] = {}
+        self._supported: Dict[str, Partition] = {}
+        for prof in compute_subslice_profiles(host_topology):
+            for pl in prof.placements:
+                p = self._from_placement(pl)
+                self._supported[p.id] = p
+
+    @staticmethod
+    def _from_placement(pl: SubslicePlacement) -> Partition:
+        return Partition(id=pl.name_suffix, profile=pl.profile,
+                         chip_indices=tuple(pl.chip_indices))
+
+    def supported_partitions(self) -> List[Partition]:
+        return sorted(self._supported.values(), key=lambda p: p.id)
+
+    def partition_for_chips(self, chips: Tuple[int, ...]) -> Optional[Partition]:
+        want = tuple(sorted(chips))
+        for p in self._supported.values():
+            if tuple(sorted(p.chip_indices)) == want:
+                return p
+        return None
+
+    def activate(self, partition_id: str) -> Partition:
+        with self._mu:
+            p = self._supported.get(partition_id)
+            if p is None:
+                raise PartitionError(
+                    f"unsupported partition {partition_id!r} on {self.host_topology}"
+                )
+            if partition_id in self._active:
+                return p  # idempotent
+            overlapping = [
+                a.id for a in self._active.values()
+                if set(a.chip_indices) & set(p.chip_indices)
+            ]
+            if overlapping:
+                raise PartitionError(
+                    f"partition {partition_id} overlaps active {overlapping}"
+                )
+            self.client.activate(p)
+            self._active[partition_id] = p
+            return p
+
+    def deactivate(self, partition_id: str) -> None:
+        with self._mu:
+            p = self._active.pop(partition_id, None)
+            if p is None:
+                return  # idempotent
+            self.client.deactivate(p)
+
+    def active_partitions(self) -> List[Partition]:
+        with self._mu:
+            return sorted(self._active.values(), key=lambda p: p.id)

@@ -1,0 +1,65 @@
+"""Slice-agent deployment-mode configuration — the pkg/imex analog.
+
+Reference (/root/reference/pkg/imex/imex.go:25-99): deployment ``Mode``
+(driverManaged vs hostManaged) and ``Isolation`` (domain vs channel),
+with validation gated on the host-managed feature gate. TPU mapping: the
+slice agent is either run by this driver's per-CD DaemonSet or assumed to
+be part of the node image (GKE tpu-vm style); isolation decides whether
+workloads are isolated per-domain or per-channel within a domain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+
+
+class Mode(str, Enum):
+    DRIVER_MANAGED = "driverManaged"
+    HOST_MANAGED = "hostManaged"
+
+
+class Isolation(str, Enum):
+    DOMAIN = "domain"
+    CHANNEL = "channel"
+
+
+class SliceConfigError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SliceAgentConfig:
+    mode: Mode = Mode.DRIVER_MANAGED
+    isolation: Isolation = Isolation.DOMAIN
+
+    @classmethod
+    def parse(cls, mode: str = "", isolation: str = "") -> "SliceAgentConfig":
+        try:
+            m = Mode(mode) if mode else Mode.DRIVER_MANAGED
+        except ValueError:
+            raise SliceConfigError(
+                f"unknown mode {mode!r}; want one of {[x.value for x in Mode]}"
+            ) from None
+        try:
+            i = Isolation(isolation) if isolation else Isolation.DOMAIN
+        except ValueError:
+            raise SliceConfigError(
+                f"unknown isolation {isolation!r}; want one of {[x.value for x in Isolation]}"
+            ) from None
+        return cls(mode=m, isolation=i)
+
+    def effective_host_managed(self, gates: fg.FeatureGates) -> bool:
+        return self.mode == Mode.HOST_MANAGED and gates.enabled("HostManagedSliceAgent")
+
+    def validate(self, gates: fg.FeatureGates) -> None:
+        if self.mode == Mode.HOST_MANAGED and not gates.enabled("HostManagedSliceAgent"):
+            raise SliceConfigError(
+                "mode hostManaged requires the HostManagedSliceAgent feature gate"
+            )
+        if self.isolation == Isolation.CHANNEL and self.mode == Mode.HOST_MANAGED:
+            raise SliceConfigError(
+                "channel isolation is not supported with host-managed agents"
+            )

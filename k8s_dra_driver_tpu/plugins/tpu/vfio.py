@@ -1,0 +1,113 @@
+"""VFIO passthrough manager: driver rebinding for untrusted workloads.
+
+Reference: /root/reference/cmd/gpu-kubelet-plugin/vfio-device.go — sysfs
+unbind from the accel driver / bind to vfio-pci (235-257), IOMMU(fd)
+detection (319-352), wait-until-free (85-116). Roots are injectable
+(ALT_TPU_SYSFS_ROOT / ALT_TPU_DEV_ROOT) so tests drive fixture trees; the
+PassthroughSupport feature gate guards the whole path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+VFIO_PCI_DRIVER = "vfio-pci"
+
+
+class VfioError(Exception):
+    pass
+
+
+class VfioPciManager:
+    def __init__(self, sysfs_root: Optional[str] = None, dev_root: Optional[str] = None):
+        self.sysfs_root = sysfs_root or os.environ.get("ALT_TPU_SYSFS_ROOT", "/sys")
+        self.dev_root = dev_root or os.environ.get("ALT_TPU_DEV_ROOT", "/dev")
+
+    # -- sysfs paths ----------------------------------------------------------
+
+    def _pci_dir(self, pci_address: str) -> str:
+        return os.path.join(self.sysfs_root, "bus", "pci", "devices", pci_address)
+
+    def _driver_link(self, pci_address: str) -> str:
+        return os.path.join(self._pci_dir(pci_address), "driver")
+
+    def current_driver(self, pci_address: str) -> str:
+        try:
+            return os.path.basename(os.path.realpath(self._driver_link(pci_address)))
+        except OSError:
+            return ""
+
+    def iommu_group(self, pci_address: str) -> str:
+        link = os.path.join(self._pci_dir(pci_address), "iommu_group")
+        try:
+            return os.path.basename(os.path.realpath(link))
+        except OSError:
+            return ""
+
+    def iommufd_available(self) -> bool:
+        return os.path.exists(os.path.join(self.dev_root, "iommu"))
+
+    # -- rebinding -------------------------------------------------------------
+
+    def _write(self, path: str, value: str) -> None:
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(value)
+        except OSError as e:
+            raise VfioError(f"write {value!r} to {path}: {e}") from None
+
+    def wait_device_free(self, dev_path: str, timeout_s: float = 10.0) -> None:
+        """Refuse to yank a device out from under a running workload: wait
+        for its node to be openable (reference GPU-free wait, 85-116)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                fd = os.open(dev_path, os.O_RDONLY | os.O_NONBLOCK)
+                os.close(fd)
+                return
+            except OSError as e:
+                import errno
+
+                if e.errno == errno.ENOENT:
+                    return  # already unbound
+                if e.errno not in (errno.EBUSY,):
+                    return  # not busy — permission etc.; binding may proceed
+            time.sleep(0.2)
+        raise VfioError(f"{dev_path} still busy after {timeout_s}s")
+
+    def bind_to_vfio(self, pci_address: str) -> str:
+        """Unbind from the current driver, bind to vfio-pci; returns the
+        /dev/vfio/<group> path."""
+        cur = self.current_driver(pci_address)
+        if cur == VFIO_PCI_DRIVER:
+            group = self.iommu_group(pci_address)
+            return os.path.join(self.dev_root, "vfio", group)
+        if cur:
+            self._write(
+                os.path.join(self._driver_link(pci_address), "unbind"), pci_address
+            )
+        override = os.path.join(self._pci_dir(pci_address), "driver_override")
+        self._write(override, VFIO_PCI_DRIVER)
+        probe = os.path.join(self.sysfs_root, "bus", "pci", "drivers_probe")
+        self._write(probe, pci_address)
+        group = self.iommu_group(pci_address)
+        if not group:
+            raise VfioError(f"{pci_address}: no IOMMU group after vfio bind")
+        return os.path.join(self.dev_root, "vfio", group)
+
+    def unbind_from_vfio(self, pci_address: str) -> None:
+        """Return the device to the default (accel) driver."""
+        if self.current_driver(pci_address) != VFIO_PCI_DRIVER:
+            return  # idempotent
+        self._write(
+            os.path.join(self._driver_link(pci_address), "unbind"), pci_address
+        )
+        override = os.path.join(self._pci_dir(pci_address), "driver_override")
+        self._write(override, "\n")
+        self._write(os.path.join(self.sysfs_root, "bus", "pci", "drivers_probe"),
+                    pci_address)

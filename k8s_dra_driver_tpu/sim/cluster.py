@@ -270,13 +270,10 @@ class SimCluster:
                         results.append((c, r))
                     if ok:
                         for c, r in results:
-                            def set_alloc(obj, r=r, pod=pod):
+                            # Consumers are recorded by the reserve loop
+                            # below; allocation only here.
+                            def set_alloc(obj, r=r):
                                 obj.allocation = r
-                                from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
-
-                                obj.reserved_for = [ResourceClaimConsumer(
-                                    kind=POD, name=pod.meta.name, uid=pod.uid,
-                                )]
                             self.api.update_with_retry(
                                 RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
                             )

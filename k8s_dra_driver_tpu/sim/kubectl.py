@@ -20,13 +20,15 @@ from k8s_dra_driver_tpu.api.computedomain import (
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.k8s.core import (
     Container,
-    DeviceClaimConfig,
-    DeviceRequest,
-    OpaqueDeviceConfig,
     Pod,
     PodResourceClaimRef,
     ResourceClaim,
     ResourceClaimTemplate,
+)
+from k8s_dra_driver_tpu.k8s.manifest import (
+    device_configs_from_spec as _device_configs,
+    device_requests_from_spec as _device_requests,
+    unwrap_template_spec,
 )
 from k8s_dra_driver_tpu.k8s.objects import K8sObject, new_meta
 
@@ -41,13 +43,6 @@ def _meta(doc: Dict[str, Any]):
         raise ManifestError(f"manifest {doc.get('kind')} missing metadata.name")
     return new_meta(md["name"], md.get("namespace", "default"),
                     labels=md.get("labels", {}))
-
-
-from k8s_dra_driver_tpu.k8s.manifest import (
-    device_configs_from_spec as _device_configs,
-    device_requests_from_spec as _device_requests,
-    unwrap_template_spec,
-)
 
 
 def _pod(doc: Dict[str, Any]) -> Pod:

@@ -1,0 +1,4 @@
+"""Process-level utilities: debug signal handlers, version info."""
+
+from k8s_dra_driver_tpu.utils.debug import start_debug_signal_handlers  # noqa: F401
+from k8s_dra_driver_tpu.utils.version import version_string  # noqa: F401

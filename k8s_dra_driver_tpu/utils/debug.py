@@ -1,0 +1,47 @@
+"""Debug signal handlers: SIGUSR-triggered thread-stack dumps.
+
+Reference: /root/reference/internal/common/util.go:29-60 (goroutine stack
+dump to /tmp on SIGUSR). Python analog dumps every thread's stack.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def _dump_stacks(dump_dir: str) -> str:
+    path = os.path.join(dump_dir, f"stacks-{os.getpid()}-{int(time.time())}.txt")
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    with open(path, "w", encoding="utf-8") as f:
+        for ident, frame in frames.items():
+            f.write(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
+            traceback.print_stack(frame, file=f)
+            f.write("\n")
+    return path
+
+
+def start_debug_signal_handlers(dump_dir: str = "/tmp", use_faulthandler: bool = True) -> None:
+    """SIGUSR2 -> write all thread stacks to a file in dump_dir (SIGUSR1 is
+    reserved for the slice agent's reload protocol)."""
+
+    def handler(signum, frame):  # noqa: ARG001
+        try:
+            path = _dump_stacks(dump_dir)
+            log.warning("thread stacks dumped to %s", path)
+        except Exception:  # noqa: BLE001 — never die in a signal handler
+            log.exception("stack dump failed")
+
+    signal.signal(signal.SIGUSR2, handler)
+    if use_faulthandler:
+        faulthandler.enable()

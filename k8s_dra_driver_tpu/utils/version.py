@@ -1,0 +1,14 @@
+"""Version string assembly (internal/info analog)."""
+
+from __future__ import annotations
+
+import platform
+
+from k8s_dra_driver_tpu import __version__
+
+
+def version_string(component: str) -> str:
+    return (
+        f"{component} v{__version__} "
+        f"(python {platform.python_version()}, {platform.system().lower()}/{platform.machine()})"
+    )
