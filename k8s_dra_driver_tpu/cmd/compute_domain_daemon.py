@@ -84,10 +84,18 @@ def main(argv=None) -> int:
     while not stop.wait(1.0):
         try:
             agent.sync()
-            with open(ready_path, "w", encoding="utf-8") as f:
-                f.write("READY" if agent.check() else "NOT_READY")
+            status = "READY" if agent.check() else "NOT_READY"
         except Exception:  # noqa: BLE001 — retry next tick
             log.exception("agent sync failed")
+            status = "NOT_READY"
+        with open(ready_path, "w", encoding="utf-8") as f:
+            f.write(status)
+    # Invalidate readiness on the way out so probes exec'd against a dead
+    # run loop don't read a stale READY.
+    try:
+        os.remove(ready_path)
+    except OSError:
+        pass
     agent.shutdown()
     return 0
 

@@ -16,6 +16,7 @@ import threading
 from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
 from k8s_dra_driver_tpu.pkg import flags as flagpkg
 from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
+from k8s_dra_driver_tpu.plugins.health import Healthcheck
 from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
 from k8s_dra_driver_tpu.tpulib import new_tpulib
 from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
@@ -57,11 +58,17 @@ def main(argv=None) -> int:
     if args.metrics_port:
         metrics_srv = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
         metrics_srv.start()
+    health_srv = None
+    if args.healthcheck_port >= 0:
+        health_srv = Healthcheck(driver, host="0.0.0.0", port=args.healthcheck_port)
+        health_srv.start()
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    if health_srv:
+        health_srv.stop()
     driver.shutdown()
     if metrics_srv:
         metrics_srv.stop()

@@ -10,6 +10,7 @@ container deployments configure via env and humans via flags.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 from dataclasses import dataclass
@@ -51,6 +52,18 @@ class KubeClientFlags(FlagBundle):
                        help="client burst [KUBE_API_BURST]")
 
 
+class _JSONFormatter(logging.Formatter):
+    """One JSON object per line (the component-base logsapi JSON option)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        return json.dumps({
+            "ts": self.formatTime(record),
+            "lvl": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        })
+
+
 @dataclass
 class LoggingFlags(FlagBundle):
     """-v verbosity + --log-json (LOG_VERBOSITY, LOG_JSON)."""
@@ -60,19 +73,21 @@ class LoggingFlags(FlagBundle):
         g.add_argument("-v", "--verbosity", type=int,
                        default=_env_default("LOG_VERBOSITY", 0, int),
                        help="log verbosity (0=info, >=6 debug timings) [LOG_VERBOSITY]")
-        g.add_argument("--log-json", action="store_true",
+        g.add_argument("--log-json", action=argparse.BooleanOptionalAction,
                        default=_env_default("LOG_JSON", False, bool),
                        help="JSON log lines [LOG_JSON]")
 
     @staticmethod
     def configure(args: argparse.Namespace) -> None:
         level = logging.DEBUG if args.verbosity >= 6 else logging.INFO
-        fmt = (
-            '{"ts":"%(asctime)s","lvl":"%(levelname)s","logger":"%(name)s","msg":%(message)r}'
-            if args.log_json
-            else "%(asctime)s %(levelname)s %(name)s: %(message)s"
-        )
-        logging.basicConfig(level=level, format=fmt)
+        if args.log_json:
+            handler = logging.StreamHandler()
+            handler.setFormatter(_JSONFormatter())
+            logging.basicConfig(level=level, handlers=[handler])
+        else:
+            logging.basicConfig(
+                level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
+            )
 
 
 @dataclass
@@ -102,7 +117,7 @@ class FeatureGateFlags(FlagBundle):
 class LeaderElectionFlags(FlagBundle):
     def add_to(self, parser: argparse.ArgumentParser) -> None:
         g = parser.add_argument_group("leader election")
-        g.add_argument("--leader-elect", action="store_true",
+        g.add_argument("--leader-elect", action=argparse.BooleanOptionalAction,
                        default=_env_default("LEADER_ELECT", False, bool),
                        help="enable leader election [LEADER_ELECT]")
         g.add_argument("--leader-elect-lease-duration", type=float,
@@ -126,6 +141,10 @@ class PluginFlags(FlagBundle):
         g.add_argument("--metrics-port", type=int,
                        default=_env_default("METRICS_PORT", 0, int),
                        help="serve /metrics on this port; 0 disables [METRICS_PORT]")
+        g.add_argument("--healthcheck-port", type=int,
+                       default=_env_default("HEALTHCHECK_PORT", -1, int),
+                       help="serve /healthz on this port; negative disables "
+                            "[HEALTHCHECK_PORT] (reference health.go:52-55)")
 
 
 def build_parser(prog: str, description: str, bundles: Sequence[FlagBundle]) -> argparse.ArgumentParser:

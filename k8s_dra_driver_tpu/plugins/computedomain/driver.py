@@ -146,6 +146,11 @@ class ComputeDomainDriver:
             self._stop_evt.set()
             self._cleanup_thread.join(timeout=5)
 
+    def healthy(self) -> bool:
+        """Registration-status leg of the healthcheck probe (health.go:145)."""
+        stop_evt = getattr(self, "_stop_evt", None)
+        return stop_evt is not None and not stop_evt.is_set()
+
     def _cleanup_loop(self, interval_s: float) -> None:
         """Periodic tombstone expiry (the reference's cleanup manager runs
         this tier, cleanup.go:99-141)."""

@@ -1,0 +1,120 @@
+"""Plugin healthcheck service.
+
+The reference exposes a gRPC grpc.health.v1 server on each kubelet plugin
+whose Check() performs a LIVE round-trip through the plugin's own serving
+surface — a GetInfo against the kubelet registration socket plus a noop
+NodePrepareResources against the DRA socket — rather than reporting a cached
+flag (/root/reference/cmd/gpu-kubelet-plugin/health.go:39-148). That makes
+the probe catch wedged serving loops, not just process liveness.
+
+TPU-native analog: our kubelet seam is the in-process DRA service object
+(TpuDriver / ComputeDomainDriver), so the live probe is a noop
+prepare_resource_claims([]) call plus the driver's registration status,
+served over HTTP (`/healthz`, `/healthz/liveness`) for kubelet httpGet
+probes. Responses mirror grpc.health.v1 semantics: 200 "SERVING",
+503 "NOT_SERVING", 404 for unknown service names (codes.NotFound,
+health.go:122-125).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+from typing import Optional, Protocol
+
+log = logging.getLogger(__name__)
+
+# Known service names, matching the reference's map (health.go:122).
+KNOWN_SERVICES = ("", "liveness")
+
+
+class DRAService(Protocol):
+    """What the healthcheck needs from a plugin driver."""
+
+    def prepare_resource_claims(self, claims): ...
+    def healthy(self) -> bool: ...
+
+
+class Healthcheck:
+    """HTTP healthcheck server bound to a plugin driver.
+
+    check() is usable standalone (unit tests, in-process probes); start()
+    serves it at /healthz[/<service>] the way the reference serves
+    grpc.health.v1 on --healthcheck-port (health.go:51-110).
+    """
+
+    def __init__(self, driver: DRAService, host: str = "127.0.0.1", port: int = 0):
+        self.driver = driver
+        self._host = host
+        self._port = port
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- probe ---------------------------------------------------------------
+
+    def check(self, service: str = "") -> str:
+        """Return "SERVING"/"NOT_SERVING"; raise KeyError for unknown service."""
+        if service not in KNOWN_SERVICES:
+            raise KeyError(service)
+        try:
+            # Noop request through the real serving path (health.go:138-144):
+            # an empty claim list must round-trip without error.
+            result = self.driver.prepare_resource_claims([])
+            if result != {}:
+                return "NOT_SERVING"
+            if not self.driver.healthy():
+                return "NOT_SERVING"
+        except Exception:  # noqa: BLE001 — any probe failure is NOT_SERVING
+            log.exception("healthcheck probe failed")
+            return "NOT_SERVING"
+        return "SERVING"
+
+    # -- HTTP server ---------------------------------------------------------
+
+    def start(self) -> None:
+        hc = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path in ("/healthz", "/healthz/"):
+                    service = ""
+                elif self.path.startswith("/healthz/"):
+                    service = self.path[len("/healthz/"):]
+                else:
+                    self.send_error(404)
+                    return
+                try:
+                    status = hc.check(service)
+                except KeyError:
+                    self.send_error(404, "unknown service")
+                    return
+                code = 200 if status == "SERVING" else 503
+                body = (status + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a) -> None:  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="healthcheck", daemon=True
+        )
+        self._thread.start()
+        log.info("healthcheck serving at %s:%d", *self._httpd.server_address[:2])
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

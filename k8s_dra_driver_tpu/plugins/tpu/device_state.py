@@ -28,7 +28,7 @@ import logging
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.api.configs import (
@@ -61,6 +61,7 @@ from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
     enumerate_allocatable,
 )
 from k8s_dra_driver_tpu.plugins.tpu.sharing import SharingManager
+from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 from k8s_dra_driver_tpu.tpulib.types import HostInventory, parse_topology
 
@@ -102,6 +103,7 @@ class DeviceState:
         )
         self.cdi = CDIHandler(cdi_root)
         self.sharing = SharingManager(plugin_dir)
+        self.vfio = VfioPciManager()
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
         self._mutex = threading.Lock()
@@ -244,6 +246,8 @@ class DeviceState:
                 if result.driver != self.driver_name:
                     continue
                 dev = self.allocatable[result.device]
+                if isinstance(dev, VfioDevice):
+                    dev = self._ensure_vfio_bound(dev)
                 for cfg in configs.get(result.request, []):
                     self._apply_config(cfg, claim.uid, dev)
                 prepared.append(
@@ -321,9 +325,28 @@ class DeviceState:
                 claim_uid, dev.chip_indices, sharing.premapped
             )
 
+    def _ensure_vfio_bound(self, dev: VfioDevice) -> VfioDevice:
+        """Rebind the chip's PCI function to vfio-pci at Prepare time
+        (reference device_state.go:1254-1297, vfio-device.go:235-257). A
+        device whose group path is already known (inventory pre-bound, or a
+        prior prepare) is left alone."""
+        if dev.vfio_group_path:
+            return dev
+        group_path = self.vfio.bind_to_vfio(
+            dev.chip.pci_address, dev_path=dev.chip.dev_path
+        )
+        dev = replace(dev, vfio_group_path=group_path)
+        self.allocatable[dev.name] = dev
+        return dev
+
     def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
         try:
             self.sharing.clear(claim_uid, tuple(d.chip_indices))
+            dev = self.allocatable.get(d.name)
+            if isinstance(dev, VfioDevice):
+                # Return the function to the accel driver (vfio-device.go
+                # unbind path); no-op when it was never vfio-bound.
+                self.vfio.unbind_from_vfio(dev.chip.pci_address)
         except Exception:  # noqa: BLE001 — rollback is best effort
             log.exception("rollback of %s for claim %s failed", d.name, claim_uid)
 

@@ -80,14 +80,18 @@ class VfioPciManager:
             time.sleep(0.2)
         raise VfioError(f"{dev_path} still busy after {timeout_s}s")
 
-    def bind_to_vfio(self, pci_address: str) -> str:
+    def bind_to_vfio(self, pci_address: str, dev_path: Optional[str] = None) -> str:
         """Unbind from the current driver, bind to vfio-pci; returns the
-        /dev/vfio/<group> path."""
+        /dev/vfio/<group> path. When dev_path is given, waits for the accel
+        node to be free first so a running workload isn't yanked off the
+        device (reference vfio-device.go:85-116)."""
         cur = self.current_driver(pci_address)
         if cur == VFIO_PCI_DRIVER:
             group = self.iommu_group(pci_address)
             return os.path.join(self.dev_root, "vfio", group)
         if cur:
+            if dev_path:
+                self.wait_device_free(dev_path)
             self._write(
                 os.path.join(self._driver_link(pci_address), "unbind"), pci_address
             )

@@ -1,0 +1,103 @@
+"""Plugin healthcheck service: live-probe semantics, HTTP surface, unknown
+service handling (reference cmd/gpu-kubelet-plugin/health.go:39-148)."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.health import Healthcheck
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+
+@pytest.fixture
+def driver(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+    d = TpuDriver(
+        api=APIServer(),
+        node_name="node-0",
+        tpulib=MockTpuLib("v5e-4"),
+        plugin_dir=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.FeatureGates(),
+    )
+    d.start()
+    yield d
+    d.shutdown()
+
+
+def test_check_serving_after_start(driver):
+    hc = Healthcheck(driver)
+    assert hc.check() == "SERVING"
+    assert hc.check("liveness") == "SERVING"
+
+
+def test_check_unknown_service_raises(driver):
+    hc = Healthcheck(driver)
+    with pytest.raises(KeyError):
+        hc.check("no-such-service")
+
+
+def test_check_not_serving_after_shutdown(driver):
+    hc = Healthcheck(driver)
+    driver.shutdown()
+    assert hc.check() == "NOT_SERVING"
+
+
+def test_check_not_serving_when_probe_raises(driver):
+    class Wedged:
+        def prepare_resource_claims(self, claims):
+            raise RuntimeError("serving loop wedged")
+
+        def healthy(self):
+            return True
+
+    assert Healthcheck(Wedged()).check() == "NOT_SERVING"
+
+
+def test_http_endpoints(driver):
+    hc = Healthcheck(driver)
+    hc.start()
+    try:
+        base = f"http://127.0.0.1:{hc.port}"
+        with urllib.request.urlopen(f"{base}/healthz") as resp:
+            assert resp.status == 200
+            assert resp.read().strip() == b"SERVING"
+        with urllib.request.urlopen(f"{base}/healthz/liveness") as resp:
+            assert resp.status == 200
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz/bogus")
+        assert exc.value.code == 404
+
+        driver.shutdown()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert exc.value.code == 503
+    finally:
+        hc.stop()
+
+
+def test_compute_domain_driver_healthy_flag(tmp_path, monkeypatch):
+    from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
+
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+    d = ComputeDomainDriver(
+        api=APIServer(),
+        node_name="node-0",
+        tpulib=MockTpuLib("v5e-4"),
+        plugin_dir=str(tmp_path / "cd-plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+    )
+    assert not d.healthy()  # not started yet
+    d.start()
+    assert Healthcheck(d).check() == "SERVING"
+    d.shutdown()
+    assert Healthcheck(d).check() == "NOT_SERVING"

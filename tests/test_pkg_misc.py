@@ -114,30 +114,44 @@ def _vfio_fixture(tmp_path, driver="tpu-accel"):
     return pci, str(sysfs), str(dev)
 
 
-def test_vfio_bind_unbind_flow(tmp_path):
+def test_vfio_bind_writes_rebind_sequence(tmp_path):
     pci, sysfs, dev = _vfio_fixture(tmp_path)
     mgr = VfioPciManager(sysfs_root=sysfs, dev_root=dev)
     assert mgr.current_driver(pci) == "tpu-accel"
     assert mgr.iommu_group(pci) == "7"
-    # The fixture can't emulate the kernel's rebind side effects; bind will
-    # write unbind/driver_override/drivers_probe and then read the (still
-    # symlinked) driver. Simulate the kernel by flipping the symlink.
-    path = mgr.bind_to_vfio.__name__  # exercise writes
-    import os as _os
 
-    devdir = os.path.join(sysfs, "bus", "pci", "devices", pci)
-    _os.remove(os.path.join(devdir, "driver"))
-    vfio_drv = os.path.join(sysfs, "bus", "pci", "drivers", "vfio-pci")
-    _os.makedirs(vfio_drv, exist_ok=True)
-    _os.symlink(vfio_drv, os.path.join(devdir, "driver"))
     group_path = mgr.bind_to_vfio(pci)
     assert group_path == os.path.join(dev, "vfio", "7")
-    # Unbind: flip back.
-    _os.remove(os.path.join(devdir, "driver"))
-    tpu_drv = os.path.join(sysfs, "bus", "pci", "drivers", "tpu-accel")
-    _os.symlink(tpu_drv, os.path.join(devdir, "driver"))
-    mgr.unbind_from_vfio(pci)  # idempotent when not vfio-bound
-    assert path == "bind_to_vfio"
+    # The real rebind sequence must have been written to sysfs
+    # (vfio-device.go:235-257): unbind from current driver, override,
+    # re-probe.
+    devdir = os.path.join(sysfs, "bus", "pci", "devices", pci)
+    drvdir = os.path.join(sysfs, "bus", "pci", "drivers", "tpu-accel")
+    with open(os.path.join(drvdir, "unbind")) as f:
+        assert f.read() == pci
+    with open(os.path.join(devdir, "driver_override")) as f:
+        assert f.read() == "vfio-pci"
+    with open(os.path.join(sysfs, "bus", "pci", "drivers_probe")) as f:
+        assert f.read() == pci
+
+    # Simulate the kernel's rebind, then already-bound is a no-op shortcut.
+    os.remove(os.path.join(devdir, "driver"))
+    vfio_drv = os.path.join(sysfs, "bus", "pci", "drivers", "vfio-pci")
+    os.makedirs(vfio_drv, exist_ok=True)
+    os.symlink(vfio_drv, os.path.join(devdir, "driver"))
+    assert mgr.bind_to_vfio(pci) == group_path
+
+    # Unbind: writes vfio-pci unbind + cleared override + re-probe.
+    mgr.unbind_from_vfio(pci)
+    with open(os.path.join(vfio_drv, "unbind")) as f:
+        assert f.read() == pci
+    with open(os.path.join(devdir, "driver_override")) as f:
+        assert f.read() == "\n"
+    # Flip back; a second unbind is the idempotent no-op.
+    os.remove(os.path.join(devdir, "driver"))
+    os.symlink(os.path.join(sysfs, "bus", "pci", "drivers", "tpu-accel"),
+               os.path.join(devdir, "driver"))
+    mgr.unbind_from_vfio(pci)
 
 
 def test_vfio_wait_device_free_missing_is_free(tmp_path):
