@@ -11,9 +11,12 @@ from __future__ import annotations
 
 import logging
 import os
+import shutil
 import signal
+import subprocess
 import sys
 import threading
+import time
 
 from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
 from k8s_dra_driver_tpu.daemon import SliceAgent
@@ -26,6 +29,21 @@ log = logging.getLogger("compute-domain-daemon")
 READY_FILE = "ready"
 
 
+def _find_slice_ctl() -> str:
+    """Locate the native tpu-slice-ctl probe: explicit env, PATH, or the
+    in-repo native build; empty when not built (Python fallback applies)."""
+    explicit = os.environ.get("TPU_SLICE_CTL", "")
+    if explicit:
+        return explicit if os.access(explicit, os.X_OK) else ""
+    found = shutil.which("tpu-slice-ctl")
+    if found:
+        return found
+    local = os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "build", "tpu-slice-ctl"
+    )
+    return os.path.abspath(local) if os.access(local, os.X_OK) else ""
+
+
 def main(argv=None) -> int:
     parser = flagpkg.build_parser(
         "compute-domain-daemon", "per-domain slice agent",
@@ -35,6 +53,9 @@ def main(argv=None) -> int:
     parser.add_argument("command", nargs="?", default="run", choices=("run", "check"))
     parser.add_argument("--workdir", default=os.environ.get("SLICE_AGENT_WORKDIR",
                                                             "/var/run/tpu-slice-agent"))
+    parser.add_argument("--stale-seconds", type=int,
+                        default=int(os.environ.get("SLICE_READY_STALE_SECONDS", "10")),
+                        help="ready file older than this probes NOT_READY; 0 disables")
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -44,10 +65,27 @@ def main(argv=None) -> int:
 
     if args.command == "check":
         # Probe the running agent via its ready file (written by run loop).
+        # Prefer the native tpu-slice-ctl when built (the nvidia-imex-ctl
+        # analog); same semantics in the Python fallback: READY content AND
+        # a fresh mtime — a dead run loop's leftover file is NOT_READY.
         path = os.path.join(args.workdir, READY_FILE)
+        ctl = _find_slice_ctl()
+        if ctl:
+            proc = subprocess.run(
+                [ctl, "-q", "-f", path, "-t", str(args.stale_seconds)],
+                capture_output=True, text=True, timeout=10, check=False,
+            )
+            sys.stdout.write(proc.stdout)
+            return proc.returncode
+        ready = False
         try:
+            st = os.stat(path)
+            fresh = (
+                args.stale_seconds <= 0
+                or time.time() - st.st_mtime <= args.stale_seconds
+            )
             with open(path, "r", encoding="utf-8") as f:
-                ready = f.read().strip() == "READY"
+                ready = fresh and f.read().strip() == "READY"
         except OSError:
             ready = False
         print("READY" if ready else "NOT_READY")

@@ -83,6 +83,7 @@ def daemon_set_for_domain(cd: ComputeDomain, driver_namespace: str) -> DaemonSet
                     name="slice-agent",
                     image="tpu-dra-driver:latest",
                     command=["compute-domain-daemon"],
+                    readiness_probe=["compute-domain-daemon", "check"],
                     env={
                         "COMPUTE_DOMAIN_UUID": cd.uid,
                         "COMPUTE_DOMAIN_NAMESPACE": cd.namespace,

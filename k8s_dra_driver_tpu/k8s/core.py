@@ -170,6 +170,10 @@ class Container:
     image: str = ""
     env: Dict[str, str] = field(default_factory=dict)
     command: List[str] = field(default_factory=list)
+    # Exec readiness probe command; the sim's probe loop honors agent state,
+    # this records the manifest-level probe (reference
+    # templates/compute-domain-daemon.tmpl.yaml:75-100).
+    readiness_probe: List[str] = field(default_factory=list)
 
 
 @dataclass

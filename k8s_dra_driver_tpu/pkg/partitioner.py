@@ -12,7 +12,10 @@ reference's are.
 
 from __future__ import annotations
 
+import ctypes
+import json
 import logging
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Tuple
@@ -57,6 +60,108 @@ class StubPartitionClient:
         self.active.pop(partition.id, None)
 
 
+_TPUPART_CANDIDATES = (
+    os.environ.get("TPUPART_LIBRARY_PATH", ""),
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "build",
+                 "libtpupart.so"),
+    "/usr/local/lib/libtpupart.so",
+    "libtpupart.so",
+)
+
+
+def load_tpupart(path: Optional[str] = None) -> Optional["ctypes.CDLL"]:
+    """dlopen the native partitioner at an explicit path, the way the
+    reference binds libnvfm (client_nvfm.go:32-44). None when unavailable."""
+    for cand in ((path,) if path else _TPUPART_CANDIDATES):
+        if not cand:
+            continue
+        try:
+            lib = ctypes.CDLL(
+                os.path.abspath(cand) if os.path.sep in cand else cand
+            )
+        except OSError:
+            continue
+        lib.tpupart_version.restype = ctypes.c_char_p
+        for fn in (lib.tpupart_supported, lib.tpupart_active):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tpupart_activate.restype = ctypes.c_int
+        lib.tpupart_activate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tpupart_deactivate.restype = ctypes.c_int
+        lib.tpupart_deactivate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        return lib
+    return None
+
+
+class NativePartitionClient:
+    """PartitionClient backed by the C++ tpupart library: partition legality
+    is recomputed natively and the activation ledger lives on disk (flock'd,
+    atomic-rename), so it survives plugin restarts and is shared across
+    processes — the role the Fabric Manager service plays for the reference
+    (pkg/fabricmanager/client_nvfm.go:46-135)."""
+
+    def __init__(self, host_topology: str, state_path: str,
+                 lib_path: Optional[str] = None):
+        lib = load_tpupart(lib_path)
+        if lib is None:
+            raise PartitionError("libtpupart.so not found; build native/ first")
+        self._lib = lib
+        self._topology = host_topology.encode()
+        self._state = state_path.encode()
+        os.makedirs(os.path.dirname(state_path) or ".", exist_ok=True)
+
+    def _call_json(self, fn, *args) -> dict:
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            rc = fn(*args, buf, cap)
+            if rc >= 0:
+                return json.loads(buf.value.decode())
+            if rc == -1:
+                try:
+                    raise PartitionError(json.loads(buf.value.decode())["error"])
+                except (ValueError, KeyError):
+                    raise PartitionError("native partitioner error") from None
+            cap = -rc  # buffer too small; need is -(rc)-1 + NUL
+
+    def supported(self) -> List[Partition]:
+        doc = self._call_json(self._lib.tpupart_supported, self._topology)
+        return [
+            Partition(id=p["id"], profile=p["profile"],
+                      chip_indices=tuple(p["chips"]))
+            for p in doc["partitions"]
+        ]
+
+    def activate(self, partition: Partition) -> None:
+        err = ctypes.create_string_buffer(512)
+        rc = self._lib.tpupart_activate(
+            self._state, self._topology, partition.id.encode(), err, len(err)
+        )
+        if rc != 0:
+            try:
+                msg = json.loads(err.value.decode())["error"]
+            except (ValueError, KeyError):
+                msg = "activate failed"
+            raise PartitionError(f"{partition.id}: {msg}")
+
+    def deactivate(self, partition: Partition) -> None:
+        err = ctypes.create_string_buffer(512)
+        rc = self._lib.tpupart_deactivate(
+            self._state, partition.id.encode(), err, len(err)
+        )
+        if rc != 0:
+            raise PartitionError(f"{partition.id}: deactivate failed")
+
+    def active_ids(self) -> List[str]:
+        doc = self._call_json(self._lib.tpupart_active, self._state)
+        return list(doc["active"])
+
+
 class PartitionManager:
     """Caches supported partitions for a host topology; activates and
     deactivates idempotently; refuses overlapping activations (two active
@@ -72,6 +177,13 @@ class PartitionManager:
             for pl in prof.placements:
                 p = self._from_placement(pl)
                 self._supported[p.id] = p
+        # A client with a persistent ledger (NativePartitionClient) seeds the
+        # active set across restarts, like the reference reading partition
+        # state back from the FM service (manager.go:96-130).
+        if hasattr(self.client, "active_ids"):
+            for pid in self.client.active_ids():
+                if pid in self._supported:
+                    self._active[pid] = self._supported[pid]
 
     @staticmethod
     def _from_placement(pl: SubslicePlacement) -> Partition:

@@ -1,0 +1,311 @@
+// tpupart — native ICI mesh partitioner.
+//
+// The TPU-native counterpart of the reference's cgo->libnvfm boundary
+// (/root/reference/pkg/fabricmanager/client_nvfm.go:32-135): the component
+// that owns partition state for passthrough device groups. NVSwitch has a
+// fabric-manager service to program; an ICI mesh has no switch, so the
+// native library's job is (a) computing the legal axis-aligned subslice
+// partitions of a host topology — the same rule as the Python mock
+// (k8s_dra_driver_tpu/tpulib/profiles.py compute_subslice_profiles): every
+// dim of the block divides the host dim, placements tile at fixed offsets —
+// and (b) holding the activation ledger crash-safely on disk (flock'd
+// read-modify-write, temp+rename+fsync), enforcing that two active
+// partitions never share a chip, idempotently like the reference's
+// Activate/Deactivate (manager.go:215-255).
+//
+// ABI matches tpulib.cc: JSON into a caller buffer; bytes written on
+// success, -(need+1) when the buffer is too small, TPUPART_ERR (-1) with an
+// {"error":...} body for hard errors.
+
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "tpupart 0.1.0";
+constexpr int TPUPART_ERR = -1;
+constexpr int kMaxDims = 3;
+
+struct Partition {
+  std::string id;       // "1x2-at-0x0"
+  std::string profile;  // "1x2"
+  std::vector<int> chips;
+};
+
+bool ParseTopology(const char* s, std::vector<int>* dims) {
+  dims->clear();
+  if (s == nullptr || *s == '\0') return false;
+  int cur = 0;
+  bool have_digit = false;
+  for (const char* p = s;; ++p) {
+    if (*p >= '0' && *p <= '9') {
+      cur = cur * 10 + (*p - '0');
+      have_digit = true;
+    } else if (*p == 'x' || *p == '\0') {
+      if (!have_digit || cur <= 0) return false;
+      dims->push_back(cur);
+      cur = 0;
+      have_digit = false;
+      if (*p == '\0') break;
+    } else {
+      return false;
+    }
+  }
+  return !dims->empty() && dims->size() <= kMaxDims;
+}
+
+std::string FormatShape(const std::vector<int>& shape) {
+  std::string out;
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) out += "x";
+    out += std::to_string(shape[i]);
+  }
+  return out;
+}
+
+// Row-major index of a coordinate: last dim fastest (the order Python's
+// itertools.product enumerates host_chip_coords in).
+int IndexOf(const std::vector<int>& dims, const std::vector<int>& coord) {
+  int idx = 0;
+  for (size_t i = 0; i < dims.size(); ++i) idx = idx * dims[i] + coord[i];
+  return idx;
+}
+
+// Enumerate every divisor tuple of dims except dims itself, and for each,
+// all placements at step-aligned origins.
+std::vector<Partition> SupportedPartitions(const std::vector<int>& dims) {
+  std::vector<Partition> out;
+  std::vector<std::vector<int>> divs(dims.size());
+  for (size_t i = 0; i < dims.size(); ++i)
+    for (int d = 1; d <= dims[i]; ++d)
+      if (dims[i] % d == 0) divs[i].push_back(d);
+
+  std::vector<size_t> pick(dims.size(), 0);
+  for (;;) {
+    std::vector<int> shape(dims.size());
+    for (size_t i = 0; i < dims.size(); ++i) shape[i] = divs[i][pick[i]];
+    if (shape != dims) {
+      std::string profile = FormatShape(shape);
+      // Walk origins: each axis steps by the shape's extent.
+      std::vector<int> origin(dims.size(), 0);
+      for (;;) {
+        Partition p;
+        p.profile = profile;
+        p.id = profile + "-at-" + FormatShape(origin);
+        // Cells of the block, row-major.
+        std::vector<int> cell(origin);
+        for (;;) {
+          p.chips.push_back(IndexOf(dims, cell));
+          int axis = static_cast<int>(dims.size()) - 1;
+          for (; axis >= 0; --axis) {
+            if (++cell[axis] < origin[axis] + shape[axis]) break;
+            cell[axis] = origin[axis];
+          }
+          if (axis < 0) break;
+        }
+        std::sort(p.chips.begin(), p.chips.end());
+        out.push_back(std::move(p));
+        int axis = static_cast<int>(dims.size()) - 1;
+        for (; axis >= 0; --axis) {
+          origin[axis] += shape[axis];
+          if (origin[axis] < dims[axis]) break;
+          origin[axis] = 0;
+        }
+        if (axis < 0) break;
+      }
+    }
+    int axis = static_cast<int>(dims.size()) - 1;
+    for (; axis >= 0; --axis) {
+      if (++pick[axis] < divs[axis].size()) break;
+      pick[axis] = 0;
+    }
+    if (axis < 0) break;
+  }
+  return out;
+}
+
+const Partition* FindPartition(const std::vector<Partition>& all, const char* id) {
+  for (const Partition& p : all)
+    if (p.id == id) return &p;
+  return nullptr;
+}
+
+int WriteOut(const std::string& s, char* out, int cap) {
+  int need = static_cast<int>(s.size());
+  if (out == nullptr || cap <= need) return -(need + 1);
+  std::memcpy(out, s.c_str(), need + 1);
+  return need;
+}
+
+int WriteErr(const std::string& msg, char* out, int cap) {
+  std::string body = "{\"error\":\"" + msg + "\"}";
+  if (out != nullptr && cap > static_cast<int>(body.size()))
+    std::memcpy(out, body.c_str(), body.size() + 1);
+  return TPUPART_ERR;
+}
+
+// ---- activation ledger ------------------------------------------------------
+//
+// One active partition id per line. All mutation is flock(LOCK_EX) on a
+// sidecar .lock file + read, modify, write-to-temp, fsync, rename — the
+// crash-safety discipline of the plugin checkpoint (reference
+// device_state.go:771-805) applied to fabric state.
+
+class Ledger {
+ public:
+  explicit Ledger(const std::string& path) : path_(path), lock_fd_(-1) {}
+  ~Ledger() { Unlock(); }
+
+  bool Lock() {
+    lock_fd_ = ::open((path_ + ".lock").c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+    if (lock_fd_ < 0) return false;
+    if (::flock(lock_fd_, LOCK_EX) != 0) {
+      ::close(lock_fd_);
+      lock_fd_ = -1;
+      return false;
+    }
+    return true;
+  }
+
+  void Unlock() {
+    if (lock_fd_ >= 0) {
+      ::flock(lock_fd_, LOCK_UN);
+      ::close(lock_fd_);
+      lock_fd_ = -1;
+    }
+  }
+
+  std::set<std::string> Read() const {
+    std::set<std::string> ids;
+    FILE* f = std::fopen(path_.c_str(), "re");
+    if (!f) return ids;
+    char line[256];
+    while (std::fgets(line, sizeof(line), f)) {
+      std::string s(line);
+      while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
+      if (!s.empty()) ids.insert(s);
+    }
+    std::fclose(f);
+    return ids;
+  }
+
+  bool Write(const std::set<std::string>& ids) const {
+    std::string tmp = path_ + ".tmp";
+    int fd = ::open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC | O_CLOEXEC, 0644);
+    if (fd < 0) return false;
+    std::string body;
+    for (const std::string& id : ids) body += id + "\n";
+    ssize_t n = ::write(fd, body.data(), body.size());
+    bool ok = n == static_cast<ssize_t>(body.size()) && ::fsync(fd) == 0;
+    ::close(fd);
+    if (!ok) {
+      ::unlink(tmp.c_str());
+      return false;
+    }
+    return ::rename(tmp.c_str(), path_.c_str()) == 0;
+  }
+
+ private:
+  std::string path_;
+  int lock_fd_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* tpupart_version() { return kVersion; }
+
+// All legal partitions of a host topology.
+// JSON: {"partitions":[{"id":..,"profile":..,"chips":[..]},...]}
+int tpupart_supported(const char* topology, char* out, int cap) {
+  std::vector<int> dims;
+  if (!ParseTopology(topology, &dims)) return WriteErr("bad topology", out, cap);
+  std::vector<Partition> all = SupportedPartitions(dims);
+  std::string json = "{\"partitions\":[";
+  for (size_t i = 0; i < all.size(); ++i) {
+    const Partition& p = all[i];
+    if (i) json += ",";
+    json += "{\"id\":\"" + p.id + "\",\"profile\":\"" + p.profile + "\",\"chips\":[";
+    for (size_t j = 0; j < p.chips.size(); ++j) {
+      if (j) json += ",";
+      json += std::to_string(p.chips[j]);
+    }
+    json += "]}";
+  }
+  json += "]}";
+  return WriteOut(json, out, cap);
+}
+
+// Activate a partition: records it in the ledger at state_path. Idempotent.
+// Returns 0 on success; TPUPART_ERR with {"error":...} for unknown id,
+// chip overlap with an already-active partition, or ledger IO failure.
+int tpupart_activate(const char* state_path, const char* topology,
+                     const char* partition_id, char* err, int errcap) {
+  std::vector<int> dims;
+  if (state_path == nullptr || partition_id == nullptr)
+    return WriteErr("null arg", err, errcap);
+  if (!ParseTopology(topology, &dims)) return WriteErr("bad topology", err, errcap);
+  std::vector<Partition> all = SupportedPartitions(dims);
+  const Partition* want = FindPartition(all, partition_id);
+  if (want == nullptr) return WriteErr("unsupported partition", err, errcap);
+
+  Ledger ledger(state_path);
+  if (!ledger.Lock()) return WriteErr("ledger lock failed", err, errcap);
+  std::set<std::string> active = ledger.Read();
+  if (active.count(partition_id)) return 0;  // idempotent
+
+  std::set<int> held;
+  for (const std::string& id : active) {
+    const Partition* p = FindPartition(all, id.c_str());
+    if (p != nullptr) held.insert(p->chips.begin(), p->chips.end());
+  }
+  for (int c : want->chips) {
+    if (held.count(c)) return WriteErr("chip overlap with active partition", err, errcap);
+  }
+  active.insert(partition_id);
+  if (!ledger.Write(active)) return WriteErr("ledger write failed", err, errcap);
+  return 0;
+}
+
+// Deactivate: removes from the ledger. Idempotent; 0 unless IO fails.
+int tpupart_deactivate(const char* state_path, const char* partition_id,
+                       char* err, int errcap) {
+  if (state_path == nullptr || partition_id == nullptr)
+    return WriteErr("null arg", err, errcap);
+  Ledger ledger(state_path);
+  if (!ledger.Lock()) return WriteErr("ledger lock failed", err, errcap);
+  std::set<std::string> active = ledger.Read();
+  if (active.erase(partition_id) == 0) return 0;  // idempotent
+  if (!ledger.Write(active)) return WriteErr("ledger write failed", err, errcap);
+  return 0;
+}
+
+// Currently-active partition ids. JSON: {"active":["id",...]}
+int tpupart_active(const char* state_path, char* out, int cap) {
+  if (state_path == nullptr) return WriteErr("null arg", out, cap);
+  Ledger ledger(state_path);
+  if (!ledger.Lock()) return WriteErr("ledger lock failed", out, cap);
+  std::set<std::string> active = ledger.Read();
+  std::string json = "{\"active\":[";
+  bool first = true;
+  for (const std::string& id : active) {
+    if (!first) json += ",";
+    first = false;
+    json += "\"" + id + "\"";
+  }
+  json += "]}";
+  return WriteOut(json, out, cap);
+}
+
+}  // extern "C"

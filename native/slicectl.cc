@@ -1,0 +1,86 @@
+// tpu-slice-ctl — native readiness probe for the per-domain slice agent.
+//
+// The nvidia-imex-ctl analog: the reference daemon's exec probe shells out
+// to `nvidia-imex-ctl -q` and treats exactly "READY\n" as ready
+// (/root/reference/cmd/compute-domain-daemon/main.go:433-459). Here the
+// slice agent's run loop rewrites a status file every tick, so the probe
+// checks BOTH the content and the write's freshness — a wedged or dead run
+// loop leaves a stale file behind, which must probe NOT_READY even if the
+// last written word was READY.
+//
+// Usage: tpu-slice-ctl -q [-f <status-file>] [-t <stale-seconds>]
+//   -q   query (required; mirrors imex-ctl)
+//   -f   status file (default $SLICE_AGENT_WORKDIR/ready, else
+//        /var/run/tpu-slice-agent/ready)
+//   -t   freshness window in seconds (default 10; 0 disables)
+// Prints READY or NOT_READY; exit 0 iff READY.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr const char* kDefaultDir = "/var/run/tpu-slice-agent";
+constexpr int kDefaultStaleS = 10;
+
+int NotReady() {
+  std::puts("NOT_READY");
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string file;
+  int stale_s = kDefaultStaleS;
+  bool query = false;
+
+  const char* workdir = std::getenv("SLICE_AGENT_WORKDIR");
+  file = std::string(workdir != nullptr ? workdir : kDefaultDir) + "/ready";
+
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-q") == 0) {
+      query = true;
+    } else if (std::strcmp(argv[i], "-f") == 0 && i + 1 < argc) {
+      file = argv[++i];
+    } else if (std::strcmp(argv[i], "-t") == 0 && i + 1 < argc) {
+      stale_s = std::atoi(argv[++i]);
+    } else {
+      std::fprintf(stderr,
+                   "usage: tpu-slice-ctl -q [-f status-file] [-t stale-seconds]\n");
+      return 2;
+    }
+  }
+  if (!query) {
+    std::fprintf(stderr,
+                 "usage: tpu-slice-ctl -q [-f status-file] [-t stale-seconds]\n");
+    return 2;
+  }
+
+  struct stat st;
+  if (::stat(file.c_str(), &st) != 0) return NotReady();
+  if (stale_s > 0) {
+    std::time_t now = std::time(nullptr);
+    if (now - st.st_mtime > stale_s) return NotReady();
+  }
+
+  FILE* f = std::fopen(file.c_str(), "re");
+  if (f == nullptr) return NotReady();
+  char buf[64];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  // Trim trailing whitespace/newline.
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r' || buf[n - 1] == ' '))
+    buf[--n] = '\0';
+
+  if (std::strcmp(buf, "READY") != 0) return NotReady();
+  std::puts("READY");
+  return 0;
+}

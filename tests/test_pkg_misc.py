@@ -209,3 +209,41 @@ def test_daemon_check_not_ready(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out.returncode == 0 and "READY" in out.stdout
+    # A stale READY (dead run loop's leftover) probes NOT_READY.
+    old = time.time() - 120
+    os.utime(tmp_path / "ready", (old, old))
+    out = subprocess.run(
+        [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.compute_domain_daemon",
+         "check", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 1 and "NOT_READY" in out.stdout
+
+
+@pytest.mark.skipif(
+    not os.access(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "native", "build", "tpu-slice-ctl"), os.X_OK),
+    reason="tpu-slice-ctl not built (cmake native/)",
+)
+def test_native_slice_ctl_probe(tmp_path):
+    ctl = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "native", "build", "tpu-slice-ctl")
+    ready = tmp_path / "ready"
+    out = subprocess.run([ctl, "-q", "-f", str(ready)],
+                         capture_output=True, text=True, timeout=10)
+    assert out.returncode == 1 and out.stdout.strip() == "NOT_READY"
+    ready.write_text("READY")
+    out = subprocess.run([ctl, "-q", "-f", str(ready)],
+                         capture_output=True, text=True, timeout=10)
+    assert out.returncode == 0 and out.stdout.strip() == "READY"
+    old = time.time() - 120
+    os.utime(ready, (old, old))
+    out = subprocess.run([ctl, "-q", "-f", str(ready)],
+                         capture_output=True, text=True, timeout=10)
+    assert out.returncode == 1 and out.stdout.strip() == "NOT_READY"
+    # -t 0 disables the freshness window.
+    out = subprocess.run([ctl, "-q", "-f", str(ready), "-t", "0"],
+                         capture_output=True, text=True, timeout=10)
+    assert out.returncode == 0 and out.stdout.strip() == "READY"
