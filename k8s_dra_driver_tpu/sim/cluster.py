@@ -158,6 +158,16 @@ class SimCluster:
             if all(p.phase in ("Running", "Failed") for p in pods):
                 return
 
+    def wait_for(self, predicate, max_steps: int = 20) -> bool:
+        """Step until predicate(self) holds. Pod phases settling does not
+        imply the controllers' status writes have converged (they may trail
+        by a pass), so status assertions should use this, not settle()."""
+        for _ in range(max_steps):
+            if predicate(self):
+                return True
+            self.step()
+        return predicate(self)
+
     # -- DaemonSet controller ----------------------------------------------------
 
     def _daemonset_pass(self) -> None:

@@ -167,3 +167,142 @@ def test_shared_claim_survives_first_pod_deletion(tmp_path):
         assert claim.uid not in node.tpu_driver.state.prepared_claims()
     finally:
         sim.stop()
+
+
+def test_daemon_pod_restart_preserves_domain(tmp_path):
+    """Slice-agent pod killed mid-domain: the DaemonSet recreates it, the
+    agent re-registers into the clique, the domain returns Ready and the
+    running workers are untouched (reference test_cd_failover.bats)."""
+    import os
+
+    from k8s_dra_driver_tpu.e2e import SPECS_DIR
+    from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN
+    from k8s_dra_driver_tpu.sim.cluster import DRIVER_NAMESPACE
+    from k8s_dra_driver_tpu.sim.kubectl import apply_file
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16")
+    sim.start()
+    try:
+        apply_file(sim.api, os.path.join(SPECS_DIR, "computedomain/cd-multi-host.yaml"))
+        sim.settle()
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+        assert cd.status.status == "Ready"
+        workers = [p for p in sim.api.list(POD, namespace="cd-multi")
+                   if p.meta.name.startswith("worker-")]
+        assert all(p.phase == "Running" for p in workers)
+        env_before = {p.meta.name: dict(p.injected_env) for p in workers}
+
+        # Kill each node's agent pod in turn — the coordinator-owning agent
+        # (index 0) included — so no victim choice hides a failover bug.
+        for victim_node in sorted(p.node_name for p in workers):
+            agent_pod = next(
+                p for p in sim.api.list(POD, namespace=DRIVER_NAMESPACE)
+                if p.node_name == victim_node
+            )
+            index_before = sim.nodes[victim_node].agents[agent_pod.meta.name].index
+            sim.delete_pod(agent_pod.meta.name, DRIVER_NAMESPACE)
+            sim.settle()
+
+            # DaemonSet recreated the pod; agent re-registered with its index.
+            recreated = next(
+                p for p in sim.api.list(POD, namespace=DRIVER_NAMESPACE)
+                if p.node_name == victim_node
+            )
+            assert recreated.ready, f"agent on {victim_node} not ready after restart"
+            assert sim.nodes[victim_node].agents[recreated.meta.name].index == index_before
+            # Status trails pod readiness by a controller pass; wait bounded.
+            assert sim.wait_for(
+                lambda s: s.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+                .status.status == "Ready"
+            ), f"CD never Ready after {victim_node} restart"
+            for p in sim.api.list(POD, namespace="cd-multi"):
+                if p.meta.name.startswith("worker-"):
+                    assert p.phase == "Running"
+                    assert p.injected_env == env_before[p.meta.name]
+    finally:
+        sim.stop()
+
+
+def test_health_taint_blocks_scheduling_until_healed(tmp_path):
+    """Unhealthy chip -> device taint -> new claims unschedulable on that
+    host; heal -> schedulable (reference device_health.go -> taints chain,
+    here driven end-to-end through the scheduler)."""
+    from k8s_dra_driver_tpu.tpulib import ChipHealth
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4",
+                     gates="TPUDeviceHealthCheck=true")
+    sim.start()
+    try:
+        sim.nodes["tpu-node-0"].tpulib.set_health(0, ChipHealth.UNHEALTHY)
+        # count: 4 needs every chip; the tainted one makes it unsatisfiable
+        # (allocationMode: All would just shrink to the untainted three).
+        manifest = """
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, count: 4}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: wants-all, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle(max_steps=6)
+        assert sim.api.get(POD, "wants-all", "default").phase == "Pending"
+
+        sim.nodes["tpu-node-0"].tpulib.set_health(0, ChipHealth.HEALTHY)
+        sim.settle(max_steps=6)
+        assert sim.api.get(POD, "wants-all", "default").phase == "Running"
+    finally:
+        sim.stop()
+
+
+def test_claim_churn_leaves_no_state_behind(tmp_path):
+    """Repeated create/delete cycles (reference test_gpu_stress.bats): after
+    the last delete no checkpoint entries, CDI spec files, or claims leak."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        manifest = """
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: pair, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, count: 2}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        pod_manifest = """
+apiVersion: v1
+kind: Pod
+metadata: {name: churn, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: pair}]
+"""
+        for _ in range(5):
+            for obj in load_manifests(pod_manifest):
+                sim.api.create(obj)
+            sim.settle(max_steps=6)
+            assert sim.api.get(POD, "churn", "default").phase == "Running"
+            sim.delete_pod("churn", "default")
+
+        import os
+
+        assert sim.api.list(RESOURCE_CLAIM, namespace="default") == []
+        for node in sim.nodes.values():
+            assert node.tpu_driver.state.prepared_claims() == {}
+            cdi_root = node.tpu_driver.state.cdi.cdi_root
+            leftover = os.listdir(cdi_root) if os.path.isdir(cdi_root) else []
+            assert leftover == [], f"leaked CDI specs: {leftover}"
+    finally:
+        sim.stop()
