@@ -191,10 +191,28 @@ class VfioTpuConfig(DeviceConfig):
 @dataclass
 class ComputeDomainChannelConfig(DeviceConfig):
     domain_id: str = ""  # uid of the ComputeDomain this channel belongs to
+    # Which slice channel this claim binds (checkpointed; at most one claim
+    # may hold a channel id per node — the assertImexChannelNotAllocated
+    # analog, reference device_state.go:878-906).
+    channel_id: int = 0
+    # "All" CDI-injects every channel char device up to the plugin's
+    # max-channel-count (the reference's AllocationMode: All,
+    # device_state.go:690-733); "Single" injects only channel_id.
+    allocation_mode: str = "All"
 
     def validate(self) -> None:
         if not self.domain_id:
             raise ValidationError("domain_id is required")
+        if isinstance(self.channel_id, bool) or not isinstance(self.channel_id, int):
+            raise ValidationError(
+                f"channel_id must be an integer, got {self.channel_id!r}"
+            )
+        if self.channel_id < 0:
+            raise ValidationError("channel_id must be >= 0")
+        if self.allocation_mode not in ("All", "Single"):
+            raise ValidationError(
+                f"allocation_mode must be All or Single, got {self.allocation_mode!r}"
+            )
 
 
 @dataclass

@@ -27,6 +27,10 @@ DEFAULT_CDI_ROOT = "/var/run/cdi"
 @dataclass
 class ContainerEdits:
     device_nodes: List[str] = field(default_factory=list)   # host paths
+    # Structured char devices the runtime must mknod (path/type/major/minor),
+    # e.g. slice channels — the reference carries these for IMEX channels
+    # (cmd/compute-domain-kubelet-plugin/device_state.go:722-731).
+    char_devices: List[Dict[str, object]] = field(default_factory=list)
     env: Dict[str, str] = field(default_factory=dict)
     mounts: List[Dict[str, str]] = field(default_factory=list)  # {host_path, container_path, [options]}
     hooks: List[Dict[str, object]] = field(default_factory=list)
@@ -34,6 +38,7 @@ class ContainerEdits:
     def merged(self, other: "ContainerEdits") -> "ContainerEdits":
         return ContainerEdits(
             device_nodes=[*self.device_nodes, *other.device_nodes],
+            char_devices=[*self.char_devices, *other.char_devices],
             env={**self.env, **other.env},
             mounts=[*self.mounts, *other.mounts],
             hooks=[*self.hooks, *other.hooks],
@@ -41,8 +46,10 @@ class ContainerEdits:
 
     def to_cdi(self) -> dict:
         out: dict = {}
-        if self.device_nodes:
-            out["deviceNodes"] = [{"path": p} for p in self.device_nodes]
+        if self.device_nodes or self.char_devices:
+            out["deviceNodes"] = [{"path": p} for p in self.device_nodes] + [
+                dict(d) for d in self.char_devices
+            ]
         if self.env:
             out["env"] = [f"{k}={v}" for k, v in sorted(self.env.items())]
         if self.mounts:

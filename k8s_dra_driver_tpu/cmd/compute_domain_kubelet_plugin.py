@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import socket
 import sys
@@ -11,7 +12,10 @@ import threading
 from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
 from k8s_dra_driver_tpu.pkg import flags as flagpkg
 from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
-from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
+from k8s_dra_driver_tpu.plugins.computedomain.driver import (
+    DEFAULT_MAX_CHANNEL_COUNT,
+    ComputeDomainDriver,
+)
 from k8s_dra_driver_tpu.plugins.health import Healthcheck
 from k8s_dra_driver_tpu.tpulib import new_tpulib
 from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
@@ -28,7 +32,22 @@ def main(argv=None) -> int:
     )
     add_api_backend_flag(parser)
     parser.add_argument("--version", action="store_true")
+    try:
+        max_channels_default = int(
+            os.environ.get("MAX_SLICE_CHANNEL_COUNT", DEFAULT_MAX_CHANNEL_COUNT)
+        )
+    except ValueError:
+        max_channels_default = DEFAULT_MAX_CHANNEL_COUNT
+    parser.add_argument(
+        "--max-slice-channel-count",
+        type=int,
+        default=max_channels_default,
+        help="slice channels CDI-injected under AllocationMode All "
+        "(the reference's maxImexChannelCount)",
+    )
     args = parser.parse_args(argv)
+    if args.max_slice_channel_count < 1:
+        parser.error("--max-slice-channel-count must be >= 1")
     if args.version:
         print(version_string("compute-domain-kubelet-plugin"))
         return 0
@@ -43,6 +62,7 @@ def main(argv=None) -> int:
         api=api, node_name=args.node_name or socket.gethostname(),
         tpulib=new_tpulib(), plugin_dir=args.plugin_dir,
         cdi_root=args.cdi_root, gates=gates, metrics_registry=registry,
+        max_channel_count=args.max_slice_channel_count,
     )
     driver.start()
     log.info("%s serving", version_string("compute-domain-kubelet-plugin"))

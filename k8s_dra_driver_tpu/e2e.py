@@ -120,7 +120,10 @@ def check_cd_multi(sim: SimCluster, _pods) -> None:
     nodes = {p.node_name for p in workers}
     _expect(len(nodes) == 4, f"workers must spread over 4 hosts, got {nodes}")
     for p in workers:
-        _expect(len(p.injected_devices) == 4, "each worker holds its whole host")
+        accel = [d for d in p.injected_devices if d.startswith("/dev/accel")]
+        chans = [d for d in p.injected_devices if d.startswith("/dev/tpu-slice-channels/")]
+        _expect(len(accel) == 4, "each worker holds its whole host")
+        _expect(len(chans) > 0, "slice channel char devices injected")
         _expect(p.injected_env.get("TPU_TOPOLOGY") == "4x4", "slice topology")
 
 

@@ -1,0 +1,124 @@
+"""Slice-channel char-device discovery (the nvcaps analog).
+
+The reference discovers IMEX channel char devices by parsing `/proc/devices`
+for the `nvidia-caps-imex-channels` character major and building
+`/dev/nvidia-caps-imex-channels/chan<N>` nodes from it
+(/root/reference/internal/common/nvcaps.go:78-218). The TPU build keeps the
+same shape for its slice channels: the per-slice bootstrap capability handed
+to a workload is a char device `/dev/tpu-slice-channels/chan<N>` whose major
+comes from `/proc/devices` and whose minor is the channel id. CDI carries
+path+type+major+minor so the runtime mknods the node inside the container.
+
+Mock seam (reference precedent `ALT_PROC_DEVICES_PATH`,
+nvcaps.go:33-75): the `TPU_DRA_ALT_PROC_DEVICES` env var redirects the
+`/proc/devices` read so CPU-only CI can fake a channel major without the
+kernel module; `using_alt_proc_devices()` lets callers skip kernel-only
+operations in that mode, exactly like the reference's
+`common.UsingAltProcDevices()` guards.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+# The char-device class name our (hypothetical) kernel facility registers,
+# standing in for `nvidia-caps-imex-channels`.
+CHANNEL_CLASS_NAME = "tpu-slice-channels"
+CHANNEL_DEV_DIR = "/dev/tpu-slice-channels"
+
+ALT_PROC_DEVICES_ENV = "TPU_DRA_ALT_PROC_DEVICES"
+
+_proc_devices_override: Optional[str] = None
+
+
+def configure_proc_devices_path(path: Optional[str]) -> None:
+    """Test hook (reference ConfigureProcDevicesPath, nvcaps.go:60-75)."""
+    global _proc_devices_override
+    _proc_devices_override = path
+
+
+def proc_devices_path() -> str:
+    if _proc_devices_override:
+        return _proc_devices_override
+    return os.environ.get(ALT_PROC_DEVICES_ENV) or "/proc/devices"
+
+
+def using_alt_proc_devices() -> bool:
+    """True when the mock seam is active — kernel-only operations must be
+    skipped (reference UsingAltProcDevices)."""
+    return bool(_proc_devices_override or os.environ.get(ALT_PROC_DEVICES_ENV))
+
+
+def get_char_device_major(class_name: str = CHANNEL_CLASS_NAME) -> Optional[int]:
+    """Parse the `Character devices:` section of /proc/devices for
+    ``class_name``'s major number (nvcaps.go:78-120). Returns None when the
+    class is absent (kernel facility not loaded) or the file is unreadable.
+    """
+    try:
+        with open(proc_devices_path(), "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    in_char = False
+    for line in lines:
+        stripped = line.strip()
+        if stripped == "Character devices:":
+            in_char = True
+            continue
+        if stripped == "Block devices:":
+            in_char = False
+            continue
+        if not in_char or not stripped:
+            continue
+        parts = stripped.split()
+        if len(parts) >= 2 and parts[1] == class_name:
+            try:
+                return int(parts[0])
+            except ValueError:
+                return None
+    return None
+
+
+@dataclass(frozen=True)
+class ChannelDevice:
+    """One slice-channel char device (NVcapDeviceInfo analog)."""
+
+    channel_id: int
+    major: int
+
+    @property
+    def minor(self) -> int:
+        return self.channel_id
+
+    @property
+    def path(self) -> str:
+        return f"{CHANNEL_DEV_DIR}/chan{self.channel_id}"
+
+    def to_cdi_node(self) -> dict:
+        return {
+            "path": self.path,
+            "type": "c",
+            "major": self.major,
+            "minor": self.minor,
+            "permissions": "rw",
+        }
+
+
+def enumerate_channels(
+    count: int, class_name: str = CHANNEL_CLASS_NAME
+) -> List[ChannelDevice]:
+    """Channel devices chan0..chan<count-1>, or [] when the char class is not
+    registered — callers degrade to env-only injection (mockless CI)."""
+    major = get_char_device_major(class_name)
+    if major is None:
+        return []
+    return [ChannelDevice(channel_id=i, major=major) for i in range(count)]
+
+
+def channel_device(channel_id: int) -> Optional[ChannelDevice]:
+    major = get_char_device_major()
+    if major is None:
+        return None
+    return ChannelDevice(channel_id=channel_id, major=major)

@@ -38,6 +38,7 @@ from k8s_dra_driver_tpu.k8s.core import (
     ResourceSlice,
 )
 from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg import devcaps
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
 from k8s_dra_driver_tpu.pkg.flock import Flock
@@ -64,6 +65,9 @@ log = logging.getLogger(__name__)
 CHANNEL_DEVICE = "channel-0"
 DAEMON_DEVICE = "daemon"
 PU_LOCK_TIMEOUT_S = 10.0
+# Channels CDI-injected under AllocationMode All (the reference's
+# maxImexChannelCount, cmd/compute-domain-kubelet-plugin/main.go).
+DEFAULT_MAX_CHANNEL_COUNT = 32
 
 
 class ComputeDomainDriver:
@@ -77,7 +81,9 @@ class ComputeDomainDriver:
         gates: Optional[fg.FeatureGates] = None,
         metrics_registry: Optional[Registry] = None,
         driver_name: str = COMPUTE_DOMAIN_DRIVER_NAME,
+        max_channel_count: int = DEFAULT_MAX_CHANNEL_COUNT,
     ):
+        self.max_channel_count = max_channel_count
         self.api = api
         self.node_name = node_name
         self.driver_name = driver_name
@@ -253,7 +259,7 @@ class ComputeDomainDriver:
                 if isinstance(cfg, ComputeDomainDaemonConfig):
                     prepared = self._prepare_daemon(claim, cfg, devices)
                 elif isinstance(cfg, ComputeDomainChannelConfig):
-                    prepared = self._prepare_channel(claim, cfg, devices)
+                    prepared = self._prepare_channel(claim, cfg, devices, cp)
                 else:
                     raise PermanentError(f"config kind {cfg.kind} not valid here")
             except Exception:
@@ -288,12 +294,57 @@ class ComputeDomainDriver:
             extra={"domain": cfg.domain_id},
         )]
 
+    def _assert_channel_not_allocated(
+        self, cp: Checkpoint, claim_uid: str, channel_id: int
+    ) -> None:
+        """At most one claim may hold a channel id on this node
+        (assertImexChannelNotAllocated, reference device_state.go:878-906).
+        The checkpoint is the allocation source of truth. Entries written
+        before channel ids existed implicitly hold channel 0."""
+        for other_uid, entry in cp.claims.items():
+            if other_uid == claim_uid:
+                continue
+            for d in entry.devices:
+                if d.device_type == "channel" and d.extra.get("channel_id", 0) == channel_id:
+                    raise PermanentError(
+                        f"slice channel {channel_id} is already allocated to "
+                        f"claim {other_uid} on this node"
+                    )
+
+    def _channel_cdi_nodes(self, cfg: ComputeDomainChannelConfig) -> List[dict]:
+        """Char-device nodes to inject: all channels up to max_channel_count
+        (AllocationMode All, device_state.go:690-733) or just the claim's.
+        On a real node a missing kernel channel class is a fault — retry
+        until the facility comes up; only the mock seam (CPU CI,
+        UsingAltProcDevices analog) degrades to env-only bootstrap."""
+        if devcaps.get_char_device_major() is None:
+            if devcaps.using_alt_proc_devices():
+                return []
+            raise RetryableError(
+                f"char device class {devcaps.CHANNEL_CLASS_NAME!r} not registered "
+                "in /proc/devices (kernel facility not up yet?)"
+            )
+        if cfg.allocation_mode == "Single":
+            dev = devcaps.channel_device(cfg.channel_id)
+            return [dev.to_cdi_node()] if dev else []
+        chans = devcaps.enumerate_channels(self.max_channel_count)
+        return [c.to_cdi_node() for c in chans]
+
     def _prepare_channel(
-        self, claim: ResourceClaim, cfg: ComputeDomainChannelConfig, devices: List[str]
+        self,
+        claim: ResourceClaim,
+        cfg: ComputeDomainChannelConfig,
+        devices: List[str],
+        cp: Checkpoint,
     ) -> List[PreparedDevice]:
         if devices != [CHANNEL_DEVICE]:
             raise PermanentError(f"channel claim must allocate exactly [{CHANNEL_DEVICE}]")
+        if cfg.channel_id >= self.max_channel_count:
+            raise PermanentError(
+                f"channel_id {cfg.channel_id} >= max channel count {self.max_channel_count}"
+            )
         cd_uid = cfg.domain_id
+        self._assert_channel_not_allocated(cp, claim.uid, cfg.channel_id)
         # The gate chain (§3.5) — order matters: anti-spoof before any
         # mutation; label before the ready check so the DaemonSet can land.
         domain, clique = self.cd.resolve(cd_uid)
@@ -303,11 +354,12 @@ class ComputeDomainDriver:
         clique = self.cd.get_clique(domain)
         self.cd.assert_domain_ready(domain, clique)
         env = self.cd.bootstrap_env(cd_uid, clique)
-        edits = ContainerEdits(env=env)
+        env["TPU_SLICE_CHANNEL_ID"] = str(cfg.channel_id)
+        edits = ContainerEdits(env=env, char_devices=self._channel_cdi_nodes(cfg))
         ids = self.cdi.create_claim_spec_file(claim.uid, {CHANNEL_DEVICE: edits})
         return [PreparedDevice(
             name=CHANNEL_DEVICE, device_type="channel", cdi_device_ids=ids,
-            extra={"domain": cd_uid},
+            extra={"domain": cd_uid, "channel_id": cfg.channel_id},
         )]
 
     def _unprepare(self, claim_uid: str) -> None:

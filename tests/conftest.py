@@ -22,6 +22,20 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def slice_channel_seam(tmp_path_factory):
+    """Fake the tpu-slice-channels char major for every test — the analog of
+    the reference CI always installing mock-NVML + ALT_PROC_DEVICES_PATH
+    (hack/ci/mock-nvml; internal/common/nvcaps.go:33-56). Tests that need
+    the class absent point devcaps at their own file via
+    configure_proc_devices_path, which overrides this env seam."""
+    p = tmp_path_factory.mktemp("devcaps") / "proc_devices"
+    p.write_text("Character devices:\n  1 mem\n511 tpu-slice-channels\n\nBlock devices:\n")
+    os.environ["TPU_DRA_ALT_PROC_DEVICES"] = str(p)
+    yield
+    os.environ.pop("TPU_DRA_ALT_PROC_DEVICES", None)
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devs = jax.devices()

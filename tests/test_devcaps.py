@@ -1,0 +1,237 @@
+"""Slice-channel char-device discovery + multi-channel allocation.
+
+Reference models: /proc/devices major parsing with the ALT seam
+(internal/common/nvcaps.go:33-120, ConfigureProcDevicesPath test hook),
+per-channel allocation conflict (compute-domain-kubelet-plugin/
+device_state.go:878-906), AllocationMode All CDI injection (690-733).
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import API_VERSION
+from k8s_dra_driver_tpu.api.configs import COMPUTE_DOMAIN_DRIVER_NAME
+from k8s_dra_driver_tpu.daemon import SliceAgent
+from k8s_dra_driver_tpu.k8s.core import DeviceClaimConfig, OpaqueDeviceConfig
+from k8s_dra_driver_tpu.pkg import devcaps
+from k8s_dra_driver_tpu.plugins.computedomain.computedomain import PermanentError
+
+from tests.test_computedomain import (  # noqa: F401
+    NS,
+    boot_id,
+    cd_env,
+    channel_claim,
+    make_cd,
+)
+
+PROC_DEVICES = """Character devices:
+  1 mem
+  5 /dev/tty
+136 pts
+195 nvidia
+511 tpu-slice-channels
+
+Block devices:
+259 blkext
+"""
+
+
+@pytest.fixture
+def proc_devices(tmp_path):
+    p = tmp_path / "proc_devices"
+    p.write_text(PROC_DEVICES)
+    devcaps.configure_proc_devices_path(str(p))
+    yield p
+    devcaps.configure_proc_devices_path(None)
+
+
+def test_channel_major_parsed(proc_devices):
+    assert devcaps.get_char_device_major() == 511
+    assert devcaps.using_alt_proc_devices()
+
+
+def test_missing_class_yields_none(tmp_path):
+    p = tmp_path / "proc_devices"
+    p.write_text("Character devices:\n  1 mem\n\nBlock devices:\n259 blkext\n")
+    devcaps.configure_proc_devices_path(str(p))
+    try:
+        assert devcaps.get_char_device_major() is None
+        assert devcaps.enumerate_channels(4) == []
+    finally:
+        devcaps.configure_proc_devices_path(None)
+
+
+def test_block_section_not_scanned(tmp_path):
+    # A class name appearing only under "Block devices:" must not match.
+    p = tmp_path / "proc_devices"
+    p.write_text("Character devices:\n  1 mem\n\nBlock devices:\n  8 tpu-slice-channels\n")
+    devcaps.configure_proc_devices_path(str(p))
+    try:
+        assert devcaps.get_char_device_major() is None
+    finally:
+        devcaps.configure_proc_devices_path(None)
+
+
+def test_channel_device_shape(proc_devices):
+    chans = devcaps.enumerate_channels(3)
+    assert [c.channel_id for c in chans] == [0, 1, 2]
+    c = chans[1]
+    assert c.path == "/dev/tpu-slice-channels/chan1"
+    assert c.major == 511 and c.minor == 1
+    node = c.to_cdi_node()
+    assert node == {
+        "path": "/dev/tpu-slice-channels/chan1",
+        "type": "c",
+        "major": 511,
+        "minor": 1,
+        "permissions": "rw",
+    }
+
+
+# -- multi-channel prepare ----------------------------------------------------
+
+
+def _ready_agent(api, lib, cd, tmp_path):
+    agent = SliceAgent(api, NS, cd.uid, "n0", "10.0.0.1", lib, str(tmp_path / "agent"))
+    agent.startup()
+    agent.sync()
+    assert agent.check()
+    return agent
+
+
+def _with_channel(claim, channel_id, allocation_mode="All"):
+    params = dict(claim.config[0].opaque.parameters)
+    params["channel_id"] = channel_id
+    params["allocation_mode"] = allocation_mode
+    claim.config = [DeviceClaimConfig(
+        source="claim",
+        opaque=OpaqueDeviceConfig(driver=COMPUTE_DOMAIN_DRIVER_NAME, parameters=params),
+    )]
+    return claim
+
+
+def test_prepare_injects_all_channel_nodes(cd_env, tmp_path, proc_devices):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    try:
+        claim = channel_claim(cd)
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert not isinstance(res, Exception), res
+        spec = driver.cdi.read_claim_spec(claim.uid)
+        nodes = spec["devices"][0]["containerEdits"]["deviceNodes"]
+        assert len(nodes) == driver.max_channel_count
+        assert nodes[0]["path"] == "/dev/tpu-slice-channels/chan0"
+        assert nodes[0]["major"] == 511
+        env = dict(e.split("=", 1) for e in spec["devices"][0]["containerEdits"]["env"])
+        assert env["TPU_SLICE_CHANNEL_ID"] == "0"
+    finally:
+        agent.shutdown()
+
+
+def test_prepare_single_mode_injects_one_node(cd_env, tmp_path, proc_devices):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    try:
+        claim = _with_channel(channel_claim(cd), 3, "Single")
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert not isinstance(res, Exception), res
+        spec = driver.cdi.read_claim_spec(claim.uid)
+        nodes = spec["devices"][0]["containerEdits"]["deviceNodes"]
+        assert [n["path"] for n in nodes] == ["/dev/tpu-slice-channels/chan3"]
+    finally:
+        agent.shutdown()
+
+
+def test_channel_conflict_across_claims(cd_env, tmp_path, proc_devices):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    try:
+        first = channel_claim(cd, name="wl-1")
+        res = driver.prepare_resource_claims([first])[first.uid]
+        assert not isinstance(res, Exception), res
+        # Second claim on the same channel id: refused.
+        second = channel_claim(cd, name="wl-2")
+        res = driver.prepare_resource_claims([second])[second.uid]
+        assert isinstance(res, PermanentError)
+        assert "already allocated" in str(res)
+        # A different channel id succeeds.
+        third = _with_channel(channel_claim(cd, name="wl-3"), 1)
+        res = driver.prepare_resource_claims([third])[third.uid]
+        assert not isinstance(res, Exception), res
+        # Releasing the first frees channel 0.
+        driver.unprepare_resource_claims([first.uid])
+        res = driver.prepare_resource_claims([second])[second.uid]
+        assert not isinstance(res, Exception), res
+    finally:
+        agent.shutdown()
+
+
+def test_channel_id_beyond_max_rejected(cd_env, tmp_path, proc_devices):
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    claim = _with_channel(channel_claim(cd), driver.max_channel_count)
+    res = driver.prepare_resource_claims([claim])[claim.uid]
+    assert isinstance(res, PermanentError)
+    assert "max channel count" in str(res)
+
+
+def test_no_kernel_class_degrades_to_env_only(cd_env, tmp_path):
+    """Under the mock seam, a missing char class degrades to env-only."""
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    p = tmp_path / "proc_devices_empty"
+    p.write_text("Character devices:\n  1 mem\n")
+    devcaps.configure_proc_devices_path(str(p))
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    try:
+        claim = channel_claim(cd)
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert not isinstance(res, Exception), res
+        spec = driver.cdi.read_claim_spec(claim.uid)
+        assert "deviceNodes" not in spec["devices"][0]["containerEdits"]
+    finally:
+        devcaps.configure_proc_devices_path(None)
+        agent.shutdown()
+
+
+def test_missing_class_on_real_node_is_retryable(cd_env, tmp_path, monkeypatch):
+    """Without the mock seam, a missing kernel channel class must fail the
+    prepare retryably — never start a workload missing its channel device."""
+    from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
+
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    monkeypatch.delenv(devcaps.ALT_PROC_DEVICES_ENV, raising=False)
+    try:
+        claim = channel_claim(cd)
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert isinstance(res, RetryableError)
+        assert "not registered" in str(res)
+    finally:
+        agent.shutdown()
+
+
+def test_legacy_checkpoint_entry_holds_channel_zero(cd_env, tmp_path, proc_devices):
+    """Entries checkpointed before channel ids existed implicitly hold
+    channel 0 — a post-upgrade claim must not double-allocate it."""
+    api, lib, driver, _ = cd_env
+    cd = make_cd(api)
+    agent = _ready_agent(api, lib, cd, tmp_path)
+    try:
+        first = channel_claim(cd, name="old-claim")
+        res = driver.prepare_resource_claims([first])[first.uid]
+        assert not isinstance(res, Exception), res
+        # Simulate a pre-upgrade checkpoint: no channel_id key in extra.
+        cp = driver._get_checkpoint()
+        for d in cp.claims[first.uid].devices:
+            d.extra.pop("channel_id", None)
+        driver._save_checkpoint(cp)
+        second = channel_claim(cd, name="new-claim")
+        res = driver.prepare_resource_claims([second])[second.uid]
+        assert isinstance(res, PermanentError)
+        assert "already allocated" in str(res)
+    finally:
+        agent.shutdown()
