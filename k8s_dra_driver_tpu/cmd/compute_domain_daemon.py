@@ -110,6 +110,8 @@ def main(argv=None) -> int:
         tpulib=new_tpulib(),
         workdir=args.workdir,
         gates=gates,
+        pod_name=os.environ.get("POD_NAME", ""),
+        pod_namespace=os.environ.get("POD_NAMESPACE", ""),
     )
     agent.startup()
     log.info("%s registered: index=%d ici=%s",

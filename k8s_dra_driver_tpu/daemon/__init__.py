@@ -8,5 +8,6 @@ probes that gate the workload's Prepare.
 """
 
 from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager, clique_name  # noqa: F401
+from k8s_dra_driver_tpu.daemon.podmanager import PodManager  # noqa: F401
 from k8s_dra_driver_tpu.daemon.process import ProcessManager  # noqa: F401
 from k8s_dra_driver_tpu.daemon.agent import SliceAgent  # noqa: F401

@@ -19,6 +19,7 @@ import threading
 from typing import Dict, List, Optional
 
 from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager
+from k8s_dra_driver_tpu.daemon.podmanager import PodManager
 from k8s_dra_driver_tpu.daemon.process import ProcessManager
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.pkg import featuregates as fg
@@ -50,6 +51,8 @@ class SliceAgent:
         expected_nodes: int = 0,
         gates: Optional[fg.FeatureGates] = None,
         child_argv: Optional[List[str]] = None,
+        pod_name: str = "",
+        pod_namespace: str = "",
     ):
         if not domain_uid:
             raise ValueError("domain_uid (COMPUTE_DOMAIN_UUID) is required")
@@ -67,8 +70,21 @@ class SliceAgent:
         self.expected_nodes = expected_nodes or self.inventory.num_hosts
         self.clique: Optional[CliqueManager] = None
         self.index = -1
+        # When running inside a daemon pod, clique readiness mirrors the
+        # kubelet's probe verdict on that pod (podmanager.go:35-137) rather
+        # than the agent's self-assessment.
+        self.pod_manager: Optional[PodManager] = None
+        if pod_name:
+            self.pod_manager = PodManager(
+                api, pod_namespace or namespace, pod_name, self._on_pod_ready
+            )
         self.process = ProcessManager(child_argv or DEFAULT_CHILD_ARGV)
         self._last_peers: List[str] = []
+        # Serializes clique-readiness writes between the run loop and the
+        # pod-informer callback; both read fresh state under the lock so a
+        # stale read can never overwrite a newer verdict (the reference
+        # serializes via a latest-wins workqueue key, podmanager.go:76-82).
+        self._sync_mu = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -100,7 +116,19 @@ class SliceAgent:
         if self.gates.enabled("SliceAgentsWithDNSNames"):
             # The DNS name embeds the index, which only exists post-register.
             self.clique.register(self.node_name, self.pod_ip, dns_name=self.dns_name)
+        if self.pod_manager is not None:
+            self.pod_manager.add_clique_label(self.ici_domain)
+            self.pod_manager.start()
         self.sync()
+
+    def _on_pod_ready(self, _ready: bool) -> None:
+        """Kubelet probe verdict changed: mirror it into the clique now,
+        without waiting for the next sync tick. Re-reads the pod under the
+        sync lock rather than trusting the event payload, which may be stale
+        by the time the lock is held."""
+        with self._sync_mu:
+            if self.clique is not None and self.pod_manager is not None:
+                self.clique.set_ready(self.node_name, self.pod_manager.pod_ready())
 
     def sync(self) -> None:
         """One reconcile pass: refresh peer config, supervise child, update
@@ -117,7 +145,12 @@ class SliceAgent:
             self._last_peers = peers
         else:
             self.process.ensure_started()
-        self.clique.set_ready(self.node_name, self.check())
+        with self._sync_mu:
+            ready = (
+                self.pod_manager.pod_ready() if self.pod_manager is not None
+                else self.check()
+            )
+            self.clique.set_ready(self.node_name, ready)
 
     def check(self) -> bool:
         """The readiness probe (`tpu-slice-ctl -q` analog)."""
@@ -145,6 +178,8 @@ class SliceAgent:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.pod_manager is not None:
+            self.pod_manager.stop()
         try:
             if self.clique is not None:
                 self.clique.set_ready(self.node_name, False)

@@ -1,0 +1,91 @@
+"""PodManager — the slice agent's readiness mirror over its own Pod.
+
+Reference: /root/reference/cmd/compute-domain-daemon/podmanager.go:35-137
+and the clique self-label patch (main.go:537-563). The daemon's readiness
+probe (`tpu-slice-ctl -q` / SliceAgent.check) is judged by the *kubelet*;
+the kubelet's verdict lands in the Pod's Ready condition; the PodManager
+watches its own Pod and mirrors that verdict into the clique registration
+via a callback — so clique readiness reflects what the cluster actually
+probes, not the agent's self-assessment. It also stamps the clique id label
+onto the pod so operators can select per-clique daemon pods.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from k8s_dra_driver_tpu.k8s import APIServer, Informer, NotFoundError
+from k8s_dra_driver_tpu.k8s.core import POD, Pod
+
+log = logging.getLogger(__name__)
+
+COMPUTE_DOMAIN_CLIQUE_LABEL = "resource.tpu.google.com/computeDomainClique"
+
+
+def is_pod_ready(pod: Pod) -> bool:
+    """Pod readiness from conditions, with the simplified `ready` bool the
+    sim kubelet maintains as a fallback (podmanager.go isPodReady). A
+    non-Running pod is never ready, whatever its conditions say — a dead
+    node's pod can carry the kubelet's last Ready=True verdict forever."""
+    if pod.phase != "Running":
+        return False
+    for cond in pod.conditions:
+        if cond.type == "Ready":
+            return cond.status == "True"
+    return pod.ready
+
+
+class PodManager:
+    def __init__(
+        self,
+        api: APIServer,
+        namespace: str,
+        pod_name: str,
+        on_ready_change: Callable[[bool], None],
+    ):
+        self.api = api
+        self.namespace = namespace
+        self.pod_name = pod_name
+        self.on_ready_change = on_ready_change
+        self._informer = Informer(api, POD)
+        self._last: Optional[bool] = None
+        self._informer.add_event_handler(
+            on_add=self._on_event, on_update=self._on_event
+        )
+
+    def _on_event(self, _old, new) -> None:
+        # Single-pod field-selector analog: filter to our own pod.
+        if new is None or new.meta.name != self.pod_name or new.namespace != self.namespace:
+            return
+        ready = is_pod_ready(new)
+        if ready == self._last:
+            return
+        self._last = ready
+        try:
+            self.on_ready_change(ready)
+        except Exception:  # noqa: BLE001 — next event retries the mirror
+            log.exception("pod readiness callback failed")
+            self._last = None
+
+    def start(self) -> None:
+        self._informer.start()
+
+    def stop(self) -> None:
+        self._informer.stop()
+
+    def pod_ready(self) -> bool:
+        """Read from the informer cache, not the API — the watch already
+        delivers updates (reference re-pulls from GetStore(), never GETs)."""
+        pod = self._informer.get(self.pod_name, self.namespace)
+        return is_pod_ready(pod) if pod is not None else False  # type: ignore[arg-type]
+
+    def add_clique_label(self, clique_id: str) -> None:
+        """Self-patch the pod with the clique label (main.go:537-563)."""
+        def mutate(obj):
+            obj.meta.labels[COMPUTE_DOMAIN_CLIQUE_LABEL] = clique_id
+        try:
+            self.api.update_with_retry(POD, self.pod_name, self.namespace, mutate)
+        except NotFoundError:
+            log.warning("own pod %s/%s not found for clique label",
+                        self.namespace, self.pod_name)

@@ -412,6 +412,8 @@ class SimCluster:
                 tpulib=node.tpulib,
                 workdir=os.path.join(self.workdir, node_name, "agent", pod_name),
                 gates=self.gates,
+                pod_name=pod_name,
+                pod_namespace=pod.namespace,
             )
             agent.startup()
             node.agents[pod_name] = agent

@@ -1,0 +1,107 @@
+"""PodManager readiness mirror: the clique reflects the kubelet's probe
+verdict on the daemon pod, not the agent's self-assessment.
+
+Reference model: /root/reference/cmd/compute-domain-daemon/podmanager.go
+(own-pod informer -> readiness callback) and main.go:537-563 (clique label
+self-patch).
+"""
+
+import time
+
+from k8s_dra_driver_tpu.daemon import SliceAgent
+from k8s_dra_driver_tpu.daemon.podmanager import (
+    COMPUTE_DOMAIN_CLIQUE_LABEL,
+    PodManager,
+    is_pod_ready,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import POD, Pod, PodCondition
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+from tests.test_computedomain import NS, make_cd, wait_for
+
+
+def make_pod(api, name="agent-pod", ns=NS, ready=False):
+    return api.create(Pod(meta=new_meta(name, ns), ready=ready, phase="Running"))
+
+
+def test_is_pod_ready_prefers_conditions():
+    pod = Pod(meta=new_meta("p"), ready=True, phase="Running",
+              conditions=[PodCondition(type="Ready", status="False")])
+    assert not is_pod_ready(pod)
+    pod.conditions[0].status = "True"
+    assert is_pod_ready(pod)
+    # No Ready condition: fall back to the sim kubelet's bool.
+    pod.conditions = []
+    assert is_pod_ready(pod)
+
+
+def test_non_running_pod_never_ready():
+    """A Failed pod carrying the dead kubelet's last Ready=True verdict must
+    not mirror as ready (reference isPodReady phase guard)."""
+    pod = Pod(meta=new_meta("p"), ready=True, phase="Failed",
+              conditions=[PodCondition(type="Ready", status="True")])
+    assert not is_pod_ready(pod)
+    pod.phase = "Pending"
+    assert not is_pod_ready(pod)
+
+
+def test_mirror_fires_on_ready_transitions():
+    api = APIServer()
+    make_pod(api, ready=False)
+    seen = []
+    pm = PodManager(api, NS, "agent-pod", seen.append)
+    pm.start()
+    try:
+        # Initial sync mirrors the current (not ready) state.
+        wait_for(lambda: seen == [False], msg="initial state mirrored")
+        def flip(val):
+            def mutate(obj):
+                obj.ready = val
+            api.update_with_retry(POD, "agent-pod", NS, mutate)
+        flip(True)
+        wait_for(lambda: seen == [False, True], msg="ready mirrored")
+        flip(True)  # no transition -> no extra callback
+        flip(False)
+        wait_for(lambda: seen == [False, True, False], msg="unready mirrored")
+        # Another pod's events are ignored.
+        make_pod(api, name="other", ready=True)
+        time.sleep(0.1)
+        assert seen == [False, True, False]
+    finally:
+        pm.stop()
+
+
+def test_clique_label_self_patch():
+    api = APIServer()
+    make_pod(api)
+    pm = PodManager(api, NS, "agent-pod", lambda _: None)
+    pm.add_clique_label("slice-0")
+    pod = api.get(POD, "agent-pod", NS)
+    assert pod.meta.labels[COMPUTE_DOMAIN_CLIQUE_LABEL] == "slice-0"
+
+
+def test_agent_readiness_follows_pod_not_self(tmp_path):
+    """With a pod manager, the clique mirrors the kubelet verdict: an agent
+    whose own check() passes stays NotReady until the pod goes Ready."""
+    api = APIServer()
+    cd = make_cd(api)
+    make_pod(api)
+    lib = MockTpuLib("v5e-4")
+    agent = SliceAgent(
+        api, NS, cd.uid, "n0", "10.0.0.9", lib, str(tmp_path / "agent"),
+        pod_name="agent-pod", pod_namespace=NS,
+    )
+    try:
+        agent.startup()
+        agent.sync()
+        assert agent.check()  # self-assessment passes...
+        members = agent.clique.members()
+        assert len(members) == 1 and not members[0].ready  # ...but not mirrored
+        def mutate(obj):
+            obj.ready = True
+        api.update_with_retry(POD, "agent-pod", NS, mutate)
+        wait_for(lambda: agent.clique.members()[0].ready, msg="clique follows pod")
+    finally:
+        agent.shutdown()
