@@ -23,17 +23,32 @@ from k8s_dra_driver_tpu.k8s import APIServer
 def resolve_api(args: argparse.Namespace) -> APIServer:
     if args.api_backend == "sim":
         return APIServer()
+    if args.api_backend == "http":
+        from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+
+        if not args.api_server_url:
+            raise SystemExit("error: --api-backend http requires --api-server-url")
+        return RemoteAPIServer(args.api_server_url)  # type: ignore[return-value]
     # Operator-facing: a clean error, not a traceback.
     raise SystemExit(
         "error: api-backend 'kubernetes' requires a real-cluster adapter "
         "implementing k8s_dra_driver_tpu.k8s.APIServer's interface "
-        "(create/get/list/update/delete/watch); run with --api-backend sim "
-        "or embed the components with your own APIServer"
+        "(create/get/list/update/delete/watch); run with --api-backend sim, "
+        "--api-backend http against tpu-dra-apiserver, or embed the "
+        "components with your own APIServer"
     )
 
 
 def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
+    import os
+
     parser.add_argument(
-        "--api-backend", choices=("sim", "kubernetes"), default="sim",
-        help="API server backend: in-process sim or a real cluster adapter",
+        "--api-backend", choices=("sim", "http", "kubernetes"),
+        default=os.environ.get("API_BACKEND", "sim"),
+        help="API server backend: in-process sim, http (shared "
+        "tpu-dra-apiserver), or a real cluster adapter",
+    )
+    parser.add_argument(
+        "--api-server-url", default=os.environ.get("API_SERVER_URL", ""),
+        help="base URL for --api-backend http",
     )

@@ -17,6 +17,7 @@ from k8s_dra_driver_tpu.plugins.computedomain.driver import (
     ComputeDomainDriver,
 )
 from k8s_dra_driver_tpu.plugins.health import Healthcheck
+from k8s_dra_driver_tpu.plugins.server import DRAPluginServer
 from k8s_dra_driver_tpu.tpulib import new_tpulib
 from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
 
@@ -45,6 +46,11 @@ def main(argv=None) -> int:
         help="slice channels CDI-injected under AllocationMode All "
         "(the reference's maxImexChannelCount)",
     )
+    parser.add_argument(
+        "--dra-port", type=int, default=flagpkg._env_default("DRA_PORT", 0, int),
+        help="serve the DRA Prepare/Unprepare endpoint on this local port "
+        "(0 = ephemeral; registration file written to the plugin dir)",
+    )
     args = parser.parse_args(argv)
     if args.max_slice_channel_count < 1:
         parser.error("--max-slice-channel-count must be >= 1")
@@ -65,7 +71,12 @@ def main(argv=None) -> int:
         max_channel_count=args.max_slice_channel_count,
     )
     driver.start()
-    log.info("%s serving", version_string("compute-domain-kubelet-plugin"))
+    dra_srv = DRAPluginServer(
+        driver, args.plugin_dir, args.node_name or socket.gethostname(),
+        port=args.dra_port,
+    ).start()
+    log.info("%s serving on %s",
+             version_string("compute-domain-kubelet-plugin"), dra_srv.endpoint)
 
     metrics_srv = None
     if args.metrics_port:
@@ -80,6 +91,7 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    dra_srv.stop()
     if health_srv:
         health_srv.stop()
     driver.shutdown()

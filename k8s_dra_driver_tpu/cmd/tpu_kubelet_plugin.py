@@ -17,6 +17,7 @@ from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
 from k8s_dra_driver_tpu.pkg import flags as flagpkg
 from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
 from k8s_dra_driver_tpu.plugins.health import Healthcheck
+from k8s_dra_driver_tpu.plugins.server import DRAPluginServer
 from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
 from k8s_dra_driver_tpu.tpulib import new_tpulib
 from k8s_dra_driver_tpu.utils import start_debug_signal_handlers, version_string
@@ -32,6 +33,11 @@ def main(argv=None) -> int:
          flagpkg.KubeClientFlags()],
     )
     add_api_backend_flag(parser)
+    parser.add_argument(
+        "--dra-port", type=int, default=flagpkg._env_default("DRA_PORT", 0, int),
+        help="serve the DRA Prepare/Unprepare endpoint on this local port "
+        "(0 = ephemeral; registration file written to the plugin dir)",
+    )
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -51,8 +57,12 @@ def main(argv=None) -> int:
         gates=gates, metrics_registry=registry,
     )
     driver.start()
-    log.info("%s serving; %d allocatable devices published",
-             version_string("tpu-kubelet-plugin"), len(driver.state.allocatable))
+    dra_srv = DRAPluginServer(
+        driver, args.plugin_dir, node_name, port=args.dra_port
+    ).start()
+    log.info("%s serving on %s; %d allocatable devices published",
+             version_string("tpu-kubelet-plugin"), dra_srv.endpoint,
+             len(driver.state.allocatable))
 
     metrics_srv = None
     if args.metrics_port:
@@ -67,6 +77,7 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    dra_srv.stop()
     if health_srv:
         health_srv.stop()
     driver.shutdown()

@@ -89,6 +89,14 @@ def daemon_set_for_domain(cd: ComputeDomain, driver_namespace: str) -> DaemonSet
                         "COMPUTE_DOMAIN_NAMESPACE": cd.namespace,
                         "COMPUTE_DOMAIN_NAME": cd.name,
                     },
+                    # Own-pod identity for the kubelet-verdict readiness
+                    # mirror (PodManager); without these the agent falls
+                    # back to self-assessed readiness.
+                    downward_env={
+                        "POD_NAME": "metadata.name",
+                        "POD_NAMESPACE": "metadata.namespace",
+                        "POD_IP": "status.podIP",
+                    },
                 )
             ],
             resource_claims=[

@@ -72,11 +72,19 @@ class SliceAgent:
         self.index = -1
         # When running inside a daemon pod, clique readiness mirrors the
         # kubelet's probe verdict on that pod (podmanager.go:35-137) rather
-        # than the agent's self-assessment.
+        # than the agent's self-assessment. Both identity halves are
+        # required: the daemon pod lives in the DRIVER namespace, not the
+        # domain's, so guessing a namespace would watch a pod that does not
+        # exist and pin readiness False forever.
         self.pod_manager: Optional[PodManager] = None
-        if pod_name:
+        if pod_name and pod_namespace:
             self.pod_manager = PodManager(
-                api, pod_namespace or namespace, pod_name, self._on_pod_ready
+                api, pod_namespace, pod_name, self._on_pod_ready
+            )
+        elif pod_name:
+            log.warning(
+                "POD_NAME set without POD_NAMESPACE; kubelet-verdict mirror "
+                "disabled, falling back to self-assessed readiness"
             )
         self.process = ProcessManager(child_argv or DEFAULT_CHILD_ARGV)
         self._last_peers: List[str] = []

@@ -48,7 +48,11 @@ class PodManager:
         self.namespace = namespace
         self.pod_name = pod_name
         self.on_ready_change = on_ready_change
-        self._informer = Informer(api, POD)
+        # Field-selector-narrowed informer: only this pod's events arrive
+        # (reference single-pod field selector, podmanager.go:47-53).
+        self._informer = Informer(
+            api, POD, field_name=pod_name, field_namespace=namespace
+        )
         self._last: Optional[bool] = None
         self._informer.add_event_handler(
             on_add=self._on_event, on_update=self._on_event

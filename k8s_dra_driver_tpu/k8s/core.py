@@ -169,6 +169,10 @@ class Container:
     name: str = "main"
     image: str = ""
     env: Dict[str, str] = field(default_factory=dict)
+    # Downward-API env: env var name -> fieldPath (metadata.name,
+    # metadata.namespace, status.podIP); the kubelet materializes these from
+    # the pod at start.
+    downward_env: Dict[str, str] = field(default_factory=dict)
     command: List[str] = field(default_factory=list)
     # Exec readiness probe command; the sim's probe loop honors agent state,
     # this records the manifest-level probe (reference

@@ -1,0 +1,400 @@
+"""HTTP transport for the APIServer: server + remote client.
+
+The reference's five binaries are separate processes sharing one Kubernetes
+API server over HTTP; this module gives the TPU build the same shape for
+development and the multi-process test tier. `serve_api()` exposes an
+in-process APIServer over REST + streaming watches; `RemoteAPIServer`
+implements the same interface as `k8s.APIServer` over that wire, so every
+component (plugins, controller, daemon, webhook, informers) runs unmodified
+in its own process with `--api-backend http`.
+
+Run standalone:  python -m k8s_dra_driver_tpu.k8s.httpapi --port 8001
+
+Routes:
+    POST   /objects                     create (body: wire object)
+    PUT    /objects                     update (CAS; 409 on conflict)
+    GET    /objects/{kind}?name=&ns=    get one (404) or list (ns optional,
+                                        labels=<json> selector)
+    DELETE /objects/{kind}?name=&ns=    delete (finalizer-aware)
+    GET    /watch/{kind}                JSON-lines event stream
+    GET    /healthz
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.objects import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    K8sObject,
+    NotFoundError,
+)
+from k8s_dra_driver_tpu.k8s.serialize import from_wire, to_wire
+from k8s_dra_driver_tpu.k8s.store import APIServer, WatchEvent
+
+log = logging.getLogger(__name__)
+
+_ERROR_STATUS = {
+    NotFoundError: 404,
+    AlreadyExistsError: 409,
+    ConflictError: 409,
+}
+_ERROR_CODE = {
+    NotFoundError: "NotFound",
+    AlreadyExistsError: "AlreadyExists",
+    ConflictError: "Conflict",
+}
+_CODE_ERROR = {v: k for k, v in _ERROR_CODE.items()}
+
+WATCH_HEARTBEAT_S = 5.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    api: APIServer  # set by serve_api subclassing
+
+    def log_message(self, *args: object) -> None:  # quiet
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _send_json(self, status: int, doc: dict) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_obj(self, e: Exception) -> None:
+        status = _ERROR_STATUS.get(type(e), 500)
+        code = _ERROR_CODE.get(type(e), "Internal")
+        self._send_json(status, {"error": code, "message": str(e)})
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _route(self) -> Tuple[str, List[str], Dict[str, List[str]]]:
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        return parsed.path, parts, urllib.parse.parse_qs(parsed.query)
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        _, parts, q = self._route()
+        try:
+            if parts == ["healthz"]:
+                self._send_json(200, {"ok": True})
+            elif len(parts) == 2 and parts[0] == "objects":
+                kind = parts[1]
+                name = q.get("name", [None])[0]
+                if name is not None:
+                    ns = q.get("ns", [""])[0]
+                    self._send_json(200, to_wire(self.api.get(kind, name, ns)))
+                else:
+                    ns = q.get("ns", [None])[0]
+                    labels = json.loads(q["labels"][0]) if "labels" in q else None
+                    objs = self.api.list(kind, namespace=ns, label_selector=labels)
+                    self._send_json(200, {"items": [to_wire(o) for o in objs]})
+            elif len(parts) == 2 and parts[0] == "watch":
+                self._stream_watch(
+                    parts[1],
+                    name=q.get("name", [None])[0],
+                    namespace=q.get("ns", [None])[0],
+                )
+            else:
+                self._send_json(404, {"error": "NoRoute", "message": self.path})
+        except ApiError as e:
+            self._send_error_obj(e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_POST(self) -> None:  # noqa: N802
+        _, parts, _ = self._route()
+        try:
+            if parts == ["objects"]:
+                obj = from_wire(self._body())
+                self._send_json(201, to_wire(self.api.create(obj)))
+            else:
+                self._send_json(404, {"error": "NoRoute", "message": self.path})
+        except ApiError as e:
+            self._send_error_obj(e)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        _, parts, _ = self._route()
+        try:
+            if parts == ["objects"]:
+                obj = from_wire(self._body())
+                self._send_json(200, to_wire(self.api.update(obj)))
+            else:
+                self._send_json(404, {"error": "NoRoute", "message": self.path})
+        except ApiError as e:
+            self._send_error_obj(e)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        _, parts, q = self._route()
+        try:
+            if len(parts) == 2 and parts[0] == "objects":
+                name = q.get("name", [""])[0]
+                ns = q.get("ns", [""])[0]
+                self.api.delete(parts[1], name, ns)
+                self._send_json(200, {"ok": True})
+            else:
+                self._send_json(404, {"error": "NoRoute", "message": self.path})
+        except ApiError as e:
+            self._send_error_obj(e)
+
+    # -- watch streaming ----------------------------------------------------
+
+    def _stream_watch(self, kind: str, name: Optional[str] = None,
+                      namespace: Optional[str] = None) -> None:
+        """JSON-lines chunked stream; heartbeats detect dead clients so the
+        server-side queue is unregistered (a real API server closes idle
+        watches the same way). name/ns are the field-selector analog."""
+        wq = self.api.watch(kind, name=name, namespace=namespace)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_line(doc: dict) -> None:
+                line = (json.dumps(doc) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            # The queue is registered: tell the client its watch is live so
+            # it can order a subsequent list after the subscription.
+            write_line({"type": "SYNC"})
+            while True:
+                try:
+                    ev = wq.get(timeout=WATCH_HEARTBEAT_S)
+                except queue.Empty:
+                    write_line({"type": "HEARTBEAT"})
+                    continue
+                write_line({"type": ev.type, "object": to_wire(ev.obj)})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.api.stop_watch(kind, wq)
+
+
+class HTTPAPIServer:
+    """Hosts an APIServer over HTTP on a background thread."""
+
+    def __init__(self, api: Optional[APIServer] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.api = api or APIServer()
+
+        class Handler(_Handler):
+            pass
+
+        Handler.api = self.api
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HTTPAPIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def serve_api(api: Optional[APIServer] = None, host: str = "127.0.0.1",
+              port: int = 0) -> HTTPAPIServer:
+    return HTTPAPIServer(api, host, port).start()
+
+
+# -- client -----------------------------------------------------------------
+
+
+class RemoteAPIServer:
+    """Client-side APIServer over the HTTP wire — drop-in for k8s.APIServer
+    (create/get/try_get/list/update/delete/update_with_retry/watch/
+    stop_watch/list_and_watch)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._watch_stops: Dict[int, threading.Event] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            doc = {}
+            try:
+                doc = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                pass
+            err_cls = _CODE_ERROR.get(doc.get("error", ""), ApiError)
+            raise err_cls(doc.get("message", str(e))) from None
+
+    @staticmethod
+    def _q(**params) -> str:
+        q = {k: v for k, v in params.items() if v is not None}
+        return ("?" + urllib.parse.urlencode(q)) if q else ""
+
+    # -- interface ----------------------------------------------------------
+
+    def create(self, obj: K8sObject) -> K8sObject:
+        return from_wire(self._request("POST", "/objects", to_wire(obj)))
+
+    def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
+        return from_wire(
+            self._request("GET", f"/objects/{kind}" + self._q(name=name, ns=namespace))
+        )
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[K8sObject]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[K8sObject]:
+        labels = json.dumps(label_selector) if label_selector else None
+        doc = self._request(
+            "GET", f"/objects/{kind}" + self._q(ns=namespace, labels=labels)
+        )
+        return [from_wire(d) for d in doc["items"]]
+
+    def update(self, obj: K8sObject) -> K8sObject:
+        return from_wire(self._request("PUT", "/objects", to_wire(obj)))
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._request("DELETE", f"/objects/{kind}" + self._q(name=name, ns=namespace))
+
+    def update_with_retry(
+        self, kind: str, name: str, namespace: str,
+        mutate: Callable[[K8sObject], None], attempts: int = 10,
+    ) -> K8sObject:
+        last: Optional[ConflictError] = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+    ) -> "queue.Queue[WatchEvent]":
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        stop = threading.Event()
+        synced = threading.Event()
+        self._watch_stops[id(q)] = stop
+        query = self._q(name=name, ns=namespace)
+
+        def reader() -> None:
+            req = urllib.request.Request(self.base_url + f"/watch/{kind}" + query)
+            try:
+                with urllib.request.urlopen(req, timeout=None) as resp:
+                    for raw in resp:
+                        if stop.is_set():
+                            return
+                        doc = json.loads(raw)
+                        kind_ = doc.get("type")
+                        if kind_ == "SYNC":
+                            synced.set()
+                            continue
+                        if kind_ == "HEARTBEAT":
+                            continue
+                        q.put(WatchEvent(doc["type"], from_wire(doc["object"])))
+            except (OSError, json.JSONDecodeError):
+                if not stop.is_set():
+                    log.warning("watch stream for %s ended", kind)
+            finally:
+                synced.set()  # never leave the caller blocked
+
+        threading.Thread(target=reader, name=f"watch-{kind}", daemon=True).start()
+        # Block until the server registered the subscription: events emitted
+        # after watch() returns are then guaranteed to be delivered, which
+        # list_and_watch's snapshot ordering relies on.
+        synced.wait(timeout=self.timeout)
+        return q
+
+    def stop_watch(self, kind: str, q: "queue.Queue[WatchEvent]") -> None:
+        stop = self._watch_stops.pop(id(q), None)
+        if stop:
+            stop.set()
+
+    def list_and_watch(
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+    ) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
+        """Watch-then-list: events racing the list may duplicate objects the
+        snapshot already contains; informer caches absorb replays (the
+        real-world list+watch has the same at-least-once property)."""
+        q = self.watch(kind, name=name, namespace=namespace)
+        objs = self.list(kind, namespace=namespace)
+        if name is not None:
+            objs = [o for o in objs if o.meta.name == name]
+        return objs, q
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser("tpu-dra-apiserver",
+                                     description="standalone sim API server over HTTP")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args(argv)
+    srv = serve_api(host=args.host, port=args.port)
+    print(f"serving on {srv.url}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

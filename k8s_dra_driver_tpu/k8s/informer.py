@@ -23,9 +23,20 @@ Handler = Callable[[Optional[K8sObject], K8sObject], None]
 
 
 class Informer:
-    def __init__(self, api: APIServer, kind: str):
+    def __init__(
+        self,
+        api: APIServer,
+        kind: str,
+        field_name: Optional[str] = None,
+        field_namespace: Optional[str] = None,
+    ):
+        """field_name/field_namespace narrow the list+watch to one object —
+        the reference's single-pod field selector (podmanager.go:47-53);
+        the server then never streams unrelated churn to this informer."""
         self.api = api
         self.kind = kind
+        self.field_name = field_name
+        self.field_namespace = field_namespace
         self._cache: Dict[str, K8sObject] = {}
         self._mu = threading.RLock()
         self._on_add: List[Handler] = []
@@ -54,7 +65,9 @@ class Informer:
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("informer already started")
-        objs, self._queue = self.api.list_and_watch(self.kind)
+        objs, self._queue = self.api.list_and_watch(
+            self.kind, name=self.field_name, namespace=self.field_namespace
+        )
         with self._mu:
             for o in objs:
                 self._cache[o.key] = o

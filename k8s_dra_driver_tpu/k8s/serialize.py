@@ -1,0 +1,84 @@
+"""K8sObject <-> JSON wire format for the HTTP API server and clients.
+
+The reference's equivalent is client-go's generated codecs; here one generic
+typed codec covers every registered kind: encode via dataclasses.asdict,
+decode by walking the dataclass field annotations (nested dataclasses,
+List[...], Dict[...], Optional[...]). The kind registry is built from
+K8sObject subclasses, so new kinds serialize without codec changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type
+
+from k8s_dra_driver_tpu.k8s.objects import K8sObject
+
+# Importing for side effect: registers every kind as a K8sObject subclass.
+import k8s_dra_driver_tpu.k8s.core  # noqa: F401
+import k8s_dra_driver_tpu.api.computedomain  # noqa: F401
+
+
+def _all_subclasses(cls: type) -> list[type]:
+    out = []
+    for sub in cls.__subclasses__():
+        out.append(sub)
+        out.extend(_all_subclasses(sub))
+    return out
+
+
+def kind_registry() -> Dict[str, Type[K8sObject]]:
+    reg: Dict[str, Type[K8sObject]] = {}
+    for cls in _all_subclasses(K8sObject):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        for f in dataclasses.fields(cls):
+            if f.name == "kind" and isinstance(f.default, str) and f.default:
+                reg[f.default] = cls
+    return reg
+
+
+_REGISTRY = kind_registry()
+
+
+def to_wire(obj: K8sObject) -> Dict[str, Any]:
+    return dataclasses.asdict(obj)
+
+
+def _decode_value(tp: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X] and friends
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _decode_value(args[0], value) if len(args) == 1 else value
+    if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+        return _decode_dataclass(tp, value)
+    if origin in (list, tuple):
+        (elem,) = typing.get_args(tp) or (Any,)
+        seq = [_decode_value(elem, v) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = typing.get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(vt, v) for k, v in value.items()}
+    return value
+
+
+def _decode_dataclass(cls: type, data: Dict[str, Any]):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        kwargs[f.name] = _decode_value(hints.get(f.name, Any), data[f.name])
+    return cls(**kwargs)
+
+
+def from_wire(doc: Dict[str, Any]) -> K8sObject:
+    kind = doc.get("kind", "")
+    cls = _REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown kind {kind!r}; known: {sorted(_REGISTRY)}")
+    return _decode_dataclass(cls, doc)  # type: ignore[return-value]

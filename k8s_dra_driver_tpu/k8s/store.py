@@ -51,7 +51,12 @@ class APIServer:
         self._mu = threading.RLock()
         self._objects: Dict[_Key, K8sObject] = {}
         self._rv = 0
-        self._watchers: Dict[str, List["queue.Queue[WatchEvent]"]] = {}
+        # (queue, name-filter, namespace-filter); None filters match all —
+        # the field-selector analog so a single-object watcher (e.g. the
+        # daemon's own-pod PodManager) doesn't receive cluster-wide churn.
+        self._watchers: Dict[
+            str, List[Tuple["queue.Queue[WatchEvent]", Optional[str], Optional[str]]]
+        ] = {}
 
     # -- internal ----------------------------------------------------------
 
@@ -60,7 +65,11 @@ class APIServer:
         return self._rv
 
     def _emit(self, kind: str, event: WatchEvent) -> None:
-        for q in self._watchers.get(kind, []):
+        for q, name, ns in self._watchers.get(kind, []):
+            if name is not None and event.obj.meta.name != name:
+                continue
+            if ns is not None and event.obj.meta.namespace != ns:
+                continue
             q.put(event)
 
     @staticmethod
@@ -178,24 +187,29 @@ class APIServer:
                 last = e
         raise last  # type: ignore[misc]
 
-    def watch(self, kind: str) -> "queue.Queue[WatchEvent]":
+    def watch(
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+    ) -> "queue.Queue[WatchEvent]":
         with self._mu:
             q: "queue.Queue[WatchEvent]" = queue.Queue()
-            self._watchers.setdefault(kind, []).append(q)
+            self._watchers.setdefault(kind, []).append((q, name, namespace))
             return q
 
     def stop_watch(self, kind: str, q: "queue.Queue[WatchEvent]") -> None:
         with self._mu:
-            try:
-                self._watchers.get(kind, []).remove(q)
-            except ValueError:
-                pass
+            entries = self._watchers.get(kind, [])
+            self._watchers[kind] = [e for e in entries if e[0] is not q]
 
-    def list_and_watch(self, kind: str) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
+    def list_and_watch(
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+    ) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
         """Atomic snapshot + subscription — informer bootstrap."""
         with self._mu:
-            q = self.watch(kind)
-            return self.list(kind), q
+            q = self.watch(kind, name, namespace)
+            objs = self.list(kind, namespace=namespace)
+            if name is not None:
+                objs = [o for o in objs if o.meta.name == name]
+            return objs, q
 
     # -- garbage collection -------------------------------------------------
 

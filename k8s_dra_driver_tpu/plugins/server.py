@@ -1,0 +1,147 @@
+"""DRA plugin endpoint — the kubelet plugin-socket analog.
+
+The reference registers a gRPC socket with the kubelet via the
+`kubeletplugin` helper and serves PrepareResourceClaims /
+UnprepareResourceClaims over it (SURVEY.md §1 L3→kubelet;
+/root/reference/cmd/gpu-kubelet-plugin/driver.go:131-149). Here the plugin
+serves the same service over local HTTP and announces itself through a
+registration file in the plugin dir — the kubelet-side discovery scan of
+the plugin registration directory:
+
+    {plugin_dir}/registration.json   {"driver", "endpoint", "node"}
+
+Routes:
+    POST /v1/prepare     {"claims": [wire ResourceClaim, ...]}
+                         -> {"results": {uid: {"cdi_device_ids": [...]}
+                                             | {"error", "retryable"}}}
+    POST /v1/unprepare   {"claim_uids": [...]} -> {"results": {uid: null|err}}
+    GET  /healthz        {"healthy": bool} — the gRPC healthcheck analog
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from k8s_dra_driver_tpu.k8s.serialize import from_wire
+
+log = logging.getLogger(__name__)
+
+REGISTRATION_FILE = "registration.json"
+
+
+def _is_retryable(err: Exception) -> bool:
+    # Import here: computedomain pulls api types this module must not require.
+    try:
+        from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
+        return isinstance(err, RetryableError)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class DRAPluginServer:
+    """Serves a driver's Prepare/Unprepare/health over local HTTP and writes
+    the registration file kubelets discover."""
+
+    def __init__(self, driver, plugin_dir: str, node_name: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.driver = driver
+        self.plugin_dir = plugin_dir
+        self.node_name = node_name
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args: object) -> None:
+                pass
+
+            def _send(self, status: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == "/healthz":
+                    healthy = outer.driver.healthy()
+                    self._send(200 if healthy else 503, {"healthy": healthy})
+                else:
+                    self._send(404, {"error": "NoRoute"})
+
+            def do_POST(self) -> None:  # noqa: N802
+                try:
+                    if self.path == "/v1/prepare":
+                        claims = [from_wire(d) for d in self._body().get("claims", [])]
+                        res = outer.driver.prepare_resource_claims(claims)
+                        out = {}
+                        for uid, r in res.items():
+                            if isinstance(r, Exception):
+                                out[uid] = {"error": str(r),
+                                            "retryable": _is_retryable(r)}
+                            else:
+                                ids = getattr(r, "cdi_device_ids", r)
+                                out[uid] = {"cdi_device_ids": list(ids)}
+                        self._send(200, {"results": out})
+                    elif self.path == "/v1/unprepare":
+                        uids = self._body().get("claim_uids", [])
+                        res = outer.driver.unprepare_resource_claims(uids)
+                        self._send(200, {"results": {
+                            uid: (None if err is None else str(err))
+                            for uid, err in res.items()
+                        }})
+                    else:
+                        self._send(404, {"error": "NoRoute"})
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    log.exception("plugin request failed")
+                    self._send(500, {"error": "Internal", "message": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def registration_path(self) -> str:
+        return os.path.join(self.plugin_dir, REGISTRATION_FILE)
+
+    def start(self) -> "DRAPluginServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dra-plugin-server", daemon=True
+        )
+        self._thread.start()
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        reg = {
+            "driver": self.driver.driver_name,
+            "endpoint": self.endpoint,
+            "node": self.node_name,
+        }
+        tmp = self.registration_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(reg, f)
+        os.replace(tmp, self.registration_path)
+        return self
+
+    def stop(self) -> None:
+        try:
+            os.unlink(self.registration_path)
+        except FileNotFoundError:
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -399,10 +399,21 @@ class SimCluster:
             node = self.nodes.get(node_name)
             if node is None or pod_name in node.agents:
                 continue
-            env = next(
-                (c.env for c in pod.containers if c.command and c.command[0] == "compute-domain-daemon"),
-                {},
+            container = next(
+                (c for c in pod.containers
+                 if c.command and c.command[0] == "compute-domain-daemon"),
+                None,
             )
+            env = dict(container.env) if container else {}
+            if container:
+                # Kubelet materializes downward-API env from the pod.
+                fields = {
+                    "metadata.name": pod.meta.name,
+                    "metadata.namespace": pod.namespace,
+                    "status.podIP": pod.pod_ip,
+                }
+                for var, path in container.downward_env.items():
+                    env[var] = fields.get(path, "")
             agent = SliceAgent(
                 api=self.api,
                 namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace),
@@ -412,8 +423,8 @@ class SimCluster:
                 tpulib=node.tpulib,
                 workdir=os.path.join(self.workdir, node_name, "agent", pod_name),
                 gates=self.gates,
-                pod_name=pod_name,
-                pod_namespace=pod.namespace,
+                pod_name=env.get("POD_NAME", ""),
+                pod_namespace=env.get("POD_NAMESPACE", ""),
             )
             agent.startup()
             node.agents[pod_name] = agent

@@ -1,0 +1,190 @@
+"""Multi-process e2e: real binaries, one shared API server, kill -9 recovery.
+
+The reference's bats tier runs the actual driver binaries against a live
+cluster (SURVEY.md §4.4); this tier does the same shape on one machine:
+`tpu-dra-apiserver` and `tpu-kubelet-plugin` run as separate OS processes,
+the test plays the kubelet (discovers the plugin's registration file, calls
+its DRA endpoint), and a SIGKILL between prepares proves the checkpoint
+state machine survives plugin death — the crash-consistency property the
+reference encodes in device_state.go (§3.2).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.api import API_VERSION
+from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s.core import (
+    RESOURCE_SLICE,
+    AllocationResult,
+    DeviceRequestAllocationResult,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.k8s.serialize import to_wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _post(url: str, doc: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class PluginProc:
+    """One tpu-kubelet-plugin OS process + its discovered endpoint."""
+
+    def __init__(self, tmp, api_url, boot_id_path):
+        self.plugin_dir = os.path.join(tmp, "plugin")
+        self.cdi_root = os.path.join(tmp, "cdi")
+        self.env = {
+            **os.environ,
+            "ALT_TPU_TOPOLOGY": "v5e-4",          # mock tpulib backend
+            "ALT_TPU_BOOT_ID_PATH": boot_id_path,
+            "API_BACKEND": "http",
+            "API_SERVER_URL": api_url,
+            "NODE_NAME": "mp-node-0",
+            "PLUGIN_DIR": self.plugin_dir,
+            "CDI_ROOT": self.cdi_root,
+            "PYTHONPATH": REPO,
+        }
+        self.proc = None
+
+    def start(self):
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin"],
+            env=self.env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        reg = os.path.join(self.plugin_dir, "registration.json")
+        _wait(lambda: os.path.exists(reg) or self.proc.poll() is not None,
+              msg="plugin registration file")
+        if self.proc.poll() is not None:
+            raise AssertionError(
+                "plugin died at startup:\n" + self.proc.stdout.read().decode()
+            )
+        with open(reg, encoding="utf-8") as f:
+            self.endpoint = json.load(f)["endpoint"]
+        return self
+
+    def kill9(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+        # SIGKILL leaves the registration file behind (no cleanup ran); drop
+        # it so the restart's fresh registration is what gets discovered.
+        try:
+            os.unlink(os.path.join(self.plugin_dir, "registration.json"))
+        except FileNotFoundError:
+            pass
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+@pytest.fixture
+def cluster_procs(tmp_path):
+    """apiserver process + plugin process + remote client."""
+    boot_id = tmp_path / "boot_id"
+    boot_id.write_text("mp-boot-1\n")
+    apiserver = subprocess.Popen(
+        [sys.executable, "-m", "k8s_dra_driver_tpu.k8s.httpapi", "--port", "0"],
+        env={**os.environ, "PYTHONPATH": REPO}, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        line = apiserver.stdout.readline()
+        assert line.startswith("serving on "), line
+        url = line.split()[-1]
+        api = RemoteAPIServer(url)
+        plugin = PluginProc(str(tmp_path), url, str(boot_id)).start()
+        try:
+            yield api, plugin
+        finally:
+            plugin.terminate()
+    finally:
+        apiserver.terminate()
+        try:
+            apiserver.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            apiserver.kill()
+
+
+def make_claim(devices, name="mp-claim"):
+    claim = ResourceClaim(meta=new_meta(name, "mp-ns"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(devices=[
+        DeviceRequestAllocationResult(
+            request="tpus", driver=TPU_DRIVER_NAME, pool="mp-node-0", device=d)
+        for d in devices
+    ])
+    return claim
+
+
+def test_publish_prepare_unprepare_across_processes(cluster_procs):
+    api, plugin = cluster_procs
+    # The plugin process published its ResourceSlice to the shared server.
+    _wait(lambda: any(s.driver == TPU_DRIVER_NAME for s in api.list(RESOURCE_SLICE)),
+          msg="ResourceSlice published")
+    rs = next(s for s in api.list(RESOURCE_SLICE) if s.driver == TPU_DRIVER_NAME)
+    names = {d.name for d in rs.devices}
+    assert {"tpu-0", "tpu-1", "tpu-2", "tpu-3"} <= names
+    # Kubelet role: create the claim on the API server, call the endpoint.
+    claim = api.create(make_claim(["tpu-0", "tpu-1"]))
+    out = _post(plugin.endpoint + "/v1/prepare", {"claims": [to_wire(claim)]})
+    res = out["results"][claim.uid]
+    assert res.get("cdi_device_ids"), res
+    spec_files = os.listdir(plugin.cdi_root)
+    assert any(claim.uid in f for f in spec_files)
+    # Health endpoint answers.
+    with urllib.request.urlopen(plugin.endpoint + "/healthz", timeout=5) as r:
+        assert json.loads(r.read())["healthy"] is True
+    out = _post(plugin.endpoint + "/v1/unprepare", {"claim_uids": [claim.uid]})
+    assert out["results"][claim.uid] is None
+    assert not any(claim.uid in f for f in os.listdir(plugin.cdi_root))
+
+
+def test_prepare_survives_sigkill(cluster_procs, tmp_path):
+    """Kill -9 the plugin after a completed prepare; the restarted process
+    serves the same devices from its checkpoint (idempotent re-prepare) and
+    an overlapping claim is still refused."""
+    api, plugin = cluster_procs
+    claim = api.create(make_claim(["tpu-2", "tpu-3"], name="surviving"))
+    out = _post(plugin.endpoint + "/v1/prepare", {"claims": [to_wire(claim)]})
+    ids_before = out["results"][claim.uid]["cdi_device_ids"]
+    assert ids_before
+
+    plugin.kill9()
+    plugin.start()  # same plugin_dir -> same checkpoint + boot id
+
+    out = _post(plugin.endpoint + "/v1/prepare", {"claims": [to_wire(claim)]})
+    assert out["results"][claim.uid]["cdi_device_ids"] == ids_before
+    # Overlap guard still enforced from the recovered checkpoint.
+    thief = api.create(make_claim(["tpu-3"], name="thief"))
+    out = _post(plugin.endpoint + "/v1/prepare", {"claims": [to_wire(thief)]})
+    assert "overlap" in out["results"][thief.uid].get("error", "")
