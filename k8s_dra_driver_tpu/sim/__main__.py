@@ -1,0 +1,72 @@
+"""Standalone simulated cluster — the `kind create cluster` analog.
+
+Hosts the HTTP API server and runs the SimCluster control loops (scheduler,
+DaemonSet controller, kubelet, slice agents, CD controller) continuously,
+so external processes — the kubectl CLI, the shell e2e tier, or the real
+binaries with --api-backend http — operate against a live "cluster" without
+hardware, the way the reference's mock-NVML kind cluster backs its CI
+(SURVEY.md §4.2).
+
+    python -m k8s_dra_driver_tpu.sim --port 8001 --profile v5e-16
+
+Prints `cluster up at <url>` when serving; steps the control loops every
+--tick seconds until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import tempfile
+import threading
+
+from k8s_dra_driver_tpu.k8s.httpapi import serve_api
+from k8s_dra_driver_tpu.sim.cluster import SimCluster
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "tpu-dra-simcluster", description="simulated TPU cluster over HTTP"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--profile", default="v5e-16",
+                        help="mock tpulib topology profile per node")
+    parser.add_argument("--num-hosts", type=int, default=None,
+                        help="override node count (default: profile's host count)")
+    parser.add_argument("--gates", default="", help="feature gates, k=v comma list")
+    parser.add_argument("--workdir", default="",
+                        help="plugin/CDI state dir (default: temp dir)")
+    parser.add_argument("--tick", type=float, default=0.2,
+                        help="control-loop step interval seconds")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="tpu-dra-sim-")
+    srv = serve_api(host=args.host, port=args.port)
+    sim = SimCluster(
+        workdir=workdir, profile=args.profile, num_hosts=args.num_hosts,
+        gates=args.gates, api=srv.api,
+    )
+    sim.start()
+    print(f"cluster up at {srv.url} "
+          f"({len(sim.nodes)} nodes, profile {args.profile})", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    while not stop.wait(args.tick):
+        try:
+            sim.step()
+        except Exception:  # noqa: BLE001 — a bad pass must not kill the cluster
+            logging.exception("sim step failed")
+    sim.stop()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ from k8s_dra_driver_tpu.controller.templates import (
 )
 from k8s_dra_driver_tpu.daemon import SliceAgent
 from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
+from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError
 from k8s_dra_driver_tpu.k8s.core import (
     DAEMON_SET,
     DEVICE_CLASS,
@@ -78,8 +79,9 @@ class SimCluster:
         profile: str = "v5e-16",
         num_hosts: Optional[int] = None,
         gates: str = "",
+        api: Optional[APIServer] = None,
     ):
-        self.api = APIServer()
+        self.api = api if api is not None else APIServer()
         self.workdir = workdir
         self.gates = fg.parse(gates)
         self.allocator = Allocator(self.api)
@@ -104,9 +106,12 @@ class SimCluster:
             (DEVICE_CLASS_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "channel"}),
             (DEVICE_CLASS_DAEMON, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "daemon"}),
         ):
-            self.api.create(DeviceClass(
-                meta=new_meta(name), driver=driver, match_attributes=match,
-            ))
+            try:
+                self.api.create(DeviceClass(
+                    meta=new_meta(name), driver=driver, match_attributes=match,
+                ))
+            except AlreadyExistsError:
+                pass  # attaching to a server that was already seeded
 
     def _add_node(self, name: str, worker_id: int) -> None:
         self.api.create(Node(meta=new_meta(name)))

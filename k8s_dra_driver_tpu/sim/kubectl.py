@@ -131,3 +131,149 @@ def apply_file(api: APIServer, path: str) -> List[K8sObject]:
     for obj in objs:
         created.append(api.create(obj))
     return created
+
+
+# -- CLI ---------------------------------------------------------------------
+#
+# kubectl-style operator CLI against a tpu-dra-apiserver / sim cluster:
+#
+#   tpu-kubectl --server http://127.0.0.1:8001 apply -f pod.yaml
+#   tpu-kubectl get pods -n default [-o json]
+#   tpu-kubectl delete pod my-pod -n default
+#   tpu-kubectl wait pod my-pod -n default --for=Running --timeout=30
+#
+# The server defaults to $TPU_KUBECTL_SERVER. Kind aliases follow kubectl
+# conventions (pods/po, resourceclaims/rc, computedomains/cd, ...).
+
+_KIND_ALIASES = {
+    "pod": "Pod", "pods": "Pod", "po": "Pod",
+    "node": "Node", "nodes": "Node",
+    "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
+    "resourceclaimtemplate": "ResourceClaimTemplate",
+    "resourceclaimtemplates": "ResourceClaimTemplate",
+    "resourceslice": "ResourceSlice", "resourceslices": "ResourceSlice",
+    "deviceclass": "DeviceClass", "deviceclasses": "DeviceClass",
+    "daemonset": "DaemonSet", "daemonsets": "DaemonSet", "ds": "DaemonSet",
+    "computedomain": "ComputeDomain", "computedomains": "ComputeDomain",
+    "cd": "ComputeDomain",
+    "computedomainclique": "ComputeDomainClique",
+    "computedomaincliques": "ComputeDomainClique",
+}
+
+
+def _resolve_kind(token: str) -> str:
+    kind = _KIND_ALIASES.get(token.lower())
+    if kind is None:
+        raise SystemExit(f"error: unknown resource kind {token!r}")
+    return kind
+
+
+def _summary_row(obj: K8sObject) -> List[str]:
+    extra = ""
+    if obj.kind == "Pod":
+        extra = getattr(obj, "phase", "")
+        if getattr(obj, "ready", False):
+            extra += " (ready)"
+    elif obj.kind == "ComputeDomain":
+        extra = getattr(getattr(obj, "status", None), "status", "")
+    elif obj.kind == "ResourceClaim":
+        alloc = getattr(obj, "allocation", None)
+        extra = "allocated" if alloc and alloc.devices else "pending"
+    elif obj.kind == "ResourceSlice":
+        extra = f"{len(getattr(obj, 'devices', []))} devices"
+    return [obj.namespace or "-", obj.meta.name, extra]
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import os
+    import time as _time
+
+    from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+    from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+    from k8s_dra_driver_tpu.k8s.serialize import to_wire
+
+    parser = argparse.ArgumentParser("tpu-kubectl",
+                                     description="kubectl-style CLI for the TPU DRA stack")
+    parser.add_argument("--server", default=os.environ.get("TPU_KUBECTL_SERVER", ""),
+                        help="API server URL [TPU_KUBECTL_SERVER]")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_apply = sub.add_parser("apply")
+    p_apply.add_argument("-f", "--filename", required=True)
+
+    p_get = sub.add_parser("get")
+    p_get.add_argument("kind")
+    p_get.add_argument("name", nargs="?")
+    p_get.add_argument("-n", "--namespace", default=None)
+    p_get.add_argument("-o", "--output", choices=("table", "json"), default="table")
+
+    p_del = sub.add_parser("delete")
+    p_del.add_argument("kind")
+    p_del.add_argument("name")
+    p_del.add_argument("-n", "--namespace", default="")
+
+    p_wait = sub.add_parser("wait")
+    p_wait.add_argument("kind")
+    p_wait.add_argument("name")
+    p_wait.add_argument("-n", "--namespace", default="")
+    p_wait.add_argument("--for", dest="condition", default="Running",
+                        help="Pod phase / CD status to wait for, or 'deleted'")
+    p_wait.add_argument("--timeout", type=float, default=60.0)
+
+    args = parser.parse_args(argv)
+    if not args.server:
+        raise SystemExit("error: --server (or TPU_KUBECTL_SERVER) is required")
+    api = RemoteAPIServer(args.server)
+
+    if args.cmd == "apply":
+        for obj in apply_file(api, args.filename):
+            print(f"{obj.kind.lower()}/{obj.meta.name} created")
+        return 0
+
+    kind = _resolve_kind(args.kind)
+    if args.cmd == "get":
+        if args.name:
+            objs = [api.get(kind, args.name, args.namespace or "")]
+        else:
+            objs = api.list(kind, namespace=args.namespace)
+        if args.output == "json":
+            print(json.dumps([to_wire(o) for o in objs], indent=1, sort_keys=True))
+        else:
+            rows = [["NAMESPACE", "NAME", "STATUS"]] + [_summary_row(o) for o in objs]
+            widths = [max(len(r[i]) for r in rows) for i in range(3)]
+            for r in rows:
+                print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        return 0
+
+    if args.cmd == "delete":
+        api.delete(kind, args.name, args.namespace)
+        print(f"{args.kind.lower()}/{args.name} deleted")
+        return 0
+
+    if args.cmd == "wait":
+        deadline = _time.monotonic() + args.timeout
+        while _time.monotonic() < deadline:
+            try:
+                obj = api.get(kind, args.name, args.namespace)
+            except NotFoundError:
+                if args.condition == "deleted":
+                    print(f"{args.kind.lower()}/{args.name} deleted")
+                    return 0
+                _time.sleep(0.2)
+                continue
+            state = _summary_row(obj)[2]
+            if args.condition != "deleted" and args.condition in state:
+                print(f"{args.kind.lower()}/{args.name} is {state}")
+                return 0
+            _time.sleep(0.2)
+        raise SystemExit(
+            f"error: timed out waiting for {args.kind}/{args.name} "
+            f"to reach {args.condition!r}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,48 @@
+# Shared harness for the shell e2e tier (the reference's bats helpers.sh
+# analog): boots a simulated cluster process, points tpu-kubectl at it, and
+# tears everything down on exit.
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+export PYTHONPATH="$REPO"
+PY="${PYTHON:-python}"
+
+KUBECTL="$PY -m k8s_dra_driver_tpu.sim.kubectl"
+SIM_PID=""
+
+start_cluster() {  # usage: start_cluster <profile> [extra sim args...]
+  local profile="$1"; shift
+  local logf; logf="$(mktemp)"
+  # Mock the slice-channel char class (the reference CI's mock-NVML
+  # ALT_PROC_DEVICES seam) so CD channel prepares inject device nodes.
+  local procdev; procdev="$(mktemp)"
+  printf 'Character devices:\n511 tpu-slice-channels\n\nBlock devices:\n' > "$procdev"
+  export TPU_DRA_ALT_PROC_DEVICES="$procdev"
+  $PY -m k8s_dra_driver_tpu.sim --port 0 --profile "$profile" "$@" > "$logf" 2>&1 &
+  SIM_PID=$!
+  for _ in $(seq 1 100); do
+    if grep -q "cluster up at" "$logf"; then break; fi
+    if ! kill -0 "$SIM_PID" 2>/dev/null; then
+      echo "sim cluster died:"; cat "$logf"; exit 1
+    fi
+    sleep 0.1
+  done
+  export TPU_KUBECTL_SERVER="$(grep -o 'http://[^ ]*' "$logf" | head -1)"
+  echo "# cluster: $TPU_KUBECTL_SERVER ($profile)"
+}
+
+stop_cluster() {
+  if [ -n "$SIM_PID" ] && kill -0 "$SIM_PID" 2>/dev/null; then
+    kill "$SIM_PID"; wait "$SIM_PID" 2>/dev/null || true
+  fi
+}
+trap stop_cluster EXIT
+
+kubectl() { $KUBECTL "$@"; }
+
+assert_contains() {  # usage: assert_contains <haystack> <needle> <msg>
+  if ! grep -q "$2" <<<"$1"; then
+    echo "FAIL: $3"; echo "  wanted: $2"; echo "  got: $1"; exit 1
+  fi
+}

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# ComputeDomain shell e2e (reference tests/bats/test_cd_*.bats analog):
+# apply a 4-host domain + workers, wait for readiness chain to release the
+# workload, assert bootstrap env, then delete and verify teardown.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-16
+
+kubectl apply -f "$REPO/demo/specs/computedomain/cd-multi-host.yaml"
+kubectl wait computedomain jax-domain -n default --for=Ready --timeout=60
+for i in 0 1 2 3; do
+  kubectl wait pod "worker-$i" -n default --for=Running --timeout=60
+done
+
+pods_json="$(kubectl get pods -n default -o json)"
+$PY - <<PYEOF
+import json
+pods = [p for p in json.loads('''$pods_json''') if p["meta"]["name"].startswith("worker-")]
+assert len(pods) == 4, [p["meta"]["name"] for p in pods]
+ids = sorted(int(p["injected_env"]["TPU_WORKER_ID"]) for p in pods)
+assert ids == [0, 1, 2, 3], ids
+coords = {p["injected_env"]["MEGASCALE_COORDINATOR_ADDRESS"] for p in pods}
+assert len(coords) == 1, coords
+chans = [d for d in pods[0]["injected_devices"] if d.startswith("/dev/tpu-slice-channels/")]
+assert chans, "no channel devices injected"
+print("workers OK:", ids, "coordinator:", coords.pop())
+PYEOF
+
+# Teardown: deleting the CD removes cliques and daemon pods.
+kubectl delete computedomain jax-domain -n default
+kubectl wait computedomain jax-domain -n default --for=deleted --timeout=60
+cliques="$(kubectl get computedomaincliques -n default -o json)"
+[ "$cliques" = "[]" ] || { echo "FAIL: cliques left behind: $cliques"; exit 1; }
+
+echo "PASS test_computedomain"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Quickstart shell e2e (reference tests/bats/test_basic.bats analog):
+# apply the shared-claim spec, wait for both pods, assert they landed on the
+# claim's node and see the same chip.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test2.yaml"
+kubectl wait pod pod0 -n tpu-test2 --for=Running --timeout=30
+kubectl wait pod pod1 -n tpu-test2 --for=Running --timeout=30
+
+pods_json="$(kubectl get pods -n tpu-test2 -o json)"
+nodes="$($PY -c "
+import json,sys
+pods=json.loads(sys.stdin.read())
+print(' '.join(sorted({p['node_name'] for p in pods})))
+print(' '.join(sorted({p['injected_env']['TPU_VISIBLE_CHIPS'] for p in pods})))
+" <<<"$pods_json")"
+node_line="$(head -1 <<<"$nodes")"
+chips_line="$(tail -1 <<<"$nodes")"
+
+[ "$(wc -w <<<"$node_line")" = "1" ] || { echo "FAIL: pods on different nodes: $node_line"; exit 1; }
+[ "$(wc -w <<<"$chips_line")" = "1" ] || { echo "FAIL: pods see different chips: $chips_line"; exit 1; }
+
+claims="$(kubectl get resourceclaims -n tpu-test2)"
+assert_contains "$claims" "allocated" "claim shows allocated"
+
+kubectl delete pod pod0 -n tpu-test2
+kubectl wait pod pod0 -n tpu-test2 --for=deleted --timeout=30
+
+echo "PASS test_quickstart"

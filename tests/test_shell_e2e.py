@@ -1,0 +1,28 @@
+"""Runs the shell e2e tier (tests/shell/*.sh) under pytest — the reference's
+bats suite analog (SURVEY.md §4.4), here driving a simulated cluster process
+through the tpu-kubectl CLI over HTTP."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = sorted(glob.glob(os.path.join(REPO, "tests", "shell", "test_*.sh")))
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=[os.path.basename(s) for s in SCRIPTS])
+def test_shell_scenario(script):
+    env = {**os.environ, "PYTHON": sys.executable, "PYTHONPATH": REPO}
+    # The suite-wide channel seam must not leak in: scripts set their own.
+    env.pop("TPU_DRA_ALT_PROC_DEVICES", None)
+    proc = subprocess.run(
+        ["bash", script], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, (
+        f"{os.path.basename(script)} failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "PASS" in proc.stdout
