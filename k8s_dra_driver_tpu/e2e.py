@@ -167,6 +167,18 @@ def main(argv=None) -> int:
     parser.add_argument("scenarios", nargs="*", default=[])
     parser.add_argument("--list", action="store_true")
     args = parser.parse_args(argv)
+    # Standalone runs self-provision the slice-channel mock seam (conftest
+    # and the shell helpers do the same for their tiers): without a real
+    # tpu-slice-channels char class, CD channel prepares retry forever.
+    if "TPU_DRA_ALT_PROC_DEVICES" not in os.environ:
+        from k8s_dra_driver_tpu.pkg import devcaps
+        if devcaps.get_char_device_major() is None:
+            import atexit
+            seam = os.path.join(tempfile.gettempdir(), f"e2e-procdev-{os.getpid()}")
+            with open(seam, "w", encoding="utf-8") as f:
+                f.write("Character devices:\n511 tpu-slice-channels\n\nBlock devices:\n")
+            os.environ["TPU_DRA_ALT_PROC_DEVICES"] = seam
+            atexit.register(lambda: os.path.exists(seam) and os.unlink(seam))
     if args.list:
         for name in SCENARIOS:
             print(name)

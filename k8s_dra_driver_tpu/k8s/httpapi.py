@@ -26,6 +26,7 @@ import json
 import logging
 import queue
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -62,6 +63,7 @@ WATCH_HEARTBEAT_S = 5.0
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     api: APIServer  # set by serve_api subclassing
+    stopping: threading.Event  # server shutdown: terminate watch streams
 
     def log_message(self, *args: object) -> None:  # quiet
         pass
@@ -118,6 +120,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
             self._send_error_obj(e)
+        except (ValueError, KeyError) as e:
+            # Malformed labels= JSON / invalid body must not tear down the
+            # connection without a JSON error document.
+            self._send_json(400, {"error": "BadRequest", "message": str(e)})
         except (BrokenPipeError, ConnectionResetError):
             pass
 
@@ -131,6 +137,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
             self._send_error_obj(e)
+        except (ValueError, KeyError) as e:
+            # Malformed labels= JSON / invalid body must not tear down the
+            # connection without a JSON error document.
+            self._send_json(400, {"error": "BadRequest", "message": str(e)})
 
     def do_PUT(self) -> None:  # noqa: N802
         _, parts, _ = self._route()
@@ -142,6 +152,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
             self._send_error_obj(e)
+        except (ValueError, KeyError) as e:
+            # Malformed labels= JSON / invalid body must not tear down the
+            # connection without a JSON error document.
+            self._send_json(400, {"error": "BadRequest", "message": str(e)})
 
     def do_DELETE(self) -> None:  # noqa: N802
         _, parts, q = self._route()
@@ -155,6 +169,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
             self._send_error_obj(e)
+        except (ValueError, KeyError) as e:
+            # Malformed labels= JSON / invalid body must not tear down the
+            # connection without a JSON error document.
+            self._send_json(400, {"error": "BadRequest", "message": str(e)})
 
     # -- watch streaming ----------------------------------------------------
 
@@ -178,13 +196,18 @@ class _Handler(BaseHTTPRequestHandler):
             # The queue is registered: tell the client its watch is live so
             # it can order a subsequent list after the subscription.
             write_line({"type": "SYNC"})
-            while True:
+            last_beat = time.monotonic()
+            while not self.stopping.is_set():
                 try:
-                    ev = wq.get(timeout=WATCH_HEARTBEAT_S)
+                    ev = wq.get(timeout=0.5)
                 except queue.Empty:
-                    write_line({"type": "HEARTBEAT"})
+                    if time.monotonic() - last_beat >= WATCH_HEARTBEAT_S:
+                        write_line({"type": "HEARTBEAT"})
+                        last_beat = time.monotonic()
                     continue
                 write_line({"type": ev.type, "object": to_wire(ev.obj)})
+            # Server stopping: end the stream so clients see the outage and
+            # reconnect (a real apiserver severs watches on restart too).
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
@@ -202,6 +225,7 @@ class HTTPAPIServer:
             pass
 
         Handler.api = self.api
+        Handler.stopping = self._stopping = threading.Event()
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -223,6 +247,7 @@ class HTTPAPIServer:
         return self
 
     def stop(self) -> None:
+        self._stopping.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
@@ -246,6 +271,7 @@ class RemoteAPIServer:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._watch_stops: Dict[int, threading.Event] = {}
+        self._watch_known: Dict[int, Dict[Tuple[str, str], K8sObject]] = {}
 
     # -- plumbing ----------------------------------------------------------
 
@@ -331,26 +357,80 @@ class RemoteAPIServer:
         self._watch_stops[id(q)] = stop
         query = self._q(name=name, ns=namespace)
 
-        def reader() -> None:
+        # Objects this watch has delivered and not yet seen deleted, keyed by
+        # (namespace, name) — lets a resync synthesize DELETED events for
+        # objects that vanished while the stream was down. Exposed per-queue
+        # so list_and_watch can seed it with its snapshot (objects a consumer
+        # learned from the list, not the stream, must also be diffed).
+        known: Dict[Tuple[str, str], K8sObject] = {}
+        self._watch_known[id(q)] = known
+
+        def emit(ev_type: str, obj: K8sObject) -> None:
+            key = (obj.namespace or "", obj.meta.name)
+            if ev_type == "DELETED":
+                known.pop(key, None)
+            else:
+                known[key] = obj
+            q.put(WatchEvent(ev_type, obj))
+
+        def replay_list() -> None:
+            live = {}
+            for obj in self.list(kind, namespace=namespace):
+                if name is None or obj.meta.name == name:
+                    live[(obj.namespace or "", obj.meta.name)] = obj
+            # Anything we knew about that the snapshot no longer contains was
+            # deleted during the outage.
+            for key, obj in list(known.items()):
+                if key not in live:
+                    emit("DELETED", obj)
+            for obj in live.values():
+                emit("ADDED", obj)
+
+        def stream_once(resync: bool) -> None:
             req = urllib.request.Request(self.base_url + f"/watch/{kind}" + query)
+            with urllib.request.urlopen(req, timeout=None) as resp:
+                for raw in resp:
+                    if stop.is_set():
+                        return
+                    doc = json.loads(raw)
+                    kind_ = doc.get("type")
+                    if kind_ == "SYNC":
+                        if resync:
+                            # Subscription is live again: replay the current
+                            # state (ADDED + synthesized DELETED) so informer
+                            # caches converge on everything missed during the
+                            # outage. Listing after SYNC means no gap between
+                            # snapshot and stream; informers absorb replays.
+                            replay_list()
+                        synced.set()
+                        continue
+                    if kind_ == "HEARTBEAT":
+                        continue
+                    emit(doc["type"], from_wire(doc["object"]))
+
+        def reader() -> None:
+            # Reconnect on unexpected stream end (apiserver restart, network
+            # blip) rather than leaving informers — incl. the PodManager
+            # readiness mirror — on a stale cache forever.
+            first = True
             try:
-                with urllib.request.urlopen(req, timeout=None) as resp:
-                    for raw in resp:
+                while not stop.is_set():
+                    try:
+                        stream_once(resync=not first)
+                        if not stop.is_set():
+                            log.warning("watch stream for %s ended; reconnecting", kind)
+                    except (OSError, json.JSONDecodeError, ApiError):
+                        # ApiError covers replay_list()'s HTTP list failing
+                        # (e.g. 500 while the server restarts) — the thread
+                        # must survive to retry, not die silently.
                         if stop.is_set():
                             return
-                        doc = json.loads(raw)
-                        kind_ = doc.get("type")
-                        if kind_ == "SYNC":
-                            synced.set()
-                            continue
-                        if kind_ == "HEARTBEAT":
-                            continue
-                        q.put(WatchEvent(doc["type"], from_wire(doc["object"])))
-            except (OSError, json.JSONDecodeError):
-                if not stop.is_set():
-                    log.warning("watch stream for %s ended", kind)
+                        log.warning("watch stream for %s errored; reconnecting", kind)
+                    first = False
+                    synced.set()  # never leave the caller blocked
+                    stop.wait(timeout=1.0)
             finally:
-                synced.set()  # never leave the caller blocked
+                synced.set()
 
         threading.Thread(target=reader, name=f"watch-{kind}", daemon=True).start()
         # Block until the server registered the subscription: events emitted
@@ -360,6 +440,7 @@ class RemoteAPIServer:
         return q
 
     def stop_watch(self, kind: str, q: "queue.Queue[WatchEvent]") -> None:
+        self._watch_known.pop(id(q), None)
         stop = self._watch_stops.pop(id(q), None)
         if stop:
             stop.set()
@@ -374,6 +455,13 @@ class RemoteAPIServer:
         objs = self.list(kind, namespace=namespace)
         if name is not None:
             objs = [o for o in objs if o.meta.name == name]
+        # Seed the watch's known-object map with the snapshot: a consumer's
+        # cache built from this list must see synthesized DELETED events for
+        # these objects too if they vanish during a stream outage.
+        known = self._watch_known.get(id(q))
+        if known is not None:
+            for obj in objs:
+                known.setdefault((obj.namespace or "", obj.meta.name), obj)
         return objs, q
 
 

@@ -304,6 +304,11 @@ class ComputeDomainDriver:
         for other_uid, entry in cp.claims.items():
             if other_uid == claim_uid:
                 continue
+            # Only completed prepares hold a channel; Aborted tombstones and
+            # stale Started entries must not block the id (the reference
+            # filters on ClaimCheckpointStatePrepareCompleted the same way).
+            if entry.state != PREPARE_COMPLETED:
+                continue
             for d in entry.devices:
                 if d.device_type == "channel" and d.extra.get("channel_id", 0) == channel_id:
                     raise PermanentError(

@@ -8,7 +8,7 @@ serves the same service over local HTTP and announces itself through a
 registration file in the plugin dir — the kubelet-side discovery scan of
 the plugin registration directory:
 
-    {plugin_dir}/registration.json   {"driver", "endpoint", "node"}
+    {plugin_dir}/{driver_name}-registration.json   {"driver", "endpoint", "node"}
 
 Routes:
     POST /v1/prepare     {"claims": [wire ResourceClaim, ...]}
@@ -117,7 +117,12 @@ class DRAPluginServer:
 
     @property
     def registration_path(self) -> str:
-        return os.path.join(self.plugin_dir, REGISTRATION_FILE)
+        # Namespaced by driver name: both kubelet plugins may share one
+        # plugin dir, and each driver gets its own registration (the
+        # reference gives each driver its own plugin socket the same way).
+        return os.path.join(
+            self.plugin_dir, f"{self.driver.driver_name}-{REGISTRATION_FILE}"
+        )
 
     def start(self) -> "DRAPluginServer":
         self._thread = threading.Thread(

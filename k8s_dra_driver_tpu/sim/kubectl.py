@@ -264,7 +264,10 @@ def main(argv=None) -> int:
                 _time.sleep(0.2)
                 continue
             state = _summary_row(obj)[2]
-            if args.condition != "deleted" and args.condition in state:
+            # Exact-token match: "--for=Ready" must not match "NotReady";
+            # pod states render as "Running (ready)" so accept a phase prefix.
+            reached = state == args.condition or state.startswith(args.condition + " ")
+            if args.condition != "deleted" and reached:
                 print(f"{args.kind.lower()}/{args.name} is {state}")
                 return 0
             _time.sleep(0.2)

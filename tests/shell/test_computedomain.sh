@@ -7,12 +7,12 @@ source "$(dirname "$0")/helpers.sh"
 start_cluster v5e-16
 
 kubectl apply -f "$REPO/demo/specs/computedomain/cd-multi-host.yaml"
-kubectl wait computedomain jax-domain -n default --for=Ready --timeout=60
+kubectl wait computedomain jax-domain -n cd-multi --for=Ready --timeout=60
 for i in 0 1 2 3; do
-  kubectl wait pod "worker-$i" -n default --for=Running --timeout=60
+  kubectl wait pod "worker-$i" -n cd-multi --for=Running --timeout=60
 done
 
-pods_json="$(kubectl get pods -n default -o json)"
+pods_json="$(kubectl get pods -n cd-multi -o json)"
 $PY - <<PYEOF
 import json
 pods = [p for p in json.loads('''$pods_json''') if p["meta"]["name"].startswith("worker-")]
@@ -27,9 +27,9 @@ print("workers OK:", ids, "coordinator:", coords.pop())
 PYEOF
 
 # Teardown: deleting the CD removes cliques and daemon pods.
-kubectl delete computedomain jax-domain -n default
-kubectl wait computedomain jax-domain -n default --for=deleted --timeout=60
-cliques="$(kubectl get computedomaincliques -n default -o json)"
+kubectl delete computedomain jax-domain -n cd-multi
+kubectl wait computedomain jax-domain -n cd-multi --for=deleted --timeout=60
+cliques="$(kubectl get computedomaincliques -n cd-multi -o json)"
 [ "$cliques" = "[]" ] || { echo "FAIL: cliques left behind: $cliques"; exit 1; }
 
 echo "PASS test_computedomain"

@@ -134,3 +134,72 @@ def test_watch_stream_and_informer(remote):
         assert {p.meta.name for p in inf.list()} == {"i1", "i2"}
     finally:
         inf.stop()
+
+
+def test_watch_reconnects_after_server_restart(tmp_path):
+    """Outage resilience: a watch must survive an apiserver restart, replay
+    surviving objects and synthesize DELETED for objects removed during the
+    outage — otherwise informers (incl. the PodManager readiness mirror)
+    serve a stale cache forever."""
+    api = APIServer()
+    srv = HTTPAPIServer(api).start()
+    host, port = "127.0.0.1", srv.port
+    remote = RemoteAPIServer(srv.url)
+    q = remote.watch(POD)
+    # Created after watch(): the stream delivers these, populating the
+    # client's known-object set that the resync diffs against.
+    api.create(Pod(meta=new_meta("survivor", "ns")))
+    api.create(Pod(meta=new_meta("victim", "ns")))
+    events = []
+
+    def drain(want):
+        def check():
+            while not q.empty():
+                events.append(q.get_nowait())
+            return want(events)
+        wait_for(check, msg=f"watch events: {[e.type for e in events]}")
+
+    drain(lambda evs: {e.obj.meta.name for e in evs} == {"survivor", "victim"})
+    # Outage: stop the server, mutate state while the stream is down, then
+    # bring a new server up on the same port with the same backing store.
+    srv.stop()
+    api.delete(POD, "victim", "ns")
+    api.create(Pod(meta=new_meta("newcomer", "ns")))
+    events.clear()
+    srv2 = HTTPAPIServer(api, host=host, port=port).start()
+    try:
+        drain(lambda evs: any(e.type == "DELETED" and e.obj.meta.name == "victim"
+                              for e in evs)
+              and any(e.type == "ADDED" and e.obj.meta.name == "newcomer"
+                      for e in evs))
+        # Live events flow again after the resync.
+        api.create(Pod(meta=new_meta("post-outage", "ns")))
+        drain(lambda evs: any(e.obj.meta.name == "post-outage" for e in evs))
+    finally:
+        remote.stop_watch(POD, q)
+        srv2.stop()
+
+
+def test_informer_list_seeded_cache_survives_outage_delete():
+    """An informer that learned an object from list_and_watch's snapshot
+    (not the stream) must still see a synthesized DELETED when the object
+    vanishes during a stream outage."""
+    api = APIServer()
+    srv = HTTPAPIServer(api).start()
+    port = srv.port
+    remote = RemoteAPIServer(srv.url)
+    api.create(Pod(meta=new_meta("preexisting", "ns")))
+    inf = Informer(remote, POD)
+    inf.start()
+    try:
+        wait_for(lambda: any(p.meta.name == "preexisting" for p in inf.list()),
+                 msg="informer snapshot")
+        srv.stop()
+        api.delete(POD, "preexisting", "ns")
+        srv2 = HTTPAPIServer(api, port=port).start()
+        try:
+            wait_for(lambda: not inf.list(), msg="informer prunes deleted pod")
+        finally:
+            srv2.stop()
+    finally:
+        inf.stop()

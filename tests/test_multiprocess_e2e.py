@@ -30,6 +30,7 @@ from k8s_dra_driver_tpu.k8s.core import (
 from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
 from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
 from k8s_dra_driver_tpu.k8s.serialize import to_wire
+from k8s_dra_driver_tpu.plugins.server import REGISTRATION_FILE
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -77,7 +78,7 @@ class PluginProc:
             env=self.env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
-        reg = os.path.join(self.plugin_dir, "registration.json")
+        reg = os.path.join(self.plugin_dir, f"{TPU_DRIVER_NAME}-{REGISTRATION_FILE}")
         _wait(lambda: os.path.exists(reg) or self.proc.poll() is not None,
               msg="plugin registration file")
         if self.proc.poll() is not None:
@@ -94,7 +95,7 @@ class PluginProc:
         # SIGKILL leaves the registration file behind (no cleanup ran); drop
         # it so the restart's fresh registration is what gets discovered.
         try:
-            os.unlink(os.path.join(self.plugin_dir, "registration.json"))
+            os.unlink(os.path.join(self.plugin_dir, f"{TPU_DRIVER_NAME}-{REGISTRATION_FILE}"))
         except FileNotFoundError:
             pass
 
