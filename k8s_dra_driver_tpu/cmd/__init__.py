@@ -29,14 +29,34 @@ def resolve_api(args: argparse.Namespace) -> APIServer:
         if not args.api_server_url:
             raise SystemExit("error: --api-backend http requires --api-server-url")
         return RemoteAPIServer(args.api_server_url)  # type: ignore[return-value]
-    # Operator-facing: a clean error, not a traceback.
-    raise SystemExit(
-        "error: api-backend 'kubernetes' requires a real-cluster adapter "
-        "implementing k8s_dra_driver_tpu.k8s.APIServer's interface "
-        "(create/get/list/update/delete/watch); run with --api-backend sim, "
-        "--api-backend http against tpu-dra-apiserver, or embed the "
-        "components with your own APIServer"
-    )
+    if args.api_backend == "kubernetes":
+        from k8s_dra_driver_tpu.k8s.kubeclient import (
+            KubeAuth,
+            KubeConfigError,
+            KubernetesAPIServer,
+        )
+
+        # --api-server-url points at a plain-HTTP apiserver (the conformance
+        # server / a kubectl proxy); otherwise resolve kubeconfig/in-cluster
+        # credentials exactly like the reference's kubeclient flag bundle
+        # (/root/reference/pkg/flags/kubeclient.go).
+        try:
+            if args.api_server_url:
+                return KubernetesAPIServer(  # type: ignore[return-value]
+                    base_url=args.api_server_url
+                )
+            auth = KubeAuth.resolve(
+                kubeconfig=getattr(args, "kubeconfig", ""),
+                context=getattr(args, "kube_context", ""),
+            )
+            return KubernetesAPIServer(auth=auth)  # type: ignore[return-value]
+        except (KubeConfigError, OSError) as e:
+            raise SystemExit(
+                f"error: api-backend 'kubernetes': {e} "
+                "(provide --kubeconfig, run in-cluster, or point "
+                "--api-server-url at an apiserver/kubectl-proxy URL)"
+            ) from None
+    raise SystemExit(f"error: unknown api-backend {args.api_backend!r}")
 
 
 def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
@@ -50,5 +70,17 @@ def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
     )
     parser.add_argument(
         "--api-server-url", default=os.environ.get("API_SERVER_URL", ""),
-        help="base URL for --api-backend http",
+        help="base URL for --api-backend http, or a plain-HTTP k8s apiserver "
+        "endpoint (conformance server / kubectl proxy) for "
+        "--api-backend kubernetes",
+    )
+    parser.add_argument(
+        "--kubeconfig", default=os.environ.get("KUBECONFIG_PATH", ""),
+        help="kubeconfig path for --api-backend kubernetes "
+        "[KUBECONFIG_PATH; falls back to $KUBECONFIG, ~/.kube/config, "
+        "then in-cluster credentials]",
+    )
+    parser.add_argument(
+        "--kube-context", default=os.environ.get("KUBE_CONTEXT", ""),
+        help="kubeconfig context override [KUBE_CONTEXT]",
     )

@@ -1,0 +1,407 @@
+"""Conformance kube-apiserver: serves the real Kubernetes REST wire protocol
+over the in-process store.
+
+This is the mock-NVML-kind-cluster analog for the API seam (reference CI:
+/root/reference/hack/ci/mock-nvml, .github/workflows/mock-nvml-e2e.yaml):
+`KubernetesAPIServer` (kubeclient.py) — the adapter the five binaries use
+with ``--api-backend kubernetes`` — is exercised against this server in CI,
+so the codec and REST/watch plumbing that will face a live cluster are
+tested on every run without one.
+
+Protocol surface (the subset the driver exercises, matching a real
+apiserver's behavior):
+
+    GET/POST       /api/v1/namespaces/{ns}/pods            core, namespaced
+    GET/PUT/DELETE /api/v1/namespaces/{ns}/pods/{name}
+    GET/POST       /apis/{group}/{version}/{plural}        cluster-scoped
+    GET            ...?labelSelector=k%3Dv,k2%3Dv2
+    GET            ...?watch=true[&fieldSelector=metadata.name%3Dx]
+                   -> JSON-lines {"type": ADDED|MODIFIED|DELETED, "object"}
+    PUT            .../{name}/status                        status subresource
+    errors         -> application/json k8s Status objects (404/409/422)
+
+List responses are `<Kind>List` envelopes. Writes to a resource with a
+status subresource ignore status changes (and vice versa), as on a real
+apiserver — the adapter must split updates accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.k8swire import (
+    RESOURCE_MAP,
+    from_k8s_wire,
+    kind_for_plural,
+    to_k8s_wire,
+)
+from k8s_dra_driver_tpu.k8s.objects import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
+from k8s_dra_driver_tpu.k8s.store import APIServer
+
+log = logging.getLogger(__name__)
+
+# Kinds whose /status is a separate subresource on a real apiserver. The
+# ComputeDomain CRD declares `subresources: status: {}` (helm/crds), and the
+# built-ins below behave this way upstream.
+STATUS_SUBRESOURCE_KINDS = {
+    "Pod", "Node", "DaemonSet", "Deployment", "ResourceClaim", "ComputeDomain",
+}
+
+# Internal dataclass fields that live under .status on the k8s wire, per
+# kind — used to split main-resource writes from status writes.
+_STATUS_FIELDS = {
+    "Pod": ("phase", "pod_ip", "ready", "conditions"),
+    "Node": ("addresses", "allocatable"),
+    "DaemonSet": ("desired", "ready"),
+    "Deployment": ("ready",),
+    "ResourceClaim": ("allocation", "reserved_for"),
+    "ComputeDomain": ("status",),
+}
+
+WATCH_HEARTBEAT_S = 5.0
+
+
+def _status_error(e: Exception) -> Tuple[int, Dict[str, Any]]:
+    code, reason = {
+        NotFoundError: (404, "NotFound"),
+        AlreadyExistsError: (409, "AlreadyExists"),
+        ConflictError: (409, "Conflict"),
+    }.get(type(e), (500, "InternalError"))
+    return code, {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": str(e),
+        "reason": reason,
+        "code": code,
+    }
+
+
+def _parse_label_selector(raw: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k.strip().rstrip("!")] = v.strip().lstrip("=")
+    return out
+
+
+def _parse_field_selector(raw: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _merge_status(kind: str, base, incoming):
+    """Copy the status-backed fields of `incoming` onto a copy of `base`."""
+    out = base.deepcopy()
+    for f in _STATUS_FIELDS.get(kind, ()):  # noqa: B905
+        setattr(out, f, getattr(incoming, f))
+    return out
+
+
+def _merge_main(kind: str, base, incoming):
+    """Copy everything EXCEPT status-backed fields from `incoming` onto a
+    copy of `base` (metadata travels with the main resource)."""
+    out = incoming.deepcopy()
+    for f in _STATUS_FIELDS.get(kind, ()):  # noqa: B905
+        setattr(out, f, getattr(base, f))
+    return out
+
+
+class _Route:
+    """Decomposed request path: kind, namespace, name, subresource."""
+
+    def __init__(self, kind: str, namespace: str, name: str, subresource: str):
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def _parse_path(path: str) -> Optional[_Route]:
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... (core) or /apis/<group>/<version>/...
+    if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+        rest = parts[2:]
+    elif len(parts) >= 3 and parts[0] == "apis":
+        rest = parts[3:]
+    else:
+        return None
+    namespace = ""
+    if len(rest) >= 2 and rest[0] == "namespaces":
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest:
+        return None
+    plural, rest = rest[0], rest[1:]
+    kind = kind_for_plural(plural)
+    if kind is None:
+        return None
+    name = rest[0] if rest else ""
+    subresource = rest[1] if len(rest) > 1 else ""
+    return _Route(kind, namespace, name, subresource)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    api: APIServer
+    stopping: threading.Event
+
+    def log_message(self, *args: object) -> None:  # quiet
+        pass
+
+    def _send_json(self, status: int, doc: dict) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_err(self, e: Exception) -> None:
+        code, doc = _status_error(e)
+        self._send_json(code, doc)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _route_and_query(self) -> Tuple[Optional[_Route], Dict[str, List[str]]]:
+        parsed = urllib.parse.urlparse(self.path)
+        return _parse_path(parsed.path), urllib.parse.parse_qs(parsed.query)
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        route, q = self._route_and_query()
+        try:
+            if route is None:
+                if urllib.parse.urlparse(self.path).path in ("/healthz", "/readyz"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                raise NotFoundError(f"no route for {self.path}")
+            if q.get("watch", ["false"])[0] == "true":
+                self._stream_watch(route, q)
+                return
+            if route.name:
+                obj = self.api.get(route.kind, route.name, route.namespace)
+                self._send_json(200, to_k8s_wire(obj))
+                return
+            labels = None
+            if "labelSelector" in q:
+                labels = _parse_label_selector(q["labelSelector"][0])
+            ns: Optional[str] = route.namespace or None
+            objs = self.api.list(route.kind, namespace=ns, label_selector=labels)
+            fields = _parse_field_selector(q.get("fieldSelector", [""])[0])
+            want_name = fields.get("metadata.name")
+            if want_name:
+                objs = [o for o in objs if o.meta.name == want_name]
+            api_version, _, _ = RESOURCE_MAP[route.kind]
+            self._send_json(200, {
+                "apiVersion": api_version,
+                "kind": f"{route.kind}List",
+                "metadata": {"resourceVersion": str(int(time.time() * 1000))},
+                "items": [to_k8s_wire(o) for o in objs],
+            })
+        except ApiError as e:
+            self._send_err(e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except (ValueError, KeyError) as e:
+            self._send_json(400, _status_error(e)[1] | {"code": 400, "reason": "BadRequest"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        route, _ = self._route_and_query()
+        try:
+            if route is None or route.name:
+                raise NotFoundError(f"no route for POST {self.path}")
+            obj = from_k8s_wire(self._body())
+            if route.namespace and not obj.meta.namespace:
+                obj.meta.namespace = route.namespace
+            created = self.api.create(obj)
+            self._send_json(201, to_k8s_wire(created))
+        except ApiError as e:
+            self._send_err(e)
+        except (ValueError, KeyError) as e:
+            self._send_json(400, _status_error(e)[1] | {"code": 400, "reason": "BadRequest"})
+
+    def do_PUT(self) -> None:  # noqa: N802
+        route, _ = self._route_and_query()
+        try:
+            if route is None or not route.name:
+                raise NotFoundError(f"no route for PUT {self.path}")
+            incoming = from_k8s_wire(self._body())
+            if route.namespace and not incoming.meta.namespace:
+                incoming.meta.namespace = route.namespace
+            current = self.api.get(route.kind, route.name, route.namespace)
+            if route.subresource == "status":
+                # Status writes: only status fields change; CAS on the
+                # incoming resourceVersion.
+                merged = _merge_status(route.kind, current, incoming)
+                merged.meta.resource_version = incoming.meta.resource_version
+            elif route.kind in STATUS_SUBRESOURCE_KINDS:
+                # Main-resource writes ignore status changes, like a real
+                # apiserver with the status subresource enabled.
+                merged = _merge_main(route.kind, current, incoming)
+            else:
+                merged = incoming
+            updated = self.api.update(merged)
+            self._send_json(200, to_k8s_wire(updated))
+        except ApiError as e:
+            self._send_err(e)
+        except (ValueError, KeyError) as e:
+            self._send_json(400, _status_error(e)[1] | {"code": 400, "reason": "BadRequest"})
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        route, _ = self._route_and_query()
+        try:
+            if route is None or not route.name:
+                raise NotFoundError(f"no route for DELETE {self.path}")
+            self.api.delete(route.kind, route.name, route.namespace)
+            self._send_json(200, {
+                "kind": "Status", "apiVersion": "v1", "status": "Success",
+            })
+        except ApiError as e:
+            self._send_err(e)
+
+    # -- watch -------------------------------------------------------------
+
+    def _stream_watch(self, route: _Route, q: Dict[str, List[str]]) -> None:
+        fields = _parse_field_selector(q.get("fieldSelector", [""])[0])
+        name = fields.get("metadata.name") or (route.name or None)
+        labels = (_parse_label_selector(q["labelSelector"][0])
+                  if "labelSelector" in q else None)
+        wq = self.api.watch(route.kind, name=name,
+                            namespace=route.namespace or None)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_line(doc: dict) -> None:
+                line = (json.dumps(doc) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            # resourceVersion semantics: a client that lists then watches
+            # passes the list rv. The store keeps no event history, so
+            # replay the current snapshot as ADDED — at-least-once, which
+            # informer caches absorb (same property as list+watch replays
+            # against a real apiserver after a 410).
+            if q.get("resourceVersion", [""])[0] not in ("", "0"):
+                for obj in self.api.list(route.kind,
+                                         namespace=route.namespace or None,
+                                         label_selector=labels):
+                    if name and obj.meta.name != name:
+                        continue
+                    write_line({"type": "ADDED", "object": to_k8s_wire(obj)})
+            last_beat = time.monotonic()
+            while not self.stopping.is_set():
+                try:
+                    ev = wq.get(timeout=0.5)
+                except queue.Empty:
+                    if time.monotonic() - last_beat >= WATCH_HEARTBEAT_S:
+                        # BOOKMARK doubles as liveness signal; real
+                        # apiservers emit these with allowWatchBookmarks.
+                        write_line({"type": "BOOKMARK", "object": {
+                            "kind": route.kind,
+                            "metadata": {"resourceVersion": "0"},
+                        }})
+                        last_beat = time.monotonic()
+                    continue
+                if labels is not None:
+                    obj_labels = ev.obj.meta.labels
+                    if any(obj_labels.get(k) != v for k, v in labels.items()):
+                        continue
+                write_line({"type": ev.type, "object": to_k8s_wire(ev.obj)})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.api.stop_watch(route.kind, wq)
+
+
+class K8sAPIServer:
+    """Hosts the conformance apiserver on a background thread."""
+
+    def __init__(self, api: Optional[APIServer] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.api = api or APIServer()
+
+        class Handler(_Handler):
+            pass
+
+        Handler.api = self.api
+        Handler.stopping = self._stopping = threading.Event()
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "K8sAPIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="k8s-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        "tpu-dra-k8sapiserver",
+        description="conformance apiserver speaking the real k8s REST wire",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8002)
+    args = parser.parse_args(argv)
+    srv = K8sAPIServer(host=args.host, port=args.port).start()
+    print(f"serving k8s wire on {srv.url}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,953 @@
+"""Internal objects <-> real Kubernetes JSON wire format.
+
+The sim/http tiers speak the compact internal wire (`serialize.py`); a real
+cluster speaks the Kubernetes API forms — `resource.k8s.io/v1beta1`
+ResourceSlice/ResourceClaim (KEP-4381 shapes), `apps/v1` DaemonSet,
+`coordination.k8s.io/v1` Lease, and the `resource.tpu.google.com/v1beta1`
+CRDs this driver ships. This codec is the client-go-generated-types analog
+(reference: /root/reference/pkg/nvidia.com + vendored k8s.io/api): one
+encode/decode pair per kind, exercised from both sides by the conformance
+apiserver (`k8sapiserver.py`) and the real-cluster adapter
+(`kubeclient.py`).
+
+Lossiness is deliberate and one-way only: sim-only fields (Pod.injected_*)
+do not encode; unknown incoming fields are ignored the way client-go drops
+unknown JSON members.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomain,
+    ComputeDomainChannelSpec,
+    ComputeDomainClique,
+    ComputeDomainDaemonInfo,
+    ComputeDomainNode,
+    ComputeDomainSpec,
+    ComputeDomainStatus,
+)
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    Container,
+    Counter,
+    CounterSet,
+    DaemonSet,
+    Deployment,
+    Device,
+    DeviceClaimConfig,
+    DeviceClass,
+    DeviceCounterConsumption,
+    DeviceRequest,
+    DeviceRequestAllocationResult,
+    DeviceTaint,
+    Node,
+    NodeTaint,
+    OpaqueDeviceConfig,
+    Pod,
+    PodCondition,
+    PodResourceClaimRef,
+    PodTemplate,
+    ResourceClaim,
+    ResourceClaimConsumer,
+    ResourceClaimTemplate,
+    ResourcePool,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.k8s.objects import K8sObject, ObjectMeta, OwnerReference
+from k8s_dra_driver_tpu.pkg.leaderelection import Lease
+
+# kind -> (apiVersion, plural, namespaced)
+RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
+    "Pod": ("v1", "pods", True),
+    "Node": ("v1", "nodes", False),
+    "DaemonSet": ("apps/v1", "daemonsets", True),
+    "Deployment": ("apps/v1", "deployments", True),
+    "ResourceClaim": ("resource.k8s.io/v1beta1", "resourceclaims", True),
+    "ResourceClaimTemplate": ("resource.k8s.io/v1beta1", "resourceclaimtemplates", True),
+    "ResourceSlice": ("resource.k8s.io/v1beta1", "resourceslices", False),
+    "DeviceClass": ("resource.k8s.io/v1beta1", "deviceclasses", False),
+    "ComputeDomain": ("resource.tpu.google.com/v1beta1", "computedomains", True),
+    "ComputeDomainClique": ("resource.tpu.google.com/v1beta1", "computedomaincliques", True),
+    "Lease": ("coordination.k8s.io/v1", "leases", True),
+}
+
+_PLURAL_TO_KIND = {plural: kind for kind, (_, plural, _ns) in RESOURCE_MAP.items()}
+
+
+def kind_for_plural(plural: str) -> Optional[str]:
+    return _PLURAL_TO_KIND.get(plural)
+
+
+def api_path(kind: str, namespace: str = "", name: str = "") -> str:
+    """REST path for a kind: /api/v1/... (core) or /apis/<group>/..."""
+    api_version, plural, namespaced = RESOURCE_MAP[kind]
+    root = f"/api/{api_version}" if "/" not in api_version else f"/apis/{api_version}"
+    path = root
+    if namespaced and namespace:
+        path += f"/namespaces/{namespace}"
+    path += f"/{plural}"
+    if name:
+        path += f"/{name}"
+    return path
+
+
+# -- timestamps -------------------------------------------------------------
+
+
+def _ts_encode(epoch: Optional[float]) -> Optional[str]:
+    if not epoch:
+        return None
+    dt = datetime.datetime.fromtimestamp(epoch, tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _ts_encode_micro(epoch: Optional[float]) -> Optional[str]:
+    if not epoch:
+        return None
+    dt = datetime.datetime.fromtimestamp(epoch, tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _ts_decode(s: Optional[str]) -> float:
+    if not s:
+        return 0.0
+    s = s.replace("Z", "+00:00")
+    try:
+        return datetime.datetime.fromisoformat(s).timestamp()
+    except ValueError:
+        return 0.0
+
+
+# -- metadata ---------------------------------------------------------------
+
+
+def _meta_encode(meta: ObjectMeta) -> Dict[str, Any]:
+    md: Dict[str, Any] = {"name": meta.name}
+    if meta.namespace:
+        md["namespace"] = meta.namespace
+    if meta.uid:
+        md["uid"] = meta.uid
+    if meta.resource_version:
+        md["resourceVersion"] = str(meta.resource_version)
+    if meta.generation:
+        md["generation"] = meta.generation
+    if meta.labels:
+        md["labels"] = dict(meta.labels)
+    if meta.annotations:
+        md["annotations"] = dict(meta.annotations)
+    if meta.finalizers:
+        md["finalizers"] = list(meta.finalizers)
+    if meta.owner_references:
+        md["ownerReferences"] = [
+            {
+                "apiVersion": RESOURCE_MAP.get(r.kind, ("v1",))[0],
+                "kind": r.kind,
+                "name": r.name,
+                "uid": r.uid,
+                "controller": r.controller,
+            }
+            for r in meta.owner_references
+        ]
+    if meta.creation_timestamp:
+        md["creationTimestamp"] = _ts_encode(meta.creation_timestamp)
+    if meta.deletion_timestamp is not None:
+        md["deletionTimestamp"] = _ts_encode(meta.deletion_timestamp)
+    return md
+
+
+def _meta_decode(md: Dict[str, Any]) -> ObjectMeta:
+    rv_raw = md.get("resourceVersion", "0")
+    try:
+        rv = int(rv_raw)
+    except (TypeError, ValueError):
+        # Opaque non-decimal resourceVersion: keep CAS semantics by hashing
+        # into an int — the adapter echoes the original string on writes.
+        rv = abs(hash(rv_raw)) % (1 << 62)
+    return ObjectMeta(
+        name=md.get("name", ""),
+        namespace=md.get("namespace", ""),
+        uid=md.get("uid", ""),
+        resource_version=rv,
+        generation=md.get("generation", 0),
+        labels=dict(md.get("labels") or {}),
+        annotations=dict(md.get("annotations") or {}),
+        finalizers=list(md.get("finalizers") or []),
+        owner_references=[
+            OwnerReference(
+                kind=r.get("kind", ""),
+                name=r.get("name", ""),
+                uid=r.get("uid", ""),
+                controller=bool(r.get("controller", True)),
+            )
+            for r in md.get("ownerReferences") or []
+        ],
+        creation_timestamp=_ts_decode(md.get("creationTimestamp")),
+        deletion_timestamp=(
+            _ts_decode(md["deletionTimestamp"])
+            if md.get("deletionTimestamp")
+            else None
+        ),
+    )
+
+
+# -- containers / pod templates ---------------------------------------------
+
+
+def _container_encode(c: Container) -> Dict[str, Any]:
+    env: List[Dict[str, Any]] = [
+        {"name": k, "value": v} for k, v in c.env.items()
+    ]
+    env += [
+        {"name": k, "valueFrom": {"fieldRef": {"fieldPath": fp}}}
+        for k, fp in c.downward_env.items()
+    ]
+    doc: Dict[str, Any] = {"name": c.name, "image": c.image}
+    if c.command:
+        doc["command"] = list(c.command)
+    if env:
+        doc["env"] = env
+    if c.readiness_probe:
+        doc["readinessProbe"] = {"exec": {"command": list(c.readiness_probe)}}
+    return doc
+
+
+def _container_decode(doc: Dict[str, Any]) -> Container:
+    env: Dict[str, str] = {}
+    downward: Dict[str, str] = {}
+    for e in doc.get("env") or []:
+        if "valueFrom" in e:
+            fp = (e["valueFrom"].get("fieldRef") or {}).get("fieldPath", "")
+            if fp:
+                downward[e["name"]] = fp
+        else:
+            env[e["name"]] = str(e.get("value", ""))
+    probe = ((doc.get("readinessProbe") or {}).get("exec") or {}).get("command", [])
+    return Container(
+        name=doc.get("name", "main"),
+        image=doc.get("image", ""),
+        command=list(doc.get("command") or []),
+        env=env,
+        downward_env=downward,
+        readiness_probe=list(probe),
+    )
+
+
+def _claim_refs_encode(refs: List[PodResourceClaimRef]) -> List[Dict[str, Any]]:
+    out = []
+    for r in refs:
+        doc: Dict[str, Any] = {"name": r.name}
+        if r.resource_claim_name:
+            doc["resourceClaimName"] = r.resource_claim_name
+        if r.resource_claim_template_name:
+            doc["resourceClaimTemplateName"] = r.resource_claim_template_name
+        out.append(doc)
+    return out
+
+
+def _claim_refs_decode(docs: List[Dict[str, Any]]) -> List[PodResourceClaimRef]:
+    return [
+        PodResourceClaimRef(
+            name=d.get("name", ""),
+            resource_claim_name=d.get("resourceClaimName", ""),
+            resource_claim_template_name=d.get("resourceClaimTemplateName", ""),
+        )
+        for d in docs or []
+    ]
+
+
+# -- Pod --------------------------------------------------------------------
+
+
+def _pod_encode(p: Pod) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "containers": [_container_encode(c) for c in p.containers],
+    }
+    if p.node_name:
+        spec["nodeName"] = p.node_name
+    if p.resource_claims:
+        spec["resourceClaims"] = _claim_refs_encode(p.resource_claims)
+    conditions = [{"type": c.type, "status": c.status} for c in p.conditions]
+    if p.ready and not any(c["type"] == "Ready" for c in conditions):
+        conditions.append({"type": "Ready", "status": "True"})
+    status: Dict[str, Any] = {"phase": p.phase}
+    if p.pod_ip:
+        status["podIP"] = p.pod_ip
+    if conditions:
+        status["conditions"] = conditions
+    return {"spec": spec, "status": status}
+
+
+def _pod_decode(doc: Dict[str, Any]) -> Pod:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    conditions = [
+        PodCondition(type=c.get("type", ""), status=c.get("status", "False"))
+        for c in status.get("conditions") or []
+    ]
+    ready = any(c.type == "Ready" and c.status == "True" for c in conditions)
+    return Pod(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        node_name=spec.get("nodeName", ""),
+        containers=[_container_decode(c) for c in spec.get("containers") or []],
+        resource_claims=_claim_refs_decode(spec.get("resourceClaims") or []),
+        phase=status.get("phase", "Pending"),
+        pod_ip=status.get("podIP", ""),
+        ready=ready,
+        conditions=conditions,
+    )
+
+
+# -- Node -------------------------------------------------------------------
+
+
+def _node_encode(n: Node) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if n.taints:
+        spec["taints"] = [
+            {"key": t.key, "value": t.value, "effect": t.effect} for t in n.taints
+        ]
+    status: Dict[str, Any] = {}
+    if n.addresses:
+        status["addresses"] = [
+            {"type": k, "address": v} for k, v in n.addresses.items()
+        ]
+    if n.allocatable:
+        status["allocatable"] = {k: str(v) for k, v in n.allocatable.items()}
+    return {"spec": spec, "status": status}
+
+
+def _node_decode(doc: Dict[str, Any]) -> Node:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    allocatable = {}
+    for k, v in (status.get("allocatable") or {}).items():
+        try:
+            allocatable[k] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return Node(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        taints=[
+            NodeTaint(key=t.get("key", ""), value=t.get("value", ""),
+                      effect=t.get("effect", "NoSchedule"))
+            for t in spec.get("taints") or []
+        ],
+        addresses={
+            a.get("type", ""): a.get("address", "")
+            for a in status.get("addresses") or []
+        },
+        allocatable=allocatable,
+    )
+
+
+# -- DaemonSet / Deployment --------------------------------------------------
+
+
+def _template_encode(t: PodTemplate, node_selector: Dict[str, str]) -> Dict[str, Any]:
+    pod_spec: Dict[str, Any] = {
+        "containers": [_container_encode(c) for c in t.containers],
+    }
+    if node_selector:
+        pod_spec["nodeSelector"] = dict(node_selector)
+    if t.resource_claims:
+        pod_spec["resourceClaims"] = _claim_refs_encode(t.resource_claims)
+    if t.env:
+        # Template-level env applies to all containers at render time; keep
+        # it as a pod annotation would be lossy — fold into each container.
+        for c in pod_spec["containers"]:
+            existing = {e["name"] for e in c.get("env", [])}
+            c.setdefault("env", []).extend(
+                {"name": k, "value": v} for k, v in t.env.items()
+                if k not in existing
+            )
+    return {"metadata": {"labels": dict(t.labels)}, "spec": pod_spec}
+
+
+def _template_decode(doc: Dict[str, Any]) -> Tuple[PodTemplate, Dict[str, str]]:
+    md = doc.get("metadata") or {}
+    spec = doc.get("spec") or {}
+    tmpl = PodTemplate(
+        labels=dict(md.get("labels") or {}),
+        containers=[_container_decode(c) for c in spec.get("containers") or []],
+        resource_claims=_claim_refs_decode(spec.get("resourceClaims") or []),
+    )
+    return tmpl, dict(spec.get("nodeSelector") or {})
+
+
+def _daemonset_encode(ds: DaemonSet) -> Dict[str, Any]:
+    return {
+        "spec": {
+            "selector": {"matchLabels": dict(ds.selector)},
+            "template": _template_encode(ds.template, ds.node_selector),
+        },
+        "status": {
+            "desiredNumberScheduled": ds.desired,
+            "numberReady": ds.ready,
+        },
+    }
+
+
+def _daemonset_decode(doc: Dict[str, Any]) -> DaemonSet:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    tmpl, node_selector = _template_decode(spec.get("template") or {})
+    return DaemonSet(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+        node_selector=node_selector,
+        template=tmpl,
+        desired=status.get("desiredNumberScheduled", 0),
+        ready=status.get("numberReady", 0),
+    )
+
+
+def _deployment_encode(d: Deployment) -> Dict[str, Any]:
+    return {
+        "spec": {
+            "replicas": d.replicas,
+            "selector": {"matchLabels": dict(d.selector)},
+            "template": _template_encode(d.template, {}),
+        },
+        "status": {"readyReplicas": d.ready},
+    }
+
+
+def _deployment_decode(doc: Dict[str, Any]) -> Deployment:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    tmpl, _ = _template_decode(spec.get("template") or {})
+    return Deployment(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        replicas=spec.get("replicas", 1),
+        selector=dict((spec.get("selector") or {}).get("matchLabels") or {}),
+        template=tmpl,
+        ready=status.get("readyReplicas", 0),
+    )
+
+
+# -- DRA: requests / configs / allocations ----------------------------------
+
+
+def _requests_encode(requests: List[DeviceRequest]) -> List[Dict[str, Any]]:
+    out = []
+    for r in requests:
+        doc: Dict[str, Any] = {
+            "name": r.name,
+            "deviceClassName": r.device_class_name,
+            "allocationMode": r.allocation_mode,
+        }
+        if r.allocation_mode == "ExactCount":
+            doc["count"] = r.count
+        if r.selectors:
+            doc["selectors"] = [{"cel": {"expression": s}} for s in r.selectors]
+        out.append(doc)
+    return out
+
+
+def _requests_decode(docs: List[Dict[str, Any]]) -> List[DeviceRequest]:
+    out = []
+    for d in docs or []:
+        # v1beta1 wraps exactly-one-of in "exactly"; flat form also accepted.
+        inner = d.get("exactly") or d
+        out.append(DeviceRequest(
+            name=d.get("name", ""),
+            device_class_name=inner.get("deviceClassName", ""),
+            allocation_mode=inner.get("allocationMode", "ExactCount"),
+            count=inner.get("count", 1),
+            selectors=[
+                ((s.get("cel") or {}).get("expression", ""))
+                for s in inner.get("selectors") or []
+            ],
+        ))
+    return out
+
+
+def _configs_encode(configs: List[DeviceClaimConfig]) -> List[Dict[str, Any]]:
+    out = []
+    for c in configs:
+        doc: Dict[str, Any] = {}
+        if c.requests:
+            doc["requests"] = list(c.requests)
+        if c.opaque:
+            doc["opaque"] = {
+                "driver": c.opaque.driver,
+                "parameters": dict(c.opaque.parameters),
+            }
+        out.append(doc)
+    return out
+
+
+def _configs_decode(docs: List[Dict[str, Any]], source: str) -> List[DeviceClaimConfig]:
+    out = []
+    for d in docs or []:
+        op = d.get("opaque")
+        out.append(DeviceClaimConfig(
+            requests=list(d.get("requests") or []),
+            opaque=OpaqueDeviceConfig(
+                driver=op.get("driver", ""),
+                parameters=dict(op.get("parameters") or {}),
+            ) if op else None,
+            source=source,
+        ))
+    return out
+
+
+def _claim_encode(rc: ResourceClaim) -> Dict[str, Any]:
+    spec = {
+        "devices": {
+            "requests": _requests_encode(rc.requests),
+            "config": _configs_encode(rc.config),
+        }
+    }
+    status: Dict[str, Any] = {}
+    if rc.allocation:
+        alloc: Dict[str, Any] = {
+            "devices": {
+                "results": [
+                    {
+                        "request": r.request,
+                        "driver": r.driver,
+                        "pool": r.pool,
+                        "device": r.device,
+                    }
+                    for r in rc.allocation.devices
+                ]
+            }
+        }
+        if rc.allocation.node_name:
+            alloc["nodeSelector"] = {
+                "nodeSelectorTerms": [{
+                    "matchFields": [{
+                        "key": "metadata.name",
+                        "operator": "In",
+                        "values": [rc.allocation.node_name],
+                    }]
+                }]
+            }
+        status["allocation"] = alloc
+    if rc.reserved_for:
+        status["reservedFor"] = [
+            {"resource": "pods", "name": c.name, "uid": c.uid}
+            for c in rc.reserved_for
+        ]
+    return {"spec": spec, "status": status}
+
+
+def _alloc_node_name(alloc_doc: Dict[str, Any]) -> str:
+    for term in (alloc_doc.get("nodeSelector") or {}).get("nodeSelectorTerms") or []:
+        for f in term.get("matchFields") or []:
+            if f.get("key") == "metadata.name" and f.get("values"):
+                return f["values"][0]
+    return ""
+
+
+def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
+    spec = doc.get("spec") or {}
+    devices = spec.get("devices") or {}
+    status = doc.get("status") or {}
+    allocation = None
+    if "allocation" in status:
+        alloc_doc = status["allocation"] or {}
+        allocation = AllocationResult(
+            devices=[
+                DeviceRequestAllocationResult(
+                    request=r.get("request", ""),
+                    driver=r.get("driver", ""),
+                    pool=r.get("pool", ""),
+                    device=r.get("device", ""),
+                )
+                for r in (alloc_doc.get("devices") or {}).get("results") or []
+            ],
+            node_name=_alloc_node_name(alloc_doc),
+        )
+    return ResourceClaim(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        requests=_requests_decode(devices.get("requests") or []),
+        config=_configs_decode(devices.get("config") or [], source="claim"),
+        allocation=allocation,
+        reserved_for=[
+            ResourceClaimConsumer(
+                kind="Pod", name=c.get("name", ""), uid=c.get("uid", "")
+            )
+            for c in status.get("reservedFor") or []
+        ],
+    )
+
+
+def _claim_template_encode(t: ResourceClaimTemplate) -> Dict[str, Any]:
+    tmpl_meta: Dict[str, Any] = {}
+    if t.spec_meta_labels:
+        tmpl_meta["labels"] = dict(t.spec_meta_labels)
+    if t.spec_meta_annotations:
+        tmpl_meta["annotations"] = dict(t.spec_meta_annotations)
+    return {
+        "spec": {
+            "metadata": tmpl_meta,
+            "spec": {
+                "devices": {
+                    "requests": _requests_encode(t.requests),
+                    "config": _configs_encode(t.config),
+                }
+            },
+        }
+    }
+
+
+def _claim_template_decode(doc: Dict[str, Any]) -> ResourceClaimTemplate:
+    spec = doc.get("spec") or {}
+    tmpl_meta = spec.get("metadata") or {}
+    inner = (spec.get("spec") or {}).get("devices") or {}
+    return ResourceClaimTemplate(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        spec_meta_labels=dict(tmpl_meta.get("labels") or {}),
+        spec_meta_annotations=dict(tmpl_meta.get("annotations") or {}),
+        requests=_requests_decode(inner.get("requests") or []),
+        config=_configs_decode(inner.get("config") or [], source="claim"),
+    )
+
+
+# -- ResourceSlice ----------------------------------------------------------
+
+
+def _attr_encode(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"bool": v}
+    if isinstance(v, int):
+        return {"int": v}
+    return {"string": str(v)}
+
+
+def _attr_decode(doc: Dict[str, Any]) -> Any:
+    if "bool" in doc:
+        return bool(doc["bool"])
+    if "int" in doc:
+        return int(doc["int"])
+    if "version" in doc:
+        return doc["version"]
+    return doc.get("string", "")
+
+
+def _counters_encode(counters: Dict[str, Counter]) -> Dict[str, Any]:
+    return {k: {"value": str(c.value)} for k, c in counters.items()}
+
+
+def _counters_decode(doc: Dict[str, Any]) -> Dict[str, Counter]:
+    out = {}
+    for k, v in (doc or {}).items():
+        try:
+            out[k] = Counter(value=int(v.get("value", 0)))
+        except (TypeError, ValueError, AttributeError):
+            out[k] = Counter(value=0)
+    return out
+
+
+def _slice_encode(rs: ResourceSlice) -> Dict[str, Any]:
+    devices = []
+    for d in rs.devices:
+        basic: Dict[str, Any] = {
+            "attributes": {k: _attr_encode(v) for k, v in d.attributes.items()},
+        }
+        if d.capacity:
+            basic["capacity"] = {k: {"value": str(v)} for k, v in d.capacity.items()}
+        if d.taints:
+            basic["taints"] = [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in d.taints
+            ]
+        if d.consumes_counters:
+            basic["consumesCounters"] = [
+                {
+                    "counterSet": cc.counter_set,
+                    "counters": _counters_encode(cc.counters),
+                }
+                for cc in d.consumes_counters
+            ]
+        devices.append({"name": d.name, "basic": basic})
+    spec: Dict[str, Any] = {
+        "driver": rs.driver,
+        "pool": {
+            "name": rs.pool.name,
+            "generation": rs.pool.generation,
+            "resourceSliceCount": rs.pool.resource_slice_count,
+        },
+        "devices": devices,
+    }
+    if rs.node_name:
+        spec["nodeName"] = rs.node_name
+    if rs.shared_counters:
+        spec["sharedCounters"] = [
+            {"name": cs.name, "counters": _counters_encode(cs.counters)}
+            for cs in rs.shared_counters
+        ]
+    return {"spec": spec}
+
+
+def _slice_decode(doc: Dict[str, Any]) -> ResourceSlice:
+    spec = doc.get("spec") or {}
+    pool = spec.get("pool") or {}
+    devices = []
+    for d in spec.get("devices") or []:
+        basic = d.get("basic") or d  # v1 dropped the "basic" wrapper
+        devices.append(Device(
+            name=d.get("name", ""),
+            attributes={
+                k: _attr_decode(v) for k, v in (basic.get("attributes") or {}).items()
+            },
+            capacity={
+                k: v.get("value", "") for k, v in (basic.get("capacity") or {}).items()
+            },
+            taints=[
+                DeviceTaint(key=t.get("key", ""), value=t.get("value", ""),
+                            effect=t.get("effect", "NoSchedule"))
+                for t in basic.get("taints") or []
+            ],
+            consumes_counters=[
+                DeviceCounterConsumption(
+                    counter_set=cc.get("counterSet", ""),
+                    counters=_counters_decode(cc.get("counters")),
+                )
+                for cc in basic.get("consumesCounters") or []
+            ],
+        ))
+    return ResourceSlice(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        driver=spec.get("driver", ""),
+        node_name=spec.get("nodeName", ""),
+        pool=ResourcePool(
+            name=pool.get("name", ""),
+            generation=pool.get("generation", 0),
+            resource_slice_count=pool.get("resourceSliceCount", 1),
+        ),
+        devices=devices,
+        shared_counters=[
+            CounterSet(name=cs.get("name", ""),
+                       counters=_counters_decode(cs.get("counters")))
+            for cs in spec.get("sharedCounters") or []
+        ],
+    )
+
+
+# -- DeviceClass ------------------------------------------------------------
+
+_CEL_DRIVER_RE = re.compile(r'device\.driver\s*==\s*"([^"]+)"')
+_CEL_ATTR_RE = re.compile(
+    r'device\.attributes\["([^"]+)"\]\s*==\s*("([^"]*)"|true|false|-?\d+)'
+)
+
+
+def _deviceclass_encode(dc: DeviceClass) -> Dict[str, Any]:
+    exprs = []
+    if dc.driver:
+        exprs.append(f'device.driver == "{dc.driver}"')
+    for k, v in dc.match_attributes.items():
+        if isinstance(v, bool):
+            lit = "true" if v else "false"
+        elif isinstance(v, int):
+            lit = str(v)
+        else:
+            lit = f'"{v}"'
+        exprs.append(f'device.attributes["{k}"] == {lit}')
+    spec: Dict[str, Any] = {}
+    if exprs:
+        spec["selectors"] = [{"cel": {"expression": " && ".join(exprs)}}]
+    if dc.config:
+        spec["config"] = _configs_encode(dc.config)
+    return {"spec": spec}
+
+
+def _deviceclass_decode(doc: Dict[str, Any]) -> DeviceClass:
+    spec = doc.get("spec") or {}
+    driver = ""
+    match_attributes: Dict[str, Any] = {}
+    for sel in spec.get("selectors") or []:
+        expr = (sel.get("cel") or {}).get("expression", "")
+        m = _CEL_DRIVER_RE.search(expr)
+        if m:
+            driver = m.group(1)
+        for am in _CEL_ATTR_RE.finditer(expr):
+            key, raw, quoted = am.group(1), am.group(2), am.group(3)
+            if quoted is not None:
+                match_attributes[key] = quoted
+            elif raw in ("true", "false"):
+                match_attributes[key] = raw == "true"
+            else:
+                match_attributes[key] = int(raw)
+    return DeviceClass(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        driver=driver,
+        match_attributes=match_attributes,
+        config=_configs_decode(spec.get("config") or [], source="class"),
+    )
+
+
+# -- ComputeDomain CRDs ------------------------------------------------------
+
+
+def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"numNodes": cd.spec.num_nodes}
+    if cd.spec.topology:
+        spec["topology"] = cd.spec.topology
+    if cd.spec.channel.resource_claim_template_name:
+        spec["channel"] = {
+            "resourceClaimTemplate": {
+                "name": cd.spec.channel.resource_claim_template_name
+            }
+        }
+    status: Dict[str, Any] = {"status": cd.status.status}
+    if cd.status.nodes:
+        status["nodes"] = [
+            {
+                "name": n.name,
+                "ipAddress": n.ip_address,
+                "iciDomain": n.ici_domain,
+                "workerId": n.worker_id,
+                "status": n.status,
+            }
+            for n in cd.status.nodes
+        ]
+    return {"spec": spec, "status": status}
+
+
+def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    chan = ((spec.get("channel") or {}).get("resourceClaimTemplate") or {})
+    return ComputeDomain(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        spec=ComputeDomainSpec(
+            num_nodes=spec.get("numNodes", 0),
+            topology=spec.get("topology", ""),
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name=chan.get("name", "")
+            ),
+        ),
+        status=ComputeDomainStatus(
+            status=status.get("status", "NotReady"),
+            nodes=[
+                ComputeDomainNode(
+                    name=n.get("name", ""),
+                    ip_address=n.get("ipAddress", ""),
+                    ici_domain=n.get("iciDomain", ""),
+                    worker_id=n.get("workerId", -1),
+                    status=n.get("status", "NotReady"),
+                )
+                for n in status.get("nodes") or []
+            ],
+        ),
+    )
+
+
+def _clique_encode(cl: ComputeDomainClique) -> Dict[str, Any]:
+    return {
+        "domainUid": cl.domain_uid,
+        "iciDomain": cl.ici_domain,
+        "nodes": [
+            {
+                "nodeName": n.node_name,
+                "ipAddress": n.ip_address,
+                "dnsName": n.dns_name,
+                "index": n.index,
+                "ready": n.ready,
+            }
+            for n in cl.nodes
+        ],
+    }
+
+
+def _clique_decode(doc: Dict[str, Any]) -> ComputeDomainClique:
+    return ComputeDomainClique(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        domain_uid=doc.get("domainUid", ""),
+        ici_domain=doc.get("iciDomain", ""),
+        nodes=[
+            ComputeDomainDaemonInfo(
+                node_name=n.get("nodeName", ""),
+                ip_address=n.get("ipAddress", ""),
+                dns_name=n.get("dnsName", ""),
+                index=n.get("index", -1),
+                ready=bool(n.get("ready", False)),
+            )
+            for n in doc.get("nodes") or []
+        ],
+    )
+
+
+# -- Lease ------------------------------------------------------------------
+
+
+def _lease_encode(lease: Lease) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "leaseDurationSeconds": int(lease.lease_duration_s),
+    }
+    if lease.holder:
+        spec["holderIdentity"] = lease.holder
+    if lease.acquired_at:
+        spec["acquireTime"] = _ts_encode_micro(lease.acquired_at)
+    if lease.renewed_at:
+        spec["renewTime"] = _ts_encode_micro(lease.renewed_at)
+    return {"spec": spec}
+
+
+def _lease_decode(doc: Dict[str, Any]) -> Lease:
+    spec = doc.get("spec") or {}
+    return Lease(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        holder=spec.get("holderIdentity", ""),
+        acquired_at=_ts_decode(spec.get("acquireTime")),
+        renewed_at=_ts_decode(spec.get("renewTime")),
+        lease_duration_s=float(spec.get("leaseDurationSeconds", 15)),
+    )
+
+
+# -- top level ---------------------------------------------------------------
+
+_ENCODERS = {
+    "Pod": _pod_encode,
+    "Node": _node_encode,
+    "DaemonSet": _daemonset_encode,
+    "Deployment": _deployment_encode,
+    "ResourceClaim": _claim_encode,
+    "ResourceClaimTemplate": _claim_template_encode,
+    "ResourceSlice": _slice_encode,
+    "DeviceClass": _deviceclass_encode,
+    "ComputeDomain": _computedomain_encode,
+    "ComputeDomainClique": _clique_encode,
+    "Lease": _lease_encode,
+}
+
+_DECODERS = {
+    "Pod": _pod_decode,
+    "Node": _node_decode,
+    "DaemonSet": _daemonset_decode,
+    "Deployment": _deployment_decode,
+    "ResourceClaim": _claim_decode,
+    "ResourceClaimTemplate": _claim_template_decode,
+    "ResourceSlice": _slice_decode,
+    "DeviceClass": _deviceclass_decode,
+    "ComputeDomain": _computedomain_decode,
+    "ComputeDomainClique": _clique_decode,
+    "Lease": _lease_decode,
+}
+
+
+def to_k8s_wire(obj: K8sObject) -> Dict[str, Any]:
+    """Encode an internal object as real Kubernetes JSON."""
+    if obj.kind not in _ENCODERS:
+        raise ValueError(f"kind {obj.kind!r} has no k8s wire mapping")
+    api_version, _, _ = RESOURCE_MAP[obj.kind]
+    doc = {"apiVersion": api_version, "kind": obj.kind,
+           "metadata": _meta_encode(obj.meta)}
+    doc.update(_ENCODERS[obj.kind](obj))
+    return doc
+
+
+def from_k8s_wire(doc: Dict[str, Any]) -> K8sObject:
+    """Decode real Kubernetes JSON into the internal object model."""
+    kind = doc.get("kind", "")
+    if kind not in _DECODERS:
+        raise ValueError(f"kind {kind!r} has no k8s wire mapping")
+    return _DECODERS[kind](doc)

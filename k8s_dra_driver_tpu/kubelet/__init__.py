@@ -1,0 +1,16 @@
+"""Kubelet-facing gRPC seam: the DRA plugin protocol + plugin registration.
+
+A real kubelet discovers plugins by scanning its plugin registry directory
+for unix sockets, handshakes over the `pluginregistration.Registration`
+service, then drives `DRAPlugin.NodePrepareResources` /
+`NodeUnprepareResources` on the advertised endpoint (the reference reaches
+this seam through the vendored kubeletplugin helper,
+/root/reference/cmd/gpu-kubelet-plugin/driver.go:131-149).
+
+Modules:
+    draserver.py    — serves both protocols over unix sockets
+    kubeletstub.py  — a kubelet test double driving the same wire
+    *_pb2.py        — protoc-generated message bindings (protos/*.proto)
+"""
+
+from k8s_dra_driver_tpu.kubelet.draserver import DRAGrpcServer  # noqa: F401

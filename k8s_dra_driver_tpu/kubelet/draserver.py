@@ -1,0 +1,260 @@
+"""gRPC DRA plugin server — the kubelet-facing seam of both node plugins.
+
+Serves two unix-domain sockets, matching the kubelet's conventions
+(reference: vendored k8s.io/dynamic-resource-allocation/kubeletplugin,
+draplugin.go — KubeletPluginsDir/KubeletRegistryDir):
+
+    <registrar_dir>/<driver>-reg.sock   pluginregistration.Registration
+    <plugin_data_dir>/dra.sock          DRAPlugin (v1 AND v1beta1 service
+                                        names, so any kubelet >= 1.31 can
+                                        drive us)
+
+The DRA service resolves each wire Claim{namespace,uid,name} to the full
+ResourceClaim through the API server — the same resolution the reference
+helper performs before invoking the driver — then delegates to the
+driver's prepare/unprepare and translates results back to wire Devices.
+
+No grpcio-tools in the image: service plumbing uses
+grpc.method_handlers_generic_handler over the protoc-generated messages.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, ResourceClaim
+from k8s_dra_driver_tpu.kubelet import dra_v1_pb2, dra_v1beta1_pb2
+from k8s_dra_driver_tpu.kubelet import pluginregistration_pb2 as reg_pb2
+
+log = logging.getLogger(__name__)
+
+DRA_SOCKET_NAME = "dra.sock"
+SUPPORTED_VERSIONS = ["v1beta1", "v1"]
+
+_V1_SERVICE = "k8s.io.kubelet.pkg.apis.dra.v1.DRAPlugin"
+_V1BETA1_SERVICE = "k8s.io.kubelet.pkg.apis.dra.v1beta1.DRAPlugin"
+_REG_SERVICE = "pluginregistration.Registration"
+
+
+def _is_retryable(err: Exception) -> bool:
+    try:
+        from k8s_dra_driver_tpu.plugins.computedomain.computedomain import (
+            RetryableError,
+        )
+        return isinstance(err, RetryableError)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class _DRAService:
+    """Version-agnostic service body; `pb` selects the message module."""
+
+    def __init__(self, server: "DRAGrpcServer", pb):
+        self.server = server
+        self.pb = pb
+
+    # -- claim resolution ---------------------------------------------------
+
+    def _resolve_claim(self, wire_claim) -> ResourceClaim:
+        rc = self.server.api.get(
+            RESOURCE_CLAIM, wire_claim.name, wire_claim.namespace
+        )
+        if wire_claim.uid and rc.meta.uid and rc.meta.uid != wire_claim.uid:
+            raise ValueError(
+                f"claim {wire_claim.namespace}/{wire_claim.name}: uid mismatch "
+                f"(kubelet has {wire_claim.uid}, apiserver has {rc.meta.uid})"
+            )
+        return rc
+
+    def _wire_devices(self, claim: ResourceClaim, result) -> List:
+        """Map a driver prepare result onto wire Device entries keyed by the
+        claim's allocation (pool/device names come from the allocation; CDI
+        ids from what the driver actually prepared)."""
+        alloc = claim.allocation.devices if claim.allocation else []
+        prepared = getattr(result, "devices", None)
+        out = []
+        if prepared:
+            by_name = {d.name: d for d in prepared}
+            for ar in alloc:
+                pd = by_name.get(ar.device)
+                out.append(self.pb.Device(
+                    request_names=[ar.request] if ar.request else [],
+                    pool_name=ar.pool,
+                    device_name=ar.device,
+                    cdi_device_ids=list(pd.cdi_device_ids) if pd else [],
+                ))
+            return out
+        # Flat CDI-id list (compute-domain driver): attach to the first
+        # allocated device; the runtime applies each CDI id once.
+        ids = list(getattr(result, "cdi_device_ids", None) or result or [])
+        for i, ar in enumerate(alloc):
+            out.append(self.pb.Device(
+                request_names=[ar.request] if ar.request else [],
+                pool_name=ar.pool,
+                device_name=ar.device,
+                cdi_device_ids=ids if i == 0 else [],
+            ))
+        return out
+
+    # -- rpc handlers -------------------------------------------------------
+
+    def node_prepare_resources(self, request, context):
+        resp = self.pb.NodePrepareResourcesResponse()
+        claims: Dict[str, ResourceClaim] = {}
+        for wc in request.claims:
+            try:
+                claims[wc.uid] = self._resolve_claim(wc)
+            except Exception as e:  # noqa: BLE001 — per-claim error contract
+                resp.claims[wc.uid].error = f"resolve claim: {e}"
+        if claims:
+            results = self.server.driver.prepare_resource_claims(
+                list(claims.values())
+            )
+            for uid, result in results.items():
+                if isinstance(result, Exception):
+                    kind = "retryable" if _is_retryable(result) else "permanent"
+                    resp.claims[uid].error = f"{kind}: {result}"
+                else:
+                    resp.claims[uid].devices.extend(
+                        self._wire_devices(claims[uid], result)
+                    )
+        return resp
+
+    def node_unprepare_resources(self, request, context):
+        resp = self.pb.NodeUnprepareResourcesResponse()
+        uids = [wc.uid for wc in request.claims]
+        results = self.server.driver.unprepare_resource_claims(uids)
+        for uid in uids:
+            err = results.get(uid)
+            resp.claims[uid].error = str(err) if err is not None else ""
+        return resp
+
+    def handlers(self, service_name: str) -> grpc.GenericRpcHandler:
+        pb = self.pb
+        return grpc.method_handlers_generic_handler(service_name, {
+            "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+                self.node_prepare_resources,
+                request_deserializer=pb.NodePrepareResourcesRequest.FromString,
+                response_serializer=pb.NodePrepareResourcesResponse.SerializeToString,
+            ),
+            "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+                self.node_unprepare_resources,
+                request_deserializer=pb.NodeUnprepareResourcesRequest.FromString,
+                response_serializer=pb.NodeUnprepareResourcesResponse.SerializeToString,
+            ),
+        })
+
+
+class _RegistrationService:
+    def __init__(self, server: "DRAGrpcServer"):
+        self.server = server
+
+    def get_info(self, request, context):
+        return reg_pb2.PluginInfo(
+            type="DRAPlugin",
+            name=self.server.driver_name,
+            endpoint=self.server.dra_socket_path,
+            supported_versions=SUPPORTED_VERSIONS,
+        )
+
+    def notify_registration_status(self, request, context):
+        self.server.registered = bool(request.plugin_registered)
+        if request.error:
+            log.error("kubelet rejected plugin registration: %s", request.error)
+        else:
+            log.info("kubelet registration status: registered=%s",
+                     self.server.registered)
+        return reg_pb2.RegistrationStatusResponse()
+
+    def handlers(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(_REG_SERVICE, {
+            "GetInfo": grpc.unary_unary_rpc_method_handler(
+                self.get_info,
+                request_deserializer=reg_pb2.InfoRequest.FromString,
+                response_serializer=reg_pb2.PluginInfo.SerializeToString,
+            ),
+            "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+                self.notify_registration_status,
+                request_deserializer=reg_pb2.RegistrationStatus.FromString,
+                response_serializer=(
+                    reg_pb2.RegistrationStatusResponse.SerializeToString
+                ),
+            ),
+        })
+
+
+class DRAGrpcServer:
+    """Runs the registration + DRA gRPC services for one driver."""
+
+    def __init__(
+        self,
+        driver,
+        api,
+        plugin_data_dir: str,
+        registrar_dir: str,
+        driver_name: Optional[str] = None,
+    ):
+        self.driver = driver
+        self.api = api
+        self.driver_name = driver_name or driver.driver_name
+        self.plugin_data_dir = plugin_data_dir
+        self.registrar_dir = registrar_dir
+        self.registered = False
+        self._dra_server: Optional[grpc.Server] = None
+        self._reg_server: Optional[grpc.Server] = None
+        self._lock = threading.Lock()
+
+    @property
+    def dra_socket_path(self) -> str:
+        return os.path.join(self.plugin_data_dir, DRA_SOCKET_NAME)
+
+    @property
+    def registration_socket_path(self) -> str:
+        return os.path.join(self.registrar_dir, f"{self.driver_name}-reg.sock")
+
+    def start(self) -> "DRAGrpcServer":
+        os.makedirs(self.plugin_data_dir, exist_ok=True)
+        os.makedirs(self.registrar_dir, exist_ok=True)
+        for path in (self.dra_socket_path, self.registration_socket_path):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+        dra = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="dra-grpc"))
+        dra.add_generic_rpc_handlers((
+            _DRAService(self, dra_v1_pb2).handlers(_V1_SERVICE),
+            _DRAService(self, dra_v1beta1_pb2).handlers(_V1BETA1_SERVICE),
+        ))
+        dra.add_insecure_port(f"unix://{self.dra_socket_path}")
+        dra.start()
+        self._dra_server = dra
+
+        reg = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="reg-grpc"))
+        reg.add_generic_rpc_handlers((_RegistrationService(self).handlers(),))
+        reg.add_insecure_port(f"unix://{self.registration_socket_path}")
+        reg.start()
+        self._reg_server = reg
+        log.info("DRA gRPC up: dra=%s registrar=%s",
+                 self.dra_socket_path, self.registration_socket_path)
+        return self
+
+    def stop(self, grace: float = 2.0) -> None:
+        with self._lock:
+            for srv in (self._reg_server, self._dra_server):
+                if srv is not None:
+                    srv.stop(grace).wait()
+            self._reg_server = self._dra_server = None
+            for path in (self.dra_socket_path, self.registration_socket_path):
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
