@@ -74,13 +74,6 @@ def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
         "endpoint (conformance server / kubectl proxy) for "
         "--api-backend kubernetes",
     )
-    parser.add_argument(
-        "--kubeconfig", default=os.environ.get("KUBECONFIG_PATH", ""),
-        help="kubeconfig path for --api-backend kubernetes "
-        "[KUBECONFIG_PATH; falls back to $KUBECONFIG, ~/.kube/config, "
-        "then in-cluster credentials]",
-    )
-    parser.add_argument(
-        "--kube-context", default=os.environ.get("KUBE_CONTEXT", ""),
-        help="kubeconfig context override [KUBE_CONTEXT]",
-    )
+    # --kubeconfig / --kube-context live in flags.KubeClientFlags — every
+    # binary that calls this also wires that bundle (round-2 regression:
+    # registering them here too crashed argparse at import).

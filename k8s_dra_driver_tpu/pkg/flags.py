@@ -38,12 +38,14 @@ class FlagBundle:
 
 @dataclass
 class KubeClientFlags(FlagBundle):
-    """--kubeconfig / --kube-api-qps / --kube-api-burst (KUBECONFIG, ...)."""
+    """--kubeconfig / --kube-context / --kube-api-qps / --kube-api-burst."""
 
     def add_to(self, parser: argparse.ArgumentParser) -> None:
         g = parser.add_argument_group("kubernetes client")
         g.add_argument("--kubeconfig", default=_env_default("KUBECONFIG", ""),
                        help="path to kubeconfig (in-cluster when empty) [KUBECONFIG]")
+        g.add_argument("--kube-context", default=_env_default("KUBE_CONTEXT", ""),
+                       help="kubeconfig context override [KUBE_CONTEXT]")
         g.add_argument("--kube-api-qps", type=float,
                        default=_env_default("KUBE_API_QPS", 5.0, float),
                        help="client QPS [KUBE_API_QPS]")
