@@ -77,3 +77,46 @@ def add_api_backend_flag(parser: argparse.ArgumentParser) -> None:
     # --kubeconfig / --kube-context live in flags.KubeClientFlags — every
     # binary that calls this also wires that bundle (round-2 regression:
     # registering them here too crashed argparse at import).
+
+
+def add_kubelet_grpc_flags(parser: argparse.ArgumentParser) -> None:
+    """Flags for the real kubelet-facing gRPC seam (registration socket +
+    DRA plugin socket; reference kubeletplugin.Start at
+    cmd/gpu-kubelet-plugin/driver.go:131-149)."""
+    import os
+
+    parser.add_argument(
+        "--kubelet-plugin-dir",
+        default=os.environ.get("KUBELET_PLUGIN_DIR", ""),
+        help="serve the DRA gRPC socket as <dir>/dra.sock (the kubelet "
+        "plugin data dir, e.g. /var/lib/kubelet/plugins/<driver>); "
+        "requires --registrar-dir [KUBELET_PLUGIN_DIR]",
+    )
+    parser.add_argument(
+        "--registrar-dir",
+        default=os.environ.get("REGISTRAR_DIR", ""),
+        help="kubelet plugin registry dir for the registration socket "
+        "(e.g. /var/lib/kubelet/plugins_registry) [REGISTRAR_DIR]",
+    )
+
+
+def validate_kubelet_grpc_flags(parser: argparse.ArgumentParser,
+                                args: argparse.Namespace) -> None:
+    """Call right after parse_args — before any component starts."""
+    if bool(args.kubelet_plugin_dir) != bool(args.registrar_dir):
+        parser.error("--kubelet-plugin-dir and --registrar-dir must be set together")
+
+
+def maybe_start_dra_grpc(args: argparse.Namespace, driver, api):
+    """Start the kubelet gRPC seam when the flag pair is set; returns the
+    running server or None."""
+    if not (args.kubelet_plugin_dir and args.registrar_dir):
+        return None
+    from k8s_dra_driver_tpu.kubelet.draserver import DRAGrpcServer
+
+    return DRAGrpcServer(
+        driver,
+        api,
+        plugin_data_dir=args.kubelet_plugin_dir,
+        registrar_dir=args.registrar_dir,
+    ).start()

@@ -9,7 +9,13 @@ import socket
 import sys
 import threading
 
-from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
+from k8s_dra_driver_tpu.cmd import (
+    add_api_backend_flag,
+    add_kubelet_grpc_flags,
+    maybe_start_dra_grpc,
+    resolve_api,
+    validate_kubelet_grpc_flags,
+)
 from k8s_dra_driver_tpu.pkg import flags as flagpkg
 from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
 from k8s_dra_driver_tpu.plugins.computedomain.driver import (
@@ -32,6 +38,7 @@ def main(argv=None) -> int:
          flagpkg.KubeClientFlags()],
     )
     add_api_backend_flag(parser)
+    add_kubelet_grpc_flags(parser)
     parser.add_argument("--version", action="store_true")
     try:
         max_channels_default = int(
@@ -57,6 +64,7 @@ def main(argv=None) -> int:
     if args.version:
         print(version_string("compute-domain-kubelet-plugin"))
         return 0
+    validate_kubelet_grpc_flags(parser, args)
     flagpkg.LoggingFlags.configure(args)
     flagpkg.log_startup_config(args, log)
     gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
@@ -75,8 +83,10 @@ def main(argv=None) -> int:
         driver, args.plugin_dir, args.node_name or socket.gethostname(),
         port=args.dra_port,
     ).start()
-    log.info("%s serving on %s",
-             version_string("compute-domain-kubelet-plugin"), dra_srv.endpoint)
+    grpc_srv = maybe_start_dra_grpc(args, driver, api)
+    log.info("%s serving on %s%s",
+             version_string("compute-domain-kubelet-plugin"), dra_srv.endpoint,
+             f" + gRPC {grpc_srv.dra_socket_path}" if grpc_srv else "")
 
     metrics_srv = None
     if args.metrics_port:
@@ -91,6 +101,8 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    if grpc_srv:
+        grpc_srv.stop()
     dra_srv.stop()
     if health_srv:
         health_srv.stop()

@@ -13,7 +13,13 @@ import socket
 import sys
 import threading
 
-from k8s_dra_driver_tpu.cmd import add_api_backend_flag, resolve_api
+from k8s_dra_driver_tpu.cmd import (
+    add_api_backend_flag,
+    add_kubelet_grpc_flags,
+    maybe_start_dra_grpc,
+    resolve_api,
+    validate_kubelet_grpc_flags,
+)
 from k8s_dra_driver_tpu.pkg import flags as flagpkg
 from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
 from k8s_dra_driver_tpu.plugins.health import Healthcheck
@@ -33,6 +39,7 @@ def main(argv=None) -> int:
          flagpkg.KubeClientFlags()],
     )
     add_api_backend_flag(parser)
+    add_kubelet_grpc_flags(parser)
     parser.add_argument(
         "--dra-port", type=int, default=flagpkg._env_default("DRA_PORT", 0, int),
         help="serve the DRA Prepare/Unprepare endpoint on this local port "
@@ -43,6 +50,7 @@ def main(argv=None) -> int:
     if args.version:
         print(version_string("tpu-kubelet-plugin"))
         return 0
+    validate_kubelet_grpc_flags(parser, args)
     flagpkg.LoggingFlags.configure(args)
     flagpkg.log_startup_config(args, log)
     gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
@@ -60,8 +68,10 @@ def main(argv=None) -> int:
     dra_srv = DRAPluginServer(
         driver, args.plugin_dir, node_name, port=args.dra_port
     ).start()
-    log.info("%s serving on %s; %d allocatable devices published",
+    grpc_srv = maybe_start_dra_grpc(args, driver, api)
+    log.info("%s serving on %s%s; %d allocatable devices published",
              version_string("tpu-kubelet-plugin"), dra_srv.endpoint,
+             f" + gRPC {grpc_srv.dra_socket_path}" if grpc_srv else "",
              len(driver.state.allocatable))
 
     metrics_srv = None
@@ -77,6 +87,8 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    if grpc_srv:
+        grpc_srv.stop()
     dra_srv.stop()
     if health_srv:
         health_srv.stop()

@@ -56,7 +56,10 @@ def _post(url: str, doc: dict) -> dict:
 class PluginProc:
     """One tpu-kubelet-plugin OS process + its discovered endpoint."""
 
-    def __init__(self, tmp, api_url, boot_id_path):
+    def __init__(self, tmp, api_url, boot_id_path, grpc_dirs=False):
+        self.grpc_dirs = grpc_dirs
+        self.kubelet_plugin_dir = os.path.join(tmp, "kp")
+        self.registrar_dir = os.path.join(tmp, "reg")
         self.plugin_dir = os.path.join(tmp, "plugin")
         self.cdi_root = os.path.join(tmp, "cdi")
         self.env = {
@@ -73,9 +76,12 @@ class PluginProc:
         self.proc = None
 
     def start(self):
+        argv = [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin"]
+        if self.grpc_dirs:
+            argv += ["--kubelet-plugin-dir", self.kubelet_plugin_dir,
+                     "--registrar-dir", self.registrar_dir]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin"],
-            env=self.env, cwd=REPO,
+            argv, env=self.env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         reg = os.path.join(self.plugin_dir, f"{TPU_DRIVER_NAME}-{REGISTRATION_FILE}")
@@ -109,8 +115,10 @@ class PluginProc:
 
 
 @pytest.fixture
-def cluster_procs(tmp_path):
-    """apiserver process + plugin process + remote client."""
+def cluster_procs(tmp_path, request):
+    """apiserver process + plugin process + remote client. Parametrize
+    indirectly with grpc_dirs=True to serve the kubelet gRPC socket pair."""
+    grpc_dirs = getattr(request, "param", False)
     boot_id = tmp_path / "boot_id"
     boot_id.write_text("mp-boot-1\n")
     apiserver = subprocess.Popen(
@@ -123,8 +131,9 @@ def cluster_procs(tmp_path):
         assert line.startswith("serving on "), line
         url = line.split()[-1]
         api = RemoteAPIServer(url)
-        plugin = PluginProc(str(tmp_path), url, str(boot_id)).start()
+        plugin = PluginProc(str(tmp_path), url, str(boot_id), grpc_dirs=grpc_dirs)
         try:
+            plugin.start()
             yield api, plugin
         finally:
             plugin.terminate()
@@ -167,6 +176,34 @@ def test_publish_prepare_unprepare_across_processes(cluster_procs):
         assert json.loads(r.read())["healthy"] is True
     out = _post(plugin.endpoint + "/v1/unprepare", {"claim_uids": [claim.uid]})
     assert out["results"][claim.uid] is None
+    assert not any(claim.uid in f for f in os.listdir(plugin.cdi_root))
+
+
+@pytest.mark.parametrize("cluster_procs", [True], indirect=True)
+def test_prepare_unprepare_purely_over_grpc(cluster_procs):
+    """The full kubelet dance against the plugin *binary*, no HTTP involved:
+    registration socket discovery -> GetInfo -> NotifyRegistrationStatus ->
+    NodePrepareResources -> CDI ids -> NodeUnprepareResources."""
+    from tests.test_kubelet_grpc import FakeKubelet
+
+    api, plugin = cluster_procs
+    kubelet = FakeKubelet(plugin.registrar_dir)
+    _wait(lambda: kubelet.discover_sockets(), msg="registration socket")
+    [reg_sock] = kubelet.discover_sockets()
+    info = kubelet.get_info(reg_sock)
+    assert info.name == TPU_DRIVER_NAME
+    kubelet.notify_registered(reg_sock)
+
+    claim = api.create(make_claim(["tpu-0", "tpu-1"], name="grpc-claim"))
+    resp = kubelet.node_prepare(info.endpoint, [claim], "v1")
+    result = resp.claims[claim.uid]
+    assert result.error == ""
+    assert {d.device_name for d in result.devices} == {"tpu-0", "tpu-1"}
+    assert all(d.cdi_device_ids for d in result.devices)
+    assert any(claim.uid in f for f in os.listdir(plugin.cdi_root))
+
+    resp = kubelet.node_unprepare(info.endpoint, [claim], "v1")
+    assert resp.claims[claim.uid].error == ""
     assert not any(claim.uid in f for f in os.listdir(plugin.cdi_root))
 
 
