@@ -21,17 +21,38 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--bind", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument(
+        "--tls-cert-file", default=flagpkg._env_default("TLS_CERT_FILE", ""),
+        help="PEM serving cert; with --tls-private-key-file the webhook "
+        "serves HTTPS (required behind a real apiserver) [TLS_CERT_FILE]",
+    )
+    parser.add_argument(
+        "--tls-private-key-file",
+        default=flagpkg._env_default("TLS_PRIVATE_KEY_FILE", ""),
+        help="PEM private key for --tls-cert-file [TLS_PRIVATE_KEY_FILE]",
+    )
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
         print(version_string("webhook"))
         return 0
+    if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+        parser.error("--tls-cert-file and --tls-private-key-file "
+                     "must be set together")
     flagpkg.LoggingFlags.configure(args)
     start_debug_signal_handlers()
 
-    srv = AdmissionWebhook().serve(host=args.bind, port=args.port)
+    srv = AdmissionWebhook().serve(
+        host=args.bind, port=args.port,
+        cert_file=args.tls_cert_file or None,
+        key_file=args.tls_private_key_file or None,
+    )
     srv.start()
-    log.info("%s listening on %s:%d", version_string("webhook"), args.bind, srv.port)
+    if not srv.tls:
+        log.warning("serving PLAIN HTTP — a real apiserver refuses non-TLS "
+                    "webhooks; pass --tls-cert-file/--tls-private-key-file")
+    log.info("%s listening on %s:%d (tls=%s)",
+             version_string("webhook"), args.bind, srv.port, srv.tls)
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())

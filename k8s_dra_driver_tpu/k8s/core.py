@@ -25,6 +25,7 @@ RESOURCE_SLICE = "ResourceSlice"
 DEVICE_CLASS = "DeviceClass"
 COMPUTE_DOMAIN = "ComputeDomain"
 COMPUTE_DOMAIN_CLIQUE = "ComputeDomainClique"
+VALIDATING_WEBHOOK_CONFIGURATION = "ValidatingWebhookConfiguration"
 
 
 # -- DRA building blocks ---------------------------------------------------
@@ -127,6 +128,43 @@ class ResourceClaim(K8sObject):
     config: List[DeviceClaimConfig] = field(default_factory=list)
     allocation: Optional[AllocationResult] = None
     reserved_for: List[ResourceClaimConsumer] = field(default_factory=list)
+
+
+@dataclass
+class WebhookClientConfig:
+    """Where the apiserver dials the webhook. `url` for out-of-cluster
+    endpoints (tests / kind), service ref for in-cluster; ca_bundle is
+    base64 PEM the apiserver must verify the serving cert against."""
+
+    url: str = ""
+    service_name: str = ""
+    service_namespace: str = ""
+    service_path: str = ""
+    ca_bundle: str = ""
+
+
+@dataclass
+class WebhookRule:
+    api_groups: List[str] = field(default_factory=list)
+    api_versions: List[str] = field(default_factory=list)
+    operations: List[str] = field(default_factory=list)  # CREATE/UPDATE/*
+    resources: List[str] = field(default_factory=list)   # plurals
+
+
+@dataclass
+class RegisteredWebhook:
+    name: str = ""
+    client_config: WebhookClientConfig = field(default_factory=WebhookClientConfig)
+    rules: List[WebhookRule] = field(default_factory=list)
+    failure_policy: str = "Fail"  # or Ignore
+    side_effects: str = "None"
+    admission_review_versions: List[str] = field(default_factory=lambda: ["v1"])
+
+
+@dataclass
+class ValidatingWebhookConfiguration(K8sObject):
+    kind: str = VALIDATING_WEBHOOK_CONFIGURATION
+    webhooks: List[RegisteredWebhook] = field(default_factory=list)
 
 
 @dataclass

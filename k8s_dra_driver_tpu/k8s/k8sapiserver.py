@@ -27,12 +27,16 @@ apiserver — the adapter must split updates accordingly.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import queue
+import ssl
 import threading
 import time
 import urllib.parse
+import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -78,6 +82,8 @@ def _status_error(e: Exception) -> Tuple[int, Dict[str, Any]]:
         NotFoundError: (404, "NotFound"),
         AlreadyExistsError: (409, "AlreadyExists"),
         ConflictError: (409, "Conflict"),
+        AdmissionDeniedError: (400, "Invalid"),
+        AdmissionUnreachableError: (500, "InternalError"),
     }.get(type(e), (500, "InternalError"))
     return code, {
         "kind": "Status",
@@ -159,10 +165,120 @@ def _parse_path(path: str) -> Optional[_Route]:
     return _Route(kind, namespace, name, subresource)
 
 
+class AdmissionDeniedError(ApiError):
+    """Webhook disallowed the request (HTTP 400, reason Invalid)."""
+
+
+class AdmissionUnreachableError(ApiError):
+    """failurePolicy=Fail webhook could not be reached (HTTP 500)."""
+
+
+def _webhook_matches(rule_sets, plural: str, group: str, version: str,
+                     operation: str) -> bool:
+    for rule in rule_sets:
+        groups_ok = "*" in rule.api_groups or group in rule.api_groups
+        vers_ok = (not rule.api_versions or "*" in rule.api_versions
+                   or version in rule.api_versions)
+        res_ok = "*" in rule.resources or plural in rule.resources
+        op_ok = "*" in rule.operations or operation in rule.operations
+        if groups_ok and vers_ok and res_ok and op_ok:
+            return True
+    return False
+
+
+def _call_admission_webhook(wh, review: Dict[str, Any],
+                            timeout: float = 10.0) -> Dict[str, Any]:
+    """POST an AdmissionReview to one registered webhook over (m)TLS or
+    plain HTTP, verifying the serving cert against the caBundle — the
+    apiserver side of the reference's webhook contract."""
+    url = wh.client_config.url
+    if not url and wh.client_config.service_name:
+        # Service refs resolve via cluster DNS on a real apiserver; this
+        # conformance server has no DNS, so only url-style configs work.
+        raise AdmissionUnreachableError(
+            f"webhook {wh.name}: service-ref clientConfig not resolvable "
+            "outside a cluster; use clientConfig.url"
+        )
+    ctx = None
+    if url.startswith("https"):
+        ctx = ssl.create_default_context()
+        if wh.client_config.ca_bundle:
+            pem = base64.b64decode(wh.client_config.ca_bundle).decode()
+            ctx.load_verify_locations(cadata=pem)
+    req = urllib.request.Request(
+        url, data=json.dumps(review).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+        body = resp.read()
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        # Non-AdmissionReview 2xx body (misconfigured proxy, HTML error
+        # page): treat like an unreachable webhook so failurePolicy applies.
+        raise AdmissionUnreachableError(
+            f"webhook {wh.name}: non-JSON response: {e}"
+        ) from None
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     api: APIServer
     stopping: threading.Event
+
+    def _admit(self, route: _Route, doc: Dict[str, Any], operation: str) -> None:
+        """Run registered validating webhooks for this write; raises
+        AdmissionDeniedError / AdmissionUnreachableError accordingly."""
+        try:
+            configs = self.api.list("ValidatingWebhookConfiguration")
+        except Exception:  # store may predate the kind
+            return
+        if not configs:
+            return
+        api_version, plural, _ = RESOURCE_MAP[route.kind]
+        group = api_version.rsplit("/", 1)[0] if "/" in api_version else ""
+        version = api_version.rsplit("/", 1)[-1]
+        for vwc in configs:
+            for wh in vwc.webhooks:
+                if not _webhook_matches(wh.rules, plural, group, version,
+                                        operation):
+                    continue
+                review = {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {
+                        "uid": uuid.uuid4().hex,
+                        "kind": {"group": group,
+                                 "version": api_version.rsplit("/", 1)[-1],
+                                 "kind": route.kind},
+                        "operation": operation,
+                        "namespace": route.namespace,
+                        "object": doc,
+                    },
+                }
+                try:
+                    out = _call_admission_webhook(wh, review)
+                except AdmissionUnreachableError as e:
+                    if wh.failure_policy == "Ignore":
+                        log.warning("ignoring failed webhook %s: %s",
+                                    wh.name, e)
+                        continue
+                    raise
+                except OSError as e:
+                    if wh.failure_policy == "Ignore":
+                        log.warning("ignoring unreachable webhook %s: %s",
+                                    wh.name, e)
+                        continue
+                    raise AdmissionUnreachableError(
+                        f"webhook {wh.name} unreachable: {e}"
+                    ) from None
+                resp = out.get("response") or {}
+                if not resp.get("allowed", False):
+                    msg = (resp.get("status") or {}).get("message", "denied")
+                    raise AdmissionDeniedError(
+                        f"admission webhook {wh.name!r} denied the request: "
+                        f"{msg}"
+                    )
 
     def log_message(self, *args: object) -> None:  # quiet
         pass
@@ -236,9 +352,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route is None or route.name:
                 raise NotFoundError(f"no route for POST {self.path}")
-            obj = from_k8s_wire(self._body())
+            doc = self._body()
+            obj = from_k8s_wire(doc)
             if route.namespace and not obj.meta.namespace:
                 obj.meta.namespace = route.namespace
+            self._admit(route, doc, "CREATE")
             created = self.api.create(obj)
             self._send_json(201, to_k8s_wire(created))
         except ApiError as e:
@@ -251,9 +369,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route is None or not route.name:
                 raise NotFoundError(f"no route for PUT {self.path}")
-            incoming = from_k8s_wire(self._body())
+            doc = self._body()
+            incoming = from_k8s_wire(doc)
             if route.namespace and not incoming.meta.namespace:
                 incoming.meta.namespace = route.namespace
+            if route.subresource != "status":
+                self._admit(route, doc, "UPDATE")
             current = self.api.get(route.kind, route.name, route.namespace)
             if route.subresource == "status":
                 # Status writes: only status fields change; CAS on the

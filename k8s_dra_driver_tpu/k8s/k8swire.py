@@ -56,6 +56,10 @@ from k8s_dra_driver_tpu.k8s.core import (
     ResourceClaimTemplate,
     ResourcePool,
     ResourceSlice,
+    RegisteredWebhook,
+    ValidatingWebhookConfiguration,
+    WebhookClientConfig,
+    WebhookRule,
 )
 from k8s_dra_driver_tpu.k8s.objects import K8sObject, ObjectMeta, OwnerReference
 from k8s_dra_driver_tpu.pkg.leaderelection import Lease
@@ -73,6 +77,10 @@ RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
     "ComputeDomain": ("resource.tpu.google.com/v1beta1", "computedomains", True),
     "ComputeDomainClique": ("resource.tpu.google.com/v1beta1", "computedomaincliques", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
+    "ValidatingWebhookConfiguration": (
+        "admissionregistration.k8s.io/v1", "validatingwebhookconfigurations",
+        False,
+    ),
 }
 
 _PLURAL_TO_KIND = {plural: kind for kind, (_, plural, _ns) in RESOURCE_MAP.items()}
@@ -903,6 +911,68 @@ def _lease_decode(doc: Dict[str, Any]) -> Lease:
     )
 
 
+# -- ValidatingWebhookConfiguration ------------------------------------------
+
+
+def _vwc_encode(vwc: ValidatingWebhookConfiguration) -> Dict[str, Any]:
+    hooks = []
+    for wh in vwc.webhooks:
+        cc: Dict[str, Any] = {}
+        if wh.client_config.url:
+            cc["url"] = wh.client_config.url
+        if wh.client_config.service_name:
+            cc["service"] = {
+                "name": wh.client_config.service_name,
+                "namespace": wh.client_config.service_namespace,
+                "path": wh.client_config.service_path,
+            }
+        if wh.client_config.ca_bundle:
+            cc["caBundle"] = wh.client_config.ca_bundle
+        hooks.append({
+            "name": wh.name,
+            "clientConfig": cc,
+            "rules": [{
+                "apiGroups": r.api_groups,
+                "apiVersions": r.api_versions,
+                "operations": r.operations,
+                "resources": r.resources,
+            } for r in wh.rules],
+            "failurePolicy": wh.failure_policy,
+            "sideEffects": wh.side_effects,
+            "admissionReviewVersions": wh.admission_review_versions,
+        })
+    return {"webhooks": hooks}
+
+
+def _vwc_decode(doc: Dict[str, Any]) -> ValidatingWebhookConfiguration:
+    hooks = []
+    for wh in doc.get("webhooks") or []:
+        cc = wh.get("clientConfig") or {}
+        svc = cc.get("service") or {}
+        hooks.append(RegisteredWebhook(
+            name=wh.get("name", ""),
+            client_config=WebhookClientConfig(
+                url=cc.get("url", ""),
+                service_name=svc.get("name", ""),
+                service_namespace=svc.get("namespace", ""),
+                service_path=svc.get("path", ""),
+                ca_bundle=cc.get("caBundle", ""),
+            ),
+            rules=[WebhookRule(
+                api_groups=r.get("apiGroups") or [],
+                api_versions=r.get("apiVersions") or [],
+                operations=r.get("operations") or [],
+                resources=r.get("resources") or [],
+            ) for r in wh.get("rules") or []],
+            failure_policy=wh.get("failurePolicy", "Fail"),
+            side_effects=wh.get("sideEffects", "None"),
+            admission_review_versions=wh.get("admissionReviewVersions") or ["v1"],
+        ))
+    return ValidatingWebhookConfiguration(
+        meta=_meta_decode(doc.get("metadata") or {}), webhooks=hooks
+    )
+
+
 # -- top level ---------------------------------------------------------------
 
 _ENCODERS = {
@@ -917,6 +987,7 @@ _ENCODERS = {
     "ComputeDomain": _computedomain_encode,
     "ComputeDomainClique": _clique_encode,
     "Lease": _lease_encode,
+    "ValidatingWebhookConfiguration": _vwc_encode,
 }
 
 _DECODERS = {
@@ -931,6 +1002,7 @@ _DECODERS = {
     "ComputeDomain": _computedomain_decode,
     "ComputeDomainClique": _clique_decode,
     "Lease": _lease_decode,
+    "ValidatingWebhookConfiguration": _vwc_decode,
 }
 
 

@@ -1,0 +1,171 @@
+"""Self-signed serving-cert generation for the admission webhook.
+
+The reference webhook serves HTTPS from --tls-cert-file/--tls-private-key-file
+(/root/reference/cmd/webhook/main.go:83-129) and its chart injects the CA into
+the ValidatingWebhookConfiguration's caBundle. Real clusters use cert-manager;
+for self-contained installs and tests this module mints a CA + server cert
+(SAN-based, as required since TLS 1.3 / Go 1.15-era verification) the same way
+helm's genCA/genSignedCert sprig functions do.
+
+Also usable as a one-shot CLI (the chart's cert-generation hook job):
+
+    python -m k8s_dra_driver_tpu.pkg.certs --out-dir /certs \
+        --san webhook-svc.kube-system.svc --san 127.0.0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import ipaddress
+import os
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+DEFAULT_DAYS = 365
+
+
+@dataclass
+class CertPaths:
+    cert_file: str
+    key_file: str
+    ca_file: str
+
+    def read_ca_pem(self) -> bytes:
+        with open(self.ca_file, "rb") as f:
+            return f.read()
+
+
+def _key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _san_entries(sans: List[str]) -> x509.SubjectAlternativeName:
+    entries: List[x509.GeneralName] = []
+    for san in sans:
+        try:
+            entries.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            entries.append(x509.DNSName(san))
+    return x509.SubjectAlternativeName(entries)
+
+
+def generate_ca(
+    common_name: str = "tpu-dra-webhook-ca", days: int = DEFAULT_DAYS
+) -> Tuple[x509.Certificate, rsa.RSAPrivateKey]:
+    key = _key()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name))
+        .issuer_name(_name(common_name))
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def generate_server_cert(
+    ca_cert: x509.Certificate,
+    ca_key: rsa.RSAPrivateKey,
+    sans: List[str],
+    common_name: str = "",
+    days: int = DEFAULT_DAYS,
+) -> Tuple[x509.Certificate, rsa.RSAPrivateKey]:
+    if not sans:
+        raise ValueError("server cert needs at least one SAN")
+    key = _key()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name or sans[0]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(_san_entries(sans), critical=False)
+        .add_extension(
+            x509.ExtendedKeyUsage([x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def _pem_cert(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def _pem_key(key: rsa.RSAPrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def write_webhook_certs(
+    out_dir: str, sans: List[str], days: int = DEFAULT_DAYS
+) -> CertPaths:
+    """Mint CA + server cert; write tls.crt / tls.key / ca.crt (the k8s TLS
+    secret layout). Returns the paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    ca_cert, ca_key = generate_ca(days=days)
+    cert, key = generate_server_cert(ca_cert, ca_key, sans, days=days)
+    paths = CertPaths(
+        cert_file=os.path.join(out_dir, "tls.crt"),
+        key_file=os.path.join(out_dir, "tls.key"),
+        ca_file=os.path.join(out_dir, "ca.crt"),
+    )
+    for path, data in (
+        (paths.cert_file, _pem_cert(cert)),
+        (paths.key_file, _pem_key(key)),
+        (paths.ca_file, _pem_cert(ca_cert)),
+    ):
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+    return paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "tpu-dra-certs", description="mint webhook serving certs (CA + server)"
+    )
+    parser.add_argument("--out-dir", required=True)
+    parser.add_argument("--san", action="append", default=[],
+                        help="DNS name or IP; repeatable")
+    parser.add_argument("--days", type=int, default=DEFAULT_DAYS)
+    args = parser.parse_args(argv)
+    paths = write_webhook_certs(args.out_dir, args.san or ["localhost", "127.0.0.1"],
+                                days=args.days)
+    print(f"wrote {paths.cert_file} {paths.key_file} {paths.ca_file}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -97,8 +97,11 @@ class AdmissionWebhook:
             out["response"]["status"] = {"message": resp.message, "code": 400}
         return out
 
-    def serve(self, host: str = "127.0.0.1", port: int = 0) -> "WebhookServer":
-        return WebhookServer(self, host, port)
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              cert_file: Optional[str] = None,
+              key_file: Optional[str] = None) -> "WebhookServer":
+        return WebhookServer(self, host, port, cert_file=cert_file,
+                             key_file=key_file)
 
 
 def _object_from_json(kind: str, raw: Dict[str, Any]):
@@ -121,10 +124,29 @@ def _object_from_json(kind: str, raw: Dict[str, Any]):
 
 
 class WebhookServer:
-    def __init__(self, webhook: AdmissionWebhook, host: str, port: int):
+    """Serves /validate-resource-claim-parameters (+ /readyz). With
+    cert_file/key_file it speaks HTTPS — required to sit behind a real
+    apiserver's ValidatingWebhookConfiguration, which refuses plain HTTP
+    (reference: ListenAndServeTLS at cmd/webhook/main.go:104-106)."""
+
+    def __init__(self, webhook: AdmissionWebhook, host: str, port: int,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
         hook = webhook
+        if bool(cert_file) != bool(key_file):
+            raise ValueError("cert_file and key_file must be given together")
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path.rstrip("/") == "/readyz":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
             def do_POST(self) -> None:  # noqa: N802
                 if self.path.rstrip("/") != "/validate-resource-claim-parameters":
                     self.send_error(404)
@@ -147,6 +169,15 @@ class WebhookServer:
                 pass
 
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.tls = bool(cert_file)
+        if cert_file:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self._thread: Optional[threading.Thread] = None
 
     @property

