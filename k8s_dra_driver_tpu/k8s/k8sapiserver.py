@@ -43,7 +43,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from k8s_dra_driver_tpu.k8s.k8swire import (
     RESOURCE_MAP,
     from_k8s_wire,
+    group_version_split,
     kind_for_plural,
+    served_versions,
     to_k8s_wire,
 )
 from k8s_dra_driver_tpu.k8s.objects import (
@@ -132,21 +134,25 @@ def _merge_main(kind: str, base, incoming):
 
 
 class _Route:
-    """Decomposed request path: kind, namespace, name, subresource."""
+    """Decomposed request path: kind, version, namespace, name, subresource."""
 
-    def __init__(self, kind: str, namespace: str, name: str, subresource: str):
+    def __init__(self, kind: str, namespace: str, name: str, subresource: str,
+                 version: str = ""):
         self.kind = kind
         self.namespace = namespace
         self.name = name
         self.subresource = subresource
+        self.version = version  # bare version from the path, e.g. "v1beta1"
 
 
 def _parse_path(path: str) -> Optional[_Route]:
     parts = [p for p in path.split("/") if p]
     # /api/v1/... (core) or /apis/<group>/<version>/...
     if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+        version = "v1"
         rest = parts[2:]
     elif len(parts) >= 3 and parts[0] == "apis":
+        version = parts[2]
         rest = parts[3:]
     else:
         return None
@@ -160,9 +166,44 @@ def _parse_path(path: str) -> Optional[_Route]:
     kind = kind_for_plural(plural)
     if kind is None:
         return None
+    # Unserved version for a known resource -> no route (404), as upstream.
+    if version not in served_versions(kind):
+        return None
     name = rest[0] if rest else ""
     subresource = rest[1] if len(rest) > 1 else ""
-    return _Route(kind, namespace, name, subresource)
+    return _Route(kind, namespace, name, subresource, version=version)
+
+
+def _discovery_doc(path: str) -> Optional[Dict[str, Any]]:
+    """Serve /apis (APIGroupList) and /apis/<group> (APIGroup) so clients
+    can negotiate versions the way client-go discovery does."""
+    parts = [p for p in path.split("/") if p]
+    groups: Dict[str, List[str]] = {}
+    for kind, (api_version, _, _) in RESOURCE_MAP.items():
+        group, _version = group_version_split(api_version)
+        if not group:
+            continue
+        groups.setdefault(group, [v for v in served_versions(kind)])
+    if parts == ["apis"]:
+        return {
+            "kind": "APIGroupList", "apiVersion": "v1",
+            "groups": [_group_doc(g, vs) for g, vs in sorted(groups.items())],
+        }
+    if len(parts) == 2 and parts[0] == "apis" and parts[1] in groups:
+        return _group_doc(parts[1], groups[parts[1]])
+    return None
+
+
+def _group_doc(group: str, versions: List[str]) -> Dict[str, Any]:
+    return {
+        "kind": "APIGroup", "apiVersion": "v1", "name": group,
+        "versions": [
+            {"groupVersion": f"{group}/{v}", "version": v} for v in versions
+        ],
+        "preferredVersion": {
+            "groupVersion": f"{group}/{versions[0]}", "version": versions[0],
+        },
+    }
 
 
 class AdmissionDeniedError(ApiError):
@@ -236,8 +277,8 @@ class _Handler(BaseHTTPRequestHandler):
         if not configs:
             return
         api_version, plural, _ = RESOURCE_MAP[route.kind]
-        group = api_version.rsplit("/", 1)[0] if "/" in api_version else ""
-        version = api_version.rsplit("/", 1)[-1]
+        group, _ = group_version_split(api_version)
+        version = route.version or api_version.rsplit("/", 1)[-1]
         for vwc in configs:
             for wh in vwc.webhooks:
                 if not _webhook_matches(wh.rules, plural, group, version,
@@ -249,7 +290,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "request": {
                         "uid": uuid.uuid4().hex,
                         "kind": {"group": group,
-                                 "version": api_version.rsplit("/", 1)[-1],
+                                 "version": version,
                                  "kind": route.kind},
                         "operation": operation,
                         "namespace": route.namespace,
@@ -309,12 +350,17 @@ class _Handler(BaseHTTPRequestHandler):
         route, q = self._route_and_query()
         try:
             if route is None:
-                if urllib.parse.urlparse(self.path).path in ("/healthz", "/readyz"):
+                raw_path = urllib.parse.urlparse(self.path).path
+                if raw_path in ("/healthz", "/readyz"):
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"ok")
+                    return
+                disc = _discovery_doc(raw_path)
+                if disc is not None:
+                    self._send_json(200, disc)
                     return
                 raise NotFoundError(f"no route for {self.path}")
             if q.get("watch", ["false"])[0] == "true":
@@ -322,7 +368,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if route.name:
                 obj = self.api.get(route.kind, route.name, route.namespace)
-                self._send_json(200, to_k8s_wire(obj))
+                self._send_json(200, to_k8s_wire(obj, route.version))
                 return
             labels = None
             if "labelSelector" in q:
@@ -334,11 +380,13 @@ class _Handler(BaseHTTPRequestHandler):
             if want_name:
                 objs = [o for o in objs if o.meta.name == want_name]
             api_version, _, _ = RESOURCE_MAP[route.kind]
+            group, _v = group_version_split(api_version)
             self._send_json(200, {
-                "apiVersion": api_version,
+                "apiVersion": (f"{group}/{route.version}" if group
+                               else route.version),
                 "kind": f"{route.kind}List",
                 "metadata": {"resourceVersion": str(int(time.time() * 1000))},
-                "items": [to_k8s_wire(o) for o in objs],
+                "items": [to_k8s_wire(o, route.version) for o in objs],
             })
         except ApiError as e:
             self._send_err(e)
@@ -358,7 +406,7 @@ class _Handler(BaseHTTPRequestHandler):
                 obj.meta.namespace = route.namespace
             self._admit(route, doc, "CREATE")
             created = self.api.create(obj)
-            self._send_json(201, to_k8s_wire(created))
+            self._send_json(201, to_k8s_wire(created, route.version))
         except ApiError as e:
             self._send_err(e)
         except (ValueError, KeyError) as e:
@@ -388,7 +436,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 merged = incoming
             updated = self.api.update(merged)
-            self._send_json(200, to_k8s_wire(updated))
+            self._send_json(200, to_k8s_wire(updated, route.version))
         except ApiError as e:
             self._send_err(e)
         except (ValueError, KeyError) as e:
@@ -399,6 +447,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route is None or not route.name:
                 raise NotFoundError(f"no route for DELETE {self.path}")
+            current = self.api.get(route.kind, route.name, route.namespace)
+            self._admit(route, to_k8s_wire(current, route.version), "DELETE")
             self.api.delete(route.kind, route.name, route.namespace)
             self._send_json(200, {
                 "kind": "Status", "apiVersion": "v1", "status": "Success",
@@ -437,7 +487,8 @@ class _Handler(BaseHTTPRequestHandler):
                                          label_selector=labels):
                     if name and obj.meta.name != name:
                         continue
-                    write_line({"type": "ADDED", "object": to_k8s_wire(obj)})
+                    write_line({"type": "ADDED",
+                                "object": to_k8s_wire(obj, route.version)})
             last_beat = time.monotonic()
             while not self.stopping.is_set():
                 try:
@@ -456,7 +507,8 @@ class _Handler(BaseHTTPRequestHandler):
                     obj_labels = ev.obj.meta.labels
                     if any(obj_labels.get(k) != v for k, v in labels.items()):
                         continue
-                write_line({"type": ev.type, "object": to_k8s_wire(ev.obj)})
+                write_line({"type": ev.type,
+                            "object": to_k8s_wire(ev.obj, route.version)})
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

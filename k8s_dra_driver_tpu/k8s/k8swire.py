@@ -70,10 +70,13 @@ RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
     "Node": ("v1", "nodes", False),
     "DaemonSet": ("apps/v1", "daemonsets", True),
     "Deployment": ("apps/v1", "deployments", True),
-    "ResourceClaim": ("resource.k8s.io/v1beta1", "resourceclaims", True),
-    "ResourceClaimTemplate": ("resource.k8s.io/v1beta1", "resourceclaimtemplates", True),
-    "ResourceSlice": ("resource.k8s.io/v1beta1", "resourceslices", False),
-    "DeviceClass": ("resource.k8s.io/v1beta1", "deviceclasses", False),
+    # resource.k8s.io is served at BOTH v1 (GA, 1.34+; the preferred wire
+    # here, matching the reference's demo/specs/quickstart/v1) and v1beta1
+    # (see SERVED_VERSIONS); kubeclient negotiates via discovery.
+    "ResourceClaim": ("resource.k8s.io/v1", "resourceclaims", True),
+    "ResourceClaimTemplate": ("resource.k8s.io/v1", "resourceclaimtemplates", True),
+    "ResourceSlice": ("resource.k8s.io/v1", "resourceslices", False),
+    "DeviceClass": ("resource.k8s.io/v1", "deviceclasses", False),
     "ComputeDomain": ("resource.tpu.google.com/v1beta1", "computedomains", True),
     "ComputeDomainClique": ("resource.tpu.google.com/v1beta1", "computedomaincliques", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
@@ -83,6 +86,12 @@ RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
     ),
 }
 
+# group -> every served version, preferred first. Groups not listed serve
+# only their RESOURCE_MAP version.
+SERVED_VERSIONS: Dict[str, List[str]] = {
+    "resource.k8s.io": ["v1", "v1beta1"],
+}
+
 _PLURAL_TO_KIND = {plural: kind for kind, (_, plural, _ns) in RESOURCE_MAP.items()}
 
 
@@ -90,10 +99,29 @@ def kind_for_plural(plural: str) -> Optional[str]:
     return _PLURAL_TO_KIND.get(plural)
 
 
-def api_path(kind: str, namespace: str = "", name: str = "") -> str:
-    """REST path for a kind: /api/v1/... (core) or /apis/<group>/..."""
-    api_version, plural, namespaced = RESOURCE_MAP[kind]
-    root = f"/api/{api_version}" if "/" not in api_version else f"/apis/{api_version}"
+def group_version_split(api_version: str) -> Tuple[str, str]:
+    """'resource.k8s.io/v1' -> ('resource.k8s.io', 'v1'); 'v1' -> ('', 'v1')."""
+    if "/" in api_version:
+        group, _, version = api_version.rpartition("/")
+        return group, version
+    return "", api_version
+
+
+def served_versions(kind: str) -> List[str]:
+    api_version, _, _ = RESOURCE_MAP[kind]
+    group, version = group_version_split(api_version)
+    return SERVED_VERSIONS.get(group, [version])
+
+
+def api_path(kind: str, namespace: str = "", name: str = "",
+             api_version: str = "") -> str:
+    """REST path for a kind: /api/v1/... (core) or /apis/<group>/...
+    `api_version` (bare version like 'v1beta1') overrides the preferred."""
+    preferred, plural, namespaced = RESOURCE_MAP[kind]
+    group, version = group_version_split(preferred)
+    if api_version:
+        version = api_version
+    root = f"/api/{version}" if not group else f"/apis/{group}/{version}"
     path = root
     if namespaced and namespace:
         path += f"/namespaces/{namespace}"
@@ -440,19 +468,24 @@ def _deployment_decode(doc: Dict[str, Any]) -> Deployment:
 # -- DRA: requests / configs / allocations ----------------------------------
 
 
-def _requests_encode(requests: List[DeviceRequest]) -> List[Dict[str, Any]]:
+def _requests_encode(requests: List[DeviceRequest],
+                     version: str = "v1") -> List[Dict[str, Any]]:
+    """v1 nests the one-of under `exactly:` (reference quickstart
+    demo/specs/quickstart/v1/gpu-test1.yaml:10-21); v1beta1 is flat."""
     out = []
     for r in requests:
-        doc: Dict[str, Any] = {
-            "name": r.name,
+        inner: Dict[str, Any] = {
             "deviceClassName": r.device_class_name,
             "allocationMode": r.allocation_mode,
         }
         if r.allocation_mode == "ExactCount":
-            doc["count"] = r.count
+            inner["count"] = r.count
         if r.selectors:
-            doc["selectors"] = [{"cel": {"expression": s}} for s in r.selectors]
-        out.append(doc)
+            inner["selectors"] = [{"cel": {"expression": s}} for s in r.selectors]
+        if version == "v1beta1":
+            out.append({"name": r.name, **inner})
+        else:
+            out.append({"name": r.name, "exactly": inner})
     return out
 
 
@@ -504,10 +537,10 @@ def _configs_decode(docs: List[Dict[str, Any]], source: str) -> List[DeviceClaim
     return out
 
 
-def _claim_encode(rc: ResourceClaim) -> Dict[str, Any]:
+def _claim_encode(rc: ResourceClaim, version: str = "v1") -> Dict[str, Any]:
     spec = {
         "devices": {
-            "requests": _requests_encode(rc.requests),
+            "requests": _requests_encode(rc.requests, version),
             "config": _configs_encode(rc.config),
         }
     }
@@ -586,7 +619,8 @@ def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
     )
 
 
-def _claim_template_encode(t: ResourceClaimTemplate) -> Dict[str, Any]:
+def _claim_template_encode(t: ResourceClaimTemplate,
+                           version: str = "v1") -> Dict[str, Any]:
     tmpl_meta: Dict[str, Any] = {}
     if t.spec_meta_labels:
         tmpl_meta["labels"] = dict(t.spec_meta_labels)
@@ -597,7 +631,7 @@ def _claim_template_encode(t: ResourceClaimTemplate) -> Dict[str, Any]:
             "metadata": tmpl_meta,
             "spec": {
                 "devices": {
-                    "requests": _requests_encode(t.requests),
+                    "requests": _requests_encode(t.requests, version),
                     "config": _configs_encode(t.config),
                 }
             },
@@ -653,7 +687,7 @@ def _counters_decode(doc: Dict[str, Any]) -> Dict[str, Counter]:
     return out
 
 
-def _slice_encode(rs: ResourceSlice) -> Dict[str, Any]:
+def _slice_encode(rs: ResourceSlice, version: str = "v1") -> Dict[str, Any]:
     devices = []
     for d in rs.devices:
         basic: Dict[str, Any] = {
@@ -674,7 +708,11 @@ def _slice_encode(rs: ResourceSlice) -> Dict[str, Any]:
                 }
                 for cc in d.consumes_counters
             ]
-        devices.append({"name": d.name, "basic": basic})
+        # v1 flattened the Device one-of; v1beta1 wraps it in "basic".
+        if version == "v1beta1":
+            devices.append({"name": d.name, "basic": basic})
+        else:
+            devices.append({"name": d.name, **basic})
     spec: Dict[str, Any] = {
         "driver": rs.driver,
         "pool": {
@@ -1006,14 +1044,33 @@ _DECODERS = {
 }
 
 
-def to_k8s_wire(obj: K8sObject) -> Dict[str, Any]:
-    """Encode an internal object as real Kubernetes JSON."""
+# Kinds whose wire shape differs between served versions; their encoders
+# take (obj, version).
+_VERSIONED_KINDS = {"ResourceClaim", "ResourceClaimTemplate", "ResourceSlice"}
+
+
+def to_k8s_wire(obj: K8sObject, api_version: str = "") -> Dict[str, Any]:
+    """Encode an internal object as real Kubernetes JSON. `api_version` is
+    a bare version ('v1beta1') selecting among the kind's served versions;
+    default is the preferred (RESOURCE_MAP) version."""
     if obj.kind not in _ENCODERS:
         raise ValueError(f"kind {obj.kind!r} has no k8s wire mapping")
-    api_version, _, _ = RESOURCE_MAP[obj.kind]
-    doc = {"apiVersion": api_version, "kind": obj.kind,
+    preferred, _, _ = RESOURCE_MAP[obj.kind]
+    group, version = group_version_split(preferred)
+    if api_version:
+        if api_version not in served_versions(obj.kind):
+            raise ValueError(
+                f"{obj.kind} is not served at {api_version!r} "
+                f"(served: {served_versions(obj.kind)})"
+            )
+        version = api_version
+    full = f"{group}/{version}" if group else version
+    doc = {"apiVersion": full, "kind": obj.kind,
            "metadata": _meta_encode(obj.meta)}
-    doc.update(_ENCODERS[obj.kind](obj))
+    if obj.kind in _VERSIONED_KINDS:
+        doc.update(_ENCODERS[obj.kind](obj, version))
+    else:
+        doc.update(_ENCODERS[obj.kind](obj))
     return doc
 
 

@@ -43,6 +43,8 @@ from k8s_dra_driver_tpu.k8s.k8swire import (
     RESOURCE_MAP,
     api_path,
     from_k8s_wire,
+    group_version_split,
+    served_versions,
     to_k8s_wire,
 )
 from k8s_dra_driver_tpu.k8s.k8sapiserver import STATUS_SUBRESOURCE_KINDS
@@ -200,6 +202,48 @@ class KubernetesAPIServer:
         self._ssl = auth.ssl_context()
         self._watch_stops: Dict[int, threading.Event] = {}
         self._watch_known: Dict[int, Dict[Tuple[str, str], K8sObject]] = {}
+        # group -> negotiated bare version (filled lazily via discovery).
+        self._group_version: Dict[str, str] = {}
+        self._group_version_lock = threading.Lock()
+
+    # -- version negotiation -------------------------------------------------
+
+    def _negotiated_version(self, kind: str) -> str:
+        """For multi-version kinds, pick the newest version this client
+        speaks that the server serves (client-go discovery analog): GET
+        /apis/<group>, intersect with our served list, prefer ours first.
+        Returns '' for single-version kinds (use the RESOURCE_MAP path)."""
+        ours = served_versions(kind)
+        if len(ours) == 1:
+            return ""
+        group, _ = group_version_split(RESOURCE_MAP[kind][0])
+        with self._group_version_lock:
+            cached = self._group_version.get(group)
+        if cached:
+            return cached
+        chosen = ours[0]
+        try:
+            doc = self._request("GET", f"/apis/{group}")
+            theirs = {v.get("version") for v in doc.get("versions") or []}
+            chosen = next((v for v in ours if v in theirs), ours[0])
+        except (ApiError, OSError) as e:
+            # 1.30-ish servers may 403 discovery to anonymous users; fall
+            # back to our preferred version rather than failing closed —
+            # but do NOT cache, so a transient startup failure doesn't pin
+            # the wrong version for the life of the process.
+            log.warning("discovery for group %s failed (%s); assuming %s",
+                        group, e, chosen)
+            return chosen
+        with self._group_version_lock:
+            self._group_version[group] = chosen
+        return chosen
+
+    def _path(self, kind: str, namespace: str = "", name: str = "") -> str:
+        return api_path(kind, namespace, name,
+                        api_version=self._negotiated_version(kind))
+
+    def _to_wire(self, obj: K8sObject) -> dict:
+        return to_k8s_wire(obj, self._negotiated_version(obj.kind))
 
     # -- plumbing ----------------------------------------------------------
 
@@ -238,12 +282,12 @@ class KubernetesAPIServer:
     # -- interface ----------------------------------------------------------
 
     def create(self, obj: K8sObject) -> K8sObject:
-        path = api_path(obj.kind, obj.meta.namespace)
-        return from_k8s_wire(self._request("POST", path, to_k8s_wire(obj)))
+        path = self._path(obj.kind, obj.meta.namespace)
+        return from_k8s_wire(self._request("POST", path, self._to_wire(obj)))
 
     def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
         return from_k8s_wire(
-            self._request("GET", api_path(kind, namespace, name))
+            self._request("GET", self._path(kind, namespace, name))
         )
 
     def try_get(self, kind: str, name: str,
@@ -259,7 +303,7 @@ class KubernetesAPIServer:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[K8sObject]:
-        path = api_path(kind, namespace or "")
+        path = self._path(kind, namespace or "")
         params = {}
         if label_selector:
             params["labelSelector"] = ",".join(
@@ -271,8 +315,8 @@ class KubernetesAPIServer:
         return [from_k8s_wire(d) for d in doc.get("items", [])]
 
     def update(self, obj: K8sObject) -> K8sObject:
-        path = api_path(obj.kind, obj.meta.namespace, obj.meta.name)
-        wire = to_k8s_wire(obj)
+        path = self._path(obj.kind, obj.meta.namespace, obj.meta.name)
+        wire = self._to_wire(obj)
         updated = from_k8s_wire(self._request("PUT", path, wire))
         if obj.kind in STATUS_SUBRESOURCE_KINDS:
             # Second phase: the main PUT ignored status changes; write them
@@ -292,7 +336,7 @@ class KubernetesAPIServer:
         return updated
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
-        self._request("DELETE", api_path(kind, namespace, name))
+        self._request("DELETE", self._path(kind, namespace, name))
 
     def update_with_retry(
         self, kind: str, name: str, namespace: str,
@@ -312,7 +356,7 @@ class KubernetesAPIServer:
 
     def _watch_path(self, kind: str, name: Optional[str],
                     namespace: Optional[str]) -> str:
-        path = api_path(kind, namespace or "")
+        path = self._path(kind, namespace or "")
         params: Dict[str, str] = {"watch": "true"}
         if name:
             params["fieldSelector"] = f"metadata.name={name}"

@@ -23,16 +23,19 @@ def unwrap_template_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
-    return [
-        DeviceRequest(
+    out = []
+    for r in spec.get("devices", {}).get("requests", []):
+        # resource.k8s.io/v1 nests the one-of under "exactly"; v1beta1 is
+        # flat (reference demo/specs/quickstart/v1/gpu-test1.yaml:10-21).
+        inner = r.get("exactly") or r
+        out.append(DeviceRequest(
             name=r.get("name", "device"),
-            device_class_name=r.get("deviceClassName", ""),
-            allocation_mode=r.get("allocationMode", "ExactCount"),
-            count=r.get("count", 1),
-            selectors=r.get("selectors", []),
-        )
-        for r in spec.get("devices", {}).get("requests", [])
-    ]
+            device_class_name=inner.get("deviceClassName", ""),
+            allocation_mode=inner.get("allocationMode", "ExactCount"),
+            count=inner.get("count", 1),
+            selectors=inner.get("selectors", []),
+        ))
+    return out
 
 
 def device_configs_from_spec(spec: Dict[str, Any]) -> List[DeviceClaimConfig]:

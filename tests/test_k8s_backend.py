@@ -115,9 +115,16 @@ def test_wire_resourceslice_roundtrip():
         "tpu.google.com/coords": "0,0,0", "index": 0, "healthy": True}
     assert back.shared_counters[0].counters["chip"].value == 4
     wire = to_k8s_wire(rs)
-    assert wire["apiVersion"] == "resource.k8s.io/v1beta1"
-    # v1beta1 wraps per-device payload in "basic"
-    assert "basic" in wire["spec"]["devices"][0]
+    # v1 (preferred) flattens the device payload; v1beta1 wraps in "basic".
+    assert wire["apiVersion"] == "resource.k8s.io/v1"
+    assert "basic" not in wire["spec"]["devices"][0]
+    assert "attributes" in wire["spec"]["devices"][0]
+    wire_beta = to_k8s_wire(rs, "v1beta1")
+    assert wire_beta["apiVersion"] == "resource.k8s.io/v1beta1"
+    assert "basic" in wire_beta["spec"]["devices"][0]
+    from k8s_dra_driver_tpu.k8s.k8swire import from_k8s_wire
+    assert from_k8s_wire(wire_beta).devices[0].attributes == \
+        back.devices[0].attributes
 
 
 def test_wire_claim_roundtrip():
@@ -229,7 +236,9 @@ def test_wire_claim_template_and_node_roundtrip():
 
 def test_api_path():
     assert api_path("Pod", "ns", "p") == "/api/v1/namespaces/ns/pods/p"
-    assert api_path("ResourceSlice") == "/apis/resource.k8s.io/v1beta1/resourceslices"
+    assert api_path("ResourceSlice") == "/apis/resource.k8s.io/v1/resourceslices"
+    assert api_path("ResourceSlice", api_version="v1beta1") == \
+        "/apis/resource.k8s.io/v1beta1/resourceslices"
     assert (api_path("ComputeDomain", "ns")
             == "/apis/resource.tpu.google.com/v1beta1/namespaces/ns/computedomains")
     assert api_path("Lease", "kube-system", "x") == (
@@ -395,6 +404,67 @@ def test_kube_watch_survives_apiserver_restart():
 
 
 # -- kubeconfig resolution ---------------------------------------------------
+
+
+def test_kube_discovery_and_v1_negotiation(kube):
+    """Client discovers resource.k8s.io versions and speaks v1 (GA) with the
+    `exactly:` request shape; the server also still serves v1beta1 paths."""
+    import json as _json
+    import urllib.request as _rq
+
+    api, store = kube
+    # Discovery endpoints answer like a real apiserver.
+    with _rq.urlopen(api.auth.server + "/apis", timeout=5) as r:
+        groups = {g["name"]: g for g in _json.loads(r.read())["groups"]}
+    assert groups["resource.k8s.io"]["preferredVersion"]["version"] == "v1"
+    assert {v["version"] for v in groups["resource.k8s.io"]["versions"]} == \
+        {"v1", "v1beta1"}
+
+    # The adapter negotiated v1 and round-trips a claim with requests.
+    claim = ResourceClaim(
+        meta=new_meta("neg", "ns"),
+        requests=[DeviceRequest(name="tpus",
+                                device_class_name="tpu.google.com", count=2)],
+    )
+    api.create(claim)
+    assert api._group_version.get("resource.k8s.io") == "v1"
+    back = api.get("ResourceClaim", "neg", "ns")
+    assert back.requests[0].count == 2
+
+    # Raw v1 GET shows the exactly: shape; raw v1beta1 GET the flat shape.
+    with _rq.urlopen(api.auth.server +
+                     "/apis/resource.k8s.io/v1/namespaces/ns/resourceclaims/neg",
+                     timeout=5) as r:
+        v1doc = _json.loads(r.read())
+    assert "exactly" in v1doc["spec"]["devices"]["requests"][0]
+    with _rq.urlopen(api.auth.server +
+                     "/apis/resource.k8s.io/v1beta1/namespaces/ns/resourceclaims/neg",
+                     timeout=5) as r:
+        betadoc = _json.loads(r.read())
+    req = betadoc["spec"]["devices"]["requests"][0]
+    assert "exactly" not in req and req["deviceClassName"] == "tpu.google.com"
+    assert betadoc["apiVersion"] == "resource.k8s.io/v1beta1"
+
+    # Unserved version -> 404, like upstream.
+    import urllib.error as _err
+    with pytest.raises(_err.HTTPError) as exc:
+        _rq.urlopen(api.auth.server +
+                    "/apis/resource.k8s.io/v9/resourceclaims", timeout=5)
+    assert exc.value.code == 404
+
+
+def test_kube_falls_back_to_v1beta1_only_server(kube):
+    """Against a server whose discovery offers only v1beta1 (a 1.32-era
+    cluster), the adapter downgrades and still round-trips."""
+    api, _ = kube
+    api._group_version["resource.k8s.io"] = "v1beta1"  # as negotiation would
+    claim = ResourceClaim(
+        meta=new_meta("beta", "ns"),
+        requests=[DeviceRequest(name="r", device_class_name="tpu.google.com")],
+    )
+    api.create(claim)
+    back = api.get("ResourceClaim", "beta", "ns")
+    assert back.requests[0].device_class_name == "tpu.google.com"
 
 
 def test_kubeauth_from_kubeconfig(tmp_path):
