@@ -149,10 +149,10 @@ def _parse_path(path: str) -> Optional[_Route]:
     parts = [p for p in path.split("/") if p]
     # /api/v1/... (core) or /apis/<group>/<version>/...
     if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
-        version = "v1"
+        group, version = "", "v1"
         rest = parts[2:]
     elif len(parts) >= 3 and parts[0] == "apis":
-        version = parts[2]
+        group, version = parts[1], parts[2]
         rest = parts[3:]
     else:
         return None
@@ -166,8 +166,10 @@ def _parse_path(path: str) -> Optional[_Route]:
     kind = kind_for_plural(plural)
     if kind is None:
         return None
-    # Unserved version for a known resource -> no route (404), as upstream.
-    if version not in served_versions(kind):
+    # Wrong group or unserved version for a known resource -> no route
+    # (404), as upstream.
+    kind_group, _ = group_version_split(RESOURCE_MAP[kind][0])
+    if group != kind_group or version not in served_versions(kind):
         return None
     name = rest[0] if rest else ""
     subresource = rest[1] if len(rest) > 1 else ""
@@ -269,7 +271,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _admit(self, route: _Route, doc: Dict[str, Any], operation: str) -> None:
         """Run registered validating webhooks for this write; raises
-        AdmissionDeniedError / AdmissionUnreachableError accordingly."""
+        AdmissionDeniedError / AdmissionUnreachableError accordingly.
+        For DELETE, `doc` is the existing object and is sent as oldObject
+        (request.object is null), per the admission.k8s.io/v1 contract."""
         try:
             configs = self.api.list("ValidatingWebhookConfiguration")
         except Exception:  # store may predate the kind
@@ -278,7 +282,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         api_version, plural, _ = RESOURCE_MAP[route.kind]
         group, _ = group_version_split(api_version)
-        version = route.version or api_version.rsplit("/", 1)[-1]
+        version = route.version
         for vwc in configs:
             for wh in vwc.webhooks:
                 if not _webhook_matches(wh.rules, plural, group, version,
@@ -294,7 +298,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  "kind": route.kind},
                         "operation": operation,
                         "namespace": route.namespace,
-                        "object": doc,
+                        "object": None if operation == "DELETE" else doc,
+                        "oldObject": doc if operation == "DELETE" else None,
                     },
                 }
                 try:

@@ -455,16 +455,40 @@ def test_kube_discovery_and_v1_negotiation(kube):
 
 def test_kube_falls_back_to_v1beta1_only_server(kube):
     """Against a server whose discovery offers only v1beta1 (a 1.32-era
-    cluster), the adapter downgrades and still round-trips."""
+    cluster), negotiation itself downgrades and round-trips still work."""
     api, _ = kube
-    api._group_version["resource.k8s.io"] = "v1beta1"  # as negotiation would
+    real_request = api._request
+
+    def request_with_old_discovery(method, path, body=None):
+        if method == "GET" and path == "/apis/resource.k8s.io":
+            return {"kind": "APIGroup", "name": "resource.k8s.io",
+                    "versions": [{"groupVersion": "resource.k8s.io/v1beta1",
+                                  "version": "v1beta1"}],
+                    "preferredVersion": {"version": "v1beta1"}}
+        return real_request(method, path, body)
+
+    api._request = request_with_old_discovery
     claim = ResourceClaim(
         meta=new_meta("beta", "ns"),
         requests=[DeviceRequest(name="r", device_class_name="tpu.google.com")],
     )
     api.create(claim)
+    assert api._group_version["resource.k8s.io"] == "v1beta1"
     back = api.get("ResourceClaim", "beta", "ns")
     assert back.requests[0].device_class_name == "tpu.google.com"
+
+
+def test_wrong_group_paths_404(kube):
+    """A known plural under the wrong group must not route (upstream
+    behavior): /api/v1/resourceclaims and /apis/apps/v1/resourceclaims."""
+    import urllib.error as _err
+    import urllib.request as _rq
+
+    api, _ = kube
+    for path in ("/api/v1/resourceclaims", "/apis/apps/v1/resourceclaims"):
+        with pytest.raises(_err.HTTPError) as exc:
+            _rq.urlopen(api.auth.server + path, timeout=5)
+        assert exc.value.code == 404, path
 
 
 def test_kubeauth_from_kubeconfig(tmp_path):
