@@ -64,6 +64,10 @@ class TpuDriver:
         )
         self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
         self._pool_generation = 1
+        # Serializes slice publishes between the main thread and the health
+        # watcher's callback thread (taint loss via last-writer-wins and a
+        # racy generation increment otherwise).
+        self._publish_mu = threading.Lock()
         self._tainted_chips: Dict[int, ChipHealth] = {}
         self._cleanup_interval = cleanup_interval_s
         self._stop = threading.Event()
@@ -86,6 +90,8 @@ class TpuDriver:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if hasattr(self.state.tpulib, "stop_health_watch"):
+            self.state.tpulib.stop_health_watch()
         if self._cleanup_thread:
             self._cleanup_thread.join(timeout=5)
         self._registered = False
@@ -97,22 +103,24 @@ class TpuDriver:
     # -- ResourceSlice publishing -------------------------------------------
 
     def publish_resources(self) -> None:
-        rs = build_resource_slice(
-            self.node_name,
-            self.driver_name,
-            self.state.allocatable,
-            self.state.inventory,
-            pool_generation=self._pool_generation,
-        )
-        self._pool_generation += 1
-        # Apply current taints before publishing.
-        for dev in rs.devices:
-            chips = self.state.allocatable[dev.name].chip_indices
-            if any(c in self._tainted_chips for c in chips):
-                dev.taints.append(
-                    DeviceTaint(key=UNHEALTHY_TAINT_KEY, value="true", effect="NoSchedule")
-                )
-        create_or_update_slice(self.api, rs)
+        with self._publish_mu:
+            rs = build_resource_slice(
+                self.node_name,
+                self.driver_name,
+                self.state.allocatable,
+                self.state.inventory,
+                pool_generation=self._pool_generation,
+            )
+            self._pool_generation += 1
+            # Apply current taints before publishing.
+            for dev in rs.devices:
+                chips = self.state.allocatable[dev.name].chip_indices
+                if any(c in self._tainted_chips for c in chips):
+                    dev.taints.append(
+                        DeviceTaint(key=UNHEALTHY_TAINT_KEY, value="true",
+                                    effect="NoSchedule")
+                    )
+            create_or_update_slice(self.api, rs)
 
     # -- health -> taints ----------------------------------------------------
 

@@ -16,7 +16,8 @@ import json
 import logging
 import os
 import re
-from typing import Dict, List, Optional
+import threading
+from typing import Callable, Dict, List, Optional
 
 from k8s_dra_driver_tpu.tpulib.profiles import GENS, compute_subslice_profiles
 from k8s_dra_driver_tpu.tpulib.types import (
@@ -34,6 +35,8 @@ log = logging.getLogger(__name__)
 TPULIB_PATH_ENV = "TPULIB_PATH"
 ALT_TPU_DEV_ROOT_ENV = "ALT_TPU_DEV_ROOT"
 ALT_TPU_SYSFS_ROOT_ENV = "ALT_TPU_SYSFS_ROOT"
+HEALTH_POLL_SECONDS_ENV = "TPU_HEALTH_POLL_SECONDS"
+DEFAULT_HEALTH_POLL_S = 5.0
 
 _DEFAULT_LIB_LOCATIONS = (
     os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libtpulib.so"),
@@ -142,6 +145,11 @@ class RealTpuLib:
         self.sysfs_root = sysfs_root or os.environ.get(ALT_TPU_SYSFS_ROOT_ENV, "/sys")
         self.env = dict(env) if env is not None else dict(os.environ)
         self.native = self._lib is not None
+        self._health_listeners: List[Callable[[int, ChipHealth], None]] = []
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+        self._health_known: Dict[int, ChipHealth] = {}
+        self._enumerated_indexes: List[int] = []
 
     def shim_version(self) -> str:
         if self._lib is None:
@@ -171,8 +179,87 @@ class RealTpuLib:
         path = os.path.join(self.dev_root, f"accel{index}")
         return ChipHealth.HEALTHY if os.path.exists(path) else ChipHealth.UNHEALTHY
 
+    # -- health events (NVML event-set analog) -------------------------------
+
+    def watch_health(
+        self,
+        callback: Callable[[int, ChipHealth], None],
+        poll_interval_s: Optional[float] = None,
+    ) -> None:
+        """Register callback(chip_index, health) and start the poller on
+        first registration. The reference blocks on an NVML event set
+        (device_health.go:103-274); the TPU kernel driver has no equivalent
+        event fd, so this polls tpulib_chip_health for each enumerated chip
+        (native shim when loaded) and fires callbacks on transitions.
+        Interval from TPU_HEALTH_POLL_SECONDS (default 5s)."""
+        self._health_listeners.append(callback)
+        if self._health_thread is not None:
+            return
+        if poll_interval_s is None:
+            try:
+                poll_interval_s = float(
+                    self.env.get(HEALTH_POLL_SECONDS_ENV, DEFAULT_HEALTH_POLL_S)
+                )
+            except ValueError:
+                poll_interval_s = DEFAULT_HEALTH_POLL_S
+        # Baseline every known chip as HEALTHY regardless of current state:
+        # a chip that is already dead at watch start then fires an UNHEALTHY
+        # transition on the first poll, so it gets tainted instead of being
+        # silently grandfathered in as schedulable. The union with the last
+        # enumeration covers chips whose device node vanished entirely
+        # (they no longer appear in a fresh scan).
+        indexes = {c["index"] for c in self._scan()} | set(self._enumerated_indexes)
+        self._health_known = {i: ChipHealth.HEALTHY for i in indexes}
+        self._health_stop.clear()
+        self._health_thread = threading.Thread(
+            target=self._health_poll_loop, args=(poll_interval_s,),
+            name="tpu-health-watch", daemon=True,
+        )
+        self._health_thread.start()
+
+    def stop_health_watch(self) -> None:
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+        # Drop listeners: a later watch_health must not re-fire into
+        # already-shut-down owners.
+        self._health_listeners = []
+
+    def _health_poll_loop(self, interval_s: float) -> None:
+        # Immediate first pass so startup-dead chips surface without waiting
+        # a full interval.
+        while True:
+            try:
+                self._health_poll_once()
+            except Exception:  # noqa: BLE001 — keep polling
+                log.exception("health poll failed")
+            if self._health_stop.wait(interval_s):
+                return
+
+    def _health_poll_once(self) -> None:
+        for index, prev in list(self._health_known.items()):
+            cur = self.chip_health(index)
+            if cur == prev:
+                continue
+            log.warning("chip %d health %s -> %s", index, prev.value, cur.value)
+            delivered = True
+            for cb in list(self._health_listeners):
+                try:
+                    cb(index, cur)
+                except Exception:  # noqa: BLE001 — isolate listeners
+                    log.exception("health listener failed for chip %d", index)
+                    delivered = False
+            # Commit only after every listener accepted the event; a failed
+            # delivery (e.g. apiserver briefly unreachable during the taint
+            # republish) keeps the old state so the transition re-fires
+            # next poll. Listeners must therefore be idempotent.
+            if delivered:
+                self._health_known[index] = cur
+
     def enumerate(self) -> HostInventory:
         raw = self._scan()
+        self._enumerated_indexes = [c["index"] for c in raw]
         n_local = len(raw)
 
         acc_type = self.env.get("TPU_ACCELERATOR_TYPE", "")
