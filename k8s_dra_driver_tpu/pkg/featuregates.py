@@ -64,8 +64,10 @@ FEATURES: Tuple[FeatureSpec, ...] = (
     ),
     FeatureSpec(
         "DynamicSubslice", False, Stage.ALPHA,
-        "Create ICI subslice partitions on demand at Prepare time instead of "
-        "advertising a static partition set (the DynamicMIG analog).",
+        "Carve ICI subslice partitions through the partitioner ledger at "
+        "Prepare time (the DynamicMIG analog); unprepare/rollback releases "
+        "them.",
+        requires=("ICIPartitioning",),
     ),
     FeatureSpec(
         "ComputeDomainCliques", True, Stage.BETA,
@@ -83,9 +85,10 @@ FEATURES: Tuple[FeatureSpec, ...] = (
     ),
     FeatureSpec(
         "ICIPartitioning", False, Stage.ALPHA,
-        "Program ICI mesh partitions for passthrough device groups (the "
-        "NVSwitch/FabricManager partitioning analog).",
-        requires=("PassthroughSupport",),
+        "Program ICI mesh partitions (the NVSwitch/FabricManager "
+        "partitioning analog) — consumed by passthrough device groups and "
+        "by DynamicSubslice carving. No PassthroughSupport dependency: "
+        "subslice deployments must not be forced to advertise VFIO devices.",
     ),
     FeatureSpec(
         "HostManagedSliceAgent", False, Stage.ALPHA,

@@ -60,6 +60,13 @@ from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
     VfioDevice,
     enumerate_allocatable,
 )
+from k8s_dra_driver_tpu.pkg.partitioner import (
+    NativePartitionClient,
+    PartitionError,
+    PartitionManager,
+    StubPartitionClient,
+    load_tpupart,
+)
 from k8s_dra_driver_tpu.plugins.tpu.sharing import SharingManager
 from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
@@ -106,6 +113,37 @@ class DeviceState:
         self.vfio = VfioPciManager()
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
+        # DynamicSubslice (the DynamicMIG analog, reference
+        # nvlib.go:971-1199): subslice prepares carve a partition through
+        # the ICI partitioner ledger; static mode leaves partitioning to
+        # the platform. The native flock'd on-disk ledger survives plugin
+        # restarts (like FM service state); the stub covers mock runs.
+        self.partitions: Optional[PartitionManager] = None
+        if self.gates.enabled("DynamicSubslice"):
+            host_topology = self.inventory.host_topology
+            ledger = os.path.join(plugin_dir, "partitions.json")
+            if load_tpupart() is not None:
+                client = NativePartitionClient(host_topology, ledger)
+            elif os.environ.get("ALT_TPU_TOPOLOGY"):
+                # Mock seam (CPU CI): the in-memory stub stands in for the
+                # platform, like the reference's FM stubClient.
+                client = StubPartitionClient()
+            elif not self.gates.enabled("CrashOnICIFabricErrors"):
+                log.error(
+                    "DynamicSubslice enabled but libtpupart.so is missing: "
+                    "using the in-memory stub — partitions are NOT "
+                    "programmed into hardware and do NOT survive restarts"
+                )
+                client = StubPartitionClient()
+            else:
+                # Refuse to degrade silently (CrashOnICIFabricErrors
+                # posture, reference CrashOnNVLinkFabricErrors).
+                raise PartitionError(
+                    "DynamicSubslice requires libtpupart.so on real nodes "
+                    "(build native/, or set CrashOnICIFabricErrors=false "
+                    "to degrade to the in-memory stub)"
+                )
+            self.partitions = PartitionManager(host_topology, client)
         self._mutex = threading.Lock()
 
         def on_discard(uid: str) -> None:
@@ -248,14 +286,27 @@ class DeviceState:
                 dev = self.allocatable[result.device]
                 if isinstance(dev, VfioDevice):
                     dev = self._ensure_vfio_bound(dev)
-                for cfg in configs.get(result.request, []):
-                    self._apply_config(cfg, claim.uid, dev)
+                extra: Dict[str, str] = {}
+                try:
+                    if isinstance(dev, SubsliceDevice) and self.partitions is not None:
+                        extra["partition"] = self._activate_partition(dev)
+                    for cfg in configs.get(result.request, []):
+                        self._apply_config(cfg, claim.uid, dev)
+                except Exception:
+                    # The in-flight device is not in `prepared` yet; undo its
+                    # own partition/sharing before the outer rollback runs.
+                    pid = extra.get("partition")
+                    if pid and self.partitions is not None:
+                        self.partitions.deactivate(pid)
+                    self.sharing.clear(claim.uid, tuple(dev.chip_indices))
+                    raise
                 prepared.append(
                     PreparedDevice(
                         name=dev.name,
                         device_type=dev.device_type,
                         chip_indices=list(dev.chip_indices),
                         request=result.request,
+                        extra=extra,
                     )
                 )
         except Exception:
@@ -339,9 +390,55 @@ class DeviceState:
         self.allocatable[dev.name] = dev
         return dev
 
+    def _activate_partition(self, dev: SubsliceDevice) -> str:
+        """Carve the subslice's ICI partition (the createMigDevice leg of the
+        MIG transaction, nvlib.go:971-1199). Idempotent via the manager; an
+        overlap with a live partition is a PrepareError like any other
+        device conflict."""
+        assert self.partitions is not None
+        partition = self.partitions.partition_for_chips(tuple(dev.chip_indices))
+        if partition is None:
+            raise PrepareError(
+                f"no legal ICI partition for subslice {dev.name} "
+                f"(chips {dev.chip_indices}) on {self.inventory.host_topology}"
+            )
+        try:
+            self.partitions.activate(partition.id)
+        except PartitionError as e:
+            raise PrepareError(f"partition activate {partition.id}: {e}") from e
+        return partition.id
+
+    def destroy_unknown_partitions(self) -> int:
+        """Startup reconcile (the DestroyUnknownMIGDevices analog,
+        driver.go:110 + nvlib.go:429-464): deactivate ledger partitions no
+        PrepareCompleted claim holds — leftovers of a crash between
+        activation and the checkpoint write. Returns how many were freed.
+        Caller must hold the node-global pu flock: an overlapping old
+        plugin process mid-prepare has activated its partition but not yet
+        checkpointed it, and without the lock this would free it."""
+        if self.partitions is None:
+            return 0
+        with self._mutex:
+            held = {
+                d.extra.get("partition")
+                for entry in self._get_checkpoint().claims.values()
+                if entry.state == PREPARE_COMPLETED
+                for d in entry.devices
+            }
+            freed = 0
+            for p in self.partitions.active_partitions():
+                if p.id not in held:
+                    log.warning("freeing unknown ICI partition %s", p.id)
+                    self.partitions.deactivate(p.id)
+                    freed += 1
+            return freed
+
     def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
         try:
             self.sharing.clear(claim_uid, tuple(d.chip_indices))
+            pid = d.extra.get("partition")
+            if pid and self.partitions is not None:
+                self.partitions.deactivate(pid)
             dev = self.allocatable.get(d.name)
             if isinstance(dev, VfioDevice):
                 # Return the function to the accel driver (vfio-device.go

@@ -77,6 +77,13 @@ class TpuDriver:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        if self.gates.enabled("DynamicSubslice"):
+            # Free ICI partitions no checkpointed claim holds — leftovers of
+            # a crash mid-prepare (DestroyUnknownMIGDevices analog,
+            # reference driver.go:110). Under the pu flock: a rolling
+            # restart's overlapping old process may be mid-prepare.
+            with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                self.state.destroy_unknown_partitions()
         if self.gates.enabled("TPUDeviceHealthCheck") and hasattr(
             self.state.tpulib, "watch_health"
         ):

@@ -27,11 +27,11 @@ def test_parse_rejects_malformed(bad):
 
 
 def test_dependency_validation():
-    # ICIPartitioning requires PassthroughSupport.
-    gates = fg.parse("ICIPartitioning=true")
-    with pytest.raises(fg.FeatureGateError, match="requires PassthroughSupport"):
+    # DynamicSubslice requires ICIPartitioning.
+    gates = fg.parse("DynamicSubslice=true")
+    with pytest.raises(fg.FeatureGateError, match="requires ICIPartitioning"):
         gates.validate()
-    fg.parse("ICIPartitioning=true,PassthroughSupport=true").validate()
+    fg.parse("DynamicSubslice=true,ICIPartitioning=true").validate()
 
     # HostManagedSliceAgent requires ComputeDomainCliques (default-on, so
     # disabling the dependency breaks it).

@@ -28,19 +28,19 @@ from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
 
 def test_flag_bundles_env_mirrors(monkeypatch):
     monkeypatch.setenv("NODE_NAME", "from-env")
-    monkeypatch.setenv("FEATURE_GATES", "DynamicSubslice=true")
+    monkeypatch.setenv("FEATURE_GATES", "TimeSlicingSettings=true")
     parser = flagpkg.build_parser("t", "", [flagpkg.PluginFlags(), flagpkg.FeatureGateFlags()])
     args = parser.parse_args([])
     assert args.node_name == "from-env"
     gates = flagpkg.FeatureGateFlags.resolve(args)
-    assert gates.enabled("DynamicSubslice")
+    assert gates.enabled("TimeSlicingSettings")
     # Flag overrides env.
     args = parser.parse_args(["--node-name", "from-flag"])
     assert args.node_name == "from-flag"
 
 
 def test_feature_gate_flag_validation(monkeypatch):
-    monkeypatch.setenv("FEATURE_GATES", "ICIPartitioning=true")  # missing dep
+    monkeypatch.setenv("FEATURE_GATES", "DynamicSubslice=true")  # missing dep
     parser = flagpkg.build_parser("t", "", [flagpkg.FeatureGateFlags()])
     with pytest.raises(fg.FeatureGateError):
         flagpkg.FeatureGateFlags.resolve(parser.parse_args([]))
