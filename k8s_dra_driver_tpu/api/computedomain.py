@@ -26,6 +26,10 @@ COMPUTE_DOMAIN_NODE_LABEL = "resource.tpu.google.com/computeDomain"
 
 CD_STATUS_READY = "Ready"
 CD_STATUS_NOT_READY = "NotReady"
+# Spec failed domain-bounds validation (the reference rejects domains over
+# the 18-node IMEX limit, cmd/compute-domain-controller/main.go:55-60); no
+# owned objects are rendered for a Rejected domain.
+CD_STATUS_REJECTED = "Rejected"
 
 # Default cap on hosts per domain, the 18-node IMEX-domain analog
 # (/root/reference/cmd/compute-domain-controller/main.go:55-60). A v5e pod

@@ -23,20 +23,33 @@ def main(argv=None) -> int:
         "compute-domain-controller",
         "cluster-scoped ComputeDomain reconciler",
         [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(),
-         flagpkg.LeaderElectionFlags(), flagpkg.KubeClientFlags()],
+         flagpkg.LeaderElectionFlags(), flagpkg.KubeClientFlags(),
+         flagpkg.SliceConfigFlags()],
     )
     add_api_backend_flag(parser)
     parser.add_argument("--driver-namespace", default="tpu-dra-driver")
     parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument(
+        "--max-nodes-per-domain", type=int,
+        default=flagpkg._env_default("MAX_NODES_PER_DOMAIN", 0, int),
+        help="reject domains over this many nodes; 0 = topology-derived "
+        "default (reference caps IMEX domains at 18, main.go:55-60) "
+        "[MAX_NODES_PER_DOMAIN]",
+    )
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
         print(version_string("compute-domain-controller"))
         return 0
+    if args.max_nodes_per_domain < 0:
+        parser.error("--max-nodes-per-domain must be >= 0 (0 = default)")
     flagpkg.LoggingFlags.configure(args)
     flagpkg.log_startup_config(args, log)
-    flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    slice_config = flagpkg.SliceConfigFlags.resolve(args, gates, exit_on_error=True)
     start_debug_signal_handlers()
+
+    from k8s_dra_driver_tpu.controller.controller import DEFAULT_MAX_NODES_PER_DOMAIN
 
     api = resolve_api(args)
     registry = Registry()
@@ -44,6 +57,8 @@ def main(argv=None) -> int:
         api, driver_namespace=args.driver_namespace,
         identity=f"{socket.gethostname()}-controller",
         leader_elect=args.leader_elect, metrics_registry=registry,
+        max_nodes_per_domain=args.max_nodes_per_domain or DEFAULT_MAX_NODES_PER_DOMAIN,
+        slice_config=slice_config,
     )
     controller.start()
     log.info("%s running (leader_elect=%s)",

@@ -47,7 +47,8 @@ def _find_slice_ctl() -> str:
 def main(argv=None) -> int:
     parser = flagpkg.build_parser(
         "compute-domain-daemon", "per-domain slice agent",
-        [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(), flagpkg.KubeClientFlags()],
+        [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(),
+         flagpkg.KubeClientFlags(), flagpkg.SliceConfigFlags()],
     )
     add_api_backend_flag(parser)
     parser.add_argument("command", nargs="?", default="run", choices=("run", "check"))
@@ -92,6 +93,7 @@ def main(argv=None) -> int:
         return 0 if ready else 1
 
     gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    slice_config = flagpkg.SliceConfigFlags.resolve(args, gates, exit_on_error=True)
     start_debug_signal_handlers()
     domain_uid = os.environ.get("COMPUTE_DOMAIN_UUID", "")
     if not domain_uid:
@@ -112,6 +114,7 @@ def main(argv=None) -> int:
         gates=gates,
         pod_name=os.environ.get("POD_NAME", ""),
         pod_namespace=os.environ.get("POD_NAMESPACE", ""),
+        isolation=slice_config.isolation.value,
     )
     agent.startup()
     log.info("%s registered: index=%d ici=%s",

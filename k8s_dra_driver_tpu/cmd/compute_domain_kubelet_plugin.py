@@ -35,7 +35,7 @@ def main(argv=None) -> int:
         "compute-domain-kubelet-plugin",
         "DRA kubelet plugin for compute-domain.tpu.google.com",
         [flagpkg.LoggingFlags(), flagpkg.FeatureGateFlags(), flagpkg.PluginFlags(),
-         flagpkg.KubeClientFlags()],
+         flagpkg.KubeClientFlags(), flagpkg.SliceConfigFlags()],
     )
     add_api_backend_flag(parser)
     add_kubelet_grpc_flags(parser)
@@ -68,6 +68,7 @@ def main(argv=None) -> int:
     flagpkg.LoggingFlags.configure(args)
     flagpkg.log_startup_config(args, log)
     gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
+    slice_config = flagpkg.SliceConfigFlags.resolve(args, gates, exit_on_error=True)
     start_debug_signal_handlers()
 
     api = resolve_api(args)
@@ -77,6 +78,7 @@ def main(argv=None) -> int:
         tpulib=new_tpulib(), plugin_dir=args.plugin_dir,
         cdi_root=args.cdi_root, gates=gates, metrics_registry=registry,
         max_channel_count=args.max_slice_channel_count,
+        slice_config=slice_config,
     )
     driver.start()
     dra_srv = DRAPluginServer(

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from k8s_dra_driver_tpu.api.computedomain import (
     CD_STATUS_NOT_READY,
     CD_STATUS_READY,
+    CD_STATUS_REJECTED,
     COMPUTE_DOMAIN_FINALIZER,
     COMPUTE_DOMAIN_NODE_LABEL,
     ComputeDomain,
@@ -37,9 +38,16 @@ from k8s_dra_driver_tpu.k8s.core import (
 )
 from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
 from k8s_dra_driver_tpu.pkg.metrics import ComputeDomainStatusMetric, Registry
+from k8s_dra_driver_tpu.pkg.sliceconfig import SliceAgentConfig
 from k8s_dra_driver_tpu.pkg.workqueue import WorkQueue, default_controller_rate_limiter
+from k8s_dra_driver_tpu.tpulib.types import topology_chips
 
 log = logging.getLogger(__name__)
+
+# Largest supported domain: a v5e-256 pod is 64 hosts of 4 chips — the
+# topology-derived analog of the reference's 18-node IMEX cap
+# (cmd/compute-domain-controller/main.go:55-60).
+DEFAULT_MAX_NODES_PER_DOMAIN = 64
 
 
 class Controller:
@@ -51,10 +59,14 @@ class Controller:
         leader_elect: bool = False,
         metrics_registry: Optional[Registry] = None,
         cleanup_interval_s: float = 600.0,
+        max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN,
+        slice_config: Optional[SliceAgentConfig] = None,
     ):
         self.api = api
         self.driver_namespace = driver_namespace
         self.identity = identity
+        self.max_nodes_per_domain = max_nodes_per_domain
+        self.slice_config = slice_config or SliceAgentConfig()
         self.metric = ComputeDomainStatusMetric(metrics_registry or Registry())
         self._queue = WorkQueue(
             self._reconcile_key, default_controller_rate_limiter(), name="cd-controller"
@@ -151,9 +163,57 @@ class Controller:
         if cd.deleting:
             self._teardown(cd)
             return
+        # Finalizer first — even a Rejected domain must flow through
+        # _teardown on delete (metric forget, label sweep).
         self._ensure_finalizer(cd)
+        reason = self._validate_bounds(cd)
+        if reason:
+            self._set_rejected(cd, reason)
+            return
         self._ensure_owned_objects(cd)
         self._update_status(cd)
+
+    # -- domain bounds ---------------------------------------------------------
+
+    def _validate_bounds(self, cd: ComputeDomain) -> str:
+        """Reject domains over the node cap — flag-set, and tightened by the
+        requested topology when given (a domain cannot span more hosts than
+        its slice has chips). Reference caps IMEX domains at 18 nodes
+        (main.go:55-60); TPU slices are bounded by the pod topology."""
+        limit = self.max_nodes_per_domain
+        reason = f"exceeds --max-nodes-per-domain {limit}"
+        if cd.spec.topology:
+            try:
+                chips = topology_chips(cd.spec.topology)
+            except ValueError:
+                return f"malformed spec.topology {cd.spec.topology!r}"
+            if chips < limit:
+                limit, reason = chips, (
+                    f"exceeds the {chips}-chip bound of topology "
+                    f"{cd.spec.topology} (>=1 chip per host)"
+                )
+        if cd.spec.num_nodes > limit:
+            return f"spec.numNodes {cd.spec.num_nodes} {reason}"
+        return ""
+
+    def _set_rejected(self, cd: ComputeDomain, reason: str) -> None:
+        log.warning("ComputeDomain %s rejected: %s", cd.key, reason)
+        # A domain can turn Rejected after being reconciled (spec mutated
+        # over the limit): the contract is that no owned objects exist for
+        # a Rejected domain, so tear them down.
+        self._delete_owned_objects(cd)
+        self._remove_node_labels(cd.uid)
+
+        def mutate(obj):
+            obj.status = ComputeDomainStatus(status=CD_STATUS_REJECTED, nodes=[])
+
+        fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
+        if fresh is not None and fresh.status.status != CD_STATUS_REJECTED:
+            try:
+                self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
+            except NotFoundError:
+                return
+        self.metric.set(cd.namespace, cd.name, CD_STATUS_REJECTED)
 
     def _ensure_finalizer(self, cd: ComputeDomain) -> None:
         if COMPUTE_DOMAIN_FINALIZER in cd.meta.finalizers:
@@ -167,8 +227,13 @@ class Controller:
         cd = self.api.get(COMPUTE_DOMAIN, cd.name, cd.namespace)  # fresh uid/rv
         rct_daemon = daemon_resource_claim_template(cd, self.driver_namespace)
         rct_workload = workload_resource_claim_template(cd)
-        ds = daemon_set_for_domain(cd, self.driver_namespace)
-        for obj in (rct_daemon, rct_workload, ds):
+        owned = [rct_daemon, rct_workload]
+        if not self.slice_config.host_managed:
+            # Host-managed agents (pkg/sliceconfig Mode.HOST_MANAGED): the
+            # node image runs the slice agent, so no DaemonSet is deployed —
+            # the reference's HostManagedIMEXDaemon behavior.
+            owned.append(daemon_set_for_domain(cd, self.driver_namespace))
+        for obj in owned:
             existing = self.api.try_get(obj.kind, obj.meta.name, obj.meta.namespace)
             if existing is None:
                 self.api.create(obj)
@@ -235,7 +300,7 @@ class Controller:
 
     # -- deletion --------------------------------------------------------------
 
-    def _teardown(self, cd: ComputeDomain) -> None:
+    def _delete_owned_objects(self, cd: ComputeDomain) -> None:
         for kind, name, ns in (
             (DAEMON_SET, f"{cd.name}-slice-agent", self.driver_namespace),
             (RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-daemon-claim", self.driver_namespace),
@@ -249,6 +314,9 @@ class Controller:
                     self.api.delete(kind, name, ns)
                 except NotFoundError:
                     pass
+
+    def _teardown(self, cd: ComputeDomain) -> None:
+        self._delete_owned_objects(cd)
         for clique in self.api.list(COMPUTE_DOMAIN_CLIQUE, namespace=cd.namespace):
             if clique.domain_uid == cd.uid:
                 try:

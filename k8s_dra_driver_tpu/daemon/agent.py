@@ -53,6 +53,7 @@ class SliceAgent:
         child_argv: Optional[List[str]] = None,
         pod_name: str = "",
         pod_namespace: str = "",
+        isolation: str = "domain",
     ):
         if not domain_uid:
             raise ValueError("domain_uid (COMPUTE_DOMAIN_UUID) is required")
@@ -62,6 +63,9 @@ class SliceAgent:
         self.node_name = node_name
         self.pod_ip = pod_ip
         self.gates = gates or fg.FeatureGates()
+        # pkg/sliceconfig Isolation, recorded in the peer config so the
+        # bootstrap child and probes see the deployment granularity.
+        self.isolation = isolation
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
         self.inventory = tpulib.enumerate()
@@ -217,6 +221,7 @@ class SliceAgent:
         cfg = {
             "ici_domain": self.ici_domain,
             "expected_nodes": self.expected_nodes,
+            "isolation": self.isolation,
             "self_index": self.index,
             "peers": [
                 {

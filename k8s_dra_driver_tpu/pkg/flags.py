@@ -116,6 +116,43 @@ class FeatureGateFlags(FlagBundle):
 
 
 @dataclass
+class SliceConfigFlags(FlagBundle):
+    """--slice-agent-mode / --slice-agent-isolation (pkg/sliceconfig — the
+    reference's pkg/imex Mode/Isolation flag surface)."""
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("slice agent deployment")
+        g.add_argument("--slice-agent-mode",
+                       choices=("driverManaged", "hostManaged"),
+                       default=_env_default("SLICE_AGENT_MODE", "driverManaged"),
+                       help="who runs slice agents: this driver's DaemonSet "
+                            "or the node image [SLICE_AGENT_MODE]")
+        g.add_argument("--slice-agent-isolation", choices=("domain", "channel"),
+                       default=_env_default("SLICE_AGENT_ISOLATION", "domain"),
+                       help="workload isolation granularity "
+                            "[SLICE_AGENT_ISOLATION]")
+
+    @staticmethod
+    def resolve(args: argparse.Namespace, gates: "fg.FeatureGates",
+                exit_on_error: bool = False):
+        from k8s_dra_driver_tpu.pkg.sliceconfig import (
+            SliceAgentConfig,
+            SliceConfigError,
+        )
+
+        try:
+            cfg = SliceAgentConfig.parse(
+                args.slice_agent_mode, args.slice_agent_isolation
+            )
+            cfg.validate(gates)
+        except SliceConfigError as e:
+            if exit_on_error:
+                raise SystemExit(f"error: slice-agent config: {e}") from None
+            raise
+        return cfg
+
+
+@dataclass
 class LeaderElectionFlags(FlagBundle):
     def add_to(self, parser: argparse.ArgumentParser) -> None:
         g = parser.add_argument_group("leader election")

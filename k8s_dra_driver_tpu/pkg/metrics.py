@@ -251,7 +251,7 @@ class DRARequestMetrics:
             self.request_duration.observe(self.driver, method, value=time.perf_counter() - t0)
 
 
-COMPUTE_DOMAIN_STATES = ("NotReady", "Ready", "Deleting")
+COMPUTE_DOMAIN_STATES = ("NotReady", "Ready", "Rejected", "Deleting")
 
 
 class ComputeDomainStatusMetric:

@@ -51,8 +51,14 @@ class SliceAgentConfig:
             ) from None
         return cls(mode=m, isolation=i)
 
-    def effective_host_managed(self, gates: fg.FeatureGates) -> bool:
-        return self.mode == Mode.HOST_MANAGED and gates.enabled("HostManagedSliceAgent")
+    @property
+    def host_managed(self) -> bool:
+        """The one mode test consumers branch on. Ungated by design:
+        validate(gates) at startup is the single place the gate is checked
+        (reference EffectiveHostManaged folds these together; splitting
+        construction-time validation from runtime branching avoids passing
+        gates through every consumer)."""
+        return self.mode == Mode.HOST_MANAGED
 
     def validate(self, gates: fg.FeatureGates) -> None:
         if self.mode == Mode.HOST_MANAGED and not gates.enabled("HostManagedSliceAgent"):

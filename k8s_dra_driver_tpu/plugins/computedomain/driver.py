@@ -43,6 +43,7 @@ from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
 from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
+from k8s_dra_driver_tpu.pkg.sliceconfig import Isolation, SliceAgentConfig
 from k8s_dra_driver_tpu.plugins.checkpoint import (
     Checkpoint,
     CheckpointStore,
@@ -82,8 +83,12 @@ class ComputeDomainDriver:
         metrics_registry: Optional[Registry] = None,
         driver_name: str = COMPUTE_DOMAIN_DRIVER_NAME,
         max_channel_count: int = DEFAULT_MAX_CHANNEL_COUNT,
+        slice_config: Optional[SliceAgentConfig] = None,
     ):
         self.max_channel_count = max_channel_count
+        # Deployment mode/isolation (pkg/sliceconfig, the pkg/imex analog) —
+        # validated against the gates at binary startup.
+        self.slice_config = slice_config or SliceAgentConfig()
         self.api = api
         self.node_name = node_name
         self.driver_name = driver_name
@@ -329,7 +334,12 @@ class ComputeDomainDriver:
                 f"char device class {devcaps.CHANNEL_CLASS_NAME!r} not registered "
                 "in /proc/devices (kernel facility not up yet?)"
             )
-        if cfg.allocation_mode == "Single":
+        if cfg.allocation_mode == "Single" or (
+            self.slice_config.isolation == Isolation.CHANNEL
+        ):
+            # Channel isolation: workloads only ever see their own channel
+            # device, regardless of the claim's allocation mode (the
+            # pkg/imex Isolation=channel semantics).
             dev = devcaps.channel_device(cfg.channel_id)
             return [dev.to_cdi_node()] if dev else []
         chans = devcaps.enumerate_channels(self.max_channel_count)
@@ -354,7 +364,11 @@ class ComputeDomainDriver:
         # mutation; label before the ready check so the DaemonSet can land.
         domain, clique = self.cd.resolve(cd_uid)
         self.cd.assert_domain_namespace(domain, claim.namespace)
-        self.cd.add_node_label(cd_uid)
+        if not self.slice_config.host_managed:
+            # Host-managed agents ship with the node image: no DaemonSet
+            # follows the workload, so no label is planted (reference
+            # HostManagedIMEXDaemon path).
+            self.cd.add_node_label(cd_uid)
         # Re-read the clique: it may have appeared since resolve().
         clique = self.cd.get_clique(domain)
         self.cd.assert_domain_ready(domain, clique)

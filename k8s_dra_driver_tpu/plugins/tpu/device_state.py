@@ -124,9 +124,12 @@ class DeviceState:
             ledger = os.path.join(plugin_dir, "partitions.json")
             if load_tpupart() is not None:
                 client = NativePartitionClient(host_topology, ledger)
-            elif os.environ.get("ALT_TPU_TOPOLOGY"):
-                # Mock seam (CPU CI): the in-memory stub stands in for the
-                # platform, like the reference's FM stubClient.
+            elif getattr(tpulib, "is_mock", False) or os.environ.get(
+                "ALT_TPU_TOPOLOGY"
+            ):
+                # Mock seam (injected MockTpuLib or the env selector): the
+                # in-memory stub stands in for the platform, like the
+                # reference's FM stubClient.
                 client = StubPartitionClient()
             elif not self.gates.enabled("CrashOnICIFabricErrors"):
                 log.error(
@@ -294,11 +297,16 @@ class DeviceState:
                         self._apply_config(cfg, claim.uid, dev)
                 except Exception:
                     # The in-flight device is not in `prepared` yet; undo its
-                    # own partition/sharing before the outer rollback runs.
+                    # own partition/sharing/vfio before the outer rollback.
                     pid = extra.get("partition")
                     if pid and self.partitions is not None:
                         self.partitions.deactivate(pid)
                     self.sharing.clear(claim.uid, tuple(dev.chip_indices))
+                    if isinstance(dev, VfioDevice):
+                        try:
+                            self.vfio.unbind_from_vfio(dev.chip.pci_address)
+                        except Exception:  # noqa: BLE001 — best effort
+                            log.exception("vfio unbind rollback failed")
                     raise
                 prepared.append(
                     PreparedDevice(

@@ -49,6 +49,8 @@ def _host_block_origin(profile: SliceProfile, worker_id: int) -> Tuple[int, ...]
 class MockTpuLib:
     """A fake host within a fake slice."""
 
+    is_mock = True  # backends consult this to pick their test doubles
+
     def __init__(
         self,
         profile: str | SliceProfile,

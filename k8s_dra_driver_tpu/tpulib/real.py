@@ -133,6 +133,8 @@ class RealTpuLib:
     """Enumerates the actual host. Slice identity comes from the TPU VM env;
     a host with no slice env is treated as a single-host slice."""
 
+    is_mock = False
+
     def __init__(
         self,
         lib_path: Optional[str] = None,

@@ -534,3 +534,172 @@ def test_reregister_preserves_dns_name():
     # Restarted agent registers ip-first (no dns yet): must not blank it.
     mgr.register("n0", "10.0.0.1")
     assert mgr.members()[0].dns_name == "0.slice.internal"
+
+
+# -- domain bounds + slice-agent deployment config ----------------------------
+
+
+def test_controller_rejects_over_limit_domain():
+    """numNodes over the cap -> status Rejected, no owned objects rendered
+    (the reference's 18-node IMEX bound, main.go:55-60)."""
+    from k8s_dra_driver_tpu.api.computedomain import CD_STATUS_REJECTED
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600, max_nodes_per_domain=4)
+    ctrl.start()
+    try:
+        cd = ComputeDomain(
+            meta=new_meta("too-big", NS),
+            spec=ComputeDomainSpec(num_nodes=5),
+        )
+        cd = api.create(cd)
+        wait_for(
+            lambda: api.get("ComputeDomain", "too-big", NS).status.status
+            == CD_STATUS_REJECTED,
+            msg="Rejected status",
+        )
+        assert api.try_get(DAEMON_SET, "too-big-slice-agent", "tpu-dra-driver") is None
+        assert api.try_get(RESOURCE_CLAIM_TEMPLATE, "too-big-channel", NS) is None
+        # An in-bounds domain on the same controller still reconciles.
+        ok = make_cd(api, name="fits", num_nodes=2)
+        wait_for(
+            lambda: api.try_get(DAEMON_SET, "fits-slice-agent", "tpu-dra-driver"),
+            msg="in-bounds DS",
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_controller_topology_derived_bound():
+    """spec.topology tightens the cap: a 2x2 slice cannot span 5 hosts."""
+    from k8s_dra_driver_tpu.api.computedomain import CD_STATUS_REJECTED
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600)  # default flag cap 64
+    ctrl.start()
+    try:
+        cd = api.create(ComputeDomain(
+            meta=new_meta("topo-bound", NS),
+            spec=ComputeDomainSpec(num_nodes=5, topology="2x2"),
+        ))
+        wait_for(
+            lambda: api.get("ComputeDomain", "topo-bound", NS).status.status
+            == CD_STATUS_REJECTED,
+            msg="topology-derived rejection",
+        )
+        assert api.try_get(DAEMON_SET, "topo-bound-slice-agent",
+                           "tpu-dra-driver") is None
+    finally:
+        ctrl.stop()
+
+
+def test_host_managed_mode_skips_daemonset_and_label(tmp_path, boot_id):
+    """Mode hostManaged (pkg/sliceconfig consumed end to end): the
+    controller renders no DaemonSet and the plugin plants no node label —
+    the node image owns the agents (HostManagedIMEXDaemon analog)."""
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.sliceconfig import SliceAgentConfig
+
+    gates = fg.parse("HostManagedSliceAgent=true")
+    cfg = SliceAgentConfig.parse("hostManaged", "domain")
+    cfg.validate(gates)
+
+    api = APIServer()
+    api.create(Node(meta=new_meta("hm0")))
+    ctrl = Controller(api, cleanup_interval_s=3600, slice_config=cfg)
+    ctrl.start()
+    driver = ComputeDomainDriver(
+        api=api, node_name="hm0", tpulib=MockTpuLib("v5e-4"),
+        plugin_dir=str(tmp_path / "cd-plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=gates, slice_config=cfg,
+    )
+    driver.start()
+    try:
+        cd = make_cd(api, name="hm-cd")
+        wait_for(
+            lambda: api.try_get(RESOURCE_CLAIM_TEMPLATE, "hm-cd-channel", NS),
+            msg="workload RCT",
+        )
+        assert api.try_get(DAEMON_SET, "hm-cd-slice-agent", "tpu-dra-driver") is None
+
+        claim = channel_claim(cd)
+        res = driver.prepare_resource_claims([claim])[claim.uid]
+        assert isinstance(res, RetryableError)  # no agent yet, still gated
+        node = api.get("Node", "hm0")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node.meta.labels
+    finally:
+        driver.shutdown()
+        ctrl.stop()
+
+
+def test_sliceconfig_flag_bundle_and_validation():
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg import flags as flagpkg
+    from k8s_dra_driver_tpu.pkg.sliceconfig import Isolation, Mode
+
+    parser = flagpkg.build_parser("t", "", [flagpkg.SliceConfigFlags()])
+    args = parser.parse_args(["--slice-agent-isolation", "channel"])
+    cfg = flagpkg.SliceConfigFlags.resolve(args, fg.FeatureGates())
+    assert cfg.mode == Mode.DRIVER_MANAGED and cfg.isolation == Isolation.CHANNEL
+    # hostManaged without its gate is refused at startup.
+    args = parser.parse_args(["--slice-agent-mode", "hostManaged"])
+    with pytest.raises(Exception, match="HostManagedSliceAgent"):
+        flagpkg.SliceConfigFlags.resolve(args, fg.FeatureGates())
+
+
+def test_agent_records_isolation_in_peer_config(tmp_path):
+    import json
+
+    api = APIServer()
+    agent = SliceAgent(
+        api=api, namespace=NS, domain_uid="d1", node_name="n0",
+        pod_ip="10.0.0.1", tpulib=MockTpuLib("v5e-4"),
+        workdir=str(tmp_path / "agent"), isolation="channel",
+    )
+    agent.startup()
+    try:
+        agent.sync()
+        cfg = json.load(open(agent.peer_config_path))
+        assert cfg["isolation"] == "channel"
+    finally:
+        agent.shutdown()
+
+
+def test_rejection_after_reconcile_tears_down_owned_objects():
+    """A domain mutated over the limit after reconciling loses its DS/RCTs
+    (the Rejected contract: no owned objects), and deleting a rejected
+    domain flows through the finalizer so the metric is forgotten."""
+    from k8s_dra_driver_tpu.api.computedomain import CD_STATUS_REJECTED
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600, max_nodes_per_domain=4)
+    ctrl.start()
+    try:
+        cd = make_cd(api, name="mutates", num_nodes=2)
+        wait_for(
+            lambda: api.try_get(DAEMON_SET, "mutates-slice-agent", "tpu-dra-driver"),
+            msg="DS rendered while in bounds",
+        )
+
+        def grow(obj):
+            obj.spec.num_nodes = 100
+        api.update_with_retry("ComputeDomain", "mutates", NS, grow)
+        wait_for(
+            lambda: api.get("ComputeDomain", "mutates", NS).status.status
+            == CD_STATUS_REJECTED,
+            msg="Rejected after mutation",
+        )
+        wait_for(
+            lambda: api.try_get(DAEMON_SET, "mutates-slice-agent",
+                                "tpu-dra-driver") is None,
+            msg="DS torn down on rejection",
+        )
+        assert api.try_get(RESOURCE_CLAIM_TEMPLATE, "mutates-channel", NS) is None
+        # Rejected domains still carry the finalizer -> delete runs _teardown.
+        assert COMPUTE_DOMAIN_FINALIZER in api.get(
+            "ComputeDomain", "mutates", NS).meta.finalizers
+        api.delete("ComputeDomain", "mutates", NS)
+        wait_for(lambda: api.try_get("ComputeDomain", "mutates", NS) is None,
+                 msg="finalized deletion")
+    finally:
+        ctrl.stop()

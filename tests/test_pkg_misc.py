@@ -58,7 +58,7 @@ def test_slice_config_parse_and_validate():
         hm.validate(fg.parse(""))
     gates = fg.parse("HostManagedSliceAgent=true")
     hm.validate(gates)
-    assert hm.effective_host_managed(gates)
+    assert hm.host_managed
     bad = SliceAgentConfig(mode=Mode.HOST_MANAGED, isolation=Isolation.CHANNEL)
     with pytest.raises(SliceConfigError, match="channel isolation"):
         bad.validate(gates)
