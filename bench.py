@@ -8,8 +8,12 @@ vs_baseline compares against the smallest bucket of its designed-for latency
 envelope (50 ms, /root/reference/pkg/metrics/dra_requests.go:29): values
 > 1.0 mean our p50 is that many times below the reference's floor bucket.
 
-Extras: flagship SliceProof train-step throughput on the available device(s)
-(the nvbandwidth-analog proof that a prepared slice actually computes).
+Extras: flagship SliceProof train-step throughput on the available device(s),
+and the BASELINE.md north-star collective metric — jax.psum allreduce bus
+bandwidth (ops/allreduce_bench.py, the nvbandwidth analog) — recorded every
+round so the fabric number is tracked alongside prepare latency. On the
+single tunneled chip this measures the in-chip reduction path; on a real
+slice the same job reports ICI bus bandwidth.
 """
 
 from __future__ import annotations
@@ -87,6 +91,19 @@ def bench_flagship_step(iters: int = 30) -> dict:
     }
 
 
+def bench_psum(size_mib: float = 64.0, iters: int = 20) -> dict:
+    from k8s_dra_driver_tpu.ops.allreduce_bench import psum_bandwidth
+
+    r = psum_bandwidth(size_mib=size_mib, iters=iters)
+    return {
+        "psum_bus_gb_per_s": r["value"],
+        "psum_n_devices": r["n_devices"],
+        "psum_size_mib_per_device": r["size_mib_per_device"],
+        "psum_time_ms": r["time_per_allreduce_ms"],
+        "psum_platform": r["platform"],
+    }
+
+
 def main() -> None:
     import os
     import sys
@@ -97,6 +114,10 @@ def main() -> None:
         result.update(bench_flagship_step())
     except Exception as e:  # noqa: BLE001 — flagship extras are best-effort
         result["flagship_error"] = str(e)[:200]
+    try:
+        result.update(bench_psum())
+    except Exception as e:  # noqa: BLE001 — collective extras are best-effort
+        result["psum_error"] = str(e)[:200]
     print(json.dumps(result))
 
 
