@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# One command from clone to a Running claimed pod on a kind cluster with
+# mock TPUs — the reference's demo/clusters/kind + hack/ci/mock-nvml
+# bring-up (/root/reference/hack/ci/mock-nvml/e2e-test.sh analog).
+#
+#   demo/clusters/kind/create-cluster.sh            # build, install, test
+#   CLUSTER_NAME=x PROFILE=v5e-16 .../create-cluster.sh
+#
+# Requires: docker, kind, kubectl, helm. Kubernetes >= 1.34 (resource.k8s.io
+# v1) or 1.32+ with the v1beta1 feature gates; DRA must be enabled.
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+IMAGE="${IMAGE:-tpu-dra-driver:0.1.0}"
+PROFILE="${PROFILE:-v5e-4}"      # mock topology each "TPU node" reports
+RELEASE="${RELEASE:-tpu-dra}"
+NAMESPACE="${NAMESPACE:-tpu-dra-driver}"
+
+echo "==> building driver image ${IMAGE}"
+docker build -t "${IMAGE}" -f "${REPO}/deployments/container/Dockerfile" "${REPO}"
+
+if ! kind get clusters 2>/dev/null | grep -qx "${CLUSTER_NAME}"; then
+  echo "==> creating kind cluster ${CLUSTER_NAME} (DRA enabled)"
+  kind create cluster --name "${CLUSTER_NAME}" --config \
+    "${REPO}/demo/clusters/kind/kind-config.yaml"
+fi
+
+echo "==> loading image into kind"
+kind load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
+
+echo "==> installing chart with the mock TPU seam (${PROFILE})"
+# Last-colon split so registry-qualified names (localhost:5000/x:tag) work.
+IMAGE_TAG="${IMAGE##*:}"
+IMAGE_REPO="${IMAGE%:*}"
+helm upgrade --install "${RELEASE}" \
+  "${REPO}/deployments/helm/tpu-dra-driver" \
+  --namespace "${NAMESPACE}" --create-namespace \
+  --set image.repository="${IMAGE_REPO}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set kubeletPlugin.altTpuTopology="${PROFILE}" \
+  --set nodeSelector=null \
+  --wait --timeout 5m
+
+echo "==> waiting for published ResourceSlices"
+ok=""
+for _ in $(seq 1 60); do
+  n="$(kubectl get resourceslices -o name 2>/dev/null | wc -l)"
+  if [ "${n}" -ge 1 ]; then ok=1; break; fi
+  sleep 2
+done
+if [ -z "${ok}" ]; then
+  echo "ERROR: driver published no ResourceSlices; plugin logs:"
+  kubectl logs -n "${NAMESPACE}" -l app.kubernetes.io/component=kubelet-plugin \
+    --tail=50 || true
+  exit 1
+fi
+kubectl get resourceslices
+
+echo "==> running the mock quickstart (claimed pod -> Succeeded)"
+kubectl apply -f "${REPO}/demo/clusters/kind/tpu-test-mock.yaml"
+kubectl wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod0 \
+  -n tpu-test-mock --timeout=300s
+kubectl logs pod0 -n tpu-test-mock || true
+echo "OK: claimed pod ran to completion on ${CLUSTER_NAME}"
+echo "    (on real TPU nodes, apply demo/specs/quickstart/tpu-test1.yaml"
+echo "     with a jax-equipped image instead)"

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# One command from clone to a Running claimed pod, no docker/kind needed:
+# boots the simulated cluster (mock TPU hosts + the real driver control
+# loops), applies quickstart tpu-test1 with tpu-kubectl, and waits for the
+# claimed pod to run with its injected TPU devices/env. The hardware-free
+# twin of demo/clusters/kind/create-cluster.sh.
+#
+#   demo/clusters/local/up.sh                 # v5e-4, one host
+#   PROFILE=v5e-16 demo/clusters/local/up.sh  # 4 mock hosts
+#   KEEP=1 .../up.sh                          # leave the cluster running
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+export PYTHONPATH="$REPO"
+PY="${PYTHON:-python}"
+PROFILE="${PROFILE:-v5e-4}"
+
+# Mock slice-channel char class (the reference CI's ALT_PROC_DEVICES seam).
+procdev="$(mktemp)"
+printf 'Character devices:\n511 tpu-slice-channels\n\nBlock devices:\n' > "$procdev"
+export TPU_DRA_ALT_PROC_DEVICES="$procdev"
+
+logf="$(mktemp)"
+$PY -m k8s_dra_driver_tpu.sim --port 0 --profile "$PROFILE" > "$logf" 2>&1 &
+SIM_PID=$!
+cleanup() {
+  if [ -z "${KEEP:-}" ]; then
+    kill "$SIM_PID" 2>/dev/null || true
+    rm -f "$procdev" "$logf"
+  fi
+}
+trap cleanup EXIT
+
+for _ in $(seq 1 100); do
+  grep -q "cluster up at" "$logf" && break
+  kill -0 "$SIM_PID" 2>/dev/null || { echo "cluster died:"; cat "$logf"; exit 1; }
+  sleep 0.1
+done
+SERVER="$(sed -n 's/^cluster up at \([^ ]*\).*/\1/p' "$logf" | head -1)"
+export TPU_KUBECTL_SERVER="$SERVER"
+echo "==> cluster up at $SERVER ($PROFILE)"
+
+KUBECTL="$PY -m k8s_dra_driver_tpu.sim.kubectl"
+$KUBECTL get resourceslices
+echo "==> applying quickstart tpu-test1"
+$KUBECTL apply -f "$REPO/demo/specs/quickstart/tpu-test1.yaml"
+$KUBECTL wait pod pod0 -n tpu-test1 --for=Running --timeout=60
+echo "==> claimed pod:"
+$KUBECTL get pods -n tpu-test1
+$KUBECTL get pod pod0 -n tpu-test1 -o json | $PY -c '
+import json, sys
+pod = json.load(sys.stdin)[0]
+print("injected devices:", pod.get("injected_devices"))
+env = pod.get("injected_env", {})
+print("injected env:", {k: env[k] for k in sorted(env) if k.startswith("TPU_")})
+'
+echo "OK: claimed pod Running"
+if [ -n "${KEEP:-}" ]; then
+  echo "cluster left running at $SERVER (pid $SIM_PID); kill it when done"
+fi

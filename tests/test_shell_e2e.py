@@ -26,3 +26,33 @@ def test_shell_scenario(script):
         f"{os.path.basename(script)} failed:\n{proc.stdout}\n{proc.stderr}"
     )
     assert "PASS" in proc.stdout
+
+
+def test_local_cluster_bringup():
+    """demo/clusters/local/up.sh: one command from clone to a Running
+    claimed pod (the kind bring-up's hardware-free twin)."""
+    env = {**os.environ, "PYTHON": sys.executable, "PYTHONPATH": REPO}
+    env.pop("TPU_DRA_ALT_PROC_DEVICES", None)
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "demo", "clusters", "local", "up.sh")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, f"up.sh failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "OK: claimed pod Running" in proc.stdout
+    assert "/dev/accel0" in proc.stdout
+
+
+def test_kind_scripts_are_wellformed():
+    """No kind/docker here: at least keep the cluster scripts parseable and
+    the kind config valid YAML (the CI seam a real cluster run uses)."""
+    import yaml
+
+    for script in ("create-cluster.sh", "delete-cluster.sh"):
+        path = os.path.join(REPO, "demo", "clusters", "kind", script)
+        proc = subprocess.run(["bash", "-n", path], capture_output=True, text=True)
+        assert proc.returncode == 0, f"{script}: {proc.stderr}"
+        assert os.access(path, os.X_OK), f"{script} not executable"
+    cfg = yaml.safe_load(open(os.path.join(
+        REPO, "demo", "clusters", "kind", "kind-config.yaml")))
+    assert cfg["kind"] == "Cluster"
+    assert cfg["featureGates"]["DynamicResourceAllocation"] is True
