@@ -38,6 +38,9 @@ for _ in $(seq 1 100); do
   sleep 0.1
 done
 SERVER="$(sed -n 's/^cluster up at \([^ ]*\).*/\1/p' "$logf" | head -1)"
+if [ -z "$SERVER" ]; then
+  echo "cluster did not come up in time:"; cat "$logf"; exit 1
+fi
 export TPU_KUBECTL_SERVER="$SERVER"
 echo "==> cluster up at $SERVER ($PROFILE)"
 

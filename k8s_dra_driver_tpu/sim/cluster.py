@@ -50,17 +50,25 @@ from k8s_dra_driver_tpu.k8s.core import (
 )
 from k8s_dra_driver_tpu.k8s.objects import new_meta
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_ABORTED
 from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
 from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
 from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
 from k8s_dra_driver_tpu.sim.allocator import AllocationError, Allocator
-from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.tpulib import ChipHealth, MockTpuLib
 
 log = logging.getLogger(__name__)
 
 DRIVER_NAMESPACE = "tpu-dra-driver"
 DEVICE_CLASS_SUBSLICE = "subslice.tpu.google.com"
 DEVICE_CLASS_VFIO = "vfio.tpu.google.com"
+
+# Node annotation consumed by the chaos pass: "0=unhealthy,2=healthy" flips
+# per-chip mock health so kubectl-driven suites can exercise the
+# taint/republish chain without reaching into the process (the shell-tier
+# stand-in for the reference's fault-injection bats scenarios,
+# /root/reference/tests/bats/test_gpu_robustness.bats).
+CHAOS_CHIP_HEALTH_ANNOTATION = "sim.tpu.google.com/chip-health"
 
 
 @dataclass
@@ -87,6 +95,7 @@ class SimCluster:
         self.allocator = Allocator(self.api)
         self.profile = profile
         self.nodes: Dict[str, SimNode] = {}
+        self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
         self.controller = Controller(
             self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600
         )
@@ -149,6 +158,8 @@ class SimCluster:
     def step(self) -> None:
         """One pass of every emulated control loop."""
         self.controller.drain(timeout=5)
+        self._chaos_pass()
+        self._gc_pass()
         self._daemonset_pass()
         self._scheduler_pass()
         self._agent_pass()
@@ -464,46 +475,99 @@ class SimCluster:
             node.agents[pod.meta.name].shutdown()
             del node.agents[pod.meta.name]
 
+    # -- API-observed garbage collection -------------------------------------------
+
+    def _gc_pass(self) -> None:
+        """React to deletions observed through the API — the path a kubectl
+        delete takes on a real cluster: the garbage collector removes
+        generated claims whose owner pod is gone (ownerRef GC), the
+        resource-claim controller drops consumers of deleted pods, and the
+        kubelet unprepares claims that no longer have any consumer or whose
+        claim object vanished (the plugins' stale-claim cleanup,
+        reference cleanup.go:149-259, runs the same sweep on a timer)."""
+        ds_uids = {d.uid for d in self.api.list(DAEMON_SET)}
+        for pod in self.api.list(POD):
+            owner_ds = [r for r in pod.meta.owner_references if r.kind == DAEMON_SET]
+            if owner_ds and all(r.uid not in ds_uids for r in owner_ds):
+                self._teardown_pod(pod)
+                try:
+                    self.api.delete(POD, pod.meta.name, pod.namespace)
+                except NotFoundError:
+                    pass
+        pod_uids = {p.uid for p in self.api.list(POD)}
+        for claim in self.api.list(RESOURCE_CLAIM):
+            owner_pods = [r for r in claim.meta.owner_references if r.kind == POD]
+            if owner_pods and all(r.uid not in pod_uids for r in owner_pods):
+                try:
+                    self.api.delete(RESOURCE_CLAIM, claim.meta.name, claim.namespace)
+                except NotFoundError:
+                    pass
+                continue
+            if any(r.kind == POD and r.uid not in pod_uids
+                   for r in claim.reserved_for):
+                def drop(obj, pod_uids=pod_uids):
+                    obj.reserved_for = [
+                        r for r in obj.reserved_for
+                        if not (r.kind == POD and r.uid not in pod_uids)
+                    ]
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, claim.meta.name, claim.namespace, drop
+                    )
+                except NotFoundError:
+                    pass
+        live = {c.uid: c for c in self.api.list(RESOURCE_CLAIM)}
+        for node in self.nodes.values():
+            for plugin in (node.tpu_driver, node.cd_driver):
+                prepared = (
+                    plugin.state.prepared_claims() if hasattr(plugin, "state")
+                    else plugin.prepared_claims()
+                )
+                for uid, entry in prepared.items():
+                    if getattr(entry, "state", "") == PREPARE_ABORTED:
+                        continue  # tombstones expire on their own TTL
+                    claim = live.get(uid)
+                    if claim is not None and claim.reserved_for:
+                        continue
+                    plugin.unprepare_resource_claims([uid])
+
+    # -- annotation-driven fault injection ------------------------------------------
+
+    def _chaos_pass(self) -> None:
+        """Apply CHAOS_CHIP_HEALTH_ANNOTATION deltas from Node objects to the
+        mock tpulib, so external (kubectl-level) suites can drive the
+        health -> taint -> republish chain (device_health.go:103-274)."""
+        for node_obj in self.api.list("Node"):
+            sim_node = self.nodes.get(node_obj.meta.name)
+            if sim_node is None:
+                continue
+            value = node_obj.meta.annotations.get(CHAOS_CHIP_HEALTH_ANNOTATION, "")
+            if value == self._chaos_applied.get(node_obj.meta.name, ""):
+                continue
+            self._chaos_applied[node_obj.meta.name] = value
+            for tok in filter(None, (t.strip() for t in value.split(","))):
+                idx, _, state = tok.partition("=")
+                try:
+                    chip = int(idx)
+                    health = ChipHealth(state.strip().lower())
+                except ValueError:
+                    log.warning("chaos: bad chip health token %r on %s",
+                                tok, node_obj.meta.name)
+                    continue
+                sim_node.tpulib.set_health(chip, health)
+
     # -- pod-deletion driven unprepare -------------------------------------------------
 
     def delete_pod(self, name: str, namespace: str = "default") -> None:
-        """Delete a pod kubelet-style: unprepare its claims, then remove the
-        pod and its generated claims."""
+        """Delete a pod kubelet-style: remove the object, then run the same
+        API-observed GC the kubectl path relies on (consumer drop, ownerRef
+        claim GC, unprepare of unconsumed claims)."""
         pod = self.api.try_get(POD, name, namespace)
         if pod is None:
             return
         self._teardown_pod(pod)
-        for ref in pod.resource_claims:
-            cname = ref.resource_claim_name or f"{name}-{ref.name}"
-            claim = self.api.try_get(RESOURCE_CLAIM, cname, namespace)
-            if claim is None:
-                continue
-            # Drop this pod from the consumer list; a shared claim stays
-            # prepared while any other consumer remains.
-            def release(obj, pod=pod):
-                obj.reserved_for = [r for r in obj.reserved_for if r.uid != pod.uid]
-            try:
-                claim = self.api.update_with_retry(
-                    RESOURCE_CLAIM, cname, namespace, release
-                )
-            except NotFoundError:
-                continue
-            if claim.reserved_for:
-                continue
-            node = self.nodes.get(pod.node_name)
-            if node is not None and claim.allocation is not None:
-                for driver_name in {r.driver for r in claim.allocation.devices}:
-                    plugin = (
-                        node.tpu_driver if driver_name == TPU_DRIVER_NAME
-                        else node.cd_driver
-                    )
-                    plugin.unprepare_resource_claims([claim.uid])
-            if not ref.resource_claim_name:
-                try:
-                    self.api.delete(RESOURCE_CLAIM, cname, namespace)
-                except NotFoundError:
-                    pass
         try:
             self.api.delete(POD, name, namespace)
         except NotFoundError:
             pass
+        self._gc_pass()

@@ -168,6 +168,17 @@ def _resolve_kind(token: str) -> str:
     return kind
 
 
+_CLUSTER_SCOPED = {"Node", "DeviceClass", "ResourceSlice"}
+
+
+def _default_namespace(kind: str, namespace: str) -> str:
+    """kubectl semantics: an omitted -n means the 'default' namespace for
+    namespaced kinds, and no namespace at all for cluster-scoped ones."""
+    if namespace:
+        return namespace
+    return "" if kind in _CLUSTER_SCOPED else "default"
+
+
 def _summary_row(obj: K8sObject) -> List[str]:
     extra = ""
     if obj.kind == "Pod":
@@ -207,6 +218,7 @@ def main(argv=None) -> int:
     p_get.add_argument("kind")
     p_get.add_argument("name", nargs="?")
     p_get.add_argument("-n", "--namespace", default=None)
+    p_get.add_argument("-A", "--all-namespaces", action="store_true")
     p_get.add_argument("-o", "--output", choices=("table", "json"), default="table")
 
     p_del = sub.add_parser("delete")
@@ -222,6 +234,13 @@ def main(argv=None) -> int:
                         help="Pod phase / CD status to wait for, or 'deleted'")
     p_wait.add_argument("--timeout", type=float, default=60.0)
 
+    p_ann = sub.add_parser("annotate")
+    p_ann.add_argument("kind")
+    p_ann.add_argument("name")
+    p_ann.add_argument("pairs", nargs="+", metavar="KEY=VALUE",
+                       help="annotations to set (KEY- removes KEY)")
+    p_ann.add_argument("-n", "--namespace", default="")
+
     args = parser.parse_args(argv)
     if not args.server:
         raise SystemExit("error: --server (or TPU_KUBECTL_SERVER) is required")
@@ -235,9 +254,15 @@ def main(argv=None) -> int:
     kind = _resolve_kind(args.kind)
     if args.cmd == "get":
         if args.name:
-            objs = [api.get(kind, args.name, args.namespace or "")]
+            objs = [api.get(kind, args.name, _default_namespace(kind, args.namespace or ""))]
         else:
-            objs = api.list(kind, namespace=args.namespace)
+            # kubectl semantics: a bare list means the default namespace
+            # (cluster-scoped kinds and -A list everything).
+            if getattr(args, "all_namespaces", False) or kind in _CLUSTER_SCOPED:
+                list_ns = args.namespace
+            else:
+                list_ns = args.namespace or "default"
+            objs = api.list(kind, namespace=list_ns)
         if args.output == "json":
             print(json.dumps([to_wire(o) for o in objs], indent=1, sort_keys=True))
         else:
@@ -248,15 +273,28 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "delete":
-        api.delete(kind, args.name, args.namespace)
+        api.delete(kind, args.name, _default_namespace(kind, args.namespace))
         print(f"{args.kind.lower()}/{args.name} deleted")
         return 0
 
+    if args.cmd == "annotate":
+        def mutate(obj, pairs=args.pairs):
+            for pair in pairs:
+                if pair.endswith("-") and "=" not in pair:
+                    obj.meta.annotations.pop(pair[:-1], None)
+                else:
+                    k, _, v = pair.partition("=")
+                    obj.meta.annotations[k] = v
+        api.update_with_retry(kind, args.name, _default_namespace(kind, args.namespace), mutate)
+        print(f"{args.kind.lower()}/{args.name} annotated")
+        return 0
+
     if args.cmd == "wait":
+        wait_ns = _default_namespace(kind, args.namespace)
         deadline = _time.monotonic() + args.timeout
         while _time.monotonic() < deadline:
             try:
-                obj = api.get(kind, args.name, args.namespace)
+                obj = api.get(kind, args.name, wait_ns)
             except NotFoundError:
                 if args.condition == "deleted":
                     print(f"{args.kind.lower()}/{args.name} deleted")

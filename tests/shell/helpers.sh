@@ -29,6 +29,9 @@ start_cluster() {  # usage: start_cluster <profile> [extra sim args...]
     sleep 0.1
   done
   export TPU_KUBECTL_SERVER="$(grep -o 'http://[^ ]*' "$logf" | head -1)"
+  if [ -z "$TPU_KUBECTL_SERVER" ]; then
+    echo "FAIL: sim cluster did not come up in time:"; cat "$logf"; exit 1
+  fi
   echo "# cluster: $TPU_KUBECTL_SERVER ($profile)"
 }
 

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# ComputeDomain failover shell e2e (reference tests/bats/test_cd_failover.bats
+# analog): kill a slice-agent pod out from under a Ready 4-host domain; the
+# DaemonSet recreates it, the domain returns to Ready, and the running
+# workers keep their bootstrap env untouched.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-16
+
+kubectl apply -f "$REPO/demo/specs/computedomain/cd-multi-host.yaml"
+kubectl wait computedomain jax-domain -n cd-multi --for=Ready --timeout=60
+for i in 0 1 2 3; do
+  kubectl wait pod "worker-$i" -n cd-multi --for=Running --timeout=60
+done
+
+env_before="$(kubectl get pods -n cd-multi -o json | $PY -c "
+import json,sys
+pods=json.loads(sys.stdin.read())
+print(json.dumps({p['meta']['name']: p['injected_env'] for p in pods
+                  if p['meta']['name'].startswith('worker-')}, sort_keys=True))")"
+
+# Find and kill one slice-agent pod (the per-domain daemon).
+victim="$(kubectl get pods -n tpu-dra-driver -o json | $PY -c "
+import json,sys
+pods=json.loads(sys.stdin.read())
+agents=[p['meta']['name'] for p in pods if 'slice-agent' in p['meta']['name'] or any(
+    c.get('command', [''])[0] == 'compute-domain-daemon' for c in p.get('containers', []))]
+assert agents, 'no slice-agent pods found'
+print(agents[0])")"
+echo "# killing agent pod $victim"
+kubectl delete pod "$victim" -n tpu-dra-driver
+
+# The DaemonSet recreates the agent; the domain must recover to Ready.
+kubectl wait computedomain jax-domain -n cd-multi --for=Ready --timeout=60
+
+# Workers rode through the failover with identical bootstrap env.
+env_after="$(kubectl get pods -n cd-multi -o json | $PY -c "
+import json,sys
+pods=json.loads(sys.stdin.read())
+print(json.dumps({p['meta']['name']: p['injected_env'] for p in pods
+                  if p['meta']['name'].startswith('worker-')}, sort_keys=True))")"
+[ "$env_before" = "$env_after" ] || {
+  echo "FAIL: worker env changed across agent failover"; exit 1; }
+for i in 0 1 2 3; do
+  kubectl wait pod "worker-$i" -n cd-multi --for=Running --timeout=30
+done
+
+echo "PASS test_cd_failover"

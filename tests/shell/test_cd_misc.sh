@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# ComputeDomain controller behavior shell e2e (reference
+# tests/bats/test_cd_misc.bats analog): controller-generated objects appear
+# (workload RCT + per-domain DaemonSet), status follows the daemon chain,
+# out-of-bounds domains are Rejected, and deletion sweeps everything.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-16
+
+# A domain whose numNodes exceeds the slice topology bound is Rejected
+# (controller bound enforcement; reference caps IMEX domains at 18 nodes,
+# cmd/compute-domain-controller/main.go:55-60).
+bad="$(mktemp --suffix=.yaml)"
+cat > "$bad" <<'EOF'
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: too-big, namespace: default}
+spec:
+  numNodes: 99
+  channel:
+    resourceClaimTemplate: {name: too-big-channel}
+EOF
+kubectl apply -f "$bad"
+kubectl wait computedomain too-big --for=Rejected --timeout=30
+kubectl delete computedomain too-big
+kubectl wait computedomain too-big --for=deleted --timeout=30
+
+# A valid domain: controller creates the workload channel RCT up front;
+# no DaemonSet pods land until a workload prepares (follow-the-workload).
+kubectl apply -f "$REPO/demo/specs/computedomain/cd-multi-host.yaml"
+for _ in $(seq 1 50); do
+  rcts="$(kubectl get resourceclaimtemplates -n cd-multi)"
+  grep -q "jax-domain-channel" <<<"$rcts" && break
+  sleep 0.2
+done
+assert_contains "$rcts" "jax-domain-channel" "controller created the channel RCT"
+
+# Workers land -> nodes labeled -> DaemonSet pods -> Ready.
+kubectl wait computedomain jax-domain -n cd-multi --for=Ready --timeout=60
+ds="$(kubectl get daemonsets -n tpu-dra-driver)"
+assert_contains "$ds" "jax-domain" "per-domain DaemonSet exists"
+agents="$(kubectl get pods -n tpu-dra-driver -o json | $PY -c "
+import json,sys; print(len(json.loads(sys.stdin.read())))")"
+[ "$agents" = "4" ] || { echo "FAIL: want 4 agent pods, got $agents"; exit 1; }
+
+# Deleting the domain sweeps the DaemonSet, its pods, and the cliques.
+kubectl delete computedomain jax-domain -n cd-multi
+kubectl wait computedomain jax-domain -n cd-multi --for=deleted --timeout=60
+for _ in $(seq 1 50); do
+  left="$(kubectl get pods -n tpu-dra-driver -o json | $PY -c "
+import json,sys; print(len(json.loads(sys.stdin.read())))")"
+  [ "$left" = "0" ] && break
+  sleep 0.2
+done
+[ "$left" = "0" ] || { echo "FAIL: agent pods left after delete: $left"; exit 1; }
+cliques="$(kubectl get computedomaincliques -n cd-multi -o json)"
+[ "$cliques" = "[]" ] || { echo "FAIL: cliques left behind: $cliques"; exit 1; }
+
+echo "PASS test_cd_misc"

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# DynamicSubslice shell e2e (reference tests/bats/test_gpu_dynmig.bats
+# analog): with the gate on, a subslice Prepare carves an ICI partition
+# through the partitioner ledger; deleting the pod releases it so a
+# whole-host claim can land afterwards.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4 --gates DynamicSubslice=true,ICIPartitioning=true
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test3.yaml"
+kubectl wait pod pod0 -n tpu-test3 --for=Running --timeout=30
+
+pods_json="$(kubectl get pods -n tpu-test3 -o json)"
+bounds="$($PY -c "
+import json,sys
+p=json.loads(sys.stdin.read())[0]
+print(p['injected_env'].get('TPU_CHIPS_PER_PROCESS_BOUNDS',''))
+" <<<"$pods_json")"
+[ "$bounds" = "1,2,1" ] || { echo "FAIL: dynamic subslice bounds: $bounds"; exit 1; }
+
+# Release the partition; a whole-host claim must then be satisfiable
+# (proves the ledger freed the carved chips on unprepare).
+kubectl delete pod pod0 -n tpu-test3
+kubectl wait pod pod0 -n tpu-test3 --for=deleted --timeout=30
+
+whole="$(mktemp --suffix=.yaml)"
+cat > "$whole" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: tpu-test3}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 4}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: wants-all, namespace: tpu-test3}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
+EOF
+kubectl apply -f "$whole"
+kubectl wait pod wants-all -n tpu-test3 --for=Running --timeout=30
+rm -f "$whole"
+
+echo "PASS test_dynamic_subslice"

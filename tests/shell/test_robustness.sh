@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Health/taint robustness shell e2e (reference tests/bats/test_gpu_robustness.bats
+# analog): an unhealthy chip taints its device and blocks a whole-host claim;
+# healing the chip un-taints and releases the pod — all driven through
+# kubectl (the chip flip rides a Node annotation the sim chaos pass applies).
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4 --gates TPUDeviceHealthCheck=true
+
+# Break chip 0 before the claim exists.
+kubectl annotate node tpu-node-0 "sim.tpu.google.com/chip-health=0=unhealthy"
+
+spec="$(mktemp --suffix=.yaml)"
+cat > "$spec" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: default}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 4}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: wants-all, namespace: default}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
+EOF
+kubectl apply -f "$spec"
+
+# The taint on chip 0 makes a 4-chip claim unsatisfiable on the only host.
+sleep 2
+phase="$(kubectl get pod wants-all -o json | $PY -c "
+import json,sys; print(json.loads(sys.stdin.read())[0]['phase'])")"
+[ "$phase" = "Pending" ] || { echo "FAIL: pod should be Pending while tainted, got $phase"; exit 1; }
+
+# Heal -> republish -> schedulable.
+kubectl annotate node tpu-node-0 "sim.tpu.google.com/chip-health=0=healthy"
+kubectl wait pod wants-all --for=Running --timeout=30
+rm -f "$spec"
+
+echo "PASS test_robustness"

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Sharing shell e2e (reference tests/bats/test_gpu_sharing.bats analog):
+# two pods share one chip through a shared claim with a TimeSlicing config;
+# both must run on the same chip with the time-slice env injected.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4 --gates TimeSlicingSettings=true
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test4.yaml"
+for p in pod0 pod1; do
+  kubectl wait pod "$p" -n tpu-test4 --for=Running --timeout=30
+done
+
+pods_json="$(kubectl get pods -n tpu-test4 -o json)"
+$PY - <<PYEOF
+import json
+pods = json.loads('''$pods_json''')
+assert len(pods) == 2, [p["meta"]["name"] for p in pods]
+for p in pods:
+    ts = p["injected_env"].get("TPU_TIMESLICE_US")
+    assert ts == "2000", f'{p["meta"]["name"]}: TPU_TIMESLICE_US={ts}'
+chips = {p["injected_env"]["TPU_VISIBLE_CHIPS"] for p in pods}
+assert len(chips) == 1, f"sharing pods on different chips: {chips}"
+print("sharing OK: both pods on chip", chips.pop(), "timeslice 2000us")
+PYEOF
+
+echo "PASS test_sharing"

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Claim-churn stress shell e2e (reference tests/bats/test_gpu_stress.bats
+# analog): repeated apply/delete rounds of template-generated claims; every
+# round must schedule (capacity fully recycled) and the last delete must
+# leave no claims behind.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4
+
+spec="$(mktemp --suffix=.yaml)"
+cat > "$spec" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: pair, namespace: default}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 2}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: churn-a, namespace: default}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: pair}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: churn-b, namespace: default}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: pair}]
+EOF
+
+podspec="$(mktemp --suffix=.yaml)"
+# Rounds after the first re-apply only the pods (the RCT persists).
+sed -n '/kind: Pod/,$p' "$spec" | sed '1i apiVersion: v1' > "$podspec"
+
+for round in 1 2 3 4; do
+  if [ "$round" = 1 ]; then kubectl apply -f "$spec"; else kubectl apply -f "$podspec"; fi
+  # Both pods claim 2 of the host's 4 chips: both must fit, every round.
+  kubectl wait pod churn-a --for=Running --timeout=30
+  kubectl wait pod churn-b --for=Running --timeout=30
+  kubectl delete pod churn-a
+  kubectl delete pod churn-b
+  kubectl wait pod churn-a --for=deleted --timeout=30
+  kubectl wait pod churn-b --for=deleted --timeout=30
+  echo "# round $round ok"
+done
+
+# Generated claims must be garbage-collected with their pods.
+sleep 1
+claims="$(kubectl get resourceclaims -o json)"
+[ "$claims" = "[]" ] || { echo "FAIL: claims leaked after churn: $claims"; exit 1; }
+rm -f "$spec" "$podspec"
+
+echo "PASS test_stress"

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Static subslice partitioning shell e2e (reference tests/bats/test_gpu_mig.bats
+# analog): a 1x2 ICI subslice claim coexists with nothing else on its chips —
+# the KEP-4815 counters make a whole-host claim unschedulable until the
+# subslice is released.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test3.yaml"
+kubectl wait pod pod0 -n tpu-test3 --for=Running --timeout=30
+
+pods_json="$(kubectl get pods -n tpu-test3 -o json)"
+bounds="$($PY -c "
+import json,sys
+p=json.loads(sys.stdin.read())[0]
+print(p['injected_env'].get('TPU_CHIPS_PER_PROCESS_BOUNDS',''), len(p['injected_devices']))
+" <<<"$pods_json")"
+[ "$bounds" = "1,2,1 2" ] || { echo "FAIL: subslice bounds/devices: $bounds"; exit 1; }
+
+# Counter exclusion: the 1x2 subslice consumes 2 of the host's 4 chip
+# counters, so a whole-host (count: 4) claim must stay Pending.
+whole="$(mktemp --suffix=.yaml)"
+cat > "$whole" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: tpu-test3}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 4}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: wants-all, namespace: tpu-test3}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
+EOF
+kubectl apply -f "$whole"
+sleep 2
+phase="$(kubectl get pod wants-all -n tpu-test3 -o json | $PY -c "
+import json,sys; print(json.loads(sys.stdin.read())[0]['phase'])")"
+[ "$phase" = "Pending" ] || { echo "FAIL: whole-host pod should be Pending, got $phase"; exit 1; }
+
+# Releasing the subslice frees its chip counters; the whole-host pod lands.
+kubectl delete pod pod0 -n tpu-test3
+kubectl wait pod wants-all -n tpu-test3 --for=Running --timeout=30
+rm -f "$whole"
+
+echo "PASS test_subslice"
