@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Bring up a GKE cluster with real TPU node pools for the driver
+# (reference demo/clusters/gke/create-cluster.sh analog, TPU-native):
+# a single-host v5e pool for the quickstart specs and a multi-host
+# v5e-16 pod-slice pool (4 hosts x 4 chips, --tpu-topology 4x4) for the
+# ComputeDomain demos. DRA APIs are enabled on the control plane.
+#
+#   PROJECT_NAME=my-proj demo/clusters/gke/create-cluster.sh
+#
+# Env overrides: CLUSTER_NAME, REGION, NODE_VERSION, SINGLE_HOST_POOL_SIZE.
+# Requires: gcloud with TPU quota in the chosen region.
+
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [[ -z ${PROJECT_NAME} ]]; then
+  echo "Project name could not be determined; run 'gcloud config set project'"
+  exit 1
+fi
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-cluster}"
+NETWORK_NAME="${NETWORK_NAME:-${CLUSTER_NAME}-net}"
+# v5e pod-slice machine types live in these zones; see
+# https://cloud.google.com/tpu/docs/regions-zones
+REGION="${REGION:-us-west4-a}"
+NODE_VERSION="${NODE_VERSION:-1.34}"
+SINGLE_HOST_POOL_SIZE="${SINGLE_HOST_POOL_SIZE:-1}"
+
+gcloud compute networks create "${NETWORK_NAME}" \
+  --quiet \
+  --project="${PROJECT_NAME}" \
+  --description="Network for the TPU DRA demo cluster" \
+  --subnet-mode=auto \
+  --bgp-routing-mode=regional
+
+# resource.k8s.io is GA (v1) from 1.34; older control planes need the
+# unstable-API enablement for the v1beta1 group the driver also speaks.
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --quiet \
+  --project "${PROJECT_NAME}" \
+  --enable-kubernetes-unstable-apis="resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices" \
+  --release-channel=rapid \
+  --no-enable-autorepair \
+  --enable-autoupgrade \
+  --region "${REGION}" \
+  --num-nodes "1" \
+  --network "${NETWORK_NAME}" \
+  --cluster-version "${NODE_VERSION}" \
+  --node-version "${NODE_VERSION}"
+
+# Single-host v5e pool (ct5lp-hightpu-4t = 4 chips, 2x2): quickstart specs
+# tpu-test1..5. The gke-no-default label keeps GKE's bundled TPU device
+# plugin off these nodes so the DRA driver owns them.
+gcloud container node-pools create "tpu-v5e-single" \
+  --quiet \
+  --project "${PROJECT_NAME}" \
+  --cluster "${CLUSTER_NAME}" \
+  --region "${REGION}" \
+  --node-version "${NODE_VERSION}" \
+  --machine-type "ct5lp-hightpu-4t" \
+  --num-nodes "${SINGLE_HOST_POOL_SIZE}" \
+  --enable-autoupgrade \
+  --no-enable-autorepair \
+  --node-labels=gke-no-default-tpu-device-plugin=true,tpu.google.com/present=true
+
+# Multi-host v5e-16 pod slice (4 hosts x 4 chips, ICI-connected): the
+# ComputeDomain demos. --tpu-topology makes GKE carve an ICI-coherent
+# slice; node count must equal hosts-in-topology (16 chips / 4 per host).
+gcloud container node-pools create "tpu-v5e-16-slice" \
+  --quiet \
+  --project "${PROJECT_NAME}" \
+  --cluster "${CLUSTER_NAME}" \
+  --region "${REGION}" \
+  --node-version "${NODE_VERSION}" \
+  --machine-type "ct5lp-hightpu-4t" \
+  --tpu-topology "4x4" \
+  --num-nodes "4" \
+  --enable-autoupgrade \
+  --no-enable-autorepair \
+  --node-labels=gke-no-default-tpu-device-plugin=true,tpu.google.com/present=true
+
+# NAT so TPU nodes (no external IPs) can pull images.
+gcloud compute routers create "${NETWORK_NAME}-nat-router" \
+  --quiet \
+  --project "${PROJECT_NAME}" \
+  --network "${NETWORK_NAME}" \
+  --region "${REGION%-*}"
+
+gcloud compute routers nats create "${NETWORK_NAME}-nat-config" \
+  --quiet \
+  --project "${PROJECT_NAME}" \
+  --router "${NETWORK_NAME}-nat-router" \
+  --router-region "${REGION%-*}" \
+  --auto-allocate-nat-external-ips \
+  --nat-all-subnet-ip-ranges
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+  --project "${PROJECT_NAME}" --region "${REGION}"
+
+echo "==> cluster ${CLUSTER_NAME} up; install the driver with:"
+echo "    demo/clusters/gke/install-dra-driver-tpu.sh"

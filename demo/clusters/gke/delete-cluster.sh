@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Tear down everything create-cluster.sh made (reference
+# demo/clusters/gke/delete-cluster.sh analog).
+
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [[ -z ${PROJECT_NAME} ]]; then
+  echo "Project name could not be determined; run 'gcloud config set project'"
+  exit 1
+fi
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-cluster}"
+NETWORK_NAME="${NETWORK_NAME:-${CLUSTER_NAME}-net}"
+REGION="${REGION:-us-west4-a}"
+
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --quiet --project "${PROJECT_NAME}" --region "${REGION}" || true
+
+gcloud compute routers nats delete "${NETWORK_NAME}-nat-config" \
+  --quiet --project "${PROJECT_NAME}" \
+  --router "${NETWORK_NAME}-nat-router" --router-region "${REGION%-*}" || true
+
+gcloud compute routers delete "${NETWORK_NAME}-nat-router" \
+  --quiet --project "${PROJECT_NAME}" --region "${REGION%-*}" || true
+
+gcloud compute networks delete "${NETWORK_NAME}" \
+  --quiet --project "${PROJECT_NAME}" || true

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Install the driver chart onto the current kubectl context (reference
+# demo/clusters/gke/install-dra-driver-gpu.sh analog). Real TPU nodes: no
+# mock seam; node selection and tolerations come from values.yaml
+# (cloud.google.com/gke-tpu-accelerator selector, google.com/tpu toleration).
+#
+#   IMAGE_REGISTRY=gcr.io/my-proj IMAGE_TAG=0.1.0 \
+#     demo/clusters/gke/install-dra-driver-tpu.sh
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+
+: "${IMAGE_REGISTRY:=tpu-dra-driver}"   # registry/name prefix
+: "${IMAGE_NAME:=tpu-dra-driver}"
+: "${IMAGE_TAG:=0.1.0}"
+: "${RELEASE:=tpu-dra}"
+: "${NAMESPACE:=tpu-dra-driver}"
+: "${FEATURE_GATES:=}"                  # e.g. "DynamicSubslice=true,ICIPartitioning=true"
+
+repository="${IMAGE_REGISTRY}"
+[[ "${IMAGE_REGISTRY}" != */* ]] || repository="${IMAGE_REGISTRY}/${IMAGE_NAME}"
+
+helm upgrade --install "${RELEASE}" \
+  "${REPO}/deployments/helm/tpu-dra-driver" \
+  --namespace "${NAMESPACE}" --create-namespace \
+  --set image.repository="${repository}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set image.pullPolicy=Always \
+  --set featureGates="${FEATURE_GATES}"
+
+kubectl -n "${NAMESPACE}" rollout status ds -l app.kubernetes.io/instance="${RELEASE}" --timeout=300s || true
+kubectl get deviceclasses
+echo "==> try: kubectl apply -f ${REPO}/demo/specs/quickstart/tpu-test1.yaml"

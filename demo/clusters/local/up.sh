@@ -37,7 +37,8 @@ for _ in $(seq 1 100); do
   kill -0 "$SIM_PID" 2>/dev/null || { echo "cluster died:"; cat "$logf"; exit 1; }
   sleep 0.1
 done
-SERVER="$(sed -n 's/^cluster up at \([^ ]*\).*/\1/p' "$logf" | head -1)"
+# Same extraction the shell-tier harness uses (tests/shell/helpers.sh).
+SERVER="$(grep -o 'http://[^ ]*' "$logf" | head -1)"
 if [ -z "$SERVER" ]; then
   echo "cluster did not come up in time:"; cat "$logf"; exit 1
 fi

@@ -96,6 +96,7 @@ class SimCluster:
         self.profile = profile
         self.nodes: Dict[str, SimNode] = {}
         self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
+        self._gc_prev_claim_uids: set = set()
         self.controller = Controller(
             self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600
         )
@@ -413,8 +414,17 @@ class SimCluster:
                 agent_pods[(pod.node_name, pod.meta.name)] = pod
         for (node_name, pod_name), pod in agent_pods.items():
             node = self.nodes.get(node_name)
-            if node is None or pod_name in node.agents:
+            if node is None:
                 continue
+            existing = node.agents.get(pod_name)
+            if existing is not None:
+                # Same name but a different pod uid means the old pod was
+                # deleted and the DaemonSet recreated it within one step:
+                # the agent container must actually restart.
+                if getattr(existing, "_sim_pod_uid", None) == pod.uid:
+                    continue
+                existing.shutdown()
+                del node.agents[pod_name]
             container = next(
                 (c for c in pod.containers
                  if c.command and c.command[0] == "compute-domain-daemon"),
@@ -443,6 +453,7 @@ class SimCluster:
                 pod_namespace=env.get("POD_NAMESPACE", ""),
             )
             agent.startup()
+            agent._sim_pod_uid = pod.uid  # restart detection on DS recreate
             node.agents[pod_name] = agent
         # Sync all agents; mark their pods ready per probe result.
         for node in self.nodes.values():
@@ -495,6 +506,7 @@ class SimCluster:
                 except NotFoundError:
                     pass
         pod_uids = {p.uid for p in self.api.list(POD)}
+        deleted_now: set = set()
         for claim in self.api.list(RESOURCE_CLAIM):
             owner_pods = [r for r in claim.meta.owner_references if r.kind == POD]
             if owner_pods and all(r.uid not in pod_uids for r in owner_pods):
@@ -502,6 +514,7 @@ class SimCluster:
                     self.api.delete(RESOURCE_CLAIM, claim.meta.name, claim.namespace)
                 except NotFoundError:
                     pass
+                deleted_now.add(claim.uid)
                 continue
             if any(r.kind == POD and r.uid not in pod_uids
                    for r in claim.reserved_for):
@@ -516,7 +529,20 @@ class SimCluster:
                     )
                 except NotFoundError:
                     pass
+        # The unprepare sweep reads every plugin checkpoint from disk, so
+        # only run it when the API state suggests something to clean: a
+        # claim uid vanished since the last pass, or an allocated claim
+        # lost its last consumer. Steady state skips the file reads.
         live = {c.uid: c for c in self.api.list(RESOURCE_CLAIM)}
+        # In-pass deletions (deleted_now) never made it into the previous
+        # snapshot when the claim lived for less than one tick.
+        vanished = (self._gc_prev_claim_uids - live.keys()) | deleted_now
+        self._gc_prev_claim_uids = set(live.keys())
+        unconsumed = any(
+            c.allocation is not None and not c.reserved_for for c in live.values()
+        )
+        if not vanished and not unconsumed:
+            return
         for node in self.nodes.values():
             for plugin in (node.tpu_driver, node.cd_driver):
                 prepared = (
@@ -544,7 +570,6 @@ class SimCluster:
             value = node_obj.meta.annotations.get(CHAOS_CHIP_HEALTH_ANNOTATION, "")
             if value == self._chaos_applied.get(node_obj.meta.name, ""):
                 continue
-            self._chaos_applied[node_obj.meta.name] = value
             for tok in filter(None, (t.strip() for t in value.split(","))):
                 idx, _, state = tok.partition("=")
                 try:
@@ -554,7 +579,14 @@ class SimCluster:
                     log.warning("chaos: bad chip health token %r on %s",
                                 tok, node_obj.meta.name)
                     continue
-                sim_node.tpulib.set_health(chip, health)
+                try:
+                    sim_node.tpulib.set_health(chip, health)
+                except Exception:  # noqa: BLE001 — one bad chip must not drop the rest
+                    log.exception("chaos: set_health(%d) failed on %s",
+                                  chip, node_obj.meta.name)
+            # Mark applied only after the whole annotation was processed so
+            # a mid-loop crash retries the remaining tokens next pass.
+            self._chaos_applied[node_obj.meta.name] = value
 
     # -- pod-deletion driven unprepare -------------------------------------------------
 

@@ -49,3 +49,26 @@ assert_contains() {  # usage: assert_contains <haystack> <needle> <msg>
     echo "FAIL: $3"; echo "  wanted: $2"; echo "  got: $1"; exit 1
   fi
 }
+
+whole_host_spec() {  # usage: whole_host_spec <namespace> — YAML on stdout
+  # A 4-chip (whole v5e-4 host) RCT + pod, shared by the subslice/
+  # robustness scenarios that need an all-or-nothing claim.
+  cat <<EOF
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: $1}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 4}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: wants-all, namespace: $1}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
+EOF
+}

@@ -24,24 +24,7 @@ kubectl delete pod pod0 -n tpu-test3
 kubectl wait pod pod0 -n tpu-test3 --for=deleted --timeout=30
 
 whole="$(mktemp --suffix=.yaml)"
-cat > "$whole" <<'EOF'
-apiVersion: resource.k8s.io/v1
-kind: ResourceClaimTemplate
-metadata: {name: whole-host, namespace: tpu-test3}
-spec:
-  spec:
-    devices:
-      requests:
-      - name: tpus
-        exactly: {deviceClassName: tpu.google.com, count: 4}
----
-apiVersion: v1
-kind: Pod
-metadata: {name: wants-all, namespace: tpu-test3}
-spec:
-  containers: [{name: c, image: python:3.12}]
-  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
-EOF
+whole_host_spec tpu-test3 > "$whole"
 kubectl apply -f "$whole"
 kubectl wait pod wants-all -n tpu-test3 --for=Running --timeout=30
 rm -f "$whole"

@@ -11,24 +11,7 @@ start_cluster v5e-4 --gates TPUDeviceHealthCheck=true
 kubectl annotate node tpu-node-0 "sim.tpu.google.com/chip-health=0=unhealthy"
 
 spec="$(mktemp --suffix=.yaml)"
-cat > "$spec" <<'EOF'
-apiVersion: resource.k8s.io/v1
-kind: ResourceClaimTemplate
-metadata: {name: whole-host, namespace: default}
-spec:
-  spec:
-    devices:
-      requests:
-      - name: tpus
-        exactly: {deviceClassName: tpu.google.com, count: 4}
----
-apiVersion: v1
-kind: Pod
-metadata: {name: wants-all, namespace: default}
-spec:
-  containers: [{name: c, image: python:3.12}]
-  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
-EOF
+whole_host_spec default > "$spec"
 kubectl apply -f "$spec"
 
 # The taint on chip 0 makes a 4-chip claim unsatisfiable on the only host.

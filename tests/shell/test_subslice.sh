@@ -21,24 +21,7 @@ print(p['injected_env'].get('TPU_CHIPS_PER_PROCESS_BOUNDS',''), len(p['injected_
 # Counter exclusion: the 1x2 subslice consumes 2 of the host's 4 chip
 # counters, so a whole-host (count: 4) claim must stay Pending.
 whole="$(mktemp --suffix=.yaml)"
-cat > "$whole" <<'EOF'
-apiVersion: resource.k8s.io/v1
-kind: ResourceClaimTemplate
-metadata: {name: whole-host, namespace: tpu-test3}
-spec:
-  spec:
-    devices:
-      requests:
-      - name: tpus
-        exactly: {deviceClassName: tpu.google.com, count: 4}
----
-apiVersion: v1
-kind: Pod
-metadata: {name: wants-all, namespace: tpu-test3}
-spec:
-  containers: [{name: c, image: python:3.12}]
-  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
-EOF
+whole_host_spec tpu-test3 > "$whole"
 kubectl apply -f "$whole"
 sleep 2
 phase="$(kubectl get pod wants-all -n tpu-test3 -o json | $PY -c "

@@ -43,15 +43,22 @@ def test_local_cluster_bringup():
 
 
 def test_kind_scripts_are_wellformed():
-    """No kind/docker here: at least keep the cluster scripts parseable and
-    the kind config valid YAML (the CI seam a real cluster run uses)."""
+    """No kind/docker/gcloud here: at least keep the cluster scripts
+    parseable and the kind config valid YAML (the CI seam a real cluster
+    run uses)."""
     import yaml
 
-    for script in ("create-cluster.sh", "delete-cluster.sh"):
-        path = os.path.join(REPO, "demo", "clusters", "kind", script)
+    for rel in (
+        ("kind", "create-cluster.sh"),
+        ("kind", "delete-cluster.sh"),
+        ("gke", "create-cluster.sh"),
+        ("gke", "delete-cluster.sh"),
+        ("gke", "install-dra-driver-tpu.sh"),
+    ):
+        path = os.path.join(REPO, "demo", "clusters", *rel)
         proc = subprocess.run(["bash", "-n", path], capture_output=True, text=True)
-        assert proc.returncode == 0, f"{script}: {proc.stderr}"
-        assert os.access(path, os.X_OK), f"{script} not executable"
+        assert proc.returncode == 0, f"{'/'.join(rel)}: {proc.stderr}"
+        assert os.access(path, os.X_OK), f"{'/'.join(rel)} not executable"
     cfg = yaml.safe_load(open(os.path.join(
         REPO, "demo", "clusters", "kind", "kind-config.yaml")))
     assert cfg["kind"] == "Cluster"
