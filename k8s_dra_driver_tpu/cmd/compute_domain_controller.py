@@ -28,7 +28,16 @@ def main(argv=None) -> int:
     )
     add_api_backend_flag(parser)
     parser.add_argument("--driver-namespace", default="tpu-dra-driver")
-    parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--metrics-port", type=int,
+                        default=flagpkg._env_default("METRICS_PORT", 0, int),
+                        help="serve Prometheus metrics here; 0 disables "
+                        "[METRICS_PORT]")
+    parser.add_argument(
+        "--pprof-path", default=flagpkg._env_default("PPROF_PATH", "", str),
+        help="serve thread-stack/runtime-stat debug endpoints under this "
+        "path on the metrics port (reference --pprof-path, "
+        "main.go:423-431); empty disables [PPROF_PATH]",
+    )
     parser.add_argument(
         "--max-nodes-per-domain", type=int,
         default=flagpkg._env_default("MAX_NODES_PER_DOMAIN", 0, int),
@@ -66,7 +75,9 @@ def main(argv=None) -> int:
 
     metrics_srv = None
     if args.metrics_port:
-        metrics_srv = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
+        metrics_srv = MetricsServer(registry, host="0.0.0.0",
+                                    port=args.metrics_port,
+                                    debug_path=args.pprof_path)
         metrics_srv.start()
 
     stop = threading.Event()

@@ -277,20 +277,72 @@ class ComputeDomainStatusMetric:
         self.gauge.forget_matching(namespace=namespace, name=name)
 
 
-class MetricsServer:
-    """Threaded /metrics HTTP server over a Registry."""
+def _debug_stacks_text() -> bytes:
+    """All live thread stacks, the goroutine-dump half of net/http/pprof."""
+    from k8s_dra_driver_tpu.utils.debug import format_stacks
 
-    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
+    return format_stacks().encode()
+
+
+def _debug_vars_json() -> bytes:
+    """Process-level runtime stats (expvar/pprof-index analog)."""
+    import gc
+    import json
+    import os
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    try:
+        n_fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        n_fds = -1
+    return json.dumps({
+        "pid": os.getpid(),
+        "threads": threading.active_count(),
+        "open_fds": n_fds,
+        "max_rss_kib": ru.ru_maxrss,
+        "user_cpu_s": ru.ru_utime,
+        "system_cpu_s": ru.ru_stime,
+        "gc_counts": gc.get_count(),
+    }, indent=1).encode()
+
+
+class MetricsServer:
+    """Threaded /metrics HTTP server over a Registry.
+
+    With ``debug_path`` set (the reference controller's --pprof-path,
+    /root/reference/cmd/compute-domain-controller/main.go:423-431), also
+    serves ``<debug_path>/stacks`` (live thread stacks) and
+    ``<debug_path>/vars`` (process runtime stats)."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0,
+                 debug_path: str = ""):
         registry_ref = registry
+        # Normalize: "debug" and "/debug/" both mean "/debug"; "/" serves
+        # the endpoints at the root. Empty disables.
+        debug_enabled = bool(debug_path.strip())
+        debug = "/" + debug_path.strip().strip("/") if debug_enabled else ""
+        if debug == "/":
+            debug = ""
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 — http.server API
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if debug_enabled and path == f"{debug}/stacks":
+                    self._reply(_debug_stacks_text(), "text/plain")
+                    return
+                if debug_enabled and path == f"{debug}/vars":
+                    self._reply(_debug_vars_json(), "application/json")
+                    return
+                if path not in ("", "/metrics"):
                     self.send_error(404)
                     return
-                body = registry_ref.expose().encode()
+                self._reply(registry_ref.expose().encode(),
+                            "text/plain; version=0.0.4")
+
+            def _reply(self, body: bytes, ctype: str) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

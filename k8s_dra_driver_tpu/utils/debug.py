@@ -19,15 +19,23 @@ from typing import Optional
 log = logging.getLogger(__name__)
 
 
-def _dump_stacks(dump_dir: str) -> str:
-    path = os.path.join(dump_dir, f"stacks-{os.getpid()}-{int(time.time())}.txt")
+def format_stacks() -> str:
+    """Every live thread's stack as text (goroutine-dump analog). Shared by
+    the SIGUSR2 file dump and the metrics server's /stacks endpoint."""
     frames = sys._current_frames()
     names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
+        out.extend(traceback.format_stack(frame))
+        out.append("\n")
+    return "".join(out)
+
+
+def _dump_stacks(dump_dir: str) -> str:
+    path = os.path.join(dump_dir, f"stacks-{os.getpid()}-{int(time.time())}.txt")
     with open(path, "w", encoding="utf-8") as f:
-        for ident, frame in frames.items():
-            f.write(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
-            traceback.print_stack(frame, file=f)
-            f.write("\n")
+        f.write(format_stacks())
     return path
 
 

@@ -1,0 +1,63 @@
+"""Docs consistency: the reference pages generated-by-hand from code
+registries must not drift from those registries."""
+
+import os
+import re
+
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs")
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(DOCS, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def test_feature_gates_doc_lists_every_gate():
+    body = _read(os.path.join("reference", "feature-gates.md"))
+    for spec in fg.FEATURES:
+        row = re.search(rf"^\| `{spec.name}` \| (\w+) \| (\w+) \|", body, re.M)
+        assert row, f"gate {spec.name} missing from feature-gates.md"
+        assert row.group(1) == str(spec.default).lower(), (
+            f"{spec.name}: documented default {row.group(1)!r} != {spec.default}"
+        )
+        assert row.group(2) == spec.stage.value, (
+            f"{spec.name}: documented stage {row.group(2)!r} != {spec.stage.value}"
+        )
+        for dep in spec.requires:
+            assert dep in body, f"{spec.name} dependency {dep} undocumented"
+
+
+def test_metrics_doc_lists_every_metric():
+    from k8s_dra_driver_tpu.pkg.metrics import (
+        ComputeDomainStatusMetric,
+        DRARequestMetrics,
+        Registry,
+    )
+
+    reg = Registry()
+    DRARequestMetrics(driver="tpu.google.com", registry=reg)
+    ComputeDomainStatusMetric(reg)
+    names = set(reg._metrics)
+    body = _read(os.path.join("reference", "metrics.md"))
+    for name in names:
+        assert f"`{name}`" in body, f"metric {name} missing from metrics.md"
+
+
+def test_resourceslice_attributes_doc_matches_code():
+    from k8s_dra_driver_tpu.plugins.tpu.driver import UNHEALTHY_TAINT_KEY
+
+    body = _read(os.path.join("reference", "resourceslice-attributes.md"))
+    for attr in ("tpu.google.com/gen", "tpu.google.com/acceleratorType",
+                 "tpu.google.com/iciDomain", "tpu.google.com/sliceTopology",
+                 "tpu.google.com/hostTopology", "tpu.google.com/workerId"):
+        assert attr in body
+    assert UNHEALTHY_TAINT_KEY in body
+
+
+def test_docs_index_links_resolve():
+    body = _read("README.md")
+    for rel in re.findall(r"\]\(([^)#]+\.md)\)", body):
+        assert os.path.exists(os.path.join(DOCS, rel)), f"dead docs link {rel}"

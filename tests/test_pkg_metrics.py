@@ -74,3 +74,43 @@ def test_metrics_http_server():
         assert 'method="NodePrepareResources"' in body
     finally:
         srv.stop()
+
+
+def test_metrics_server_debug_endpoints():
+    """--pprof-path analog: /debug/stacks shows live thread stacks,
+    /debug/vars shows process stats; disabled by default (404)."""
+    import json
+
+    reg = Registry()
+    srv = MetricsServer(reg, port=0, debug_path="/debug")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        stacks = urllib.request.urlopen(f"{base}/debug/stacks", timeout=5).read().decode()
+        assert "--- thread" in stacks and "MainThread" in stacks
+        stats = json.loads(urllib.request.urlopen(f"{base}/debug/vars", timeout=5).read())
+        assert stats["threads"] >= 1 and stats["pid"] > 0
+    finally:
+        srv.stop()
+
+    # A path without a leading slash is normalized, not silently dead.
+    srv2 = MetricsServer(reg, port=0, debug_path="debug")
+    srv2.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv2.port}/debug/vars", timeout=5).read()
+        assert b"threads" in body
+    finally:
+        srv2.stop()
+
+    plain = MetricsServer(reg, port=0)
+    plain.start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{plain.port}/debug/stacks", timeout=5)
+            raise AssertionError("debug endpoint served without debug_path")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        plain.stop()
