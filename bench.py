@@ -108,14 +108,20 @@ def bench_flagship_step(iters: int = 30) -> dict:
         return time.perf_counter() - t0
 
     # Marginal step time: two loop sizes difference cancels the fixed
-    # dispatch/fetch round-trip (large over the tunneled chip).
+    # dispatch/fetch round-trip (large over the tunneled chip). Best-of-2
+    # per size filters host jitter; if jitter still swamps the subtraction,
+    # fall back to the un-subtracted total and say so rather than publish
+    # a clamped absurdity (same guard as allreduce_bench).
     iters = max(iters, 4)
     n1 = max(1, iters // 4)
-    t1, t2 = run(n1), run(iters)
-    dt = max(t2 - t1, 1e-9) / (iters - n1)
+    t1 = min(run(n1) for _ in range(2))
+    t2 = min(run(iters) for _ in range(2))
+    noise_limited = t2 <= t1
+    dt = t2 / iters if noise_limited else (t2 - t1) / (iters - n1)
     out = {
         "flagship_tokens_per_s": round(batch["tokens"].size / dt, 1),
         "flagship_step_ms": round(dt * 1e3, 2),
+        "flagship_noise_limited": noise_limited,
         "flagship_platform": devices[0].platform,
         "flagship_n_devices": len(devices),
     }
@@ -128,6 +134,42 @@ def bench_flagship_step(iters: int = 30) -> dict:
             100 * flops / dt / (peak * len(devices)), 1
         )
     return out
+
+
+def check_flash_numerics() -> dict:
+    """TPU-only: the attention=flash path (Pallas kernel + qkv relayout)
+    must agree with the einsum path — this is the flash wiring's test
+    surface, since CI meshes are CPU-pinned and the kernel is TPU-only."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_dra_driver_tpu.models.flagship import (
+        SliceProofConfig,
+        forward,
+        init_params,
+    )
+
+    if jax.devices()[0].platform != "tpu":
+        return {}
+    cfg_e = SliceProofConfig(vocab=512, d_model=256, n_heads=4, n_layers=2,
+                             d_ff=512, seq_len=256)
+    cfg_f = dataclasses.replace(cfg_e, attention="flash")
+    params = init_params(cfg_e, seed=0)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg_e.vocab, size=(2, cfg_e.seq_len)),
+        dtype=jnp.int32,
+    )
+    le = np.asarray(jax.jit(lambda p, t: forward(cfg_e, p, t))(params, tokens))
+    lf = np.asarray(jax.jit(lambda p, t: forward(cfg_f, p, t))(params, tokens))
+    err = float(np.max(np.abs(le - lf)))
+    scale = float(np.max(np.abs(le))) or 1.0
+    return {
+        "flash_vs_einsum_max_abs_err": round(err, 5),
+        "flash_numerics_ok": bool(err / scale < 2e-2),  # bf16 path tolerance
+    }
 
 
 def bench_psum(size_mib: float = 64.0, iters: int = 100) -> dict:
@@ -157,6 +199,10 @@ def main() -> None:
         result.update(bench_psum())
     except Exception as e:  # noqa: BLE001 — collective extras are best-effort
         result["psum_error"] = str(e)[:200]
+    try:
+        result.update(check_flash_numerics())
+    except Exception as e:  # noqa: BLE001 — flash check is best-effort
+        result["flash_check_error"] = str(e)[:200]
     print(json.dumps(result))
 
 

@@ -32,6 +32,8 @@ def psum_bandwidth(
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    from k8s_dra_driver_tpu.parallel.mesh import revary as _revary
+
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     mesh = Mesh(np.array(devices), ("d",))
@@ -54,6 +56,7 @@ def psum_bandwidth(
             # time. block_until_ready can return before remote work
             # finishes there, so completion is forced by fetching a value.
             def body(i, y):
+                del i
                 if n == 1:
                     # A 1-device psum folds to identity and the whole loop
                     # constant-folds away (XLA strength-reduces y+c loops
@@ -61,9 +64,9 @@ def psum_bandwidth(
                     # iteration it cannot fold, so the single-chip number
                     # reports in-chip memory bandwidth.
                     return jnp.sqrt(y * y + 1.0)
-                # psum output is device-invariant; pvary restores the
+                # psum output is device-invariant; re-vary restores the
                 # carry's varying-over-d type (no data movement).
-                return jax.lax.pvary(jax.lax.psum(y, "d"), ("d",))
+                return _revary(jax.lax.psum(y, "d"), "d")
 
             return jax.lax.fori_loop(0, k, body, x)
 

@@ -15,6 +15,18 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 
+def revary(x, axis_name):
+    """Mark a device-invariant value as varying over ``axis_name`` (no data
+    movement) — needed for loop carries whose body applies an invariant
+    collective like psum. jax >= 0.9 renamed pvary to pcast(to='varying');
+    support both so a jax upgrade doesn't break the shard bodies."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name if isinstance(axis_name, tuple) else (axis_name,))
+
+
 def build_mesh(devices: Sequence, dp: int, tp: int, *, axis_names: Tuple[str, str] = ("data", "model")):
     """Build a dp×tp Mesh over ``devices`` (len must equal dp*tp).
 

@@ -71,11 +71,13 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
         return o_new, m_new, l_new, k_next, v_next
 
     b, t, h, d = q.shape
-    # pvary: the constant initial carry must be typed as device-varying over
+    # revary: the constant initial carry must be typed as device-varying over
     # the ring axis or the fori_loop carry types mismatch under shard_map.
-    o0 = jax.lax.pvary(jnp.zeros((b, t, h, d), jnp.float32), (axis_name,))
-    m0 = jax.lax.pvary(jnp.full((b, h, t), -jnp.inf, jnp.float32), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((b, h, t), jnp.float32), (axis_name,))
+    from k8s_dra_driver_tpu.parallel.mesh import revary
+
+    o0 = revary(jnp.zeros((b, t, h, d), jnp.float32), axis_name)
+    m0 = revary(jnp.full((b, h, t), -jnp.inf, jnp.float32), axis_name)
+    l0 = revary(jnp.zeros((b, h, t), jnp.float32), axis_name)
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
     l = jnp.maximum(l, 1e-20)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

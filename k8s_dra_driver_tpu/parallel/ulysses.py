@@ -1,0 +1,91 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the two long-context strategies (ring attention is the
+other, ``parallel/ring_attention.py``): instead of rotating KV blocks
+around a ring, one ``all_to_all`` redistributes the sequence-sharded
+[B, T/n, H, D] tensors into head-sharded [B, T, H/n, D], each device runs
+*full* attention for its head subset, and a second ``all_to_all`` restores
+sequence sharding.
+
+Trade-off vs ring attention (both ride ICI):
+- Ulysses moves q, k, v, o once each (4 tensor volumes) in two dense
+  all-to-alls, and each device sees the whole sequence — attention itself
+  is unchanged, so any kernel (flash, blocked) drops in per head.
+- Ring moves k, v around the whole ring (2·(n-1)/n volumes) in n
+  neighbor hops overlapped with compute, and never materializes the full
+  sequence — the O(T/n) memory choice for extreme context lengths.
+- Ulysses parallelism is capped by head count (n must divide H); ring is
+  capped only by sequence length.
+
+No counterpart exists in the reference (resource layer); this is
+workload-side capability for multi-host ComputeDomains. Pattern follows
+the public DeepSpeed-Ulysses formulation; implementation is original.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body under shard_map. q,k,v local: [B, T/n, H, D]."""
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]: split heads over the axis,
+        # concatenate the sequence shards.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    scale = 1.0 / np.sqrt(qg.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * scale
+    if causal:
+        t = qg.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vg.dtype), vg)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Causal self-attention with q/k/v sequence-sharded over ``seq_axis``,
+    computed via head-parallel all-to-all exchange.
+
+    q, k, v: [B, T, H, D] global; T and H divisible by the axis size.
+    Returns [B, T, H, D] with the same sequence sharding. Same signature
+    as ``ring_attention`` so workloads can switch strategies per length.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[seq_axis]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"'{seq_axis}' axis size ({n}); use ring_attention otherwise"
+        )
+    spec = P(None, seq_axis, None, None)
+    body = partial(_ulysses_shard, axis_name=seq_axis, causal=causal)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

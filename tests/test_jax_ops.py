@@ -89,3 +89,41 @@ def test_pallas_matmul_untileable_fallback():
     b = jnp.ones((7, 9), jnp.float32)
     got = tiled_matmul(a, b, bm=8, bn=8, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.full((13, 9), 7.0))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_reference(cpu_devices, causal):
+    from jax.sharding import Mesh
+
+    from k8s_dra_driver_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = Mesh(np.array(cpu_devices[:4]), ("sp",))
+    b, t, h, d = 2, 32, 4, 16
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, d), jnp.float32)
+    want = reference_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_matches_ring_jit_sharded(cpu_devices):
+    """Both sequence-parallel strategies agree under jit on an 8-way mesh;
+    head count not divisible by the axis is rejected with guidance."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from k8s_dra_driver_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = Mesh(np.array(cpu_devices[:8]), ("sp",))
+    b, t, h, d = 1, 128, 8, 8
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, t, h, d), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "sp", None, None)))
+    got_u = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(xs, xs, xs)
+    got_r = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(xs, xs, xs)
+    np.testing.assert_allclose(np.asarray(got_u), np.asarray(got_r),
+                               rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="ring_attention"):
+        bad = jax.random.normal(jax.random.PRNGKey(4), (1, 128, 6, 8), jnp.float32)
+        ulysses_attention(bad, bad, bad, mesh)
