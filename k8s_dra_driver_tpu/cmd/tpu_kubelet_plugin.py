@@ -45,6 +45,13 @@ def main(argv=None) -> int:
         help="serve the DRA Prepare/Unprepare endpoint on this local port "
         "(0 = ephemeral; registration file written to the plugin dir)",
     )
+    parser.add_argument(
+        "--health-events-to-ignore",
+        default=flagpkg._env_default("HEALTH_EVENTS_TO_IGNORE", "", str),
+        help="comma list of chip health states (degraded, unhealthy) that "
+        "never taint devices — the reference's benign-XID skip list "
+        "(--additional-xids-to-ignore) [HEALTH_EVENTS_TO_IGNORE]",
+    )
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -56,6 +63,24 @@ def main(argv=None) -> int:
     gates = flagpkg.FeatureGateFlags.resolve(args, exit_on_error=True)
     start_debug_signal_handlers()
 
+    from k8s_dra_driver_tpu.tpulib import ChipHealth
+
+    try:
+        ignored = frozenset(
+            ChipHealth(tok.strip().lower())
+            for tok in args.health_events_to_ignore.split(",") if tok.strip()
+        )
+    except ValueError:
+        parser.error(
+            f"--health-events-to-ignore: unknown state in "
+            f"{args.health_events_to_ignore!r}; valid: "
+            f"{', '.join(h.value for h in ChipHealth if h != ChipHealth.HEALTHY)}"
+        )
+    if ChipHealth.HEALTHY in ignored:
+        # Ignoring recovery events would leave taints stuck forever.
+        parser.error("--health-events-to-ignore: 'healthy' cannot be "
+                     "ignored (recovery events clear taints)")
+
     api = resolve_api(args)
     node_name = args.node_name or socket.gethostname()
     registry = Registry()
@@ -63,6 +88,7 @@ def main(argv=None) -> int:
         api=api, node_name=node_name, tpulib=new_tpulib(),
         plugin_dir=args.plugin_dir, cdi_root=args.cdi_root,
         gates=gates, metrics_registry=registry,
+        ignored_health_states=ignored,
     )
     driver.start()
     dra_srv = DRAPluginServer(

@@ -50,6 +50,7 @@ class TpuDriver:
         metrics_registry: Optional[Registry] = None,
         cleanup_interval_s: float = CLEANUP_INTERVAL_S,
         driver_name: str = TPU_DRIVER_NAME,
+        ignored_health_states: frozenset = frozenset(),
     ):
         self.api = api
         self.node_name = node_name
@@ -69,6 +70,10 @@ class TpuDriver:
         # racy generation increment otherwise).
         self._publish_mu = threading.Lock()
         self._tainted_chips: Dict[int, ChipHealth] = {}
+        # Health states the operator declared benign — events in this set
+        # never (un)taint (the reference's user-extendable benign-XID skip
+        # list, device_health.go:394-443 / --additional-xids-to-ignore).
+        self._ignored_health_states = frozenset(ignored_health_states)
         self._cleanup_interval = cleanup_interval_s
         self._stop = threading.Event()
         self._cleanup_thread: Optional[threading.Thread] = None
@@ -132,6 +137,10 @@ class TpuDriver:
     # -- health -> taints ----------------------------------------------------
 
     def _on_health_event(self, chip_index: int, health: ChipHealth) -> None:
+        if health in self._ignored_health_states:
+            log.info("chip %d health -> %s (ignored by operator config)",
+                     chip_index, health.value)
+            return
         log.warning("chip %d health -> %s", chip_index, health.value)
         if health == ChipHealth.HEALTHY:
             self._tainted_chips.pop(chip_index, None)

@@ -44,35 +44,43 @@ class _SelfProvisioned:
     """Conformance apiserver + SimCluster loops over the k8s wire."""
 
     def __init__(self, tmp):
+        import select
+
         env = {**os.environ, "PYTHONPATH": REPO}
+        self.sim = None
+        self._thread = None
+        self._stop = threading.Event()
         self.apiserver = subprocess.Popen(
             [sys.executable, "-m", "k8s_dra_driver_tpu.k8s.k8sapiserver",
              "--port", "0"],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
-        line = self.apiserver.stdout.readline()
-        if "serving k8s wire on " not in line:
-            self.apiserver.terminate()
-            raise AssertionError(f"apiserver failed to boot: {line!r}")
-        self.url = line.strip().split()[-1]
-        # Keep draining the (stderr-merged) pipe so handler tracebacks can
-        # never fill it and wedge the server mid-write.
-        threading.Thread(
-            target=lambda: any(False for _ in self.apiserver.stdout),
-            daemon=True,
-        ).start()
+        try:
+            r, _, _ = select.select([self.apiserver.stdout], [], [], 30)
+            line = self.apiserver.stdout.readline() if r else ""
+            if "serving k8s wire on " not in line:
+                raise AssertionError(f"apiserver failed to boot: {line!r}")
+            self.url = line.strip().split()[-1]
+            # Keep draining the (stderr-merged) pipe so handler tracebacks
+            # can never fill it and wedge the server mid-write.
+            threading.Thread(
+                target=lambda: any(False for _ in self.apiserver.stdout),
+                daemon=True,
+            ).start()
 
-        from k8s_dra_driver_tpu.sim import SimCluster
+            from k8s_dra_driver_tpu.sim import SimCluster
 
-        self.sim = SimCluster(
-            workdir=str(tmp), profile="v5e-4",
-            api=KubernetesAPIServer(base_url=self.url),
-        )
-        self.sim.start()
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+            self.sim = SimCluster(
+                workdir=str(tmp), profile="v5e-4",
+                api=KubernetesAPIServer(base_url=self.url),
+            )
+            self.sim.start()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        except BaseException:
+            self.stop()
+            raise
 
     def _loop(self):
         while not self._stop.wait(0.2):
@@ -83,13 +91,16 @@ class _SelfProvisioned:
 
     def stop(self):
         self._stop.set()
-        self._thread.join(timeout=10)
-        self.sim.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.sim is not None:
+            self.sim.stop()
         self.apiserver.terminate()
         try:
             self.apiserver.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self.apiserver.kill()
+            self.apiserver.wait(timeout=10)
 
 
 @pytest.fixture(scope="module")

@@ -356,3 +356,30 @@ def test_cleanup_stale_claims(env):
     api.delete("ResourceClaim", claim.name, claim.namespace)
     assert driver.cleanup_stale_claims() == 1
     assert driver.state.prepared_claims() == {}
+
+
+def test_ignored_health_states_never_taint(tmp_path, boot_id):
+    """Operator-declared benign states (the --health-events-to-ignore /
+    benign-XID skip-list analog, device_health.go:394-443) neither taint
+    nor untaint."""
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    driver = TpuDriver(
+        api=api, node_name=NODE, tpulib=lib,
+        plugin_dir=str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("TPUDeviceHealthCheck=true"),
+        ignored_health_states=frozenset({ChipHealth.DEGRADED}),
+    )
+    driver.start()
+    try:
+        lib.set_health(0, ChipHealth.DEGRADED)
+        assert not any(d.taints for d in api.list(RESOURCE_SLICE)[0].devices)
+        # Non-ignored states still taint; an ignored event must not clear.
+        lib.set_health(0, ChipHealth.UNHEALTHY)
+        assert any(d.taints for d in api.list(RESOURCE_SLICE)[0].devices)
+        lib.set_health(0, ChipHealth.DEGRADED)
+        assert any(d.taints for d in api.list(RESOURCE_SLICE)[0].devices)
+        lib.set_health(0, ChipHealth.HEALTHY)
+        assert not any(d.taints for d in api.list(RESOURCE_SLICE)[0].devices)
+    finally:
+        driver.shutdown()
