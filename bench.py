@@ -93,7 +93,7 @@ def bench_flagship_step(iters: int = 30) -> dict:
     # MXU-sized model on real hardware; tiny on CPU so mock runs stay fast.
     cfg = SliceProofConfig.bench() if on_tpu else SliceProofConfig.tiny()
     step, state, batch = make_sharded_train_step(
-        cfg, devices, batch_per_replica=8 if on_tpu else 2
+        cfg, devices, batch_per_replica=4 if on_tpu else 2
     )
     state, loss = step(state, batch)
     float(loss)  # compile + full sync (block_until_ready lies over the
