@@ -56,9 +56,10 @@ def _moe_shard(params, x, *, axis_name: str, capacity: int):
     logits = x @ params["router"]
     slot, keep, gate = _dispatch_indices(logits, capacity)
 
-    # Pack tokens into the [E*C, d] dispatch buffer (dropped tokens write
-    # zeros via the keep mask; duplicate slots cannot happen by
-    # construction).
+    # Pack tokens into the [E*C, d] dispatch buffer. Dropped tokens'
+    # clipped slots ALIAS kept tokens' slots — correctness depends on the
+    # keep mask zeroing their contribution here (add of zeros) and zeroing
+    # their gather on the way back; neither mask is optional.
     buf = jnp.zeros((n * capacity, d), x.dtype)
     buf = buf.at[slot].add(x * keep[:, None].astype(x.dtype))
 

@@ -102,7 +102,14 @@ class SimCluster:
         )
         self._install_device_classes()
         lib_probe = MockTpuLib(profile, worker_id=0)
-        n = num_hosts if num_hosts is not None else lib_probe.profile.num_hosts
+        self._profile_hosts = lib_probe.profile.num_hosts
+        n = num_hosts if num_hosts is not None else self._profile_hosts
+        if n % self._profile_hosts:
+            raise ValueError(
+                f"num_hosts={n} must be a multiple of profile {profile!r}'s "
+                f"host count ({self._profile_hosts}): partial slices would "
+                f"advertise hosts that don't exist"
+            )
         for w in range(n):
             self._add_node(f"tpu-node-{w}", w)
 
@@ -125,7 +132,16 @@ class SimCluster:
 
     def _add_node(self, name: str, worker_id: int) -> None:
         self.api.create(Node(meta=new_meta(name)))
-        lib = MockTpuLib(self.profile, worker_id=worker_id)
+        # --num-hosts beyond the profile's host count models additional
+        # independent slices (a GKE node pool of several pod slices): node
+        # w is host w % H of slice w // H, each slice with its own ICI
+        # domain uid.
+        slice_idx, host_idx = divmod(worker_id, self._profile_hosts)
+        lib = MockTpuLib(
+            self.profile, worker_id=host_idx,
+            slice_uid=(None if slice_idx == 0
+                       else f"mock-slice-{self.profile}.{slice_idx}"),
+        )
         base = os.path.join(self.workdir, name)
         tpu = TpuDriver(
             api=self.api, node_name=name, tpulib=lib,

@@ -306,3 +306,77 @@ spec:
             assert leftover == [], f"leaked CDI specs: {leftover}"
     finally:
         sim.stop()
+
+
+def test_scale_16_hosts_claim_churn(tmp_path):
+    """Scale pass (test_gpu_stress.bats at cluster size): 16 single-host
+    slices / 64 chips; 48 single-chip pods all run; full churn then 16
+    whole-host pods all run (capacity fully recycled); teardown leaves
+    nothing."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=16)
+    sim.start()
+    try:
+        manifests = ["""
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: one, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: host, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""]
+        manifests += [f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: small-{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: one}}]
+""" for i in range(48)]
+        for m in manifests:
+            for obj in load_manifests(m):
+                sim.api.create(obj)
+        sim.settle(max_steps=40)
+        pods = sim.api.list(POD)
+        assert len(pods) == 48
+        assert all(p.phase == "Running" for p in pods), [
+            (p.meta.name, p.phase) for p in pods if p.phase != "Running"]
+
+        for p in pods:
+            sim.delete_pod(p.meta.name, "default")
+        sim.settle(max_steps=10)
+        assert sim.api.list(RESOURCE_CLAIM, namespace="default") == []
+
+        for i in range(16):
+            for obj in load_manifests(f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: big-{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: host}}]
+"""):
+                sim.api.create(obj)
+        sim.settle(max_steps=40)
+        pods = sim.api.list(POD)
+        assert len(pods) == 16
+        assert all(p.phase == "Running" for p in pods), [
+            (p.meta.name, p.phase) for p in pods if p.phase != "Running"]
+        assert len({p.node_name for p in pods}) == 16  # one per host
+
+        for p in pods:
+            sim.delete_pod(p.meta.name, "default")
+        sim.settle(max_steps=10)
+        assert sim.api.list(RESOURCE_CLAIM, namespace="default") == []
+        for node in sim.nodes.values():
+            assert node.tpu_driver.state.prepared_claims() == {}
+    finally:
+        sim.stop()
