@@ -292,6 +292,15 @@ class SimCluster:
             if len(allocated_nodes) > 1:
                 self._fail_pod(pod, f"claims allocated on different nodes: {allocated_nodes}")
                 continue
+            if pod.node_name and allocated_nodes and pod.node_name not in allocated_nodes:
+                # A nodeName-pinned pod whose shared claim is already
+                # allocated elsewhere can never be prepared there.
+                self._fail_pod(
+                    pod,
+                    f"pod pinned to {pod.node_name} but claim allocated on "
+                    f"{next(iter(allocated_nodes))}",
+                )
+                continue
             if pod.node_name:
                 candidates = [pod.node_name]
             elif allocated_nodes:

@@ -64,7 +64,8 @@ def _pod(doc: Dict[str, Any]) -> Pod:
         )
         for rc in spec.get("resourceClaims", [])
     ]
-    return Pod(meta=_meta(doc), containers=containers, resource_claims=claims)
+    return Pod(meta=_meta(doc), containers=containers, resource_claims=claims,
+               node_name=spec.get("nodeName", ""))
 
 
 def _claim(doc: Dict[str, Any]) -> ResourceClaim:

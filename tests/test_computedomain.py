@@ -6,6 +6,7 @@ device_state_test.go PrepareAborted behavior, controller status calculus
 (computedomain_test.go:28-60).
 """
 
+import os
 import threading
 import time
 
@@ -29,8 +30,10 @@ from k8s_dra_driver_tpu.daemon import CliqueManager, SliceAgent
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.k8s.core import (
     AllocationResult,
+    COMPUTE_DOMAIN,
     COMPUTE_DOMAIN_CLIQUE,
     DAEMON_SET,
+    POD,
     DeviceClaimConfig,
     DeviceRequestAllocationResult,
     Node,
@@ -703,3 +706,53 @@ def test_rejection_after_reconcile_tears_down_owned_objects():
                  msg="finalized deletion")
     finally:
         ctrl.stop()
+
+
+def test_cd_assembles_on_second_slice(tmp_path):
+    """Two independent v5e-16 slices in one cluster (multi-slice node
+    pool): a domain whose workers are pinned onto the SECOND slice
+    assembles there — clique identity keys on that slice's ICI domain uid,
+    unconfused by the first slice's idle hosts."""
+    import yaml
+
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    spec_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "demo", "specs", "computedomain", "cd-multi-host.yaml")
+    with open(spec_path, encoding="utf-8") as f:
+        docs = list(yaml.safe_load_all(f))
+    for doc in docs:
+        if doc and doc.get("kind") == "Pod":
+            # Pin worker-i onto slice 1 (nodes 4..7).
+            idx = int(doc["metadata"]["name"].rsplit("-", 1)[1])
+            doc["spec"]["nodeName"] = f"tpu-node-{4 + idx}"
+    manifest = yaml.safe_dump_all(docs)
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=8)
+    sim.start()
+    try:
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle()
+        workers = [p for p in sim.api.list(POD, namespace="cd-multi")
+                   if p.meta.name.startswith("worker-")]
+        assert len(workers) == 4
+        assert {p.node_name for p in workers} == {f"tpu-node-{i}" for i in (4, 5, 6, 7)}
+        assert all(p.phase == "Running" for p in workers), [
+            (p.meta.name, p.phase, p.meta.annotations.get("failure"))
+            for p in workers]
+        ids = sorted(int(p.injected_env["TPU_WORKER_ID"]) for p in workers)
+        assert ids == [0, 1, 2, 3]
+        # Status writes may trail pod settling by a pass — poll, per the
+        # wait_for contract.
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+            .status.status == "Ready"
+        )
+        # The domain's agents run only on the second slice's nodes.
+        agent_nodes = {n.name for n in sim.nodes.values() if n.agents}
+        assert agent_nodes == {f"tpu-node-{i}" for i in (4, 5, 6, 7)}
+    finally:
+        sim.stop()
