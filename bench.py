@@ -136,6 +136,65 @@ def bench_flagship_step(iters: int = 30) -> dict:
     return out
 
 
+def bench_claim_to_running(iters: int = 30) -> dict:
+    """BASELINE.md headline: ResourceClaim-to-Running p50 — wall time from
+    pod+claim creation to phase Running through the whole control plane
+    (scheduler pass, structured-parameters allocation, plugin Prepare with
+    flock/checkpoint/CDI, kubelet env materialization), on the sim cluster
+    stepped as fast as the control loops can run."""
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    rct = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: bench, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+    lat = []
+    with tempfile.TemporaryDirectory() as tmp:
+        sim = SimCluster(workdir=tmp, profile="v5e-4")
+        sim.start()
+        try:
+            for obj in load_manifests(rct):
+                sim.api.create(obj)
+            for i in range(iters):
+                pod_yaml = f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: bench-{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: bench}}]
+"""
+                for obj in load_manifests(pod_yaml):
+                    sim.api.create(obj)
+                t0 = time.perf_counter()
+                for _ in range(200):  # bounded: a Failed/stuck pod must not hang
+                    phase = sim.api.get(POD, f"bench-{i}", "default").phase
+                    if phase == "Running":
+                        break
+                    if phase == "Failed":
+                        raise RuntimeError(f"bench pod {i} Failed")
+                    sim.step()
+                else:
+                    raise RuntimeError(f"bench pod {i} stuck in {phase}")
+                lat.append(time.perf_counter() - t0)
+                sim.delete_pod(f"bench-{i}", "default")
+        finally:
+            sim.stop()
+    p50 = statistics.median(lat)
+    return {
+        "claim_to_running_p50_ms": round(p50 * 1e3, 2),
+        "claim_to_running_max_ms": round(max(lat) * 1e3, 2),
+        "claim_to_running_iters": iters,
+    }
+
+
 def check_flash_numerics() -> dict:
     """TPU-only: the attention=flash path (Pallas kernel + qkv relayout)
     must agree with the einsum path — this is the flash wiring's test
@@ -191,6 +250,10 @@ def main() -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     result = bench_prepare_latency()
+    try:
+        result.update(bench_claim_to_running())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["claim_to_running_error"] = str(e)[:200]
     try:
         result.update(bench_flagship_step())
     except Exception as e:  # noqa: BLE001 — flagship extras are best-effort

@@ -1,0 +1,212 @@
+"""SliceProof-MoE: the flagship's switch-routed Mixture-of-Experts sibling.
+
+Second model family of the workload tier: a transformer whose every other
+FF layer is a switch-MoE (``parallel/expert.py``) with one expert per
+device along a single ``ep`` mesh axis that also carries data parallelism
+— the canonical TPU MoE layout (experts ride the same devices the batch is
+sharded over; dispatch is one all_to_all each way). Dense blocks replicate
+their params and let XLA data-parallelize; expert blocks shard_map.
+
+Training uses the Switch Transformer auxiliary load-balancing loss
+(n_experts · Σ_e f_e·p_e over tokens-fraction f and router-prob mass p) so
+routing does not collapse onto one expert.
+
+No counterpart in the reference (resource layer). Public Switch/GShard
+formulation; implementation original.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_dra_driver_tpu.models.flagship import _rmsnorm
+from k8s_dra_driver_tpu.parallel.expert import init_moe_params, moe_ffn
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 4          # even layers dense FF, odd layers MoE
+    d_ff: int = 512
+    seq_len: int = 64
+    n_experts: int = 4         # must equal the ep mesh size
+    capacity_factor: float = 2.0
+    aux_loss_coef: float = 0.01
+    learning_rate: float = 1e-3
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return i % 2 == 1
+
+    @classmethod
+    def tiny(cls, n_experts: int = 4) -> "MoEConfig":
+        return cls(n_experts=n_experts)
+
+
+def init_params(cfg: MoEConfig, seed: int = 0) -> Params:
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = 0.02
+
+    def dense(k, *shape):
+        return scale * jax.random.normal(k, shape, dtype=jnp.float32)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = keys[2 + i]
+        ka, kf = jax.random.split(k)
+        layer: Params = {
+            "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(ka, cfg.d_model, 3, cfg.n_heads, cfg.head_dim),
+            "wo": dense(jax.random.fold_in(ka, 1), cfg.n_heads, cfg.head_dim, cfg.d_model),
+        }
+        if cfg.is_moe_layer(i):
+            layer["moe"] = init_moe_params(kf, cfg.d_model, cfg.d_ff, cfg.n_experts)
+        else:
+            layer["w1"] = dense(kf, cfg.d_model, cfg.d_ff)
+            layer["w2"] = dense(jax.random.fold_in(kf, 1), cfg.d_ff, cfg.d_model)
+        layers.append(layer)
+    return {
+        "embed": dense(keys[0], cfg.vocab, cfg.d_model),
+        "unembed": dense(keys[1], cfg.d_model, cfg.vocab),
+        "layers": layers,
+    }
+
+
+def param_pspecs(cfg: MoEConfig, axis: str = "ep") -> Params:
+    """Sharding specs: expert-stacked leaves along ``axis``, rest replicated."""
+    layers = []
+    for i in range(cfg.n_layers):
+        layer = {"ln1": P(), "ln2": P(), "wqkv": P(), "wo": P()}
+        if cfg.is_moe_layer(i):
+            layer["moe"] = {"router": P(), "w1": P(axis), "w2": P(axis)}
+        else:
+            layer["w1"] = P()
+            layer["w2"] = P()
+        layers.append(layer)
+    return {"embed": P(), "unembed": P(), "layers": layers}
+
+
+def _attention(cfg: MoEConfig, p: Params, x: jax.Array) -> jax.Array:
+    h = _rmsnorm(x, p["ln1"])
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    s = x.shape[1]
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / np.sqrt(cfg.head_dim)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+    return x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+
+
+def _aux_loss(logits2d: jax.Array, n_experts: int) -> jax.Array:
+    """Switch LB loss: n_experts · Σ_e (token fraction)·(prob mass)."""
+    probs = jax.nn.softmax(logits2d.astype(jnp.float32), axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), n_experts), axis=0)
+    mass = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(frac * mass)
+
+
+def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh):
+    """tokens [b, s] -> (logits [b, s, vocab] f32, aux_loss scalar)."""
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    b, s, d = x.shape
+    aux = jnp.zeros((), jnp.float32)
+    for i, p in enumerate(params["layers"]):
+        x = _attention(cfg, p, x)
+        h = _rmsnorm(x, p["ln2"])
+        if cfg.is_moe_layer(i):
+            flat = h.reshape(b * s, d)
+            logits = flat @ p["moe"]["router"]  # shared: aux loss + dispatch
+            aux = aux + _aux_loss(logits, cfg.n_experts)
+            x = x + moe_ffn(
+                p["moe"], flat, mesh,
+                capacity_factor=cfg.capacity_factor,
+                router_logits=logits,
+            ).reshape(b, s, d).astype(x.dtype)
+        else:
+            ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
+            x = x + jnp.einsum("bsf,fd->bsd", ff, p["w2"].astype(jnp.bfloat16))
+    logits = jnp.einsum("bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16))
+    return logits.astype(jnp.float32), aux
+
+
+def loss_fn(cfg: MoEConfig, params: Params, batch: Dict[str, jax.Array], mesh: Mesh):
+    logits, aux = forward(cfg, params, batch["tokens"], mesh)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = batch["tokens"][:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.aux_loss_coef * aux
+
+
+def make_moe_train_step(
+    cfg: MoEConfig,
+    devices: Sequence,
+    *,
+    batch_per_replica: int = 2,
+    seed: int = 0,
+    expert_axis: str = "ep",
+):
+    """Build (jitted_step, sharded_state, sharded_batch) over a 1-D ep mesh
+    carrying both data parallelism and expert placement."""
+    n = len(devices)
+    if cfg.n_experts != n:
+        raise ValueError(f"n_experts ({cfg.n_experts}) must equal device count ({n})")
+    mesh = Mesh(np.array(devices), (expert_axis,))
+
+    params = init_params(cfg, seed=seed)
+    pspecs = param_pspecs(cfg, expert_axis)
+
+    def shard(tree, specs):
+        return jax.tree.map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            tree, specs,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray),
+        )
+
+    state = {
+        "params": shard(params, pspecs),
+        "momentum": shard(jax.tree.map(jnp.zeros_like, params), pspecs),
+    }
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab, size=(n * batch_per_replica, cfg.seq_len))
+    batch = {
+        "tokens": jax.device_put(
+            jnp.asarray(tokens, dtype=jnp.int32),
+            NamedSharding(mesh, P(expert_axis, None)),
+        )
+    }
+
+    def train_step(state, batch):
+        params, mom = state["params"], state["momentum"]
+        loss, grads = jax.value_and_grad(
+            partial(loss_fn, cfg), argnums=0)(params, batch, mesh)
+        new_mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom, grads)
+        new_params = jax.tree.map(
+            lambda p, m: p - cfg.learning_rate * m, params, new_mom)
+        return {"params": new_params, "momentum": new_mom}, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+
+    def step(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    return step, state, batch

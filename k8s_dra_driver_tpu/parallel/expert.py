@@ -48,12 +48,11 @@ def _dispatch_indices(logits: jax.Array, capacity: int):
     return slot, keep, gate
 
 
-def _moe_shard(params, x, *, axis_name: str, capacity: int):
-    """Per-device body. x local: [t, d]; params local: router [d, E],
-    w1 [1, d, f], w2 [1, f, d] (this device's expert)."""
+def _moe_shard(params, x, logits, *, axis_name: str, capacity: int):
+    """Per-device body. x local: [t, d]; logits local: [t, E]; params
+    local: w1 [1, d, f], w2 [1, f, d] (this device's expert)."""
     n = jax.lax.psum(1, axis_name)
     d = x.shape[-1]
-    logits = x @ params["router"]
     slot, keep, gate = _dispatch_indices(logits, capacity)
 
     # Pack tokens into the [E*C, d] dispatch buffer. Dropped tokens'
@@ -82,12 +81,16 @@ def moe_ffn(
     *,
     expert_axis: str = "ep",
     capacity_factor: float = 1.25,
+    router_logits: jax.Array = None,
 ) -> jax.Array:
     """Switch-MoE feed-forward over expert-parallel devices.
 
     params: init_moe_params output; expert-stacked leaves are sharded one
     expert per device along ``expert_axis`` (n_experts == axis size).
     x: [tokens, d_model] global, token-sharded along the same axis.
+    router_logits: optional precomputed [tokens, n_experts] (callers that
+    also need them — e.g. for an aux loss — avoid a second router matmul;
+    XLA cannot CSE across the shard_map boundary).
     Returns [tokens, d_model], same sharding. Tokens over an expert's
     capacity contribute zero (Switch Transformer drop semantics).
     """
@@ -105,16 +108,19 @@ def moe_ffn(
     local_tokens = tokens // n
     capacity = max(1, math.ceil(local_tokens / n * capacity_factor))
 
+    if router_logits is None:
+        router_logits = x @ params["router"]
     body = partial(_moe_shard, axis_name=expert_axis, capacity=capacity)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(
             {"router": P(), "w1": P(expert_axis), "w2": P(expert_axis)},
             P(expert_axis),
+            P(expert_axis),
         ),
         out_specs=P(expert_axis),
     )
-    return fn(params, x)
+    return fn(params, x, router_logits)
 
 
 def reference_moe_ffn(params: Dict[str, jax.Array], x: jax.Array,
