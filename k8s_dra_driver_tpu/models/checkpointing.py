@@ -1,0 +1,65 @@
+"""Workload-side checkpoint/resume: orbax save/restore of sharded state.
+
+The training-tier counterpart of the driver's crash-consistent claim
+checkpoint (`plugins/checkpoint.py`): a job running on a claimed slice
+persists its sharded train state and resumes after preemption — including
+onto a *different* slice shape (elastic resume: a claim regranted as 8
+chips restores a 4-chip checkpoint; orbax reshards on load from the target
+sharding tree, so the restore is a resharded read, not a gather-then-
+scatter through host memory).
+
+No counterpart in the reference (resource layer); this is what makes
+driver-level preemption (health taints, domain teardown) survivable for
+the workload.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_train_state(ckpt_dir: str, step: int, state: Any) -> str:
+    """Persist the (sharded) train state for ``step``. Blocks until the
+    write is durable. Returns the step directory."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = _checkpointer()
+    ckptr.save(path, state)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Newest step with a finalized checkpoint, or None."""
+    try:
+        entries = os.listdir(ckpt_dir)
+    except FileNotFoundError:
+        return None
+    steps = [int(e.split("_", 1)[1]) for e in entries
+             if e.startswith("step_") and e.split("_", 1)[1].isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_train_state(ckpt_dir: str, step: int, target: Any) -> Any:
+    """Restore ``step`` resharded onto ``target``'s shardings.
+
+    target: a pytree of arrays OR jax.ShapeDtypeStruct leaves carrying the
+    *destination* shardings (current mesh — may differ from the one that
+    saved). Passing a live state tree restores 'like' it without keeping
+    two copies alive: leaves are converted to abstract structs first.
+    """
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if not isinstance(x, jax.ShapeDtypeStruct) else x,
+        target,
+    )
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    return _checkpointer().restore(path, abstract)

@@ -1,0 +1,94 @@
+"""Training scaffolding shared by the model families (flagship, MoE).
+
+One home for the pieces that must not drift between families: causal
+einsum attention, the NLL loss, the momentum-SGD update, and the
+state/batch sharding helpers used by every ``make_*_train_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+    return (x * g).astype(jnp.bfloat16)
+
+
+def causal_einsum_attention(
+    p: Dict[str, jax.Array],
+    x: jax.Array,
+    h: jax.Array,
+    head_dim: int,
+    pin_q: Optional[Callable[[jax.Array], jax.Array]] = None,
+) -> jax.Array:
+    """x + Attn(h) with p["wqkv"]/p["wo"]; h is the pre-normed input.
+    ``pin_q`` optionally sharding-constrains q (tp head pinning)."""
+    s = x.shape[1]
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    if pin_q is not None:
+        q = pin_q(q)
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / np.sqrt(head_dim)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+    return x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+
+
+def nll_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token NLL: logits [b, s, v] f32, tokens [b, s] int."""
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = tokens[:, 1:]
+    return -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0].mean()
+
+
+def momentum_sgd(params, momentum, grads, lr: float, beta: float = 0.9):
+    """Heavyweight-ball SGD shared by every family's train step."""
+    new_mom = jax.tree.map(lambda m, g: beta * m + g, momentum, grads)
+    new_params = jax.tree.map(lambda p, m: p - lr * m, params, new_mom)
+    return new_params, new_mom
+
+
+def shard_tree(tree, specs, mesh: Mesh):
+    """device_put every leaf with its NamedSharding spec."""
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        tree, specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray),
+    )
+
+
+def make_sharded_state(params, pspecs, mesh: Mesh) -> Dict[str, Any]:
+    """{"params", "momentum"} with momentum zeros_like, both sharded."""
+    return {
+        "params": shard_tree(params, pspecs, mesh),
+        "momentum": shard_tree(jax.tree.map(jnp.zeros_like, params), pspecs, mesh),
+    }
+
+
+def make_token_batch(seed: int, rows: int, seq_len: int, vocab: int,
+                     mesh: Mesh, spec: P) -> Dict[str, jax.Array]:
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, vocab, size=(rows, seq_len))
+    return {
+        "tokens": jax.device_put(
+            jnp.asarray(tokens, dtype=jnp.int32), NamedSharding(mesh, spec)
+        )
+    }
+
+
+def meshed_step(jitted, mesh: Mesh):
+    """Wrap a jitted step so it runs under the mesh context."""
+    def step(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    return step

@@ -21,13 +21,22 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from k8s_dra_driver_tpu.models.common import (
+    causal_einsum_attention,
+    make_sharded_state,
+    make_token_batch,
+    meshed_step,
+    momentum_sgd,
+    nll_loss,
+    rmsnorm as _rmsnorm,
+)
 from k8s_dra_driver_tpu.parallel.mesh import build_mesh, choose_dp_tp
 
 Params = Dict[str, Any]
@@ -133,14 +142,7 @@ def _pin(x: jax.Array, spec: P) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, spec)
 
 
-def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
-    x = x.astype(jnp.float32)
-    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
-    return (x * g).astype(jnp.bfloat16)
-
-
 def _block(cfg: SliceProofConfig, p: Params, x: jax.Array) -> jax.Array:
-    b, s, _ = x.shape
     h = _rmsnorm(x, p["ln1"])
     if cfg.attention == "flash":
         # [b,h,s,k] layout straight out of the projection; the kernel keeps
@@ -158,16 +160,12 @@ def _block(cfg: SliceProofConfig, p: Params, x: jax.Array) -> jax.Array:
             sm_scale=float(1.0 / np.sqrt(cfg.head_dim)),
         )
         attn = jnp.swapaxes(attn_bhsk, 1, 2)  # -> [b,s,h,k]
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
     else:
-        qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
-        q, kk, v = qkv[0], qkv[1], qkv[2]
-        q = _pin(q, P("data", None, "model", None))
-        scores = jnp.einsum("bshk,bthk->bhst", q, kk) / np.sqrt(cfg.head_dim)
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
-        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+        x = causal_einsum_attention(
+            p, x, h, cfg.head_dim,
+            pin_q=lambda q: _pin(q, P("data", None, "model", None)),
+        )
 
     h = _rmsnorm(x, p["ln2"])
     ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
@@ -187,19 +185,14 @@ def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array) -> jax.Arr
 
 
 def loss_fn(cfg: SliceProofConfig, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
-    logits = forward(cfg, params, batch["tokens"])
-    logp = jax.nn.log_softmax(logits[:, :-1])
-    tgt = batch["tokens"][:, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll_loss(forward(cfg, params, batch["tokens"]), batch["tokens"])
 
 
 def sgd_train_step(cfg: SliceProofConfig, state: Dict[str, Any], batch: Dict[str, jax.Array]):
     """One full training step: fwd, bwd, momentum-SGD update."""
     params, mom = state["params"], state["momentum"]
     loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, batch)
-    new_mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom, grads)
-    new_params = jax.tree.map(lambda p, m: p - cfg.learning_rate * m, params, new_mom)
+    new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
     return {"params": new_params, "momentum": new_mom}, loss
 
 
@@ -214,33 +207,8 @@ def make_sharded_train_step(
     dp, tp = choose_dp_tp(len(devices), max_tp=min(8, cfg.n_heads))
     mesh = build_mesh(devices, dp, tp)
 
-    params = init_params(cfg, seed=seed)
-    pspecs = param_pspecs(cfg)
-
-    def shard(tree, specs):
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            tree,
-            specs,
-            is_leaf=lambda x: isinstance(x, jnp.ndarray),
-        )
-
-    state = {
-        "params": shard(params, pspecs),
-        "momentum": shard(jax.tree.map(jnp.zeros_like, params), pspecs),
-    }
-    rng = np.random.default_rng(seed)
-    tokens = rng.integers(0, cfg.vocab, size=(dp * batch_per_replica, cfg.seq_len))
-    batch = {
-        "tokens": jax.device_put(
-            jnp.asarray(tokens, dtype=jnp.int32), NamedSharding(mesh, P("data", None))
-        )
-    }
-
+    state = make_sharded_state(init_params(cfg, seed=seed), param_pspecs(cfg), mesh)
+    batch = make_token_batch(seed, dp * batch_per_replica, cfg.seq_len,
+                             cfg.vocab, mesh, P("data", None))
     jitted = jax.jit(partial(sgd_train_step, cfg), donate_argnums=(0,))
-
-    def step(state, batch):
-        with jax.set_mesh(mesh):
-            return jitted(state, batch)
-
-    return step, state, batch
+    return meshed_step(jitted, mesh), state, batch

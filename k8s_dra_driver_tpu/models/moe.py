@@ -24,9 +24,17 @@ from typing import Any, Dict, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from k8s_dra_driver_tpu.models.flagship import _rmsnorm
+from k8s_dra_driver_tpu.models.common import (
+    causal_einsum_attention,
+    make_sharded_state,
+    make_token_batch,
+    meshed_step,
+    momentum_sgd,
+    nll_loss,
+    rmsnorm as _rmsnorm,
+)
 from k8s_dra_driver_tpu.parallel.expert import init_moe_params, moe_ffn
 
 Params = Dict[str, Any]
@@ -104,16 +112,7 @@ def param_pspecs(cfg: MoEConfig, axis: str = "ep") -> Params:
 
 
 def _attention(cfg: MoEConfig, p: Params, x: jax.Array) -> jax.Array:
-    h = _rmsnorm(x, p["ln1"])
-    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
-    q, k, v = qkv[0], qkv[1], qkv[2]
-    s = x.shape[1]
-    scores = jnp.einsum("bshk,bthk->bhst", q, k) / np.sqrt(cfg.head_dim)
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
-    attn = jnp.einsum("bhst,bthk->bshk", probs, v)
-    return x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+    return causal_einsum_attention(p, x, _rmsnorm(x, p["ln1"]), cfg.head_dim)
 
 
 def _aux_loss(logits2d: jax.Array, n_experts: int) -> jax.Array:
@@ -150,10 +149,7 @@ def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh):
 
 def loss_fn(cfg: MoEConfig, params: Params, batch: Dict[str, jax.Array], mesh: Mesh):
     logits, aux = forward(cfg, params, batch["tokens"], mesh)
-    logp = jax.nn.log_softmax(logits[:, :-1])
-    tgt = batch["tokens"][:, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.aux_loss_coef * aux
+    return nll_loss(logits, batch["tokens"]) + cfg.aux_loss_coef * aux
 
 
 def make_moe_train_step(
@@ -170,43 +166,17 @@ def make_moe_train_step(
     if cfg.n_experts != n:
         raise ValueError(f"n_experts ({cfg.n_experts}) must equal device count ({n})")
     mesh = Mesh(np.array(devices), (expert_axis,))
-
-    params = init_params(cfg, seed=seed)
-    pspecs = param_pspecs(cfg, expert_axis)
-
-    def shard(tree, specs):
-        return jax.tree.map(
-            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
-            tree, specs,
-            is_leaf=lambda x: isinstance(x, jnp.ndarray),
-        )
-
-    state = {
-        "params": shard(params, pspecs),
-        "momentum": shard(jax.tree.map(jnp.zeros_like, params), pspecs),
-    }
-    rng = np.random.default_rng(seed)
-    tokens = rng.integers(0, cfg.vocab, size=(n * batch_per_replica, cfg.seq_len))
-    batch = {
-        "tokens": jax.device_put(
-            jnp.asarray(tokens, dtype=jnp.int32),
-            NamedSharding(mesh, P(expert_axis, None)),
-        )
-    }
+    state = make_sharded_state(
+        init_params(cfg, seed=seed), param_pspecs(cfg, expert_axis), mesh)
+    batch = make_token_batch(seed, n * batch_per_replica, cfg.seq_len,
+                             cfg.vocab, mesh, P(expert_axis, None))
 
     def train_step(state, batch):
         params, mom = state["params"], state["momentum"]
         loss, grads = jax.value_and_grad(
             partial(loss_fn, cfg), argnums=0)(params, batch, mesh)
-        new_mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom, grads)
-        new_params = jax.tree.map(
-            lambda p, m: p - cfg.learning_rate * m, params, new_mom)
+        new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
         return {"params": new_params, "momentum": new_mom}, loss
 
     jitted = jax.jit(train_step, donate_argnums=(0,))
-
-    def step(state, batch):
-        with jax.set_mesh(mesh):
-            return jitted(state, batch)
-
-    return step, state, batch
+    return meshed_step(jitted, mesh), state, batch

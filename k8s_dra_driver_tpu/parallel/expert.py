@@ -50,7 +50,7 @@ def _dispatch_indices(logits: jax.Array, capacity: int):
 
 def _moe_shard(params, x, logits, *, axis_name: str, capacity: int):
     """Per-device body. x local: [t, d]; logits local: [t, E]; params
-    local: w1 [1, d, f], w2 [1, f, d] (this device's expert)."""
+    local: {"w1": [1, d, f], "w2": [1, f, d]} (this device's expert)."""
     n = jax.lax.psum(1, axis_name)
     d = x.shape[-1]
     slot, keep, gate = _dispatch_indices(logits, capacity)
@@ -111,16 +111,18 @@ def moe_ffn(
     if router_logits is None:
         router_logits = x @ params["router"]
     body = partial(_moe_shard, axis_name=expert_axis, capacity=capacity)
+    # Only the expert weights enter the shard body — routing already
+    # happened outside, so the router stays out of the exchange.
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(
-            {"router": P(), "w1": P(expert_axis), "w2": P(expert_axis)},
+            {"w1": P(expert_axis), "w2": P(expert_axis)},
             P(expert_axis),
             P(expert_axis),
         ),
         out_specs=P(expert_axis),
     )
-    return fn(params, x, router_logits)
+    return fn({"w1": params["w1"], "w2": params["w2"]}, x, router_logits)
 
 
 def reference_moe_ffn(params: Dict[str, jax.Array], x: jax.Array,

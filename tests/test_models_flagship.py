@@ -89,3 +89,32 @@ def test_moe_train_step_runs_and_learns(cpu_devices):
 
     with pytest.raises(ValueError, match="must equal device count"):
         make_moe_train_step(MoEConfig.tiny(3), cpu_devices[:4])
+
+
+def test_checkpoint_elastic_resume_across_mesh_shapes(cpu_devices, tmp_path):
+    """Workload checkpoint/resume: state saved from a 4-device dp×tp mesh
+    restores resharded onto an 8-device mesh (elastic resume after a claim
+    regrant) and training continues."""
+    from k8s_dra_driver_tpu.models.checkpointing import (
+        latest_step,
+        restore_train_state,
+        save_train_state,
+    )
+
+    cfg = SliceProofConfig.tiny()
+    step4, state4, batch4 = make_sharded_train_step(cfg, cpu_devices[:4])
+    for _ in range(2):
+        state4, loss4 = step4(state4, batch4)
+    assert latest_step(str(tmp_path)) is None
+    save_train_state(str(tmp_path), 2, state4)
+    assert latest_step(str(tmp_path)) == 2
+
+    step8, target8, batch8 = make_sharded_train_step(cfg, cpu_devices[:8])
+    restored = restore_train_state(str(tmp_path), 2, target8)
+    a = np.asarray(jax.device_get(state4["params"]["embed"]))
+    b = np.asarray(jax.device_get(restored["params"]["embed"]))
+    np.testing.assert_array_equal(a, b)
+    # Restored leaves carry the 8-device mesh's shardings.
+    assert restored["params"]["layers"][0]["wqkv"].sharding.mesh.size == 8
+    _, loss8 = step8(restored, batch8)
+    assert np.isfinite(float(loss8))
