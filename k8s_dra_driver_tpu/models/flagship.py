@@ -26,7 +26,7 @@ from typing import Any, Dict, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from k8s_dra_driver_tpu.models.common import (
     causal_einsum_attention,

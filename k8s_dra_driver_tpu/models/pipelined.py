@@ -1,0 +1,129 @@
+"""Pipelined flagship: the SliceProof transformer trained layer-per-device.
+
+Third composition of the workload tier: the same transformer family as
+``models/flagship`` but with one block per device along a ``pp`` mesh axis
+(``parallel/pipeline.py``'s GPipe schedule). Embedding and unembedding are
+replicated (cheap at these widths); the block stack is the pipeline.
+``jax.grad`` through the pipeline scan is the reverse schedule — the whole
+train step is still one jitted computation.
+
+Use when a model's layers don't fit one device's HBM but a single layer
+does — the orthogonal axis to dp×tp (flagship) and ep (MoE).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_dra_driver_tpu.models.common import (
+    causal_einsum_attention,
+    make_sharded_state,
+    make_token_batch,
+    meshed_step,
+    momentum_sgd,
+    nll_loss,
+    rmsnorm as _rmsnorm,
+)
+from k8s_dra_driver_tpu.models.flagship import SliceProofConfig, init_params
+from k8s_dra_driver_tpu.parallel.pipeline import pipeline_apply
+
+Params = Dict[str, Any]
+
+
+def _stage_fn(cfg: SliceProofConfig, p: Params, x: jax.Array) -> jax.Array:
+    """One transformer block, pin-free: under a pp-only mesh there are no
+    data/model axes to constrain onto. Einsum attention only — the flash
+    kernel is rejected up front in make_pipelined_train_step."""
+    x = causal_einsum_attention(p, x, _rmsnorm(x, p["ln1"]), cfg.head_dim)
+    h = _rmsnorm(x, p["ln2"])
+    ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
+    return x + jnp.einsum("bsf,fd->bsd", ff, p["w2"].astype(jnp.bfloat16))
+
+
+def stack_layer_params(params: Params) -> Params:
+    """[{'wqkv': ...} x L] -> {'wqkv': [L, ...]} for stage sharding."""
+    layers = params["layers"]
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *layers)
+
+
+def param_pspecs(cfg: SliceProofConfig, pipe_axis: str = "pp") -> Params:
+    stage = jax.tree.map(
+        lambda _: P(pipe_axis),
+        {"wqkv": 0, "wo": 0, "w1": 0, "w2": 0, "ln1": 0, "ln2": 0},
+    )
+    return {"embed": P(), "unembed": P(), "stages": stage}
+
+
+def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array,
+            mesh: Mesh, *, num_microbatches: int) -> jax.Array:
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    x = pipeline_apply(
+        partial(_stage_fn, cfg), params["stages"], x, mesh,
+        num_microbatches=num_microbatches,
+    )
+    return jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)
+    ).astype(jnp.float32)
+
+
+def loss_fn(cfg, params, batch, mesh, *, num_microbatches):
+    logits = forward(cfg, params, batch["tokens"], mesh,
+                     num_microbatches=num_microbatches)
+    return nll_loss(logits, batch["tokens"])
+
+
+def make_pipelined_train_step(
+    cfg: SliceProofConfig,
+    devices: Sequence,
+    *,
+    batch_per_microbatch: int = 2,
+    num_microbatches: Optional[int] = None,
+    seed: int = 0,
+    pipe_axis: str = "pp",
+):
+    """Build (jitted_step, sharded_state, sharded_batch) with one block per
+    device. cfg.n_layers must equal the device count."""
+    n = len(devices)
+    if cfg.n_layers != n:
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must equal device count ({n}) — "
+            f"one block per pipeline stage"
+        )
+    if cfg.attention != "einsum":
+        raise ValueError(
+            f"pipelined stages support einsum attention only, got "
+            f"{cfg.attention!r} (the flash kernel's tp pins have no axes "
+            f"on a pp-only mesh)"
+        )
+    if num_microbatches is None:
+        num_microbatches = n  # enough to keep every stage busy
+    mesh = Mesh(np.array(devices), (pipe_axis,))
+
+    flat = init_params(cfg, seed=seed)
+    params = {
+        "embed": flat["embed"],
+        "unembed": flat["unembed"],
+        "stages": stack_layer_params(flat),
+    }
+    state = make_sharded_state(params, param_pspecs(cfg, pipe_axis), mesh)
+    batch = make_token_batch(
+        seed, num_microbatches * batch_per_microbatch, cfg.seq_len,
+        cfg.vocab, mesh, P(),  # batch replicated; microbatching splits it
+    )
+
+    def train_step(state, batch):
+        params, mom = state["params"], state["momentum"]
+        loss, grads = jax.value_and_grad(partial(
+            loss_fn, cfg, num_microbatches=num_microbatches,
+        ), argnums=0)(params, batch, mesh)
+        new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
+        return {"params": new_params, "momentum": new_mom}, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+    return meshed_step(jitted, mesh), state, batch
