@@ -1,0 +1,111 @@
+"""Long-context flagship: the SliceProof transformer trained with ring
+attention over a sequence-parallel mesh axis.
+
+Fourth composition of the workload tier: batch activations are sharded
+along the *sequence* dimension over ``sp`` (every device holds T/n tokens
+of every example); attention runs as the ring schedule
+(``parallel/ring_attention.py``) so no device ever materializes the full
+sequence — the configuration for contexts that do not fit one chip's HBM.
+Dense ops (FF, norms, embeddings) stay under ``jit`` with sequence
+sharding constraints; XLA inserts the halo/collectives it needs (e.g. for
+the next-token shift in the loss).
+
+Use ``parallel/ulysses.py`` instead when heads divide the axis and a
+fused full-sequence kernel is preferred; this module is the O(T/n)-memory
+choice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_dra_driver_tpu.models.common import (
+    make_sharded_state,
+    make_token_batch,
+    meshed_step,
+    momentum_sgd,
+    nll_loss,
+    rmsnorm as _rmsnorm,
+)
+from k8s_dra_driver_tpu.models.flagship import (
+    SliceProofConfig,
+    init_params,
+)
+from k8s_dra_driver_tpu.parallel.ring_attention import ring_attention
+
+Params = Dict[str, Any]
+
+
+def _pin_seq(x: jax.Array, seq_axis: str) -> jax.Array:
+    spec = P(None, seq_axis) if x.ndim == 2 else P(None, seq_axis, *([None] * (x.ndim - 2)))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _block(cfg: SliceProofConfig, p: Params, x: jax.Array,
+           mesh: Mesh, seq_axis: str) -> jax.Array:
+    h = _rmsnorm(x, p["ln1"])
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
+    q = _pin_seq(qkv[0], seq_axis)
+    k = _pin_seq(qkv[1], seq_axis)
+    v = _pin_seq(qkv[2], seq_axis)
+    attn = ring_attention(q, k, v, mesh, seq_axis=seq_axis, causal=True)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
+
+    h = _rmsnorm(x, p["ln2"])
+    ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
+    ff = _pin_seq(ff, seq_axis)
+    return x + jnp.einsum("bsf,fd->bsd", ff, p["w2"].astype(jnp.bfloat16))
+
+
+def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array,
+            mesh: Mesh, seq_axis: str = "sp") -> jax.Array:
+    x = _pin_seq(params["embed"].astype(jnp.bfloat16)[tokens], seq_axis)
+    for p in params["layers"]:
+        x = _block(cfg, p, x, mesh, seq_axis)
+    return jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)
+    ).astype(jnp.float32)
+
+
+def loss_fn(cfg, params, batch, mesh, seq_axis: str = "sp"):
+    return nll_loss(forward(cfg, params, batch["tokens"], mesh, seq_axis),
+                    batch["tokens"])
+
+
+def make_longcontext_train_step(
+    cfg: SliceProofConfig,
+    devices: Sequence,
+    *,
+    batch_size: int = 1,
+    seed: int = 0,
+    seq_axis: str = "sp",
+):
+    """Build (jitted_step, sharded_state, sharded_batch) with the sequence
+    sharded over every device. cfg.seq_len must divide by the device count."""
+    n = len(devices)
+    if cfg.seq_len % n:
+        raise ValueError(f"seq_len ({cfg.seq_len}) must divide by device count ({n})")
+    if cfg.attention != "einsum":
+        raise ValueError("long-context training uses ring attention; "
+                         "cfg.attention must stay 'einsum' (the default)")
+    mesh = Mesh(np.array(devices), (seq_axis,))
+    pspecs = jax.tree.map(lambda _: P(), init_params(cfg, seed=seed))
+    state = make_sharded_state(init_params(cfg, seed=seed), pspecs, mesh)
+    batch = make_token_batch(seed, batch_size, cfg.seq_len, cfg.vocab,
+                             mesh, P(None, seq_axis))
+
+    def train_step(state, batch):
+        params, mom = state["params"], state["momentum"]
+        loss, grads = jax.value_and_grad(partial(
+            loss_fn, cfg, seq_axis=seq_axis), argnums=0)(params, batch, mesh)
+        new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
+        return {"params": new_params, "momentum": new_mom}, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+    return meshed_step(jitted, mesh), state, batch

@@ -31,12 +31,16 @@ def _tokenize_expr(expr):
 
 
 class MiniHelm:
-    def __init__(self, values, release="test", namespace="tpu-dra-driver"):
+    def __init__(self, values, release="test", namespace="tpu-dra-driver",
+                 lookups=None):
         self.scope = {
             "Values": values,
             "Release": {"Name": release, "Namespace": namespace},
         }
         self.vars = {}
+        # (apiVersion, kind, namespace, name) -> object; the `lookup` stub
+        # (empty = fresh install, populated = upgrade-path rendering).
+        self.lookups = lookups or {}
 
     # -- expression evaluation ------------------------------------------------
 
@@ -73,7 +77,7 @@ class MiniHelm:
         if fn == "genSignedCert":
             return _Cert()
         if fn == "lookup":
-            return None  # fresh install: no existing objects
+            return self.lookups.get(tuple(args))
         if fn == "or":
             return next((a for a in args if a), args[-1] if args else None)
         raise AssertionError(f"unknown function {fn!r}")
@@ -107,9 +111,15 @@ class MiniHelm:
         return expr
 
     def eval_expr(self, expr):
+        """Full pipeline evaluation: head (incl. toYaml) then every pipe
+        stage in order — no segment is ever silently dropped."""
         expr = self._reduce_parens(expr)
         segments = [s.strip() for s in expr.split("|")]
-        value = self._eval_tokens(_tokenize_expr(segments[0]))
+        head = _tokenize_expr(segments[0])
+        if head[0] == "toYaml":
+            value = self._eval_tokens(head[1:])
+        else:
+            value = self._eval_tokens(head)
         for seg in segments[1:]:
             toks = _tokenize_expr(seg)
             if toks[0] == "nindent":
@@ -118,16 +128,10 @@ class MiniHelm:
                     if not isinstance(value, str) else value
                 value = pad + text.replace("\n", pad)
             elif toks[0] == "toYaml":
-                raise AssertionError("toYaml must be first")
+                raise AssertionError("toYaml must be first in a pipeline")
             else:
                 value = self._pipe_fn(toks[0], value)
         return value
-
-    def eval_head(self, expr):
-        toks = _tokenize_expr(expr)
-        if toks[0] == "toYaml":
-            return self._eval_tokens(toks[1:])
-        return self.eval_expr(expr)
 
     # -- rendering -------------------------------------------------------------
 
@@ -146,35 +150,28 @@ class MiniHelm:
             is_control = bool(actions) and not stripped.strip()
             if is_control:
                 for act in actions:
+                    # Syntax is validated even inside dead branches so that
+                    # unsupported constructs in default-disabled sections
+                    # still fail loudly.
                     if act.startswith("if "):
                         stack.append(bool(self._eval_control(act[3:])) if live() else False)
                     elif act == "else":
                         stack[-1] = (not stack[-1]) and all(stack[:-1])
                     elif act == "end":
                         stack.pop()
-                    elif live() and re.match(r"^\$\w+ :?=", act):
-                        name, _, expr = act.partition("=")
-                        name = name.strip().rstrip(":").strip().lstrip("$")
-                        self.vars[name] = self.eval_head(expr.strip())
-                    elif not live():
-                        pass
+                    elif re.match(r"^\$\w+ :?=", act):
+                        if live():
+                            name, _, expr = act.partition("=")
+                            name = name.strip().rstrip(":").strip().lstrip("$")
+                            self.vars[name] = self.eval_expr(expr.strip())
                     else:
                         raise AssertionError(f"unknown control {act!r}")
                 continue
             if not live():
                 continue
 
-            def sub(m, line=raw_line):
-                body = m.group(1)
-                if body.startswith("toYaml") or "| nindent" in body:
-                    toks = _tokenize_expr(body.split("|")[0])
-                    value = self._eval_tokens(toks[1:]) if toks[0] == "toYaml" \
-                        else self.eval_expr(body.split("|")[0])
-                    n = int(re.search(r"nindent (\d+)", body).group(1))
-                    pad = "\n" + " " * n
-                    text_val = yaml.safe_dump(value, default_flow_style=False).rstrip()
-                    return pad + text_val.replace("\n", pad)
-                return str(self.eval_expr(body))
+            def sub(m):
+                return str(self.eval_expr(m.group(1)))
 
             out.append(pat.sub(sub, raw_line))
         assert not stack, "unclosed {{ if }}"
@@ -227,6 +224,24 @@ def test_kubelet_plugin_commands_are_importable(values):
     assert seen, "no python -m commands found in rendered templates"
     for module in sorted(seen):
         importlib.import_module(module)
+
+
+def test_webhook_upgrade_reuses_existing_certs(values):
+    """The lookup/reuse branch: on upgrade the existing TLS secret's certs
+    are carried forward (rotating the CA would break admission until pod
+    restart)."""
+    existing = {"data": {"tls.crt": "T0xEQ1JU", "tls.key": "T0xES0VZ",
+                         "ca.crt": "T0xEQ0E="}}
+    helm = MiniHelm(dict(values), lookups={
+        ("v1", "Secret", "tpu-dra-driver", "test-webhook-tls"): existing,
+    })
+    with open(os.path.join(CHART, "templates", "webhook.yaml"), encoding="utf-8") as f:
+        rendered = helm.render(f.read())
+    docs = {d["kind"]: d for d in yaml.safe_load_all(rendered) if d}
+    assert docs["Secret"]["data"]["tls.crt"] == "T0xEQ1JU"
+    assert docs["Secret"]["data"]["ca.crt"] == "T0xEQ0E="
+    vwc = docs["ValidatingWebhookConfiguration"]
+    assert vwc["webhooks"][0]["clientConfig"]["caBundle"] == "T0xEQ0E="
 
 
 def test_gated_env_plumbed(values):
