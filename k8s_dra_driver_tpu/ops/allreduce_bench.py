@@ -30,9 +30,9 @@ def psum_bandwidth(
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map, revary as _revary
 
-    from k8s_dra_driver_tpu.parallel.mesh import revary as _revary
+    shard_map = get_shard_map()
 
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)

@@ -94,7 +94,9 @@ def moe_ffn(
     Returns [tokens, d_model], same sharding. Tokens over an expert's
     capacity contribute zero (Switch Transformer drop semantics).
     """
-    from jax.experimental.shard_map import shard_map
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
 
     n = mesh.shape[expert_axis]
     if params["w1"].shape[0] != n:

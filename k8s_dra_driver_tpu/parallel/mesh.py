@@ -15,6 +15,19 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 
+def get_shard_map():
+    """The shard_map entry point across jax versions: the public
+    ``jax.shard_map`` (0.8+) with the experimental path as fallback —
+    same compat posture as revary below."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map  # pragma: no cover
+
+    return shard_map
+
+
 def revary(x, axis_name):
     """Mark a device-invariant value as varying over ``axis_name`` (no data
     movement) — needed for loop carries whose body applies an invariant

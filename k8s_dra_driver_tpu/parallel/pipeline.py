@@ -77,7 +77,9 @@ def pipeline_apply(
     x: [B, ...] global batch; B divisible by num_microbatches.
     Returns [B, ...] outputs, replicated along the pipe axis.
     """
-    from jax.experimental.shard_map import shard_map
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
 
     n = mesh.shape[pipe_axis]
     stage_dims = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}

@@ -46,8 +46,11 @@ def _block_attend(q, k, v, qi, ki, block_len, causal):
     return o, jnp.where(jnp.isfinite(m), m, -jnp.inf), l
 
 
-def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
-    """Per-shard body under shard_map. q,k,v: [B, T_local, H, D]."""
+def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool,
+                          vary_axes: tuple):
+    """Per-shard body under shard_map. q,k,v: [B, T_local, H, D].
+    ``vary_axes``: every mesh axis the inputs vary over (the ring axis
+    plus any batch axis) — the constant initial carry must match."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     block_len = q.shape[1]
@@ -72,12 +75,12 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
 
     b, t, h, d = q.shape
     # revary: the constant initial carry must be typed as device-varying over
-    # the ring axis or the fori_loop carry types mismatch under shard_map.
+    # every sharded axis or the fori_loop carry types mismatch under shard_map.
     from k8s_dra_driver_tpu.parallel.mesh import revary
 
-    o0 = revary(jnp.zeros((b, t, h, d), jnp.float32), axis_name)
-    m0 = revary(jnp.full((b, h, t), -jnp.inf, jnp.float32), axis_name)
-    l0 = revary(jnp.zeros((b, h, t), jnp.float32), axis_name)
+    o0 = revary(jnp.zeros((b, t, h, d), jnp.float32), vary_axes)
+    m0 = revary(jnp.full((b, h, t), -jnp.inf, jnp.float32), vary_axes)
+    l0 = revary(jnp.zeros((b, h, t), jnp.float32), vary_axes)
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
     l = jnp.maximum(l, 1e-20)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
@@ -90,17 +93,25 @@ def ring_attention(
     mesh: Mesh,
     *,
     seq_axis: str = "sp",
+    batch_axis: Optional[str] = None,
     causal: bool = True,
 ) -> jax.Array:
     """Causal self-attention with q/k/v sequence-sharded over ``seq_axis``.
 
     q, k, v: [B, T, H, D] global shapes, T divisible by the axis size.
+    ``batch_axis`` additionally shards B over a second mesh axis (dp×sp
+    composition) — a pure SPMD split: the ring's collectives only ever run
+    within each batch group's sp sub-axis.
     Returns [B, T, H, D] with the same sharding.
     """
-    from jax.experimental.shard_map import shard_map
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
 
-    spec = P(None, seq_axis, None, None)
-    body = partial(_ring_attention_shard, axis_name=seq_axis, causal=causal)
+    shard_map = get_shard_map()
+
+    spec = P(batch_axis, seq_axis, None, None)
+    vary_axes = (seq_axis,) + ((batch_axis,) if batch_axis else ())
+    body = partial(_ring_attention_shard, axis_name=seq_axis, causal=causal,
+                   vary_axes=vary_axes)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -73,7 +73,9 @@ def ulysses_attention(
     Returns [B, T, H, D] with the same sequence sharding. Same signature
     as ``ring_attention`` so workloads can switch strategies per length.
     """
-    from jax.experimental.shard_map import shard_map
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
 
     n = mesh.shape[seq_axis]
     if q.shape[2] % n:
