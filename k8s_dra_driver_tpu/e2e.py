@@ -95,6 +95,14 @@ def check_test5(sim: SimCluster, _pods) -> None:
     _expect(env.get("TPU_TOPOLOGY") == "4x4", f"bad topology {env.get('TPU_TOPOLOGY')}")
 
 
+def check_test6(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test6")
+    p = pods[0]
+    _expect(len(p.injected_devices) == 2, f"two distinct chips: {p.injected_devices}")
+    chips = p.injected_env.get("TPU_VISIBLE_CHIPS", "")
+    _expect(len(set(chips.split(","))) == 2, f"distinct chip ids: {chips}")
+
+
 def check_cd_single(sim: SimCluster, _pods) -> None:
     pods = _running_pods(sim, "cd-single")
     env = pods[0].injected_env
@@ -136,6 +144,7 @@ SCENARIOS: Dict[str, Scenario] = {
         Scenario("tpu-test4", "quickstart/tpu-test4.yaml",
                  gates="TimeSlicingSettings=true", check=check_test4),
         Scenario("tpu-test5", "quickstart/tpu-test5.yaml", check=check_test5),
+        Scenario("tpu-test6", "quickstart/tpu-test6.yaml", check=check_test6),
         Scenario("cd-single-host", "computedomain/cd-single-host.yaml",
                  profile="v5e-4", check=check_cd_single),
         Scenario("cd-multi-host", "computedomain/cd-multi-host.yaml",

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.k8s.core import (
@@ -53,18 +53,21 @@ class Allocator:
 
     # -- counter accounting --------------------------------------------------
 
-    def _consumed_counters(self, node_name: str) -> Dict[str, Dict[str, int]]:
+    def _consumed_counters(self, node_name: str,
+                           in_flight: Sequence = ()) -> Dict[str, Dict[str, int]]:
         """counter_set -> counter -> consumed, over all allocated claims on
-        this node."""
+        this node plus any ``in_flight`` AllocationResults computed but not
+        yet committed (several claims of one pod scheduled in one pass)."""
         slices = {
             (s.driver, s.node_name): s
             for s in self.api.list(RESOURCE_SLICE)
         }
         consumed: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
-        for claim in self.api.list(RESOURCE_CLAIM):
-            if claim.allocation is None or claim.allocation.node_name != node_name:
-                continue
-            for r in claim.allocation.devices:
+
+        def count(alloc) -> None:
+            if alloc is None or alloc.node_name != node_name:
+                return
+            for r in alloc.devices:
                 rs = slices.get((r.driver, node_name))
                 if rs is None:
                     continue
@@ -74,6 +77,11 @@ class Allocator:
                 for cc in dev.consumes_counters:
                     for cname, ctr in cc.counters.items():
                         consumed[cc.counter_set][cname] += ctr.value
+
+        for claim in self.api.list(RESOURCE_CLAIM):
+            count(claim.allocation)
+        for alloc in in_flight:
+            count(alloc)
         return consumed
 
     def _fits(self, rs: ResourceSlice, dev: Device,
@@ -103,15 +111,18 @@ class Allocator:
             raise AllocationError(f"DeviceClass {class_name!r} not found")
         return dc.driver, getattr(dc, "match_attributes", {})
 
-    def allocate_on_node(self, claim: ResourceClaim, node_name: str) -> Optional[AllocationResult]:
+    def allocate_on_node(self, claim: ResourceClaim, node_name: str,
+                         in_flight: Sequence = ()) -> Optional[AllocationResult]:
         """Try to satisfy every request of the claim on one node; returns the
-        allocation or None when it doesn't fit."""
+        allocation or None when it doesn't fit. ``in_flight``: allocations
+        computed this pass but not yet written (sibling claims of the same
+        pod) — their devices count as consumed."""
         slices_by_driver = {
             s.driver: s
             for s in self.api.list(RESOURCE_SLICE)
             if s.node_name == node_name
         }
-        consumed = self._consumed_counters(node_name)
+        consumed = self._consumed_counters(node_name, in_flight)
         pending: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
         picked: List[DeviceRequestAllocationResult] = []
         picked_names: set = set()

@@ -70,6 +70,10 @@ DEVICE_CLASS_VFIO = "vfio.tpu.google.com"
 # /root/reference/tests/bats/test_gpu_robustness.bats).
 CHAOS_CHIP_HEALTH_ANNOTATION = "sim.tpu.google.com/chip-health"
 
+# Comma-list env keys whose values union when a pod holds several claims
+# (each claim's CDI spec names only its own chips).
+UNION_ENV_KEYS = {"TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES"}
+
 
 @dataclass
 class SimNode:
@@ -315,7 +319,10 @@ class SimCluster:
                     results = []
                     ok = True
                     for c in unallocated:
-                        r = self.allocator.allocate_on_node(c, node)
+                        # Sibling claims computed this pass count as
+                        # consumed, or two claims of one pod double-book.
+                        r = self.allocator.allocate_on_node(
+                            c, node, in_flight=[r for _, r in results])
                         if r is None:
                             ok = False
                             break
@@ -398,7 +405,16 @@ class SimCluster:
                             edits = dev.get("containerEdits", {})
                             for e in edits.get("env", []):
                                 k, _, v = e.partition("=")
-                                env[k] = v
+                                if k in UNION_ENV_KEYS and env.get(k) and v:
+                                    # A pod holding several claims sees the
+                                    # union of their chip lists, like its
+                                    # device nodes (scalar env is CDI
+                                    # last-wins).
+                                    merged = set(env[k].split(",")) | set(v.split(","))
+                                    env[k] = ",".join(
+                                        sorted(merged, key=lambda s: (len(s), s)))
+                                else:
+                                    env[k] = v
                             for dn in edits.get("deviceNodes", []):
                                 devices.append(dn["path"])
                 if outcome == "failed":
