@@ -203,3 +203,25 @@ def test_informer_list_seeded_cache_survives_outage_delete():
             srv2.stop()
     finally:
         inf.stop()
+
+
+def test_kubectl_cli_verbs(remote):
+    """The tpu-kubectl CLI verbs against a live server: get/annotate/delete
+    with kubectl namespace defaulting (omitted -n = 'default' for
+    namespaced kinds, cluster scope for Node)."""
+    from k8s_dra_driver_tpu.k8s.core import Node, Pod
+    from k8s_dra_driver_tpu.sim.kubectl import main as kubectl
+
+    client, api = remote
+    api.create(Node(meta=new_meta("n0")))
+    api.create(Pod(meta=new_meta("p0", "default")))
+
+    base = ["--server", client.base_url]
+    assert kubectl(base + ["annotate", "node", "n0", "sim/x=1"]) == 0
+    assert api.get("Node", "n0", "").meta.annotations["sim/x"] == "1"
+    # Namespaced kind without -n resolves to 'default'.
+    assert kubectl(base + ["annotate", "pod", "p0", "team=a", "old-"]) == 0
+    assert api.get("Pod", "p0", "default").meta.annotations["team"] == "a"
+    assert kubectl(base + ["get", "pods"]) == 0
+    assert kubectl(base + ["delete", "pod", "p0"]) == 0
+    assert api.try_get("Pod", "p0", "default") is None
