@@ -103,6 +103,22 @@ def check_test6(sim: SimCluster, _pods) -> None:
     _expect(len(set(chips.split(","))) == 2, f"distinct chip ids: {chips}")
 
 
+def check_vfio(sim: SimCluster, _pods) -> None:
+    pods = _running_pods(sim, "tpu-test-vfio")
+    p = pods[0]
+    addr = p.injected_env.get("TPU_VFIO_PCI_ADDRESS", "")
+    _expect(addr.startswith("0000:"), f"bad TPU_VFIO_PCI_ADDRESS {addr!r}")
+    groups = [d for d in p.injected_devices if "/vfio/" in d]
+    _expect(len(groups) == 1, f"want one /dev/vfio group node, got {p.injected_devices}")
+    _expect(os.path.exists(groups[0]), f"vfio group node {groups[0]} missing on disk")
+    _expect(not any(d.endswith("accel0") for d in p.injected_devices),
+            "passthrough pod must not also get the accel node")
+    # The rebind really happened in the node's sysfs fixture.
+    mgr = sim.nodes[p.node_name].tpu_driver.state.vfio
+    _expect(mgr.current_driver(addr) == "vfio-pci",
+            f"chip driver is {mgr.current_driver(addr)!r}, want vfio-pci")
+
+
 def check_cd_single(sim: SimCluster, _pods) -> None:
     pods = _running_pods(sim, "cd-single")
     env = pods[0].injected_env
@@ -145,6 +161,8 @@ SCENARIOS: Dict[str, Scenario] = {
                  gates="TimeSlicingSettings=true", check=check_test4),
         Scenario("tpu-test5", "quickstart/tpu-test5.yaml", check=check_test5),
         Scenario("tpu-test6", "quickstart/tpu-test6.yaml", check=check_test6),
+        Scenario("tpu-test-vfio", "quickstart/tpu-test-vfio.yaml",
+                 gates="PassthroughSupport=true", check=check_vfio),
         Scenario("cd-single-host", "computedomain/cd-single-host.yaml",
                  profile="v5e-4", check=check_cd_single),
         Scenario("cd-multi-host", "computedomain/cd-multi-host.yaml",

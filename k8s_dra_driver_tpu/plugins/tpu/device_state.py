@@ -98,6 +98,7 @@ class DeviceState:
         cdi_root: Optional[str] = None,
         gates: Optional[fg.FeatureGates] = None,
         driver_name: str = TPU_DRIVER_NAME,
+        vfio: Optional[VfioPciManager] = None,
     ):
         self.gates = gates or fg.FeatureGates()
         self.driver_name = driver_name
@@ -110,7 +111,7 @@ class DeviceState:
         )
         self.cdi = CDIHandler(cdi_root)
         self.sharing = SharingManager(plugin_dir)
-        self.vfio = VfioPciManager()
+        self.vfio = vfio or VfioPciManager()
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
         # DynamicSubslice (the DynamicMIG analog, reference
@@ -288,7 +289,14 @@ class DeviceState:
                     continue
                 dev = self.allocatable[result.device]
                 if isinstance(dev, VfioDevice):
-                    dev = self._ensure_vfio_bound(dev)
+                    try:
+                        dev = self._ensure_vfio_bound(dev)
+                    except Exception:
+                        # A failed bind can strand the function driverless
+                        # (unbound from accel, vfio probe failed); re-probe
+                        # it back to the default driver before surfacing.
+                        self._release_vfio(dev)
+                        raise
                 extra: Dict[str, str] = {}
                 try:
                     if isinstance(dev, SubsliceDevice) and self.partitions is not None:
@@ -303,10 +311,7 @@ class DeviceState:
                         self.partitions.deactivate(pid)
                     self.sharing.clear(claim.uid, tuple(dev.chip_indices))
                     if isinstance(dev, VfioDevice):
-                        try:
-                            self.vfio.unbind_from_vfio(dev.chip.pci_address)
-                        except Exception:  # noqa: BLE001 — best effort
-                            log.exception("vfio unbind rollback failed")
+                        self._release_vfio(dev)
                     raise
                 prepared.append(
                     PreparedDevice(
@@ -441,6 +446,17 @@ class DeviceState:
                     freed += 1
             return freed
 
+    def _release_vfio(self, dev: VfioDevice) -> None:
+        """Return the function to the accel driver (vfio-device.go unbind
+        path) and clear the cached group path so a later prepare re-binds —
+        after the unbind the old /dev/vfio node is gone even for chips the
+        inventory reported pre-bound."""
+        try:
+            self.vfio.unbind_from_vfio(dev.chip.pci_address)
+        except Exception:  # noqa: BLE001 — best effort
+            log.exception("vfio unbind rollback failed")
+        self.allocatable[dev.name] = replace(dev, vfio_group_path="")
+
     def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
         try:
             self.sharing.clear(claim_uid, tuple(d.chip_indices))
@@ -449,9 +465,7 @@ class DeviceState:
                 self.partitions.deactivate(pid)
             dev = self.allocatable.get(d.name)
             if isinstance(dev, VfioDevice):
-                # Return the function to the accel driver (vfio-device.go
-                # unbind path); no-op when it was never vfio-bound.
-                self.vfio.unbind_from_vfio(dev.chip.pci_address)
+                self._release_vfio(dev)
         except Exception:  # noqa: BLE001 — rollback is best effort
             log.exception("rollback of %s for claim %s failed", d.name, claim_uid)
 

@@ -51,6 +51,7 @@ class TpuDriver:
         cleanup_interval_s: float = CLEANUP_INTERVAL_S,
         driver_name: str = TPU_DRIVER_NAME,
         ignored_health_states: frozenset = frozenset(),
+        vfio=None,
     ):
         self.api = api
         self.node_name = node_name
@@ -58,7 +59,7 @@ class TpuDriver:
         self.gates = gates or fg.FeatureGates()
         self.state = DeviceState(
             tpulib, plugin_dir, cdi_root=cdi_root, gates=self.gates,
-            driver_name=driver_name,
+            driver_name=driver_name, vfio=vfio,
         )
         self.metrics = DRARequestMetrics(
             driver=driver_name, registry=metrics_registry or Registry()

@@ -24,9 +24,21 @@ class VfioError(Exception):
 
 
 class VfioPciManager:
-    def __init__(self, sysfs_root: Optional[str] = None, dev_root: Optional[str] = None):
+    def __init__(
+        self,
+        sysfs_root: Optional[str] = None,
+        dev_root: Optional[str] = None,
+        fixture_kernel: bool = False,
+    ):
+        """``fixture_kernel=True`` points the manager at a mock sysfs tree
+        (vfiosysfs.build_vfio_sysfs) and emulates the kernel's reactions to
+        writes in-process — the ALT_PROC_DEVICES_PATH-style seam (reference
+        internal/common/nvcaps.go:33-75). It must stay False against any
+        *real* sysfs, relocated or not (e.g. /host/sys in a containerized
+        plugin), where the kernel itself reacts."""
         self.sysfs_root = sysfs_root or os.environ.get("ALT_TPU_SYSFS_ROOT", "/sys")
         self.dev_root = dev_root or os.environ.get("ALT_TPU_DEV_ROOT", "/dev")
+        self._fixture_kernel_on = fixture_kernel
 
     # -- sysfs paths ----------------------------------------------------------
 
@@ -37,13 +49,18 @@ class VfioPciManager:
         return os.path.join(self._pci_dir(pci_address), "driver")
 
     def current_driver(self, pci_address: str) -> str:
+        link = self._driver_link(pci_address)
+        if not os.path.islink(link):
+            return ""  # unbound (realpath on a dangling path is identity)
         try:
-            return os.path.basename(os.path.realpath(self._driver_link(pci_address)))
+            return os.path.basename(os.path.realpath(link))
         except OSError:
             return ""
 
     def iommu_group(self, pci_address: str) -> str:
         link = os.path.join(self._pci_dir(pci_address), "iommu_group")
+        if not os.path.islink(link):
+            return ""
         try:
             return os.path.basename(os.path.realpath(link))
         except OSError:
@@ -60,6 +77,54 @@ class VfioPciManager:
                 f.write(value)
         except OSError as e:
             raise VfioError(f"write {value!r} to {path}: {e}") from None
+        if self._fixture_kernel_on:
+            self._fixture_kernel(path, value)
+
+    def _fixture_kernel(self, path: str, value: str) -> None:
+        """Emulate what the kernel does in response to a sysfs write."""
+        devices = os.path.join(self.sysfs_root, "bus", "pci", "devices")
+        addr = value.strip()
+        if path.endswith(os.path.join("driver", "unbind")):
+            link = os.path.join(devices, addr, "driver")
+            if os.path.islink(link):
+                was_vfio = os.path.basename(os.path.realpath(link)) == VFIO_PCI_DRIVER
+                os.unlink(link)
+                if was_vfio:
+                    # Leaving vfio-pci removes the group's /dev/vfio node
+                    # once no member device remains bound (single-function
+                    # fixture: always).
+                    node = os.path.join(
+                        self.dev_root, "vfio", self.iommu_group(addr)
+                    )
+                    if os.path.exists(node):
+                        os.unlink(node)
+        elif path.endswith("drivers_probe"):
+            link = os.path.join(devices, addr, "driver")
+            if os.path.islink(link):
+                return  # already bound; probe is a no-op
+            try:
+                with open(os.path.join(devices, addr, "driver_override"),
+                          encoding="utf-8") as f:
+                    override = f.read().strip()
+            except OSError:
+                override = ""
+            if not override:
+                try:
+                    with open(os.path.join(devices, addr, ".default_driver"),
+                              encoding="utf-8") as f:
+                        override = f.read().strip()
+                except OSError:
+                    return  # no matching driver: device stays unbound
+            drv_dir = os.path.join(self.sysfs_root, "bus", "pci", "drivers", override)
+            if not os.path.isdir(drv_dir):
+                return  # driver not loaded: probe finds nothing
+            os.symlink(os.path.join("..", "..", "drivers", override), link)
+            if override == VFIO_PCI_DRIVER:
+                group = self.iommu_group(addr)
+                if group:
+                    vdir = os.path.join(self.dev_root, "vfio")
+                    os.makedirs(vdir, exist_ok=True)
+                    open(os.path.join(vdir, group), "a").close()
 
     def wait_device_free(self, dev_path: str, timeout_s: float = 10.0) -> None:
         """Refuse to yank a device out from under a running workload: wait
@@ -99,18 +164,30 @@ class VfioPciManager:
         self._write(override, VFIO_PCI_DRIVER)
         probe = os.path.join(self.sysfs_root, "bus", "pci", "drivers_probe")
         self._write(probe, pci_address)
+        if self.current_driver(pci_address) != VFIO_PCI_DRIVER:
+            # Probe found no vfio-pci (module not loaded, device blocked):
+            # surface it here so Prepare can roll the device back instead of
+            # handing the workload a half-bound function.
+            raise VfioError(
+                f"{pci_address}: not bound to {VFIO_PCI_DRIVER} after probe "
+                f"(current driver: {self.current_driver(pci_address) or 'none'})"
+            )
         group = self.iommu_group(pci_address)
         if not group:
             raise VfioError(f"{pci_address}: no IOMMU group after vfio bind")
         return os.path.join(self.dev_root, "vfio", group)
 
     def unbind_from_vfio(self, pci_address: str) -> None:
-        """Return the device to the default (accel) driver."""
-        if self.current_driver(pci_address) != VFIO_PCI_DRIVER:
-            return  # idempotent
-        self._write(
-            os.path.join(self._driver_link(pci_address), "unbind"), pci_address
-        )
+        """Return the device to the default (accel) driver. Also recovers a
+        driverless device (failed vfio bind left it unbound): clearing the
+        override and re-probing rebinds the default driver."""
+        cur = self.current_driver(pci_address)
+        if cur and cur != VFIO_PCI_DRIVER:
+            return  # already on a non-vfio driver: idempotent
+        if cur == VFIO_PCI_DRIVER:
+            self._write(
+                os.path.join(self._driver_link(pci_address), "unbind"), pci_address
+            )
         override = os.path.join(self._pci_dir(pci_address), "driver_override")
         self._write(override, "\n")
         self._write(os.path.join(self.sysfs_root, "bus", "pci", "drivers_probe"),

@@ -1,0 +1,81 @@
+"""Mock VFIO sysfs fixture tree — the passthrough analog of mock-NVML.
+
+The reference tests its rebind logic against a live kernel only in the bats
+tier; its CPU-only CI relies on the mock seam pattern
+(/root/reference/internal/common/nvcaps.go:33-75). For VFIO we build a
+fixture filesystem that mirrors the sysfs surfaces VfioPciManager touches
+(/root/reference/cmd/gpu-kubelet-plugin/vfio-device.go:235-257, 319-352):
+
+    {sysfs}/bus/pci/devices/{addr}/driver          -> ../../drivers/<name>
+    {sysfs}/bus/pci/devices/{addr}/driver_override
+    {sysfs}/bus/pci/devices/{addr}/iommu_group     -> iommu_groups/<n>
+    {sysfs}/bus/pci/drivers/{name}/{bind,unbind}
+    {sysfs}/bus/pci/drivers_probe
+    {dev}/accel*, {dev}/vfio/<n>, {dev}/iommu
+
+The kernel's *reactions* to writes (unbind drops the driver link, probe
+binds per driver_override) are emulated inside VfioPciManager when it is
+pointed at a non-/sys root — the same in-driver mock-seam approach the
+reference uses for ALT_PROC_DEVICES_PATH.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable
+
+ACCEL_DRIVER_NAME = "accel-tpu"  # the fixture's stand-in for the TPU driver
+IOMMU_GROUP_BASE = 10
+
+
+def iommu_group_for(chip_index: int) -> int:
+    return IOMMU_GROUP_BASE + chip_index
+
+
+def build_vfio_sysfs(
+    sysfs_root: str,
+    dev_root: str,
+    chips: Iterable,
+    *,
+    default_driver: str = ACCEL_DRIVER_NAME,
+    with_vfio_driver: bool = True,
+    with_iommufd: bool = False,
+) -> None:
+    """Create the fixture tree for ``chips`` (objects with .pci_address,
+    .index, .dev_path). Idempotent."""
+    drivers = os.path.join(sysfs_root, "bus", "pci", "drivers")
+    devices = os.path.join(sysfs_root, "bus", "pci", "devices")
+    groups = os.path.join(sysfs_root, "kernel", "iommu_groups")
+    os.makedirs(devices, exist_ok=True)
+    driver_names = [default_driver] + (["vfio-pci"] if with_vfio_driver else [])
+    for name in driver_names:
+        d = os.path.join(drivers, name)
+        os.makedirs(d, exist_ok=True)
+        for f in ("bind", "unbind"):
+            open(os.path.join(d, f), "a").close()
+    probe = os.path.join(sysfs_root, "bus", "pci", "drivers_probe")
+    open(probe, "a").close()
+    os.makedirs(os.path.join(dev_root, "vfio"), exist_ok=True)
+    if with_iommufd:
+        open(os.path.join(dev_root, "iommu"), "a").close()
+    for chip in chips:
+        ddir = os.path.join(devices, chip.pci_address)
+        os.makedirs(ddir, exist_ok=True)
+        open(os.path.join(ddir, "driver_override"), "a").close()
+        # Fixture metadata: which driver the kernel would pick with no
+        # override (real sysfs encodes this in modalias matching).
+        with open(os.path.join(ddir, ".default_driver"), "w", encoding="utf-8") as f:
+            f.write(default_driver)
+        link = os.path.join(ddir, "driver")
+        if not os.path.islink(link):
+            os.symlink(os.path.join("..", "..", "drivers", default_driver), link)
+        gdir = os.path.join(groups, str(iommu_group_for(chip.index)))
+        os.makedirs(gdir, exist_ok=True)
+        glink = os.path.join(ddir, "iommu_group")
+        if not os.path.islink(glink):
+            os.symlink(
+                os.path.relpath(gdir, ddir), glink
+            )
+        # The accel node the workload would otherwise use.
+        accel = os.path.join(dev_root, os.path.basename(chip.dev_path))
+        open(accel, "a").close()

@@ -147,11 +147,25 @@ class SimCluster:
                        else f"mock-slice-{self.profile}.{slice_idx}"),
         )
         base = os.path.join(self.workdir, name)
+        vfio_mgr = None
+        if self.gates.enabled("PassthroughSupport"):
+            # Per-node VFIO sysfs fixture (PCI addresses repeat across
+            # hosts, so the tree cannot be shared) — the mock-NVML-style
+            # seam the vfio rebind path runs against in CPU-only CI.
+            from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
+            from k8s_dra_driver_tpu.plugins.tpu.vfiosysfs import build_vfio_sysfs
+
+            sys_root = os.path.join(base, "sysfs")
+            dev_root = os.path.join(base, "dev")
+            build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips)
+            vfio_mgr = VfioPciManager(sysfs_root=sys_root, dev_root=dev_root,
+                                      fixture_kernel=True)
         tpu = TpuDriver(
             api=self.api, node_name=name, tpulib=lib,
             plugin_dir=os.path.join(base, "tpu-plugin"),
             cdi_root=os.path.join(base, "cdi"),
             gates=self.gates,
+            vfio=vfio_mgr,
         )
         cd = ComputeDomainDriver(
             api=self.api, node_name=name, tpulib=lib,

@@ -103,11 +103,13 @@ def _vfio_fixture(tmp_path, driver="tpu-accel"):
     devdir.mkdir(parents=True)
     drvdir = sysfs / "bus" / "pci" / "drivers" / driver
     drvdir.mkdir(parents=True)
+    (sysfs / "bus" / "pci" / "drivers" / "vfio-pci").mkdir(parents=True)
     os.symlink(drvdir, devdir / "driver")
     grp = sysfs / "kernel" / "iommu_groups" / "7"
     grp.mkdir(parents=True)
     os.symlink(grp, devdir / "iommu_group")
     (devdir / "driver_override").write_text("")
+    (devdir / ".default_driver").write_text(driver)
     (sysfs / "bus" / "pci" / "drivers_probe").write_text("")
     dev = tmp_path / "dev"
     (dev / "vfio").mkdir(parents=True)
@@ -116,7 +118,7 @@ def _vfio_fixture(tmp_path, driver="tpu-accel"):
 
 def test_vfio_bind_writes_rebind_sequence(tmp_path):
     pci, sysfs, dev = _vfio_fixture(tmp_path)
-    mgr = VfioPciManager(sysfs_root=sysfs, dev_root=dev)
+    mgr = VfioPciManager(sysfs_root=sysfs, dev_root=dev, fixture_kernel=True)
     assert mgr.current_driver(pci) == "tpu-accel"
     assert mgr.iommu_group(pci) == "7"
 
@@ -124,7 +126,8 @@ def test_vfio_bind_writes_rebind_sequence(tmp_path):
     assert group_path == os.path.join(dev, "vfio", "7")
     # The real rebind sequence must have been written to sysfs
     # (vfio-device.go:235-257): unbind from current driver, override,
-    # re-probe.
+    # re-probe. The fixture kernel reacts to the writes but preserves the
+    # written file contents, so both are checkable.
     devdir = os.path.join(sysfs, "bus", "pci", "devices", pci)
     drvdir = os.path.join(sysfs, "bus", "pci", "drivers", "tpu-accel")
     with open(os.path.join(drvdir, "unbind")) as f:
@@ -133,25 +136,23 @@ def test_vfio_bind_writes_rebind_sequence(tmp_path):
         assert f.read() == "vfio-pci"
     with open(os.path.join(sysfs, "bus", "pci", "drivers_probe")) as f:
         assert f.read() == pci
+    assert mgr.current_driver(pci) == "vfio-pci"
 
-    # Simulate the kernel's rebind, then already-bound is a no-op shortcut.
-    os.remove(os.path.join(devdir, "driver"))
-    vfio_drv = os.path.join(sysfs, "bus", "pci", "drivers", "vfio-pci")
-    os.makedirs(vfio_drv, exist_ok=True)
-    os.symlink(vfio_drv, os.path.join(devdir, "driver"))
+    # Already bound: the no-op shortcut returns the same group path.
     assert mgr.bind_to_vfio(pci) == group_path
 
-    # Unbind: writes vfio-pci unbind + cleared override + re-probe.
+    # Unbind: writes vfio-pci unbind + cleared override + re-probe, after
+    # which the default driver owns the function again.
+    vfio_drv = os.path.join(sysfs, "bus", "pci", "drivers", "vfio-pci")
     mgr.unbind_from_vfio(pci)
     with open(os.path.join(vfio_drv, "unbind")) as f:
         assert f.read() == pci
     with open(os.path.join(devdir, "driver_override")) as f:
         assert f.read() == "\n"
-    # Flip back; a second unbind is the idempotent no-op.
-    os.remove(os.path.join(devdir, "driver"))
-    os.symlink(os.path.join(sysfs, "bus", "pci", "drivers", "tpu-accel"),
-               os.path.join(devdir, "driver"))
+    assert mgr.current_driver(pci) == "tpu-accel"
+    # A second unbind is the idempotent no-op.
     mgr.unbind_from_vfio(pci)
+    assert mgr.current_driver(pci) == "tpu-accel"
 
 
 def test_vfio_wait_device_free_missing_is_free(tmp_path):

@@ -1,0 +1,262 @@
+"""VFIO passthrough: rebind logic, Prepare integration, failure rollback.
+
+Covers the reference's vfio surfaces
+(/root/reference/cmd/gpu-kubelet-plugin/vfio-device.go:235-257 rebind,
+85-116 wait-free; vfio-cdi.go:52-118 CDI edits) against the mock sysfs
+fixture tree (plugins/tpu/vfiosysfs.py) — the CPU-only CI analog of
+mock-NVML for the passthrough path.
+"""
+
+import errno
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    DeviceClaimConfig,
+    DeviceRequestAllocationResult,
+    OpaqueDeviceConfig,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState, PrepareError
+from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioError, VfioPciManager
+from k8s_dra_driver_tpu.plugins.tpu.vfiosysfs import build_vfio_sysfs, iommu_group_for
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+NODE = "node-0"
+
+
+@pytest.fixture
+def lib():
+    return MockTpuLib("v5e-4")
+
+
+@pytest.fixture
+def fixture_roots(tmp_path, lib):
+    sys_root = str(tmp_path / "sysfs")
+    dev_root = str(tmp_path / "dev")
+    build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips)
+    return sys_root, dev_root
+
+
+@pytest.fixture
+def mgr(fixture_roots):
+    return VfioPciManager(sysfs_root=fixture_roots[0], dev_root=fixture_roots[1], fixture_kernel=True)
+
+
+ADDR0 = "0000:00:04.0"
+
+
+# -- VfioPciManager against the fixture kernel -------------------------------
+
+def test_bind_flips_driver_and_creates_group_node(mgr):
+    assert mgr.current_driver(ADDR0) == "accel-tpu"
+    group_path = mgr.bind_to_vfio(ADDR0)
+    assert mgr.current_driver(ADDR0) == "vfio-pci"
+    assert group_path.endswith(f"/vfio/{iommu_group_for(0)}")
+    assert os.path.exists(group_path)
+
+
+def test_bind_is_idempotent(mgr):
+    first = mgr.bind_to_vfio(ADDR0)
+    second = mgr.bind_to_vfio(ADDR0)
+    assert first == second
+    assert mgr.current_driver(ADDR0) == "vfio-pci"
+
+
+def test_unbind_returns_default_driver_and_removes_node(mgr):
+    group_path = mgr.bind_to_vfio(ADDR0)
+    mgr.unbind_from_vfio(ADDR0)
+    assert mgr.current_driver(ADDR0) == "accel-tpu"
+    assert not os.path.exists(group_path)
+    mgr.unbind_from_vfio(ADDR0)  # idempotent
+    assert mgr.current_driver(ADDR0) == "accel-tpu"
+
+
+def test_bind_without_vfio_driver_fails_and_recovers(tmp_path, lib):
+    """No vfio-pci module loaded: the probe binds nothing; bind_to_vfio must
+    raise rather than report success, and unbind_from_vfio must recover the
+    stranded (driverless) function back to the accel driver."""
+    sys_root, dev_root = str(tmp_path / "s"), str(tmp_path / "d")
+    build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips,
+                     with_vfio_driver=False)
+    mgr = VfioPciManager(sysfs_root=sys_root, dev_root=dev_root, fixture_kernel=True)
+    with pytest.raises(VfioError, match="not bound to vfio-pci"):
+        mgr.bind_to_vfio(ADDR0)
+    assert mgr.current_driver(ADDR0) == ""  # stranded driverless
+    mgr.unbind_from_vfio(ADDR0)
+    assert mgr.current_driver(ADDR0) == "accel-tpu"
+
+
+def test_iommufd_detection(tmp_path, lib):
+    sys_root, dev_root = str(tmp_path / "s"), str(tmp_path / "d")
+    build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips,
+                     with_iommufd=True)
+    assert VfioPciManager(sysfs_root=sys_root, dev_root=dev_root,
+                          fixture_kernel=True).iommufd_available()
+    assert not VfioPciManager(sysfs_root=sys_root, dev_root=str(tmp_path / "nope"),
+                              fixture_kernel=True).iommufd_available()
+
+
+def test_wait_device_free_missing_node_returns(mgr, tmp_path):
+    mgr.wait_device_free(str(tmp_path / "gone"), timeout_s=0.1)  # no raise
+
+
+def test_wait_device_free_busy_times_out(mgr, tmp_path, monkeypatch):
+    dev = tmp_path / "accel9"
+    dev.write_text("")
+    real_open = os.open
+
+    def busy_open(path, flags, *a, **kw):
+        if str(path) == str(dev):
+            raise OSError(errno.EBUSY, "busy", str(dev))
+        return real_open(path, flags, *a, **kw)
+
+    monkeypatch.setattr(os, "open", busy_open)
+    with pytest.raises(VfioError, match="still busy"):
+        mgr.wait_device_free(str(dev), timeout_s=0.3)
+
+
+# -- DeviceState Prepare/Unprepare integration --------------------------------
+
+@pytest.fixture
+def state(tmp_path, lib, fixture_roots, monkeypatch):
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    return DeviceState(
+        lib,
+        str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("PassthroughSupport=true"),
+        vfio=VfioPciManager(sysfs_root=fixture_roots[0], dev_root=fixture_roots[1], fixture_kernel=True),
+    )
+
+
+def make_vfio_claim(device="tpu-0-vfio", configs=None):
+    claim = ResourceClaim(meta=new_meta("vm-claim", "default"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[DeviceRequestAllocationResult(
+            request="tpu", driver=TPU_DRIVER_NAME, pool=NODE, device=device,
+        )],
+        node_name=NODE,
+    )
+    claim.config = configs or []
+    return claim
+
+
+def vfio_cfg(**body):
+    return DeviceClaimConfig(
+        requests=["tpu"],
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION, "kind": "VfioTpuConfig", **body},
+        ),
+    )
+
+
+def test_prepare_vfio_binds_and_injects_group(state):
+    claim = make_vfio_claim(configs=[vfio_cfg(iommu_mode="auto")])
+    res = state.prepare(claim)
+    assert len(res.devices) == 1
+    spec = state.cdi.read_claim_spec(claim.uid)
+    dev = spec["devices"][0]
+    edits = dev["containerEdits"]
+    nodes = [n["path"] for n in edits.get("deviceNodes", [])]
+    assert len(nodes) == 1 and f"/vfio/{iommu_group_for(0)}" in nodes[0]
+    assert any(e.startswith("TPU_VFIO_PCI_ADDRESS=0000:") for e in edits["env"])
+    assert state.vfio.current_driver(ADDR0) == "vfio-pci"
+
+
+def test_unprepare_vfio_unbinds_and_reprepare_rebinds(state):
+    claim = make_vfio_claim()
+    state.prepare(claim)
+    assert state.vfio.current_driver(ADDR0) == "vfio-pci"
+    state.unprepare(claim.uid)
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    # The cached group path was reset: a new prepare re-binds.
+    claim2 = make_vfio_claim()
+    state.prepare(claim2)
+    assert state.vfio.current_driver(ADDR0) == "vfio-pci"
+    spec = state.cdi.read_claim_spec(claim2.uid)
+    nodes = [n["path"] for n in spec["devices"][0]["containerEdits"]["deviceNodes"]]
+    assert nodes and "/vfio/" in nodes[0]
+
+
+def test_config_failure_after_bind_rolls_back(state):
+    """A config error after the vfio bind succeeded must unbind the chip
+    (the device_state rollback branch) and leave no checkpoint entry."""
+    bad = DeviceClaimConfig(
+        requests=["tpu"],
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION, "kind": "SubsliceConfig"},
+        ),
+    )
+    claim = make_vfio_claim(configs=[bad])
+    with pytest.raises(PrepareError, match="non-subslice"):
+        state.prepare(claim)
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    assert claim.uid not in state.prepared_claims()
+    # And the device is reusable afterwards.
+    state.prepare(make_vfio_claim())
+    assert state.vfio.current_driver(ADDR0) == "vfio-pci"
+
+
+def test_bind_failure_recovers_default_driver(tmp_path, lib, monkeypatch):
+    """vfio-pci unavailable: prepare fails, the chip must be back on the
+    accel driver (bind-failure recovery in _prepare_devices), no entry."""
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    sys_root, dev_root = str(tmp_path / "s"), str(tmp_path / "d")
+    build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips,
+                     with_vfio_driver=False)
+    state = DeviceState(
+        lib, str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("PassthroughSupport=true"),
+        vfio=VfioPciManager(sysfs_root=sys_root, dev_root=dev_root, fixture_kernel=True),
+    )
+    claim = make_vfio_claim()
+    with pytest.raises(VfioError):
+        state.prepare(claim)
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    assert claim.uid not in state.prepared_claims()
+    assert state.cdi.read_claim_spec(claim.uid) is None
+
+
+def test_vfio_config_requires_gate(tmp_path, lib, fixture_roots, monkeypatch):
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    state = DeviceState(
+        lib, str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.FeatureGates(),  # PassthroughSupport off
+        vfio=VfioPciManager(sysfs_root=fixture_roots[0], dev_root=fixture_roots[1], fixture_kernel=True),
+    )
+    # Without the gate, vfio siblings are not even enumerated.
+    assert "tpu-0-vfio" not in state.allocatable
+    with pytest.raises(PrepareError, match="unknown device"):
+        state.prepare(make_vfio_claim())
+
+
+def test_vfio_excludes_accel_node_and_chip_env(state):
+    """Passthrough hands the group node, never the accel char dev or the
+    TPU_VISIBLE_* env of the shared path (vfio-cdi.go:52-118)."""
+    claim = make_vfio_claim()
+    state.prepare(claim)
+    spec = state.cdi.read_claim_spec(claim.uid)
+    edits = spec["devices"][0]["containerEdits"]
+    assert not any(
+        os.path.basename(n["path"]).startswith("accel")
+        for n in edits.get("deviceNodes", [])
+    )
+    assert not any(e.startswith("TPU_VISIBLE_") for e in edits.get("env", []))
