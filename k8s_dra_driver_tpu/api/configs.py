@@ -54,6 +54,14 @@ class TimeSlicingConfig:
             )
 
 
+# Admission-level absurdity bound for premapped budgets: far above any real
+# chip's HBM (v5p is 95 GiB), so typo'd units (bytes-vs-KiB etc.) die at the
+# webhook while the exact per-chip capacity check happens at Prepare, where
+# the chip's hbm_bytes is known (the two-phase split of the reference's MPS
+# pinned-memory validation, validate.go:25-106).
+MAX_PREMAPPED_HBM_BYTES = 1 << 40  # 1 TiB
+
+
 @dataclass
 class MpsLikePremappedConfig:
     """Multi-process chip sharing via premapped HBM budgets.
@@ -74,10 +82,31 @@ class MpsLikePremappedConfig:
     def validate(self) -> None:
         if self.default_premapped_hbm_bytes < 0:
             raise ValidationError("default_premapped_hbm_bytes must be >= 0")
+        if (self.default_premapped_hbm_bytes == 0
+                and not self.per_chip_premapped_hbm_bytes):
+            raise ValidationError(
+                "premapped sharing needs a budget: set "
+                "default_premapped_hbm_bytes > 0 or per-chip overrides"
+            )
+        if self.default_premapped_hbm_bytes > MAX_PREMAPPED_HBM_BYTES:
+            raise ValidationError(
+                f"default_premapped_hbm_bytes="
+                f"{self.default_premapped_hbm_bytes} exceeds the "
+                f"{MAX_PREMAPPED_HBM_BYTES} sanity bound (check units)"
+            )
         for idx, v in self.per_chip_premapped_hbm_bytes.items():
-            if idx < 0 or v < 0:
+            if idx < 0:
                 raise ValidationError(
-                    f"per_chip_premapped_hbm_bytes[{idx}]={v} must be >= 0"
+                    f"per_chip_premapped_hbm_bytes key {idx} must be >= 0"
+                )
+            if v <= 0:
+                raise ValidationError(
+                    f"per_chip_premapped_hbm_bytes[{idx}]={v} must be > 0"
+                )
+            if v > MAX_PREMAPPED_HBM_BYTES:
+                raise ValidationError(
+                    f"per_chip_premapped_hbm_bytes[{idx}]={v} exceeds the "
+                    f"{MAX_PREMAPPED_HBM_BYTES} sanity bound (check units)"
                 )
 
 

@@ -103,6 +103,22 @@ def check_test6(sim: SimCluster, _pods) -> None:
     _expect(len(set(chips.split(","))) == 2, f"distinct chip ids: {chips}")
 
 
+def check_test7(sim: SimCluster, _pods) -> None:
+    pods = {p.meta.name: p for p in sim.api.list(POD, namespace="tpu-test7")}
+    _expect(set(pods) == {"pod0", "pod1", "hog"}, f"pods: {sorted(pods)}")
+    for name in ("pod0", "pod1"):
+        p = pods[name]
+        _expect(p.phase == "Running", f"{name} is {p.phase}")
+        _expect(p.injected_env.get("TPU_PREMAPPED_BUFFER_BYTES") == "4294967296",
+                f"{name} premapped env: {p.injected_env.get('TPU_PREMAPPED_BUFFER_BYTES')}")
+    _expect(pods["pod0"].injected_devices == pods["pod1"].injected_devices,
+            "premapped sharers must see the same chip")
+    hog = pods["hog"]
+    _expect(hog.phase == "Failed", f"over-budget pod is {hog.phase}, want Failed")
+    _expect("exceeds HBM" in hog.meta.annotations.get("failure", ""),
+            f"hog failure: {hog.meta.annotations.get('failure')!r}")
+
+
 def check_vfio(sim: SimCluster, _pods) -> None:
     pods = _running_pods(sim, "tpu-test-vfio")
     p = pods[0]
@@ -161,6 +177,9 @@ SCENARIOS: Dict[str, Scenario] = {
                  gates="TimeSlicingSettings=true", check=check_test4),
         Scenario("tpu-test5", "quickstart/tpu-test5.yaml", check=check_test5),
         Scenario("tpu-test6", "quickstart/tpu-test6.yaml", check=check_test6),
+        Scenario("tpu-test7", "quickstart/tpu-test7.yaml",
+                 gates="TimeSlicingSettings=true,PremappedBufferSharing=true",
+                 check=check_test7),
         Scenario("tpu-test-vfio", "quickstart/tpu-test-vfio.yaml",
                  gates="PassthroughSupport=true", check=check_vfio),
         Scenario("cd-single-host", "computedomain/cd-single-host.yaml",

@@ -110,7 +110,10 @@ class DeviceState:
             with_vfio=self.gates.enabled("PassthroughSupport"),
         )
         self.cdi = CDIHandler(cdi_root)
-        self.sharing = SharingManager(plugin_dir)
+        self.sharing = SharingManager(
+            plugin_dir,
+            hbm_by_chip={c.index: c.hbm_bytes for c in self.inventory.chips},
+        )
         self.vfio = vfio or VfioPciManager()
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
@@ -158,6 +161,12 @@ class DeviceState:
         self._store = CheckpointStore(
             plugin_dir, Flock, read_boot_id(), on_discard=on_discard
         )
+        # Startup reconcile: sharing records are persisted *before* the
+        # claim's checkpoint entry, so a crash in between leaves orphans
+        # that would poison capacity sums and mode-conflict checks forever.
+        dropped = self.sharing.reconcile(self._store.get().claims)
+        if dropped:
+            log.warning("dropped %d orphaned sharing record(s) at startup", dropped)
 
     def _get_checkpoint(self) -> Checkpoint:
         return self._store.get()

@@ -23,13 +23,19 @@ TIME_SLICE_US = {"Default": 0, "Short": 2000, "Medium": 10000, "Long": 50000}
 
 
 class SharingConflictError(Exception):
-    pass
+    """A sharing request contradicts existing records or chip capacity —
+    the Prepare-time enforcement the reference does for MPS pinned-memory
+    limits (/root/reference/api/nvidia.com/resource/v1beta1/validate.go:25-106)."""
 
 
 class SharingManager:
-    def __init__(self, plugin_dir: str):
+    def __init__(self, plugin_dir: str,
+                 hbm_by_chip: Optional[Dict[int, int]] = None):
+        """``hbm_by_chip`` (chip index -> HBM bytes) bounds premapped
+        budgets; chips absent from the map are unbounded (mock/test use)."""
         self._path = os.path.join(plugin_dir, "sharing.json")
         self._mu = threading.Lock()
+        self._hbm = dict(hbm_by_chip or {})
         self._state: Dict[str, dict] = {}  # "claim_uid:chip" -> record
         self._load()
 
@@ -51,10 +57,30 @@ class SharingManager:
     def _key(claim_uid: str, chip: int) -> str:
         return f"{claim_uid}:{chip}"
 
+    @staticmethod
+    def _key_uid(key: str) -> str:
+        return key.rsplit(":", 1)[0]
+
+    def _check_mode_conflict(
+        self, claim_uid: str, chips: Sequence[int], mode: str
+    ) -> None:
+        """A chip cannot carry timeslice and premapped records from
+        different claims at once (a claim's own records may be rewritten by
+        a more specific config — that is precedence, not a conflict)."""
+        want = set(chips)
+        for key, r in self._state.items():
+            if (r["chip"] in want and r["mode"] != mode
+                    and self._key_uid(key) != claim_uid):
+                raise SharingConflictError(
+                    f"chip {r['chip']}: claim {self._key_uid(key)} already "
+                    f"shares it in {r['mode']} mode; cannot add {mode}"
+                )
+
     def set_time_slice(self, claim_uid: str, chips: Sequence[int], interval: str) -> None:
         if interval not in TIME_SLICE_US:
             raise ValueError(f"unknown interval {interval!r}")
         with self._mu:
+            self._check_mode_conflict(claim_uid, chips, "timeslice")
             for c in chips:
                 self._state[self._key(claim_uid, c)] = {
                     "mode": "timeslice", "interval": interval, "chip": c,
@@ -65,10 +91,39 @@ class SharingManager:
         self, claim_uid: str, chips: Sequence[int], cfg: MpsLikePremappedConfig
     ) -> None:
         with self._mu:
+            self._check_mode_conflict(claim_uid, chips, "premapped")
+            budgets: Dict[int, int] = {}
             for c in chips:
                 budget = cfg.per_chip_premapped_hbm_bytes.get(
                     c, cfg.default_premapped_hbm_bytes
                 )
+                if budget <= 0:
+                    # Admission can't know which chip the allocator picks:
+                    # a config whose per-chip overrides miss this chip and
+                    # whose default is 0 surfaces here.
+                    raise SharingConflictError(
+                        f"chip {c}: premapped sharing with no budget (config "
+                        f"covers other chips only; set a default)"
+                    )
+                cap = self._hbm.get(c)
+                if cap is not None:
+                    # Per-chip sum over every *other* claim's records plus
+                    # this budget must fit the silicon (the pinned-memory
+                    # bound of validate.go:25-106, enforced where the chip
+                    # capacity is actually known).
+                    others = sum(
+                        r["bytes"]
+                        for key, r in self._state.items()
+                        if r["chip"] == c and r["mode"] == "premapped"
+                        and self._key_uid(key) != claim_uid
+                    )
+                    if others + budget > cap:
+                        raise SharingConflictError(
+                            f"chip {c}: premapped budget {budget} + {others} "
+                            f"already premapped exceeds HBM {cap}"
+                        )
+                budgets[c] = budget
+            for c, budget in budgets.items():
                 self._state[self._key(claim_uid, c)] = {
                     "mode": "premapped", "bytes": budget, "chip": c,
                 }
@@ -79,6 +134,21 @@ class SharingManager:
             for c in chips:
                 self._state.pop(self._key(claim_uid, c), None)
             self._save()
+
+    def reconcile(self, live_claim_uids) -> int:
+        """Drop records of claims absent from ``live_claim_uids`` — orphans
+        of a crash between the sharing write and the checkpoint write, which
+        would otherwise count into capacity sums and mode-conflict checks
+        forever (the sharing-side analog of destroy_unknown_partitions).
+        Returns how many records were dropped."""
+        live = set(live_claim_uids)
+        with self._mu:
+            doomed = [k for k in self._state if self._key_uid(k) not in live]
+            for k in doomed:
+                del self._state[k]
+            if doomed:
+                self._save()
+            return len(doomed)
 
     def clear_claim(self, claim_uid: str) -> None:
         with self._mu:

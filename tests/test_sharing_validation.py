@@ -1,0 +1,281 @@
+"""Sharing enforcement: premapped HBM budgets and cross-claim mode conflicts.
+
+The TPU analog of the reference's MPS pinned-memory validation
+(/root/reference/api/nvidia.com/resource/v1beta1/validate.go:25-106), split
+in two phases: absurdity bounds at admission (webhook strict-decode) and
+exact per-chip capacity at Prepare (SharingManager, which knows hbm_bytes).
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.api.configs import (
+    API_VERSION,
+    MAX_PREMAPPED_HBM_BYTES,
+    MpsLikePremappedConfig,
+    TPU_DRIVER_NAME,
+    ValidationError,
+    strict_decode,
+)
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    DeviceClaimConfig,
+    DeviceRequestAllocationResult,
+    OpaqueDeviceConfig,
+    RESOURCE_CLAIM,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+from k8s_dra_driver_tpu.plugins.tpu.sharing import (
+    SharingConflictError,
+    SharingManager,
+)
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.webhook.admission import AdmissionRequest, AdmissionWebhook
+
+GIB = 1 << 30
+NODE = "node-0"
+
+
+# -- SharingManager ----------------------------------------------------------
+
+@pytest.fixture
+def mgr(tmp_path):
+    return SharingManager(str(tmp_path), hbm_by_chip={0: 16 * GIB, 1: 16 * GIB})
+
+
+def premap(default=0, per_chip=None):
+    return MpsLikePremappedConfig(
+        default_premapped_hbm_bytes=default,
+        per_chip_premapped_hbm_bytes=per_chip or {},
+    )
+
+
+def test_premapped_within_budget_accumulates(mgr):
+    mgr.set_premapped("claim-a", [0], premap(default=6 * GIB))
+    mgr.set_premapped("claim-b", [0], premap(default=8 * GIB))
+    env = mgr.env_for([0])
+    assert env["TPU_PREMAPPED_BUFFER_BYTES"] == str(6 * GIB)  # min of budgets
+
+
+def test_premapped_overcommit_rejected(mgr):
+    mgr.set_premapped("claim-a", [0], premap(default=10 * GIB))
+    with pytest.raises(SharingConflictError, match="exceeds HBM"):
+        mgr.set_premapped("claim-b", [0], premap(default=8 * GIB))
+    # The rejected claim left no record behind.
+    assert mgr.records_for([0]) == [
+        {"mode": "premapped", "bytes": 10 * GIB, "chip": 0}
+    ]
+
+
+def test_premapped_single_claim_over_hbm_rejected(mgr):
+    with pytest.raises(SharingConflictError, match="exceeds HBM"):
+        mgr.set_premapped("claim-a", [0], premap(default=17 * GIB))
+
+
+def test_premapped_rejection_is_atomic_across_chips(mgr):
+    """Chip 1 fits but chip 0 does not: neither chip may be recorded."""
+    mgr.set_premapped("claim-a", [0], premap(default=10 * GIB))
+    with pytest.raises(SharingConflictError):
+        mgr.set_premapped(
+            "claim-b", [0, 1], premap(default=8 * GIB)
+        )
+    assert mgr.records_for([1]) == []
+
+
+def test_premapped_own_claim_rewrite_is_not_overcommit(mgr):
+    """A more specific config overwriting the same claim's record is
+    precedence, not a conflict (device_state config apply order)."""
+    mgr.set_premapped("claim-a", [0], premap(default=10 * GIB))
+    mgr.set_premapped("claim-a", [0], premap(default=12 * GIB))
+    assert mgr.records_for([0])[0]["bytes"] == 12 * GIB
+
+
+def test_mixed_mode_cross_claim_rejected(mgr):
+    mgr.set_time_slice("claim-a", [0], "Short")
+    with pytest.raises(SharingConflictError, match="timeslice mode"):
+        mgr.set_premapped("claim-b", [0], premap(default=GIB))
+    mgr.set_premapped("claim-c", [1], premap(default=GIB))
+    with pytest.raises(SharingConflictError, match="premapped mode"):
+        mgr.set_time_slice("claim-d", [1], "Short")
+
+
+def test_mixed_mode_same_claim_rewrite_allowed(mgr):
+    mgr.set_time_slice("claim-a", [0], "Short")
+    mgr.set_premapped("claim-a", [0], premap(default=GIB))  # precedence rewrite
+    assert mgr.records_for([0])[0]["mode"] == "premapped"
+
+
+def test_unknown_chip_is_unbounded(tmp_path):
+    mgr = SharingManager(str(tmp_path))  # no hbm map: mock/test posture
+    mgr.set_premapped("claim-a", [7], premap(default=100 * GIB))  # no raise
+
+
+def test_zero_budget_for_uncovered_chip_rejected(mgr):
+    """Per-chip overrides that miss the allocated chip with default 0 slip
+    past admission (it can't know which chip the allocator picks); Prepare
+    must refuse the resulting zero budget."""
+    with pytest.raises(SharingConflictError, match="no budget"):
+        mgr.set_premapped("claim-a", [0], premap(per_chip={3: 4 * GIB}))
+    assert mgr.records_for([0]) == []
+
+
+def test_reconcile_drops_orphans_keeps_live(mgr):
+    mgr.set_premapped("claim-live", [0], premap(default=4 * GIB))
+    mgr.set_premapped("claim-orphan", [1], premap(default=4 * GIB))
+    assert mgr.reconcile({"claim-live"}) == 1
+    assert mgr.records_for([0]) != [] and mgr.records_for([1]) == []
+
+
+# -- DeviceState Prepare integration ----------------------------------------
+
+@pytest.fixture
+def state(tmp_path, monkeypatch):
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    return DeviceState(
+        MockTpuLib("v5e-4"),
+        str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("TimeSlicingSettings=true,PremappedBufferSharing=true"),
+    )
+
+
+def premap_claim(budget, device="tpu-0", name="claim-a"):
+    claim = ResourceClaim(meta=new_meta(name, "default"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[DeviceRequestAllocationResult(
+            request="tpu", driver=TPU_DRIVER_NAME, pool=NODE, device=device,
+        )],
+        node_name=NODE,
+    )
+    claim.config = [DeviceClaimConfig(
+        requests=["tpu"],
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters={
+                "apiVersion": API_VERSION,
+                "kind": "TpuConfig",
+                "sharing": {
+                    "strategy": "Premapped",
+                    "premapped": {"default_premapped_hbm_bytes": budget},
+                },
+            },
+        ),
+    )]
+    return claim
+
+
+def test_prepare_rejects_over_hbm_budget_and_rolls_back(state):
+    claim = premap_claim(32 * GIB)  # v5e chip has 16 GiB
+    with pytest.raises(SharingConflictError, match="exceeds HBM"):
+        state.prepare(claim)
+    assert claim.uid not in state.prepared_claims()
+    assert state.sharing.records_for([0]) == []
+    assert state.cdi.read_claim_spec(claim.uid) is None
+    # The chip is unharmed: a sane budget prepares fine afterwards.
+    ok = premap_claim(4 * GIB, name="claim-b")
+    state.prepare(ok)
+    assert state.sharing.records_for([0])[0]["bytes"] == 4 * GIB
+
+
+def test_prepare_within_budget_emits_env(state):
+    claim = premap_claim(4 * GIB)
+    state.prepare(claim)
+    spec = state.cdi.read_claim_spec(claim.uid)
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert f"TPU_PREMAPPED_BUFFER_BYTES={4 * GIB}" in env
+
+
+def test_unprepare_clears_budget_for_reuse(state):
+    claim = premap_claim(12 * GIB)
+    state.prepare(claim)
+    state.unprepare(claim.uid)
+    # Full budget available again.
+    state.prepare(premap_claim(14 * GIB, name="claim-b"))
+
+
+def test_startup_reconciles_orphan_sharing_records(tmp_path, monkeypatch):
+    """A crash between the sharing write and the checkpoint write leaves a
+    sharing.json record with no checkpoint entry; a fresh DeviceState must
+    drop it so the chip's capacity isn't poisoned forever."""
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    plugin_dir = str(tmp_path / "plugin")
+    gates = fg.parse("TimeSlicingSettings=true,PremappedBufferSharing=true")
+
+    first = DeviceState(MockTpuLib("v5e-4"), plugin_dir,
+                        cdi_root=str(tmp_path / "cdi"), gates=gates)
+    live = premap_claim(4 * GIB, name="claim-live")
+    first.prepare(live)
+    # Simulate the crash window: a record written without a checkpoint entry.
+    first.sharing.set_premapped(
+        "orphan-uid", [0], MpsLikePremappedConfig(default_premapped_hbm_bytes=10 * GIB)
+    )
+
+    restarted = DeviceState(MockTpuLib("v5e-4"), plugin_dir,
+                            cdi_root=str(tmp_path / "cdi"), gates=gates)
+    recs = restarted.sharing.records_for([0])
+    assert [r["bytes"] for r in recs] == [4 * GIB]  # orphan gone, live kept
+    # The freed capacity is usable again: 12 GiB fits alongside the live 4
+    # (4 + 10 + 12 would have exceeded the 16 GiB chip).
+    restarted.sharing.set_premapped(
+        "claim-b", [0], MpsLikePremappedConfig(default_premapped_hbm_bytes=12 * GIB)
+    )
+
+
+# -- admission-level config validation ---------------------------------------
+
+def premap_blob(**premapped):
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Premapped", "premapped": premapped},
+    }
+
+
+def test_config_zero_budget_rejected():
+    cfg = strict_decode(premap_blob(default_premapped_hbm_bytes=0))
+    with pytest.raises(ValidationError, match="needs a budget"):
+        cfg.validate()
+
+
+def test_config_absurd_budget_rejected():
+    cfg = strict_decode(
+        premap_blob(default_premapped_hbm_bytes=MAX_PREMAPPED_HBM_BYTES + 1)
+    )
+    with pytest.raises(ValidationError, match="sanity bound"):
+        cfg.validate()
+
+
+def test_config_zero_per_chip_rejected():
+    cfg = strict_decode(premap_blob(per_chip_premapped_hbm_bytes={"0": 0}))
+    with pytest.raises(ValidationError, match="must be > 0"):
+        cfg.validate()
+
+
+def test_config_per_chip_only_is_valid():
+    cfg = strict_decode(premap_blob(per_chip_premapped_hbm_bytes={"0": GIB}))
+    cfg.validate()
+
+
+def test_webhook_rejects_bad_premapped_config():
+    claim = ResourceClaim(meta=new_meta("c", "default"))
+    claim.config = [DeviceClaimConfig(
+        requests=["tpu"],
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters=premap_blob(default_premapped_hbm_bytes=(1 << 41)),
+        ),
+    )]
+    resp = AdmissionWebhook().admit(
+        AdmissionRequest(uid="u1", kind=RESOURCE_CLAIM, object=claim)
+    )
+    assert not resp.allowed
+    assert "sanity bound" in resp.message
