@@ -1,6 +1,11 @@
 # Build/test entry points (reference Makefile analog).
 
-.PHONY: all native test e2e bench clean
+.PHONY: all native test e2e bench ci clean
+
+# The full CI gate, exactly as .github/workflows declares it (add
+# RUN_KIND=1 for the kind mock-cluster tier).
+ci:
+	hack/ci/run-local.sh
 
 all: native test
 

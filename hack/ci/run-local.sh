@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Run the full CI gate locally — the same steps the GitHub workflows
+# declare (.github/workflows/), so "CI passes" is reproducible without
+# GitHub (reference precedent: hack/ci/mock-nvml/e2e-test.sh is runnable
+# both ways).
+#
+#   hack/ci/run-local.sh                 # native + unit + sim e2e + shell + helm
+#   RUN_KIND=1 hack/ci/run-local.sh      # also the kind mock-cluster tier
+#   hack/ci/run-local.sh unit-tests helm-render   # just these steps
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+DEFAULT_STEPS=(native unit-tests sim-e2e shell-e2e helm-render)
+if [ "${RUN_KIND:-0}" = "1" ]; then
+  DEFAULT_STEPS+=(kind-mock-e2e)
+fi
+if [ "$#" -gt 0 ]; then
+  STEPS=("$@")
+else
+  STEPS=("${DEFAULT_STEPS[@]}")
+fi
+
+failed=()
+for step in "${STEPS[@]}"; do
+  script="${HERE}/steps/${step}.sh"
+  if [ ! -f "${script}" ]; then
+    echo "ERROR: unknown step '${step}' (have: $(ls "${HERE}/steps" | sed 's/\.sh$//' | tr '\n' ' '))"
+    exit 2
+  fi
+  echo
+  echo "=== CI step: ${step} ==="
+  if ! bash "${script}"; then
+    failed+=("${step}")
+    echo "FAIL: ${step}"
+  fi
+done
+
+echo
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "CI FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "CI PASSED: ${STEPS[*]}"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# CI step: Helm chart validation. Always runs the in-repo renderer
+# (tests/test_helm_chart.py — works without a helm binary); when `helm` is
+# installed, also lints and templates the chart for real.
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+cd "${REPO}"
+"${PYTHON:-python}" -m pytest tests/test_helm_chart.py -x -q
+if command -v helm >/dev/null 2>&1; then
+  helm lint deployments/helm/tpu-dra-driver
+  helm template tpu-dra deployments/helm/tpu-dra-driver >/dev/null
+  echo "OK: helm lint+template"
+else
+  echo "OK: chart render-validated (helm binary not present; skipped lint)"
+fi

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# CI step: the kind mock-cluster e2e — image build, DRA-enabled kind
+# cluster, Helm install with the ALT_TPU_TOPOLOGY mock seam, claimed pod
+# runs to completion (the reference's mock-NVML kind e2e,
+# /root/reference/.github/workflows/mock-nvml-e2e.yaml:42-83 +
+# hack/ci/mock-nvml/e2e-test.sh).
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+
+for tool in docker kind kubectl helm; do
+  if ! command -v "${tool}" >/dev/null 2>&1; then
+    echo "SKIP: ${tool} not installed (kind tier needs docker+kind+kubectl+helm)"
+    exit 0
+  fi
+done
+
+export CLUSTER_NAME="${KIND_CLUSTER_NAME:-tpu-dra-ci}"
+cleanup() {
+  if [ "${KEEP_CLUSTER:-}" != "1" ]; then
+    "${REPO}/demo/clusters/kind/delete-cluster.sh" || true
+  fi
+}
+trap cleanup EXIT
+"${REPO}/demo/clusters/kind/create-cluster.sh"
+echo "OK: kind mock e2e"

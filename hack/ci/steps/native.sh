@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# CI step: build the native pieces (libtpulib / libtpupart / tpu-slice-ctl).
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+GEN="${CMAKE_GENERATOR:-Ninja}"
+command -v ninja >/dev/null 2>&1 || GEN="Unix Makefiles"
+cmake -S "${REPO}/native" -B "${REPO}/native/build" -G "${GEN}"
+cmake --build "${REPO}/native/build"
+echo "OK: native build"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# CI step: the shell scenario tier (tests/shell/*.sh, the bats-suite
+# analog) plus the local cluster bring-up — run through their pytest
+# wrapper so skips/timeouts behave identically to `make test`.
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+cd "${REPO}"
+"${PYTHON:-python}" -m pytest tests/test_shell_e2e.py -x -q
+echo "OK: shell e2e"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# CI step: the simulated-cluster e2e tier — every shipped quickstart and
+# ComputeDomain manifest against real plugin/controller/daemon code over
+# mock tpulib (the mock-NVML kind run's cheaper sibling; the kind step
+# covers the containerized path).
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+cd "${REPO}"
+"${PYTHON:-python}" -m k8s_dra_driver_tpu.e2e
+echo "OK: sim e2e"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# CI step: the unit/integration pytest tier (SURVEY.md §4.1 analog).
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+cd "${REPO}"
+"${PYTHON:-python}" -m pytest tests/ -x -q
+echo "OK: unit tests"

@@ -1,0 +1,224 @@
+#!/usr/bin/env bash
+# Up/downgrade robustness (the reference's test_gpu_updowngrade.bats +
+# test_cd_updowngrade.bats analog): claims are prepared, the plugin process
+# stops, and a different "driver version" starts over the same on-disk
+# state. Four phases:
+#   1. same-schema restart (the normal rolling upgrade): claims stay
+#      prepared, CDI specs intact, old workload deletable, fresh cycle ok;
+#   2. v1 checkpoint on disk (written by an old driver): migration runs,
+#      v1 entries are conservatively rebuilt (no boot-id proof) with their
+#      CDI specs cleaned up, file is rewritten at v2;
+#   3. synthetic NEWER checkpoint (v3): a downgraded plugin refuses to
+#      start and leaves the file byte-identical (no clobbering);
+#   4. helm upgrade render old->new image tag, including the cert-reuse
+#      lookup branch.
+
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+export PYTHONPATH="$REPO"
+PY="${PYTHON:-python}"
+
+WORK="$(mktemp -d)"
+trap 'rm -rf "$WORK"' EXIT
+export ALT_TPU_BOOT_ID_PATH="$WORK/boot_id"
+printf 'boot-aaaa\n' > "$ALT_TPU_BOOT_ID_PATH"
+
+plugin_py() {  # run a python snippet with the plugin env set up
+  UPDOWN_WORK="$WORK" "$PY" - "$@"
+}
+
+echo "# phase 1: same-schema restart keeps claims prepared"
+plugin_py <<'EOF'
+import json, os, sys
+work = os.environ["UPDOWN_WORK"]
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.k8s.core import (AllocationResult,
+    DeviceRequestAllocationResult, ResourceClaim)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+def claim(uid, device):
+    c = ResourceClaim(meta=new_meta("wl-" + device, "updown"))
+    c.meta.uid = uid
+    c.allocation = AllocationResult(devices=[DeviceRequestAllocationResult(
+        request="r0", driver="tpu.google.com", pool="n0", device=device)],
+        node_name="n0")
+    return c
+
+drv = TpuDriver(api=APIServer(), node_name="n0", tpulib=MockTpuLib("v5e-4"),
+                plugin_dir=os.path.join(work, "plugin"),
+                cdi_root=os.path.join(work, "cdi"))
+res = drv.prepare_resource_claims([claim("uid-1", "tpu-0"), claim("uid-2", "tpu-1")])
+assert all(not isinstance(r, Exception) for r in res.values()), res
+drv.shutdown()  # "old version" exits with claims in flight
+print("prepared", sorted(res))
+EOF
+
+test -f "$WORK/plugin/checkpoint.json" || { echo "FAIL: no checkpoint"; exit 1; }
+grep -q '"version": "v2"' "$WORK/plugin/checkpoint.json" \
+  || { echo "FAIL: checkpoint not v2"; exit 1; }
+ls "$WORK"/cdi/*uid-1* >/dev/null || { echo "FAIL: no CDI spec for uid-1"; exit 1; }
+
+plugin_py <<'EOF'
+import os
+work = os.environ["UPDOWN_WORK"]
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+# The "new version" starts over the same plugin dir.
+drv = TpuDriver(api=APIServer(), node_name="n0", tpulib=MockTpuLib("v5e-4"),
+                plugin_dir=os.path.join(work, "plugin"),
+                cdi_root=os.path.join(work, "cdi"))
+held = drv.state.prepared_claims()
+assert set(held) == {"uid-1", "uid-2"}, held
+assert all(e.state == "PrepareCompleted" for e in held.values())
+assert drv.state.cdi.read_claim_spec("uid-1") is not None, "CDI spec lost"
+# Old workload deletable: unprepare works and removes the spec.
+drv.unprepare_resource_claims(["uid-1"])
+assert drv.state.cdi.read_claim_spec("uid-1") is None
+# Fresh create cycle on the freed chip.
+from k8s_dra_driver_tpu.k8s.core import (AllocationResult,
+    DeviceRequestAllocationResult, ResourceClaim)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+c = ResourceClaim(meta=new_meta("wl-new", "updown")); c.meta.uid = "uid-3"
+c.allocation = AllocationResult(devices=[DeviceRequestAllocationResult(
+    request="r0", driver="tpu.google.com", pool="n0", device="tpu-0")],
+    node_name="n0")
+res = drv.prepare_resource_claims([c])
+assert not isinstance(res["uid-3"], Exception), res
+drv.shutdown()
+print("survived restart; old deletable; fresh cycle ok")
+EOF
+echo "PASS phase 1"
+
+echo "# phase 2: v1 checkpoint migrates (conservative rebuild, CDI cleaned)"
+plugin_py <<'EOF'
+import json, os, zlib
+work = os.environ["UPDOWN_WORK"]
+path = os.path.join(work, "plugin", "checkpoint.json")
+with open(path) as f:
+    doc = json.load(f)
+# Rewrite as an old driver would have: v1 schema had no node_boot_id.
+payload = doc["data"]
+payload.pop("node_boot_id", None)
+canon = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+with open(path, "w") as f:
+    json.dump({"version": "v1", "checksum": zlib.crc32(canon.encode()),
+               "data": payload}, f)
+print("downgraded checkpoint to v1 with", len(payload["claims"]), "claims")
+EOF
+
+plugin_py <<'EOF'
+import json, os
+work = os.environ["UPDOWN_WORK"]
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+drv = TpuDriver(api=APIServer(), node_name="n0", tpulib=MockTpuLib("v5e-4"),
+                plugin_dir=os.path.join(work, "plugin"),
+                cdi_root=os.path.join(work, "cdi"))
+# v1 cannot prove the node did not reboot: entries are rebuilt and their
+# CDI specs removed (docs/upgrade.md contract).
+assert drv.state.prepared_claims() == {}, drv.state.prepared_claims()
+assert drv.state.cdi.read_claim_spec("uid-2") is None, "v1 CDI spec leaked"
+assert drv.state.cdi.read_claim_spec("uid-3") is None, "v1 CDI spec leaked"
+with open(os.path.join(work, "plugin", "checkpoint.json")) as f:
+    doc = json.load(f)
+assert doc["version"] == "v2", doc["version"]
+assert doc["data"]["node_boot_id"] == "boot-aaaa"
+# And the node is fully usable post-migration.
+from k8s_dra_driver_tpu.k8s.core import (AllocationResult,
+    DeviceRequestAllocationResult, ResourceClaim)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+c = ResourceClaim(meta=new_meta("wl-post", "updown")); c.meta.uid = "uid-4"
+c.allocation = AllocationResult(devices=[DeviceRequestAllocationResult(
+    request="r0", driver="tpu.google.com", pool="n0", device="tpu-2")],
+    node_name="n0")
+res = drv.prepare_resource_claims([c])
+assert not isinstance(res["uid-4"], Exception), res
+drv.shutdown()
+print("v1 migrated; post-migration prepare ok")
+EOF
+echo "PASS phase 2"
+
+echo "# phase 3: downgraded plugin refuses a newer checkpoint, no clobber"
+plugin_py <<'EOF'
+import json, os
+work = os.environ["UPDOWN_WORK"]
+path = os.path.join(work, "plugin", "checkpoint.json")
+with open(path) as f:
+    doc = json.load(f)
+doc["version"] = "v3"  # written by a future driver
+with open(path, "w") as f:
+    json.dump(doc, f, sort_keys=True)
+EOF
+BEFORE="$(sha256sum "$WORK/plugin/checkpoint.json" | cut -d' ' -f1)"
+
+set +e
+plugin_py <<'EOF'
+import os, sys
+work = os.environ["UPDOWN_WORK"]
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+try:
+    TpuDriver(api=APIServer(), node_name="n0", tpulib=MockTpuLib("v5e-4"),
+              plugin_dir=os.path.join(work, "plugin"),
+              cdi_root=os.path.join(work, "cdi"))
+except ValueError as e:
+    assert "unknown checkpoint version" in str(e), e
+    print("refused newer checkpoint:", e)
+    sys.exit(42)
+sys.exit(0)
+EOF
+rc=$?
+set -e
+[ "$rc" = 42 ] || { echo "FAIL: downgraded plugin accepted a v3 checkpoint"; exit 1; }
+AFTER="$(sha256sum "$WORK/plugin/checkpoint.json" | cut -d' ' -f1)"
+[ "$BEFORE" = "$AFTER" ] || { echo "FAIL: refusal clobbered the checkpoint"; exit 1; }
+echo "PASS phase 3"
+
+echo "# phase 4: helm upgrade render old->new"
+plugin_py <<'EOF'
+import os, sys
+repo = os.environ["PYTHONPATH"]
+sys.path.insert(0, os.path.join(repo, "tests"))
+import yaml
+from test_helm_chart import CHART, MiniHelm
+
+with open(os.path.join(CHART, "values.yaml")) as f:
+    values = yaml.safe_load(f)
+
+def render_all(tag, lookups=None):
+    vals = dict(values)
+    vals["image"] = {**vals["image"], "tag": tag}
+    out = []
+    tdir = os.path.join(CHART, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if name.endswith(".yaml"):
+            with open(os.path.join(tdir, name)) as f:
+                out.append(MiniHelm(vals, lookups=lookups).render(f.read()))
+    rendered = "\n".join(out)
+    for doc in yaml.safe_load_all(rendered):
+        pass  # every doc must stay parseable at both versions
+    return rendered
+
+old = render_all("0.1.0")
+# The upgrade render sees the install's TLS secret via lookup and must
+# carry it forward (cert rotation would break admission mid-upgrade).
+existing = {"data": {"tls.crt": "T0xEQ1JU", "tls.key": "T0xES0VZ",
+                     "ca.crt": "T0xEQ0E="}}
+new = render_all("0.2.0", lookups={
+    ("v1", "Secret", "tpu-dra-driver", "test-webhook-tls"): existing,
+})
+assert "0.1.0" in old and "0.2.0" in new
+assert "0.1.0" not in new, "old tag leaked into upgrade render"
+assert "T0xEQ0E=" in new, "upgrade render did not reuse existing CA"
+print("helm render upgrade ok")
+EOF
+echo "PASS phase 4"
+
+echo "PASS test_updowngrade"

@@ -1,0 +1,79 @@
+"""CI definitions stay valid: workflows parse, reference real step scripts,
+and the local runner mirrors them (the reference gates every PR through
+.github/workflows/{tests,helm,mock-nvml-e2e}.yaml — this suite is the
+equivalent contract for our four workflows + hack/ci runner)."""
+
+import glob
+import os
+import re
+import stat
+import subprocess
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKFLOWS = sorted(glob.glob(os.path.join(REPO, ".github", "workflows", "*.yaml")))
+STEPS_DIR = os.path.join(REPO, "hack", "ci", "steps")
+
+
+def test_expected_workflows_exist():
+    names = {os.path.basename(w) for w in WORKFLOWS}
+    assert {"tests.yaml", "e2e.yaml", "helm.yaml", "kind-mock-e2e.yaml"} <= names
+
+
+def test_workflows_parse_and_gate_prs():
+    for wf in WORKFLOWS:
+        with open(wf, encoding="utf-8") as f:
+            doc = yaml.safe_load(f)
+        assert doc.get("jobs"), f"{wf}: no jobs"
+        # PyYAML parses the bare `on:` key as boolean True.
+        trigger = doc.get("on", doc.get(True))
+        assert trigger and "pull_request" in trigger, f"{wf}: must gate PRs"
+        for job in doc["jobs"].values():
+            assert job.get("timeout-minutes"), f"{wf}: jobs need timeouts"
+
+
+def test_workflow_run_steps_exist_and_are_executable():
+    """Every `run:` line that invokes hack/ci must point at a real,
+    executable script — a renamed step must break CI loudly, not silently."""
+    referenced = set()
+    for wf in WORKFLOWS:
+        with open(wf, encoding="utf-8") as f:
+            for m in re.finditer(r"hack/ci/[\w/.-]+\.sh", f.read()):
+                referenced.add(m.group(0))
+    assert referenced, "workflows reference no hack/ci steps"
+    for rel in referenced:
+        path = os.path.join(REPO, rel)
+        assert os.path.isfile(path), f"{rel} referenced by a workflow is missing"
+        assert os.stat(path).st_mode & stat.S_IXUSR, f"{rel} not executable"
+
+
+def test_local_runner_knows_every_step():
+    step_names = {
+        os.path.basename(p)[:-3]
+        for p in glob.glob(os.path.join(STEPS_DIR, "*.sh"))
+    }
+    with open(os.path.join(REPO, "hack", "ci", "run-local.sh"), encoding="utf-8") as f:
+        runner = f.read()
+    for name in step_names - {"kind-mock-e2e"}:
+        assert name in runner, f"run-local.sh does not run step {name}"
+    assert "kind-mock-e2e" in runner  # opt-in via RUN_KIND=1
+
+
+def test_step_scripts_are_valid_bash():
+    for script in glob.glob(os.path.join(STEPS_DIR, "*.sh")) + [
+        os.path.join(REPO, "hack", "ci", "run-local.sh")
+    ]:
+        proc = subprocess.run(
+            ["bash", "-n", script], capture_output=True, text=True
+        )
+        assert proc.returncode == 0, f"{script}: {proc.stderr}"
+
+
+def test_runner_rejects_unknown_step():
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "hack", "ci", "run-local.sh"), "no-such-step"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert "unknown step" in proc.stdout
