@@ -15,6 +15,7 @@ the workload.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Optional
 
@@ -37,15 +38,55 @@ def save_train_state(ckpt_dir: str, step: int, state: Any) -> str:
     return path
 
 
+def _is_finalized(path: str) -> bool:
+    """True when the checkpoint at ``path`` is committed, not just named
+    like one: it must be a non-empty directory (a crash between mkdir and
+    content leaves an empty husk) that orbax does not consider an
+    in-progress tmp dir (tmp naming schemes change across orbax versions —
+    ask orbax instead of pattern-matching)."""
+    if not os.path.isdir(path):
+        return False
+    try:
+        if not os.listdir(path):
+            return False
+    except OSError:
+        return False
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        return True  # non-orbax layout: non-empty dir is the best signal
+    try:
+        check = ocp.utils.is_checkpoint_finalized
+    except AttributeError:
+        return True  # older orbax without the helper
+    try:
+        return bool(check(path))
+    except Exception:  # noqa: BLE001 — transient IO must not fail open
+        logging.getLogger(__name__).warning(
+            "is_checkpoint_finalized(%s) errored; treating as unfinalized",
+            path, exc_info=True,
+        )
+        return False
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    """Newest step with a finalized checkpoint, or None."""
+    """Newest step with a finalized checkpoint, or None. Candidates are
+    checked newest-first and the first finalized one wins, so resume costs
+    O(1) finalization checks, not one per retained step."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
     try:
         entries = os.listdir(ckpt_dir)
     except FileNotFoundError:
         return None
-    steps = [int(e.split("_", 1)[1]) for e in entries
-             if e.startswith("step_") and e.split("_", 1)[1].isdigit()]
-    return max(steps) if steps else None
+    candidates = sorted(
+        (int(e.split("_", 1)[1]) for e in entries
+         if e.startswith("step_") and e.split("_", 1)[1].isdigit()),
+        reverse=True,
+    )
+    for step in candidates:
+        if _is_finalized(os.path.join(ckpt_dir, f"step_{step}")):
+            return step
+    return None
 
 
 def restore_train_state(ckpt_dir: str, step: int, target: Any) -> Any:

@@ -35,9 +35,14 @@ def revary(x, axis_name):
     support both so a jax upgrade doesn't break the shard bodies."""
     import jax
 
+    names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name if isinstance(axis_name, tuple) else (axis_name,))
+        # One axis per call: tolerant of a pcast API that takes a single
+        # axis name (the dp×sp path passes ('sp', 'data')).
+        for name in names:
+            x = jax.lax.pcast(x, name, to="varying")
+        return x
+    return jax.lax.pvary(x, names)
 
 
 def build_mesh(devices: Sequence, dp: int, tp: int, *, axis_names: Tuple[str, str] = ("data", "model")):

@@ -161,10 +161,18 @@ class DeviceState:
         self._store = CheckpointStore(
             plugin_dir, Flock, read_boot_id(), on_discard=on_discard
         )
-        # Startup reconcile: sharing records are persisted *before* the
-        # claim's checkpoint entry, so a crash in between leaves orphans
-        # that would poison capacity sums and mode-conflict checks forever.
-        dropped = self.sharing.reconcile(self._store.get().claims)
+        # Startup reconcile: a crash inside _prepare_devices leaves sharing
+        # records whose claim never reached PREPARE_COMPLETED; they would
+        # poison capacity sums and mode-conflict checks forever. Under the
+        # node-global pu flock no prepare is in flight in any process, so
+        # every non-COMPLETED entry's records are provably orphans (a live
+        # overlapping old plugin mid-prepare would hold the lock).
+        with Flock(os.path.join(plugin_dir, "pu.lock")).hold(timeout=10):
+            completed = {
+                uid for uid, e in self._store.get().claims.items()
+                if e.state == PREPARE_COMPLETED
+            }
+            dropped = self.sharing.reconcile(completed)
         if dropped:
             log.warning("dropped %d orphaned sharing record(s) at startup", dropped)
 

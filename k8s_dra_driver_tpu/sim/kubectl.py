@@ -254,6 +254,13 @@ def main(argv=None) -> int:
 
     kind = _resolve_kind(args.kind)
     if args.cmd == "get":
+        if args.name and getattr(args, "all_namespaces", False):
+            # kubectl refuses this combination too: a name lookup is
+            # namespace-scoped, so -A would silently mean "default".
+            raise SystemExit(
+                "error: a resource cannot be retrieved by name across all "
+                "namespaces (drop -A or add -n <namespace>)"
+            )
         if args.name:
             objs = [api.get(kind, args.name, _default_namespace(kind, args.namespace or ""))]
         else:

@@ -223,5 +223,11 @@ def test_kubectl_cli_verbs(remote):
     assert kubectl(base + ["annotate", "pod", "p0", "team=a", "old-"]) == 0
     assert api.get("Pod", "p0", "default").meta.annotations["team"] == "a"
     assert kubectl(base + ["get", "pods"]) == 0
+    # kubectl semantics: a name + --all-namespaces is a hard error, not a
+    # silent default-namespace lookup.
+    import pytest
+
+    with pytest.raises(SystemExit, match="by name across all namespaces"):
+        kubectl(base + ["get", "pod", "p0", "-A"])
     assert kubectl(base + ["delete", "pod", "p0"]) == 0
     assert api.try_get("Pod", "p0", "default") is None

@@ -108,6 +108,10 @@ def test_checkpoint_elastic_resume_across_mesh_shapes(cpu_devices, tmp_path):
     assert latest_step(str(tmp_path)) is None
     save_train_state(str(tmp_path), 2, state4)
     assert latest_step(str(tmp_path)) == 2
+    # A crash between mkdir and content leaves an empty step dir; the name
+    # pattern alone must not surface it as "latest".
+    (tmp_path / "step_9").mkdir()
+    assert latest_step(str(tmp_path)) == 2
 
     step8, target8, batch8 = make_sharded_train_step(cfg, cpu_devices[:8])
     restored = restore_train_state(str(tmp_path), 2, target8)

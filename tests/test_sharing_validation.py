@@ -218,10 +218,25 @@ def test_startup_reconciles_orphan_sharing_records(tmp_path, monkeypatch):
         "orphan-uid", [0], MpsLikePremappedConfig(default_premapped_hbm_bytes=10 * GIB)
     )
 
+    # Also leave a stale PrepareStarted entry carrying records: a crash
+    # inside _prepare_devices checkpoints STARTED first, then writes
+    # sharing — reconcile must treat non-COMPLETED entries as orphans too.
+    cp = first._store.get()
+    from k8s_dra_driver_tpu.plugins.checkpoint import PreparedClaim
+    cp.claims["started-uid"] = PreparedClaim(
+        claim_uid="started-uid", namespace="default", name="half",
+        state="PrepareStarted",
+    )
+    first._save_checkpoint(cp)
+    first.sharing.set_premapped(
+        "started-uid", [1], MpsLikePremappedConfig(default_premapped_hbm_bytes=2 * GIB)
+    )
+
     restarted = DeviceState(MockTpuLib("v5e-4"), plugin_dir,
                             cdi_root=str(tmp_path / "cdi"), gates=gates)
     recs = restarted.sharing.records_for([0])
     assert [r["bytes"] for r in recs] == [4 * GIB]  # orphan gone, live kept
+    assert restarted.sharing.records_for([1]) == []  # STARTED records dropped
     # The freed capacity is usable again: 12 GiB fits alongside the live 4
     # (4 + 10 + 12 would have exceeded the 16 GiB chip).
     restarted.sharing.set_premapped(
