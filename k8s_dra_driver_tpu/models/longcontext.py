@@ -42,40 +42,43 @@ from k8s_dra_driver_tpu.parallel.ring_attention import ring_attention
 Params = Dict[str, Any]
 
 
-def _pin_seq(x: jax.Array, seq_axis: str) -> jax.Array:
-    spec = P(None, seq_axis) if x.ndim == 2 else P(None, seq_axis, *([None] * (x.ndim - 2)))
+def _pin_seq(x: jax.Array, seq_axis: str, batch_axis=None) -> jax.Array:
+    spec = (P(batch_axis, seq_axis) if x.ndim == 2
+            else P(batch_axis, seq_axis, *([None] * (x.ndim - 2))))
     return jax.lax.with_sharding_constraint(x, spec)
 
 
 def _block(cfg: SliceProofConfig, p: Params, x: jax.Array,
-           mesh: Mesh, seq_axis: str) -> jax.Array:
+           mesh: Mesh, seq_axis: str, batch_axis=None) -> jax.Array:
     h = _rmsnorm(x, p["ln1"])
     qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
-    q = _pin_seq(qkv[0], seq_axis)
-    k = _pin_seq(qkv[1], seq_axis)
-    v = _pin_seq(qkv[2], seq_axis)
-    attn = ring_attention(q, k, v, mesh, seq_axis=seq_axis, causal=True)
+    q = _pin_seq(qkv[0], seq_axis, batch_axis)
+    k = _pin_seq(qkv[1], seq_axis, batch_axis)
+    v = _pin_seq(qkv[2], seq_axis, batch_axis)
+    attn = ring_attention(q, k, v, mesh, seq_axis=seq_axis, causal=True,
+                          batch_axis=batch_axis)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
 
     h = _rmsnorm(x, p["ln2"])
     ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
-    ff = _pin_seq(ff, seq_axis)
+    ff = _pin_seq(ff, seq_axis, batch_axis)
     return x + jnp.einsum("bsf,fd->bsd", ff, p["w2"].astype(jnp.bfloat16))
 
 
 def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array,
-            mesh: Mesh, seq_axis: str = "sp") -> jax.Array:
-    x = _pin_seq(params["embed"].astype(jnp.bfloat16)[tokens], seq_axis)
+            mesh: Mesh, seq_axis: str = "sp", batch_axis=None) -> jax.Array:
+    x = _pin_seq(params["embed"].astype(jnp.bfloat16)[tokens], seq_axis, batch_axis)
     for p in params["layers"]:
-        x = _block(cfg, p, x, mesh, seq_axis)
+        x = _block(cfg, p, x, mesh, seq_axis, batch_axis)
     return jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)
     ).astype(jnp.float32)
 
 
-def loss_fn(cfg, params, batch, mesh, seq_axis: str = "sp"):
-    return nll_loss(forward(cfg, params, batch["tokens"], mesh, seq_axis),
-                    batch["tokens"])
+def loss_fn(cfg, params, batch, mesh, seq_axis: str = "sp", batch_axis=None):
+    return nll_loss(
+        forward(cfg, params, batch["tokens"], mesh, seq_axis, batch_axis),
+        batch["tokens"])
 
 
 def make_longcontext_train_step(
@@ -85,25 +88,45 @@ def make_longcontext_train_step(
     batch_size: int = 1,
     seed: int = 0,
     seq_axis: str = "sp",
+    data_parallel: int = 1,
 ):
     """Build (jitted_step, sharded_state, sharded_batch) with the sequence
-    sharded over every device. cfg.seq_len must divide by the device count."""
+    sharded over the sp axis. ``data_parallel`` > 1 composes dp×sp: the
+    batch dimension shards over a data axis whose replicas each run their
+    own attention ring over ``len(devices)/data_parallel`` devices.
+    cfg.seq_len must divide by the ring size."""
     n = len(devices)
-    if cfg.seq_len % n:
-        raise ValueError(f"seq_len ({cfg.seq_len}) must divide by device count ({n})")
+    if n % data_parallel:
+        raise ValueError(f"device count ({n}) must divide by data_parallel "
+                         f"({data_parallel})")
+    ring = n // data_parallel
+    if cfg.seq_len % ring:
+        raise ValueError(f"seq_len ({cfg.seq_len}) must divide by ring size ({ring})")
     if cfg.attention != "einsum":
         raise ValueError("long-context training uses ring attention; "
                          "cfg.attention must stay 'einsum' (the default)")
-    mesh = Mesh(np.array(devices), (seq_axis,))
+    if data_parallel > 1:
+        # sp innermost: ring hops stay on neighbor ICI links; the gradient
+        # allreduce crosses the outer data axis.
+        mesh = Mesh(np.array(devices).reshape(data_parallel, ring),
+                    ("data", seq_axis))
+        batch_axis = "data"
+        batch_size = batch_size * data_parallel
+        batch_spec = P("data", seq_axis)
+    else:
+        mesh = Mesh(np.array(devices), (seq_axis,))
+        batch_axis = None
+        batch_spec = P(None, seq_axis)
     pspecs = jax.tree.map(lambda _: P(), init_params(cfg, seed=seed))
     state = make_sharded_state(init_params(cfg, seed=seed), pspecs, mesh)
     batch = make_token_batch(seed, batch_size, cfg.seq_len, cfg.vocab,
-                             mesh, P(None, seq_axis))
+                             mesh, batch_spec)
 
     def train_step(state, batch):
         params, mom = state["params"], state["momentum"]
         loss, grads = jax.value_and_grad(partial(
-            loss_fn, cfg, seq_axis=seq_axis), argnums=0)(params, batch, mesh)
+            loss_fn, cfg, seq_axis=seq_axis, batch_axis=batch_axis,
+        ), argnums=0)(params, batch, mesh)
         new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
         return {"params": new_params, "momentum": new_mom}, loss
 

@@ -123,7 +123,8 @@ def _aux_loss(logits2d: jax.Array, n_experts: int) -> jax.Array:
     return n_experts * jnp.sum(frac * mass)
 
 
-def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh):
+def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh,
+            batch_axis=None):
     """tokens [b, s] -> (logits [b, s, vocab] f32, aux_loss scalar)."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     b, s, d = x.shape
@@ -139,6 +140,7 @@ def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh):
                 p["moe"], flat, mesh,
                 capacity_factor=cfg.capacity_factor,
                 router_logits=logits,
+                batch_axis=batch_axis,
             ).reshape(b, s, d).astype(x.dtype)
         else:
             ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16)))
@@ -147,8 +149,9 @@ def forward(cfg: MoEConfig, params: Params, tokens: jax.Array, mesh: Mesh):
     return logits.astype(jnp.float32), aux
 
 
-def loss_fn(cfg: MoEConfig, params: Params, batch: Dict[str, jax.Array], mesh: Mesh):
-    logits, aux = forward(cfg, params, batch["tokens"], mesh)
+def loss_fn(cfg: MoEConfig, params: Params, batch: Dict[str, jax.Array],
+            mesh: Mesh, batch_axis=None):
+    logits, aux = forward(cfg, params, batch["tokens"], mesh, batch_axis=batch_axis)
     return nll_loss(logits, batch["tokens"]) + cfg.aux_loss_coef * aux
 
 
@@ -159,22 +162,40 @@ def make_moe_train_step(
     batch_per_replica: int = 2,
     seed: int = 0,
     expert_axis: str = "ep",
+    data_parallel: int = 1,
 ):
-    """Build (jitted_step, sharded_state, sharded_batch) over a 1-D ep mesh
-    carrying both data parallelism and expert placement."""
+    """Build (jitted_step, sharded_state, sharded_batch). The 1-D ep mesh
+    carries both data parallelism and expert placement; ``data_parallel``
+    > 1 composes an explicit dp×ep mesh instead — experts replicate over
+    the data axis (n_experts × data_parallel == device count) and every
+    data replica dispatches among its own ep peers."""
     n = len(devices)
-    if cfg.n_experts != n:
-        raise ValueError(f"n_experts ({cfg.n_experts}) must equal device count ({n})")
-    mesh = Mesh(np.array(devices), (expert_axis,))
+    if cfg.n_experts * data_parallel != n:
+        raise ValueError(
+            f"n_experts*data_parallel ({cfg.n_experts}*{data_parallel}) "
+            f"must equal device count ({n})"
+        )
+    if data_parallel > 1:
+        # ep innermost: the a2a dispatch rides neighbor ICI links; the
+        # expert-grad allreduce crosses the outer data axis.
+        mesh = Mesh(np.array(devices).reshape(data_parallel, cfg.n_experts),
+                    ("data", expert_axis))
+        batch_axis = "data"
+        batch_spec = P(("data", expert_axis), None)
+    else:
+        mesh = Mesh(np.array(devices), (expert_axis,))
+        batch_axis = None
+        batch_spec = P(expert_axis, None)
     state = make_sharded_state(
         init_params(cfg, seed=seed), param_pspecs(cfg, expert_axis), mesh)
     batch = make_token_batch(seed, n * batch_per_replica, cfg.seq_len,
-                             cfg.vocab, mesh, P(expert_axis, None))
+                             cfg.vocab, mesh, batch_spec)
 
     def train_step(state, batch):
         params, mom = state["params"], state["momentum"]
         loss, grads = jax.value_and_grad(
-            partial(loss_fn, cfg), argnums=0)(params, batch, mesh)
+            partial(loss_fn, cfg), argnums=0)(
+                params, batch, mesh, batch_axis)
         new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
         return {"params": new_params, "momentum": new_mom}, loss
 

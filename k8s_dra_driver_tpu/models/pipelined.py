@@ -61,20 +61,21 @@ def param_pspecs(cfg: SliceProofConfig, pipe_axis: str = "pp") -> Params:
 
 
 def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array,
-            mesh: Mesh, *, num_microbatches: int) -> jax.Array:
+            mesh: Mesh, *, num_microbatches: int,
+            batch_axis: Optional[str] = None) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = pipeline_apply(
         partial(_stage_fn, cfg), params["stages"], x, mesh,
-        num_microbatches=num_microbatches,
+        num_microbatches=num_microbatches, batch_axis=batch_axis,
     )
     return jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)
     ).astype(jnp.float32)
 
 
-def loss_fn(cfg, params, batch, mesh, *, num_microbatches):
+def loss_fn(cfg, params, batch, mesh, *, num_microbatches, batch_axis=None):
     logits = forward(cfg, params, batch["tokens"], mesh,
-                     num_microbatches=num_microbatches)
+                     num_microbatches=num_microbatches, batch_axis=batch_axis)
     return nll_loss(logits, batch["tokens"])
 
 
@@ -86,14 +87,19 @@ def make_pipelined_train_step(
     num_microbatches: Optional[int] = None,
     seed: int = 0,
     pipe_axis: str = "pp",
+    data_parallel: int = 1,
 ):
     """Build (jitted_step, sharded_state, sharded_batch) with one block per
-    device. cfg.n_layers must equal the device count."""
+    pipeline stage. With ``data_parallel`` > 1 the mesh composes dp×pp:
+    cfg.n_layers stages each hold their block, replicated over the data
+    axis, and every data replica pipelines its own shard of each
+    microbatch (XLA inserts the stage-grad allreduce over data).
+    cfg.n_layers * data_parallel must equal the device count."""
     n = len(devices)
-    if cfg.n_layers != n:
+    if cfg.n_layers * data_parallel != n:
         raise ValueError(
-            f"n_layers ({cfg.n_layers}) must equal device count ({n}) — "
-            f"one block per pipeline stage"
+            f"n_layers*data_parallel ({cfg.n_layers}*{data_parallel}) must "
+            f"equal device count ({n}) — one block per pipeline stage"
         )
     if cfg.attention != "einsum":
         raise ValueError(
@@ -101,9 +107,20 @@ def make_pipelined_train_step(
             f"{cfg.attention!r} (the flash kernel's tp pins have no axes "
             f"on a pp-only mesh)"
         )
+    stages = cfg.n_layers
     if num_microbatches is None:
-        num_microbatches = n  # enough to keep every stage busy
-    mesh = Mesh(np.array(devices), (pipe_axis,))
+        num_microbatches = stages  # enough to keep every stage busy
+    if data_parallel > 1:
+        # pp innermost: stage hops ride neighbor ICI links; the per-stage
+        # gradient allreduce over data crosses the outer axis.
+        mesh = Mesh(np.array(devices).reshape(data_parallel, stages),
+                    ("data", pipe_axis))
+        batch_axis: Optional[str] = "data"
+        batch_spec = P("data")
+    else:
+        mesh = Mesh(np.array(devices), (pipe_axis,))
+        batch_axis = None
+        batch_spec = P()  # batch replicated; microbatching splits it
 
     flat = init_params(cfg, seed=seed)
     params = {
@@ -113,14 +130,15 @@ def make_pipelined_train_step(
     }
     state = make_sharded_state(params, param_pspecs(cfg, pipe_axis), mesh)
     batch = make_token_batch(
-        seed, num_microbatches * batch_per_microbatch, cfg.seq_len,
-        cfg.vocab, mesh, P(),  # batch replicated; microbatching splits it
+        seed, num_microbatches * batch_per_microbatch * data_parallel,
+        cfg.seq_len, cfg.vocab, mesh, batch_spec,
     )
 
     def train_step(state, batch):
         params, mom = state["params"], state["momentum"]
         loss, grads = jax.value_and_grad(partial(
             loss_fn, cfg, num_microbatches=num_microbatches,
+            batch_axis=batch_axis,
         ), argnums=0)(params, batch, mesh)
         new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
         return {"params": new_params, "momentum": new_mom}, loss

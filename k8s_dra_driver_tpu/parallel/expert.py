@@ -82,12 +82,17 @@ def moe_ffn(
     expert_axis: str = "ep",
     capacity_factor: float = 1.25,
     router_logits: jax.Array = None,
+    batch_axis: str = None,
 ) -> jax.Array:
     """Switch-MoE feed-forward over expert-parallel devices.
 
     params: init_moe_params output; expert-stacked leaves are sharded one
-    expert per device along ``expert_axis`` (n_experts == axis size).
-    x: [tokens, d_model] global, token-sharded along the same axis.
+    expert per device along ``expert_axis`` (n_experts == axis size) and
+    replicated over ``batch_axis`` when given.
+    x: [tokens, d_model] global, token-sharded along the expert axis (and
+    the batch axis when composing dp×ep: each data replica then runs its
+    own a2a dispatch among its ep peers, and XLA inserts the expert-grad
+    allreduce over data).
     router_logits: optional precomputed [tokens, n_experts] (callers that
     also need them — e.g. for an aux loss — avoid a second router matmul;
     XLA cannot CSE across the shard_map boundary).
@@ -104,14 +109,16 @@ def moe_ffn(
             f"n_experts ({params['w1'].shape[0]}) must equal the "
             f"'{expert_axis}' axis size ({n}) — one expert per device"
         )
+    shards = n * (mesh.shape[batch_axis] if batch_axis else 1)
     tokens = x.shape[0]
-    if tokens % n:
-        raise ValueError(f"tokens ({tokens}) not divisible by axis size {n}")
-    local_tokens = tokens // n
+    if tokens % shards:
+        raise ValueError(f"tokens ({tokens}) not divisible by {shards} token shards")
+    local_tokens = tokens // shards
     capacity = max(1, math.ceil(local_tokens / n * capacity_factor))
 
     if router_logits is None:
         router_logits = x @ params["router"]
+    token_spec = P((batch_axis, expert_axis)) if batch_axis else P(expert_axis)
     body = partial(_moe_shard, axis_name=expert_axis, capacity=capacity)
     # Only the expert weights enter the shard body — routing already
     # happened outside, so the router stays out of the exchange.
@@ -119,10 +126,10 @@ def moe_ffn(
         body, mesh=mesh,
         in_specs=(
             {"w1": P(expert_axis), "w2": P(expert_axis)},
-            P(expert_axis),
-            P(expert_axis),
+            token_spec,
+            token_spec,
         ),
-        out_specs=P(expert_axis),
+        out_specs=token_spec,
     )
     return fn({"w1": params["w1"], "w2": params["w2"]}, x, router_logits)
 

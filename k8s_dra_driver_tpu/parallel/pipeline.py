@@ -23,11 +23,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _pipeline_shard(params, x_mb, *, stage_fn, axis_name: str):
+def _pipeline_shard(params, x_mb, *, stage_fn, axis_name: str,
+                    carry_vary=()):
     """Per-device body under shard_map.
 
     params: this stage's params with a leading [1] stage axis.
-    x_mb:   [M, mb, ...] microbatches, replicated along the pipe axis.
+    x_mb:   [M, mb, ...] microbatches (mb possibly sharded over a batch
+    axis; replicated along the pipe axis).
+    carry_vary: extra mesh axes the scan carry varies over — the batch
+    axis when the mesh composes dp×pp (the carry must match y, which
+    varies over every axis its inputs do).
     Returns [M, mb, ...] final-stage outputs, valid on every device
     (broadcast from the last stage).
     """
@@ -49,7 +54,8 @@ def _pipeline_shard(params, x_mb, *, stage_fn, axis_name: str):
         y = stage_fn(params_local, x_in)
         return jax.lax.ppermute(y, axis_name, perm), y
 
-    act0 = revary(jnp.zeros(mb_shape, x_mb.dtype), axis_name)
+    act0 = revary(jnp.zeros(mb_shape, x_mb.dtype),
+                  (axis_name,) + tuple(carry_vary))
     _, ys = jax.lax.scan(tick, act0, jnp.arange(m + s - 1))
     # On the last stage, ys[t] for t in [s-1, m+s-1) are the outputs of
     # microbatches 0..m-1. Select them, zero elsewhere, and broadcast to
@@ -68,14 +74,20 @@ def pipeline_apply(
     *,
     num_microbatches: int,
     pipe_axis: str = "pp",
+    batch_axis: str = None,
 ):
     """Run ``y = stage_S-1(... stage_1(stage_0(x)))`` as a pipeline.
 
     stage_fn(params, x) -> y must preserve x's shape (uniform stages).
     stacked_params: pytree whose leaves have a leading stage axis of size
     equal to the ``pipe_axis`` mesh size (sharded one stage per device).
-    x: [B, ...] global batch; B divisible by num_microbatches.
-    Returns [B, ...] outputs, replicated along the pipe axis.
+    x: [B, ...] global batch; B divisible by num_microbatches (and, with a
+    batch_axis, each microbatch by that axis's size).
+    batch_axis: optional data-parallel mesh axis: microbatch rows shard
+    over it and each data replica runs its own pipeline (dp×pp); params
+    stay replicated over it so XLA inserts the gradient allreduce.
+    Returns [B, ...] outputs, replicated along the pipe axis and sharded
+    over the batch axis.
     """
     from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
 
@@ -91,14 +103,24 @@ def pipeline_apply(
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
-    x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    mb = b // num_microbatches
+    if batch_axis is not None and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch rows ({mb}) not divisible by '{batch_axis}' axis "
+            f"size ({mesh.shape[batch_axis]})"
+        )
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
-    body = partial(_pipeline_shard, stage_fn=stage_fn, axis_name=pipe_axis)
+    data_spec = P(None, batch_axis) if batch_axis else P()
+    body = partial(
+        _pipeline_shard, stage_fn=stage_fn, axis_name=pipe_axis,
+        carry_vary=(batch_axis,) if batch_axis else (),
+    )
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(param_specs, P()),   # params stage-sharded; batch replicated
-        out_specs=P(),
+        in_specs=(param_specs, data_spec),  # params stage-sharded
+        out_specs=data_spec,
     )
     out_mb = fn(stacked_params, x_mb)
     return out_mb.reshape(b, *x.shape[1:])
