@@ -1,6 +1,11 @@
 # Build/test entry points (reference Makefile analog).
 
-.PHONY: all native test e2e bench ci clean
+include versions.mk
+
+.PHONY: all native test e2e bench ci clean version
+
+version:
+	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
 
 # The full CI gate, exactly as .github/workflows declares it (add
 # RUN_KIND=1 for the kind mock-cluster tier).

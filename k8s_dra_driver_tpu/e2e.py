@@ -167,6 +167,30 @@ def check_cd_multi(sim: SimCluster, _pods) -> None:
         _expect(p.injected_env.get("TPU_TOPOLOGY") == "4x4", "slice topology")
 
 
+def check_allreduce_job(sim: SimCluster, _pods) -> None:
+    """The nvbandwidth-analog proof job: every indexed worker must land on
+    its own host with the full env allreduce_bench needs to bootstrap
+    jax.distributed over the assembled slice."""
+    pods = sorted(_running_pods(sim, "allreduce"), key=lambda p: p.meta.name)
+    _expect(len(pods) == 4, f"want 4 indexed workers, got {len(pods)}")
+    _expect({p.node_name for p in pods} == {f"tpu-node-{i}" for i in range(4)},
+            "workers must spread over all 4 hosts")
+    ids = sorted(int(p.injected_env["TPU_WORKER_ID"]) for p in pods)
+    _expect(ids == [0, 1, 2, 3], f"worker ids {ids}")
+    for p in pods:
+        cmd = p.containers[0].command
+        _expect("k8s_dra_driver_tpu.ops.allreduce_bench" in cmd,
+                f"job must run the allreduce proof, got {cmd}")
+        _expect(p.containers[0].env.get("JOB_COMPLETION_INDEX", "").isdigit(),
+                "indexed-job completion index missing")
+        env = p.injected_env
+        for key in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
+                    "TPU_TOPOLOGY", "TPU_VISIBLE_CHIPS"):
+            _expect(bool(env.get(key)), f"{p.meta.name}: missing {key}")
+        _expect(len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4,
+                "hostnames must list all 4 workers")
+
+
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s
     for s in (
@@ -186,6 +210,8 @@ SCENARIOS: Dict[str, Scenario] = {
                  profile="v5e-4", check=check_cd_single),
         Scenario("cd-multi-host", "computedomain/cd-multi-host.yaml",
                  check=check_cd_multi),
+        Scenario("allreduce-job", "computedomain/allreduce-job.yaml",
+                 check=check_allreduce_job),
     )
 }
 

@@ -1,0 +1,18 @@
+# Release/version variables shared by the Makefile, image build, and Helm
+# packaging (the reference's versions.mk analog,
+# /root/reference/versions.mk).
+
+DRIVER_NAME := tpu-dra-driver
+MODULE := k8s_dra_driver_tpu
+
+REGISTRY ?= localhost:5000/tpu-dra
+
+# Driver release semver: single line in the repository root VERSION file
+# (a change to it is what triggers a release, RELEASE.md).
+VERSION ?= $(shell tr -d '[:space:]' < $(CURDIR)/VERSION)
+
+# VERSION carries a v prefix; Helm chart versions must not.
+VERSION_NO_V := $(patsubst v%,%,$(VERSION))
+
+IMAGE := $(REGISTRY)/$(DRIVER_NAME):$(VERSION)
+CHART := deployments/helm/tpu-dra-driver
