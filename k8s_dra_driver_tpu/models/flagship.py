@@ -55,6 +55,10 @@ class SliceProofConfig:
     # tests). "flash": the Pallas TPU flash-attention kernel — O(s) memory,
     # never materializes the [b,h,s,s] score matrix in HBM.
     attention: str = "einsum"
+    # Rematerialize each block on the backward pass (jax.checkpoint):
+    # trades ~+1/3 of the forward FLOPs for O(L)→O(1) activation memory,
+    # buying batch (better MXU amortization) when HBM binds.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -67,15 +71,18 @@ class SliceProofConfig:
 
     @classmethod
     def bench(cls) -> "SliceProofConfig":
-        """MXU-sized single-chip benchmark config (~400M matmul params):
+        """MXU-sized single-chip benchmark config (~690M matmul params):
         large, bf16, static — dims multiples of 128 so XLA tiles cleanly
-        onto the systolic array; d_model 2048 measured 54% MFU on v5e vs
-        32% at 1024 (bigger matmuls amortize weight loads better).
-        XLA's fused einsum attention beats the Pallas flash kernel at this
-        seq_len (35% vs 23% MFU at d=1024), so einsum stays the default;
-        attention="flash" is the long-sequence escape hatch."""
+        onto the systolic array. Shape chosen by the measured r4 sweep
+        (ops/mfu_sweep.py; table in docs/benchmarks.md): d_model 2048 with
+        a ratio-8 FFN (d_ff 16384) hits 65.4% MFU on v5e vs 54% at ratio 4
+        and 32% at d_model 1024 — the [2048×16384] GEMMs amortize weight
+        loads best. XLA's fused einsum attention beats the Pallas flash
+        kernel at this seq_len, so einsum stays the default;
+        attention="flash" is the long-sequence escape hatch and
+        remat=True the HBM escape hatch (both cost reported MFU)."""
         return cls(vocab=8192, d_model=2048, n_heads=16, n_layers=8,
-                   d_ff=8192, seq_len=1024)
+                   d_ff=16384, seq_len=1024)
 
 
 def matmul_param_count(cfg: SliceProofConfig) -> int:
@@ -177,8 +184,11 @@ def _block(cfg: SliceProofConfig, p: Params, x: jax.Array) -> jax.Array:
 def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array) -> jax.Array:
     """tokens [b, s] int32 -> logits [b, s, vocab] float32."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
     for p in params["layers"]:
-        x = _block(cfg, p, x)
+        x = block(p, x)
     return jnp.einsum("bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)).astype(
         jnp.float32
     )

@@ -1,0 +1,121 @@
+"""Single-chip MFU sweep for the flagship train step.
+
+Drives the same measurement as bench.py's flagship leg over a grid of
+shapes (d_model, d_ff, seq_len, batch, attention, remat) to find — or
+bound — the best achievable MFU on the attached chip. Prints one JSON
+line per config plus a final "best" line; docs/benchmarks.md records the
+outcome (the roofline/sweep evidence the benchmark config cites).
+
+Usage:
+    python -m k8s_dra_driver_tpu.ops.mfu_sweep            # default grid
+    python -m k8s_dra_driver_tpu.ops.mfu_sweep --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+
+def measure_step(cfg, batch_per_replica: int, iters: int) -> dict:
+    """Marginal step time + MFU for one config (same two-loop-size
+    subtraction as bench.py so the tunnel round-trip cancels)."""
+    import jax
+
+    from k8s_dra_driver_tpu.models.flagship import (
+        make_sharded_train_step,
+        matmul_param_count,
+    )
+
+    devices = jax.devices()
+    step, state, batch = make_sharded_train_step(
+        cfg, devices, batch_per_replica=batch_per_replica
+    )
+    state, loss = step(state, batch)
+    float(loss)  # compile + sync (block_until_ready lies over the tunnel)
+
+    def run(n: int) -> float:
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = step(state, batch)
+        float(loss)
+        return time.perf_counter() - t0
+
+    iters = max(iters, 4)  # the subtraction below needs iters > n1
+    n1 = max(1, iters // 4)
+    t1 = min(run(n1) for _ in range(2))
+    t2 = min(run(iters) for _ in range(2))
+    noise_limited = t2 <= t1
+    dt = t2 / iters if noise_limited else (t2 - t1) / (iters - n1)
+    tokens = batch["tokens"].size
+    flops = 6 * matmul_param_count(cfg) * tokens
+    from bench import PEAK_BF16_FLOPS  # single source for peak numbers
+
+    peak = PEAK_BF16_FLOPS.get(getattr(devices[0], "device_kind", ""), 0)
+    out = {
+        "d_model": cfg.d_model, "d_ff": cfg.d_ff, "n_layers": cfg.n_layers,
+        "seq_len": cfg.seq_len, "batch": tokens // cfg.seq_len,
+        "attention": cfg.attention, "remat": cfg.remat,
+        "step_ms": round(dt * 1e3, 2),
+        "tokens_per_s": round(tokens / dt, 1),
+        "noise_limited": noise_limited,
+    }
+    if peak:
+        out["mfu_pct"] = round(100 * flops / dt / (peak * len(devices)), 1)
+    return out
+
+
+def default_grid(base) -> list:
+    """(cfg, batch_per_replica) pairs: batch/remat/seq/attention/width axes."""
+    r = dataclasses.replace
+    return [
+        (base, 4),                                        # bench.py today
+        (base, 8),                                        # amortize weights
+        (r(base, remat=True), 8),                         # remat buys batch
+        (r(base, remat=True), 16),
+        (r(base, seq_len=2048), 4),                       # longer sequence
+        (r(base, seq_len=2048, attention="flash"), 4),    # flash at 2k
+        (r(base, seq_len=2048, attention="flash", remat=True), 8),
+        (r(base, d_ff=16384), 4),                         # fatter FFN (ratio 8)
+        (r(base, d_ff=16384), 8),
+        (r(base, d_model=3072, d_ff=12288, n_heads=24), 4),   # wider model
+        (r(base, d_model=3072, d_ff=12288, n_heads=24), 8),
+    ]
+
+
+def main() -> None:
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=16)
+    args = parser.parse_args()
+
+    from k8s_dra_driver_tpu.models.flagship import SliceProofConfig
+
+    results = []
+    for cfg, bpr in default_grid(SliceProofConfig.bench()):
+        try:
+            r = measure_step(cfg, bpr, args.iters)
+        except Exception as e:  # noqa: BLE001 — OOM/compile fail is data too
+            r = {
+                "d_model": cfg.d_model, "d_ff": cfg.d_ff,
+                "seq_len": cfg.seq_len, "batch": "-",
+                "attention": cfg.attention, "remat": cfg.remat,
+                "error": str(e)[:160],
+            }
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    scored = [r for r in results if "mfu_pct" in r]
+    if scored:
+        best = max(scored, key=lambda r: r["mfu_pct"])
+        print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

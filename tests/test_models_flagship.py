@@ -163,6 +163,31 @@ def test_pipelined_flagship_matches_unpipelined(cpu_devices):
         pipelined.make_pipelined_train_step(SliceProofConfig.tiny(), cpu_devices[:4])
 
 
+def test_remat_matches_plain_forward_and_grads(cpu_devices):
+    """cfg.remat wraps each block in jax.checkpoint: same math, recomputed
+    on the backward pass. Loss and grads must match the plain path within
+    the repo's bf16 tolerance."""
+    import dataclasses
+
+    from k8s_dra_driver_tpu.models.flagship import init_params, loss_fn
+
+    cfg = SliceProofConfig.tiny()
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    params = init_params(cfg, seed=5)
+    tokens = {"tokens": jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab, size=(2, cfg.seq_len)),
+        dtype=jnp.int32)}
+    loss_p, grads_p = jax.value_and_grad(lambda p: loss_fn(cfg, p, tokens))(params)
+    loss_r, grads_r = jax.value_and_grad(lambda p: loss_fn(cfg_r, p, tokens))(params)
+    np.testing.assert_allclose(float(loss_r), float(loss_p), rtol=1e-3)
+    flat_p = jax.tree.leaves(grads_p)
+    flat_r = jax.tree.leaves(grads_r)
+    for a, b in zip(flat_p, flat_r):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = np.maximum(np.abs(a).max(), 1e-6)
+        np.testing.assert_allclose(b / denom, a / denom, atol=2e-2)
+
+
 def test_dp_pp_composition_matches_unpipelined(cpu_devices):
     """dp×pp: two data replicas each pipelining four stages on the 8-device
     mesh. Forward still equals the flat flagship, and training learns with
