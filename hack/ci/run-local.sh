@@ -10,7 +10,7 @@
 set -euo pipefail
 HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
-DEFAULT_STEPS=(native unit-tests sim-e2e shell-e2e helm-render)
+DEFAULT_STEPS=(basic-checks native unit-tests sim-e2e shell-e2e helm-render)
 if [ "${RUN_KIND:-0}" = "1" ]; then
   DEFAULT_STEPS+=(kind-mock-e2e)
 fi

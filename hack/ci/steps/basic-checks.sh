@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# CI step: fast hygiene — Python byte-compiles, shell parses, YAML loads,
+# VERSION is a valid semver. No test execution; see unit-tests.sh for
+# that (and native.sh for the cmake configure/build).
+#
+#   basic-checks.sh            # everything
+#   basic-checks.sh version    # just the VERSION semver check (used by
+#                              # the release-automation workflow so the
+#                              # regex lives in exactly one place)
+set -euo pipefail
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
+cd "${REPO}"
+
+check_version() {
+  grep -Eq '^v[0-9]+\.[0-9]+\.[0-9]+(-[0-9A-Za-z.-]+)?$' VERSION \
+    || { echo "VERSION '$(cat VERSION)' is not vX.Y.Z[-suffix]"; exit 1; }
+}
+
+if [ "${1:-}" = "version" ]; then
+  check_version
+  echo "OK: VERSION format"
+  exit 0
+fi
+
+echo "-- python compiles"
+"${PYTHON:-python}" -m compileall -q k8s_dra_driver_tpu tests bench.py __graft_entry__.py
+
+echo "-- shell parses"
+find tests/shell hack demo/clusters -name '*.sh' -print0 \
+  | xargs -0 -n1 bash -n
+
+echo "-- yaml loads"
+"${PYTHON:-python}" - <<'EOF'
+import glob
+import sys
+
+import yaml
+
+paths = (glob.glob("demo/specs/**/*.yaml", recursive=True)
+         + glob.glob(".github/workflows/*.yaml")
+         + glob.glob("deployments/helm/*/crds/*.yaml"))
+assert paths, "no YAML found — glob roots moved?"
+for p in paths:
+    with open(p, encoding="utf-8") as f:
+        list(yaml.safe_load_all(f))
+print(f"   {len(paths)} files ok")
+EOF
+
+echo "-- VERSION is semver"
+check_version
+
+echo "OK: basic checks"
