@@ -285,7 +285,13 @@ def main(argv=None) -> int:
     api = RemoteAPIServer(args.server)
 
     if args.cmd == "apply":
-        for obj in apply_file(api, args.filename):
+        if args.filename == "-":  # kubectl semantics: manifests on stdin
+            import sys as _sys
+
+            created = [api.create(o) for o in load_manifests(_sys.stdin.read())]
+        else:
+            created = apply_file(api, args.filename)
+        for obj in created:
             print(f"{obj.kind.lower()}/{obj.meta.name} created")
         return 0
 

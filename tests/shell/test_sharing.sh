@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
 # Sharing shell e2e (reference tests/bats/test_gpu_sharing.bats analog):
 # two pods share one chip through a shared claim with a TimeSlicing config;
-# both must run on the same chip with the time-slice env injected.
+# both must run on the same chip with the time-slice env injected. A second
+# phase proves premapped-HBM enforcement: sharers within budget run, an
+# over-budget claim is refused at Prepare.
 source "$(dirname "$0")/helpers.sh"
 
-start_cluster v5e-4 --gates TimeSlicingSettings=true
+start_cluster v5e-4 --gates TimeSlicingSettings=true,PremappedBufferSharing=true
 
 kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test4.yaml"
 for p in pod0 pod1; do
@@ -22,6 +24,26 @@ for p in pods:
 chips = {p["injected_env"]["TPU_VISIBLE_CHIPS"] for p in pods}
 assert len(chips) == 1, f"sharing pods on different chips: {chips}"
 print("sharing OK: both pods on chip", chips.pop(), "timeslice 2000us")
+PYEOF
+
+# Phase 2: premapped budgets — enforcement, not bookkeeping.
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test7.yaml"
+for p in pod0 pod1; do
+  kubectl wait pod "$p" -n tpu-test7 --for=Running --timeout=30
+done
+kubectl wait pod hog -n tpu-test7 --for=Failed --timeout=30
+
+premap_json="$(kubectl get pods -n tpu-test7 -o json)"
+$PY - <<PYEOF
+import json
+pods = {p["meta"]["name"]: p for p in json.loads('''$premap_json''')}
+for name in ("pod0", "pod1"):
+    env = pods[name]["injected_env"]
+    assert env.get("TPU_PREMAPPED_BUFFER_BYTES") == "4294967296", (name, env)
+hog = pods["hog"]
+failure = hog["meta"]["annotations"].get("failure", "")
+assert "exceeds HBM" in failure, failure
+print("premapped OK: sharers budgeted; over-budget claim refused:", failure[:60])
 PYEOF
 
 echo "PASS test_sharing"

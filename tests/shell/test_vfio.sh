@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# VFIO passthrough shell e2e (reference kubevirt-vfio guide path): a claim
+# in the vfio.tpu.google.com class rebinds the chip to vfio-pci in the
+# node's (fixture) sysfs and the pod receives /dev/vfio/<group> plus
+# TPU_VFIO_PCI_ADDRESS — and never the accel node.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4 --gates PassthroughSupport=true
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test-vfio.yaml"
+kubectl wait pod vm0 -n tpu-test-vfio --for=Running --timeout=30
+
+pod_json="$(kubectl get pods -n tpu-test-vfio -o json)"
+$PY - <<PYEOF
+import json
+pods = json.loads('''$pod_json''')
+assert len(pods) == 1, [p["meta"]["name"] for p in pods]
+p = pods[0]
+addr = p["injected_env"].get("TPU_VFIO_PCI_ADDRESS", "")
+assert addr.startswith("0000:"), f"bad TPU_VFIO_PCI_ADDRESS {addr!r}"
+devs = p["injected_devices"]
+groups = [d for d in devs if "/vfio/" in d]
+assert len(groups) == 1, f"want one vfio group node, got {devs}"
+assert not any(d.rsplit("/", 1)[-1].startswith("accel") for d in devs), devs
+print("vfio OK:", addr, "->", groups[0])
+PYEOF
+
+# Deleting the workload releases the function back to the accel driver:
+# the chip must be claimable again as a regular (non-vfio) device.
+kubectl delete pod vm0 -n tpu-test-vfio
+kubectl wait pod vm0 -n tpu-test-vfio --for=deleted --timeout=30
+
+kubectl apply -f - <<EOF
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: plain, namespace: tpu-test-vfio}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpu
+        exactly: {deviceClassName: tpu.google.com, count: 1}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: plain0, namespace: tpu-test-vfio}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpu, resourceClaimTemplateName: plain}]
+EOF
+kubectl wait pod plain0 -n tpu-test-vfio --for=Running --timeout=30
+echo "vfio OK: chip reusable as accel device after passthrough release"
+
+echo "PASS test_vfio"
