@@ -3,9 +3,14 @@
 Usage:
     python -m k8s_dra_driver_tpu.tpulib.cli info [--json]
     python -m k8s_dra_driver_tpu.tpulib.cli health <chip-index>
+    python -m k8s_dra_driver_tpu.tpulib.cli topo [--json]
+    python -m k8s_dra_driver_tpu.tpulib.cli partitions [--ledger PATH]
 
 (Reference role: nvidia-smi as invoked for debug/persistence-mode at
-/root/reference/cmd/gpu-kubelet-plugin/root.go:57.)
+/root/reference/cmd/gpu-kubelet-plugin/root.go:57; `topo` is the
+`nvidia-smi topo -m` analog over ICI links, `partitions` inspects the
+DynamicSubslice activation ledger the way `nvidia-smi -q` shows MIG
+devices.)
 """
 
 from __future__ import annotations
@@ -74,6 +79,94 @@ def cmd_health(args: argparse.Namespace) -> int:
     return 0 if h.value == "healthy" else 1
 
 
+def cmd_topo(args: argparse.Namespace) -> int:
+    """ICI link matrix between this host's chips (nvidia-smi topo -m
+    analog): cell = link bandwidth in GB/s, '.' = no direct link."""
+    lib = new_tpulib()
+    inv = lib.enumerate()
+    by_coords = {c.coords: c.index for c in inv.chips}
+    links = {}
+    for ln in inv.links:
+        a, b = by_coords.get(ln.a), by_coords.get(ln.b)
+        if a is None or b is None:
+            continue  # inter-host link: peer chip not on this host
+        links[(a, b)] = links[(b, a)] = ln.gbps
+    if args.json:
+        print(json.dumps({
+            "host_topology": inv.host_topology,
+            "links": [
+                {"a": a, "b": b, "gbps": g}
+                for (a, b), g in sorted(links.items()) if a < b
+            ],
+        }, indent=2))
+        return 0
+    idxs = [c.index for c in inv.chips]
+    print(f"host {inv.host_topology}; cells are ICI GB/s per direction per link")
+    print("     " + "".join(f"chip{j:<4}" for j in idxs))
+    for i in idxs:
+        row = []
+        for j in idxs:
+            if i == j:
+                row.append("x")
+            else:
+                g = links.get((i, j))
+                row.append("." if g is None else f"{g:g}")
+        print(f"chip{i:<3}" + "".join(f"{v:<8}" for v in row))
+    return 0
+
+
+def cmd_partitions(args: argparse.Namespace) -> int:
+    """Show the DynamicSubslice activation ledger (the flock'd on-disk
+    state behind Prepare-time carving; empty/missing = nothing carved).
+    Reads both ledger formats: the native partitioner's newline-separated
+    id list (native/partitioner.cc) and JSON {"partitions": [...]}."""
+    import os
+
+    path = args.ledger
+    if not os.path.exists(path):
+        print(f"no ledger at {path} (no partitions active, or "
+              f"DynamicSubslice disabled)")
+        return 0
+    with open(path, encoding="utf-8") as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+        parts = doc.get("partitions", doc if isinstance(doc, list) else [])
+    except json.JSONDecodeError:
+        # Native id-per-line ledger: resolve chips via this host's
+        # placement map so the output matches the JSON form.
+        placements = {}
+        try:
+            inv = new_tpulib().enumerate()
+            for prof in inv.subslice_profiles:
+                for pl in prof.placements:
+                    placements[pl.name_suffix] = pl
+        except Exception:  # noqa: BLE001 — enumeration is best-effort here
+            pass
+        parts = []
+        for pid in filter(None, (ln.strip() for ln in raw.splitlines())):
+            pl = placements.get(pid)
+            parts.append({
+                "id": pid,
+                "profile": pl.profile if pl else pid.split("-at-")[0],
+                "chips": list(pl.chip_indices) if pl else [],
+            })
+    if args.json:
+        print(json.dumps(parts, indent=2))
+        return 0
+    if not parts:
+        print("no active partitions")
+        return 0
+    print(f"{'ID':<20}{'PROFILE':<10}CHIPS")
+    for p in parts:
+        chips = ",".join(str(c) for c in p.get("chips", p.get("chip_indices", [])))
+        print(f"{p.get('id', '?'):<20}{p.get('profile', '?'):<10}{chips}")
+    return 0
+
+
+DEFAULT_LEDGER = "/var/lib/kubelet/plugins/tpu.google.com/partitions.json"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpu-info")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -83,6 +176,14 @@ def main(argv=None) -> int:
     p_health = sub.add_parser("health", help="probe one chip")
     p_health.add_argument("chip", type=int)
     p_health.set_defaults(fn=cmd_health)
+    p_topo = sub.add_parser("topo", help="ICI link matrix (topo -m analog)")
+    p_topo.add_argument("--json", action="store_true")
+    p_topo.set_defaults(fn=cmd_topo)
+    p_parts = sub.add_parser("partitions", help="DynamicSubslice ledger")
+    p_parts.add_argument("--ledger", default=DEFAULT_LEDGER,
+                         help=f"ledger path [default: {DEFAULT_LEDGER}]")
+    p_parts.add_argument("--json", action="store_true")
+    p_parts.set_defaults(fn=cmd_partitions)
     args = parser.parse_args(argv)
     return args.fn(args)
 
