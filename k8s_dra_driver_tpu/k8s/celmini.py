@@ -1,0 +1,225 @@
+"""Mini-CEL: the DRA device-selector subset of CEL, evaluated in-process.
+
+Real clusters evaluate DeviceClass/request `selectors[].cel.expression`
+with cel-go inside the scheduler (the reference's chart relies on this,
+e.g. `device.driver == 'gpu.nvidia.com' && device.attributes[...]...`).
+The sim's allocator uses this evaluator so the *shipped chart's actual
+expressions* — not a parallel match-attribute encoding — decide matching.
+
+Expressions compile once (lru-cached) to a closure evaluated per device,
+so the allocator's device loop pays no repeated parsing.
+
+Supported subset (everything our chart and the reference's use, plus the
+obvious neighbors):
+
+    device.driver, device.attributes["key"],
+    device.attributes["domain"].name   (-> flat "domain/name" lookup),
+    device.capacity["key"]
+    literals: 'str' "str" ints (incl. negative) true false
+    operators: == != < <= > >= && || !  and parentheses
+
+Missing attributes make comparisons false (`!=` true) rather than raising,
+mirroring how an unset attribute can never satisfy a selector.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Callable, List, Optional
+
+
+class CelError(ValueError):
+    pass
+
+
+_TOKEN = re.compile(r"""
+    \s*(
+        '(?:[^'\\]|\\.)*' | "(?:[^"\\]|\\.)*"   # strings
+      | -?\d+                                    # ints (incl. negative)
+      | [A-Za-z_][A-Za-z0-9_]*                   # identifiers
+      | == | != | <= | >= | && | \|\|            # two-char ops
+      | [()\[\].!<>]                             # single-char ops
+    )""", re.VERBOSE)
+
+
+def _tokenize(expr: str) -> List[str]:
+    out, pos = [], 0
+    while pos < len(expr):
+        m = _TOKEN.match(expr, pos)
+        if not m:
+            if expr[pos:].strip() == "":
+                break
+            raise CelError(f"bad token at {expr[pos:pos + 12]!r}")
+        out.append(m.group(1))
+        pos = m.end()
+    return out
+
+
+class _Missing:
+    """Sentinel for absent attributes: comparisons never match."""
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return "<missing>"
+
+
+MISSING = _Missing()
+
+_Fn = Callable[[Any], Any]  # compiled node: device -> value
+
+
+def _is_int(tok: str) -> bool:
+    return tok.lstrip("-").isdigit() and tok != "-"
+
+
+class _Compiler:
+    """Recursive-descent compile to closures; runs once per expression."""
+
+    def __init__(self, tokens: List[str]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def take(self, want: Optional[str] = None) -> str:
+        tok = self.peek()
+        if tok is None or (want is not None and tok != want):
+            raise CelError(f"expected {want or 'token'}, got {tok!r}")
+        self.i += 1
+        return tok
+
+    def expr(self) -> _Fn:
+        fn = self.and_()
+        while self.peek() == "||":
+            self.take()
+            rhs = self.and_()
+            fn = (lambda lhs, rhs: lambda d: bool(lhs(d)) or bool(rhs(d)))(fn, rhs)
+        return fn
+
+    def and_(self) -> _Fn:
+        fn = self.unary()
+        while self.peek() == "&&":
+            self.take()
+            rhs = self.unary()
+            fn = (lambda lhs, rhs: lambda d: bool(lhs(d)) and bool(rhs(d)))(fn, rhs)
+        return fn
+
+    def unary(self) -> _Fn:
+        if self.peek() == "!":
+            self.take()
+            inner = self.unary()
+            return lambda d: not bool(inner(d))
+        return self.cmp()
+
+    _CMPS = {"==", "!=", "<", "<=", ">", ">="}
+
+    def cmp(self) -> _Fn:
+        lhs = self.term()
+        op = self.peek()
+        if op not in self._CMPS:
+            return lhs
+        self.take()
+        rhs = self.term()
+
+        def compare(d, lhs=lhs, rhs=rhs, op=op):
+            a, b = lhs(d), rhs(d)
+            if isinstance(a, _Missing) or isinstance(b, _Missing):
+                return op == "!="
+            # CEL compares like-typed values; coerce int-vs-str-of-int
+            # since attribute wire values may arrive as strings.
+            if isinstance(a, int) != isinstance(b, int):
+                try:
+                    a, b = int(a), int(b)
+                except (TypeError, ValueError):
+                    a, b = str(a), str(b)
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            return a >= b
+
+        return compare
+
+    def term(self) -> _Fn:
+        tok = self.peek()
+        if tok == "(":
+            self.take()
+            fn = self.expr()
+            self.take(")")
+            return fn
+        if tok is None:
+            raise CelError("unexpected end of expression")
+        if tok[0] in "'\"":
+            self.take()
+            v = tok[1:-1]
+            return lambda d: v
+        if _is_int(tok):
+            self.take()
+            v = int(tok)
+            return lambda d: v
+        if tok == "true":
+            self.take()
+            return lambda d: True
+        if tok == "false":
+            self.take()
+            return lambda d: False
+        if tok == "device":
+            return self.device_path()
+        raise CelError(f"unsupported term {tok!r}")
+
+    def device_path(self) -> _Fn:
+        self.take("device")
+        self.take(".")
+        field = self.take()
+        if field == "driver":
+            return lambda d: getattr(d, "driver", MISSING)
+        if field not in ("attributes", "capacity"):
+            raise CelError(f"unsupported device field {field!r}")
+        self.take("[")
+        key_tok = self.take()
+        if key_tok[0] not in "'\"":
+            raise CelError(f"map key must be a string literal, got {key_tok!r}")
+        key = key_tok[1:-1]
+        self.take("]")
+        name = None
+        if self.peek() == ".":
+            # Qualified form: attributes["domain"].name -> "domain/name",
+            # with a fallback to the bare name for flat attribute maps.
+            self.take()
+            name = self.take()
+
+        def lookup(d, field=field, key=key, name=name):
+            mapping = getattr(d, field, None) or {}
+            if name is None:
+                return mapping.get(key, MISSING)
+            return mapping.get(f"{key}/{name}", mapping.get(name, MISSING))
+
+        return lookup
+
+
+@functools.lru_cache(maxsize=1024)
+def compile_expression(expression: str) -> _Fn:
+    """Compile one selector expression to a device -> bool-ish closure."""
+    c = _Compiler(_tokenize(expression))
+    fn = c.expr()
+    if c.peek() is not None:
+        raise CelError(f"trailing tokens at {c.peek()!r}")
+    return fn
+
+
+def evaluate(expression: str, device) -> bool:
+    """Evaluate one selector expression against a device-like object
+    (needs .driver and .attributes / .capacity mappings)."""
+    return bool(compile_expression(expression)(device))
+
+
+def matches(expressions, device) -> bool:
+    """All-of over a selector list (DRA ANDs multiple selectors)."""
+    return all(evaluate(e, device) for e in expressions)

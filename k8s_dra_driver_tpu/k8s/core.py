@@ -192,6 +192,9 @@ class DeviceClass(K8sObject):
     driver: str = ""  # selector: device.driver == driver
     # Attribute equality selectors, the CEL-expression stand-in.
     match_attributes: Dict[str, Any] = field(default_factory=dict)
+    # Real DRA selector expressions (selectors[].cel.expression); when set,
+    # evaluated by k8s.celmini — the same strings the chart ships.
+    cel_selectors: List[str] = field(default_factory=list)
     config: List[DeviceClaimConfig] = field(default_factory=list)
 
 

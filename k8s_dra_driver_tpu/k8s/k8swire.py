@@ -779,27 +779,33 @@ def _slice_decode(doc: Dict[str, Any]) -> ResourceSlice:
 
 # -- DeviceClass ------------------------------------------------------------
 
-_CEL_DRIVER_RE = re.compile(r'device\.driver\s*==\s*"([^"]+)"')
-_CEL_ATTR_RE = re.compile(
-    r'device\.attributes\["([^"]+)"\]\s*==\s*("([^"]*)"|true|false|-?\d+)'
-)
+_CEL_DRIVER_RE = re.compile(r'''device\.driver\s*==\s*['"]([^'"]+)['"]''')
 
 
 def _deviceclass_encode(dc: DeviceClass) -> Dict[str, Any]:
-    exprs = []
-    if dc.driver:
-        exprs.append(f'device.driver == "{dc.driver}"')
-    for k, v in dc.match_attributes.items():
-        if isinstance(v, bool):
-            lit = "true" if v else "false"
-        elif isinstance(v, int):
-            lit = str(v)
-        else:
-            lit = f'"{v}"'
-        exprs.append(f'device.attributes["{k}"] == {lit}')
     spec: Dict[str, Any] = {}
-    if exprs:
-        spec["selectors"] = [{"cel": {"expression": " && ".join(exprs)}}]
+    if dc.cel_selectors:
+        # Raw expressions round-trip verbatim (the chart's own strings).
+        # The driver must survive the trip even when no expression names
+        # it — the allocator's per-driver slice lookup depends on it.
+        selectors = list(dc.cel_selectors)
+        if dc.driver and not any("device.driver" in e for e in selectors):
+            selectors.insert(0, f'device.driver == "{dc.driver}"')
+        spec["selectors"] = [{"cel": {"expression": e}} for e in selectors]
+    else:
+        exprs = []
+        if dc.driver:
+            exprs.append(f'device.driver == "{dc.driver}"')
+        for k, v in dc.match_attributes.items():
+            if isinstance(v, bool):
+                lit = "true" if v else "false"
+            elif isinstance(v, int):
+                lit = str(v)
+            else:
+                lit = f'"{v}"'
+            exprs.append(f'device.attributes["{k}"] == {lit}')
+        if exprs:
+            spec["selectors"] = [{"cel": {"expression": " && ".join(exprs)}}]
     if dc.config:
         spec["config"] = _configs_encode(dc.config)
     return {"spec": spec}
@@ -808,24 +814,20 @@ def _deviceclass_encode(dc: DeviceClass) -> Dict[str, Any]:
 def _deviceclass_decode(doc: Dict[str, Any]) -> DeviceClass:
     spec = doc.get("spec") or {}
     driver = ""
-    match_attributes: Dict[str, Any] = {}
+    cel_selectors: List[str] = []
     for sel in spec.get("selectors") or []:
         expr = (sel.get("cel") or {}).get("expression", "")
+        if expr:
+            # Keep the raw expression (celmini evaluates it); the driver is
+            # still extracted for the allocator's per-driver slice lookup.
+            cel_selectors.append(expr)
         m = _CEL_DRIVER_RE.search(expr)
         if m:
             driver = m.group(1)
-        for am in _CEL_ATTR_RE.finditer(expr):
-            key, raw, quoted = am.group(1), am.group(2), am.group(3)
-            if quoted is not None:
-                match_attributes[key] = quoted
-            elif raw in ("true", "false"):
-                match_attributes[key] = raw == "true"
-            else:
-                match_attributes[key] = int(raw)
     return DeviceClass(
         meta=_meta_decode(doc.get("metadata") or {}),
         driver=driver,
-        match_attributes=match_attributes,
+        cel_selectors=cel_selectors,
         config=_configs_decode(spec.get("config") or [], source="class"),
     )
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 from collections import defaultdict
+from types import SimpleNamespace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.k8s import APIServer
@@ -34,10 +35,23 @@ class AllocationError(Exception):
 
 
 def _device_matches(dev: Device, match_attributes: Dict[str, object],
-                    selectors: List[str]) -> bool:
+                    selectors: List[str], cel_selectors: List[str] = (),
+                    driver: str = "") -> bool:
     for k, v in match_attributes.items():
         if dev.attributes.get(k) != v:
             return False
+    if cel_selectors:
+        from k8s_dra_driver_tpu.k8s import celmini
+
+        # CEL sees `device.driver`; the Device object itself doesn't carry
+        # it (the slice does), so bind it for evaluation.
+        view = SimpleNamespace(driver=driver, attributes=dev.attributes,
+                               capacity=dev.capacity)
+        try:
+            if not celmini.matches(cel_selectors, view):
+                return False
+        except celmini.CelError as e:
+            raise AllocationError(f"bad CEL selector: {e}") from e
     for sel in selectors:
         if "=" not in sel:
             raise AllocationError(f"malformed selector {sel!r} (want attr=value)")
@@ -105,11 +119,12 @@ class Allocator:
 
     # -- allocation -----------------------------------------------------------
 
-    def _class_info(self, class_name: str) -> Tuple[str, Dict[str, object]]:
+    def _class_info(self, class_name: str):
         dc = self.api.try_get(DEVICE_CLASS, class_name)
         if dc is None:
             raise AllocationError(f"DeviceClass {class_name!r} not found")
-        return dc.driver, getattr(dc, "match_attributes", {})
+        return (dc.driver, getattr(dc, "match_attributes", {}),
+                getattr(dc, "cel_selectors", []))
 
     def allocate_on_node(self, claim: ResourceClaim, node_name: str,
                          in_flight: Sequence = ()) -> Optional[AllocationResult]:
@@ -127,7 +142,7 @@ class Allocator:
         picked: List[DeviceRequestAllocationResult] = []
         picked_names: set = set()
         for req in claim.requests:
-            driver, match_attrs = self._class_info(req.device_class_name)
+            driver, match_attrs, cel_sels = self._class_info(req.device_class_name)
             rs = slices_by_driver.get(driver)
             if rs is None:
                 return None
@@ -135,7 +150,8 @@ class Allocator:
                 d for d in rs.devices
                 if d.name not in picked_names
                 and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
-                and _device_matches(d, match_attrs, req.selectors)
+                and _device_matches(d, match_attrs, req.selectors,
+                                    cel_selectors=cel_sels, driver=driver)
             ]
             want = len(candidates) if req.allocation_mode == "All" else req.count
             chosen: List[Device] = []

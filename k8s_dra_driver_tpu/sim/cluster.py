@@ -120,16 +120,24 @@ class SimCluster:
     # -- bootstrap -------------------------------------------------------------
 
     def _install_device_classes(self) -> None:
-        for name, driver, match in (
-            (DEVICE_CLASS_TPU, TPU_DRIVER_NAME, {"type": "tpu"}),
-            (DEVICE_CLASS_SUBSLICE, TPU_DRIVER_NAME, {"type": "subslice"}),
-            (DEVICE_CLASS_VFIO, TPU_DRIVER_NAME, {"type": "vfio"}),
-            (DEVICE_CLASS_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "channel"}),
-            (DEVICE_CLASS_DAEMON, COMPUTE_DOMAIN_DRIVER_NAME, {"type": "daemon"}),
+        # The CEL expressions are the same strings the Helm chart ships
+        # (templates/deviceclasses.yaml) and are what actually gates
+        # matching — the allocator evaluates them via k8s.celmini, so a
+        # selector typo in the chart fails the sim e2e, not just a live
+        # cluster (test_helm_chart pins chart<->sim expression parity).
+        for name, driver, dev_type in (
+            (DEVICE_CLASS_TPU, TPU_DRIVER_NAME, "tpu"),
+            (DEVICE_CLASS_SUBSLICE, TPU_DRIVER_NAME, "subslice"),
+            (DEVICE_CLASS_VFIO, TPU_DRIVER_NAME, "vfio"),
+            (DEVICE_CLASS_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME, "channel"),
+            (DEVICE_CLASS_DAEMON, COMPUTE_DOMAIN_DRIVER_NAME, "daemon"),
         ):
+            expr = (f'device.driver == "{driver}" && '
+                    f'device.attributes["type"] == "{dev_type}"')
             try:
                 self.api.create(DeviceClass(
-                    meta=new_meta(name), driver=driver, match_attributes=match,
+                    meta=new_meta(name), driver=driver,
+                    cel_selectors=[expr],
                 ))
             except AlreadyExistsError:
                 pass  # attaching to a server that was already seeded
@@ -329,18 +337,30 @@ class SimCluster:
             chosen = pod.node_name
             if unallocated:
                 placed = False
+                failed = False
                 for node in candidates:
                     results = []
                     ok = True
                     for c in unallocated:
                         # Sibling claims computed this pass count as
                         # consumed, or two claims of one pod double-book.
-                        r = self.allocator.allocate_on_node(
-                            c, node, in_flight=[r for _, r in results])
+                        try:
+                            r = self.allocator.allocate_on_node(
+                                c, node, in_flight=[r for _, r in results])
+                        except AllocationError as e:
+                            # A malformed class/selector must fail THIS
+                            # pod visibly, not abort the scheduler pass
+                            # for every other pod.
+                            self._fail_pod(pod, f"allocation: {e}")
+                            failed = True
+                            ok = False
+                            break
                         if r is None:
                             ok = False
                             break
                         results.append((c, r))
+                    if failed:
+                        break
                     if ok:
                         for c, r in results:
                             # Consumers are recorded by the reserve loop
@@ -353,6 +373,8 @@ class SimCluster:
                         chosen = node
                         placed = True
                         break
+                if failed:
+                    continue  # pod already marked Failed
                 if not placed:
                     log.debug("pod %s: unschedulable this pass", pod.key)
                     continue

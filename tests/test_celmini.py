@@ -1,0 +1,217 @@
+"""Mini-CEL device selectors: evaluation semantics + chart parity.
+
+The sim's allocator gates matching on the same CEL expressions the Helm
+chart ships in its DeviceClasses, evaluated by k8s.celmini — these tests
+pin the evaluator's semantics and prove the chart's actual expressions
+select exactly the devices they should.
+"""
+
+import os
+import sys
+from types import SimpleNamespace
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.k8s.celmini import CelError, evaluate, matches
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def dev(driver="tpu.google.com", **attrs):
+    return SimpleNamespace(driver=driver, attributes=attrs, capacity={})
+
+
+# -- evaluator semantics ------------------------------------------------------
+
+def test_driver_and_attribute_equality():
+    d = dev(type="tpu", index=3)
+    assert evaluate('device.driver == "tpu.google.com"', d)
+    assert not evaluate('device.driver == "gpu.nvidia.com"', d)
+    assert evaluate('device.attributes["type"] == "tpu"', d)
+    assert evaluate('device.attributes["index"] == 3', d)
+    assert evaluate("device.attributes['index'] >= 2", d)
+    assert not evaluate('device.attributes["index"] < 3', d)
+
+
+def test_boolean_operators_and_parens():
+    d = dev(type="subslice")
+    e = ('device.driver == "tpu.google.com" && '
+         '(device.attributes["type"] == "tpu" || '
+         'device.attributes["type"] == "subslice")')
+    assert evaluate(e, d)
+    assert evaluate('!(device.attributes["type"] == "tpu")', d)
+    assert not evaluate('device.attributes["type"] != "subslice"', d)
+
+
+def test_missing_attributes_never_match():
+    d = dev()
+    assert not evaluate('device.attributes["nope"] == "x"', d)
+    assert not evaluate('device.attributes["nope"] == 0', d)
+    assert evaluate('device.attributes["nope"] != "x"', d)  # CEL-ish absent
+
+
+def test_qualified_attribute_domain():
+    d = SimpleNamespace(driver="tpu.google.com",
+                        attributes={"tpu.google.com/gen": "v5e"}, capacity={})
+    assert evaluate('device.attributes["tpu.google.com"].gen == "v5e"', d)
+
+
+def test_int_string_coercion():
+    # Wire attributes may arrive stringly; comparisons still work.
+    d = dev(workerId="2")
+    assert evaluate('device.attributes["workerId"] == 2', d)
+
+
+def test_capacity_access():
+    d = SimpleNamespace(driver="d", attributes={}, capacity={"hbm": 16})
+    assert evaluate('device.capacity["hbm"] >= 16', d)
+
+
+def test_negative_int_literals():
+    d = dev(offset=-5)
+    assert evaluate('device.attributes["offset"] == -5', d)
+    assert evaluate('device.attributes["offset"] < -1', d)
+
+
+def test_compile_cache_reused():
+    from k8s_dra_driver_tpu.k8s.celmini import compile_expression
+
+    a = compile_expression('device.driver == "x"')
+    b = compile_expression('device.driver == "x"')
+    assert a is b  # lru-cached: no re-parse per device/pass
+
+
+def test_bad_class_selector_fails_only_that_pod(tmp_path):
+    """A malformed DeviceClass selector fails pods referencing it with a
+    visible message; other pods keep scheduling (the scheduler pass must
+    not abort)."""
+    from k8s_dra_driver_tpu.k8s.core import DEVICE_CLASS, DeviceClass, POD
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        sim.api.create(DeviceClass(
+            meta=new_meta("broken.tpu.google.com"),
+            driver="tpu.google.com",
+            cel_selectors=['device.attributes["a"].matches("re")'],
+        ))
+        manifest = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: broken, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: broken.tpu.google.com, count: 1}}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: doomed, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimTemplateName: broken}]
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: good, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: fine, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimTemplateName: good}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle()
+        doomed = sim.api.get(POD, "doomed", "default")
+        fine = sim.api.get(POD, "fine", "default")
+        assert doomed.phase == "Failed"
+        assert "bad CEL selector" in doomed.meta.annotations["failure"]
+        assert fine.phase == "Running"
+    finally:
+        sim.stop()
+
+
+def test_unsupported_constructs_raise():
+    with pytest.raises(CelError):
+        evaluate('device.attributes["a"].matches("re")', dev())
+    with pytest.raises(CelError):
+        evaluate('system.exit == 1', dev())
+    with pytest.raises(CelError):
+        evaluate('device.driver == "x" extra', dev())
+
+
+# -- chart parity -------------------------------------------------------------
+
+def _chart_expressions():
+    from test_helm_chart import CHART, MiniHelm
+
+    with open(os.path.join(CHART, "values.yaml"), encoding="utf-8") as f:
+        values = yaml.safe_load(f)
+    with open(os.path.join(CHART, "templates", "deviceclasses.yaml"),
+              encoding="utf-8") as f:
+        rendered = MiniHelm(dict(values)).render(f.read())
+    out = {}
+    for doc in yaml.safe_load_all(rendered):
+        if not doc or doc.get("kind") != "DeviceClass":
+            continue
+        exprs = [s["cel"]["expression"] for s in doc["spec"]["selectors"]]
+        out[doc["metadata"]["name"]] = exprs
+    return out
+
+
+def test_chart_expressions_evaluate_and_discriminate():
+    """Every DeviceClass expression the chart ships parses under celmini
+    and selects exactly its own device type from a real enumeration."""
+    from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import device_to_api
+    from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    inv = MockTpuLib("v5e-4").enumerate()
+    devices = [
+        SimpleNamespace(driver="tpu.google.com",
+                        attributes=device_to_api(d, inv).attributes,
+                        capacity=device_to_api(d, inv).capacity)
+        for d in enumerate_allocatable(inv, with_vfio=True).values()
+    ]
+    exprs = _chart_expressions()
+    assert {"tpu.google.com", "subslice.tpu.google.com",
+            "vfio.tpu.google.com"} <= set(exprs)
+    for class_name, want_type in (
+        ("tpu.google.com", "tpu"),
+        ("subslice.tpu.google.com", "subslice"),
+        ("vfio.tpu.google.com", "vfio"),
+    ):
+        selected = [d for d in devices if matches(exprs[class_name], d)]
+        assert selected, f"{class_name} selected nothing"
+        assert all(d.attributes["type"] == want_type for d in selected), class_name
+        assert len(selected) == sum(
+            1 for d in devices if d.attributes["type"] == want_type)
+
+
+def test_chart_expressions_match_sim_installed_classes(tmp_path):
+    """The sim installs the same expressions the chart ships (drift in
+    either place fails here)."""
+    from k8s_dra_driver_tpu.k8s.core import DEVICE_CLASS
+    from k8s_dra_driver_tpu.sim import SimCluster
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    try:
+        chart = _chart_expressions()
+        for dc in sim.api.list(DEVICE_CLASS):
+            if dc.meta.name in chart:
+                assert dc.cel_selectors == chart[dc.meta.name], dc.meta.name
+    finally:
+        sim.stop()

@@ -150,6 +150,13 @@ def test_wire_claim_roundtrip():
 
 
 def test_wire_deviceclass_cel_roundtrip():
+    """Legacy match_attributes encode into one CEL expression; decode keeps
+    the raw expression (celmini evaluates it), so the roundtrip is
+    *semantic*: the decoded class selects exactly what the original did."""
+    from types import SimpleNamespace
+
+    from k8s_dra_driver_tpu.k8s import celmini
+
     dc = DeviceClass(
         meta=new_meta("tpu.google.com"),
         driver="tpu.google.com",
@@ -161,8 +168,54 @@ def test_wire_deviceclass_cel_roundtrip():
     assert 'device.driver == "tpu.google.com"' in expr
     back = from_k8s_wire(wire)
     assert back.driver == "tpu.google.com"
-    assert back.match_attributes == {"tpu.google.com/type": "chip",
-                                     "count": 4, "healthy": True}
+    assert back.cel_selectors == [expr]
+    good = SimpleNamespace(
+        driver="tpu.google.com",
+        attributes={"tpu.google.com/type": "chip", "count": 4, "healthy": True},
+        capacity={})
+    bad = SimpleNamespace(
+        driver="tpu.google.com",
+        attributes={"tpu.google.com/type": "chip", "count": 2, "healthy": True},
+        capacity={})
+    assert celmini.matches(back.cel_selectors, good)
+    assert not celmini.matches(back.cel_selectors, bad)
+
+
+def test_wire_deviceclass_raw_expression_roundtrips_verbatim():
+    dc = DeviceClass(
+        meta=new_meta("vfio.tpu.google.com"),
+        driver="tpu.google.com",
+        cel_selectors=['device.driver == "tpu.google.com" && '
+                       'device.attributes["type"] == "vfio"'],
+    )
+    wire = to_k8s_wire(dc)
+    back = from_k8s_wire(wire)
+    assert back.cel_selectors == dc.cel_selectors
+    assert back.driver == "tpu.google.com"
+
+
+def test_wire_deviceclass_driver_survives_without_driver_clause():
+    """A class whose expressions never mention device.driver must still
+    round-trip its driver (the allocator's slice lookup needs it)."""
+    dc = DeviceClass(
+        meta=new_meta("attr-only"),
+        driver="tpu.google.com",
+        cel_selectors=['device.attributes["type"] == "vfio"'],
+    )
+    back = from_k8s_wire(to_k8s_wire(dc))
+    assert back.driver == "tpu.google.com"
+    assert 'device.attributes["type"] == "vfio"' in back.cel_selectors
+
+
+def test_wire_deviceclass_single_quoted_driver():
+    back = from_k8s_wire({
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "sq"},
+        "spec": {"selectors": [
+            {"cel": {"expression": "device.driver == 'tpu.google.com'"}},
+        ]},
+    })
+    assert back.driver == "tpu.google.com"
 
 
 def test_wire_computedomain_roundtrip():
