@@ -22,6 +22,21 @@ def unwrap_template_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
     return spec.get("spec", spec)
 
 
+def _selector_strings(raw) -> List[str]:
+    """Request selectors in manifest form are k8s-shaped
+    ``[{cel: {expression: ...}}]``; plain strings (CEL or the sim's legacy
+    ``attr=value``) are accepted too."""
+    out: List[str] = []
+    for s in raw or []:
+        if isinstance(s, str):
+            out.append(s)
+        elif isinstance(s, dict):
+            expr = ((s.get("cel") or {}).get("expression", ""))
+            if expr:
+                out.append(expr)
+    return out
+
+
 def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
     out = []
     for r in spec.get("devices", {}).get("requests", []):
@@ -33,7 +48,7 @@ def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
             device_class_name=inner.get("deviceClassName", ""),
             allocation_mode=inner.get("allocationMode", "ExactCount"),
             count=inner.get("count", 1),
-            selectors=inner.get("selectors", []),
+            selectors=_selector_strings(inner.get("selectors")),
         ))
     return out
 

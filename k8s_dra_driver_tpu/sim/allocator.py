@@ -53,11 +53,25 @@ def _device_matches(dev: Device, match_attributes: Dict[str, object],
         except celmini.CelError as e:
             raise AllocationError(f"bad CEL selector: {e}") from e
     for sel in selectors:
-        if "=" not in sel:
-            raise AllocationError(f"malformed selector {sel!r} (want attr=value)")
-        k, _, v = sel.partition("=")
-        if str(dev.attributes.get(k.strip())) != v.strip():
-            return False
+        if "device." in sel:
+            # A real DRA request selector (CEL) — same evaluator as class
+            # selectors, so manifests can use either level identically.
+            from k8s_dra_driver_tpu.k8s import celmini
+
+            view = SimpleNamespace(driver=driver, attributes=dev.attributes,
+                                   capacity=dev.capacity)
+            try:
+                if not celmini.evaluate(sel, view):
+                    return False
+            except celmini.CelError as e:
+                raise AllocationError(f"bad CEL selector: {e}") from e
+        elif "=" in sel:
+            k, _, v = sel.partition("=")
+            if str(dev.attributes.get(k.strip())) != v.strip():
+                return False
+        else:
+            raise AllocationError(
+                f"malformed selector {sel!r} (want a CEL expression or attr=value)")
     return True
 
 

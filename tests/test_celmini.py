@@ -144,6 +144,51 @@ spec:
         sim.stop()
 
 
+def test_request_level_cel_selector_picks_specific_device(tmp_path):
+    """A claim request can carry its own CEL selector (k8s-shaped
+    selectors[].cel.expression in the manifest), narrowing within the
+    class — here to one specific chip index."""
+    from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        manifest = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaim
+metadata: {name: chip2, namespace: default}
+spec:
+  devices:
+    requests:
+    - name: t
+      exactly:
+        deviceClassName: tpu.google.com
+        count: 1
+        selectors:
+        - cel:
+            expression: device.attributes["index"] == 2
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: picky, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimName: chip2}]
+"""
+        for obj in load_manifests(manifest):
+            sim.api.create(obj)
+        sim.settle()
+        pod = sim.api.get(POD, "picky", "default")
+        assert pod.phase == "Running", pod.meta.annotations.get("failure")
+        assert pod.injected_env["TPU_VISIBLE_CHIPS"] == "2"
+        claim = sim.api.get(RESOURCE_CLAIM, "chip2", "default")
+        assert claim.allocation.devices[0].device == "tpu-2"
+    finally:
+        sim.stop()
+
+
 def test_unsupported_constructs_raise():
     with pytest.raises(CelError):
         evaluate('device.attributes["a"].matches("re")', dev())
