@@ -80,6 +80,8 @@ class MiniHelm:
             return self.lookups.get(tuple(args))
         if fn == "or":
             return next((a for a in args if a), args[-1] if args else None)
+        if fn == "and":
+            return next((a for a in args if not a), args[-1] if args else None)
         raise AssertionError(f"unknown function {fn!r}")
 
     def _pipe_fn(self, name, value):
@@ -181,6 +183,8 @@ class MiniHelm:
         toks = _tokenize_expr(expr)
         if toks[0] == "or":
             return any(self._atom(t) for t in toks[1:])
+        if toks[0] == "and":
+            return all(self._atom(t) for t in toks[1:])
         return self._atom(toks[0])
 
 
@@ -195,12 +199,19 @@ TEMPLATES = sorted(
 )
 
 
+# Templates gated behind default-off values (reference defaults the
+# network policies off too); they render empty on a default install and
+# have their own enabled-path tests.
+OPTIONAL_TEMPLATES = {"networkpolicy.yaml"}
+
+
 @pytest.mark.parametrize("template", TEMPLATES)
 def test_template_renders_to_valid_yaml(template, values):
     with open(os.path.join(CHART, "templates", template), encoding="utf-8") as f:
         rendered = MiniHelm(dict(values)).render(f.read())
     docs = [d for d in yaml.safe_load_all(rendered) if d]
-    assert docs, f"{template} rendered empty with default values"
+    if template not in OPTIONAL_TEMPLATES:
+        assert docs, f"{template} rendered empty with default values"
     for doc in docs:
         assert "kind" in doc and "apiVersion" in doc, (template, doc)
 
@@ -261,3 +272,36 @@ def test_gated_env_plumbed(values):
                         ("HEALTH_EVENTS_TO_IGNORE", "degraded"),
                         ("ALT_TPU_TOPOLOGY", "v5e-4")):
         assert name in rendered and value in rendered, name
+
+
+def test_networkpolicy_gated_and_scoped(values):
+    """Off by default; when enabled, each policy selects its component,
+    allows only metrics-port ingress, and API-server-port egress
+    (reference networkpolicy-{controller,kubelet-plugin}.yaml)."""
+    path = os.path.join(CHART, "templates", "networkpolicy.yaml")
+    with open(path, encoding="utf-8") as f:
+        template = f.read()
+    # Default: disabled — renders to nothing.
+    rendered = MiniHelm(dict(values)).render(template)
+    assert not [d for d in yaml.safe_load_all(rendered) if d]
+
+    vals = dict(values)
+    vals["controller"] = {**vals["controller"],
+                          "networkPolicy": {"enabled": True}}
+    vals["kubeletPlugin"] = {**vals["kubeletPlugin"],
+                             "networkPolicy": {"enabled": True}}
+    docs = [d for d in yaml.safe_load_all(MiniHelm(vals).render(template)) if d]
+    assert len(docs) == 2
+    by_component = {
+        d["spec"]["podSelector"]["matchLabels"]["app.kubernetes.io/component"]: d
+        for d in docs
+    }
+    assert set(by_component) == {"controller", "kubelet-plugin"}
+    ctrl = by_component["controller"]
+    assert ctrl["spec"]["ingress"][0]["ports"][0]["port"] == 9401
+    kp = by_component["kubelet-plugin"]
+    assert kp["spec"]["ingress"][0]["ports"][0]["port"] == 9400
+    for d in docs:
+        egress_ports = {p["port"] for rule in d["spec"]["egress"]
+                        for p in rule["ports"]}
+        assert egress_ports == {443, 6443}
