@@ -305,3 +305,27 @@ def test_networkpolicy_gated_and_scoped(values):
         egress_ports = {p["port"] for rule in d["spec"]["egress"]
                         for p in rule["ports"]}
         assert egress_ports == {443, 6443}
+
+
+def test_resourceslice_policy_pins_service_account(values):
+    """The VAP restricts exactly our kubelet-plugin SA and denies
+    cross-node slice writes; disabling the value removes both objects."""
+    path = os.path.join(CHART, "templates", "resourceslice-policy.yaml")
+    with open(path, encoding="utf-8") as f:
+        template = f.read()
+    docs = [d for d in yaml.safe_load_all(MiniHelm(dict(values)).render(template)) if d]
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"ValidatingAdmissionPolicy", "ValidatingAdmissionPolicyBinding"}
+    policy = next(d for d in docs if d["kind"] == "ValidatingAdmissionPolicy")
+    cond = policy["spec"]["matchConditions"][0]["expression"]
+    assert "system:serviceaccount:tpu-dra-driver:test-kubelet-plugin" in cond
+    exprs = [v["expression"] for v in policy["spec"]["validations"]]
+    assert any("userNodeName == variables.objectNodeName" in e for e in exprs)
+    binding = next(d for d in docs if d["kind"] == "ValidatingAdmissionPolicyBinding")
+    assert binding["spec"]["policyName"] == policy["metadata"]["name"]
+    assert binding["spec"]["validationActions"] == ["Deny"]
+
+    vals = dict(values)
+    vals["kubeletPlugin"] = {**vals["kubeletPlugin"],
+                             "resourceSlicePolicy": {"enabled": False}}
+    assert not [d for d in yaml.safe_load_all(MiniHelm(vals).render(template)) if d]
