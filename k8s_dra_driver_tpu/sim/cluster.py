@@ -132,8 +132,12 @@ class SimCluster:
             (DEVICE_CLASS_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME, "channel"),
             (DEVICE_CLASS_DAEMON, COMPUTE_DOMAIN_DRIVER_NAME, "daemon"),
         ):
+            # Domain-qualified attribute access: real DRA CEL exposes a
+            # device's attributes as attributes["<driver domain>"].<name>
+            # (the reference's expressions use the same form); celmini
+            # resolves the qualified key against the flat map.
             expr = (f'device.driver == "{driver}" && '
-                    f'device.attributes["type"] == "{dev_type}"')
+                    f'device.attributes["{driver}"].type == "{dev_type}"')
             try:
                 self.api.create(DeviceClass(
                     meta=new_meta(name), driver=driver,

@@ -168,7 +168,7 @@ spec:
         count: 1
         selectors:
         - cel:
-            expression: device.attributes["index"] == 2
+            expression: device.attributes["tpu.google.com"].index == 2
 ---
 apiVersion: v1
 kind: Pod
