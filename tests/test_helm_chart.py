@@ -20,6 +20,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
 
 
+class HelmFail(AssertionError):
+    """Raised by the template `fail` action (install-time guardrails)."""
+
+
 class _Cert:
     Cert = "FAKECERTPEM"
     Key = "FAKEKEYPEM"
@@ -82,6 +86,10 @@ class MiniHelm:
             return next((a for a in args if a), args[-1] if args else None)
         if fn == "and":
             return next((a for a in args if not a), args[-1] if args else None)
+        if fn == "eq":
+            return args[0] == args[1]
+        if fn == "not":
+            return not args[0]
         raise AssertionError(f"unknown function {fn!r}")
 
     def _pipe_fn(self, name, value):
@@ -161,6 +169,9 @@ class MiniHelm:
                         stack[-1] = (not stack[-1]) and all(stack[:-1])
                     elif act == "end":
                         stack.pop()
+                    elif act.startswith("fail "):
+                        if live():
+                            raise HelmFail(act[5:].strip().strip('"'))
                     elif re.match(r"^\$\w+ :?=", act):
                         if live():
                             name, _, expr = act.partition("=")
@@ -180,11 +191,14 @@ class MiniHelm:
         return "\n".join(out)
 
     def _eval_control(self, expr):
+        expr = self._reduce_parens(expr)
         toks = _tokenize_expr(expr)
         if toks[0] == "or":
             return any(self._atom(t) for t in toks[1:])
         if toks[0] == "and":
             return all(self._atom(t) for t in toks[1:])
+        if toks[0] in ("eq", "not"):
+            return self._call(toks)
         return self._atom(toks[0])
 
 
@@ -202,7 +216,7 @@ TEMPLATES = sorted(
 # Templates gated behind default-off values (reference defaults the
 # network policies off too); they render empty on a default install and
 # have their own enabled-path tests.
-OPTIONAL_TEMPLATES = {"networkpolicy.yaml"}
+OPTIONAL_TEMPLATES = {"networkpolicy.yaml", "validation.yaml"}
 
 
 @pytest.mark.parametrize("template", TEMPLATES)
@@ -329,3 +343,19 @@ def test_resourceslice_policy_pins_service_account(values):
     vals["kubeletPlugin"] = {**vals["kubeletPlugin"],
                              "resourceSlicePolicy": {"enabled": False}}
     assert not [d for d in yaml.safe_load_all(MiniHelm(vals).render(template)) if d]
+
+
+def test_validation_refuses_default_namespace(values):
+    """The install guardrail: default-namespace installs fail with a clear
+    message unless allowDefaultNamespace is set (reference validation.yaml)."""
+    path = os.path.join(CHART, "templates", "validation.yaml")
+    with open(path, encoding="utf-8") as f:
+        template = f.read()
+    # Normal namespace: renders to nothing.
+    assert not [d for d in yaml.safe_load_all(
+        MiniHelm(dict(values)).render(template)) if d]
+    with pytest.raises(HelmFail, match="not recommended"):
+        MiniHelm(dict(values), namespace="default").render(template)
+    vals = dict(values)
+    vals["allowDefaultNamespace"] = True
+    MiniHelm(vals, namespace="default").render(template)  # explicit bypass
