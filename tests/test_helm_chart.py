@@ -359,3 +359,30 @@ def test_validation_refuses_default_namespace(values):
     vals = dict(values)
     vals["allowDefaultNamespace"] = True
     MiniHelm(vals, namespace="default").render(template)  # explicit bypass
+
+
+def test_webhook_cert_manager_mode(values):
+    """tls.mode=cert-manager renders Issuer+Certificate instead of the
+    self-minted Secret, annotates the VWC for cainjector, and omits the
+    static caBundle; helm mode (default) keeps the minted path."""
+    path = os.path.join(CHART, "templates", "webhook.yaml")
+    with open(path, encoding="utf-8") as f:
+        template = f.read()
+
+    default_docs = [d for d in yaml.safe_load_all(
+        MiniHelm(dict(values)).render(template)) if d]
+    assert {"Secret", "Deployment", "Service",
+            "ValidatingWebhookConfiguration"} == {d["kind"] for d in default_docs}
+
+    vals = dict(values)
+    vals["webhook"] = {**vals["webhook"], "tls": {"mode": "cert-manager"}}
+    docs = [d for d in yaml.safe_load_all(MiniHelm(vals).render(template)) if d]
+    kinds = {d["kind"] for d in docs}
+    assert "Issuer" in kinds and "Certificate" in kinds
+    assert "Secret" not in kinds  # cert-manager owns the secret
+    cert = next(d for d in docs if d["kind"] == "Certificate")
+    assert cert["spec"]["secretName"] == "test-webhook-tls"  # pod mounts it
+    vwc = next(d for d in docs if d["kind"] == "ValidatingWebhookConfiguration")
+    assert vwc["metadata"]["annotations"]["cert-manager.io/inject-ca-from"] \
+        == "tpu-dra-driver/test-webhook"
+    assert "caBundle" not in vwc["webhooks"][0]["clientConfig"]
