@@ -35,10 +35,14 @@ class VfioPciManager:
         writes in-process — the ALT_PROC_DEVICES_PATH-style seam (reference
         internal/common/nvcaps.go:33-75). It must stay False against any
         *real* sysfs, relocated or not (e.g. /host/sys in a containerized
-        plugin), where the kernel itself reacts."""
+        plugin), where the kernel itself reacts. The real plugin binaries
+        opt in via ALT_TPU_VFIO_FIXTURE=1 (explicit — never inferred from
+        the sysfs path, which legitimately differs in containers)."""
         self.sysfs_root = sysfs_root or os.environ.get("ALT_TPU_SYSFS_ROOT", "/sys")
         self.dev_root = dev_root or os.environ.get("ALT_TPU_DEV_ROOT", "/dev")
-        self._fixture_kernel_on = fixture_kernel
+        self._fixture_kernel_on = (
+            fixture_kernel or os.environ.get("ALT_TPU_VFIO_FIXTURE") == "1"
+        )
 
     # -- sysfs paths ----------------------------------------------------------
 

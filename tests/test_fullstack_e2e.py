@@ -470,3 +470,131 @@ def test_leader_election_failover(stack):
     _wait(lambda: lease_holder() is not None, msg="lease held after failover")
     assert c2.proc.poll() is None
     del holder_before  # identity strings are host-derived; equality is not guaranteed
+
+
+def test_full_stack_sharing_and_vfio_over_grpc(stack, tmp_path):
+    """Round-4 subsystems over the production-shaped path: premapped-HBM
+    enforcement and VFIO rebind driven through the real tpu-kubelet-plugin
+    binary via its gRPC kubelet socket against the kubernetes backend."""
+    import shutil
+    import tempfile
+
+    import yaml
+
+    from k8s_dra_driver_tpu.api.configs import API_VERSION
+    from k8s_dra_driver_tpu.k8s.core import (
+        DeviceClaimConfig,
+        DeviceRequest,
+        OpaqueDeviceConfig,
+    )
+    from k8s_dra_driver_tpu.plugins.tpu.vfiosysfs import build_vfio_sysfs
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    tmp = stack.tmp
+    sock = tempfile.mkdtemp(prefix="fsv-")
+
+    # VFIO mock-sysfs fixture the plugin binary will operate on (explicit
+    # env opt-in for the fixture kernel — never inferred from paths).
+    sys_root = os.path.join(tmp, "sysfs")
+    dev_root = os.path.join(tmp, "dev")
+    build_vfio_sysfs(sys_root, dev_root, MockTpuLib("v5e-4").enumerate().chips)
+
+    tpu_env = {
+        **_plugin_dirs(tmp, "tpu"),
+        "NODE_NAME": NODE_NAME,
+        "FEATURE_GATES": ("TimeSlicingSettings=true,PremappedBufferSharing=true,"
+                          "PassthroughSupport=true"),
+        "ALT_TPU_SYSFS_ROOT": sys_root,
+        "ALT_TPU_DEV_ROOT": dev_root,
+        "ALT_TPU_VFIO_FIXTURE": "1",
+    }
+    stack.spawn(
+        "tpu-plugin", "k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin",
+        "--kubelet-plugin-dir", f"{sock}/tkp", "--registrar-dir", f"{sock}/treg",
+        env_extra=tpu_env)
+    procs = stack.watch_procs()
+    stack.kube.create(DeviceClass(
+        meta=new_meta("vfio.tpu.google.com"), driver=TPU_DRIVER_NAME,
+        cel_selectors=['device.driver == "tpu.google.com" && '
+                       'device.attributes["tpu.google.com"].type == "vfio"'],
+    ))
+
+    _wait(lambda: any(s.driver == TPU_DRIVER_NAME
+                      for s in stack.kube.list(RESOURCE_SLICE)),
+          msg="slice published", procs=procs)
+    kubelet = FakeKubelet(f"{sock}/treg")
+    _wait(lambda: kubelet.discover_sockets(), msg="registration socket",
+          procs=procs)
+    ep = kubelet.get_info(kubelet.discover_sockets()[0]).endpoint
+    kubelet.notify_registered(kubelet.discover_sockets()[0])
+
+    def premap_cfg(budget):
+        return DeviceClaimConfig(
+            requests=["tpus"], source="claim",
+            opaque=OpaqueDeviceConfig(
+                driver=TPU_DRIVER_NAME,
+                parameters={
+                    "apiVersion": API_VERSION, "kind": "TpuConfig",
+                    "sharing": {"strategy": "Premapped",
+                                "premapped": {"default_premapped_hbm_bytes": budget}},
+                },
+            ))
+
+    # Over-budget premapped (32 GiB on a 16 GiB chip): refused at Prepare
+    # through the gRPC seam, with the enforcement message on the wire.
+    hog = stack.kube.create(ResourceClaim(
+        meta=new_meta("hog", CD_NS),
+        requests=[DeviceRequest(name="tpus",
+                                device_class_name=DEVICE_CLASS_TPU, count=1)],
+        config=[premap_cfg(32 << 30)],
+    ))
+    hog = stack.schedule(hog)
+    resp = kubelet.node_prepare(ep, [hog], "v1")
+    assert "exceeds HBM" in resp.claims[hog.uid].error
+
+    # A sane budget prepares; the CDI spec carries the byte limit.
+    ok = stack.kube.create(ResourceClaim(
+        meta=new_meta("sane", CD_NS),
+        requests=[DeviceRequest(name="tpus",
+                                device_class_name=DEVICE_CLASS_TPU, count=1)],
+        config=[premap_cfg(4 << 30)],
+    ))
+    ok = stack.schedule(ok)
+    resp = kubelet.node_prepare(ep, [ok], "v1")
+    assert resp.claims[ok.uid].error == "", resp.claims[ok.uid].error
+    cdi_dir = tpu_env["CDI_ROOT"]
+    spec = yaml.safe_load(open(os.path.join(
+        cdi_dir, next(f for f in os.listdir(cdi_dir) if ok.uid in f))))
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    assert f"TPU_PREMAPPED_BUFFER_BYTES={4 << 30}" in envs
+
+    # VFIO passthrough over the same socket: bind happens in the fixture
+    # sysfs, the group node is injected, and unprepare releases the chip.
+    vm = stack.kube.create(ResourceClaim(
+        meta=new_meta("vm", CD_NS),
+        requests=[DeviceRequest(name="tpus",
+                                device_class_name="vfio.tpu.google.com", count=1)],
+    ))
+    vm = stack.schedule(vm)
+    resp = kubelet.node_prepare(ep, [vm], "v1")
+    assert resp.claims[vm.uid].error == "", resp.claims[vm.uid].error
+    spec = yaml.safe_load(open(os.path.join(
+        cdi_dir, next(f for f in os.listdir(cdi_dir) if vm.uid in f))))
+    nodes = [n["path"] for d in spec["devices"]
+             for n in d["containerEdits"].get("deviceNodes", [])]
+    assert len(nodes) == 1 and "/vfio/" in nodes[0], nodes
+    assert os.path.exists(nodes[0])
+
+    from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
+    mgr = VfioPciManager(sysfs_root=sys_root, dev_root=dev_root)
+    bound_addr = next(
+        a for a in (f"0000:00:{4 + i:02x}.0" for i in range(4))
+        if mgr.current_driver(a) == "vfio-pci"
+    )
+    resp = kubelet.node_unprepare(ep, [vm], "v1")
+    assert resp.claims[vm.uid].error == ""
+    assert mgr.current_driver(bound_addr) == "accel-tpu"
+    assert not os.path.exists(nodes[0])
+
+    kubelet.node_unprepare(ep, [ok], "v1")
+    shutil.rmtree(sock, ignore_errors=True)
