@@ -231,6 +231,101 @@ def check_flash_numerics() -> dict:
     }
 
 
+def bench_grpc_prepare(iters: int = 40) -> dict:
+    """Production-shaped prepare latency: the real tpu-kubelet-plugin
+    binary against the conformance apiserver, driven through its gRPC
+    kubelet socket (registration + NodePrepareResources/Unprepare) — the
+    exact seam a kubelet exercises, including the claim fetch over the
+    wire, flock, checkpoint fsync, and CDI write."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import shutil
+
+    from k8s_dra_driver_tpu.k8s.core import DeviceRequest, Node, ResourceClaim
+    from k8s_dra_driver_tpu.k8s.kubeclient import KubernetesAPIServer
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.sim.allocator import Allocator
+    from tests.test_kubelet_grpc import FakeKubelet
+
+    tmp = tempfile.mkdtemp(prefix="bgrpc-")
+    sock = tempfile.mkdtemp(prefix="bgs-")  # unix paths are length-capped
+    procs = []
+    try:
+        boot = os.path.join(tmp, "boot_id")
+        with open(boot, "w") as f:
+            f.write("bench-boot\n")
+        env = {**os.environ, "ALT_TPU_TOPOLOGY": "v5e-4",
+               "ALT_TPU_BOOT_ID_PATH": boot, "PYTHONPATH": os.getcwd()}
+        apiserver = subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.k8s.k8sapiserver",
+             "--port", "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(apiserver)
+        line = apiserver.stdout.readline()
+        url = line.strip().split()[-1]
+        kube = KubernetesAPIServer(base_url=url)
+        kube.create(Node(meta=new_meta("bench-node")))
+        from k8s_dra_driver_tpu.controller.templates import DEVICE_CLASS_TPU
+        from k8s_dra_driver_tpu.k8s.core import DeviceClass
+        kube.create(DeviceClass(meta=new_meta(DEVICE_CLASS_TPU),
+                                driver="tpu.google.com"))
+        plugin = subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.tpu_kubelet_plugin",
+             "--kubelet-plugin-dir", f"{sock}/kp",
+             "--registrar-dir", f"{sock}/reg"],
+            env={**env, "API_BACKEND": "kubernetes", "API_SERVER_URL": url,
+                 "NODE_NAME": "bench-node",
+                 "PLUGIN_DIR": os.path.join(tmp, "plugin"),
+                 "CDI_ROOT": os.path.join(tmp, "cdi")},
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        procs.append(plugin)
+        kubelet = FakeKubelet(f"{sock}/reg")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not kubelet.discover_sockets():
+            time.sleep(0.2)
+        socks = kubelet.discover_sockets()
+        assert socks, "plugin registration socket never appeared"
+        ep = kubelet.get_info(socks[0]).endpoint
+        kubelet.notify_registered(socks[0])
+        alloc = Allocator(kube)
+        lat = []
+        for i in range(iters):
+            claim = kube.create(ResourceClaim(
+                meta=new_meta(f"bench-{i}", "default"),
+                requests=[DeviceRequest(name="t", device_class_name=DEVICE_CLASS_TPU,
+                                        count=1)],
+            ))
+            a = alloc.allocate_on_node(claim, "bench-node")
+
+            def set_alloc(obj, a=a):
+                obj.allocation = a
+            claim = kube.update_with_retry(
+                "ResourceClaim", claim.meta.name, "default", set_alloc)
+            t0 = time.perf_counter()
+            resp = kubelet.node_prepare(ep, [claim], "v1")
+            dt = time.perf_counter() - t0
+            assert resp.claims[claim.uid].error == "", resp.claims[claim.uid].error
+            lat.append(dt)
+            kubelet.node_unprepare(ep, [claim], "v1")
+            kube.delete("ResourceClaim", claim.meta.name, "default")
+        return {
+            "grpc_prepare_p50_ms": round(statistics.median(lat) * 1e3, 3),
+            "grpc_prepare_p99_ms": round(sorted(lat)[int(0.99 * len(lat))] * 1e3, 3),
+            "grpc_prepare_iters": iters,
+        }
+    finally:
+        for p in reversed(procs):
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(sock, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_psum(size_mib: float = 64.0, iters: int = 100, runs: int = 3) -> dict:
     import gc
 
@@ -268,6 +363,10 @@ def main() -> None:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["claim_to_running_error"] = str(e)[:200]
+    try:
+        result.update(bench_grpc_prepare())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["grpc_prepare_error"] = str(e)[:200]
     try:
         result.update(bench_flagship_step())
     except Exception as e:  # noqa: BLE001 — flagship extras are best-effort
