@@ -306,6 +306,16 @@ class SimCluster:
         return claims
 
     def _scheduler_pass(self) -> None:
+        # One snapshot of slices + existing allocations per pass; every
+        # allocation written during the pass is recorded via
+        # allocator.commit(), so the snapshot cannot double-book.
+        self.allocator.begin_pass()
+        try:
+            self._scheduler_pass_inner()
+        finally:
+            self.allocator.end_pass()
+
+    def _scheduler_pass_inner(self) -> None:
         for pod in self.api.list(POD):
             if pod.phase != "Pending":
                 continue
@@ -374,6 +384,7 @@ class SimCluster:
                             self.api.update_with_retry(
                                 RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
                             )
+                            self.allocator.commit(r)
                         chosen = node
                         placed = True
                         break

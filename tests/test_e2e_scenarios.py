@@ -336,6 +336,55 @@ spec:
         sim.stop()
 
 
+def test_scale_64_hosts_claim_storm(tmp_path):
+    """Cluster-scale pass: 64 hosts / 256 chips (four v5e-64 slices), 128
+    single-chip pods in one storm — all run, no chip double-booked, and
+    the whole storm settles in seconds (the allocator's per-pass snapshot;
+    this took ~115 s before it)."""
+    import time
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-64", num_hosts=64)
+    sim.start()
+    try:
+        for obj in load_manifests("""
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: one, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""):
+            sim.api.create(obj)
+        for i in range(128):
+            for obj in load_manifests(f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: p{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: one}}]
+"""):
+                sim.api.create(obj)
+        t0 = time.perf_counter()
+        sim.settle(max_steps=200)
+        elapsed = time.perf_counter() - t0
+        pods = sim.api.list(POD)
+        assert len(pods) == 128
+        assert all(p.phase == "Running" for p in pods), [
+            (p.meta.name, p.phase) for p in pods if p.phase != "Running"]
+        seen = set()
+        for c in sim.api.list(RESOURCE_CLAIM):
+            for d in (c.allocation.devices if c.allocation else []):
+                key = (c.allocation.node_name, d.device)
+                assert key not in seen, f"double-booked {key}"
+                seen.add(key)
+        assert len(seen) == 128
+        assert elapsed < 30, f"storm took {elapsed:.1f}s — snapshot regressed?"
+    finally:
+        sim.stop()
+
+
 def test_scale_16_hosts_claim_churn(tmp_path):
     """Scale pass (test_gpu_stress.bats at cluster size): 16 single-host
     slices / 64 chips; 48 single-chip pods all run; full churn then 16
