@@ -136,7 +136,8 @@ def bench_flagship_step(iters: int = 30) -> dict:
     return out
 
 
-def bench_claim_to_running(iters: int = 30) -> dict:
+def bench_claim_to_running(iters: int = 30, profile: str = "v5e-4",
+                           num_hosts=None, key: str = "claim_to_running") -> dict:
     """BASELINE.md headline: ResourceClaim-to-Running p50 — wall time from
     pod+claim creation to phase Running through the whole control plane
     (scheduler pass, structured-parameters allocation, plugin Prepare with
@@ -157,7 +158,7 @@ spec:
 """
     lat = []
     with tempfile.TemporaryDirectory() as tmp:
-        sim = SimCluster(workdir=tmp, profile="v5e-4")
+        sim = SimCluster(workdir=tmp, profile=profile, num_hosts=num_hosts)
         sim.start()
         try:
             for obj in load_manifests(rct):
@@ -189,9 +190,9 @@ spec:
             sim.stop()
     p50 = statistics.median(lat)
     return {
-        "claim_to_running_p50_ms": round(p50 * 1e3, 2),
-        "claim_to_running_max_ms": round(max(lat) * 1e3, 2),
-        "claim_to_running_iters": iters,
+        f"{key}_p50_ms": round(p50 * 1e3, 2),
+        f"{key}_max_ms": round(max(lat) * 1e3, 2),
+        f"{key}_iters": iters,
     }
 
 
@@ -363,6 +364,14 @@ def main() -> None:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["claim_to_running_error"] = str(e)[:200]
+    try:
+        # Control-plane scalability: same latency question on a 64-node /
+        # 256-chip cluster — flat p50 proves the control loops are
+        # O(cluster), not O(pods x nodes).
+        result.update(bench_claim_to_running(
+            iters=15, profile="v5e-64", num_hosts=64, key="claim_to_running_64n"))
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["claim_to_running_64n_error"] = str(e)[:200]
     try:
         result.update(bench_grpc_prepare())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
