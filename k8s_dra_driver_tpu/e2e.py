@@ -167,6 +167,16 @@ def check_cd_multi(sim: SimCluster, _pods) -> None:
         _expect(p.injected_env.get("TPU_TOPOLOGY") == "4x4", "slice topology")
 
 
+def check_selectors(sim: SimCluster, _pods) -> None:
+    pods = {p.meta.name: p for p in _running_pods(sim, "selectors")}
+    _expect(set(pods) == {"pinned", "roomy"}, f"pods: {sorted(pods)}")
+    _expect(pods["pinned"].injected_env.get("TPU_VISIBLE_CHIPS") == "2",
+            f"request selector must pin chip 2, got "
+            f"{pods['pinned'].injected_env.get('TPU_VISIBLE_CHIPS')}")
+    _expect(bool(pods["roomy"].injected_env.get("TPU_VISIBLE_CHIPS")),
+            "capacity-selected pod must hold a chip")
+
+
 def check_allreduce_job(sim: SimCluster, _pods) -> None:
     """The nvbandwidth-analog proof job: every indexed worker must land on
     its own host with the full env allreduce_bench needs to bootstrap
@@ -212,6 +222,8 @@ SCENARIOS: Dict[str, Scenario] = {
                  check=check_cd_multi),
         Scenario("allreduce-job", "computedomain/allreduce-job.yaml",
                  check=check_allreduce_job),
+        Scenario("selectors", "selectors/selectors.yaml",
+                 profile="v5e-4", check=check_selectors),
     )
 }
 
