@@ -177,6 +177,28 @@ def check_selectors(sim: SimCluster, _pods) -> None:
             "capacity-selected pod must hold a chip")
 
 
+def check_subslice_sharing(sim: SimCluster, _pods) -> None:
+    pods = {p.meta.name: p for p in _running_pods(sim, "subslice-sharing")}
+    _expect(set(pods) == {"sharer-0", "sharer-1", "neighbor"},
+            f"pods: {sorted(pods)}")
+    s0, s1 = pods["sharer-0"], pods["sharer-1"]
+    _expect(s0.injected_devices == s1.injected_devices and
+            len(s0.injected_devices) == 2,
+            f"sharers must see the same two chips: "
+            f"{s0.injected_devices} vs {s1.injected_devices}")
+    for p in (s0, s1):
+        _expect(p.injected_env.get("TPU_TIMESLICE_US") == "10000",
+                f"{p.meta.name}: Medium interval env missing: "
+                f"{p.injected_env.get('TPU_TIMESLICE_US')}")
+        _expect(p.injected_env.get("TPU_CHIPS_PER_PROCESS_BOUNDS") == "1,2,1",
+                "subslice bounds env missing")
+    shared = set(s0.injected_env["TPU_VISIBLE_CHIPS"].split(","))
+    neighbor_chips = set(pods["neighbor"].injected_env["TPU_VISIBLE_CHIPS"].split(","))
+    _expect(not (shared & neighbor_chips),
+            f"neighbor must not overlap the shared subslice: "
+            f"{shared} vs {neighbor_chips}")
+
+
 def check_allreduce_job(sim: SimCluster, _pods) -> None:
     """The nvbandwidth-analog proof job: every indexed worker must land on
     its own host with the full env allreduce_bench needs to bootstrap
@@ -224,6 +246,9 @@ SCENARIOS: Dict[str, Scenario] = {
                  check=check_allreduce_job),
         Scenario("selectors", "selectors/selectors.yaml",
                  profile="v5e-4", check=check_selectors),
+        Scenario("subslice-sharing", "subslice-sharing/sharing.yaml",
+                 profile="v5e-4", gates="TimeSlicingSettings=true",
+                 check=check_subslice_sharing),
     )
 }
 
