@@ -81,6 +81,19 @@ def check_test3(sim: SimCluster, _pods) -> None:
     _expect(len(pods[0].injected_devices) == 2, "1x2 subslice = 2 device nodes")
 
 
+def check_test3_dynamic(sim: SimCluster, _pods) -> None:
+    check_test3(sim, _pods)  # same workload-visible contract...
+    # ...plus the Prepare really carved an ICI partition in the ledger
+    # (the DynamicMIG-analog path, reference nvlib.go:971-1199).
+    pods = sim.api.list(POD, namespace="tpu-test3")
+    node = sim.nodes[pods[0].node_name]
+    partitions = node.tpu_driver.state.partitions
+    _expect(partitions is not None, "DynamicSubslice gate must wire a manager")
+    active = partitions.active_partitions()
+    _expect(any(p.profile == "1x2" for p in active),
+            f"no active 1x2 partition in the ledger: {active}")
+
+
 def check_test4(sim: SimCluster, _pods) -> None:
     pods = _running_pods(sim, "tpu-test4")
     for p in pods:
@@ -229,6 +242,9 @@ SCENARIOS: Dict[str, Scenario] = {
         Scenario("tpu-test1", "quickstart/tpu-test1.yaml", check=check_test1),
         Scenario("tpu-test2", "quickstart/tpu-test2.yaml", check=check_test2),
         Scenario("tpu-test3", "quickstart/tpu-test3.yaml", check=check_test3),
+        Scenario("tpu-test3-dynamic", "quickstart/tpu-test3.yaml",
+                 gates="DynamicSubslice=true,ICIPartitioning=true",
+                 check=check_test3_dynamic),
         Scenario("tpu-test4", "quickstart/tpu-test4.yaml",
                  gates="TimeSlicingSettings=true", check=check_test4),
         Scenario("tpu-test5", "quickstart/tpu-test5.yaml", check=check_test5),
