@@ -18,8 +18,11 @@ obvious neighbors):
     literals: 'str' "str" ints (incl. negative) true false
     operators: == != < <= > >= && || !  and parentheses
 
-Missing attributes make comparisons false (`!=` true) rather than raising,
-mirroring how an unset attribute can never satisfy a selector.
+Missing attributes make *every* comparison false — including `!=`. Real
+cel-go errors on a missing-key access and DRA treats an erroring selector
+as non-matching, so "absent attribute → device does not match" is the
+faithful net behavior (a `!= -> true` convenience would match devices in
+sim that a real scheduler would reject).
 """
 
 from __future__ import annotations
@@ -125,7 +128,9 @@ class _Compiler:
         def compare(d, lhs=lhs, rhs=rhs, op=op):
             a, b = lhs(d), rhs(d)
             if isinstance(a, _Missing) or isinstance(b, _Missing):
-                return op == "!="
+                # cel-go errors here and DRA counts the device as
+                # non-matching — so every operator, != included, is false.
+                return False
             # CEL compares like-typed values; coerce int-vs-str-of-int
             # since attribute wire values may arrive as strings.
             if isinstance(a, int) != isinstance(b, int):

@@ -47,10 +47,14 @@ def test_boolean_operators_and_parens():
 
 
 def test_missing_attributes_never_match():
+    """cel-go errors on a missing-key access and DRA treats the selector as
+    non-matching — every operator on an absent attribute is false, != too
+    (a `!= -> true` convenience would match devices a real scheduler
+    rejects)."""
     d = dev()
     assert not evaluate('device.attributes["nope"] == "x"', d)
     assert not evaluate('device.attributes["nope"] == 0', d)
-    assert evaluate('device.attributes["nope"] != "x"', d)  # CEL-ish absent
+    assert not evaluate('device.attributes["nope"] != "x"', d)
 
 
 def test_qualified_attribute_domain():
