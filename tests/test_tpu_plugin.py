@@ -342,6 +342,27 @@ def test_health_event_taints_and_republishes(env):
     assert not any(d.taints for d in rs.devices)
 
 
+def test_health_event_taints_vfio_sibling(tmp_path, boot_id):
+    """A sick chip's VFIO passthrough sibling shares the silicon and must
+    taint with it — handing the function to a VM doesn't make it healthy."""
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    driver = TpuDriver(
+        api=api, node_name=NODE, tpulib=lib,
+        plugin_dir=str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("TPUDeviceHealthCheck=true,PassthroughSupport=true"),
+    )
+    driver.start()
+    try:
+        lib.set_health(2, ChipHealth.UNHEALTHY)
+        rs = api.list(RESOURCE_SLICE)[0]
+        tainted = {d.name for d in rs.devices if d.taints}
+        assert {"tpu-2", "tpu-2-vfio"} <= tainted
+        assert "tpu-1-vfio" not in tainted
+    finally:
+        driver.shutdown()
+
+
 # -- stale cleanup ------------------------------------------------------------
 
 def test_cleanup_stale_claims(env):
