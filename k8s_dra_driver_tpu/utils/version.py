@@ -2,13 +2,33 @@
 
 from __future__ import annotations
 
+import os
 import platform
 
 from k8s_dra_driver_tpu import __version__
 
 
+def release_version() -> str:
+    """The release semver, v-prefixed. Single source is the repo-root
+    VERSION file (what versions.mk and the release automation read); the
+    package __version__ is the fallback when the file isn't shipped (e.g.
+    a pip-style install)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "VERSION",
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            v = f.read().strip()
+            if v:
+                return v if v.startswith("v") else f"v{v}"
+    except OSError:
+        pass
+    return f"v{__version__}"
+
+
 def version_string(component: str) -> str:
     return (
-        f"{component} v{__version__} "
+        f"{component} {release_version()} "
         f"(python {platform.python_version()}, {platform.system().lower()}/{platform.machine()})"
     )

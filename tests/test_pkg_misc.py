@@ -248,3 +248,18 @@ def test_native_slice_ctl_probe(tmp_path):
     out = subprocess.run([ctl, "-q", "-f", str(ready), "-t", "0"],
                          capture_output=True, text=True, timeout=10)
     assert out.returncode == 0 and out.stdout.strip() == "READY"
+
+
+def test_version_single_sourced_from_version_file():
+    """The --version output must agree with the repo-root VERSION file (the
+    same source versions.mk and the release automation read), so a release
+    bump cannot drift from what the binaries report."""
+    import os
+
+    from k8s_dra_driver_tpu.utils.version import release_version, version_string
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "VERSION"), encoding="utf-8") as f:
+        want = f.read().strip()
+    assert release_version() == want
+    assert want in version_string("tpu-kubelet-plugin")
