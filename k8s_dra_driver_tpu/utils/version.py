@@ -22,7 +22,7 @@ def release_version() -> str:
             v = f.read().strip()
             if v:
                 return v if v.startswith("v") else f"v{v}"
-    except OSError:
+    except (OSError, UnicodeDecodeError):
         pass
     return f"v{__version__}"
 
