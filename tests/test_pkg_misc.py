@@ -254,8 +254,6 @@ def test_version_single_sourced_from_version_file():
     """The --version output must agree with the repo-root VERSION file (the
     same source versions.mk and the release automation read), so a release
     bump cannot drift from what the binaries report."""
-    import os
-
     from k8s_dra_driver_tpu.utils.version import release_version, version_string
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
