@@ -75,13 +75,14 @@ class SliceProofConfig:
         large, bf16, static — dims multiples of 128 so XLA tiles cleanly
         onto the systolic array. Shape chosen by the measured r4 sweep
         (ops/mfu_sweep.py; table in docs/benchmarks.md): d_model 2048 with
-        a ratio-8 FFN (d_ff 16384) hits 65.4% MFU on v5e vs 54% at ratio 4
-        and 32% at d_model 1024 — the [2048×16384] GEMMs amortize weight
-        loads best. XLA's fused einsum attention beats the Pallas flash
-        kernel at this seq_len, so einsum stays the default;
-        attention="flash" is the long-sequence escape hatch and
+        a ratio-8 FFN (d_ff 16384) and 8 heads of head_dim 256 hits 76.4%
+        MFU on v5e — vs 65.4% at 16×128 heads (identical counted FLOPs;
+        the fatter per-head GEMMs tile the 128×128 MXU better), 54% at FFN
+        ratio 4, and 32% at d_model 1024. XLA's fused einsum attention
+        beats the Pallas flash kernel at this seq_len, so einsum stays the
+        default; attention="flash" is the long-sequence escape hatch and
         remat=True the HBM escape hatch (both cost reported MFU)."""
-        return cls(vocab=8192, d_model=2048, n_heads=16, n_layers=8,
+        return cls(vocab=8192, d_model=2048, n_heads=8, n_layers=8,
                    d_ff=16384, seq_len=1024)
 
 
