@@ -69,20 +69,21 @@ def measure_step(cfg, batch_per_replica: int, iters: int) -> dict:
 
 
 def default_grid(base) -> list:
-    """(cfg, batch_per_replica) pairs: batch/remat/seq/attention/width axes."""
+    """(cfg, batch_per_replica) pairs exploring around the shipped bench
+    shape (r4 winner: d2048 / ff16384 / 8×256 heads — the
+    batch/remat/seq/attention/head/width axes; full r4 findings in
+    docs/benchmarks.md)."""
     r = dataclasses.replace
     return [
         (base, 4),                                        # bench.py today
         (base, 8),                                        # amortize weights
+        (r(base, n_heads=16), 4),                         # head_dim 128 (r4 -11 pts)
+        (r(base, n_heads=4), 4),                          # head_dim 512 (headroom)
         (r(base, remat=True), 8),                         # remat buys batch
-        (r(base, remat=True), 16),
         (r(base, seq_len=2048), 4),                       # longer sequence
         (r(base, seq_len=2048, attention="flash"), 4),    # flash at 2k
-        (r(base, seq_len=2048, attention="flash", remat=True), 8),
-        (r(base, d_ff=16384), 4),                         # fatter FFN (ratio 8)
-        (r(base, d_ff=16384), 8),
-        (r(base, d_model=3072, d_ff=12288, n_heads=24), 4),   # wider model
-        (r(base, d_model=3072, d_ff=12288, n_heads=24), 8),
+        (r(base, d_ff=8192), 4),                          # FFN ratio 4 (r4 -11 pts)
+        (r(base, d_model=3072, d_ff=24576, n_heads=12), 4),   # wider, ratio 8
     ]
 
 
