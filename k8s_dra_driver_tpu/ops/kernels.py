@@ -1,10 +1,19 @@
-"""Pallas TPU kernels for the workload hot path.
+"""Pallas TPU kernels, written against the MXU/VMEM model from the Pallas
+TPU guide: a fused RMSNorm (one VMEM round-trip instead of three HBM-bound
+elementwise passes; differentiable via an analytical custom VJP) and a
+tiled matmul with float32 accumulation feeding the MXU in (8,128)-aligned
+blocks. Off-TPU the kernels run in interpreter mode so CPU CI tests the
+same code path.
 
-Two fused kernels the flagship workload leans on, written against the MXU/
-VMEM model from the Pallas TPU guide: a fused RMSNorm (one VMEM round-trip
-instead of three HBM-bound elementwise passes) and a tiled matmul with
-float32 accumulation feeding the MXU in (8,128)-aligned blocks. Off-TPU the
-kernels run in interpreter mode so CPU CI tests the same code path.
+Where they're used — and deliberately not: the models keep plain-jnp
+RMSNorm in their *training* graphs because an A/B on v5e (r4, bench
+config) measured the pallas version ~2% slower there — a pallas_call is a
+fusion barrier, and XLA otherwise fuses the norm into its neighbors. The
+kernel earns its keep standalone (inference-style whole-op use, where the
+single VMEM pass beats three unfused HBM passes) and as the in-repo
+reference for the Pallas authoring pattern. The flagship's TPU kernel in
+the training hot path is flash attention (9.3× over einsum at seq 8k,
+docs/benchmarks.md).
 """
 
 from __future__ import annotations
@@ -31,18 +40,7 @@ def _rmsnorm_kernel(x_ref, g_ref, o_ref, *, eps: float):
     o_ref[...] = (x * r * g_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows", "eps", "interpret"))
-def rmsnorm(
-    x: jax.Array,
-    gain: jax.Array,
-    *,
-    block_rows: int = 256,
-    eps: float = 1e-6,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """RMSNorm over the last dim. x: [..., d]; gain: [d]."""
-    if interpret is None:
-        interpret = not _on_tpu()
+def _rmsnorm_forward(x, gain, eps: float, block_rows: int, interpret: bool):
     orig_shape = x.shape
     d = orig_shape[-1]
     rows = 1
@@ -66,6 +64,53 @@ def rmsnorm(
         interpret=interpret,
     )(x2, gain)
     return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rmsnorm_cv(x, gain, eps, block_rows, interpret):
+    return _rmsnorm_forward(x, gain, eps, block_rows, interpret)
+
+
+def _rmsnorm_cv_fwd(x, gain, eps, block_rows, interpret):
+    return _rmsnorm_forward(x, gain, eps, block_rows, interpret), (x, gain)
+
+
+def _rmsnorm_cv_bwd(eps, block_rows, interpret, res, dy):
+    # Analytical backward in plain jnp (XLA fuses it): with
+    # r = rsqrt(mean(x²)+eps) and y = x·r·g,
+    #   dx = r·g·dy − x · r³/d · Σ_d(x·g·dy)
+    #   dg = Σ_rows(x·r·dy)
+    x, gain = res
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    gf = gain.astype(jnp.float32)
+    d = x.shape[-1]
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    gdy = gf * dyf
+    dx = r * gdy - xf * (r ** 3 / d) * jnp.sum(xf * gdy, axis=-1, keepdims=True)
+    dg = jnp.sum((xf * r) * dyf, axis=tuple(range(x.ndim - 1)))
+    return dx.astype(x.dtype), dg.astype(gain.dtype)
+
+
+_rmsnorm_cv.defvjp(_rmsnorm_cv_fwd, _rmsnorm_cv_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "eps", "interpret"))
+def rmsnorm(
+    x: jax.Array,
+    gain: jax.Array,
+    *,
+    block_rows: int = 256,
+    eps: float = 1e-6,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """RMSNorm over the last dim. x: [..., d]; gain: [d]. Differentiable:
+    the Pallas kernel runs the forward; the analytical VJP runs in plain
+    jnp (a pallas_call has no autodiff rule, so without this the kernel
+    would crash any training graph it appears in)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _rmsnorm_cv(x, gain, eps, block_rows, interpret)
 
 
 # -- tiled matmul ------------------------------------------------------------
