@@ -121,18 +121,7 @@ def _matmul_kernel(a_ref, b_ref, o_ref):
     ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
-def tiled_matmul(
-    a: jax.Array,
-    b: jax.Array,
-    *,
-    bm: int = 256,
-    bn: int = 256,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """a[M,K] @ b[K,N] with f32 accumulation, tiled (bm, bn) for the MXU."""
-    if interpret is None:
-        interpret = not _on_tpu()
+def _tiled_matmul_forward(a, b, bm: int, bn: int, interpret: bool):
     m, k = a.shape
     k2, n = b.shape
     assert k == k2, (a.shape, b.shape)
@@ -152,3 +141,40 @@ def tiled_matmul(
         out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
         interpret=interpret,
     )(a, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _tiled_matmul_cv(a, b, bm, bn, interpret):
+    return _tiled_matmul_forward(a, b, bm, bn, interpret)
+
+
+def _tiled_matmul_cv_fwd(a, b, bm, bn, interpret):
+    return _tiled_matmul_forward(a, b, bm, bn, interpret), (a, b)
+
+
+def _tiled_matmul_cv_bwd(bm, bn, interpret, res, dy):
+    # The matmul VJP is two matmuls — run them through the same kernel
+    # (transposes are free relayouts for XLA): dA = dY·Bᵀ, dB = Aᵀ·dY.
+    a, b = res
+    da = _tiled_matmul_forward(dy, b.T, bm, bn, interpret)
+    db = _tiled_matmul_forward(a.T, dy, bm, bn, interpret)
+    return da.astype(a.dtype), db.astype(b.dtype)
+
+
+_tiled_matmul_cv.defvjp(_tiled_matmul_cv_fwd, _tiled_matmul_cv_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def tiled_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    bm: int = 256,
+    bn: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """a[M,K] @ b[K,N] with f32 accumulation, tiled (bm, bn) for the MXU.
+    Differentiable: the VJP's two matmuls run through the same kernel."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _tiled_matmul_cv(a, b, bm, bn, interpret)

@@ -252,3 +252,18 @@ def test_rmsnorm_kernel_is_differentiable():
         lambda x, g: jnp.sum(jnp.sin(plain(x, g))), argnums=(0, 1))(x, g)
     np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(gg1), np.asarray(gg2), rtol=1e-4, atol=1e-5)
+
+
+def test_tiled_matmul_is_differentiable():
+    """The matmul VJP (dA = dY·Bᵀ, dB = Aᵀ·dY) runs through the same
+    kernel; grads must match jnp.dot's."""
+    import numpy as np
+
+    a = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(3), (32, 48), jnp.float32)
+    ga1, gb1 = jax.grad(
+        lambda a, b: jnp.sum(jnp.sin(tiled_matmul(a, b))), argnums=(0, 1))(a, b)
+    ga2, gb2 = jax.grad(
+        lambda a, b: jnp.sum(jnp.sin(a @ b)), argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(ga1), np.asarray(ga2), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb1), np.asarray(gb2), rtol=1e-4, atol=1e-5)
