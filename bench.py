@@ -335,22 +335,22 @@ def bench_psum(size_mib: float = 64.0, iters: int = 100, runs: int = 3) -> dict:
     # The flagship leg's train state (GBs of HBM) lives in uncollected
     # reference cycles after its function returns, and the remote backend
     # releases device memory lazily; a full HBM throttles the psum pass
-    # ~4-10x (measured 110 vs ~1070 GB/s). Collect host-side, then take
-    # the best of `runs` — early runs can still see the crowded HBM while
-    # the allocator drains, and this metric measures fabric capability,
-    # not allocator ramp (median reported alongside for honesty).
+    # ~4-10x (measured 110 vs ~1070 GB/s). Collect host-side, run `runs`
+    # times, and headline the MEDIAN — typical fabric throughput, robust
+    # against both the crowded-HBM ramp on the low side and a lucky run
+    # on the high side. The best run is kept as an explicit ceiling.
     gc.collect()
     results = [psum_bandwidth(size_mib=size_mib, iters=iters) for _ in range(runs)]
     results.sort(key=lambda r: r["value"])
-    best = results[-1]
+    median = results[len(results) // 2]
     return {
-        "psum_bus_gb_per_s": best["value"],
-        "psum_bus_gb_per_s_median": results[len(results) // 2]["value"],
+        "psum_bus_gb_per_s": median["value"],
+        "psum_bus_gb_per_s_best": results[-1]["value"],
         "psum_runs": runs,
-        "psum_n_devices": best["n_devices"],
-        "psum_size_mib_per_device": best["size_mib_per_device"],
-        "psum_time_ms": best["time_per_allreduce_ms"],
-        "psum_platform": best["platform"],
+        "psum_n_devices": median["n_devices"],
+        "psum_size_mib_per_device": median["size_mib_per_device"],
+        "psum_time_ms": median["time_per_allreduce_ms"],
+        "psum_platform": median["platform"],
     }
 
 

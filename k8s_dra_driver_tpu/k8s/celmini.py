@@ -14,21 +14,31 @@ obvious neighbors):
 
     device.driver, device.attributes["key"],
     device.attributes["domain"].name   (-> flat "domain/name" lookup),
-    device.capacity["key"]
+    device.capacity["key"], device.capacity["domain"].name
+    quantity("16Gi") and the k8s CEL quantity methods
+      .isGreaterThan(q) .isLessThan(q) .isEqualTo(q) .compareTo(q)
     literals: 'str' "str" ints (incl. negative) true false
     operators: == != < <= > >= && || !  and parentheses
+
+Operator precedence follows cel-go: unary `!` binds tighter than the
+comparison operators (`!a == b` is `(!a) == b`), comparisons bind tighter
+than `&&`, which binds tighter than `||`.
 
 Missing attributes make *every* comparison false — including `!=`. Real
 cel-go errors on a missing-key access and DRA treats an erroring selector
 as non-matching, so "absent attribute → device does not match" is the
 faithful net behavior (a `!= -> true` convenience would match devices in
-sim that a real scheduler would reject).
+sim that a real scheduler would reject). The same rule applies to
+unlike-typed comparisons that can't be numerically coerced (cel-go
+type-errors; we return non-match) and to quantity methods over
+unparseable operands.
 """
 
 from __future__ import annotations
 
 import functools
 import re
+from fractions import Fraction
 from typing import Any, Callable, List, Optional
 
 
@@ -60,7 +70,14 @@ def _tokenize(expr: str) -> List[str]:
 
 
 class _Missing:
-    """Sentinel for absent attributes: comparisons never match."""
+    """Sentinel for absent attributes / type errors: never matches.
+
+    Falsy so that `&&` / `||` short-circuits agree with cel-go's net
+    effect (an erroring operand can only make the selector non-matching,
+    never matching)."""
+
+    def __bool__(self) -> bool:
+        return False
 
     def __repr__(self) -> str:  # pragma: no cover — debug aid
         return "<missing>"
@@ -73,6 +90,51 @@ _Fn = Callable[[Any], Any]  # compiled node: device -> value
 
 def _is_int(tok: str) -> bool:
     return tok.lstrip("-").isdigit() and tok != "-"
+
+
+# Kubernetes resource.Quantity suffixes (binary + decimal + milli), the
+# subset CEL's quantity("...") accepts that selectors realistically use.
+_QTY_SUFFIX = {
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60, "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "P": 10**15, "E": 10**18, "": 1,
+}
+_QTY_RE = re.compile(r"^\s*([+-]?\d+(?:\.\d+)?)([KMGTPE]i|[kMGTPE]|m|)\s*$")
+
+
+def parse_quantity(s) -> Fraction:
+    """Parse a k8s quantity ("16Gi", "500m", "2", 17179869184) to an
+    exact Fraction. Raises ValueError on anything unparseable."""
+    if isinstance(s, bool):
+        raise ValueError(f"not a quantity: {s!r}")
+    if isinstance(s, (int, Fraction)):
+        return Fraction(s)
+    m = _QTY_RE.match(str(s))
+    if not m:
+        raise ValueError(f"not a quantity: {s!r}")
+    num, suffix = m.groups()
+    if suffix == "m":
+        return Fraction(num) / 1000
+    return Fraction(num) * _QTY_SUFFIX[suffix]
+
+
+_QTY_METHODS = {"isGreaterThan", "isLessThan", "isEqualTo", "compareTo"}
+
+
+def _qty_method(name: str, a, b):
+    """Apply a k8s CEL quantity method; MISSING on type error so a bad
+    operand makes the device non-matching, mirroring cel-go's error."""
+    try:
+        qa, qb = parse_quantity(a), parse_quantity(b)
+    except ValueError:
+        return MISSING
+    if name == "isGreaterThan":
+        return qa > qb
+    if name == "isLessThan":
+        return qa < qb
+    if name == "isEqualTo":
+        return qa == qb
+    return -1 if qa < qb else (1 if qa > qb else 0)  # compareTo
 
 
 class _Compiler:
@@ -101,29 +163,38 @@ class _Compiler:
         return fn
 
     def and_(self) -> _Fn:
-        fn = self.unary()
+        fn = self.cmp()
         while self.peek() == "&&":
             self.take()
-            rhs = self.unary()
+            rhs = self.cmp()
             fn = (lambda lhs, rhs: lambda d: bool(lhs(d)) and bool(rhs(d)))(fn, rhs)
         return fn
 
     def unary(self) -> _Fn:
+        # cel-go binds `!` tighter than comparisons: `!a == b` is
+        # `(!a) == b`. Errors (MISSING) propagate through negation.
         if self.peek() == "!":
             self.take()
             inner = self.unary()
-            return lambda d: not bool(inner(d))
-        return self.cmp()
+
+            def negate(d, inner=inner):
+                v = inner(d)
+                if isinstance(v, _Missing):
+                    return MISSING
+                return not bool(v)
+
+            return negate
+        return self.term()
 
     _CMPS = {"==", "!=", "<", "<=", ">", ">="}
 
     def cmp(self) -> _Fn:
-        lhs = self.term()
+        lhs = self.unary()
         op = self.peek()
         if op not in self._CMPS:
             return lhs
         self.take()
-        rhs = self.term()
+        rhs = self.unary()
 
         def compare(d, lhs=lhs, rhs=rhs, op=op):
             a, b = lhs(d), rhs(d)
@@ -132,12 +203,19 @@ class _Compiler:
                 # non-matching — so every operator, != included, is false.
                 return False
             # CEL compares like-typed values; coerce int-vs-str-of-int
-            # since attribute wire values may arrive as strings.
-            if isinstance(a, int) != isinstance(b, int):
+            # since attribute wire values may arrive as strings. Unlike
+            # types that won't coerce to int are a cel-go type error
+            # (DRA: non-match) — never fall back to lexicographic compare,
+            # which would match devices real cel-go rejects (e.g.
+            # "16Gi" < "2" is lexicographically true). Deliberately int()
+            # not parse_quantity(): cel-go has no int-vs-quantity overload
+            # either, so `capacity < 2` against "16Gi" must not match —
+            # quantity math belongs to the quantity methods.
+            if isinstance(a, (int, Fraction)) != isinstance(b, (int, Fraction)):
                 try:
                     a, b = int(a), int(b)
                 except (TypeError, ValueError):
-                    a, b = str(a), str(b)
+                    return False  # no_such_overload → DRA non-match
             if op == "==":
                 return a == b
             if op == "!=":
@@ -175,6 +253,18 @@ class _Compiler:
         if tok == "false":
             self.take()
             return lambda d: False
+        if tok == "quantity":
+            self.take()
+            self.take("(")
+            arg = self.take()
+            if arg[0] not in "'\"":
+                raise CelError(f"quantity() wants a string literal, got {arg!r}")
+            self.take(")")
+            try:
+                q = parse_quantity(arg[1:-1])
+            except ValueError as e:
+                raise CelError(str(e)) from e
+            return self.postfix(lambda d, q=q: q)
         if tok == "device":
             return self.device_path()
         raise CelError(f"unsupported term {tok!r}")
@@ -184,7 +274,7 @@ class _Compiler:
         self.take(".")
         field = self.take()
         if field == "driver":
-            return lambda d: getattr(d, "driver", MISSING)
+            return self.postfix(lambda d: getattr(d, "driver", MISSING))
         if field not in ("attributes", "capacity"):
             raise CelError(f"unsupported device field {field!r}")
         self.take("[")
@@ -194,9 +284,12 @@ class _Compiler:
         key = key_tok[1:-1]
         self.take("]")
         name = None
-        if self.peek() == ".":
+        if (self.peek() == "." and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1] not in _QTY_METHODS):
             # Qualified form: attributes["domain"].name -> "domain/name",
-            # with a fallback to the bare name for flat attribute maps.
+            # with a fallback to the bare name for flat attribute maps
+            # (capacity gets the identical treatment: cel-go exposes
+            # device.capacity['<domain>'].<name> with quantity values).
             self.take()
             name = self.take()
 
@@ -206,7 +299,30 @@ class _Compiler:
                 return mapping.get(key, MISSING)
             return mapping.get(f"{key}/{name}", mapping.get(name, MISSING))
 
-        return lookup
+        return self.postfix(lookup)
+
+    def postfix(self, base: _Fn) -> _Fn:
+        """Chained quantity method calls: .isGreaterThan(q) etc., applied
+        to whatever value `base` yields (the k8s CEL quantity library the
+        reference's bats specs rely on, e.g.
+        device.capacity['nvidia.com'].memory.isGreaterThan(quantity("10Gi")))."""
+        fn = base
+        while (self.peek() == "." and self.i + 1 < len(self.toks)
+               and self.toks[self.i + 1] in _QTY_METHODS):
+            self.take()
+            method = self.take()
+            self.take("(")
+            arg = self.expr()
+            self.take(")")
+
+            def call(d, fn=fn, method=method, arg=arg):
+                v, a = fn(d), arg(d)
+                if isinstance(v, _Missing) or isinstance(a, _Missing):
+                    return MISSING
+                return _qty_method(method, v, a)
+
+            fn = call
+        return fn
 
 
 @functools.lru_cache(maxsize=1024)

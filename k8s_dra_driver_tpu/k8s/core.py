@@ -53,7 +53,12 @@ class DeviceRequest:
     device_class_name: str = ""
     allocation_mode: str = "ExactCount"  # or "All"
     count: int = 1
-    selectors: List[str] = field(default_factory=list)  # CEL-ish exprs, unused in fake
+    # Legacy sim-only attr=value strings; never wire-encoded.
+    selectors: List[str] = field(default_factory=list)
+    # Real DRA selectors[].cel.expression strings — tagged at manifest
+    # parse time (the k8s shape {cel: {expression}}) so the allocator
+    # never has to sniff which language a string is in.
+    cel_selectors: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -480,8 +480,8 @@ def _requests_encode(requests: List[DeviceRequest],
         }
         if r.allocation_mode == "ExactCount":
             inner["count"] = r.count
-        if r.selectors:
-            inner["selectors"] = [{"cel": {"expression": s}} for s in r.selectors]
+        if r.cel_selectors:
+            inner["selectors"] = [{"cel": {"expression": s}} for s in r.cel_selectors]
         if version == "v1beta1":
             out.append({"name": r.name, **inner})
         else:
@@ -499,9 +499,9 @@ def _requests_decode(docs: List[Dict[str, Any]]) -> List[DeviceRequest]:
             device_class_name=inner.get("deviceClassName", ""),
             allocation_mode=inner.get("allocationMode", "ExactCount"),
             count=inner.get("count", 1),
-            selectors=[
-                ((s.get("cel") or {}).get("expression", ""))
-                for s in inner.get("selectors") or []
+            cel_selectors=[
+                expr for s in inner.get("selectors") or []
+                if (expr := (s.get("cel") or {}).get("expression", ""))
             ],
         ))
     return out

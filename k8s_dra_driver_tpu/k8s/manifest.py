@@ -22,19 +22,23 @@ def unwrap_template_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
     return spec.get("spec", spec)
 
 
-def _selector_strings(raw) -> List[str]:
-    """Request selectors in manifest form are k8s-shaped
-    ``[{cel: {expression: ...}}]``; plain strings (CEL or the sim's legacy
-    ``attr=value``) are accepted too."""
-    out: List[str] = []
+def _split_selectors(raw) -> tuple:
+    """Discriminate selectors by manifest *shape*, not content: the k8s
+    form ``{cel: {expression: ...}}`` is CEL; a plain string is the sim's
+    legacy ``attr=value``. Tagging here (instead of sniffing for
+    "device." downstream) means a legacy value containing "device." can't
+    be misrouted to the CEL evaluator, and a CEL literal like ``true``
+    can't be misread as malformed attr=value."""
+    legacy: List[str] = []
+    cel: List[str] = []
     for s in raw or []:
         if isinstance(s, str):
-            out.append(s)
+            legacy.append(s)
         elif isinstance(s, dict):
             expr = ((s.get("cel") or {}).get("expression", ""))
             if expr:
-                out.append(expr)
-    return out
+                cel.append(expr)
+    return legacy, cel
 
 
 def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
@@ -43,12 +47,14 @@ def device_requests_from_spec(spec: Dict[str, Any]) -> List[DeviceRequest]:
         # resource.k8s.io/v1 nests the one-of under "exactly"; v1beta1 is
         # flat (reference demo/specs/quickstart/v1/gpu-test1.yaml:10-21).
         inner = r.get("exactly") or r
+        legacy, cel = _split_selectors(inner.get("selectors"))
         out.append(DeviceRequest(
             name=r.get("name", "device"),
             device_class_name=inner.get("deviceClassName", ""),
             allocation_mode=inner.get("allocationMode", "ExactCount"),
             count=inner.get("count", 1),
-            selectors=_selector_strings(inner.get("selectors")),
+            selectors=legacy,
+            cel_selectors=cel,
         ))
     return out
 

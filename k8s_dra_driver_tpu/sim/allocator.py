@@ -41,6 +41,10 @@ def _device_matches(dev: Device, match_attributes: Dict[str, object],
         if dev.attributes.get(k) != v:
             return False
     if cel_selectors:
+        # Real DRA selectors (class- or request-level), tagged as CEL at
+        # manifest parse time by their k8s shape {cel: {expression}} —
+        # never sniffed out of a string, so a legacy value containing
+        # "device." can't be misrouted here.
         from k8s_dra_driver_tpu.k8s import celmini
 
         # CEL sees `device.driver`; the Device object itself doesn't carry
@@ -53,25 +57,15 @@ def _device_matches(dev: Device, match_attributes: Dict[str, object],
         except celmini.CelError as e:
             raise AllocationError(f"bad CEL selector: {e}") from e
     for sel in selectors:
-        if "device." in sel:
-            # A real DRA request selector (CEL) — same evaluator as class
-            # selectors, so manifests can use either level identically.
-            from k8s_dra_driver_tpu.k8s import celmini
-
-            view = SimpleNamespace(driver=driver, attributes=dev.attributes,
-                                   capacity=dev.capacity)
-            try:
-                if not celmini.evaluate(sel, view):
-                    return False
-            except celmini.CelError as e:
-                raise AllocationError(f"bad CEL selector: {e}") from e
-        elif "=" in sel:
+        # Legacy sim-only attr=value strings.
+        if "=" in sel:
             k, _, v = sel.partition("=")
             if str(dev.attributes.get(k.strip())) != v.strip():
                 return False
         else:
             raise AllocationError(
-                f"malformed selector {sel!r} (want a CEL expression or attr=value)")
+                f"malformed legacy selector {sel!r} (want attr=value; CEL "
+                f"selectors use the manifest form {{cel: {{expression}}}})")
     return True
 
 
@@ -214,8 +208,10 @@ class Allocator:
                 d for d in rs.devices
                 if d.name not in picked_names
                 and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
-                and _device_matches(d, match_attrs, req.selectors,
-                                    cel_selectors=cel_sels, driver=driver)
+                and _device_matches(
+                    d, match_attrs, req.selectors,
+                    cel_selectors=list(cel_sels) + list(getattr(req, "cel_selectors", ())),
+                    driver=driver)
             ]
             want = len(candidates) if req.allocation_mode == "All" else req.count
             chosen: List[Device] = []

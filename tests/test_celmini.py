@@ -80,6 +80,73 @@ def test_negative_int_literals():
     assert evaluate('device.attributes["offset"] < -1', d)
 
 
+def test_quantity_methods_on_capacity():
+    """The k8s CEL quantity library as real cel-go evaluates it: capacity
+    values are quantities accessed domain-qualified (the reference's bats
+    specs use device.capacity['nvidia.com'].memory.isGreaterThan(...))."""
+    d = SimpleNamespace(driver="tpu.google.com", attributes={},
+                        capacity={"hbm": 16 << 30})
+    q = 'device.capacity["tpu.google.com"].hbm'
+    assert evaluate(f'{q}.isGreaterThan(quantity("10Gi"))', d)
+    assert not evaluate(f'{q}.isGreaterThan(quantity("16Gi"))', d)  # strict
+    assert evaluate(f'{q}.isEqualTo(quantity("16Gi"))', d)
+    assert evaluate(f'{q}.isLessThan(quantity("32Gi"))', d)
+    assert evaluate(f'{q}.compareTo(quantity("16Gi")) >= 0', d)
+    # Wire-decoded capacity arrives stringly; quantities still compare.
+    ds = SimpleNamespace(driver="tpu.google.com", attributes={},
+                         capacity={"hbm": str(16 << 30)})
+    assert evaluate(f'{q}.isGreaterThan(quantity("10Gi"))', ds)
+    # Missing capacity: method result is non-match, not a crash.
+    empty = SimpleNamespace(driver="d", attributes={}, capacity={})
+    assert not evaluate(f'{q}.isGreaterThan(quantity("1Ki"))', empty)
+
+
+def test_quantity_parsing():
+    from k8s_dra_driver_tpu.k8s.celmini import parse_quantity
+
+    assert parse_quantity("16Gi") == 16 * 2**30
+    assert parse_quantity("1500m") == 1.5
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity(str(16 << 30)) == 16 << 30
+    assert parse_quantity(4096) == 4096
+    with pytest.raises(ValueError):
+        parse_quantity("16GiB")  # not a k8s suffix
+    with pytest.raises(ValueError):
+        parse_quantity(True)
+
+
+def test_mixed_incomparable_types_never_match():
+    """cel-go type-errors on unlike-typed comparison (no_such_overload) and
+    DRA treats that as non-match — never lexicographic string compare,
+    which would invert the outcome ("16Gi" < "2" is True stringly)."""
+    d = SimpleNamespace(driver="d", attributes={}, capacity={"hbm": "16Gi"})
+    assert not evaluate('device.capacity["hbm"] < 2', d)
+    assert not evaluate('device.capacity["hbm"] == 2', d)
+    assert not evaluate('device.capacity["hbm"] != 2', d)
+    # But quantity-coercible strings still compare numerically.
+    assert evaluate('device.capacity["hbm"] > 2', d) is False  # type error
+    dq = SimpleNamespace(driver="d", attributes={"n": "3"}, capacity={})
+    assert evaluate('device.attributes["n"] > 2', dq)
+
+
+def test_not_binds_tighter_than_comparison():
+    """cel-go precedence: `!a == b` is `(!a) == b`, not `!(a == b)`.
+
+    For pure booleans the two parses happen to agree, so pin the parse
+    where they observably diverge: a missing attribute. cel-go errors on
+    the access either way (non-match); the old `!(a == b)` parse instead
+    negated the comparison's False into a spurious match."""
+    d = dev(flag=False)
+    assert evaluate('!device.attributes["flag"] == true', d)
+    assert evaluate('!(device.attributes["flag"] == true)', d)  # parens still work
+    # Missing attribute: (!MISSING) == true must be non-match; the wrong
+    # parse !(MISSING == true) -> !False -> True would match.
+    assert not evaluate('!device.attributes["absent"] == true', d)
+    # `!` also stays usable bare and inside boolean chains.
+    assert evaluate('!device.attributes["flag"] && '
+                    'device.attributes["flag"] == false', d)
+
+
 def test_compile_cache_reused():
     from k8s_dra_driver_tpu.k8s.celmini import compile_expression
 
