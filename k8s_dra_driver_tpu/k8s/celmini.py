@@ -211,7 +211,12 @@ class _Compiler:
             # not parse_quantity(): cel-go has no int-vs-quantity overload
             # either, so `capacity < 2` against "16Gi" must not match —
             # quantity math belongs to the quantity methods.
-            if isinstance(a, (int, Fraction)) != isinstance(b, (int, Fraction)):
+            if isinstance(a, Fraction) != isinstance(b, Fraction):
+                # quantity vs anything-but-quantity: cel-go has no such
+                # overload (int-vs-quantity included) — non-match, never
+                # a truncating coercion.
+                return False
+            if isinstance(a, int) != isinstance(b, int):
                 try:
                     a, b = int(a), int(b)
                 except (TypeError, ValueError):

@@ -11,6 +11,7 @@ reference encodes for MIG memory slices
 from __future__ import annotations
 
 import logging
+import re
 from collections import defaultdict
 from types import SimpleNamespace
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -32,6 +33,10 @@ log = logging.getLogger(__name__)
 
 class AllocationError(Exception):
     pass
+
+
+# attr=value with exactly one bare '=' — no CEL comparison operators.
+_LEGACY_SELECTOR = re.compile(r"([^=!<>]+)=([^=]*)")
 
 
 def _device_matches(dev: Device, match_attributes: Dict[str, object],
@@ -57,9 +62,14 @@ def _device_matches(dev: Device, match_attributes: Dict[str, object],
         except celmini.CelError as e:
             raise AllocationError(f"bad CEL selector: {e}") from e
     for sel in selectors:
-        # Legacy sim-only attr=value strings.
-        if "=" in sel:
-            k, _, v = sel.partition("=")
+        # Legacy sim-only attr=value strings: a bare key, one '=', a bare
+        # value. A CEL expression that arrives here as a plain string must
+        # fail loudly (its '==' / '!=' / '>=' / '<=' doesn't fit the
+        # shape), not silently look up a garbage attribute key and match
+        # zero devices.
+        m = _LEGACY_SELECTOR.fullmatch(sel)
+        if m:
+            k, v = m.group(1), m.group(2)
             if str(dev.attributes.get(k.strip())) != v.strip():
                 return False
         else:
@@ -201,6 +211,7 @@ class Allocator:
         picked_names: set = set()
         for req in claim.requests:
             driver, match_attrs, cel_sels = self._class_info(req.device_class_name)
+            all_cel = list(cel_sels) + list(getattr(req, "cel_selectors", ()))
             rs = slices_by_driver.get(driver)
             if rs is None:
                 return None
@@ -208,10 +219,8 @@ class Allocator:
                 d for d in rs.devices
                 if d.name not in picked_names
                 and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
-                and _device_matches(
-                    d, match_attrs, req.selectors,
-                    cel_selectors=list(cel_sels) + list(getattr(req, "cel_selectors", ())),
-                    driver=driver)
+                and _device_matches(d, match_attrs, req.selectors,
+                                    cel_selectors=all_cel, driver=driver)
             ]
             want = len(candidates) if req.allocation_mode == "All" else req.count
             chosen: List[Device] = []

@@ -127,6 +127,28 @@ def test_mixed_incomparable_types_never_match():
     assert evaluate('device.capacity["hbm"] > 2', d) is False  # type error
     dq = SimpleNamespace(driver="d", attributes={"n": "3"}, capacity={})
     assert evaluate('device.attributes["n"] > 2', dq)
+    # quantity vs non-quantity has no cel-go overload either — a plain
+    # comparison against a quantity() literal is a non-match even when a
+    # truncating numeric coercion would succeed.
+    di = SimpleNamespace(driver="d", attributes={}, capacity={"hbm": 16 << 30})
+    assert not evaluate('device.capacity["hbm"] >= quantity("10Gi")', di)
+    dn = SimpleNamespace(driver="d", attributes={"n": "1"}, capacity={})
+    assert not evaluate('device.attributes["n"] == quantity("1500m")', dn)
+
+
+def test_legacy_selector_shape_is_enforced():
+    """A CEL expression smuggled in as a plain string must fail the pod
+    loudly, not silently look up a garbage attribute key and match zero
+    devices."""
+    from k8s_dra_driver_tpu.sim.allocator import AllocationError, _device_matches
+
+    d = dev(index=0, kind="device.tpu")
+    assert _device_matches(d, {}, ["kind=device.tpu"], driver="d")
+    assert not _device_matches(d, {}, ["kind=other"], driver="d")
+    with pytest.raises(AllocationError):
+        _device_matches(d, {}, ["device.attributes['index'] == 0"], driver="d")
+    with pytest.raises(AllocationError):
+        _device_matches(d, {}, ["true"], driver="d")
 
 
 def test_not_binds_tighter_than_comparison():
