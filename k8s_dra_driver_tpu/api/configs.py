@@ -201,9 +201,18 @@ IOMMU_MODES = ("auto", "legacy", "iommufd")
 
 @dataclass
 class VfioTpuConfig(DeviceConfig):
-    """Passthrough config (PassthroughSupport gate)."""
+    """Passthrough config (PassthroughSupport gate).
+
+    ``iommu_mode`` selects the IOMMU backend the workload sees (the
+    reference's IOMMUBackendPolicy, api/.../iommu.go:22-76): ``legacy``
+    pins the group-fd backend, ``iommufd`` requires /dev/iommu on the
+    node, ``auto`` prefers iommufd when present (≈ PreferIommuFD).
+    ``enable_api_device`` additionally injects the IOMMU API device into
+    the container — /dev/iommu (iommufd) or /dev/vfio/vfio (legacy), the
+    vfio-cdi.go:52-81 common edit."""
 
     iommu_mode: str = "auto"
+    enable_api_device: bool = False
 
     def normalize(self) -> None:
         if not self.iommu_mode:
@@ -215,6 +224,8 @@ class VfioTpuConfig(DeviceConfig):
             raise ValidationError(
                 f"unknown iommu_mode {self.iommu_mode!r}; want one of {IOMMU_MODES}"
             )
+        if not isinstance(self.enable_api_device, bool):
+            raise ValidationError("enable_api_device must be a boolean")
 
 
 @dataclass

@@ -138,14 +138,54 @@ def check_vfio(sim: SimCluster, _pods) -> None:
     addr = p.injected_env.get("TPU_VFIO_PCI_ADDRESS", "")
     _expect(addr.startswith("0000:"), f"bad TPU_VFIO_PCI_ADDRESS {addr!r}")
     groups = [d for d in p.injected_devices if "/vfio/" in d]
-    _expect(len(groups) == 1, f"want one /dev/vfio group node, got {p.injected_devices}")
-    _expect(os.path.exists(groups[0]), f"vfio group node {groups[0]} missing on disk")
+    _expect(len(groups) == 1, f"want one /dev/vfio node, got {p.injected_devices}")
+    _expect(os.path.exists(groups[0]), f"vfio node {groups[0]} missing on disk")
+    # The spec's iommu_mode is auto and the sim kernel exposes iommufd,
+    # so the injected handle is the per-device cdev, not the group fd.
+    _expect("/vfio/devices/" in groups[0],
+            f"auto mode should prefer the iommufd cdev, got {groups[0]}")
+    _expect(p.injected_env.get("TPU_VFIO_IOMMU_MODE") == "iommufd",
+            f"iommu mode env: {p.injected_env.get('TPU_VFIO_IOMMU_MODE')!r}")
     _expect(not any(d.endswith("accel0") for d in p.injected_devices),
             "passthrough pod must not also get the accel node")
     # The rebind really happened in the node's sysfs fixture.
     mgr = sim.nodes[p.node_name].tpu_driver.state.vfio
     _expect(mgr.current_driver(addr) == "vfio-pci",
             f"chip driver is {mgr.current_driver(addr)!r}, want vfio-pci")
+
+
+def check_vfio_part(sim: SimCluster, _pods) -> None:
+    """Multi-chip passthrough: partition activate -> bind -> (delete) ->
+    unbind -> release, with the legacy backend and the IOMMU API device."""
+    pods = _running_pods(sim, "tpu-test-vfio-part")
+    p = pods[0]
+    node = sim.nodes[p.node_name].tpu_driver.state
+    # Two group fds (legacy mode) + the /dev/vfio/vfio API container.
+    group_fds = [d for d in p.injected_devices
+                 if "/vfio/" in d and "/devices/" not in d
+                 and not d.endswith("/vfio/vfio")]
+    _expect(len(group_fds) == 2, f"want two group fds, got {p.injected_devices}")
+    _expect(any(d.endswith("/vfio/vfio") for d in p.injected_devices),
+            f"missing IOMMU API device: {p.injected_devices}")
+    _expect(p.injected_env.get("TPU_VFIO_IOMMU_MODE") == "legacy",
+            f"iommu mode env: {p.injected_env.get('TPU_VFIO_IOMMU_MODE')!r}")
+    # Both functions are discoverable: the claim-wide address list names
+    # every member (per-device TPU_VFIO_PCI_ADDRESS is last-wins).
+    addrs = p.injected_env.get("TPU_VFIO_PCI_ADDRESSES", "").split(",")
+    _expect(len(addrs) == 2 and all(a.startswith("0000:") for a in addrs),
+            f"bad TPU_VFIO_PCI_ADDRESSES: {addrs}")
+    # The group's isolating ICI partition is live while the claim holds it.
+    active = [q.id for q in node.partitions.active_partitions()]
+    _expect(len(active) == 1, f"want exactly one active partition, got {active}")
+    # Release path: deleting the pod unprepares — drivers return to accel
+    # and the partition is released.
+    addr = p.injected_env.get("TPU_VFIO_PCI_ADDRESS", "")
+    sim.delete_pod(p.meta.name, p.namespace)
+    sim.settle()
+    _expect(node.partitions.active_partitions() == [],
+            "partition must be released on unprepare")
+    _expect(node.vfio.current_driver(addr) == "accel-tpu",
+            f"chip driver is {node.vfio.current_driver(addr)!r} after release")
 
 
 def check_cd_single(sim: SimCluster, _pods) -> None:
@@ -254,6 +294,10 @@ SCENARIOS: Dict[str, Scenario] = {
                  check=check_test7),
         Scenario("tpu-test-vfio", "quickstart/tpu-test-vfio.yaml",
                  gates="PassthroughSupport=true", check=check_vfio),
+        Scenario("tpu-test-vfio-part", "quickstart/tpu-test-vfio-part.yaml",
+                 profile="v5e-4",
+                 gates="PassthroughSupport=true,ICIPartitioning=true",
+                 check=check_vfio_part),
         Scenario("cd-single-host", "computedomain/cd-single-host.yaml",
                  profile="v5e-4", check=check_cd_single),
         Scenario("cd-multi-host", "computedomain/cd-multi-host.yaml",

@@ -100,6 +100,8 @@ class SubsliceDevice:
 class VfioDevice:
     chip: ChipInfo
     vfio_group_path: str  # /dev/vfio/<group>, empty until bound
+    # /dev/vfio/devices/vfioN, set when bound under the iommufd backend.
+    vfio_cdev_path: str = ""
 
     @property
     def name(self) -> str:

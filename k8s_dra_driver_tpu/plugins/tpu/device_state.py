@@ -117,13 +117,15 @@ class DeviceState:
         self.vfio = vfio or VfioPciManager()
         self.plugin_dir = plugin_dir
         os.makedirs(plugin_dir, exist_ok=True)
-        # DynamicSubslice (the DynamicMIG analog, reference
-        # nvlib.go:971-1199): subslice prepares carve a partition through
-        # the ICI partitioner ledger; static mode leaves partitioning to
-        # the platform. The native flock'd on-disk ledger survives plugin
-        # restarts (like FM service state); the stub covers mock runs.
+        # ICIPartitioning is the base partitioner gate (the FM
+        # partitioning analog): DynamicSubslice carves subslice partitions
+        # through it (nvlib.go:971-1199) and VFIO passthrough groups
+        # activate their isolating partition through it before binding
+        # (device_state.go:1284-1289). The native flock'd on-disk ledger
+        # survives plugin restarts (like FM service state); the stub
+        # covers mock runs.
         self.partitions: Optional[PartitionManager] = None
-        if self.gates.enabled("DynamicSubslice"):
+        if self.gates.enabled("ICIPartitioning"):
             host_topology = self.inventory.host_topology
             ledger = os.path.join(plugin_dir, "partitions.json")
             if load_tpupart() is not None:
@@ -137,7 +139,7 @@ class DeviceState:
                 client = StubPartitionClient()
             elif not self.gates.enabled("CrashOnICIFabricErrors"):
                 log.error(
-                    "DynamicSubslice enabled but libtpupart.so is missing: "
+                    "ICIPartitioning enabled but libtpupart.so is missing: "
                     "using the in-memory stub — partitions are NOT "
                     "programmed into hardware and do NOT survive restarts"
                 )
@@ -146,7 +148,7 @@ class DeviceState:
                 # Refuse to degrade silently (CrashOnICIFabricErrors
                 # posture, reference CrashOnNVLinkFabricErrors).
                 raise PartitionError(
-                    "DynamicSubslice requires libtpupart.so on real nodes "
+                    "ICIPartitioning requires libtpupart.so on real nodes "
                     "(build native/, or set CrashOnICIFabricErrors=false "
                     "to degrade to the in-memory stub)"
                 )
@@ -299,36 +301,73 @@ class DeviceState:
 
     def _prepare_devices(self, claim: ResourceClaim) -> List[PreparedDevice]:
         configs = self._resolve_configs(claim)
+        results = [
+            r for r in claim.allocation.devices  # type: ignore[union-attr]
+            if r.driver == self.driver_name
+        ]
+        # Resolve the passthrough group BEFORE any sysfs mutation: config
+        # resolution (iommu backend) and fabric isolation both have to
+        # precede the vfio-pci bind — the reference activates the FM
+        # partition for the whole group first and only then configures
+        # each function (device_state.go:1254-1297).
+        vfio_group = self._resolve_vfio_group(claim, results, configs)
+        group_pid = ""
+        if vfio_group is not None:
+            group_pid = self._activate_vfio_partition(
+                [self.allocatable[r.device] for r in vfio_group["results"]]
+            )
         prepared: List[PreparedDevice] = []
         try:
-            for result in claim.allocation.devices:  # type: ignore[union-attr]
-                if result.driver != self.driver_name:
-                    continue
+            for result in results:
                 dev = self.allocatable[result.device]
+                extra: Dict[str, str] = {}
                 if isinstance(dev, VfioDevice):
+                    extra["iommu"] = vfio_group["iommu_mode"]  # type: ignore[index]
+                    if vfio_group["api_device"]:  # type: ignore[index]
+                        extra["api_device"] = "1"
+                    if group_pid:
+                        extra["partition"] = group_pid
                     try:
-                        dev = self._ensure_vfio_bound(dev)
+                        dev = self._ensure_vfio_bound(dev, vfio_group["iommu_mode"])  # type: ignore[index]
                     except Exception:
                         # A failed bind can strand the function driverless
                         # (unbound from accel, vfio probe failed); re-probe
                         # it back to the default driver before surfacing.
+                        # The group partition is released by the outer
+                        # rollback via the prepared entries (or below when
+                        # nothing was prepared yet).
                         self._release_vfio(dev)
+                        if (group_pid and self.partitions is not None
+                                and not any(p.extra.get("partition") == group_pid
+                                            for p in prepared)):
+                            # No prepared entry carries the group partition
+                            # yet, so the outer rollback won't release it.
+                            self.partitions.deactivate(group_pid)
                         raise
-                extra: Dict[str, str] = {}
                 try:
-                    if isinstance(dev, SubsliceDevice) and self.partitions is not None:
+                    if (isinstance(dev, SubsliceDevice)
+                            and self.partitions is not None
+                            and self.gates.enabled("DynamicSubslice")):
                         extra["partition"] = self._activate_partition(dev)
                     for cfg in configs.get(result.request, []):
                         self._apply_config(cfg, claim.uid, dev)
                 except Exception:
                     # The in-flight device is not in `prepared` yet; undo its
                     # own partition/sharing/vfio before the outer rollback.
-                    pid = extra.get("partition")
-                    if pid and self.partitions is not None:
-                        self.partitions.deactivate(pid)
                     self.sharing.clear(claim.uid, tuple(dev.chip_indices))
                     if isinstance(dev, VfioDevice):
                         self._release_vfio(dev)
+                    pid = extra.get("partition")
+                    if pid and self.partitions is not None:
+                        # The shared group partition is released by the
+                        # outer rollback once a prepared sibling carries it
+                        # (after that sibling's unbind — never while a group
+                        # member is still bound to vfio-pci).
+                        carried = pid == group_pid and any(
+                            p.extra.get("partition") == pid for p in prepared
+                        )
+                        if not carried:
+                            self.partitions.deactivate(pid)
                     raise
                 prepared.append(
                     PreparedDevice(
@@ -344,6 +383,86 @@ class DeviceState:
                 self._rollback_device(claim.uid, d)
             raise
         return prepared
+
+    def _resolve_vfio_group(self, claim: ResourceClaim, results,
+                            configs) -> Optional[Dict]:
+        """Resolve the claim's passthrough group and its effective IOMMU
+        backend up front. Like the reference, a single VfioTpuConfig
+        governs the whole group (device_state.go:1254-1263 assumes one
+        vfio config per claim); conflicting configs are a PrepareError."""
+        vfio_results = [
+            r for r in results
+            if isinstance(self.allocatable[r.device], VfioDevice)
+        ]
+        if not vfio_results:
+            return None
+        # Per request, the effective config is the LAST VfioTpuConfig in
+        # apply order (most specific wins — a claim config overrides a
+        # class default, exactly the GetOpaqueDeviceConfigs precedence).
+        # The group stays consistent: different requests resolving to
+        # different effective configs is the conflict.
+        effective: Dict[str, VfioTpuConfig] = {}
+        for r in vfio_results:
+            for cfg in configs.get(r.request, []):
+                if isinstance(cfg, VfioTpuConfig):
+                    effective[r.request] = cfg
+        distinct = {id(c): c for c in effective.values()}
+        unique = list(distinct.values())
+        if len(unique) > 1 and any(c != unique[0] for c in unique[1:]):
+            raise PrepareError(
+                "conflicting VfioTpuConfigs in one claim "
+                "(one config governs the whole passthrough group)"
+            )
+        cfg = unique[0] if unique else VfioTpuConfig()
+        if not self.gates.enabled("PassthroughSupport"):
+            raise PrepareError("VFIO passthrough requires PassthroughSupport gate")
+        return {
+            "results": vfio_results,
+            "iommu_mode": self._resolve_iommu_mode(cfg),
+            "api_device": cfg.enable_api_device,
+        }
+
+    def _resolve_iommu_mode(self, cfg: VfioTpuConfig) -> str:
+        """auto/legacy/iommufd -> the backend actually used. ``iommufd``
+        hard-requires /dev/iommu; ``auto`` prefers it when present (the
+        PreferIommuFD posture, vfio-cdi.go:52-66)."""
+        if cfg.iommu_mode == "legacy":
+            return "legacy"
+        available = self.vfio.iommufd_available()
+        if cfg.iommu_mode == "iommufd":
+            if not available:
+                raise PrepareError(
+                    "iommu_mode=iommufd but the node has no /dev/iommu "
+                    "(iommufd backend unavailable)"
+                )
+            return "iommufd"
+        return "iommufd" if available else "legacy"
+
+    def _activate_vfio_partition(self, devs: Sequence[AllocatableDevice]) -> str:
+        """Isolate the passthrough group on the ICI mesh BEFORE binding to
+        vfio-pci (reference device_state.go:1284-1289: fabric partition
+        activation precedes Configure). Whole-host passthrough needs no
+        carving — nothing else shares the mesh; a strict-subset group that
+        matches no legal partition refuses activation like the reference's
+        'does not match any FM partition' error."""
+        if self.partitions is None or not self.gates.enabled("ICIPartitioning"):
+            return ""
+        chips = tuple(sorted({i for d in devs for i in d.chip_indices}))
+        if len(chips) == len(self.inventory.chips):
+            return ""
+        partition = self.partitions.partition_for_chips(chips)
+        if partition is None:
+            raise PrepareError(
+                f"passthrough group (chips {list(chips)}) matches no legal "
+                f"ICI partition on {self.inventory.host_topology}; refusing "
+                f"activation"
+            )
+        try:
+            self.partitions.activate(partition.id)
+        except PartitionError as e:
+            raise PrepareError(
+                f"vfio partition activate {partition.id}: {e}") from e
+        return partition.id
 
     def _resolve_configs(self, claim: ResourceClaim) -> Dict[str, List[DeviceConfig]]:
         """request name -> configs in apply order (most specific last)."""
@@ -406,17 +525,30 @@ class DeviceState:
                 claim_uid, dev.chip_indices, sharing.premapped
             )
 
-    def _ensure_vfio_bound(self, dev: VfioDevice) -> VfioDevice:
+    def _ensure_vfio_bound(self, dev: VfioDevice, iommu_mode: str = "legacy") -> VfioDevice:
         """Rebind the chip's PCI function to vfio-pci at Prepare time
         (reference device_state.go:1254-1297, vfio-device.go:235-257). A
         device whose group path is already known (inventory pre-bound, or a
-        prior prepare) is left alone."""
-        if dev.vfio_group_path:
+        prior prepare) is left alone — unless the iommufd backend needs a
+        cdev the cached state lacks."""
+        if dev.vfio_group_path and (iommu_mode != "iommufd" or dev.vfio_cdev_path):
             return dev
-        group_path = self.vfio.bind_to_vfio(
+        group_path = dev.vfio_group_path or self.vfio.bind_to_vfio(
             dev.chip.pci_address, dev_path=dev.chip.dev_path
         )
-        dev = replace(dev, vfio_group_path=group_path)
+        cdev_path = ""
+        if iommu_mode == "iommufd":
+            cdev_path = self.vfio.iommufd_cdev(dev.chip.pci_address)
+            if not cdev_path:
+                # Bound, but the kernel exposes no per-device cdev: the
+                # iommufd backend can't serve this function
+                # (vfio-cdi.go:100-106 'missing iommufd cdev').
+                raise PrepareError(
+                    f"{dev.chip.pci_address}: bound to vfio-pci but no "
+                    f"iommufd cdev under vfio-dev/ (kernel lacks "
+                    f"VFIO_DEVICE_CDEV?)"
+                )
+        dev = replace(dev, vfio_group_path=group_path, vfio_cdev_path=cdev_path)
         self.allocatable[dev.name] = dev
         return dev
 
@@ -465,24 +597,29 @@ class DeviceState:
 
     def _release_vfio(self, dev: VfioDevice) -> None:
         """Return the function to the accel driver (vfio-device.go unbind
-        path) and clear the cached group path so a later prepare re-binds —
-        after the unbind the old /dev/vfio node is gone even for chips the
-        inventory reported pre-bound."""
+        path) and clear the cached group/cdev paths so a later prepare
+        re-binds — after the unbind the old /dev/vfio nodes are gone even
+        for chips the inventory reported pre-bound."""
         try:
             self.vfio.unbind_from_vfio(dev.chip.pci_address)
         except Exception:  # noqa: BLE001 — best effort
             log.exception("vfio unbind rollback failed")
-        self.allocatable[dev.name] = replace(dev, vfio_group_path="")
+        self.allocatable[dev.name] = replace(
+            dev, vfio_group_path="", vfio_cdev_path="")
 
     def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
+        """Reverse of prepare order: sharing records, then the vfio unbind,
+        then the partition release (the group's ICI partition was activated
+        BEFORE the bind, so it is released after the unbind — mirroring the
+        reference's deactivateFabricPartition on unprepare)."""
         try:
             self.sharing.clear(claim_uid, tuple(d.chip_indices))
-            pid = d.extra.get("partition")
-            if pid and self.partitions is not None:
-                self.partitions.deactivate(pid)
             dev = self.allocatable.get(d.name)
             if isinstance(dev, VfioDevice):
                 self._release_vfio(dev)
+            pid = d.extra.get("partition")
+            if pid and self.partitions is not None:
+                self.partitions.deactivate(pid)
         except Exception:  # noqa: BLE001 — rollback is best effort
             log.exception("rollback of %s for claim %s failed", d.name, claim_uid)
 
@@ -497,9 +634,15 @@ class DeviceState:
         dev = self.allocatable[d.name]
         edits = ContainerEdits()
         if isinstance(dev, VfioDevice):
-            if dev.vfio_group_path:
+            # Backend-selected node (vfio-cdi.go:89-118): the iommufd
+            # per-device cdev when that backend is active, the legacy
+            # group fd otherwise.
+            if d.extra.get("iommu") == "iommufd" and dev.vfio_cdev_path:
+                edits.device_nodes.append(dev.vfio_cdev_path)
+            elif dev.vfio_group_path:
                 edits.device_nodes.append(dev.vfio_group_path)
             edits.env["TPU_VFIO_PCI_ADDRESS"] = dev.chip.pci_address
+            edits.env["TPU_VFIO_IOMMU_MODE"] = d.extra.get("iommu", "legacy")
             return edits
         chips = (
             (dev.chip,) if isinstance(dev, TpuDevice) else dev.chips  # type: ignore[union-attr]
@@ -523,6 +666,26 @@ class DeviceState:
         edits = ContainerEdits()
         edits.env["TPU_ACCELERATOR_TYPE"] = inv.accelerator_type
         edits.env["TPU_SKIP_MDS_QUERY"] = "true"
+        # The IOMMU API device, once per claim when the vfio config asked
+        # for it: /dev/iommu (iommufd backend) or the legacy /dev/vfio/vfio
+        # container (vfio-cdi.go:52-81 GetCommonEdits).
+        api_vfio = [d for d in prepared if d.extra.get("api_device")]
+        if api_vfio:
+            edits.device_nodes.append(
+                self.vfio.api_device_path(api_vfio[0].extra.get("iommu", "legacy"))
+            )
+        # Env merge across devices is last-wins, so a multi-function group
+        # also gets the full address list claim-wide (the per-device
+        # TPU_VFIO_PCI_ADDRESS alone can only name one function).
+        vfio_devs = [
+            self.allocatable[d.name] for d in prepared
+            if isinstance(self.allocatable.get(d.name), VfioDevice)
+        ]
+        if vfio_devs:
+            edits.env["TPU_VFIO_PCI_ADDRESSES"] = ",".join(
+                d.chip.pci_address
+                for d in sorted(vfio_devs, key=lambda v: v.chip.index)
+            )
         all_chips = sorted({i for d in prepared for i in d.chip_indices})
         whole_host = len(all_chips) == len(inv.chips)
         if whole_host:

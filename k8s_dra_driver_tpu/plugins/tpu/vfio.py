@@ -73,6 +73,30 @@ class VfioPciManager:
     def iommufd_available(self) -> bool:
         return os.path.exists(os.path.join(self.dev_root, "iommu"))
 
+    def iommufd_cdev(self, pci_address: str) -> str:
+        """The device's IOMMUFD cdev path (/dev/vfio/devices/vfioN), or ""
+        when the kernel exposes none. The kernel publishes the cdev name
+        under the device's sysfs vfio-dev/ directory once it is bound to
+        vfio-pci with iommufd support (the nvpci IommuFD lookup the
+        reference relies on, vfio-cdi.go:96-106)."""
+        vdir = os.path.join(self._pci_dir(pci_address), "vfio-dev")
+        try:
+            names = sorted(os.listdir(vdir))
+        except OSError:
+            return ""
+        for name in names:
+            if name.startswith("vfio") and name[4:].isdigit():
+                return os.path.join(self.dev_root, "vfio", "devices", name)
+        return ""
+
+    def api_device_path(self, iommu_mode: str) -> str:
+        """The IOMMU API container device: /dev/iommu for the iommufd
+        backend, the legacy /dev/vfio/vfio container otherwise
+        (vfio-cdi.go:52-81)."""
+        if iommu_mode == "iommufd":
+            return os.path.join(self.dev_root, "iommu")
+        return os.path.join(self.dev_root, "vfio", "vfio")
+
     # -- rebinding -------------------------------------------------------------
 
     def _write(self, path: str, value: str) -> None:
@@ -96,12 +120,13 @@ class VfioPciManager:
                 if was_vfio:
                     # Leaving vfio-pci removes the group's /dev/vfio node
                     # once no member device remains bound (single-function
-                    # fixture: always).
+                    # fixture: always) — and the iommufd cdev with it.
                     node = os.path.join(
                         self.dev_root, "vfio", self.iommu_group(addr)
                     )
                     if os.path.exists(node):
                         os.unlink(node)
+                    self._fixture_drop_cdev(addr)
         elif path.endswith("drivers_probe"):
             link = os.path.join(devices, addr, "driver")
             if os.path.islink(link):
@@ -129,6 +154,32 @@ class VfioPciManager:
                     vdir = os.path.join(self.dev_root, "vfio")
                     os.makedirs(vdir, exist_ok=True)
                     open(os.path.join(vdir, group), "a").close()
+                    if self.iommufd_available():
+                        # An iommufd-capable kernel also publishes the
+                        # per-device cdev: sysfs vfio-dev/vfioN plus the
+                        # /dev/vfio/devices/vfioN node (group number doubles
+                        # as a unique N in the single-function fixture).
+                        name = f"vfio{group}"
+                        os.makedirs(
+                            os.path.join(devices, addr, "vfio-dev", name),
+                            exist_ok=True)
+                        cdev_dir = os.path.join(vdir, "devices")
+                        os.makedirs(cdev_dir, exist_ok=True)
+                        open(os.path.join(cdev_dir, name), "a").close()
+
+    def _fixture_drop_cdev(self, addr: str) -> None:
+        import shutil
+
+        vdir = os.path.join(self._pci_dir(addr), "vfio-dev")
+        try:
+            names = os.listdir(vdir)
+        except OSError:
+            return
+        for name in names:
+            node = os.path.join(self.dev_root, "vfio", "devices", name)
+            if os.path.exists(node):
+                os.unlink(node)
+        shutil.rmtree(vdir, ignore_errors=True)
 
     def wait_device_free(self, dev_path: str, timeout_s: float = 10.0) -> None:
         """Refuse to yank a device out from under a running workload: wait

@@ -56,6 +56,8 @@ def build_vfio_sysfs(
     probe = os.path.join(sysfs_root, "bus", "pci", "drivers_probe")
     open(probe, "a").close()
     os.makedirs(os.path.join(dev_root, "vfio"), exist_ok=True)
+    # The legacy IOMMU API container device is always present with vfio.
+    open(os.path.join(dev_root, "vfio", "vfio"), "a").close()
     if with_iommufd:
         open(os.path.join(dev_root, "iommu"), "a").close()
     for chip in chips:

@@ -169,7 +169,10 @@ class SimCluster:
 
             sys_root = os.path.join(base, "sysfs")
             dev_root = os.path.join(base, "dev")
-            build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips)
+            # iommufd present: the 'auto' backend prefers the per-device
+            # cdev, and the explicit modes are both exercisable.
+            build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips,
+                             with_iommufd=True)
             vfio_mgr = VfioPciManager(sysfs_root=sys_root, dev_root=dev_root,
                                       fixture_kernel=True)
         tpu = TpuDriver(

@@ -51,4 +51,68 @@ EOF
 kubectl wait pod plain0 -n tpu-test-vfio --for=Running --timeout=30
 echo "vfio OK: chip reusable as accel device after passthrough release"
 
+stop_cluster
+
+# -- partitioned multi-chip passthrough (legacy backend + API device) --------
+# The group's isolating ICI partition is carved before the vfio-pci binds;
+# the pod receives two legacy group fds plus /dev/vfio/vfio; deleting the
+# workload releases the partition and rebinds the accel driver (exercised
+# via the overlapping subslice becoming schedulable).
+start_cluster v5e-4 --gates PassthroughSupport=true,ICIPartitioning=true,DynamicSubslice=true
+
+kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test-vfio-part.yaml"
+kubectl wait pod vm-pair -n tpu-test-vfio-part --for=Running --timeout=30
+
+pod_json="$(kubectl get pods -n tpu-test-vfio-part -o json)"
+$PY - <<PYEOF
+import json
+pods = json.loads('''$pod_json''')
+p = pods[0]
+devs = p["injected_devices"]
+groups = [d for d in devs if "/vfio/" in d and "/devices/" not in d
+          and not d.endswith("/vfio/vfio")]
+assert len(groups) == 2, f"want two legacy group fds, got {devs}"
+assert any(d.endswith("/vfio/vfio") for d in devs), f"missing API device: {devs}"
+assert p["injected_env"].get("TPU_VFIO_IOMMU_MODE") == "legacy", p["injected_env"]
+print("vfio-part OK: two group fds + /dev/vfio/vfio")
+PYEOF
+
+# While the pair is passed through, the 1x2 subslice over the SAME chips
+# is unschedulable (KEP-4815 chip counters are consumed by the vfio
+# claim), so its ICI carve can never race the passthrough partition.
+kubectl apply -f - <<EOF
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: sub, namespace: tpu-test-vfio-part}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: s
+        exactly:
+          deviceClassName: subslice.tpu.google.com
+          count: 1
+          selectors:
+          - cel:
+              expression: device.attributes["tpu.google.com"].chips == "0,1"
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: carve0, namespace: tpu-test-vfio-part}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: s, resourceClaimTemplateName: sub}]
+EOF
+if kubectl wait pod carve0 -n tpu-test-vfio-part --for=Running --timeout=5 2>/dev/null; then
+  echo "FAIL: overlapping subslice scheduled while its chips were passed through" >&2
+  exit 1
+fi
+echo "vfio-part OK: overlapping subslice blocked while passthrough holds the chips"
+
+# Releasing the passthrough group frees the partition: the carve succeeds.
+kubectl delete pod vm-pair -n tpu-test-vfio-part
+kubectl wait pod vm-pair -n tpu-test-vfio-part --for=deleted --timeout=30
+kubectl wait pod carve0 -n tpu-test-vfio-part --for=Running --timeout=30
+echo "vfio-part OK: partition released on unprepare; subslice carved"
+
 echo "PASS test_vfio"

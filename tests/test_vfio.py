@@ -248,6 +248,282 @@ def test_vfio_config_requires_gate(tmp_path, lib, fixture_roots, monkeypatch):
         state.prepare(make_vfio_claim())
 
 
+# -- IOMMU backend plumbing ---------------------------------------------------
+
+def make_group_claim(devices, configs=None):
+    claim = ResourceClaim(meta=new_meta("vm-group", "default"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[
+            DeviceRequestAllocationResult(
+                request="tpu", driver=TPU_DRIVER_NAME, pool=NODE, device=d)
+            for d in devices
+        ],
+        node_name=NODE,
+    )
+    claim.config = configs or []
+    return claim
+
+
+def make_state(tmp_path, lib, monkeypatch, *, gates, with_iommufd=False,
+               sub=""):
+    boot = tmp_path / f"boot_id{sub}"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+    sys_root = str(tmp_path / f"sysfs{sub}")
+    dev_root = str(tmp_path / f"dev{sub}")
+    build_vfio_sysfs(sys_root, dev_root, lib.enumerate().chips,
+                     with_iommufd=with_iommufd)
+    return DeviceState(
+        lib, str(tmp_path / f"plugin{sub}"),
+        cdi_root=str(tmp_path / f"cdi{sub}"),
+        gates=fg.parse(gates),
+        vfio=VfioPciManager(sysfs_root=sys_root, dev_root=dev_root,
+                            fixture_kernel=True),
+    )
+
+
+def _claim_nodes(state, uid):
+    spec = state.cdi.read_claim_spec(uid)
+    return [n["path"] for d in spec["devices"]
+            for n in d["containerEdits"].get("deviceNodes", [])]
+
+
+def test_iommu_legacy_mode_injects_group_fd(tmp_path, lib, monkeypatch):
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true", with_iommufd=True)
+    claim = make_vfio_claim(configs=[vfio_cfg(iommu_mode="legacy")])
+    state.prepare(claim)
+    nodes = _claim_nodes(state, claim.uid)
+    assert any(f"/vfio/{iommu_group_for(0)}" in n for n in nodes)
+    assert not any("/vfio/devices/" in n for n in nodes)
+    spec = state.cdi.read_claim_spec(claim.uid)
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    assert "TPU_VFIO_IOMMU_MODE=legacy" in envs
+
+
+def test_iommu_iommufd_mode_injects_cdev(tmp_path, lib, monkeypatch):
+    """iommufd backend: the per-device cdev (/dev/vfio/devices/vfioN) is
+    the workload's handle, not the group fd (vfio-cdi.go:96-110)."""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true", with_iommufd=True)
+    claim = make_vfio_claim(configs=[vfio_cfg(iommu_mode="iommufd")])
+    state.prepare(claim)
+    nodes = _claim_nodes(state, claim.uid)
+    assert any("/vfio/devices/vfio" in n for n in nodes), nodes
+    assert not any(n.endswith(f"/vfio/{iommu_group_for(0)}") for n in nodes)
+    spec = state.cdi.read_claim_spec(claim.uid)
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    assert "TPU_VFIO_IOMMU_MODE=iommufd" in envs
+
+
+def test_iommu_auto_prefers_iommufd_when_available(tmp_path, lib, monkeypatch):
+    with_fd = make_state(tmp_path, lib, monkeypatch,
+                         gates="PassthroughSupport=true", with_iommufd=True,
+                         sub="a")
+    claim = make_vfio_claim(configs=[vfio_cfg(iommu_mode="auto")])
+    with_fd.prepare(claim)
+    assert any("/vfio/devices/vfio" in n for n in _claim_nodes(with_fd, claim.uid))
+
+    without = make_state(tmp_path, lib, monkeypatch,
+                         gates="PassthroughSupport=true", with_iommufd=False,
+                         sub="b")
+    claim2 = make_vfio_claim(configs=[vfio_cfg(iommu_mode="auto")])
+    without.prepare(claim2)
+    nodes = _claim_nodes(without, claim2.uid)
+    assert any(f"/vfio/{iommu_group_for(0)}" in n for n in nodes)
+    assert not any("/vfio/devices/" in n for n in nodes)
+
+
+def test_iommufd_mode_without_dev_iommu_fails_before_bind(tmp_path, lib, monkeypatch):
+    """iommu_mode=iommufd on a node with no /dev/iommu must refuse at
+    config resolution — BEFORE any sysfs mutation (the restructured
+    ordering: config precedes bind)."""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true", with_iommufd=False)
+    claim = make_vfio_claim(configs=[vfio_cfg(iommu_mode="iommufd")])
+    with pytest.raises(PrepareError, match="iommufd backend unavailable"):
+        state.prepare(claim)
+    # The bind never happened: the chip is still on the accel driver.
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    assert claim.uid not in state.prepared_claims()
+
+
+def test_enable_api_device_injects_iommu_api_node(tmp_path, lib, monkeypatch):
+    """enable_api_device adds the claim-common IOMMU API device:
+    /dev/iommu under iommufd, /dev/vfio/vfio under legacy
+    (vfio-cdi.go:52-81 GetCommonEdits)."""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true", with_iommufd=True,
+                       sub="fd")
+    claim = make_vfio_claim(
+        configs=[vfio_cfg(iommu_mode="iommufd", enable_api_device=True)])
+    state.prepare(claim)
+    assert any(n.endswith("/iommu") for n in _claim_nodes(state, claim.uid))
+
+    legacy = make_state(tmp_path, lib, monkeypatch,
+                        gates="PassthroughSupport=true", with_iommufd=False,
+                        sub="lg")
+    claim2 = make_vfio_claim(
+        configs=[vfio_cfg(iommu_mode="legacy", enable_api_device=True)])
+    legacy.prepare(claim2)
+    assert any(n.endswith("/vfio/vfio") for n in _claim_nodes(legacy, claim2.uid))
+    # Without the flag, no API device is injected.
+    claim3 = make_vfio_claim(configs=[vfio_cfg(iommu_mode="legacy")])
+    legacy.unprepare(claim2.uid)
+    legacy.prepare(claim3)
+    assert not any(n.endswith("/vfio/vfio") for n in _claim_nodes(legacy, claim3.uid))
+
+
+def test_conflicting_vfio_configs_refused(tmp_path, lib, monkeypatch):
+    """Two requests in one claim pinning DIFFERENT effective vfio configs
+    can't both govern the single passthrough group. (Two configs on the
+    SAME request are ordinary apply-order semantics: last wins.)"""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true")
+    claim = ResourceClaim(meta=new_meta("vm-conflict", "default"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[
+            DeviceRequestAllocationResult(
+                request="a", driver=TPU_DRIVER_NAME, pool=NODE,
+                device="tpu-0-vfio"),
+            DeviceRequestAllocationResult(
+                request="b", driver=TPU_DRIVER_NAME, pool=NODE,
+                device="tpu-1-vfio"),
+        ],
+        node_name=NODE,
+    )
+
+    def cfg_for(req, mode):
+        c = vfio_cfg(iommu_mode=mode)
+        c.requests = [req]
+        return c
+
+    claim.config = [cfg_for("a", "legacy"), cfg_for("b", "auto")]
+    with pytest.raises(PrepareError, match="conflicting VfioTpuConfigs"):
+        state.prepare(claim)
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    # Same request, two configs: last wins, no conflict.
+    claim2 = make_vfio_claim(
+        configs=[vfio_cfg(iommu_mode="auto"), vfio_cfg(iommu_mode="legacy")])
+    state.prepare(claim2)
+    nodes = _claim_nodes(state, claim2.uid)
+    assert any(f"/vfio/{iommu_group_for(0)}" in n for n in nodes)
+
+
+def test_claim_vfio_config_overrides_class_default(tmp_path, lib, monkeypatch):
+    """A class-sourced VfioTpuConfig default plus a claim override is the
+    precedence machinery working, not a conflict: the claim (most
+    specific, applied last) wins."""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates="PassthroughSupport=true", with_iommufd=True)
+    class_default = DeviceClaimConfig(
+        requests=[], source="class",
+        opaque=OpaqueDeviceConfig(
+            driver=TPU_DRIVER_NAME,
+            parameters={"apiVersion": API_VERSION, "kind": "VfioTpuConfig",
+                        "iommu_mode": "auto"},
+        ),
+    )
+    claim = make_vfio_claim(
+        configs=[class_default, vfio_cfg(iommu_mode="legacy")])
+    state.prepare(claim)
+    nodes = _claim_nodes(state, claim.uid)
+    # auto would have picked iommufd (it's available); legacy won.
+    assert any(f"/vfio/{iommu_group_for(0)}" in n for n in nodes)
+    assert not any("/vfio/devices/" in n for n in nodes)
+
+
+def test_group_env_lists_every_function(tmp_path, lib, monkeypatch):
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    claim = make_group_claim(["tpu-0-vfio", "tpu-1-vfio"])
+    state.prepare(claim)
+    spec = state.cdi.read_claim_spec(claim.uid)
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    lists = [e for e in envs if e.startswith("TPU_VFIO_PCI_ADDRESSES=")]
+    assert lists and len(lists[0].split("=", 1)[1].split(",")) == 2, envs
+
+
+# -- VFIO <-> ICI partitioner coupling ---------------------------------------
+
+PART_GATES = "PassthroughSupport=true,ICIPartitioning=true"
+
+
+def test_passthrough_group_activates_partition_before_bind(tmp_path, lib, monkeypatch):
+    """A 2-chip passthrough group on a 4-chip host carves its isolating
+    ICI partition BEFORE the vfio binds and releases it on unprepare
+    (reference device_state.go:1284-1289 + deactivateFabricPartition)."""
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    assert state.partitions is not None
+    claim = make_group_claim(["tpu-0-vfio", "tpu-1-vfio"])
+    res = state.prepare(claim)
+    assert len(res.devices) == 2
+    active = [p.id for p in state.partitions.active_partitions()]
+    assert active == ["1x2-at-0x0"]
+    assert all(d.extra.get("partition") == "1x2-at-0x0" for d in res.devices)
+    assert state.vfio.current_driver(ADDR0) == "vfio-pci"
+    state.unprepare(claim.uid)
+    assert state.partitions.active_partitions() == []
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+
+
+def test_passthrough_whole_host_needs_no_partition(tmp_path, lib, monkeypatch):
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    claim = make_group_claim([f"tpu-{i}-vfio" for i in range(4)])
+    state.prepare(claim)
+    assert state.partitions.active_partitions() == []  # nothing else shares the mesh
+    state.unprepare(claim.uid)
+
+
+def test_passthrough_illegal_group_refused_before_bind(tmp_path, lib, monkeypatch):
+    """Diagonal chips (0,3) form no legal ICI partition on a 2x2 host:
+    refuse activation — and since partitioning precedes binding, no sysfs
+    mutation happened (the reference's 'does not match any FM partition'
+    refusal)."""
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    claim = make_group_claim(["tpu-0-vfio", "tpu-3-vfio"])
+    with pytest.raises(PrepareError, match="no legal"):
+        state.prepare(claim)
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    assert state.partitions.active_partitions() == []
+
+
+def test_passthrough_partition_blocks_overlapping_subslice(tmp_path, lib, monkeypatch):
+    """While chips 0-1 are passed through, a subslice carve over chip 0
+    must fail partition activation (the isolation the coupling buys)."""
+    state = make_state(tmp_path, lib, monkeypatch,
+                       gates=PART_GATES + ",DynamicSubslice=true")
+    vm = make_group_claim(["tpu-0-vfio", "tpu-1-vfio"])
+    state.prepare(vm)
+    sub = make_group_claim(["tpu-subslice-1x2-at-0x0"])
+    with pytest.raises(Exception):  # overlap guard or partition overlap
+        state.prepare(sub)
+    state.unprepare(vm.uid)
+    state.prepare(sub)  # after release, the same carve succeeds
+    assert [p.id for p in state.partitions.active_partitions()] == ["1x2-at-0x0"]
+
+
+def test_partition_released_when_second_bind_fails(tmp_path, lib, monkeypatch):
+    """Group of 2: first chip binds, second bind blows up -> the group's
+    partition must not leak (rollback releases it after the unbinds)."""
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    real_bind = state.vfio.bind_to_vfio
+
+    def failing_bind(addr, dev_path=None):
+        if addr != ADDR0:
+            raise VfioError("injected bind failure")
+        return real_bind(addr, dev_path=dev_path)
+
+    monkeypatch.setattr(state.vfio, "bind_to_vfio", failing_bind)
+    claim = make_group_claim(["tpu-0-vfio", "tpu-1-vfio"])
+    with pytest.raises(VfioError, match="injected"):
+        state.prepare(claim)
+    assert state.partitions.active_partitions() == []
+    assert state.vfio.current_driver(ADDR0) == "accel-tpu"
+    assert claim.uid not in state.prepared_claims()
+
+
 def test_vfio_excludes_accel_node_and_chip_env(state):
     """Passthrough hands the group node, never the accel char dev or the
     TPU_VISIBLE_* env of the shared path (vfio-cdi.go:52-118)."""
