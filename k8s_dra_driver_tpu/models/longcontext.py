@@ -38,8 +38,15 @@ from k8s_dra_driver_tpu.models.flagship import (
     init_params,
 )
 from k8s_dra_driver_tpu.parallel.ring_attention import ring_attention
+from k8s_dra_driver_tpu.parallel.ulysses import ulysses_attention
 
 Params = Dict[str, Any]
+
+# The two sequence-parallel attention strategies share a signature, so
+# the model is strategy-agnostic: "ring" never materializes the full
+# sequence (O(T/n) memory); "ulysses" trades two dense all-to-alls for
+# full-sequence attention per head subset (needs heads % axis == 0).
+_ATTENTION = {"ring": ring_attention, "ulysses": ulysses_attention}
 
 
 def _pin_seq(x: jax.Array, seq_axis: str, batch_axis=None) -> jax.Array:
@@ -49,14 +56,15 @@ def _pin_seq(x: jax.Array, seq_axis: str, batch_axis=None) -> jax.Array:
 
 
 def _block(cfg: SliceProofConfig, p: Params, x: jax.Array,
-           mesh: Mesh, seq_axis: str, batch_axis=None) -> jax.Array:
+           mesh: Mesh, seq_axis: str, batch_axis=None,
+           attention: str = "ring") -> jax.Array:
     h = _rmsnorm(x, p["ln1"])
     qkv = jnp.einsum("bsd,dthk->tbshk", h, p["wqkv"].astype(jnp.bfloat16))
     q = _pin_seq(qkv[0], seq_axis, batch_axis)
     k = _pin_seq(qkv[1], seq_axis, batch_axis)
     v = _pin_seq(qkv[2], seq_axis, batch_axis)
-    attn = ring_attention(q, k, v, mesh, seq_axis=seq_axis, causal=True,
-                          batch_axis=batch_axis)
+    attn = _ATTENTION[attention](q, k, v, mesh, seq_axis=seq_axis,
+                                 causal=True, batch_axis=batch_axis)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(jnp.bfloat16))
 
     h = _rmsnorm(x, p["ln2"])
@@ -66,18 +74,21 @@ def _block(cfg: SliceProofConfig, p: Params, x: jax.Array,
 
 
 def forward(cfg: SliceProofConfig, params: Params, tokens: jax.Array,
-            mesh: Mesh, seq_axis: str = "sp", batch_axis=None) -> jax.Array:
+            mesh: Mesh, seq_axis: str = "sp", batch_axis=None,
+            attention: str = "ring") -> jax.Array:
     x = _pin_seq(params["embed"].astype(jnp.bfloat16)[tokens], seq_axis, batch_axis)
     for p in params["layers"]:
-        x = _block(cfg, p, x, mesh, seq_axis, batch_axis)
+        x = _block(cfg, p, x, mesh, seq_axis, batch_axis, attention)
     return jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"].astype(jnp.bfloat16)
     ).astype(jnp.float32)
 
 
-def loss_fn(cfg, params, batch, mesh, seq_axis: str = "sp", batch_axis=None):
+def loss_fn(cfg, params, batch, mesh, seq_axis: str = "sp", batch_axis=None,
+            attention: str = "ring"):
     return nll_loss(
-        forward(cfg, params, batch["tokens"], mesh, seq_axis, batch_axis),
+        forward(cfg, params, batch["tokens"], mesh, seq_axis, batch_axis,
+                attention),
         batch["tokens"])
 
 
@@ -89,12 +100,16 @@ def make_longcontext_train_step(
     seed: int = 0,
     seq_axis: str = "sp",
     data_parallel: int = 1,
+    attention: str = "ring",
 ):
     """Build (jitted_step, sharded_state, sharded_batch) with the sequence
     sharded over the sp axis. ``data_parallel`` > 1 composes dp×sp: the
     batch dimension shards over a data axis whose replicas each run their
-    own attention ring over ``len(devices)/data_parallel`` devices.
-    cfg.seq_len must divide by the ring size."""
+    own attention ring (or Ulysses group) over
+    ``len(devices)/data_parallel`` devices. ``attention`` picks the
+    sequence-parallel strategy: "ring" (O(T/n) memory) or "ulysses"
+    (all-to-all head exchange; needs cfg.n_heads % group == 0).
+    cfg.seq_len must divide by the group size."""
     n = len(devices)
     if n % data_parallel:
         raise ValueError(f"device count ({n}) must divide by data_parallel "
@@ -102,9 +117,16 @@ def make_longcontext_train_step(
     ring = n // data_parallel
     if cfg.seq_len % ring:
         raise ValueError(f"seq_len ({cfg.seq_len}) must divide by ring size ({ring})")
+    if attention not in _ATTENTION:
+        raise ValueError(f"unknown attention strategy {attention!r}; "
+                         f"want one of {sorted(_ATTENTION)}")
+    if attention == "ulysses" and cfg.n_heads % ring:
+        raise ValueError(f"ulysses needs n_heads ({cfg.n_heads}) divisible "
+                         f"by the sp group size ({ring})")
     if cfg.attention != "einsum":
-        raise ValueError("long-context training uses ring attention; "
-                         "cfg.attention must stay 'einsum' (the default)")
+        raise ValueError("long-context training uses sequence-parallel "
+                         "attention; cfg.attention must stay 'einsum' "
+                         "(the default)")
     if data_parallel > 1:
         # sp innermost: ring hops stay on neighbor ICI links; the gradient
         # allreduce crosses the outer data axis.
@@ -126,6 +148,7 @@ def make_longcontext_train_step(
         params, mom = state["params"], state["momentum"]
         loss, grads = jax.value_and_grad(partial(
             loss_fn, cfg, seq_axis=seq_axis, batch_axis=batch_axis,
+            attention=attention,
         ), argnums=0)(params, batch, mesh)
         new_params, new_mom = momentum_sgd(params, mom, grads, cfg.learning_rate)
         return {"params": new_params, "momentum": new_mom}, loss

@@ -1,0 +1,93 @@
+"""Multi-process cluster-initialization proof — the function-proof job.
+
+The reference proves an assembled IMEX domain works by running a real
+collective across the fabric (demo/specs/imex/nvbandwidth-test-job.yaml);
+this is the same proof for a driver-assembled TPU slice: every worker
+process configures itself EXCLUSIVELY from the environment the channel
+device's CDI spec injected (plugins/computedomain/computedomain.py
+bootstrap_env), calls ``jax.distributed.initialize``, and runs a psum
+across all processes. If the env the driver hands out is wrong in any way
+— bad coordinator, inconsistent worker ids, wrong peer count — the
+cluster never initializes or the reduction disagrees.
+
+Derivation (exactly what libtpu/JAX do on a real slice):
+- process_id         <- TPU_WORKER_ID
+- num_processes      <- len(TPU_WORKER_HOSTNAMES)
+- coordinator        <- MEGASCALE_COORDINATOR_ADDRESS (host:port)
+
+Each worker contributes (process_id + 1); the psum must equal
+N(N+1)/2 on every process. Prints one JSON line with the result.
+
+Usage (as the container command of an Indexed Job on a ComputeDomain, or
+spawned locally by the e2e harness on the CPU backend):
+
+    python -m k8s_dra_driver_tpu.ops.psum_proof
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def run_proof(timeout_s: float = 60.0) -> dict:
+    hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if not hosts:
+        raise SystemExit("TPU_WORKER_HOSTNAMES missing: not a driver-assembled slice")
+    process_id = int(os.environ["TPU_WORKER_ID"])
+    coordinator = os.environ["MEGASCALE_COORDINATOR_ADDRESS"]
+    num_processes = len(hosts)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=int(timeout_s),
+    )
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
+    devices = jax.devices()  # global: every process's devices
+    mesh = Mesh(np.array(devices), ("d",))
+    # Every local device contributes this process's (id + 1); the psum is
+    # a REAL cross-process collective over the distributed runtime.
+    local = jnp.full((jax.local_device_count(), 1),
+                     float(process_id + 1), jnp.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("d")), np.asarray(local), (len(devices), 1)
+    )
+
+    @jax.jit
+    def reduce(x):
+        return shard_map(
+            lambda y: jax.lax.psum(y, "d"),
+            mesh=mesh, in_specs=P("d"), out_specs=P(None),
+        )(x)
+
+    total = float(np.asarray(jax.device_get(reduce(garr)))[0])
+    # Weighted by each process's local device count (1 on default CPU).
+    return {
+        "process_id": process_id,
+        "num_processes": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": len(devices),
+        "psum": total,
+        "platform": devices[0].platform,
+    }
+
+
+def main() -> int:
+    result = run_proof()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,14 +64,18 @@ def ulysses_attention(
     mesh: Mesh,
     *,
     seq_axis: str = "sp",
+    batch_axis=None,
     causal: bool = True,
 ) -> jax.Array:
     """Causal self-attention with q/k/v sequence-sharded over ``seq_axis``,
     computed via head-parallel all-to-all exchange.
 
     q, k, v: [B, T, H, D] global; T and H divisible by the axis size.
-    Returns [B, T, H, D] with the same sequence sharding. Same signature
-    as ``ring_attention`` so workloads can switch strategies per length.
+    ``batch_axis`` additionally shards B over a second mesh axis (the
+    dp×sp composition): the all-to-alls only ever run within each batch
+    group's sp sub-axis. Returns [B, T, H, D] with the same sharding.
+    Same signature as ``ring_attention`` so workloads can switch
+    strategies per length.
     """
     from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
 
@@ -83,7 +87,7 @@ def ulysses_attention(
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"'{seq_axis}' axis size ({n}); use ring_attention otherwise"
         )
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, None, None)
     body = partial(_ulysses_shard, axis_name=seq_axis, causal=causal)
     fn = shard_map(
         body, mesh=mesh,

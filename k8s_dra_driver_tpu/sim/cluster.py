@@ -92,9 +92,18 @@ class SimCluster:
         num_hosts: Optional[int] = None,
         gates: str = "",
         api: Optional[APIServer] = None,
+        loopback_agents: bool = False,
     ):
+        """``loopback_agents=True`` registers slice agents with their real
+        harness address (127.0.0.1 — everything runs in this process), so
+        the bootstrap env the CDI specs inject is genuinely dialable and a
+        test can launch actual OS processes from it (the
+        multi-process collective proof). Combine with
+        ``SliceAgentsWithDNSNames=false`` so clique members publish the
+        raw address instead of sim-only DNS names."""
         self.api = api if api is not None else APIServer()
         self.workdir = workdir
+        self.loopback_agents = loopback_agents
         self.gates = fg.parse(gates)
         self.allocator = Allocator(self.api)
         self.profile = profile
@@ -540,7 +549,8 @@ class SimCluster:
                 namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace),
                 domain_uid=env.get("COMPUTE_DOMAIN_UUID", ""),
                 node_name=node_name,
-                pod_ip=f"10.2.0.{len(node.agents) + 1}",
+                pod_ip=("127.0.0.1" if self.loopback_agents
+                        else f"10.2.0.{len(node.agents) + 1}"),
                 tpulib=node.tpulib,
                 workdir=os.path.join(self.workdir, node_name, "agent", pod_name),
                 gates=self.gates,
