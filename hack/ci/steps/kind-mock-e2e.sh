@@ -7,10 +7,16 @@
 set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../.." && pwd)"
 
+# Missing prerequisites are a LOUD skip (exit 75, EX_TEMPFAIL): the
+# runner reports the step as SKIPPED — never as green — so a CI pass
+# can't silently mean "the kind tier didn't run" (it did exactly that
+# until round 5). The chart-as-executed pytest tier
+# (tests/test_chart_executed.py, in the unit-tests step) covers the
+# chart command/env composition without docker meanwhile.
 for tool in docker kind kubectl helm; do
   if ! command -v "${tool}" >/dev/null 2>&1; then
-    echo "SKIP: ${tool} not installed (kind tier needs docker+kind+kubectl+helm)"
-    exit 0
+    echo "SKIPPED: ${tool} not installed (kind tier needs docker+kind+kubectl+helm)" >&2
+    exit 75
   fi
 done
 

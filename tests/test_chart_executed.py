@@ -1,0 +1,254 @@
+"""Chart-as-executed: the rendered Helm chart's container specs run.
+
+Without docker/kind, render-validation alone can't prove the chart's
+command/args/env/mount composition actually starts a working driver (the
+reference proves it with its mock-NVML kind e2e,
+.github/workflows/mock-nvml-e2e.yaml:42-83). This harness closes that
+gap: it renders the chart with MiniHelm, extracts the kubelet-plugin
+DaemonSet and controller Deployment container specs, and launches the
+EXACT commands with the EXACT env as local OS processes against the
+conformance apiserver — playing only the roles the platform would
+(kubelet mounts hostPath volumes under a sandbox root, the downward API
+resolves NODE_NAME, the service account provides the API endpoint).
+
+Editing a chart command, module path, env var name, or default value
+breaks this test — not just a live cluster.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+import yaml
+
+from tests.test_helm_chart import CHART, MiniHelm
+from tests.test_kubelet_grpc import FakeKubelet
+
+from k8s_dra_driver_tpu.api.computedomain import ComputeDomain, ComputeDomainSpec
+from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s.core import (
+    DAEMON_SET,
+    RESOURCE_SLICE,
+    DeviceClass,
+    DeviceRequest,
+    Node,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.kubeclient import KubernetesAPIServer
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.sim.allocator import Allocator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NODE_NAME = "chart-node-0"
+RELEASE = "exec"
+NAMESPACE = "tpu-dra-driver"
+
+
+def _wait(cond, timeout=45.0, msg="condition", procs=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for p in procs:
+            assert p.poll() is None, (
+                f"{getattr(p, 'chart_name', '?')} died:\n"
+                f"{p.stdout.read()[-3000:] if p.stdout else ''}"
+            )
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _render(template, values):
+    with open(os.path.join(CHART, "templates", template), encoding="utf-8") as f:
+        text = MiniHelm(values, release=RELEASE, namespace=NAMESPACE).render(f.read())
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+def _find(docs, kind, name):
+    for d in docs:
+        if d["kind"] == kind and d["metadata"]["name"] == name:
+            return d
+    raise AssertionError(f"{kind}/{name} not in render: "
+                         f"{[(d['kind'], d['metadata']['name']) for d in docs]}")
+
+
+class ChartProcessLauncher:
+    """Launches a rendered container spec as a local process, standing in
+    for exactly what the platform provides: the image's interpreter, the
+    hostPath mounts (sandboxed), the downward API, and in-cluster API
+    access (API_SERVER_URL, read by the same flag the service-account
+    path feeds)."""
+
+    def __init__(self, sandbox, api_url):
+        self.sandbox = sandbox
+        self.api_url = api_url
+        self.procs = []
+
+    def launch(self, container, extra_env=None):
+        cmd = list(container["command"]) + list(container.get("args", []))
+        assert cmd[0] == "python", f"unexpected interpreter in chart: {cmd}"
+        cmd[0] = sys.executable
+        env = {}
+        for e in container.get("env", []):
+            if "value" in e:
+                env[e["name"]] = e["value"]
+            elif (e.get("valueFrom", {}).get("fieldRef", {}).get("fieldPath")
+                  == "spec.nodeName"):
+                env[e["name"]] = NODE_NAME
+            else:
+                raise AssertionError(f"unsupported env source in chart: {e}")
+        # Kubelet's job: hostPath mounts materialize under the sandbox, so
+        # every absolute path the chart passes is remapped wholesale.
+        for k, v in env.items():
+            if v.startswith("/"):
+                env[k] = self.sandbox + v
+                os.makedirs(env[k], exist_ok=True)
+        env.update({
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/root"),
+            "PYTHONPATH": REPO,
+            "PYTHONUNBUFFERED": "1",
+            "API_SERVER_URL": self.api_url,
+            **(extra_env or {}),
+        })
+        p = subprocess.Popen(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        p.chart_name = container["name"]
+        p.chart_env = env
+        self.procs.append(p)
+        return p
+
+    def stop(self):
+        for p in reversed(self.procs):
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture
+def harness():
+    # Unix socket paths cap at ~107 bytes; pytest tmp paths are too long
+    # once the chart's /var/lib/kubelet/... prefix lands on top.
+    sandbox = tempfile.mkdtemp(prefix="chart-")
+    apiserver = subprocess.Popen(
+        [sys.executable, "-m", "k8s_dra_driver_tpu.k8s.k8sapiserver",
+         "--port", "0"],
+        env={**os.environ, "PYTHONPATH": REPO}, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    line = apiserver.stdout.readline()
+    assert "serving k8s wire on " in line, line
+    url = line.strip().split()[-1]
+    launcher = ChartProcessLauncher(sandbox, url)
+    try:
+        yield launcher, KubernetesAPIServer(base_url=url)
+    finally:
+        launcher.stop()
+        apiserver.terminate()
+        try:
+            apiserver.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            apiserver.kill()
+        import shutil
+
+        shutil.rmtree(sandbox, ignore_errors=True)  # mkdtemp: caller cleans up
+
+
+def _chart_values():
+    with open(os.path.join(CHART, "values.yaml"), encoding="utf-8") as f:
+        values = yaml.safe_load(f)
+    # User-facing values choices, not spec rewrites: the chart's own mock
+    # seam (the mock-NVML driver-root analog) and ephemeral metrics ports
+    # so parallel CI runs don't collide.
+    values["kubeletPlugin"]["altTpuTopology"] = "v5e-4"
+    values["kubeletPlugin"]["metricsPort"] = 0
+    values["controller"]["metricsPort"] = 0
+    return values
+
+
+def test_chart_daemonset_containers_run_and_prepare(harness):
+    """The DaemonSet's two plugin containers, launched verbatim from the
+    render, register over the chart-configured kubelet dirs, publish
+    ResourceSlices, and serve a Prepare whose CDI spec lands under the
+    chart's cdiRoot."""
+    launcher, kube = harness
+    values = _chart_values()
+    ds = _find(_render("kubeletplugin.yaml", values),
+               "DaemonSet", f"{RELEASE}-kubelet-plugin")
+    containers = {c["name"]: c for c in ds["spec"]["template"]["spec"]["containers"]}
+    assert set(containers) == {"tpu-kubelet-plugin", "compute-domain-kubelet-plugin"}
+
+    kube.create(Node(meta=new_meta(NODE_NAME)))
+    kube.create(DeviceClass(meta=new_meta("tpu.google.com"),
+                            driver=TPU_DRIVER_NAME,
+                            match_attributes={"type": "tpu"}))
+
+    by_name = {name: launcher.launch(c) for name, c in containers.items()}
+    procs = list(by_name.values())
+
+    # Both drivers publish their node's slices through the chart env alone.
+    _wait(lambda: len({s.driver for s in kube.list(RESOURCE_SLICE)
+                       if s.node_name == NODE_NAME}) >= 2,
+          msg="ResourceSlices from both chart containers", procs=procs)
+
+    # The kubelet seam: the registration socket appears under the chart's
+    # REGISTRAR_DIR (sandboxed hostPath), exactly where kubelet watches.
+    tpu_env = by_name["tpu-kubelet-plugin"].chart_env
+    registrar = tpu_env["REGISTRAR_DIR"]
+    kubelet = FakeKubelet(registrar)
+    _wait(lambda: kubelet.discover_sockets(), msg="registration sockets",
+          procs=procs)
+    socks = kubelet.discover_sockets()
+    tpu_sock = next(s for s in socks if "tpu.google.com" in s
+                    and "compute-domain" not in s)
+    endpoint = kubelet.get_info(tpu_sock).endpoint
+    assert endpoint.startswith(tpu_env["KUBELET_PLUGIN_DIR"]), (
+        "DRA socket must live under the chart's pluginDir")
+    kubelet.notify_registered(tpu_sock)
+
+    # A claim prepared over that socket materializes its CDI spec under
+    # the chart's cdiRoot.
+    claim = kube.create(ResourceClaim(
+        meta=new_meta("chart-claim", "default"),
+        requests=[DeviceRequest(name="tpus", device_class_name="tpu.google.com",
+                                count=1)],
+    ))
+    alloc = Allocator(kube).allocate_on_node(claim, NODE_NAME)
+    assert alloc is not None
+
+    def set_alloc(obj):
+        obj.allocation = alloc
+
+    claim = kube.update_with_retry("ResourceClaim", "chart-claim", "default",
+                                   set_alloc)
+    resp = kubelet.node_prepare(endpoint, [claim], "v1")
+    assert resp.claims[claim.uid].error == "", resp.claims[claim.uid].error
+    cdi_root = tpu_env["CDI_ROOT"]
+    specs = os.listdir(cdi_root)
+    assert any(claim.uid in f for f in specs), (cdi_root, specs)
+
+
+def test_chart_controller_container_reconciles(harness):
+    """The controller Deployment's container, launched verbatim from the
+    render (including --driver-namespace derived from the release
+    namespace), reconciles a ComputeDomain into a slice-agent DaemonSet."""
+    launcher, kube = harness
+    values = _chart_values()
+    dep = _find(_render("controller.yaml", values),
+                "Deployment", f"{RELEASE}-controller")
+    (container,) = dep["spec"]["template"]["spec"]["containers"]
+    assert f"--driver-namespace={NAMESPACE}" in container["args"]
+
+    proc = launcher.launch(container)
+    kube.create(ComputeDomain(meta=new_meta("cd-chart", "default"),
+                              spec=ComputeDomainSpec(num_nodes=1)))
+    _wait(lambda: kube.try_get(DAEMON_SET, "cd-chart-slice-agent", NAMESPACE),
+          msg="controller rendered the slice-agent DaemonSet", procs=[proc])

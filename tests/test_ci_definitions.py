@@ -9,6 +9,7 @@ import re
 import stat
 import subprocess
 
+import pytest
 import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -58,6 +59,38 @@ def test_local_runner_knows_every_step():
     for name in step_names - {"kind-mock-e2e"}:
         assert name in runner, f"run-local.sh does not run step {name}"
     assert "kind-mock-e2e" in runner  # opt-in via RUN_KIND=1
+
+
+def test_prerequisite_skips_are_loud():
+    """A step that can't run must exit 75 (EX_TEMPFAIL), and both runners
+    must surface that as SKIPPED — never as a silent green. Green CI that
+    quietly omitted a tier is how the chart composition went untested for
+    four rounds."""
+    with open(os.path.join(STEPS_DIR, "kind-mock-e2e.sh"), encoding="utf-8") as f:
+        kind = f.read()
+    assert "exit 75" in kind and "exit 0" not in kind.split("for tool")[1].split("done")[0]
+    # An empty PATH dir GUARANTEES the prerequisite loop fails, so this
+    # never accidentally runs a real kind e2e on a box that has the tools.
+    import tempfile
+
+    empty = tempfile.mkdtemp(prefix="nopath-")
+    try:
+        proc = subprocess.run(
+            ["/bin/bash", os.path.join(STEPS_DIR, "kind-mock-e2e.sh")],
+            capture_output=True, text=True, timeout=60,
+            env={"PATH": empty},
+        )
+    finally:
+        os.rmdir(empty)
+    assert proc.returncode == 75, (proc.returncode, proc.stdout, proc.stderr)
+    assert "SKIPPED" in proc.stderr
+    with open(os.path.join(REPO, "hack", "ci", "run-local.sh"), encoding="utf-8") as f:
+        runner = f.read()
+    assert "75" in runner and "SKIPPED (did not run)" in runner
+    with open(os.path.join(REPO, ".github", "workflows", "kind-mock-e2e.yaml"),
+              encoding="utf-8") as f:
+        wf = f.read()
+    assert "::warning" in wf and "75" in wf
 
 
 def test_step_scripts_are_valid_bash():
