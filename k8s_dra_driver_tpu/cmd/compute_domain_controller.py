@@ -28,6 +28,13 @@ def main(argv=None) -> int:
     )
     add_api_backend_flag(parser)
     parser.add_argument("--driver-namespace", default="tpu-dra-driver")
+    parser.add_argument(
+        "--additional-namespaces",
+        default=flagpkg._env_default("ADDITIONAL_NAMESPACES", "", str),
+        help="comma list of additional namespaces where per-CD DaemonSets "
+        "are managed (the reference --additional-namespaces, "
+        "main.go:183-188) [ADDITIONAL_NAMESPACES]",
+    )
     parser.add_argument("--metrics-port", type=int,
                         default=flagpkg._env_default("METRICS_PORT", 0, int),
                         help="serve Prometheus metrics here; 0 disables "
@@ -68,6 +75,10 @@ def main(argv=None) -> int:
         leader_elect=args.leader_elect, metrics_registry=registry,
         max_nodes_per_domain=args.max_nodes_per_domain or DEFAULT_MAX_NODES_PER_DOMAIN,
         slice_config=slice_config,
+        additional_namespaces=[
+            ns.strip() for ns in args.additional_namespaces.split(",")
+            if ns.strip()
+        ],
     )
     controller.start()
     log.info("%s running (leader_elect=%s)",

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from k8s_dra_driver_tpu.api.computedomain import (
     CD_STATUS_NOT_READY,
@@ -61,9 +61,23 @@ class Controller:
         cleanup_interval_s: float = 600.0,
         max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN,
         slice_config: Optional[SliceAgentConfig] = None,
+        additional_namespaces: Sequence[str] = (),
     ):
         self.api = api
         self.driver_namespace = driver_namespace
+        # Per-CD DaemonSets are managed across the driver namespace PLUS
+        # these (the reference's MultiNamespaceDaemonSetManager,
+        # mnsdaemonset.go:29-119): a DS already living in any managed
+        # namespace — e.g. placed there by a previous install — is kept
+        # and managed there instead of duplicated; deletion and orphan
+        # sweeps span all of them. New DSes are created in the driver
+        # namespace. Deduplicated, driver namespace first.
+        seen = {driver_namespace}
+        self.managed_namespaces: List[str] = [driver_namespace]
+        for ns in additional_namespaces:
+            if ns and ns not in seen:
+                seen.add(ns)
+                self.managed_namespaces.append(ns)
         self.identity = identity
         self.max_nodes_per_domain = max_nodes_per_domain
         self.slice_config = slice_config or SliceAgentConfig()
@@ -232,7 +246,7 @@ class Controller:
             # Host-managed agents (pkg/sliceconfig Mode.HOST_MANAGED): the
             # node image runs the slice agent, so no DaemonSet is deployed —
             # the reference's HostManagedIMEXDaemon behavior.
-            owned.append(daemon_set_for_domain(cd, self.driver_namespace))
+            self._ensure_daemon_set(cd)
         for obj in owned:
             existing = self.api.try_get(obj.kind, obj.meta.name, obj.meta.namespace)
             if existing is None:
@@ -242,6 +256,39 @@ class Controller:
                     f"{obj.kind} {obj.key} exists but is not owned by ComputeDomain "
                     f"{cd.key} — refusing to adopt"
                 )
+
+    def _ensure_daemon_set(self, cd: ComputeDomain) -> None:
+        """The MultiNamespaceDaemonSetManager.Create semantics
+        (mnsdaemonset.go:81-97): a DS for this CD already living in ANY
+        managed namespace is kept there (it keeps working; no duplicate);
+        otherwise the DS is created in the driver namespace. The
+        anti-spoof check is unchanged: a same-named object NOT owned by
+        this CD is never adopted, in any namespace."""
+        ds = daemon_set_for_domain(cd, self.driver_namespace)
+        kept = None
+        for ns in self.managed_namespaces:
+            existing = self.api.try_get(DAEMON_SET, ds.meta.name, ns)
+            if existing is None:
+                continue
+            if not existing.owned_by(cd):
+                raise RuntimeError(
+                    f"DaemonSet {ns}/{ds.meta.name} exists but is not owned "
+                    f"by ComputeDomain {cd.key} — refusing to adopt"
+                )
+            if kept is None:
+                kept = ns  # managed where it already lives (driver ns wins)
+            else:
+                # Owned duplicate from a namespace migration (e.g. the
+                # driver-ns copy was created before --additional-namespaces
+                # was configured): converge to one DS per CD.
+                log.warning("removing duplicate slice-agent DS %s/%s "
+                            "(kept %s)", ns, ds.meta.name, kept)
+                try:
+                    self.api.delete(DAEMON_SET, ds.meta.name, ns)
+                except NotFoundError:
+                    pass
+        if kept is None:
+            self.api.create(ds)
 
     # -- status ---------------------------------------------------------------
 
@@ -301,13 +348,19 @@ class Controller:
     # -- deletion --------------------------------------------------------------
 
     def _delete_owned_objects(self, cd: ComputeDomain) -> None:
-        for kind, name, ns in (
-            (DAEMON_SET, f"{cd.name}-slice-agent", self.driver_namespace),
+        # The DS may live in any managed namespace (mnsdaemonset.go Delete
+        # spans all of them).
+        targets = [
+            (DAEMON_SET, f"{cd.name}-slice-agent", ns)
+            for ns in self.managed_namespaces
+        ]
+        targets += [
             (RESOURCE_CLAIM_TEMPLATE, f"{cd.name}-daemon-claim", self.driver_namespace),
             (RESOURCE_CLAIM_TEMPLATE,
              cd.spec.channel.resource_claim_template_name or f"{cd.name}-channel",
              cd.namespace),
-        ):
+        ]
+        for kind, name, ns in targets:
             obj = self.api.try_get(kind, name, ns)
             if obj is not None and obj.owned_by(cd):
                 try:

@@ -406,6 +406,92 @@ def test_controller_teardown_on_delete():
         ctrl.stop()
 
 
+def test_controller_multi_namespace_daemonsets():
+    """additionalNamespaces (mnsdaemonset.go:29-119): two CDs in two
+    workload namespaces; one's DS already lives in an additional managed
+    namespace (a previous install placed it there) and is managed THERE —
+    no duplicate in the driver namespace — while the other's DS is
+    created in the driver namespace. Deletion sweeps both namespaces.
+    The anti-spoof refusal is unchanged in additional namespaces."""
+    from k8s_dra_driver_tpu.controller.templates import daemon_set_for_domain
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600,
+                      additional_namespaces=["legacy-ns", "tpu-dra-driver"])
+    assert ctrl.managed_namespaces == ["tpu-dra-driver", "legacy-ns"]  # deduped
+
+    # cd-old's DS pre-exists in legacy-ns, owned by it.
+    cd_old = ComputeDomain(
+        meta=new_meta("cd-old", "team-a"),
+        spec=ComputeDomainSpec(
+            num_nodes=0,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="cd-old-channel"),
+        ),
+    )
+    cd_old = api.create(cd_old)
+    pre_ds = daemon_set_for_domain(cd_old, "legacy-ns")
+    api.create(pre_ds)
+
+    ctrl.start()
+    try:
+        cd_new = make_cd(api, name="cd-new", ns="team-b")
+        wait_for(
+            lambda: api.try_get(DAEMON_SET, "cd-new-slice-agent", "tpu-dra-driver"),
+            msg="new CD's DS in the driver namespace",
+        )
+        wait_for(
+            lambda: COMPUTE_DOMAIN_FINALIZER
+            in api.get("ComputeDomain", "cd-old", "team-a").meta.finalizers,
+            msg="cd-old reconciled",
+        )
+        # Adopted in place: managed in legacy-ns, NOT duplicated.
+        assert api.try_get(DAEMON_SET, "cd-old-slice-agent", "legacy-ns") is not None
+        assert api.try_get(DAEMON_SET, "cd-old-slice-agent", "tpu-dra-driver") is None
+
+        # Migration convergence: an owned duplicate (created before the
+        # flag was configured) is swept; exactly one DS per CD survives.
+        dup = daemon_set_for_domain(
+            api.get("ComputeDomain", "cd-old", "team-a"), "tpu-dra-driver")
+        api.create(dup)
+        ctrl._ensure_daemon_set(api.get("ComputeDomain", "cd-old", "team-a"))
+        assert api.try_get(DAEMON_SET, "cd-old-slice-agent", "legacy-ns") is None
+        assert api.try_get(DAEMON_SET, "cd-old-slice-agent", "tpu-dra-driver") is not None
+
+        # Deleting cd-old sweeps the DS out of the additional namespace.
+        api.delete("ComputeDomain", "cd-old", "team-a")
+        wait_for(lambda: api.try_get("ComputeDomain", "cd-old", "team-a") is None,
+                 msg="cd-old teardown")
+        assert api.try_get(DAEMON_SET, "cd-old-slice-agent", "legacy-ns") is None
+        assert api.try_get(DAEMON_SET, "cd-new-slice-agent", "tpu-dra-driver") is not None
+    finally:
+        ctrl.stop()
+
+
+def test_controller_multi_namespace_antispoof():
+    """A same-named DS in an additional namespace NOT owned by the CD is
+    never adopted — reconcile refuses instead of duplicating silently."""
+    from k8s_dra_driver_tpu.k8s.core import DaemonSet
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600,
+                      additional_namespaces=["legacy-ns"])
+    api.create(DaemonSet(meta=new_meta("cd-spoof-slice-agent", "legacy-ns")))
+    cd = ComputeDomain(
+        meta=new_meta("cd-spoof", NS),
+        spec=ComputeDomainSpec(
+            num_nodes=0,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="cd-spoof-channel"),
+        ),
+    )
+    cd = api.create(cd)
+    with pytest.raises(RuntimeError, match="refusing to adopt"):
+        ctrl._ensure_owned_objects(cd)
+    # Not duplicated into the driver namespace either.
+    assert api.try_get(DAEMON_SET, "cd-spoof-slice-agent", "tpu-dra-driver") is None
+
+
 def test_controller_refuses_to_adopt_unowned_objects():
     api = APIServer()
     ctrl = Controller(api, cleanup_interval_s=3600)

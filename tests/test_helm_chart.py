@@ -288,6 +288,21 @@ def test_gated_env_plumbed(values):
         assert name in rendered and value in rendered, name
 
 
+def test_additional_namespaces_arg_plumbed(values):
+    """controller.additionalNamespaces renders as --additional-namespaces
+    exactly when set (the reference's multi-namespace DS management)."""
+    with open(os.path.join(CHART, "templates", "controller.yaml"),
+              encoding="utf-8") as f:
+        template = f.read()
+    default = MiniHelm(dict(values)).render(template)
+    assert "--additional-namespaces" not in default
+    vals = dict(values)
+    vals["controller"] = {**vals["controller"],
+                          "additionalNamespaces": "team-a,team-b"}
+    rendered = MiniHelm(vals).render(template)
+    assert "--additional-namespaces=team-a,team-b" in rendered
+
+
 def test_networkpolicy_gated_and_scoped(values):
     """Off by default; when enabled, each policy selects its component,
     allows only metrics-port ingress, and API-server-port egress
