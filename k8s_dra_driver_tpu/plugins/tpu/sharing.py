@@ -22,6 +22,12 @@ from k8s_dra_driver_tpu.api.configs import MpsLikePremappedConfig
 TIME_SLICE_US = {"Default": 0, "Short": 2000, "Medium": 10000, "Long": 50000}
 
 
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (libtpu's premapped buffer size must be
+    a power of two); 0 stays 0."""
+    return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
 class SharingConflictError(Exception):
     """A sharing request contradicts existing records or chip capacity —
     the Prepare-time enforcement the reference does for MPS pinned-memory
@@ -173,5 +179,16 @@ class SharingManager:
             )
         pm = [r for r in recs if r["mode"] == "premapped"]
         if pm:
-            env["TPU_PREMAPPED_BUFFER_BYTES"] = str(min(r["bytes"] for r in pm))
+            budget = min(r["bytes"] for r in pm)
+            # Driver bookkeeping: the exact enforced budget (what Prepare
+            # validated against HBM capacity).
+            env["TPU_PREMAPPED_BUFFER_BYTES"] = str(budget)
+            # The ACTUAL libtpu knob: TPU_PREMAPPED_BUFFER_SIZE sizes the
+            # runtime's premapped host transfer buffer and must be a power
+            # of two — round the budget down so the handed-off value is
+            # one the runtime accepts. Whether the runtime honors it is
+            # environment-dependent (remote/tunneled backends ignore
+            # client env); ops/premapped_ab.py measures exactly that, and
+            # docs/guides/sharing.md records the honest answer.
+            env["TPU_PREMAPPED_BUFFER_SIZE"] = str(_pow2_floor(budget))
         return env

@@ -57,6 +57,19 @@ def test_premapped_within_budget_accumulates(mgr):
     mgr.set_premapped("claim-b", [0], premap(default=8 * GIB))
     env = mgr.env_for([0])
     assert env["TPU_PREMAPPED_BUFFER_BYTES"] == str(6 * GIB)  # min of budgets
+    # The real libtpu knob rides along, rounded down to the power of two
+    # the runtime requires (6 GiB -> 4 GiB).
+    assert env["TPU_PREMAPPED_BUFFER_SIZE"] == str(4 * GIB)
+
+
+def test_premapped_libtpu_knob_pow2():
+    from k8s_dra_driver_tpu.plugins.tpu.sharing import _pow2_floor
+
+    assert _pow2_floor(4 * GIB) == 4 * GIB      # exact powers unchanged
+    assert _pow2_floor(4 * GIB + 1) == 4 * GIB
+    assert _pow2_floor(3) == 2
+    assert _pow2_floor(1) == 1
+    assert _pow2_floor(0) == 0
 
 
 def test_premapped_overcommit_rejected(mgr):
