@@ -79,7 +79,7 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def bench_flagship_step(iters: int = 30) -> dict:
+def bench_flagship_step(iters: int = 30, runs: int = 3) -> dict:
     import jax
 
     from k8s_dra_driver_tpu.models.flagship import (
@@ -114,16 +114,37 @@ def bench_flagship_step(iters: int = 30) -> dict:
     # a clamped absurdity (same guard as allreduce_bench).
     iters = max(iters, 4)
     n1 = max(1, iters // 4)
-    t1 = min(run(n1) for _ in range(2))
-    t2 = min(run(iters) for _ in range(2))
-    noise_limited = t2 <= t1
-    dt = t2 / iters if noise_limited else (t2 - t1) / (iters - n1)
+
+    def marginal() -> tuple:
+        t1 = min(run(n1) for _ in range(2))
+        t2 = min(run(iters) for _ in range(2))
+        noise_limited = t2 <= t1
+        dt = t2 / iters if noise_limited else (t2 - t1) / (iters - n1)
+        return dt, noise_limited
+
+    # The whole marginal measurement repeats `runs` times; the MEDIAN is
+    # the headline (r4 lesson: the single-run number undercut the sweep by
+    # ~3 MFU points on tunnel variance), the best rides along as ceiling.
+    samples = sorted(marginal() for _ in range(runs))
+    dt, noise_limited = samples[len(samples) // 2]
+    dt_best = samples[0][0]
     out = {
         "flagship_tokens_per_s": round(batch["tokens"].size / dt, 1),
         "flagship_step_ms": round(dt * 1e3, 2),
+        "flagship_step_ms_best": round(dt_best * 1e3, 2),
+        "flagship_runs": runs,
         "flagship_noise_limited": noise_limited,
         "flagship_platform": devices[0].platform,
         "flagship_n_devices": len(devices),
+        # The exact measured configuration, so the recorded artifact is
+        # reproducible without chasing docs.
+        "flagship_config": {
+            "d_model": cfg.d_model, "n_heads": cfg.n_heads,
+            "n_layers": cfg.n_layers, "d_ff": cfg.d_ff,
+            "seq_len": cfg.seq_len, "vocab": cfg.vocab,
+            "batch_tokens": int(batch["tokens"].size),
+            "attention": cfg.attention, "remat": cfg.remat,
+        },
     }
     peak = PEAK_BF16_FLOPS.get(getattr(devices[0], "device_kind", ""))
     if peak:
@@ -133,10 +154,13 @@ def bench_flagship_step(iters: int = 30) -> dict:
         out["flagship_mfu_pct"] = round(
             100 * flops / dt / (peak * len(devices)), 1
         )
+        out["flagship_mfu_pct_best"] = round(
+            100 * flops / dt_best / (peak * len(devices)), 1
+        )
     return out
 
 
-def bench_claim_to_running(iters: int = 30, profile: str = "v5e-4",
+def bench_claim_to_running(iters: int = 120, profile: str = "v5e-4",
                            num_hosts=None, key: str = "claim_to_running") -> dict:
     """BASELINE.md headline: ResourceClaim-to-Running p50 — wall time from
     pod+claim creation to phase Running through the whole control plane
@@ -163,7 +187,11 @@ spec:
         try:
             for obj in load_manifests(rct):
                 sim.api.create(obj)
-            for i in range(iters):
+            # One untimed warmup claim: the first pass pays the one-time
+            # snapshot/index build (cold caches measured 77 ms vs 8-12 ms
+            # steady-state at 64 nodes) — steady-state latency is the
+            # metric; the cold pass is a startup cost, not a tail.
+            for i in ["warm"] + list(range(iters)):
                 pod_yaml = f"""
 apiVersion: v1
 kind: Pod
@@ -184,13 +212,16 @@ spec:
                     sim.step()
                 else:
                     raise RuntimeError(f"bench pod {i} stuck in {phase}")
-                lat.append(time.perf_counter() - t0)
+                if i != "warm":
+                    lat.append(time.perf_counter() - t0)
                 sim.delete_pod(f"bench-{i}", "default")
         finally:
             sim.stop()
     p50 = statistics.median(lat)
+    p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
     return {
         f"{key}_p50_ms": round(p50 * 1e3, 2),
+        f"{key}_p99_ms": round(p99 * 1e3, 2),
         f"{key}_max_ms": round(max(lat) * 1e3, 2),
         f"{key}_iters": iters,
     }
@@ -230,6 +261,44 @@ def check_flash_numerics() -> dict:
         "flash_vs_einsum_max_abs_err": round(err, 5),
         "flash_numerics_ok": bool(err / scale < 2e-2),  # bf16 path tolerance
     }
+
+
+def bench_real_chip() -> dict:
+    """Hardware execution evidence for the real-chip access path: the
+    enumeration RealTpuLib would use on a TPU VM (local accel scan +
+    accelerator-type detection), plus a live compute healthcheck on the
+    chip JAX actually reaches — the same shape as the plugin's noop-probe
+    healthcheck, but executed on silicon. Recorded every round so the
+    real path has bench-chip evidence beyond unit fixtures."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return {}
+    out = {"real_device_kind": getattr(d, "device_kind", "")}
+    # Live compute probe: a matmul with a known answer must come back
+    # correct from the device (device responds + computes, the health
+    # semantics of tpu-info's `health` subcommand).
+    x = jnp.full((128, 128), 2.0, jnp.bfloat16)
+    got = float(jax.jit(lambda a: (a @ a)[0, 0])(x))
+    out["real_compute_probe_ok"] = bool(abs(got - 2.0 * 2.0 * 128) < 1.0)
+    try:
+        from k8s_dra_driver_tpu.tpulib.real import RealTpuLib
+
+        lib = RealTpuLib()
+        inv = lib.enumerate()
+        # On a TPU VM this lists /dev/accel* chips; on the tunneled bench
+        # host there are no local accel nodes — recording 0 here is the
+        # honest answer, with the env-derived accelerator type alongside.
+        out["real_local_accel_chips"] = len(inv.chips)
+        out["real_accelerator_type"] = inv.accelerator_type
+        out["real_slice_topology"] = inv.slice_topology
+        if inv.chips:
+            out["real_chip0_health"] = lib.chip_health(0).value
+    except Exception as e:  # noqa: BLE001 — evidence leg, never fatal
+        out["real_enumerate_error"] = str(e)[:120]
+    return out
 
 
 def bench_grpc_prepare(iters: int = 40) -> dict:
@@ -368,8 +437,10 @@ def main() -> None:
         # Control-plane scalability: same latency question on a 64-node /
         # 256-chip cluster — flat p50 proves the control loops are
         # O(cluster), not O(pods x nodes).
+        # iters > 100 so the recorded p99 is a real order statistic, not
+        # an alias of max (at 100 samples index 99 IS the max).
         result.update(bench_claim_to_running(
-            iters=15, profile="v5e-64", num_hosts=64, key="claim_to_running_64n"))
+            iters=120, profile="v5e-64", num_hosts=64, key="claim_to_running_64n"))
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["claim_to_running_64n_error"] = str(e)[:200]
     try:
@@ -388,6 +459,10 @@ def main() -> None:
         result.update(check_flash_numerics())
     except Exception as e:  # noqa: BLE001 — flash check is best-effort
         result["flash_check_error"] = str(e)[:200]
+    try:
+        result.update(bench_real_chip())
+    except Exception as e:  # noqa: BLE001 — evidence leg is best-effort
+        result["real_chip_error"] = str(e)[:200]
     print(json.dumps(result))
 
 

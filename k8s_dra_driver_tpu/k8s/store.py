@@ -110,6 +110,26 @@ class APIServer:
         except NotFoundError:
             return None
 
+    def kind_fingerprint(self, kind: str) -> tuple:
+        """Cheap change-detection token for one kind: (count, max
+        resourceVersion). O(objects) with no copying — lets read-mostly
+        callers (the allocator's per-pass snapshot) reuse their previous
+        deepcopied list when nothing of that kind changed. Any create
+        bumps max-rv, any update bumps the object's rv, any delete drops
+        the count (and a delete+create in one window bumps max-rv), so
+        the token changes whenever the listed set could differ."""
+        with self._mu:
+            count = 0
+            max_rv = 0
+            for (k, _, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                count += 1
+                rv = obj.meta.resource_version or 0
+                if rv > max_rv:
+                    max_rv = rv
+            return (count, max_rv)
+
     def list(
         self,
         kind: str,
