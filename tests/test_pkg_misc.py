@@ -190,7 +190,10 @@ def test_binary_version_flag(module):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out.returncode == 0, out.stderr[-400:]
-    assert "v0.1.0" in out.stdout
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "VERSION"), encoding="utf-8") as f:
+        version = f.read().strip()
+    assert version in out.stdout  # single-sourced from the VERSION file
 
 
 def test_daemon_check_not_ready(tmp_path):
