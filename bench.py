@@ -263,6 +263,39 @@ def check_flash_numerics() -> dict:
     }
 
 
+def check_fused_ce_numerics() -> dict:
+    """TPU-only: the fused cross-entropy kernel (ops/fused_ce.py, the
+    evaluate_nll path) must agree with the materializing loss on hardware
+    — CI runs it in interpreter mode, so this is the kernel's silicon
+    test surface (same role as the flash check)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_dra_driver_tpu.ops.fused_ce import (
+        fused_ce_losses,
+        reference_ce_losses,
+    )
+
+    if jax.devices()[0].platform != "tpu":
+        return {}
+    T, D, V = 1024, 512, 8192
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(3), 3)
+    x = jax.random.normal(kx, (T, D), jnp.bfloat16)
+    w = jax.random.normal(kw, (D, V), jnp.bfloat16) * 0.05
+    labels = jax.random.randint(kl, (T,), 0, V)
+    got = np.asarray(jax.jit(
+        lambda x, w: fused_ce_losses(x, w, labels, 256, 512, False))(x, w))
+    want = np.asarray(jax.jit(
+        lambda x, w: reference_ce_losses(x, w, labels))(x, w))
+    err = float(np.max(np.abs(got - want)))
+    scale = float(np.max(np.abs(want))) or 1.0
+    return {
+        "fused_ce_max_abs_err": round(err, 5),
+        "fused_ce_numerics_ok": bool(err / scale < 2e-2),  # bf16 tolerance
+    }
+
+
 def bench_real_chip() -> dict:
     """Hardware execution evidence for the real-chip access path: the
     enumeration RealTpuLib would use on a TPU VM (local accel scan +
@@ -459,6 +492,10 @@ def main() -> None:
         result.update(check_flash_numerics())
     except Exception as e:  # noqa: BLE001 — flash check is best-effort
         result["flash_check_error"] = str(e)[:200]
+    try:
+        result.update(check_fused_ce_numerics())
+    except Exception as e:  # noqa: BLE001 — kernel check is best-effort
+        result["fused_ce_check_error"] = str(e)[:200]
     try:
         result.update(bench_real_chip())
     except Exception as e:  # noqa: BLE001 — evidence leg is best-effort
