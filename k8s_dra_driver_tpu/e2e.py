@@ -276,6 +276,34 @@ def check_allreduce_job(sim: SimCluster, _pods) -> None:
                 "hostnames must list all 4 workers")
 
 
+def check_psum_proof(sim: SimCluster, _pods) -> None:
+    """The cluster-initialization proof job: every worker gets the exact
+    env set psum_proof derives its whole configuration from, forming one
+    coherent 4-process cluster spec."""
+    pods = sorted(_running_pods(sim, "psum-proof"), key=lambda p: p.meta.name)
+    _expect(len(pods) == 4, f"want 4 indexed workers, got {len(pods)}")
+    ids = sorted(int(p.injected_env["TPU_WORKER_ID"]) for p in pods)
+    _expect(ids == [0, 1, 2, 3], f"worker ids {ids}")
+    coords = {p.injected_env.get("MEGASCALE_COORDINATOR_ADDRESS") for p in pods}
+    _expect(len(coords) == 1 and None not in coords,
+            f"coordinator must be identical everywhere, got {coords}")
+    # One coherent cluster spec: every worker sees the SAME ordered peer
+    # list, and the workers actually spread over distinct hosts.
+    peer_lists = {p.injected_env.get("TPU_WORKER_HOSTNAMES", "") for p in pods}
+    _expect(len(peer_lists) == 1,
+            f"peer lists must be identical everywhere, got {peer_lists}")
+    _expect(len(peer_lists.pop().split(",")) == 4,
+            "hostnames must list all 4 workers")
+    _expect(len({p.node_name for p in pods}) == 4,
+            "workers must spread over 4 distinct hosts")
+    for p in pods:
+        cmd = p.containers[0].command
+        _expect("k8s_dra_driver_tpu.ops.psum_proof" in cmd,
+                f"job must run the psum proof, got {cmd}")
+    # test_collective_proof.py executes this exact derivation with real OS
+    # processes (loopback sim) and asserts the psum agrees.
+
+
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s
     for s in (
@@ -304,6 +332,8 @@ SCENARIOS: Dict[str, Scenario] = {
                  check=check_cd_multi),
         Scenario("allreduce-job", "computedomain/allreduce-job.yaml",
                  check=check_allreduce_job),
+        Scenario("psum-proof", "computedomain/psum-proof-job.yaml",
+                 check=check_psum_proof),
         Scenario("selectors", "selectors/selectors.yaml",
                  profile="v5e-4", check=check_selectors),
         Scenario("subslice-sharing", "subslice-sharing/sharing.yaml",
