@@ -200,6 +200,34 @@ class VfioPciManager:
             time.sleep(0.2)
         raise VfioError(f"{dev_path} still busy after {timeout_s}s")
 
+    def ensure_vfio_module(self) -> None:
+        """Best-effort `modprobe vfio-pci` when the driver isn't loaded
+        (reference vfio-device.go:292-317 modprobes through a chroot to
+        the host root, since the plugin container has no modules). The
+        host root comes from TPU_DRA_HOST_ROOT; failures are swallowed —
+        the post-probe verification in bind_to_vfio errors loudly anyway,
+        with a message naming the real problem."""
+        drv = os.path.join(self.sysfs_root, "bus", "pci", "drivers",
+                           VFIO_PCI_DRIVER)
+        if os.path.isdir(drv) or self._fixture_kernel_on:
+            return
+        import subprocess
+
+        host_root = os.environ.get("TPU_DRA_HOST_ROOT", "")
+        cmd = (["chroot", host_root] if host_root else []) + [
+            "modprobe", VFIO_PCI_DRIVER]
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30, check=False)
+            if out.returncode != 0:
+                # The root cause (blacklisted module, missing chroot
+                # tooling) must be in the logs — bind's post-probe error
+                # is generic.
+                log.warning("modprobe %s exited %d: %s", VFIO_PCI_DRIVER,
+                            out.returncode, out.stderr.strip()[-400:])
+        except (OSError, subprocess.TimeoutExpired) as e:  # noqa: PERF203
+            log.warning("modprobe %s failed: %s", VFIO_PCI_DRIVER, e)
+
     def bind_to_vfio(self, pci_address: str, dev_path: Optional[str] = None) -> str:
         """Unbind from the current driver, bind to vfio-pci; returns the
         /dev/vfio/<group> path. When dev_path is given, waits for the accel
@@ -209,6 +237,7 @@ class VfioPciManager:
         if cur == VFIO_PCI_DRIVER:
             group = self.iommu_group(pci_address)
             return os.path.join(self.dev_root, "vfio", group)
+        self.ensure_vfio_module()
         if cur:
             if dev_path:
                 self.wait_device_free(dev_path)

@@ -288,6 +288,32 @@ def test_gated_env_plumbed(values):
         assert name in rendered and value in rendered, name
 
 
+def test_host_root_modprobe_plumbed(values):
+    """kubeletPlugin.hostRootForModprobe wires TPU_DRA_HOST_ROOT plus the
+    read-only host-root mount exactly when set (the reference's
+    chroot-to-host modprobe)."""
+    with open(os.path.join(CHART, "templates", "kubeletplugin.yaml"),
+              encoding="utf-8") as f:
+        template = f.read()
+    default = MiniHelm(dict(values)).render(template)
+    assert "TPU_DRA_HOST_ROOT" not in default
+    assert "host-root" not in default
+    vals = dict(values)
+    vals["kubeletPlugin"] = {**vals["kubeletPlugin"],
+                             "hostRootForModprobe": "/host"}
+    rendered = MiniHelm(vals).render(template)
+    assert "TPU_DRA_HOST_ROOT" in rendered and "/host" in rendered
+    docs = list(yaml.safe_load_all(rendered))
+    ds = next(d for d in docs if d and d["kind"] == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    tpu = next(c for c in spec["containers"]
+               if c["name"] == "tpu-kubelet-plugin")
+    mount = next(m for m in tpu["volumeMounts"] if m["name"] == "host-root")
+    assert mount["readOnly"] is True and mount["mountPath"] == "/host"
+    assert any(v["name"] == "host-root" and v["hostPath"]["path"] == "/"
+               for v in spec["volumes"])
+
+
 def test_additional_namespaces_arg_plumbed(values):
     """controller.additionalNamespaces renders as --additional-namespaces
     exactly when set (the reference's multi-namespace DS management)."""
