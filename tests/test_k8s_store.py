@@ -110,6 +110,87 @@ def test_list_with_selectors():
     assert [o.name for o in api.list("Pod", namespace="default")] == ["a", "b"]
 
 
+def test_kind_fingerprint_changes_on_every_mutation():
+    """The allocator's copy-on-change slice cache keys on this token: it
+    must change for create, update, delete, and delete+recreate — and
+    stay stable when nothing of the kind changed."""
+    api = APIServer()
+    fp0 = api.kind_fingerprint("Pod")
+    api.create(make_pod("a"))
+    fp1 = api.kind_fingerprint("Pod")
+    assert fp1 != fp0
+    assert api.kind_fingerprint("Pod") == fp1  # reads don't perturb it
+    pod = api.get("Pod", "a", "default")
+    api.update(pod)
+    fp2 = api.kind_fingerprint("Pod")
+    assert fp2 != fp1
+    api.delete("Pod", "a", "default")
+    fp3 = api.kind_fingerprint("Pod")
+    assert fp3 != fp2
+    api.create(make_pod("a"))
+    assert api.kind_fingerprint("Pod") != fp3  # recreate is a new token
+    # Mutating a DIFFERENT kind never perturbs this kind's token.
+    before = api.kind_fingerprint("Pod")
+    api.create(ResourceClaim(meta=new_meta("rc-b", "default")))
+    assert api.kind_fingerprint("Pod") == before
+
+
+def test_allocator_slice_cache_invalidates_on_slice_change():
+    """The cached slice snapshot must refresh when a ResourceSlice changes
+    (e.g. health taint republish) — a tainted device disappears from the
+    very next scheduler pass."""
+    from k8s_dra_driver_tpu.k8s.core import (
+        DeviceClass,
+        DeviceRequest,
+        DeviceTaint,
+        RESOURCE_SLICE,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import fresh_uid
+    from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+    from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+    from k8s_dra_driver_tpu.sim.allocator import Allocator
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    api = APIServer()
+    api.create(DeviceClass(meta=new_meta("tpu.google.com"),
+                           driver="tpu.google.com",
+                           match_attributes={"type": "tpu"}))
+    inv = MockTpuLib("v5e-4").enumerate()
+    devices = enumerate_allocatable(inv, with_subslices=False)
+    rs = build_resource_slice("n0", "tpu.google.com", devices, inv)
+    api.create(rs)
+    alloc = Allocator(api)
+
+    def claim(name):
+        c = ResourceClaim(
+            meta=new_meta(name, "default"),
+            requests=[DeviceRequest(name="t",
+                                    device_class_name="tpu.google.com",
+                                    count=4)],
+        )
+        c.meta.uid = fresh_uid()
+        return c
+
+    alloc.begin_pass()
+    assert alloc.allocate_on_node(claim("c1"), "n0") is not None
+    cached = alloc._pass_snapshot["slices"]
+    alloc.end_pass()
+    # The cache is genuinely reused when nothing changed: the very same
+    # list object comes back (not a fresh deepcopy per pass).
+    alloc.begin_pass()
+    assert alloc._pass_snapshot["slices"] is cached
+    alloc.end_pass()
+
+    # Republish with every chip tainted: the next pass must see it.
+    live = api.get(RESOURCE_SLICE, rs.meta.name)
+    for d in live.devices:
+        d.taints = [DeviceTaint(key="health", effect="NoSchedule")]
+    api.update(live)
+    alloc.begin_pass()
+    assert alloc.allocate_on_node(claim("c2"), "n0") is None
+    alloc.end_pass()
+
+
 def test_watch_stream():
     api = APIServer()
     q = api.watch("Pod")
