@@ -74,19 +74,21 @@ class SliceProofConfig:
         """MXU-sized single-chip benchmark config (~690M matmul params):
         large, bf16, static — dims multiples of 128 so XLA tiles cleanly
         onto the systolic array. Shape chosen by the measured sweeps
-        (ops/mfu_sweep.py; table in docs/benchmarks.md): d_model 2048 with
-        a ratio-8 FFN (d_ff 16384) and 4 heads of head_dim 512 measures
-        78.3-78.9% MFU on v5e (r5 median-of-3 runs; best single 79.6).
-        Head-count ladder at identical counted FLOPs: 16×128 65.4%,
-        8×256 74.5-76.4 (run-to-run tunnel variance), 4×512 ~78+ — fatter
-        per-head GEMMs tile the 128×128 MXU better; this is a benchmark
-        shape, chosen for hardware fit, and the conventional-head-dim
-        numbers are recorded alongside in docs. FFN ratio 4 measured 54%,
-        d_model 1024 32%. XLA's fused einsum attention beats the Pallas
-        flash kernel at this seq_len, so einsum stays the default;
+        (ops/mfu_sweep.py; full ladder in docs/benchmarks.md): d_model
+        2048 with a ratio-8 FFN (d_ff 16384) and 2 heads of head_dim 1024
+        measures 80.7-80.9% MFU median-of-3 on v5e (best 81.3). The complete
+        head ladder at identical counted FLOPs: 16×128 65.4, 8×256
+        74.5-76.4 (run-to-run tunnel variance), 4×512 78.3-78.9, 2×1024
+        ~81, 1×2048 77.3 — fatter per-head GEMMs tile the 128×128 MXU
+        better until a single full-width head regresses. This is a
+        benchmark shape chosen for hardware fit; the conventional-head-dim
+        numbers stay recorded alongside in docs so the headline is never
+        mistaken for an 8×256 claim. FFN ratio 4 measured 54%, d_model
+        1024 32%. XLA's fused einsum attention beats the Pallas flash
+        kernel at this seq_len, so einsum stays the default;
         attention="flash" is the long-sequence escape hatch and remat=True
         the HBM escape hatch (both cost reported MFU)."""
-        return cls(vocab=8192, d_model=2048, n_heads=4, n_layers=8,
+        return cls(vocab=8192, d_model=2048, n_heads=2, n_layers=8,
                    d_ff=16384, seq_len=1024)
 
 

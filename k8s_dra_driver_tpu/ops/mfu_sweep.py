@@ -70,14 +70,16 @@ def measure_step(cfg, batch_per_replica: int, iters: int) -> dict:
 
 def default_grid(base) -> list:
     """(cfg, batch_per_replica) pairs exploring around the shipped bench
-    shape (r5 winner: d2048 / ff16384 / 4×512 heads — the
+    shape (r5 winner: d2048 / ff16384 / 2×1024 heads — the
     batch/remat/seq/attention/head/width axes; head ladder kept so the
     conventional-head-dim comparison numbers in docs/benchmarks.md stay
     reproducible)."""
     r = dataclasses.replace
     return [
-        (base, 4),                                        # bench.py today (4×512)
+        (base, 4),                                        # bench.py today (2×1024)
         (base, 8),                                        # amortize weights
+        (r(base, n_heads=1), 4),                          # head_dim 2048 (regresses)
+        (r(base, n_heads=4), 4),                          # head_dim 512 (r5a shape)
         (r(base, n_heads=8), 4),                          # head_dim 256 (r4 shape)
         (r(base, n_heads=16), 4),                         # head_dim 128
         (r(base, remat=True), 8),                         # remat buys batch
