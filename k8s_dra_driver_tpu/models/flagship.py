@@ -76,7 +76,7 @@ class SliceProofConfig:
         onto the systolic array. Shape chosen by the measured sweeps
         (ops/mfu_sweep.py; full ladder in docs/benchmarks.md): d_model
         2048 with a ratio-8 FFN (d_ff 16384) and 2 heads of head_dim 1024
-        measures 80.7-80.9% MFU median-of-3 on v5e (best 81.3). The complete
+        measures 80.4-81.1% MFU median-of-3 on v5e (best 82.2). The complete
         head ladder at identical counted FLOPs: 16×128 65.4, 8×256
         74.5-76.4 (run-to-run tunnel variance), 4×512 78.3-78.9, 2×1024
         ~81, 1×2048 77.3 — fatter per-head GEMMs tile the 128×128 MXU
