@@ -268,32 +268,38 @@ def check_flash_numerics() -> dict:
 
 
 def check_fused_ce_numerics() -> dict:
-    """TPU-only: the fused cross-entropy kernel (ops/fused_ce.py, the
-    evaluate_nll path) must agree with the materializing loss on hardware
-    — CI runs it in interpreter mode, so this is the kernel's silicon
-    test surface (same role as the flash check)."""
+    """TPU-only: the fused cross-entropy kernel must agree with the
+    materializing loss on hardware — CI runs it in interpreter mode, so
+    this is the kernel's silicon test surface (same role as the flash
+    check). Runs THROUGH the production consumer: the flagship's
+    evaluate_nll scoring path (forward_hidden + fused kernel) against
+    loss_fn (forward + materializing nll) on the same tokens."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from k8s_dra_driver_tpu.ops.fused_ce import (
-        fused_ce_losses,
-        reference_ce_losses,
+    from k8s_dra_driver_tpu.models.flagship import (
+        SliceProofConfig,
+        evaluate_nll,
+        init_params,
+        loss_fn,
     )
 
     if jax.devices()[0].platform != "tpu":
         return {}
-    T, D, V = 1024, 512, 8192
-    kx, kw, kl = jax.random.split(jax.random.PRNGKey(3), 3)
-    x = jax.random.normal(kx, (T, D), jnp.bfloat16)
-    w = jax.random.normal(kw, (D, V), jnp.bfloat16) * 0.05
-    labels = jax.random.randint(kl, (T,), 0, V)
-    got = np.asarray(jax.jit(
-        lambda x, w: fused_ce_losses(x, w, labels, 256, 512, False))(x, w))
-    want = np.asarray(jax.jit(
-        lambda x, w: reference_ce_losses(x, w, labels))(x, w))
-    err = float(np.max(np.abs(got - want)))
-    scale = float(np.max(np.abs(want))) or 1.0
+    # b*(s-1) = 998: NOT a block multiple, so the padding/masking path
+    # runs on silicon too.
+    cfg = SliceProofConfig(vocab=8192, d_model=512, n_heads=4, n_layers=2,
+                           d_ff=2048, seq_len=500)
+    params = init_params(cfg, seed=3)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab, (2, cfg.seq_len)),
+        jnp.int32)
+    got = float(jax.jit(lambda p, t: evaluate_nll(cfg, p, t))(params, tokens))
+    want = float(jax.jit(
+        lambda p, t: loss_fn(cfg, p, {"tokens": t}))(params, tokens))
+    err = abs(got - want)
+    scale = abs(want) or 1.0
     return {
         "fused_ce_max_abs_err": round(err, 5),
         "fused_ce_numerics_ok": bool(err / scale < 2e-2),  # bf16 tolerance

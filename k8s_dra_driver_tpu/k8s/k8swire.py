@@ -480,6 +480,15 @@ def _requests_encode(requests: List[DeviceRequest],
         }
         if r.allocation_mode == "ExactCount":
             inner["count"] = r.count
+        if r.selectors:
+            # Legacy attr=value strings are a sim-only convenience with no
+            # wire representation; dropping them silently would let a
+            # round-tripped claim over-match (the constraint vanishes).
+            raise ValueError(
+                f"request {r.name!r} carries legacy attr=value selectors "
+                f"{r.selectors}; real-API claims must use CEL "
+                f"(cel_selectors / the {{cel: {{expression}}}} manifest form)"
+            )
         if r.cel_selectors:
             inner["selectors"] = [{"cel": {"expression": s}} for s in r.cel_selectors]
         if version == "v1beta1":

@@ -229,8 +229,8 @@ class DeviceState:
                 for d in prepared:
                     d.cdi_device_ids = [id_by_name[d.name]]
             except Exception:
-                for d in prepared:  # device work succeeded but CDI write failed
-                    self._rollback_device(uid, d)
+                # Device work succeeded but the CDI write failed.
+                self._rollback_devices(uid, prepared)
                 self.cdi.delete_claim_spec_file(uid)
                 del cp.claims[uid]
                 self._save_checkpoint(cp)
@@ -379,8 +379,7 @@ class DeviceState:
                     )
                 )
         except Exception:
-            for d in prepared:
-                self._rollback_device(claim.uid, d)
+            self._rollback_devices(claim.uid, prepared)
             raise
         return prepared
 
@@ -607,25 +606,45 @@ class DeviceState:
         self.allocatable[dev.name] = replace(
             dev, vfio_group_path="", vfio_cdev_path="")
 
-    def _rollback_device(self, claim_uid: str, d: PreparedDevice) -> None:
+    def _rollback_device(self, claim_uid: str, d: PreparedDevice,
+                         release_partition: bool = True) -> None:
         """Reverse of prepare order: sharing records, then the vfio unbind,
         then the partition release (the group's ICI partition was activated
         BEFORE the bind, so it is released after the unbind — mirroring the
-        reference's deactivateFabricPartition on unprepare)."""
+        reference's deactivateFabricPartition on unprepare). Claim-level
+        rollback passes release_partition=False and releases partitions
+        AFTER every device unbound: a multi-chip passthrough group's
+        shared partition must never drop while a sibling is still bound."""
         try:
             self.sharing.clear(claim_uid, tuple(d.chip_indices))
             dev = self.allocatable.get(d.name)
             if isinstance(dev, VfioDevice):
                 self._release_vfio(dev)
             pid = d.extra.get("partition")
-            if pid and self.partitions is not None:
+            if release_partition and pid and self.partitions is not None:
                 self.partitions.deactivate(pid)
         except Exception:  # noqa: BLE001 — rollback is best effort
             log.exception("rollback of %s for claim %s failed", d.name, claim_uid)
 
+    def _rollback_devices(self, claim_uid: str,
+                          devices: Sequence[PreparedDevice]) -> None:
+        """Roll back a set of prepared devices: every unbind first, then
+        each distinct partition exactly once."""
+        for d in devices:
+            self._rollback_device(claim_uid, d, release_partition=False)
+        if self.partitions is not None:
+            pids = dict.fromkeys(
+                d.extra.get("partition") for d in devices
+                if d.extra.get("partition"))
+            for pid in pids:
+                try:
+                    self.partitions.deactivate(pid)
+                except Exception:  # noqa: BLE001 — rollback is best effort
+                    log.exception("partition release %s for claim %s failed",
+                                  pid, claim_uid)
+
     def _rollback(self, entry: PreparedClaim) -> None:
-        for d in entry.devices:
-            self._rollback_device(entry.claim_uid, d)
+        self._rollback_devices(entry.claim_uid, entry.devices)
         self.sharing.clear_claim(entry.claim_uid)
 
     # -- CDI edits ----------------------------------------------------------

@@ -51,10 +51,14 @@ def _wait(cond, timeout=45.0, msg="condition", procs=()):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         for p in procs:
-            assert p.poll() is None, (
-                f"{getattr(p, 'chart_name', '?')} died:\n"
-                f"{p.stdout.read()[-3000:] if p.stdout else ''}"
-            )
+            if p.poll() is not None:
+                tail = ""
+                log = getattr(p, "chart_log", "")
+                if log and os.path.exists(log):
+                    with open(log, encoding="utf-8") as f:
+                        tail = f.read()[-3000:]
+                raise AssertionError(
+                    f"{getattr(p, 'chart_name', '?')} died:\n{tail}")
         v = cond()
         if v:
             return v
@@ -87,6 +91,7 @@ class ChartProcessLauncher:
         self.sandbox = sandbox
         self.api_url = api_url
         self.procs = []
+        self._log_files = []
 
     def launch(self, container, extra_env=None):
         cmd = list(container["command"]) + list(container.get("args", []))
@@ -115,10 +120,17 @@ class ChartProcessLauncher:
             "API_SERVER_URL": self.api_url,
             **(extra_env or {}),
         })
-        p = subprocess.Popen(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+        # Log to a file, not a PIPE: nothing drains the pipe while the
+        # process runs, so a chatty container would block on a full
+        # buffer and fail the test with an undiagnostic timeout.
+        log_path = os.path.join(self.sandbox, f"{container['name']}.log")
+        log_f = open(log_path, "w", encoding="utf-8")
+        p = subprocess.Popen(cmd, env=env, cwd=REPO, stdout=log_f,
                              stderr=subprocess.STDOUT, text=True)
         p.chart_name = container["name"]
         p.chart_env = env
+        p.chart_log = log_path
+        self._log_files.append(log_f)
         self.procs.append(p)
         return p
 
@@ -131,6 +143,8 @@ class ChartProcessLauncher:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for f in self._log_files:
+            f.close()
 
 
 @pytest.fixture

@@ -9,7 +9,6 @@ import re
 import stat
 import subprocess
 
-import pytest
 import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

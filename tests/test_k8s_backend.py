@@ -149,6 +149,32 @@ def test_wire_claim_roundtrip():
     assert back.reserved_for[0].uid == "u1"
 
 
+def test_wire_cel_selectors_roundtrip_and_legacy_refused():
+    """cel_selectors survive the wire; legacy attr=value selectors have
+    NO wire form and must fail encoding loudly — silently dropping them
+    would let a round-tripped claim over-match (the constraint just
+    vanishes)."""
+    rc = ResourceClaim(
+        meta=new_meta("c2", "ns"),
+        requests=[DeviceRequest(
+            name="tpus", device_class_name="tpu.google.com", count=1,
+            cel_selectors=['device.attributes["tpu.google.com"].index == 2'])],
+    )
+    back = _roundtrip(rc)
+    assert back.requests[0].cel_selectors == [
+        'device.attributes["tpu.google.com"].index == 2']
+    assert back.requests[0].selectors == []
+
+    legacy = ResourceClaim(
+        meta=new_meta("c3", "ns"),
+        requests=[DeviceRequest(name="tpus",
+                                device_class_name="tpu.google.com",
+                                count=1, selectors=["kind=tpu-chip"])],
+    )
+    with pytest.raises(ValueError, match="legacy attr=value"):
+        _roundtrip(legacy)
+
+
 def test_wire_deviceclass_cel_roundtrip():
     """Legacy match_attributes encode into one CEL expression; decode keeps
     the raw expression (celmini evaluates it), so the roundtrip is

@@ -537,6 +537,30 @@ def test_passthrough_partition_blocks_overlapping_subslice(tmp_path, lib, monkey
     assert [p.id for p in state.partitions.active_partitions()] == ["1x2-at-0x0"]
 
 
+def test_group_partition_released_only_after_all_unbinds(tmp_path, lib, monkeypatch):
+    """Unprepare ordering for a multi-chip group: EVERY member unbinds
+    from vfio-pci before the shared ICI partition drops — fabric
+    isolation must never vanish while a sibling is still passed through
+    (the invariant the reference's deactivate-after-Configure ordering
+    encodes). Released exactly once."""
+    state = make_state(tmp_path, lib, monkeypatch, gates=PART_GATES)
+    claim = make_group_claim(["tpu-0-vfio", "tpu-1-vfio"])
+    state.prepare(claim)
+
+    events = []
+    real_unbind = state.vfio.unbind_from_vfio
+    real_deact = state.partitions.deactivate
+    monkeypatch.setattr(state.vfio, "unbind_from_vfio",
+                        lambda addr: (events.append(("unbind", addr)),
+                                      real_unbind(addr))[1])
+    monkeypatch.setattr(state.partitions, "deactivate",
+                        lambda pid: (events.append(("release", pid)),
+                                     real_deact(pid))[1])
+    state.unprepare(claim.uid)
+    kinds = [k for k, _ in events]
+    assert kinds == ["unbind", "unbind", "release"], events
+
+
 def test_partition_released_when_second_bind_fails(tmp_path, lib, monkeypatch):
     """Group of 2: first chip binds, second bind blows up -> the group's
     partition must not leak (rollback releases it after the unbinds)."""
