@@ -2,7 +2,7 @@
 
 include versions.mk
 
-.PHONY: all native test e2e bench ci clean version
+.PHONY: all native test e2e bench bench-smoke ci clean version
 
 version:
 	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
@@ -26,6 +26,11 @@ e2e:
 
 bench:
 	python bench.py
+
+# CI-sized bench pass: prepare-latency headline (20 iters) + batched
+# prepare amortization + a 4-node scheduler storm, hard-capped at 5 min.
+bench-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke
 
 clean:
 	rm -rf native/build .pytest_cache

@@ -68,6 +68,128 @@ def bench_prepare_latency(iters: int = 300) -> dict:
     }
 
 
+def bench_control_plane(batch_sizes=(1, 8, 64), iters: int = 30,
+                        storm_nodes: int = 64, storm_pods: int = 128,
+                        storm_max_steps: int = 400) -> dict:
+    """Control-plane storm benchmark: (a) batched NodePrepareResources
+    latency at several batch sizes through the real plugin pipeline — one
+    pu flock + two checkpoint fsyncs per batch, CDI specs materialized
+    concurrently — reported as amortized per-claim p50/p99 so the batch-1
+    number IS the old per-claim path; (b) end-to-end pods-scheduled-per-
+    second on a SimCluster storm (every pod created up front, control
+    loops stepped to convergence)."""
+    import os
+
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+    from k8s_dra_driver_tpu.tpulib.profiles import SliceProfile
+    from k8s_dra_driver_tpu.tpulib.types import TpuGen
+    from tests.test_tpu_plugin import make_claim
+
+    out: dict = {}
+    max_batch = max(batch_sizes)
+    # A dense single-host mock profile: the largest batch needs that many
+    # non-overlapping single-chip claims on ONE node. Real v5e hosts carry
+    # 4 chips; this is a control-plane shape, not a silicon claim.
+    side = 1
+    while side * side < max_batch:
+        side *= 2
+    topo = f"{side}x{side}"
+    profile = SliceProfile(
+        name=f"bench-v5e-{side * side}x1", gen=TpuGen.V5E,
+        accelerator_type=f"v5litepod-{side * side}",
+        slice_topology=topo, host_topology=topo,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        driver = TpuDriver(
+            api=APIServer(),
+            node_name="bench-node",
+            tpulib=MockTpuLib(profile),
+            plugin_dir=os.path.join(tmp, "plugin"),
+            cdi_root=os.path.join(tmp, "cdi"),
+        )
+        driver.start()
+        try:
+            for bs in batch_sizes:
+                lat = []
+                for it in range(iters):
+                    claims = [
+                        make_claim([f"tpu-{i}"], name=f"b{bs}-{it}-{i}")
+                        for i in range(bs)
+                    ]
+                    t0 = time.perf_counter()
+                    res = driver.prepare_resource_claims(claims)
+                    dt = time.perf_counter() - t0
+                    errs = [r for r in res.values() if isinstance(r, Exception)]
+                    assert not errs, errs[0]
+                    lat.append(dt / bs)  # amortized per claim
+                    driver.unprepare_resource_claims([c.uid for c in claims])
+                p50 = statistics.median(lat)
+                p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+                out[f"prepare_batch{bs}_p50_ms_per_claim"] = round(p50 * 1e3, 3)
+                out[f"prepare_batch{bs}_p99_ms_per_claim"] = round(p99 * 1e3, 3)
+        finally:
+            driver.shutdown()
+    b1 = out.get(f"prepare_batch{min(batch_sizes)}_p50_ms_per_claim")
+    bN = out.get(f"prepare_batch{max_batch}_p50_ms_per_claim")
+    if b1 and bN:
+        # Amortization headline: per-claim cost at max batch vs batch 1.
+        out[f"batch{max_batch}_speedup_vs_batch1"] = round(b1 / bN, 2)
+    out["prepare_batch_iters"] = iters
+
+    # -- scheduler/kubelet storm: all pods at once -------------------------
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    rct = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: storm, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+    with tempfile.TemporaryDirectory() as tmp:
+        sim = SimCluster(workdir=tmp, profile="v5e-4", num_hosts=storm_nodes)
+        sim.start()
+        try:
+            for obj in load_manifests(rct):
+                sim.api.create(obj)
+            for i in range(storm_pods):
+                pod_yaml = f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: storm-{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: storm}}]
+"""
+                for obj in load_manifests(pod_yaml):
+                    sim.api.create(obj)
+            t0 = time.perf_counter()
+            for _ in range(storm_max_steps):
+                pods = sim.api.list(POD)
+                if all(p.phase == "Running" for p in pods):
+                    break
+                if any(p.phase == "Failed" for p in pods):
+                    raise RuntimeError("storm pod Failed")
+                sim.step()
+            else:
+                raise RuntimeError("storm did not converge")
+            wall = time.perf_counter() - t0
+        finally:
+            sim.stop()
+    out["storm_nodes"] = storm_nodes
+    out["storm_pods"] = storm_pods
+    out["storm_wall_s"] = round(wall, 3)
+    out["storm_pods_per_s"] = round(storm_pods / wall, 1)
+    return out
+
+
 # Public peak dense-bf16 FLOP/s per chip (cloud.google.com/tpu/docs spec
 # pages); device_kind strings as libtpu reports them.
 PEAK_BF16_FLOPS = {
@@ -471,7 +593,25 @@ def main() -> None:
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if "--smoke" in sys.argv:
+        # CI-sized pass (make bench-smoke): headline prepare latency plus a
+        # small control-plane storm, seconds not minutes.
+        result = bench_prepare_latency(iters=20)
+        try:
+            result.update(bench_control_plane(
+                batch_sizes=(1, 8, 16), iters=5,
+                storm_nodes=4, storm_pods=8, storm_max_steps=80))
+        except Exception as e:  # noqa: BLE001 — extras are best-effort
+            result["control_plane_error"] = str(e)[:200]
+        print(json.dumps(result))
+        return
     result = bench_prepare_latency()
+    try:
+        # Batched prepare amortization + 64-node scheduler storm (tracked
+        # in every round's BENCH json from PR 1 on).
+        result.update(bench_control_plane())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["control_plane_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

@@ -85,10 +85,19 @@ def make_token_batch(seed: int, rows: int, seq_len: int, vocab: int,
     }
 
 
+def mesh_context(mesh: Mesh):
+    """Ambient-mesh context across jax versions: ``jax.set_mesh`` where it
+    exists (>= 0.6), the classic ``with mesh:`` thread-resources context on
+    older runtimes — both make bare PartitionSpecs in
+    ``with_sharding_constraint`` resolve against ``mesh``."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
+
+
 def meshed_step(jitted, mesh: Mesh):
     """Wrap a jitted step so it runs under the mesh context."""
     def step(state, batch):
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             return jitted(state, batch)
 
     return step

@@ -148,10 +148,19 @@ def param_pspecs(cfg: SliceProofConfig) -> Params:
     }
 
 
+def _ambient_mesh_empty() -> bool:
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is not None:
+        return get_am().empty
+    from jax._src import mesh as _mesh  # jax < 0.5: thread-resources mesh
+
+    return _mesh.thread_resources.env.physical_mesh.empty
+
+
 def _pin(x: jax.Array, spec: P) -> jax.Array:
     """Sharding-constrain x when a mesh context is active; no-op single-chip,
     so the same forward serves entry() (one device) and the sharded step."""
-    if jax.sharding.get_abstract_mesh().empty:
+    if _ambient_mesh_empty():
         return x
     return jax.lax.with_sharding_constraint(x, spec)
 

@@ -42,7 +42,11 @@ def revary(x, axis_name):
         for name in names:
             x = jax.lax.pcast(x, name, to="varying")
         return x
-    return jax.lax.pvary(x, names)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, names)
+    # jax < 0.5 has no varying-annotation machinery at all (replication is
+    # inferred); identity is the correct degenerate form.
+    return x
 
 
 def build_mesh(devices: Sequence, dp: int, tp: int, *, axis_names: Tuple[str, str] = ("data", "model")):

@@ -103,6 +103,11 @@ class Gauge(Counter):
 # The reference's bucket envelope: 0.05s * 2^k for k=0..8 (0.05s .. 12.8s).
 DRA_DURATION_BUCKETS: Tuple[float, ...] = tuple(0.05 * (2**k) for k in range(9))
 
+# Batch-size envelope for the batched prepare path: 2^k claims per
+# NodePrepareResources call, k=0..8 (1 .. 256) — same exponential shape as
+# the duration buckets so both histograms read on one grid.
+PREPARE_BATCH_SIZE_BUCKETS: Tuple[float, ...] = tuple(float(2**k) for k in range(9))
+
 
 class Histogram(_Metric):
     kind = "histogram"
@@ -150,13 +155,15 @@ class Histogram(_Metric):
                 cum = 0
                 for ub, c in zip(self.buckets, self._counts[labels]):
                     cum += c
+                    le = 'le="%s"' % ub
                     out.append(
                         f"{self.name}_bucket"
-                        f"{_fmt_labels(self.label_names, labels, f'le=\"{ub}\"')} {cum}"
+                        f"{_fmt_labels(self.label_names, labels, le)} {cum}"
                     )
+                le_inf = 'le="+Inf"'
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(self.label_names, labels, 'le=\"+Inf\"')} {self._totals[labels]}"
+                    f"{_fmt_labels(self.label_names, labels, le_inf)} {self._totals[labels]}"
                 )
                 out.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {self._sums[labels]}")
                 out.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {self._totals[labels]}")
@@ -209,6 +216,8 @@ class DRARequestMetrics:
     request_duration: Histogram = field(init=False)
     in_flight: Gauge = field(init=False)
     prepared_devices: Gauge = field(init=False)
+    prepare_batch_size: Histogram = field(init=False)
+    prepare_seconds: Histogram = field(init=False)
 
     def __post_init__(self) -> None:
         r = self.registry
@@ -235,6 +244,21 @@ class DRARequestMetrics:
                 ("driver", "device_type"),
             )
         )
+        self.prepare_batch_size = r.register(
+            Histogram(
+                "tpu_dra_prepare_batch_size",
+                "Claims per batched prepare/unprepare call.",
+                ("driver", "method"),
+                buckets=PREPARE_BATCH_SIZE_BUCKETS,
+            )
+        )
+        self.prepare_seconds = r.register(
+            Histogram(
+                "tpu_dra_prepare_seconds",
+                "Wall time of one batched prepare/unprepare call.",
+                ("driver", "method"),
+            )
+        )
 
     @contextmanager
     def track(self, method: str) -> Iterator[None]:
@@ -249,6 +273,35 @@ class DRARequestMetrics:
         finally:
             self.in_flight.dec(self.driver)
             self.request_duration.observe(self.driver, method, value=time.perf_counter() - t0)
+
+    @contextmanager
+    def track_batch(self, method: str, batch_size: int) -> Iterator[None]:
+        """Instrument one batched DRA call serving ``batch_size`` claims:
+        requests_total counts claims (so per-claim accounting survives the
+        batched pipeline), in_flight carries the whole batch while it runs,
+        and the batch itself lands in prepare_batch_size / prepare_seconds.
+        request_duration gets one observation per call — the per-RPC
+        semantics of the reference's dra_requests.go histogram."""
+        self.requests_total.inc(self.driver, method, by=batch_size)
+        self.in_flight.inc(self.driver, by=batch_size)
+        self.prepare_batch_size.observe(self.driver, method, value=float(batch_size))
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self.request_errors_total.inc(self.driver, method, by=batch_size)
+            raise
+        finally:
+            self.in_flight.dec(self.driver, by=batch_size)
+            dt = time.perf_counter() - t0
+            self.request_duration.observe(self.driver, method, value=dt)
+            self.prepare_seconds.observe(self.driver, method, value=dt)
+
+    def record_claim_errors(self, method: str, count: int = 1) -> None:
+        """Per-claim failures surfaced inline in a batch result (the batch
+        call itself succeeded, so track_batch saw no exception)."""
+        if count > 0:
+            self.request_errors_total.inc(self.driver, method, by=count)
 
 
 COMPUTE_DOMAIN_STATES = ("NotReady", "Ready", "Rejected", "Deleting")

@@ -59,6 +59,12 @@ class StubPartitionClient:
         self.calls.append(("deactivate", partition.id))
         self.active.pop(partition.id, None)
 
+    def active_ids(self) -> List[str]:
+        """Ledger read-back, like NativePartitionClient: a stub shared
+        across driver instances models partition state surviving a plugin
+        crash (crash-recovery tests restart against the same stub)."""
+        return list(self.active)
+
 
 _TPUPART_CANDIDATES = (
     os.environ.get("TPUPART_LIBRARY_PATH", ""),

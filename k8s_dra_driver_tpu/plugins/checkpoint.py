@@ -13,6 +13,11 @@ checkpointv.go:59-133, device_state.go:246-302,740-805):
 - claim states PrepareStarted -> PrepareCompleted, plus the PrepareAborted
   tombstone (TTL'd) the compute-domain plugin uses;
 - every write is atomic (tmp + fsync + rename).
+
+Batched access: ``CheckpointStore.session()`` holds the cp flock across one
+read-modify-write *sequence*, so an N-claim NodePrepareResources batch pays
+one lock acquire and two fsyncs (one save persisting every PrepareStarted,
+one persisting every PrepareCompleted) instead of N of each.
 """
 
 from __future__ import annotations
@@ -22,14 +27,21 @@ import json
 import os
 import time
 import zlib
+from contextlib import contextmanager
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 LATEST_VERSION = "v2"
 
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
 PREPARE_ABORTED = "PrepareAborted"
+
+# Fault-injection points both plugins' batched pipelines fire between their
+# two checkpoint writes (tests install a hook that raises to simulate a
+# crash at the exact point); shared here so the seams can never drift.
+FAULT_STARTED_PERSISTED = "batch:started-persisted"   # after write #1
+FAULT_PRE_COMPLETED = "batch:pre-completed"           # before write #2
 
 # TTL for PrepareAborted tombstones (reference:
 # cmd/compute-domain-kubelet-plugin/cleanup.go:35-37).
@@ -116,6 +128,12 @@ class CheckpointStore:
                         on_discard(uid)
                 self._mgr.save(Checkpoint(node_boot_id=boot_id))
 
+    @property
+    def manager(self) -> "CheckpointManager":
+        """The underlying manager — tests pin write counts via its
+        ``save_count``."""
+        return self._mgr
+
     def get(self) -> "Checkpoint":
         with self._lock.hold(timeout=10):
             cp = self._mgr.load()
@@ -126,6 +144,35 @@ class CheckpointStore:
         with self._lock.hold(timeout=10):
             self._mgr.save(cp)
 
+    @contextmanager
+    def session(self, timeout: float = 10) -> Iterator["CheckpointSession"]:
+        """Hold the cp flock across a whole read-modify-write batch.
+
+        The yielded session exposes the loaded checkpoint and a ``save()``
+        that writes (one atomic write + fsync per call) WITHOUT re-acquiring
+        the lock — the batched prepare pipeline does exactly two saves per
+        session. The lock is released even if the caller raises mid-batch
+        (crash injection leaves per-claim PrepareStarted tombstones behind,
+        recovered by the stale-entry path on restart)."""
+        with self._lock.hold(timeout=timeout):
+            cp = self._mgr.load()
+            assert cp is not None, "checkpoint disappeared"
+            yield CheckpointSession(self._mgr, cp)
+
+
+class CheckpointSession:
+    """One locked batch over the checkpoint. ``checkpoint`` is the state as
+    loaded (mutate it in place); every ``save()`` is one fsync'd write."""
+
+    def __init__(self, mgr: "CheckpointManager", cp: "Checkpoint"):
+        self._mgr = mgr
+        self.checkpoint = cp
+        self.saves = 0
+
+    def save(self) -> None:
+        self._mgr.save(self.checkpoint)
+        self.saves += 1
+
 
 class CheckpointManager:
     """Atomic load/save of the checkpoint file. Callers serialize access via
@@ -133,6 +180,10 @@ class CheckpointManager:
 
     def __init__(self, path: str):
         self.path = path
+        # Write accounting: each save() is exactly one fsync'd atomic write,
+        # so tests pin the batched pipeline's write amplification (2 per
+        # N-claim batch) by diffing this counter.
+        self.save_count = 0
 
     def load(self) -> Optional[Checkpoint]:
         try:
@@ -185,6 +236,7 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self.save_count += 1
 
     def delete(self) -> None:
         try:

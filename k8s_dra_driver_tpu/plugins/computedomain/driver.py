@@ -19,7 +19,8 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
 
 from k8s_dra_driver_tpu.api.configs import (
     COMPUTE_DOMAIN_DRIVER_NAME,
@@ -47,6 +48,8 @@ from k8s_dra_driver_tpu.pkg.sliceconfig import Isolation, SliceAgentConfig
 from k8s_dra_driver_tpu.plugins.checkpoint import (
     Checkpoint,
     CheckpointStore,
+    FAULT_PRE_COMPLETED,
+    FAULT_STARTED_PERSISTED,
     PREPARE_ABORTED,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
@@ -66,6 +69,9 @@ log = logging.getLogger(__name__)
 CHANNEL_DEVICE = "channel-0"
 DAEMON_DEVICE = "daemon"
 PU_LOCK_TIMEOUT_S = 10.0
+# Bound on concurrent CDI spec writes in one batch (mirrors the tpu
+# plugin's device_state pipeline).
+CDI_MATERIALIZE_WORKERS = 8
 # Channels CDI-injected under AllocationMode All (the reference's
 # maxImexChannelCount, cmd/compute-domain-kubelet-plugin/main.go).
 DEFAULT_MAX_CHANNEL_COUNT = 32
@@ -107,6 +113,13 @@ class ComputeDomainDriver:
             plugin_dir, Flock, read_boot_id(),
             on_discard=self.cdi.delete_claim_spec_file,
         )
+        # Crash-injection seam for the batched pipeline (same FAULT_* points
+        # as plugins.tpu.device_state).
+        self.fault_hook: Optional[Callable[[str], None]] = None
+
+    def _fire_fault(self, point: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(point)
 
     def _get_checkpoint(self) -> Checkpoint:
         return self._store.get()
@@ -176,27 +189,41 @@ class ComputeDomainDriver:
     def prepare_resource_claims(
         self, claims: List[ResourceClaim]
     ) -> Dict[str, object]:
+        """Batch-amortized prepare: one pu flock acquire and one checkpoint
+        session (two fsyncs) per NodePrepareResources call; per-claim gate
+        failures come back inline without failing siblings."""
+        if not claims:
+            return {}
         out: Dict[str, object] = {}
+        with self.metrics.track_batch("PrepareResourceClaims", len(claims)):
+            try:
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                    out = self._prepare_batch(claims)
+            except Exception as e:  # noqa: BLE001 — whole-batch failure
+                log.warning("cd prepare batch of %d failed: %s", len(claims), e)
+                out = {c.uid: e for c in claims}
+        failed = sum(1 for r in out.values() if isinstance(r, Exception))
+        self.metrics.record_claim_errors("PrepareResourceClaims", failed)
         for claim in claims:
-            with self.metrics.track("PrepareResourceClaims"):
-                try:
-                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
-                        out[claim.uid] = self._prepare(claim)
-                except Exception as e:  # noqa: BLE001
-                    log.warning("cd prepare %s failed: %s", claim.key, e)
-                    out[claim.uid] = e
+            r = out.get(claim.uid)
+            if isinstance(r, Exception):
+                log.warning("cd prepare %s failed: %s", claim.key, r)
         return out
 
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
+        if not claim_uids:
+            return {}
         out: Dict[str, Optional[Exception]] = {}
-        for uid in claim_uids:
-            with self.metrics.track("UnprepareResourceClaims"):
-                try:
-                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
-                        self._unprepare(uid)
-                    out[uid] = None
-                except Exception as e:  # noqa: BLE001
-                    out[uid] = e
+        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)):
+            try:
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                    out = self._unprepare_batch(claim_uids)
+            except Exception as e:  # noqa: BLE001 — whole-batch failure
+                log.warning("cd unprepare batch of %d failed: %s",
+                            len(claim_uids), e)
+                out = {uid: e for uid in claim_uids}
+        failed = sum(1 for r in out.values() if r is not None)
+        self.metrics.record_claim_errors("UnprepareResourceClaims", failed)
         return out
 
     def handle_error(self, claim_uid: str) -> None:
@@ -235,56 +262,133 @@ class ComputeDomainDriver:
             return cfg
         raise PermanentError(f"claim {claim.key} has no {self.driver_name} config")
 
-    def _prepare(self, claim: ResourceClaim):
+    def _prepare_batch(self, claims: List[ResourceClaim]) -> Dict[str, object]:
+        """The batched state machine: one checkpoint session, two fsync'd
+        writes (all PrepareStarted, then all PrepareCompleted), per-claim
+        gate chains run sequentially (they mutate node labels and read the
+        API) with the CDI spec writes fanned out in between."""
+        out: Dict[str, object] = {}
         with self._mutex:
-            cp = self._get_checkpoint()
-            uid = claim.uid
-            entry = cp.claims.get(uid)
-            if entry is not None and entry.state == PREPARE_COMPLETED:
-                return [i for d in entry.devices for i in d.cdi_device_ids]
-            if entry is not None and entry.state == PREPARE_ABORTED:
-                if not entry.aborted_expired():
-                    raise PermanentError(f"claim {uid} was aborted; refusing to re-prepare")
-                del cp.claims[uid]
-                self._save_checkpoint(cp)
-            devices = [
-                r.device for r in (claim.allocation.devices if claim.allocation else [])
-                if r.driver == self.driver_name
-            ]
-            if not devices:
-                raise PermanentError(f"claim {claim.key}: no {self.driver_name} devices")
-            cfg = self._decode_config(claim)
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                dirty = False
+                pending: List[Tuple[ResourceClaim, object, List[str]]] = []
+                seen: set = set()
+                for claim in claims:
+                    uid = claim.uid
+                    if uid in seen or uid in out:
+                        continue  # duplicate uid in one batch: first wins
+                    entry = cp.claims.get(uid)
+                    if entry is not None and entry.state == PREPARE_COMPLETED:
+                        out[uid] = [i for d in entry.devices for i in d.cdi_device_ids]
+                        continue
+                    try:
+                        if entry is not None and entry.state == PREPARE_ABORTED:
+                            if not entry.aborted_expired():
+                                raise PermanentError(
+                                    f"claim {uid} was aborted; refusing to re-prepare")
+                            del cp.claims[uid]
+                            dirty = True
+                        devices = [
+                            r.device
+                            for r in (claim.allocation.devices if claim.allocation else [])
+                            if r.driver == self.driver_name
+                        ]
+                        if not devices:
+                            raise PermanentError(
+                                f"claim {claim.key}: no {self.driver_name} devices")
+                        cfg = self._decode_config(claim)
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        out[uid] = e
+                        continue
+                    cp.claims[uid] = PreparedClaim(
+                        claim_uid=uid, namespace=claim.namespace, name=claim.name,
+                        state=PREPARE_STARTED, started_at=time.time(),
+                    )
+                    seen.add(uid)
+                    pending.append((claim, cfg, devices))
+                    dirty = True
+                if not pending:
+                    if dirty:
+                        sess.save()
+                    return out
+                # Write #1: every PrepareStarted entry in ONE fsync'd write.
+                sess.save()
+                self._fire_fault(FAULT_STARTED_PERSISTED)
 
-            cp.claims[uid] = PreparedClaim(
-                claim_uid=uid, namespace=claim.namespace, name=claim.name,
-                state=PREPARE_STARTED, started_at=time.time(),
-            )
-            self._save_checkpoint(cp)
-            try:
-                if isinstance(cfg, ComputeDomainDaemonConfig):
-                    prepared = self._prepare_daemon(claim, cfg, devices)
-                elif isinstance(cfg, ComputeDomainChannelConfig):
-                    prepared = self._prepare_channel(claim, cfg, devices, cp)
-                else:
-                    raise PermanentError(f"config kind {cfg.kind} not valid here")
-            except Exception:
-                # Retryable or not, this attempt is over: clear the Started
-                # entry so the next Prepare starts clean.
-                cp = self._get_checkpoint()
-                cp.claims.pop(uid, None)
-                self._save_checkpoint(cp)
-                self.cdi.delete_claim_spec_file(uid)
-                raise
-            entry = cp.claims[uid]
-            entry.devices = prepared
-            entry.state = PREPARE_COMPLETED
-            entry.completed_at = time.time()
-            self._save_checkpoint(cp)
-            return [i for d in prepared for i in d.cdi_device_ids]
+                # Gate chains are sequential: they plant node labels, read
+                # domain/clique state, and check channel exclusivity against
+                # cp — including batch siblings completed just above.
+                staged: List[Tuple[ResourceClaim, Dict[str, ContainerEdits],
+                                   List[PreparedDevice]]] = []
+                for claim, cfg, devices in pending:
+                    try:
+                        if isinstance(cfg, ComputeDomainDaemonConfig):
+                            edits, prepared = self._stage_daemon(claim, cfg, devices)
+                        elif isinstance(cfg, ComputeDomainChannelConfig):
+                            edits, prepared = self._stage_channel(claim, cfg, devices, cp)
+                        else:
+                            raise PermanentError(
+                                f"config kind {cfg.kind} not valid here")
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        # Retryable or not, this attempt is over: clear the
+                        # Started entry so the next Prepare starts clean.
+                        cp.claims.pop(claim.uid, None)
+                        self.cdi.delete_claim_spec_file(claim.uid)
+                        out[claim.uid] = e
+                        continue
+                    # Mark completed in the in-memory cp NOW so a batch
+                    # sibling's channel-exclusivity check sees this claim;
+                    # it is persisted by write #2 below.
+                    entry = cp.claims[claim.uid]
+                    entry.devices = prepared
+                    entry.state = PREPARE_COMPLETED
+                    entry.completed_at = time.time()
+                    staged.append((claim, edits, prepared))
 
-    def _prepare_daemon(
+                # Fan the CDI spec writes out between the two checkpoint
+                # writes (independent fsync'd files).
+                def materialize(item) -> List[str]:
+                    claim, edits, prepared = item
+                    ids = self.cdi.create_claim_spec_file(claim.uid, edits)
+                    for d in prepared:
+                        d.cdi_device_ids = list(ids)
+                    return ids
+
+                results: Dict[str, object] = {}
+                if len(staged) == 1:
+                    try:
+                        results[staged[0][0].uid] = materialize(staged[0])
+                    except Exception as e:  # noqa: BLE001
+                        results[staged[0][0].uid] = e
+                elif staged:
+                    workers = min(CDI_MATERIALIZE_WORKERS, len(staged))
+                    with ThreadPoolExecutor(
+                        max_workers=workers, thread_name_prefix="cd-cdi-spec"
+                    ) as pool:
+                        futs = {item[0].uid: pool.submit(materialize, item)
+                                for item in staged}
+                        for uid, fut in futs.items():
+                            try:
+                                results[uid] = fut.result()
+                            except Exception as e:  # noqa: BLE001
+                                results[uid] = e
+                for claim, _edits, _prepared in staged:
+                    got = results[claim.uid]
+                    if isinstance(got, Exception):
+                        cp.claims.pop(claim.uid, None)
+                        self.cdi.delete_claim_spec_file(claim.uid)
+                        out[claim.uid] = got
+                    else:
+                        out[claim.uid] = got
+                self._fire_fault(FAULT_PRE_COMPLETED)
+                # Write #2: every PrepareCompleted transition in ONE write.
+                sess.save()
+        return out
+
+    def _stage_daemon(
         self, claim: ResourceClaim, cfg: ComputeDomainDaemonConfig, devices: List[str]
-    ) -> List[PreparedDevice]:
+    ) -> Tuple[Dict[str, ContainerEdits], List[PreparedDevice]]:
         if devices != [DAEMON_DEVICE]:
             raise PermanentError(f"daemon claim must allocate exactly [{DAEMON_DEVICE}]")
         edits = ContainerEdits(env={
@@ -293,9 +397,8 @@ class ComputeDomainDriver:
             "NODE_NAME": self.node_name,
             "ICI_DOMAIN": self.inventory.ici_domain,
         })
-        ids = self.cdi.create_claim_spec_file(claim.uid, {DAEMON_DEVICE: edits})
-        return [PreparedDevice(
-            name=DAEMON_DEVICE, device_type="daemon", cdi_device_ids=ids,
+        return {DAEMON_DEVICE: edits}, [PreparedDevice(
+            name=DAEMON_DEVICE, device_type="daemon",
             extra={"domain": cfg.domain_id},
         )]
 
@@ -345,13 +448,13 @@ class ComputeDomainDriver:
         chans = devcaps.enumerate_channels(self.max_channel_count)
         return [c.to_cdi_node() for c in chans]
 
-    def _prepare_channel(
+    def _stage_channel(
         self,
         claim: ResourceClaim,
         cfg: ComputeDomainChannelConfig,
         devices: List[str],
         cp: Checkpoint,
-    ) -> List[PreparedDevice]:
+    ) -> Tuple[Dict[str, ContainerEdits], List[PreparedDevice]]:
         if devices != [CHANNEL_DEVICE]:
             raise PermanentError(f"channel claim must allocate exactly [{CHANNEL_DEVICE}]")
         if cfg.channel_id >= self.max_channel_count:
@@ -375,43 +478,65 @@ class ComputeDomainDriver:
         env = self.cd.bootstrap_env(cd_uid, clique)
         env["TPU_SLICE_CHANNEL_ID"] = str(cfg.channel_id)
         edits = ContainerEdits(env=env, char_devices=self._channel_cdi_nodes(cfg))
-        ids = self.cdi.create_claim_spec_file(claim.uid, {CHANNEL_DEVICE: edits})
-        return [PreparedDevice(
-            name=CHANNEL_DEVICE, device_type="channel", cdi_device_ids=ids,
+        return {CHANNEL_DEVICE: edits}, [PreparedDevice(
+            name=CHANNEL_DEVICE, device_type="channel",
             extra={"domain": cd_uid, "channel_id": cfg.channel_id},
         )]
 
-    def _unprepare(self, claim_uid: str) -> None:
+    def _unprepare_batch(
+        self, claim_uids: List[str]
+    ) -> Dict[str, Optional[Exception]]:
+        """Batched unprepare: one checkpoint session, at most one fsync'd
+        write for the whole batch; node-label cleanup runs once per domain
+        against the batch's final state."""
+        out: Dict[str, Optional[Exception]] = {}
+        domains_to_check: set = set()
         with self._mutex:
-            cp = self._get_checkpoint()
-            entry = cp.claims.get(claim_uid)
-            if entry is None:
-                self.cdi.delete_claim_spec_file(claim_uid)
-                return
-            if entry.state == PREPARE_ABORTED:
-                # Keep the tombstone: it guards against a stale Prepare retry
-                # arriving after this Unprepare (reference device_state.go:
-                # 328-329); TTL expiry removes it.
-                self.cdi.delete_claim_spec_file(claim_uid)
-                return
-            domains = {d.extra.get("domain") for d in entry.devices
-                       if d.device_type == "channel"}
-            del cp.claims[claim_uid]
-            self._save_checkpoint(cp)
-            self.cdi.delete_claim_spec_file(claim_uid)
-            # Last channel claim for a domain on this node: drop the label so
-            # the DaemonSet can leave with the workload.
-            for cd_uid in filter(None, domains):
-                still_used = any(
-                    d.extra.get("domain") == cd_uid
-                    for e in cp.claims.values() for d in e.devices
-                    if d.device_type == "channel"
-                )
-                if not still_used:
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                dirty = False
+                for uid in claim_uids:
                     try:
-                        self.cd.remove_node_label(cd_uid)
-                    except Exception:  # noqa: BLE001 — controller also sweeps
-                        log.exception("label removal for %s failed", cd_uid)
+                        entry = cp.claims.get(uid)
+                        if entry is None:
+                            self.cdi.delete_claim_spec_file(uid)
+                            out[uid] = None
+                            continue
+                        if entry.state == PREPARE_ABORTED:
+                            # Keep the tombstone: it guards against a stale
+                            # Prepare retry arriving after this Unprepare
+                            # (reference device_state.go:328-329); TTL
+                            # expiry removes it.
+                            self.cdi.delete_claim_spec_file(uid)
+                            out[uid] = None
+                            continue
+                        domains_to_check |= {
+                            d.extra.get("domain") for d in entry.devices
+                            if d.device_type == "channel"
+                        }
+                        del cp.claims[uid]
+                        dirty = True
+                        self.cdi.delete_claim_spec_file(uid)
+                        out[uid] = None
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        out[uid] = e
+                if dirty:
+                    sess.save()
+                # Last channel claim for a domain on this node: drop the
+                # label so the DaemonSet can leave with the workload.
+                # Checked once per domain against the post-batch state.
+                for cd_uid in filter(None, domains_to_check):
+                    still_used = any(
+                        d.extra.get("domain") == cd_uid
+                        for e in cp.claims.values() for d in e.devices
+                        if d.device_type == "channel"
+                    )
+                    if not still_used:
+                        try:
+                            self.cd.remove_node_label(cd_uid)
+                        except Exception:  # noqa: BLE001 — controller also sweeps
+                            log.exception("label removal for %s failed", cd_uid)
+        return out
 
     def prepared_claims(self) -> Dict[str, PreparedClaim]:
         return dict(self._get_checkpoint().claims)

@@ -16,6 +16,16 @@ consistency corners are the hard part (SURVEY.md §7):
   dropped (612-700);
 - unprepare is idempotent and removes the CDI spec before the entry.
 
+The pipeline is batch-amortized: ``prepare_batch``/``unprepare_batch`` run
+every claim of one NodePrepareResources call through a single checkpoint
+session — one cp flock acquire, one load, exactly two fsync'd writes (all
+PrepareStarted entries in one, all PrepareCompleted in the other) — with
+the per-claim CDI specs materialized concurrently between the two writes.
+A crash anywhere in the batch still leaves per-claim PrepareStarted
+tombstones on disk, so the existing stale-entry rollback recovers each
+claim independently on restart: crash-consistency semantics are unchanged,
+only the write amplification moved from O(claims) to O(1) per batch.
+
 Config resolution follows GetOpaqueDeviceConfigs precedence
 (1399-1463): class-sourced configs apply before claim-sourced, and
 all-requests configs before request-specific ones, so the most specific
@@ -28,8 +38,9 @@ import logging
 import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.api.configs import (
     DeviceConfig,
@@ -48,6 +59,8 @@ from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.plugins.checkpoint import (
     Checkpoint,
     CheckpointStore,
+    FAULT_PRE_COMPLETED,
+    FAULT_STARTED_PERSISTED,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     PreparedClaim,
@@ -73,6 +86,10 @@ from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 from k8s_dra_driver_tpu.tpulib.types import HostInventory, parse_topology
 
 log = logging.getLogger(__name__)
+
+# Bound on concurrent CDI spec writes in one batch (each is a small fsync'd
+# YAML file; past ~8 writers the disk queue, not Python, is the limit).
+CDI_MATERIALIZE_WORKERS = 8
 
 
 class PrepareError(Exception):
@@ -154,6 +171,8 @@ class DeviceState:
                 )
             self.partitions = PartitionManager(host_topology, client)
         self._mutex = threading.Lock()
+        # Crash-injection seam for the batched pipeline (see FAULT_* above).
+        self.fault_hook: Optional[Callable[[str], None]] = None
 
         def on_discard(uid: str) -> None:
             # Pre-reboot claim: its CDI spec and sharing records are stale.
@@ -186,79 +205,198 @@ class DeviceState:
 
     # -- public state machine ----------------------------------------------
 
+    def _fire_fault(self, point: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(point)
+
     def prepare(self, claim: ResourceClaim) -> PrepareResult:
         """Prepare one claim; returns CDI device ids for the kubelet."""
+        res = self.prepare_batch([claim])[claim.uid]
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def prepare_batch(
+        self, claims: Sequence[ResourceClaim]
+    ) -> Dict[str, "PrepareResult | Exception"]:
+        """Prepare a whole NodePrepareResources batch under one checkpoint
+        session: two fsync'd writes total (all PrepareStarted, then all
+        PrepareCompleted), CDI specs materialized concurrently in between.
+        Per-claim failures come back as inline exceptions — one bad claim
+        never fails its batch siblings."""
+        out: Dict[str, "PrepareResult | Exception"] = {}
+        if not claims:
+            return out
         with self._mutex:
             t0 = time.perf_counter()
-            cp = self._get_checkpoint()
-            uid = claim.uid
-            entry = cp.claims.get(uid)
-            if entry is not None and entry.state == PREPARE_COMPLETED:
-                return PrepareResult(
-                    claim_uid=uid,
-                    cdi_device_ids=[i for d in entry.devices for i in d.cdi_device_ids],
-                    devices=list(entry.devices),
-                )
-            if entry is not None and entry.state == PREPARE_STARTED:
-                log.warning("claim %s has a stale PrepareStarted entry; rolling back", uid)
-                self._rollback(entry)
-                del cp.claims[uid]
-                self._save_checkpoint(cp)
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                dirty = False
+                pending: List[ResourceClaim] = []
+                batch_chips: Dict[str, set] = {}  # uid -> chips wanted by siblings
+                for claim in claims:
+                    uid = claim.uid
+                    if uid in batch_chips or uid in out:
+                        continue  # duplicate uid in one batch: first wins
+                    entry = cp.claims.get(uid)
+                    if entry is not None and entry.state == PREPARE_COMPLETED:
+                        out[uid] = PrepareResult(
+                            claim_uid=uid,
+                            cdi_device_ids=[i for d in entry.devices
+                                            for i in d.cdi_device_ids],
+                            devices=list(entry.devices),
+                        )
+                        continue
+                    try:
+                        if entry is not None and entry.state == PREPARE_STARTED:
+                            # Inside the per-claim try: a poisoned stale
+                            # entry fails only ITS claim, never the batch.
+                            log.warning(
+                                "claim %s has a stale PrepareStarted entry; rolling back", uid)
+                            self._rollback(entry)
+                            del cp.claims[uid]
+                            dirty = True
+                        requested = self._allocated_device_names(claim)
+                        want = self._validate_no_overlap(cp, uid, requested)
+                        # Batch siblings are not in cp yet: they conflict too.
+                        for other_uid, held in batch_chips.items():
+                            both = want & held
+                            if both:
+                                raise OverlapError(
+                                    f"devices overlap with batch sibling claim "
+                                    f"{other_uid} on chips {sorted(both)}"
+                                )
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        out[uid] = e
+                        continue
+                    batch_chips[uid] = want
+                    cp.claims[uid] = PreparedClaim(
+                        claim_uid=uid,
+                        namespace=claim.namespace,
+                        name=claim.name,
+                        state=PREPARE_STARTED,
+                        started_at=time.time(),
+                    )
+                    pending.append(claim)
+                    dirty = True
+                if not pending:
+                    if dirty:
+                        sess.save()
+                    return out
+                # Write #1: every PrepareStarted entry (and any stale-entry
+                # removal) lands in ONE fsync'd write.
+                sess.save()
+                self._fire_fault(FAULT_STARTED_PERSISTED)
 
-            requested = self._allocated_device_names(claim)
-            self._validate_no_overlap(cp, uid, requested)
+                # Device mutations stay sequential — they touch shared
+                # managers (partitions, sharing, vfio sysfs) whose invariants
+                # are ordering-sensitive; the parallel win is the CDI I/O.
+                prepared_by_uid: Dict[str, List[PreparedDevice]] = {}
+                for claim in pending:
+                    try:
+                        # _prepare_devices rolls back its own partial work.
+                        prepared_by_uid[claim.uid] = self._prepare_devices(claim)
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        del cp.claims[claim.uid]
+                        out[claim.uid] = e
+                survivors = [c for c in pending if c.uid in prepared_by_uid]
 
-            cp.claims[uid] = PreparedClaim(
-                claim_uid=uid,
-                namespace=claim.namespace,
-                name=claim.name,
-                state=PREPARE_STARTED,
-                started_at=time.time(),
-            )
-            self._save_checkpoint(cp)
+                # Materialize per-claim CDI specs concurrently between the
+                # two checkpoint writes (each spec is an independent fsync'd
+                # file; edits are computed from now-quiescent device state).
+                def materialize(claim: ResourceClaim) -> List[PreparedDevice]:
+                    prepared = prepared_by_uid[claim.uid]
+                    per_dev = {d.name: self._edits_for(d) for d in prepared}
+                    ids = self.cdi.create_claim_spec_file(
+                        claim.uid, per_dev,
+                        common_edits=self._common_edits(prepared),
+                    )
+                    id_by_name = dict(zip(sorted(per_dev), ids))
+                    for d in prepared:
+                        d.cdi_device_ids = [id_by_name[d.name]]
+                    return prepared
 
-            prepared: List[PreparedDevice] = []
-            try:
-                # _prepare_devices rolls back its own partial work on failure.
-                prepared = self._prepare_devices(claim)
-                per_dev = {d.name: self._edits_for(d) for d in prepared}
-                ids = self.cdi.create_claim_spec_file(
-                    uid, per_dev, common_edits=self._common_edits(prepared)
-                )
-                id_by_name = dict(zip(sorted(per_dev), ids))
-                for d in prepared:
-                    d.cdi_device_ids = [id_by_name[d.name]]
-            except Exception:
-                # Device work succeeded but the CDI write failed.
-                self._rollback_devices(uid, prepared)
-                self.cdi.delete_claim_spec_file(uid)
-                del cp.claims[uid]
-                self._save_checkpoint(cp)
-                raise
+                results: Dict[str, "List[PreparedDevice] | Exception"] = {}
+                if len(survivors) == 1:
+                    c = survivors[0]
+                    try:
+                        results[c.uid] = materialize(c)
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        results[c.uid] = e
+                elif survivors:
+                    workers = min(CDI_MATERIALIZE_WORKERS, len(survivors))
+                    with ThreadPoolExecutor(
+                        max_workers=workers, thread_name_prefix="cdi-spec"
+                    ) as pool:
+                        futs = {c.uid: pool.submit(materialize, c)
+                                for c in survivors}
+                        for uid, fut in futs.items():
+                            try:
+                                results[uid] = fut.result()
+                            except Exception as e:  # noqa: BLE001
+                                results[uid] = e
 
-            entry = cp.claims[uid]
-            entry.devices = prepared
-            entry.state = PREPARE_COMPLETED
-            entry.completed_at = time.time()
-            self._save_checkpoint(cp)
-            log.debug("t_prep=%0.4fs claim=%s", time.perf_counter() - t0, uid)
-            return PrepareResult(
-                claim_uid=uid,
-                cdi_device_ids=[i for d in prepared for i in d.cdi_device_ids],
-                devices=list(prepared),
-            )
+                for claim in survivors:
+                    uid = claim.uid
+                    got = results[uid]
+                    if isinstance(got, Exception):
+                        # Device work succeeded but the CDI write failed.
+                        self._rollback_devices(uid, prepared_by_uid[uid])
+                        self.cdi.delete_claim_spec_file(uid)
+                        del cp.claims[uid]
+                        out[uid] = got
+                        continue
+                    entry = cp.claims[uid]
+                    entry.devices = got
+                    entry.state = PREPARE_COMPLETED
+                    entry.completed_at = time.time()
+                    out[uid] = PrepareResult(
+                        claim_uid=uid,
+                        cdi_device_ids=[i for d in got for i in d.cdi_device_ids],
+                        devices=list(got),
+                    )
+                self._fire_fault(FAULT_PRE_COMPLETED)
+                # Write #2: every PrepareCompleted transition in ONE write.
+                sess.save()
+            log.debug("t_prep_batch=%0.4fs n=%d", time.perf_counter() - t0,
+                      len(claims))
+        return out
 
     def unprepare(self, claim_uid: str) -> None:
+        errs = self.unprepare_batch([claim_uid])
+        err = errs.get(claim_uid)
+        if err is not None:
+            raise err
+
+    def unprepare_batch(
+        self, claim_uids: Sequence[str]
+    ) -> Dict[str, Optional[Exception]]:
+        """Unprepare a batch under one checkpoint session: one flock, one
+        load, at most one fsync'd write for the whole batch."""
+        out: Dict[str, Optional[Exception]] = {}
+        if not claim_uids:
+            return out
         with self._mutex:
-            cp = self._get_checkpoint()
-            entry = cp.claims.get(claim_uid)
-            if entry is None:
-                self.cdi.delete_claim_spec_file(claim_uid)  # belt and braces
-                return
-            self._rollback(entry)
-            self.cdi.delete_claim_spec_file(claim_uid)
-            del cp.claims[claim_uid]
-            self._save_checkpoint(cp)
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                dirty = False
+                for uid in claim_uids:
+                    try:
+                        entry = cp.claims.get(uid)
+                        if entry is None:
+                            self.cdi.delete_claim_spec_file(uid)  # belt and braces
+                            out[uid] = None
+                            continue
+                        self._rollback(entry)
+                        self.cdi.delete_claim_spec_file(uid)
+                        del cp.claims[uid]
+                        dirty = True
+                        out[uid] = None
+                    except Exception as e:  # noqa: BLE001 — per-claim contract
+                        out[uid] = e
+                if dirty:
+                    sess.save()
+        return out
 
     def prepared_claims(self) -> Dict[str, PreparedClaim]:
         return dict(self._get_checkpoint().claims)
@@ -282,10 +420,12 @@ class DeviceState:
 
     def _validate_no_overlap(
         self, cp: Checkpoint, uid: str, requested: Sequence[str]
-    ) -> None:
+    ) -> set:
         """No chip may be held by two claims (device_state.go:1482-1520).
         Overlap is computed on chip indices, so a subslice conflicts with
-        its member chips even though the device names differ."""
+        its member chips even though the device names differ. Returns the
+        claim's requested chip set (the batch pipeline reuses it for
+        sibling-overlap checks — one derivation rule, not two)."""
         want: set = set()
         for name in requested:
             want |= set(self.allocatable[name].chip_indices)
@@ -298,6 +438,7 @@ class DeviceState:
                 raise OverlapError(
                     f"devices overlap with claim {other_uid} on chips {sorted(both)}"
                 )
+        return want
 
     def _prepare_devices(self, claim: ResourceClaim) -> List[PreparedDevice]:
         configs = self._resolve_configs(claim)

@@ -154,47 +154,75 @@ class TpuDriver:
     def prepare_resource_claims(
         self, claims: List[ResourceClaim]
     ) -> Dict[str, PrepareResult | Exception]:
+        """Batch-amortized prepare: ONE pu flock acquire and ONE checkpoint
+        session (two fsyncs) for the whole NodePrepareResources call; the
+        state machine returns per-claim results/exceptions inline, so a bad
+        claim never fails its siblings."""
+        if not claims:
+            return {}
         out: Dict[str, PrepareResult | Exception] = {}
+        with self.metrics.track_batch("PrepareResourceClaims", len(claims)):
+            try:
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                    out = self.state.prepare_batch(claims)
+            except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
+                # Whole-batch failure (lock timeout, checkpoint corruption):
+                # every claim reports it.
+                log.warning("prepare batch of %d failed: %s", len(claims), e)
+                out = {c.uid: e for c in claims}
+        failed = sum(1 for r in out.values() if isinstance(r, Exception))
+        self.metrics.record_claim_errors("PrepareResourceClaims", failed)
         for claim in claims:
-            with self.metrics.track("PrepareResourceClaims"):
-                try:
-                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
-                        out[claim.uid] = self.state.prepare(claim)
-                except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
-                    log.warning("prepare %s failed: %s", claim.key, e)
-                    out[claim.uid] = e
+            r = out.get(claim.uid)
+            if isinstance(r, Exception):
+                log.warning("prepare %s failed: %s", claim.key, r)
         return out
 
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
+        if not claim_uids:
+            return {}
         out: Dict[str, Optional[Exception]] = {}
-        for uid in claim_uids:
-            with self.metrics.track("UnprepareResourceClaims"):
-                try:
-                    with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
-                        self.state.unprepare(uid)
-                    out[uid] = None
-                except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
-                    log.warning("unprepare %s failed: %s", uid, e)
-                    out[uid] = e
+        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)):
+            try:
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                    out = self.state.unprepare_batch(claim_uids)
+            except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
+                log.warning("unprepare batch of %d failed: %s", len(claim_uids), e)
+                out = {uid: e for uid in claim_uids}
+        failed = sum(1 for r in out.values() if r is not None)
+        self.metrics.record_claim_errors("UnprepareResourceClaims", failed)
+        for uid, err in out.items():
+            if err is not None:
+                log.warning("unprepare %s failed: %s", uid, err)
         return out
 
     # -- stale-claim cleanup -------------------------------------------------
 
     def cleanup_stale_claims(self) -> int:
         """Unprepare claims whose ResourceClaim no longer exists
-        (cleanup.go:149-259). Returns how many were cleaned."""
-        cleaned = 0
+        (cleanup.go:149-259). Returns how many were cleaned. The whole
+        sweep is one unprepare batch: one flock, one checkpoint write."""
+        stale = []
         for uid, entry in self.state.prepared_claims().items():
             obj = self.api.try_get(RESOURCE_CLAIM, entry.name, entry.namespace)
             if obj is not None and obj.uid == uid:
                 continue
             log.info("cleaning stale claim %s/%s uid=%s", entry.namespace, entry.name, uid)
-            try:
-                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
-                    self.state.unprepare(uid)
+            stale.append(uid)
+        if not stale:
+            return 0
+        cleaned = 0
+        try:
+            with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                errs = self.state.unprepare_batch(stale)
+        except Exception:  # noqa: BLE001
+            log.exception("stale cleanup batch of %d failed", len(stale))
+            return 0
+        for uid, err in errs.items():
+            if err is None:
                 cleaned += 1
-            except Exception:  # noqa: BLE001
-                log.exception("stale cleanup of %s failed", uid)
+            else:
+                log.error("stale cleanup of %s failed: %s", uid, err)
         return cleaned
 
     def _cleanup_loop(self) -> None:

@@ -39,44 +39,79 @@ class AllocationError(Exception):
 _LEGACY_SELECTOR = re.compile(r"([^=!<>]+)=([^=]*)")
 
 
+class _MatchPlan:
+    """Per-request device matcher, compiled ONCE per request instead of
+    re-parsed per device: legacy ``attr=value`` selectors are regex-parsed
+    at plan build (a malformed one fails the request up front, same
+    observable error as before), and CEL selectors are compiled to closures
+    (celmini caches compilation; the plan pins the compiled fns so the hot
+    loop does zero dict/regex work per device)."""
+
+    __slots__ = ("driver", "match_attrs", "legacy_pairs", "cel_fns",
+                 "_cel_error")
+
+    def __init__(self, driver: str, match_attrs: Dict[str, object],
+                 legacy_selectors: Sequence[str],
+                 cel_selectors: Sequence[str]):
+        self.driver = driver
+        self.match_attrs = dict(match_attrs)
+        self.legacy_pairs: List[Tuple[str, str]] = []
+        for sel in legacy_selectors:
+            # Legacy sim-only attr=value strings: a bare key, one '=', a
+            # bare value. A CEL expression that arrives here as a plain
+            # string must fail loudly (its '==' / '!=' / '>=' / '<='
+            # doesn't fit the shape), not silently look up a garbage
+            # attribute key and match zero devices.
+            m = _LEGACY_SELECTOR.fullmatch(sel)
+            if not m:
+                raise AllocationError(
+                    f"malformed legacy selector {sel!r} (want attr=value; CEL "
+                    f"selectors use the manifest form {{cel: {{expression}}}})")
+            self.legacy_pairs.append((m.group(1).strip(), m.group(2).strip()))
+        self.cel_fns = []
+        self._cel_error: type = Exception
+        if cel_selectors:
+            # Real DRA selectors (class- or request-level), tagged as CEL
+            # at manifest parse time by their k8s shape {cel: {expression}}
+            # — never sniffed out of a string, so a legacy value containing
+            # "device." can't be misrouted here.
+            from k8s_dra_driver_tpu.k8s import celmini
+
+            self._cel_error = celmini.CelError  # bound once, off the hot loop
+            try:
+                self.cel_fns = [celmini.compile_expression(e)
+                                for e in cel_selectors]
+            except celmini.CelError as e:
+                raise AllocationError(f"bad CEL selector: {e}") from e
+
+    def matches(self, dev: Device) -> bool:
+        for k, v in self.match_attrs.items():
+            if dev.attributes.get(k) != v:
+                return False
+        if self.cel_fns:
+            # CEL sees `device.driver`; the Device object itself doesn't
+            # carry it (the slice does), so bind it for evaluation.
+            view = SimpleNamespace(driver=self.driver,
+                                   attributes=dev.attributes,
+                                   capacity=dev.capacity)
+            try:
+                if not all(bool(fn(view)) for fn in self.cel_fns):
+                    return False
+            except self._cel_error as e:
+                raise AllocationError(f"bad CEL selector: {e}") from e
+        for k, v in self.legacy_pairs:
+            if str(dev.attributes.get(k)) != v:
+                return False
+        return True
+
+
 def _device_matches(dev: Device, match_attributes: Dict[str, object],
                     selectors: List[str], cel_selectors: List[str] = (),
                     driver: str = "") -> bool:
-    for k, v in match_attributes.items():
-        if dev.attributes.get(k) != v:
-            return False
-    if cel_selectors:
-        # Real DRA selectors (class- or request-level), tagged as CEL at
-        # manifest parse time by their k8s shape {cel: {expression}} —
-        # never sniffed out of a string, so a legacy value containing
-        # "device." can't be misrouted here.
-        from k8s_dra_driver_tpu.k8s import celmini
-
-        # CEL sees `device.driver`; the Device object itself doesn't carry
-        # it (the slice does), so bind it for evaluation.
-        view = SimpleNamespace(driver=driver, attributes=dev.attributes,
-                               capacity=dev.capacity)
-        try:
-            if not celmini.matches(cel_selectors, view):
-                return False
-        except celmini.CelError as e:
-            raise AllocationError(f"bad CEL selector: {e}") from e
-    for sel in selectors:
-        # Legacy sim-only attr=value strings: a bare key, one '=', a bare
-        # value. A CEL expression that arrives here as a plain string must
-        # fail loudly (its '==' / '!=' / '>=' / '<=' doesn't fit the
-        # shape), not silently look up a garbage attribute key and match
-        # zero devices.
-        m = _LEGACY_SELECTOR.fullmatch(sel)
-        if m:
-            k, v = m.group(1), m.group(2)
-            if str(dev.attributes.get(k.strip())) != v.strip():
-                return False
-        else:
-            raise AllocationError(
-                f"malformed legacy selector {sel!r} (want attr=value; CEL "
-                f"selectors use the manifest form {{cel: {{expression}}}})")
-    return True
+    """One-shot matcher (tests, ad-hoc callers): builds a throwaway plan.
+    The allocator's hot loop uses a per-request plan instead."""
+    return _MatchPlan(driver, match_attributes, selectors,
+                      list(cel_selectors)).matches(dev)
 
 
 class Allocator:
@@ -116,25 +151,83 @@ class Allocator:
         and slice — O(pods × nodes × claims) per pass, which dominates at
         cluster scale (64 nodes / 128 pods: ~115 s → ~1 s). Allocations
         written during the pass must be recorded with ``commit()`` so the
-        snapshot can never double-book by construction."""
+        snapshot can never double-book by construction.
+
+        The pass also carries incremental per-node consumed-counter
+        accounting: built here in ONE scan of the allocation list, then
+        updated by ``commit()``/``rollback()`` — so a whole scheduler pass
+        is O(allocations) total instead of re-scanning every allocation for
+        every pod × node probe (O(pods × allocations))."""
         slices, index = self._snapshot_slices()
         allocations = [
             c.allocation for c in self.api.list(RESOURCE_CLAIM)
             if c.allocation is not None
         ]
+        index = dict(index)
+        if not index:
+            # No fingerprint-backed slice cache (api without
+            # kind_fingerprint): build the device index here — the
+            # consumed cache below is only correct against a real index.
+            index = {
+                (s.driver, s.node_name): {d.name: d for d in s.devices}
+                for s in slices
+            }
+        consumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for alloc in allocations:
+            self._accrue(consumed, index, alloc, +1)
         self._pass_snapshot = {
             "slices": slices,
             "allocations": allocations,
-            "index": dict(index),  # (driver, node) -> {name -> Device}
+            "index": index,  # (driver, node) -> {name -> Device}
+            "consumed": consumed,  # node -> counter_set -> counter -> used
+            "classes": {},  # DeviceClass name -> (driver, attrs, cel)
         }
+
+    @staticmethod
+    def _accrue(consumed: Dict, index: Dict, alloc, sign: int) -> None:
+        """Add (or with sign=-1 remove) one allocation's counter consumption
+        to the per-node incremental cache."""
+        if alloc is None or not alloc.node_name:
+            return
+        node = consumed.setdefault(
+            alloc.node_name, defaultdict(lambda: defaultdict(int)))
+        for r in alloc.devices:
+            dev = index.get((r.driver, alloc.node_name), {}).get(r.device)
+            if dev is None:
+                continue
+            for cc in dev.consumes_counters:
+                for cname, ctr in cc.counters.items():
+                    node[cc.counter_set][cname] += sign * ctr.value
 
     def commit(self, alloc) -> None:
         """Record an allocation written to the API during the active pass —
-        it joins the snapshot's allocation list so every later
-        allocate_on_node counts it. No-op outside a pass (live listing sees
-        the write directly)."""
+        it joins the snapshot's allocation list AND the incremental
+        consumed-counter cache, so every later allocate_on_node counts it
+        without a rescan. No-op outside a pass (live listing sees the write
+        directly)."""
         if self._pass_snapshot is not None and alloc is not None:
             self._pass_snapshot["allocations"].append(alloc)
+            self._accrue(self._pass_snapshot["consumed"],
+                         self._pass_snapshot["index"], alloc, +1)
+
+    def rollback(self, alloc) -> None:
+        """Withdraw an allocation previously ``commit()``-ed this pass (the
+        scheduler undid the placement, e.g. a sibling claim of the same pod
+        failed on that node). Counter accounting is decremented exactly as
+        commit incremented it, so re-allocation sees the same state as a
+        from-scratch rescan."""
+        if self._pass_snapshot is None or alloc is None:
+            return
+        allocations = self._pass_snapshot["allocations"]
+        for i, a in enumerate(allocations):
+            # Identity first (the common case: the object commit() took),
+            # falling back to value equality so a caller holding an equal
+            # reconstruction of the allocation still withdraws it.
+            if a is alloc or a == alloc:
+                del allocations[i]
+                self._accrue(self._pass_snapshot["consumed"],
+                             self._pass_snapshot["index"], alloc, -1)
+                return
 
     def end_pass(self) -> None:
         self._pass_snapshot = None
@@ -169,7 +262,12 @@ class Allocator:
                            in_flight: Sequence = ()) -> Dict[str, Dict[str, int]]:
         """counter_set -> counter -> consumed, over all allocated claims on
         this node plus any ``in_flight`` AllocationResults computed but not
-        yet committed (sibling claims of one pod scheduled together)."""
+        yet committed (sibling claims of one pod scheduled together).
+
+        This is the from-scratch rescan — O(allocations) per call. Inside a
+        pass, ``_consumed_for_node`` serves the same answer from the
+        incremental cache; this implementation is kept as the correctness
+        oracle the property tests diff the cache against."""
         by_name = self._device_index(self._list_slices())
         consumed: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
 
@@ -188,6 +286,31 @@ class Allocator:
             count(alloc)
         for alloc in in_flight:
             count(alloc)
+        return consumed
+
+    def _consumed_for_node(self, node_name: str,
+                           in_flight: Sequence = ()) -> Dict[str, Dict[str, int]]:
+        """Consumed counters for one node: the incremental cache inside a
+        pass (O(in_flight) per call), the full rescan outside one."""
+        snap = self._pass_snapshot
+        if snap is None:
+            return self._consumed_counters(node_name, in_flight)
+        base = snap["consumed"].get(node_name)
+        if not in_flight:
+            if base is None:
+                base = snap["consumed"].setdefault(
+                    node_name, defaultdict(lambda: defaultdict(int)))
+            return base
+        # Overlay in-flight siblings on a copy so probing one node for one
+        # pod never dirties the pass-wide cache.
+        consumed: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        if base is not None:
+            for cs, counters in base.items():
+                consumed[cs].update(counters)
+        overlay = {node_name: consumed}
+        for alloc in in_flight:
+            if alloc is not None and alloc.node_name == node_name:
+                self._accrue(overlay, snap["index"], alloc, +1)
         return consumed
 
     def _fits(self, rs: ResourceSlice, dev: Device,
@@ -212,11 +335,25 @@ class Allocator:
     # -- allocation -----------------------------------------------------------
 
     def _class_info(self, class_name: str):
+        snap = self._pass_snapshot
+        if snap is not None and class_name in snap["classes"]:
+            return snap["classes"][class_name]
         dc = self.api.try_get(DEVICE_CLASS, class_name)
         if dc is None:
             raise AllocationError(f"DeviceClass {class_name!r} not found")
-        return (dc.driver, getattr(dc, "match_attributes", {}),
+        info = (dc.driver, getattr(dc, "match_attributes", {}),
                 getattr(dc, "cel_selectors", []))
+        if snap is not None:
+            snap["classes"][class_name] = info
+        return info
+
+    def _match_plan(self, req) -> Tuple[str, _MatchPlan]:
+        """(driver, compiled plan) for one request — class lookup, legacy
+        selector parsing, and CEL compilation all happen here, once per
+        request, not once per candidate device."""
+        driver, match_attrs, cel_sels = self._class_info(req.device_class_name)
+        all_cel = list(cel_sels) + list(getattr(req, "cel_selectors", ()))
+        return driver, _MatchPlan(driver, match_attrs, req.selectors, all_cel)
 
     def allocate_on_node(self, claim: ResourceClaim, node_name: str,
                          in_flight: Sequence = ()) -> Optional[AllocationResult]:
@@ -229,13 +366,12 @@ class Allocator:
             for s in self._list_slices()
             if s.node_name == node_name
         }
-        consumed = self._consumed_counters(node_name, in_flight)
+        consumed = self._consumed_for_node(node_name, in_flight)
         pending: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
         picked: List[DeviceRequestAllocationResult] = []
         picked_names: set = set()
         for req in claim.requests:
-            driver, match_attrs, cel_sels = self._class_info(req.device_class_name)
-            all_cel = list(cel_sels) + list(getattr(req, "cel_selectors", ()))
+            driver, plan = self._match_plan(req)
             rs = slices_by_driver.get(driver)
             if rs is None:
                 return None
@@ -243,8 +379,7 @@ class Allocator:
                 d for d in rs.devices
                 if d.name not in picked_names
                 and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
-                and _device_matches(d, match_attrs, req.selectors,
-                                    cel_selectors=all_cel, driver=driver)
+                and plan.matches(d)
             ]
             want = len(candidates) if req.allocation_mode == "All" else req.count
             chosen: List[Device] = []

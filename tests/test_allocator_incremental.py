@@ -1,0 +1,189 @@
+"""Allocator incremental consumed-counter accounting: the per-node cache
+built in begin_pass() and maintained by commit()/rollback() must agree
+device-for-device and counter-for-counter with the from-scratch
+_consumed_counters rescan (kept as the oracle) after any allocate /
+rollback / re-allocate sequence.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+from k8s_dra_driver_tpu.sim.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+TPU_CLASS = "tpu.google.com"
+SUB_CLASS = "subslice.tpu.google.com"
+
+
+def _normalize(consumed):
+    """Nested counter dicts -> plain dicts with zero entries dropped (the
+    cache may carry explicit zeros after a rollback; the oracle never
+    materializes them)."""
+    return {
+        cs: {c: v for c, v in counters.items() if v}
+        for cs, counters in consumed.items()
+        if any(counters.values())
+    }
+
+
+@pytest.fixture
+def api():
+    api = APIServer()
+    api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver="tpu.google.com",
+                           match_attributes={"type": "tpu"}))
+    api.create(DeviceClass(meta=new_meta(SUB_CLASS), driver="tpu.google.com",
+                           match_attributes={"type": "subslice"}))
+    for node in ("n0", "n1"):
+        inv = MockTpuLib("v5e-4").enumerate()
+        devices = enumerate_allocatable(inv, with_subslices=True)
+        api.create(build_resource_slice(node, "tpu.google.com", devices, inv))
+    return api
+
+
+def _claim(name, class_name=TPU_CLASS, count=1, selectors=()):
+    c = ResourceClaim(
+        meta=new_meta(name, "default"),
+        requests=[DeviceRequest(name="r", device_class_name=class_name,
+                                count=count, selectors=list(selectors))],
+    )
+    c.meta.uid = fresh_uid()
+    return c
+
+
+def _check_cache_matches_oracle(alloc, nodes=("n0", "n1")):
+    for node in nodes:
+        cache = _normalize(alloc._consumed_for_node(node))
+        oracle = _normalize(alloc._consumed_counters(node))
+        assert cache == oracle, f"{node}: cache {cache} != rescan {oracle}"
+
+
+def test_allocate_rollback_reallocate_matches_rescan(api):
+    """The satellite property check: a pass that allocates, rolls back, and
+    re-allocates agrees with the from-scratch rescan at every step."""
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        a1 = alloc.allocate_on_node(_claim("c1", count=2), "n0")
+        assert a1 is not None
+        alloc.commit(a1)
+        _check_cache_matches_oracle(alloc)
+
+        a2 = alloc.allocate_on_node(_claim("c2", SUB_CLASS), "n0")
+        assert a2 is not None
+        alloc.commit(a2)
+        _check_cache_matches_oracle(alloc)
+
+        # Scheduler changed its mind: withdraw c2.
+        alloc.rollback(a2)
+        _check_cache_matches_oracle(alloc)
+
+        # Re-allocate on the other node, plus more churn on n0.
+        a2b = alloc.allocate_on_node(_claim("c2b", SUB_CLASS), "n1")
+        assert a2b is not None
+        alloc.commit(a2b)
+        a3 = alloc.allocate_on_node(_claim("c3", count=2), "n0")
+        assert a3 is not None
+        alloc.commit(a3)
+        _check_cache_matches_oracle(alloc)
+
+        # n0 is now full (2 + 2 chips): a chip claim must not fit, and the
+        # cache-backed answer must agree with what a rescan would say.
+        assert alloc.allocate_on_node(_claim("c4"), "n0") is None
+        # The rolled-back c2 freed its chips: a subslice fits on n0 again
+        # only where counters allow; n1 still has room.
+        assert alloc.allocate_on_node(_claim("c5"), "n1") is not None
+    finally:
+        alloc.end_pass()
+
+
+def test_rollback_then_reallocate_same_devices(api):
+    """After rollback the exact same devices are allocatable again —
+    device-for-device equality with the pre-allocation answer."""
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        first = alloc.allocate_on_node(_claim("c1", count=4), "n0")
+        assert first is not None
+        alloc.commit(first)
+        # Node full: nothing else fits.
+        assert alloc.allocate_on_node(_claim("c2"), "n0") is None
+        alloc.rollback(first)
+        _check_cache_matches_oracle(alloc)
+        again = alloc.allocate_on_node(_claim("c3", count=4), "n0")
+        assert again is not None
+        assert [r.device for r in again.devices] == \
+            [r.device for r in first.devices]
+    finally:
+        alloc.end_pass()
+
+
+def test_in_flight_overlay_does_not_dirty_cache(api):
+    """Probing with in_flight siblings must not mutate the pass-wide
+    cache: an uncommitted probe leaves no trace."""
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        probe = alloc.allocate_on_node(_claim("p1", count=2), "n0")
+        assert probe is not None
+        # Probe a sibling with p1 in flight, then walk away from both.
+        sibling = alloc.allocate_on_node(_claim("p2", count=2), "n0",
+                                         in_flight=[probe])
+        assert sibling is not None
+        _check_cache_matches_oracle(alloc)  # nothing committed, cache clean
+        # With both in flight the node is full.
+        assert alloc.allocate_on_node(
+            _claim("p3"), "n0", in_flight=[probe, sibling]) is None
+        # Without them it is empty again.
+        assert alloc.allocate_on_node(_claim("p4", count=4), "n0") is not None
+    finally:
+        alloc.end_pass()
+
+
+def test_incremental_matches_fresh_pass(api):
+    """Counters committed during a pass equal a brand-new pass built from
+    the API state after the allocations are actually written."""
+    alloc = Allocator(api)
+    claim = _claim("c1", count=3)
+    api.create(claim)
+    alloc.begin_pass()
+    a = alloc.allocate_on_node(claim, "n0")
+    assert a is not None
+    alloc.commit(a)
+    end_state = _normalize(alloc._consumed_for_node("n0"))
+    alloc.end_pass()
+
+    stored = api.get("ResourceClaim", claim.meta.name, "default")
+    stored.allocation = a
+    api.update(stored)
+    alloc.begin_pass()
+    try:
+        fresh = _normalize(alloc._consumed_for_node("n0"))
+        assert fresh == end_state
+    finally:
+        alloc.end_pass()
+
+
+def test_match_plan_rejects_malformed_selector_once(api):
+    """The per-request match plan compiles selectors up front: a malformed
+    legacy selector fails the request with AllocationError (not a silent
+    zero-device match)."""
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        with pytest.raises(AllocationError, match="malformed legacy selector"):
+            alloc.allocate_on_node(
+                _claim("bad", selectors=["no-equals-sign"]), "n0")
+        # Valid legacy selectors still work through the plan.
+        got = alloc.allocate_on_node(
+            _claim("ok", selectors=["type=tpu"]), "n0")
+        assert got is not None
+    finally:
+        alloc.end_pass()

@@ -1,0 +1,341 @@
+"""Batched node-prepare pipeline: one flock/checkpoint session per batch,
+two fsync'd writes for N claims, concurrent CDI materialization, and
+crash-consistency between the two batch writes (per-claim PrepareStarted
+tombstones recovered on restart, no leaked ICI partitions).
+
+The write-amplification guards are deliberately exact: a regression that
+re-introduces per-claim checkpoint writes fails here long before a bench
+run notices the latency.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg.partitioner import StubPartitionClient
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+)
+from k8s_dra_driver_tpu.plugins.tpu import device_state as ds_mod
+from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+    FAULT_PRE_COMPLETED,
+    FAULT_STARTED_PERSISTED,
+    OverlapError,
+    PrepareError,
+)
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.tpulib.profiles import SliceProfile
+from k8s_dra_driver_tpu.tpulib.types import TpuGen
+
+from tests.test_tpu_plugin import make_claim
+
+# Dense single-host mock shape: 16 non-overlapping single-chip claims on
+# one node (real v5e hosts carry 4 chips; this is a control-plane shape).
+DENSE16 = SliceProfile(
+    name="test-v5e-16x1", gen=TpuGen.V5E, accelerator_type="v5litepod-16",
+    slice_topology="4x4", host_topology="4x4",
+)
+
+
+@pytest.fixture
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-batch-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+    return p
+
+
+def _driver(tmp_path, profile=DENSE16, gates=""):
+    driver = TpuDriver(
+        api=APIServer(), node_name="node-0", tpulib=MockTpuLib(profile),
+        plugin_dir=str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse(gates),
+    )
+    driver.start()
+    return driver
+
+
+class _Boom(Exception):
+    pass
+
+
+# -- write amplification ------------------------------------------------------
+
+def test_batch_prepare_16_claims_two_checkpoint_writes(tmp_path, boot_id,
+                                                       monkeypatch):
+    """The fast CI guard: a 16-claim batch issues <= 2 checkpoint writes
+    (and exactly 2 checkpoint fsyncs — one persisting every PrepareStarted,
+    one persisting every PrepareCompleted)."""
+    driver = _driver(tmp_path)
+    try:
+        import os
+
+        cp_fsyncs = []
+        real_fsync = os.fsync
+
+        def counting_fsync(fd):
+            # os is shared by every module: attribute the fsync to its
+            # target file so CDI spec writes don't pollute the count.
+            try:
+                target = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                target = ""
+            if "checkpoint.json" in target:
+                cp_fsyncs.append(target)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", counting_fsync)
+        mgr = driver.state._store.manager
+        claims = [make_claim([f"tpu-{i}"], name=f"c{i}") for i in range(16)]
+        before = mgr.save_count
+        res = driver.prepare_resource_claims(claims)
+        assert all(not isinstance(r, Exception) for r in res.values())
+        assert len(res) == 16
+        writes = mgr.save_count - before
+        assert writes <= 2, f"batched prepare issued {writes} checkpoint writes"
+        assert len(cp_fsyncs) == 2, \
+            f"expected exactly 2 checkpoint fsyncs, got {len(cp_fsyncs)}"
+
+        # Unprepare of the whole batch: a single write.
+        before = mgr.save_count
+        errs = driver.unprepare_resource_claims([c.uid for c in claims])
+        assert all(e is None for e in errs.values())
+        assert mgr.save_count - before == 1
+    finally:
+        driver.shutdown()
+
+
+def test_batch_all_completed_is_read_only(tmp_path, boot_id):
+    """Re-preparing an already-completed batch returns cached results with
+    ZERO checkpoint writes (idempotency without write amplification)."""
+    driver = _driver(tmp_path)
+    try:
+        claims = [make_claim([f"tpu-{i}"], name=f"c{i}") for i in range(4)]
+        first = driver.prepare_resource_claims(claims)
+        mgr = driver.state._store.manager
+        before = mgr.save_count
+        second = driver.prepare_resource_claims(claims)
+        assert mgr.save_count == before
+        for c in claims:
+            assert first[c.uid].cdi_device_ids == second[c.uid].cdi_device_ids
+    finally:
+        driver.shutdown()
+
+
+# -- batch semantics ----------------------------------------------------------
+
+def test_batch_sibling_overlap_rejected(tmp_path, boot_id):
+    """Two claims in one batch wanting the same chip: first wins, second
+    fails with OverlapError — without poisoning disjoint siblings."""
+    driver = _driver(tmp_path)
+    try:
+        a = make_claim(["tpu-0"], name="a")
+        b = make_claim(["tpu-0"], name="b")
+        c = make_claim(["tpu-1"], name="c")
+        res = driver.prepare_resource_claims([a, b, c])
+        assert not isinstance(res[a.uid], Exception)
+        assert isinstance(res[b.uid], OverlapError)
+        assert "sibling" in str(res[b.uid])
+        assert not isinstance(res[c.uid], Exception)
+        cp = driver.state.prepared_claims()
+        assert cp[a.uid].state == PREPARE_COMPLETED
+        assert b.uid not in cp
+        assert cp[c.uid].state == PREPARE_COMPLETED
+    finally:
+        driver.shutdown()
+
+
+def test_batch_partial_failure_isolated(tmp_path, boot_id):
+    """A claim that fails validation (unknown device) reports its own error;
+    every sibling still prepares, and the checkpoint holds no residue for
+    the failed claim."""
+    driver = _driver(tmp_path)
+    try:
+        good = [make_claim([f"tpu-{i}"], name=f"g{i}") for i in range(3)]
+        bad = make_claim(["tpu-99"], name="bad")
+        res = driver.prepare_resource_claims(good + [bad])
+        assert isinstance(res[bad.uid], PrepareError)
+        for g in good:
+            assert not isinstance(res[g.uid], Exception)
+            assert driver.state.cdi.claim_spec_exists(g.uid)
+        assert bad.uid not in driver.state.prepared_claims()
+        assert not driver.state.cdi.claim_spec_exists(bad.uid)
+    finally:
+        driver.shutdown()
+
+
+def test_batch_metrics_observed(tmp_path, boot_id):
+    """track_batch: requests_total counts claims, prepare_batch_size and
+    prepare_seconds see one observation per call, and per-claim failures
+    land in request_errors_total."""
+    driver = _driver(tmp_path)
+    try:
+        m = driver.metrics
+        claims = [make_claim([f"tpu-{i}"], name=f"c{i}") for i in range(4)]
+        claims.append(make_claim(["tpu-99"], name="bad"))
+        driver.prepare_resource_claims(claims)
+        d = driver.driver_name
+        assert m.requests_total.value(d, "PrepareResourceClaims") == 5
+        assert m.request_errors_total.value(d, "PrepareResourceClaims") == 1
+        assert m.prepare_batch_size.count(d, "PrepareResourceClaims") == 1
+        assert m.prepare_seconds.count(d, "PrepareResourceClaims") == 1
+        assert m.in_flight.value(d) == 0
+    finally:
+        driver.shutdown()
+
+
+# -- crash consistency --------------------------------------------------------
+
+GATES_DYN = "DynamicSubslice=true,ICIPartitioning=true"
+
+# v5e-4 subslice devices on disjoint chip pairs.
+SUBSLICE_A = "tpu-subslice-1x2-at-0x0"
+SUBSLICE_B = "tpu-subslice-1x2-at-1x0"
+
+
+def _shared_stub(monkeypatch):
+    """Route every DeviceState at a single StubPartitionClient, so partition
+    state survives a simulated crash/restart the way the native ledger (or
+    the hardware itself) would."""
+    stub = StubPartitionClient()
+    monkeypatch.setattr(ds_mod, "StubPartitionClient", lambda: stub)
+    return stub
+
+
+def test_crash_between_batch_writes_recovers_all_claims(tmp_path, boot_id,
+                                                        monkeypatch):
+    """Kill the pipeline between the PrepareStarted and PrepareCompleted
+    writes: every claim must be left as a PrepareStarted tombstone on disk,
+    the restarted plugin must free the leaked ICI partitions, and
+    re-preparing must succeed for every claim via the stale-entry path."""
+    stub = _shared_stub(monkeypatch)
+    d1 = _driver(tmp_path, profile="v5e-4", gates=GATES_DYN)
+    claims = [make_claim([SUBSLICE_A], name="a"), make_claim([SUBSLICE_B], name="b")]
+
+    def boom(point):
+        if point == FAULT_PRE_COMPLETED:
+            raise _Boom(point)
+    d1.state.fault_hook = boom
+    res = d1.prepare_resource_claims(claims)
+    assert all(isinstance(r, _Boom) for r in res.values())
+    # The dying process had activated both partitions (hardware state).
+    assert len(stub.active) == 2
+    # On-disk checkpoint: per-claim PrepareStarted tombstones.
+    cp = d1.state._store.get()
+    assert {e.state for e in cp.claims.values()} == {PREPARE_STARTED}
+    assert set(cp.claims) == {c.uid for c in claims}
+    d1.shutdown()
+
+    # Restart: startup reconcile must free the partitions no completed
+    # claim holds, then the stale-entry path re-prepares cleanly.
+    d2 = _driver(tmp_path, profile="v5e-4", gates=GATES_DYN)
+    try:
+        assert stub.active == {}, "leaked ICI partitions after restart"
+        res = d2.prepare_resource_claims(claims)
+        assert all(not isinstance(r, Exception) for r in res.values())
+        cp = d2.state.prepared_claims()
+        assert {e.state for e in cp.values()} == {PREPARE_COMPLETED}
+        # Exactly the two re-prepared partitions are active again.
+        assert len(stub.active) == 2
+    finally:
+        d2.shutdown()
+
+
+def test_crash_right_after_started_write_recovers(tmp_path, boot_id,
+                                                  monkeypatch):
+    """Crash immediately after write #1 (no device touched yet): tombstones
+    on disk, nothing leaked, restart re-prepares."""
+    stub = _shared_stub(monkeypatch)
+    d1 = _driver(tmp_path, profile="v5e-4", gates=GATES_DYN)
+    claim = make_claim([SUBSLICE_A], name="a")
+
+    def boom(point):
+        if point == FAULT_STARTED_PERSISTED:
+            raise _Boom(point)
+    d1.state.fault_hook = boom
+    res = d1.prepare_resource_claims([claim])
+    assert isinstance(res[claim.uid], _Boom)
+    assert stub.active == {}  # crashed before any partition work
+    assert d1.state._store.get().claims[claim.uid].state == PREPARE_STARTED
+    d1.shutdown()
+
+    d2 = _driver(tmp_path, profile="v5e-4", gates=GATES_DYN)
+    try:
+        res = d2.prepare_resource_claims([claim])
+        assert not isinstance(res[claim.uid], Exception)
+        assert d2.state.prepared_claims()[claim.uid].state == PREPARE_COMPLETED
+    finally:
+        d2.shutdown()
+
+
+# -- compute-domain plugin ----------------------------------------------------
+
+def _daemon_claim(api, name, domain_uid, ns="default"):
+    from k8s_dra_driver_tpu.api.configs import COMPUTE_DOMAIN_DRIVER_NAME
+    from k8s_dra_driver_tpu.k8s.core import (
+        AllocationResult,
+        DeviceClaimConfig,
+        DeviceRequestAllocationResult,
+        OpaqueDeviceConfig,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+
+    claim = ResourceClaim(meta=new_meta(name, ns))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[DeviceRequestAllocationResult(
+            request="d", driver=COMPUTE_DOMAIN_DRIVER_NAME,
+            pool="n0", device="daemon",
+        )],
+        node_name="n0",
+    )
+    claim.config = [DeviceClaimConfig(
+        source="claim",
+        opaque=OpaqueDeviceConfig(
+            driver=COMPUTE_DOMAIN_DRIVER_NAME,
+            parameters={
+                "apiVersion": API_VERSION,
+                "kind": "ComputeDomainDaemonConfig",
+                "domain_id": domain_uid,
+            },
+        ),
+    )]
+    return claim
+
+
+def test_cd_batch_prepare_two_checkpoint_writes(tmp_path, boot_id):
+    """The compute-domain plugin runs the same batched pipeline: N daemon
+    claims in one call -> 2 checkpoint writes, batched unprepare -> 1."""
+    from k8s_dra_driver_tpu.k8s.core import Node
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
+
+    api = APIServer()
+    api.create(Node(meta=new_meta("n0")))
+    driver = ComputeDomainDriver(
+        api=api, node_name="n0", tpulib=MockTpuLib("v5e-4"),
+        plugin_dir=str(tmp_path / "cd-plugin"), cdi_root=str(tmp_path / "cdi"),
+    )
+    driver.start()
+    try:
+        claims = [_daemon_claim(api, f"d{i}", f"dom-{i}") for i in range(4)]
+        mgr = driver._store.manager
+        before = mgr.save_count
+        res = driver.prepare_resource_claims(claims)
+        assert all(not isinstance(r, Exception) for r in res.values()), res
+        assert mgr.save_count - before == 2
+        for c in claims:
+            assert driver.cdi.claim_spec_exists(c.uid)
+
+        before = mgr.save_count
+        errs = driver.unprepare_resource_claims([c.uid for c in claims])
+        assert all(e is None for e in errs.values())
+        assert mgr.save_count - before == 1
+        for c in claims:
+            assert not driver.cdi.claim_spec_exists(c.uid)
+    finally:
+        driver.shutdown()

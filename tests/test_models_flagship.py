@@ -288,7 +288,8 @@ def test_longcontext_ring_training_matches_dense(cpu_devices):
     mesh = Mesh(np.array(cpu_devices[:4]), ("sp",))
     params = init_params(cfg, seed=3)
     tokens = jnp.asarray(np.asarray(jax.device_get(batch["tokens"])))
-    with jax.set_mesh(mesh):
+    from k8s_dra_driver_tpu.models.common import mesh_context
+    with mesh_context(mesh):
         got = longcontext.forward(cfg, params, tokens, mesh)
     want = dense_forward(cfg, params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -321,7 +322,8 @@ def test_dp_sp_composition_matches_dense(cpu_devices):
     mesh = Mesh(np.asarray(cpu_devices[:8]).reshape(2, 4), ("data", "sp"))
     params = init_params(cfg, seed=3)
     tokens = jnp.asarray(np.asarray(jax.device_get(batch["tokens"])))
-    with jax.set_mesh(mesh):
+    from k8s_dra_driver_tpu.models.common import mesh_context
+    with mesh_context(mesh):
         got = longcontext.forward(cfg, params, tokens, mesh, batch_axis="data")
     want = dense_forward(cfg, params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
