@@ -2,7 +2,7 @@
 
 include versions.mk
 
-.PHONY: all native test e2e bench bench-smoke ci clean version
+.PHONY: all native test e2e bench bench-smoke ci clean version verify check-metrics-docs test-tier1
 
 version:
 	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
@@ -31,6 +31,17 @@ bench:
 # prepare amortization + a 4-node scheduler storm, hard-capped at 5 min.
 bench-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke
+
+# Pre-merge gate: doc/code consistency checks plus the tier-1 pytest run
+# (the suite ROADMAP.md pins as the regression floor).
+verify: check-metrics-docs test-tier1
+
+check-metrics-docs:
+	python hack/check_metrics_docs.py
+
+test-tier1:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
 
 clean:
 	rm -rf native/build .pytest_cache

@@ -46,6 +46,9 @@ for p in paths:
 print(f"   {len(paths)} files ok")
 EOF
 
+echo "-- metrics documented"
+"${PYTHON:-python}" hack/check_metrics_docs.py
+
 echo "-- VERSION is semver"
 check_version
 

@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     parser.add_argument("command", nargs="?", default="run", choices=("run", "check"))
     parser.add_argument("--workdir", default=os.environ.get("SLICE_AGENT_WORKDIR",
                                                             "/var/run/tpu-slice-agent"))
+    parser.add_argument("--metrics-port", type=int,
+                        default=flagpkg._env_default("METRICS_PORT", 0, int),
+                        help="serve /metrics + /debug/traces (clique assembly "
+                        "spans) on this port; 0 disables [METRICS_PORT]")
     parser.add_argument("--stale-seconds", type=int,
                         default=int(os.environ.get("SLICE_READY_STALE_SECONDS", "10")),
                         help="ready file older than this probes NOT_READY; 0 disables")
@@ -120,6 +124,14 @@ def main(argv=None) -> int:
     log.info("%s registered: index=%d ici=%s",
              version_string("compute-domain-daemon"), agent.index, agent.ici_domain)
 
+    metrics_srv = None
+    if args.metrics_port:
+        from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
+
+        metrics_srv = MetricsServer(Registry(), host="0.0.0.0",
+                                    port=args.metrics_port, debug_path="/debug")
+        metrics_srv.start()
+
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *a: stop.set())
@@ -140,6 +152,8 @@ def main(argv=None) -> int:
     except OSError:
         pass
     agent.shutdown()
+    if metrics_srv:
+        metrics_srv.stop()
     return 0
 
 

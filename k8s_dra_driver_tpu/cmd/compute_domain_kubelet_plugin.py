@@ -92,7 +92,9 @@ def main(argv=None) -> int:
 
     metrics_srv = None
     if args.metrics_port:
-        metrics_srv = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
+        metrics_srv = MetricsServer(registry, host="0.0.0.0",
+                                    port=args.metrics_port,
+                                    debug_path=args.pprof_path)
         metrics_srv.start()
     health_srv = None
     if args.healthcheck_port >= 0:

@@ -36,10 +36,20 @@ from k8s_dra_driver_tpu.k8s.core import (
     NODE,
     RESOURCE_CLAIM_TEMPLATE,
 )
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
-from k8s_dra_driver_tpu.pkg.metrics import ComputeDomainStatusMetric, Registry
+from k8s_dra_driver_tpu.pkg.metrics import (
+    ComputeDomainStatusMetric,
+    Counter,
+    Histogram,
+    Registry,
+)
 from k8s_dra_driver_tpu.pkg.sliceconfig import SliceAgentConfig
-from k8s_dra_driver_tpu.pkg.workqueue import WorkQueue, default_controller_rate_limiter
+from k8s_dra_driver_tpu.pkg.workqueue import (
+    WORKQUEUE_SECONDS_BUCKETS,
+    WorkQueue,
+    default_controller_rate_limiter,
+)
 from k8s_dra_driver_tpu.tpulib.types import topology_chips
 
 log = logging.getLogger(__name__)
@@ -81,9 +91,22 @@ class Controller:
         self.identity = identity
         self.max_nodes_per_domain = max_nodes_per_domain
         self.slice_config = slice_config or SliceAgentConfig()
-        self.metric = ComputeDomainStatusMetric(metrics_registry or Registry())
+        registry = metrics_registry or Registry()
+        self.metric = ComputeDomainStatusMetric(registry)
+        self.reconciles_total = registry.register(Counter(
+            "tpu_dra_reconciles_total",
+            "Reconcile passes, by outcome (success/error).",
+            ("controller", "outcome"),
+        ))
+        self.reconcile_seconds = registry.register(Histogram(
+            "tpu_dra_reconcile_seconds",
+            "Wall time of one reconcile pass.",
+            ("controller",),
+            buckets=WORKQUEUE_SECONDS_BUCKETS,
+        ))
         self._queue = WorkQueue(
-            self._reconcile_key, default_controller_rate_limiter(), name="cd-controller"
+            self._reconcile_key, default_controller_rate_limiter(),
+            name="cd-controller", metrics_registry=registry,
         )
         self._cd_informer = Informer(api, COMPUTE_DOMAIN)
         self._clique_informer = Informer(api, COMPUTE_DOMAIN_CLIQUE)
@@ -103,6 +126,7 @@ class Controller:
                 api, "tpu-dra-compute-domain-controller", identity,
                 on_started_leading=self._start_workers,
                 on_stopped_leading=self._stop_workers,
+                metrics_registry=registry,
             )
         self._cleanup_interval = cleanup_interval_s
         self._stop = threading.Event()
@@ -174,6 +198,22 @@ class Controller:
     # -- reconcile -------------------------------------------------------------
 
     def reconcile(self, cd: ComputeDomain) -> None:
+        """One instrumented reconcile pass: a ``cd.reconcile`` span (the
+        root of the controller half of a claim's lifecycle trace) plus
+        outcome counter + duration histogram. Errors propagate to the
+        workqueue for backoff-retry after being counted."""
+        with self.reconcile_seconds.time("cd-controller"), \
+                tracing.span("cd.reconcile", namespace=cd.namespace,
+                             domain=cd.name, uid=cd.uid) as sp:
+            try:
+                self._reconcile_inner(cd)
+            except Exception:
+                self.reconciles_total.inc("cd-controller", "error")
+                raise
+            self.reconciles_total.inc("cd-controller", "success")
+            sp.attrs["deleting"] = cd.deleting
+
+    def _reconcile_inner(self, cd: ComputeDomain) -> None:
         if cd.deleting:
             self._teardown(cd)
             return

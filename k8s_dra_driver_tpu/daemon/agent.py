@@ -23,6 +23,7 @@ from k8s_dra_driver_tpu.daemon.podmanager import PodManager
 from k8s_dra_driver_tpu.daemon.process import ProcessManager
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 
 log = logging.getLogger(__name__)
@@ -121,17 +122,23 @@ class SliceAgent:
         if self.idle:
             log.info("no ICI domain on this node; idling")
             return
-        self.clique = CliqueManager(
-            self.api, self.namespace, self.domain_uid, self.ici_domain
-        )
-        self.index = self.clique.register(self.node_name, self.pod_ip)
-        if self.gates.enabled("SliceAgentsWithDNSNames"):
-            # The DNS name embeds the index, which only exists post-register.
-            self.clique.register(self.node_name, self.pod_ip, dns_name=self.dns_name)
-        if self.pod_manager is not None:
-            self.pod_manager.add_clique_label(self.ici_domain)
-            self.pod_manager.start()
-        self.sync()
+        with tracing.span("clique.assemble", domain=self.domain_uid,
+                          node=self.node_name, ici_domain=self.ici_domain) as sp:
+            self.clique = CliqueManager(
+                self.api, self.namespace, self.domain_uid, self.ici_domain
+            )
+            with tracing.span("clique.register"):
+                self.index = self.clique.register(self.node_name, self.pod_ip)
+                if self.gates.enabled("SliceAgentsWithDNSNames"):
+                    # The DNS name embeds the index, which only exists
+                    # post-register.
+                    self.clique.register(self.node_name, self.pod_ip,
+                                         dns_name=self.dns_name)
+            sp.attrs["index"] = self.index
+            if self.pod_manager is not None:
+                self.pod_manager.add_clique_label(self.ici_domain)
+                self.pod_manager.start()
+            self.sync()
 
     def _on_pod_ready(self, _ready: bool) -> None:
         """Kubelet probe verdict changed: mirror it into the clique now,
@@ -147,22 +154,27 @@ class SliceAgent:
         readiness. Deterministic for tests; run_forever() loops it."""
         if self.idle or self.clique is None:
             return
-        members = self.clique.members()
-        peers = self._peer_addresses(members)
-        if peers != self._last_peers:
-            self._write_peer_config(members)
-            spawned = self.process.ensure_started()
-            if not spawned:
-                self.process.signal_reload()
-            self._last_peers = peers
-        else:
-            self.process.ensure_started()
-        with self._sync_mu:
-            ready = (
-                self.pod_manager.pod_ready() if self.pod_manager is not None
-                else self.check()
-            )
-            self.clique.set_ready(self.node_name, ready)
+        with tracing.span("clique.sync", domain=self.domain_uid,
+                          node=self.node_name) as sp:
+            members = self.clique.members()
+            peers = self._peer_addresses(members)
+            sp.attrs["peers"] = len(peers)
+            if peers != self._last_peers:
+                sp.attrs["peer_config_rewritten"] = True
+                self._write_peer_config(members)
+                spawned = self.process.ensure_started()
+                if not spawned:
+                    self.process.signal_reload()
+                self._last_peers = peers
+            else:
+                self.process.ensure_started()
+            with self._sync_mu:
+                ready = (
+                    self.pod_manager.pod_ready() if self.pod_manager is not None
+                    else self.check()
+                )
+                sp.attrs["ready"] = ready
+                self.clique.set_ready(self.node_name, ready)
 
     def check(self) -> bool:
         """The readiness probe (`tpu-slice-ctl -q` analog)."""

@@ -55,15 +55,23 @@ class KubeClientFlags(FlagBundle):
 
 
 class _JSONFormatter(logging.Formatter):
-    """One JSON object per line (the component-base logsapi JSON option)."""
+    """One JSON object per line (the component-base logsapi JSON option).
+    Records logged under an active tracing span carry its trace_id /
+    span_id (stamped by TraceContextFilter), so structured logs and
+    /debug/traces spans correlate on one id."""
 
     def format(self, record: logging.LogRecord) -> str:
-        return json.dumps({
+        doc = {
             "ts": self.formatTime(record),
             "lvl": record.levelname,
             "logger": record.name,
             "msg": record.getMessage(),
-        })
+        }
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            doc["trace_id"] = trace_id
+            doc["span_id"] = getattr(record, "span_id", "")
+        return json.dumps(doc)
 
 
 @dataclass
@@ -81,15 +89,17 @@ class LoggingFlags(FlagBundle):
 
     @staticmethod
     def configure(args: argparse.Namespace) -> None:
+        from k8s_dra_driver_tpu.pkg.tracing import TraceContextFilter
+
         level = logging.DEBUG if args.verbosity >= 6 else logging.INFO
+        handler = logging.StreamHandler()
+        handler.addFilter(TraceContextFilter())
         if args.log_json:
-            handler = logging.StreamHandler()
             handler.setFormatter(_JSONFormatter())
-            logging.basicConfig(level=level, handlers=[handler])
         else:
-            logging.basicConfig(
-                level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
-            )
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logging.basicConfig(level=level, handlers=[handler])
 
 
 @dataclass
@@ -184,6 +194,12 @@ class PluginFlags(FlagBundle):
                        default=_env_default("HEALTHCHECK_PORT", -1, int),
                        help="serve /healthz on this port; negative disables "
                             "[HEALTHCHECK_PORT] (reference health.go:52-55)")
+        g.add_argument("--pprof-path",
+                       default=_env_default("PPROF_PATH", "", str),
+                       help="serve thread-stack/runtime-stat debug endpoints "
+                            "under this path on the metrics port (reference "
+                            "--pprof-path); /debug/traces is always served; "
+                            "empty disables stacks/vars [PPROF_PATH]")
 
 
 def build_parser(prog: str, description: str, bundles: Sequence[FlagBundle]) -> argparse.ArgumentParser:

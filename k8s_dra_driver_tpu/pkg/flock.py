@@ -84,9 +84,27 @@ class Flock:
             os.close(fd)
 
     @contextmanager
-    def hold(self, timeout: Optional[float] = None) -> Iterator["Flock"]:
-        self.acquire(timeout=timeout)
+    def hold(self, timeout: Optional[float] = None,
+             trace_name: str = "") -> Iterator["Flock"]:
+        """Acquire-for-scope. With ``trace_name`` set, the wait and the
+        critical section become separate child spans
+        (``<trace_name>.acquire`` / ``<trace_name>.hold``) — the flock
+        wait vs hold split the batched prepare pipeline's telemetry
+        reads (contention shows up in acquire, lock-amortized work in
+        hold)."""
+        if not trace_name:
+            self.acquire(timeout=timeout)
+            try:
+                yield self
+            finally:
+                self.release()
+            return
+        from k8s_dra_driver_tpu.pkg.tracing import span
+
+        with span(f"{trace_name}.acquire", path=self.path):
+            self.acquire(timeout=timeout)
         try:
-            yield self
+            with span(f"{trace_name}.hold", path=self.path):
+                yield self
         finally:
             self.release()

@@ -16,10 +16,29 @@ from typing import Callable, Optional
 
 from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
 from k8s_dra_driver_tpu.k8s.objects import K8sObject, new_meta
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
 
 log = logging.getLogger(__name__)
 
 LEASE = "Lease"
+
+
+class LeaderElectionMetrics:
+    """Transition counters + a held gauge, one series per lease, so an
+    operator can see failover churn (clock slip, API partitions) that a
+    point-in-time `is_leader` probe would hide."""
+
+    def __init__(self, registry: Registry):
+        self.transitions_total = registry.register(Counter(
+            "tpu_dra_leader_election_transitions_total",
+            "Leadership transitions, by direction (acquired/lost).",
+            ("lease", "transition"),
+        ))
+        self.is_leader = registry.register(Gauge(
+            "tpu_dra_leader_is_leader",
+            "1 while this replica holds the lease.",
+            ("lease",),
+        ))
 
 
 @dataclass
@@ -42,9 +61,11 @@ class LeaderElector:
         retry_period_s: float = 2.0,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
+        metrics_registry: Optional[Registry] = None,
     ):
         self.api = api
         self.lease_name = lease_name
+        self.metrics = LeaderElectionMetrics(metrics_registry or Registry())
         self.namespace = namespace
         self.identity = identity
         self.lease_duration_s = lease_duration_s
@@ -108,6 +129,8 @@ class LeaderElector:
         if self._leading.is_set():
             self._leading.clear()
             self.release()
+            self.metrics.transitions_total.inc(self.lease_name, "lost")
+            self.metrics.is_leader.set(self.lease_name, value=0.0)
             if self.on_stopped_leading:
                 self.on_stopped_leading()
 
@@ -117,6 +140,8 @@ class LeaderElector:
             got = self.try_acquire_or_renew()
             if got and not self._leading.is_set():
                 self._leading.set()
+                self.metrics.transitions_total.inc(self.lease_name, "acquired")
+                self.metrics.is_leader.set(self.lease_name, value=1.0)
                 log.info("%s became leader of %s", self.identity, self.lease_name)
                 if self.on_started_leading:
                     self.on_started_leading()
@@ -124,6 +149,8 @@ class LeaderElector:
                 # Lost the lease (e.g. clock slip / partition): crash-only
                 # controllers exit here; we flag and call back.
                 self._leading.clear()
+                self.metrics.transitions_total.inc(self.lease_name, "lost")
+                self.metrics.is_leader.set(self.lease_name, value=0.0)
                 log.warning("%s lost leadership of %s", self.identity, self.lease_name)
                 if self.on_stopped_leading:
                     self.on_stopped_leading()

@@ -29,8 +29,25 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 LabelValues = Tuple[str, ...]
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the scrape line is invalid
+    (ComputeDomain names and error strings can carry any of them)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping per the exposition format: backslash + newline."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(names: Sequence[str], values: LabelValues, extra: str = "") -> str:
-    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    pairs = [f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)]
     if extra:
         pairs.append(extra)
     return ("{" + ",".join(pairs) + "}") if pairs else ""
@@ -65,7 +82,8 @@ class Counter(_Metric):
             return self._values.get(labels, 0.0)
 
     def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.kind}"]
         with self._mu:
             for labels, v in sorted(self._values.items()):
                 out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
@@ -149,7 +167,8 @@ class Histogram(_Metric):
             return self._totals.get(labels, 0)
 
     def collect(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.kind}"]
         with self._mu:
             for labels in sorted(self._counts):
                 cum = 0
@@ -366,39 +385,89 @@ class MetricsServer:
     With ``debug_path`` set (the reference controller's --pprof-path,
     /root/reference/cmd/compute-domain-controller/main.go:423-431), also
     serves ``<debug_path>/stacks`` (live thread stacks) and
-    ``<debug_path>/vars`` (process runtime stats)."""
+    ``<debug_path>/vars`` (process runtime stats). The tracer's span ring
+    buffer is always exported as Chrome trace-event JSON at
+    ``<debug_path or /debug>/traces`` — loadable in Perfetto /
+    chrome://tracing, and what the sim ``trace`` command consumes.
+
+    HTTP semantics: GET and HEAD are served; any other method gets 405
+    with an Allow header (scanners and misconfigured scrapers must not
+    hang or 500). ``/metrics`` and the debug endpoints are point-in-time
+    reads, so every response carries ``Cache-Control: no-store``."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0,
-                 debug_path: str = ""):
+                 debug_path: str = "", tracer=None):
         registry_ref = registry
+        if tracer is None:
+            from k8s_dra_driver_tpu.pkg.tracing import get_tracer
+            tracer = get_tracer()
+        tracer_ref = tracer
         # Normalize: "debug" and "/debug/" both mean "/debug"; "/" serves
-        # the endpoints at the root. Empty disables.
+        # the endpoints at the root. Empty disables stacks/vars (the
+        # traces endpoint stays on, under /debug).
         debug_enabled = bool(debug_path.strip())
         debug = "/" + debug_path.strip().strip("/") if debug_enabled else ""
         if debug == "/":
             debug = ""
+        traces_path = f"{debug}/traces" if debug_enabled else "/debug/traces"
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self) -> None:  # noqa: N802 — http.server API
-                path = self.path.rstrip("/")
+            def _resolve(self):
+                """(body, content-type) for this path, or None for 404."""
+                path = self.path.split("?", 1)[0].rstrip("/")
                 if debug_enabled and path == f"{debug}/stacks":
-                    self._reply(_debug_stacks_text(), "text/plain")
-                    return
+                    return _debug_stacks_text(), "text/plain"
                 if debug_enabled and path == f"{debug}/vars":
-                    self._reply(_debug_vars_json(), "application/json")
-                    return
-                if path not in ("", "/metrics"):
+                    return _debug_vars_json(), "application/json"
+                # /debug/traces stays valid even under a custom --pprof-path
+                # prefix: the docs and the sim `trace --url` client promise
+                # that URL unconditionally.
+                if path in (traces_path, "/debug/traces"):
+                    return tracer_ref.export_chrome_json(), "application/json"
+                if path in ("", "/metrics"):
+                    return (registry_ref.expose().encode(),
+                            "text/plain; version=0.0.4")
+                return None
+
+            def _serve(self, include_body: bool) -> None:
+                resolved = self._resolve()
+                if resolved is None:
                     self.send_error(404)
                     return
-                self._reply(registry_ref.expose().encode(),
-                            "text/plain; version=0.0.4")
-
-            def _reply(self, body: bytes, ctype: str) -> None:
+                body, ctype = resolved
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                self.send_header("Cache-Control", "no-store")
+                self.end_headers()
+                if include_body:
+                    self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                self._serve(include_body=True)
+
+            def do_HEAD(self) -> None:  # noqa: N802 — http.server API
+                self._serve(include_body=False)
+
+            def _method_not_allowed(self) -> None:
+                # Drain any request body so the connection stays sane,
+                # then answer 405 instead of http.server's default 501.
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                if length:
+                    self.rfile.read(length)
+                body = b"405 Method Not Allowed\n"
+                self.send_response(405)
+                self.send_header("Allow", "GET, HEAD")
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            do_POST = _method_not_allowed  # noqa: N815 — http.server API
+            do_PUT = _method_not_allowed  # noqa: N815
+            do_DELETE = _method_not_allowed  # noqa: N815
+            do_PATCH = _method_not_allowed  # noqa: N815
+            do_OPTIONS = _method_not_allowed  # noqa: N815
 
             def log_message(self, *args: object) -> None:
                 pass

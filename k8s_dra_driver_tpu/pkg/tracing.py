@@ -1,0 +1,315 @@
+"""Dependency-free claim-lifecycle tracing.
+
+Spans carry a trace id / span id pair and nest through a thread-local
+context stack, so one NodePrepareResources batch (or one controller
+reconcile, one scheduler pass, one clique sync) becomes a tree the
+operator can read as a timeline. Finished spans land in a bounded
+in-memory ring buffer and are exported as Chrome trace-event JSON —
+loadable in Perfetto / chrome://tracing — via ``MetricsServer``'s
+``/debug/traces`` endpoint and the ``python -m k8s_dra_driver_tpu.sim
+trace <claim-uid>`` timeline command.
+
+Design constraints, in the spirit of the rest of ``pkg/``:
+
+- stdlib only (the kubelet plugin images carry no OTel SDK);
+- always on: recording a span is two monotonic reads, a dict, and one
+  deque append under a lock — cheap enough for the prepare hot path the
+  PR 1 batching work created (flock hold, checkpoint fsync, CDI fan-out);
+- bounded: the ring buffer drops the oldest trace data instead of
+  growing, like the reference's pprof ring buffers;
+- explicit cross-thread propagation: thread-local context does not leak
+  into worker pools; callers capture ``current()`` and pass it as
+  ``parent=`` (the batched CDI materialization fan-out does exactly
+  this).
+
+Log correlation: ``TraceContextFilter`` stamps ``trace_id``/``span_id``
+onto every LogRecord emitted under an active span, and the JSON log
+formatter (pkg/flags) includes them, so a log line and its span join on
+one id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import logging
+
+# Default ring capacity: at ~300 bytes/span this is a few MiB ceiling —
+# roughly the last several thousand prepare batches worth of spans.
+DEFAULT_CAPACITY = 8192
+
+# Attribute keys the claim-lifecycle timeline joins on: a span is "about"
+# a claim when claim_uid equals it or claim_uids contains it.
+ATTR_CLAIM_UID = "claim_uid"
+ATTR_CLAIM_UIDS = "claim_uids"
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagatable half of a span: what a child (possibly on another
+    thread) needs to attach itself to the tree."""
+
+    trace_id: str
+    span_id: str
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start_time: float = 0.0       # wall clock, seconds since epoch
+    duration: float = 0.0         # seconds
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"            # ok | error
+    error: str = ""
+    thread: str = ""
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def about_claim(self, claim_uid: str) -> bool:
+        if self.attrs.get(ATTR_CLAIM_UID) == claim_uid:
+            return True
+        uids = self.attrs.get(ATTR_CLAIM_UIDS)
+        return bool(uids) and claim_uid in uids
+
+    def to_chrome_event(self) -> Dict[str, Any]:
+        """One complete ("ph": "X") Chrome trace event; ts/dur in µs."""
+        args = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            args["parent_id"] = self.parent_id
+        if self.status != "ok":
+            args["status"] = self.status
+            args["error"] = self.error
+        args.update(self.attrs)
+        return {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.start_time * 1e6,
+            "dur": self.duration * 1e6,
+            "pid": os.getpid(),
+            "tid": self.thread,
+            "cat": "tpu-dra",
+            "args": args,
+        }
+
+
+class Tracer:
+    """Span factory + bounded in-memory exporter."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._spans: List[Span] = []
+        self._local = threading.local()
+
+    # -- context -------------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current(self) -> Optional[SpanContext]:
+        """This thread's active span context, or None outside any span."""
+        stack = self._stack()
+        return stack[-1].context if stack else None
+
+    # -- recording -----------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[SpanContext] = None,
+             **attrs: Any) -> Iterator[Span]:
+        """Open a span. Nesting is automatic within a thread; pass
+        ``parent=`` (a ``SpanContext`` captured with ``current()``) to
+        attach work running on another thread to the same trace."""
+        ctx = parent if parent is not None else self.current()
+        sp = Span(
+            name=name,
+            trace_id=ctx.trace_id if ctx else _new_id(8),
+            span_id=_new_id(4),
+            parent_id=ctx.span_id if ctx else "",
+            start_time=time.time(),
+            attrs=dict(attrs),
+            thread=threading.current_thread().name,
+        )
+        stack = self._stack()
+        stack.append(sp)
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        except BaseException as e:
+            sp.status = "error"
+            sp.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            sp.duration = time.perf_counter() - t0
+            stack.pop()
+            with self._mu:
+                self._spans.append(sp)
+                if len(self._spans) > self.capacity:
+                    # Amortized trim: drop the oldest tenth in one slice
+                    # instead of popping per append.
+                    del self._spans[: max(1, self.capacity // 10)]
+
+    # -- reads ---------------------------------------------------------------
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        with self._mu:
+            snap = list(self._spans)
+        if trace_id is None:
+            return snap
+        return [s for s in snap if s.trace_id == trace_id]
+
+    def traces_for_claim(self, claim_uid: str) -> List[Span]:
+        """Every span of every trace that touched ``claim_uid`` — the
+        whole tree, not just the tagged spans, so the timeline shows the
+        flock/fsync/CDI children around the tagged batch span."""
+        snap = self.spans()
+        trace_ids = {s.trace_id for s in snap if s.about_claim(claim_uid)}
+        return [s for s in snap if s.trace_id in trace_ids]
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+
+    # -- export --------------------------------------------------------------
+
+    def export_chrome(self, spans: Optional[List[Span]] = None) -> Dict[str, Any]:
+        """The Chrome trace-event JSON document (object form, complete
+        events) Perfetto and chrome://tracing both load."""
+        if spans is None:
+            spans = self.spans()
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": [s.to_chrome_event() for s in spans],
+        }
+
+    def export_chrome_json(self, spans: Optional[List[Span]] = None) -> bytes:
+        return json.dumps(self.export_chrome(spans)).encode()
+
+
+# -- module-level default tracer ---------------------------------------------
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default_tracer
+
+
+def current() -> Optional[SpanContext]:
+    return _default_tracer.current()
+
+
+def span(name: str, parent: Optional[SpanContext] = None, **attrs: Any):
+    """Open a span on the process-default tracer (the common case: every
+    component in one binary shares one ring buffer, like one /metrics
+    registry)."""
+    return _default_tracer.span(name, parent=parent, **attrs)
+
+
+# -- log correlation ----------------------------------------------------------
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps trace_id/span_id from the active span onto LogRecords, so
+    structured log lines and trace spans correlate on one id. Outside a
+    span both fields are empty strings (never missing — formatters can
+    reference them unconditionally)."""
+
+    def __init__(self, tracer: Optional[Tracer] = None):
+        super().__init__()
+        self._tracer = tracer or _default_tracer
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = self._tracer.current()
+        record.trace_id = ctx.trace_id if ctx else ""
+        record.span_id = ctx.span_id if ctx else ""
+        return True
+
+
+# -- timeline rendering (sim `trace` command, debug dumps) --------------------
+
+
+def render_timeline(spans: List[Span]) -> str:
+    """ASCII timeline of one or more traces: spans sorted by start time,
+    indented by parent depth, with offsets relative to each trace's root."""
+    if not spans:
+        return "(no spans)"
+    out: List[str] = []
+    by_trace: Dict[str, List[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    for trace_id in sorted(by_trace, key=lambda t: min(s.start_time for s in by_trace[t])):
+        group = sorted(by_trace[trace_id], key=lambda s: (s.start_time, s.span_id))
+        t0 = group[0].start_time
+        total_ms = max((s.start_time - t0) * 1e3 + s.duration * 1e3 for s in group)
+        out.append(f"trace {trace_id} ({len(group)} spans, {total_ms:.3f}ms)")
+        parents = {s.span_id: s.parent_id for s in group}
+
+        def depth(s: Span) -> int:
+            d, pid, seen = 0, s.parent_id, set()
+            while pid and pid in parents and pid not in seen:
+                seen.add(pid)
+                d += 1
+                pid = parents[pid]
+            return d
+
+        for s in group:
+            off_ms = (s.start_time - t0) * 1e3
+            attrs = " ".join(
+                f"{k}={v}" for k, v in sorted(s.attrs.items())
+                if k != ATTR_CLAIM_UIDS
+            )
+            uids = s.attrs.get(ATTR_CLAIM_UIDS)
+            if uids:
+                attrs = (attrs + f" claims={len(uids)}").strip()
+            err = f" ERROR({s.error})" if s.status != "ok" else ""
+            out.append(
+                f"  {off_ms:9.3f}ms {'  ' * depth(s)}- {s.name} "
+                f"({s.duration * 1e3:.3f}ms){(' ' + attrs) if attrs else ''}{err}"
+            )
+    return "\n".join(out)
+
+
+def spans_from_chrome(doc: Dict[str, Any]) -> List[Span]:
+    """Rebuild Span objects from an exported Chrome trace document (the
+    sim `trace` command consumes dumps fetched from /debug/traces)."""
+    spans: List[Span] = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        trace_id = args.pop("trace_id", "")
+        span_id = args.pop("span_id", "")
+        parent_id = args.pop("parent_id", "")
+        status = args.pop("status", "ok")
+        error = args.pop("error", "")
+        spans.append(Span(
+            name=ev.get("name", ""),
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_id=parent_id,
+            start_time=float(ev.get("ts", 0.0)) / 1e6,
+            duration=float(ev.get("dur", 0.0)) / 1e6,
+            attrs=args,
+            status=status,
+            error=error,
+            thread=str(ev.get("tid", "")),
+        ))
+    return spans

@@ -20,7 +20,50 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Hashable, Optional
 
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
+
 log = logging.getLogger(__name__)
+
+# Queue-latency / work-duration envelope: 1ms * 4^k, k=0..8 (1ms .. ~65s)
+# — reconcile handlers are far faster than DRA prepares, so the
+# request-duration envelope (50ms floor) would flatten everything into
+# the first bucket.
+WORKQUEUE_SECONDS_BUCKETS = tuple(0.001 * (4**k) for k in range(9))
+
+
+class WorkQueueMetrics:
+    """The k8s workqueue metric surface (depth / adds / retries /
+    queue-latency / work-duration), on the shared ``tpu_dra_*``
+    registry, one series per queue name."""
+
+    def __init__(self, registry: Registry):
+        self.depth = registry.register(Gauge(
+            "tpu_dra_workqueue_depth",
+            "Items waiting in the queue (not yet picked up by a worker).",
+            ("queue",),
+        ))
+        self.adds_total = registry.register(Counter(
+            "tpu_dra_workqueue_adds_total",
+            "Items accepted by the queue (dedup'd enqueues don't count).",
+            ("queue",),
+        ))
+        self.retries_total = registry.register(Counter(
+            "tpu_dra_workqueue_retries_total",
+            "Handler failures re-queued with backoff.",
+            ("queue",),
+        ))
+        self.queue_latency = registry.register(Histogram(
+            "tpu_dra_workqueue_queue_latency_seconds",
+            "Time from enqueue (incl. backoff delay) to worker pickup.",
+            ("queue",),
+            buckets=WORKQUEUE_SECONDS_BUCKETS,
+        ))
+        self.work_seconds = registry.register(Histogram(
+            "tpu_dra_workqueue_work_seconds",
+            "Handler execution time per item.",
+            ("queue",),
+            buckets=WORKQUEUE_SECONDS_BUCKETS,
+        ))
 
 
 class RateLimiter:
@@ -90,6 +133,7 @@ class _Scheduled:
     ready_at: float
     seq: int
     key: Hashable = field(compare=False)
+    enq_at: float = field(compare=False, default=0.0)
 
 
 class WorkQueue:
@@ -107,11 +151,15 @@ class WorkQueue:
         rate_limiter: Optional[RateLimiter] = None,
         name: str = "workqueue",
         max_retries: Optional[int] = None,
+        metrics_registry: Optional[Registry] = None,
     ):
         self._handler = handler
         self._rl = rate_limiter or default_controller_rate_limiter()
         self.name = name
         self._max_retries = max_retries
+        # A private registry when none is shared: instrumentation code
+        # stays unconditional, series just aren't scraped anywhere.
+        self.metrics = WorkQueueMetrics(metrics_registry or Registry())
         self._mu = threading.Condition()
         self._heap: list[_Scheduled] = []
         self._seq = 0
@@ -138,7 +186,11 @@ class WorkQueue:
 
     def _push_locked(self, key: Hashable, delay: float) -> None:
         self._seq += 1
-        heapq.heappush(self._heap, _Scheduled(time.monotonic() + delay, self._seq, key))
+        now = time.monotonic()
+        heapq.heappush(self._heap, _Scheduled(now + delay, self._seq, key,
+                                              enq_at=now))
+        self.metrics.adds_total.inc(self.name)
+        self.metrics.depth.set(self.name, value=float(len(self._queued)))
         self._mu.notify_all()
 
     def start(self, workers: int = 1) -> None:
@@ -181,6 +233,10 @@ class WorkQueue:
                         heapq.heappop(self._heap)
                         self._queued.discard(item.key)
                         self._processing.add(item.key)
+                        self.metrics.depth.set(
+                            self.name, value=float(len(self._queued)))
+                        self.metrics.queue_latency.observe(
+                            self.name, value=now - item.enq_at)
                         return item.key
                     self._mu.wait(timeout=min(item.ready_at - now, 0.5))
                 else:
@@ -194,7 +250,8 @@ class WorkQueue:
                 return
             obj = self._latest.get(key)
             try:
-                self._handler(key, obj)
+                with self.metrics.work_seconds.time(self.name):
+                    self._handler(key, obj)
             except Exception:  # noqa: BLE001 — reconcile errors retry by design
                 log.exception("%s: handler failed for %r", self.name, key)
                 self._finish(key, failed=True)
@@ -220,6 +277,7 @@ class WorkQueue:
                 else:
                     self._dirty.discard(key)
                     self._queued.add(key)
+                    self.metrics.retries_total.inc(self.name)
                     self._push_locked(key, self._rl.when(key))
             else:
                 self._rl.forget(key)

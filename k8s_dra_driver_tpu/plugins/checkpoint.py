@@ -154,7 +154,7 @@ class CheckpointStore:
         session. The lock is released even if the caller raises mid-batch
         (crash injection leaves per-claim PrepareStarted tombstones behind,
         recovered by the stale-entry path on restart)."""
-        with self._lock.hold(timeout=timeout):
+        with self._lock.hold(timeout=timeout, trace_name="cp_flock"):
             cp = self._mgr.load()
             assert cp is not None, "checkpoint disappeared"
             yield CheckpointSession(self._mgr, cp)
@@ -223,6 +223,8 @@ class CheckpointManager:
         return _from_payload(payload)
 
     def save(self, cp: Checkpoint) -> None:
+        from k8s_dra_driver_tpu.pkg.tracing import span
+
         payload = _to_payload(cp)
         doc = {
             "version": LATEST_VERSION,
@@ -231,11 +233,14 @@ class CheckpointManager:
         }
         tmp = f"{self.path}.tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        # One span per fsync'd write: the batched pipeline's exactly-two
+        # checkpoint writes are individually visible in the batch trace.
+        with span("checkpoint.save", path=self.path, claims=len(cp.claims)):
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
         self.save_count += 1
 
     def delete(self) -> None:

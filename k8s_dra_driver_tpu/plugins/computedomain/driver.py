@@ -41,6 +41,7 @@ from k8s_dra_driver_tpu.k8s.core import (
 from k8s_dra_driver_tpu.k8s.objects import new_meta
 from k8s_dra_driver_tpu.pkg import devcaps
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
 from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
@@ -195,14 +196,20 @@ class ComputeDomainDriver:
         if not claims:
             return {}
         out: Dict[str, object] = {}
-        with self.metrics.track_batch("PrepareResourceClaims", len(claims)):
+        with self.metrics.track_batch("PrepareResourceClaims", len(claims)), \
+                tracing.span(
+                    "dra.prepare_batch", driver=self.driver_name,
+                    batch_size=len(claims),
+                    claim_uids=[c.uid for c in claims]) as sp:
             try:
-                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                        trace_name="pu_flock"):
                     out = self._prepare_batch(claims)
             except Exception as e:  # noqa: BLE001 — whole-batch failure
                 log.warning("cd prepare batch of %d failed: %s", len(claims), e)
                 out = {c.uid: e for c in claims}
-        failed = sum(1 for r in out.values() if isinstance(r, Exception))
+            failed = sum(1 for r in out.values() if isinstance(r, Exception))
+            sp.attrs["failed_claims"] = failed
         self.metrics.record_claim_errors("PrepareResourceClaims", failed)
         for claim in claims:
             r = out.get(claim.uid)
@@ -214,15 +221,21 @@ class ComputeDomainDriver:
         if not claim_uids:
             return {}
         out: Dict[str, Optional[Exception]] = {}
-        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)):
+        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)), \
+                tracing.span(
+                    "dra.unprepare_batch", driver=self.driver_name,
+                    batch_size=len(claim_uids),
+                    claim_uids=list(claim_uids)) as sp:
             try:
-                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                        trace_name="pu_flock"):
                     out = self._unprepare_batch(claim_uids)
             except Exception as e:  # noqa: BLE001 — whole-batch failure
                 log.warning("cd unprepare batch of %d failed: %s",
                             len(claim_uids), e)
                 out = {uid: e for uid in claim_uids}
-        failed = sum(1 for r in out.values() if r is not None)
+            failed = sum(1 for r in out.values() if r is not None)
+            sp.attrs["failed_claims"] = failed
         self.metrics.record_claim_errors("UnprepareResourceClaims", failed)
         return out
 
@@ -347,13 +360,18 @@ class ComputeDomainDriver:
                     staged.append((claim, edits, prepared))
 
                 # Fan the CDI spec writes out between the two checkpoint
-                # writes (independent fsync'd files).
+                # writes (independent fsync'd files). Capture the batch span
+                # context: pool threads carry no thread-local context.
+                batch_ctx = tracing.current()
+
                 def materialize(item) -> List[str]:
                     claim, edits, prepared = item
-                    ids = self.cdi.create_claim_spec_file(claim.uid, edits)
-                    for d in prepared:
-                        d.cdi_device_ids = list(ids)
-                    return ids
+                    with tracing.span("cdi.materialize", parent=batch_ctx,
+                                      claim_uid=claim.uid):
+                        ids = self.cdi.create_claim_spec_file(claim.uid, edits)
+                        for d in prepared:
+                            d.cdi_device_ids = list(ids)
+                        return ids
 
                 results: Dict[str, object] = {}
                 if len(staged) == 1:

@@ -54,6 +54,7 @@ from k8s_dra_driver_tpu.api.configs import (
 from k8s_dra_driver_tpu.cdi import CDIHandler, ContainerEdits
 from k8s_dra_driver_tpu.k8s.core import ResourceClaim
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
 from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.plugins.checkpoint import (
@@ -304,17 +305,24 @@ class DeviceState:
                 # Materialize per-claim CDI specs concurrently between the
                 # two checkpoint writes (each spec is an independent fsync'd
                 # file; edits are computed from now-quiescent device state).
+                # Pool threads have no thread-local span context, so the
+                # batch context is captured here and attached explicitly —
+                # every per-claim CDI write is a child of the batch span.
+                batch_ctx = tracing.current()
+
                 def materialize(claim: ResourceClaim) -> List[PreparedDevice]:
-                    prepared = prepared_by_uid[claim.uid]
-                    per_dev = {d.name: self._edits_for(d) for d in prepared}
-                    ids = self.cdi.create_claim_spec_file(
-                        claim.uid, per_dev,
-                        common_edits=self._common_edits(prepared),
-                    )
-                    id_by_name = dict(zip(sorted(per_dev), ids))
-                    for d in prepared:
-                        d.cdi_device_ids = [id_by_name[d.name]]
-                    return prepared
+                    with tracing.span("cdi.materialize", parent=batch_ctx,
+                                      claim_uid=claim.uid):
+                        prepared = prepared_by_uid[claim.uid]
+                        per_dev = {d.name: self._edits_for(d) for d in prepared}
+                        ids = self.cdi.create_claim_spec_file(
+                            claim.uid, per_dev,
+                            common_edits=self._common_edits(prepared),
+                        )
+                        id_by_name = dict(zip(sorted(per_dev), ids))
+                        for d in prepared:
+                            d.cdi_device_ids = [id_by_name[d.name]]
+                        return prepared
 
                 results: Dict[str, "List[PreparedDevice] | Exception"] = {}
                 if len(survivors) == 1:

@@ -20,6 +20,7 @@ from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
 from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, ResourceClaim
 from k8s_dra_driver_tpu.k8s.core import DeviceTaint
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
 from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState, PrepareResult
@@ -161,16 +162,22 @@ class TpuDriver:
         if not claims:
             return {}
         out: Dict[str, PrepareResult | Exception] = {}
-        with self.metrics.track_batch("PrepareResourceClaims", len(claims)):
+        with self.metrics.track_batch("PrepareResourceClaims", len(claims)), \
+                tracing.span(
+                    "dra.prepare_batch", driver=self.driver_name,
+                    batch_size=len(claims),
+                    claim_uids=[c.uid for c in claims]) as sp:
             try:
-                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                        trace_name="pu_flock"):
                     out = self.state.prepare_batch(claims)
             except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
                 # Whole-batch failure (lock timeout, checkpoint corruption):
                 # every claim reports it.
                 log.warning("prepare batch of %d failed: %s", len(claims), e)
                 out = {c.uid: e for c in claims}
-        failed = sum(1 for r in out.values() if isinstance(r, Exception))
+            failed = sum(1 for r in out.values() if isinstance(r, Exception))
+            sp.attrs["failed_claims"] = failed
         self.metrics.record_claim_errors("PrepareResourceClaims", failed)
         for claim in claims:
             r = out.get(claim.uid)
@@ -182,14 +189,20 @@ class TpuDriver:
         if not claim_uids:
             return {}
         out: Dict[str, Optional[Exception]] = {}
-        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)):
+        with self.metrics.track_batch("UnprepareResourceClaims", len(claim_uids)), \
+                tracing.span(
+                    "dra.unprepare_batch", driver=self.driver_name,
+                    batch_size=len(claim_uids),
+                    claim_uids=list(claim_uids)) as sp:
             try:
-                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+                with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                        trace_name="pu_flock"):
                     out = self.state.unprepare_batch(claim_uids)
             except (Exception, FlockTimeoutError) as e:  # noqa: BLE001
                 log.warning("unprepare batch of %d failed: %s", len(claim_uids), e)
                 out = {uid: e for uid in claim_uids}
-        failed = sum(1 for r in out.values() if r is not None)
+            failed = sum(1 for r in out.values() if r is not None)
+            sp.attrs["failed_claims"] = failed
         self.metrics.record_claim_errors("UnprepareResourceClaims", failed)
         for uid, err in out.items():
             if err is not None:
@@ -213,7 +226,10 @@ class TpuDriver:
             return 0
         cleaned = 0
         try:
-            with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+            with tracing.span("dra.stale_cleanup", driver=self.driver_name,
+                              claim_uids=list(stale)), \
+                    self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                       trace_name="pu_flock"):
                 errs = self.state.unprepare_batch(stale)
         except Exception:  # noqa: BLE001
             log.exception("stale cleanup batch of %d failed", len(stale))

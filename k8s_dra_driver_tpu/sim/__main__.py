@@ -10,23 +10,39 @@ hardware, the way the reference's mock-NVML kind cluster backs its CI
     python -m k8s_dra_driver_tpu.sim --port 8001 --profile v5e-16
 
 Prints `cluster up at <url>` when serving; steps the control loops every
---tick seconds until SIGTERM/SIGINT.
+--tick seconds until SIGTERM/SIGINT. With --metrics-port set, the shared
+cluster registry and the trace ring buffer are served on that port
+(/metrics, /debug/traces, /debug/stacks, /debug/vars).
+
+Subcommand ``trace`` renders the claim-lifecycle timeline for one claim
+from a trace dump (a file saved from /debug/traces, or fetched live):
+
+    python -m k8s_dra_driver_tpu.sim trace <claim-uid> --url http://127.0.0.1:9090
+    python -m k8s_dra_driver_tpu.sim trace <claim-uid> --input traces.json
+    python -m k8s_dra_driver_tpu.sim trace <claim-uid> --input traces.json --format chrome > claim.json
+
+The ``--format chrome`` output is the filtered Chrome trace-event JSON,
+loadable in Perfetto / chrome://tracing.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import signal
 import sys
 import tempfile
 import threading
+import urllib.request
 
 from k8s_dra_driver_tpu.k8s.httpapi import serve_api
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.metrics import MetricsServer
 from k8s_dra_driver_tpu.sim.cluster import SimCluster
 
 
-def main(argv=None) -> int:
+def run_main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         "tpu-dra-simcluster", description="simulated TPU cluster over HTTP"
     )
@@ -41,6 +57,9 @@ def main(argv=None) -> int:
                         help="plugin/CDI state dir (default: temp dir)")
     parser.add_argument("--tick", type=float, default=0.2,
                         help="control-loop step interval seconds")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="serve the cluster-wide /metrics + /debug/traces "
+                        "here; 0 disables")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -52,8 +71,16 @@ def main(argv=None) -> int:
         gates=args.gates, api=srv.api,
     )
     sim.start()
+    metrics_srv = None
+    if args.metrics_port:
+        metrics_srv = MetricsServer(sim.metrics_registry, host=args.host,
+                                    port=args.metrics_port, debug_path="/debug")
+        metrics_srv.start()
     print(f"cluster up at {srv.url} "
-          f"({len(sim.nodes)} nodes, profile {args.profile})", flush=True)
+          f"({len(sim.nodes)} nodes, profile {args.profile})"
+          + (f"; metrics at http://{args.host}:{metrics_srv.port}"
+             if metrics_srv else ""),
+          flush=True)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -64,8 +91,71 @@ def main(argv=None) -> int:
         except Exception:  # noqa: BLE001 — a bad pass must not kill the cluster
             logging.exception("sim step failed")
     sim.stop()
+    if metrics_srv:
+        metrics_srv.stop()
     srv.stop()
     return 0
+
+
+def trace_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "tpu-dra-simcluster trace",
+        description="render the claim-lifecycle timeline for one claim "
+        "from a /debug/traces dump",
+    )
+    parser.add_argument("claim_uid", help="ResourceClaim uid to trace")
+    parser.add_argument("--input", default="",
+                        help="Chrome trace-event JSON file (saved from "
+                        "/debug/traces); mutually exclusive with --url")
+    parser.add_argument("--url", default="",
+                        help="base URL of a running MetricsServer (its "
+                        "/debug/traces is fetched), e.g. http://127.0.0.1:9090")
+    parser.add_argument("--format", choices=("timeline", "chrome"),
+                        default="timeline",
+                        help="timeline: human-readable; chrome: filtered "
+                        "trace-event JSON for Perfetto/chrome://tracing")
+    args = parser.parse_args(argv)
+    if bool(args.input) == bool(args.url):
+        parser.error("exactly one of --input or --url is required")
+
+    if args.input:
+        with open(args.input, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    else:
+        url = args.url.rstrip("/")
+        if not url.endswith("/traces"):
+            url += "/debug/traces"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.load(resp)
+
+    spans = tracing.spans_from_chrome(doc)
+    tagged = [s for s in spans if s.about_claim(args.claim_uid)]
+    trace_ids = {s.trace_id for s in tagged}
+    selected = [s for s in spans if s.trace_id in trace_ids]
+    if not selected:
+        print(f"no spans reference claim {args.claim_uid}", file=sys.stderr)
+        return 1
+    if args.format == "chrome":
+        print(json.dumps({
+            "displayTimeUnit": "ms",
+            "traceEvents": [s.to_chrome_event() for s in selected],
+        }))
+    else:
+        print(f"claim {args.claim_uid}: {len(trace_ids)} trace(s), "
+              f"{len(selected)} span(s)")
+        print(tracing.render_timeline(selected))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Subcommand dispatch that keeps the historical flag-only invocation
+    # (`python -m k8s_dra_driver_tpu.sim --port ...`) working unchanged.
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
+    if argv and argv[0] == "run":
+        argv = argv[1:]
+    return run_main(argv)
 
 
 if __name__ == "__main__":

@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import logging
 import re
+import time
 from collections import defaultdict
 from types import SimpleNamespace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
+from k8s_dra_driver_tpu.pkg.workqueue import WORKQUEUE_SECONDS_BUCKETS
 from k8s_dra_driver_tpu.k8s.core import (
     AllocationResult,
     DEVICE_CLASS,
@@ -114,9 +117,58 @@ def _device_matches(dev: Device, match_attributes: Dict[str, object],
                       list(cel_selectors)).matches(dev)
 
 
+class AllocatorPassMetrics:
+    """Per-pass decision telemetry: how much the scheduler probed and how
+    much of that work the pass-scoped caches absorbed. Gauges carry the
+    last completed pass (the partition-tuning signal MISO/Flex-MIG-style
+    placement work needs per decision, not cumulatively)."""
+
+    def __init__(self, registry: Registry):
+        self.passes_total = registry.register(Counter(
+            "tpu_dra_allocator_passes_total", "Completed allocator passes."))
+        self.pass_seconds = registry.register(Histogram(
+            "tpu_dra_allocator_pass_seconds",
+            "Wall time of one allocator pass (begin_pass to end_pass).",
+            buckets=WORKQUEUE_SECONDS_BUCKETS,
+        ))
+        self.nodes_probed = registry.register(Gauge(
+            "tpu_dra_allocator_pass_nodes_probed",
+            "allocate_on_node probes in the last pass."))
+        self.plans_compiled = registry.register(Gauge(
+            "tpu_dra_allocator_pass_plans_compiled",
+            "Match plans compiled (selector parse + CEL compile) last pass."))
+        self.plans_cached = registry.register(Gauge(
+            "tpu_dra_allocator_pass_plans_cached",
+            "Match-plan requests served from the pass cache last pass."))
+        self.commits = registry.register(Gauge(
+            "tpu_dra_allocator_pass_commits",
+            "Allocations committed in the last pass."))
+        self.rollbacks = registry.register(Gauge(
+            "tpu_dra_allocator_pass_rollbacks",
+            "Allocations rolled back in the last pass."))
+
+    def publish(self, stats: Dict[str, int], seconds: float) -> None:
+        self.passes_total.inc()
+        self.pass_seconds.observe(value=seconds)
+        self.nodes_probed.set(value=float(stats["nodes_probed"]))
+        self.plans_compiled.set(value=float(stats["plans_compiled"]))
+        self.plans_cached.set(value=float(stats["plans_cached"]))
+        self.commits.set(value=float(stats["commits"]))
+        self.rollbacks.set(value=float(stats["rollbacks"]))
+
+
+def _pass_stats() -> Dict[str, int]:
+    return {"nodes_probed": 0, "plans_compiled": 0, "plans_cached": 0,
+            "commits": 0, "rollbacks": 0}
+
+
 class Allocator:
-    def __init__(self, api: APIServer):
+    def __init__(self, api: APIServer, metrics_registry: Optional[Registry] = None):
         self.api = api
+        self.metrics = AllocatorPassMetrics(metrics_registry or Registry())
+        # Stats of the last completed pass (mirrors the gauges; handy for
+        # the sim's scheduler-pass span attributes and tests).
+        self.last_pass_stats: Dict[str, int] = _pass_stats()
         self._pass_snapshot = None  # (slices, allocations) for one pass
         # fingerprint -> (slices, index): slices survive across passes
         # until any ResourceSlice changes (see begin_pass).
@@ -181,6 +233,9 @@ class Allocator:
             "index": index,  # (driver, node) -> {name -> Device}
             "consumed": consumed,  # node -> counter_set -> counter -> used
             "classes": {},  # DeviceClass name -> (driver, attrs, cel)
+            "plans": {},  # content key -> (driver, _MatchPlan)
+            "stats": _pass_stats(),
+            "t0": time.perf_counter(),
         }
 
     @staticmethod
@@ -207,6 +262,7 @@ class Allocator:
         directly)."""
         if self._pass_snapshot is not None and alloc is not None:
             self._pass_snapshot["allocations"].append(alloc)
+            self._pass_snapshot["stats"]["commits"] += 1
             self._accrue(self._pass_snapshot["consumed"],
                          self._pass_snapshot["index"], alloc, +1)
 
@@ -225,12 +281,17 @@ class Allocator:
             # reconstruction of the allocation still withdraws it.
             if a is alloc or a == alloc:
                 del allocations[i]
+                self._pass_snapshot["stats"]["rollbacks"] += 1
                 self._accrue(self._pass_snapshot["consumed"],
                              self._pass_snapshot["index"], alloc, -1)
                 return
 
     def end_pass(self) -> None:
-        self._pass_snapshot = None
+        snap, self._pass_snapshot = self._pass_snapshot, None
+        if snap is not None:
+            self.last_pass_stats = snap["stats"]
+            self.metrics.publish(snap["stats"],
+                                 time.perf_counter() - snap["t0"])
 
     def _list_slices(self):
         if self._pass_snapshot is not None:
@@ -350,10 +411,25 @@ class Allocator:
     def _match_plan(self, req) -> Tuple[str, _MatchPlan]:
         """(driver, compiled plan) for one request — class lookup, legacy
         selector parsing, and CEL compilation all happen here, once per
-        request, not once per candidate device."""
+        request, not once per candidate device. Inside a pass, plans are
+        additionally cached by content (class + selectors), so probing one
+        pod's claim across 64 candidate nodes compiles its plan once."""
+        snap = self._pass_snapshot
+        key = None
+        if snap is not None:
+            key = (req.device_class_name, tuple(req.selectors),
+                   tuple(getattr(req, "cel_selectors", ())))
+            cached = snap["plans"].get(key)
+            if cached is not None:
+                snap["stats"]["plans_cached"] += 1
+                return cached
         driver, match_attrs, cel_sels = self._class_info(req.device_class_name)
         all_cel = list(cel_sels) + list(getattr(req, "cel_selectors", ()))
-        return driver, _MatchPlan(driver, match_attrs, req.selectors, all_cel)
+        plan = (driver, _MatchPlan(driver, match_attrs, req.selectors, all_cel))
+        if snap is not None:
+            snap["plans"][key] = plan
+            snap["stats"]["plans_compiled"] += 1
+        return plan
 
     def allocate_on_node(self, claim: ResourceClaim, node_name: str,
                          in_flight: Sequence = ()) -> Optional[AllocationResult]:
@@ -361,6 +437,8 @@ class Allocator:
         allocation or None when it doesn't fit. ``in_flight``: allocations
         computed this pass but not yet written (sibling claims of the same
         pod) — their devices count as consumed."""
+        if self._pass_snapshot is not None:
+            self._pass_snapshot["stats"]["nodes_probed"] += 1
         slices_by_driver = {
             s.driver: s
             for s in self._list_slices()

@@ -50,6 +50,8 @@ from k8s_dra_driver_tpu.k8s.core import (
 )
 from k8s_dra_driver_tpu.k8s.objects import new_meta
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.metrics import Registry
 from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_ABORTED
 from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
 from k8s_dra_driver_tpu.plugins.computedomain.driver import ComputeDomainDriver
@@ -93,6 +95,7 @@ class SimCluster:
         gates: str = "",
         api: Optional[APIServer] = None,
         loopback_agents: bool = False,
+        metrics_registry: Optional[Registry] = None,
     ):
         """``loopback_agents=True`` registers slice agents with their real
         harness address (127.0.0.1 — everything runs in this process), so
@@ -105,13 +108,19 @@ class SimCluster:
         self.workdir = workdir
         self.loopback_agents = loopback_agents
         self.gates = fg.parse(gates)
-        self.allocator = Allocator(self.api)
+        # One cluster-wide registry: every node plugin, the controller,
+        # and the allocator expose on it (per-node series merge — the
+        # sim's /metrics reads as a cluster aggregate).
+        self.metrics_registry = metrics_registry or Registry()
+        self.allocator = Allocator(self.api,
+                                   metrics_registry=self.metrics_registry)
         self.profile = profile
         self.nodes: Dict[str, SimNode] = {}
         self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
         self._gc_prev_claim_uids: set = set()
         self.controller = Controller(
-            self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600
+            self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600,
+            metrics_registry=self.metrics_registry,
         )
         self._install_device_classes()
         lib_probe = MockTpuLib(profile, worker_id=0)
@@ -190,12 +199,14 @@ class SimCluster:
             cdi_root=os.path.join(base, "cdi"),
             gates=self.gates,
             vfio=vfio_mgr,
+            metrics_registry=self.metrics_registry,
         )
         cd = ComputeDomainDriver(
             api=self.api, node_name=name, tpulib=lib,
             plugin_dir=os.path.join(base, "cd-plugin"),
             cdi_root=os.path.join(base, "cdi"),
             gates=self.gates,
+            metrics_registry=self.metrics_registry,
         )
         tpu.start()
         cd.start()
@@ -321,11 +332,15 @@ class SimCluster:
         # One snapshot of slices + existing allocations per pass; every
         # allocation written during the pass is recorded via
         # allocator.commit(), so the snapshot cannot double-book.
-        self.allocator.begin_pass()
-        try:
-            self._scheduler_pass_inner()
-        finally:
-            self.allocator.end_pass()
+        with tracing.span("scheduler.pass") as sp:
+            self.allocator.begin_pass()
+            try:
+                self._scheduler_pass_inner()
+            finally:
+                self.allocator.end_pass()
+                # Per-pass allocator decisions ride on the span: nodes
+                # probed, plans cached vs compiled, commits/rollbacks.
+                sp.attrs.update(self.allocator.last_pass_stats)
 
     def _scheduler_pass_inner(self) -> None:
         for pod in self.api.list(POD):
@@ -407,12 +422,15 @@ class SimCluster:
                     continue
             if not chosen:
                 chosen = candidates[0] if candidates else ""
-            def bind(obj, chosen=chosen):
-                obj.node_name = chosen
-            try:
-                self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
-            except NotFoundError:
-                continue
+            with tracing.span(
+                    "scheduler.bind", pod=pod.key, node=chosen,
+                    claim_uids=[c.uid for c in claims.values()]):
+                def bind(obj, chosen=chosen):
+                    obj.node_name = chosen
+                try:
+                    self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
+                except NotFoundError:
+                    continue
             # Every consumer of a claim is recorded (shared claims have
             # several); unprepare only happens when the last one is gone.
             from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer

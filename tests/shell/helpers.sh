@@ -21,7 +21,9 @@ start_cluster() {  # usage: start_cluster <profile> [extra sim args...]
   export TPU_DRA_ALT_PROC_DEVICES="$procdev"
   $PY -m k8s_dra_driver_tpu.sim --port 0 --profile "$profile" "$@" > "$logf" 2>&1 &
   SIM_PID=$!
-  for _ in $(seq 1 100); do
+  # 60s ceiling: interpreter start + N-node bring-up can exceed 10s when
+  # the whole tier-1 suite shares the machine.
+  for _ in $(seq 1 600); do
     if grep -q "cluster up at" "$logf"; then break; fi
     if ! kill -0 "$SIM_PID" 2>/dev/null; then
       echo "sim cluster died:"; cat "$logf"; exit 1

@@ -45,6 +45,28 @@ def _collect_worker_envs(tmp_path):
         sim.stop()
 
 
+def _multiprocess_backend_available() -> bool:
+    """Capability probe: can the psum workers run a cross-process
+    collective at all? The workers below are pinned to JAX_PLATFORMS=cpu
+    regardless of the parent's backend, and XLA:CPU rejects multi-process
+    computations unless a CPU collectives implementation (gloo/mpi) is
+    configured — bare XLA:CPU raises 'Multiprocess computations aren't
+    implemented on the CPU backend'."""
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "")
+    if not impl:
+        try:
+            import jax
+
+            impl = getattr(jax.config, "jax_cpu_collectives_implementation",
+                           None) or ""
+            if not impl and getattr(jax.config,
+                                    "jax_cpu_enable_gloo_collectives", False):
+                impl = "gloo"
+        except Exception:  # noqa: BLE001 — conservative: treat as absent
+            impl = ""
+    return bool(impl) and impl != "none"
+
+
 def _require_coordinator_port_free(addr: str) -> None:
     """The injected coordinator port is fixed (8476); an unrelated process
     holding it would fail every worker with a misleading timeout — skip
@@ -60,6 +82,12 @@ def _require_coordinator_port_free(addr: str) -> None:
 
 
 def test_multiprocess_psum_from_injected_env(tmp_path):
+    if not _multiprocess_backend_available():
+        pytest.skip(
+            "CPU backend has no multiprocess collectives implementation "
+            "configured (set JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo on a "
+            "jaxlib built with gloo support)"
+        )
     envs = _collect_worker_envs(tmp_path)
 
     # The driver-injected identities must already be a coherent cluster
@@ -85,6 +113,10 @@ def test_multiprocess_psum_from_injected_env(tmp_path):
             "PYTHONPATH": REPO,
             "JAX_PLATFORMS": "cpu",
         })
+        # The capability the skip above probed must reach the workers.
+        impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "")
+        if impl:
+            penv["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = impl
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "k8s_dra_driver_tpu.ops.psum_proof"],
             env=penv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,

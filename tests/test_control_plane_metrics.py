@@ -1,0 +1,191 @@
+"""The control-plane metric surface closed by this PR: workqueue
+depth/adds/retries/latency/work histograms, controller reconcile
+counters + duration, allocator pass gauges, and leader-election
+transition counters — all on one shared ``tpu_dra_*`` registry."""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomain,
+    ComputeDomainChannelSpec,
+    ComputeDomainSpec,
+)
+from k8s_dra_driver_tpu.controller import Controller
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import AllocationResult
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.pkg.workqueue import WorkQueue
+from k8s_dra_driver_tpu.sim.allocator import Allocator
+
+NS = "default"
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- workqueue ----------------------------------------------------------------
+
+def test_workqueue_metrics_full_cycle():
+    reg = Registry()
+    done = []
+
+    def handler(key, obj):
+        if obj == "fail-once" and not done:
+            done.append(key)
+            raise RuntimeError("first attempt fails")
+
+    q = WorkQueue(handler, name="test-q", metrics_registry=reg)
+    m = q.metrics
+    # Depth moves while items wait (workers not started yet).
+    q.enqueue("a", "ok")
+    q.enqueue("b", "fail-once")
+    assert m.depth.value("test-q") == 2.0
+    assert m.adds_total.value("test-q") == 2.0
+    q.start(workers=1)
+    try:
+        assert q.drain(timeout=10)
+    finally:
+        q.stop()
+    assert m.depth.value("test-q") == 0.0
+    assert m.retries_total.value("test-q") == 1.0
+    # a once + b twice (failure + retry) = 3 handler runs and 3 pickups.
+    assert m.work_seconds.count("test-q") == 3
+    assert m.queue_latency.count("test-q") == 3
+    # The retry rode the backoff requeue, which counts as an add.
+    assert m.adds_total.value("test-q") == 3.0
+
+
+# -- controller reconcile ------------------------------------------------------
+
+def test_controller_reconcile_counters_and_duration():
+    api = APIServer()
+    reg = Registry()
+    ctrl = Controller(api, cleanup_interval_s=3600, metrics_registry=reg)
+    cd = api.create(ComputeDomain(
+        meta=new_meta("cd-metrics", NS),
+        spec=ComputeDomainSpec(
+            num_nodes=0,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="cd-metrics-channel"),
+        ),
+    ))
+    ctrl.reconcile(api.get("ComputeDomain", cd.name, NS))
+    assert ctrl.reconciles_total.value("cd-controller", "success") == 1.0
+    assert ctrl.reconcile_seconds.count("cd-controller") == 1
+    # An over-limit domain still reconciles successfully (to Rejected).
+    def grow(obj):
+        obj.spec.num_nodes = 10_000
+    api.update_with_retry("ComputeDomain", cd.name, NS, grow)
+    ctrl.reconcile(api.get("ComputeDomain", cd.name, NS))
+    assert ctrl.reconciles_total.value("cd-controller", "success") == 2.0
+
+    # A reconcile that throws counts as an error and re-raises (the
+    # workqueue's retry contract).
+    class Boom(Exception):
+        pass
+
+    def boom(_cd):
+        raise Boom()
+    ctrl._reconcile_inner = boom
+    with pytest.raises(Boom):
+        ctrl.reconcile(api.get("ComputeDomain", cd.name, NS))
+    assert ctrl.reconciles_total.value("cd-controller", "error") == 1.0
+    assert ctrl.reconcile_seconds.count("cd-controller") == 3
+
+
+# -- allocator pass gauges -----------------------------------------------------
+
+def test_allocator_pass_gauges_publish_on_end_pass():
+    api = APIServer()
+    reg = Registry()
+    alloc = Allocator(api, metrics_registry=reg)
+    alloc.begin_pass()
+    a = AllocationResult(devices=[], node_name="n0")
+    b = AllocationResult(devices=[], node_name="n1")
+    alloc.commit(a)
+    alloc.commit(b)
+    alloc.rollback(b)
+    alloc.end_pass()
+    m = alloc.metrics
+    assert m.passes_total.value() == 1.0
+    assert m.pass_seconds.count() == 1
+    assert m.commits.value() == 2.0
+    assert m.rollbacks.value() == 1.0
+    assert alloc.last_pass_stats["commits"] == 2
+    assert alloc.last_pass_stats["rollbacks"] == 1
+    # Gauges reflect the LAST pass: an empty follow-up pass resets them.
+    alloc.begin_pass()
+    alloc.end_pass()
+    assert m.commits.value() == 0.0
+    assert m.passes_total.value() == 2.0
+
+
+def test_allocator_pass_plan_cache_counts(tmp_path):
+    """Probing the same claim across nodes compiles its plan once and
+    serves the rest from the pass cache — and the gauges say so."""
+    from k8s_dra_driver_tpu.sim import SimCluster
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2)
+    try:
+        from k8s_dra_driver_tpu.k8s.core import DeviceRequest, ResourceClaim
+
+        claim = ResourceClaim(
+            meta=new_meta("plan-cache-claim", NS),
+            requests=[DeviceRequest(
+                name="r0", device_class_name="tpu.google.com", count=1)],
+        )
+        sim.api.create(claim)
+        sim.allocator.begin_pass()
+        for node in sorted(sim.nodes):
+            got = sim.allocator.allocate_on_node(
+                sim.api.get("ResourceClaim", "plan-cache-claim", NS), node)
+            assert got is not None
+        sim.allocator.end_pass()
+        stats = sim.allocator.last_pass_stats
+        assert stats["nodes_probed"] == 2
+        assert stats["plans_compiled"] == 1
+        assert stats["plans_cached"] == 1
+        m = sim.allocator.metrics
+        assert m.nodes_probed.value() == 2.0
+        assert m.plans_compiled.value() == 1.0
+        assert m.plans_cached.value() == 1.0
+    finally:
+        sim.stop()
+
+
+# -- leader election -----------------------------------------------------------
+
+def test_leader_election_transition_counters():
+    api = APIServer()
+    reg = Registry()
+    a = LeaderElector(api, "lease-m", "a", lease_duration_s=0.5,
+                      retry_period_s=0.05, metrics_registry=reg)
+    b = LeaderElector(api, "lease-m", "b", lease_duration_s=0.5,
+                      retry_period_s=0.05, metrics_registry=reg)
+    a.start()
+    try:
+        wait_for(lambda: a.is_leader, msg="a acquires")
+        assert a.metrics.transitions_total.value("lease-m", "acquired") == 1.0
+        assert a.metrics.is_leader.value("lease-m") == 1.0
+        b.start()
+        time.sleep(0.2)
+        assert b.metrics.transitions_total.value("lease-m", "acquired") == 1.0
+        # Shared registry: b's bundle sees the same series (a's acquire).
+        a.stop()
+        assert a.metrics.transitions_total.value("lease-m", "lost") == 1.0
+        assert a.metrics.is_leader.value("lease-m") == 0.0
+        wait_for(lambda: b.is_leader, msg="b takes over")
+        assert b.metrics.transitions_total.value("lease-m", "acquired") == 2.0
+    finally:
+        a.stop()
+        b.stop()

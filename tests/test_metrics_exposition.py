@@ -1,0 +1,203 @@
+"""Prometheus exposition-format round-trip: a mini scrape parser applied
+to ``Registry.expose()`` output from a REAL batched-prepare run — every
+line parses, histogram buckets are cumulative, ``le="+Inf"`` equals
+``_count``, and label values with quotes/backslashes/newlines escape per
+the text-format spec."""
+
+import re
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg.metrics import (
+    ComputeDomainStatusMetric,
+    Gauge,
+    Registry,
+)
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+from tests.test_batch_prepare import DENSE16, boot_id  # noqa: F401 — fixture
+from tests.test_tpu_plugin import make_claim
+
+METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+SAMPLE_RE = re.compile(
+    rf"^({METRIC_NAME})(?:\{{(.*)\}})? (-?(?:[0-9.]+(?:e[+-]?[0-9]+)?|Inf)|NaN)$"
+)
+LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+
+
+def parse_labels(s: str) -> dict:
+    """Parse the inside of a {…} label block, honoring \\\\, \\", \\n."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        m = LABEL_NAME_RE.match(s, i)
+        assert m, f"bad label name at {s[i:]!r}"
+        name = m.group(0)
+        i = m.end()
+        assert s[i] == "=", f"expected '=' at {s[i:]!r}"
+        assert s[i + 1] == '"', f"label value must be quoted at {s[i:]!r}"
+        i += 2
+        out = []
+        while True:
+            assert i < len(s), "unterminated label value"
+            ch = s[i]
+            if ch == "\\":
+                esc = s[i + 1]
+                assert esc in ('\\', '"', "n"), f"bad escape \\{esc}"
+                out.append({"\\": "\\", '"': '"', "n": "\n"}[esc])
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                assert ch != "\n", "raw newline inside label value"
+                out.append(ch)
+                i += 1
+        labels[name] = "".join(out)
+        if i < len(s):
+            assert s[i] == ",", f"expected ',' between labels at {s[i:]!r}"
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str):
+    """Parse a whole scrape: returns (samples, types) where samples is a
+    list of (name, labels dict, float value) and types maps metric name ->
+    declared TYPE. Raises on any malformed line."""
+    samples, types, helps = [], {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, _help = rest.partition(" ")
+            helps[name] = _help
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), kind
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        name, labelblock, value = m.groups()
+        labels = parse_labels(labelblock) if labelblock else {}
+        samples.append((name, labels, float(value.replace("Inf", "inf"))))
+    return samples, types
+
+
+def check_histograms(samples, types):
+    """Every histogram series: buckets cumulative in le order, +Inf bucket
+    present and equal to _count, _sum present."""
+    hist_names = [n for n, k in types.items() if k == "histogram"]
+    checked = 0
+    for name in hist_names:
+        buckets = {}
+        counts = {}
+        sums = {}
+        for sname, labels, value in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            if sname == f"{name}_bucket":
+                buckets.setdefault(key, []).append((labels["le"], value))
+            elif sname == f"{name}_count":
+                counts[key] = value
+            elif sname == f"{name}_sum":
+                sums[key] = value
+        for key, series in buckets.items():
+            assert key in counts, f"{name}{key}: _bucket without _count"
+            assert key in sums, f"{name}{key}: _bucket without _sum"
+            infs = [v for le, v in series if le == "+Inf"]
+            assert len(infs) == 1, f"{name}{key}: need exactly one le=+Inf"
+            assert infs[0] == counts[key], (
+                f'{name}{key}: le="+Inf" {infs[0]} != _count {counts[key]}')
+            finite = sorted(
+                ((float(le), v) for le, v in series if le != "+Inf"))
+            cum = [v for _, v in finite]
+            assert cum == sorted(cum), f"{name}{key}: buckets not cumulative"
+            if cum:
+                assert cum[-1] <= counts[key]
+            checked += 1
+    return checked
+
+
+def test_real_batched_prepare_scrape_roundtrips(tmp_path, boot_id):  # noqa: F811
+    """Populate the registry the way production does — a 16-claim batched
+    prepare + an unprepare + a per-claim failure — then round-trip the
+    scrape."""
+    reg = Registry()
+    driver = TpuDriver(
+        api=APIServer(), node_name="node-0", tpulib=MockTpuLib(DENSE16),
+        plugin_dir=str(tmp_path / "plugin"), cdi_root=str(tmp_path / "cdi"),
+        metrics_registry=reg,
+    )
+    driver.start()
+    try:
+        claims = [make_claim([f"tpu-{i}"], name=f"c{i}") for i in range(16)]
+        claims.append(make_claim(["tpu-99"], name="bad"))  # per-claim error
+        driver.prepare_resource_claims(claims)
+        driver.unprepare_resource_claims([c.uid for c in claims[:4]])
+    finally:
+        driver.shutdown()
+    # A CD status series with a hostile name exercises escaping in the
+    # same scrape.
+    cd = ComputeDomainStatusMetric(reg)
+    cd.set("ns", 'dom"quote\\slash', "Ready")
+
+    text = reg.expose()
+    samples, types = parse_exposition(text)
+    assert samples, "empty scrape"
+    # Everything the bundle registers shows up with a TYPE.
+    for expected in ("tpu_dra_requests_total", "tpu_dra_request_errors_total",
+                     "tpu_dra_prepare_batch_size", "tpu_dra_prepare_seconds",
+                     "tpu_dra_request_duration_seconds",
+                     "tpu_dra_compute_domain_status"):
+        assert expected in types, f"{expected} missing from scrape"
+    assert check_histograms(samples, types) >= 3  # duration/batch/prepare series
+    # The real run's numbers survived the round trip.
+    by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    d = driver.driver_name
+    assert by[("tpu_dra_requests_total",
+               (("driver", d), ("method", "PrepareResourceClaims")))] == 17.0
+    assert by[("tpu_dra_request_errors_total",
+               (("driver", d), ("method", "PrepareResourceClaims")))] == 1.0
+    # Escaped label value round-trips to the original string.
+    assert by[("tpu_dra_compute_domain_status",
+               (("name", 'dom"quote\\slash'), ("namespace", "ns"),
+                ("status", "Ready")))] == 1.0
+
+
+def test_label_escaping_spec():
+    """The satellite fix pinned directly: quotes, backslashes, and
+    newlines in label values emit the spec's escape sequences."""
+    reg = Registry()
+    g = Gauge("esc_gauge", "help", ("name",))
+    reg.register(g)
+    hostile = 'a"b\\c\nd'
+    g.set(hostile, value=1.0)
+    text = reg.expose()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    assert "\n" not in [ln for ln in text.splitlines()
+                        if ln.startswith("esc_gauge{")][0]
+    samples, _ = parse_exposition(text)
+    (name, labels, value), = [s for s in samples if s[0] == "esc_gauge"]
+    assert labels["name"] == hostile
+    assert value == 1.0
+
+
+def test_help_text_escaping():
+    reg = Registry()
+    reg.register(Gauge("multi_line_help", "line1\nline2"))
+    text = reg.expose()
+    help_line = next(ln for ln in text.splitlines()
+                     if ln.startswith("# HELP multi_line_help"))
+    assert "\\n" in help_line
+    parse_exposition(text)  # every line still parses
+
+
+def test_parser_rejects_garbage():
+    with pytest.raises(AssertionError):
+        parse_exposition("not a metric line at all!")

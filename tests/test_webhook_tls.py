@@ -17,6 +17,10 @@ import urllib.request
 
 import pytest
 
+# Capability skip, not a failure: pkg/certs mints the CA/serving certs
+# with the cryptography package, which the minimal CI image may lack.
+pytest.importorskip("cryptography")
+
 from k8s_dra_driver_tpu.api.configs import API_VERSION, TPU_DRIVER_NAME
 from k8s_dra_driver_tpu.k8s.core import (
     RegisteredWebhook,
