@@ -28,7 +28,9 @@ bench:
 	python bench.py
 
 # CI-sized bench pass: prepare-latency headline (20 iters) + batched
-# prepare amortization + a 4-node scheduler storm, hard-capped at 5 min.
+# prepare amortization + a 4-node scheduler storm + the 64-node indexed
+# scheduler storm with a hard probes-per-bind budget assertion (a
+# feasibility-filter regression fails this target). Capped at 5 min.
 bench-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke
 

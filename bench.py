@@ -190,6 +190,97 @@ spec:
     return out
 
 
+def bench_scheduler(node_counts=(64, 256, 512), storm_pods: int = 128,
+                    storm_max_steps: int = 400, assert_budget: bool = False) -> dict:
+    """Indexed-scheduling-core benchmark (PR 3): a storm of single-chip
+    pods against clusters of growing node count, reporting
+
+    - pods-to-Running throughput (the control-plane headline),
+    - allocator probes-per-bind: with the node-capacity feasibility
+      pre-filter this stays ~1 and is bounded by the feasible-set size,
+      instead of growing O(nodes) like the probe-every-node scheduler,
+    - store-list object touches, actual (per-kind/namespace indexes) vs
+      naive (what the pre-index whole-store scan would have walked for the
+      same calls) — the copy-traffic the store indexes removed.
+
+    ``assert_budget=True`` (the bench-smoke wiring) turns the probe bound
+    into a hard failure so a feasibility regression fails CI, not just a
+    trend line."""
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    rct = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: storm, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+    out: dict = {"sched_storm_pods": storm_pods}
+    for nodes in node_counts:
+        with tempfile.TemporaryDirectory() as tmp:
+            sim = SimCluster(workdir=tmp, profile="v5e-4", num_hosts=nodes)
+            sim.start()
+            try:
+                for obj in load_manifests(rct):
+                    sim.api.create(obj)
+                for i in range(storm_pods):
+                    pod_yaml = f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: storm-{i}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: storm}}]
+"""
+                    for obj in load_manifests(pod_yaml):
+                        sim.api.create(obj)
+                stats0 = sim.api.stats.snapshot()
+                probes = feasible = binds = 0
+                t0 = time.perf_counter()
+                for _ in range(storm_max_steps):
+                    sim.step()
+                    st = sim.allocator.last_pass_stats
+                    probes += st["nodes_probed"]
+                    feasible += st["feasible_nodes"]
+                    binds += st["commits"]
+                    pods = sim.api.list(POD)
+                    if all(p.phase == "Running" for p in pods):
+                        break
+                    if any(p.phase == "Failed" for p in pods):
+                        raise RuntimeError("storm pod Failed")
+                else:
+                    raise RuntimeError("storm did not converge")
+                wall = time.perf_counter() - t0
+                stats1 = sim.api.stats.snapshot()
+            finally:
+                sim.stop()
+        scanned = stats1["objects_scanned"] - stats0["objects_scanned"]
+        naive = (stats1["objects_scanned_naive"]
+                 - stats0["objects_scanned_naive"])
+        key = f"sched_{nodes}n"
+        out[f"{key}_pods_per_s"] = round(storm_pods / wall, 1)
+        out[f"{key}_wall_s"] = round(wall, 3)
+        out[f"{key}_probes_per_bind"] = round(probes / max(1, binds), 2)
+        out[f"{key}_feasible_per_bind"] = round(
+            feasible / max(1, binds), 1)
+        out[f"{key}_store_objects_scanned"] = scanned
+        out[f"{key}_store_objects_scanned_naive"] = naive
+        out[f"{key}_store_scan_reduction"] = round(
+            naive / max(1, scanned), 1)
+        if assert_budget:
+            # Probes bounded by the feasible set, never by the node count,
+            # and most-free-first ordering keeps the per-bind cost a small
+            # constant on an uncontended storm.
+            assert probes <= feasible, (probes, feasible)
+            assert probes / max(1, binds) <= 3.0, (probes, binds)
+            assert scanned < naive, (scanned, naive)
+    return out
+
+
 # Public peak dense-bf16 FLOP/s per chip (cloud.google.com/tpu/docs spec
 # pages); device_kind strings as libtpu reports them.
 PEAK_BF16_FLOPS = {
@@ -603,6 +694,11 @@ def main() -> None:
                 storm_nodes=4, storm_pods=8, storm_max_steps=80))
         except Exception as e:  # noqa: BLE001 — extras are best-effort
             result["control_plane_error"] = str(e)[:200]
+        # Probes-per-bind budget is a hard gate here (make bench-smoke):
+        # a feasibility-filter regression fails the run, not just the
+        # trend line.
+        result.update(bench_scheduler(
+            node_counts=(64,), storm_pods=32, assert_budget=True))
         print(json.dumps(result))
         return
     result = bench_prepare_latency()
@@ -612,6 +708,12 @@ def main() -> None:
         result.update(bench_control_plane())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["control_plane_error"] = str(e)[:200]
+    try:
+        # Indexed scheduling core: pods-to-Running throughput,
+        # probes-per-bind, and store scan reduction at 64/256/512 nodes.
+        result.update(bench_scheduler())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["sched_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

@@ -12,13 +12,22 @@ Semantics kept faithful to the pieces the driver depends on:
   finalizer — the controller's finalizer dance (computedomain.go:316-330).
 - watch() streams ADDED/MODIFIED/DELETED events from the moment of
   subscription; informers do list+watch.
+
+Listing is index-backed: objects are bucketed per kind and per
+(kind, namespace) on every write, so ``list(kind)`` touches only objects of
+that kind (and ``list(kind, namespace=ns)`` only that namespace's) instead
+of scanning and re-sorting the whole store — etcd's range-read over a key
+prefix rather than a full keyspace scan. ``kind_fingerprint`` is an O(1)
+counter lookup maintained on the same writes. ``stats`` counts what each
+list actually touched (and what a pre-index full scan would have), so the
+scheduler bench can report the delta.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from k8s_dra_driver_tpu.k8s.objects import (
@@ -37,6 +46,28 @@ class WatchEvent:
     obj: K8sObject
 
 
+@dataclass
+class StoreStats:
+    """Read-path accounting (plain ints, no locking beyond the store's):
+    ``objects_scanned`` is what the per-kind/namespace indexes actually
+    iterated; ``objects_scanned_naive`` is what the pre-index
+    whole-store sort-and-filter would have touched for the same calls —
+    the pair the scheduler bench reports as the index win."""
+
+    list_calls: int = 0
+    objects_scanned: int = 0
+    objects_scanned_naive: int = 0
+    objects_returned: int = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "list_calls": self.list_calls,
+            "objects_scanned": self.objects_scanned,
+            "objects_scanned_naive": self.objects_scanned_naive,
+            "objects_returned": self.objects_returned,
+        }
+
+
 _Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 
@@ -50,7 +81,17 @@ class APIServer:
     def __init__(self) -> None:
         self._mu = threading.RLock()
         self._objects: Dict[_Key, K8sObject] = {}
+        # Secondary indexes, maintained on every write: kind -> {key -> obj}
+        # and (kind, namespace) -> {key -> obj}. Values are the SAME stored
+        # objects (no copies); list() deepcopies on the way out as before.
+        self._by_kind: Dict[str, Dict[_Key, K8sObject]] = {}
+        self._by_kind_ns: Dict[Tuple[str, str], Dict[_Key, K8sObject]] = {}
+        # kind -> (live count, last resourceVersion stamped on this kind).
+        # O(1) to read and to maintain; see kind_fingerprint().
+        self._fp: Dict[str, Tuple[int, int]] = {}
         self._rv = 0
+        self.stats = StoreStats()
+        self._metrics = None  # set by attach_metrics()
         # (queue, name-filter, namespace-filter); None filters match all —
         # the field-selector analog so a single-object watcher (e.g. the
         # daemon's own-pod PodManager) doesn't receive cluster-wide churn.
@@ -76,6 +117,28 @@ class APIServer:
     def _key(obj: K8sObject) -> _Key:
         return (obj.kind, obj.meta.namespace, obj.meta.name)
 
+    def _index_add(self, key: _Key, obj: K8sObject) -> None:
+        self._objects[key] = obj
+        self._by_kind.setdefault(key[0], {})[key] = obj
+        self._by_kind_ns.setdefault((key[0], key[1]), {})[key] = obj
+
+    def _index_drop(self, key: _Key) -> None:
+        del self._objects[key]
+        self._by_kind[key[0]].pop(key, None)
+        self._by_kind_ns[(key[0], key[1])].pop(key, None)
+
+    def _fp_mutate(self, kind: str, delta: int, rv: Optional[int] = None) -> None:
+        """Maintain the fingerprint counters on one mutation. ``rv`` is the
+        resourceVersion just stamped (None for plain removals, which consume
+        no rv). Token uniqueness: the rv component is monotone and strictly
+        increases on every stamp; between two tokens with the same rv only
+        removals happened, so the count strictly decreases — no (count, rv)
+        pair can ever repeat within one kind's history."""
+        count, last = self._fp.get(kind, (0, 0))
+        self._fp[kind] = (count + delta, last if rv is None else rv)
+        if self._metrics is not None and delta:
+            self._metrics["objects"].set(kind, value=float(count + delta))
+
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: K8sObject) -> K8sObject:
@@ -91,7 +154,8 @@ class APIServer:
             stored.meta.generation = 1
             stored.meta.creation_timestamp = stored.meta.creation_timestamp or now()
             stored.meta.deletion_timestamp = None
-            self._objects[key] = stored
+            self._index_add(key, stored)
+            self._fp_mutate(obj.kind, +1, stored.meta.resource_version)
             out = stored.deepcopy()
             self._emit(obj.kind, WatchEvent("ADDED", stored.deepcopy()))
             return out
@@ -111,24 +175,15 @@ class APIServer:
             return None
 
     def kind_fingerprint(self, kind: str) -> tuple:
-        """Cheap change-detection token for one kind: (count, max
-        resourceVersion). O(objects) with no copying — lets read-mostly
-        callers (the allocator's per-pass snapshot) reuse their previous
-        deepcopied list when nothing of that kind changed. Any create
-        bumps max-rv, any update bumps the object's rv, any delete drops
-        the count (and a delete+create in one window bumps max-rv), so
-        the token changes whenever the listed set could differ."""
+        """Cheap change-detection token for one kind: (live count, last
+        resourceVersion stamped on the kind). O(1) — maintained by the
+        write paths instead of scanned — so read-mostly callers (the
+        allocator's per-pass snapshot, the sim's quiescence detection) can
+        poll it every pass for free. Any create/update bumps the rv
+        component, any removal drops the count, so the token changes
+        whenever the listed set could differ and never repeats."""
         with self._mu:
-            count = 0
-            max_rv = 0
-            for (k, _, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                count += 1
-                rv = obj.meta.resource_version or 0
-                if rv > max_rv:
-                    max_rv = rv
-            return (count, max_rv)
+            return self._fp.get(kind, (0, 0))
 
     def list(
         self,
@@ -137,15 +192,24 @@ class APIServer:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[K8sObject]:
         with self._mu:
+            if namespace is None:
+                bucket = self._by_kind.get(kind, {})
+            else:
+                bucket = self._by_kind_ns.get((kind, namespace), {})
+            self.stats.list_calls += 1
+            self.stats.objects_scanned += len(bucket)
+            self.stats.objects_scanned_naive += len(self._objects)
             out = []
-            for (k, ns, _), obj in sorted(self._objects.items()):
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
-                    continue
+            for key in sorted(bucket):
+                obj = bucket[key]
                 if not _match_labels(obj, label_selector):
                     continue
                 out.append(obj.deepcopy())
+            self.stats.objects_returned += len(out)
+            if self._metrics is not None:
+                self._metrics["list_total"].inc()
+                self._metrics["scanned_total"].inc(by=float(len(bucket)))
+                self._metrics["returned_total"].inc(by=float(len(out)))
             return out
 
     def update(self, obj: K8sObject) -> K8sObject:
@@ -168,10 +232,12 @@ class APIServer:
             stored.meta.resource_version = self._next_rv()
             stored.meta.generation = cur.meta.generation + 1
             if stored.meta.deletion_timestamp is not None and not stored.meta.finalizers:
-                del self._objects[key]
+                self._index_drop(key)
+                self._fp_mutate(obj.kind, -1, stored.meta.resource_version)
                 self._emit(obj.kind, WatchEvent("DELETED", stored.deepcopy()))
                 return stored.deepcopy()
-            self._objects[key] = stored
+            self._index_add(key, stored)
+            self._fp_mutate(obj.kind, 0, stored.meta.resource_version)
             self._emit(obj.kind, WatchEvent("MODIFIED", stored.deepcopy()))
             return stored.deepcopy()
 
@@ -185,12 +251,40 @@ class APIServer:
                 if cur.meta.deletion_timestamp is None:
                     cur.meta.deletion_timestamp = now()
                     cur.meta.resource_version = self._next_rv()
+                    self._fp_mutate(kind, 0, cur.meta.resource_version)
                     self._emit(kind, WatchEvent("MODIFIED", cur.deepcopy()))
                 return
-            del self._objects[key]
+            self._index_drop(key)
+            self._fp_mutate(kind, -1)
             self._emit(kind, WatchEvent("DELETED", cur.deepcopy()))
 
     # -- helpers -----------------------------------------------------------
+
+    def attach_metrics(self, registry) -> None:
+        """Expose the store's read/size accounting on a tpu_dra_* registry
+        (the sim wires its cluster-shared registry here). Idempotent per
+        registry; re-attaching to a different registry re-registers."""
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge
+
+        with self._mu:
+            self._metrics = {
+                "list_total": registry.register(Counter(
+                    "tpu_dra_store_list_requests_total",
+                    "list() calls served by the API store.")),
+                "scanned_total": registry.register(Counter(
+                    "tpu_dra_store_list_objects_scanned_total",
+                    "Objects the per-kind/namespace indexes iterated "
+                    "across all list() calls.")),
+                "returned_total": registry.register(Counter(
+                    "tpu_dra_store_list_objects_returned_total",
+                    "Objects deepcopied out of list() calls.")),
+                "objects": registry.register(Gauge(
+                    "tpu_dra_store_objects",
+                    "Objects currently stored, by kind.",
+                    label_names=("kind",))),
+            }
+            for kind, (count, _) in self._fp.items():
+                self._metrics["objects"].set(kind, value=float(count))
 
     def update_with_retry(
         self, kind: str, name: str, namespace: str, mutate: Callable[[K8sObject], None],
@@ -240,13 +334,12 @@ class APIServer:
         doomed: List[K8sObject] = []
         with self._mu:
             uids = {o.meta.uid for o in self._objects.values()}
-            for (k, _, _), obj in list(self._objects.items()):
-                if k not in kinds:
-                    continue
-                for ref in obj.meta.owner_references:
-                    if ref.controller and ref.uid not in uids:
-                        doomed.append(obj)
-                        break
+            for kind in kinds:
+                for obj in list(self._by_kind.get(kind, {}).values()):
+                    for ref in obj.meta.owner_references:
+                        if ref.controller and ref.uid not in uids:
+                            doomed.append(obj)
+                            break
         for obj in doomed:
             try:
                 self.delete(obj.kind, obj.meta.name, obj.meta.namespace)

@@ -15,7 +15,7 @@ import re
 import time
 from collections import defaultdict
 from types import SimpleNamespace
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
@@ -146,6 +146,17 @@ class AllocatorPassMetrics:
         self.rollbacks = registry.register(Gauge(
             "tpu_dra_allocator_pass_rollbacks",
             "Allocations rolled back in the last pass."))
+        self.feasibility_checked = registry.register(Gauge(
+            "tpu_dra_allocator_pass_feasibility_checked",
+            "Nodes examined by the feasibility pre-filter last pass."))
+        self.feasible_nodes = registry.register(Gauge(
+            "tpu_dra_allocator_pass_feasible_nodes",
+            "Nodes the feasibility pre-filter admitted last pass "
+            "(only these are probed with allocate_on_node)."))
+        self.infeasible_skipped = registry.register(Gauge(
+            "tpu_dra_allocator_pass_infeasible_skipped",
+            "Nodes the feasibility pre-filter excluded last pass — "
+            "probes the indexed scheduler never issued."))
 
     def publish(self, stats: Dict[str, int], seconds: float) -> None:
         self.passes_total.inc()
@@ -155,11 +166,15 @@ class AllocatorPassMetrics:
         self.plans_cached.set(value=float(stats["plans_cached"]))
         self.commits.set(value=float(stats["commits"]))
         self.rollbacks.set(value=float(stats["rollbacks"]))
+        self.feasibility_checked.set(value=float(stats["feasibility_checked"]))
+        self.feasible_nodes.set(value=float(stats["feasible_nodes"]))
+        self.infeasible_skipped.set(value=float(stats["infeasible_skipped"]))
 
 
 def _pass_stats() -> Dict[str, int]:
     return {"nodes_probed": 0, "plans_compiled": 0, "plans_cached": 0,
-            "commits": 0, "rollbacks": 0}
+            "commits": 0, "rollbacks": 0, "feasibility_checked": 0,
+            "feasible_nodes": 0, "infeasible_skipped": 0}
 
 
 class Allocator:
@@ -173,6 +188,16 @@ class Allocator:
         # fingerprint -> (slices, index): slices survive across passes
         # until any ResourceSlice changes (see begin_pass).
         self._slice_cache: Optional[tuple] = None
+        # Static per-(driver, node) capacity summaries + per-plan match
+        # cache backing feasible_nodes(); invalidated when the slice or
+        # DeviceClass fingerprint moves (see _feasibility_state).
+        self._feas_cache: Optional[dict] = None
+        # (claim_fp, slice_fp) -> (allocations, consumed) surviving across
+        # passes while no ResourceClaim changed: a quiet cluster's
+        # begin_pass is O(1) instead of O(claims). Any commit during a
+        # pass writes the claim through the API first, so the fingerprint
+        # always moves before the cached list could go stale.
+        self._alloc_cache: Optional[tuple] = None
 
     # -- pass-scoped snapshot -------------------------------------------------
 
@@ -211,10 +236,6 @@ class Allocator:
         is O(allocations) total instead of re-scanning every allocation for
         every pod × node probe (O(pods × allocations))."""
         slices, index = self._snapshot_slices()
-        allocations = [
-            c.allocation for c in self.api.list(RESOURCE_CLAIM)
-            if c.allocation is not None
-        ]
         index = dict(index)
         if not index:
             # No fingerprint-backed slice cache (api without
@@ -224,9 +245,22 @@ class Allocator:
                 (s.driver, s.node_name): {d.name: d for d in s.devices}
                 for s in slices
             }
-        consumed: Dict[str, Dict[str, Dict[str, int]]] = {}
-        for alloc in allocations:
-            self._accrue(consumed, index, alloc, +1)
+        fp_fn = getattr(self.api, "kind_fingerprint", None)
+        alloc_fps = (None if fp_fn is None else
+                     (fp_fn(RESOURCE_CLAIM), fp_fn(RESOURCE_SLICE)))
+        if (alloc_fps is not None and self._alloc_cache is not None
+                and self._alloc_cache[0] == alloc_fps):
+            allocations, consumed = self._alloc_cache[1], self._alloc_cache[2]
+        else:
+            allocations = [
+                c.allocation for c in self.api.list(RESOURCE_CLAIM)
+                if c.allocation is not None
+            ]
+            consumed = {}
+            for alloc in allocations:
+                self._accrue(consumed, index, alloc, +1)
+            if alloc_fps is not None:
+                self._alloc_cache = (alloc_fps, allocations, consumed)
         self._pass_snapshot = {
             "slices": slices,
             "allocations": allocations,
@@ -290,6 +324,12 @@ class Allocator:
         snap, self._pass_snapshot = self._pass_snapshot, None
         if snap is not None:
             self.last_pass_stats = snap["stats"]
+            if snap["stats"]["commits"] or snap["stats"]["rollbacks"]:
+                # The pass mutated the cached allocation list/consumed
+                # counters in place; rebuild from the API next pass (test
+                # harnesses may commit without an API write, so don't rely
+                # on the fingerprint alone).
+                self._alloc_cache = None
             self.metrics.publish(snap["stats"],
                                  time.perf_counter() - snap["t0"])
 
@@ -392,6 +432,170 @@ class Allocator:
                 if used + ctr.value > cap.value:
                     return False
         return True
+
+    # -- node-capacity feasibility index --------------------------------------
+
+    def _feasibility_state(self) -> dict:
+        """Static half of the node-capacity index: per (driver, node) the
+        untainted devices, the slice's counter capacities, and total
+        capacity units (the most-free-first ordering key), plus the set of
+        attribute values present per attribute. Built once and reused until
+        the ResourceSlice or DeviceClass kind fingerprint moves — the
+        dynamic half (consumed counters) already lives in the pass snapshot
+        and is maintained incrementally by commit()/rollback()."""
+        fp_fn = getattr(self.api, "kind_fingerprint", None)
+        if fp_fn is None:
+            fps = None
+        else:
+            # The slice component must be the fingerprint of the slices the
+            # index is actually built from: inside a pass that is the
+            # snapshot (its fp was recorded at begin_pass), NOT the live
+            # store — a slice deleted mid-pass must invalidate on the NEXT
+            # pass, when the snapshot refreshes, not be masked forever by a
+            # cache stamped with the post-deletion fingerprint.
+            snap = self._pass_snapshot
+            if (snap is not None and self._slice_cache is not None
+                    and snap["slices"] is self._slice_cache[1]):
+                slice_fp = self._slice_cache[0]
+            else:
+                slice_fp = fp_fn(RESOURCE_SLICE)
+            fps = (slice_fp, fp_fn(DEVICE_CLASS))
+        cache = self._feas_cache
+        if cache is not None and fps is not None and cache["fps"] == fps:
+            return cache
+        entries: Dict[Tuple[str, str], dict] = {}
+        for s in self._list_slices():
+            caps = {cs.name: {c: ctr.value for c, ctr in cs.counters.items()}
+                    for cs in s.shared_counters}
+            untainted = [
+                d for d in s.devices
+                if not any(t.effect in ("NoSchedule", "NoExecute")
+                           for t in d.taints)
+            ]
+            attr_values: Dict[str, set] = {}
+            for d in untainted:
+                for k, v in d.attributes.items():
+                    attr_values.setdefault(k, set()).add(v)
+            entries[(s.driver, s.node_name)] = {
+                "devices": untainted,
+                "caps": caps,
+                "cap_units": sum(v for cc in caps.values()
+                                 for v in cc.values()),
+                "attr_values": attr_values,
+            }
+        cap_units: Dict[str, int] = {}
+        for (_, node), e in entries.items():
+            cap_units[node] = cap_units.get(node, 0) + e["cap_units"]
+        cache = {"fps": fps, "entries": entries, "match": {},
+                 "nodes": frozenset(cap_units), "node_cap_units": cap_units}
+        self._feas_cache = cache
+        return cache
+
+    @staticmethod
+    def _dev_fits_base(dev: Device, caps: Dict[str, Dict[str, int]],
+                       consumed) -> bool:
+        """Would this device fit with the node's CURRENT consumption alone
+        (no pending/in-flight overlay)? Mirrors _fits(); any device a real
+        allocation chooses necessarily passes this weaker check."""
+        for cc in dev.consumes_counters:
+            cap_set = caps.get(cc.counter_set)
+            if cap_set is None:
+                continue  # unconstrained counter set (channel/daemon)
+            used_set = consumed.get(cc.counter_set) if consumed else None
+            for cname, ctr in cc.counters.items():
+                cap = cap_set.get(cname)
+                if cap is None:
+                    return False
+                used = used_set.get(cname, 0) if used_set else 0
+                if used + ctr.value > cap:
+                    return False
+        return True
+
+    def _matching_devices(self, cache: dict, driver: str, node: str,
+                          plan_key, plan: _MatchPlan) -> list:
+        """Untainted devices on (driver, node) matching one request's plan.
+        Match results depend only on slice + class content, so they are
+        cached alongside the static index and survive across passes."""
+        entry = cache["entries"].get((driver, node))
+        if entry is None:
+            return []
+        mkey = (driver, node, plan_key)
+        hit = cache["match"].get(mkey)
+        if hit is None:
+            present = entry["attr_values"]
+            if any(v not in present.get(k, ())
+                   for k, v in plan.match_attrs.items()):
+                hit = []  # a required attribute value exists on no device
+            else:
+                hit = [d for d in entry["devices"] if plan.matches(d)]
+            cache["match"][mkey] = hit
+        return hit
+
+    def feasible_nodes(self, claims, nodes: Optional[Iterable[str]] = None,
+                       ) -> List[str]:
+        """Pre-filter for the scheduler: node names on which every request
+        of every claim could POSSIBLY be satisfied, ordered most-free-first
+        (ties by name, so a fresh cluster keeps the deterministic name
+        order). Checks necessary conditions only — a slice for the
+        request's driver, enough plan-matching untainted devices, and
+        enough of them individually fitting the node's current consumed
+        counters — so it never excludes a node allocate_on_node (the
+        probe-every-node oracle) would have placed on; it may admit nodes
+        a full probe then rejects (joint sibling fit, within-claim counter
+        accumulation). ``claims``: one ResourceClaim or a sequence (a
+        pod's unallocated claims, intersected)."""
+        if isinstance(claims, ResourceClaim):
+            claims = [claims]
+        cache = self._feasibility_state()
+        snap = self._pass_snapshot
+        plans = []
+        for claim in claims:
+            for req in claim.requests:
+                driver, plan = self._match_plan(req)
+                plan_key = (req.device_class_name, tuple(req.selectors),
+                            tuple(getattr(req, "cel_selectors", ())))
+                plans.append((req, driver, plan_key, plan))
+        candidates = cache["nodes"]
+        if nodes is not None:
+            candidates = candidates & set(nodes)
+        cap_units = cache["node_cap_units"]
+        scored = []
+        for node in candidates:
+            consumed = self._consumed_for_node(node)
+            used = sum(v for counters in consumed.values()
+                       for v in counters.values()) if consumed else 0
+            if all(self._node_feasible(cache, node, req, driver, pk, plan,
+                                       consumed if used else None)
+                   for req, driver, pk, plan in plans):
+                scored.append((used - cap_units.get(node, 0), node))
+        if snap is not None:
+            snap["stats"]["feasibility_checked"] += len(candidates)
+            snap["stats"]["feasible_nodes"] += len(scored)
+            snap["stats"]["infeasible_skipped"] += (
+                len(candidates) - len(scored))
+        scored.sort()
+        return [node for _, node in scored]
+
+    def _node_feasible(self, cache: dict, node: str, req, driver: str,
+                       plan_key, plan: _MatchPlan, consumed) -> bool:
+        entry = cache["entries"].get((driver, node))
+        if entry is None:
+            return False
+        matched = self._matching_devices(cache, driver, node, plan_key, plan)
+        if not matched:
+            return False
+        want = len(matched) if req.allocation_mode == "All" else req.count
+        if len(matched) < want:
+            return False
+        if consumed is None:
+            return True  # nothing consumed: matching count is the answer
+        fit = 0
+        for d in matched:
+            if self._dev_fits_base(d, entry["caps"], consumed):
+                fit += 1
+                if fit >= want:
+                    return True
+        return fit >= want
 
     # -- allocation -----------------------------------------------------------
 

@@ -15,14 +15,24 @@ Composes everything into a runnable in-process cluster:
 
 Deterministic by design: drive with ``step()`` until convergence instead of
 background threads, so e2e tests never race.
+
+Event-driven by design too: every pass feeds off the API server's watch
+stream. Events drain into per-pass dirty sets, so the scheduler reconciles
+only pods that changed (plus an unschedulable backlog retried on capacity
+events), the kubelet only pods with node-side work outstanding, and the
+DaemonSet/GC/chaos passes skip entirely when nothing they react to moved —
+a quiet cluster steps in O(1), not O(objects). ``settle()``/``wait_for()``
+detect that quiescence through the store's O(1) kind fingerprints instead
+of re-listing every pod per step.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import queue
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 from k8s_dra_driver_tpu.api.configs import (
     COMPUTE_DOMAIN_DRIVER_NAME,
@@ -35,17 +45,21 @@ from k8s_dra_driver_tpu.controller.templates import (
     DEVICE_CLASS_TPU,
 )
 from k8s_dra_driver_tpu.daemon import SliceAgent
-from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
+from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError, WatchEvent
 from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError
 from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    COMPUTE_DOMAIN_CLIQUE,
     DAEMON_SET,
     DEVICE_CLASS,
     DeviceClass,
+    NODE,
     Node,
     POD,
     Pod,
     RESOURCE_CLAIM,
     RESOURCE_CLAIM_TEMPLATE,
+    RESOURCE_SLICE,
     ResourceClaim,
 )
 from k8s_dra_driver_tpu.k8s.objects import new_meta
@@ -75,6 +89,20 @@ CHAOS_CHIP_HEALTH_ANNOTATION = "sim.tpu.google.com/chip-health"
 # Comma-list env keys whose values union when a pod holds several claims
 # (each claim's CDI spec names only its own chips).
 UNION_ENV_KEYS = {"TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES"}
+
+# Kinds whose watch streams drive the dirty sets. RESOURCE_SLICE /
+# RESOURCE_CLAIM_TEMPLATE / DEVICE_CLASS events carry no per-object work of
+# their own but mean previously-unschedulable pods may now fit.
+_WATCHED_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
+                  RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS)
+
+# Kinds whose fingerprints define "nothing is moving" for settle()/
+# wait_for(): everything the control loops read or write.
+_QUIESCENCE_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
+                     RESOURCE_CLAIM_TEMPLATE, COMPUTE_DOMAIN,
+                     COMPUTE_DOMAIN_CLIQUE)
+
+_PodKey = Tuple[str, str]  # (namespace, name)
 
 
 @dataclass
@@ -112,12 +140,34 @@ class SimCluster:
         # and the allocator expose on it (per-node series merge — the
         # sim's /metrics reads as a cluster aggregate).
         self.metrics_registry = metrics_registry or Registry()
+        if hasattr(self.api, "attach_metrics"):
+            self.api.attach_metrics(self.metrics_registry)
         self.allocator = Allocator(self.api,
                                    metrics_registry=self.metrics_registry)
         self.profile = profile
         self.nodes: Dict[str, SimNode] = {}
         self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
         self._gc_prev_claim_uids: set = set()
+        # -- dirty-set state fed by the watch streams -----------------------
+        # Subscribed before any object is created below, so the cluster's
+        # own bootstrap (nodes, device classes, published slices) arrives
+        # as ordinary events; a pre-seeded api is covered by the one-shot
+        # bootstrap scan on the first pass.
+        self._watch_queues: Dict[str, "queue.Queue[WatchEvent]"] = {
+            kind: self.api.watch(kind) for kind in _WATCHED_KINDS
+        }
+        self._sched_dirty: Set[_PodKey] = set()    # pods needing scheduling
+        self._sched_backlog: Set[_PodKey] = set()  # unschedulable, awaiting capacity
+        self._kubelet_dirty: Set[_PodKey] = set()  # bound pods not yet Running
+        self._ds_dirty = True
+        self._gc_dirty = True
+        self._chaos_dirty = True
+        self._gc_deleted_claim_uids: set = set()
+        # (node, pod name) -> latest Pod, maintained straight from the
+        # watch stream — the agent pass never re-lists pods to find its
+        # containers.
+        self._agent_pods: Dict[Tuple[str, str], Pod] = {}
+        self._bootstrapped = False
         self.controller = Controller(
             self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600,
             metrics_registry=self.metrics_registry,
@@ -222,6 +272,93 @@ class SimCluster:
             node.tpu_driver.shutdown()
             node.cd_driver.shutdown()
         self.controller.stop()
+        for kind, q in self._watch_queues.items():
+            self.api.stop_watch(kind, q)
+
+    # -- event ingestion ---------------------------------------------------------
+
+    def _drain_events(self) -> None:
+        """Move pending watch events into the per-pass dirty sets. Called
+        at the top of every pass so work created earlier in the same step
+        (a DS-created pod, a bind) is visible to the next pass without
+        waiting a whole step."""
+        if not self._bootstrapped:
+            self._bootstrap_dirty()
+        for kind, q in self._watch_queues.items():
+            while True:
+                try:
+                    ev = q.get_nowait()
+                except queue.Empty:
+                    break
+                self._ingest(kind, ev)
+
+    def _bootstrap_dirty(self) -> None:
+        """One-shot full scan covering objects that existed before our
+        watches (a pre-seeded api passed into __init__)."""
+        self._bootstrapped = True
+        for pod in self.api.list(POD):
+            key = (pod.namespace, pod.meta.name)
+            if pod.phase == "Pending":
+                self._sched_dirty.add(key)
+            if pod.node_name and pod.phase not in ("Running", "Failed"):
+                self._kubelet_dirty.add(key)
+            if self._is_agent_pod(pod):
+                self._agent_pods[(pod.node_name, pod.meta.name)] = pod
+
+    @staticmethod
+    def _is_agent_pod(pod: Pod) -> bool:
+        return any(c.command and c.command[0] == "compute-domain-daemon"
+                   for c in pod.containers)
+
+    def _ingest(self, kind: str, ev: WatchEvent) -> None:
+        obj = ev.obj
+        if kind == POD:
+            key = (obj.meta.namespace, obj.meta.name)
+            self._ds_dirty = True          # ownership / ready counts moved
+            if self._is_agent_pod(obj):
+                akey = (obj.node_name, obj.meta.name)
+                if ev.type == "DELETED":
+                    self._agent_pods.pop(akey, None)
+                else:
+                    self._agent_pods[akey] = obj
+            if ev.type == "DELETED":
+                self._gc_dirty = True      # consumers / owned claims to drop
+                self._sched_dirty.discard(key)
+                self._sched_backlog.discard(key)
+                self._kubelet_dirty.discard(key)
+                return
+            if obj.phase == "Pending":
+                self._sched_dirty.add(key)
+            else:
+                self._sched_dirty.discard(key)
+                self._sched_backlog.discard(key)
+            if obj.node_name and obj.phase not in ("Running", "Failed"):
+                self._kubelet_dirty.add(key)
+            elif obj.phase in ("Running", "Failed"):
+                self._kubelet_dirty.discard(key)
+        elif kind == RESOURCE_CLAIM:
+            # Any claim movement can change GC's mind (ownerRefs, consumer
+            # lists, allocations) and can free capacity for the backlog.
+            self._gc_dirty = True
+            self._retry_backlog()
+            if ev.type == "DELETED":
+                self._gc_deleted_claim_uids.add(obj.uid)
+        elif kind == DAEMON_SET:
+            self._ds_dirty = True
+            if ev.type == "DELETED":
+                self._gc_dirty = True
+        elif kind == NODE:
+            self._chaos_dirty = True
+            self._ds_dirty = True
+            self._retry_backlog()
+        elif kind in (RESOURCE_SLICE, RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS):
+            # Capacity / matching rules changed: unschedulable pods may fit.
+            self._retry_backlog()
+
+    def _retry_backlog(self) -> None:
+        if self._sched_backlog:
+            self._sched_dirty |= self._sched_backlog
+            self._sched_backlog.clear()
 
     # -- control loop passes ----------------------------------------------------
 
@@ -236,35 +373,70 @@ class SimCluster:
         self.controller.drain(timeout=5)
         self._kubelet_pass()
 
+    def _quiescence_token(self) -> tuple:
+        """O(1) change-detection over every kind the control loops touch.
+        Two steps with identical tokens mean the second step wrote nothing
+        to the API — at that point further steps cannot make progress
+        (every pass is a function of API state plus idempotent retries)."""
+        fp = getattr(self.api, "kind_fingerprint", None)
+        if fp is None:
+            return (object(),)  # unknown backend: tokens never equal
+        return tuple(fp(kind) for kind in _QUIESCENCE_KINDS)
+
     def settle(self, max_steps: int = 20) -> None:
-        """Step until every pod reached a terminal-ish state or cap hit."""
+        """Step until every pod reached a terminal-ish state, the cluster
+        quiesced (two consecutive steps with no API writes — detected via
+        the O(1) kind fingerprints), or the cap hit."""
+        prev = None
+        quiet = 0
+        pods: List[Pod] = []
+        pod_fp = None
         for _ in range(max_steps):
             self.step()
-            pods = self.api.list(POD)
+            fp = getattr(self.api, "kind_fingerprint", None)
+            cur_pod_fp = fp(POD) if fp else None
+            if cur_pod_fp is None or cur_pod_fp != pod_fp:
+                pods = self.api.list(POD)
+                pod_fp = cur_pod_fp
             if all(p.phase in ("Running", "Failed") for p in pods):
+                return
+            token = self._quiescence_token()
+            quiet = quiet + 1 if token == prev else 0
+            prev = token
+            if quiet >= 2:
                 return
 
     def wait_for(self, predicate, max_steps: int = 20) -> bool:
         """Step until predicate(self) holds. Pod phases settling does not
         imply the controllers' status writes have converged (they may trail
-        by a pass), so status assertions should use this, not settle()."""
+        by a pass), so status assertions should use this, not settle().
+        Returns early once the cluster quiesces: if two consecutive steps
+        changed nothing, stepping further cannot flip the predicate."""
+        prev = None
+        quiet = 0
         for _ in range(max_steps):
             if predicate(self):
                 return True
             self.step()
+            token = self._quiescence_token()
+            quiet = quiet + 1 if token == prev else 0
+            prev = token
+            if quiet >= 2:
+                break
         return predicate(self)
 
     # -- DaemonSet controller ----------------------------------------------------
 
     def _daemonset_pass(self) -> None:
+        self._drain_events()
+        if not self._ds_dirty:
+            return
+        self._ds_dirty = False
         for ds in self.api.list(DAEMON_SET):
-            matching = self.api.list("Node", label_selector=ds.node_selector)
+            matching = self.api.list(NODE, label_selector=ds.node_selector)
             want = {n.name for n in matching}
-            have = {
-                p.node_name: p
-                for p in self.api.list(POD, namespace=ds.namespace)
-                if p.owned_by(ds)
-            }
+            ns_pods = self.api.list(POD, namespace=ds.namespace)
+            have = {p.node_name: p for p in ns_pods if p.owned_by(ds)}
             for node_name in want - have.keys():
                 pod = Pod(
                     meta=new_meta(
@@ -284,12 +456,18 @@ class SimCluster:
                     self.api.delete(POD, pod.meta.name, pod.namespace)
                 except NotFoundError:
                     pass
-            def set_counts(obj, desired=len(want)):
+            # Ready count computed ONCE from the listing above — not
+            # re-listed inside the mutation closure on every CAS retry.
+            desired = len(want)
+            ready = sum(1 for p in ns_pods
+                        if p.owned_by(ds) and p.ready
+                        and p.node_name in want)
+            if ds.desired == desired and ds.ready == ready:
+                continue  # no-op status write would just churn the watch
+
+            def set_counts(obj, desired=desired, ready=ready):
                 obj.desired = desired
-                obj.ready = sum(
-                    1 for p in self.api.list(POD, namespace=ds.namespace)
-                    if p.owned_by(ds) and p.ready
-                )
+                obj.ready = ready
             try:
                 self.api.update_with_retry(DAEMON_SET, ds.meta.name, ds.namespace, set_counts)
             except NotFoundError:
@@ -343,85 +521,120 @@ class SimCluster:
                 sp.attrs.update(self.allocator.last_pass_stats)
 
     def _scheduler_pass_inner(self) -> None:
-        for pod in self.api.list(POD):
-            if pod.phase != "Pending":
-                continue
-            try:
-                claims = self._ensure_claims_for_pod(pod)
-            except AllocationError as e:
-                log.debug("pod %s: %s", pod.key, e)
-                continue
-            unallocated = [c for c in claims.values() if c.allocation is None]
-            allocated_nodes = {
-                c.allocation.node_name for c in claims.values()
-                if c.allocation is not None and c.allocation.node_name
-            }
-            if len(allocated_nodes) > 1:
-                self._fail_pod(pod, f"claims allocated on different nodes: {allocated_nodes}")
-                continue
-            if pod.node_name and allocated_nodes and pod.node_name not in allocated_nodes:
-                # A nodeName-pinned pod whose shared claim is already
-                # allocated elsewhere can never be prepared there.
-                self._fail_pod(
-                    pod,
-                    f"pod pinned to {pod.node_name} but claim allocated on "
-                    f"{next(iter(allocated_nodes))}",
-                )
-                continue
-            if pod.node_name:
-                candidates = [pod.node_name]
-            elif allocated_nodes:
-                # A shared, already-allocated claim pins the pod to its node.
-                candidates = [next(iter(allocated_nodes))]
-            else:
-                candidates = sorted(self.nodes)
-            chosen = pod.node_name
-            if unallocated:
-                placed = False
-                failed = False
-                for node in candidates:
-                    results = []
-                    ok = True
-                    for c in unallocated:
-                        # Sibling claims computed this pass count as
-                        # consumed, or two claims of one pod double-book.
-                        try:
-                            r = self.allocator.allocate_on_node(
-                                c, node, in_flight=[r for _, r in results])
-                        except AllocationError as e:
-                            # A malformed class/selector must fail THIS
-                            # pod visibly, not abort the scheduler pass
-                            # for every other pod.
-                            self._fail_pod(pod, f"allocation: {e}")
-                            failed = True
-                            ok = False
-                            break
-                        if r is None:
-                            ok = False
-                            break
-                        results.append((c, r))
-                    if failed:
-                        break
-                    if ok:
-                        for c, r in results:
-                            # Consumers are recorded by the reserve loop
-                            # below; allocation only here.
-                            def set_alloc(obj, r=r):
-                                obj.allocation = r
-                            self.api.update_with_retry(
-                                RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
-                            )
-                            self.allocator.commit(r)
-                        chosen = node
-                        placed = True
-                        break
-                if failed:
-                    continue  # pod already marked Failed
-                if not placed:
-                    log.debug("pod %s: unschedulable this pass", pod.key)
+        self._drain_events()
+        work, self._sched_dirty = self._sched_dirty, set()
+        pending = sorted(work)
+        try:
+            while pending:
+                key = pending.pop(0)
+                pod = self.api.try_get(POD, key[1], key[0])
+                if pod is None or pod.phase != "Pending":
                     continue
-            if not chosen:
-                chosen = candidates[0] if candidates else ""
+                if self._schedule_pod(pod) == "unschedulable":
+                    # Parked until a capacity event (claim/slice/node/
+                    # template movement) promotes the backlog back into
+                    # the dirty set.
+                    self._sched_backlog.add(key)
+        except BaseException:
+            # A mid-pass crash (e.g. a CAS retry exhausting against a
+            # concurrent controller) must not silently drop the pods we
+            # drained but never reached — the old re-list-every-pass
+            # scheduler self-healed; re-dirty them so the next pass does.
+            self._sched_dirty.add(key)
+            self._sched_dirty.update(pending)
+            raise
+
+    def _schedule_pod(self, pod: Pod) -> str:
+        """Schedule one Pending pod; returns 'bound', 'unschedulable', or
+        'failed'. Probes only allocator-feasible nodes, most-free-first;
+        the exhaustive probe-every-node path remains available as the
+        oracle the feasibility property tests diff against."""
+        try:
+            claims = self._ensure_claims_for_pod(pod)
+        except AllocationError as e:
+            log.debug("pod %s: %s", pod.key, e)
+            return "unschedulable"
+        unallocated = [c for c in claims.values() if c.allocation is None]
+        allocated_nodes = {
+            c.allocation.node_name for c in claims.values()
+            if c.allocation is not None and c.allocation.node_name
+        }
+        if len(allocated_nodes) > 1:
+            self._fail_pod(pod, f"claims allocated on different nodes: {allocated_nodes}")
+            return "failed"
+        if pod.node_name and allocated_nodes and pod.node_name not in allocated_nodes:
+            # A nodeName-pinned pod whose shared claim is already
+            # allocated elsewhere can never be prepared there.
+            self._fail_pod(
+                pod,
+                f"pod pinned to {pod.node_name} but claim allocated on "
+                f"{next(iter(allocated_nodes))}",
+            )
+            return "failed"
+        if pod.node_name:
+            candidates = [pod.node_name]
+        elif allocated_nodes:
+            # A shared, already-allocated claim pins the pod to its node.
+            candidates = [next(iter(allocated_nodes))]
+        else:
+            candidates = None  # chosen per-claim-set below
+        chosen = pod.node_name
+        if unallocated:
+            if candidates is None:
+                # Feasibility pre-filter: only nodes that can possibly
+                # satisfy every unallocated claim, most-free-first.
+                try:
+                    feasible = self.allocator.feasible_nodes(unallocated)
+                except AllocationError as e:
+                    self._fail_pod(pod, f"allocation: {e}")
+                    return "failed"
+                candidates = [n for n in feasible if n in self.nodes]
+            placed = False
+            for node in candidates:
+                results = []
+                ok = True
+                for c in unallocated:
+                    # Sibling claims computed this pass count as
+                    # consumed, or two claims of one pod double-book.
+                    try:
+                        r = self.allocator.allocate_on_node(
+                            c, node, in_flight=[r for _, r in results])
+                    except AllocationError as e:
+                        # A malformed class/selector must fail THIS
+                        # pod visibly, not abort the scheduler pass
+                        # for every other pod.
+                        self._fail_pod(pod, f"allocation: {e}")
+                        return "failed"
+                    if r is None:
+                        ok = False
+                        break
+                    results.append((c, r))
+                if ok:
+                    for c, r in results:
+                        # Consumers are recorded by the reserve loop
+                        # below; allocation only here.
+                        def set_alloc(obj, r=r):
+                            obj.allocation = r
+                        self.api.update_with_retry(
+                            RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
+                        )
+                        self.allocator.commit(r)
+                    chosen = node
+                    placed = True
+                    break
+            if not placed:
+                log.debug("pod %s: unschedulable this pass", pod.key)
+                return "unschedulable"
+        if not chosen:
+            if candidates is None:
+                # No claims and no pin (a plain pod): any node will do.
+                candidates = sorted(self.nodes)
+            if not candidates:
+                # Nowhere to put it (no nodes yet): park it so a NODE
+                # event retries, instead of dropping it as 'bound'.
+                return "unschedulable"
+            chosen = candidates[0]
+        if pod.node_name != chosen:
             with tracing.span(
                     "scheduler.bind", pod=pod.key, node=chosen,
                     claim_uids=[c.uid for c in claims.values()]):
@@ -430,89 +643,117 @@ class SimCluster:
                 try:
                     self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
                 except NotFoundError:
-                    continue
-            # Every consumer of a claim is recorded (shared claims have
-            # several); unprepare only happens when the last one is gone.
-            from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
+                    return "bound"
+        # Every consumer of a claim is recorded (shared claims have
+        # several); unprepare only happens when the last one is gone.
+        from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
 
-            for c in claims.values():
-                def reserve(obj, pod=pod):
-                    if not any(r.uid == pod.uid for r in obj.reserved_for):
-                        obj.reserved_for.append(ResourceClaimConsumer(
-                            kind=POD, name=pod.meta.name, uid=pod.uid,
-                        ))
-                try:
-                    self.api.update_with_retry(
-                        RESOURCE_CLAIM, c.meta.name, c.namespace, reserve
-                    )
-                except NotFoundError:
-                    pass
+        for c in claims.values():
+            if any(r.uid == pod.uid for r in c.reserved_for):
+                continue  # already reserved: skip the no-op write
+
+            def reserve(obj, pod=pod):
+                if not any(r.uid == pod.uid for r in obj.reserved_for):
+                    obj.reserved_for.append(ResourceClaimConsumer(
+                        kind=POD, name=pod.meta.name, uid=pod.uid,
+                    ))
+            try:
+                self.api.update_with_retry(
+                    RESOURCE_CLAIM, c.meta.name, c.namespace, reserve
+                )
+            except NotFoundError:
+                pass
+        return "bound"
 
     # -- kubelet -------------------------------------------------------------------
 
     def _kubelet_pass(self) -> None:
-        for pod in self.api.list(POD):
-            if not pod.node_name or pod.phase == "Running":
-                continue
-            node = self.nodes.get(pod.node_name)
-            if node is None:
-                continue
-            try:
-                claims = self._ensure_claims_for_pod(pod)
-            except AllocationError:
-                continue
-            if any(c.allocation is None for c in claims.values()):
-                continue
-            env: Dict[str, str] = {}
-            devices: List[str] = []
-            outcome = "ready"
-            for claim in claims.values():
-                for driver_name in sorted({r.driver for r in claim.allocation.devices}):
-                    plugin = (
-                        node.tpu_driver if driver_name == TPU_DRIVER_NAME
-                        else node.cd_driver
-                    )
-                    res = plugin.prepare_resource_claims([claim])[claim.uid]
-                    if isinstance(res, RetryableError):
-                        outcome = "retry"  # pod stays ContainerCreating
-                    elif isinstance(res, Exception):
-                        self._fail_pod(pod, str(res))
-                        outcome = "failed"
-                        break
-                    else:
-                        cdi = plugin.state.cdi if hasattr(plugin, "state") else plugin.cdi
-                        spec = cdi.read_claim_spec(claim.uid)
-                        for dev in (spec or {}).get("devices", []):
-                            edits = dev.get("containerEdits", {})
-                            for e in edits.get("env", []):
-                                k, _, v = e.partition("=")
-                                if k in UNION_ENV_KEYS and env.get(k) and v:
-                                    # A pod holding several claims sees the
-                                    # union of their chip lists, like its
-                                    # device nodes (scalar env is CDI
-                                    # last-wins).
-                                    merged = set(env[k].split(",")) | set(v.split(","))
-                                    env[k] = ",".join(
-                                        sorted(merged, key=lambda s: (len(s), s)))
-                                else:
-                                    env[k] = v
-                            for dn in edits.get("deviceNodes", []):
-                                devices.append(dn["path"])
-                if outcome == "failed":
-                    break
-            if outcome != "ready":
-                continue
+        self._drain_events()
+        work, self._kubelet_dirty = self._kubelet_dirty, set()
+        pending = sorted(work)
+        try:
+            while pending:
+                key = pending.pop(0)
+                pod = self.api.try_get(POD, key[1], key[0])
+                if pod is None or not pod.node_name or pod.phase in ("Running", "Failed"):
+                    continue
+                if not self._kubelet_sync_pod(pod):
+                    # Outstanding node-side work (retryable prepare, claims
+                    # not yet allocated): stays dirty so the next pass
+                    # retries even if no event touches this pod itself.
+                    self._kubelet_dirty.add(key)
+        except BaseException:
+            # Same self-healing contract as the scheduler pass: a mid-pass
+            # crash re-dirties everything not yet processed.
+            self._kubelet_dirty.add(key)
+            self._kubelet_dirty.update(pending)
+            raise
 
-            def run(obj, env=env, devices=devices):
-                obj.phase = "Running"
-                obj.ready = True
-                obj.pod_ip = obj.pod_ip or f"10.1.{abs(hash(obj.meta.name)) % 250}.{abs(hash(obj.namespace)) % 250}"
-                obj.injected_env = env
-                obj.injected_devices = sorted(set(devices))
-            try:
-                self.api.update_with_retry(POD, pod.meta.name, pod.namespace, run)
-            except NotFoundError:
-                continue
+    def _kubelet_sync_pod(self, pod: Pod) -> bool:
+        """Run one kubelet sync for a bound pod; True when the pod reached
+        a terminal phase (Running/Failed) and needs no more kubelet work."""
+        node = self.nodes.get(pod.node_name)
+        if node is None:
+            return False
+        try:
+            claims = self._ensure_claims_for_pod(pod)
+        except AllocationError:
+            return False
+        if any(c.allocation is None for c in claims.values()):
+            return False
+        env: Dict[str, str] = {}
+        devices: List[str] = []
+        outcome = "ready"
+        for claim in claims.values():
+            for driver_name in sorted({r.driver for r in claim.allocation.devices}):
+                plugin = (
+                    node.tpu_driver if driver_name == TPU_DRIVER_NAME
+                    else node.cd_driver
+                )
+                res = plugin.prepare_resource_claims([claim])[claim.uid]
+                if isinstance(res, RetryableError):
+                    outcome = "retry"  # pod stays ContainerCreating
+                elif isinstance(res, Exception):
+                    self._fail_pod(pod, str(res))
+                    outcome = "failed"
+                    break
+                else:
+                    cdi = plugin.state.cdi if hasattr(plugin, "state") else plugin.cdi
+                    spec = cdi.read_claim_spec(claim.uid)
+                    for dev in (spec or {}).get("devices", []):
+                        edits = dev.get("containerEdits", {})
+                        for e in edits.get("env", []):
+                            k, _, v = e.partition("=")
+                            if k in UNION_ENV_KEYS and env.get(k) and v:
+                                # A pod holding several claims sees the
+                                # union of their chip lists, like its
+                                # device nodes (scalar env is CDI
+                                # last-wins).
+                                merged = set(env[k].split(",")) | set(v.split(","))
+                                env[k] = ",".join(
+                                    sorted(merged, key=lambda s: (len(s), s)))
+                            else:
+                                env[k] = v
+                        for dn in edits.get("deviceNodes", []):
+                            devices.append(dn["path"])
+            if outcome == "failed":
+                break
+        if outcome == "failed":
+            return True
+        if outcome != "ready":
+            return False
+
+        def run(obj, env=env, devices=devices):
+            obj.phase = "Running"
+            obj.ready = True
+            obj.pod_ip = obj.pod_ip or f"10.1.{abs(hash(obj.meta.name)) % 250}.{abs(hash(obj.namespace)) % 250}"
+            obj.injected_env = env
+            obj.injected_devices = sorted(set(devices))
+        try:
+            self.api.update_with_retry(POD, pod.meta.name, pod.namespace, run)
+        except NotFoundError:
+            pass
+        return True
 
     def _fail_pod(self, pod: Pod, message: str) -> None:
         def mutate(obj, message=message):
@@ -528,13 +769,11 @@ class SimCluster:
 
     def _agent_pass(self) -> None:
         """Run/stop SliceAgents for slice-agent pods — the 'container' the
-        DaemonSet started."""
-        agent_pods = {}
-        for pod in self.api.list(POD):
-            cmds = [c.command for c in pod.containers]
-            if any(cmd and cmd[0] == "compute-domain-daemon" for cmd in cmds):
-                agent_pods[(pod.node_name, pod.meta.name)] = pod
-        for (node_name, pod_name), pod in agent_pods.items():
+        DaemonSet started. Pod discovery is event-gated; the per-agent
+        sync loop runs every step (clique convergence is driven by the
+        agents themselves, not by API churn)."""
+        self._drain_events()
+        for (node_name, pod_name), pod in list(self._agent_pods.items()):
             node = self.nodes.get(node_name)
             if node is None:
                 continue
@@ -577,23 +816,23 @@ class SimCluster:
             )
             agent.startup()
             agent._sim_pod_uid = pod.uid  # restart detection on DS recreate
+            agent._sim_pod_ns = pod.namespace  # direct lookup in the sync loop
             node.agents[pod_name] = agent
         # Sync all agents; mark their pods ready per probe result.
         for node in self.nodes.values():
-            live = set()
             for pod_name, agent in list(node.agents.items()):
-                pod = next(
-                    (p for p in self.api.list(POD) if p.meta.name == pod_name
-                     and p.node_name == node.name),
-                    None,
-                )
+                ns = getattr(agent, "_sim_pod_ns", "default")
+                pod = self.api.try_get(POD, pod_name, ns)
+                if pod is not None and pod.node_name != node.name:
+                    pod = None  # recreated on another node: not ours
                 if pod is None:
                     agent.shutdown()
                     del node.agents[pod_name]
                     continue
-                live.add(pod_name)
                 agent.sync()
                 ready = agent.check()
+                if pod.ready == ready and pod.phase == "Running":
+                    continue  # probe result unchanged: skip the no-op write
 
                 def set_ready(obj, ready=ready):
                     obj.ready = ready
@@ -611,14 +850,24 @@ class SimCluster:
 
     # -- API-observed garbage collection -------------------------------------------
 
-    def _gc_pass(self) -> None:
+    def _gc_pass(self, force: bool = False) -> None:
         """React to deletions observed through the API — the path a kubectl
         delete takes on a real cluster: the garbage collector removes
         generated claims whose owner pod is gone (ownerRef GC), the
         resource-claim controller drops consumers of deleted pods, and the
         kubelet unprepares claims that no longer have any consumer or whose
         claim object vanished (the plugins' stale-claim cleanup,
-        reference cleanup.go:149-259, runs the same sweep on a timer)."""
+        reference cleanup.go:149-259, runs the same sweep on a timer).
+
+        Event-gated: runs only when a pod/DaemonSet/claim deletion or any
+        claim movement was observed since the last run (``force=True`` for
+        the direct delete_pod path, which bypasses the step loop)."""
+        self._drain_events()
+        if not (self._gc_dirty or force):
+            return
+        self._gc_dirty = False
+        event_deleted, self._gc_deleted_claim_uids = (
+            self._gc_deleted_claim_uids, set())
         ds_uids = {d.uid for d in self.api.list(DAEMON_SET)}
         for pod in self.api.list(POD):
             owner_ds = [r for r in pod.meta.owner_references if r.kind == DAEMON_SET]
@@ -629,7 +878,7 @@ class SimCluster:
                 except NotFoundError:
                     pass
         pod_uids = {p.uid for p in self.api.list(POD)}
-        deleted_now: set = set()
+        deleted_now: set = set(event_deleted)
         for claim in self.api.list(RESOURCE_CLAIM):
             owner_pods = [r for r in claim.meta.owner_references if r.kind == POD]
             if owner_pods and all(r.uid not in pod_uids for r in owner_pods):
@@ -654,11 +903,10 @@ class SimCluster:
                     pass
         # The unprepare sweep reads every plugin checkpoint from disk, so
         # only run it when the API state suggests something to clean: a
-        # claim uid vanished since the last pass, or an allocated claim
-        # lost its last consumer. Steady state skips the file reads.
+        # claim uid vanished since the last pass (set-diff, plus DELETED
+        # watch events covering claims that lived less than one gc run),
+        # or an allocated claim lost its last consumer.
         live = {c.uid: c for c in self.api.list(RESOURCE_CLAIM)}
-        # In-pass deletions (deleted_now) never made it into the previous
-        # snapshot when the claim lived for less than one tick.
         vanished = (self._gc_prev_claim_uids - live.keys()) | deleted_now
         self._gc_prev_claim_uids = set(live.keys())
         unconsumed = any(
@@ -685,8 +933,14 @@ class SimCluster:
     def _chaos_pass(self) -> None:
         """Apply CHAOS_CHIP_HEALTH_ANNOTATION deltas from Node objects to the
         mock tpulib, so external (kubectl-level) suites can drive the
-        health -> taint -> republish chain (device_health.go:103-274)."""
-        for node_obj in self.api.list("Node"):
+        health -> taint -> republish chain (device_health.go:103-274).
+        Event-gated on Node watch events — annotation edits arrive as
+        MODIFIED; a quiet cluster skips the node listing entirely."""
+        self._drain_events()
+        if not self._chaos_dirty:
+            return
+        self._chaos_dirty = False
+        for node_obj in self.api.list(NODE):
             sim_node = self.nodes.get(node_obj.meta.name)
             if sim_node is None:
                 continue
@@ -725,4 +979,4 @@ class SimCluster:
             self.api.delete(POD, name, namespace)
         except NotFoundError:
             pass
-        self._gc_pass()
+        self._gc_pass(force=True)

@@ -1,0 +1,174 @@
+"""Dirty-set control loops + fingerprint quiescence in SimCluster.
+
+The scheduler/kubelet/GC/DaemonSet/chaos passes feed off the API watch
+stream: a quiet cluster must step without listing anything, unschedulable
+pods must be parked until a capacity event and then retried, and
+settle()/wait_for() must stop stepping once two consecutive steps wrote
+nothing (detected via the store's O(1) kind fingerprints).
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: rct, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: %d}}]
+"""
+
+
+def make_pod_yaml(name, claim="rct"):
+    return f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: {name}, namespace: default}}
+spec:
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: {claim}}}]
+"""
+
+
+@pytest.fixture
+def sim(tmp_path):
+    s = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _apply(sim, text):
+    for obj in load_manifests(text):
+        sim.api.create(obj)
+
+
+def test_quiet_cluster_steps_without_listing(sim):
+    _apply(sim, RCT % 1)
+    _apply(sim, make_pod_yaml("p0"))
+    sim.settle()
+    assert sim.api.get(POD, "p0", "default").phase == "Running"
+    # Drain any trailing convergence, then measure pure steady state.
+    for _ in range(3):
+        sim.step()
+    before = sim.api.stats.snapshot()
+    for _ in range(5):
+        sim.step()
+    after = sim.api.stats.snapshot()
+    assert after["list_calls"] == before["list_calls"], (
+        "steady-state steps must not list anything "
+        f"(+{after['list_calls'] - before['list_calls']} calls)")
+    assert after["objects_scanned"] == before["objects_scanned"]
+
+
+def test_settle_stops_on_quiescence_not_step_cap(sim):
+    _apply(sim, RCT % 1)
+    _apply(sim, make_pod_yaml("p0"))
+    sim.settle()
+    steps = [0]
+    orig_step = sim.step
+
+    def counting_step():
+        steps[0] += 1
+        orig_step()
+
+    sim.step = counting_step
+    # Converged cluster: a huge cap must not mean a huge number of steps.
+    sim.settle(max_steps=500)
+    assert steps[0] <= 4, f"settle kept stepping a quiet cluster: {steps[0]}"
+    sim.step = orig_step
+
+
+def test_wait_for_false_predicate_exits_on_quiescence(sim):
+    _apply(sim, RCT % 1)
+    _apply(sim, make_pod_yaml("p0"))
+    sim.settle()
+    steps = [0]
+    orig_step = sim.step
+
+    def counting_step():
+        steps[0] += 1
+        orig_step()
+
+    sim.step = counting_step
+    assert sim.wait_for(lambda s: False, max_steps=500) is False
+    assert steps[0] <= 4, f"wait_for kept stepping a quiet cluster: {steps[0]}"
+    sim.step = orig_step
+
+
+def test_unschedulable_pod_parked_then_retried_on_capacity_event(sim):
+    """A pod that fits nowhere is parked in the backlog (no probing, no
+    churn); deleting the pod that holds its capacity frees it and the
+    backlog pod schedules on the very next settle."""
+    _apply(sim, RCT % 4)  # whole-node claims
+    _apply(sim, make_pod_yaml("hog-0"))
+    _apply(sim, make_pod_yaml("hog-1"))
+    sim.settle()
+    pods = {p.meta.name: p.phase for p in sim.api.list(POD)}
+    assert pods == {"hog-0": "Running", "hog-1": "Running"}
+
+    _apply(sim, make_pod_yaml("parked"))
+    sim.settle()
+    assert sim.api.get(POD, "parked", "default").phase == "Pending"
+    # Parked: once quiesced, further steps issue zero allocator probes.
+    sim.step()
+    sim.step()
+    assert sim.allocator.last_pass_stats["nodes_probed"] == 0
+    assert ("default", "parked") in sim._sched_backlog
+
+    sim.delete_pod("hog-0", "default")  # capacity event: claim deleted
+    sim.settle()
+    assert sim.api.get(POD, "parked", "default").phase == "Running"
+
+
+def test_missing_template_pod_retried_when_template_appears(sim):
+    _apply(sim, make_pod_yaml("early", claim="late-rct"))
+    sim.settle()
+    assert sim.api.get(POD, "early", "default").phase == "Pending"
+    _apply(sim, """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: late-rct, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+""")
+    sim.settle()
+    assert sim.api.get(POD, "early", "default").phase == "Running"
+
+
+def test_bound_pods_do_not_rewrite_api_every_step(sim):
+    """The pre-dirty-set scheduler re-ran bind/reserve writes for every
+    Pending pod each pass; the indexed one must leave a converged pod's
+    resourceVersion alone."""
+    _apply(sim, RCT % 1)
+    _apply(sim, make_pod_yaml("p0"))
+    sim.settle()
+    rv_pod = sim.api.get(POD, "p0", "default").meta.resource_version
+    rv_claim = sim.api.get(RESOURCE_CLAIM, "p0-t", "default").meta.resource_version
+    for _ in range(4):
+        sim.step()
+    assert sim.api.get(POD, "p0", "default").meta.resource_version == rv_pod
+    assert sim.api.get(
+        RESOURCE_CLAIM, "p0-t", "default").meta.resource_version == rv_claim
+
+
+def test_delete_pod_still_unprepares_via_forced_gc(sim):
+    """delete_pod bypasses the step loop; the forced GC must still drop
+    consumers and unprepare — the claim vanishes and chips free up."""
+    _apply(sim, RCT % 4)
+    _apply(sim, make_pod_yaml("p0"))
+    sim.settle()
+    assert sim.api.get(POD, "p0", "default").phase == "Running"
+    sim.delete_pod("p0", "default")
+    assert sim.api.try_get(RESOURCE_CLAIM, "p0-t", "default") is None
+    # All four chips are allocatable again.
+    _apply(sim, make_pod_yaml("p1"))
+    sim.settle()
+    assert sim.api.get(POD, "p1", "default").phase == "Running"
