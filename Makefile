@@ -2,7 +2,7 @@
 
 include versions.mk
 
-.PHONY: all native test e2e bench bench-smoke ci clean version verify check-metrics-docs test-tier1
+.PHONY: all native test e2e bench bench-smoke ci clean version verify check-metrics-docs check-event-reasons test-tier1
 
 version:
 	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
@@ -36,10 +36,13 @@ bench-smoke:
 
 # Pre-merge gate: doc/code consistency checks plus the tier-1 pytest run
 # (the suite ROADMAP.md pins as the regression floor).
-verify: check-metrics-docs test-tier1
+verify: check-metrics-docs check-event-reasons test-tier1
 
 check-metrics-docs:
 	python hack/check_metrics_docs.py
+
+check-event-reasons:
+	python hack/check_event_reasons.py
 
 test-tier1:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

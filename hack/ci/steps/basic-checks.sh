@@ -49,6 +49,9 @@ EOF
 echo "-- metrics documented"
 "${PYTHON:-python}" hack/check_metrics_docs.py
 
+echo "-- event reasons documented"
+"${PYTHON:-python}" hack/check_event_reasons.py
+
 echo "-- VERSION is semver"
 check_version
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE
 from k8s_dra_driver_tpu.k8s.objects import K8sObject
 
@@ -35,6 +36,13 @@ CD_STATUS_REJECTED = "Rejected"
 # (/root/reference/cmd/compute-domain-controller/main.go:55-60). A v5e pod
 # slice tops out at 64 hosts (v5e-256 = 64 hosts x 4 chips).
 DEFAULT_MAX_NODES_PER_DOMAIN = 64
+
+# Typed condition types on ComputeDomainStatus.conditions. `status.status`
+# stays as the coarse summary string; the conditions carry the reasoned,
+# transition-timestamped history `kubectl describe` renders.
+CD_COND_VALIDATED = "Validated"   # spec passed domain-bounds validation
+CD_COND_READY = "Ready"           # required member nodes registered + ready
+CD_COND_DEGRADED = "Degraded"     # a member node publishes unhealthy devices
 
 
 @dataclass
@@ -67,6 +75,7 @@ class ComputeDomainNode:
 class ComputeDomainStatus:
     status: str = CD_STATUS_NOT_READY
     nodes: List[ComputeDomainNode] = field(default_factory=list)
+    conditions: List[Condition] = field(default_factory=list)
 
 
 @dataclass

@@ -106,6 +106,9 @@ def main(argv=None) -> int:
         log.error("COMPUTE_DOMAIN_UUID not set; was the daemon claim prepared?")
         return 1
 
+    from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
+
+    registry = Registry()
     api = resolve_api(args)
     agent = SliceAgent(
         api=api,
@@ -119,6 +122,7 @@ def main(argv=None) -> int:
         pod_name=os.environ.get("POD_NAME", ""),
         pod_namespace=os.environ.get("POD_NAMESPACE", ""),
         isolation=slice_config.isolation.value,
+        metrics_registry=registry,
     )
     agent.startup()
     log.info("%s registered: index=%d ici=%s",
@@ -126,9 +130,7 @@ def main(argv=None) -> int:
 
     metrics_srv = None
     if args.metrics_port:
-        from k8s_dra_driver_tpu.pkg.metrics import MetricsServer, Registry
-
-        metrics_srv = MetricsServer(Registry(), host="0.0.0.0",
+        metrics_srv = MetricsServer(registry, host="0.0.0.0",
                                     port=args.metrics_port, debug_path="/debug")
         metrics_srv.start()
 

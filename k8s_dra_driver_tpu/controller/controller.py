@@ -9,11 +9,15 @@ removal, orphan cleanup, and leader election around the whole loop.
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.api.computedomain import (
+    CD_COND_DEGRADED,
+    CD_COND_READY,
+    CD_COND_VALIDATED,
     CD_STATUS_NOT_READY,
     CD_STATUS_READY,
     CD_STATUS_REJECTED,
@@ -29,14 +33,28 @@ from k8s_dra_driver_tpu.controller.templates import (
     workload_resource_claim_template,
 )
 from k8s_dra_driver_tpu.k8s import APIServer, Informer, NotFoundError
+from k8s_dra_driver_tpu.k8s.conditions import (
+    CONDITION_FALSE,
+    CONDITION_TRUE,
+    condition_true,
+    set_condition,
+)
 from k8s_dra_driver_tpu.k8s.core import (
     COMPUTE_DOMAIN,
     COMPUTE_DOMAIN_CLIQUE,
     DAEMON_SET,
     NODE,
     RESOURCE_CLAIM_TEMPLATE,
+    RESOURCE_SLICE,
 )
 from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_DOMAIN_DEGRADED,
+    REASON_DOMAIN_READY,
+    REASON_DOMAIN_RECOVERED,
+    REASON_DOMAIN_REJECTED,
+)
 from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
 from k8s_dra_driver_tpu.pkg.metrics import (
     ComputeDomainStatusMetric,
@@ -93,6 +111,8 @@ class Controller:
         self.slice_config = slice_config or SliceAgentConfig()
         registry = metrics_registry or Registry()
         self.metric = ComputeDomainStatusMetric(registry)
+        self.recorder = EventRecorder(api, "cd-controller",
+                                      metrics_registry=registry)
         self.reconciles_total = registry.register(Counter(
             "tpu_dra_reconciles_total",
             "Reconcile passes, by outcome (success/error).",
@@ -120,6 +140,20 @@ class Controller:
             on_update=lambda old, new: self._enqueue_for_clique(new),
             on_delete=lambda old, new: self._enqueue_for_clique(new),
         )
+        # Device health rides on ResourceSlice taints: a (re)publish must
+        # re-evaluate the Degraded condition of domains spanning that
+        # node. The handler maintains an O(1) node->tainted map (no store
+        # scan per reconcile) and enqueues only domains whose member set
+        # contains the slice's node.
+        self._taint_mu = threading.Lock()
+        self._slice_taints: Dict[str, Tuple[str, bool]] = {}  # slice -> (node, tainted)
+        self._tainted_nodes: Dict[str, int] = {}  # node -> tainted-slice count
+        self._slice_informer = Informer(api, RESOURCE_SLICE)
+        self._slice_informer.add_event_handler(
+            on_add=lambda old, new: self._on_slice_event(new, deleted=False),
+            on_update=lambda old, new: self._on_slice_event(new, deleted=False),
+            on_delete=lambda old, new: self._on_slice_event(new, deleted=True),
+        )
         self._elector: Optional[LeaderElector] = None
         if leader_elect:
             self._elector = LeaderElector(
@@ -138,6 +172,7 @@ class Controller:
     def start(self) -> None:
         self._cd_informer.start()
         self._clique_informer.start()
+        self._slice_informer.start()
         if self._elector is not None:
             self._elector.start()
         else:
@@ -154,6 +189,7 @@ class Controller:
         self._stop_workers()
         self._cd_informer.stop()
         self._clique_informer.stop()
+        self._slice_informer.stop()
         if self._cleanup_thread:
             self._cleanup_thread.join(timeout=5)
 
@@ -185,6 +221,36 @@ class Controller:
     def _enqueue_for_clique(self, clique) -> None:
         for cd in self._cd_informer.list(namespace=clique.meta.namespace):
             if cd.uid == getattr(clique, "domain_uid", None):
+                self._enqueue(cd)
+
+    def _on_slice_event(self, rs, deleted: bool) -> None:
+        """Fold one ResourceSlice event into the node->tainted map; enqueue
+        only the domains that span the slice's node, and only when the
+        node's taint verdict actually moved (a quiet republish — pool
+        generation bump, no taint change — enqueues nothing)."""
+        node = getattr(rs, "node_name", "")
+        if not node:
+            return
+        tainted = (not deleted) and any(
+            d.taints for d in getattr(rs, "devices", []))
+        key = rs.meta.name
+        with self._taint_mu:
+            prev_node, prev_tainted = self._slice_taints.get(key, ("", False))
+            if prev_tainted:
+                self._tainted_nodes[prev_node] = self._tainted_nodes.get(prev_node, 1) - 1
+                if self._tainted_nodes[prev_node] <= 0:
+                    del self._tainted_nodes[prev_node]
+            if deleted:
+                self._slice_taints.pop(key, None)
+            else:
+                self._slice_taints[key] = (node, tainted)
+                if tainted:
+                    self._tainted_nodes[node] = self._tainted_nodes.get(node, 0) + 1
+            changed = prev_tainted != tainted
+        if not changed:
+            return
+        for cd in self._cd_informer.list():
+            if any(n.name == node for n in cd.status.nodes):
                 self._enqueue(cd)
 
     def _reconcile_key(self, key, _obj) -> None:
@@ -258,8 +324,14 @@ class Controller:
         self._delete_owned_objects(cd)
         self._remove_node_labels(cd.uid)
 
-        def mutate(obj):
-            obj.status = ComputeDomainStatus(status=CD_STATUS_REJECTED, nodes=[])
+        def mutate(obj, reason=reason):
+            conds = copy.deepcopy(obj.status.conditions)
+            set_condition(conds, CD_COND_VALIDATED, CONDITION_FALSE,
+                          "BoundsExceeded", reason)
+            set_condition(conds, CD_COND_READY, CONDITION_FALSE,
+                          "Rejected", "domain spec failed validation")
+            obj.status = ComputeDomainStatus(
+                status=CD_STATUS_REJECTED, nodes=[], conditions=conds)
 
         fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
         if fresh is not None and fresh.status.status != CD_STATUS_REJECTED:
@@ -267,6 +339,8 @@ class Controller:
                 self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
             except NotFoundError:
                 return
+            self.recorder.warning(fresh, REASON_DOMAIN_REJECTED,
+                                  f"domain rejected: {reason}")
         self.metric.set(cd.namespace, cd.name, CD_STATUS_REJECTED)
 
     def _ensure_finalizer(self, cd: ComputeDomain) -> None:
@@ -363,26 +437,77 @@ class Controller:
             else CD_STATUS_NOT_READY
         )
 
+    def _degraded_member_nodes(self, member_names) -> List[str]:
+        """Member nodes whose published ResourceSlices carry tainted
+        (unhealthy / ICI-link-broken) devices — what flips the domain's
+        Degraded condition so schedulers and operators can route around a
+        bad host before jobs land on it. Reads the O(1) node map the slice
+        informer maintains; no store scan per reconcile."""
+        if not member_names:
+            return []
+        with self._taint_mu:
+            return sorted(set(member_names) & self._tainted_nodes.keys())
+
     def _update_status(self, cd: ComputeDomain) -> None:
         nodes = self._collect_nodes(cd)
         status = self._calculate_global_status(cd, nodes)
-        desired = ComputeDomainStatus(status=status, nodes=nodes)
         # Only write on change: an unconditional write emits MODIFIED, which
         # re-enqueues this CD, which writes again — a full-speed loop.
+        # Conditions are evolved from the live object so lastTransitionTime
+        # stays monotonic and a steady state compares equal.
         fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
         if fresh is None:
             return
+        ready_count = sum(1 for n in nodes if n.status == CD_STATUS_READY)
+        want = cd.spec.num_nodes or len(nodes)
+        degraded_nodes = self._degraded_member_nodes({n.name for n in nodes})
+        conds = copy.deepcopy(fresh.status.conditions)
+        set_condition(conds, CD_COND_VALIDATED, CONDITION_TRUE,
+                      "SpecValid", "")
+        if status == CD_STATUS_READY:
+            set_condition(conds, CD_COND_READY, CONDITION_TRUE,
+                          "AllNodesReady",
+                          f"{ready_count}/{want} member nodes ready")
+        else:
+            set_condition(conds, CD_COND_READY, CONDITION_FALSE,
+                          "WaitingForNodes",
+                          f"{ready_count}/{want} member nodes ready")
+        if degraded_nodes:
+            set_condition(conds, CD_COND_DEGRADED, CONDITION_TRUE,
+                          "UnhealthyDevices",
+                          "tainted devices on node(s): "
+                          + ",".join(degraded_nodes))
+        else:
+            set_condition(conds, CD_COND_DEGRADED, CONDITION_FALSE,
+                          "AllDevicesHealthy", "")
+        desired = ComputeDomainStatus(status=status, nodes=nodes,
+                                      conditions=conds)
         if fresh.status == desired:
             self.metric.set(cd.namespace, cd.name, status)
             return
+        was_ready = condition_true(fresh.status.conditions, CD_COND_READY)
+        was_degraded = condition_true(fresh.status.conditions, CD_COND_DEGRADED)
 
         def mutate(obj):
-            obj.status = ComputeDomainStatus(status=status, nodes=nodes)
+            obj.status = copy.deepcopy(desired)
 
         try:
             self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
         except NotFoundError:
             return
+        if status == CD_STATUS_READY and not was_ready:
+            self.recorder.normal(
+                fresh, REASON_DOMAIN_READY,
+                f"domain ready: {ready_count}/{want} member nodes ready")
+        if degraded_nodes and not was_degraded:
+            self.recorder.warning(
+                fresh, REASON_DOMAIN_DEGRADED,
+                "domain degraded: tainted devices on node(s) "
+                + ",".join(degraded_nodes))
+        elif was_degraded and not degraded_nodes:
+            self.recorder.normal(
+                fresh, REASON_DOMAIN_RECOVERED,
+                "domain recovered: all member devices healthy")
         self.metric.set(cd.namespace, cd.name, status)
 
     # -- deletion --------------------------------------------------------------

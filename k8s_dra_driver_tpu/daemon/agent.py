@@ -24,6 +24,12 @@ from k8s_dra_driver_tpu.daemon.process import ProcessManager
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_CLIQUE_ASSEMBLED,
+    REASON_NODE_JOINED,
+    find_compute_domain_by_uid,
+)
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 
 log = logging.getLogger(__name__)
@@ -55,6 +61,7 @@ class SliceAgent:
         pod_name: str = "",
         pod_namespace: str = "",
         isolation: str = "domain",
+        metrics_registry=None,
     ):
         if not domain_uid:
             raise ValueError("domain_uid (COMPUTE_DOMAIN_UUID) is required")
@@ -92,6 +99,10 @@ class SliceAgent:
                 "disabled, falling back to self-assessed readiness"
             )
         self.process = ProcessManager(child_argv or DEFAULT_CHILD_ARGV)
+        self.recorder = EventRecorder(api, "slice-agent",
+                                      metrics_registry=metrics_registry)
+        self._domain_obj = None        # resolved lazily from domain_uid
+        self._assembled_announced = False
         self._last_peers: List[str] = []
         # Serializes clique-readiness writes between the run loop and the
         # pod-informer callback; both read fresh state under the lock so a
@@ -125,7 +136,8 @@ class SliceAgent:
         with tracing.span("clique.assemble", domain=self.domain_uid,
                           node=self.node_name, ici_domain=self.ici_domain) as sp:
             self.clique = CliqueManager(
-                self.api, self.namespace, self.domain_uid, self.ici_domain
+                self.api, self.namespace, self.domain_uid, self.ici_domain,
+                on_join=self._on_clique_join,
             )
             with tracing.span("clique.register"):
                 self.index = self.clique.register(self.node_name, self.pod_ip)
@@ -140,14 +152,48 @@ class SliceAgent:
                 self.pod_manager.start()
             self.sync()
 
+    def _event_target(self):
+        """The ComputeDomain the uid names (resolved once), falling back to
+        the clique object when the domain is not visible to this agent."""
+        if self._domain_obj is None:
+            self._domain_obj = find_compute_domain_by_uid(
+                self.api, self.namespace, self.domain_uid)
+        if self._domain_obj is not None:
+            return self._domain_obj
+        return self.clique.get() if self.clique is not None else None
+
+    def _on_clique_join(self, info) -> None:
+        target = self._event_target()
+        if target is not None:
+            self.recorder.normal(
+                target, REASON_NODE_JOINED,
+                f"node {info.node_name} joined clique {self.ici_domain} "
+                f"as worker {info.index}")
+
+    def _announce_assembled(self, members) -> None:
+        if self._assembled_announced:
+            return
+        self._assembled_announced = True
+        target = self._event_target()
+        if target is not None:
+            ready = sum(1 for m in members if m.ready)
+            self.recorder.normal(
+                target, REASON_CLIQUE_ASSEMBLED,
+                f"clique {self.ici_domain} assembled: {len(members)}/"
+                f"{self.expected_nodes} members registered, {ready} ready")
+
     def _on_pod_ready(self, _ready: bool) -> None:
         """Kubelet probe verdict changed: mirror it into the clique now,
         without waiting for the next sync tick. Re-reads the pod under the
         sync lock rather than trusting the event payload, which may be stale
         by the time the lock is held."""
+        ready = False
         with self._sync_mu:
             if self.clique is not None and self.pod_manager is not None:
-                self.clique.set_ready(self.node_name, self.pod_manager.pod_ready())
+                ready = self.pod_manager.pod_ready()
+                self.clique.set_ready(self.node_name, ready)
+        if ready and self.clique is not None and not self._assembled_announced:
+            self._announce_assembled(self.clique.members())
 
     def sync(self) -> None:
         """One reconcile pass: refresh peer config, supervise child, update
@@ -175,6 +221,10 @@ class SliceAgent:
                 )
                 sp.attrs["ready"] = ready
                 self.clique.set_ready(self.node_name, ready)
+            if ready and not self._assembled_announced:
+                # Refetched: this pass's `members` predates our own
+                # set_ready, and the announcement should count it.
+                self._announce_assembled(self.clique.members())
 
     def check(self) -> bool:
         """The readiness probe (`tpu-slice-ctl -q` analog)."""

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainClique,
@@ -31,12 +31,18 @@ def clique_name(domain_uid: str, ici_domain: str) -> str:
 
 
 class CliqueManager:
-    def __init__(self, api: APIServer, namespace: str, domain_uid: str, ici_domain: str):
+    def __init__(self, api: APIServer, namespace: str, domain_uid: str,
+                 ici_domain: str,
+                 on_join: Optional[Callable[[ComputeDomainDaemonInfo], None]] = None):
         self.api = api
         self.namespace = namespace
         self.domain_uid = domain_uid
         self.ici_domain = ici_domain
         self.name = clique_name(domain_uid, ici_domain)
+        # Fired once per NEW membership (not on upserts of an existing
+        # member) after the CAS append landed — the agent's NodeJoined
+        # event hook.
+        self.on_join = on_join
 
     # -- registration -------------------------------------------------------
 
@@ -62,20 +68,24 @@ class CliqueManager:
                 return info.index
             used = set(clique.used_indices())
             index = next(i for i in range(len(clique.nodes) + 1) if i not in used)
-            clique.nodes.append(
-                ComputeDomainDaemonInfo(
-                    node_name=node_name,
-                    ip_address=ip_address,
-                    dns_name=dns_name,
-                    index=index,
-                    ready=False,
-                )
+            info = ComputeDomainDaemonInfo(
+                node_name=node_name,
+                ip_address=ip_address,
+                dns_name=dns_name,
+                index=index,
+                ready=False,
             )
+            clique.nodes.append(info)
             try:
                 self.api.update(clique)
-                return index
             except ConflictError:
                 continue  # someone else won this index; re-read and retry
+            if self.on_join is not None:
+                try:
+                    self.on_join(info)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    log.exception("on_join hook failed for %s", node_name)
+            return index
         raise RuntimeError(f"could not register {node_name} in clique {self.name}")
 
     def set_ready(self, node_name: str, ready: bool, attempts: int = 20) -> None:
@@ -113,6 +123,12 @@ class CliqueManager:
         raise RuntimeError(f"could not deregister {node_name}")
 
     # -- reads --------------------------------------------------------------
+
+    def get(self) -> Optional[ComputeDomainClique]:
+        """The live clique object, or None before first registration —
+        what event recorders fall back to when the ComputeDomain itself
+        is not visible."""
+        return self._get()
 
     def members(self) -> List[ComputeDomainDaemonInfo]:
         clique = self._get()

@@ -1,0 +1,71 @@
+"""Typed status conditions (metav1.Condition analog).
+
+The reference surfaces ComputeDomain health through `status.conditions`
+entries shaped like metav1.Condition: type/status/reason/message plus a
+lastTransitionTime that moves ONLY when the boolean status flips — the
+monotonic-transition contract `kubectl describe` and condition-age alerts
+rely on. These helpers keep that contract in one place so every writer
+(controller, scheduler, kubelet sync) maintains conditions identically.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+CONDITION_UNKNOWN = "Unknown"
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = CONDITION_UNKNOWN   # True | False | Unknown
+    reason: str = ""
+    message: str = ""
+    # Moves only on a status flip, never on reason/message refreshes.
+    last_transition_time: float = 0.0
+
+
+def get_condition(conditions: List[Condition], type_: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == type_:
+            return c
+    return None
+
+
+def condition_true(conditions: List[Condition], type_: str) -> bool:
+    c = get_condition(conditions, type_)
+    return c is not None and c.status == CONDITION_TRUE
+
+
+def set_condition(
+    conditions: List[Condition],
+    type_: str,
+    status: str,
+    reason: str = "",
+    message: str = "",
+    now: Optional[float] = None,
+) -> bool:
+    """Upsert one condition in place. Returns True when anything changed.
+    lastTransitionTime is stamped only when the status actually flips (or
+    the condition is new), so a steady condition compares equal across
+    reconciles and change-gated status writes stay no-ops."""
+    ts = time.time() if now is None else now
+    cur = get_condition(conditions, type_)
+    if cur is None:
+        conditions.append(Condition(
+            type=type_, status=status, reason=reason, message=message,
+            last_transition_time=ts,
+        ))
+        return True
+    if cur.status == status and cur.reason == reason and cur.message == message:
+        return False
+    if cur.status != status:
+        cur.last_transition_time = ts
+    cur.status = status
+    cur.reason = reason
+    cur.message = message
+    return True

@@ -11,10 +11,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.k8s.objects import K8sObject, ObjectMeta
 
 # Kind names --------------------------------------------------------------
 
+EVENT = "Event"
 POD = "Pod"
 NODE = "Node"
 DAEMON_SET = "DaemonSet"
@@ -124,6 +126,39 @@ class ResourceClaimConsumer:
     uid: str = ""
 
 
+# -- events ------------------------------------------------------------------
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class ObjectReference:
+    """Pointer to the object an Event narrates (corev1.ObjectReference)."""
+
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event(K8sObject):
+    """corev1.Event, the subset `kubectl describe` renders: the involved
+    object, a CamelCase reason, a human message, and client-go-style
+    aggregation fields (count / firstTimestamp / lastTimestamp)."""
+
+    kind: str = EVENT
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    type: str = EVENT_TYPE_NORMAL   # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    source: str = ""                # emitting component
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+
 # -- kinds ------------------------------------------------------------------
 
 @dataclass
@@ -133,6 +168,13 @@ class ResourceClaim(K8sObject):
     config: List[DeviceClaimConfig] = field(default_factory=list)
     allocation: Optional[AllocationResult] = None
     reserved_for: List[ResourceClaimConsumer] = field(default_factory=list)
+    # Typed lifecycle conditions (Allocated, Prepared), mirrored from the
+    # scheduler/kubelet the way claim.status.conditions carries them upstream.
+    conditions: List[Condition] = field(default_factory=list)
+
+
+CLAIM_COND_ALLOCATED = "Allocated"
+CLAIM_COND_PREPARED = "Prepared"
 
 
 @dataclass

@@ -181,7 +181,14 @@ class _Handler(BaseHTTPRequestHandler):
         """JSON-lines chunked stream; heartbeats detect dead clients so the
         server-side queue is unregistered (a real API server closes idle
         watches the same way). name/ns are the field-selector analog."""
-        wq = self.api.watch(kind, name=name, namespace=namespace)
+        # Deep bound: remote informers rebuild their caches from this
+        # stream and only relist on reconnect — a drop here would diverge
+        # them silently, so allow a far larger burst than the store default
+        # (a stalled client is eventually reaped by the heartbeat below).
+        from k8s_dra_driver_tpu.k8s.informer import INFORMER_WATCH_QUEUE_MAXSIZE
+
+        wq = self.api.watch(kind, name=name, namespace=namespace,
+                            maxsize=INFORMER_WATCH_QUEUE_MAXSIZE)
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/jsonl")
@@ -349,8 +356,14 @@ class RemoteAPIServer:
     # -- watch ---------------------------------------------------------------
 
     def watch(
-        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None,
+        maxsize: int = 0,
     ) -> "queue.Queue[WatchEvent]":
+        # ``maxsize`` keeps the APIServer.watch signature so informers and
+        # the sim need no backend-specific branching; the meaningful bound
+        # lives server-side (_stream_watch) — this client queue is drained
+        # by the reader thread, and capping it here would make the
+        # reconnect replay_list() deadlock against a slow consumer.
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         stop = threading.Event()
         synced = threading.Event()
@@ -446,12 +459,13 @@ class RemoteAPIServer:
             stop.set()
 
     def list_and_watch(
-        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None,
+        maxsize: int = 0,
     ) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
         """Watch-then-list: events racing the list may duplicate objects the
         snapshot already contains; informer caches absorb replays (the
         real-world list+watch has the same at-least-once property)."""
-        q = self.watch(kind, name=name, namespace=namespace)
+        q = self.watch(kind, name=name, namespace=namespace, maxsize=maxsize)
         objs = self.list(kind, namespace=namespace)
         if name is not None:
             objs = [o for o in objs if o.meta.name == name]

@@ -21,6 +21,13 @@ log = logging.getLogger(__name__)
 Handler = Callable[[Optional[K8sObject], K8sObject], None]
 # add: (None, new); update: (old, new); delete: (old, old)
 
+# Informer caches are built once at start() and have no relist path: a
+# dropped event means permanent, silent divergence. So informers subscribe
+# with a much deeper bound than the store's 1024 default — bounded (a dead
+# handler thread still cannot grow memory forever) but far beyond any
+# realistic burst between handler dispatches.
+INFORMER_WATCH_QUEUE_MAXSIZE = 65536
+
 
 class Informer:
     def __init__(
@@ -66,7 +73,8 @@ class Informer:
         if self._thread is not None:
             raise RuntimeError("informer already started")
         objs, self._queue = self.api.list_and_watch(
-            self.kind, name=self.field_name, namespace=self.field_namespace
+            self.kind, name=self.field_name, namespace=self.field_namespace,
+            maxsize=INFORMER_WATCH_QUEUE_MAXSIZE,
         )
         with self._mu:
             for o in objs:
@@ -84,7 +92,12 @@ class Informer:
         self._stop.set()
         if self._queue is not None:
             self.api.stop_watch(self.kind, self._queue)
-            self._queue.put(None)  # type: ignore[arg-type] — wake the loop
+            try:
+                # Wake the loop; a full (bounded) queue is fine — the 0.5s
+                # get timeout observes _stop on its own.
+                self._queue.put_nowait(None)  # type: ignore[arg-type]
+            except queue.Full:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -364,8 +364,12 @@ class KubernetesAPIServer:
 
     def watch(
         self, kind: str, name: Optional[str] = None,
-        namespace: Optional[str] = None,
+        namespace: Optional[str] = None, maxsize: int = 0,
     ) -> "queue.Queue[WatchEvent]":
+        # ``maxsize`` keeps the APIServer.watch signature (informers and
+        # the sim pass it); the client queue stays unbounded like
+        # RemoteAPIServer's — the reader thread drains it, and a cap here
+        # would stall replay_list() against a slow consumer.
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         stop = threading.Event()
         connected = threading.Event()
@@ -451,12 +455,12 @@ class KubernetesAPIServer:
 
     def list_and_watch(
         self, kind: str, name: Optional[str] = None,
-        namespace: Optional[str] = None,
+        namespace: Optional[str] = None, maxsize: int = 0,
     ) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
         """Watch-then-list: at-least-once, like RemoteAPIServer — events
         racing the list may duplicate snapshot objects; informer caches
         absorb replays."""
-        q = self.watch(kind, name=name, namespace=namespace)
+        q = self.watch(kind, name=name, namespace=namespace, maxsize=maxsize)
         objs = self.list(kind, namespace=namespace)
         if name is not None:
             objs = [o for o in objs if o.meta.name == name]

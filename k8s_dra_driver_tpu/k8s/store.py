@@ -46,6 +46,14 @@ class WatchEvent:
     obj: K8sObject
 
 
+# Per-watcher event-queue bound. A stalled watcher (a consumer that stopped
+# draining) must not grow memory without limit: when its queue is full the
+# OLDEST event is dropped to admit the new one — the newest state always
+# arrives, and informer-style consumers relist on resync anyway. Drops are
+# counted (StoreStats.watch_events_dropped / tpu_dra_watch_dropped_total).
+WATCH_QUEUE_MAXSIZE = 1024
+
+
 @dataclass
 class StoreStats:
     """Read-path accounting (plain ints, no locking beyond the store's):
@@ -58,6 +66,7 @@ class StoreStats:
     objects_scanned: int = 0
     objects_scanned_naive: int = 0
     objects_returned: int = 0
+    watch_events_dropped: int = 0
 
     def snapshot(self) -> Dict[str, int]:
         return {
@@ -65,6 +74,7 @@ class StoreStats:
             "objects_scanned": self.objects_scanned,
             "objects_scanned_naive": self.objects_scanned_naive,
             "objects_returned": self.objects_returned,
+            "watch_events_dropped": self.watch_events_dropped,
         }
 
 
@@ -111,7 +121,29 @@ class APIServer:
                 continue
             if ns is not None and event.obj.meta.namespace != ns:
                 continue
-            q.put(event)
+            try:
+                q.put_nowait(event)
+                continue
+            except queue.Full:
+                pass
+            # Stalled watcher: evict the oldest queued event so the queue
+            # stays bounded and the newest state still arrives. Count
+            # exactly the events actually lost — an eviction, plus the new
+            # event itself if a racing producer refilled the freed slot.
+            lost = 0
+            try:
+                q.get_nowait()
+                lost += 1
+            except queue.Empty:
+                pass  # consumer drained meanwhile: nothing was dropped
+            try:
+                q.put_nowait(event)
+            except queue.Full:  # pragma: no cover — racing producer refilled
+                lost += 1
+            if lost:
+                self.stats.watch_events_dropped += lost
+                if self._metrics is not None:
+                    self._metrics["watch_dropped"].inc(kind, by=float(lost))
 
     @staticmethod
     def _key(obj: K8sObject) -> _Key:
@@ -282,6 +314,11 @@ class APIServer:
                     "tpu_dra_store_objects",
                     "Objects currently stored, by kind.",
                     label_names=("kind",))),
+                "watch_dropped": registry.register(Counter(
+                    "tpu_dra_watch_dropped_total",
+                    "Watch events dropped (oldest-first) because a "
+                    "watcher's bounded queue was full.",
+                    label_names=("kind",))),
             }
             for kind, (count, _) in self._fp.items():
                 self._metrics["objects"].set(kind, value=float(count))
@@ -302,10 +339,11 @@ class APIServer:
         raise last  # type: ignore[misc]
 
     def watch(
-        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None,
+        maxsize: int = WATCH_QUEUE_MAXSIZE,
     ) -> "queue.Queue[WatchEvent]":
         with self._mu:
-            q: "queue.Queue[WatchEvent]" = queue.Queue()
+            q: "queue.Queue[WatchEvent]" = queue.Queue(maxsize=maxsize)
             self._watchers.setdefault(kind, []).append((q, name, namespace))
             return q
 
@@ -315,11 +353,12 @@ class APIServer:
             self._watchers[kind] = [e for e in entries if e[0] is not q]
 
     def list_and_watch(
-        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None
+        self, kind: str, name: Optional[str] = None, namespace: Optional[str] = None,
+        maxsize: int = WATCH_QUEUE_MAXSIZE,
     ) -> Tuple[List[K8sObject], "queue.Queue[WatchEvent]"]:
         """Atomic snapshot + subscription — informer bootstrap."""
         with self._mu:
-            q = self.watch(kind, name, namespace)
+            q = self.watch(kind, name, namespace, maxsize=maxsize)
             objs = self.list(kind, namespace=namespace)
             if name is not None:
                 objs = [o for o in objs if o.meta.name == name]

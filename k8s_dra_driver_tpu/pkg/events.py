@@ -1,0 +1,312 @@
+"""EventRecorder: client-go-style Event emission with aggregation.
+
+The reference narrates allocation and ComputeDomain transitions through
+corev1 Events recorded via client-go's EventRecorder, whose correlator
+(k8s.io/client-go/tools/record) deduplicates repeats, caps per-object spam
+with a token bucket, and keeps count/firstTimestamp/lastTimestamp on the
+aggregated Event. This module is that correlator for the in-process API:
+
+- **Dedup**: the series key is (involved object, type, reason, message).
+  A repeat increments ``count`` and advances ``lastTimestamp`` on the ONE
+  stored Event — a 100x FailedScheduling storm is one row with count=100.
+  The Event name is a deterministic hash of the series key, so recorders
+  in different processes sharing one API server aggregate into the same
+  object instead of racing duplicates.
+- **Burst limiter**: creating a NEW series consumes a token from a
+  per-involved-object bucket (capacity ``burst``, refilled at
+  ``refill_per_s``) — the EventCorrelator spam filter. Aggregation updates
+  are free (they are what the limiter is funnelling spam into).
+  Suppressions are themselves counted (``tpu_dra_events_suppressed_total``).
+- **Bounded backlog**: at most ``max_events_per_object`` distinct series
+  per involved object; the stalest series is evicted to admit a new one,
+  so one flapping object cannot grow the store without bound.
+
+Reason strings are CamelCase constants catalogued below; the
+``hack/check_event_reasons.py`` gate fails `make verify` when an emitted
+reason is not CamelCase or missing from ``docs/reference/events.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from k8s_dra_driver_tpu.k8s.core import (
+    EVENT,
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    Event,
+    ObjectReference,
+)
+from k8s_dra_driver_tpu.k8s.objects import (
+    AlreadyExistsError,
+    ConflictError,
+    K8sObject,
+    NotFoundError,
+    new_meta,
+)
+
+log = logging.getLogger(__name__)
+
+# -- reason catalog (docs/reference/events.md is the operator-facing copy) --
+
+# Scheduler / allocator
+REASON_SCHEDULED = "Scheduled"
+REASON_FAILED_SCHEDULING = "FailedScheduling"
+REASON_ALLOCATION_FAILED = "AllocationFailed"
+# Kubelet plugins
+REASON_PREPARED_DEVICES = "PreparedDevices"
+REASON_PREPARE_FAILED = "PrepareFailed"
+REASON_UNPREPARE_FAILED = "UnprepareFailed"
+REASON_CHECKPOINT_RECOVERED = "CheckpointRecovered"
+# Device health
+REASON_DEVICE_DEGRADED = "DeviceDegraded"
+REASON_DEVICE_RECOVERED = "DeviceRecovered"
+# ComputeDomain controller / daemon
+REASON_NODE_JOINED = "NodeJoined"
+REASON_CLIQUE_ASSEMBLED = "CliqueAssembled"
+REASON_DOMAIN_READY = "DomainReady"
+REASON_DOMAIN_DEGRADED = "DomainDegraded"
+REASON_DOMAIN_RECOVERED = "DomainRecovered"
+REASON_DOMAIN_REJECTED = "DomainRejected"
+
+# Correlator defaults, scaled from client-go's EventCorrelator (burst 25,
+# refill 1 token / 5 min per object-and-source).
+DEFAULT_BURST = 25
+DEFAULT_REFILL_PER_S = 1.0 / 300.0
+DEFAULT_MAX_EVENTS_PER_OBJECT = 16
+# Cap on per-object correlator state (token buckets + series gates) held in
+# memory — client-go bounds the same state with an LRU cache. Past the cap
+# the least-recently-touched half is evicted; an evicted object that comes
+# back simply starts with a full bucket again.
+MAX_TRACKED_OBJECTS = 4096
+
+_SeriesKey = Tuple[str, str, str, str, str, str, str]
+_ObjKey = Tuple[str, str, str, str]
+
+
+def object_reference(obj: Union[K8sObject, ObjectReference]) -> ObjectReference:
+    if isinstance(obj, ObjectReference):
+        return obj
+    return ObjectReference(
+        kind=obj.kind, name=obj.meta.name, namespace=obj.meta.namespace,
+        uid=obj.meta.uid,
+    )
+
+
+def event_name(ref: ObjectReference, type_: str, reason: str, message: str) -> str:
+    """Deterministic per-series Event name: dedup works across recorder
+    instances and processes because they all address the same object."""
+    key = "\x00".join((ref.kind, ref.namespace, ref.name, ref.uid,
+                       type_, reason, message))
+    h = hashlib.sha1(key.encode(), usedforsecurity=False).hexdigest()[:12]
+    return f"{ref.name}.{h}"
+
+
+def event_namespace(ref: ObjectReference) -> str:
+    """Where an Event about this object is stored: its namespace, or —
+    for cluster-scoped objects like Nodes — "default", matching real
+    Kubernetes so `get events` (which lists the default namespace) shows
+    DeviceDegraded rows without -A."""
+    return ref.namespace or "default"
+
+
+def events_for(api, obj: Union[K8sObject, ObjectReference]) -> List[Event]:
+    """Every Event involving one object (by uid when set, else by
+    kind/namespace/name), oldest-last-activity first — the rows a
+    ``describe`` renders."""
+    ref = object_reference(obj)
+    out: List[Event] = []
+    for ev in api.list(EVENT, namespace=event_namespace(ref)):
+        io = ev.involved_object
+        if ref.uid and io.uid:
+            if io.uid != ref.uid:
+                continue
+        elif (io.kind, io.namespace, io.name) != (ref.kind, ref.namespace, ref.name):
+            continue
+        out.append(ev)
+    out.sort(key=lambda e: (e.last_timestamp, e.meta.name))
+    return out
+
+
+class EventRecorder:
+    """Records Events against an APIServer with correlator semantics.
+
+    ``component`` is the recorder's source identity (scheduler, allocator,
+    tpu-kubelet-plugin, ...). ``clock`` is injectable for deterministic
+    timestamp tests. Thread-safe; the token buckets and backlog accounting
+    are process-local while dedup itself is store-backed (cross-process)."""
+
+    def __init__(
+        self,
+        api,
+        component: str,
+        metrics_registry=None,
+        clock: Callable[[], float] = time.time,
+        burst: int = DEFAULT_BURST,
+        refill_per_s: float = DEFAULT_REFILL_PER_S,
+        max_events_per_object: int = DEFAULT_MAX_EVENTS_PER_OBJECT,
+    ) -> None:
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Registry
+
+        self.api = api
+        self.component = component
+        self.clock = clock
+        self.burst = burst
+        self.refill_per_s = refill_per_s
+        self.max_events_per_object = max_events_per_object
+        registry = metrics_registry or Registry()
+        self.emitted_total = registry.register(Counter(
+            "tpu_dra_events_emitted_total",
+            "Events recorded (created or aggregated), by component and reason.",
+            ("component", "reason"),
+        ))
+        self.suppressed_total = registry.register(Counter(
+            "tpu_dra_events_suppressed_total",
+            "Events dropped by the per-object burst limiter, by component "
+            "and reason.",
+            ("component", "reason"),
+        ))
+        self._mu = threading.Lock()
+        # obj key -> [tokens, last refill timestamp]
+        self._buckets: Dict[_ObjKey, List[float]] = {}
+        # obj key -> Event names this recorder created — gates the backlog
+        # enforcement scan (an O(namespace-events) list) to objects that
+        # have plausibly reached the cap, instead of paying it per series.
+        self._series_seen: Dict[_ObjKey, set] = {}
+
+    # -- public emit helpers -------------------------------------------------
+
+    def normal(self, involved, reason: str, message: str) -> Optional[Event]:
+        return self.event(involved, EVENT_TYPE_NORMAL, reason, message)
+
+    def warning(self, involved, reason: str, message: str) -> Optional[Event]:
+        return self.event(involved, EVENT_TYPE_WARNING, reason, message)
+
+    def event(
+        self, involved: Union[K8sObject, ObjectReference], type_: str,
+        reason: str, message: str,
+    ) -> Optional[Event]:
+        """Record one event occurrence. Returns the stored (created or
+        aggregated) Event, or None when the burst limiter suppressed it.
+        Never raises: a recorder failure must not fail the actor's
+        reconcile (client-go's recorder is fire-and-forget too)."""
+        try:
+            return self._record(object_reference(involved), type_, reason, message)
+        except Exception:  # noqa: BLE001 — telemetry must not break control flow
+            log.exception("event %s/%s dropped", reason, message)
+            return None
+
+    # -- internals -----------------------------------------------------------
+
+    def _record(self, ref: ObjectReference, type_: str, reason: str,
+                message: str) -> Optional[Event]:
+        now = self.clock()
+        name = event_name(ref, type_, reason, message)
+        ns = event_namespace(ref)
+        # Aggregation first: a dedup hit is an update, costs no token.
+        if self._bump_existing(name, ns, now):
+            self.emitted_total.inc(self.component, reason)
+            return self.api.try_get(EVENT, name, ns)
+        if not self._take_token(ref, now):
+            self.suppressed_total.inc(self.component, reason)
+            return None
+        obj_key: _ObjKey = (ref.kind, ref.namespace, ref.name, ref.uid)
+        with self._mu:
+            seen = self._series_seen.setdefault(obj_key, set())
+            seen.add(name)
+            near_cap = len(seen) >= self.max_events_per_object
+        if near_cap:
+            self._enforce_backlog(ref, obj_key, name)
+        ev = Event(
+            meta=new_meta(name, ns),
+            involved_object=ref,
+            type=type_,
+            reason=reason,
+            message=message,
+            source=self.component,
+            count=1,
+            first_timestamp=now,
+            last_timestamp=now,
+        )
+        try:
+            created = self.api.create(ev)
+        except AlreadyExistsError:
+            # Cross-process race on the deterministic name: fold into it.
+            self._bump_existing(name, ns, now)
+            created = self.api.try_get(EVENT, name, ns)
+        self.emitted_total.inc(self.component, reason)
+        return created
+
+    def _bump_existing(self, name: str, ns: str, now: float) -> bool:
+        def bump(obj):
+            obj.count += 1
+            obj.last_timestamp = max(obj.last_timestamp, now)
+        try:
+            self.api.update_with_retry(EVENT, name, ns, bump)
+            return True
+        except (NotFoundError, ConflictError):
+            return False
+
+    def _evict_stale_objects_locked(self) -> None:
+        """Drop correlator state for the least-recently-touched half of
+        tracked objects once the cap is hit — short-lived pods/claims must
+        not grow a long-lived recorder's memory forever (caller holds
+        self._mu)."""
+        if len(self._buckets) < MAX_TRACKED_OBJECTS:
+            return
+        by_age = sorted(self._buckets, key=lambda k: self._buckets[k][1])
+        for key in by_age[: len(by_age) // 2]:
+            del self._buckets[key]
+            self._series_seen.pop(key, None)
+
+    def _take_token(self, ref: ObjectReference, now: float) -> bool:
+        key: _ObjKey = (ref.kind, ref.namespace, ref.name, ref.uid)
+        with self._mu:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                self._evict_stale_objects_locked()
+                bucket = self._buckets[key] = [float(self.burst), now]
+            tokens, last = bucket
+            tokens = min(float(self.burst),
+                         tokens + max(0.0, now - last) * self.refill_per_s)
+            if tokens < 1.0:
+                bucket[0], bucket[1] = tokens, now
+                return False
+            bucket[0], bucket[1] = tokens - 1.0, now
+            return True
+
+    def _enforce_backlog(self, ref: ObjectReference, obj_key: _ObjKey,
+                         new_name: str) -> None:
+        """Keep at most max_events_per_object series per involved object by
+        evicting the series with the stalest lastTimestamp — recent
+        narration survives, ancient flaps age out. Only called once the
+        process-local series count plausibly reached the cap; the store
+        listing here is the ground truth (evictions and other processes'
+        series included)."""
+        existing = events_for(self.api, ref)
+        while len(existing) >= self.max_events_per_object:
+            victim = existing.pop(0)
+            try:
+                self.api.delete(EVENT, victim.meta.name, victim.namespace)
+            except NotFoundError:
+                pass
+        with self._mu:
+            # Resync the gate to the store's verdict: the surviving series
+            # plus the one being created now (not yet stored).
+            self._series_seen[obj_key] = (
+                {e.meta.name for e in existing} | {new_name})
+
+
+def find_compute_domain_by_uid(api, namespace: str, uid: str):
+    """Resolve a ComputeDomain object from the uid actors carry around
+    (COMPUTE_DOMAIN_UUID) so events land on the domain, not just its uid."""
+    from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN
+
+    for cd in api.list(COMPUTE_DOMAIN, namespace=namespace):
+        if cd.uid == uid:
+            return cd
+    return None

@@ -43,6 +43,12 @@ from k8s_dra_driver_tpu.pkg import devcaps
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.bootid import read_boot_id
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_CHECKPOINT_RECOVERED,
+    REASON_PREPARE_FAILED,
+    REASON_PREPARED_DEVICES,
+)
 from k8s_dra_driver_tpu.pkg.flock import Flock
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
 from k8s_dra_driver_tpu.pkg.sliceconfig import Isolation, SliceAgentConfig
@@ -103,9 +109,10 @@ class ComputeDomainDriver:
         self.inventory = tpulib.enumerate()
         self.cd = ComputeDomainManager(api, node_name, self.inventory)
         self.cdi = CDIHandler(cdi_root)
-        self.metrics = DRARequestMetrics(
-            driver=driver_name, registry=metrics_registry or Registry()
-        )
+        registry = metrics_registry or Registry()
+        self.metrics = DRARequestMetrics(driver=driver_name, registry=registry)
+        self.recorder = EventRecorder(api, "compute-domain-kubelet-plugin",
+                                      metrics_registry=registry)
         os.makedirs(plugin_dir, exist_ok=True)
         self._mutex = threading.Lock()
         self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
@@ -215,6 +222,13 @@ class ComputeDomainDriver:
             r = out.get(claim.uid)
             if isinstance(r, Exception):
                 log.warning("cd prepare %s failed: %s", claim.key, r)
+                self.recorder.warning(
+                    claim, REASON_PREPARE_FAILED,
+                    f"prepare on {self.node_name} failed: {r}")
+            elif r is not None:
+                self.recorder.normal(
+                    claim, REASON_PREPARED_DEVICES,
+                    f"prepared channel/daemon devices on {self.node_name}")
         return out
 
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
@@ -302,6 +316,10 @@ class ComputeDomainDriver:
                                     f"claim {uid} was aborted; refusing to re-prepare")
                             del cp.claims[uid]
                             dirty = True
+                            self.recorder.warning(
+                                claim, REASON_CHECKPOINT_RECOVERED,
+                                f"expired PrepareAborted tombstone cleared on "
+                                f"{self.node_name}; re-preparing")
                         devices = [
                             r.device
                             for r in (claim.allocation.devices if claim.allocation else [])

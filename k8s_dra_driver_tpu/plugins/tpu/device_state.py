@@ -84,7 +84,7 @@ from k8s_dra_driver_tpu.pkg.partitioner import (
 from k8s_dra_driver_tpu.plugins.tpu.sharing import SharingManager
 from k8s_dra_driver_tpu.plugins.tpu.vfio import VfioPciManager
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
-from k8s_dra_driver_tpu.tpulib.types import HostInventory, parse_topology
+from k8s_dra_driver_tpu.tpulib.types import ChipHealth, HostInventory, parse_topology
 
 log = logging.getLogger(__name__)
 
@@ -99,6 +99,125 @@ class PrepareError(Exception):
 
 class OverlapError(PrepareError):
     pass
+
+
+# tpu_dra_device_health gauge encoding (per node, per chip/link id).
+HEALTH_GAUGE_VALUES = {
+    ChipHealth.HEALTHY: 0.0,
+    ChipHealth.DEGRADED: 1.0,
+    ChipHealth.UNHEALTHY: 2.0,
+}
+
+
+def link_id(a: int, b: int) -> str:
+    """Stable per-host id for the ICI link between two local chips."""
+    return f"{min(a, b)}-{max(a, b)}"
+
+
+@dataclass
+class HealthDelta:
+    """One observed transition plus the devices it touches — what the
+    driver turns into taints and DeviceDegraded/DeviceRecovered events."""
+
+    kind: str                    # "chip" | "link"
+    id: str                      # chip index or "a-b" link id
+    health: ChipHealth
+    affected_devices: List[str] = field(default_factory=list)
+
+
+class DeviceHealthMonitor:
+    """Per-chip and per-ICI-link health ledger for one node.
+
+    The reference's device_health.go only models whole-GPU XID events; a
+    TPU mesh additionally loses individual ICI links while both endpoint
+    chips stay alive — a 2x2 host with a dead 0-1 link can still serve
+    single-chip claims but no subslice spanning that link. The monitor
+    keeps both layers, answers "which chips are schedulable" for the
+    ResourceSlice taint pass, and exports ``tpu_dra_device_health``
+    (0=healthy, 1=degraded, 2=unhealthy) on the shared registry so a
+    scraper sees the failed link, not just its downstream taints."""
+
+    def __init__(self, node_name: str, allocatable: Dict[str, "AllocatableDevice"],
+                 metrics_registry=None):
+        from k8s_dra_driver_tpu.pkg.metrics import Gauge, Registry
+
+        self.node_name = node_name
+        self._allocatable = allocatable
+        self._chips: Dict[int, ChipHealth] = {}
+        self._links: Dict[Tuple[int, int], ChipHealth] = {}
+        registry = metrics_registry or Registry()
+        self.gauge = registry.register(Gauge(
+            "tpu_dra_device_health",
+            "Device health by node and chip/ICI-link "
+            "(0=healthy, 1=degraded, 2=unhealthy).",
+            ("node", "kind", "id"),
+        ))
+
+    # -- transitions ---------------------------------------------------------
+
+    def set_chip(self, index: int, health: ChipHealth) -> Optional[HealthDelta]:
+        prev = self._chips.get(index, ChipHealth.HEALTHY)
+        if prev == health:
+            return None
+        if health == ChipHealth.HEALTHY:
+            self._chips.pop(index, None)
+        else:
+            self._chips[index] = health
+        self.gauge.set(self.node_name, "chip", str(index),
+                       value=HEALTH_GAUGE_VALUES[health])
+        return HealthDelta(kind="chip", id=str(index), health=health,
+                           affected_devices=self._devices_touching({index}))
+
+    def set_link(self, a: int, b: int, health: ChipHealth) -> Optional[HealthDelta]:
+        key = (min(a, b), max(a, b))
+        prev = self._links.get(key, ChipHealth.HEALTHY)
+        if prev == health:
+            return None
+        if health == ChipHealth.HEALTHY:
+            self._links.pop(key, None)
+        else:
+            self._links[key] = health
+        self.gauge.set(self.node_name, "link", link_id(a, b),
+                       value=HEALTH_GAUGE_VALUES[health])
+        # A bad link breaks only devices that SPAN it (multi-chip subslices
+        # and whole-host groups); its endpoint chips alone still work.
+        return HealthDelta(kind="link", id=link_id(a, b), health=health,
+                           affected_devices=self._devices_spanning(key))
+
+    # -- queries -------------------------------------------------------------
+
+    def unhealthy_chips(self) -> set:
+        """Chips that must not be scheduled at all (chip-level fault)."""
+        return set(self._chips)
+
+    def broken_links(self) -> Dict[Tuple[int, int], ChipHealth]:
+        return dict(self._links)
+
+    def tainted_devices(self) -> Dict[str, str]:
+        """device name -> "chip"|"link": every allocatable device that an
+        unhealthy chip or a broken link makes unschedulable."""
+        out: Dict[str, str] = {}
+        bad_chips = self.unhealthy_chips()
+        for name, dev in self._allocatable.items():
+            if bad_chips & set(dev.chip_indices):
+                out[name] = "chip"
+        for key in self._links:
+            for name in self._devices_spanning(key):
+                out.setdefault(name, "link")
+        return out
+
+    def _devices_touching(self, chips: set) -> List[str]:
+        return sorted(
+            name for name, dev in self._allocatable.items()
+            if chips & set(dev.chip_indices)
+        )
+
+    def _devices_spanning(self, link: Tuple[int, int]) -> List[str]:
+        a, b = link
+        return sorted(
+            name for name, dev in self._allocatable.items()
+            if a in dev.chip_indices and b in dev.chip_indices
+        )
 
 
 @dataclass
@@ -174,6 +293,10 @@ class DeviceState:
         self._mutex = threading.Lock()
         # Crash-injection seam for the batched pipeline (see FAULT_* above).
         self.fault_hook: Optional[Callable[[str], None]] = None
+        # Observability seam: called with the stale PreparedClaim entry
+        # whenever a PrepareStarted leftover (plugin died mid-prepare) is
+        # rolled back — the driver turns it into a CheckpointRecovered event.
+        self.recovery_hook: Optional[Callable[[PreparedClaim], None]] = None
 
         def on_discard(uid: str) -> None:
             # Pre-reboot claim: its CDI spec and sharing records are stale.
@@ -257,6 +380,8 @@ class DeviceState:
                             self._rollback(entry)
                             del cp.claims[uid]
                             dirty = True
+                            if self.recovery_hook is not None:
+                                self.recovery_hook(entry)
                         requested = self._allocated_device_names(claim)
                         want = self._validate_no_overlap(cp, uid, requested)
                         # Batch siblings are not in cp yet: they conflict too.

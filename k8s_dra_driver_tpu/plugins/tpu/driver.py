@@ -21,9 +21,22 @@ from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, ResourceClaim
 from k8s_dra_driver_tpu.k8s.core import DeviceTaint
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_CHECKPOINT_RECOVERED,
+    REASON_DEVICE_DEGRADED,
+    REASON_DEVICE_RECOVERED,
+    REASON_PREPARE_FAILED,
+    REASON_PREPARED_DEVICES,
+    REASON_UNPREPARE_FAILED,
+)
 from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
 from k8s_dra_driver_tpu.pkg.metrics import DRARequestMetrics, Registry
-from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState, PrepareResult
+from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+    DeviceHealthMonitor,
+    DeviceState,
+    PrepareResult,
+)
 from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import (
     build_resource_slice,
     create_or_update_slice,
@@ -37,6 +50,9 @@ PU_LOCK_TIMEOUT_S = 10.0  # reference budget (driver.go:388,430)
 CLEANUP_INTERVAL_S = 600.0  # reference 10 min (cleanup.go:34-36)
 
 UNHEALTHY_TAINT_KEY = "tpu.google.com/unhealthy"
+# Device is healthy but spans an ICI link that is not: distinct key so an
+# operator can tell silicon faults from fabric faults at a glance.
+ICI_LINK_TAINT_KEY = "tpu.google.com/ici-link-unhealthy"
 
 
 class TpuDriver:
@@ -62,8 +78,12 @@ class TpuDriver:
             tpulib, plugin_dir, cdi_root=cdi_root, gates=self.gates,
             driver_name=driver_name, vfio=vfio,
         )
-        self.metrics = DRARequestMetrics(
-            driver=driver_name, registry=metrics_registry or Registry()
+        registry = metrics_registry or Registry()
+        self.metrics = DRARequestMetrics(driver=driver_name, registry=registry)
+        self.recorder = EventRecorder(api, "tpu-kubelet-plugin",
+                                      metrics_registry=registry)
+        self.health = DeviceHealthMonitor(
+            node_name, self.state.allocatable, metrics_registry=registry,
         )
         self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
         self._pool_generation = 1
@@ -71,7 +91,10 @@ class TpuDriver:
         # watcher's callback thread (taint loss via last-writer-wins and a
         # racy generation increment otherwise).
         self._publish_mu = threading.Lock()
-        self._tainted_chips: Dict[int, ChipHealth] = {}
+        # CheckpointRecovered events: DeviceState reports each stale
+        # PrepareStarted rollback; the claim's Event is recorded against
+        # the object the checkpoint remembers.
+        self.state.recovery_hook = self._on_checkpoint_recovery
         # Health states the operator declared benign — events in this set
         # never (un)taint (the reference's user-extendable benign-XID skip
         # list, device_health.go:394-443 / --additional-xids-to-ignore).
@@ -91,10 +114,28 @@ class TpuDriver:
             # restart's overlapping old process may be mid-prepare.
             with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
                 self.state.destroy_unknown_partitions()
-        if self.gates.enabled("TPUDeviceHealthCheck") and hasattr(
-            self.state.tpulib, "watch_health"
-        ):
-            self.state.tpulib.watch_health(self._on_health_event)
+        if self.gates.enabled("TPUDeviceHealthCheck"):
+            # Seed from the enumerated snapshot: chips and ICI links already
+            # unhealthy at plugin start must be tainted by the FIRST
+            # publish, not only after their next transition event (a
+            # restart must not silently clear taints on a broken fabric).
+            for chip in self.state.inventory.chips:
+                if (chip.health != ChipHealth.HEALTHY
+                        and chip.health not in self._ignored_health_states):
+                    delta = self.health.set_chip(chip.index, chip.health)
+                    if delta is not None:
+                        self._record_health_event(delta)
+            if hasattr(self.state.tpulib, "link_health"):
+                for (a, b), health in self.state.tpulib.link_health().items():
+                    if (health != ChipHealth.HEALTHY
+                            and health not in self._ignored_health_states):
+                        delta = self.health.set_link(a, b, health)
+                        if delta is not None:
+                            self._record_health_event(delta)
+            if hasattr(self.state.tpulib, "watch_health"):
+                self.state.tpulib.watch_health(self._on_health_event)
+            if hasattr(self.state.tpulib, "watch_link_health"):
+                self.state.tpulib.watch_link_health(self._on_link_health_event)
         self.publish_resources()
         self._cleanup_thread = threading.Thread(
             target=self._cleanup_loop, name="checkpoint-cleanup", daemon=True
@@ -126,17 +167,48 @@ class TpuDriver:
                 pool_generation=self._pool_generation,
             )
             self._pool_generation += 1
-            # Apply current taints before publishing.
+            # Apply current health taints before publishing: chip-level
+            # faults under the silicon key, link-spanning devices under the
+            # fabric key (both NoSchedule — the allocator skips either).
+            tainted = self.health.tainted_devices()
             for dev in rs.devices:
-                chips = self.state.allocatable[dev.name].chip_indices
-                if any(c in self._tainted_chips for c in chips):
+                cause = tainted.get(dev.name)
+                if cause == "chip":
                     dev.taints.append(
                         DeviceTaint(key=UNHEALTHY_TAINT_KEY, value="true",
                                     effect="NoSchedule")
                     )
+                elif cause == "link":
+                    dev.taints.append(
+                        DeviceTaint(key=ICI_LINK_TAINT_KEY, value="true",
+                                    effect="NoSchedule")
+                    )
             create_or_update_slice(self.api, rs)
 
-    # -- health -> taints ----------------------------------------------------
+    # -- health -> taints + events -------------------------------------------
+
+    def _node_ref(self):
+        node = self.api.try_get("Node", self.node_name)
+        if node is not None:
+            return node
+        from k8s_dra_driver_tpu.k8s.core import ObjectReference
+
+        return ObjectReference(kind="Node", name=self.node_name)
+
+    def _record_health_event(self, delta) -> None:
+        what = (f"chip {delta.id}" if delta.kind == "chip"
+                else f"ICI link {delta.id}")
+        devs = ",".join(delta.affected_devices) or "none"
+        if delta.health == ChipHealth.HEALTHY:
+            self.recorder.normal(
+                self._node_ref(), REASON_DEVICE_RECOVERED,
+                f"{what} on {self.node_name} recovered; "
+                f"untainted devices: {devs}")
+        else:
+            self.recorder.warning(
+                self._node_ref(), REASON_DEVICE_DEGRADED,
+                f"{what} on {self.node_name} is {delta.health.value}; "
+                f"tainted devices: {devs}")
 
     def _on_health_event(self, chip_index: int, health: ChipHealth) -> None:
         if health in self._ignored_health_states:
@@ -144,11 +216,33 @@ class TpuDriver:
                      chip_index, health.value)
             return
         log.warning("chip %d health -> %s", chip_index, health.value)
-        if health == ChipHealth.HEALTHY:
-            self._tainted_chips.pop(chip_index, None)
-        else:
-            self._tainted_chips[chip_index] = health
+        delta = self.health.set_chip(chip_index, health)
+        if delta is None:
+            return
+        self._record_health_event(delta)
         self.publish_resources()
+
+    def _on_link_health_event(self, a: int, b: int, health: ChipHealth) -> None:
+        if health in self._ignored_health_states:
+            log.info("link %d-%d health -> %s (ignored by operator config)",
+                     a, b, health.value)
+            return
+        log.warning("link %d-%d health -> %s", a, b, health.value)
+        delta = self.health.set_link(a, b, health)
+        if delta is None:
+            return
+        self._record_health_event(delta)
+        self.publish_resources()
+
+    def _on_checkpoint_recovery(self, entry) -> None:
+        from k8s_dra_driver_tpu.k8s.core import ObjectReference
+
+        ref = ObjectReference(kind=RESOURCE_CLAIM, name=entry.name,
+                              namespace=entry.namespace, uid=entry.claim_uid)
+        self.recorder.warning(
+            ref, REASON_CHECKPOINT_RECOVERED,
+            f"rolled back stale PrepareStarted checkpoint entry on "
+            f"{self.node_name} (plugin restarted mid-prepare)")
 
     # -- DRA service --------------------------------------------------------
 
@@ -183,6 +277,14 @@ class TpuDriver:
             r = out.get(claim.uid)
             if isinstance(r, Exception):
                 log.warning("prepare %s failed: %s", claim.key, r)
+                self.recorder.warning(
+                    claim, REASON_PREPARE_FAILED,
+                    f"prepare on {self.node_name} failed: {r}")
+            elif r is not None:
+                devs = ",".join(d.name for d in r.devices)
+                self.recorder.normal(
+                    claim, REASON_PREPARED_DEVICES,
+                    f"prepared [{devs}] on {self.node_name}")
         return out
 
     def unprepare_resource_claims(self, claim_uids: List[str]) -> Dict[str, Optional[Exception]]:
@@ -204,9 +306,26 @@ class TpuDriver:
             failed = sum(1 for r in out.values() if r is not None)
             sp.attrs["failed_claims"] = failed
         self.metrics.record_claim_errors("UnprepareResourceClaims", failed)
-        for uid, err in out.items():
-            if err is not None:
+        if failed:
+            from k8s_dra_driver_tpu.k8s.core import ObjectReference
+
+            # Failed entries survive in the checkpoint, so the claim's
+            # name/namespace can be resolved lazily — the common all-success
+            # path pays no extra flock/load. A uid-only ref would file the
+            # Event in namespace "" where describe/get never look.
+            known = self.state.prepared_claims()
+            for uid, err in out.items():
+                if err is None:
+                    continue
                 log.warning("unprepare %s failed: %s", uid, err)
+                entry = known.get(uid)
+                self.recorder.warning(
+                    ObjectReference(kind=RESOURCE_CLAIM,
+                                    name=entry.name if entry else "",
+                                    namespace=entry.namespace if entry else "",
+                                    uid=uid),
+                    REASON_UNPREPARE_FAILED,
+                    f"unprepare on {self.node_name} failed: {err}")
         return out
 
     # -- stale-claim cleanup -------------------------------------------------

@@ -532,7 +532,7 @@ class Allocator:
         return hit
 
     def feasible_nodes(self, claims, nodes: Optional[Iterable[str]] = None,
-                       ) -> List[str]:
+                       reasons: Optional[Dict[str, str]] = None) -> List[str]:
         """Pre-filter for the scheduler: node names on which every request
         of every claim could POSSIBLY be satisfied, ordered most-free-first
         (ties by name, so a fresh cluster keeps the deterministic name
@@ -543,7 +543,10 @@ class Allocator:
         probe-every-node oracle) would have placed on; it may admit nodes
         a full probe then rejects (joint sibling fit, within-claim counter
         accumulation). ``claims``: one ResourceClaim or a sequence (a
-        pod's unallocated claims, intersected)."""
+        pod's unallocated claims, intersected). ``reasons``: optional dict
+        the filter fills with node -> first human-readable rejection reason
+        — the per-node verdict the scheduler's FailedScheduling /
+        AllocationFailed events narrate."""
         if isinstance(claims, ResourceClaim):
             claims = [claims]
         cache = self._feasibility_state()
@@ -568,6 +571,9 @@ class Allocator:
                                        consumed if used else None)
                    for req, driver, pk, plan in plans):
                 scored.append((used - cap_units.get(node, 0), node))
+            elif reasons is not None:
+                reasons[node] = self._infeasibility_reason(
+                    cache, node, plans, consumed if used else None)
         if snap is not None:
             snap["stats"]["feasibility_checked"] += len(candidates)
             snap["stats"]["feasible_nodes"] += len(scored)
@@ -575,6 +581,29 @@ class Allocator:
                 len(candidates) - len(scored))
         scored.sort()
         return [node for _, node in scored]
+
+    def _infeasibility_reason(self, cache: dict, node: str, plans,
+                              consumed) -> str:
+        """Why feasible_nodes excluded one node: the first failing necessary
+        condition, in request order, phrased for an Event message."""
+        for req, driver, plan_key, plan in plans:
+            entry = cache["entries"].get((driver, node))
+            if entry is None:
+                return f"no ResourceSlice for driver {driver}"
+            matched = self._matching_devices(cache, driver, node, plan_key, plan)
+            if not matched:
+                return (f"no untainted device matches request "
+                        f"{req.name or req.device_class_name!r}")
+            want = len(matched) if req.allocation_mode == "All" else req.count
+            if len(matched) < want:
+                return (f"only {len(matched)}/{want} matching devices for "
+                        f"request {req.name or req.device_class_name!r}")
+            if not self._node_feasible(cache, node, req, driver, plan_key,
+                                       plan, consumed):
+                return (f"insufficient free capacity for request "
+                        f"{req.name or req.device_class_name!r} "
+                        f"(devices held by existing allocations)")
+        return "infeasible"
 
     def _node_feasible(self, cache: dict, node: str, req, driver: str,
                        plan_key, plan: _MatchPlan, consumed) -> bool:

@@ -46,8 +46,17 @@ from k8s_dra_driver_tpu.controller.templates import (
 )
 from k8s_dra_driver_tpu.daemon import SliceAgent
 from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError, WatchEvent
+from k8s_dra_driver_tpu.k8s.informer import INFORMER_WATCH_QUEUE_MAXSIZE
+from k8s_dra_driver_tpu.k8s.conditions import (
+    CONDITION_FALSE,
+    CONDITION_TRUE,
+    get_condition,
+    set_condition,
+)
 from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError
 from k8s_dra_driver_tpu.k8s.core import (
+    CLAIM_COND_ALLOCATED,
+    CLAIM_COND_PREPARED,
     COMPUTE_DOMAIN,
     COMPUTE_DOMAIN_CLIQUE,
     DAEMON_SET,
@@ -65,6 +74,12 @@ from k8s_dra_driver_tpu.k8s.core import (
 from k8s_dra_driver_tpu.k8s.objects import new_meta
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_ALLOCATION_FAILED,
+    REASON_FAILED_SCHEDULING,
+    REASON_SCHEDULED,
+)
 from k8s_dra_driver_tpu.pkg.metrics import Registry
 from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_ABORTED
 from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
@@ -85,6 +100,10 @@ DEVICE_CLASS_VFIO = "vfio.tpu.google.com"
 # stand-in for the reference's fault-injection bats scenarios,
 # /root/reference/tests/bats/test_gpu_robustness.bats).
 CHAOS_CHIP_HEALTH_ANNOTATION = "sim.tpu.google.com/chip-health"
+# Same idea for ICI links: "0-1=unhealthy,2-3=healthy" flips the mock
+# link between two host-local chips, driving the link-taint / DeviceDegraded
+# / DomainDegraded chain from outside the process.
+CHAOS_LINK_HEALTH_ANNOTATION = "sim.tpu.google.com/link-health"
 
 # Comma-list env keys whose values union when a pod holds several claims
 # (each claim's CDI spec names only its own chips).
@@ -144,17 +163,28 @@ class SimCluster:
             self.api.attach_metrics(self.metrics_registry)
         self.allocator = Allocator(self.api,
                                    metrics_registry=self.metrics_registry)
+        # Event plane: the emulated scheduler and the allocator verdicts
+        # narrate through the same correlator the real actors use.
+        self.sched_recorder = EventRecorder(
+            self.api, "scheduler", metrics_registry=self.metrics_registry)
+        self.alloc_recorder = EventRecorder(
+            self.api, "allocator", metrics_registry=self.metrics_registry)
         self.profile = profile
         self.nodes: Dict[str, SimNode] = {}
         self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
+        self._chaos_link_applied: Dict[str, str] = {}
         self._gc_prev_claim_uids: set = set()
         # -- dirty-set state fed by the watch streams -----------------------
         # Subscribed before any object is created below, so the cluster's
         # own bootstrap (nodes, device classes, published slices) arrives
         # as ordinary events; a pre-seeded api is covered by the one-shot
-        # bootstrap scan on the first pass.
+        # bootstrap scan on the first pass. The control loops drain every
+        # pass but their POD dirty-keys are loss-sensitive, so these
+        # watchers get a much deeper bound than the store default (a
+        # 512-node storm boots >1024 slice events before the first drain).
         self._watch_queues: Dict[str, "queue.Queue[WatchEvent]"] = {
-            kind: self.api.watch(kind) for kind in _WATCHED_KINDS
+            kind: self.api.watch(kind, maxsize=INFORMER_WATCH_QUEUE_MAXSIZE)
+            for kind in _WATCHED_KINDS
         }
         self._sched_dirty: Set[_PodKey] = set()    # pods needing scheduling
         self._sched_backlog: Set[_PodKey] = set()  # unschedulable, awaiting capacity
@@ -548,11 +578,14 @@ class SimCluster:
         """Schedule one Pending pod; returns 'bound', 'unschedulable', or
         'failed'. Probes only allocator-feasible nodes, most-free-first;
         the exhaustive probe-every-node path remains available as the
-        oracle the feasibility property tests diff against."""
+        oracle the feasibility property tests diff against. Every verdict
+        is narrated as an Event on the pod (and AllocationFailed on the
+        claims), so `describe pod` answers "why is it Pending"."""
         try:
             claims = self._ensure_claims_for_pod(pod)
         except AllocationError as e:
             log.debug("pod %s: %s", pod.key, e)
+            self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, str(e))
             return "unschedulable"
         unallocated = [c for c in claims.values() if c.allocation is None]
         allocated_nodes = {
@@ -560,16 +593,17 @@ class SimCluster:
             if c.allocation is not None and c.allocation.node_name
         }
         if len(allocated_nodes) > 1:
-            self._fail_pod(pod, f"claims allocated on different nodes: {allocated_nodes}")
+            msg = f"claims allocated on different nodes: {allocated_nodes}"
+            self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+            self._fail_pod(pod, msg)
             return "failed"
         if pod.node_name and allocated_nodes and pod.node_name not in allocated_nodes:
             # A nodeName-pinned pod whose shared claim is already
             # allocated elsewhere can never be prepared there.
-            self._fail_pod(
-                pod,
-                f"pod pinned to {pod.node_name} but claim allocated on "
-                f"{next(iter(allocated_nodes))}",
-            )
+            msg = (f"pod pinned to {pod.node_name} but claim allocated on "
+                   f"{next(iter(allocated_nodes))}")
+            self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+            self._fail_pod(pod, msg)
             return "failed"
         if pod.node_name:
             candidates = [pod.node_name]
@@ -579,16 +613,23 @@ class SimCluster:
         else:
             candidates = None  # chosen per-claim-set below
         chosen = pod.node_name
+        feasible_note = ""
         if unallocated:
+            reject_reasons: Dict[str, str] = {}
             if candidates is None:
                 # Feasibility pre-filter: only nodes that can possibly
                 # satisfy every unallocated claim, most-free-first.
                 try:
-                    feasible = self.allocator.feasible_nodes(unallocated)
+                    feasible = self.allocator.feasible_nodes(
+                        unallocated, reasons=reject_reasons)
                 except AllocationError as e:
-                    self._fail_pod(pod, f"allocation: {e}")
+                    msg = f"allocation: {e}"
+                    self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+                    self._fail_pod(pod, msg)
                     return "failed"
                 candidates = [n for n in feasible if n in self.nodes]
+                feasible_note = (f"feasibility filter admitted "
+                                 f"{len(candidates)}/{len(self.nodes)} nodes")
             placed = False
             for node in candidates:
                 results = []
@@ -603,18 +644,26 @@ class SimCluster:
                         # A malformed class/selector must fail THIS
                         # pod visibly, not abort the scheduler pass
                         # for every other pod.
-                        self._fail_pod(pod, f"allocation: {e}")
+                        msg = f"allocation: {e}"
+                        self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+                        self._fail_pod(pod, msg)
                         return "failed"
                     if r is None:
                         ok = False
+                        reject_reasons.setdefault(
+                            node, f"claim {c.meta.name!r} does not fit "
+                            "jointly with its siblings")
                         break
                     results.append((c, r))
                 if ok:
                     for c, r in results:
                         # Consumers are recorded by the reserve loop
                         # below; allocation only here.
-                        def set_alloc(obj, r=r):
+                        def set_alloc(obj, r=r, node=node):
                             obj.allocation = r
+                            set_condition(obj.conditions, CLAIM_COND_ALLOCATED,
+                                          CONDITION_TRUE, "Allocated",
+                                          f"allocated on {node}")
                         self.api.update_with_retry(
                             RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
                         )
@@ -624,6 +673,7 @@ class SimCluster:
                     break
             if not placed:
                 log.debug("pod %s: unschedulable this pass", pod.key)
+                self._record_unschedulable(pod, unallocated, reject_reasons)
                 return "unschedulable"
         if not chosen:
             if candidates is None:
@@ -644,6 +694,10 @@ class SimCluster:
                     self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
                 except NotFoundError:
                     return "bound"
+            self.sched_recorder.normal(
+                pod, REASON_SCHEDULED,
+                f"assigned {pod.key} to {chosen}"
+                + (f" ({feasible_note})" if feasible_note else ""))
         # Every consumer of a claim is recorded (shared claims have
         # several); unprepare only happens when the last one is gone.
         from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
@@ -665,7 +719,45 @@ class SimCluster:
                 pass
         return "bound"
 
+    def _record_unschedulable(self, pod: Pod, unallocated, reasons) -> None:
+        """FailedScheduling on the pod + AllocationFailed on each claim,
+        carrying the feasibility filter's per-node verdicts — the
+        `0/N nodes are available: ...` message kubectl users expect."""
+        total = len(self.nodes)
+        detail = "; ".join(
+            f"{node}: {reason}" for node, reason in sorted(reasons.items())[:8]
+        ) or "no candidate nodes"
+        self.sched_recorder.warning(
+            pod, REASON_FAILED_SCHEDULING,
+            f"0/{total} nodes can place the pod: {detail}")
+        for c in unallocated:
+            self.alloc_recorder.warning(
+                c, REASON_ALLOCATION_FAILED,
+                f"cannot allocate claim on any of {total} node(s): {detail}")
+
     # -- kubelet -------------------------------------------------------------------
+
+    def _set_claim_condition(self, claim: ResourceClaim, type_: str,
+                             status: str, reason: str, message: str) -> None:
+        """Change-gated claim-condition write (a steady retry loop must not
+        churn the claim's resourceVersion every pass). Gates on the LIVE
+        object, not the pass's snapshot copy, so the second plugin of a
+        two-driver pod doesn't re-write the condition the first just set."""
+        live = self.api.try_get(RESOURCE_CLAIM, claim.meta.name, claim.namespace)
+        if live is None:
+            return
+        cur = get_condition(live.conditions, type_)
+        if (cur is not None and cur.status == status
+                and cur.reason == reason and cur.message == message):
+            return
+
+        def mutate(obj):
+            set_condition(obj.conditions, type_, status, reason, message)
+        try:
+            self.api.update_with_retry(
+                RESOURCE_CLAIM, claim.meta.name, claim.namespace, mutate)
+        except NotFoundError:
+            pass
 
     def _kubelet_pass(self) -> None:
         self._drain_events()
@@ -713,11 +805,20 @@ class SimCluster:
                 res = plugin.prepare_resource_claims([claim])[claim.uid]
                 if isinstance(res, RetryableError):
                     outcome = "retry"  # pod stays ContainerCreating
+                    self._set_claim_condition(
+                        claim, CLAIM_COND_PREPARED, CONDITION_FALSE,
+                        "Retrying", str(res))
                 elif isinstance(res, Exception):
+                    self._set_claim_condition(
+                        claim, CLAIM_COND_PREPARED, CONDITION_FALSE,
+                        "PrepareFailed", str(res))
                     self._fail_pod(pod, str(res))
                     outcome = "failed"
                     break
                 else:
+                    self._set_claim_condition(
+                        claim, CLAIM_COND_PREPARED, CONDITION_TRUE,
+                        "Prepared", f"prepared on {pod.node_name}")
                     cdi = plugin.state.cdi if hasattr(plugin, "state") else plugin.cdi
                     spec = cdi.read_claim_spec(claim.uid)
                     for dev in (spec or {}).get("devices", []):
@@ -813,6 +914,7 @@ class SimCluster:
                 gates=self.gates,
                 pod_name=env.get("POD_NAME", ""),
                 pod_namespace=env.get("POD_NAMESPACE", ""),
+                metrics_registry=self.metrics_registry,
             )
             agent.startup()
             agent._sim_pod_uid = pod.uid  # restart detection on DS recreate
@@ -945,25 +1047,43 @@ class SimCluster:
             if sim_node is None:
                 continue
             value = node_obj.meta.annotations.get(CHAOS_CHIP_HEALTH_ANNOTATION, "")
-            if value == self._chaos_applied.get(node_obj.meta.name, ""):
-                continue
-            for tok in filter(None, (t.strip() for t in value.split(","))):
-                idx, _, state = tok.partition("=")
-                try:
-                    chip = int(idx)
-                    health = ChipHealth(state.strip().lower())
-                except ValueError:
-                    log.warning("chaos: bad chip health token %r on %s",
-                                tok, node_obj.meta.name)
-                    continue
-                try:
-                    sim_node.tpulib.set_health(chip, health)
-                except Exception:  # noqa: BLE001 — one bad chip must not drop the rest
-                    log.exception("chaos: set_health(%d) failed on %s",
-                                  chip, node_obj.meta.name)
-            # Mark applied only after the whole annotation was processed so
-            # a mid-loop crash retries the remaining tokens next pass.
-            self._chaos_applied[node_obj.meta.name] = value
+            if value != self._chaos_applied.get(node_obj.meta.name, ""):
+                for tok in filter(None, (t.strip() for t in value.split(","))):
+                    idx, _, state = tok.partition("=")
+                    try:
+                        chip = int(idx)
+                        health = ChipHealth(state.strip().lower())
+                    except ValueError:
+                        log.warning("chaos: bad chip health token %r on %s",
+                                    tok, node_obj.meta.name)
+                        continue
+                    try:
+                        sim_node.tpulib.set_health(chip, health)
+                    except Exception:  # noqa: BLE001 — one bad chip must not drop the rest
+                        log.exception("chaos: set_health(%d) failed on %s",
+                                      chip, node_obj.meta.name)
+                # Mark applied only after the whole annotation was processed
+                # so a mid-loop crash retries the remaining tokens next pass.
+                self._chaos_applied[node_obj.meta.name] = value
+            link_value = node_obj.meta.annotations.get(
+                CHAOS_LINK_HEALTH_ANNOTATION, "")
+            if link_value != self._chaos_link_applied.get(node_obj.meta.name, ""):
+                for tok in filter(None, (t.strip() for t in link_value.split(","))):
+                    pair, _, state = tok.partition("=")
+                    try:
+                        a_s, _, b_s = pair.partition("-")
+                        a, b = int(a_s), int(b_s)
+                        health = ChipHealth(state.strip().lower())
+                    except ValueError:
+                        log.warning("chaos: bad link health token %r on %s",
+                                    tok, node_obj.meta.name)
+                        continue
+                    try:
+                        sim_node.tpulib.set_link_health(a, b, health)
+                    except Exception:  # noqa: BLE001 — one bad link must not drop the rest
+                        log.exception("chaos: set_link_health(%d,%d) failed on %s",
+                                      a, b, node_obj.meta.name)
+                self._chaos_link_applied[node_obj.meta.name] = link_value
 
     # -- pod-deletion driven unprepare -------------------------------------------------
 

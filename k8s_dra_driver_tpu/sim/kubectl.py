@@ -8,6 +8,7 @@ user could port to a live cluster, not test fixtures.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List
 
 import yaml
@@ -186,6 +187,7 @@ def apply_file(api: APIServer, path: str) -> List[K8sObject]:
 _KIND_ALIASES = {
     "pod": "Pod", "pods": "Pod", "po": "Pod",
     "node": "Node", "nodes": "Node",
+    "event": "Event", "events": "Event", "ev": "Event",
     "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
     "resourceclaimtemplate": "ResourceClaimTemplate",
     "resourceclaimtemplates": "ResourceClaimTemplate",
@@ -230,7 +232,137 @@ def _summary_row(obj: K8sObject) -> List[str]:
         extra = "allocated" if alloc and alloc.devices else "pending"
     elif obj.kind == "ResourceSlice":
         extra = f"{len(getattr(obj, 'devices', []))} devices"
+    elif obj.kind == "Event":
+        extra = (f"{getattr(obj, 'type', '')}/{getattr(obj, 'reason', '')} "
+                 f"x{getattr(obj, 'count', 1)}")
     return [obj.namespace or "-", obj.meta.name, extra]
+
+
+# -- describe ----------------------------------------------------------------
+
+
+def _age(ts: float, now: float) -> str:
+    if not ts:
+        return "<unknown>"
+    s = max(0, int(now - ts))
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    return f"{s // 3600}h"
+
+
+def _table(rows: List[List[str]], indent: str = "  ") -> List[str]:
+    if not rows:
+        return []
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return [
+        indent + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows
+    ]
+
+
+def _conditions_lines(conditions, now: float) -> List[str]:
+    if not conditions:
+        return []
+    rows = [["Type", "Status", "Reason", "Age", "Message"]]
+    for c in conditions:
+        rows.append([
+            getattr(c, "type", ""),
+            getattr(c, "status", ""),
+            getattr(c, "reason", "") or "-",
+            _age(getattr(c, "last_transition_time", 0.0), now),
+            getattr(c, "message", "") or "-",
+        ])
+    return ["Conditions:"] + _table(rows)
+
+
+def _events_lines(api, obj: K8sObject, now: float) -> List[str]:
+    from k8s_dra_driver_tpu.pkg.events import events_for
+
+    events = events_for(api, obj)
+    if not events:
+        return ["Events:  <none>"]
+    rows = [["Type", "Reason", "Age", "Count", "From", "Message"]]
+    for ev in events:
+        first = _age(ev.first_timestamp, now)
+        last = _age(ev.last_timestamp, now)
+        age = last if ev.count <= 1 else f"{last} (first {first})"
+        rows.append([ev.type, ev.reason, age, str(ev.count),
+                     ev.source or "-", ev.message])
+    return ["Events:"] + _table(rows)
+
+
+def _describe_body(api, obj: K8sObject) -> List[str]:
+    lines: List[str] = []
+    if obj.kind == "Pod":
+        lines += [f"Node:   {obj.node_name or '<none>'}",
+                  f"Phase:  {obj.phase}" + (" (ready)" if obj.ready else ""),
+                  f"IP:     {obj.pod_ip or '<none>'}"]
+        for ref in obj.resource_claims:
+            src = ref.resource_claim_name or f"template/{ref.resource_claim_template_name}"
+            lines.append(f"Claim:  {ref.name} -> {src}")
+        if obj.injected_devices:
+            lines.append("Devices: " + ",".join(obj.injected_devices))
+        lines += _conditions_lines(obj.conditions, time.time())
+    elif obj.kind == "ResourceClaim":
+        for req in obj.requests:
+            lines.append(
+                f"Request: {req.name or '-'} class={req.device_class_name} "
+                f"mode={req.allocation_mode} count={req.count}")
+        alloc = obj.allocation
+        if alloc is not None and alloc.devices:
+            lines.append(f"Allocated on: {alloc.node_name or '<none>'}")
+            for d in alloc.devices:
+                lines.append(f"  {d.driver}: {d.device} (request {d.request})")
+        else:
+            lines.append("Allocated on: <pending>")
+        for r in obj.reserved_for:
+            lines.append(f"Reserved for: {r.kind}/{r.name}")
+        lines += _conditions_lines(obj.conditions, time.time())
+    elif obj.kind == "ComputeDomain":
+        lines += [f"NumNodes:  {obj.spec.num_nodes}",
+                  f"Topology:  {obj.spec.topology or '<any>'}",
+                  f"Status:    {obj.status.status}"]
+        if obj.status.nodes:
+            rows = [["Node", "IciDomain", "Worker", "Status"]]
+            for n in obj.status.nodes:
+                rows.append([n.name, n.ici_domain, str(n.worker_id), n.status])
+            lines += ["Nodes:"] + _table(rows)
+        lines += _conditions_lines(obj.status.conditions, time.time())
+    elif obj.kind == "Node":
+        for t in getattr(obj, "taints", []):
+            lines.append(f"Taint: {t.key}={t.value}:{t.effect}")
+        slices = [s for s in api.list("ResourceSlice")
+                  if s.node_name == obj.meta.name]
+        for s in slices:
+            tainted = [d.name for d in s.devices if d.taints]
+            lines.append(
+                f"ResourceSlice: {s.meta.name} driver={s.driver} "
+                f"devices={len(s.devices)}"
+                + (f" tainted=[{','.join(tainted)}]" if tainted else ""))
+    return lines
+
+
+def describe_object(api, kind: str, name: str, namespace: str = "") -> str:
+    """Render the `kubectl describe` view: identity, kind-specific status,
+    conditions, and the deduplicated Event table."""
+    obj = api.get(kind, name, namespace)
+    now = time.time()
+    lines = [f"Name:       {obj.meta.name}"]
+    if obj.meta.namespace:
+        lines.append(f"Namespace:  {obj.meta.namespace}")
+    lines += [f"Kind:       {obj.kind}",
+              f"UID:        {obj.meta.uid}"]
+    if obj.meta.labels:
+        lines.append("Labels:     " + ",".join(
+            f"{k}={v}" for k, v in sorted(obj.meta.labels.items())))
+    if obj.meta.annotations:
+        lines.append("Annotations: " + ",".join(
+            f"{k}={v}" for k, v in sorted(obj.meta.annotations.items())))
+    lines += _describe_body(api, obj)
+    lines += _events_lines(api, obj, now)
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
@@ -257,7 +389,15 @@ def main(argv=None) -> int:
     p_get.add_argument("name", nargs="?")
     p_get.add_argument("-n", "--namespace", default=None)
     p_get.add_argument("-A", "--all-namespaces", action="store_true")
-    p_get.add_argument("-o", "--output", choices=("table", "json"), default="table")
+    p_get.add_argument("-o", "--output", choices=("table", "json", "yaml"),
+                       default="table")
+
+    p_desc = sub.add_parser(
+        "describe",
+        help="status, conditions, and deduped events for one object")
+    p_desc.add_argument("kind")
+    p_desc.add_argument("name")
+    p_desc.add_argument("-n", "--namespace", default=None)
 
     p_del = sub.add_parser("delete")
     p_del.add_argument("kind")
@@ -316,11 +456,26 @@ def main(argv=None) -> int:
             objs = api.list(kind, namespace=list_ns)
         if args.output == "json":
             print(json.dumps([to_wire(o) for o in objs], indent=1, sort_keys=True))
+        elif args.output == "yaml":
+            # A single named object renders as one document (scriptable
+            # `get cd x -o yaml | yq .status.conditions`); lists as a
+            # kubectl-style items wrapper.
+            if args.name:
+                print(yaml.safe_dump(to_wire(objs[0]), sort_keys=True),
+                      end="")
+            else:
+                print(yaml.safe_dump({"items": [to_wire(o) for o in objs]},
+                                     sort_keys=True), end="")
         else:
             rows = [["NAMESPACE", "NAME", "STATUS"]] + [_summary_row(o) for o in objs]
             widths = [max(len(r[i]) for r in rows) for i in range(3)]
             for r in rows:
                 print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        return 0
+
+    if args.cmd == "describe":
+        print(describe_object(
+            api, kind, args.name, _default_namespace(kind, args.namespace or "")))
         return 0
 
     if args.cmd == "delete":

@@ -85,6 +85,8 @@ class MockTpuLib:
         for idx in unhealthy or ():
             self._health[idx] = ChipHealth.UNHEALTHY
         self._health_listeners: List = []
+        self._link_health: Dict[Tuple[int, int], ChipHealth] = {}
+        self._link_listeners: List = []
 
     # -- health injection ---------------------------------------------------
 
@@ -97,6 +99,23 @@ class MockTpuLib:
         """Register callback(chip_index, health) — the NVML event-set analog
         (/root/reference/cmd/gpu-kubelet-plugin/device_health.go:103-274)."""
         self._health_listeners.append(callback)
+
+    def set_link_health(self, a: int, b: int, health: ChipHealth) -> None:
+        """Inject ICI-link health between two host-local chips (order
+        insensitive) — the per-link fault the chip-level NVML analog has no
+        equivalent for; TPU meshes lose individual ICI links while both
+        endpoint chips stay up."""
+        key = (min(a, b), max(a, b))
+        self._link_health[key] = health
+        for cb in list(self._link_listeners):
+            cb(key[0], key[1], health)
+
+    def watch_link_health(self, callback) -> None:
+        """Register callback(chip_a, chip_b, health) for link transitions."""
+        self._link_listeners.append(callback)
+
+    def link_health(self) -> Dict[Tuple[int, int], ChipHealth]:
+        return dict(self._link_health)
 
     # -- enumeration --------------------------------------------------------
 

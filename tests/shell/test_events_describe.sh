@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Event-plane shell e2e: `describe` renders status/conditions/events from
+# outside the process, `get -o yaml` makes conditions scriptable, and the
+# link-health chaos annotation drives DeviceDegraded narration — the
+# kubectl debugging loop of docs/reference/events.md, over the wire.
+source "$(dirname "$0")/helpers.sh"
+
+start_cluster v5e-4 --gates TPUDeviceHealthCheck=true
+
+spec="$(mktemp --suffix=.yaml)"
+cat > "$spec" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: default}
+spec:
+  spec:
+    devices:
+      requests:
+      - name: tpus
+        exactly: {deviceClassName: tpu.google.com, count: 4}
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: web, namespace: default}
+spec:
+  containers: [{name: c, image: python:3.12}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole-host}]
+EOF
+kubectl apply -f "$spec"
+kubectl wait pod web --for=Running --timeout=30
+
+# describe pod: scheduling narrated as a deduped event table.
+desc="$(kubectl describe pod web)"
+assert_contains "$desc" "Phase:  Running" "describe pod shows phase"
+assert_contains "$desc" "Scheduled" "describe pod shows the Scheduled event"
+assert_contains "$desc" "scheduler" "describe pod shows the event source"
+
+# get -o yaml: the claim's typed conditions are scriptable.
+allocated="$(kubectl get resourceclaim web-tpus -o yaml | $PY -c "
+import sys, yaml
+doc = yaml.safe_load(sys.stdin)
+conds = {c['type']: c['status'] for c in doc['conditions']}
+print(conds.get('Allocated'), conds.get('Prepared'))")"
+[ "$allocated" = "True True" ] || {
+  echo "FAIL: claim conditions not True True, got: $allocated"; exit 1; }
+
+# Inject an ICI-link failure; the node narrates DeviceDegraded and the
+# slice carries the link taint.
+kubectl annotate node tpu-node-0 "sim.tpu.google.com/link-health=0-1=unhealthy"
+sleep 2
+node_desc="$(kubectl describe node tpu-node-0)"
+assert_contains "$node_desc" "DeviceDegraded" "node narrates the link failure"
+assert_contains "$node_desc" "ICI link 0-1" "event names the failed link"
+assert_contains "$node_desc" "tainted=" "describe node lists tainted devices"
+
+# Heal; recovery is narrated too.
+kubectl annotate node tpu-node-0 "sim.tpu.google.com/link-health=0-1=healthy"
+sleep 2
+node_desc="$(kubectl describe node tpu-node-0)"
+assert_contains "$node_desc" "DeviceRecovered" "node narrates the recovery"
+
+rm -f "$spec"
+echo "PASS test_events_describe"

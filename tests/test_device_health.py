@@ -1,0 +1,253 @@
+"""TPU device/ICI health telemetry: monitor semantics, health -> taint ->
+event -> condition chain, chaos link injection, and the ISSUE acceptance
+scenario (4-node domain + one ICI-link failure, observed via describe)."""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e import SPECS_DIR
+from k8s_dra_driver_tpu.k8s.conditions import condition_true
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, NODE, RESOURCE_SLICE
+from k8s_dra_driver_tpu.pkg.events import (
+    REASON_DEVICE_DEGRADED,
+    REASON_DEVICE_RECOVERED,
+    REASON_DOMAIN_DEGRADED,
+    REASON_DOMAIN_RECOVERED,
+    events_for,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+    DeviceHealthMonitor,
+    link_id,
+)
+from k8s_dra_driver_tpu.plugins.tpu.driver import (
+    ICI_LINK_TAINT_KEY,
+    UNHEALTHY_TAINT_KEY,
+)
+from k8s_dra_driver_tpu.sim.cluster import (
+    CHAOS_LINK_HEALTH_ANNOTATION,
+    SimCluster,
+)
+from k8s_dra_driver_tpu.sim.kubectl import apply_file, describe_object
+from k8s_dra_driver_tpu.tpulib import ChipHealth, MockTpuLib
+from k8s_dra_driver_tpu.tpulib.types import HostInventory
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+def _monitor():
+    lib = MockTpuLib("v5e-4")
+    inv: HostInventory = lib.enumerate()
+    allocatable = enumerate_allocatable(inv, with_subslices=True)
+    reg = Registry()
+    return DeviceHealthMonitor("n0", allocatable, metrics_registry=reg), reg
+
+
+# -- monitor unit tier -------------------------------------------------------
+
+
+def test_chip_fault_taints_every_covering_device():
+    mon, _ = _monitor()
+    delta = mon.set_chip(0, ChipHealth.UNHEALTHY)
+    assert delta.kind == "chip"
+    # Every device covering chip 0 (the chip itself, subslices, whole host).
+    assert "tpu-0" in delta.affected_devices
+    assert any("subslice" in d for d in delta.affected_devices)
+    tainted = mon.tainted_devices()
+    assert tainted["tpu-0"] == "chip"
+    # Devices not covering chip 0 stay clean.
+    assert "tpu-1" not in tainted
+
+
+def test_link_fault_taints_only_spanning_devices():
+    mon, _ = _monitor()
+    delta = mon.set_link(0, 1, ChipHealth.UNHEALTHY)
+    assert delta.kind == "link" and delta.id == "0-1"
+    tainted = mon.tainted_devices()
+    # The endpoint chips alone still work: single-chip devices untainted.
+    assert "tpu-0" not in tainted and "tpu-1" not in tainted
+    # Multi-chip devices spanning BOTH endpoints are out.
+    assert delta.affected_devices, "no spanning devices found"
+    for name in delta.affected_devices:
+        assert tainted[name] == "link"
+
+
+def test_monitor_transitions_are_edge_triggered():
+    mon, _ = _monitor()
+    assert mon.set_chip(0, ChipHealth.UNHEALTHY) is not None
+    assert mon.set_chip(0, ChipHealth.UNHEALTHY) is None  # no repeat
+    assert mon.set_chip(0, ChipHealth.HEALTHY) is not None
+    assert mon.set_chip(0, ChipHealth.HEALTHY) is None
+    assert not mon.tainted_devices()
+
+
+def test_health_gauge_encodes_states():
+    mon, reg = _monitor()
+    mon.set_chip(2, ChipHealth.DEGRADED)
+    mon.set_link(0, 1, ChipHealth.UNHEALTHY)
+    text = reg.expose()
+    assert 'tpu_dra_device_health{node="n0",kind="chip",id="2"} 1.0' in text
+    assert 'tpu_dra_device_health{node="n0",kind="link",id="0-1"} 2.0' in text
+    mon.set_chip(2, ChipHealth.HEALTHY)
+    assert 'tpu_dra_device_health{node="n0",kind="chip",id="2"} 0.0' \
+        in reg.expose()
+
+
+def test_link_id_is_order_insensitive():
+    assert link_id(3, 1) == "1-3" == link_id(1, 3)
+
+
+def test_plugin_restart_reseeds_link_taints(tmp_path):
+    """A restart must not silently clear link taints while the fabric is
+    still broken: the fresh driver re-seeds from the tpulib's link state
+    and the first publish carries the taint."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    kw = dict(api=api, node_name="n0", tpulib=lib,
+              plugin_dir=str(tmp_path / "plugin"),
+              cdi_root=str(tmp_path / "cdi"),
+              gates=fg.parse("TPUDeviceHealthCheck=true"))
+    d1 = TpuDriver(**kw)
+    d1.start()
+    lib.set_link_health(0, 1, ChipHealth.UNHEALTHY)
+    d1.shutdown()
+    d2 = TpuDriver(**kw)
+    d2.start()
+    try:
+        rs = api.get(RESOURCE_SLICE, "n0-tpu.google.com")
+        assert any(t.key == ICI_LINK_TAINT_KEY
+                   for d in rs.devices for t in d.taints), \
+            "restart cleared link taints on a still-broken fabric"
+    finally:
+        d2.shutdown()
+
+
+# -- sim integration: link chaos -> taints -> events -------------------------
+
+
+def test_link_chaos_taints_slice_and_fires_node_event(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4",
+                     gates="TPUDeviceHealthCheck=true")
+    sim.start()
+    try:
+        def annotate(obj):
+            obj.meta.annotations[CHAOS_LINK_HEALTH_ANNOTATION] = "0-1=unhealthy"
+        sim.api.update_with_retry(NODE, "tpu-node-0", "", annotate)
+        sim.settle(max_steps=5)
+        rs = next(s for s in sim.api.list(RESOURCE_SLICE)
+                  if s.node_name == "tpu-node-0"
+                  and s.driver == "tpu.google.com")
+        link_tainted = [d.name for d in rs.devices
+                        if any(t.key == ICI_LINK_TAINT_KEY for t in d.taints)]
+        assert link_tainted, "no device tainted by the link failure"
+        # Single chips keep working: no chip-level taints.
+        assert not any(t.key == UNHEALTHY_TAINT_KEY
+                       for d in rs.devices for t in d.taints)
+        node = sim.api.get(NODE, "tpu-node-0")
+        degr = [e for e in events_for(sim.api, node)
+                if e.reason == REASON_DEVICE_DEGRADED]
+        assert degr and "ICI link 0-1" in degr[0].message
+        # Heal: taints lift, DeviceRecovered fires.
+        def heal(obj):
+            obj.meta.annotations[CHAOS_LINK_HEALTH_ANNOTATION] = "0-1=healthy"
+        sim.api.update_with_retry(NODE, "tpu-node-0", "", heal)
+        sim.settle(max_steps=5)
+        rs = next(s for s in sim.api.list(RESOURCE_SLICE)
+                  if s.node_name == "tpu-node-0"
+                  and s.driver == "tpu.google.com")
+        assert not any(d.taints for d in rs.devices)
+        assert REASON_DEVICE_RECOVERED in {
+            e.reason for e in events_for(sim.api, node)}
+    finally:
+        sim.stop()
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+
+def test_four_node_domain_ici_failure_acceptance(tmp_path):
+    """ISSUE acceptance: a 4-node domain suffering one injected ICI-link
+    failure shows the Degraded condition transition and the deduped
+    DeviceDegraded/DomainDegraded events in `describe computedomain`, with
+    tpu_dra_device_health reflecting the failed link on the registry."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16",
+                     gates="TPUDeviceHealthCheck=true")
+    sim.start()
+    try:
+        apply_file(sim.api,
+                   os.path.join(SPECS_DIR, "computedomain/cd-multi-host.yaml"))
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+            .status.status == "Ready",
+            max_steps=60,
+        ), "domain never became Ready"
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+        assert not condition_true(cd.status.conditions, "Degraded")
+        ready_ltt = next(c for c in cd.status.conditions
+                         if c.type == "Degraded").last_transition_time
+
+        # Inject ONE ICI-link failure on a member node.
+        def annotate(obj):
+            obj.meta.annotations[CHAOS_LINK_HEALTH_ANNOTATION] = "0-1=unhealthy"
+        sim.api.update_with_retry(NODE, "tpu-node-1", "", annotate)
+        assert sim.wait_for(
+            lambda s: condition_true(
+                s.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+                .status.conditions, "Degraded"),
+            max_steps=30,
+        ), "Degraded condition never flipped"
+
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+        degraded = next(c for c in cd.status.conditions if c.type == "Degraded")
+        assert degraded.reason == "UnhealthyDevices"
+        assert "tpu-node-1" in degraded.message
+        # The condition TRANSITION is visible: lastTransitionTime moved.
+        assert degraded.last_transition_time >= ready_ltt
+        # Domain events: DomainDegraded, deduped.
+        cd_events = {e.reason: e for e in events_for(sim.api, cd)}
+        assert REASON_DOMAIN_DEGRADED in cd_events
+        # Node events: DeviceDegraded names the link.
+        node = sim.api.get(NODE, "tpu-node-1")
+        node_events = [e for e in events_for(sim.api, node)
+                       if e.reason == REASON_DEVICE_DEGRADED]
+        assert len(node_events) == 1, "DeviceDegraded not deduped"
+        assert "ICI link 0-1" in node_events[0].message
+
+        # The gauge reflects the failed link on the shared registry.
+        text = sim.metrics_registry.expose()
+        assert ('tpu_dra_device_health{node="tpu-node-1",kind="link",'
+                'id="0-1"} 2.0') in text
+
+        # describe computedomain renders the transition + both events.
+        out = describe_object(sim.api, COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+        assert "Degraded" in out and "UnhealthyDevices" in out
+        assert "DomainDegraded" in out
+        assert "tpu-node-1" in out
+
+        # Heal -> domain recovers, DomainRecovered narrated.
+        def heal(obj):
+            obj.meta.annotations[CHAOS_LINK_HEALTH_ANNOTATION] = "0-1=healthy"
+        sim.api.update_with_retry(NODE, "tpu-node-1", "", heal)
+        assert sim.wait_for(
+            lambda s: not condition_true(
+                s.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi")
+                .status.conditions, "Degraded"),
+            max_steps=30,
+        )
+        assert REASON_DOMAIN_RECOVERED in {
+            e.reason for e in events_for(
+                sim.api,
+                sim.api.get(COMPUTE_DOMAIN, "jax-domain", "cd-multi"))}
+    finally:
+        sim.stop()

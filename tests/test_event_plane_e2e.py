@@ -1,0 +1,222 @@
+"""Event plane integration tier: the sim's actors narrate scheduling,
+allocation, prepare, and domain assembly through Events and typed
+conditions — the `kubectl describe` debugging loop, end to end."""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.e2e import SPECS_DIR
+from k8s_dra_driver_tpu.k8s.conditions import condition_true
+from k8s_dra_driver_tpu.k8s.core import (
+    CLAIM_COND_ALLOCATED,
+    CLAIM_COND_PREPARED,
+    COMPUTE_DOMAIN,
+    POD,
+    RESOURCE_CLAIM,
+)
+from k8s_dra_driver_tpu.pkg.events import (
+    REASON_ALLOCATION_FAILED,
+    REASON_CLIQUE_ASSEMBLED,
+    REASON_DOMAIN_READY,
+    REASON_FAILED_SCHEDULING,
+    REASON_NODE_JOINED,
+    REASON_PREPARED_DEVICES,
+    REASON_SCHEDULED,
+    events_for,
+)
+from k8s_dra_driver_tpu.sim.cluster import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import apply_file, load_manifests
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+WHOLE_HOST_POD = """
+apiVersion: v1
+kind: Pod
+metadata: {name: p0, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: whole}]
+---
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, allocationMode: All}]
+"""
+
+IMPOSSIBLE_POD = """
+apiVersion: v1
+kind: Pod
+metadata: {name: greedy, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: tpus, resourceClaimTemplateName: toobig}]
+---
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {name: toobig, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, deviceClassName: tpu.google.com, count: 8}]
+"""
+
+
+def _reasons(api, obj):
+    return {e.reason for e in events_for(api, obj)}
+
+
+def test_happy_path_events_and_claim_conditions(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        for obj in load_manifests(WHOLE_HOST_POD):
+            sim.api.create(obj)
+        sim.settle()
+        pod = sim.api.get(POD, "p0", "default")
+        assert pod.phase == "Running"
+        pod_events = events_for(sim.api, pod)
+        assert REASON_SCHEDULED in {e.reason for e in pod_events}
+        sched = next(e for e in pod_events if e.reason == REASON_SCHEDULED)
+        assert "feasibility filter" in sched.message
+        assert sched.source == "scheduler"
+        claim = sim.api.get(RESOURCE_CLAIM, "p0-tpus", "default")
+        assert REASON_PREPARED_DEVICES in _reasons(sim.api, claim)
+        assert condition_true(claim.conditions, CLAIM_COND_ALLOCATED)
+        assert condition_true(claim.conditions, CLAIM_COND_PREPARED)
+        alloc_cond = next(c for c in claim.conditions
+                          if c.type == CLAIM_COND_ALLOCATED)
+        assert pod.node_name in alloc_cond.message
+    finally:
+        sim.stop()
+
+
+def test_unschedulable_pod_gets_failed_scheduling_and_allocation_failed(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        for obj in load_manifests(IMPOSSIBLE_POD):
+            sim.api.create(obj)
+        sim.settle()
+        pod = sim.api.get(POD, "greedy", "default")
+        assert pod.phase == "Pending"
+        pod_events = events_for(sim.api, pod)
+        fs = next(e for e in pod_events
+                  if e.reason == REASON_FAILED_SCHEDULING)
+        # The feasibility-filter verdict rides in the message.
+        assert "0/1 nodes" in fs.message
+        assert "tpu-node-0" in fs.message
+        claim = sim.api.get(RESOURCE_CLAIM, "greedy-tpus", "default")
+        af = next(e for e in events_for(sim.api, claim)
+                  if e.reason == REASON_ALLOCATION_FAILED)
+        assert af.source == "allocator"
+        assert "tpu-node-0" in af.message
+    finally:
+        sim.stop()
+
+
+def test_repeated_unschedulable_passes_aggregate_not_duplicate(tmp_path):
+    """Capacity events re-admit the backlog; each retry dedups into the
+    same FailedScheduling Event instead of minting new objects."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        for obj in load_manifests(IMPOSSIBLE_POD):
+            sim.api.create(obj)
+        sim.settle()
+        # Poke capacity twice: each retry re-runs the scheduler verdict.
+        for i in range(2):
+            sim.api.create(load_manifests(
+                f"""
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata: {{name: poke{i}, namespace: default}}
+spec:
+  spec:
+    devices:
+      requests: [{{name: r, deviceClassName: tpu.google.com}}]
+""")[0])
+            sim.settle()
+        pod = sim.api.get(POD, "greedy", "default")
+        fs_events = [e for e in events_for(sim.api, pod)
+                     if e.reason == REASON_FAILED_SCHEDULING]
+        assert len(fs_events) == 1
+        assert fs_events[0].count >= 3
+        assert fs_events[0].last_timestamp >= fs_events[0].first_timestamp
+    finally:
+        sim.stop()
+
+
+def test_compute_domain_assembly_events_and_conditions(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        apply_file(sim.api,
+                   os.path.join(SPECS_DIR, "computedomain/cd-single-host.yaml"))
+        assert sim.wait_for(
+            lambda s: s.api.list(COMPUTE_DOMAIN, namespace="cd-single")
+            and s.api.list(COMPUTE_DOMAIN, namespace="cd-single")[0]
+            .status.status == "Ready",
+            max_steps=40,
+        )
+        cd = sim.api.list(COMPUTE_DOMAIN, namespace="cd-single")[0]
+        assert condition_true(cd.status.conditions, "Validated")
+        assert condition_true(cd.status.conditions, "Ready")
+        assert not condition_true(cd.status.conditions, "Degraded")
+        ready_cond = next(c for c in cd.status.conditions if c.type == "Ready")
+        assert ready_cond.reason == "AllNodesReady"
+        assert ready_cond.last_transition_time > 0
+        reasons = _reasons(sim.api, cd)
+        assert {REASON_NODE_JOINED, REASON_CLIQUE_ASSEMBLED,
+                REASON_DOMAIN_READY} <= reasons
+    finally:
+        sim.stop()
+
+
+def test_rejected_domain_validated_condition_and_event(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        for obj in load_manifests("""
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: too-big, namespace: default}
+spec: {numNodes: 9999}
+"""):
+            sim.api.create(obj)
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "too-big", "default")
+            .status.status == "Rejected",
+            max_steps=20,
+        )
+        cd = sim.api.get(COMPUTE_DOMAIN, "too-big", "default")
+        validated = next(c for c in cd.status.conditions
+                         if c.type == "Validated")
+        assert validated.status == "False"
+        assert validated.reason == "BoundsExceeded"
+        assert "DomainRejected" in _reasons(sim.api, cd)
+    finally:
+        sim.stop()
+
+
+def test_events_emitted_metric_on_shared_registry(tmp_path):
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4")
+    sim.start()
+    try:
+        for obj in load_manifests(WHOLE_HOST_POD):
+            sim.api.create(obj)
+        sim.settle()
+        text = sim.metrics_registry.expose()
+        assert 'tpu_dra_events_emitted_total{component="scheduler",reason="Scheduled"}' in text
+        assert "tpu_dra_events_suppressed_total" in text
+    finally:
+        sim.stop()

@@ -1,0 +1,287 @@
+"""Event plane unit tier: recorder aggregation, burst limiting, backlog
+bounds, condition monotonicity, and the reason-catalog CI gate."""
+
+import re
+import subprocess
+import sys
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.conditions import (
+    CONDITION_FALSE,
+    CONDITION_TRUE,
+    Condition,
+    condition_true,
+    get_condition,
+    set_condition,
+)
+from k8s_dra_driver_tpu.k8s.core import EVENT, Pod
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.k8s.serialize import from_wire, to_wire
+from k8s_dra_driver_tpu.pkg import events as events_mod
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_FAILED_SCHEDULING,
+    events_for,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def _pod(api, name="p0", ns="default"):
+    return api.create(Pod(meta=new_meta(name, ns)))
+
+
+def test_storm_collapses_to_one_event_with_count_and_timestamps():
+    """The satellite contract: a 100x repeated FailedScheduling storm is ONE
+    Event with count=100 and first/last timestamps spanning the storm."""
+    api = APIServer()
+    clock = FakeClock()
+    reg = Registry()
+    rec = EventRecorder(api, "scheduler", metrics_registry=reg, clock=clock)
+    pod = _pod(api)
+    msg = "0/4 nodes can place the pod: tpu-node-0: no device matches"
+    for _ in range(100):
+        rec.warning(pod, REASON_FAILED_SCHEDULING, msg)
+        clock.tick(1.0)
+    evs = events_for(api, pod)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.count == 100
+    assert ev.reason == REASON_FAILED_SCHEDULING
+    assert ev.type == "Warning"
+    assert ev.first_timestamp == 1000.0
+    assert ev.last_timestamp == 1099.0
+    assert rec.emitted_total.value("scheduler", REASON_FAILED_SCHEDULING) == 100
+    assert rec.suppressed_total.value("scheduler", REASON_FAILED_SCHEDULING) == 0
+
+
+def test_dedup_is_cross_recorder():
+    """Deterministic Event names: two recorder instances (two processes in
+    real life) aggregate into the same stored object."""
+    api = APIServer()
+    pod = _pod(api)
+    r1 = EventRecorder(api, "scheduler")
+    r2 = EventRecorder(api, "scheduler")
+    r1.warning(pod, REASON_FAILED_SCHEDULING, "same message")
+    r2.warning(pod, REASON_FAILED_SCHEDULING, "same message")
+    evs = events_for(api, pod)
+    assert len(evs) == 1 and evs[0].count == 2
+
+
+def test_distinct_messages_are_distinct_series():
+    api = APIServer()
+    pod = _pod(api)
+    rec = EventRecorder(api, "scheduler")
+    rec.warning(pod, REASON_FAILED_SCHEDULING, "reason A")
+    rec.warning(pod, REASON_FAILED_SCHEDULING, "reason B")
+    assert len(events_for(api, pod)) == 2
+
+
+def test_burst_limiter_suppresses_and_counts():
+    """New-series creation consumes tokens; suppression is itself counted
+    (the satellite's 'burst limiter suppression is itself counted')."""
+    api = APIServer()
+    clock = FakeClock()
+    rec = EventRecorder(api, "scheduler", clock=clock, burst=3,
+                        refill_per_s=0.0)
+    pod = _pod(api)
+    for i in range(5):
+        rec.warning(pod, REASON_FAILED_SCHEDULING, f"distinct message {i}")
+    assert len(events_for(api, pod)) == 3
+    assert rec.suppressed_total.value("scheduler", REASON_FAILED_SCHEDULING) == 2
+    # Aggregation updates stay free even with an empty bucket.
+    rec.warning(pod, REASON_FAILED_SCHEDULING, "distinct message 0")
+    evs = {e.message: e for e in events_for(api, pod)}
+    assert evs["distinct message 0"].count == 2
+
+
+def test_burst_limiter_refills():
+    api = APIServer()
+    clock = FakeClock()
+    rec = EventRecorder(api, "scheduler", clock=clock, burst=1,
+                        refill_per_s=1.0)
+    pod = _pod(api)
+    assert rec.warning(pod, REASON_FAILED_SCHEDULING, "m1") is not None
+    assert rec.warning(pod, REASON_FAILED_SCHEDULING, "m2") is None
+    clock.tick(2.0)  # refill
+    assert rec.warning(pod, REASON_FAILED_SCHEDULING, "m3") is not None
+
+
+def test_per_object_backlog_is_bounded_and_evicts_stalest():
+    api = APIServer()
+    clock = FakeClock()
+    rec = EventRecorder(api, "scheduler", clock=clock, burst=100,
+                        max_events_per_object=4)
+    pod = _pod(api)
+    for i in range(6):
+        rec.warning(pod, REASON_FAILED_SCHEDULING, f"series {i}")
+        clock.tick(1.0)
+    evs = events_for(api, pod)
+    assert len(evs) == 4
+    # The two oldest series were evicted; the newest survive.
+    assert {e.message for e in evs} == {f"series {i}" for i in range(2, 6)}
+
+
+def test_backlog_is_per_object_not_global():
+    api = APIServer()
+    rec = EventRecorder(api, "scheduler", burst=100, max_events_per_object=2)
+    p0, p1 = _pod(api, "p0"), _pod(api, "p1")
+    for i in range(3):
+        rec.warning(p0, REASON_FAILED_SCHEDULING, f"m{i}")
+        rec.warning(p1, REASON_FAILED_SCHEDULING, f"m{i}")
+    assert len(events_for(api, p0)) == 2
+    assert len(events_for(api, p1)) == 2
+
+
+def test_tracked_object_state_is_bounded(monkeypatch):
+    """Per-object correlator state (token buckets, series gates) is LRU-
+    evicted past the cap — narrating short-lived objects forever must not
+    grow a long-lived recorder's memory."""
+    monkeypatch.setattr(events_mod, "MAX_TRACKED_OBJECTS", 8)
+    api = APIServer()
+    clock = FakeClock()
+    rec = EventRecorder(api, "scheduler", clock=clock, burst=5)
+    for i in range(40):
+        rec.normal(_pod(api, f"p{i}"), "Scheduled", f"assigned p{i}")
+        clock.tick(1.0)
+    assert len(rec._buckets) <= 8
+    assert len(rec._series_seen) <= 8
+
+
+def test_cluster_scoped_object_events_land_in_default_namespace():
+    """Node events are filed under "default" (matching real Kubernetes) so
+    `get events` shows DeviceDegraded rows without -A."""
+    from k8s_dra_driver_tpu.k8s.core import Node
+
+    api = APIServer()
+    node = api.create(Node(meta=new_meta("n0")))
+    rec = EventRecorder(api, "tpu-kubelet-plugin")
+    rec.warning(node, "DeviceDegraded", "ICI link 0-1 is unhealthy")
+    stored = api.list(EVENT, namespace="default")
+    assert len(stored) == 1
+    assert stored[0].involved_object.kind == "Node"
+    assert events_for(api, node)[0].reason == "DeviceDegraded"
+
+
+def test_event_round_trips_through_wire_codec():
+    api = APIServer()
+    pod = _pod(api)
+    rec = EventRecorder(api, "scheduler")
+    rec.normal(pod, "Scheduled", "assigned default/p0 to tpu-node-0")
+    ev = api.list(EVENT, namespace="default")[0]
+    back = from_wire(to_wire(ev))
+    assert back.kind == EVENT
+    assert back.involved_object.uid == pod.uid
+    assert back.reason == "Scheduled"
+    assert back.count == 1
+
+
+def test_recorder_never_raises(monkeypatch):
+    """A recorder failure must not break the emitting actor."""
+    api = APIServer()
+    pod = _pod(api)
+    rec = EventRecorder(api, "scheduler")
+    monkeypatch.setattr(rec, "_record",
+                        lambda *a, **k: (_ for _ in ()).throw(RuntimeError()))
+    assert rec.normal(pod, "Scheduled", "boom") is None
+
+
+# -- reason catalog ----------------------------------------------------------
+
+
+def test_all_reason_constants_are_camelcase():
+    camel = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+    reasons = [v for k, v in vars(events_mod).items()
+               if k.startswith("REASON_")]
+    assert reasons, "no reason constants found"
+    for r in reasons:
+        assert camel.match(r), f"reason {r!r} is not CamelCase"
+
+
+def test_check_event_reasons_gate_passes():
+    proc = subprocess.run(
+        [sys.executable, "hack/check_event_reasons.py"],
+        capture_output=True, text=True,
+        cwd=__import__("os").path.dirname(__import__("os").path.dirname(
+            __import__("os").path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_event_reasons_gate_fails_on_undocumented(tmp_path):
+    """The checker actually bites: an emitted reason absent from events.md
+    (or not CamelCase) fails the run."""
+    import os
+    import shutil
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tmp_path / "repo"
+    (work / "hack").mkdir(parents=True)
+    shutil.copy(os.path.join(repo, "hack", "check_event_reasons.py"),
+                work / "hack" / "check_event_reasons.py")
+    pkg = work / "k8s_dra_driver_tpu"
+    pkg.mkdir()
+    (pkg / "thing.py").write_text(
+        'REASON_BAD = "not_camel_case"\n'
+        'rec.warning(x, reason="Undocumented", message="m")\n')
+    docs = work / "docs" / "reference"
+    docs.mkdir(parents=True)
+    (docs / "events.md").write_text("# Events\n\nonly `SomethingElse` here\n")
+    proc = subprocess.run(
+        [sys.executable, "hack/check_event_reasons.py"],
+        capture_output=True, text=True, cwd=work,
+    )
+    assert proc.returncode == 1
+    assert "not CamelCase" in proc.stderr
+    assert "Undocumented" in proc.stderr
+
+
+# -- conditions --------------------------------------------------------------
+
+
+def test_set_condition_monotonic_transition_time():
+    conds = []
+    assert set_condition(conds, "Ready", CONDITION_FALSE, "Waiting", "0/4",
+                         now=10.0)
+    c = get_condition(conds, "Ready")
+    assert c.last_transition_time == 10.0
+    # Same status, new message: refreshed, but the transition time holds.
+    assert set_condition(conds, "Ready", CONDITION_FALSE, "Waiting", "2/4",
+                         now=20.0)
+    assert c.last_transition_time == 10.0 and c.message == "2/4"
+    # No-op write returns False (the change gates rely on it).
+    assert not set_condition(conds, "Ready", CONDITION_FALSE, "Waiting", "2/4",
+                             now=30.0)
+    # Status flip: the transition time finally moves.
+    assert set_condition(conds, "Ready", CONDITION_TRUE, "AllReady", "4/4",
+                         now=40.0)
+    assert c.last_transition_time == 40.0
+    assert condition_true(conds, "Ready")
+
+
+def test_condition_round_trips_through_wire_codec():
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainStatus,
+    )
+
+    cd = ComputeDomain(meta=new_meta("d", "ns"))
+    cd.status = ComputeDomainStatus(
+        status="Ready",
+        conditions=[Condition(type="Ready", status=CONDITION_TRUE,
+                              reason="AllNodesReady", message="4/4",
+                              last_transition_time=5.0)],
+    )
+    back = from_wire(to_wire(cd))
+    assert back.status.conditions[0].type == "Ready"
+    assert back.status.conditions[0].last_transition_time == 5.0

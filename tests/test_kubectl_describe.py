@@ -1,0 +1,155 @@
+"""kubectl surface: the describe verb (status + conditions + deduped event
+table) and scriptable single-object `get -o yaml/json`, both in-process and
+over the HTTP wire the shell e2e tier uses."""
+
+import json
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.conditions import CONDITION_TRUE, Condition
+from k8s_dra_driver_tpu.k8s.core import (
+    NODE,
+    POD,
+    Node,
+    Pod,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.httpapi import HTTPAPIServer
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg.events import EventRecorder
+from k8s_dra_driver_tpu.sim.kubectl import describe_object, main as kubectl_main
+
+
+@pytest.fixture
+def srv():
+    s = HTTPAPIServer().start()
+    try:
+        yield s
+    finally:
+        s.stop()
+
+
+def _seed(api):
+    api.create(Node(meta=new_meta("n0")))
+    pod = api.create(Pod(meta=new_meta("web", "default"), phase="Running",
+                         node_name="n0", ready=True))
+    claim = api.create(ResourceClaim(
+        meta=new_meta("web-tpus", "default"),
+        conditions=[Condition(type="Allocated", status=CONDITION_TRUE,
+                              reason="Allocated", message="allocated on n0",
+                              last_transition_time=1.0)],
+    ))
+    rec = EventRecorder(api, "scheduler")
+    rec.normal(pod, "Scheduled", "assigned default/web to n0")
+    rec.warning(claim, "AllocationFailed", "transient: no capacity")
+    rec.warning(claim, "AllocationFailed", "transient: no capacity")
+    return pod, claim
+
+
+def test_describe_pod_renders_status_and_events():
+    api = APIServer()
+    _seed(api)
+    out = describe_object(api, POD, "web", "default")
+    assert "Name:       web" in out
+    assert "Phase:  Running (ready)" in out
+    assert "Node:   n0" in out
+    assert "Scheduled" in out and "assigned default/web to n0" in out
+    assert "From" in out and "scheduler" in out
+
+
+def test_describe_claim_renders_conditions_and_dedup_count():
+    api = APIServer()
+    _seed(api)
+    out = describe_object(api, "ResourceClaim", "web-tpus", "default")
+    assert "Allocated" in out and "allocated on n0" in out
+    # The duplicate AllocationFailed collapsed into one row with count 2.
+    lines = [l for l in out.splitlines() if "AllocationFailed" in l]
+    assert len(lines) == 1 and " 2 " in lines[0] + " "
+
+
+def test_describe_node_lists_slices_and_events():
+    api = APIServer()
+    pod, _ = _seed(api)
+    out = describe_object(api, NODE, "n0")
+    assert "Kind:       Node" in out
+    assert "Events:" in out
+
+
+def test_describe_object_without_events_says_none():
+    api = APIServer()
+    api.create(Node(meta=new_meta("lonely")))
+    out = describe_object(api, NODE, "lonely")
+    assert "Events:  <none>" in out
+
+
+# -- through the CLI over HTTP ----------------------------------------------
+
+
+def test_cli_describe_over_http(srv, capsys):
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "describe", "pod", "web"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Phase:  Running (ready)" in out
+    assert "Scheduled" in out
+
+
+def test_cli_get_single_object_yaml(srv, capsys):
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "pod", "web", "-o", "yaml"])
+    assert rc == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    # One document, full status — scriptable in shell e2e tests.
+    assert doc["kind"] == "Pod"
+    assert doc["phase"] == "Running"
+    assert doc["meta"]["name"] == "web"
+
+
+def test_cli_get_claim_yaml_includes_conditions(srv, capsys):
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "resourceclaim",
+                       "web-tpus", "-o", "yaml"])
+    assert rc == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["conditions"][0]["type"] == "Allocated"
+    assert doc["conditions"][0]["status"] == "True"
+
+
+def test_cli_get_list_yaml_wraps_items(srv, capsys):
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "pods", "-o", "yaml"])
+    assert rc == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert [p["meta"]["name"] for p in doc["items"]] == ["web"]
+
+
+def test_cli_get_json_list_shape_unchanged(srv, capsys):
+    """The shell tier parses `get pod NAME -o json` as an array — the yaml
+    addition must not break that contract."""
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "pod", "web", "-o", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert isinstance(doc, list) and doc[0]["phase"] == "Running"
+
+
+def test_cli_get_events_kind(srv, capsys):
+    _seed(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "events"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Normal/Scheduled" in out
+
+
+def test_sim_main_dispatches_describe(srv, capsys, monkeypatch):
+    """`python -m k8s_dra_driver_tpu.sim describe ...` reaches the kubectl
+    describe verb (the acceptance criterion's spelling)."""
+    from k8s_dra_driver_tpu.sim.__main__ import main as sim_main
+
+    _seed(srv.api)
+    monkeypatch.setenv("TPU_KUBECTL_SERVER", srv.url)
+    rc = sim_main(["describe", "pod", "web"])
+    assert rc == 0
+    assert "Phase:  Running (ready)" in capsys.readouterr().out

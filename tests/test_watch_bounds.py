@@ -1,0 +1,71 @@
+"""Bounded APIServer watch queues: a stalled watcher cannot grow memory
+without bound; drops are oldest-first and counted."""
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import POD, Pod
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.k8s.store import WATCH_QUEUE_MAXSIZE
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+
+
+def test_default_watch_queue_is_bounded():
+    api = APIServer()
+    q = api.watch(POD)
+    assert q.maxsize == WATCH_QUEUE_MAXSIZE > 0
+
+
+def test_stalled_watcher_stays_bounded_and_drops_oldest():
+    api = APIServer()
+    q = api.watch(POD, maxsize=8)
+    for i in range(20):
+        api.create(Pod(meta=new_meta(f"p{i}", "default")))
+    assert q.qsize() == 8
+    assert api.stats.watch_events_dropped == 12
+    # Oldest-drop semantics: the queue holds the 12 newest events.
+    first = q.get_nowait()
+    assert first.obj.meta.name == "p12"
+    names = [first.obj.meta.name] + [q.get_nowait().obj.meta.name
+                                     for _ in range(7)]
+    assert names == [f"p{i}" for i in range(12, 20)]
+
+
+def test_draining_watcher_never_drops():
+    api = APIServer()
+    q = api.watch(POD, maxsize=8)
+    for i in range(30):
+        api.create(Pod(meta=new_meta(f"p{i}", "default")))
+        q.get_nowait()
+    assert api.stats.watch_events_dropped == 0
+
+
+def test_drop_counter_exported_on_registry():
+    api = APIServer()
+    reg = Registry()
+    api.attach_metrics(reg)
+    q = api.watch(POD, maxsize=2)
+    for i in range(5):
+        api.create(Pod(meta=new_meta(f"p{i}", "default")))
+    assert q.qsize() == 2
+    text = reg.expose()
+    assert 'tpu_dra_watch_dropped_total{kind="Pod"} 3.0' in text
+
+
+def test_snapshot_reports_drops():
+    api = APIServer()
+    q = api.watch(POD, maxsize=1)
+    api.create(Pod(meta=new_meta("a", "default")))
+    api.create(Pod(meta=new_meta("b", "default")))
+    assert api.stats.snapshot()["watch_events_dropped"] == 1
+    assert q.qsize() == 1
+
+
+def test_name_and_namespace_filtered_watchers_unaffected():
+    """Filtered watchers only queue matching events, so churn elsewhere
+    never evicts their backlog."""
+    api = APIServer()
+    q = api.watch(POD, name="special", namespace="default", maxsize=2)
+    api.create(Pod(meta=new_meta("special", "default")))
+    for i in range(20):
+        api.create(Pod(meta=new_meta(f"noise{i}", "default")))
+    assert q.qsize() == 1
+    assert q.get_nowait().obj.meta.name == "special"
