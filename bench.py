@@ -281,6 +281,123 @@ spec:
     return out
 
 
+def bench_placement(num_nodes: int = 64, seed: int = 11, max_claims: int = 5000,
+                    assert_budget: bool = False) -> dict:
+    """Topology-aware placement engine benchmark (PR 5): a churn storm of
+    mixed v5e-1/2/4 claims (single chips, 1x2/2x1 ICI subslices, whole
+    4-chip hosts) against ``num_nodes`` v5e-4 hosts, run twice on identical
+    state — fragmentation-scored best-fit vs the PR 3 first-fit baseline
+    (slice-order device pick, most-free-first node rank).
+
+    Packing efficiency = claims placed before the FIRST unplaceable
+    whole-host claim: the baseline smears small claims across empty hosts
+    and strands whole-host capacity early; best-fit packs them tightly and
+    keeps empty hosts intact. Also reports allocation throughput and
+    allocator probes-per-bind (must stay within PR 3's <=3 budget — the
+    packing rank must not reintroduce probe fan-out).
+
+    ``assert_budget=True`` (the bench-smoke wiring) hard-fails the run
+    unless best-fit places >=15% more claims than the baseline with
+    probes-per-bind in budget."""
+    import random
+
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import DeviceClass, DeviceRequest, ResourceClaim
+    from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+    from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+    from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+    from k8s_dra_driver_tpu.sim.allocator import Allocator
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    TPU_CLASS = "tpu.google.com"
+    SUB_CLASS = "subslice.tpu.google.com"
+
+    def make_api():
+        api = APIServer()
+        api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver=TPU_CLASS,
+                               match_attributes={"type": "tpu"}))
+        api.create(DeviceClass(meta=new_meta(SUB_CLASS), driver=TPU_CLASS,
+                               match_attributes={"type": "subslice"}))
+        for w in range(num_nodes):
+            inv = MockTpuLib("v5e-4", worker_id=0,
+                             slice_uid=f"bench-slice.{w}").enumerate()
+            devices = enumerate_allocatable(inv, with_subslices=True)
+            api.create(build_resource_slice(
+                f"bench-node-{w}", TPU_CLASS, devices, inv))
+        return api
+
+    def next_claim(rng, i):
+        r = rng.random()
+        if r < 0.5:
+            req = DeviceRequest(name="r", device_class_name=TPU_CLASS, count=1)
+            large = False
+        elif r < 0.8:
+            prof = rng.choice(("1x2", "2x1"))
+            req = DeviceRequest(name="r", device_class_name=SUB_CLASS,
+                                count=1, selectors=[f"profile={prof}"])
+            large = False
+        else:
+            req = DeviceRequest(name="r", device_class_name=TPU_CLASS, count=4)
+            large = True
+        c = ResourceClaim(meta=new_meta(f"c{i}", "default"), requests=[req])
+        c.meta.uid = fresh_uid()
+        return c, large
+
+    def run(best_fit: bool):
+        api = make_api()
+        alloc = Allocator(api, best_fit=best_fit)
+        rng = random.Random(seed)  # identical claim sequence both runs
+        alloc.begin_pass()
+        placed = large_placed = 0
+        t0 = time.perf_counter()
+        for i in range(max_claims):
+            claim, large = next_claim(rng, i)
+            res = None
+            for node in alloc.feasible_nodes(claim):
+                res = alloc.allocate_on_node(claim, node)
+                if res is not None:
+                    break
+            if res is None:
+                if large:
+                    break  # first unplaceable whole-host claim ends the storm
+                continue  # small claims may keep landing in the gaps
+            alloc.commit(res)
+            placed += 1
+            large_placed += large
+        wall = time.perf_counter() - t0
+        alloc.end_pass()
+        stats = alloc.last_pass_stats
+        return {
+            "placed": placed,
+            "large_placed": large_placed,
+            "probes_per_bind": round(
+                stats["nodes_probed"] / max(1, stats["commits"]), 2),
+            "claims_per_s": round(placed / max(wall, 1e-9), 1),
+        }
+
+    best = run(best_fit=True)
+    base = run(best_fit=False)
+    out = {
+        "placement_nodes": num_nodes,
+        "placement_bestfit_claims": best["placed"],
+        "placement_firstfit_claims": base["placed"],
+        "placement_gain_pct": round(
+            100.0 * (best["placed"] - base["placed"]) / max(1, base["placed"]), 1),
+        "placement_bestfit_large_claims": best["large_placed"],
+        "placement_firstfit_large_claims": base["large_placed"],
+        "placement_probes_per_bind": best["probes_per_bind"],
+        "placement_claims_per_s": best["claims_per_s"],
+    }
+    if assert_budget:
+        # Best-fit must never pack worse than first-fit, must beat it by
+        # >=15% on the mixed-profile storm, and must hold PR 3's
+        # probes-per-bind budget.
+        assert best["placed"] >= base["placed"], (best, base)
+        assert best["placed"] >= 1.15 * base["placed"], (best, base)
+        assert best["probes_per_bind"] <= 3.0, best
+    return out
+
+
 # Public peak dense-bf16 FLOP/s per chip (cloud.google.com/tpu/docs spec
 # pages); device_kind strings as libtpu reports them.
 PEAK_BF16_FLOPS = {
@@ -699,6 +816,10 @@ def main() -> None:
         # trend line.
         result.update(bench_scheduler(
             node_counts=(64,), storm_pods=32, assert_budget=True))
+        # Packing gate: best-fit must place >=15% more mixed-profile
+        # claims than the first-fit baseline at 64 nodes, within the
+        # probes-per-bind budget — a placement-engine regression fails CI.
+        result.update(bench_placement(num_nodes=64, assert_budget=True))
         print(json.dumps(result))
         return
     result = bench_prepare_latency()
@@ -714,6 +835,12 @@ def main() -> None:
         result.update(bench_scheduler())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["sched_error"] = str(e)[:200]
+    try:
+        # Placement engine: packing efficiency best-fit vs first-fit,
+        # allocation throughput, probes-per-bind at 64 nodes.
+        result.update(bench_placement())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["placement_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

@@ -72,10 +72,25 @@ class ComputeDomainNode:
 
 
 @dataclass
+class ComputeDomainPlacement:
+    """The host-grid block the scheduler chose for this domain: a
+    contiguous axis-aligned rectangle of hosts within ONE ICI domain's
+    host grid (e.g. a 2x2 block at 0x0 of a v5e-16 slice). Recorded so
+    the daemon's clique assembly — and any operator reading `describe` —
+    sees placement that reflects real ICI adjacency, not just N nodes."""
+
+    ici_domain: str = ""
+    block_origin: str = ""   # host-grid coords, e.g. "0x0"
+    block_shape: str = ""    # host-grid units, e.g. "2x2"
+    nodes: List[str] = field(default_factory=list)  # row-major over block
+
+
+@dataclass
 class ComputeDomainStatus:
     status: str = CD_STATUS_NOT_READY
     nodes: List[ComputeDomainNode] = field(default_factory=list)
     conditions: List[Condition] = field(default_factory=list)
+    placement: Optional[ComputeDomainPlacement] = None
 
 
 @dataclass

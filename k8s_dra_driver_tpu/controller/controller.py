@@ -480,8 +480,12 @@ class Controller:
         else:
             set_condition(conds, CD_COND_DEGRADED, CONDITION_FALSE,
                           "AllDevicesHealthy", "")
+        # The scheduler owns status.placement (the chosen host-grid
+        # block); the controller's aggregation must carry it, not wipe it.
         desired = ComputeDomainStatus(status=status, nodes=nodes,
-                                      conditions=conds)
+                                      conditions=conds,
+                                      placement=copy.deepcopy(
+                                          fresh.status.placement))
         if fresh.status == desired:
             self.metric.set(cd.namespace, cd.name, status)
             return
@@ -489,7 +493,12 @@ class Controller:
         was_degraded = condition_true(fresh.status.conditions, CD_COND_DEGRADED)
 
         def mutate(obj):
-            obj.status = copy.deepcopy(desired)
+            # Placement is re-read from the LIVE object, not the pre-read
+            # copy: a CAS retry against a scheduler that just recorded the
+            # block must not revert it to the stale (None) value.
+            new = copy.deepcopy(desired)
+            new.placement = copy.deepcopy(obj.status.placement)
+            obj.status = new
 
         try:
             self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)

@@ -27,6 +27,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainClique,
     ComputeDomainDaemonInfo,
     ComputeDomainNode,
+    ComputeDomainPlacement,
     ComputeDomainSpec,
     ComputeDomainStatus,
 )
@@ -866,6 +867,14 @@ def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
             }
             for n in cd.status.nodes
         ]
+    if cd.status.placement is not None:
+        p = cd.status.placement
+        status["placement"] = {
+            "iciDomain": p.ici_domain,
+            "blockOrigin": p.block_origin,
+            "blockShape": p.block_shape,
+            "nodes": list(p.nodes),
+        }
     return {"spec": spec, "status": status}
 
 
@@ -894,6 +903,15 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
                 )
                 for n in status.get("nodes") or []
             ],
+            placement=(
+                ComputeDomainPlacement(
+                    ici_domain=status["placement"].get("iciDomain", ""),
+                    block_origin=status["placement"].get("blockOrigin", ""),
+                    block_shape=status["placement"].get("blockShape", ""),
+                    nodes=list(status["placement"].get("nodes") or []),
+                )
+                if status.get("placement") else None
+            ),
         ),
     )
 

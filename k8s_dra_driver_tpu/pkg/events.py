@@ -57,6 +57,7 @@ log = logging.getLogger(__name__)
 REASON_SCHEDULED = "Scheduled"
 REASON_FAILED_SCHEDULING = "FailedScheduling"
 REASON_ALLOCATION_FAILED = "AllocationFailed"
+REASON_DOMAIN_PLACED = "DomainPlaced"
 # Kubelet plugins
 REASON_PREPARED_DEVICES = "PreparedDevices"
 REASON_PREPARE_FAILED = "PrepareFailed"

@@ -1,0 +1,300 @@
+"""Topology-aware placement engine: bitmask subslice tables + host-grid math.
+
+Two layers, both precomputed once per topology and shared by reference:
+
+1. **Chip-level placement tables** (``PlacementTables``): every legal
+   subslice placement of a host topology — the same enumeration
+   ``compute_subslice_profiles`` feeds the kubelet plugin — becomes an int
+   chip-bitmask, with per-placement conflict masks (pairwise chip-set
+   intersection, the exact overlap rule ``DeviceState._validate_no_overlap``
+   enforces at Prepare time) and per-profile candidate lists. Overlap and
+   feasibility questions collapse to a single AND + popcount, and the
+   allocator can score a candidate device by how many *surviving
+   larger-profile* placements it would destroy — the fragmentation-aware
+   best-fit that keeps large ICI-contiguous subslices placeable under mixed
+   workloads (the MIG-fragmentation failure mode Flex-MIG/MISO document).
+
+2. **Host-grid block planning** (``choose_host_block``): a multi-host
+   ComputeDomain needs hosts that are *grid-adjacent* within one ICI
+   domain, not just "N free hosts". Given each candidate host's ici-domain
+   and host-grid coordinate (published as ResourceSlice attributes), the
+   planner enumerates contiguous axis-aligned blocks of the requested size
+   and returns the most compact one that is entirely free.
+
+Dependency-free (stdlib + tpulib types only) so both the sim allocator and
+the node plugins can use it.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.tpulib.profiles import (  # noqa: F401 — re-exported
+    compute_subslice_profiles,
+    host_grid_coord,
+    host_grid_dims,
+)
+from k8s_dra_driver_tpu.tpulib.types import (
+    format_topology,
+    parse_topology,
+    topology_chips,
+)
+
+
+def popcount(x: int) -> int:
+    return x.bit_count()
+
+
+def chips_to_mask(chips: Iterable[int]) -> int:
+    mask = 0
+    for c in chips:
+        mask |= 1 << c
+    return mask
+
+
+def chip_bits_of_device(dev) -> int:
+    """Chip-bitmask of an API ``Device``, derived from the counters it
+    consumes: every ``chip-<i>`` counter is one bit. This is the same
+    derivation rule the per-host CounterSet encodes (deviceinfo.py) and the
+    chip-index overlap rule DeviceState enforces, so two devices overlap
+    iff their masks AND to non-zero. Devices consuming no chip counters
+    (channels, daemons) map to 0."""
+    bits = 0
+    for cc in dev.consumes_counters:
+        for cname in cc.counters:
+            if cname.startswith("chip-"):
+                idx = cname[5:]
+                if idx.isdigit():
+                    bits |= 1 << int(idx)
+    return bits
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One legal placement: a profile shape at a fixed origin, as chips and
+    as a bitmask. ``index`` is the bit position in placement-set bitmaps."""
+
+    index: int
+    profile: str                 # "1x2", or the host topology for whole-host
+    chips: Tuple[int, ...]
+    mask: int
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+
+class PlacementTables:
+    """Precomputed bitmask tables for one host topology.
+
+    ``placements`` covers every subslice placement from
+    ``compute_subslice_profiles`` plus one synthetic *whole-host* placement
+    (all chips) so fragmentation scoring accounts for destroying whole-host
+    capacity — the shape multi-host ComputeDomain workers claim.
+
+    Two bitmap spaces:
+    - chip masks: bit i = host-local chip i (``mask`` fields);
+    - placement-set bitmaps: bit k = placement with ``index`` k
+      (``conflicts``/``larger_conflicts``/``surviving()`` results).
+    """
+
+    def __init__(self, host_topology: str):
+        self.host_topology = host_topology
+        self.dims = parse_topology(host_topology)
+        self.num_chips = topology_chips(host_topology)
+        self.full_mask = (1 << self.num_chips) - 1
+        placements: List[Placement] = []
+        for prof in compute_subslice_profiles(host_topology):
+            for pl in prof.placements:
+                placements.append(Placement(
+                    index=len(placements), profile=prof.name,
+                    chips=tuple(pl.chip_indices),
+                    mask=chips_to_mask(pl.chip_indices),
+                ))
+        # Whole-host rides along as the largest "profile": not a subslice
+        # device, but the capacity unit large claims consume and the one
+        # fragmentation destroys first.
+        self.whole_host_index = len(placements)
+        placements.append(Placement(
+            index=self.whole_host_index, profile=host_topology,
+            chips=tuple(range(self.num_chips)), mask=self.full_mask,
+        ))
+        self.placements: Tuple[Placement, ...] = tuple(placements)
+        by_profile: Dict[str, List[int]] = {}
+        by_mask: Dict[int, int] = {}
+        for p in self.placements:
+            by_profile.setdefault(p.profile, []).append(p.index)
+            by_mask[p.mask] = p.index
+        self.by_profile: Dict[str, Tuple[int, ...]] = {
+            k: tuple(v) for k, v in by_profile.items()
+        }
+        self.by_mask = by_mask
+        # conflicts[i]: placement-set bitmap of every OTHER placement whose
+        # chip set intersects placement i's (== chip-mask AND != 0).
+        # larger_conflicts[i]: same, restricted to strictly-larger profiles
+        # — the "surviving larger placements destroyed" term of the
+        # best-fit score.
+        conflicts = [0] * len(self.placements)
+        larger = [0] * len(self.placements)
+        for a in self.placements:
+            for b in self.placements:
+                if a.index != b.index and (a.mask & b.mask):
+                    conflicts[a.index] |= 1 << b.index
+                    if b.num_chips > a.num_chips:
+                        larger[a.index] |= 1 << b.index
+        self.conflicts: Tuple[int, ...] = tuple(conflicts)
+        self.larger_conflicts: Tuple[int, ...] = tuple(larger)
+        self.all_placements_bitmap = (1 << len(self.placements)) - 1
+
+    def surviving(self, used_mask: int,
+                  available: Optional[int] = None) -> int:
+        """Placement-set bitmap of placements still placeable: available
+        (device published and untainted) and with every chip free."""
+        if available is None:
+            available = self.all_placements_bitmap
+        out = 0
+        for p in self.placements:
+            if (available >> p.index) & 1 and not (p.mask & used_mask):
+                out |= 1 << p.index
+        return out
+
+    def frag_score(self, chip_mask: int, surviving: int) -> int:
+        """How many surviving strictly-larger placements choosing
+        ``chip_mask`` would destroy (lower = better packing). A mask that
+        is itself a table placement uses its precomputed conflict set (one
+        AND + popcount); an arbitrary mask falls back to a scan."""
+        idx = self.by_mask.get(chip_mask)
+        if idx is not None:
+            return popcount(self.larger_conflicts[idx] & surviving)
+        n = popcount(chip_mask)
+        score = 0
+        rest = surviving
+        while rest:
+            low = rest & -rest
+            p = self.placements[low.bit_length() - 1]
+            if p.num_chips > n and (p.mask & chip_mask):
+                score += 1
+            rest ^= low
+        return score
+
+    def largest_free_chips(self, used_mask: int,
+                           available: Optional[int] = None) -> int:
+        """Chips in the largest still-placeable profile (whole-host
+        included) — the per-node fragmentation signal
+        ``tpu_dra_node_frag_largest_free_profile`` exports."""
+        best = 0
+        rest = self.surviving(used_mask, available)
+        while rest:
+            low = rest & -rest
+            p = self.placements[low.bit_length() - 1]
+            if p.num_chips > best:
+                best = p.num_chips
+            rest ^= low
+        return best
+
+
+@lru_cache(maxsize=64)
+def tables_for(host_topology: str) -> PlacementTables:
+    """Memoized per-topology tables: a 64-node cluster of identical hosts
+    builds ONE table, not 64."""
+    return PlacementTables(host_topology)
+
+
+# -- host-grid math ----------------------------------------------------------
+# host_grid_dims / host_grid_coord are re-exported from tpulib.profiles —
+# ONE tiling rule shared by SliceProfile.host_grid, the tpulibs' chip-block
+# origins, the published hostCoord attribute, and the block planner below.
+
+
+def _block_shapes(grid: Tuple[int, ...], n: int) -> List[Tuple[int, ...]]:
+    """Axis-aligned block shapes of volume n fitting the grid, most compact
+    first (smallest max dimension — fewest ICI hops across the block)."""
+    shapes = set()
+    for dims in itertools.product(*(range(1, g + 1) for g in grid)):
+        vol = 1
+        for d in dims:
+            vol *= d
+        if vol == n:
+            shapes.add(dims)
+    return sorted(shapes, key=lambda s: (max(s), s))
+
+
+@dataclass(frozen=True)
+class HostBlock:
+    """A chosen contiguous host set within one ICI domain's host grid."""
+
+    ici_domain: str
+    origin: Tuple[int, ...]
+    shape: Tuple[int, ...]
+    nodes: Tuple[str, ...]       # row-major over the block's coordinates
+
+    @property
+    def origin_str(self) -> str:
+        return format_topology(self.origin) if len(self.origin) > 1 else str(
+            self.origin[0])
+
+    @property
+    def shape_str(self) -> str:
+        return format_topology(self.shape) if len(self.shape) > 1 else str(
+            self.shape[0])
+
+
+def choose_host_block(
+    topologies: Dict[str, dict],
+    free_nodes: Sequence[str],
+    num_nodes: int,
+) -> Optional[HostBlock]:
+    """Pick a contiguous host-grid block of ``num_nodes`` free hosts.
+
+    ``topologies``: node -> {"ici_domain", "slice_topology",
+    "host_topology", "host_coord" (tuple)} — the ResourceSlice attribute
+    surface. ``free_nodes``: nodes the feasibility filter admitted for the
+    domain's whole-host claim, in preference order.
+
+    Deterministic choice: ICI domains in the order their first free node
+    appears in ``free_nodes`` preference order (name order on ties), block
+    shapes most-compact-first, origins ascending. Returns None when no
+    domain holds a fully-free block of the requested size (the scheduler
+    then degrades to unaligned placement rather than deadlocking)."""
+    free = [n for n in free_nodes if n in topologies]
+    if num_nodes <= 0 or len(free) < num_nodes:
+        return None
+    domains: Dict[str, Dict[Tuple[int, ...], str]] = {}
+    domain_order: List[str] = []
+    for node in free:
+        info = topologies[node]
+        dom = info.get("ici_domain", "")
+        coord = info.get("host_coord")
+        if coord is None:
+            continue
+        if dom not in domains:
+            domains[dom] = {}
+            domain_order.append(dom)
+        domains[dom][tuple(coord)] = node
+    for dom in domain_order:
+        coords = domains[dom]
+        if len(coords) < num_nodes:
+            continue
+        any_node = next(iter(coords.values()))
+        info = topologies[any_node]
+        try:
+            grid = host_grid_dims(info["slice_topology"],
+                                  info["host_topology"])
+        except (KeyError, ValueError, TypeError):
+            # Missing/None topology strings must degrade to "no block in
+            # this domain", never abort the scheduler pass.
+            continue
+        for shape in _block_shapes(grid, num_nodes):
+            for origin in itertools.product(
+                    *(range(g - s + 1) for g, s in zip(grid, shape))):
+                cells = list(itertools.product(
+                    *(range(o, o + s) for o, s in zip(origin, shape))))
+                if all(c in coords for c in cells):
+                    return HostBlock(
+                        ici_domain=dom, origin=tuple(origin), shape=shape,
+                        nodes=tuple(coords[c] for c in cells),
+                    )
+    return None

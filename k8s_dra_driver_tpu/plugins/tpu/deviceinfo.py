@@ -27,6 +27,7 @@ from k8s_dra_driver_tpu.plugins.tpu.allocatable import (
     TpuDevice,
     VfioDevice,
 )
+from k8s_dra_driver_tpu.pkg import placement
 from k8s_dra_driver_tpu.tpulib.types import HostInventory
 
 HOST_COUNTER_SET = "tpu-host-chips"
@@ -34,6 +35,26 @@ HOST_COUNTER_SET = "tpu-host-chips"
 
 def chip_counter_name(index: int) -> str:
     return f"chip-{index}"
+
+
+def _host_grid_attrs(inv: HostInventory) -> Dict[str, str]:
+    """Host-grid coordinates for topology-aware domain placement: where
+    this host's chip block sits in the slice's grid of hosts
+    (``hostCoord``) and the grid's dimensions (``hostGrid``), both in
+    host units. The scheduler's host-set planner groups by ``iciDomain``
+    and packs ComputeDomains onto grid-contiguous blocks using exactly
+    these. Omitted when the host shape doesn't tile the slice (defensive:
+    enumeration should never produce that)."""
+    try:
+        grid = placement.host_grid_dims(inv.slice_topology, inv.host_topology)
+        coord = placement.host_grid_coord(inv.slice_topology,
+                                          inv.host_topology, inv.worker_id)
+    except (ValueError, TypeError):
+        return {}
+    return {
+        "tpu.google.com/hostGrid": "x".join(str(d) for d in grid),
+        "tpu.google.com/hostCoord": "x".join(str(c) for c in coord),
+    }
 
 
 def device_to_api(dev: AllocatableDevice, inv: HostInventory) -> Device:
@@ -46,6 +67,7 @@ def device_to_api(dev: AllocatableDevice, inv: HostInventory) -> Device:
         "tpu.google.com/workerId": inv.worker_id,
         "type": dev.device_type,
     }
+    common.update(_host_grid_attrs(inv))
     if isinstance(dev, TpuDevice):
         c = dev.chip
         attrs = {

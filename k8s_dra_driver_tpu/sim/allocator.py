@@ -18,6 +18,7 @@ from types import SimpleNamespace
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg import placement
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
 from k8s_dra_driver_tpu.pkg.workqueue import WORKQUEUE_SECONDS_BUCKETS
 from k8s_dra_driver_tpu.k8s.core import (
@@ -157,6 +158,20 @@ class AllocatorPassMetrics:
             "tpu_dra_allocator_pass_infeasible_skipped",
             "Nodes the feasibility pre-filter excluded last pass — "
             "probes the indexed scheduler never issued."))
+        self.frag_largest_free = registry.register(Gauge(
+            "tpu_dra_node_frag_largest_free_profile",
+            "Chips in the largest still-placeable subslice profile "
+            "(whole-host included) per node — the fragmentation signal: "
+            "free chips without a large placeable profile are stranded.",
+            ("node",),
+        ))
+        self.placement_score = registry.register(Histogram(
+            "tpu_dra_alloc_placement_score",
+            "Fragmentation score of each placement the best-fit allocator "
+            "chose: surviving larger-profile placements the choice "
+            "destroyed (0 = perfectly packing choice).",
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 16.0, 32.0),
+        ))
 
     def publish(self, stats: Dict[str, int], seconds: float) -> None:
         self.passes_total.inc()
@@ -178,8 +193,14 @@ def _pass_stats() -> Dict[str, int]:
 
 
 class Allocator:
-    def __init__(self, api: APIServer, metrics_registry: Optional[Registry] = None):
+    def __init__(self, api: APIServer, metrics_registry: Optional[Registry] = None,
+                 best_fit: bool = True):
         self.api = api
+        # Fragmentation-scored best-fit placement + packing-aware node
+        # rank. False reverts to the PR 3 behavior (slice-order first-fit,
+        # most-free-first) — kept as the bench_placement baseline and an
+        # escape hatch, not a supported production mode.
+        self.best_fit = best_fit
         self.metrics = AllocatorPassMetrics(metrics_registry or Registry())
         # Stats of the last completed pass (mirrors the gauges; handy for
         # the sim's scheduler-pass span attributes and tests).
@@ -192,6 +213,10 @@ class Allocator:
         # cache backing feasible_nodes(); invalidated when the slice or
         # DeviceClass fingerprint moves (see _feasibility_state).
         self._feas_cache: Optional[dict] = None
+        # Nodes with a published frag gauge series — forgotten when the
+        # node's slice disappears so /metrics never reports fragmentation
+        # for deleted nodes.
+        self._frag_nodes: set = set()
         # (claim_fp, slice_fp) -> (allocations, consumed) surviving across
         # passes while no ResourceClaim changed: a quiet cluster's
         # begin_pass is O(1) instead of O(claims). Any commit during a
@@ -251,21 +276,36 @@ class Allocator:
         if (alloc_fps is not None and self._alloc_cache is not None
                 and self._alloc_cache[0] == alloc_fps):
             allocations, consumed = self._alloc_cache[1], self._alloc_cache[2]
+            used_masks = self._alloc_cache[3]
         else:
             allocations = [
                 c.allocation for c in self.api.list(RESOURCE_CLAIM)
                 if c.allocation is not None
             ]
             consumed = {}
+            used_masks = {}
             for alloc in allocations:
                 self._accrue(consumed, index, alloc, +1)
+                self._accrue_mask(used_masks, index, alloc, +1)
             if alloc_fps is not None:
-                self._alloc_cache = (alloc_fps, allocations, consumed)
+                self._alloc_cache = (alloc_fps, allocations, consumed,
+                                     used_masks)
+        # Per-node {driver -> slice} — built once so allocate_on_node
+        # reuses the pass's device view instead of re-listing/rebuilding
+        # slices_by_driver on every node probe.
+        by_node: Dict[str, Dict[str, ResourceSlice]] = {}
+        for s in slices:
+            by_node.setdefault(s.node_name, {})[s.driver] = s
         self._pass_snapshot = {
             "slices": slices,
             "allocations": allocations,
             "index": index,  # (driver, node) -> {name -> Device}
+            "slices_by_node": by_node,  # node -> {driver -> slice}
             "consumed": consumed,  # node -> counter_set -> counter -> used
+            # node -> int chip-bitmask of allocated chips, maintained
+            # incrementally next to `consumed` (commit/rollback) — the
+            # placement engine's O(1) free-mask source.
+            "used_masks": used_masks,
             "classes": {},  # DeviceClass name -> (driver, attrs, cel)
             "plans": {},  # content key -> (driver, _MatchPlan)
             "stats": _pass_stats(),
@@ -288,6 +328,26 @@ class Allocator:
                 for cname, ctr in cc.counters.items():
                     node[cc.counter_set][cname] += sign * ctr.value
 
+    @staticmethod
+    def _accrue_mask(masks: Dict[str, int], index: Dict, alloc,
+                     sign: int) -> None:
+        """Fold one allocation's chip coverage into the per-node used-chip
+        bitmask. Chip counters cap at 1, so set/clear is exact: no two
+        live allocations can hold the same chip bit."""
+        if alloc is None or not alloc.node_name:
+            return
+        bits = 0
+        for r in alloc.devices:
+            dev = index.get((r.driver, alloc.node_name), {}).get(r.device)
+            if dev is not None:
+                bits |= placement.chip_bits_of_device(dev)
+        if not bits:
+            return
+        if sign > 0:
+            masks[alloc.node_name] = masks.get(alloc.node_name, 0) | bits
+        else:
+            masks[alloc.node_name] = masks.get(alloc.node_name, 0) & ~bits
+
     def commit(self, alloc) -> None:
         """Record an allocation written to the API during the active pass —
         it joins the snapshot's allocation list AND the incremental
@@ -297,8 +357,15 @@ class Allocator:
         if self._pass_snapshot is not None and alloc is not None:
             self._pass_snapshot["allocations"].append(alloc)
             self._pass_snapshot["stats"]["commits"] += 1
+            scores = getattr(alloc, "_placement_scores", None)
+            if scores is not None:
+                del alloc._placement_scores  # observe exactly once
+                for score in scores:
+                    self.metrics.placement_score.observe(value=score)
             self._accrue(self._pass_snapshot["consumed"],
                          self._pass_snapshot["index"], alloc, +1)
+            self._accrue_mask(self._pass_snapshot["used_masks"],
+                              self._pass_snapshot["index"], alloc, +1)
 
     def rollback(self, alloc) -> None:
         """Withdraw an allocation previously ``commit()``-ed this pass (the
@@ -318,9 +385,15 @@ class Allocator:
                 self._pass_snapshot["stats"]["rollbacks"] += 1
                 self._accrue(self._pass_snapshot["consumed"],
                              self._pass_snapshot["index"], alloc, -1)
+                self._accrue_mask(self._pass_snapshot["used_masks"],
+                                  self._pass_snapshot["index"], alloc, -1)
                 return
 
     def end_pass(self) -> None:
+        if self._pass_snapshot is not None:
+            # While the snapshot is still active so the feasibility state
+            # resolves against the pass's slice view, not a fresh listing.
+            self._publish_frag_gauges(self._pass_snapshot)
         snap, self._pass_snapshot = self._pass_snapshot, None
         if snap is not None:
             self.last_pass_stats = snap["stats"]
@@ -332,6 +405,28 @@ class Allocator:
                 self._alloc_cache = None
             self.metrics.publish(snap["stats"],
                                  time.perf_counter() - snap["t0"])
+
+    def _publish_frag_gauges(self, snap: dict) -> None:
+        """Per-node fragmentation gauge at pass end: chips in the largest
+        profile still placeable on each placement-table-backed node. One
+        AND+popcount sweep over the precomputed tables per node."""
+        try:
+            cache = self._feasibility_state()
+        except Exception:  # noqa: BLE001 — telemetry must not fail a pass
+            return
+        used_masks = snap["used_masks"]
+        seen = set()
+        for (_, node), entry in cache["entries"].items():
+            tables = entry.get("tables")
+            if tables is None:
+                continue
+            largest = tables.largest_free_chips(
+                used_masks.get(node, 0), entry["available"])
+            self.metrics.frag_largest_free.set(node, value=float(largest))
+            seen.add(node)
+        for node in self._frag_nodes - seen:
+            self.metrics.frag_largest_free.forget(node)
+        self._frag_nodes = seen
 
     def _list_slices(self):
         if self._pass_snapshot is not None:
@@ -438,7 +533,7 @@ class Allocator:
     def _feasibility_state(self) -> dict:
         """Static half of the node-capacity index: per (driver, node) the
         untainted devices, the slice's counter capacities, and total
-        capacity units (the most-free-first ordering key), plus the set of
+        capacity units (the packing-rank ordering key), plus the set of
         attribute values present per attribute. Built once and reused until
         the ResourceSlice or DeviceClass kind fingerprint moves — the
         dynamic half (consumed counters) already lives in the pass snapshot
@@ -464,6 +559,7 @@ class Allocator:
         if cache is not None and fps is not None and cache["fps"] == fps:
             return cache
         entries: Dict[Tuple[str, str], dict] = {}
+        topologies: Dict[str, dict] = {}
         for s in self._list_slices():
             caps = {cs.name: {c: ctr.value for c, ctr in cs.counters.items()}
                     for cs in s.shared_counters}
@@ -476,20 +572,107 @@ class Allocator:
             for d in untainted:
                 for k, v in d.attributes.items():
                     attr_values.setdefault(k, set()).add(v)
-            entries[(s.driver, s.node_name)] = {
+            entry = {
                 "devices": untainted,
                 "caps": caps,
                 "cap_units": sum(v for cc in caps.values()
                                  for v in cc.values()),
                 "attr_values": attr_values,
             }
+            self._build_placement_state(s, untainted, entry)
+            entries[(s.driver, s.node_name)] = entry
+            if entry.get("topo") is not None:
+                topologies[s.node_name] = entry["topo"]
         cap_units: Dict[str, int] = {}
         for (_, node), e in entries.items():
             cap_units[node] = cap_units.get(node, 0) + e["cap_units"]
         cache = {"fps": fps, "entries": entries, "match": {},
-                 "nodes": frozenset(cap_units), "node_cap_units": cap_units}
+                 "nodes": frozenset(cap_units), "node_cap_units": cap_units,
+                 "topologies": topologies}
         self._feas_cache = cache
         return cache
+
+    @staticmethod
+    def _build_placement_state(s: ResourceSlice, untainted, entry: dict) -> None:
+        """Attach the bitmask placement view to one static index entry:
+        the host's precomputed PlacementTables, a placement-availability
+        bitmap (a placement is available iff an untainted device with that
+        exact chip mask is published — a taint drops exactly its device's
+        placements, endpoint chips stay placeable), per-device chip masks,
+        and the node's grid/ICI-domain coordinates for host-set planning.
+        Slices without TPU topology attributes get no placement state and
+        keep the plain counter-probing path."""
+        entry["tables"] = None
+        entry["available"] = 0
+        entry["dev_mask"] = {}
+        entry["topo"] = None
+        host_topo = slice_topo = ici = coord_s = None
+        worker = None
+        for d in s.devices:
+            for k, v in d.attributes.items():
+                if k.endswith("/hostTopology"):
+                    host_topo = v
+                elif k.endswith("/sliceTopology"):
+                    slice_topo = v
+                elif k.endswith("/iciDomain"):
+                    ici = v
+                elif k.endswith("/workerId"):
+                    worker = v
+                elif k.endswith("/hostCoord"):
+                    coord_s = v
+            if host_topo:
+                break
+        if not host_topo:
+            return
+        try:
+            tables = placement.tables_for(host_topo)
+        except ValueError:
+            return
+        entry["tables"] = tables
+        available = 0
+        chips_avail = 0
+        dev_mask: Dict[str, int] = {}
+        for d in untainted:
+            bits = placement.chip_bits_of_device(d)
+            if not bits:
+                continue
+            dev_mask[d.name] = bits
+            idx = tables.by_mask.get(bits)
+            if idx is not None:
+                available |= 1 << idx
+            if bits & (bits - 1) == 0:
+                chips_avail |= bits
+        # Whole-host placeability: every chip individually available AND no
+        # published spanning device is tainted (an ICI-link taint lands on
+        # spanning devices only — it must kill whole-host placements while
+        # the endpoint chips stay schedulable).
+        untainted_ids = {id(d) for d in untainted}
+        spanning_tainted = any(
+            placement.popcount(placement.chip_bits_of_device(d)) >= 2
+            for d in s.devices if id(d) not in untainted_ids
+        )
+        if chips_avail == tables.full_mask and not spanning_tainted:
+            available |= 1 << tables.whole_host_index
+        entry["available"] = available
+        entry["dev_mask"] = dev_mask
+        topo = {"host_topology": host_topo, "slice_topology": slice_topo,
+                "ici_domain": ici or "", "worker_id": worker,
+                "host_coord": None}
+        if coord_s:
+            try:
+                topo["host_coord"] = tuple(
+                    int(v) for v in str(coord_s).split("x"))
+            except ValueError:
+                pass
+        elif slice_topo is not None and worker is not None:
+            # Older slices without the hostCoord attribute: derive it from
+            # workerId with the same row-major tiling rule the tpulibs use.
+            try:
+                topo["host_coord"] = placement.host_grid_coord(
+                    slice_topo, host_topo, int(worker))
+            except (ValueError, TypeError):
+                pass
+        entry["topo"] = topo
 
     @staticmethod
     def _dev_fits_base(dev: Device, caps: Dict[str, Dict[str, int]],
@@ -531,12 +714,34 @@ class Allocator:
             cache["match"][mkey] = hit
         return hit
 
+    def node_topologies(self) -> Dict[str, dict]:
+        """node -> {ici_domain, slice_topology, host_topology, host_coord,
+        worker_id} from the static index — the input the host-grid domain
+        planner (pkg.placement.choose_host_block) consumes."""
+        return dict(self._feasibility_state()["topologies"])
+
+    def placement_state(self, driver: str, node: str) -> Optional[dict]:
+        """Bitmask placement view of one node (tests, telemetry): the
+        host's PlacementTables, the availability bitmap (taints applied),
+        per-device chip masks, and the current used-chip mask."""
+        entry = self._feasibility_state()["entries"].get((driver, node))
+        if entry is None or entry.get("tables") is None:
+            return None
+        return {
+            "tables": entry["tables"],
+            "available": entry["available"],
+            "dev_mask": dict(entry["dev_mask"]),
+            "used_mask": self._used_mask(node),
+        }
+
     def feasible_nodes(self, claims, nodes: Optional[Iterable[str]] = None,
                        reasons: Optional[Dict[str, str]] = None) -> List[str]:
         """Pre-filter for the scheduler: node names on which every request
-        of every claim could POSSIBLY be satisfied, ordered most-free-first
-        (ties by name, so a fresh cluster keeps the deterministic name
-        order). Checks necessary conditions only — a slice for the
+        of every claim could POSSIBLY be satisfied, in packing-aware order
+        — tightest-fit first for partial-node claim sets, emptiest-first
+        when any request is mode=All (whole-host/domain) or with
+        best_fit=False; ties by name, so a fresh cluster keeps the
+        deterministic name order. Checks necessary conditions only — a slice for the
         request's driver, enough plan-matching untainted devices, and
         enough of them individually fitting the node's current consumed
         counters — so it never excludes a node allocate_on_node (the
@@ -562,6 +767,14 @@ class Allocator:
         if nodes is not None:
             candidates = candidates & set(nodes)
         cap_units = cache["node_cap_units"]
+        # Packing-aware rank: partial-node claims probe the TIGHTEST
+        # feasible node first (fewest free capacity units — small claims
+        # pile onto already-fragmented hosts, preserving empty hosts for
+        # whole-host/domain claims); whole-node claims (any mode=All
+        # request) keep the emptiest-first order they need. best_fit=False
+        # reverts to unconditional most-free-first (the PR 3 rank).
+        emptiest_first = (not self.best_fit) or any(
+            req.allocation_mode == "All" for req, _, _, _ in plans)
         scored = []
         for node in candidates:
             consumed = self._consumed_for_node(node)
@@ -570,7 +783,8 @@ class Allocator:
             if all(self._node_feasible(cache, node, req, driver, pk, plan,
                                        consumed if used else None)
                    for req, driver, pk, plan in plans):
-                scored.append((used - cap_units.get(node, 0), node))
+                free = cap_units.get(node, 0) - used
+                scored.append((-free if emptiest_first else free, node))
             elif reasons is not None:
                 reasons[node] = self._infeasibility_reason(
                     cache, node, plans, consumed if used else None)
@@ -664,21 +878,93 @@ class Allocator:
             snap["stats"]["plans_compiled"] += 1
         return plan
 
+    def _used_mask(self, node_name: str, in_flight: Sequence = ()) -> int:
+        """Chip-bitmask of allocated chips on one node: the incrementally
+        maintained pass mask plus any in-flight sibling allocations; a
+        from-scratch scan outside a pass."""
+        snap = self._pass_snapshot
+        if snap is not None:
+            base = snap["used_masks"].get(node_name, 0)
+            index = snap["index"]
+        else:
+            index = self._device_index(self._list_slices())
+            masks: Dict[str, int] = {}
+            for alloc in self._list_allocations():
+                self._accrue_mask(masks, index, alloc, +1)
+            base = masks.get(node_name, 0)
+        flight = [a for a in in_flight
+                  if a is not None and a.node_name == node_name]
+        if flight:
+            overlay = {node_name: base}
+            for alloc in flight:
+                self._accrue_mask(overlay, index, alloc, +1)
+            base = overlay[node_name]
+        return base
+
+    def _rank_candidates(self, driver: str, node_name: str, candidates,
+                         used_mask: int):
+        """Fragmentation-scored best-fit order for one request's candidate
+        devices: fewest surviving larger-profile placements destroyed
+        first (name tie-break keeps it deterministic). Returns the ordered
+        list plus {device name -> (score, chip bits)} so the chosen loop
+        can maintain the pending mask and observe the score histogram.
+        Nodes without placement tables keep slice order (score None)."""
+        cache = self._feasibility_state()
+        entry = cache["entries"].get((driver, node_name))
+        tables = entry.get("tables") if entry else None
+        if tables is None:
+            return candidates, {}
+        surviving = tables.surviving(used_mask, entry["available"])
+        scores: Dict[str, tuple] = {}
+        for d in candidates:
+            bits = entry["dev_mask"].get(d.name)
+            if bits is None:
+                bits = placement.chip_bits_of_device(d)
+            scores[d.name] = (tables.frag_score(bits, surviving), bits)
+        candidates = sorted(
+            candidates, key=lambda d: (scores[d.name][0], d.name))
+        return candidates, scores
+
     def allocate_on_node(self, claim: ResourceClaim, node_name: str,
                          in_flight: Sequence = ()) -> Optional[AllocationResult]:
         """Try to satisfy every request of the claim on one node; returns the
         allocation or None when it doesn't fit. ``in_flight``: allocations
         computed this pass but not yet written (sibling claims of the same
-        pod) — their devices count as consumed."""
-        if self._pass_snapshot is not None:
-            self._pass_snapshot["stats"]["nodes_probed"] += 1
-        slices_by_driver = {
-            s.driver: s
-            for s in self._list_slices()
-            if s.node_name == node_name
-        }
+        pod) — their devices count as consumed.
+
+        With ``best_fit`` (the default), candidates within a request are
+        probed in fragmentation-score order — the placement that destroys
+        the fewest surviving larger-profile placements wins — instead of
+        raw slice order; `_fits` stays the authority on whether a device
+        can actually be taken (counter semantics are unchanged, only the
+        preference order moved)."""
+        snap = self._pass_snapshot
+        if snap is not None:
+            snap["stats"]["nodes_probed"] += 1
+            # Per-pass device view, indexed once in begin_pass — not
+            # re-listed and re-grouped on every node probe.
+            slices_by_driver = snap["slices_by_node"].get(node_name, {})
+        else:
+            slices_by_driver = {
+                s.driver: s
+                for s in self._list_slices()
+                if s.node_name == node_name
+            }
         consumed = self._consumed_for_node(node_name, in_flight)
+        # Chip-mask view of the same state, for placement scoring only.
+        # Scoring needs the static feasibility index; without a kind
+        # fingerprint that index can never cache, so ranking would rebuild
+        # it on EVERY probe — skip scoring there (ordering is a
+        # preference; counter semantics are unchanged either way).
+        score_placements = self.best_fit and (
+            getattr(self.api, "kind_fingerprint", None) is not None)
+        used_mask = self._used_mask(node_name, in_flight) if score_placements else 0
         pending: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        pending_mask = 0
+        # Scores are buffered on the result and observed at commit():
+        # failed probes and successful-but-abandoned probes (a sibling
+        # claim failed on the node) were never "chosen".
+        chosen_scores: List[float] = []
         picked: List[DeviceRequestAllocationResult] = []
         picked_names: set = set()
         for req in claim.requests:
@@ -692,6 +978,10 @@ class Allocator:
                 and not any(t.effect in ("NoSchedule", "NoExecute") for t in d.taints)
                 and plan.matches(d)
             ]
+            scores: Dict[str, tuple] = {}
+            if score_placements:
+                candidates, scores = self._rank_candidates(
+                    driver, node_name, candidates, used_mask | pending_mask)
             want = len(candidates) if req.allocation_mode == "All" else req.count
             chosen: List[Device] = []
             for dev in candidates:
@@ -702,6 +992,10 @@ class Allocator:
                     for cc in dev.consumes_counters:
                         for cname, ctr in cc.counters.items():
                             pending[cc.counter_set][cname] += ctr.value
+                    got = scores.get(dev.name)
+                    if got is not None:
+                        pending_mask |= got[1]
+                        chosen_scores.append(float(got[0]))
             if len(chosen) < want or (req.allocation_mode == "All" and not chosen):
                 return None
             for dev in chosen:
@@ -712,4 +1006,12 @@ class Allocator:
                         pool=rs.pool.name, device=dev.name,
                     )
                 )
-        return AllocationResult(devices=picked, node_name=node_name)
+        result = AllocationResult(devices=picked, node_name=node_name)
+        if chosen_scores:
+            # Observed at commit(), never here: a successful probe the
+            # caller then abandons (a sibling claim failed on this node,
+            # or an outside-a-pass probe that is never committed) was not
+            # "chosen", and the same claim re-probed elsewhere must not
+            # double-count.
+            result._placement_scores = chosen_scores
+        return result

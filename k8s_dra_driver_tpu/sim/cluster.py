@@ -72,11 +72,14 @@ from k8s_dra_driver_tpu.k8s.core import (
     ResourceClaim,
 )
 from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.api.computedomain import ComputeDomainPlacement
 from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
 from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.events import (
     EventRecorder,
     REASON_ALLOCATION_FAILED,
+    REASON_DOMAIN_PLACED,
     REASON_FAILED_SCHEDULING,
     REASON_SCHEDULED,
 )
@@ -618,7 +621,9 @@ class SimCluster:
             reject_reasons: Dict[str, str] = {}
             if candidates is None:
                 # Feasibility pre-filter: only nodes that can possibly
-                # satisfy every unallocated claim, most-free-first.
+                # satisfy every unallocated claim, in packing-aware
+                # order (tightest-fit first for partial-node claim sets,
+                # emptiest-first for whole-node/domain ones).
                 try:
                     feasible = self.allocator.feasible_nodes(
                         unallocated, reasons=reject_reasons)
@@ -630,6 +635,11 @@ class SimCluster:
                 candidates = [n for n in feasible if n in self.nodes]
                 feasible_note = (f"feasibility filter admitted "
                                  f"{len(candidates)}/{len(self.nodes)} nodes")
+                # Multi-host ComputeDomain workers: steer onto the
+                # domain's host-grid-aligned block so the assembled
+                # clique is ICI-contiguous, not just "N free hosts".
+                candidates = self._steer_domain_candidates(
+                    pod, unallocated, candidates)
             placed = False
             for node in candidates:
                 results = []
@@ -718,6 +728,77 @@ class SimCluster:
             except NotFoundError:
                 pass
         return "bound"
+
+    def _domain_by_uid(self, uid: str, namespace: Optional[str] = None):
+        """Linear ComputeDomain-by-uid lookup (domains are few)."""
+        if not uid:
+            return None
+        domains = (self.api.list(COMPUTE_DOMAIN, namespace=namespace)
+                   if namespace else self.api.list(COMPUTE_DOMAIN))
+        for cd in domains:
+            if cd.uid == uid:
+                return cd
+        return None
+
+    def _pod_compute_domain(self, claims):
+        """The ComputeDomain a pod's claim set belongs to (via the channel
+        claim's opaque ComputeDomainChannelConfig), or None."""
+        for c in claims:
+            for cc in c.config:
+                if (cc.opaque is not None
+                        and cc.opaque.driver == COMPUTE_DOMAIN_DRIVER_NAME
+                        and cc.opaque.parameters.get("kind")
+                        == "ComputeDomainChannelConfig"):
+                    return self._domain_by_uid(
+                        cc.opaque.parameters.get("domain_id", ""))
+        return None
+
+    def _steer_domain_candidates(self, pod: Pod, unallocated,
+                                 candidates: List[str]) -> List[str]:
+        """Host-grid-aligned domain placement. For a pod whose claims
+        carry a ComputeDomain channel, prefer the domain's recorded
+        host-grid block; when none is recorded yet, choose the most
+        compact contiguous block of feasible hosts within one ICI domain
+        (pkg.placement.choose_host_block) and record it in
+        ComputeDomainStatus. Preference only — if the block can't serve
+        (stolen capacity, heterogeneous nodes), the remaining feasible
+        nodes follow, so placement degrades instead of deadlocking."""
+        if len(candidates) <= 1:
+            return candidates
+        cd = self._pod_compute_domain(unallocated)
+        if cd is None or cd.spec.num_nodes <= 1:
+            return candidates
+        planned = cd.status.placement
+        if planned is None:
+            block = placement_lib.choose_host_block(
+                self.allocator.node_topologies(), candidates,
+                cd.spec.num_nodes)
+            if block is None:
+                return candidates
+            planned = ComputeDomainPlacement(
+                ici_domain=block.ici_domain,
+                block_origin=block.origin_str,
+                block_shape=block.shape_str,
+                nodes=list(block.nodes),
+            )
+
+            def set_placement(obj, planned=planned):
+                if obj.status.placement is None:
+                    obj.status.placement = planned
+            try:
+                self.api.update_with_retry(
+                    COMPUTE_DOMAIN, cd.name, cd.namespace, set_placement)
+            except NotFoundError:
+                return candidates
+            self.sched_recorder.normal(
+                cd, REASON_DOMAIN_PLACED,
+                f"placed domain on host-grid block {planned.block_shape}"
+                f"@{planned.block_origin} of ICI domain "
+                f"{planned.ici_domain or '<default>'}: "
+                + ",".join(planned.nodes))
+        preferred = [n for n in planned.nodes if n in candidates]
+        rest = [n for n in candidates if n not in preferred]
+        return preferred + rest
 
     def _record_unschedulable(self, pod: Pod, unallocated, reasons) -> None:
         """FailedScheduling on the pod + AllocationFailed on each claim,
@@ -902,10 +983,18 @@ class SimCluster:
                 }
                 for var, path in container.downward_env.items():
                     env[var] = fields.get(path, "")
+            # A domain sized below its slice (numNodes < hosts, placed on
+            # a host-grid sub-block) must assemble with numNodes members —
+            # the whole-slice default would wait for hosts that never join.
+            cd = self._domain_by_uid(
+                env.get("COMPUTE_DOMAIN_UUID", ""),
+                namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace))
+            expected_nodes = cd.spec.num_nodes if cd is not None else 0
             agent = SliceAgent(
                 api=self.api,
                 namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace),
                 domain_uid=env.get("COMPUTE_DOMAIN_UUID", ""),
+                expected_nodes=expected_nodes,
                 node_name=node_name,
                 pod_ip=("127.0.0.1" if self.loopback_agents
                         else f"10.2.0.{len(node.agents) + 1}"),

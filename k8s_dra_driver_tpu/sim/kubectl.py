@@ -324,6 +324,12 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
         lines += [f"NumNodes:  {obj.spec.num_nodes}",
                   f"Topology:  {obj.spec.topology or '<any>'}",
                   f"Status:    {obj.status.status}"]
+        if obj.status.placement is not None:
+            p = obj.status.placement
+            lines.append(
+                f"Placement: block {p.block_shape}@{p.block_origin} of "
+                f"{p.ici_domain or '<default>'} "
+                f"({','.join(p.nodes)})")
         if obj.status.nodes:
             rows = [["Node", "IciDomain", "Worker", "Status"]]
             for n in obj.status.nodes:

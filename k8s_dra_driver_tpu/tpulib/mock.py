@@ -18,6 +18,7 @@ from k8s_dra_driver_tpu.tpulib.profiles import (
     SliceProfile,
     compute_subslice_profiles,
     host_chip_coords,
+    host_grid_coord,
 )
 from k8s_dra_driver_tpu.tpulib.types import (
     ChipHealth,
@@ -33,16 +34,14 @@ ALT_TPU_UNHEALTHY_CHIPS_ENV = "ALT_TPU_UNHEALTHY_CHIPS"
 
 
 def _host_block_origin(profile: SliceProfile, worker_id: int) -> Tuple[int, ...]:
-    """Global coords of this host's chip block, hosts tiling row-major."""
+    """Global coords of this host's chip block: the canonical row-major
+    host-grid coordinate (profiles.host_grid_coord — also published as the
+    ``hostCoord`` ResourceSlice attribute) scaled to chip units."""
     grid = profile.host_grid
     host_dims = parse_topology(profile.host_topology)
     host_dims = host_dims + (1,) * (len(grid) - len(host_dims))
-    rem = worker_id
-    pos = []
-    for g in reversed(grid):
-        pos.append(rem % g)
-        rem //= g
-    pos.reverse()
+    pos = host_grid_coord(profile.slice_topology, profile.host_topology,
+                          worker_id)
     return tuple(p * h for p, h in zip(pos, host_dims))
 
 

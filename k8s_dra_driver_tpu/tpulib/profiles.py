@@ -65,11 +65,7 @@ class SliceProfile:
     @property
     def host_grid(self) -> Tuple[int, ...]:
         """How host blocks tile the slice grid."""
-        s = parse_topology(self.slice_topology)
-        h = parse_topology(self.host_topology)
-        h = h + (1,) * (len(s) - len(h))
-        assert all(sd % hd == 0 for sd, hd in zip(s, h)), (s, h)
-        return tuple(sd // hd for sd, hd in zip(s, h))
+        return host_grid_dims(self.slice_topology, self.host_topology)
 
 
 def _p(name: str, gen: TpuGen, acc: str, slice_topo: str, host_topo: str) -> SliceProfile:
@@ -98,6 +94,38 @@ PROFILES: Dict[str, SliceProfile] = {
 def host_chip_coords(host_topo: Tuple[int, ...]) -> List[Tuple[int, ...]]:
     """Host-local chip coords, row-major; chip index == position in list."""
     return [c for c in itertools.product(*(range(d) for d in host_topo))]
+
+
+def host_grid_dims(slice_topology: str, host_topology: str) -> Tuple[int, ...]:
+    """THE canonical host-tiling rule (pad host dims with 1s to the slice
+    rank, every slice dim must divide evenly): how host blocks tile the
+    slice grid, in host units. SliceProfile.host_grid, host_grid_coord,
+    and the placement engine all resolve through this one function."""
+    s = parse_topology(slice_topology)
+    h = parse_topology(host_topology)
+    h = h + (1,) * (len(s) - len(h))
+    if any(hd <= 0 or sd % hd for sd, hd in zip(s, h)):
+        raise ValueError(
+            f"host topology {host_topology!r} does not tile slice "
+            f"{slice_topology!r}")
+    return tuple(sd // hd for sd, hd in zip(s, h))
+
+
+def host_grid_coord(slice_topology: str, host_topology: str,
+                    worker_id: int) -> Tuple[int, ...]:
+    """Grid coordinate of host ``worker_id`` within the slice's host grid,
+    hosts tiling row-major — the mock/real tpulibs derive chip-block
+    origins from it and the kubelet plugin publishes it as the
+    ``hostCoord`` ResourceSlice attribute the host-grid-aligned domain
+    placer consumes."""
+    grid = host_grid_dims(slice_topology, host_topology)
+    rem = worker_id
+    pos = []
+    for g in reversed(grid):
+        pos.append(rem % g)
+        rem //= g
+    pos.reverse()
+    return tuple(pos)
 
 
 def compute_subslice_profiles(host_topology: str) -> List[SubsliceProfile]:

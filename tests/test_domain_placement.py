@@ -1,0 +1,221 @@
+"""Host-grid-aligned ComputeDomain placement — the north-star sim e2e.
+
+A multi-host ComputeDomain must land on a *host-grid-contiguous* block of
+hosts inside ONE ICI domain, with the workers' allocated chips ICI-
+contiguous (bitmask-verified against the slice grid) — even when free
+hosts are scattered across several slices, where the un-steered
+emptiest-first/name-order scheduler would happily assemble a cross-slice
+"domain" with no real ICI connectivity.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.k8s.core import Pod, PodResourceClaimRef
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+from k8s_dra_driver_tpu.tpulib.types import parse_topology
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+WHOLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+CD_MANIFEST = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: grid}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: jax-domain, namespace: grid}
+spec:
+  numNodes: %(num_nodes)d
+  channel:
+    resourceClaimTemplate: {name: jax-domain-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: grid}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+WORKER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: worker-%(i)d, namespace: grid}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: jax-domain-channel}
+"""
+
+
+def _block_node(sim, node_name: str, index: int) -> None:
+    """Pin a whole-host pod to one node (scatters the free-host set)."""
+    pod = Pod(
+        meta=new_meta(f"blocker-{index}", "default"),
+        node_name=node_name,
+        containers=[],
+        resource_claims=[PodResourceClaimRef(
+            name="tpus", resource_claim_template_name="whole")],
+    )
+    sim.api.create(pod)
+
+
+def _worker_chip_coords(sim, pod) -> set:
+    """Global slice-grid coords of every chip allocated to one worker."""
+    coords = set()
+    node = sim.nodes[pod.node_name]
+    by_index = {c.index: c for c in node.tpulib.enumerate().chips}
+    for claim in sim.api.list(RESOURCE_CLAIM, namespace=pod.namespace):
+        if not any(r.uid == pod.uid for r in claim.reserved_for):
+            continue
+        if claim.allocation is None:
+            continue
+        for r in claim.allocation.devices:
+            if r.driver != "tpu.google.com":
+                continue
+            dev = node.tpu_driver.state.allocatable[r.device]
+            for idx in dev.chip_indices:
+                coords.add(tuple(by_index[idx].coords))
+    return coords
+
+
+def test_domain_lands_on_contiguous_host_grid_block(tmp_path):
+    """4-host v5e-16 domain on a 12-host cluster (3 slices) with the free
+    hosts scattered: slice 0 and slice 1 each have a blocked host, so only
+    slice 2 holds a full 2x2 host-grid block. The domain must land there
+    entirely — not on the lexicographically-first free hosts across
+    slices — and its chip set must tile the whole 4x4 slice grid."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=12)
+    sim.start()
+    try:
+        for obj in load_manifests(WHOLE_RCT):
+            sim.api.create(obj)
+        # Slice 0 = nodes 0-3, slice 1 = 4-7, slice 2 = 8-11.
+        _block_node(sim, "tpu-node-1", 0)
+        _block_node(sim, "tpu-node-6", 1)
+        sim.settle(max_steps=8)
+        blockers = [p for p in sim.api.list(POD, namespace="default")]
+        assert all(p.phase == "Running" for p in blockers), [
+            (p.meta.name, p.phase) for p in blockers]
+
+        for obj in load_manifests(CD_MANIFEST % {"num_nodes": 4}):
+            sim.api.create(obj)
+        for i in range(4):
+            for obj in load_manifests(WORKER % {"i": i}):
+                sim.api.create(obj)
+        sim.settle(max_steps=30)
+        workers = [p for p in sim.api.list(POD, namespace="grid")]
+        assert len(workers) == 4
+        assert all(p.phase == "Running" for p in workers), [
+            (p.meta.name, p.phase, p.meta.annotations.get("failure"))
+            for p in workers]
+
+        # The whole domain sits on slice 2's full host grid.
+        nodes = {p.node_name for p in workers}
+        assert nodes == {f"tpu-node-{i}" for i in range(8, 12)}, nodes
+        ici_domains = {sim.nodes[p.node_name].tpulib.enumerate().ici_domain
+                       for p in workers}
+        assert len(ici_domains) == 1, ici_domains
+
+        # Recorded placement: a 2x2 block at the grid origin.
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert cd.status.placement is not None
+        assert cd.status.placement.block_shape == "2x2"
+        assert cd.status.placement.block_origin == "0x0"
+        assert set(cd.status.placement.nodes) == nodes
+        assert cd.status.placement.ici_domain == next(iter(ici_domains))
+
+        # Bitmask-verified ICI contiguity: the union of all allocated
+        # chips' global coords tiles the ENTIRE 4x4 slice grid — one
+        # contiguous ICI mesh, no holes, no foreign-slice chips.
+        coords = set()
+        for p in workers:
+            got = _worker_chip_coords(sim, p)
+            assert len(got) == 4, (p.meta.name, got)  # whole host each
+            coords |= got
+        dims = parse_topology("4x4")
+        mask = 0
+        for c in coords:
+            assert 0 <= c[0] < dims[0] and 0 <= c[1] < dims[1], c
+            mask |= 1 << (c[0] * dims[1] + c[1])
+        assert mask == (1 << (dims[0] * dims[1])) - 1, bin(mask)
+
+        # The controller's status aggregation must carry the placement,
+        # and the domain must assemble Ready on it.
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+            .status.status == "Ready")
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert cd.status.placement is not None  # not wiped by aggregation
+    finally:
+        sim.stop()
+
+
+def test_two_host_domain_picks_compact_block(tmp_path):
+    """num_nodes=2 on one 4-host slice: the planner prefers the most
+    compact free block — deterministically the 1x2 at the grid origin —
+    and records it before the first worker binds."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16")
+    sim.start()
+    try:
+        for obj in load_manifests(CD_MANIFEST % {"num_nodes": 2}):
+            sim.api.create(obj)
+        for i in range(2):
+            for obj in load_manifests(WORKER % {"i": i}):
+                sim.api.create(obj)
+        sim.settle(max_steps=30)
+        workers = [p for p in sim.api.list(POD, namespace="grid")]
+        assert all(p.phase == "Running" for p in workers), [
+            (p.meta.name, p.phase) for p in workers]
+        assert {p.node_name for p in workers} == {"tpu-node-0", "tpu-node-1"}
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert cd.status.placement is not None
+        assert cd.status.placement.block_shape == "1x2"
+        assert cd.status.placement.nodes == ["tpu-node-0", "tpu-node-1"]
+    finally:
+        sim.stop()
+
+
+def test_domain_placed_event_and_describe(tmp_path):
+    """The chosen block is narrated: a DomainPlaced event on the CD and a
+    Placement line in `describe computedomains`."""
+    from k8s_dra_driver_tpu.sim.kubectl import describe_object
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16")
+    sim.start()
+    try:
+        for obj in load_manifests(CD_MANIFEST % {"num_nodes": 4}):
+            sim.api.create(obj)
+        for i in range(4):
+            for obj in load_manifests(WORKER % {"i": i}):
+                sim.api.create(obj)
+        sim.settle(max_steps=30)
+        events = [e for e in sim.api.list("Event", namespace="grid")
+                  if e.reason == "DomainPlaced"]
+        assert len(events) == 1, [(e.reason, e.message) for e in events]
+        assert "2x2@0x0" in events[0].message
+        out = describe_object(sim.api, COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert "Placement:" in out and "2x2@0x0" in out
+    finally:
+        sim.stop()

@@ -1,0 +1,330 @@
+"""Bitmask placement tables vs the enumeration + overlap oracles, the
+fragmentation-scored best-fit behavior, and the taint/link-health
+interaction with the node's placement availability.
+
+The tables are a *derived* representation: every property here pins them
+against the sources of truth — `compute_subslice_profiles` (the legality
+enumeration the kubelet plugin publishes devices from) and the chip-index
+overlap rule `DeviceState._validate_no_overlap` enforces at Prepare time
+(two devices conflict iff their chip sets intersect).
+"""
+
+import random
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    DeviceClass,
+    DeviceRequest,
+    RESOURCE_SLICE,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg import placement
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+from k8s_dra_driver_tpu.plugins.tpu.deviceinfo import build_resource_slice
+from k8s_dra_driver_tpu.sim.allocator import Allocator
+from k8s_dra_driver_tpu.tpulib import ChipHealth, MockTpuLib
+from k8s_dra_driver_tpu.tpulib.profiles import (
+    SliceProfile,
+    compute_subslice_profiles,
+)
+from k8s_dra_driver_tpu.tpulib.types import TpuGen
+
+TPU_CLASS = "tpu.google.com"
+SUB_CLASS = "subslice.tpu.google.com"
+
+
+def _random_topologies(n=12, seed=5):
+    rng = random.Random(seed)
+    topos = {"2x2", "1x4", "4x2", "2x2x2"}  # always cover the known shapes
+    while len(topos) < n:
+        dims = [rng.randint(1, 4) for _ in range(rng.choice((2, 2, 3)))]
+        topos.add("x".join(str(d) for d in dims))
+    return sorted(topos)
+
+
+# -- property: tables == enumeration, conflicts == chip-set intersection ----
+
+
+@pytest.mark.parametrize("topo", _random_topologies())
+def test_tables_match_profile_enumeration(topo):
+    """Every placement compute_subslice_profiles enumerates is a table
+    placement with the exact chip bitmask, and the table adds nothing but
+    the synthetic whole-host entry."""
+    tables = placement.PlacementTables(topo)
+    legal = {
+        (prof.name, tuple(pl.chip_indices))
+        for prof in compute_subslice_profiles(topo)
+        for pl in prof.placements
+    }
+    in_tables = {
+        (p.profile, p.chips) for p in tables.placements
+        if p.index != tables.whole_host_index
+    }
+    assert in_tables == legal
+    for p in tables.placements:
+        assert p.mask == placement.chips_to_mask(p.chips)
+        assert p.index == tables.by_mask[p.mask]
+    whole = tables.placements[tables.whole_host_index]
+    assert whole.mask == tables.full_mask
+    assert whole.num_chips == tables.num_chips
+
+
+@pytest.mark.parametrize("topo", _random_topologies())
+def test_conflict_masks_match_pairwise_chip_intersection(topo):
+    """conflicts[i] bit j <=> chip sets of i and j intersect (i != j) —
+    the DeviceState overlap rule, precomputed; larger_conflicts restricts
+    to strictly-larger profiles (the best-fit scoring term)."""
+    tables = placement.PlacementTables(topo)
+    for a in tables.placements:
+        for b in tables.placements:
+            expect = a.index != b.index and bool(set(a.chips) & set(b.chips))
+            got = bool((tables.conflicts[a.index] >> b.index) & 1)
+            assert got == expect, (topo, a, b)
+            got_larger = bool(
+                (tables.larger_conflicts[a.index] >> b.index) & 1)
+            assert got_larger == (expect and b.num_chips > a.num_chips)
+
+
+@pytest.mark.parametrize("topo", ["2x2", "4x2", "2x2x2"])
+def test_chip_bits_match_published_counter_rule(topo):
+    """chip_bits_of_device derives the same chip set from a published
+    Device's counter consumption as the allocatable map carries — the two
+    overlap rules (scheduler counters, Prepare chip indices) agree."""
+    profile = SliceProfile(name=f"t-{topo}", gen=TpuGen.V5E,
+                           accelerator_type="t", slice_topology=topo,
+                           host_topology=topo)
+    inv = MockTpuLib(profile).enumerate()
+    allocatable = enumerate_allocatable(inv, with_subslices=True)
+    rs = build_resource_slice("n0", TPU_CLASS, allocatable, inv)
+    for dev in rs.devices:
+        want = placement.chips_to_mask(allocatable[dev.name].chip_indices)
+        assert placement.chip_bits_of_device(dev) == want, dev.name
+
+
+def test_surviving_and_largest_free():
+    tables = placement.tables_for("2x2")
+    # Empty host: everything survives; largest profile = whole host.
+    assert tables.surviving(0) == tables.all_placements_bitmap
+    assert tables.largest_free_chips(0) == 4
+    # Chip 0 used: whole host and every placement containing chip 0 die.
+    surv = tables.surviving(0b0001)
+    for p in tables.placements:
+        assert bool((surv >> p.index) & 1) == (0 not in p.chips)
+    assert tables.largest_free_chips(0b0001) == 2
+    # Diagonal chips used: no 2-chip placement survives.
+    assert tables.largest_free_chips(0b1001) == 1
+    assert tables.largest_free_chips(0b1111) == 0
+
+
+# -- best-fit allocation behavior -------------------------------------------
+
+
+def _one_node_api(topo):
+    profile = SliceProfile(name=f"t-{topo}", gen=TpuGen.V5E,
+                           accelerator_type="t", slice_topology=topo,
+                           host_topology=topo)
+    api = APIServer()
+    api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver=TPU_CLASS,
+                           match_attributes={"type": "tpu"}))
+    api.create(DeviceClass(meta=new_meta(SUB_CLASS), driver=TPU_CLASS,
+                           match_attributes={"type": "subslice"}))
+    inv = MockTpuLib(profile).enumerate()
+    api.create(build_resource_slice(
+        "n0", TPU_CLASS, enumerate_allocatable(inv, with_subslices=True), inv))
+    return api
+
+
+def _claim(name, class_name=TPU_CLASS, count=1, selectors=()):
+    c = ResourceClaim(
+        meta=new_meta(name, "default"),
+        requests=[DeviceRequest(name="r", device_class_name=class_name,
+                                count=count, selectors=list(selectors))],
+    )
+    c.meta.uid = fresh_uid()
+    return c
+
+
+def test_best_fit_picks_least_destructive_chip():
+    """4x2 host with chip 6 taken: a new single-chip claim must land on
+    chip 4 (destroys only the 4-5 pair — its 2x2 block and column are
+    already dead) instead of slice-order chip 0, which would kill the
+    intact 2x2 block. The first-fit baseline picks chip 0 and strands it."""
+    for best_fit, expect in ((True, "tpu-4"), (False, "tpu-0")):
+        api = _one_node_api("4x2")
+        alloc = Allocator(api, best_fit=best_fit)
+        alloc.begin_pass()
+        try:
+            pin = alloc.allocate_on_node(
+                _claim("pin", selectors=["index=6"]), "n0")
+            assert pin is not None
+            alloc.commit(pin)
+            r = alloc.allocate_on_node(_claim("single"), "n0")
+            assert r is not None
+            assert r.devices[0].device == expect, (best_fit, r.devices)
+            alloc.commit(r)
+            if best_fit:
+                # The packing choice kept the intact 2x2 block placeable.
+                big = alloc.allocate_on_node(
+                    _claim("big", SUB_CLASS, selectors=["profile=2x2"]), "n0")
+                assert big is not None
+        finally:
+            alloc.end_pass()
+
+
+def test_best_fit_packs_partial_claims_onto_one_node():
+    """Two sequential single-chip claims pack onto the SAME node under the
+    tightest-fit rank (preserving an empty host); the legacy most-free
+    rank spreads them."""
+    for best_fit, expect_nodes in ((True, {"n0"}), (False, {"n0", "n1"})):
+        api = APIServer()
+        api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver=TPU_CLASS,
+                               match_attributes={"type": "tpu"}))
+        for node in ("n0", "n1"):
+            inv = MockTpuLib("v5e-4").enumerate()
+            api.create(build_resource_slice(
+                node, TPU_CLASS,
+                enumerate_allocatable(inv, with_subslices=True), inv))
+        alloc = Allocator(api, best_fit=best_fit)
+        alloc.begin_pass()
+        try:
+            used = set()
+            for i in range(2):
+                c = _claim(f"c{i}")
+                node = alloc.feasible_nodes(c)[0]
+                r = alloc.allocate_on_node(c, node)
+                assert r is not None
+                alloc.commit(r)
+                used.add(node)
+            assert used == expect_nodes, (best_fit, used)
+        finally:
+            alloc.end_pass()
+
+
+def test_placement_score_counts_only_committed_placements():
+    """A successful probe the scheduler abandons (sibling claim failed on
+    the node) is never 'chosen': scores land in the histogram at commit(),
+    so re-probing the claim elsewhere cannot double-count."""
+    api = APIServer()
+    api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver=TPU_CLASS,
+                           match_attributes={"type": "tpu"}))
+    for node in ("n0", "n1"):
+        inv = MockTpuLib("v5e-4").enumerate()
+        api.create(build_resource_slice(
+            node, TPU_CLASS,
+            enumerate_allocatable(inv, with_subslices=True), inv))
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        pre = alloc.allocate_on_node(_claim("pre", count=2), "n0")
+        alloc.commit(pre)                                   # 2 observed
+        r1 = alloc.allocate_on_node(_claim("a"), "n0")      # abandoned below
+        assert r1 is not None
+        sib = alloc.allocate_on_node(_claim("b", count=4), "n0",
+                                     in_flight=[r1])
+        assert sib is None                                  # sibling fails
+        r2 = alloc.allocate_on_node(_claim("a2"), "n1")
+        alloc.commit(r2)                                    # 1 observed
+    finally:
+        alloc.end_pass()
+    assert alloc.metrics.placement_score._totals.get((), 0) == 3
+
+
+def test_placement_metrics_published():
+    """The frag gauge carries the largest still-placeable profile per node
+    and the score histogram observes each best-fit choice."""
+    api = _one_node_api("2x2")
+    alloc = Allocator(api)
+    alloc.begin_pass()
+    r = alloc.allocate_on_node(_claim("c"), "n0")
+    assert r is not None
+    alloc.commit(r)
+    alloc.end_pass()
+    gauge = alloc.metrics.frag_largest_free
+    # One chip used on a 2x2 host: the largest placeable profile is 1x2.
+    assert gauge.value("n0") == 2.0
+    hist = alloc.metrics.placement_score
+    assert hist._totals.get((), 0) >= 1
+
+
+# -- taints / link health ----------------------------------------------------
+
+
+def test_link_taint_drops_exactly_spanning_placements(tmp_path, monkeypatch):
+    """Satellite: a tpu.google.com/ici-link-unhealthy-tainted spanning
+    device must drop exactly its placements from the node's availability —
+    endpoint chips stay placeable — pinned against the DeviceHealthMonitor
+    -> taint -> republish chain, not a hand-crafted slice."""
+    import os
+
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(boot))
+
+    api = APIServer()
+    api.create(DeviceClass(meta=new_meta(TPU_CLASS), driver=TPU_CLASS,
+                           match_attributes={"type": "tpu"}))
+    api.create(DeviceClass(meta=new_meta(SUB_CLASS), driver=TPU_CLASS,
+                           match_attributes={"type": "subslice"}))
+    lib = MockTpuLib("v5e-4")
+    driver = TpuDriver(
+        api=api, node_name="n0", tpulib=lib,
+        plugin_dir=os.path.join(str(tmp_path), "plugin"),
+        cdi_root=os.path.join(str(tmp_path), "cdi"),
+        gates=fg.parse("TPUDeviceHealthCheck=true"),
+    )
+    driver.start()
+    try:
+        lib.set_link_health(0, 1, ChipHealth.UNHEALTHY)  # -> taint + republish
+        rs = api.get(RESOURCE_SLICE, "n0-tpu.google.com")
+        tainted = {d.name for d in rs.devices if d.taints}
+        assert tainted == {"tpu-subslice-1x2-at-0x0"}, tainted
+
+        alloc = Allocator(api)
+        alloc.begin_pass()
+        try:
+            state = alloc.placement_state(TPU_CLASS, "n0")
+            assert state is not None
+            tables = state["tables"]
+            # Exactly the spanning placement (and whole-host, which spans
+            # every link) dropped; every chip placement still available.
+            dead = tables.by_mask[placement.chips_to_mask((0, 1))]
+            assert not (state["available"] >> dead) & 1
+            assert not (state["available"] >> tables.whole_host_index) & 1
+            for chip in range(4):
+                idx = tables.by_mask[1 << chip]
+                assert (state["available"] >> idx) & 1, chip
+            # Largest placeable profile shrinks to 2 chips (1x2/2x1 away
+            # from the broken link), not 0: endpoint chips are NOT dead.
+            assert tables.largest_free_chips(
+                state["used_mask"], state["available"]) == 2
+
+            # Endpoint chips still allocate as single chips...
+            for chip in (0, 1):
+                r = alloc.allocate_on_node(
+                    _claim(f"chip{chip}", selectors=[f"index={chip}"]), "n0")
+                assert r is not None, chip
+            # ...and a 1x2 subslice claim lands on the intact placement.
+            r = alloc.allocate_on_node(
+                _claim("sub", SUB_CLASS, selectors=["profile=1x2"]), "n0")
+            assert r is not None
+            assert r.devices[0].device == "tpu-subslice-1x2-at-1x0"
+        finally:
+            alloc.end_pass()
+
+        # Heal: the placement returns to the availability bitmap.
+        lib.set_link_health(0, 1, ChipHealth.HEALTHY)
+        alloc2 = Allocator(api)
+        alloc2.begin_pass()
+        try:
+            state = alloc2.placement_state(TPU_CLASS, "n0")
+            assert (state["available"] >> tables.whole_host_index) & 1
+        finally:
+            alloc2.end_pass()
+    finally:
+        driver.shutdown()

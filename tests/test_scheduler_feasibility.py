@@ -221,10 +221,36 @@ def test_feasible_multi_claim_intersection():
         alloc.end_pass()
 
 
-def test_feasible_ordering_most_free_first():
+def test_feasible_ordering_packing_aware():
+    """Partial-node claims rank TIGHTEST-fit first (small claims pile onto
+    fragmented hosts, preserving empty ones for whole-host claims);
+    whole-node (mode=All) claims rank emptiest-first; best_fit=False
+    reverts to the unconditional most-free-first legacy rank."""
     nodes = ["n0", "n1", "n2"]
     api = make_api(nodes)
     alloc = Allocator(api)
+    alloc.begin_pass()
+    try:
+        for node, count in (("n0", 3), ("n1", 1)):
+            r = alloc.allocate_on_node(make_claim(f"f-{node}", count=count), node)
+            assert r is not None
+            alloc.commit(r)
+        # Partial claim: fullest feasible node probes first.
+        assert alloc.feasible_nodes(make_claim("c")) == ["n0", "n1", "n2"]
+        # Whole-node claim (mode=All + a selector narrowing the matched
+        # set so partially-used nodes stay feasible): emptiest first.
+        whole = make_claim("w", mode="All")
+        whole.requests[0].selectors = ["index=0"]
+        ordered = alloc.feasible_nodes(whole)
+        assert ordered[0] == "n2", ordered
+    finally:
+        alloc.end_pass()
+
+
+def test_feasible_ordering_legacy_most_free_first():
+    nodes = ["n0", "n1", "n2"]
+    api = make_api(nodes)
+    alloc = Allocator(api, best_fit=False)
     alloc.begin_pass()
     try:
         for node, count in (("n0", 3), ("n1", 1)):
