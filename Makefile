@@ -2,7 +2,7 @@
 
 include versions.mk
 
-.PHONY: all native test e2e bench bench-smoke ci clean version verify check-metrics-docs check-event-reasons test-tier1
+.PHONY: all native test e2e bench bench-smoke ci clean version verify tpulint check-metrics-docs check-event-reasons test-tier1
 
 version:
 	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
@@ -34,10 +34,19 @@ bench:
 bench-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke
 
-# Pre-merge gate: doc/code consistency checks plus the tier-1 pytest run
-# (the suite ROADMAP.md pins as the regression floor).
-verify: check-metrics-docs check-event-reasons test-tier1
+# Pre-merge gate: the tpulint invariant analyzer (which subsumes the
+# metrics-docs and event-reasons checks) plus the tier-1 pytest run (the
+# suite ROADMAP.md pins as the regression floor).
+verify: tpulint test-tier1
 
+# AST-based invariant analysis (k8s_dra_driver_tpu/analysis): CAS-closure
+# purity, flock ordering, store-scan hygiene, k8s wire-drift, metric/event
+# discipline, and doc sync — fails on any finding not in the committed
+# baseline (hack/tpulint_baseline.json, empty: no legacy debt).
+tpulint:
+	python -m k8s_dra_driver_tpu.analysis
+
+# Single-rule views of the tpulint engine (former standalone scripts).
 check-metrics-docs:
 	python hack/check_metrics_docs.py
 

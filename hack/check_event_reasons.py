@@ -1,84 +1,21 @@
 #!/usr/bin/env python
-"""Fail CI when an Event reason string is malformed or undocumented.
+"""Compatibility shim — the event-reason audit is now the
+``event-reasons`` rule of the tpulint engine (k8s_dra_driver_tpu/analysis):
+AST-parsed REASON_* constants and literal ``reason=`` kwargs, CamelCase +
+documented in docs/reference/events.md. Kept so existing muscle memory
+and CI references keep working:
 
-Same contract as check_metrics_docs.py, for the event plane: every reason
-an actor can emit must be (a) CamelCase — the kubectl-ecosystem convention
-Events are grepped and alerted on — and (b) catalogued in
-docs/reference/events.md so operators can look a reason up.
-
-Reasons are found two ways:
-- the canonical ``REASON_* = "..."`` constants in ``pkg/events.py`` (the
-  only sanctioned source for recorder calls), and
-- any literal ``reason="..."`` keyword argument anywhere in the package,
-  catching call sites that bypass the catalog.
-
-Run directly or via `make verify`:
-
-    python hack/check_event_reasons.py
+    python hack/check_event_reasons.py   ==    hack/tpulint.py --select event-reasons
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "k8s_dra_driver_tpu")
-DOC = os.path.join(REPO, "docs", "reference", "events.md")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-CONSTANT_RE = re.compile(r"^REASON_[A-Z0-9_]+\s*=\s*[\"']([^\"']+)[\"']",
-                         re.MULTILINE)
-KWARG_RE = re.compile(r"\breason=[\"']([^\"']+)[\"']")
-CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
-
-
-def emitted_reasons() -> dict:
-    """reason string -> [files that emit/define it]."""
-    found: dict = {}
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            rel = os.path.relpath(path, REPO)
-            for rx in (CONSTANT_RE, KWARG_RE):
-                for name in rx.findall(src):
-                    found.setdefault(name, []).append(rel)
-    return found
-
-
-def main() -> int:
-    reasons = emitted_reasons()
-    if not reasons:
-        print("error: no event reasons found — scanner broken?",
-              file=sys.stderr)
-        return 2
-    try:
-        with open(DOC, encoding="utf-8") as f:
-            body = f.read()
-    except FileNotFoundError:
-        print(f"error: {DOC} missing", file=sys.stderr)
-        return 2
-
-    bad = 0
-    for name, files in sorted(reasons.items()):
-        where = ", ".join(sorted(set(files)))
-        if not CAMEL_RE.match(name):
-            print(f"error: reason {name!r} is not CamelCase ({where})",
-                  file=sys.stderr)
-            bad += 1
-        if f"`{name}`" not in body:
-            print(f"error: reason {name!r} missing from "
-                  f"docs/reference/events.md ({where})", file=sys.stderr)
-            bad += 1
-    if bad:
-        return 1
-    print(f"ok: {len(reasons)} event reason(s), all CamelCase and documented")
-    return 0
-
+from k8s_dra_driver_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--select", "event-reasons"] + sys.argv[1:]))

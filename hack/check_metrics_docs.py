@@ -1,91 +1,21 @@
 #!/usr/bin/env python
-"""Fail CI when a registered metric is missing from docs/reference/metrics.md.
+"""Compatibility shim — the metrics/docs consistency check is now the
+``metrics-docs`` rule of the tpulint engine (k8s_dra_driver_tpu/analysis),
+which parses registrations with ``ast`` instead of regex and reports
+file:line findings. Kept so existing muscle memory and CI references keep
+working:
 
-Scans every Python file in the package for Counter/Gauge/Histogram
-constructions with a literal metric name (the only way metrics are
-registered in this codebase) and asserts each name appears in the metrics
-reference page. The inverse direction — documented names no code
-registers — is reported as a warning, not a failure: prose may legitimately
-reference derived series (`*_bucket`, `*_sum`, `*_count`).
-
-Run directly or via `make verify`:
-
-    python hack/check_metrics_docs.py
+    python hack/check_metrics_docs.py    ==    hack/tpulint.py --select metrics-docs
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "k8s_dra_driver_tpu")
-DOC = os.path.join(REPO, "docs", "reference", "metrics.md")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# A metric registration: Counter("name", ...), Gauge("name", ...),
-# Histogram("name", ...) — first positional arg is always the literal name.
-METRIC_RE = re.compile(
-    r"\b(?:Counter|Gauge|Histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']"
-)
-
-# Documented metric names: every `backtick_quoted_identifier` that looks
-# like a metric (our namespace prefix).
-DOC_NAME_RE = re.compile(r"`(tpu_dra_[a-zA-Z0-9_:]*)`")
-
-
-def registered_metrics() -> dict:
-    """metric name -> [files that register it]."""
-    found: dict = {}
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            for name in METRIC_RE.findall(src):
-                found.setdefault(name, []).append(os.path.relpath(path, REPO))
-    return found
-
-
-def main() -> int:
-    registered = registered_metrics()
-    if not registered:
-        print("error: no metric registrations found — scanner broken?",
-              file=sys.stderr)
-        return 2
-    with open(DOC, encoding="utf-8") as f:
-        body = f.read()
-    documented = set(DOC_NAME_RE.findall(body))
-
-    missing = {
-        name: files for name, files in sorted(registered.items())
-        if f"`{name}`" not in body
-    }
-    if missing:
-        print(f"error: {len(missing)} metric(s) registered in the package "
-              f"but missing from docs/reference/metrics.md:", file=sys.stderr)
-        for name, files in missing.items():
-            print(f"  {name}  (registered in {', '.join(sorted(set(files)))})",
-                  file=sys.stderr)
-        return 1
-
-    base = set(registered)
-    derived_suffixes = ("_bucket", "_sum", "_count")
-    stale = {
-        name for name in documented
-        if name not in base
-        and not any(name.endswith(s) and name[: -len(s)] in base
-                    for s in derived_suffixes)
-    }
-    if stale:
-        print(f"warning: {len(stale)} documented metric name(s) no code "
-              f"registers: {', '.join(sorted(stale))}")
-
-    print(f"ok: {len(registered)} registered metric(s), all documented")
-    return 0
-
+from k8s_dra_driver_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--select", "metrics-docs"] + sys.argv[1:]))

@@ -46,11 +46,8 @@ for p in paths:
 print(f"   {len(paths)} files ok")
 EOF
 
-echo "-- metrics documented"
-"${PYTHON:-python}" hack/check_metrics_docs.py
-
-echo "-- event reasons documented"
-"${PYTHON:-python}" hack/check_event_reasons.py
+echo "-- tpulint invariants (incl. metrics/event-reason docs)"
+"${PYTHON:-python}" -m k8s_dra_driver_tpu.analysis
 
 echo "-- VERSION is semver"
 check_version

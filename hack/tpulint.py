@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""tpulint alias — the AST invariant analyzer lives in
+``k8s_dra_driver_tpu/analysis``; this shim only fixes up sys.path so
+``python hack/tpulint.py`` works from anywhere in the checkout.
+
+    python hack/tpulint.py               # whole package, committed baseline
+    python hack/tpulint.py --list-rules
+    python hack/tpulint.py --select store-scan k8s_dra_driver_tpu/sim
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_dra_driver_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""tpulint — AST-based invariant analysis for the control plane.
+
+The reference driver leans on `go vet`/staticcheck to keep its control
+plane honest; this package is the Python analog for the invariants PRs
+1-5 established by convention: CAS closures must be pure (they re-run on
+conflict), the checkpoint flock nests under the pu flock, scheduler loops
+never rescan the store per item, every API dataclass field round-trips
+through the k8s wire codec, metrics and event reasons stay documented and
+bounded-cardinality, and lock-guarded state is only mutated under its
+lock.
+
+Architecture:
+
+- ``engine.py``     — the analysis driver: per-file parallel checking,
+                      ``# tpulint: disable=<rule> -- <reason>``
+                      suppressions (reason mandatory), a committed
+                      baseline for explicit burn-down, stable ordering.
+- ``astutil.py``    — shared AST helpers (parent maps, dotted chains).
+- ``checkers/``     — one module per rule; registered via
+                      ``@register_checker``.
+- ``__main__.py``   — the CLI (``python -m k8s_dra_driver_tpu.analysis``,
+                      alias ``hack/tpulint.py``), wired into
+                      ``make tpulint`` / ``make verify`` / CI
+                      basic-checks.
+
+Runs dependency-free on stdlib ``ast`` so CI needs no new packages.
+"""
+
+from k8s_dra_driver_tpu.analysis.engine import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    all_checkers,
+    register_checker,
+    run_analysis,
+)

@@ -1,0 +1,11 @@
+"""tpulint CLI: ``python -m k8s_dra_driver_tpu.analysis`` (alias
+``hack/tpulint.py``; ``make tpulint`` runs it as the verify gate)."""
+
+from __future__ import annotations
+
+import sys
+
+from k8s_dra_driver_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Shared AST helpers for tpulint checkers.
+
+Everything here is stdlib-``ast`` only and stateless, so checkers stay
+trivially parallelizable across files.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# Shared vocabulary between the metric/event checkers and the doc-sync
+# rules — one definition so the pairs can't silently diverge.
+METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+CAMEL_CASE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+
+def dotted(node: ast.AST) -> str:
+    """Render an attribute chain as a dotted string.
+
+    ``self._pu_lock.hold`` -> ``"self._pu_lock.hold"``;
+    intermediate calls collapse to ``()``: ``Flock(p).hold`` ->
+    ``"().hold"``. Unrenderable bases become ``"?"``.
+    """
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append("()")
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def call_chain(call: ast.Call) -> str:
+    """Dotted chain of a call's function expression."""
+    return dotted(call.func)
+
+
+def receiver_chain(call: ast.Call) -> str:
+    """Dotted chain of the receiver of a method call (empty for plain
+    function calls): ``self.api.list(...)`` -> ``"self.api"``."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return ""
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """node -> parent map for one module tree."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> Iterator[ast.AST]:
+    """Walk from ``node``'s parent up to the module root."""
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def enclosing_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda, or None."""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def enclosing_class(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.ClassDef]:
+    for anc in ancestors(node, parents):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def in_loop_body(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    """True when ``node`` runs once per loop iteration: anywhere under a
+    ``while`` (its test re-evaluates every iteration too), or in a
+    ``for``'s body/orelse — the ``for`` iterable and target evaluate
+    once, so they're exempt."""
+    prev: ast.AST = node
+    for anc in ancestors(node, parents):
+        if isinstance(anc, ast.For):
+            if prev is not anc.iter and prev is not anc.target:
+                return True
+        elif isinstance(anc, ast.While):
+            return True
+        prev = anc
+    return False
+
+
+def with_ancestors(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Iterator[ast.With]:
+    """Every ``with`` statement lexically containing ``node``."""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, ast.With):
+            yield anc
+
+
+def string_constants(node: ast.AST) -> Iterator[str]:
+    """Every string literal anywhere under ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def const_str(node: Optional[ast.AST]) -> Optional[str]:
+    """The value of a string-literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def dataclass_fields(cls: ast.ClassDef) -> List[ast.AnnAssign]:
+    """Annotated assignments directly in a class body — dataclass fields
+    (includes un-defaulted annotations)."""
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            out.append(stmt)
+    return out
+
+
+def find_classes(tree: ast.AST) -> Dict[str, ast.ClassDef]:
+    return {
+        n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    }
+
+
+def iter_metric_registrations(
+    tree: ast.AST,
+) -> Iterator[Tuple[str, ast.Call]]:
+    """Every ``Counter/Gauge/Histogram("<literal name>", ...)`` call —
+    the only way metrics are registered in this codebase."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in METRIC_CTORS and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            yield node.args[0].value, node
+
+
+def iter_reason_constants(
+    tree: ast.AST,
+) -> Iterator[Tuple[str, ast.Assign]]:
+    """Every ``REASON_* = "<literal>"`` assignment — the sanctioned
+    event-reason catalog shape."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id.startswith("REASON_"):
+                yield node.value.value, node
+                break
+
+
+def find_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Top-level + nested FunctionDefs by name; first definition wins on
+    duplicates (fine for the codec-module lookups this backs)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef) and n.name not in out:
+            out[n.name] = n
+    return out

@@ -1,0 +1,18 @@
+"""tpulint checkers — importing this package registers every rule.
+
+One module per invariant family; each module's checkers self-register
+via ``@register_checker`` so ``all_checkers()`` sees them in a stable
+order (import order below = report/finalize order).
+"""
+
+from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
+    cas_purity,
+    lock_order,
+    store_scan,
+    wire_drift,
+    metric_discipline,
+    event_discipline,
+    swallowed_exceptions,
+    thread_shared_state,
+    docs_sync,
+)

@@ -1,0 +1,140 @@
+"""cas-purity: CAS mutation closures must be pure.
+
+``update_with_retry`` re-runs its mutate closure on every resourceVersion
+conflict (k8s/store.py, k8s/httpapi.py, k8s/kubeclient.py all share the
+contract). Anything effectful inside the closure therefore happens a
+nondeterministic number of times under contention: sleeps stretch the
+retry loop, counter ``inc``/histogram ``observe`` calls inflate, events
+double-emit, nested API writes interleave half-applied state, and I/O
+repeats. PR 3 already burned one of these (the DaemonSet ready-count was
+re-listed inside the closure); this rule stops the class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    ancestors,
+    call_chain,
+    enclosing_function,
+    receiver_chain,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+# Effectful call patterns. Each entry: (predicate description, matcher).
+_API_WRITE_ATTRS = {"create", "delete", "update", "update_with_retry"}
+_METRIC_MUT_ATTRS = {"inc", "observe"}
+_RECORDER_ATTRS = {"event", "normal", "warning"}
+_IO_PREFIXES = ("os.", "subprocess.", "shutil.", "socket.", "requests.")
+_IO_PURE_PREFIXES = ("os.path.", "os.environ.get",)
+
+
+def _impurity(call: ast.Call) -> Optional[str]:
+    chain = call_chain(call)
+    recv = receiver_chain(call).lower()
+    last = chain.rsplit(".", 1)[-1]
+    if chain == "open":
+        return "file I/O (open)"
+    if last == "sleep" and ("time" in recv or chain == "sleep"):
+        return "time.sleep (stretches every CAS retry)"
+    if chain.startswith(_IO_PREFIXES) and not chain.startswith(_IO_PURE_PREFIXES):
+        return f"I/O call {chain}"
+    if last in _METRIC_MUT_ATTRS and recv:
+        return f"metric mutation {chain} (inflates on every retry)"
+    if last in _RECORDER_ATTRS and "recorder" in recv:
+        return f"event emission {chain} (double-emits on retry)"
+    if last in _API_WRITE_ATTRS and ("api" in recv or "store" in recv):
+        return f"nested API write {chain}"
+    return None
+
+
+def _mutate_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "mutate":
+            return kw.value
+    # update_with_retry(kind, name, namespace, mutate, ...)
+    if len(call.args) >= 4:
+        return call.args[3]
+    return None
+
+
+def _function_index(
+    sf: SourceFile,
+) -> Dict[str, List[Tuple[ast.FunctionDef, Tuple[ast.AST, ...]]]]:
+    """name -> [(def node, enclosing-scope chain)] for closure lookup."""
+    out: Dict[str, List[Tuple[ast.FunctionDef, Tuple[ast.AST, ...]]]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            scope = tuple(
+                a for a in ancestors(node, sf.parents)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+            out.setdefault(node.name, []).append((node, scope))
+    return out
+
+
+@register_checker
+class CasPurityChecker(Checker):
+    rule = "cas-purity"
+    description = ("no I/O, sleeps, event emission, metric mutation, or "
+                   "nested API writes inside update_with_retry closures "
+                   "(they re-run on CAS conflict)")
+    hint = ("compute effectful values before the closure and capture them "
+            "(the PR 3 _daemonset_pass pattern), or move the side effect "
+            "after the update returns")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        fn_index = None
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "update_with_retry"):
+                continue
+            mutate = _mutate_arg(node)
+            body: Optional[ast.AST] = None
+            if isinstance(mutate, ast.Lambda):
+                body = mutate
+            elif isinstance(mutate, ast.Name):
+                if fn_index is None:
+                    fn_index = _function_index(sf)
+                body = self._resolve(sf, node, mutate.id, fn_index)
+            if body is None:
+                continue
+            for sub in ast.walk(body):
+                if isinstance(sub, ast.Call):
+                    why = _impurity(sub)
+                    if why:
+                        findings.append(self.finding(
+                            sf, sub,
+                            f"{why} inside an update_with_retry closure",
+                        ))
+        return findings
+
+    @staticmethod
+    def _resolve(sf, call, name, fn_index):
+        """Pick the lexically-nearest FunctionDef named ``name``: the one
+        whose enclosing-scope chain is the longest suffix of the call
+        site's own chain (plain lexical scoping, no imports)."""
+        candidates = fn_index.get(name, [])
+        if not candidates:
+            return None
+        call_scope = tuple(
+            a for a in ancestors(call, sf.parents)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        best, best_depth = None, -1
+        for node, scope in candidates:
+            # A def visible from the call shares the call's scope chain
+            # as a suffix (module level: empty chain, always a suffix).
+            if scope == call_scope[len(call_scope) - len(scope):] \
+                    and len(scope) > best_depth:
+                best, best_depth = node, len(scope)
+        return best

@@ -1,0 +1,154 @@
+"""metrics-docs / event-reasons: the doc pages track the code.
+
+The AST successors of ``hack/check_metrics_docs.py`` and
+``hack/check_event_reasons.py`` (now shims over these rules):
+
+- **metrics-docs**: every ``Counter``/``Gauge``/``Histogram`` registered
+  with a literal name must appear in ``docs/reference/metrics.md``;
+  documented ``tpu_dra_*`` names nothing registers are warnings (prose
+  may legitimately reference derived ``_bucket``/``_sum``/``_count``
+  series, which are exempt).
+- **event-reasons**: every ``REASON_*`` constant and literal
+  ``reason="..."`` keyword must be CamelCase and catalogued in
+  ``docs/reference/events.md``.
+
+Both are collect/finalize rules: the per-file phase gathers names in
+parallel (via the SAME astutil matchers metric-discipline and
+event-discipline use, so the pairs can't diverge), the finalize phase
+reads the doc page once. Inventory-wide checks — stale documented names,
+and the old scripts' "found nothing at all: scanner broken?" guard —
+only run when the run actually covered the package (gated on the
+registering module being in the analyzed set), so single-file and
+fixture runs stay meaningful.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Tuple
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    CAMEL_CASE,
+    iter_metric_registrations,
+    iter_reason_constants,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    Project,
+    SEVERITY_WARNING,
+    SourceFile,
+    register_checker,
+)
+
+_DOC_METRIC_RE = re.compile(r"`(tpu_dra_[a-zA-Z0-9_:]*)`")
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@register_checker
+class MetricsDocsChecker(Checker):
+    rule = "metrics-docs"
+    description = ("every registered tpu_dra_* metric is documented in "
+                   "docs/reference/metrics.md")
+    hint = "add the metric to docs/reference/metrics.md"
+    # The module whose presence in the analyzed set marks a run as
+    # package-wide — the precondition for inventory-level checks.
+    _IMPL = "k8s_dra_driver_tpu/pkg/metrics.py"
+
+    def __init__(self, doc_rel: str = "docs/reference/metrics.md"):
+        self.doc_rel = doc_rel
+
+    def collect(self, sf: SourceFile):
+        names = [(name, node.lineno)
+                 for name, node in iter_metric_registrations(sf.tree)]
+        return names or None
+
+    def finalize(self, project: Project, facts) -> List[Finding]:
+        body = project.read(self.doc_rel)
+        if body is None:
+            return [self.finding(self.doc_rel, 1,
+                                 f"{self.doc_rel} missing")]
+        findings: List[Finding] = []
+        full_run = self._IMPL in project.analyzed
+        registered = set()
+        for rel, names in facts:
+            for name, lineno in names:
+                registered.add(name)
+                if f"`{name}`" not in body:
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"metric {name!r} registered here but missing "
+                        f"from {self.doc_rel}"))
+        if not full_run:
+            return findings
+        if not registered:
+            # The old standalone script's exit-2 guard: a package-wide
+            # run that sees ZERO registrations means the scanner pattern
+            # rotted, not that the code went metric-free.
+            findings.append(self.finding(
+                self._IMPL, 1,
+                "no metric registrations found in a package-wide run — "
+                "scanner broken?"))
+            return findings
+        for doc_name in sorted(set(_DOC_METRIC_RE.findall(body))):
+            if doc_name in registered:
+                continue
+            if any(doc_name.endswith(s)
+                   and doc_name[: -len(s)] in registered
+                   for s in _DERIVED_SUFFIXES):
+                continue
+            findings.append(self.finding(
+                self.doc_rel, 1,
+                f"documented metric {doc_name!r} is registered by no code",
+                severity=SEVERITY_WARNING))
+        return findings
+
+
+@register_checker
+class EventReasonsChecker(Checker):
+    rule = "event-reasons"
+    description = ("every REASON_* constant / literal reason= kwarg is "
+                   "CamelCase and catalogued in docs/reference/events.md")
+    hint = "add the reason to the docs/reference/events.md catalog"
+    _IMPL = "k8s_dra_driver_tpu/pkg/events.py"
+
+    def __init__(self, doc_rel: str = "docs/reference/events.md"):
+        self.doc_rel = doc_rel
+
+    def collect(self, sf: SourceFile):
+        reasons: List[Tuple[str, int]] = [
+            (value, node.lineno)
+            for value, node in iter_reason_constants(sf.tree)
+        ]
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (kw.arg == "reason"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        reasons.append((kw.value.value, kw.value.lineno))
+        return reasons or None
+
+    def finalize(self, project: Project, facts) -> List[Finding]:
+        body = project.read(self.doc_rel)
+        if body is None:
+            return [self.finding(self.doc_rel, 1, f"{self.doc_rel} missing")]
+        findings: List[Finding] = []
+        for rel, reasons in facts:
+            for reason, lineno in reasons:
+                if not CAMEL_CASE.match(reason):
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"event reason {reason!r} is not CamelCase"))
+                if f"`{reason}`" not in body:
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"event reason {reason!r} missing from "
+                        f"{self.doc_rel}"))
+        if self._IMPL in project.analyzed and not facts:
+            findings.append(self.finding(
+                self._IMPL, 1,
+                "no event reasons found in a package-wide run — "
+                "scanner broken?"))
+        return findings

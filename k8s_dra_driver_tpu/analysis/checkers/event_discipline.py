@@ -1,0 +1,112 @@
+"""event-discipline: Events only through the EventRecorder, reasons only
+from the catalog.
+
+The PR 4 recorder owns dedup (cross-process series aggregation), burst
+limiting, and backlog bounds; a raw ``Event`` written straight to the
+store bypasses all three and races concurrent recorders on the series
+name. Reason strings passed to recorder calls must be the ``REASON_*``
+constants from ``pkg/events.py`` — inline literals fork the catalog the
+``event-reasons`` doc rule audits and operators alert on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    CAMEL_CASE,
+    iter_reason_constants,
+    receiver_chain,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+_RECORDER_CALLS = {"event": 2, "normal": 1, "warning": 1}  # reason arg index
+_IMPL = "k8s_dra_driver_tpu/pkg/events.py"
+
+
+@register_checker
+class EventDisciplineChecker(Checker):
+    rule = "event-discipline"
+    description = ("Events written only via EventRecorder; recorder "
+                   "reasons only via REASON_* constants, CamelCase")
+    hint = ("emit through recorder.normal/warning with a REASON_* "
+            "constant from pkg/events.py (add one there if missing)")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            findings.extend(self._check_raw_write(sf, node))
+            findings.extend(self._check_reason(sf, node))
+        findings.extend(self._check_constants(sf))
+        return findings
+
+    def _check_raw_write(self, sf: SourceFile, node: ast.Call) -> List[Finding]:
+        if sf.rel == _IMPL:
+            return []
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("create", "update", "update_with_retry")):
+            return []
+        recv = receiver_chain(node).lower()
+        if "api" not in recv and "store" not in recv:
+            return []
+        for arg in list(node.args)[:1]:
+            # api.create(Event(...)) / api.update(Event(...))
+            if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "Event"):
+                return [self.finding(
+                    sf, node,
+                    "Event written directly to the store — bypasses the "
+                    "EventRecorder's dedup, burst limiting, and backlog "
+                    "bounds, and races concurrent recorders on the "
+                    "series name",
+                )]
+            # api.update_with_retry(EVENT, ...)
+            if isinstance(arg, ast.Name) and arg.id == "EVENT":
+                return [self.finding(
+                    sf, node,
+                    "Event kind mutated directly in the store — only the "
+                    "EventRecorder may write Events",
+                )]
+        return []
+
+    def _check_reason(self, sf: SourceFile, node: ast.Call) -> List[Finding]:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RECORDER_CALLS
+                and "recorder" in receiver_chain(node).lower()):
+            return []
+        idx = _RECORDER_CALLS[node.func.attr]
+        reason_node = None
+        if len(node.args) > idx:
+            reason_node = node.args[idx]
+        for kw in node.keywords:
+            if kw.arg == "reason":
+                reason_node = kw.value
+        if isinstance(reason_node, ast.Constant) \
+                and isinstance(reason_node.value, str):
+            return [self.finding(
+                sf, reason_node,
+                f"inline event reason {reason_node.value!r} — use a "
+                f"REASON_* constant from pkg/events.py so the catalog "
+                f"and docs stay the single source",
+            )]
+        return []
+
+    def _check_constants(self, sf: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                sf, node,
+                f"event reason {value!r} is not CamelCase — the "
+                f"kubectl-ecosystem convention Events are grepped and "
+                f"alerted on",
+            )
+            for value, node in iter_reason_constants(sf.tree)
+            if not CAMEL_CASE.match(value)
+        ]

@@ -1,0 +1,157 @@
+"""lock-order: pu flock before cp flock, flocks only via context managers.
+
+The node-global prepare/unprepare flock (``pu.lock``) serializes device
+mutation across plugin *processes*; the checkpoint flock (``cp.lock``)
+guards checkpoint read-modify-write. Every PR-1 pipeline takes them in
+that order — pu outside, cp (via ``CheckpointStore.session()``) inside —
+so a reversed acquisition anywhere is a cross-process deadlock waiting
+for load. Three statically-checkable rules:
+
+- a checkpoint ``session()`` opens only where the pu flock is provably
+  held: lexically inside ``with <pu-lock>.hold(...)``, or in a function
+  annotated ``# tpulint: holds=pu-flock`` (the gRPC handler takes the
+  lock and delegates);
+- the checkpoint is never saved outside a session except through the
+  store's own locked single-write path (batching discipline: a bare
+  get→mutate→save pair is TWO lock holds and a lost-update window);
+- flocks are acquired only through context managers (``.hold()``) —
+  a bare ``.acquire()`` leaks the lock on any exception path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    call_chain,
+    enclosing_function,
+    receiver_chain,
+    string_constants,
+    with_ancestors,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+HOLDS_MARK = "tpulint: holds=pu-flock"
+
+# The lock/checkpoint implementations themselves are exempt — they *are*
+# the sanctioned acquisition paths the rule funnels everyone through.
+_IMPL_FILES = (
+    "k8s_dra_driver_tpu/pkg/flock.py",
+    "k8s_dra_driver_tpu/plugins/checkpoint.py",
+)
+
+
+def _is_pu_hold(withitem_expr: ast.AST) -> bool:
+    """``with self._pu_lock.hold(...)`` or
+    ``with Flock(<...pu.lock...>).hold(...)``."""
+    if not (isinstance(withitem_expr, ast.Call)
+            and isinstance(withitem_expr.func, ast.Attribute)
+            and withitem_expr.func.attr == "hold"):
+        return False
+    recv = receiver_chain(withitem_expr)
+    if "pu_lock" in recv:
+        return True
+    base = withitem_expr.func.value
+    if isinstance(base, ast.Call):
+        return any("pu.lock" in s for s in string_constants(base))
+    return False
+
+
+def _fn_holds_pu(sf: SourceFile, fn) -> bool:
+    """The enclosing def carries the holds annotation on its signature
+    lines or directly above it."""
+    if fn is None or isinstance(fn, ast.Lambda):
+        return False
+    first_stmt = fn.body[0].lineno if fn.body else fn.lineno
+    lo = max(1, fn.lineno - 1)
+    return any(
+        HOLDS_MARK in sf.line(n) for n in range(lo, first_stmt + 1)
+    )
+
+
+@register_checker
+class LockOrderChecker(Checker):
+    rule = "lock-order"
+    description = ("checkpoint flock nests under the pu flock, checkpoint "
+                   "saves go through sessions, flocks only via context "
+                   "managers")
+    scope = ("k8s_dra_driver_tpu/plugins/", "k8s_dra_driver_tpu/pkg/",
+             "k8s_dra_driver_tpu/daemon/", "k8s_dra_driver_tpu/cmd/")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.rel in _IMPL_FILES:
+            return []
+        findings: List[Finding] = []
+        session_vars = self._session_vars(sf)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = receiver_chain(node)
+                low = recv.lower()
+                if attr == "session" and ("store" in low or "checkpoint" in low
+                                          or "_cp" in low):
+                    findings.extend(self._check_session(sf, node))
+                elif attr == "save" and ("checkpoint" in low or "_mgr" in low
+                                         or "store" in low):
+                    if recv.split(".")[-1] not in session_vars \
+                            and recv not in session_vars:
+                        findings.append(self.finding(
+                            sf, node,
+                            f"checkpoint saved outside a session "
+                            f"({call_chain(node)}) — get→mutate→save pairs "
+                            f"release the cp flock between load and write",
+                            hint="use `with <store>.session() as sess:` and "
+                                 "mutate sess.checkpoint, then sess.save()",
+                        ))
+                elif attr in ("acquire", "release") and (
+                        "lock" in low or "flock" in low):
+                    findings.append(self.finding(
+                        sf, node,
+                        f"flock {attr}() called directly "
+                        f"({call_chain(node)}) — locks leak on exception "
+                        f"paths outside a context manager",
+                        hint="use `with <lock>.hold(timeout=...):`",
+                    ))
+        return findings
+
+    def _check_session(self, sf: SourceFile, call: ast.Call) -> List[Finding]:
+        for w in with_ancestors(call, sf.parents):
+            for item in w.items:
+                if _is_pu_hold(item.context_expr):
+                    return []
+        if _fn_holds_pu(sf, enclosing_function(call, sf.parents)):
+            return []
+        return [self.finding(
+            sf, call,
+            "checkpoint session opened without the pu flock held — the cp "
+            "flock must nest under the pu flock (lock order), and prepare "
+            "state must not move while another process prepares",
+            hint="wrap in `with self._pu_lock.hold(...):`, or annotate the "
+                 "enclosing function `# tpulint: holds=pu-flock` if every "
+                 "caller provably holds it",
+        )]
+
+    @staticmethod
+    def _session_vars(sf: SourceFile) -> Set[str]:
+        """Names bound by ``with <x>.session(...) as NAME`` — their
+        ``.save()`` is the sanctioned in-session write."""
+        out: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                ce = item.context_expr
+                if (isinstance(ce, ast.Call)
+                        and isinstance(ce.func, ast.Attribute)
+                        and ce.func.attr == "session"
+                        and isinstance(item.optional_vars, ast.Name)):
+                    out.add(item.optional_vars.id)
+        return out

@@ -1,0 +1,136 @@
+"""metric-discipline: metrics registered on the shared registry, labels
+bounded.
+
+Two failure modes the metric surface (PR 2/4) is vulnerable to:
+
+- a ``Counter``/``Gauge``/``Histogram`` constructed but never passed
+  through ``registry.register(...)`` records into an object nothing
+  scrapes — the series silently vanishes from /metrics (the get-or-create
+  registry is also what dedupes shared series across plugin bundles, so
+  a bare construction can additionally fork a same-name series);
+- a label value built from an f-string over an unbounded source (claim
+  uids, messages, node names from user input) explodes series
+  cardinality; label values must come from closed vocabularies, with
+  free-form detail in logs/events instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    METRIC_CTORS,
+    call_chain,
+    dotted,
+    iter_metric_registrations,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+_LABELLED_CALLS = {"inc", "set", "observe"}
+# Keyword args of metric calls that carry the measurement, not a label.
+_VALUE_KWARGS = {"value", "by", "amount"}
+
+
+@register_checker
+class MetricDisciplineChecker(Checker):
+    rule = "metric-discipline"
+    description = ("tpu_dra_* metrics only via registry.register(), label "
+                   "values never from f-strings (cardinality)")
+    hint = ("wrap the constructor: registry.register(Counter(...)); pass "
+            "closed-vocabulary label values and put free-form detail in "
+            "the log/event message")
+    # The metric primitives live in pkg/metrics.py; its internal exposition
+    # code (HELP/TYPE line formatting) legitimately f-strings series names.
+    _IMPL = "k8s_dra_driver_tpu/pkg/metrics.py"
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for name, node in iter_metric_registrations(sf.tree):
+            findings.extend(self._check_ctor(sf, name, node))
+        if sf.rel != self._IMPL:
+            bindings = self._metric_bindings(sf)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    findings.extend(self._check_labels(sf, node, bindings))
+        return findings
+
+    @staticmethod
+    def _metric_bindings(sf: SourceFile) -> set:
+        """Names (locals and self-attributes) bound from
+        ``registry.register(...)`` or a metric constructor in this file —
+        the receivers whose inc/set/observe calls are metric calls. Keeps
+        the f-string rule off unrelated setters (a status object's
+        ``.set(f"...")`` is not a label write)."""
+        out = set()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            fn = node.value.func
+            is_metric = (
+                (isinstance(fn, ast.Attribute) and fn.attr == "register")
+                or (isinstance(fn, ast.Name) and fn.id in METRIC_CTORS)
+            )
+            if not is_metric:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    out.add(tgt.attr)
+        return out
+
+    def _check_ctor(self, sf: SourceFile, name: str,
+                    node: ast.Call) -> List[Finding]:
+        if not name.startswith("tpu_dra_"):
+            return []
+        parent = sf.parents.get(node)
+        registered = (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Attribute)
+            and parent.func.attr == "register"
+        )
+        if registered:
+            return []
+        return [self.finding(
+            sf, node,
+            f"metric {name!r} constructed outside "
+            f"registry.register() — the series never reaches /metrics "
+            f"and dodges shared-registry dedup",
+        )]
+
+    def _check_labels(self, sf: SourceFile, node: ast.Call,
+                      bindings: set) -> List[Finding]:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LABELLED_CALLS):
+            return []
+        # Receiver must actually be a metric: a name/attr bound from
+        # register()/a constructor, or a chain through a metrics bundle
+        # (self.metrics.foo.inc, self._metrics["x"].set).
+        recv = node.func.value
+        if isinstance(recv, ast.Subscript):
+            recv = recv.value
+        chain = dotted(recv)
+        parts = set(chain.split("."))
+        if not (parts & bindings or "metric" in chain.lower()):
+            return []
+        findings = []
+        label_args = list(node.args) + [
+            kw.value for kw in node.keywords
+            if kw.arg and kw.arg not in _VALUE_KWARGS
+        ]
+        for arg in label_args:
+            if isinstance(arg, ast.JoinedStr):
+                findings.append(self.finding(
+                    sf, arg,
+                    f"label value for {call_chain(node)}() built from an "
+                    f"f-string — unbounded label sources explode series "
+                    f"cardinality",
+                ))
+        return findings

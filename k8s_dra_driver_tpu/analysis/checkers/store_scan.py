@@ -1,0 +1,57 @@
+"""store-scan: no full-kind store scans inside per-item loops.
+
+PR 3 indexed the APIServer store and gave the allocator/scheduler
+point lookups precisely so hot loops stop paying O(kind) per item; a
+``store.list()`` (or ``api.list()``) inside a ``for``/``while`` body
+reintroduces the O(n·m) scan the bench budgets exist to catch. Listing
+*as* the loop's iterable is fine — that is one scan. Informer caches
+(``*_informer.list()``) are exempt: they serve from memory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    call_chain,
+    in_loop_body,
+    receiver_chain,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+
+@register_checker
+class StoreScanChecker(Checker):
+    rule = "store-scan"
+    description = ("no store/api list() scans inside per-item loops in "
+                   "sim/ and controller/ — hoist the scan or use the "
+                   "PR 3 indexes")
+    hint = ("hoist the list() above the loop (one scan, filter in "
+            "Python), or use try_get/feasibility indexes")
+    scope = ("k8s_dra_driver_tpu/sim/", "k8s_dra_driver_tpu/controller/")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "list"):
+                continue
+            recv = receiver_chain(node).lower()
+            if not recv or "informer" in recv:
+                continue
+            if not ("api" in recv.split(".")[-1] or "store" in recv):
+                continue
+            if in_loop_body(node, sf.parents):
+                findings.append(self.finding(
+                    sf, node,
+                    f"store scan {call_chain(node)}() inside a per-item "
+                    f"loop — O(kind) work repeated every iteration",
+                ))
+        return findings

@@ -1,0 +1,74 @@
+"""swallowed-exceptions: no silently-dropped failures in control loops.
+
+A ``pass``-only broad except in a control loop or watch drain turns a
+real failure (store conflict storm, codec error, poisoned watch event)
+into an infinite quiet retry — the failure mode that's invisible until a
+10k-node storm hits it. Narrow typed excepts with ``pass`` are fine
+(``except NotFoundError: pass`` is the idiomatic delete race absorber);
+what this rule bans is:
+
+- ``except:`` (bare) anywhere — it eats KeyboardInterrupt/SystemExit;
+- ``except Exception:`` / ``except BaseException:`` whose body is only
+  ``pass``/``...`` — handle it, log it, or count it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_noop_body(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring/ellipsis
+        return False
+    return True
+
+
+@register_checker
+class SwallowedExceptionsChecker(Checker):
+    rule = "swallowed-exceptions"
+    description = ("no bare excepts; no pass-only broad excepts in "
+                   "control-plane code")
+    hint = ("catch the specific exception, or log/count the failure "
+            "before continuing; telemetry-must-not-break-control-flow "
+            "excepts should at least debug-log")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(self.finding(
+                    sf, node,
+                    "bare `except:` — also catches KeyboardInterrupt and "
+                    "SystemExit; name the exception type",
+                ))
+                continue
+            names = []
+            if isinstance(node.type, ast.Name):
+                names = [node.type.id]
+            elif isinstance(node.type, ast.Tuple):
+                names = [e.id for e in node.type.elts
+                         if isinstance(e, ast.Name)]
+            if any(n in _BROAD for n in names) and _is_noop_body(node.body):
+                findings.append(self.finding(
+                    sf, node,
+                    f"broad `except {'/'.join(names)}` swallowed with "
+                    f"pass — a control-loop failure disappears without a "
+                    f"log line or counter",
+                ))
+        return findings

@@ -1,0 +1,159 @@
+"""thread-shared-state: guarded attributes mutate only under their lock.
+
+The control plane is threaded (store watchers, plugin gRPC pools, the
+metrics server) and guards shared maps with plain ``threading.Lock``
+members. The convention is declared in code: an attribute initialized
+with a trailing ``# tpulint: guarded-by=<lock-attr>`` comment may only
+be mutated inside ``with self.<lock-attr>:`` (or ``.acquire()``-style
+holds are already banned by lock-order). The checker enforces every
+declared guard; ``__init__`` is exempt (the object isn't shared yet).
+
+Mutations covered: assignment/augmented assignment to ``self.X`` or
+``self.X[...]``, deletion, and the standard container mutators
+(``self.X.append(...)``, ``.pop``, ``.update``, ...).
+
+Internal helpers that are only ever called with the lock already held
+declare it: ``# tpulint: holds=<lock-attr>`` on the def (the same
+annotation family lock-order uses for the pu flock) — the declared
+contract is then visible at the def instead of silently assumed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    ancestors,
+    dotted,
+    enclosing_function,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+GUARDED_RE = re.compile(r"#\s*tpulint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
+# The value char class includes '-' so lock-order's `holds=pu-flock`
+# captures whole and can never prefix-match a lock attr named `pu`
+# (attribute names cannot contain '-', so the exact compare rejects it).
+HOLDS_RE = re.compile(r"#\s*tpulint:\s*holds=([A-Za-z_][A-Za-z0-9_\-]*)")
+
+_MUTATORS = {
+    "append", "add", "insert", "extend", "remove", "discard", "pop",
+    "popitem", "clear", "update", "setdefault", "appendleft", "popleft",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X``; also unwraps one subscript: ``self.X[k]``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@register_checker
+class ThreadSharedStateChecker(Checker):
+    rule = "thread-shared-state"
+    description = ("attributes declared `# tpulint: guarded-by=<lock>` "
+                   "mutate only inside `with self.<lock>:`")
+    hint = "move the mutation inside `with self.<lock>:`"
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guards = self._declared_guards(sf, cls)
+            if not guards:
+                continue
+            findings.extend(self._check_class(sf, cls, guards))
+        return findings
+
+    def _declared_guards(self, sf: SourceFile,
+                         cls: ast.ClassDef) -> Dict[str, str]:
+        """attr -> lock attr, from `self.X = ...  # tpulint: guarded-by=Y`
+        lines anywhere in the class body."""
+        guards: Dict[str, str] = {}
+        end = max((n.end_lineno or n.lineno for n in ast.walk(cls)
+                   if hasattr(n, "lineno")), default=cls.lineno)
+        for lineno in range(cls.lineno, end + 1):
+            m = GUARDED_RE.search(sf.line(lineno))
+            if not m:
+                continue
+            am = re.search(r"self\.([A-Za-z_][A-Za-z0-9_]*)\s*[:=]",
+                           sf.line(lineno))
+            if am:
+                guards[am.group(1)] = m.group(1)
+        return guards
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
+                     guards: Dict[str, str]) -> List[Finding]:
+        findings = []
+        for node in ast.walk(cls):
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target] if isinstance(node, ast.AugAssign)
+                           else node.targets)
+                for t in targets:
+                    attr = attr or (_self_attr(t) if _self_attr(t) in guards
+                                    else None)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATORS):
+                cand = _self_attr(node.func.value)
+                if cand in guards:
+                    attr = cand
+            if attr is None:
+                continue
+            fn = enclosing_function(node, sf.parents)
+            if fn is not None and getattr(fn, "name", "") == "__init__":
+                continue
+            lock = guards[attr]
+            if self._under_lock(sf, node, lock):
+                continue
+            if fn is not None and lock in self._fn_holds(sf, fn):
+                continue
+            findings.append(self.finding(
+                sf, node,
+                f"self.{attr} (guarded-by={lock}) mutated outside "
+                f"`with self.{lock}:` — torn read/write under the "
+                f"threaded control plane",
+            ))
+        return findings
+
+    @staticmethod
+    def _fn_holds(sf: SourceFile, fn) -> set:
+        """Lock names a `# tpulint: holds=<lock>` annotation on the def
+        (signature lines through the first body statement) declares."""
+        if isinstance(fn, ast.Lambda):
+            return set()
+        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
+        out = set()
+        for n in range(max(1, fn.lineno - 1), first_stmt + 1):
+            m = HOLDS_RE.search(sf.line(n))
+            if m:
+                out.add(m.group(1))
+        return out
+
+    @staticmethod
+    def _under_lock(sf: SourceFile, node: ast.AST, lock: str) -> bool:
+        want = f"self.{lock}"
+        for anc in ancestors(node, sf.parents):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    ce = item.context_expr
+                    # `with self._mu:` or `with self._mu.hold(...):`
+                    if dotted(ce) == want:
+                        return True
+                    if (isinstance(ce, ast.Call)
+                            and dotted(ce.func).startswith(want + ".")):
+                        return True
+        return False

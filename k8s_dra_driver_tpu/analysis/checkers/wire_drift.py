@@ -1,0 +1,205 @@
+"""wire-drift: every API dataclass field round-trips through k8swire.
+
+The k8s wire codec (``k8s/k8swire.py``) is hand-written — one encode and
+one decode function per kind, the client-go-generated-types analog. A
+field added to a dataclass but not threaded through *both* directions is
+silent data loss on a real cluster (the sim's internal wire round-trips
+everything via serialize.py, so nothing fails until kubeclient is in the
+path — exactly the drift class PR 5's placement wiring nearly shipped).
+
+Mechanically: a field named ``foo`` passes when the encoder subtree
+reads ``.foo`` somewhere and the decoder subtree passes ``foo=`` to a
+constructor. Fields ``kind``/``meta`` are codec-generic (the top-level
+``to_k8s_wire``/``_meta_encode`` pair owns them). Deliberately lossy
+fields (sim-only conveniences) carry a line suppression with the reason
+in the dataclass itself, next to the field they exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    dataclass_fields,
+    find_classes,
+    find_functions,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    Project,
+    register_checker,
+)
+
+
+@dataclass
+class WireKindSpec:
+    """One wire-encoded kind: where its dataclasses live and which codec
+    functions must mention every field."""
+
+    kind: str
+    # rel path -> dataclass names composing the kind's object graph
+    dataclasses: Dict[str, Tuple[str, ...]]
+    encoders: Tuple[str, ...]
+    decoders: Tuple[str, ...]
+    exempt: FrozenSet[str] = frozenset({"kind", "meta"})
+
+
+_CONDITION = ("k8s_dra_driver_tpu/k8s/conditions.py", ("Condition",))
+_API_CD = "k8s_dra_driver_tpu/api/computedomain.py"
+_CORE = "k8s_dra_driver_tpu/k8s/core.py"
+
+DEFAULT_SPECS: Tuple[WireKindSpec, ...] = (
+    WireKindSpec(
+        kind="ComputeDomain",
+        dataclasses={
+            _API_CD: ("ComputeDomain", "ComputeDomainSpec",
+                      "ComputeDomainChannelSpec", "ComputeDomainNode",
+                      "ComputeDomainPlacement", "ComputeDomainStatus"),
+            _CONDITION[0]: _CONDITION[1],
+        },
+        encoders=("_computedomain_encode", "_conditions_encode"),
+        decoders=("_computedomain_decode", "_conditions_decode"),
+    ),
+    WireKindSpec(
+        kind="ComputeDomainClique",
+        dataclasses={
+            _API_CD: ("ComputeDomainClique", "ComputeDomainDaemonInfo"),
+        },
+        encoders=("_clique_encode",),
+        decoders=("_clique_decode",),
+    ),
+    WireKindSpec(
+        kind="ResourceClaim",
+        dataclasses={
+            _CORE: ("ResourceClaim", "DeviceRequest", "DeviceClaimConfig",
+                    "OpaqueDeviceConfig", "AllocationResult",
+                    "DeviceRequestAllocationResult", "ResourceClaimConsumer"),
+            _CONDITION[0]: _CONDITION[1],
+        },
+        encoders=("_claim_encode", "_requests_encode", "_configs_encode",
+                  "_conditions_encode"),
+        decoders=("_claim_decode", "_requests_decode", "_configs_decode",
+                  "_conditions_decode"),
+    ),
+    WireKindSpec(
+        kind="ResourceSlice",
+        dataclasses={
+            _CORE: ("ResourceSlice", "ResourcePool", "Device", "DeviceTaint",
+                    "Counter", "CounterSet", "DeviceCounterConsumption"),
+        },
+        encoders=("_slice_encode", "_counters_encode"),
+        decoders=("_slice_decode", "_counters_decode"),
+    ),
+    WireKindSpec(
+        kind="DeviceClass",
+        dataclasses={_CORE: ("DeviceClass",)},
+        encoders=("_deviceclass_encode", "_configs_encode"),
+        decoders=("_deviceclass_decode", "_configs_decode"),
+    ),
+    WireKindSpec(
+        kind="Lease",
+        dataclasses={
+            "k8s_dra_driver_tpu/pkg/leaderelection.py": ("Lease",),
+        },
+        encoders=("_lease_encode",),
+        decoders=("_lease_decode",),
+    ),
+)
+
+DEFAULT_WIRE_FILE = "k8s_dra_driver_tpu/k8s/k8swire.py"
+
+
+def _attr_reads(fn: ast.FunctionDef) -> FrozenSet[str]:
+    return frozenset(
+        n.attr for n in ast.walk(fn) if isinstance(n, ast.Attribute)
+    )
+
+
+def _ctor_kwargs(fn: ast.FunctionDef) -> FrozenSet[str]:
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            out.update(kw.arg for kw in n.keywords if kw.arg)
+        elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Store):
+            # decode styles that assign obj.field = ... post-construction;
+            # Store-context only — a mere READ of .field somewhere in the
+            # decoder must not count as populating it, or dropping the
+            # ctor kwarg would go undetected
+            out.add(n.attr)
+    return frozenset(out)
+
+
+@register_checker
+class WireDriftChecker(Checker):
+    rule = "wire-drift"
+    description = ("every API dataclass field appears in the matching "
+                   "k8swire encode AND decode (no silent loss on the real "
+                   "k8s wire)")
+    hint = ("thread the field through the kind's encoder and decoder in "
+            "k8s/k8swire.py; deliberately sim-only fields take a line "
+            "suppression with the reason")
+
+    def __init__(self, specs: Sequence[WireKindSpec] = DEFAULT_SPECS,
+                 wire_file: str = DEFAULT_WIRE_FILE):
+        self.specs = tuple(specs)
+        self.wire_file = wire_file
+
+    def finalize(self, project: Project, facts) -> List[Finding]:
+        wire = project.source(self.wire_file)
+        if wire is None:
+            return [self.finding(self.wire_file, 1,
+                                 "wire codec module missing or unparseable")]
+        funcs = find_functions(wire.tree)
+        findings: List[Finding] = []
+        for spec in self.specs:
+            enc_fns = [funcs[n] for n in spec.encoders if n in funcs]
+            dec_fns = [funcs[n] for n in spec.decoders if n in funcs]
+            missing_fns = [n for n in spec.encoders + spec.decoders
+                           if n not in funcs]
+            if missing_fns:
+                findings.append(self.finding(
+                    self.wire_file, 1,
+                    f"{spec.kind}: codec function(s) "
+                    f"{', '.join(missing_fns)} not found in "
+                    f"{self.wire_file}"))
+                continue
+            encoded = frozenset().union(*[_attr_reads(f) for f in enc_fns])
+            decoded = frozenset().union(*[_ctor_kwargs(f) for f in dec_fns])
+            for rel, class_names in spec.dataclasses.items():
+                src = project.source(rel)
+                if src is None:
+                    findings.append(self.finding(
+                        rel, 1, f"{spec.kind}: dataclass module {rel} "
+                                f"missing or unparseable"))
+                    continue
+                classes = find_classes(src.tree)
+                for cname in class_names:
+                    cls = classes.get(cname)
+                    if cls is None:
+                        findings.append(self.finding(
+                            rel, 1,
+                            f"{spec.kind}: dataclass {cname} not found "
+                            f"in {rel}"))
+                        continue
+                    for fld in dataclass_fields(cls):
+                        name = fld.target.id
+                        if name in spec.exempt or name.startswith("_"):
+                            continue
+                        if name not in encoded:
+                            findings.append(self.finding(
+                                rel, fld,
+                                f"{cname}.{name} is never read by the "
+                                f"{spec.kind} k8swire encoder(s) "
+                                f"{'/'.join(spec.encoders)} — value lost "
+                                f"on encode"))
+                        if name not in decoded:
+                            findings.append(self.finding(
+                                rel, fld,
+                                f"{cname}.{name} is never populated by "
+                                f"the {spec.kind} k8swire decoder(s) "
+                                f"{'/'.join(spec.decoders)} — value lost "
+                                f"on decode"))
+        return findings

@@ -1,0 +1,122 @@
+"""Argument parsing + reporting for the tpulint CLI.
+
+Exit codes: 0 clean (stale baseline entries print a burn-down note but
+do not fail), 1 error-severity findings survive the baseline, 2
+usage/internal error — the convention hack/ci's steps already assume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from k8s_dra_driver_tpu.analysis.engine import (
+    SEVERITY_ERROR,
+    all_checkers,
+    repo_root_default,
+    run_analysis,
+    save_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join("hack", "tpulint_baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="AST-based invariant analyzer for the TPU DRA "
+                    "control plane.",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "k8s_dra_driver_tpu package)")
+    p.add_argument("--jobs", type=int, default=0,
+                   help="parallel workers (default: min(8, cpus); "
+                        "results are identical at any count)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE}; "
+                        f"'none' disables)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline with the current findings "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every registered rule and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--repo-root", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for ch in all_checkers():
+            print(f"{ch.rule:24s} {ch.description}")
+        return 0
+
+    repo_root = args.repo_root or repo_root_default()
+    baseline_path: Optional[str]
+    if args.baseline == "none":
+        baseline_path = None
+    elif args.baseline:
+        baseline_path = args.baseline
+    else:
+        baseline_path = os.path.join(repo_root, DEFAULT_BASELINE)
+
+    try:
+        result = run_analysis(
+            paths=args.paths or None,
+            repo_root=repo_root,
+            select=[r for r in args.select.split(",") if r] or None,
+            ignore=[r for r in args.ignore.split(",") if r] or None,
+            jobs=args.jobs or None,
+            baseline_path=None if args.update_baseline else baseline_path,
+        )
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not baseline_path:
+            print("tpulint: --update-baseline needs a baseline path",
+                  file=sys.stderr)
+            return 2
+        save_baseline(baseline_path, result.findings)
+        print(f"tpulint: baseline updated with {len(result.findings)} "
+              f"finding(s) at {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "files_analyzed": result.files_analyzed,
+            "findings": [f.__dict__ for f in result.new_findings],
+            "baselined": len(result.findings) - len(result.new_findings),
+            "stale_baseline": result.stale_baseline,
+        }, indent=1, sort_keys=True))
+        return 1 if result.failed else 0
+
+    for f in result.new_findings:
+        print(f.render())
+    baselined = len(result.findings) - len(result.new_findings)
+    if result.stale_baseline:
+        n = sum(result.stale_baseline.values())
+        print(f"tpulint: note: {n} baseline entr{'y' if n == 1 else 'ies'} "
+              f"no longer fire — burn them down with --update-baseline:")
+        for fp in sorted(result.stale_baseline):
+            print(f"  {fp}")
+    errors = sum(1 for f in result.new_findings
+                 if f.severity == SEVERITY_ERROR)
+    warnings = len(result.new_findings) - errors
+    summary = (f"tpulint: {result.files_analyzed} file(s), "
+               f"{errors} error(s), {warnings} warning(s)")
+    if baselined:
+        summary += f", {baselined} baselined"
+    print(summary)
+    return 1 if result.failed else 0

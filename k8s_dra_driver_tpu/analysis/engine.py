@@ -1,0 +1,446 @@
+"""The tpulint analysis engine.
+
+Pipeline:
+
+1. discover Python files under the requested paths (default: the
+   ``k8s_dra_driver_tpu`` package),
+2. per file, in parallel: parse once, run every selected checker's
+   ``check_file``/``collect``,
+3. serially, in registration order: run each checker's ``finalize`` with
+   the per-file facts (cross-file rules: wire drift, doc sync),
+4. apply ``# tpulint: disable=<rule> -- <reason>`` line suppressions
+   (a suppression without a reason is itself a finding),
+5. subtract the committed baseline; anything left fails.
+
+Findings sort by (file, line, col, rule, message) so output is stable
+regardless of worker count — pinned by the determinism test.
+"""
+
+from __future__ import annotations
+
+import ast
+import concurrent.futures
+import json
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+# Meta-rules the engine itself owns.
+RULE_SUPPRESSION = "suppression"      # disable= comment without a reason
+RULE_PARSE = "parse-error"            # file failed to parse
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s*--\s*(\S[^#]*?))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``file`` is repo-relative POSIX."""
+
+    file: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+    severity: str = SEVERITY_ERROR
+
+    def sort_key(self) -> Tuple:
+        return (self.file, self.line, self.col, self.rule, self.message)
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used for baseline matching, so
+        unrelated edits shifting line numbers don't churn the baseline."""
+        return f"{self.rule}::{self.file}::{self.message}"
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}:{self.col}"
+        out = f"{loc}: {self.severity}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class SourceFile:
+    """One parsed Python file, shared read-only across checkers."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            from k8s_dra_driver_tpu.analysis.astutil import build_parents
+
+            self._parents = build_parents(self.tree)
+        return self._parents
+
+    def line(self, lineno: int) -> str:
+        """1-based physical line, empty string out of range."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Checker:
+    """Base checker. Subclasses set ``rule``/``description`` and override
+    ``check_file`` (per-file findings), ``collect`` (per-file facts for
+    cross-file rules), and/or ``finalize`` (runs once, serially, with
+    every file's fact). Checkers must be stateless across files —
+    ``check_file``/``collect`` run concurrently."""
+
+    rule: str = ""
+    description: str = ""
+    hint: str = ""
+    # Repo-relative directory prefixes the per-file phase applies to.
+    # None = every analyzed file. Files outside the package (fixtures)
+    # always get every selected checker, so fixture tests exercise rules
+    # scoped to sim/ or plugins/ without recreating those trees.
+    scope: Optional[Tuple[str, ...]] = None
+
+    def applies_to(self, rel: str) -> bool:
+        if self.scope is None or not rel.startswith("k8s_dra_driver_tpu/"):
+            return True
+        return any(rel.startswith(p) for p in self.scope)
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        return []
+
+    def collect(self, sf: SourceFile) -> Any:
+        return None
+
+    def finalize(self, project: "Project",
+                 facts: List[Tuple[str, Any]]) -> List[Finding]:
+        return []
+
+    # -- convenience ---------------------------------------------------------
+
+    def finding(self, sf_or_rel, node_or_line, message: str,
+                hint: str = "", severity: str = SEVERITY_ERROR) -> Finding:
+        if isinstance(sf_or_rel, SourceFile):
+            rel = sf_or_rel.rel
+        else:
+            rel = sf_or_rel
+        if isinstance(node_or_line, ast.AST):
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0)
+        else:
+            line, col = int(node_or_line), 0
+        return Finding(file=rel, line=line, col=col, rule=self.rule,
+                       message=message, hint=hint or self.hint,
+                       severity=severity)
+
+
+_CHECKER_CLASSES: List[type] = []
+
+
+def register_checker(cls: type) -> type:
+    """Class decorator: adds the checker to the default registry."""
+    if not getattr(cls, "rule", ""):
+        raise ValueError(f"checker {cls.__name__} has no rule id")
+    _CHECKER_CLASSES.append(cls)
+    return cls
+
+
+def all_checkers() -> List[Checker]:
+    """Fresh instances of every registered checker, in registration
+    order (importing the checkers package registers them)."""
+    import k8s_dra_driver_tpu.analysis.checkers  # noqa: F401 — registration
+
+    return [cls() for cls in _CHECKER_CLASSES]
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+
+
+def parse_suppressions(lines: Sequence[str]) -> List[Suppression]:
+    out = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        out.append(Suppression(line=i, rules=rules, reason=reason))
+    return out
+
+
+def apply_suppressions(
+    findings: List[Finding], by_file: Dict[str, List[Suppression]]
+) -> List[Finding]:
+    """Drop findings a same-line ``disable=`` covers; emit a finding for
+    every suppression that carries no reason (reasons are mandatory —
+    an unexplained disable is exactly the silent rot tpulint exists to
+    stop)."""
+    out: List[Finding] = []
+    for f in findings:
+        sups = by_file.get(f.file, [])
+        covered = any(
+            s.line == f.line and (f.rule in s.rules or "all" in s.rules)
+            and s.reason
+            for s in sups
+        )
+        if not covered:
+            out.append(f)
+    for rel, sups in by_file.items():
+        for s in sups:
+            if not s.reason:
+                out.append(Finding(
+                    file=rel, line=s.line, col=0, rule=RULE_SUPPRESSION,
+                    message=(
+                        f"suppression of {', '.join(s.rules)} carries no "
+                        f"reason (write `# tpulint: disable=<rule> -- why`)"
+                    ),
+                ))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> allowed count."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    counts: Dict[str, int] = {}
+    for e in doc.get("findings", []):
+        fp = f"{e['rule']}::{e['file']}::{e['message']}"
+        counts[fp] = counts.get(fp, 0) + int(e.get("count", 1))
+    return counts
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        key = (f.rule, f.file, f.message)
+        counts[key] = counts.get(key, 0) + 1
+    doc = {
+        "version": 1,
+        "findings": [
+            {"rule": r, "file": fl, "message": m, "count": c}
+            for (r, fl, m), c in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def subtract_baseline(
+    findings: List[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Returns (new findings, stale baseline entries). Count-aware: N
+    baselined occurrences absorb the first N findings of that identity;
+    the N+1st fails."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in budget.items() if n > 0}
+    return new, stale
+
+
+# -- project / discovery -----------------------------------------------------
+
+
+def repo_root_default() -> str:
+    """The repo checkout containing this package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+class Project:
+    """Read-only repo view handed to ``finalize`` — cross-file rules pull
+    in files (codecs, docs pages) that may sit outside the analyzed
+    path set."""
+
+    def __init__(self, repo_root: str, analyzed: Sequence[str] = ()):
+        self.repo_root = repo_root
+        # rel paths of the files this run analyzed — lets finalize rules
+        # that need a COMPLETE inventory (stale-doc detection) bail when
+        # the run covered only a slice of the package.
+        self.analyzed = frozenset(analyzed)
+        self._sources: Dict[str, Optional[SourceFile]] = {}
+        self._mu = threading.Lock()
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.repo_root, rel.replace("/", os.sep))
+
+    def read(self, rel: str) -> Optional[str]:
+        try:
+            with open(self.abspath(rel), encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def source(self, rel: str) -> Optional[SourceFile]:
+        with self._mu:
+            if rel not in self._sources:
+                text = self.read(rel)
+                try:
+                    self._sources[rel] = (
+                        SourceFile(self.abspath(rel), rel, text)
+                        if text is not None else None
+                    )
+                except (SyntaxError, ValueError):
+                    # same failure classes _analyze_one absorbs (ValueError:
+                    # e.g. null bytes) — finalize rules see None and report
+                    # an unparseable-module finding instead of crashing
+                    self._sources[rel] = None
+            return self._sources[rel]
+
+
+def discover_files(paths: Sequence[str], repo_root: str) -> List[Tuple[str, str]]:
+    """(abspath, rel) for every .py under ``paths``, sorted by rel."""
+    seen: Dict[str, str] = {}
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            candidates = [p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                candidates.extend(
+                    os.path.join(dirpath, fn)
+                    for fn in filenames if fn.endswith(".py")
+                )
+        for c in candidates:
+            rel = os.path.relpath(c, repo_root).replace(os.sep, "/")
+            seen[rel] = c
+    return sorted((abs_, rel) for rel, abs_ in seen.items())
+
+
+# -- the run -----------------------------------------------------------------
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)   # post-suppression
+    new_findings: List[Finding] = field(default_factory=list)  # post-baseline
+    stale_baseline: Dict[str, int] = field(default_factory=dict)
+    files_analyzed: int = 0
+
+    @property
+    def failed(self) -> bool:
+        return any(f.severity == SEVERITY_ERROR for f in self.new_findings)
+
+
+def _analyze_one(
+    path: str, rel: str, checkers: List[Checker]
+) -> Tuple[str, List[Finding], List[Suppression], Dict[str, Any]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        sf = SourceFile(path, rel, text)
+    except (OSError, SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return rel, [Finding(file=rel, line=line, col=0, rule=RULE_PARSE,
+                             message=f"cannot analyze: {e}")], [], {}
+    findings: List[Finding] = []
+    facts: Dict[str, Any] = {}
+    for ch in checkers:
+        if not ch.applies_to(rel):
+            continue
+        findings.extend(ch.check_file(sf))
+        fact = ch.collect(sf)
+        if fact is not None:
+            facts[ch.rule] = fact
+    return rel, findings, parse_suppressions(sf.lines), facts
+
+
+def run_analysis(
+    paths: Optional[Sequence[str]] = None,
+    repo_root: Optional[str] = None,
+    checkers: Optional[List[Checker]] = None,
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+    jobs: Optional[int] = None,
+    baseline_path: Optional[str] = None,
+) -> AnalysisResult:
+    """Run the engine. ``baseline_path=None`` means no baseline."""
+    repo_root = repo_root or repo_root_default()
+    if paths is None:
+        paths = [os.path.join(repo_root, "k8s_dra_driver_tpu")]
+    checkers = list(checkers) if checkers is not None else all_checkers()
+    if select:
+        wanted = set(select)
+        unknown = wanted - {c.rule for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        checkers = [c for c in checkers if c.rule in wanted]
+    if ignore:
+        checkers = [c for c in checkers if c.rule not in set(ignore)]
+
+    files = discover_files(paths, repo_root)
+    jobs = jobs or min(8, (os.cpu_count() or 2))
+
+    per_file: Dict[str, Tuple[List[Finding], List[Suppression], Dict[str, Any]]] = {}
+    if jobs <= 1 or len(files) <= 1:
+        for path, rel in files:
+            rel_, fnd, sups, facts = _analyze_one(path, rel, checkers)
+            per_file[rel_] = (fnd, sups, facts)
+    else:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+            futs = [ex.submit(_analyze_one, path, rel, checkers)
+                    for path, rel in files]
+            for fut in concurrent.futures.as_completed(futs):
+                rel_, fnd, sups, facts = fut.result()
+                per_file[rel_] = (fnd, sups, facts)
+
+    findings: List[Finding] = []
+    suppressions: Dict[str, List[Suppression]] = {}
+    for rel in sorted(per_file):
+        fnd, sups, _facts = per_file[rel]
+        findings.extend(fnd)
+        if sups:
+            suppressions[rel] = sups
+
+    project = Project(repo_root, analyzed=sorted(per_file))
+    for ch in checkers:
+        facts = [(rel, per_file[rel][2][ch.rule])
+                 for rel in sorted(per_file) if ch.rule in per_file[rel][2]]
+        findings.extend(ch.finalize(project, facts))
+
+    # Finalize findings may target files outside the analyzed set (the
+    # codec, a dataclass module) — honor suppressions written there too.
+    for f in findings:
+        if f.file not in suppressions and f.file not in per_file:
+            text = project.read(f.file)
+            suppressions[f.file] = (
+                parse_suppressions(text.splitlines()) if text else []
+            )
+
+    findings = apply_suppressions(findings, suppressions)
+    findings.sort(key=Finding.sort_key)
+
+    result = AnalysisResult(findings=findings, files_analyzed=len(files))
+    if baseline_path and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+        result.new_findings, result.stale_baseline = subtract_baseline(
+            findings, baseline)
+    else:
+        result.new_findings = list(findings)
+    return result

@@ -581,7 +581,7 @@ class Controller:
         live_uids = {cd.uid for cd in self.api.list(COMPUTE_DOMAIN)}
         removed = 0
         for kind in (DAEMON_SET, RESOURCE_CLAIM_TEMPLATE):
-            for obj in self.api.list(kind):
+            for obj in self.api.list(kind):  # tpulint: disable=store-scan -- iterates a fixed 2-kind tuple: exactly one scan per kind, not per item
                 refs = [r for r in obj.meta.owner_references if r.kind == COMPUTE_DOMAIN]
                 if refs and all(r.uid not in live_uids for r in refs):
                     try:

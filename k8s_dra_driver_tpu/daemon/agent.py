@@ -257,8 +257,8 @@ class SliceAgent:
         try:
             if self.clique is not None:
                 self.clique.set_ready(self.node_name, False)
-        except Exception:  # noqa: BLE001 — API may already be gone
-            pass
+        except Exception as e:  # noqa: BLE001 — API may already be gone
+            log.debug("clique ready=false on shutdown failed: %s", e)
         self.process.stop()
 
     # -- peer config ---------------------------------------------------------

@@ -158,8 +158,8 @@ class CliqueManager:
         )
         try:
             self.api.create(clique)
-        except Exception:  # noqa: BLE001 — racing creator; re-read below
-            pass
+        except Exception as e:  # noqa: BLE001 — racing creator; re-read below
+            log.debug("clique %s create lost the race: %s", self.name, e)
         got = self._get()
         assert got is not None
         return got

@@ -216,6 +216,13 @@ class _Compiler:
                 # overload (int-vs-quantity included) — non-match, never
                 # a truncating coercion.
                 return False
+            # bool before the int branch: Python's bool IS an int, so
+            # without this check `true == 1` would compare True == 1 and
+            # match. cel-go has no bool-vs-int overload (no_such_overload
+            # error; DRA: non-match) — and bool attribute values must not
+            # be "coerced" through int("true") either.
+            if isinstance(a, bool) != isinstance(b, bool):
+                return False
             if isinstance(a, int) != isinstance(b, int):
                 try:
                     a, b = int(a), int(b)

@@ -46,7 +46,7 @@ class DeviceClaimConfig:
     opaque: Optional[OpaqueDeviceConfig] = None
     # Where this config came from: "claim" or "class" — drives precedence
     # (/root/reference/cmd/gpu-kubelet-plugin/device_state.go:1399-1463).
-    source: str = "claim"
+    source: str = "claim"  # tpulint: disable=wire-drift -- provenance tag, not wire data: the decode *context* (claim vs class doc) supplies it
 
 
 @dataclass
@@ -56,7 +56,7 @@ class DeviceRequest:
     allocation_mode: str = "ExactCount"  # or "All"
     count: int = 1
     # Legacy sim-only attr=value strings; never wire-encoded.
-    selectors: List[str] = field(default_factory=list)
+    selectors: List[str] = field(default_factory=list)  # tpulint: disable=wire-drift -- deliberately one-way: encode raises on legacy selectors (no wire form), decode yields CEL only
     # Real DRA selectors[].cel.expression strings — tagged at manifest
     # parse time (the k8s shape {cel: {expression}}) so the allocator
     # never has to sniff which language a string is in.
@@ -238,7 +238,7 @@ class DeviceClass(K8sObject):
     kind: str = DEVICE_CLASS
     driver: str = ""  # selector: device.driver == driver
     # Attribute equality selectors, the CEL-expression stand-in.
-    match_attributes: Dict[str, Any] = field(default_factory=dict)
+    match_attributes: Dict[str, Any] = field(default_factory=dict)  # tpulint: disable=wire-drift -- encode compiles match-attrs INTO CEL expressions; decode returns them via cel_selectors (semantic round-trip)
     # Real DRA selector expressions (selectors[].cel.expression); when set,
     # evaluated by k8s.celmini — the same strings the chart ships.
     cel_selectors: List[str] = field(default_factory=list)

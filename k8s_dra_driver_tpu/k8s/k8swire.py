@@ -31,6 +31,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainSpec,
     ComputeDomainStatus,
 )
+from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.k8s.core import (
     AllocationResult,
     Container,
@@ -229,6 +230,39 @@ def _meta_decode(md: Dict[str, Any]) -> ObjectMeta:
             else None
         ),
     )
+
+
+# -- status conditions -------------------------------------------------------
+
+
+def _conditions_encode(conditions: List[Condition]) -> List[Dict[str, Any]]:
+    """metav1.Condition wire shape. Always emits type/status; reason,
+    message, and lastTransitionTime only when set (matching how the
+    apiserver prunes empty optionals)."""
+    out = []
+    for c in conditions:
+        doc: Dict[str, Any] = {"type": c.type, "status": c.status}
+        if c.reason:
+            doc["reason"] = c.reason
+        if c.message:
+            doc["message"] = c.message
+        if c.last_transition_time:
+            doc["lastTransitionTime"] = _ts_encode(c.last_transition_time)
+        out.append(doc)
+    return out
+
+
+def _conditions_decode(docs: List[Dict[str, Any]]) -> List[Condition]:
+    return [
+        Condition(
+            type=d.get("type", ""),
+            status=d.get("status", "Unknown"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=_ts_decode(d.get("lastTransitionTime")),
+        )
+        for d in docs or []
+    ]
 
 
 # -- containers / pod templates ---------------------------------------------
@@ -585,6 +619,8 @@ def _claim_encode(rc: ResourceClaim, version: str = "v1") -> Dict[str, Any]:
             {"resource": "pods", "name": c.name, "uid": c.uid}
             for c in rc.reserved_for
         ]
+    if rc.conditions:
+        status["conditions"] = _conditions_encode(rc.conditions)
     return {"spec": spec, "status": status}
 
 
@@ -626,6 +662,7 @@ def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
             )
             for c in status.get("reservedFor") or []
         ],
+        conditions=_conditions_decode(status.get("conditions") or []),
     )
 
 
@@ -875,6 +912,8 @@ def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
             "blockShape": p.block_shape,
             "nodes": list(p.nodes),
         }
+    if cd.status.conditions:
+        status["conditions"] = _conditions_encode(cd.status.conditions)
     return {"spec": spec, "status": status}
 
 
@@ -912,6 +951,7 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
                 )
                 if status.get("placement") else None
             ),
+            conditions=_conditions_decode(status.get("conditions") or []),
         ),
     )
 

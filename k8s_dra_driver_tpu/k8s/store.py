@@ -90,22 +90,22 @@ def _match_labels(obj: K8sObject, selector: Optional[Dict[str, str]]) -> bool:
 class APIServer:
     def __init__(self) -> None:
         self._mu = threading.RLock()
-        self._objects: Dict[_Key, K8sObject] = {}
+        self._objects: Dict[_Key, K8sObject] = {}  # tpulint: guarded-by=_mu
         # Secondary indexes, maintained on every write: kind -> {key -> obj}
         # and (kind, namespace) -> {key -> obj}. Values are the SAME stored
         # objects (no copies); list() deepcopies on the way out as before.
-        self._by_kind: Dict[str, Dict[_Key, K8sObject]] = {}
-        self._by_kind_ns: Dict[Tuple[str, str], Dict[_Key, K8sObject]] = {}
+        self._by_kind: Dict[str, Dict[_Key, K8sObject]] = {}  # tpulint: guarded-by=_mu
+        self._by_kind_ns: Dict[Tuple[str, str], Dict[_Key, K8sObject]] = {}  # tpulint: guarded-by=_mu
         # kind -> (live count, last resourceVersion stamped on this kind).
         # O(1) to read and to maintain; see kind_fingerprint().
-        self._fp: Dict[str, Tuple[int, int]] = {}
+        self._fp: Dict[str, Tuple[int, int]] = {}  # tpulint: guarded-by=_mu
         self._rv = 0
         self.stats = StoreStats()
         self._metrics = None  # set by attach_metrics()
         # (queue, name-filter, namespace-filter); None filters match all —
         # the field-selector analog so a single-object watcher (e.g. the
         # daemon's own-pod PodManager) doesn't receive cluster-wide churn.
-        self._watchers: Dict[
+        self._watchers: Dict[  # tpulint: guarded-by=_mu
             str, List[Tuple["queue.Queue[WatchEvent]", Optional[str], Optional[str]]]
         ] = {}
 
@@ -150,16 +150,19 @@ class APIServer:
         return (obj.kind, obj.meta.namespace, obj.meta.name)
 
     def _index_add(self, key: _Key, obj: K8sObject) -> None:
+        # tpulint: holds=_mu (write-path internal; every caller locks)
         self._objects[key] = obj
         self._by_kind.setdefault(key[0], {})[key] = obj
         self._by_kind_ns.setdefault((key[0], key[1]), {})[key] = obj
 
     def _index_drop(self, key: _Key) -> None:
+        # tpulint: holds=_mu (write-path internal; every caller locks)
         del self._objects[key]
         self._by_kind[key[0]].pop(key, None)
         self._by_kind_ns[(key[0], key[1])].pop(key, None)
 
     def _fp_mutate(self, kind: str, delta: int, rv: Optional[int] = None) -> None:
+        # tpulint: holds=_mu (write-path internal; every caller locks)
         """Maintain the fingerprint counters on one mutation. ``rv`` is the
         resourceVersion just stamped (None for plain removals, which consume
         no rv). Token uniqueness: the rv component is monotone and strictly

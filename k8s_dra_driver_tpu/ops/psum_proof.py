@@ -15,8 +15,12 @@ Derivation (exactly what libtpu/JAX do on a real slice):
 - num_processes      <- len(TPU_WORKER_HOSTNAMES)
 - coordinator        <- MEGASCALE_COORDINATOR_ADDRESS (host:port)
 
-Each worker contributes (process_id + 1); the psum must equal
-N(N+1)/2 on every process. Prints one JSON line with the result.
+Each worker contributes (process_id + 1) per local device; the psum must
+equal N(N+1)/2 * devices-per-process on every process. The proof
+VERIFIES this in-process and exits nonzero on mismatch — a job that
+computes a wrong reduction must fail, not print a wrong number with
+exit 0 for the harness to misread as success. Prints one JSON line
+with the result (including ``expected`` and ``ok``).
 
 Usage (as the container command of an Indexed Job on a ComputeDomain, or
 spawned locally by the e2e harness on the CPU backend):
@@ -72,13 +76,21 @@ def run_proof(timeout_s: float = 60.0) -> dict:
         )(x)
 
     total = float(np.asarray(jax.device_get(reduce(garr)))[0])
-    # Weighted by each process's local device count (1 on default CPU).
+    # The expected reduction, derived in-process: every process p
+    # contributes (p+1) on each of its local devices, and jax requires
+    # uniform per-process device counts, so
+    #   expected = sum_{p=0}^{N-1} (p+1) * (global_devices / N)
+    #            = N(N+1)/2 * devices-per-process.
+    n = jax.process_count()
+    expected = float(n * (n + 1) // 2 * (len(devices) // n))
     return {
         "process_id": process_id,
-        "num_processes": jax.process_count(),
+        "num_processes": n,
         "local_devices": jax.local_device_count(),
         "global_devices": len(devices),
         "psum": total,
+        "expected": expected,
+        "ok": total == expected,
         "platform": devices[0].platform,
     }
 
@@ -86,6 +98,15 @@ def run_proof(timeout_s: float = 60.0) -> dict:
 def main() -> int:
     result = run_proof()
     print(json.dumps(result))
+    if not result["ok"]:
+        print(
+            f"psum proof FAILED: got {result['psum']}, "
+            f"expected {result['expected']} "
+            f"({result['num_processes']} processes x "
+            f"{result['local_devices']} local devices)",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 

@@ -173,11 +173,11 @@ class EventRecorder:
         ))
         self._mu = threading.Lock()
         # obj key -> [tokens, last refill timestamp]
-        self._buckets: Dict[_ObjKey, List[float]] = {}
+        self._buckets: Dict[_ObjKey, List[float]] = {}  # tpulint: guarded-by=_mu
         # obj key -> Event names this recorder created — gates the backlog
         # enforcement scan (an O(namespace-events) list) to objects that
         # have plausibly reached the cap, instead of paying it per series.
-        self._series_seen: Dict[_ObjKey, set] = {}
+        self._series_seen: Dict[_ObjKey, set] = {}  # tpulint: guarded-by=_mu
 
     # -- public emit helpers -------------------------------------------------
 
@@ -253,6 +253,7 @@ class EventRecorder:
             return False
 
     def _evict_stale_objects_locked(self) -> None:
+        # tpulint: holds=_mu
         """Drop correlator state for the least-recently-touched half of
         tracked objects once the cap is hit — short-lived pods/claims must
         not grow a long-lived recorder's memory forever (caller holds

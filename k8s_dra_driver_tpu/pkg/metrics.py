@@ -191,7 +191,7 @@ class Histogram(_Metric):
 
 class Registry:
     def __init__(self) -> None:
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # tpulint: guarded-by=_mu
         self._mu = threading.Lock()
 
     def register(self, metric: _Metric) -> _Metric:

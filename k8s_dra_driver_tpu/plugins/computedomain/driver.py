@@ -133,7 +133,7 @@ class ComputeDomainDriver:
         return self._store.get()
 
     def _save_checkpoint(self, cp: Checkpoint) -> None:
-        self._store.save(cp)
+        self._store.save(cp)  # tpulint: disable=lock-order -- one locked atomic write; test-seeding helper, never paired with _get_checkpoint on a live path
 
     # -- publishing ----------------------------------------------------------
 
@@ -255,28 +255,48 @@ class ComputeDomainDriver:
 
     def handle_error(self, claim_uid: str) -> None:
         """Abort a claim (kubeletplugin HandleError analog): mark the
-        tombstone so future Prepares reject it until the TTL expires."""
-        with self._mutex:
-            cp = self._get_checkpoint()
-            entry = cp.claims.get(claim_uid)
-            if entry is None:
-                entry = cp.claims[claim_uid] = PreparedClaim(claim_uid=claim_uid)
-            entry.state = PREPARE_ABORTED
-            entry.aborted_at = time.time()
-            self._save_checkpoint(cp)
-            self.cdi.delete_claim_spec_file(claim_uid)
+        tombstone so future Prepares reject it until the TTL expires.
+
+        One pu-flock + checkpoint-session hold end to end: the old
+        get→mutate→save pair released the cp flock between load and
+        write, so a concurrent batch in another plugin process could
+        slip a checkpoint in between and have it overwritten wholesale.
+
+        Lock order matches the prepare path: pu flock OUTSIDE the
+        in-process mutex (prepare takes the flock in the gRPC wrapper,
+        then _mutex inside _prepare_batch) — taking _mutex first here
+        would deadlock-by-timeout against a concurrent prepare.
+        """
+        with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+            with self._mutex:
+                with self._store.session() as sess:
+                    cp = sess.checkpoint
+                    entry = cp.claims.get(claim_uid)
+                    if entry is None:
+                        entry = cp.claims[claim_uid] = PreparedClaim(
+                            claim_uid=claim_uid)
+                    entry.state = PREPARE_ABORTED
+                    entry.aborted_at = time.time()
+                    sess.save()
+                    self.cdi.delete_claim_spec_file(claim_uid)
 
     def expire_aborted(self) -> int:
         """Drop expired PrepareAborted tombstones (cleanup loop tier,
-        reference cleanup.go:35-37). Returns count removed."""
-        with self._mutex:
-            cp = self._get_checkpoint()
-            doomed = [u for u, e in cp.claims.items() if e.aborted_expired()]
-            for u in doomed:
-                del cp.claims[u]
-            if doomed:
-                self._save_checkpoint(cp)
-            return len(doomed)
+        reference cleanup.go:35-37). Returns count removed. Same
+        single-session read-modify-write — and same pu-flock-then-mutex
+        lock order — as handle_error; this runs on the tombstone-cleanup
+        thread concurrently with gRPC prepares."""
+        with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S):
+            with self._mutex:
+                with self._store.session() as sess:
+                    cp = sess.checkpoint
+                    doomed = [u for u, e in cp.claims.items()
+                              if e.aborted_expired()]
+                    for u in doomed:
+                        del cp.claims[u]
+                    if doomed:
+                        sess.save()
+                    return len(doomed)
 
     # -- prepare internals ----------------------------------------------------
 
@@ -290,6 +310,7 @@ class ComputeDomainDriver:
         raise PermanentError(f"claim {claim.key} has no {self.driver_name} config")
 
     def _prepare_batch(self, claims: List[ResourceClaim]) -> Dict[str, object]:
+        # tpulint: holds=pu-flock (prepare_resource_claims takes it)
         """The batched state machine: one checkpoint session, two fsync'd
         writes (all PrepareStarted, then all PrepareCompleted), per-claim
         gate chains run sequentially (they mutate node labels and read the
@@ -522,6 +543,7 @@ class ComputeDomainDriver:
     def _unprepare_batch(
         self, claim_uids: List[str]
     ) -> Dict[str, Optional[Exception]]:
+        # tpulint: holds=pu-flock (unprepare_resource_claims takes it)
         """Batched unprepare: one checkpoint session, at most one fsync'd
         write for the whole batch; node-label cleanup runs once per domain
         against the batch's final state."""

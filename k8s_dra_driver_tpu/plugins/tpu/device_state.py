@@ -325,7 +325,7 @@ class DeviceState:
         return self._store.get()
 
     def _save_checkpoint(self, cp: Checkpoint) -> None:
-        self._store.save(cp)
+        self._store.save(cp)  # tpulint: disable=lock-order -- one locked atomic write; test-seeding helper, never paired with _get_checkpoint on a live path
 
     # -- public state machine ----------------------------------------------
 
@@ -343,6 +343,7 @@ class DeviceState:
     def prepare_batch(
         self, claims: Sequence[ResourceClaim]
     ) -> Dict[str, "PrepareResult | Exception"]:
+        # tpulint: holds=pu-flock (the plugin driver takes it per batch)
         """Prepare a whole NodePrepareResources batch under one checkpoint
         session: two fsync'd writes total (all PrepareStarted, then all
         PrepareCompleted), CDI specs materialized concurrently in between.
@@ -504,6 +505,7 @@ class DeviceState:
     def unprepare_batch(
         self, claim_uids: Sequence[str]
     ) -> Dict[str, Optional[Exception]]:
+        # tpulint: holds=pu-flock (the plugin driver takes it per batch)
         """Unprepare a batch under one checkpoint session: one flock, one
         load, at most one fsync'd write for the whole batch."""
         out: Dict[str, Optional[Exception]] = {}

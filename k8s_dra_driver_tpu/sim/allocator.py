@@ -39,8 +39,11 @@ class AllocationError(Exception):
     pass
 
 
-# attr=value with exactly one bare '=' — no CEL comparison operators.
-_LEGACY_SELECTOR = re.compile(r"([^=!<>]+)=([^=]*)")
+# A CEL comparison operator leaking into a string selector: '==', '!=',
+# '<=', '>=' all put one of []!<>=] immediately before the first '=' (or
+# an '=' right after it). The KEY side decides — values may contain
+# anything, including more '='.
+_CEL_OPERATOR_KEY = re.compile(r"[!<>=]$")
 
 
 class _MatchPlan:
@@ -61,17 +64,23 @@ class _MatchPlan:
         self.match_attrs = dict(match_attrs)
         self.legacy_pairs: List[Tuple[str, str]] = []
         for sel in legacy_selectors:
-            # Legacy sim-only attr=value strings: a bare key, one '=', a
-            # bare value. A CEL expression that arrives here as a plain
-            # string must fail loudly (its '==' / '!=' / '>=' / '<='
-            # doesn't fit the shape), not silently look up a garbage
-            # attribute key and match zero devices.
-            m = _LEGACY_SELECTOR.fullmatch(sel)
-            if not m:
+            # Legacy sim-only attr=value strings, split on the FIRST '='
+            # (the pre-PR-1 partition("=") behavior): the key is bare, the
+            # value may itself contain '=' ("key=a=b" -> value "a=b",
+            # e.g. base64ish or flag-shaped attribute values). A CEL
+            # expression that arrives here as a plain string must still
+            # fail loudly — its '==' / '!=' / '>=' / '<=' leaves an
+            # operator character on the key side or an '=' leading the
+            # value — not silently look up a garbage attribute key and
+            # match zero devices.
+            key, sep, value = sel.partition("=")
+            if (not sep or not key.strip()
+                    or _CEL_OPERATOR_KEY.search(key.strip())
+                    or value.startswith("=")):
                 raise AllocationError(
                     f"malformed legacy selector {sel!r} (want attr=value; CEL "
                     f"selectors use the manifest form {{cel: {{expression}}}})")
-            self.legacy_pairs.append((m.group(1).strip(), m.group(2).strip()))
+            self.legacy_pairs.append((key.strip(), value.strip()))
         self.cel_fns = []
         self._cel_error: type = Exception
         if cel_selectors:

@@ -46,6 +46,7 @@ from k8s_dra_driver_tpu.controller.templates import (
 )
 from k8s_dra_driver_tpu.daemon import SliceAgent
 from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError, WatchEvent
+from k8s_dra_driver_tpu.k8s.store import _match_labels as _store_match_labels
 from k8s_dra_driver_tpu.k8s.informer import INFORMER_WATCH_QUEUE_MAXSIZE
 from k8s_dra_driver_tpu.k8s.conditions import (
     CONDITION_FALSE,
@@ -429,7 +430,7 @@ class SimCluster:
             fp = getattr(self.api, "kind_fingerprint", None)
             cur_pod_fp = fp(POD) if fp else None
             if cur_pod_fp is None or cur_pod_fp != pod_fp:
-                pods = self.api.list(POD)
+                pods = self.api.list(POD)  # tpulint: disable=store-scan -- fingerprint-gated: re-lists only when the Pod kind actually changed, O(1) per step at quiescence
                 pod_fp = cur_pod_fp
             if all(p.phase in ("Running", "Failed") for p in pods):
                 return
@@ -465,10 +466,23 @@ class SimCluster:
         if not self._ds_dirty:
             return
         self._ds_dirty = False
-        for ds in self.api.list(DAEMON_SET):
-            matching = self.api.list(NODE, label_selector=ds.node_selector)
+        # Hoisted scans for the whole pass: nodes once (the old per-DS
+        # label_selector list walked the full Node bucket anyway), pods
+        # once per DISTINCT DS namespace through the PR 3 (kind, ns)
+        # index — not cluster-wide, which would regress sims where
+        # workload pods dwarf the DS namespaces (store-scan hygiene
+        # without losing the index).
+        all_nodes = self.api.list(NODE)
+        daemonsets = self.api.list(DAEMON_SET)
+        pods_by_ns: Dict[str, List[Pod]] = {
+            ns: self.api.list(POD, namespace=ns)
+            for ns in {ds.namespace for ds in daemonsets}
+        }
+        for ds in daemonsets:
+            matching = [n for n in all_nodes
+                        if _store_match_labels(n, ds.node_selector)]
             want = {n.name for n in matching}
-            ns_pods = self.api.list(POD, namespace=ds.namespace)
+            ns_pods = pods_by_ns.get(ds.namespace, [])
             have = {p.node_name: p for p in ns_pods if p.owned_by(ds)}
             for node_name in want - have.keys():
                 pod = Pod(

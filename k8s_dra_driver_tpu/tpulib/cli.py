@@ -141,8 +141,9 @@ def cmd_partitions(args: argparse.Namespace) -> int:
             for prof in inv.subslice_profiles:
                 for pl in prof.placements:
                     placements[pl.name_suffix] = pl
-        except Exception:  # noqa: BLE001 — enumeration is best-effort here
-            pass
+        except Exception as e:  # noqa: BLE001 — enumeration is best-effort here
+            print(f"warning: placement enumeration unavailable: {e}",
+                  file=sys.stderr)
         parts = []
         for pid in filter(None, (ln.strip() for ln in raw.splitlines())):
             pl = placements.get(pid)

@@ -1,0 +1,21 @@
+"""tpulint fixture: cas-purity must stay quiet — the PR 3 pattern:
+effectful values computed once outside, captured as defaults."""
+
+import os.path
+
+
+def sync(api, pods):
+    ready = sum(1 for p in pods if p.ready)
+    name = os.path.join("a", "b")  # os.path.* is pure
+
+    def mutate(obj, ready=ready, name=name):
+        obj.ready = ready
+        obj.name = name
+
+    api.update_with_retry("DaemonSet", "d", "ns", mutate)
+
+
+def effects_outside(api, counter, recorder, pod):
+    api.update_with_retry("Pod", "p", "ns", lambda obj: None)
+    counter.inc("after")             # outside the closure: fine
+    recorder.normal(pod, "X", "ok")  # outside the closure: fine

@@ -1,0 +1,20 @@
+"""tpulint fixture: cas-purity MUST fire — every class of impurity."""
+
+import time
+
+
+def sync(api, recorder, counter, reason):
+    def mutate(obj):
+        time.sleep(0.1)                      # re-runs stretch the retry loop
+        counter.inc("x")                     # inflates on every conflict
+        recorder.normal(obj, reason, "msg")  # double-emits
+        api.create(obj)                      # nested write
+        with open("/tmp/x") as f:            # I/O
+            obj.data = f.read()
+
+    api.update_with_retry("Pod", "p", "ns", mutate)
+
+
+def sync_lambda(api):
+    api.update_with_retry("Pod", "p", "ns",
+                          mutate=lambda obj: time.sleep(1))

@@ -1,0 +1,10 @@
+"""tpulint fixture: metrics-docs + event-reasons MUST fire — an
+undocumented metric, an undocumented reason, a non-CamelCase reason."""
+
+REASON_FIXTURE_UNDOCUMENTED = "FixtureReasonNobodyDocumented"
+REASON_FIXTURE_MALFORMED = "fixture_snake_reason"
+
+
+def setup(registry, Counter):
+    return registry.register(Counter(
+        "tpu_dra_fixture_undocumented_total", "not in metrics.md"))

@@ -1,0 +1,10 @@
+"""tpulint fixture: event-discipline must stay quiet — catalog
+constants through the recorder, non-Event store writes untouched."""
+
+REASON_FIXTURE_OK = "FixtureHappened"
+
+
+def emit(api, recorder, pod, claim):
+    recorder.normal(pod, REASON_FIXTURE_OK, "via the catalog")
+    recorder.warning(pod, REASON_FIXTURE_OK, f"free-form {pod} detail")
+    api.create(claim)  # not an Event

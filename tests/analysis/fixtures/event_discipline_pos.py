@@ -1,0 +1,10 @@
+"""tpulint fixture: event-discipline MUST fire — raw Event writes,
+inline reason literals, non-CamelCase constants."""
+
+REASON_BAD = "not-camel-case"
+
+
+def emit(api, recorder, pod, Event, EVENT):
+    api.create(Event(involved=pod))                      # raw store write
+    api.update_with_retry(EVENT, "n", "ns", lambda o: None)  # raw mutate
+    recorder.warning(pod, "FailedThing", "inline literal reason")

@@ -1,0 +1,21 @@
+"""tpulint fixture: lock-order must stay quiet — flock nesting via
+`with`, sessions under the pu flock (lexically or by annotation),
+saves through the session handle."""
+
+
+class Driver:
+    def prepare_nested(self):
+        with self._pu_lock.hold(timeout=10):
+            with self._store.session() as sess:
+                sess.checkpoint.claims.clear()
+                sess.save()
+
+    def prepare_delegated(self):
+        # tpulint: holds=pu-flock
+        with self._store.session() as sess:
+            sess.save()
+
+    def sweep(self):
+        with Flock("/var/run/pu.lock").hold(timeout=10):
+            with self._store.session() as sess:
+                sess.save()

@@ -1,0 +1,18 @@
+"""tpulint fixture: lock-order MUST fire — all three sub-rules."""
+
+
+class Driver:
+    def prepare_unguarded(self):
+        with self._store.session() as sess:  # no pu flock anywhere
+            sess.checkpoint.claims.clear()
+            sess.save()
+
+    def save_outside_session(self, cp):
+        self._checkpoints.save(cp)
+
+    def manual_lock(self):
+        self._pu_lock.acquire()
+        try:
+            pass
+        finally:
+            self._pu_lock.release()

@@ -1,0 +1,18 @@
+"""tpulint fixture: metric-discipline must stay quiet — registered
+constructions, closed-vocabulary labels."""
+
+
+def setup(registry, Counter, kind):
+    ok = registry.register(Counter("tpu_dra_fixture_quiet_total", "help",
+                                   ("kind",)))
+    ok.inc(kind)          # label from a variable: assumed bounded
+    ok.inc("Pod")         # literal label
+    msg = f"prepared {kind}"  # f-strings outside metric calls are fine
+    return msg
+
+
+def non_metric_setters(status, env, n):
+    # inc/set/observe on NON-metric receivers take f-strings freely —
+    # the rule is about label cardinality, not setters in general
+    status.set(f"{n} nodes ready")
+    env.observe(f"sample-{n}")

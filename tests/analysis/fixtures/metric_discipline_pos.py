@@ -1,0 +1,12 @@
+"""tpulint fixture: metric-discipline MUST fire — orphan construction
+and f-string label values."""
+
+
+def setup(registry, Counter, Histogram, claim_uid):
+    orphan = Counter("tpu_dra_fixture_orphan_total",
+                     "constructed, never registered")
+    ok = registry.register(Counter("tpu_dra_fixture_ok_total", "help"))
+    ok.inc(f"claim-{claim_uid}")             # unbounded label
+    hist = registry.register(Histogram("tpu_dra_fixture_seconds", "help"))
+    hist.observe(0.5, f"node-{claim_uid}")   # unbounded label
+    return orphan

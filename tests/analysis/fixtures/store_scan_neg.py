@@ -1,0 +1,11 @@
+"""tpulint fixture: store-scan must stay quiet — scans as loop
+iterables, hoisted scans, informer cache reads."""
+
+
+class Scheduler:
+    def pass_(self):
+        claims = self.api.list("ResourceClaim")  # hoisted: one scan
+        for pod in self.api.list("Pod"):         # the loop's own iterable
+            self.bind(pod, claims)
+            for cd in self._cd_informer.list():  # cache, not a store scan
+                self.touch(cd)

@@ -1,0 +1,18 @@
+"""tpulint fixture: store-scan MUST fire — list() in loop bodies."""
+
+
+class Scheduler:
+    def pass_(self):
+        for pod in self.api.list("Pod"):
+            claims = self.api.list("ResourceClaim")  # O(kind) per pod
+            self.bind(pod, claims)
+
+    def drain(self):
+        while self.dirty:
+            slices = self.store.list("ResourceSlice")  # per iteration
+            self.consume(slices)
+
+    def drain_until_empty(self):
+        # a while TEST re-evaluates every iteration — also a scan per item
+        while self.api.list("Pod"):
+            self.pop_one()

@@ -1,0 +1,8 @@
+"""tpulint fixture: a reasoned suppression silences the finding."""
+
+
+class Scheduler:
+    def pass_(self):
+        for pod in self.api.list("Pod"):
+            claims = self.api.list("ResourceClaim")  # tpulint: disable=store-scan -- fixture: proving reasoned suppressions work
+            self.bind(pod, claims)

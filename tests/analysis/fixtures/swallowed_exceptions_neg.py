@@ -1,0 +1,19 @@
+"""tpulint fixture: swallowed-exceptions must stay quiet — narrow
+typed absorbs and logged broad catches."""
+
+
+def drain(log, work, NotFoundError):
+    try:
+        work()
+    except NotFoundError:
+        pass  # narrow typed: the idiomatic delete-race absorber
+
+    try:
+        work()
+    except (KeyError, ValueError):
+        pass
+
+    try:
+        work()
+    except Exception as e:  # broad but accounted for
+        log.debug("drain failed: %s", e)

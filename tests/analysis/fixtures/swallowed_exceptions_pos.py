@@ -1,0 +1,19 @@
+"""tpulint fixture: swallowed-exceptions MUST fire — bare except and
+pass-only broad excepts."""
+
+
+def drain(q, work):
+    try:
+        work()
+    except Exception:
+        pass
+
+    try:
+        work()
+    except BaseException:
+        ...
+
+    try:
+        work()
+    except:  # noqa: E722
+        q.put("handled-but-bare")

@@ -1,0 +1,21 @@
+"""tpulint fixture: thread-shared-state must stay quiet — mutations
+under the lock, __init__ exempt, holds-annotated helpers, reads free."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}     # tpulint: guarded-by=_mu
+
+    def put(self, k, v):
+        with self._mu:
+            self._items[k] = v
+
+    def _evict_locked(self):
+        # tpulint: holds=_mu
+        self._items.clear()
+
+    def snapshot(self):
+        return dict(self._items)  # read: not this rule's business

@@ -1,0 +1,20 @@
+"""tpulint fixture: thread-shared-state MUST fire — guarded attrs
+mutated without the lock."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}     # tpulint: guarded-by=_mu
+        self._count = 0      # tpulint: guarded-by=_mu
+
+    def put(self, k, v):
+        self._items[k] = v          # subscript assign, no lock
+
+    def bump(self):
+        self._count += 1            # aug-assign, no lock
+
+    def merge(self, other):
+        self._items.update(other)   # container mutator, no lock

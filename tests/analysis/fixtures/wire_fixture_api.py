@@ -1,0 +1,14 @@
+"""tpulint fixture: dataclasses for the wire-drift checker tests."""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Widget:
+    kind: str = "Widget"                 # exempt (generic codec)
+    a: str = ""
+    b: int = 0
+    missing_enc: str = ""                # decoder-only: encode drops it
+    missing_dec: str = ""                # encoder-only: decode drops it
+    sim_only: List[str] = field(default_factory=list)  # tpulint: disable=wire-drift -- fixture: deliberately sim-only

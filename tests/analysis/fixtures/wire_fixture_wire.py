@@ -1,0 +1,19 @@
+"""tpulint fixture: codec for the wire-drift checker tests. ``Widget.a``
+and ``b`` round-trip; ``missing_enc``/``missing_dec`` each drift one way."""
+
+
+def _widget_encode(w):
+    return {"a": w.a, "b": w.b, "missingDec": w.missing_dec}
+
+
+def _widget_decode(doc, widget_cls):
+    w = widget_cls(
+        a=doc.get("a", ""),
+        b=doc.get("b", 0),
+        missing_enc=doc.get("missingEnc", ""),
+    )
+    # a Load-context READ of the dropped field must not count as
+    # populating it (the wire-drift checker demands a Store or kwarg)
+    if w.missing_dec:
+        pass
+    return w

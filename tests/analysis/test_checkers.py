@@ -1,0 +1,154 @@
+"""Fixture tests: every tpulint rule fires on its positive fixture and
+stays quiet on its negative one (the ISSUE-6 acceptance pins exactly
+this pair per checker)."""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.analysis.engine import run_analysis
+from k8s_dra_driver_tpu.analysis.checkers.wire_drift import (
+    WireDriftChecker,
+    WireKindSpec,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures")
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+
+def run_rule(rule, fixture, **kw):
+    return run_analysis(
+        paths=[os.path.join(FIXTURES, fixture)],
+        repo_root=REPO,
+        select=[rule],
+        baseline_path=None,
+        **kw,
+    )
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# (rule, positive fixture, minimum findings, negative fixture)
+CASES = [
+    ("cas-purity", "cas_purity_pos.py", 5, "cas_purity_neg.py"),
+    ("lock-order", "lock_order_pos.py", 4, "lock_order_neg.py"),
+    ("store-scan", "store_scan_pos.py", 3, "store_scan_neg.py"),
+    ("metric-discipline", "metric_discipline_pos.py", 3,
+     "metric_discipline_neg.py"),
+    ("event-discipline", "event_discipline_pos.py", 4,
+     "event_discipline_neg.py"),
+    ("swallowed-exceptions", "swallowed_exceptions_pos.py", 3,
+     "swallowed_exceptions_neg.py"),
+    ("thread-shared-state", "thread_shared_state_pos.py", 3,
+     "thread_shared_state_neg.py"),
+    ("metrics-docs", "docs_sync_pos.py", 1, "docs_sync_neg.py"),
+    ("event-reasons", "docs_sync_pos.py", 2, "docs_sync_neg.py"),
+]
+
+
+@pytest.mark.parametrize("rule,pos,min_findings,neg",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_positive_fixture(rule, pos, min_findings, neg):
+    result = run_rule(rule, pos)
+    assert len(result.findings) >= min_findings, (
+        f"{rule} found {rules_of(result)} in {pos}")
+    assert set(rules_of(result)) == {rule}
+    for f in result.findings:
+        assert f.file.endswith(pos)
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("rule,pos,min_findings,neg",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_quiet_on_negative_fixture(rule, pos, min_findings, neg):
+    result = run_rule(rule, neg)
+    assert result.findings == [], (
+        f"{rule} false-positived on {neg}: "
+        f"{[f.render() for f in result.findings]}")
+
+
+def test_cas_purity_names_every_impurity_class():
+    msgs = " | ".join(
+        f.message for f in run_rule("cas-purity", "cas_purity_pos.py").findings
+    )
+    for token in ("time.sleep", "metric mutation", "event emission",
+                  "nested API write", "I/O"):
+        assert token in msgs, f"missing impurity class {token!r}: {msgs}"
+
+
+def test_lock_order_subrules_all_present():
+    msgs = " | ".join(
+        f.message for f in run_rule("lock-order", "lock_order_pos.py").findings
+    )
+    assert "session opened without the pu flock" in msgs
+    assert "saved outside a session" in msgs
+    assert "acquire() called directly" in msgs
+    assert "release() called directly" in msgs
+
+
+# -- wire-drift: injectable spec over the fixture codec ----------------------
+
+_WIDGET_SPEC = WireKindSpec(
+    kind="Widget",
+    dataclasses={"tests/analysis/fixtures/wire_fixture_api.py": ("Widget",)},
+    encoders=("_widget_encode",),
+    decoders=("_widget_decode",),
+)
+
+
+def run_wire(spec=_WIDGET_SPEC):
+    checker = WireDriftChecker(
+        specs=[spec],
+        wire_file="tests/analysis/fixtures/wire_fixture_wire.py",
+    )
+    return run_analysis(
+        paths=[os.path.join(FIXTURES, "wire_fixture_api.py")],
+        repo_root=REPO, checkers=[checker], baseline_path=None,
+    )
+
+
+def test_wire_drift_fires_each_direction_only():
+    result = run_wire()
+    msgs = [f.message for f in result.findings]
+    assert len(msgs) == 2, msgs
+    assert any("missing_enc" in m and "never read" in m for m in msgs)
+    assert any("missing_dec" in m and "never populated" in m for m in msgs)
+    # round-tripped fields, exempt kind, and the reasoned sim-only
+    # suppression all stay quiet
+    for quiet in ("Widget.a", "Widget.b", "Widget.kind", "sim_only"):
+        assert not any(quiet in m for m in msgs)
+
+
+def test_wire_drift_detects_seeded_field_drop(tmp_path):
+    """The acceptance scenario: drop a field from the codec, the rule
+    names it — on the REAL repo codec, proving the default spec watches
+    the real k8swire functions."""
+    import re
+    import shutil
+
+    root = tmp_path / "repo"
+    for rel in ("k8s_dra_driver_tpu/api/computedomain.py",
+                "k8s_dra_driver_tpu/k8s/core.py",
+                "k8s_dra_driver_tpu/k8s/conditions.py",
+                "k8s_dra_driver_tpu/pkg/leaderelection.py",
+                "k8s_dra_driver_tpu/k8s/k8swire.py"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    wire = root / "k8s_dra_driver_tpu/k8s/k8swire.py"
+    src = wire.read_text()
+    # Seed the drift PR 5 nearly shipped: the encoder stops writing
+    # blockOrigin (and with it the only read of p.block_origin).
+    seeded = re.sub(r'\s*"blockOrigin": p\.block_origin,', "", src)
+    assert seeded != src
+    wire.write_text(seeded)
+
+    result = run_analysis(
+        paths=[str(root / "k8s_dra_driver_tpu/api/computedomain.py")],
+        repo_root=str(root), select=["wire-drift"], baseline_path=None,
+    )
+    assert any("block_origin" in f.message and "never read" in f.message
+               for f in result.findings), [f.render() for f in result.findings]

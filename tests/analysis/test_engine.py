@@ -1,0 +1,224 @@
+"""tpulint engine tests: suppression-with-reason enforcement, the
+baseline add/burn-down flow, parallel-run determinism, and the CLI
+contract (including the seeded-violation path `make verify` rides)."""
+
+import json
+import os
+import textwrap
+
+from k8s_dra_driver_tpu.analysis.cli import main
+from k8s_dra_driver_tpu.analysis.engine import run_analysis
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures")
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+VIOLATION = textwrap.dedent(
+    """\
+    class S:
+        def pass_(self):
+            for pod in self.api.list("Pod"):
+                claims = self.api.list("ResourceClaim")
+                self.bind(pod, claims)
+    """
+)
+
+
+def run(paths, **kw):
+    kw.setdefault("repo_root", REPO)
+    kw.setdefault("select", ["store-scan"])
+    kw.setdefault("baseline_path", None)
+    return run_analysis(paths=paths, **kw)
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_reasoned_suppression_silences_the_finding():
+    result = run([os.path.join(FIXTURES, "suppression_with_reason.py")])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_unreasoned_suppression_suppresses_nothing_and_is_a_finding():
+    result = run([os.path.join(FIXTURES, "suppression_without_reason.py")])
+    rules = sorted(f.rule for f in result.findings)
+    assert rules == ["store-scan", "suppression"], (
+        [f.render() for f in result.findings])
+    sup = next(f for f in result.findings if f.rule == "suppression")
+    assert "no reason" in sup.message
+
+
+def test_suppression_only_covers_its_own_line(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        VIOLATION.replace(
+            'claims = self.api.list("ResourceClaim")',
+            'claims = self.api.list("ResourceClaim")  '
+            "# tpulint: disable=store-scan -- test",
+        )
+        + "\n    def other(self):\n"
+        "        for x in self.api.list('Pod'):\n"
+        "            y = self.api.list('Node')\n"
+    )
+    result = run([str(mod)], repo_root=str(tmp_path))
+    # the suppressed line is quiet, the unsuppressed one still fires
+    assert len(result.findings) == 1
+    assert result.findings[0].rule == "store-scan"
+
+
+# -- baseline add / burn-down ------------------------------------------------
+
+
+def test_baseline_flow(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(VIOLATION)
+    baseline = tmp_path / "baseline.json"
+
+    # 1. new violation with no baseline: fails
+    result = run([str(mod)], repo_root=str(tmp_path))
+    assert result.failed and len(result.new_findings) == 1
+
+    # 2. --update-baseline accepts the legacy debt explicitly
+    rc = main([str(mod), "--select", "store-scan", "--repo-root",
+               str(tmp_path), "--baseline", str(baseline),
+               "--update-baseline"])
+    assert rc == 0
+    doc = json.loads(baseline.read_text())
+    assert len(doc["findings"]) == 1 and doc["findings"][0]["rule"] == "store-scan"
+
+    # 3. baselined: same violation no longer fails
+    result = run([str(mod)], repo_root=str(tmp_path),
+                 baseline_path=str(baseline))
+    assert not result.failed and result.new_findings == []
+    assert len(result.findings) == 1  # still reported as baselined debt
+
+    # 4. a SECOND violation of the same shape exceeds the baseline count
+    mod.write_text(VIOLATION + textwrap.dedent(
+        """\
+            def more(self):
+                for x in self.api.list("Pod"):
+                    y = self.api.list("ResourceClaim")
+        """))
+    result = run([str(mod)], repo_root=str(tmp_path),
+                 baseline_path=str(baseline))
+    assert result.failed and len(result.new_findings) == 1
+
+    # 5. burn-down: fix the code; the stale entry is reported, exit clean
+    mod.write_text("x = 1\n")
+    result = run([str(mod)], repo_root=str(tmp_path),
+                 baseline_path=str(baseline))
+    assert not result.failed and result.findings == []
+    assert len(result.stale_baseline) == 1
+    rc = main([str(mod), "--select", "store-scan", "--repo-root",
+               str(tmp_path), "--baseline", str(baseline),
+               "--update-baseline"])
+    assert rc == 0
+    assert json.loads(baseline.read_text())["findings"] == []
+
+
+def test_committed_repo_baseline_is_empty():
+    """The acceptance bar: make tpulint passes with an EMPTY baseline —
+    no legacy debt was grandfathered in."""
+    with open(os.path.join(REPO, "hack", "tpulint_baseline.json")) as f:
+        assert json.load(f)["findings"] == []
+
+
+def test_docs_rules_scanner_broken_guard(tmp_path):
+    """The old standalone scripts exited 2 when they found ZERO
+    metrics/reasons (scanner rot, not a metric-free codebase); the folded
+    rules keep that guard on package-wide runs — and stay quiet about it
+    on partial runs, where an empty inventory is expected."""
+    pkg = tmp_path / "k8s_dra_driver_tpu" / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "metrics.py").write_text("x = 1\n")   # no registrations at all
+    (pkg / "events.py").write_text("y = 2\n")    # no REASON_* at all
+    docs = tmp_path / "docs" / "reference"
+    docs.mkdir(parents=True)
+    (docs / "metrics.md").write_text("# Metrics\n")
+    (docs / "events.md").write_text("# Events\n")
+
+    result = run_analysis(
+        paths=[str(tmp_path / "k8s_dra_driver_tpu")], repo_root=str(tmp_path),
+        select=["metrics-docs", "event-reasons"], baseline_path=None)
+    msgs = [f.message for f in result.findings]
+    assert sum("scanner broken" in m for m in msgs) == 2, msgs
+
+    # a partial run (one unrelated file) must NOT trip the guard
+    other = tmp_path / "other.py"
+    other.write_text("z = 3\n")
+    result = run_analysis(
+        paths=[str(other)], repo_root=str(tmp_path),
+        select=["metrics-docs", "event-reasons"], baseline_path=None)
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_parallel_runs_are_deterministic():
+    """Same findings, same order, regardless of worker count — the
+    fixtures directory guarantees a non-trivial finding set."""
+    kw = dict(paths=[FIXTURES], repo_root=REPO, baseline_path=None)
+    serial = run_analysis(jobs=1, **kw)
+    assert serial.findings, "fixtures produced no findings — broken run?"
+    for jobs in (2, 8):
+        parallel = run_analysis(jobs=jobs, **kw)
+        assert parallel.findings == serial.findings
+    assert serial.findings == sorted(serial.findings,
+                                     key=lambda f: f.sort_key())
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_rule_id_in_output(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text(VIOLATION)
+    rc = main([str(mod), "--repo-root", str(tmp_path), "--baseline", "none",
+               "--select", "store-scan"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[store-scan]" in out and "mod.py:4" in out
+
+    mod.write_text("x = 1\n")
+    rc = main([str(mod), "--repo-root", str(tmp_path), "--baseline", "none",
+               "--select", "store-scan"])
+    assert rc == 0
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    rc = main(["--select", "no-such-rule", "--baseline", "none"])
+    assert rc == 2
+    assert "no-such-rule" in capsys.readouterr().err
+
+
+def test_cli_json_format(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text(VIOLATION)
+    rc = main([str(mod), "--repo-root", str(tmp_path), "--baseline", "none",
+               "--select", "store-scan", "--format", "json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"][0]["rule"] == "store-scan"
+    assert doc["files_analyzed"] == 1
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    mod = tmp_path / "broken.py"
+    mod.write_text("def broken(:\n")
+    result = run([str(mod)], repo_root=str(tmp_path))
+    assert [f.rule for f in result.findings] == ["parse-error"]
+    assert result.failed
+
+
+def test_seeded_violation_fails_the_verify_gate(tmp_path, capsys):
+    """ISSUE-6 acceptance: seeding a known violation (a store.list()
+    inside a scheduler loop) makes the tpulint gate — the first leg of
+    `make verify` — fail with the right rule id, via the engine exactly
+    as `python -m k8s_dra_driver_tpu.analysis <path>` runs it."""
+    seeded = tmp_path / "scheduler.py"
+    seeded.write_text(VIOLATION)
+    rc = main([str(seeded), "--repo-root", str(tmp_path),
+               "--baseline", "none"])
+    assert rc == 1
+    assert "[store-scan]" in capsys.readouterr().out

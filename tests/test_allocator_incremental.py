@@ -187,3 +187,27 @@ def test_match_plan_rejects_malformed_selector_once(api):
         assert got is not None
     finally:
         alloc.end_pass()
+
+
+def test_legacy_selector_value_may_contain_equals():
+    """Round-5 advisor nit: PR 1's regex demanded a bare value, so
+    "key=a=b" (flag-shaped or base64ish attribute values) started
+    raising; pre-PR-1 partition("=") semantics are restored — split on
+    the FIRST '=', value keeps the rest — while CEL operators leaking in
+    as strings still fail loudly."""
+    from k8s_dra_driver_tpu.k8s.core import Device
+    from k8s_dra_driver_tpu.sim.allocator import _device_matches
+
+    d = Device(name="tpu-0", attributes={"flags": "a=b", "type": "tpu",
+                                         "blob": "x==y"})
+    assert _device_matches(d, {}, ["flags=a=b"])
+    assert not _device_matches(d, {}, ["flags=a=c"])
+    assert _device_matches(d, {}, ["type=tpu", "flags=a=b"])
+    # a DOUBLE '=' straight after the key is CEL equality, not a value
+    with pytest.raises(AllocationError, match="malformed legacy selector"):
+        _device_matches(d, {}, ["blob==y"])
+    # CEL comparison shapes still rejected loudly
+    for sel in ('device.driver == "tpu.google.com"', "a!=b", "a<=b", "a>=b",
+                "=leading", "no-equals-sign", "   =x"):
+        with pytest.raises(AllocationError, match="malformed legacy selector"):
+            _device_matches(d, {}, [sel])

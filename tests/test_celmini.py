@@ -26,6 +26,27 @@ def dev(driver="tpu.google.com", **attrs):
 
 # -- evaluator semantics ------------------------------------------------------
 
+def test_bool_vs_int_is_no_such_overload():
+    """Round-5 advisor nit: Python's bool IS an int, so `true == 1` used
+    to match. cel-go type-checks bool vs int as no_such_overload and DRA
+    counts an erroring selector as non-matching — every operator, `!=`
+    included, must be false across the bool/int divide."""
+    d = dev(healthy=True, count=1)
+    # bool attribute vs int literal: non-match both ways
+    assert not evaluate('device.attributes["healthy"] == 1', d)
+    assert not evaluate('device.attributes["healthy"] != 1', d)
+    assert not evaluate('device.attributes["count"] == true', d)
+    assert not evaluate('device.attributes["count"] != true', d)
+    # like-typed comparisons still work
+    assert evaluate('device.attributes["healthy"] == true', d)
+    assert not evaluate('device.attributes["healthy"] == false', d)
+    assert evaluate('device.attributes["count"] == 1', d)
+    # bool vs string stays a type error too (no int("true") coercion)
+    assert not evaluate('device.attributes["healthy"] == "true"', d)
+    # ordering across the divide is equally overload-less
+    assert not evaluate('device.attributes["healthy"] < 2', d)
+
+
 def test_driver_and_attribute_equality():
     d = dev(type="tpu", index=3)
     assert evaluate('device.driver == "tpu.google.com"', d)

@@ -144,3 +144,60 @@ def test_multiprocess_psum_from_injected_env(tmp_path):
     assert {r["global_devices"] for r in results} == {
         sum(r["local_devices"] for r in results)
     }
+    # The proof now self-verifies: every worker derived the same expected
+    # value in-process and stamped ok=true (exit 0 already asserted above).
+    assert {r["expected"] for r in results} == {float(expected)}, results
+    assert all(r["ok"] for r in results), results
+
+
+# -- self-verification: a corrupted reduction must FAIL the job --------------
+
+
+def test_psum_proof_self_verifies_good_result(monkeypatch, capsys):
+    from k8s_dra_driver_tpu.ops import psum_proof
+
+    good = {"process_id": 0, "num_processes": 4, "local_devices": 1,
+            "global_devices": 4, "psum": 10.0, "expected": 10.0,
+            "ok": True, "platform": "cpu"}
+    monkeypatch.setattr(psum_proof, "run_proof", lambda: good)
+    assert psum_proof.main() == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+
+def test_psum_proof_corrupted_reduction_fails_the_job(monkeypatch, capsys):
+    """Round-5 advisor nit: a wrong psum used to print and exit 0 — the
+    harness would read a broken collective as success. Now the mismatch
+    is detected in-process and the job exits nonzero."""
+    from k8s_dra_driver_tpu.ops import psum_proof
+
+    bad = {"process_id": 0, "num_processes": 4, "local_devices": 1,
+           "global_devices": 4, "psum": 7.0, "expected": 10.0,
+           "ok": False, "platform": "cpu"}
+    monkeypatch.setattr(psum_proof, "run_proof", lambda: bad)
+    assert psum_proof.main() == 1
+    captured = capsys.readouterr()
+    assert "psum proof FAILED" in captured.err
+    assert json.loads(captured.out)["ok"] is False
+
+
+def test_psum_proof_expected_derivation_single_process(monkeypatch):
+    """run_proof's expected-value formula on the degenerate 1-process
+    cluster: psum == expected == local_device_count * 1 — exercised
+    in-process (no subprocess fleet) via a single-process initialize."""
+    if "TPU_WORKER_HOSTNAMES" in os.environ:  # pragma: no cover
+        pytest.skip("running inside a driver-assembled slice")
+    import jax
+
+    from k8s_dra_driver_tpu.ops import psum_proof
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "127.0.0.1:8477")
+    # Single-process "distributed" init is a no-op cluster; keep it local.
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: None)
+    result = psum_proof.run_proof()
+    devs = jax.local_device_count()
+    assert result["expected"] == float(devs)
+    assert result["psum"] == result["expected"]
+    assert result["ok"] is True

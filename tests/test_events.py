@@ -220,17 +220,15 @@ def test_check_event_reasons_gate_passes():
 
 def test_check_event_reasons_gate_fails_on_undocumented(tmp_path):
     """The checker actually bites: an emitted reason absent from events.md
-    (or not CamelCase) fails the run."""
+    (or not CamelCase) fails the run. Now served by tpulint's
+    `event-reasons` rule; hack/check_event_reasons.py stays as the shim
+    this test drives against a seeded repo."""
     import os
-    import shutil
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     work = tmp_path / "repo"
-    (work / "hack").mkdir(parents=True)
-    shutil.copy(os.path.join(repo, "hack", "check_event_reasons.py"),
-                work / "hack" / "check_event_reasons.py")
     pkg = work / "k8s_dra_driver_tpu"
-    pkg.mkdir()
+    pkg.mkdir(parents=True)
     (pkg / "thing.py").write_text(
         'REASON_BAD = "not_camel_case"\n'
         'rec.warning(x, reason="Undocumented", message="m")\n')
@@ -238,12 +236,14 @@ def test_check_event_reasons_gate_fails_on_undocumented(tmp_path):
     docs.mkdir(parents=True)
     (docs / "events.md").write_text("# Events\n\nonly `SomethingElse` here\n")
     proc = subprocess.run(
-        [sys.executable, "hack/check_event_reasons.py"],
-        capture_output=True, text=True, cwd=work,
+        [sys.executable, os.path.join(repo, "hack", "check_event_reasons.py"),
+         "--repo-root", str(work), "--baseline", "none"],
+        capture_output=True, text=True, cwd=repo,
     )
-    assert proc.returncode == 1
-    assert "not CamelCase" in proc.stderr
-    assert "Undocumented" in proc.stderr
+    assert proc.returncode == 1, proc.stderr
+    assert "not CamelCase" in proc.stdout
+    assert "Undocumented" in proc.stdout
+    assert "[event-reasons]" in proc.stdout
 
 
 # -- conditions --------------------------------------------------------------

@@ -149,6 +149,32 @@ def test_wire_claim_roundtrip():
     assert back.reserved_for[0].uid == "u1"
 
 
+def test_wire_claim_conditions_roundtrip():
+    """Typed claim conditions survive the real k8s wire (the drift class
+    tpulint's wire-drift rule found: the codec silently dropped them)."""
+    from k8s_dra_driver_tpu.k8s.conditions import Condition
+
+    rc = ResourceClaim(
+        meta=new_meta("c", "ns"),
+        conditions=[
+            Condition(type="Allocated", status="True", reason="Scheduled",
+                      message="on node-0", last_transition_time=1700000000.0),
+            Condition(type="Prepared", status="False"),
+        ],
+    )
+    wire = to_k8s_wire(rc)
+    docs = wire["status"]["conditions"]
+    assert docs[0] == {"type": "Allocated", "status": "True",
+                       "reason": "Scheduled", "message": "on node-0",
+                       "lastTransitionTime": "2023-11-14T22:13:20Z"}
+    assert docs[1] == {"type": "Prepared", "status": "False"}
+    back = _roundtrip(rc)
+    assert back.conditions[0].type == "Allocated"
+    assert back.conditions[0].last_transition_time == 1700000000.0
+    assert back.conditions[1].status == "False"
+    assert back.conditions[1].last_transition_time == 0.0
+
+
 def test_wire_cel_selectors_roundtrip_and_legacy_refused():
     """cel_selectors survive the wire; legacy attr=value selectors have
     NO wire form and must fail encoding loudly — silently dropping them
@@ -262,6 +288,31 @@ def test_wire_computedomain_roundtrip():
     wire = to_k8s_wire(cd)
     assert wire["apiVersion"] == "resource.tpu.google.com/v1beta1"
     assert wire["status"]["nodes"][0]["iciDomain"] == "slice-0"
+
+
+def test_wire_computedomain_conditions_roundtrip():
+    """ComputeDomain status conditions survive the real k8s wire — on a
+    real cluster the controller's Validated/Ready/Degraded history was
+    silently dropped by the codec before tpulint's wire-drift rule."""
+    from k8s_dra_driver_tpu.k8s.conditions import Condition
+
+    cd = ComputeDomain(
+        meta=new_meta("dom", "ns"),
+        spec=ComputeDomainSpec(num_nodes=2),
+        status=ComputeDomainStatus(status="Ready", conditions=[
+            Condition(type="Validated", status="True", reason="SpecValid",
+                      last_transition_time=1700000000.0),
+            Condition(type="Degraded", status="False",
+                      reason="AllDevicesHealthy", message="2/2 nodes clean"),
+        ]),
+    )
+    wire = to_k8s_wire(cd)
+    assert [c["type"] for c in wire["status"]["conditions"]] == [
+        "Validated", "Degraded"]
+    back = _roundtrip(cd)
+    assert back.status.conditions[0].reason == "SpecValid"
+    assert back.status.conditions[0].last_transition_time == 1700000000.0
+    assert back.status.conditions[1].message == "2/2 nodes clean"
 
 
 def test_wire_clique_daemonset_lease_roundtrip():
