@@ -398,6 +398,121 @@ def bench_placement(num_nodes: int = 64, seed: int = 11, max_claims: int = 5000,
     return out
 
 
+def bench_rebalance(num_nodes: int = 16, max_steps: int = 60,
+                    assert_budget: bool = False) -> dict:
+    """Live-repack rebalancer benchmark (the online-defrag subsystem): a
+    fragmentation storm — one single-chip claim pinned to every v5e-4 host,
+    which strands every host's whole-host capacity — run twice on identical
+    state, without and with the energy-mode rebalancer.
+
+    The headline is **largest-free-profile capacity recovery**: the sum
+    over nodes of chips in the largest still-placeable profile (the
+    cluster-wide reading of ``tpu_dra_node_frag_largest_free_profile``).
+    Without the rebalancer the scattered claims strand it forever; with it
+    the claims consolidate (cordon -> checkpoint-aware unprepare ->
+    re-place -> re-prepare) onto the fewest hosts and whole hosts go
+    reclaimable.
+
+    ``assert_budget=True`` (the bench-smoke wiring) hard-fails unless
+    capacity recovery is >= 30% over the no-rebalancer baseline with zero
+    failed migrations and no more migrations than claims."""
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    TPU_DRIVER = "tpu.google.com"
+    rct = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: frag, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+
+    def capacity(sim) -> int:
+        overview = sim.allocator.placement_overview(TPU_DRIVER)
+        return sum(
+            e["tables"].largest_free_chips(e["used_mask"], e["available"])
+            for e in overview.values()
+        )
+
+    def run(rebalance: bool) -> dict:
+        from k8s_dra_driver_tpu.rebalancer import (
+            MODE_ENERGY,
+            RebalancerConfig,
+        )
+
+        cfg = (RebalancerConfig(mode=MODE_ENERGY, max_migrations_per_pass=8,
+                                migration_burst=4 * num_nodes,
+                                migration_refill_per_s=1000.0)
+               if rebalance else None)
+        with tempfile.TemporaryDirectory() as tmp:
+            sim = SimCluster(workdir=tmp, profile="v5e-4",
+                             num_hosts=num_nodes, rebalancer_config=cfg)
+            sim.start()
+            try:
+                for obj in load_manifests(rct):
+                    sim.api.create(obj)
+                for w in range(num_nodes):
+                    pod_yaml = f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: frag-{w}, namespace: default}}
+spec:
+  nodeName: tpu-node-{w}
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: frag}}]
+"""
+                    for obj in load_manifests(pod_yaml):
+                        sim.api.create(obj)
+                t0 = time.perf_counter()
+                sim.settle(max_steps=max_steps)
+                # Convergence: settle returns when pods are Running, but
+                # the repack keeps cycling pods through Pending — step
+                # until a pass moves nothing and everything runs again.
+                for _ in range(max_steps):
+                    moved = (sim.rebalancer.step()
+                             if sim.rebalancer is not None else 0)
+                    pods = sim.api.list(POD)
+                    if moved == 0 and all(p.phase == "Running" for p in pods):
+                        break
+                    sim.settle(max_steps=10)
+                wall = time.perf_counter() - t0
+                out = {"capacity": capacity(sim), "wall_s": wall}
+                if sim.rebalancer is not None:
+                    m = sim.rebalancer.metrics
+                    out["migrated"] = m.migrations_total.value("migrated")
+                    out["failed"] = m.migrations_total.value("failed")
+                    out["reclaimable"] = m.reclaimable_hosts.value()
+                return out
+            finally:
+                sim.stop()
+
+    base = run(rebalance=False)
+    packed = run(rebalance=True)
+    c0, c1 = base["capacity"], packed["capacity"]
+    out = {
+        "rebalance_nodes": num_nodes,
+        "rebalance_baseline_capacity_chips": c0,
+        "rebalance_repacked_capacity_chips": c1,
+        "rebalance_recovery_pct": round(100.0 * (c1 - c0) / max(1, c0), 1),
+        "rebalance_migrations": packed.get("migrated", 0.0),
+        "rebalance_failed_migrations": packed.get("failed", 0.0),
+        "rebalance_reclaimable_hosts": packed.get("reclaimable", 0.0),
+        "rebalance_wall_s": round(packed["wall_s"], 3),
+    }
+    if assert_budget:
+        # The repack must recover >= 30% of largest-free-profile capacity
+        # over the no-rebalancer baseline, with zero failed/rolled-back
+        # migrations and no more moves than there are claims.
+        assert out["rebalance_recovery_pct"] >= 30.0, out
+        assert out["rebalance_failed_migrations"] == 0, out
+        assert out["rebalance_migrations"] <= num_nodes, out
+    return out
+
+
 # Public peak dense-bf16 FLOP/s per chip (cloud.google.com/tpu/docs spec
 # pages); device_kind strings as libtpu reports them.
 PEAK_BF16_FLOPS = {
@@ -820,6 +935,10 @@ def main() -> None:
         # claims than the first-fit baseline at 64 nodes, within the
         # probes-per-bind budget — a placement-engine regression fails CI.
         result.update(bench_placement(num_nodes=64, assert_budget=True))
+        # Live-repack gate: the rebalancer must recover >=30% of
+        # largest-free-profile capacity on a fragmented 16-node cluster
+        # with zero failed migrations.
+        result.update(bench_rebalance(num_nodes=16, assert_budget=True))
         print(json.dumps(result))
         return
     result = bench_prepare_latency()
@@ -841,6 +960,12 @@ def main() -> None:
         result.update(bench_placement())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["placement_error"] = str(e)[:200]
+    try:
+        # Live repack: largest-free-profile capacity recovery on a
+        # fragmented cluster, with vs without the rebalancer.
+        result.update(bench_rebalance())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["rebalance_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

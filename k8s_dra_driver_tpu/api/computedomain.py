@@ -24,6 +24,13 @@ COMPUTE_DOMAIN_FINALIZER = "resource.tpu.google.com/computedomain"
 # /root/reference/cmd/compute-domain-kubelet-plugin/computedomain.go:372-400).
 COMPUTE_DOMAIN_NODE_LABEL = "resource.tpu.google.com/computeDomain"
 
+# Per-domain override for the MEGASCALE coordinator port the channel env
+# advertises. Normally absent (the fixed well-known port is correct inside
+# pod network namespaces); the controller sets it at DaemonSet render time
+# when configured for dynamic allocation — loopback/sim deployments where
+# every "pod" shares the host's port space and the fixed port may be taken.
+COORDINATOR_PORT_ANNOTATION = "resource.tpu.google.com/coordinator-port"
+
 
 CD_STATUS_READY = "Ready"
 CD_STATUS_NOT_READY = "NotReady"

@@ -255,6 +255,20 @@ class ComputeDomainChannelConfig(DeviceConfig):
             )
 
 
+def channel_domain_uid(claim) -> str:
+    """The ComputeDomain uid a claim's channel config references, or ""
+    for claims carrying no channel. THE rule identifying a pod as a
+    domain worker — shared by the sim scheduler's host-grid steering and
+    the rebalancer's demand detection so they can never drift."""
+    for cc in claim.config:
+        if (cc.opaque is not None
+                and cc.opaque.driver == COMPUTE_DOMAIN_DRIVER_NAME
+                and cc.opaque.parameters.get("kind")
+                == "ComputeDomainChannelConfig"):
+            return cc.opaque.parameters.get("domain_id", "")
+    return ""
+
+
 @dataclass
 class ComputeDomainDaemonConfig(DeviceConfig):
     domain_id: str = ""

@@ -23,6 +23,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
     CD_STATUS_REJECTED,
     COMPUTE_DOMAIN_FINALIZER,
     COMPUTE_DOMAIN_NODE_LABEL,
+    COORDINATOR_PORT_ANNOTATION,
     ComputeDomain,
     ComputeDomainNode,
     ComputeDomainStatus,
@@ -90,9 +91,15 @@ class Controller:
         max_nodes_per_domain: int = DEFAULT_MAX_NODES_PER_DOMAIN,
         slice_config: Optional[SliceAgentConfig] = None,
         additional_namespaces: Sequence[str] = (),
+        dynamic_coordinator_port: bool = False,
     ):
         self.api = api
         self.driver_namespace = driver_namespace
+        # Loopback/sim deployments share the host's port space, so the
+        # coordinator port each domain advertises is allocated free at
+        # DaemonSet render time instead of the fixed well-known 8476 (which
+        # any unrelated process may hold — the old collective-proof flake).
+        self.dynamic_coordinator_port = dynamic_coordinator_port
         # Per-CD DaemonSets are managed across the driver namespace PLUS
         # these (the reference's MultiNamespaceDaemonSetManager,
         # mnsdaemonset.go:29-119): a DS already living in any managed
@@ -351,7 +358,32 @@ class Controller:
                 obj.meta.finalizers.append(COMPUTE_DOMAIN_FINALIZER)
         self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
 
+    def _ensure_coordinator_port(self, cd: ComputeDomain) -> None:
+        """Dynamic coordinator-port allocation at DaemonSet render: bind an
+        ephemeral port to find a free one, record it on the CD so the
+        channel bootstrap env advertises a port actually bindable on this
+        host. First allocation wins (setdefault under CAS) — every worker
+        of the domain must agree."""
+        if (not self.dynamic_coordinator_port
+                or COORDINATOR_PORT_ANNOTATION in cd.meta.annotations):
+            return
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        def mutate(obj, port=port):
+            obj.meta.annotations.setdefault(
+                COORDINATOR_PORT_ANNOTATION, str(port))
+        try:
+            self.api.update_with_retry(
+                COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
+        except NotFoundError:
+            pass
+
     def _ensure_owned_objects(self, cd: ComputeDomain) -> None:
+        self._ensure_coordinator_port(cd)
         cd = self.api.get(COMPUTE_DOMAIN, cd.name, cd.namespace)  # fresh uid/rv
         rct_daemon = daemon_resource_claim_template(cd, self.driver_namespace)
         rct_workload = workload_resource_claim_template(cd)

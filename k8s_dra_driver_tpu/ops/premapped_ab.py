@@ -46,15 +46,30 @@ print(json.dumps({{"transfer_s": best,
 """
 
 
+class ChildFailed(RuntimeError):
+    """A probe child died; carries the stderr tail for diagnosis."""
+
+    def __init__(self, returncode: int, stderr_tail: str):
+        super().__init__(
+            f"probe child exited {returncode}; stderr tail:\n{stderr_tail}")
+        self.returncode = returncode
+        self.stderr_tail = stderr_tail
+
+
 def _run_child(size_mib: int, premapped: int | None) -> dict:
     env = dict(os.environ)
     env.pop("TPU_PREMAPPED_BUFFER_SIZE", None)
     if premapped is not None:
         env["TPU_PREMAPPED_BUFFER_SIZE"] = str(premapped)
+    # check=False + explicit stderr surfacing: a libtpu init failure in the
+    # child must reach the operator as its actual error text, not die as a
+    # bare CalledProcessError with the diagnostic swallowed in .stderr.
     out = subprocess.run(
         [sys.executable, "-c", _CHILD.format(mib=size_mib)],
-        env=env, capture_output=True, text=True, timeout=300, check=True,
+        env=env, capture_output=True, text=True, timeout=300, check=False,
     )
+    if out.returncode != 0:
+        raise ChildFailed(out.returncode, out.stderr.strip()[-2000:])
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
@@ -63,8 +78,18 @@ def main(argv=None) -> int:
     ap.add_argument("--size-mib", type=int, default=256)
     ap.add_argument("--small-bytes", type=int, default=8 << 20)
     args = ap.parse_args(argv)
-    a = _run_child(args.size_mib, args.small_bytes)
-    b = _run_child(args.size_mib, None)
+    try:
+        a = _run_child(args.size_mib, args.small_bytes)
+        b = _run_child(args.size_mib, None)
+    except ChildFailed as e:
+        # Surface the child's stderr tail in the JSON error field so a
+        # libtpu init failure is diagnosable from the probe output alone.
+        print(json.dumps({
+            "binds": None,
+            "error": f"probe child exited {e.returncode}",
+            "child_stderr_tail": e.stderr_tail,
+        }))
+        return 2
     small, large = a["transfer_s"], b["transfer_s"]
     platform = a.get("platform", "?")
     ratio = small / large if large > 0 else float("inf")

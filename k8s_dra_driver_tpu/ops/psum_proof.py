@@ -45,6 +45,18 @@ def run_proof(timeout_s: float = 60.0) -> dict:
 
     import jax
 
+    # CPU-backend harness runs announce a collectives implementation via
+    # JAX_CPU_COLLECTIVES_IMPLEMENTATION; jax versions around 0.4.3x ship
+    # the gloo backend but ignore the env var (the flag is config-only),
+    # so apply it explicitly before the first backend use. Real TPU slices
+    # never set the variable.
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "")
+    if impl and impl != "none":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except (AttributeError, ValueError):
+            pass  # older/newer jax: flag absent or env-var honored natively
+
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -66,6 +66,10 @@ REASON_CHECKPOINT_RECOVERED = "CheckpointRecovered"
 # Device health
 REASON_DEVICE_DEGRADED = "DeviceDegraded"
 REASON_DEVICE_RECOVERED = "DeviceRecovered"
+# Live-repack rebalancer
+REASON_REBALANCE_PLANNED = "RebalancePlanned"
+REASON_CLAIM_MIGRATED = "ClaimMigrated"
+REASON_MIGRATION_FAILED = "MigrationFailed"
 # ComputeDomain controller / daemon
 REASON_NODE_JOINED = "NodeJoined"
 REASON_CLIQUE_ASSEMBLED = "CliqueAssembled"

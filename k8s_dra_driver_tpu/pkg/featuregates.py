@@ -96,6 +96,13 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         "driver-managed DaemonSet.",
         requires=("ComputeDomainCliques",),
     ),
+    FeatureSpec(
+        "LiveRepack", False, Stage.ALPHA,
+        "Run the online defragmentation rebalancer: migrate small-subslice "
+        "claims (cordon -> checkpoint-aware unprepare -> re-place -> "
+        "re-prepare) to restore large-profile placeability, or consolidate "
+        "onto fewer hosts in energy mode.",
+    ),
 )
 
 _SPECS: Dict[str, FeatureSpec] = {f.name: f for f in FEATURES}

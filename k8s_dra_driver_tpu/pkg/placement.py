@@ -242,29 +242,24 @@ class HostBlock:
             self.shape[0])
 
 
-def choose_host_block(
+def iter_host_blocks(
     topologies: Dict[str, dict],
-    free_nodes: Sequence[str],
+    candidate_nodes: Sequence[str],
     num_nodes: int,
-) -> Optional[HostBlock]:
-    """Pick a contiguous host-grid block of ``num_nodes`` free hosts.
-
-    ``topologies``: node -> {"ici_domain", "slice_topology",
-    "host_topology", "host_coord" (tuple)} — the ResourceSlice attribute
-    surface. ``free_nodes``: nodes the feasibility filter admitted for the
-    domain's whole-host claim, in preference order.
-
-    Deterministic choice: ICI domains in the order their first free node
-    appears in ``free_nodes`` preference order (name order on ties), block
-    shapes most-compact-first, origins ascending. Returns None when no
-    domain holds a fully-free block of the requested size (the scheduler
-    then degrades to unaligned placement rather than deadlocking)."""
-    free = [n for n in free_nodes if n in topologies]
-    if num_nodes <= 0 or len(free) < num_nodes:
-        return None
+):
+    """Yield every contiguous host-grid block of ``num_nodes`` candidate
+    hosts, in the deterministic preference order ``choose_host_block``
+    documents: ICI domains in the order their first candidate appears in
+    ``candidate_nodes``, block shapes most-compact-first, origins
+    ascending. The live-repack planner consumes the full enumeration to
+    rank blocks by how many claims must migrate to vacate them; the
+    scheduler takes the first fully-free one."""
+    cands = [n for n in candidate_nodes if n in topologies]
+    if num_nodes <= 0 or len(cands) < num_nodes:
+        return
     domains: Dict[str, Dict[Tuple[int, ...], str]] = {}
     domain_order: List[str] = []
-    for node in free:
+    for node in cands:
         info = topologies[node]
         dom = info.get("ici_domain", "")
         coord = info.get("host_coord")
@@ -285,7 +280,7 @@ def choose_host_block(
                                   info["host_topology"])
         except (KeyError, ValueError, TypeError):
             # Missing/None topology strings must degrade to "no block in
-            # this domain", never abort the scheduler pass.
+            # this domain", never abort the caller's pass.
             continue
         for shape in _block_shapes(grid, num_nodes):
             for origin in itertools.product(
@@ -293,8 +288,27 @@ def choose_host_block(
                 cells = list(itertools.product(
                     *(range(o, o + s) for o, s in zip(origin, shape))))
                 if all(c in coords for c in cells):
-                    return HostBlock(
+                    yield HostBlock(
                         ici_domain=dom, origin=tuple(origin), shape=shape,
                         nodes=tuple(coords[c] for c in cells),
                     )
-    return None
+
+
+def choose_host_block(
+    topologies: Dict[str, dict],
+    free_nodes: Sequence[str],
+    num_nodes: int,
+) -> Optional[HostBlock]:
+    """Pick a contiguous host-grid block of ``num_nodes`` free hosts.
+
+    ``topologies``: node -> {"ici_domain", "slice_topology",
+    "host_topology", "host_coord" (tuple)} — the ResourceSlice attribute
+    surface. ``free_nodes``: nodes the feasibility filter admitted for the
+    domain's whole-host claim, in preference order.
+
+    Deterministic choice: ICI domains in the order their first free node
+    appears in ``free_nodes`` preference order (name order on ties), block
+    shapes most-compact-first, origins ascending. Returns None when no
+    domain holds a fully-free block of the requested size (the scheduler
+    then degrades to unaligned placement rather than deadlocking)."""
+    return next(iter_host_blocks(topologies, free_nodes, num_nodes), None)

@@ -36,6 +36,14 @@ LATEST_VERSION = "v2"
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
 PREPARE_ABORTED = "PrepareAborted"
+# Live-repack handshake: a PrepareCompleted claim being migrated off this
+# node. The state is persisted BEFORE any device is released, so a crash
+# mid-migration leaves an entry whose rollback (stale-entry path /
+# destroy_unknown_partitions) frees every partition — a leaked ICI
+# partition is impossible by construction. The entry keeps its ``devices``
+# list as the source-placement record the rollback-to-source path
+# re-prepares from.
+MIGRATION_CHECKPOINTED = "MigrationCheckpoint"
 
 # Fault-injection points both plugins' batched pipelines fire between their
 # two checkpoint writes (tests install a hook that raises to simulate a
@@ -74,6 +82,7 @@ class PreparedClaim:
     started_at: float = 0.0
     completed_at: float = 0.0
     aborted_at: float = 0.0
+    migration_started_at: float = 0.0
 
     def aborted_expired(self, now: Optional[float] = None) -> bool:
         if self.state != PREPARE_ABORTED:

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 from k8s_dra_driver_tpu.api.computedomain import (
     COMPUTE_DOMAIN_NODE_LABEL,
+    COORDINATOR_PORT_ANNOTATION,
     ComputeDomainClique,
 )
 from k8s_dra_driver_tpu.daemon.cliquemanager import clique_name
@@ -22,6 +23,20 @@ from k8s_dra_driver_tpu.tpulib.types import HostInventory
 log = logging.getLogger(__name__)
 
 MEGASCALE_COORDINATOR_PORT = 8476
+
+
+def coordinator_port(cd) -> int:
+    """The coordinator port this domain's workers advertise: the
+    per-domain annotation when the controller allocated one dynamically
+    (loopback/sim deployments sharing the host port space), else the fixed
+    well-known port."""
+    raw = cd.meta.annotations.get(COORDINATOR_PORT_ANNOTATION, "")
+    try:
+        return int(raw) if raw else MEGASCALE_COORDINATOR_PORT
+    except ValueError:
+        log.warning("malformed %s annotation %r on %s; using default",
+                    COORDINATOR_PORT_ANNOTATION, raw, cd.key)
+        return MEGASCALE_COORDINATOR_PORT
 
 
 class RetryableError(Exception):
@@ -114,17 +129,21 @@ class ComputeDomainManager:
 
     # -- workload bootstrap env ----------------------------------------------
 
-    def bootstrap_env(self, cd_uid: str, clique: ComputeDomainClique) -> Dict[str, str]:
+    def bootstrap_env(self, cd, clique: ComputeDomainClique) -> Dict[str, str]:
         """The slice-identity environment the channel device injects: worker
         id, ordered peer hostnames, coordinator address — what libtpu/JAX
         need to initialize the multi-host slice (the IMEX channel +
-        /imexd-config analog, device_state.go:681-733)."""
+        /imexd-config analog, device_state.go:681-733). ``cd`` is the
+        resolved ComputeDomain: its coordinator-port annotation (when the
+        controller allocated one at DaemonSet render) overrides the fixed
+        well-known port."""
         members = sorted(clique.nodes, key=lambda n: n.index)
         self_info = clique.node_info(self.node_name)
         if self_info is None:
             raise RetryableError(f"{self.node_name} missing from clique")
         hostnames = [m.dns_name or m.ip_address for m in members]
         coordinator = hostnames[0] if hostnames else ""
+        port = coordinator_port(cd)
         return {
             "TPU_WORKER_ID": str(self_info.index),
             "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
@@ -132,9 +151,9 @@ class ComputeDomainManager:
             "TPU_ACCELERATOR_TYPE": self.inventory.accelerator_type,
             "TPU_HOST_BOUNDS": self.inventory.host_topology,
             "MEGASCALE_COORDINATOR_ADDRESS": (
-                f"{coordinator}:{MEGASCALE_COORDINATOR_PORT}" if coordinator else ""
+                f"{coordinator}:{port}" if coordinator else ""
             ),
             "MEGASCALE_NUM_SLICES": "1",
             "MEGASCALE_SLICE_ID": "0",
-            "COMPUTE_DOMAIN_UUID": cd_uid,
+            "COMPUTE_DOMAIN_UUID": cd.uid,
         }

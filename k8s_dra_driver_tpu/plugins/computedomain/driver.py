@@ -57,6 +57,7 @@ from k8s_dra_driver_tpu.plugins.checkpoint import (
     CheckpointStore,
     FAULT_PRE_COMPLETED,
     FAULT_STARTED_PERSISTED,
+    MIGRATION_CHECKPOINTED,
     PREPARE_ABORTED,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
@@ -341,6 +342,18 @@ class ComputeDomainDriver:
                                 claim, REASON_CHECKPOINT_RECOVERED,
                                 f"expired PrepareAborted tombstone cleared on "
                                 f"{self.node_name}; re-preparing")
+                        elif (entry is not None
+                                and entry.state == MIGRATION_CHECKPOINTED):
+                            # Mid-migration claim re-preparing here (the
+                            # rollback-to-source path of the live-repack
+                            # rebalancer): clear the migration record and
+                            # prepare fresh — channel/daemon devices hold no
+                            # node state beyond the CDI spec.
+                            log.info("claim %s has a MigrationCheckpoint "
+                                     "entry; clearing and re-preparing", uid)
+                            del cp.claims[uid]
+                            self.cdi.delete_claim_spec_file(uid)
+                            dirty = True
                         devices = [
                             r.device
                             for r in (claim.allocation.devices if claim.allocation else [])
@@ -532,7 +545,7 @@ class ComputeDomainDriver:
         # Re-read the clique: it may have appeared since resolve().
         clique = self.cd.get_clique(domain)
         self.cd.assert_domain_ready(domain, clique)
-        env = self.cd.bootstrap_env(cd_uid, clique)
+        env = self.cd.bootstrap_env(domain, clique)
         env["TPU_SLICE_CHANNEL_ID"] = str(cfg.channel_id)
         edits = ContainerEdits(env=env, char_devices=self._channel_cdi_nodes(cfg))
         return {CHANNEL_DEVICE: edits}, [PreparedDevice(

@@ -62,6 +62,7 @@ from k8s_dra_driver_tpu.plugins.checkpoint import (
     CheckpointStore,
     FAULT_PRE_COMPLETED,
     FAULT_STARTED_PERSISTED,
+    MIGRATION_CHECKPOINTED,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     PreparedClaim,
@@ -99,6 +100,16 @@ class PrepareError(Exception):
 
 class OverlapError(PrepareError):
     pass
+
+
+class MigrationError(PrepareError):
+    """migrate_out refused: the claim is not in a migratable state."""
+
+
+# Crash-injection point fired by migrate_out after the MigrationCheckpoint
+# state is persisted but before any device is released — the window the
+# handshake exists to make safe.
+FAULT_MIGRATION_CHECKPOINTED = "migrate:checkpointed"
 
 
 # tpu_dra_device_health gauge encoding (per node, per chip/link id).
@@ -383,6 +394,19 @@ class DeviceState:
                             dirty = True
                             if self.recovery_hook is not None:
                                 self.recovery_hook(entry)
+                        elif (entry is not None
+                                and entry.state == MIGRATION_CHECKPOINTED):
+                            # Re-prepare of a mid-migration claim: either the
+                            # rebalancer rolling the claim back to its source
+                            # placement, or a plugin restart recovering a
+                            # crashed migration. migrate_out already released
+                            # the devices; the extra rollback is idempotent
+                            # belt-and-braces for a crash inside the release.
+                            log.info("claim %s has a MigrationCheckpoint "
+                                     "entry; clearing and re-preparing", uid)
+                            self._rollback(entry)
+                            del cp.claims[uid]
+                            dirty = True
                         requested = self._allocated_device_names(claim)
                         want = self._validate_no_overlap(cp, uid, requested)
                         # Batch siblings are not in cp yet: they conflict too.
@@ -535,6 +559,62 @@ class DeviceState:
 
     def prepared_claims(self) -> Dict[str, PreparedClaim]:
         return dict(self._get_checkpoint().claims)
+
+    # -- live-repack migration handshake -------------------------------------
+
+    def migrate_out(self, claim_uid: str) -> PreparedClaim:
+        # tpulint: holds=pu-flock (the plugin driver takes it per migration)
+        """Checkpoint-aware unprepare for live claim migration: persist the
+        ``MigrationCheckpoint`` state FIRST (one fsync'd write), then release
+        the claim's devices (partitions, sharing records, vfio binds, CDI
+        spec) while the entry — devices list included — survives on disk as
+        the source-placement record.
+
+        The ordering is the whole point: a crash anywhere after the write
+        leaves a MigrationCheckpoint entry whose partitions are freed by
+        ``destroy_unknown_partitions`` at restart (the entry is not
+        PrepareCompleted, so nothing claims them) and whose next Prepare —
+        the rollback-to-source path — clears the entry and prepares fresh.
+        Leaked ICI partitions are impossible by construction. Returns a
+        snapshot of the migration entry."""
+        with self._mutex:
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                entry = cp.claims.get(claim_uid)
+                if entry is None:
+                    raise MigrationError(
+                        f"claim {claim_uid} has no checkpoint entry on this "
+                        f"node; nothing to migrate")
+                if entry.state != PREPARE_COMPLETED:
+                    raise MigrationError(
+                        f"claim {claim_uid} is {entry.state}, not "
+                        f"{PREPARE_COMPLETED}; refusing to migrate")
+                entry.state = MIGRATION_CHECKPOINTED
+                entry.migration_started_at = time.time()
+                sess.save()
+                self._fire_fault(FAULT_MIGRATION_CHECKPOINTED)
+                self._rollback(entry)
+                self.cdi.delete_claim_spec_file(claim_uid)
+                return replace(entry, devices=list(entry.devices))
+
+    def end_migration(self, claim_uid: str) -> None:
+        # tpulint: holds=pu-flock (the plugin driver takes it per migration)
+        """Complete a migration: drop the MigrationCheckpoint entry (the
+        claim now lives on its target node). Idempotent; a no-op for claims
+        in any other state — a re-prepare on this node (rollback-to-source)
+        already cleared the entry through the prepare path."""
+        with self._mutex:
+            with self._store.session() as sess:
+                cp = sess.checkpoint
+                entry = cp.claims.get(claim_uid)
+                if entry is not None and entry.state == MIGRATION_CHECKPOINTED:
+                    del cp.claims[claim_uid]
+                    sess.save()
+
+    def migration_entries(self) -> Dict[str, PreparedClaim]:
+        """Claims currently mid-migration off this node."""
+        return {uid: e for uid, e in self._get_checkpoint().claims.items()
+                if e.state == MIGRATION_CHECKPOINTED}
 
     # -- internals ----------------------------------------------------------
 

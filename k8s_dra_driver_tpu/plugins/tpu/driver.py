@@ -328,6 +328,27 @@ class TpuDriver:
                     f"unprepare on {self.node_name} failed: {err}")
         return out
 
+    # -- live-repack migration -----------------------------------------------
+
+    def migrate_claim_out(self, claim_uid: str):
+        """Checkpoint-aware unprepare for live migration: one pu flock hold
+        around the DeviceState MigrationCheckpoint handshake. Returns the
+        migration entry snapshot (the source-placement record)."""
+        with tracing.span("dra.migrate_out", driver=self.driver_name,
+                          claim_uid=claim_uid), \
+                self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                   trace_name="pu_flock"):
+            return self.state.migrate_out(claim_uid)
+
+    def migrate_claim_end(self, claim_uid: str) -> None:
+        """Drop the MigrationCheckpoint entry once the claim is prepared on
+        its target node (or the rollback re-prepare cleared it)."""
+        with tracing.span("dra.migrate_end", driver=self.driver_name,
+                          claim_uid=claim_uid), \
+                self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                   trace_name="pu_flock"):
+            self.state.end_migration(claim_uid)
+
     # -- stale-claim cleanup -------------------------------------------------
 
     def cleanup_stale_claims(self) -> int:

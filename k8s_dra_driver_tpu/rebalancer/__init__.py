@@ -1,0 +1,47 @@
+"""Live repack: the online defragmentation rebalancer.
+
+PR 5's topology-aware admission *slows* fragmentation but never reverses
+it: once small v5e-1/2/4 claims scatter across hosts, large-profile and
+multi-host ComputeDomain placements stay destroyed until churn happens to
+free a block. This subsystem is the reversal — the Flex-MIG /
+"Managing Multi-Instance GPUs for High Throughput and Energy Savings"
+insight that the real wins come from repartitioning *online*, not just at
+admission (PAPERS.md).
+
+Two modules:
+
+- ``planner``: pure planning over the allocator's bitmask placement view —
+  score per-node fragmentation, pick the *minimal* set of migration units
+  (a consumer pod plus every claim it holds) whose eviction restores a
+  target profile or host-grid block, and the energy-mode consolidation
+  order.
+- ``controller``: the control loop — watches the fragmentation signal
+  behind ``tpu_dra_node_frag_largest_free_profile`` plus unschedulable
+  demand, executes migrations (cordon -> checkpoint-aware unprepare ->
+  re-place via the placement tables -> re-prepare -> uncordon) under a
+  migration budget, with rollback to the source placement on any
+  mid-migration failure, per-step tracing spans, and
+  RebalancePlanned/ClaimMigrated/MigrationFailed events.
+
+Gated by the ``LiveRepack`` feature gate (or an explicit config passed to
+the sim); see docs/reference/rebalancing.md.
+"""
+
+from k8s_dra_driver_tpu.rebalancer.controller import (  # noqa: F401
+    CORDON_ANNOTATION,
+    DRAIN_READY_ANNOTATION,
+    MODE_DEFRAG,
+    MODE_ENERGY,
+    RebalanceController,
+    RebalancerConfig,
+)
+from k8s_dra_driver_tpu.rebalancer.planner import (  # noqa: F401
+    MigrationUnit,
+    NodeView,
+    RepackPlan,
+    WHOLE_HOST,
+    build_node_views,
+    plan_consolidation,
+    plan_domain_block,
+    plan_profile,
+)

@@ -1,0 +1,689 @@
+"""RebalanceController: the live-repack control loop.
+
+Watches the fragmentation signal behind the
+``tpu_dra_node_frag_largest_free_profile`` gauge (read as bitmasks via
+``Allocator.placement_overview``) plus unschedulable demand (pending pods
+whose large-profile or multi-host ComputeDomain claims no node can place),
+plans the minimal migration set with ``rebalancer.planner``, and executes
+each migration as a rollback-safe pipeline:
+
+    cordon claim(s) -> checkpoint-aware unprepare on the source
+    (DeviceState.migrate_out: the MigrationCheckpoint handshake)
+    -> re-place via the PR 5 bitmask placement tables
+    -> re-prepare on the target -> rebind the pod -> uncordon
+
+Any mid-migration failure rolls back to the source placement: the target
+side is unprepared, the allocation is restored, and the source re-prepare
+clears the MigrationCheckpoint entry — the claim ends exactly where it
+started and the partition ledger holds exactly its original partitions.
+
+Migrations are budgeted (a per-pass cap plus a token bucket refilled over
+time), every step runs under a tracing span, and the controller narrates
+through RebalancePlanned/ClaimMigrated/MigrationFailed events.
+
+**Energy mode** inverts the goal: instead of freeing one large placement
+it consolidates movable claims onto the fewest hosts (tightest-fit-first
+re-placement restricted to equal-or-busier hosts, so the occupied-host
+count strictly falls), publishes the ``tpu_dra_reclaimable_hosts`` gauge,
+and marks fully-idle hosts drain-ready via a Node annotation that
+``describe`` renders.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from k8s_dra_driver_tpu.api.configs import (
+    TPU_DRIVER_NAME,
+    channel_domain_uid,
+)
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    NODE,
+    ObjectReference,
+    POD,
+    RESOURCE_CLAIM,
+)
+from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_CLAIM_MIGRATED,
+    REASON_MIGRATION_FAILED,
+    REASON_REBALANCE_PLANNED,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
+from k8s_dra_driver_tpu.rebalancer.planner import (
+    NodeView,
+    RepackPlan,
+    WHOLE_HOST,
+    build_node_views,
+    plan_consolidation,
+    plan_domain_block,
+    plan_profile,
+    reclaimable_hosts,
+)
+
+log = logging.getLogger(__name__)
+
+MODE_DEFRAG = "defrag"
+MODE_ENERGY = "energy"
+
+# Claim annotation marking an in-flight migration: the planner skips
+# cordoned claims, so two controllers (or two passes) never double-migrate.
+CORDON_ANNOTATION = "rebalancer.tpu.google.com/cordoned"
+# Node annotation the energy mode sets on fully-idle hosts — the
+# drain-ready marker `describe node` renders.
+DRAIN_READY_ANNOTATION = "rebalancer.tpu.google.com/drain-ready"
+
+
+@dataclass
+class RebalancerConfig:
+    """Policy knobs (docs/reference/rebalancing.md)."""
+
+    mode: str = MODE_DEFRAG                 # defrag | energy
+    # Profiles to keep placeable even without pending demand ("whole-host"
+    # or a subslice shape like "2x2") — the proactive watch targets.
+    watch_profiles: Tuple[str, ...] = ()
+    # Hard cap on migration units moved in one pass.
+    max_migrations_per_pass: int = 4
+    # Token bucket across passes: capacity + refill rate. A churn storm
+    # cannot turn the rebalancer into its own churn storm.
+    migration_burst: int = 16
+    migration_refill_per_s: float = 1.0
+
+
+class RebalancerMetrics:
+    def __init__(self, registry: Registry):
+        self.passes_total = registry.register(Counter(
+            "tpu_dra_rebalance_passes_total",
+            "Completed rebalancer passes, by mode.",
+            ("mode",)))
+        self.migrations_total = registry.register(Counter(
+            "tpu_dra_rebalance_migrations_total",
+            "Claim-unit migrations attempted, by outcome "
+            "(migrated / failed — failed includes rolled-back).",
+            ("outcome",)))
+        self.deferred_total = registry.register(Counter(
+            "tpu_dra_rebalance_deferred_total",
+            "Planned migrations deferred by the per-pass cap or the "
+            "token-bucket budget."))
+        self.plan_units = registry.register(Gauge(
+            "tpu_dra_rebalance_last_pass_migrations",
+            "Migration units moved by the last rebalancer pass "
+            "(0 when nothing needed repacking)."))
+        self.reclaimable_hosts = registry.register(Gauge(
+            "tpu_dra_reclaimable_hosts",
+            "Hosts with zero allocated chips — drainable right now "
+            "(energy mode keeps this maximal by consolidating claims)."))
+
+
+class RebalanceController:
+    """``plugin_resolver(node_name)`` returns the node's TpuDriver (the
+    object exposing prepare_resource_claims / migrate_claim_out /
+    migrate_claim_end), or None for unknown nodes — the seam that lets the
+    sim hand over its in-process plugins and a future remote-plugin
+    transport slot in unchanged."""
+
+    def __init__(
+        self,
+        api,
+        allocator,
+        plugin_resolver: Callable[[str], object],
+        config: Optional[RebalancerConfig] = None,
+        metrics_registry: Optional[Registry] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.api = api
+        self.allocator = allocator
+        self.resolve_plugin = plugin_resolver
+        self.config = config or RebalancerConfig()
+        registry = metrics_registry or Registry()
+        self.metrics = RebalancerMetrics(registry)
+        self.recorder = EventRecorder(api, "rebalancer",
+                                      metrics_registry=registry)
+        self.clock = clock
+        self._tokens = float(self.config.migration_burst)
+        self._tokens_at = clock()
+        # Last pass's per-node largest-free reading — the cheap "did the
+        # fragmentation signal move" gate.
+        self._last_frag: Optional[tuple] = None
+
+    # -- budget ---------------------------------------------------------------
+
+    def _take_token(self) -> bool:
+        now = self.clock()
+        self._tokens = min(
+            float(self.config.migration_burst),
+            self._tokens + max(0.0, now - self._tokens_at)
+            * self.config.migration_refill_per_s)
+        self._tokens_at = now
+        if self._tokens < 1.0:
+            return False
+        self._tokens -= 1.0
+        return True
+
+    # -- snapshot -------------------------------------------------------------
+
+    def _snapshot(self) -> Tuple[Dict[str, NodeView], list, Dict[str, object]]:
+        """(views, claims, pods_by_uid) from ONE claim + pod listing —
+        demand detection reuses the same listings instead of re-scanning."""
+        overview = self.allocator.placement_overview(TPU_DRIVER_NAME)
+        claims = list(self.api.list(RESOURCE_CLAIM))
+        pods_by_uid = {p.uid: p for p in self.api.list(POD)}
+        device_types = {
+            (node, name): t
+            for node, entry in overview.items()
+            for name, t in entry["dev_type"].items()
+        }
+        views = build_node_views(
+            overview, claims, pods_by_uid, TPU_DRIVER_NAME, device_types,
+            is_cordoned=lambda c: CORDON_ANNOTATION in c.meta.annotations,
+        )
+        return views, claims, pods_by_uid
+
+    # -- demand detection -----------------------------------------------------
+
+    def _demand_targets(self, all_claims, pods_by_uid):
+        """(profile targets, domain targets) derived from pending pods
+        whose claims cannot place anywhere: the unschedulable demand the
+        scheduler parked in its backlog. Reads the snapshot's listings —
+        no second cluster-wide scan per pass."""
+        profiles: List[Tuple[str, object]] = []   # (profile, involved obj)
+        domains: Dict[str, Tuple[int, object]] = {}  # cd uid -> (n, cd)
+        # One domain scan for the whole pass, not one per pending claim.
+        domains_by_uid = {cd.uid: cd
+                          for cd in self.api.list(COMPUTE_DOMAIN)}
+        claims_by_key = {(c.meta.namespace, c.meta.name): c
+                         for c in all_claims}
+        for pod in pods_by_uid.values():
+            if pod.phase != "Pending":
+                continue
+            claims = []
+            for ref in pod.resource_claims:
+                name = (ref.resource_claim_name
+                        or f"{pod.meta.name}-{ref.name}")
+                c = claims_by_key.get((pod.meta.namespace, name))
+                if c is not None:
+                    claims.append(c)
+            if not claims or all(c.allocation is not None for c in claims):
+                continue
+            cd = None
+            for c in claims:
+                uid = channel_domain_uid(c)
+                if uid:
+                    cd = domains_by_uid.get(uid)
+                    break
+            if cd is not None and cd.spec.num_nodes > 1:
+                domains.setdefault(cd.uid, (cd.spec.num_nodes, cd))
+                continue
+            for c in claims:
+                if c.allocation is not None:
+                    continue
+                for req in c.requests:
+                    profile = self._request_profile(req)
+                    if profile is not None:
+                        profiles.append((profile, c))
+        # Dedup profile targets, first involved object wins.
+        seen: Set[str] = set()
+        uniq = []
+        for profile, obj in profiles:
+            if profile not in seen:
+                seen.add(profile)
+                uniq.append((profile, obj))
+        return uniq, list(domains.values())
+
+    # The common CEL shape selecting a subslice profile by equality, e.g.
+    # device.attributes["tpu.google.com"].profile == "2x2". Anything more
+    # elaborate (ranges, disjunctions) is not reverse-engineered — the
+    # claim simply yields no profile target (documented limitation).
+    _CEL_PROFILE = re.compile(
+        r"""profile["'\]]*\s*==\s*["']([\w]+)["']""")
+
+    @classmethod
+    def _request_profile(cls, req) -> Optional[str]:
+        """The placement-table profile one device request demands, or None
+        when fragmentation cannot be what blocks it (plain count-based
+        single-chip requests fit any free chip)."""
+        if req.allocation_mode == "All":
+            return WHOLE_HOST
+        for sel in req.selectors:
+            key, _, value = sel.partition("=")
+            if key.strip() == "profile" and value:
+                return value.strip()
+        for expr in getattr(req, "cel_selectors", ()):
+            m = cls._CEL_PROFILE.search(expr)
+            if m:
+                return m.group(1)
+        return None
+
+    # -- the pass -------------------------------------------------------------
+
+    def step(self) -> int:
+        """One rebalance pass; returns how many units were migrated."""
+        with tracing.span("rebalance.pass", mode=self.config.mode) as sp:
+            views, claims, pods_by_uid = self._snapshot()
+            self._publish_reclaimable(views)
+            frag = tuple(sorted(
+                (v.name,
+                 v.tables.largest_free_chips(v.used_mask, v.available))
+                for v in views.values()))
+            if self.config.mode == MODE_ENERGY:
+                migrated = self._energy_pass(views)
+            else:
+                migrated = self._defrag_pass(views, frag, claims,
+                                             pods_by_uid)
+            self._last_frag = frag
+            sp.attrs["migrated"] = migrated
+            # Set unconditionally so an idle pass reads 0, not the
+            # previous pass's count.
+            self.metrics.plan_units.set(value=float(migrated))
+            self.metrics.passes_total.inc(self.config.mode)
+            return migrated
+
+    def _defrag_pass(self, views: Dict[str, NodeView], frag: tuple,
+                     claims, pods_by_uid) -> int:
+        profile_targets, domain_targets = self._demand_targets(
+            claims, pods_by_uid)
+        if (not profile_targets and not domain_targets
+                and not self.config.watch_profiles):
+            return 0
+        if (not profile_targets and not domain_targets
+                and frag == self._last_frag):
+            # Watch-only config and the fragmentation signal didn't move:
+            # last pass's verdict stands.
+            return 0
+        migrated = 0
+        budget = self.config.max_migrations_per_pass
+        # Every node vacated by ANY plan this pass stays forbidden as a
+        # migration destination for every later plan — plan B must not
+        # refill the placement plan A just freed.
+        vacated: Set[str] = set()
+        for profile in self.config.watch_profiles:
+            if not any(p == profile for p, _ in profile_targets):
+                profile_targets.append((profile, None))
+        for profile, involved in profile_targets:
+            plan = plan_profile(views, profile)
+            migrated += self._execute(plan, views, involved, budget - migrated,
+                                      required=involved is not None,
+                                      also_forbidden=vacated)
+            if plan is not None:
+                vacated |= set(plan.nodes)
+        topologies = self.allocator.node_topologies()
+        for num_nodes, cd in domain_targets:
+            plan = plan_domain_block(
+                views, topologies, num_nodes,
+                target=f"host block for ComputeDomain {cd.key} "
+                       f"({num_nodes} nodes)")
+            migrated += self._execute(plan, views, cd, budget - migrated,
+                                      required=True, also_forbidden=vacated)
+            if plan is not None:
+                vacated |= set(plan.nodes)
+        return migrated
+
+    def _energy_pass(self, views: Dict[str, NodeView]) -> int:
+        migrated = 0
+        budget = self.config.max_migrations_per_pass
+        received: Set[str] = set()
+        for plan in plan_consolidation(views):
+            if migrated >= budget:
+                break
+            source = plan.nodes[0]
+            if source in received:
+                continue  # got claims this pass: its plan is stale
+            min_used = placement_lib.popcount(views[source].used_mask)
+            got = self._execute(
+                plan, views, None, budget - migrated, required=False,
+                min_used=min_used, received=received)
+            migrated += got
+        if migrated:
+            # One POST-migration snapshot drives both the gauge and the
+            # annotations, so /metrics and `describe` can never disagree
+            # within a pass.
+            views, _, _ = self._snapshot()
+            self._publish_reclaimable(views)
+        self._annotate_drain_ready(set(reclaimable_hosts(views)))
+        return migrated
+
+    def _publish_reclaimable(self, views: Dict[str, NodeView]) -> None:
+        self.metrics.reclaimable_hosts.set(
+            value=float(len(reclaimable_hosts(views))))
+
+    def drain_ready_hosts(self) -> List[str]:
+        """Hosts currently reclaimable (zero allocated chips) — the
+        drain-ready list energy mode annotates and `describe` renders."""
+        views, _, _ = self._snapshot()
+        return reclaimable_hosts(views)
+
+    def _annotate_drain_ready(self, empty: Set[str]) -> None:
+        """Mark fully-idle hosts drain-ready (and clear the mark when they
+        fill back up). Change-gated: a steady cluster writes nothing."""
+        for node in self.api.list(NODE):
+            name = node.meta.name
+            has = DRAIN_READY_ANNOTATION in node.meta.annotations
+            want = name in empty
+            if has == want:
+                continue
+
+            def mutate(obj, want=want):
+                if want:
+                    obj.meta.annotations[DRAIN_READY_ANNOTATION] = "true"
+                else:
+                    obj.meta.annotations.pop(DRAIN_READY_ANNOTATION, None)
+            try:
+                self.api.update_with_retry(NODE, name, "", mutate)
+            except NotFoundError:
+                continue
+
+    # -- plan execution -------------------------------------------------------
+
+    def _execute(self, plan: Optional[RepackPlan],
+                 views: Dict[str, NodeView],
+                 involved, budget: int, required: bool,
+                 min_used: Optional[int] = None,
+                 received: Optional[Set[str]] = None,
+                 also_forbidden: Optional[Set[str]] = None) -> int:
+        """Run one plan's migrations within ``budget``; returns units moved.
+        ``involved``: the object RebalancePlanned narrates on (the pending
+        ComputeDomain or claim), falling back to the vacated node.
+        ``required=False`` (energy / watch targets) skips silently when a
+        unit has no feasible destination instead of alarming.
+        ``also_forbidden``: nodes vacated by earlier plans this pass —
+        never valid destinations either."""
+        if plan is None or not plan.units or budget <= 0:
+            return 0
+        ref = involved
+        if ref is None:
+            ref = (self.api.try_get(NODE, plan.nodes[0])
+                   or ObjectReference(kind=NODE, name=plan.nodes[0]))
+        if required:
+            # Demanded repacks narrate up front; opportunistic
+            # (energy/watch) plans narrate only when they actually move
+            # something — a plan with no viable destination must not spam.
+            self.recorder.normal(
+                ref, REASON_REBALANCE_PLANNED,
+                f"live repack: migrating {len(plan.units)} claim unit(s) "
+                f"off {','.join(plan.nodes)} to restore {plan.target}")
+        migrated = 0
+        forbidden = set(plan.nodes) | (also_forbidden or set())
+        for i, unit in enumerate(plan.units):
+            if migrated >= budget:
+                self.metrics.deferred_total.inc(
+                    by=float(len(plan.units) - i))
+                break
+            outcome = self._migrate_unit(unit, views, forbidden, required,
+                                         min_used=min_used,
+                                         received=received)
+            if outcome == "no-token":
+                self.metrics.deferred_total.inc(
+                    by=float(len(plan.units) - i))
+                break
+            if outcome == "migrated":
+                if not required and migrated == 0:
+                    self.recorder.normal(
+                        ref, REASON_REBALANCE_PLANNED,
+                        f"live repack: migrating {len(plan.units)} claim "
+                        f"unit(s) off {','.join(plan.nodes)} to restore "
+                        f"{plan.target}")
+                migrated += 1
+            elif required:
+                # One stuck blocker means the placement cannot be freed
+                # this pass; don't churn the remaining units for nothing.
+                break
+        return migrated
+
+    def _allowed_targets(self, views: Dict[str, NodeView],
+                         forbidden: Set[str],
+                         min_used: Optional[int]) -> List[str]:
+        out = []
+        for name, view in views.items():
+            if name in forbidden:
+                continue
+            if min_used is not None:
+                # Energy mode: only equal-or-busier hosts (strictly
+                # reduces the occupied-host count, so the loop
+                # terminates), and never hosts being drained this pass.
+                if placement_lib.popcount(view.used_mask) < min_used:
+                    continue
+            out.append(name)
+        return out
+
+    def _migrate_unit(self, unit, views: Dict[str, NodeView],
+                      forbidden: Set[str], required: bool,
+                      min_used: Optional[int] = None,
+                      received: Optional[Set[str]] = None) -> str:
+        """One full migration with rollback. Returns "migrated", "failed"
+        (rolled back / no destination), "skip" (stale plan), or "no-token"
+        (budget exhausted before anything was touched)."""
+        with tracing.span("rebalance.migrate", pod=f"{unit.pod_namespace}/"
+                          f"{unit.pod_name}", source=unit.node) as sp:
+            claims = []
+            for ns, name in unit.claim_keys:
+                c = self.api.try_get(RESOURCE_CLAIM, name, ns)
+                if (c is None or c.allocation is None
+                        or c.allocation.node_name != unit.node):
+                    return "skip"  # stale plan: the world moved on
+                claims.append(c)
+            pod = self.api.try_get(POD, unit.pod_name, unit.pod_namespace)
+            if pod is None or pod.node_name != unit.node:
+                return "skip"
+            src_plugin = self.resolve_plugin(unit.node)
+            if src_plugin is None:
+                return "skip"
+            # Destination first, before any state is touched: a unit with
+            # nowhere to go costs neither a cordon nor a budget token.
+            target, allocs = self._pick_target(
+                claims, views, forbidden, min_used)
+            if target is None:
+                if required:
+                    self._record_failure(
+                        claims, unit,
+                        "no feasible target node for re-placement")
+                    return "failed"
+                return "skip"
+            dst_plugin = self.resolve_plugin(target)
+            if dst_plugin is None:
+                return "skip"
+            if not self._take_token():
+                return "no-token"
+            sp.attrs["target"] = target
+            self._set_cordon(claims, True)
+            try:
+                ok = self._move(unit, claims, allocs, src_plugin,
+                                dst_plugin, target)
+            except Exception:  # noqa: BLE001 — one bad unit must not kill the pass
+                # _move is rollback-safe internally; anything reaching here
+                # escaped its guarded windows (cordon/bookkeeping). Count
+                # it failed and let the pass continue — the next pass's
+                # refetch + checkpoint recovery own any residue.
+                log.exception("migration of %s/%s failed unexpectedly",
+                              unit.pod_namespace, unit.pod_name)
+                self._set_cordon(claims, False)
+                self.metrics.migrations_total.inc("failed")
+                return "failed"
+            if ok and received is not None:
+                received.add(target)
+            return "migrated" if ok else "failed"
+
+    def _pick_target(self, claims, views, forbidden, min_used):
+        allowed = self._allowed_targets(views, forbidden, min_used)
+        with tracing.span("rebalance.replace"):
+            try:
+                candidates = self.allocator.feasible_nodes(
+                    claims, nodes=allowed)
+            except Exception:  # noqa: BLE001 — malformed claim: not migratable
+                log.exception("feasibility check failed during migration")
+                return None, []
+            for node in candidates:
+                allocs = []
+                fits = True
+                for c in claims:
+                    r = self.allocator.allocate_on_node(
+                        c, node, in_flight=allocs)
+                    if r is None:
+                        fits = False
+                        break
+                    allocs.append(r)
+                if fits:
+                    return node, allocs
+        return None, []
+
+    def _move(self, unit, claims, allocs, src_plugin, dst_plugin,
+              target: str) -> bool:
+        """unprepare(source) -> re-point allocations -> prepare(target) ->
+        rebind pod -> uncordon, rolling back to the source placement on any
+        failure."""
+        source = unit.node
+        old_allocs = {c.uid: c.allocation for c in claims}
+        migrated_out: List[str] = []
+        with tracing.span("rebalance.unprepare", node=source):
+            try:
+                for c in claims:
+                    src_plugin.migrate_claim_out(c.uid)
+                    migrated_out.append(c.uid)
+            except Exception as e:  # noqa: BLE001 — roll straight back
+                log.warning("migrate_out of %s failed: %s", unit.pod_name, e)
+                self._restore_source(unit, claims, src_plugin)
+                self._record_failure(claims, unit, f"source unprepare: {e}")
+                self._set_cordon(claims, False)
+                return False
+        try:
+            for c, alloc in zip(claims, allocs):
+                def repoint(obj, alloc=alloc):
+                    obj.allocation = alloc
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, repoint)
+                except NotFoundError:
+                    self._rollback(unit, claims, old_allocs, src_plugin,
+                                   dst_plugin, "claim vanished mid-migration")
+                    return False
+            with tracing.span("rebalance.prepare", node=target):
+                fresh = [self.api.try_get(RESOURCE_CLAIM, c.meta.name,
+                                          c.namespace)
+                         for c in claims]
+                fresh = [c for c in fresh if c is not None]
+                results = dst_plugin.prepare_resource_claims(fresh)
+                errs = {uid: r for uid, r in results.items()
+                        if isinstance(r, Exception)}
+                if len(fresh) != len(claims) or errs:
+                    why = "; ".join(str(e) for e in errs.values()) or \
+                        "claim vanished mid-migration"
+                    self._rollback(unit, claims, old_allocs, src_plugin,
+                                   dst_plugin, f"target prepare: {why}")
+                    return False
+        except Exception as e:  # noqa: BLE001 — the source is already unprepared: ANY escape here must restore it
+            log.exception("unexpected error mid-migration of %s/%s",
+                          unit.pod_namespace, unit.pod_name)
+            self._rollback(unit, claims, old_allocs, src_plugin, dst_plugin,
+                           f"unexpected mid-migration error: {e}")
+            return False
+        # Past this point the migration HAS succeeded (claims prepared on
+        # the target): the closing steps are individually best-effort so
+        # one hiccup (a flock timeout on migrate_claim_end, a CAS storm on
+        # the rebind) cannot strand the unit half-finished or abort the
+        # pass.
+        for uid in migrated_out:
+            try:
+                src_plugin.migrate_claim_end(uid)
+            except Exception:  # noqa: BLE001 — benign residue: the entry holds no devices and clears on the next prepare/unprepare/restart
+                log.exception("migrate_claim_end(%s) on %s failed", uid,
+                              source)
+        try:
+            self._rebind_pod(unit, target)
+        except Exception:  # noqa: BLE001 — pod rebind retried by the next pass's stale-plan refetch
+            log.exception("rebind of %s/%s failed", unit.pod_namespace,
+                          unit.pod_name)
+        self._set_cordon(claims, False)
+        for c in claims:
+            self.recorder.normal(
+                c, REASON_CLAIM_MIGRATED,
+                f"live repack migrated claim from {source} to {target}")
+        self.metrics.migrations_total.inc("migrated")
+        return True
+
+    # -- rollback -------------------------------------------------------------
+
+    def _rollback(self, unit, claims, old_allocs, src_plugin, dst_plugin,
+                  why: str) -> None:
+        """Mid-migration failure: restore the SOURCE placement exactly.
+        Order matters — target unprepare first (free anything half-made
+        there), then allocations back, then the source re-prepare (which
+        clears the MigrationCheckpoint entries and re-activates the source
+        partitions)."""
+        with tracing.span("rebalance.rollback", pod=unit.pod_name):
+            try:
+                dst_plugin.unprepare_resource_claims([c.uid for c in claims])
+            except Exception:  # noqa: BLE001 — best effort; target holds nothing prepared
+                log.exception("rollback: target unprepare failed")
+            for c in claims:
+                def restore(obj, alloc=old_allocs.get(c.uid)):
+                    obj.allocation = alloc
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, restore)
+                except NotFoundError:
+                    continue
+            self._restore_source(unit, claims, src_plugin)
+        self._record_failure(claims, unit, why)
+        self._set_cordon(claims, False)
+
+    def _restore_source(self, unit, claims, src_plugin) -> None:
+        """Re-prepare the claims on their source node; the prepare path
+        clears MigrationCheckpoint entries, so after this the checkpoint
+        and the partition ledger read exactly as before the migration."""
+        fresh = [self.api.try_get(RESOURCE_CLAIM, c.meta.name, c.namespace)
+                 for c in claims]
+        results = src_plugin.prepare_resource_claims(
+            [c for c in fresh if c is not None])
+        for uid, r in results.items():
+            if isinstance(r, Exception):
+                # The pod's kubelet retry loop owns recovery from here; the
+                # checkpoint holds no migration entry either way.
+                log.error("rollback re-prepare of %s on %s failed: %s",
+                          uid, unit.node, r)
+
+    def _record_failure(self, claims, unit, why: str) -> None:
+        for c in claims:
+            self.recorder.warning(
+                c, REASON_MIGRATION_FAILED,
+                f"live repack migration off {unit.node} failed; claim "
+                f"rolled back to its source placement: {why}")
+        self.metrics.migrations_total.inc("failed")
+
+    # -- cordon / rebind ------------------------------------------------------
+
+    def _set_cordon(self, claims, on: bool) -> None:
+        with tracing.span("rebalance.cordon" if on else "rebalance.uncordon"):
+            for c in claims:
+                def mutate(obj, on=on):
+                    if on:
+                        obj.meta.annotations[CORDON_ANNOTATION] = "true"
+                    else:
+                        obj.meta.annotations.pop(CORDON_ANNOTATION, None)
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, mutate)
+                except NotFoundError:
+                    continue
+
+    def _rebind_pod(self, unit, target: str) -> None:
+        """Point the consumer pod at its claims' new home. Phase drops back
+        to Pending so the kubelet re-runs the (idempotent) prepare and
+        re-materializes the injected env from the target's CDI spec."""
+        with tracing.span("rebalance.rebind", pod=unit.pod_name,
+                          node=target):
+            def mutate(obj):
+                obj.node_name = target
+                obj.phase = "Pending"
+                obj.ready = False
+            try:
+                self.api.update_with_retry(
+                    POD, unit.pod_name, unit.pod_namespace, mutate)
+            except NotFoundError:
+                pass

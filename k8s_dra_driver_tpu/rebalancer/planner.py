@@ -1,0 +1,322 @@
+"""Repack planning: minimal migration sets over the bitmask placement view.
+
+Pure functions — no API writes, no device mutations — so every plan is
+unit-testable against synthetic views. The controller executes plans.
+
+The planning model:
+
+- A **migration unit** is a consumer pod plus EVERY ResourceClaim it
+  holds on its node. Claims move pod-at-a-time: migrating one claim of a
+  two-claim pod would leave its siblings allocated on another node, which
+  the scheduler rightly treats as a broken pod.
+- A unit is **movable** only when every claim is an ordinary TPU
+  chip/subslice claim (no ComputeDomain channel/daemon devices, no VFIO
+  passthrough, no shared multi-pod claims), its pod is a plain Running
+  workload pod (not DaemonSet-owned), and nothing has cordoned it.
+  Everything else pins its chips — assembled ComputeDomains are never
+  disturbed by construction.
+- **Defrag** targets answer "what is the cheapest way to make profile P
+  placeable again": for every candidate placement mask of P (the PR 5
+  tables), the blocking units are the movable units whose chips intersect
+  it; the minimal plan is the placement with the fewest blockers (ties:
+  fewest chips moved, then node/profile order). A placement overlapping
+  any pinned chip is discarded outright.
+- **Host-block** targets generalize that to a multi-host ComputeDomain:
+  enumerate contiguous host-grid blocks (pkg.placement.iter_host_blocks)
+  whose hosts are all whole-host-capable and pin-free, and take the block
+  with the fewest total blocking units.
+- **Energy** planning inverts the goal: vacate the least-utilized hosts
+  onto busier ones so whole hosts go idle and can be drained.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
+
+# Sentinel profile name for "the whole host" (the shape multi-host
+# ComputeDomain workers claim); per-host tables key it by their topology
+# string, so targets use this marker instead.
+WHOLE_HOST = "whole-host"
+
+
+@dataclass(frozen=True)
+class MigrationUnit:
+    """One movable scheduling unit: a consumer pod and all its claims."""
+
+    pod_namespace: str
+    pod_name: str
+    pod_uid: str
+    node: str
+    claim_keys: Tuple[Tuple[str, str], ...]  # (namespace, name), sorted
+    chip_mask: int                           # union over the unit's claims
+
+    @property
+    def num_chips(self) -> int:
+        return placement_lib.popcount(self.chip_mask)
+
+
+@dataclass
+class NodeView:
+    """One node's repack-relevant state, derived from the allocator's
+    placement overview plus the claim/pod listing."""
+
+    name: str
+    tables: object                 # pkg.placement.PlacementTables
+    available: int                 # placement-availability bitmap (taints)
+    used_mask: int                 # chips held by ANY allocation
+    pinned_mask: int = 0           # chips held by immovable claims
+    units: List[MigrationUnit] = field(default_factory=list)
+
+    @property
+    def movable_mask(self) -> int:
+        mask = 0
+        for u in self.units:
+            mask |= u.chip_mask
+        return mask
+
+
+@dataclass(frozen=True)
+class RepackPlan:
+    """A chosen repack move: vacate ``units`` so ``target`` becomes
+    placeable on ``nodes`` (one node for a profile target, a host-grid
+    block for a domain target)."""
+
+    target: str                       # human-readable target description
+    nodes: Tuple[str, ...]            # nodes being vacated (forbidden as
+                                      # migration destinations)
+    units: Tuple[MigrationUnit, ...]  # minimal blocking set, in order
+    placement_mask: int = 0           # chip mask freed (profile targets)
+
+
+def _profile_placements(view: NodeView, profile: str) -> List[int]:
+    """Available placement indices of ``profile`` on one node (whole-host
+    included via the WHOLE_HOST sentinel)."""
+    tables = view.tables
+    if profile == WHOLE_HOST:
+        indices: Sequence[int] = (tables.whole_host_index,)
+    else:
+        indices = tables.by_profile.get(profile, ())
+    return [i for i in indices if (view.available >> i) & 1]
+
+
+def profile_placeable(views: Dict[str, NodeView], profile: str) -> bool:
+    """Is ``profile`` placeable RIGHT NOW on any node — no migration?"""
+    for view in views.values():
+        for idx in _profile_placements(view, profile):
+            if not (view.tables.placements[idx].mask & view.used_mask):
+                return True
+    return False
+
+
+def plan_profile(views: Dict[str, NodeView],
+                 profile: str) -> Optional[RepackPlan]:
+    """Minimal migration set restoring one placement of ``profile``.
+
+    Returns None when the profile is already placeable (nothing to do) or
+    no placement can be freed by migration alone (every candidate overlaps
+    a pinned chip). The chosen placement minimizes (blocking units, chips
+    moved), with node-name and placement-index tie-breaks for
+    determinism."""
+    if profile_placeable(views, profile):
+        return None
+    best: Optional[Tuple[Tuple[int, int, str, int], NodeView, int,
+                         List[MigrationUnit]]] = None
+    for name in sorted(views):
+        view = views[name]
+        for idx in _profile_placements(view, profile):
+            mask = view.tables.placements[idx].mask
+            if mask & view.pinned_mask:
+                continue  # immovable claim in the way: not freeable
+            blockers = [u for u in view.units if u.chip_mask & mask]
+            if not blockers:
+                continue  # free placement would have been caught above
+            cost = (len(blockers),
+                    sum(u.num_chips for u in blockers), name, idx)
+            if best is None or cost < best[0]:
+                best = (cost, view, mask, blockers)
+    if best is None:
+        return None
+    _, view, mask, blockers = best
+    return RepackPlan(
+        target=f"profile {profile} on {view.name}",
+        nodes=(view.name,),
+        units=tuple(sorted(blockers,
+                           key=lambda u: (u.pod_namespace, u.pod_name))),
+        placement_mask=mask,
+    )
+
+
+def plan_domain_block(views: Dict[str, NodeView],
+                      topologies: Dict[str, dict],
+                      num_nodes: int,
+                      target: str = "") -> Optional[RepackPlan]:
+    """Minimal migration set vacating a contiguous host-grid block of
+    ``num_nodes`` whole-host-capable hosts within one ICI domain.
+
+    A host qualifies for a block when its whole-host placement is
+    available (no taints) and no pinned claim sits on it; among
+    qualifying blocks the one with the fewest blocking units wins (ties:
+    fewest chips moved, then the deterministic iter_host_blocks order).
+    Returns None when a fully-free block already exists — the scheduler
+    places the domain itself — or no block can be vacated."""
+    candidates = []
+    for name, view in sorted(views.items()):
+        if not _profile_placements(view, WHOLE_HOST):
+            continue  # tainted: can never host a domain worker
+        if view.pinned_mask:
+            continue  # immovable claim: block is not vacatable
+        candidates.append(name)
+    best: Optional[Tuple[Tuple[int, int, int], object,
+                         List[MigrationUnit]]] = None
+    for order, block in enumerate(placement_lib.iter_host_blocks(
+            topologies, candidates, num_nodes)):
+        blockers: List[MigrationUnit] = []
+        for node in block.nodes:
+            blockers.extend(views[node].units)
+        if not blockers:
+            return None  # a free block exists: nothing to repack
+        cost = (len(blockers), sum(u.num_chips for u in blockers), order)
+        if best is None or cost < best[0]:
+            best = (cost, block, blockers)
+    if best is None:
+        return None
+    _, block, blockers = best
+    return RepackPlan(
+        target=target or (f"host block {block.shape_str}@{block.origin_str} "
+                          f"of {block.ici_domain or '<default>'}"),
+        nodes=tuple(block.nodes),
+        units=tuple(sorted(blockers,
+                           key=lambda u: (u.node, u.pod_namespace,
+                                          u.pod_name))),
+    )
+
+
+def plan_consolidation(views: Dict[str, NodeView]) -> List[RepackPlan]:
+    """Energy mode: the vacate order that consolidates claims onto the
+    fewest hosts. Least-utilized pin-free hosts drain first (name
+    tie-break); a host only appears when every claim on it is movable.
+    The controller re-places each unit tightest-fit-first (the PR 5
+    packing rank), restricted to hosts at least as utilized as the
+    source, so moves strictly reduce the occupied-host count and the
+    loop terminates."""
+    plans: List[RepackPlan] = []
+    occupied = [v for v in views.values() if v.units and not v.pinned_mask]
+    occupied.sort(key=lambda v: (placement_lib.popcount(v.used_mask), v.name))
+    for view in occupied:
+        plans.append(RepackPlan(
+            target=f"consolidate {view.name} "
+                   f"({len(view.units)} unit(s)) for drain",
+            nodes=(view.name,),
+            units=tuple(sorted(view.units,
+                               key=lambda u: (u.pod_namespace, u.pod_name))),
+        ))
+    return plans
+
+
+def reclaimable_hosts(views: Dict[str, NodeView]) -> List[str]:
+    """Hosts with zero allocated chips — drainable right now."""
+    return sorted(n for n, v in views.items() if v.used_mask == 0)
+
+
+def largest_free_capacity(views: Dict[str, NodeView]) -> int:
+    """Sum over nodes of chips in the largest still-placeable profile —
+    the cluster-wide reading of the per-node
+    ``tpu_dra_node_frag_largest_free_profile`` gauge (bench_rebalance's
+    recovery metric)."""
+    return sum(
+        v.tables.largest_free_chips(v.used_mask, v.available)
+        for v in views.values()
+    )
+
+
+def build_node_views(
+    overview: Dict[str, dict],
+    claims: Sequence,
+    pods_by_uid: Dict[str, object],
+    tpu_driver_name: str,
+    device_types: Dict[Tuple[str, str], str],
+    is_cordoned,
+) -> Dict[str, NodeView]:
+    """Assemble per-node views from the allocator's placement overview
+    plus one claim/pod listing.
+
+    ``device_types``: (node, device name) -> published ``type`` attribute
+    (tpu/subslice/vfio/...) so passthrough devices pin their chips.
+    ``is_cordoned``: claim -> bool (the controller's cordon annotation)."""
+    views: Dict[str, NodeView] = {
+        node: NodeView(name=node, tables=entry["tables"],
+                       available=entry["available"],
+                       used_mask=entry["used_mask"])
+        for node, entry in overview.items()
+    }
+    # Pass 1: per-pod claim grouping with per-claim movability verdicts.
+    by_pod: Dict[str, List] = {}
+    for claim in claims:
+        alloc = claim.allocation
+        if alloc is None or alloc.node_name not in views:
+            continue
+        view = views[alloc.node_name]
+        dev_mask = overview[alloc.node_name]["dev_mask"]
+        mask = 0
+        movable = True
+        for r in alloc.devices:
+            bits = dev_mask.get(r.device, 0)
+            mask |= bits
+            if r.driver != tpu_driver_name:
+                movable = False  # channel/daemon: a ComputeDomain member
+            elif device_types.get((alloc.node_name, r.device)) == "vfio":
+                movable = False  # passthrough binds are host state
+            elif not bits:
+                movable = False  # device without chip counters: unknown
+        if is_cordoned(claim):
+            movable = False  # a migration is already in flight
+        pod_refs = [r for r in claim.reserved_for if r.kind == "Pod"]
+        if len(pod_refs) != 1 or len(pod_refs) != len(claim.reserved_for):
+            # Shared or consumer-less claims can't migrate pod-at-a-time:
+            # pin their chips where they are.
+            view.pinned_mask |= mask
+            continue
+        by_pod.setdefault(pod_refs[0].uid, []).append((claim, mask, movable))
+    # Pass 2: pod-level movability. A pod moves as ONE unit, so a single
+    # immovable claim (a ComputeDomain channel, a vfio group, a cordon)
+    # pins EVERY claim the pod holds — this is what guarantees assembled
+    # ComputeDomains are never disturbed: their workers all carry a
+    # channel claim.
+    for pod_uid, items in by_pod.items():
+        pod = pods_by_uid.get(pod_uid)
+        node = items[0][0].allocation.node_name
+        view = views[node]
+        unit_mask = 0
+        for _, mask, _m in items:
+            unit_mask |= mask
+        pod_movable = (
+            pod is not None
+            and pod.phase == "Running"
+            and not any(r.kind == "DaemonSet"
+                        for r in pod.meta.owner_references)
+            and all(m for _, _, m in items)
+            and all(c.allocation.node_name == node for c, _, _ in items)
+        )
+        if not pod_movable:
+            # Pin each claim's chips on its OWN node — a pod whose claims
+            # ended up on different nodes (a crashed mid-migration repoint)
+            # must pin every node it touches, not fold foreign bit
+            # positions into the first claim's view.
+            for c, mask, _m in items:
+                views[c.allocation.node_name].pinned_mask |= mask
+            continue
+        view.units.append(MigrationUnit(
+            pod_namespace=pod.meta.namespace,
+            pod_name=pod.meta.name,
+            pod_uid=pod_uid,
+            node=node,
+            claim_keys=tuple(sorted((c.meta.namespace, c.meta.name)
+                                    for c, _, _ in items)),
+            chip_mask=unit_mask,
+        ))
+    for view in views.values():
+        view.units.sort(key=lambda u: (u.pod_namespace, u.pod_name))
+    return views

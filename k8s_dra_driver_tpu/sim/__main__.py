@@ -60,15 +60,25 @@ def run_main(argv=None) -> int:
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="serve the cluster-wide /metrics + /debug/traces "
                         "here; 0 disables")
+    parser.add_argument("--rebalance", choices=("off", "defrag", "energy"),
+                        default="off",
+                        help="live-repack rebalancer mode (off, or defrag/"
+                        "energy; LiveRepack=true in --gates also enables "
+                        "defrag)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
     workdir = args.workdir or tempfile.mkdtemp(prefix="tpu-dra-sim-")
     srv = serve_api(host=args.host, port=args.port)
+    rebalancer_config = None
+    if args.rebalance != "off":
+        from k8s_dra_driver_tpu.rebalancer import RebalancerConfig
+
+        rebalancer_config = RebalancerConfig(mode=args.rebalance)
     sim = SimCluster(
         workdir=workdir, profile=args.profile, num_hosts=args.num_hosts,
-        gates=args.gates, api=srv.api,
+        gates=args.gates, api=srv.api, rebalancer_config=rebalancer_config,
     )
     sim.start()
     metrics_srv = None

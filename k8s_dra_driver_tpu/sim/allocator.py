@@ -743,6 +743,35 @@ class Allocator:
             "used_mask": self._used_mask(node),
         }
 
+    def placement_overview(self, driver: str) -> Dict[str, dict]:
+        """Bitmask placement view of EVERY placement-table-backed node for
+        one driver in a single allocation scan: node -> {tables, available,
+        dev_mask, used_mask}. This is the rebalancer's read surface — the
+        same state behind the ``tpu_dra_node_frag_largest_free_profile``
+        gauge, but as masks it can plan repack moves against."""
+        cache = self._feasibility_state()
+        index = self._device_index(self._list_slices())
+        masks: Dict[str, int] = {}
+        for alloc in self._list_allocations():
+            self._accrue_mask(masks, index, alloc, +1)
+        out: Dict[str, dict] = {}
+        for (drv, node), entry in cache["entries"].items():
+            if drv != driver or entry.get("tables") is None:
+                continue
+            out[node] = {
+                "tables": entry["tables"],
+                "available": entry["available"],
+                "dev_mask": dict(entry["dev_mask"]),
+                "used_mask": masks.get(node, 0),
+                # device name -> published `type` attribute (tpu/subslice/
+                # vfio/...), so the rebalancer can pin passthrough devices.
+                "dev_type": {
+                    d.name: d.attributes.get("type", "")
+                    for d in entry["devices"]
+                },
+            }
+        return out
+
     def feasible_nodes(self, claims, nodes: Optional[Iterable[str]] = None,
                        reasons: Optional[Dict[str, str]] = None) -> List[str]:
         """Pre-filter for the scheduler: node names on which every request

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from k8s_dra_driver_tpu.api.configs import (
     COMPUTE_DOMAIN_DRIVER_NAME,
     TPU_DRIVER_NAME,
+    channel_domain_uid,
 )
 from k8s_dra_driver_tpu.controller import Controller
 from k8s_dra_driver_tpu.controller.templates import (
@@ -147,6 +148,7 @@ class SimCluster:
         api: Optional[APIServer] = None,
         loopback_agents: bool = False,
         metrics_registry: Optional[Registry] = None,
+        rebalancer_config=None,
     ):
         """``loopback_agents=True`` registers slice agents with their real
         harness address (127.0.0.1 — everything runs in this process), so
@@ -205,7 +207,28 @@ class SimCluster:
         self.controller = Controller(
             self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600,
             metrics_registry=self.metrics_registry,
+            # Loopback runs launch real OS processes from the injected env:
+            # the jax.distributed coordinator binds the advertised port on
+            # THIS host, so it must be allocated free at DS render instead
+            # of the fixed default (which any unrelated process may hold).
+            dynamic_coordinator_port=loopback_agents,
         )
+        # Live repack: enabled by the LiveRepack gate (default policy) or an
+        # explicit RebalancerConfig (tests/bench tune budgets and mode).
+        self.rebalancer = None
+        if rebalancer_config is not None or self.gates.enabled("LiveRepack"):
+            from k8s_dra_driver_tpu.rebalancer import (
+                RebalanceController,
+                RebalancerConfig,
+            )
+
+            self.rebalancer = RebalanceController(
+                api=self.api,
+                allocator=self.allocator,
+                plugin_resolver=self._resolve_tpu_plugin,
+                config=rebalancer_config or RebalancerConfig(),
+                metrics_registry=self.metrics_registry,
+            )
         self._install_device_classes()
         lib_probe = MockTpuLib(profile, worker_id=0)
         self._profile_hosts = lib_probe.profile.num_hosts
@@ -406,6 +429,23 @@ class SimCluster:
         self._agent_pass()
         self.controller.drain(timeout=5)
         self._kubelet_pass()
+        self._rebalance_pass()
+
+    def _resolve_tpu_plugin(self, node_name: str):
+        node = self.nodes.get(node_name)
+        return node.tpu_driver if node else None
+
+    def _rebalance_pass(self) -> None:
+        """Live repack, after the kubelet pass so migrations see settled
+        claim/pod state and rebound pods are picked up next step. Disabled
+        (None) unless the LiveRepack gate or an explicit config turned the
+        rebalancer on."""
+        if self.rebalancer is None:
+            return
+        try:
+            self.rebalancer.step()
+        except Exception:  # noqa: BLE001 — repack is best-effort; a bad pass must not kill the sim
+            log.exception("rebalance pass failed")
 
     def _quiescence_token(self) -> tuple:
         """O(1) change-detection over every kind the control loops touch.
@@ -653,7 +693,7 @@ class SimCluster:
                 # domain's host-grid-aligned block so the assembled
                 # clique is ICI-contiguous, not just "N free hosts".
                 candidates = self._steer_domain_candidates(
-                    pod, unallocated, candidates)
+                    pod, unallocated, candidates, reject_reasons)
             placed = False
             for node in candidates:
                 results = []
@@ -758,37 +798,59 @@ class SimCluster:
         """The ComputeDomain a pod's claim set belongs to (via the channel
         claim's opaque ComputeDomainChannelConfig), or None."""
         for c in claims:
-            for cc in c.config:
-                if (cc.opaque is not None
-                        and cc.opaque.driver == COMPUTE_DOMAIN_DRIVER_NAME
-                        and cc.opaque.parameters.get("kind")
-                        == "ComputeDomainChannelConfig"):
-                    return self._domain_by_uid(
-                        cc.opaque.parameters.get("domain_id", ""))
+            uid = channel_domain_uid(c)
+            if uid:
+                return self._domain_by_uid(uid)
         return None
 
     def _steer_domain_candidates(self, pod: Pod, unallocated,
-                                 candidates: List[str]) -> List[str]:
+                                 candidates: List[str],
+                                 reject_reasons: Optional[Dict[str, str]]
+                                 = None) -> List[str]:
         """Host-grid-aligned domain placement. For a pod whose claims
         carry a ComputeDomain channel, prefer the domain's recorded
         host-grid block; when none is recorded yet, choose the most
         compact contiguous block of feasible hosts within one ICI domain
         (pkg.placement.choose_host_block) and record it in
-        ComputeDomainStatus. Preference only — if the block can't serve
-        (stolen capacity, heterogeneous nodes), the remaining feasible
-        nodes follow, so placement degrades instead of deadlocking."""
-        if len(candidates) <= 1:
+        ComputeDomainStatus.
+
+        When the cluster publishes host-grid coordinates but holds NO
+        contiguous free block of the requested size, the workers park as
+        unschedulable (empty candidate list) instead of degrading to
+        scattered hosts: an unaligned "domain" spans several ICI meshes,
+        can never assemble its clique, and strands whole hosts while it
+        waits — exactly the fragmentation signal the live-repack
+        rebalancer consumes to free a block. Clusters without host-grid
+        attributes (no topology published) keep the legacy unaligned
+        fallback. Once a block IS recorded, it is a preference — if its
+        capacity got stolen, the remaining feasible nodes follow, so
+        placement degrades instead of deadlocking."""
+        if not candidates:
             return candidates
         cd = self._pod_compute_domain(unallocated)
         if cd is None or cd.spec.num_nodes <= 1:
             return candidates
+        # Even a SINGLE feasible host must flow through the block check: a
+        # multi-host domain worker binding unaligned to a lone free host
+        # strands it (the channel claim pins the host against repack) and
+        # the domain can never assemble there anyway.
         planned = cd.status.placement
         if planned is None:
+            topologies = self.allocator.node_topologies()
             block = placement_lib.choose_host_block(
-                self.allocator.node_topologies(), candidates,
-                cd.spec.num_nodes)
+                topologies, candidates, cd.spec.num_nodes)
             if block is None:
-                return candidates
+                if not any(topologies.get(n, {}).get("host_coord")
+                           is not None for n in candidates):
+                    return candidates  # no grid info published: legacy path
+                if reject_reasons is not None:
+                    for n in candidates:
+                        reject_reasons.setdefault(
+                            n, f"free host outside any contiguous "
+                            f"{cd.spec.num_nodes}-host grid block for "
+                            f"ComputeDomain {cd.name} (fragmented: "
+                            f"awaiting churn or live repack)")
+                return []
             planned = ComputeDomainPlacement(
                 ici_domain=block.ici_domain,
                 block_origin=block.origin_str,

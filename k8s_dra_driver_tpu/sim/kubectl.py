@@ -337,6 +337,13 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
             lines += ["Nodes:"] + _table(rows)
         lines += _conditions_lines(obj.status.conditions, time.time())
     elif obj.kind == "Node":
+        from k8s_dra_driver_tpu.rebalancer.controller import (
+            DRAIN_READY_ANNOTATION,
+        )
+
+        if obj.meta.annotations.get(DRAIN_READY_ANNOTATION):
+            lines.append("Drain-ready: true (rebalancer: zero allocated "
+                         "chips — host is reclaimable)")
         for t in getattr(obj, "taints", []):
             lines.append(f"Taint: {t.key}={t.value}:{t.effect}")
         slices = [s for s in api.list("ResourceSlice")

@@ -45,13 +45,18 @@ def _collect_worker_envs(tmp_path):
         sim.stop()
 
 
-def _multiprocess_backend_available() -> bool:
-    """Capability probe: can the psum workers run a cross-process
-    collective at all? The workers below are pinned to JAX_PLATFORMS=cpu
+def _multiprocess_impl() -> str:
+    """The CPU collectives implementation the psum workers should use, or
+    "" when none works. The workers below are pinned to JAX_PLATFORMS=cpu
     regardless of the parent's backend, and XLA:CPU rejects multi-process
-    computations unless a CPU collectives implementation (gloo/mpi) is
+    computations unless a collectives implementation (gloo/mpi) is
     configured — bare XLA:CPU raises 'Multiprocess computations aren't
-    implemented on the CPU backend'."""
+    implemented on the CPU backend'.
+
+    An explicitly configured implementation wins; otherwise gloo is probed
+    EMPIRICALLY (a 2-process jax.distributed.initialize on an ephemeral
+    port) so the proof runs — instead of skipping — on any jaxlib that
+    ships gloo without the env var being set."""
     impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "")
     if not impl:
         try:
@@ -62,31 +67,70 @@ def _multiprocess_backend_available() -> bool:
             if not impl and getattr(jax.config,
                                     "jax_cpu_enable_gloo_collectives", False):
                 impl = "gloo"
-        except Exception:  # noqa: BLE001 — conservative: treat as absent
+        except Exception:  # noqa: BLE001 — fall through to the probe
             impl = ""
-    return bool(impl) and impl != "none"
+    if impl:
+        return "" if impl == "none" else impl
+    return "gloo" if _gloo_probe_works() else ""
 
 
-def _require_coordinator_port_free(addr: str) -> None:
-    """The injected coordinator port is fixed (8476); an unrelated process
-    holding it would fail every worker with a misleading timeout — skip
-    with the real cause instead."""
+def _gloo_probe_works() -> bool:
     import socket
 
-    host, _, port = addr.partition(":")
-    try:
-        with socket.socket() as s:
-            s.bind((host, int(port)))
-    except OSError as e:
-        pytest.skip(f"coordinator port {addr} unavailable on this host: {e}")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # The probe must run a REAL cross-process collective, not just
+    # initialize: some jaxlibs initialize fine and then reject the
+    # computation ("Multiprocess computations aren't implemented on the
+    # CPU backend") when the collectives impl didn't actually bind.
+    code = (
+        "import os, jax\n"
+        "try:\n"
+        "    jax.config.update('jax_cpu_collectives_implementation',"
+        " 'gloo')\n"
+        "except (AttributeError, ValueError):\n"
+        "    pass\n"
+        "jax.distributed.initialize("
+        f"coordinator_address='127.0.0.1:{port}', num_processes=2, "
+        "process_id=int(os.environ['PROBE_PID']))\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, "
+        "PartitionSpec as P\n"
+        "mesh = Mesh(np.array(jax.devices()), ('d',))\n"
+        "arr = jax.make_array_from_process_local_data("
+        "NamedSharding(mesh, P('d')), "
+        "np.ones(jax.local_device_count()))\n"
+        "out = jax.jit(lambda a: a.sum(), "
+        "out_shardings=NamedSharding(mesh, P()))(arr)\n"
+        "assert float(jax.device_get(out)) == len(jax.devices())\n"
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code],
+            env={**os.environ, "PROBE_PID": str(i), "JAX_PLATFORMS": "cpu",
+                 "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for i in range(2)
+    ]
+    ok = True
+    for p in procs:
+        try:
+            ok = p.wait(timeout=90) == 0 and ok
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return False
+    return ok
 
 
 def test_multiprocess_psum_from_injected_env(tmp_path):
-    if not _multiprocess_backend_available():
+    impl = _multiprocess_impl()
+    if not impl:
         pytest.skip(
-            "CPU backend has no multiprocess collectives implementation "
-            "configured (set JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo on a "
-            "jaxlib built with gloo support)"
+            "CPU backend has no working multiprocess collectives "
+            "implementation (gloo probe failed and none configured)"
         )
     envs = _collect_worker_envs(tmp_path)
 
@@ -98,7 +142,11 @@ def test_multiprocess_psum_from_injected_env(tmp_path):
     assert len(coords) == 1
     coord = coords.pop()
     assert coord.startswith("127.0.0.1:")
-    _require_coordinator_port_free(coord)
+    # Loopback sims allocate the coordinator port dynamically at DaemonSet
+    # render (bound free on THIS host), so the proof never has to skip
+    # because some unrelated process holds the fixed well-known port.
+    port = int(coord.rpartition(":")[2])
+    assert port > 0
 
     procs = []
     for env in envs:
@@ -113,10 +161,9 @@ def test_multiprocess_psum_from_injected_env(tmp_path):
             "PYTHONPATH": REPO,
             "JAX_PLATFORMS": "cpu",
         })
-        # The capability the skip above probed must reach the workers.
-        impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "")
-        if impl:
-            penv["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = impl
+        # The capability the probe above established must reach the
+        # workers (the probe may have selected gloo without any env set).
+        penv["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = impl
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "k8s_dra_driver_tpu.ops.psum_proof"],
             env=penv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,

@@ -197,6 +197,42 @@ def test_two_host_domain_picks_compact_block(tmp_path):
         sim.stop()
 
 
+def test_multi_host_domain_parks_instead_of_binding_unaligned(tmp_path):
+    """A multi-host domain with host-grid info but NO contiguous free
+    block must park its workers as unschedulable — even when exactly ONE
+    feasible host remains (the pre-fix early return bound the worker
+    there unaligned, stranding the host: its channel claim pins it
+    against live repack and the domain can never assemble). v5e-4 hosts
+    are single-host slices (1x1 host grid), so a 2-node domain can never
+    be ICI-contiguous at all."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2)
+    sim.start()
+    try:
+        for obj in load_manifests(WHOLE_RCT):
+            sim.api.create(obj)
+        _block_node(sim, "tpu-node-1", 0)  # exactly one free host remains
+        sim.settle(max_steps=8)
+        for obj in load_manifests(CD_MANIFEST % {"num_nodes": 2}):
+            sim.api.create(obj)
+        for i in range(2):
+            for obj in load_manifests(WORKER % {"i": i}):
+                sim.api.create(obj)
+        sim.settle(max_steps=15)
+        workers = [p for p in sim.api.list(POD, namespace="grid")]
+        assert len(workers) == 2
+        assert all(p.phase == "Pending" and not p.node_name
+                   for p in workers), [
+            (p.meta.name, p.phase, p.node_name) for p in workers]
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert cd.status.placement is None
+        events = [e for e in sim.api.list("Event", namespace="grid")
+                  if e.reason == "FailedScheduling"]
+        assert events and any("grid block" in e.message for e in events), [
+            e.message for e in events]
+    finally:
+        sim.stop()
+
+
 def test_domain_placed_event_and_describe(tmp_path):
     """The chosen block is narrated: a DomainPlaced event on the CD and a
     Placement line in `describe computedomains`."""

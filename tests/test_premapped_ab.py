@@ -1,0 +1,59 @@
+"""The premapped A/B probe must surface a dead child's stderr (round-5
+advisor finding: a libtpu init failure used to die as a bare
+CalledProcessError with the diagnostic swallowed)."""
+
+import json
+import subprocess
+
+import pytest
+
+from k8s_dra_driver_tpu.ops import premapped_ab
+
+
+class _FakeCompleted:
+    def __init__(self, returncode=0, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def test_run_child_raises_with_stderr_tail(monkeypatch):
+    def fake_run(*args, **kwargs):
+        assert kwargs.get("check") is False  # never a bare CalledProcessError
+        return _FakeCompleted(
+            returncode=1,
+            stderr="...\nRuntimeError: Unable to initialize backend 'tpu': "
+                   "libtpu.so not found\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(premapped_ab.ChildFailed) as exc:
+        premapped_ab._run_child(64, None)
+    assert "libtpu.so not found" in str(exc.value)
+    assert exc.value.returncode == 1
+
+
+def test_main_reports_child_stderr_in_json_error(monkeypatch, capsys):
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: _FakeCompleted(returncode=2,
+                                       stderr="fatal: no TPU platform"))
+    rc = premapped_ab.main(["--size-mib", "16"])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out)
+    assert out["binds"] is None
+    assert "exited 2" in out["error"]
+    assert "no TPU platform" in out["child_stderr_tail"]
+
+
+def test_main_happy_path_still_parses_child_json(monkeypatch, capsys):
+    results = iter([
+        {"transfer_s": 0.30, "platform": "tpu"},   # clamped child
+        {"transfer_s": 0.10, "platform": "tpu"},   # unconstrained child
+    ])
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: _FakeCompleted(stdout=json.dumps(next(results))))
+    rc = premapped_ab.main([])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["binds"] is True and out["ratio"] == 3.0

@@ -1,0 +1,370 @@
+"""Unit tier for the live-repack subsystem: planner minimality, the
+DeviceState MigrationCheckpoint handshake (crash-window included), and the
+controller's migration budget."""
+
+import pytest
+
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
+from k8s_dra_driver_tpu.rebalancer.planner import (
+    MigrationUnit,
+    NodeView,
+    WHOLE_HOST,
+    largest_free_capacity,
+    plan_consolidation,
+    plan_domain_block,
+    plan_profile,
+    profile_placeable,
+    reclaimable_hosts,
+)
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+def _view(name, used=0, pinned=0, units=(), topo="2x2"):
+    tables = placement_lib.tables_for(topo)
+    return NodeView(name=name, tables=tables,
+                    available=tables.all_placements_bitmap,
+                    used_mask=used, pinned_mask=pinned, units=list(units))
+
+
+def _unit(name, node, mask, ns="default"):
+    return MigrationUnit(pod_namespace=ns, pod_name=name, pod_uid=f"u-{name}",
+                         node=node, claim_keys=((ns, f"{name}-claim"),),
+                         chip_mask=mask)
+
+
+# -- planner ------------------------------------------------------------------
+
+
+def test_plan_profile_none_when_already_placeable():
+    views = {"n0": _view("n0", used=0b0001,
+                         units=[_unit("a", "n0", 0b0001)]),
+             "n1": _view("n1")}
+    assert profile_placeable(views, WHOLE_HOST)
+    assert plan_profile(views, WHOLE_HOST) is None
+
+
+def test_plan_profile_picks_fewest_blockers():
+    """Whole-host demand, n0 holds two single-chip units, n1 holds one:
+    the minimal plan vacates n1 with exactly its one unit."""
+    views = {
+        "n0": _view("n0", used=0b0011,
+                    units=[_unit("a", "n0", 0b0001),
+                           _unit("b", "n0", 0b0010)]),
+        "n1": _view("n1", used=0b0100, units=[_unit("c", "n1", 0b0100)]),
+    }
+    plan = plan_profile(views, WHOLE_HOST)
+    assert plan is not None
+    assert plan.nodes == ("n1",)
+    assert [u.pod_name for u in plan.units] == ["c"]
+
+
+def test_plan_profile_tie_breaks_on_chips_moved():
+    """Equal blocker counts: the placement moving fewer chips wins —
+    the 'minimal claim set' is measured in units first, chips second."""
+    views = {
+        "n0": _view("n0", used=0b0011, units=[_unit("two", "n0", 0b0011)]),
+        "n1": _view("n1", used=0b0100, units=[_unit("one", "n1", 0b0100)]),
+    }
+    plan = plan_profile(views, WHOLE_HOST)
+    assert plan.nodes == ("n1",)
+    assert plan.units[0].pod_name == "one"
+
+
+def test_plan_profile_skips_pinned_placements():
+    """A placement overlapping a pinned chip (domain member, vfio, shared
+    claim) can never be freed by migration; with every node pinned the
+    plan is None rather than a doomed migration."""
+    views = {
+        "n0": _view("n0", used=0b0001, pinned=0b0001),
+        "n1": _view("n1", used=0b0010, pinned=0b0010),
+    }
+    assert plan_profile(views, WHOLE_HOST) is None
+
+
+def test_plan_profile_subslice_target():
+    """A 1x2 subslice demand on a 2x2 host: chips {0,1} and {2,3} are the
+    placements; blocking unit sits on chip 0, a pinned claim on chip 2 —
+    only the {0,1} placement is freeable and its single blocker is the
+    plan."""
+    views = {
+        "n0": _view("n0", used=0b0101, pinned=0b0100,
+                    units=[_unit("a", "n0", 0b0001)]),
+    }
+    plan = plan_profile(views, "1x2")
+    assert plan is not None
+    assert plan.placement_mask == 0b0011
+    assert [u.pod_name for u in plan.units] == ["a"]
+
+
+def _grid_topologies(num_slices=2, hosts_per_slice=4):
+    topo = {}
+    for s in range(num_slices):
+        for h in range(hosts_per_slice):
+            topo[f"n{s * hosts_per_slice + h}"] = {
+                "ici_domain": f"slice-{s}",
+                "slice_topology": "4x4",
+                "host_topology": "2x2",
+                "host_coord": placement_lib.host_grid_coord("4x4", "2x2", h),
+            }
+    return topo
+
+
+def test_plan_domain_block_picks_cheapest_block():
+    """Two slices of four hosts; slice-0 carries 3 scattered units,
+    slice-1 carries 1 — the domain plan vacates slice-1."""
+    topo = _grid_topologies()
+    views = {}
+    for i in range(8):
+        name = f"n{i}"
+        views[name] = _view(name)
+    for i, node in enumerate(["n0", "n1", "n2"]):
+        u = _unit(f"s0-{i}", node, 0b0001)
+        views[node].units.append(u)
+        views[node].used_mask = 0b0001
+    views["n5"].units.append(_unit("s1-0", "n5", 0b0001))
+    views["n5"].used_mask = 0b0001
+    plan = plan_domain_block(views, topo, 4)
+    assert plan is not None
+    assert set(plan.nodes) == {"n4", "n5", "n6", "n7"}
+    assert [u.pod_name for u in plan.units] == ["s1-0"]
+
+
+def test_plan_domain_block_none_when_free_block_exists():
+    topo = _grid_topologies()
+    views = {f"n{i}": _view(f"n{i}") for i in range(8)}
+    views["n0"].units.append(_unit("a", "n0", 0b0001))
+    views["n0"].used_mask = 0b0001
+    assert plan_domain_block(views, topo, 4) is None
+
+
+def test_plan_domain_block_excludes_pinned_hosts():
+    """A pinned claim anywhere on a block makes the whole block
+    non-vacatable — assembled ComputeDomain members are never planned
+    against."""
+    topo = _grid_topologies()
+    views = {f"n{i}": _view(f"n{i}") for i in range(8)}
+    for i in range(4):  # slice-0: assembled domain (pinned whole hosts)
+        views[f"n{i}"].used_mask = 0b1111
+        views[f"n{i}"].pinned_mask = 0b1111
+    views["n5"].units.append(_unit("x", "n5", 0b0001))
+    views["n5"].used_mask = 0b0001
+    plan = plan_domain_block(views, topo, 4)
+    assert plan is not None
+    assert set(plan.nodes) == {"n4", "n5", "n6", "n7"}
+
+
+def test_plan_consolidation_orders_emptiest_first():
+    views = {
+        "n0": _view("n0", used=0b0111, units=[_unit("big", "n0", 0b0111)]),
+        "n1": _view("n1", used=0b0001, units=[_unit("small", "n1", 0b0001)]),
+        "n2": _view("n2"),
+        "n3": _view("n3", used=0b0001, pinned=0b0001),  # immovable: skipped
+    }
+    plans = plan_consolidation(views)
+    assert [p.nodes[0] for p in plans] == ["n1", "n0"]
+    assert reclaimable_hosts(views) == ["n2"]
+    # capacity: n0 has 1 free chip (largest profile 1x1), n1 has a 1x2
+    # left free ({2,3}), n2 whole host, n3 like n1.
+    assert largest_free_capacity(views) == 1 + 2 + 4 + 2
+
+
+def test_request_profile_detection_legacy_and_cel():
+    """Demand detection reads the demanded profile from allocationMode,
+    legacy selectors, AND the common CEL equality shape — a CEL-expressed
+    subslice claim must trigger defrag too."""
+    from k8s_dra_driver_tpu.k8s.core import DeviceRequest
+    from k8s_dra_driver_tpu.rebalancer.controller import RebalanceController
+
+    rp = RebalanceController._request_profile
+    assert rp(DeviceRequest(name="r", device_class_name="c",
+                            allocation_mode="All")) == WHOLE_HOST
+    assert rp(DeviceRequest(name="r", device_class_name="c",
+                            selectors=["profile=1x2"])) == "1x2"
+    assert rp(DeviceRequest(
+        name="r", device_class_name="c",
+        cel_selectors=['device.attributes["tpu.google.com"].profile'
+                       ' == "2x2"'])) == "2x2"
+    assert rp(DeviceRequest(
+        name="r", device_class_name="c",
+        cel_selectors=['device.attributes["profile"] == \'2x1\''])) == "2x1"
+    assert rp(DeviceRequest(name="r", device_class_name="c", count=2)) is None
+
+
+# -- DeviceState MigrationCheckpoint handshake --------------------------------
+
+
+def _make_state(tmp_path, stub=None):
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.partitioner import StubPartitionClient
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    from k8s_dra_driver_tpu.pkg.partitioner import PartitionManager
+
+    stub = stub or StubPartitionClient()
+    state = DeviceState(
+        MockTpuLib("v5e-4"), str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+    )
+    # Share one stub ledger across restarts (crash-recovery tests): the
+    # manager re-seeds its active set from the stub's active_ids() the way
+    # NativePartitionClient does from its on-disk ledger.
+    state.partitions = PartitionManager(state.inventory.host_topology, stub)
+    return state, stub
+
+
+def _subslice_claim(name="mig-claim"):
+    from tests.test_tpu_plugin import make_claim
+
+    return make_claim(["tpu-subslice-1x2-at-0x0"], name=name)
+
+
+def test_migrate_out_releases_devices_and_keeps_record(tmp_path):
+    from k8s_dra_driver_tpu.plugins.checkpoint import MIGRATION_CHECKPOINTED
+
+    state, stub = _make_state(tmp_path)
+    claim = _subslice_claim()
+    state.prepare(claim)
+    assert stub.active_ids(), "subslice prepare must activate a partition"
+    entry = state.migrate_out(claim.uid)
+    # Devices released: partition ledger empty, CDI spec gone…
+    assert stub.active_ids() == []
+    assert state.cdi.read_claim_spec(claim.uid) is None
+    # …but the checkpoint keeps the migration record with the source
+    # placement's devices.
+    kept = state.prepared_claims()[claim.uid]
+    assert kept.state == MIGRATION_CHECKPOINTED
+    assert kept.migration_started_at > 0
+    assert [d.name for d in kept.devices] == ["tpu-subslice-1x2-at-0x0"]
+    assert [d.name for d in entry.devices] == ["tpu-subslice-1x2-at-0x0"]
+
+
+def test_migrate_out_refuses_unprepared_claim(tmp_path):
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import MigrationError
+
+    state, _ = _make_state(tmp_path)
+    with pytest.raises(MigrationError):
+        state.migrate_out("no-such-claim")
+
+
+def test_end_migration_drops_entry(tmp_path):
+    state, stub = _make_state(tmp_path)
+    claim = _subslice_claim()
+    state.prepare(claim)
+    state.migrate_out(claim.uid)
+    state.end_migration(claim.uid)
+    assert claim.uid not in state.prepared_claims()
+    assert stub.active_ids() == []
+    state.end_migration(claim.uid)  # idempotent
+
+
+def test_reprepare_clears_migration_entry_rollback_to_source(tmp_path):
+    """The rollback-to-source path: a mid-migration claim re-preparing on
+    its source node clears the MigrationCheckpoint entry and ends with
+    exactly its original partition active — zero leaks, zero duplicates."""
+    from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+
+    state, stub = _make_state(tmp_path)
+    claim = _subslice_claim()
+    state.prepare(claim)
+    before = stub.active_ids()
+    state.migrate_out(claim.uid)
+    res = state.prepare(claim)
+    assert [d.name for d in res.devices] == ["tpu-subslice-1x2-at-0x0"]
+    assert state.prepared_claims()[claim.uid].state == PREPARE_COMPLETED
+    assert stub.active_ids() == before
+
+
+def test_crash_inside_migrate_out_cannot_leak_partitions(tmp_path):
+    """Kill the migration in its worst window — MigrationCheckpoint
+    persisted, devices NOT yet released. The restarted plugin's
+    destroy_unknown_partitions frees the partition (the entry is not
+    PrepareCompleted) and the next prepare starts clean."""
+    from k8s_dra_driver_tpu.plugins.checkpoint import MIGRATION_CHECKPOINTED
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+        FAULT_MIGRATION_CHECKPOINTED,
+    )
+
+    state, stub = _make_state(tmp_path)
+    claim = _subslice_claim()
+    state.prepare(claim)
+
+    def crash(point):
+        if point == FAULT_MIGRATION_CHECKPOINTED:
+            raise RuntimeError("injected crash mid-migration")
+
+    state.fault_hook = crash
+    with pytest.raises(RuntimeError):
+        state.migrate_out(claim.uid)
+    # The crash left the partition active and the entry persisted.
+    assert stub.active_ids() != []
+    assert (state.prepared_claims()[claim.uid].state
+            == MIGRATION_CHECKPOINTED)
+
+    restarted, stub2 = _make_state(tmp_path, stub=stub)
+    # Re-seed the manager's active set from the shared stub ledger the way
+    # NativePartitionClient does across restarts.
+    freed = restarted.destroy_unknown_partitions()
+    assert freed == 1
+    assert stub.active_ids() == []
+    res = restarted.prepare(claim)
+    assert [d.name for d in res.devices] == ["tpu-subslice-1x2-at-0x0"]
+    assert stub.active_ids() != []
+
+
+# -- controller budget --------------------------------------------------------
+
+
+def test_migration_budget_token_bucket(tmp_path):
+    """burst=1, refill=0: the second planned migration defers instead of
+    running — the rebalancer can never become its own churn storm."""
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.rebalancer import MODE_ENERGY, RebalancerConfig
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    rct = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: single, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+    cfg = RebalancerConfig(mode=MODE_ENERGY, max_migrations_per_pass=8,
+                           migration_burst=1, migration_refill_per_s=0.0)
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=4,
+                     rebalancer_config=cfg)
+    sim.start()
+    try:
+        for obj in load_manifests(rct):
+            sim.api.create(obj)
+        for w in range(3):
+            pod = f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: frag-{w}, namespace: default}}
+spec:
+  nodeName: tpu-node-{w}
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: single}}]
+"""
+            for obj in load_manifests(pod):
+                sim.api.create(obj)
+        sim.settle(max_steps=20)
+        m = sim.rebalancer.metrics
+        assert m.migrations_total.value("migrated") == 1.0
+        assert m.deferred_total.value() >= 1.0
+        # Exactly one pod moved; the others sit where they were pinned.
+        nodes = sorted(p.node_name for p in sim.api.list(POD))
+        assert len(set(nodes)) == 2, nodes
+    finally:
+        sim.stop()

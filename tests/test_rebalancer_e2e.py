@@ -1,0 +1,416 @@
+"""Live-repack e2e tier.
+
+The acceptance scenario (ISSUE 7): a 64-node sim fragmented by scattered
+v5e-1 claims cannot place a v5e-16 ComputeDomain; the rebalancer migrates
+the MINIMAL claim set, the domain then assembles on a contiguous host
+block (bitmask-verified), and no assembled ComputeDomain member is
+disturbed. Plus: migration fault injection (rollback to the source
+placement with zero leaked ICI partitions and a deduped MigrationFailed
+event) and energy-mode consolidation with the drain-ready surface.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.rebalancer import (
+    DRAIN_READY_ANNOTATION,
+    MODE_ENERGY,
+    RebalancerConfig,
+)
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+from k8s_dra_driver_tpu.tpulib.types import parse_topology
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+SINGLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: single, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+
+SUBSLICE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: sub12, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: subslice.tpu.google.com, count: 1, selectors: ["profile=1x2"]}}]
+"""
+
+WHOLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: default}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+CD_MANIFEST = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: %(ns)s}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: %(name)s, namespace: %(ns)s}
+spec:
+  numNodes: %(num_nodes)d
+  channel:
+    resourceClaimTemplate: {name: %(name)s-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: %(ns)s}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+CD_WORKER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: %(name)s-worker-%(i)d, namespace: %(ns)s}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: %(name)s-channel}
+"""
+
+
+def _pinned_pod(name, node, rct="single", ns="default"):
+    return f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: {name}, namespace: {ns}}}
+spec:
+  nodeName: {node}
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: {rct}}}]
+"""
+
+
+def _apply(sim, text):
+    for obj in load_manifests(text):
+        sim.api.create(obj)
+
+
+def _worker_chip_coords(sim, pod) -> set:
+    """Global slice-grid coords of every chip allocated to one worker."""
+    coords = set()
+    node = sim.nodes[pod.node_name]
+    by_index = {c.index: c for c in node.tpulib.enumerate().chips}
+    for claim in sim.api.list(RESOURCE_CLAIM, namespace=pod.namespace):
+        if not any(r.uid == pod.uid for r in claim.reserved_for):
+            continue
+        if claim.allocation is None:
+            continue
+        for r in claim.allocation.devices:
+            if r.driver != "tpu.google.com":
+                continue
+            dev = node.tpu_driver.state.allocatable[r.device]
+            for idx in dev.chip_indices:
+                coords.add(tuple(by_index[idx].coords))
+    return coords
+
+
+def _events(sim, reason, namespace=None):
+    evs = (sim.api.list("Event", namespace=namespace) if namespace
+           else sim.api.list("Event"))
+    return [e for e in evs if e.reason == reason]
+
+
+def test_defrag_restores_domain_placement_minimal_migration(tmp_path):
+    """THE acceptance scenario: 64 v5e-16 hosts (16 slices of 4), one
+    assembled domain on slice 0, scattered v5e-1 claims blocking every
+    other slice's 2x2 host block — two per slice except slice 9, which has
+    exactly one. A new 4-host domain cannot place; the rebalancer must
+    migrate EXACTLY that one claim (the minimal set), the domain then
+    assembles on slice 9's contiguous block with its chips tiling the full
+    4x4 slice grid, and the assembled domain on slice 0 is untouched."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=64,
+                     rebalancer_config=RebalancerConfig(
+                         max_migrations_per_pass=8))
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT)
+        # Assembled domain X on the (deterministically chosen) slice 0.
+        _apply(sim, CD_MANIFEST % {
+            "ns": "gridx", "name": "domain-x", "num_nodes": 4})
+        for i in range(4):
+            _apply(sim, CD_WORKER % {"ns": "gridx", "name": "domain-x",
+                                     "i": i})
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "domain-x", "gridx")
+            .status.status == "Ready", max_steps=40)
+        x_workers = {p.meta.name: p for p in sim.api.list(POD,
+                                                          namespace="gridx")
+                     if p.meta.name.startswith("domain-x-worker")}
+        x_nodes = {p.node_name for p in x_workers.values()}
+        assert x_nodes == {f"tpu-node-{i}" for i in range(4)}, x_nodes
+        x_allocs_before = {
+            c.meta.name: [(r.driver, r.device) for r in c.allocation.devices]
+            for c in sim.api.list(RESOURCE_CLAIM, namespace="gridx")
+            if c.allocation is not None
+        }
+
+        # Fragment every remaining slice: slices 1-15 get scattered
+        # single-chip claims — two per slice, except slice 9 gets ONE.
+        minimal_slice = 9
+        small = []
+        for s in range(1, 16):
+            hosts = [f"tpu-node-{4 * s}", f"tpu-node-{4 * s + 1}"]
+            if s == minimal_slice:
+                hosts = hosts[:1]
+            for j, node in enumerate(hosts):
+                name = f"small-{s}-{j}"
+                _apply(sim, _pinned_pod(name, node))
+                small.append(name)
+        sim.settle(max_steps=40)
+        pods = {p.meta.name: p for p in sim.api.list(POD,
+                                                     namespace="default")}
+        assert all(pods[n].phase == "Running" for n in small), [
+            (n, pods[n].phase) for n in small if pods[n].phase != "Running"]
+
+        # Domain Y: no contiguous 2x2 host block exists anywhere.
+        _apply(sim, CD_MANIFEST % {
+            "ns": "gridy", "name": "domain-y", "num_nodes": 4})
+        for i in range(4):
+            _apply(sim, CD_WORKER % {"ns": "gridy", "name": "domain-y",
+                                     "i": i})
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "domain-y", "gridy")
+            .status.status == "Ready", max_steps=60), [
+                (p.meta.name, p.phase)
+                for p in sim.api.list(POD, namespace="gridy")]
+
+        # Minimality: exactly ONE claim migrated — slice 9's lone blocker.
+        m = sim.rebalancer.metrics
+        assert m.migrations_total.value("migrated") == 1.0
+        assert m.migrations_total.value("failed") == 0.0
+        migrated_events = _events(sim, "ClaimMigrated")
+        assert len(migrated_events) == 1, [
+            (e.involved_object.name, e.message) for e in migrated_events]
+        assert "tpu-node-36" in migrated_events[0].message
+        planned = _events(sim, "RebalancePlanned", namespace="gridy")
+        assert planned and "domain-y" in planned[0].message
+
+        # The domain landed on slice 9's full 2x2 host-grid block…
+        cd = sim.api.get(COMPUTE_DOMAIN, "domain-y", "gridy")
+        block_nodes = {f"tpu-node-{i}" for i in range(36, 40)}
+        assert cd.status.placement is not None
+        assert set(cd.status.placement.nodes) == block_nodes
+        assert cd.status.placement.block_shape == "2x2"
+        y_workers = [p for p in sim.api.list(POD, namespace="gridy")
+                     if p.meta.name.startswith("domain-y-worker")]
+        assert {p.node_name for p in y_workers} == block_nodes
+        assert len({sim.nodes[p.node_name].tpulib.enumerate().ici_domain
+                    for p in y_workers}) == 1
+
+        # …with the union of its chips tiling the ENTIRE 4x4 slice grid,
+        # bitmask-verified.
+        coords = set()
+        for p in y_workers:
+            got = _worker_chip_coords(sim, p)
+            assert len(got) == 4, (p.meta.name, got)
+            coords |= got
+        dims = parse_topology("4x4")
+        mask = 0
+        for c in coords:
+            mask |= 1 << (c[0] * dims[1] + c[1])
+        assert mask == (1 << (dims[0] * dims[1])) - 1, bin(mask)
+
+        # Domain X was never disturbed: same nodes, same allocations,
+        # still Ready, zero migrations against its claims.
+        for name, before in x_workers.items():
+            now = sim.api.get(POD, name, "gridx")
+            assert now.node_name == before.node_name
+            assert now.phase == "Running"
+        x_allocs_after = {
+            c.meta.name: [(r.driver, r.device) for r in c.allocation.devices]
+            for c in sim.api.list(RESOURCE_CLAIM, namespace="gridx")
+            if c.allocation is not None
+        }
+        assert x_allocs_after == x_allocs_before
+        assert (sim.api.get(COMPUTE_DOMAIN, "domain-x", "gridx")
+                .status.status == "Ready")
+
+        # The migrated small pod still runs, on some node outside both
+        # domains' blocks.
+        victim = sim.api.get(POD, "small-9-0", "default")
+        assert victim.phase == "Running"
+        assert victim.node_name not in block_nodes | x_nodes
+        assert victim.injected_env.get("TPU_VISIBLE_CHIPS")
+    finally:
+        sim.stop()
+
+
+def test_migration_failure_rolls_back_to_source_placement(tmp_path):
+    """Satellite: kill the migration between unprepare and re-prepare (the
+    target node's prepare crashes after its PrepareStarted write). The
+    claim must roll back to its source placement — same node, same
+    devices, original ICI partition active, nothing on the target — with a
+    deduplicated MigrationFailed event. Clearing the fault lets the retry
+    complete and the stranded whole-host demand place."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=3,
+                     gates="ICIPartitioning=true,DynamicSubslice=true",
+                     rebalancer_config=RebalancerConfig())
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT)
+        _apply(sim, SUBSLICE_RCT)
+        _apply(sim, WHOLE_RCT)
+        # node0: the victim (a 1x2 subslice claim holding an ICI
+        # partition). node1: two singles (2 units — more expensive to
+        # vacate). node2: a whole-host pod (1 unit but 4 chips).
+        _apply(sim, _pinned_pod("victim", "tpu-node-0", rct="sub12"))
+        _apply(sim, _pinned_pod("one-a", "tpu-node-1"))
+        _apply(sim, _pinned_pod("one-b", "tpu-node-1"))
+        _apply(sim, _pinned_pod("full", "tpu-node-2", rct="whole"))
+        sim.settle(max_steps=20)
+        assert all(p.phase == "Running"
+                   for p in sim.api.list(POD, namespace="default"))
+
+        src_state = sim.nodes["tpu-node-0"].tpu_driver.state
+        dst_state = sim.nodes["tpu-node-1"].tpu_driver.state
+        src_parts_before = [p.id for p in
+                            src_state.partitions.active_partitions()]
+        assert src_parts_before, "subslice prepare must hold a partition"
+        victim_claim = next(
+            c for c in sim.api.list(RESOURCE_CLAIM, namespace="default")
+            if c.meta.name.startswith("victim"))
+        devices_before = [r.device for r in victim_claim.allocation.devices]
+
+        # Inject the crash on the TARGET node: its batched prepare dies
+        # right after the PrepareStarted write — exactly "between
+        # unprepare and re-prepare" of the migration pipeline.
+        from k8s_dra_driver_tpu.plugins.checkpoint import (
+            FAULT_STARTED_PERSISTED,
+        )
+
+        def crash(point):
+            if point == FAULT_STARTED_PERSISTED:
+                raise RuntimeError("injected migration crash")
+
+        dst_state.fault_hook = crash
+
+        # Whole-host demand: only node0 is worth vacating (1 unit, 2
+        # chips) -> the rebalancer tries to migrate the victim to node1
+        # and MUST roll back. Let it retry at least twice for dedup.
+        _apply(sim, """
+apiVersion: v1
+kind: Pod
+metadata: {name: big, namespace: default}
+spec:
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimTemplateName: whole}]
+""")
+        for _ in range(3):
+            sim.step()
+        failed = sim.rebalancer.metrics.migrations_total.value("failed")
+        assert failed >= 2.0, failed
+
+        # Rolled back to the source placement: same node, same devices,
+        # source partition ledger EXACTLY as before, target holds nothing.
+        claim = sim.api.get(RESOURCE_CLAIM, victim_claim.meta.name,
+                            "default")
+        assert claim.allocation.node_name == "tpu-node-0"
+        assert [r.device for r in claim.allocation.devices] == devices_before
+        assert [p.id for p in src_state.partitions.active_partitions()] \
+            == src_parts_before
+        assert dst_state.partitions.active_partitions() == []
+        assert victim_claim.uid not in dst_state.prepared_claims()
+        assert victim_claim.uid in src_state.prepared_claims()
+        from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+        assert (src_state.prepared_claims()[victim_claim.uid].state
+                == PREPARE_COMPLETED)
+        pod = sim.api.get(POD, "victim", "default")
+        assert pod.node_name == "tpu-node-0"
+        assert pod.phase == "Running"
+
+        # Deduplicated MigrationFailed: ONE event row aggregating every
+        # failed attempt.
+        fails = _events(sim, "MigrationFailed", namespace="default")
+        assert len(fails) == 1, [(e.meta.name, e.message) for e in fails]
+        assert fails[0].count >= 2
+        assert "rolled back to its source placement" in fails[0].message
+
+        # Clear the fault: the retry completes, the victim lands on node1
+        # with its partition carved there, and the whole-host demand runs
+        # on the freed node0. End state: zero leaked partitions anywhere.
+        dst_state.fault_hook = None
+        sim.settle(max_steps=30)
+        big = sim.api.get(POD, "big", "default")
+        assert big.phase == "Running", big.meta.annotations
+        assert big.node_name == "tpu-node-0"
+        victim_pod = sim.api.get(POD, "victim", "default")
+        assert victim_pod.phase == "Running"
+        assert victim_pod.node_name == "tpu-node-1"
+        assert src_state.partitions.active_partitions() == []
+        assert [p.profile for p in
+                dst_state.partitions.active_partitions()] == ["1x2"]
+        ok = _events(sim, "ClaimMigrated", namespace="default")
+        assert len(ok) == 1
+    finally:
+        sim.stop()
+
+
+def test_energy_mode_consolidates_and_marks_drain_ready(tmp_path):
+    """Energy mode: scattered single-chip claims consolidate onto the
+    fewest hosts; emptied hosts are counted in tpu_dra_reclaimable_hosts,
+    listed by drain_ready_hosts(), annotated, and rendered by describe."""
+    from k8s_dra_driver_tpu.sim.kubectl import describe_object
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=8,
+                     rebalancer_config=RebalancerConfig(
+                         mode=MODE_ENERGY, max_migrations_per_pass=8))
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT)
+        for w in range(4):
+            _apply(sim, _pinned_pod(f"frag-{w}", f"tpu-node-{w}"))
+        sim.settle(max_steps=30)
+        pods = {p.meta.name: p for p in sim.api.list(POD,
+                                                     namespace="default")}
+        assert all(p.phase == "Running" for p in pods.values())
+        # All four claims consolidated onto ONE host (a v5e-4 host holds
+        # exactly 4 single-chip claims).
+        homes = {p.node_name for p in pods.values()}
+        assert len(homes) == 1, homes
+        home = homes.pop()
+        for p in pods.values():
+            assert p.injected_env.get("TPU_VISIBLE_CHIPS"), p.meta.name
+
+        m = sim.rebalancer.metrics
+        assert m.migrations_total.value("migrated") == 3.0
+        assert m.migrations_total.value("failed") == 0.0
+        assert m.reclaimable_hosts.value() == 7.0
+        drainable = sim.rebalancer.drain_ready_hosts()
+        assert len(drainable) == 7 and home not in drainable
+
+        # The drain-ready surface: Node annotations + describe rendering.
+        annotated = {n.meta.name
+                     for n in sim.api.list("Node")
+                     if n.meta.annotations.get(DRAIN_READY_ANNOTATION)}
+        assert annotated == set(drainable)
+        out = describe_object(sim.api, "Node", sorted(drainable)[0])
+        assert "Drain-ready: true" in out
+        out_home = describe_object(sim.api, "Node", home)
+        assert "Drain-ready" not in out_home
+    finally:
+        sim.stop()
