@@ -29,10 +29,14 @@ bench:
 
 # CI-sized bench pass: prepare-latency headline (20 iters) + batched
 # prepare amortization + a 4-node scheduler storm + the 64-node indexed
-# scheduler storm with a hard probes-per-bind budget assertion (a
-# feasibility-filter regression fails this target). Capped at 5 min.
+# scheduler storm with a hard probes-per-bind budget assertion + the
+# 2048-node scale-out gate (p99 claim-to-running budget, >=2x durable
+# sharded-vs-single-lock write throughput with 8 writer threads, zero
+# watch-ordering violations, fingerprint-identical WAL restore;
+# BENCH_SCALE_NODES overrides the node count — full runs use 8192).
+# Capped at 10 min.
 bench-smoke:
-	timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py --smoke
+	timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --smoke
 
 # Pre-merge gate: the tpulint invariant analyzer (which subsumes the
 # metrics-docs and event-reasons checks) plus the tier-1 pytest run (the

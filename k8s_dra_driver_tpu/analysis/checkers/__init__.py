@@ -14,5 +14,6 @@ from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
     event_discipline,
     swallowed_exceptions,
     thread_shared_state,
+    shard_lock,
     docs_sync,
 )

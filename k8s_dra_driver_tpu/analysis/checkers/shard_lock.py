@@ -1,0 +1,240 @@
+"""shard-lock: per-shard state mutates only under its own shard's lock.
+
+The scale-out store (PR 8) partitions its indexes into shard objects,
+each carrying its own lock: ``class _Shard`` declares its bucket dicts
+with ``# tpulint: guarded-by=mu``. thread-shared-state covers ``self.X``
+mutations *inside* a class; this rule covers the cross-object accesses a
+sharded design creates:
+
+1. **External guarded mutation.** Code mutating ``<obj>.<attr>`` where
+   ``attr`` is declared guarded in some class of the same file must hold
+   that instance's lock: lexically inside ``with <obj>.<lock>:``, inside
+   a function annotated ``# tpulint: holds=<lock>`` (callers lock), or
+   under the canonical whole-store acquire (a ``with ..._locked_all():``
+   ancestor, which holds every shard's lock by construction).
+2. **Ordered multi-shard acquire.** Holding two different instances'
+   locks of the same lock attribute (``with a.mu: ... with b.mu:``), or
+   raw ``.acquire()`` calls on a non-self shard lock, deadlocks the
+   moment two threads disagree on order — allowed ONLY inside the one
+   canonical helper annotated ``# tpulint: ordered-acquire``.
+
+Instance-internal locks (``self._mu``-style, base ``self``) keep their
+fixed hierarchy and are out of scope here — rule 2 looks at non-self
+bases only, where instance identity (not the attribute name) decides
+the order.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    ancestors,
+    dotted,
+    enclosing_function,
+)
+from k8s_dra_driver_tpu.analysis.checkers.thread_shared_state import (
+    GUARDED_RE,
+    HOLDS_RE,
+    _MUTATORS,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+ORDERED_RE = re.compile(r"#\s*tpulint:\s*ordered-acquire")
+
+
+def _base_and_attr(node: ast.AST) -> Tuple[Optional[ast.AST], Optional[str]]:
+    """``<base>.<attr>`` (one optional subscript unwrapped) -> (base
+    node, attr). Returns (None, None) for anything else."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.value, node.attr
+    return None, None
+
+
+@register_checker
+class ShardLockChecker(Checker):
+    rule = "shard-lock"
+    description = ("per-shard guarded state mutates only under its own "
+                   "shard's lock; multi-shard acquisition only via the "
+                   "canonical ordered-acquire helper")
+    hint = ("wrap the mutation in `with <obj>.<lock>:` (or annotate the "
+            "helper `# tpulint: holds=<lock>`); multi-shard work goes "
+            "through the `# tpulint: ordered-acquire` helper")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        guards = self._file_guards(sf)
+        findings = self._check_external_mutations(sf, guards)
+        findings.extend(self._check_multi_acquire(sf, set(guards.values())))
+        return findings
+
+    # -- discovery -----------------------------------------------------------
+
+    @staticmethod
+    def _file_guards(sf: SourceFile) -> Dict[str, str]:
+        """attr -> lock attr, from every ``# tpulint: guarded-by=`` line
+        in the file — whether declared via ``self.X = ...`` (__init__
+        style) or a bare ``X: ... = ...`` class field."""
+        guards: Dict[str, str] = {}
+        for lineno in range(1, len(sf.lines) + 1):
+            line = sf.line(lineno)
+            m = GUARDED_RE.search(line)
+            if not m:
+                continue
+            am = re.search(r"(?:self\.)?([A-Za-z_][A-Za-z0-9_]*)\s*[:=]", line)
+            if am:
+                guards[am.group(1)] = m.group(1)
+        return guards
+
+    # -- rule 1: external guarded mutation ----------------------------------
+
+    def _check_external_mutations(self, sf: SourceFile,
+                                  guards: Dict[str, str]) -> List[Finding]:
+        if not guards:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            base = attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target] if isinstance(node, ast.AugAssign)
+                           else node.targets)
+                for t in targets:
+                    b, a = _base_and_attr(t)
+                    if a in guards:
+                        base, attr = b, a
+                        break
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATORS):
+                b, a = _base_and_attr(node.func.value)
+                if a in guards:
+                    base, attr = b, a
+            if attr is None:
+                continue
+            base_dotted = dotted(base) if base is not None else ""
+            if base_dotted == "self":
+                continue  # thread-shared-state owns in-class accesses
+            lock = guards[attr]
+            if self._holds_instance_lock(sf, node, base_dotted, lock):
+                continue
+            fn = enclosing_function(node, sf.parents)
+            if fn is not None and getattr(fn, "name", "") == "__init__":
+                continue  # construction: the instance isn't shared yet
+            if fn is not None and lock in self._fn_holds(sf, fn):
+                continue
+            findings.append(self.finding(
+                sf, node,
+                f"{base_dotted or '<expr>'}.{attr} (guarded-by={lock}) "
+                f"mutated without holding that instance's `{lock}` — "
+                f"shard state torn under concurrent writers",
+            ))
+        return findings
+
+    @staticmethod
+    def _fn_holds(sf: SourceFile, fn) -> Set[str]:
+        if isinstance(fn, ast.Lambda):
+            return set()
+        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
+        out: Set[str] = set()
+        for n in range(max(1, fn.lineno - 1), first_stmt + 1):
+            m = HOLDS_RE.search(sf.line(n))
+            if m:
+                out.add(m.group(1))
+        return out
+
+    @staticmethod
+    def _holds_instance_lock(sf: SourceFile, node: ast.AST,
+                             base_dotted: str, lock: str) -> bool:
+        """Inside ``with <base>.<lock>:`` for the SAME base expr, or under
+        the canonical whole-store acquire (``with ..._locked_all():``)."""
+        want = f"{base_dotted}.{lock}" if base_dotted else None
+        for anc in ancestors(node, sf.parents):
+            if not isinstance(anc, ast.With):
+                continue
+            for item in anc.items:
+                ce = item.context_expr
+                if want and dotted(ce) == want:
+                    return True
+                if (isinstance(ce, ast.Call)
+                        and isinstance(ce.func, ast.Attribute)
+                        and ce.func.attr == "_locked_all"):
+                    return True
+        return False
+
+    # -- rule 2: multi-shard acquisition -------------------------------------
+
+    def _check_multi_acquire(self, sf: SourceFile,
+                             lock_names: Set[str]) -> List[Finding]:
+        if not lock_names:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            # Nested `with a.<lock>:` inside `with b.<lock>:`, same lock
+            # attr, different non-self instances.
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    got = self._shard_lock_expr(item.context_expr, lock_names)
+                    if got is None:
+                        continue
+                    base, lock = got
+                    for anc in ancestors(node, sf.parents):
+                        if not isinstance(anc, ast.With):
+                            continue
+                        for outer in anc.items:
+                            outer_got = self._shard_lock_expr(
+                                outer.context_expr, lock_names)
+                            if (outer_got is not None
+                                    and outer_got[1] == lock
+                                    and outer_got[0] != base
+                                    and not self._ordered(sf, node)):
+                                findings.append(self.finding(
+                                    sf, node,
+                                    f"second shard lock `.{lock}` taken "
+                                    f"while holding `{outer_got[0]}.{lock}`"
+                                    f" — multi-shard acquisition only via "
+                                    f"the ordered-acquire helper",
+                                ))
+            # Raw .acquire() on a non-self shard lock.
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "acquire"):
+                got = self._shard_lock_expr(node.func.value, lock_names)
+                if got is not None and not self._ordered(sf, node):
+                    findings.append(self.finding(
+                        sf, node,
+                        f"raw `{got[0]}.{got[1]}.acquire()` outside the "
+                        f"ordered-acquire helper — unordered multi-shard "
+                        f"acquisition deadlocks",
+                    ))
+        return findings
+
+    @staticmethod
+    def _shard_lock_expr(node: ast.AST,
+                         lock_names: Set[str]) -> Optional[Tuple[str, str]]:
+        """``<non-self base>.<lockattr>`` -> (base dotted, lockattr)."""
+        if not isinstance(node, ast.Attribute) or node.attr not in lock_names:
+            return None
+        base = dotted(node.value)
+        if not base or base == "self" or base.startswith("self."):
+            return None
+        return base, node.attr
+
+    @staticmethod
+    def _ordered(sf: SourceFile, node: ast.AST) -> bool:
+        """The enclosing function (or its def line) carries the
+        ``# tpulint: ordered-acquire`` annotation."""
+        fn = enclosing_function(node, sf.parents)
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
+        return any(ORDERED_RE.search(sf.line(n))
+                   for n in range(max(1, fn.lineno - 1), first_stmt + 1))

@@ -97,6 +97,12 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         requires=("ComputeDomainCliques",),
     ),
     FeatureSpec(
+        "StorePersistence", False, Stage.ALPHA,
+        "Back the sim API store with an append-only WAL plus periodic "
+        "snapshots so a large-cluster sim survives restart by replay "
+        "instead of re-running its claim storm.",
+    ),
+    FeatureSpec(
         "LiveRepack", False, Stage.ALPHA,
         "Run the online defragmentation rebalancer: migrate small-subslice "
         "claims (cordon -> checkpoint-aware unprepare -> re-place -> "

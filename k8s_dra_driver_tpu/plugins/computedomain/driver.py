@@ -168,16 +168,21 @@ class ComputeDomainDriver:
     def start(self, cleanup_interval_s: float = 600.0) -> None:
         self.publish_resources()
         self._stop_evt = threading.Event()
-        self._cleanup_thread = threading.Thread(
-            target=self._cleanup_loop, args=(cleanup_interval_s,),
-            name="cd-tombstone-cleanup", daemon=True,
-        )
-        self._cleanup_thread.start()
+        self._cleanup_thread = None
+        if cleanup_interval_s > 0:
+            # interval <= 0 disables the timer thread (see TpuDriver.start:
+            # thousands of in-process sim plugins must not each own one).
+            self._cleanup_thread = threading.Thread(
+                target=self._cleanup_loop, args=(cleanup_interval_s,),
+                name="cd-tombstone-cleanup", daemon=True,
+            )
+            self._cleanup_thread.start()
 
     def shutdown(self) -> None:
         if getattr(self, "_stop_evt", None) is not None:
             self._stop_evt.set()
-            self._cleanup_thread.join(timeout=5)
+            if self._cleanup_thread is not None:
+                self._cleanup_thread.join(timeout=5)
 
     def healthy(self) -> bool:
         """Registration-status leg of the healthcheck probe (health.go:145)."""

@@ -137,10 +137,16 @@ class TpuDriver:
             if hasattr(self.state.tpulib, "watch_link_health"):
                 self.state.tpulib.watch_link_health(self._on_link_health_event)
         self.publish_resources()
-        self._cleanup_thread = threading.Thread(
-            target=self._cleanup_loop, name="checkpoint-cleanup", daemon=True
-        )
-        self._cleanup_thread.start()
+        if self._cleanup_interval > 0:
+            # interval <= 0 disables the timer thread entirely: a sim
+            # running thousands of in-process plugins cannot afford one
+            # thread per node (the container's thread/PID cap, not memory,
+            # is what limits cluster size), and its event-driven GC pass
+            # performs this same sweep deterministically.
+            self._cleanup_thread = threading.Thread(
+                target=self._cleanup_loop, name="checkpoint-cleanup", daemon=True
+            )
+            self._cleanup_thread.start()
         self._registered = True
 
     def shutdown(self) -> None:

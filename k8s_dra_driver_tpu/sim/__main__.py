@@ -65,12 +65,26 @@ def run_main(argv=None) -> int:
                         help="live-repack rebalancer mode (off, or defrag/"
                         "energy; LiveRepack=true in --gates also enables "
                         "defrag)")
+    parser.add_argument("--persist-dir", default="",
+                        help="back the API store with a WAL+snapshot in this "
+                        "directory: a restarted sim restores the previous "
+                        "run's state (fingerprint-identical) instead of "
+                        "re-running its storm")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
     workdir = args.workdir or tempfile.mkdtemp(prefix="tpu-dra-sim-")
-    srv = serve_api(host=args.host, port=args.port)
+    api = None
+    if args.persist_dir:
+        from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
+
+        api = open_persistent_store(args.persist_dir)
+        if api.restored_objects:
+            print(f"restored {api.restored_objects} objects from "
+                  f"{args.persist_dir} in {api.restore_seconds:.1f}s",
+                  flush=True)
+    srv = serve_api(api=api, host=args.host, port=args.port)
     rebalancer_config = None
     if args.rebalance != "off":
         from k8s_dra_driver_tpu.rebalancer import RebalancerConfig

@@ -167,6 +167,11 @@ class AllocatorPassMetrics:
             "tpu_dra_allocator_pass_infeasible_skipped",
             "Nodes the feasibility pre-filter excluded last pass — "
             "probes the indexed scheduler never issued."))
+        self.feasibility_cache_hits = registry.register(Gauge(
+            "tpu_dra_allocator_pass_feasibility_cache_hits",
+            "Pods whose candidate list was served from the pass-shared "
+            "admission snapshot last pass instead of a fresh "
+            "feasibility computation."))
         self.frag_largest_free = registry.register(Gauge(
             "tpu_dra_node_frag_largest_free_profile",
             "Chips in the largest still-placeable subslice profile "
@@ -193,12 +198,15 @@ class AllocatorPassMetrics:
         self.feasibility_checked.set(value=float(stats["feasibility_checked"]))
         self.feasible_nodes.set(value=float(stats["feasible_nodes"]))
         self.infeasible_skipped.set(value=float(stats["infeasible_skipped"]))
+        self.feasibility_cache_hits.set(
+            value=float(stats["feasibility_cache_hits"]))
 
 
 def _pass_stats() -> Dict[str, int]:
     return {"nodes_probed": 0, "plans_compiled": 0, "plans_cached": 0,
             "commits": 0, "rollbacks": 0, "feasibility_checked": 0,
-            "feasible_nodes": 0, "infeasible_skipped": 0}
+            "feasible_nodes": 0, "infeasible_skipped": 0,
+            "feasibility_cache_hits": 0}
 
 
 class Allocator:
@@ -833,6 +841,17 @@ class Allocator:
                 len(candidates) - len(scored))
         scored.sort()
         return [node for _, node in scored]
+
+    def note_feasible_cached(self, count: int) -> None:
+        """The scheduler served one pod's candidate list from its
+        pass-shared admission snapshot (no fresh computation). Count the
+        served nodes exactly as a fresh feasible_nodes() call would, so
+        ``probes <= feasible admitted`` stays a meaningful per-pass
+        invariant under snapshot gang admission."""
+        snap = self._pass_snapshot
+        if snap is not None:
+            snap["stats"]["feasible_nodes"] += count
+            snap["stats"]["feasibility_cache_hits"] += 1
 
     def _infeasibility_reason(self, cache: dict, node: str, plans,
                               consumed) -> str:

@@ -129,6 +129,56 @@ _QUIESCENCE_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
 _PodKey = Tuple[str, str]  # (namespace, name)
 
 
+class _PassAdmission:
+    """One shared admission snapshot per scheduler pass: the whole dirty
+    Pending batch admits against it instead of recomputing per pod.
+
+    - ``feasible``: claim-shape -> the ordered candidate list one
+      ``feasible_nodes`` call produced. Capacity only shrinks during a
+      pass (allocations commit, never release), so a cached list stays a
+      valid SUPERSET of the truly feasible nodes: stale entries cost a
+      cheap failed probe, after which ``prune`` drops them so sibling
+      pods of the same shape stop re-probing (the storm case: thousands
+      of identical single-chip claims resolve against ONE feasibility
+      computation per pass). Because pruning is heuristic for multi-claim
+      pods (a joint-sibling failure is pod-specific), a pod is only
+      parked unschedulable after a FRESH recompute confirms it.
+    - ``domains``: ComputeDomain-by-uid cache so a gang of domain workers
+      resolves its domain (and its recorded host-grid block) once per
+      pass instead of listing ComputeDomains per worker — the gang
+      places in one pass with one block computation.
+    """
+
+    __slots__ = ("feasible", "domains")
+
+    def __init__(self) -> None:
+        self.feasible: Dict[tuple, List[str]] = {}
+        self.domains: Dict[str, object] = {}
+
+    @staticmethod
+    def shape_of(claims) -> tuple:
+        """Feasibility-relevant identity of a claim set: feasible_nodes()
+        depends only on the requests' class/selectors/count/mode (plus
+        cluster state shared across the pass), never on claim names."""
+        return tuple(
+            (req.device_class_name, tuple(req.selectors),
+             tuple(getattr(req, "cel_selectors", ())), req.count,
+             req.allocation_mode)
+            for c in claims for req in c.requests
+        )
+
+    def prune(self, shape: tuple, node: str) -> None:
+        """Drop a node whose probe failed from the shape's cached list —
+        mid-pass capacity never comes back, so it cannot turn feasible
+        again for this shape before the next pass."""
+        cached = self.feasible.get(shape)
+        if cached is not None:
+            try:
+                cached.remove(node)
+            except ValueError:
+                pass
+
+
 @dataclass
 class SimNode:
     name: str
@@ -149,6 +199,7 @@ class SimCluster:
         loopback_agents: bool = False,
         metrics_registry: Optional[Registry] = None,
         rebalancer_config=None,
+        persist_dir: Optional[str] = None,
     ):
         """``loopback_agents=True`` registers slice agents with their real
         harness address (127.0.0.1 — everything runs in this process), so
@@ -157,10 +208,19 @@ class SimCluster:
         multi-process collective proof). Combine with
         ``SliceAgentsWithDNSNames=false`` so clique members publish the
         raw address instead of sim-only DNS names."""
+        self.gates = fg.parse(gates)
+        if api is None and (persist_dir is not None
+                            or self.gates.enabled("StorePersistence")):
+            # WAL+snapshot-backed store: a restarted sim replays the
+            # previous run's state instead of re-running its storm. The
+            # bootstrap below tolerates already-present Nodes/classes.
+            from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
+
+            api = open_persistent_store(
+                persist_dir or os.path.join(workdir, "store"))
         self.api = api if api is not None else APIServer()
         self.workdir = workdir
         self.loopback_agents = loopback_agents
-        self.gates = fg.parse(gates)
         # One cluster-wide registry: every node plugin, the controller,
         # and the allocator expose on it (per-node series merge — the
         # sim's /metrics reads as a cluster aggregate).
@@ -203,6 +263,9 @@ class SimCluster:
         # watch stream — the agent pass never re-lists pods to find its
         # containers.
         self._agent_pods: Dict[Tuple[str, str], Pod] = {}
+        # Pass-scoped admission snapshot (shape-keyed feasibility + domain
+        # cache); non-None only while a scheduler pass is running.
+        self._admission: Optional[_PassAdmission] = None
         self._bootstrapped = False
         self.controller = Controller(
             self.api, driver_namespace=DRIVER_NAMESPACE, cleanup_interval_s=3600,
@@ -272,7 +335,10 @@ class SimCluster:
                 pass  # attaching to a server that was already seeded
 
     def _add_node(self, name: str, worker_id: int) -> None:
-        self.api.create(Node(meta=new_meta(name)))
+        try:
+            self.api.create(Node(meta=new_meta(name)))
+        except AlreadyExistsError:
+            pass  # restored/pre-seeded store already holds this node
         # --num-hosts beyond the profile's host count models additional
         # independent slices (a GKE node pool of several pod slices): node
         # w is host w % H of slice w // H, each slice with its own ICI
@@ -307,6 +373,12 @@ class SimCluster:
             gates=self.gates,
             vfio=vfio_mgr,
             metrics_registry=self.metrics_registry,
+            # No per-plugin cleanup timer threads in the sim: at 8192
+            # nodes that would be 16k threads (the container's PID cap
+            # kills the process long before memory runs out) and the
+            # sim's event-driven _gc_pass performs the same stale sweep
+            # deterministically.
+            cleanup_interval_s=0,
         )
         cd = ComputeDomainDriver(
             api=self.api, node_name=name, tpulib=lib,
@@ -316,7 +388,7 @@ class SimCluster:
             metrics_registry=self.metrics_registry,
         )
         tpu.start()
-        cd.start()
+        cd.start(cleanup_interval_s=0)
         self.nodes[name] = SimNode(name=name, tpulib=lib, tpu_driver=tpu, cd_driver=cd)
 
     def start(self) -> None:
@@ -331,6 +403,12 @@ class SimCluster:
         self.controller.stop()
         for kind, q in self._watch_queues.items():
             self.api.stop_watch(kind, q)
+        wal = getattr(self.api, "_wal", None)
+        if wal is not None:
+            # Final compaction: the next run restores from one snapshot
+            # decode instead of a long record replay.
+            wal.compact(self.api)
+            wal.close()
 
     # -- event ingestion ---------------------------------------------------------
 
@@ -341,6 +419,12 @@ class SimCluster:
         waiting a whole step."""
         if not self._bootstrapped:
             self._bootstrap_dirty()
+        # Kick the store's off-lock fan-out: if another thread (controller,
+        # plugin pool) enqueued events and was descheduled mid-dispatch,
+        # this drain becomes the dispatcher instead of missing them.
+        flush = getattr(self.api, "flush_watchers", None)
+        if flush is not None:
+            flush()
         for kind, q in self._watch_queues.items():
             while True:
                 try:
@@ -599,9 +683,11 @@ class SimCluster:
         # allocator.commit(), so the snapshot cannot double-book.
         with tracing.span("scheduler.pass") as sp:
             self.allocator.begin_pass()
+            self._admission = _PassAdmission()
             try:
                 self._scheduler_pass_inner()
             finally:
+                self._admission = None
                 self.allocator.end_pass()
                 # Per-pass allocator decisions ride on the span: nodes
                 # probed, plans cached vs compiled, commits/rollbacks.
@@ -673,72 +759,60 @@ class SimCluster:
         feasible_note = ""
         if unallocated:
             reject_reasons: Dict[str, str] = {}
-            if candidates is None:
+            adm = self._admission
+            shape = adm.shape_of(unallocated) if adm is not None else None
+            pinned = candidates is not None
+            cached = False
+            if not pinned:
                 # Feasibility pre-filter: only nodes that can possibly
                 # satisfy every unallocated claim, in packing-aware
                 # order (tightest-fit first for partial-node claim sets,
-                # emptiest-first for whole-node/domain ones).
-                try:
-                    feasible = self.allocator.feasible_nodes(
-                        unallocated, reasons=reject_reasons)
-                except AllocationError as e:
-                    msg = f"allocation: {e}"
-                    self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
-                    self._fail_pod(pod, msg)
-                    return "failed"
-                candidates = [n for n in feasible if n in self.nodes]
-                feasible_note = (f"feasibility filter admitted "
-                                 f"{len(candidates)}/{len(self.nodes)} nodes")
-                # Multi-host ComputeDomain workers: steer onto the
-                # domain's host-grid-aligned block so the assembled
-                # clique is ICI-contiguous, not just "N free hosts".
-                candidates = self._steer_domain_candidates(
-                    pod, unallocated, candidates, reject_reasons)
-            placed = False
-            for node in candidates:
-                results = []
-                ok = True
-                for c in unallocated:
-                    # Sibling claims computed this pass count as
-                    # consumed, or two claims of one pod double-book.
-                    try:
-                        r = self.allocator.allocate_on_node(
-                            c, node, in_flight=[r for _, r in results])
-                    except AllocationError as e:
-                        # A malformed class/selector must fail THIS
-                        # pod visibly, not abort the scheduler pass
-                        # for every other pod.
-                        msg = f"allocation: {e}"
-                        self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
-                        self._fail_pod(pod, msg)
+                # emptiest-first for whole-node/domain ones). The whole
+                # dirty batch shares ONE computation per claim shape:
+                # capacity only shrinks mid-pass, so the cached list is a
+                # superset pruned as probes fail, and a pod only parks
+                # after a fresh recompute confirms (below).
+                feasible = adm.feasible.get(shape) if adm is not None else None
+                if feasible is not None:
+                    cached = True
+                    self.allocator.note_feasible_cached(len(feasible))
+                    candidates = [n for n in feasible if n in self.nodes]
+                    feasible_note = (f"feasibility filter admitted "
+                                     f"{len(candidates)}/{len(self.nodes)} nodes")
+                    candidates = self._steer_domain_candidates(
+                        pod, unallocated, candidates, reject_reasons)
+                else:
+                    got = self._fresh_candidates(
+                        pod, unallocated, shape, reject_reasons)
+                    if got is None:
                         return "failed"
-                    if r is None:
-                        ok = False
-                        reject_reasons.setdefault(
-                            node, f"claim {c.meta.name!r} does not fit "
-                            "jointly with its siblings")
-                        break
-                    results.append((c, r))
-                if ok:
-                    for c, r in results:
-                        # Consumers are recorded by the reserve loop
-                        # below; allocation only here.
-                        def set_alloc(obj, r=r, node=node):
-                            obj.allocation = r
-                            set_condition(obj.conditions, CLAIM_COND_ALLOCATED,
-                                          CONDITION_TRUE, "Allocated",
-                                          f"allocated on {node}")
-                        self.api.update_with_retry(
-                            RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
-                        )
-                        self.allocator.commit(r)
-                    chosen = node
-                    placed = True
-                    break
-            if not placed:
+                    candidates, feasible_note = got
+            prune_shape = shape if (adm is not None and not pinned) else None
+            status, chosen_node = self._try_place_on(
+                pod, unallocated, candidates, reject_reasons, prune_shape)
+            if status == "failed":
+                return "failed"
+            if status == "noplace" and cached:
+                # The shared snapshot said feasible but every probe failed:
+                # the cache may simply be stale (siblings consumed the
+                # capacity this pass). Recompute fresh — with full per-node
+                # reasons — and give the pod one authoritative retry
+                # before parking it.
+                reject_reasons.clear()
+                got = self._fresh_candidates(
+                    pod, unallocated, shape, reject_reasons)
+                if got is None:
+                    return "failed"
+                candidates, feasible_note = got
+                status, chosen_node = self._try_place_on(
+                    pod, unallocated, candidates, reject_reasons, shape)
+                if status == "failed":
+                    return "failed"
+            if status == "noplace":
                 log.debug("pod %s: unschedulable this pass", pod.key)
                 self._record_unschedulable(pod, unallocated, reject_reasons)
                 return "unschedulable"
+            chosen = chosen_node
         if not chosen:
             if candidates is None:
                 # No claims and no pin (a plain pod): any node will do.
@@ -783,14 +857,102 @@ class SimCluster:
                 pass
         return "bound"
 
+    def _fresh_candidates(self, pod: Pod, unallocated, shape: Optional[tuple],
+                          reject_reasons: Dict[str, str]):
+        """One authoritative feasibility computation for a pod: run the
+        allocator pre-filter (storing the result into the pass admission
+        cache), apply the node filter, and steer multi-host ComputeDomain
+        workers onto their host-grid block. Returns (candidates, note),
+        or None after failing the pod visibly (malformed class/selector).
+        Both admission paths — first look and the stale-cache retry —
+        go through here so they can never drift."""
+        try:
+            feasible = self.allocator.feasible_nodes(
+                unallocated, reasons=reject_reasons)
+        except AllocationError as e:
+            msg = f"allocation: {e}"
+            self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+            self._fail_pod(pod, msg)
+            return None
+        adm = self._admission
+        if adm is not None and shape is not None:
+            adm.feasible[shape] = feasible
+        candidates = [n for n in feasible if n in self.nodes]
+        note = (f"feasibility filter admitted "
+                f"{len(candidates)}/{len(self.nodes)} nodes")
+        # Multi-host ComputeDomain workers: steer onto the domain's
+        # host-grid-aligned block so the assembled clique is
+        # ICI-contiguous, not just "N free hosts".
+        candidates = self._steer_domain_candidates(
+            pod, unallocated, candidates, reject_reasons)
+        return candidates, note
+
+    def _try_place_on(self, pod: Pod, unallocated, candidates,
+                      reject_reasons: Dict[str, str],
+                      prune_shape: Optional[tuple]):
+        """Probe candidates in order and write the winning allocation.
+        Returns ('placed', node), ('failed', None) — the pod was failed
+        visibly — or ('noplace', None). With ``prune_shape``, a node whose
+        probe fails is dropped from the admission snapshot's cached list
+        so later same-shape pods of this pass skip it."""
+        adm = self._admission
+        for node in candidates:
+            results = []
+            ok = True
+            for c in unallocated:
+                # Sibling claims computed this pass count as
+                # consumed, or two claims of one pod double-book.
+                try:
+                    r = self.allocator.allocate_on_node(
+                        c, node, in_flight=[r for _, r in results])
+                except AllocationError as e:
+                    # A malformed class/selector must fail THIS
+                    # pod visibly, not abort the scheduler pass
+                    # for every other pod.
+                    msg = f"allocation: {e}"
+                    self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, msg)
+                    self._fail_pod(pod, msg)
+                    return "failed", None
+                if r is None:
+                    ok = False
+                    reject_reasons.setdefault(
+                        node, f"claim {c.meta.name!r} does not fit "
+                        "jointly with its siblings")
+                    if adm is not None and prune_shape is not None:
+                        adm.prune(prune_shape, node)
+                    break
+                results.append((c, r))
+            if ok:
+                for c, r in results:
+                    # Consumers are recorded by the reserve loop in
+                    # _schedule_pod; allocation only here.
+                    def set_alloc(obj, r=r, node=node):
+                        obj.allocation = r
+                        set_condition(obj.conditions, CLAIM_COND_ALLOCATED,
+                                      CONDITION_TRUE, "Allocated",
+                                      f"allocated on {node}")
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, set_alloc
+                    )
+                    self.allocator.commit(r)
+                return "placed", node
+        return "noplace", None
+
     def _domain_by_uid(self, uid: str, namespace: Optional[str] = None):
-        """Linear ComputeDomain-by-uid lookup (domains are few)."""
+        """ComputeDomain-by-uid lookup: the pass admission cache when a
+        scheduler pass is active (a gang of workers resolves its domain
+        once), a linear listing otherwise (domains are few)."""
         if not uid:
             return None
+        adm = self._admission
+        if adm is not None and uid in adm.domains:
+            return adm.domains[uid]
         domains = (self.api.list(COMPUTE_DOMAIN, namespace=namespace)
                    if namespace else self.api.list(COMPUTE_DOMAIN))
         for cd in domains:
             if cd.uid == uid:
+                if adm is not None:
+                    adm.domains[uid] = cd
                 return cd
         return None
 
@@ -862,10 +1024,15 @@ class SimCluster:
                 if obj.status.placement is None:
                     obj.status.placement = planned
             try:
-                self.api.update_with_retry(
+                updated = self.api.update_with_retry(
                     COMPUTE_DOMAIN, cd.name, cd.namespace, set_placement)
             except NotFoundError:
                 return candidates
+            if self._admission is not None:
+                # The gang's later workers must see the recorded block,
+                # not the stale pre-placement cache entry.
+                self._admission.domains[updated.uid] = updated
+            planned = updated.status.placement or planned
             self.sched_recorder.normal(
                 cd, REASON_DOMAIN_PLACED,
                 f"placed domain on host-grid block {planned.block_shape}"

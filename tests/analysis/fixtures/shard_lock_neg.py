@@ -1,0 +1,51 @@
+"""Negative fixture: compliant shard-lock usage — zero findings."""
+
+import threading
+
+
+class _Bucket:
+    def __init__(self):
+        self.mu = threading.RLock()
+        self.objects = {}  # tpulint: guarded-by=mu
+        self.fp = {}  # tpulint: guarded-by=mu
+
+
+class _AllLocked:
+    def __init__(self, shards):
+        self._shards = shards
+
+    def __enter__(self):  # tpulint: ordered-acquire
+        for shard in self._shards:
+            shard.mu.acquire()
+
+    def __exit__(self, *exc):
+        for shard in reversed(self._shards):
+            shard.mu.release()
+
+
+class Store:
+    def __init__(self):
+        self.shards = [_Bucket() for _ in range(4)]
+
+    def _locked_all(self):
+        return _AllLocked(self.shards)
+
+    def good_locked_write(self, shard, key, obj):
+        with shard.mu:
+            shard.objects[key] = obj
+
+    @staticmethod
+    def good_annotated_helper(shard, key, obj):
+        # tpulint: holds=mu (every caller takes the shard lock)
+        shard.objects[key] = obj
+        shard.fp[key[0]] = (1, 2)
+
+    def good_whole_store_scan(self, key):
+        with self._locked_all():
+            for shard in self.shards:
+                shard.objects.pop(key, None)
+
+    def good_same_instance_reentrant(self, shard):
+        with shard.mu:
+            with shard.mu:  # re-entrant same instance: no ordering hazard
+                return len(shard.objects)

@@ -1,0 +1,34 @@
+"""Positive fixture: shard-lock violations the rule must catch."""
+
+import threading
+
+
+class _Bucket:
+    def __init__(self):
+        self.mu = threading.RLock()
+        self.objects = {}  # tpulint: guarded-by=mu
+        self.fp = {}  # tpulint: guarded-by=mu
+
+
+class Store:
+    def __init__(self):
+        self.shards = [_Bucket() for _ in range(4)]
+
+    def bad_unlocked_write(self, shard, key, obj):
+        shard.objects[key] = obj  # mutation without shard.mu
+
+    def bad_unlocked_mutator(self, shard, kind):
+        shard.fp.pop(kind, None)  # container mutator without shard.mu
+
+    def bad_wrong_instance_lock(self, a, b, key, obj):
+        with a.mu:
+            b.objects[key] = obj  # holds a's lock, mutates b's state
+
+    def bad_nested_two_shards(self, a, b):
+        with a.mu:
+            with b.mu:  # second shard lock outside the ordered helper
+                return len(a.objects) + len(b.objects)
+
+    def bad_manual_acquire_loop(self):
+        for shard in self.shards:
+            shard.mu.acquire()  # unordered manual multi-acquire

@@ -44,6 +44,7 @@ CASES = [
      "swallowed_exceptions_neg.py"),
     ("thread-shared-state", "thread_shared_state_pos.py", 3,
      "thread_shared_state_neg.py"),
+    ("shard-lock", "shard_lock_pos.py", 5, "shard_lock_neg.py"),
     ("metrics-docs", "docs_sync_pos.py", 1, "docs_sync_neg.py"),
     ("event-reasons", "docs_sync_pos.py", 2, "docs_sync_neg.py"),
 ]
@@ -152,3 +153,28 @@ def test_wire_drift_detects_seeded_field_drop(tmp_path):
     )
     assert any("block_origin" in f.message and "never read" in f.message
                for f in result.findings), [f.render() for f in result.findings]
+
+
+def test_shard_lock_detects_seeded_unlocked_mutation(tmp_path):
+    """The acceptance scenario on the REAL sharded store: strip the
+    `holds=mu` contract off `_index_add` — its shard-bucket mutations are
+    then undeclared and the rule must name every one of them."""
+    src_path = os.path.join(REPO, "k8s_dra_driver_tpu/k8s/store.py")
+    with open(src_path) as f:
+        src = f.read()
+    marker = "# tpulint: holds=mu (write-path internal; every caller locks)"
+    assert src.count(marker) >= 2
+    seeded = src.replace(marker, "# (annotation stripped)", 1)
+    assert seeded != src
+    target = tmp_path / "store.py"
+    target.write_text(seeded)
+    result = run_analysis(
+        paths=[str(target)], repo_root=str(tmp_path),
+        select=["shard-lock"], baseline_path=None,
+    )
+    assert any("guarded-by=mu" in f.message for f in result.findings), [
+        f.render() for f in result.findings]
+    # The unmodified store is pinned clean under the same rule.
+    clean = run_analysis(paths=[src_path], repo_root=REPO,
+                         select=["shard-lock"], baseline_path=None)
+    assert not clean.findings, [f.render() for f in clean.findings]
