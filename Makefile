@@ -2,7 +2,7 @@
 
 include versions.mk
 
-.PHONY: all native test e2e bench bench-smoke ci clean version verify tpulint check-metrics-docs check-event-reasons test-tier1
+.PHONY: all native test e2e bench bench-smoke ci clean version verify tpulint race check-metrics-docs check-event-reasons test-tier1
 
 version:
 	@echo "$(DRIVER_NAME) $(VERSION) (chart $(VERSION_NO_V), image $(IMAGE))"
@@ -39,9 +39,10 @@ bench-smoke:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --smoke
 
 # Pre-merge gate: the tpulint invariant analyzer (which subsumes the
-# metrics-docs and event-reasons checks) plus the tier-1 pytest run (the
-# suite ROADMAP.md pins as the regression floor).
-verify: tpulint test-tier1
+# metrics-docs and event-reasons checks), the tpusan runtime concurrency
+# sanitizer, plus the tier-1 pytest run (the suite ROADMAP.md pins as
+# the regression floor).
+verify: tpulint race test-tier1
 
 # AST-based invariant analysis (k8s_dra_driver_tpu/analysis): CAS-closure
 # purity, flock ordering, store-scan hygiene, k8s wire-drift, metric/event
@@ -49,6 +50,14 @@ verify: tpulint test-tier1
 # baseline (hack/tpulint_baseline.json, empty: no legacy debt).
 tpulint:
 	python -m k8s_dra_driver_tpu.analysis
+
+# tpusan — tpulint's runtime half (k8s_dra_driver_tpu/analysis/sanitizer):
+# seeded-fixture self-test (every detector class must fire on every seed,
+# naming both witness threads) + the four control-plane concurrency
+# scenarios driven by the interleaving explorer (must run clean). Run the
+# whole pytest suite sanitized with `TPU_SAN=1 make test-tier1`.
+race:
+	env JAX_PLATFORMS=cpu python -m k8s_dra_driver_tpu.analysis.sanitizer --seeds 3
 
 # Single-rule views of the tpulint engine (former standalone scripts).
 check-metrics-docs:

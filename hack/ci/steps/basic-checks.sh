@@ -49,6 +49,9 @@ EOF
 echo "-- tpulint invariants (incl. metrics/event-reason docs)"
 "${PYTHON:-python}" -m k8s_dra_driver_tpu.analysis
 
+echo "-- tpusan concurrency sanitizer (fixture self-test + scenario sweep)"
+env JAX_PLATFORMS=cpu "${PYTHON:-python}" -m k8s_dra_driver_tpu.analysis.sanitizer --seeds 3
+
 echo "-- VERSION is semver"
 check_version
 

@@ -8,12 +8,172 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
 
 # Shared vocabulary between the metric/event checkers and the doc-sync
 # rules — one definition so the pairs can't silently diverge.
 METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
 CAMEL_CASE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+# -- the tpulint lock-annotation vocabulary ----------------------------------
+#
+# ONE definition consumed by the static checkers (thread-shared-state,
+# shard-lock, lock-order, sleep-under-lock) AND the runtime sanitizer
+# (analysis/sanitizer): what `# tpulint: guarded-by=` declares statically
+# is exactly what tpusan enforces dynamically, so the two halves can never
+# drift on what the annotations mean.
+
+GUARDED_RE = re.compile(r"#\s*tpulint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
+# The value char class includes '-' so lock-order's `holds=pu-flock`
+# captures whole and can never prefix-match a lock attr named `pu`
+# (attribute names cannot contain '-', so the exact compare rejects it).
+HOLDS_RE = re.compile(r"#\s*tpulint:\s*holds=([A-Za-z_][A-Za-z0-9_\-]*)")
+ORDERED_RE = re.compile(r"#\s*tpulint:\s*ordered-acquire")
+
+# Standard container mutators: calling one of these on a guarded attribute
+# is a mutation of that attribute's state.
+MUTATORS = frozenset({
+    "append", "add", "insert", "extend", "remove", "discard", "pop",
+    "popitem", "clear", "update", "setdefault", "appendleft", "popleft",
+})
+
+
+@dataclass(frozen=True)
+class FunctionAnnotation:
+    """One function's lock contract, read off its signature lines (the
+    line above the ``def`` through the first body statement): the locks a
+    ``# tpulint: holds=<lock>`` declares its callers provide, and whether
+    it is a sanctioned ``# tpulint: ordered-acquire`` multi-lock helper."""
+
+    name: str
+    lineno: int          # the def's line
+    end_lineno: int      # last line of the body
+    holds: FrozenSet[str] = frozenset()
+    ordered_acquire: bool = False
+
+
+@dataclass(frozen=True)
+class ModuleAnnotations:
+    """Every tpulint lock annotation in one module, in one structure.
+
+    - ``class_guards``: class name -> {attr -> lock attr} from
+      ``self.X = ...  # tpulint: guarded-by=Y`` (or bare ``X: ...`` class
+      fields) inside the class span.
+    - ``file_guards``: attr -> lock attr over the whole file — the
+      shard-lock view, where an attr declared guarded in ANY class of the
+      file binds external accesses too.
+    - ``functions``: per-def holds/ordered-acquire contracts, keyed for
+      lookup by (name, def lineno).
+    """
+
+    class_guards: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    file_guards: Dict[str, str] = field(default_factory=dict)
+    functions: Tuple[FunctionAnnotation, ...] = ()
+
+    @property
+    def lock_attrs(self) -> FrozenSet[str]:
+        """Every lock attribute name any guard in the file names."""
+        return frozenset(self.file_guards.values())
+
+    def function_at(self, name: str, lineno: int) -> Optional[FunctionAnnotation]:
+        for fa in self.functions:
+            if fa.name == name and fa.lineno == lineno:
+                return fa
+        return None
+
+    def fn_holds(self, fn: Optional[ast.AST]) -> FrozenSet[str]:
+        """Lock names the enclosing def's ``holds=`` annotation declares
+        (empty for lambdas / un-annotated functions)."""
+        if fn is None or isinstance(fn, ast.Lambda):
+            return frozenset()
+        fa = self.function_at(getattr(fn, "name", ""), fn.lineno)
+        return fa.holds if fa is not None else frozenset()
+
+    def fn_ordered(self, fn: Optional[ast.AST]) -> bool:
+        """The enclosing def is the sanctioned ordered-acquire helper."""
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        fa = self.function_at(getattr(fn, "name", ""), fn.lineno)
+        return fa.ordered_acquire if fa is not None else False
+
+    def ordered_functions(self) -> List[FunctionAnnotation]:
+        return [fa for fa in self.functions if fa.ordered_acquire]
+
+
+def _line(lines: Sequence[str], lineno: int) -> str:
+    """1-based physical line, empty string out of range."""
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1]
+    return ""
+
+
+_GUARD_TARGET_RE = re.compile(r"(?:self\.)?([A-Za-z_][A-Za-z0-9_]*)\s*[:=]")
+
+
+def parse_annotations(tree: ast.AST, lines: Sequence[str]) -> ModuleAnnotations:
+    """Parse every tpulint lock annotation in one parsed module. This is
+    THE annotation reader: the static checkers and the runtime sanitizer
+    both call it, so a parser change moves both in lockstep (pinned by
+    the annotation-drift test)."""
+    class_guards: Dict[str, Dict[str, str]] = {}
+    file_guards: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        end = max((n.end_lineno or n.lineno for n in ast.walk(node)
+                   if hasattr(n, "lineno")), default=node.lineno)
+        guards: Dict[str, str] = {}
+        for lineno in range(node.lineno, end + 1):
+            text = _line(lines, lineno)
+            m = GUARDED_RE.search(text)
+            if not m:
+                continue
+            am = _GUARD_TARGET_RE.search(text)
+            if am:
+                guards[am.group(1)] = m.group(1)
+        if guards:
+            class_guards[node.name] = guards
+    # File-wide view: any guarded-by line anywhere (module-level state
+    # included), matching the shard-lock discovery shape.
+    for lineno in range(1, len(lines) + 1):
+        text = _line(lines, lineno)
+        m = GUARDED_RE.search(text)
+        if not m:
+            continue
+        am = _GUARD_TARGET_RE.search(text)
+        if am:
+            file_guards[am.group(1)] = m.group(1)
+
+    functions: List[FunctionAnnotation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        first_stmt = node.body[0].lineno if node.body else node.lineno
+        holds = set()
+        ordered = False
+        for n in range(max(1, node.lineno - 1), first_stmt + 1):
+            text = _line(lines, n)
+            hm = HOLDS_RE.search(text)
+            if hm:
+                holds.add(hm.group(1))
+            if ORDERED_RE.search(text):
+                ordered = True
+        if holds or ordered:
+            functions.append(FunctionAnnotation(
+                name=node.name, lineno=node.lineno,
+                end_lineno=node.end_lineno or node.lineno,
+                holds=frozenset(holds), ordered_acquire=ordered))
+    return ModuleAnnotations(class_guards=class_guards,
+                             file_guards=file_guards,
+                             functions=tuple(functions))
+
+
+def parse_annotations_text(text: str, filename: str = "<module>") -> ModuleAnnotations:
+    """Annotation view of raw source text (the sanitizer's entry: it reads
+    module files straight off disk, no SourceFile needed)."""
+    return parse_annotations(ast.parse(text, filename=filename),
+                             text.splitlines())
 
 
 def dotted(node: ast.AST) -> str:

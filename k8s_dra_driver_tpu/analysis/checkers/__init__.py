@@ -15,5 +15,6 @@ from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
     swallowed_exceptions,
     thread_shared_state,
     shard_lock,
+    sleep_under_lock,
     docs_sync,
 )

@@ -37,8 +37,6 @@ from k8s_dra_driver_tpu.analysis.engine import (
     register_checker,
 )
 
-HOLDS_MARK = "tpulint: holds=pu-flock"
-
 # The lock/checkpoint implementations themselves are exempt — they *are*
 # the sanctioned acquisition paths the rule funnels everyone through.
 _IMPL_FILES = (
@@ -65,14 +63,8 @@ def _is_pu_hold(withitem_expr: ast.AST) -> bool:
 
 def _fn_holds_pu(sf: SourceFile, fn) -> bool:
     """The enclosing def carries the holds annotation on its signature
-    lines or directly above it."""
-    if fn is None or isinstance(fn, ast.Lambda):
-        return False
-    first_stmt = fn.body[0].lineno if fn.body else fn.lineno
-    lo = max(1, fn.lineno - 1)
-    return any(
-        HOLDS_MARK in sf.line(n) for n in range(lo, first_stmt + 1)
-    )
+    lines or directly above it (shared astutil.ModuleAnnotations parse)."""
+    return "pu-flock" in sf.annotations.fn_holds(fn)
 
 
 @register_checker

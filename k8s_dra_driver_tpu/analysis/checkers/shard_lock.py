@@ -27,18 +27,13 @@ the order.
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from k8s_dra_driver_tpu.analysis.astutil import (
+    MUTATORS as _MUTATORS,
     ancestors,
     dotted,
     enclosing_function,
-)
-from k8s_dra_driver_tpu.analysis.checkers.thread_shared_state import (
-    GUARDED_RE,
-    HOLDS_RE,
-    _MUTATORS,
 )
 from k8s_dra_driver_tpu.analysis.engine import (
     Checker,
@@ -46,8 +41,6 @@ from k8s_dra_driver_tpu.analysis.engine import (
     SourceFile,
     register_checker,
 )
-
-ORDERED_RE = re.compile(r"#\s*tpulint:\s*ordered-acquire")
 
 
 def _base_and_attr(node: ast.AST) -> Tuple[Optional[ast.AST], Optional[str]]:
@@ -82,17 +75,10 @@ class ShardLockChecker(Checker):
     def _file_guards(sf: SourceFile) -> Dict[str, str]:
         """attr -> lock attr, from every ``# tpulint: guarded-by=`` line
         in the file — whether declared via ``self.X = ...`` (__init__
-        style) or a bare ``X: ... = ...`` class field."""
-        guards: Dict[str, str] = {}
-        for lineno in range(1, len(sf.lines) + 1):
-            line = sf.line(lineno)
-            m = GUARDED_RE.search(line)
-            if not m:
-                continue
-            am = re.search(r"(?:self\.)?([A-Za-z_][A-Za-z0-9_]*)\s*[:=]", line)
-            if am:
-                guards[am.group(1)] = m.group(1)
-        return guards
+        style) or a bare ``X: ... = ...`` class field. Parsed by the
+        shared astutil.ModuleAnnotations reader (one source of truth with
+        thread-shared-state and the runtime sanitizer)."""
+        return dict(sf.annotations.file_guards)
 
     # -- rule 1: external guarded mutation ----------------------------------
 
@@ -141,15 +127,7 @@ class ShardLockChecker(Checker):
 
     @staticmethod
     def _fn_holds(sf: SourceFile, fn) -> Set[str]:
-        if isinstance(fn, ast.Lambda):
-            return set()
-        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
-        out: Set[str] = set()
-        for n in range(max(1, fn.lineno - 1), first_stmt + 1):
-            m = HOLDS_RE.search(sf.line(n))
-            if m:
-                out.add(m.group(1))
-        return out
+        return set(sf.annotations.fn_holds(fn))
 
     @staticmethod
     def _holds_instance_lock(sf: SourceFile, node: ast.AST,
@@ -232,9 +210,4 @@ class ShardLockChecker(Checker):
     def _ordered(sf: SourceFile, node: ast.AST) -> bool:
         """The enclosing function (or its def line) carries the
         ``# tpulint: ordered-acquire`` annotation."""
-        fn = enclosing_function(node, sf.parents)
-        if fn is None or isinstance(fn, ast.Lambda):
-            return False
-        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
-        return any(ORDERED_RE.search(sf.line(n))
-                   for n in range(max(1, fn.lineno - 1), first_stmt + 1))
+        return sf.annotations.fn_ordered(enclosing_function(node, sf.parents))

@@ -1,0 +1,112 @@
+"""sleep-under-lock: no blocking waits inside a held-lock region.
+
+A sleep or blocking socket/file call inside a ``with <lock>:`` body (or a
+helper whose ``# tpulint: holds=<lock>`` contract says the caller holds
+one) stretches every other thread's critical-section wait by the full
+blocking time — the convoy that turns a 16-shard store back into a
+single-lock store. cas-purity stops these inside CAS closures; this rule
+stops them inside lock scopes.
+
+The lock vocabulary is the shared one (astutil.ModuleAnnotations): a
+with-item is a lock hold when its context expression ends in a lock
+attribute any ``guarded-by=`` in the file names, or is a flock-style
+``.hold(...)`` call. ``Condition.wait`` is exempt — it releases the lock
+for the sleep; that is its job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    ancestors,
+    call_chain,
+    dotted,
+    enclosing_function,
+    receiver_chain,
+)
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "connect", "sendall",
+                    "makefile"}
+_NET_PREFIXES = ("socket.", "requests.", "urllib.", "subprocess.", "select.")
+
+
+def _blocking(call: ast.Call) -> Optional[str]:
+    chain = call_chain(call)
+    recv = receiver_chain(call).lower()
+    last = chain.rsplit(".", 1)[-1]
+    if last == "sleep" and ("time" in recv or chain == "sleep"):
+        return "time.sleep"
+    if chain == "open":
+        return "file I/O (open)"
+    if chain.startswith(_NET_PREFIXES):
+        return f"blocking call {chain}"
+    if last in _SOCKET_BLOCKING and "sock" in recv:
+        return f"blocking socket call {chain}"
+    if last == "fsync":
+        return f"fsync ({chain})"
+    return None
+
+
+@register_checker
+class SleepUnderLockChecker(Checker):
+    rule = "sleep-under-lock"
+    description = ("no time.sleep or blocking socket/file I/O lexically "
+                   "inside a `with <lock>:` body or a `holds=`-annotated "
+                   "helper")
+    hint = ("move the blocking call outside the critical section (compute "
+            "under the lock, block after release), or split the helper so "
+            "only the pure part runs under `holds=`")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        lock_attrs = sf.annotations.lock_attrs
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _blocking(node)
+            if why is None:
+                continue
+            where = self._locked_region(sf, node, lock_attrs)
+            if where is None:
+                continue
+            findings.append(self.finding(
+                sf, node,
+                f"{why} while holding {where} — every thread contending "
+                f"for that lock blocks for the full call",
+            ))
+        return findings
+
+    @staticmethod
+    def _locked_region(sf: SourceFile, node: ast.AST,
+                       lock_attrs) -> Optional[str]:
+        """The innermost held lock this call sits under, or None: a
+        ``with`` item naming a declared lock attribute (``self._mu``,
+        ``shard.mu``, ...) or a flock ``.hold(...)``, or an enclosing def
+        whose ``holds=`` contract declares a caller-held lock."""
+        for anc in ancestors(node, sf.parents):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    ce = item.context_expr
+                    d = dotted(ce)
+                    if d and d.rsplit(".", 1)[-1] in lock_attrs:
+                        return f"`{d}`"
+                    if isinstance(ce, ast.Call):
+                        fd = dotted(ce.func)
+                        if fd.endswith(".hold"):
+                            return f"`{fd}(...)`"
+                        if fd and fd.rsplit(".", 1)[-1] in lock_attrs:
+                            return f"`{fd}`"
+        fn = enclosing_function(node, sf.parents)
+        holds = sf.annotations.fn_holds(fn)
+        if holds:
+            return (f"`{sorted(holds)[0]}` (declared by this helper's "
+                    f"`holds=` contract)")
+        return None

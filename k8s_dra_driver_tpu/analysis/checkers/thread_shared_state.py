@@ -16,15 +16,19 @@ Internal helpers that are only ever called with the lock already held
 declare it: ``# tpulint: holds=<lock-attr>`` on the def (the same
 annotation family lock-order uses for the pu flock) — the declared
 contract is then visible at the def instead of silently assumed.
+
+Annotation parsing lives in ``analysis/astutil.py`` (ModuleAnnotations):
+the exact set this checker enforces statically is what the runtime
+sanitizer (``analysis/sanitizer``) enforces dynamically.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, List, Optional
 
 from k8s_dra_driver_tpu.analysis.astutil import (
+    MUTATORS as _MUTATORS,
     ancestors,
     dotted,
     enclosing_function,
@@ -35,17 +39,6 @@ from k8s_dra_driver_tpu.analysis.engine import (
     SourceFile,
     register_checker,
 )
-
-GUARDED_RE = re.compile(r"#\s*tpulint:\s*guarded-by=([A-Za-z_][A-Za-z0-9_]*)")
-# The value char class includes '-' so lock-order's `holds=pu-flock`
-# captures whole and can never prefix-match a lock attr named `pu`
-# (attribute names cannot contain '-', so the exact compare rejects it).
-HOLDS_RE = re.compile(r"#\s*tpulint:\s*holds=([A-Za-z_][A-Za-z0-9_\-]*)")
-
-_MUTATORS = {
-    "append", "add", "insert", "extend", "remove", "discard", "pop",
-    "popitem", "clear", "update", "setdefault", "appendleft", "popleft",
-}
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -70,28 +63,11 @@ class ThreadSharedStateChecker(Checker):
         for cls in ast.walk(sf.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
-            guards = self._declared_guards(sf, cls)
+            guards = sf.annotations.class_guards.get(cls.name, {})
             if not guards:
                 continue
             findings.extend(self._check_class(sf, cls, guards))
         return findings
-
-    def _declared_guards(self, sf: SourceFile,
-                         cls: ast.ClassDef) -> Dict[str, str]:
-        """attr -> lock attr, from `self.X = ...  # tpulint: guarded-by=Y`
-        lines anywhere in the class body."""
-        guards: Dict[str, str] = {}
-        end = max((n.end_lineno or n.lineno for n in ast.walk(cls)
-                   if hasattr(n, "lineno")), default=cls.lineno)
-        for lineno in range(cls.lineno, end + 1):
-            m = GUARDED_RE.search(sf.line(lineno))
-            if not m:
-                continue
-            am = re.search(r"self\.([A-Za-z_][A-Za-z0-9_]*)\s*[:=]",
-                           sf.line(lineno))
-            if am:
-                guards[am.group(1)] = m.group(1)
-        return guards
 
     def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
                      guards: Dict[str, str]) -> List[Finding]:
@@ -119,7 +95,7 @@ class ThreadSharedStateChecker(Checker):
             lock = guards[attr]
             if self._under_lock(sf, node, lock):
                 continue
-            if fn is not None and lock in self._fn_holds(sf, fn):
+            if fn is not None and lock in sf.annotations.fn_holds(fn):
                 continue
             findings.append(self.finding(
                 sf, node,
@@ -128,20 +104,6 @@ class ThreadSharedStateChecker(Checker):
                 f"threaded control plane",
             ))
         return findings
-
-    @staticmethod
-    def _fn_holds(sf: SourceFile, fn) -> set:
-        """Lock names a `# tpulint: holds=<lock>` annotation on the def
-        (signature lines through the first body statement) declares."""
-        if isinstance(fn, ast.Lambda):
-            return set()
-        first_stmt = fn.body[0].lineno if fn.body else fn.lineno
-        out = set()
-        for n in range(max(1, fn.lineno - 1), first_stmt + 1):
-            m = HOLDS_RE.search(sf.line(n))
-            if m:
-                out.add(m.group(1))
-        return out
 
     @staticmethod
     def _under_lock(sf: SourceFile, node: ast.AST, lock: str) -> bool:

@@ -77,6 +77,7 @@ class SourceFile:
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._annotations = None
 
     @property
     def parents(self) -> Dict[ast.AST, ast.AST]:
@@ -85,6 +86,18 @@ class SourceFile:
 
             self._parents = build_parents(self.tree)
         return self._parents
+
+    @property
+    def annotations(self):
+        """The module's tpulint lock annotations (astutil.ModuleAnnotations),
+        parsed once and shared by every checker that reads them — the same
+        parser the runtime sanitizer loads, so static and dynamic halves
+        see one annotation set."""
+        if self._annotations is None:
+            from k8s_dra_driver_tpu.analysis.astutil import parse_annotations
+
+            self._annotations = parse_annotations(self.tree, self.lines)
+        return self._annotations
 
     def line(self, lineno: int) -> str:
         """1-based physical line, empty string out of range."""

@@ -1,0 +1,49 @@
+"""tpusan — the runtime concurrency sanitizer (tpulint's dynamic half).
+
+tpulint proves locking discipline *statically* from ``# tpulint:``
+annotations and lexical structure; tpusan loads the SAME annotations
+(one parser, :mod:`..astutil`) and enforces them *dynamically*:
+
+- :mod:`.runtime` — instrumented lock wrappers, the runtime lock-order
+  graph with cycle (potential-deadlock) detection, the same-family
+  multi-instance rule (two shard locks outside the one
+  ``ordered-acquire`` helper), and the guarded-by write assert. Every
+  report names BOTH witness threads with their stacks.
+- :mod:`.instrument` — patches the annotated classes and the
+  flock/watch-queue/fsync seams. Activated by a test fixture or
+  ``TPU_SAN=1``; nothing in the production import graph touches it, so
+  the "off" overhead is exactly zero.
+- :mod:`.explorer` — the controlled-interleaving explorer: a seeded
+  cooperative scheduler that forces thread switches at instrumented
+  boundaries, making adversarial schedules reproducible.
+- :mod:`.scenarios` — the four hottest concurrent paths of the control
+  plane run under the explorer with invariant checks, plus the seeded
+  violation fixtures proving each detector class fires.
+
+``python -m k8s_dra_driver_tpu.analysis.sanitizer`` (``make race``) runs
+the seeded-fixture self-test and the scenario sweep across seeds.
+"""
+
+from k8s_dra_driver_tpu.analysis.sanitizer.explorer import (  # noqa: F401
+    Explorer,
+    ExplorerStall,
+    explore,
+)
+from k8s_dra_driver_tpu.analysis.sanitizer.instrument import (  # noqa: F401
+    Instrumentation,
+    current,
+    enabled,
+    env_requested,
+    install,
+    uninstall,
+)
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (  # noqa: F401
+    ATOMICITY,
+    GUARDED_BY,
+    LOCK_ORDER_CYCLE,
+    SHARD_FAMILY,
+    SanCondition,
+    SanitizerState,
+    SanLock,
+    Violation,
+)

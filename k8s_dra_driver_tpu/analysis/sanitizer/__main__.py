@@ -1,0 +1,127 @@
+"""``python -m k8s_dra_driver_tpu.analysis.sanitizer`` — the ``make race``
+entry point.
+
+Two passes, both across every requested seed:
+
+1. **Seeded-fixture self-test** — each violation fixture must produce its
+   detector class's violation, with both witness threads named, on EVERY
+   seed and at every filler-worker count. A detector that stops firing is
+   as broken as a lock that stops locking.
+2. **Scenario sweep** — the four real concurrent paths run under the
+   interleaving explorer and must be VIOLATION-FREE: any finding here is
+   a real concurrency bug (or a regression of a fixed one) and fails the
+   build with both witness stacks.
+
+Exit status: 0 all green, 1 any failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.sanitizer import instrument
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import SanitizerState
+from k8s_dra_driver_tpu.analysis.sanitizer.scenarios import FIXTURES, SCENARIOS
+
+DEFAULT_SEEDS = 3
+
+
+def _run_one(instr: instrument.Instrumentation, fn, seed: int,
+             extra_workers: int) -> SanitizerState:
+    state = SanitizerState()
+    old = instr.set_state(state)
+    try:
+        fn(state, seed, extra_workers=extra_workers)
+    finally:
+        instr.set_state(old)
+    return state
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m k8s_dra_driver_tpu.analysis.sanitizer",
+        description="tpusan: runtime concurrency sanitizer "
+                    "(self-test + scenario sweep)")
+    ap.add_argument("--seeds", type=int, default=DEFAULT_SEEDS,
+                    help=f"seeds per scenario/fixture "
+                         f"(default {DEFAULT_SEEDS})")
+    ap.add_argument("--seed-base", type=int, default=1,
+                    help="first seed value (default 1)")
+    ap.add_argument("--scenario", action="append", default=None,
+                    metavar="NAME", help="run only these scenarios "
+                    "(repeatable; also skips the fixture self-test — "
+                    "this is the one-scenario repro mode); default all")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="extra filler workers per run (default 0)")
+    ap.add_argument("--skip-fixtures", action="store_true",
+                    help="skip the seeded-fixture self-test")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and fixtures, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIOS:
+            print(f"scenario  {name}")
+        for name, (_, kind) in FIXTURES.items():
+            print(f"fixture   {name}  (expects: {kind})")
+        return 0
+
+    names = args.scenario or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {', '.join(unknown)} "
+              f"(have: {', '.join(SCENARIOS)})", file=sys.stderr)
+        return 2
+    seeds = [args.seed_base + i for i in range(max(1, args.seeds))]
+
+    # An explicit --scenario is the "reproduce THIS schedule" mode: the
+    # fixture self-test would only interleave unrelated output.
+    run_fixtures = not args.skip_fixtures and args.scenario is None
+
+    instr = instrument.install()
+    failed = False
+    try:
+        if run_fixtures:
+            for name, (fn, want_kind) in FIXTURES.items():
+                for seed in seeds:
+                    state = _run_one(instr, fn, seed, args.workers)
+                    hits = [v for v in state.violations if v.kind == want_kind]
+                    two_witness = [v for v in hits
+                                   if v.thread and v.other_thread]
+                    if not two_witness:
+                        failed = True
+                        print(f"FAIL fixture {name} seed={seed}: expected a "
+                              f"[{want_kind}] violation naming both witness "
+                              f"threads, got "
+                              f"{[v.kind for v in state.violations]}")
+                    else:
+                        print(f"ok   fixture {name} seed={seed}: "
+                              f"[{want_kind}] fired "
+                              f"({two_witness[0].thread!r} vs "
+                              f"{two_witness[0].other_thread!r})")
+        for name in names:
+            fn = SCENARIOS[name]
+            for seed in seeds:
+                state = _run_one(instr, fn, seed, args.workers)
+                if state.violations:
+                    failed = True
+                    print(f"FAIL scenario {name} seed={seed}: "
+                          f"{len(state.violations)} violation(s)")
+                    print(state.render())
+                else:
+                    print(f"ok   scenario {name} seed={seed}: clean")
+    finally:
+        instrument.uninstall()
+    if failed:
+        print("tpusan: FAILED", file=sys.stderr)
+        return 1
+    print(f"tpusan: OK — {len(FIXTURES) if run_fixtures else 0} "
+          f"fixtures self-tested, {len(names)} scenarios clean across "
+          f"seeds {seeds}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""Controlled-interleaving explorer: a seeded cooperative scheduler.
+
+Real thread schedules are decided by the OS and never reproduce; the
+explorer takes over scheduling for a set of worker threads so exactly
+ONE runs at a time and every switch happens at an instrumented boundary
+(SanLock acquire/release, watch-queue put/get, WAL fsync, or an explicit
+``checkpoint()`` in scenario code). At each yield point control returns
+to the scheduler, which picks the next worker with a seeded RNG — the
+same seed replays the same interleaving, different seeds permute it.
+That turns "run the storm test 10,000 times and hope" into "enumerate
+adversarial schedules on purpose": atomicity violations that depend on a
+writer landing inside another thread's two-step critical section become
+deterministic findings.
+
+Workers that block on a real lock are never a wedge: instrumented
+acquires under an active explorer are try-acquire/yield loops, so a
+worker whose lock is held simply yields until the scheduler runs the
+holder. A watchdog raises :class:`ExplorerStall` if a worker blocks on
+something the explorer cannot see.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import SanitizerState
+
+# A worker failing to come back to the scheduler within this budget is
+# blocked on something uninstrumented — surface it instead of hanging CI.
+STEP_TIMEOUT_S = 30.0
+
+# Overall schedule-length fuse: a runaway yield loop (two workers
+# endlessly trading a contested lock) fails loudly.
+DEFAULT_MAX_STEPS = 250_000
+
+
+class ExplorerStall(RuntimeError):
+    pass
+
+
+class _Worker:
+    def __init__(self, fn: Callable[[], None], name: str, index: int):
+        self.fn = fn
+        self.name = name
+        self.index = index
+        self.go = threading.Event()
+        self.ack = threading.Event()
+        self.finished = False
+        self.exc: Optional[BaseException] = None
+        self.thread = threading.Thread(target=self._main, name=name,
+                                       daemon=True)
+
+    def _main(self) -> None:
+        # Wait for the scheduler's first pick before touching anything.
+        self.go.wait()
+        self.go.clear()
+        try:
+            self.fn()
+        except BaseException as e:  # noqa: BLE001 — reported by run()
+            self.exc = e
+        finally:
+            self.finished = True
+            self.ack.set()
+
+
+class Explorer:
+    """One seeded schedule over a set of cooperative workers.
+
+    Usage::
+
+        state = SanitizerState()
+        ex = Explorer(state, seed=7)
+        ex.spawn(writer_a, "writer-a")
+        ex.spawn(writer_b, "writer-b")
+        ex.run()          # drives workers to completion, one at a time
+
+    ``run()`` re-raises the first worker exception. The schedule trace
+    (sequence of worker indices) is exposed for determinism tests.
+    """
+
+    def __init__(self, state: SanitizerState, seed: int,
+                 max_steps: int = DEFAULT_MAX_STEPS):
+        self.state = state
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.max_steps = max_steps
+        self.trace: List[int] = []
+        self._workers: List[_Worker] = []
+        self._by_ident: dict = {}
+
+    # -- worker management ---------------------------------------------------
+
+    def spawn(self, fn: Callable[[], None], name: str) -> None:
+        if self.state.explorer is not None and self.state.explorer is not self:
+            raise RuntimeError("another explorer is driving this state")
+        self._workers.append(_Worker(fn, name, len(self._workers)))
+
+    def drives_current(self) -> bool:
+        return threading.get_ident() in self._by_ident
+
+    def worker_name(self, index: int) -> str:
+        return self._workers[index].name
+
+    # -- the scheduler -------------------------------------------------------
+
+    def run(self) -> None:
+        if not self._workers:
+            return
+        self.state.explorer = self
+        try:
+            for w in self._workers:
+                w.thread.start()
+                self._by_ident[w.thread.ident] = w
+            steps = 0
+            while True:
+                runnable = [w for w in self._workers if not w.finished]
+                if not runnable:
+                    break
+                w = self.rng.choice(runnable)
+                steps += 1
+                if steps > self.max_steps:
+                    raise ExplorerStall(
+                        f"schedule exceeded {self.max_steps} steps "
+                        f"(seed={self.seed}) — livelock between workers?")
+                self.trace.append(w.index)
+                w.go.set()
+                if not w.ack.wait(STEP_TIMEOUT_S):
+                    raise ExplorerStall(
+                        f"worker {w.name!r} did not return to the "
+                        f"scheduler within {STEP_TIMEOUT_S}s — blocked on "
+                        f"an uninstrumented operation (seed={self.seed})")
+                w.ack.clear()
+            for w in self._workers:
+                w.thread.join(timeout=STEP_TIMEOUT_S)
+        finally:
+            self.state.explorer = None
+            self._by_ident.clear()
+        for w in self._workers:
+            if w.exc is not None:
+                raise w.exc
+
+    # -- called from instrumented code --------------------------------------
+
+    def yield_point(self, tag: Tuple[str, str]) -> None:
+        """Hand control back to the scheduler and wait to be re-picked.
+        No-op for threads the explorer does not drive (the scheduler
+        itself, background daemons)."""
+        w = self._by_ident.get(threading.get_ident())
+        if w is None:
+            return
+        w.ack.set()
+        w.go.wait()
+        w.go.clear()
+
+    def checkpoint(self) -> None:
+        """Explicit scenario yield point (between two halves of a
+        read-modify-write, etc.)."""
+        self.yield_point(("checkpoint", ""))
+
+
+def explore(state: SanitizerState, seed: int,
+            workers: Sequence[Tuple[str, Callable[[], None]]],
+            max_steps: int = DEFAULT_MAX_STEPS) -> Explorer:
+    """Convenience: build, populate, and run one schedule."""
+    ex = Explorer(state, seed, max_steps=max_steps)
+    for name, fn in workers:
+        ex.spawn(fn, name)
+    ex.run()
+    return ex

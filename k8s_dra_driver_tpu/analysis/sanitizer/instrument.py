@@ -1,0 +1,494 @@
+"""tpusan instrumentation: patch the annotated classes at runtime.
+
+``install()`` loads the repo's ``# tpulint: guarded-by=`` / ``holds=`` /
+``ordered-acquire`` annotations through the SAME parser tpulint uses
+(:func:`analysis.astutil.parse_annotations_text` — one vocabulary, two
+enforcers) and then patches every annotated class:
+
+- lock attributes named by a guard get wrapped in :class:`SanLock` /
+  :class:`SanCondition` proxies as they are assigned, feeding the
+  lock-order graph;
+- writes to guarded attributes assert the instance's named lock is held
+  by the writing thread (``__init__`` exempt — the object isn't shared
+  yet);
+- guarded ``dict``/``list``/``set`` values are wrapped in checking
+  containers so ``self.X[k] = v`` / ``.append`` / ``del self.X[...]``
+  through ANY call path — helpers, callbacks, dynamic dispatch — hits
+  the same assert;
+- :class:`pkg.flock.Flock` acquire/release feed the same lock graph
+  (keyed per lock file), so a cp-before-pu inversion shows up as a
+  runtime cycle exactly like a shard-lock inversion;
+- the store's watch queues and the WAL's fsync seam become explorer
+  yield points.
+
+Activation: a test fixture calls ``install()`` directly, or the suite
+runs with ``TPU_SAN=1`` (see ``tests/conftest.py``). Nothing in the
+production import graph touches this module, so overhead when off is
+exactly zero.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue_mod
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    ModuleAnnotations,
+    parse_annotations_text,
+)
+from k8s_dra_driver_tpu.analysis.sanitizer import runtime as runtime_mod
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (
+    OrderedFn,
+    SanitizerState,
+    wrap_lock,
+)
+
+# Objects currently running their __init__ (by id): guarded writes during
+# construction are exempt — the object is not shared yet. GIL-atomic
+# set add/discard; ids are unique while the object is alive.
+_constructing: set = set()
+
+_active: Optional["Instrumentation"] = None
+
+
+def repo_root_default() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.dirname(pkg)
+
+
+def discover_annotated_modules(repo_root: Optional[str] = None) -> List[str]:
+    """Repo-relative paths of every package module that declares a
+    ``guarded-by`` annotation (cheap text probe, then the real parse)."""
+    root = repo_root or repo_root_default()
+    pkg = os.path.join(root, "k8s_dra_driver_tpu")
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        # analysis/ is the linter+sanitizer itself: its sources QUOTE the
+        # annotation vocabulary, they don't declare guarded state.
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "analysis")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            if "guarded-by=" in text or "ordered-acquire" in text:
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                out.append(rel)
+    return sorted(out)
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".")
+
+
+def _check_container(owner, cls_name: str, attr: str, lock_attr: str) -> None:
+    instr = _active
+    if instr is not None:
+        instr.state.check_guard_write(owner, cls_name, attr, lock_attr,
+                                      via="container mutation")
+
+
+class _Meta:
+    __slots__ = ("owner", "cls_name", "attr", "lock_attr")
+
+    def __init__(self, owner, cls_name, attr, lock_attr):
+        self.owner = owner
+        self.cls_name = cls_name
+        self.attr = attr
+        self.lock_attr = lock_attr
+
+    def check(self):
+        _check_container(self.owner, self.cls_name, self.attr, self.lock_attr)
+
+
+class GuardedDict(dict):
+    """dict that runtime-asserts the declared lock on every mutation."""
+
+    _san_meta: _Meta
+
+    def __setitem__(self, k, v):
+        self._san_meta.check()
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._san_meta.check()
+        dict.__delitem__(self, k)
+
+    def pop(self, *a):
+        self._san_meta.check()
+        return dict.pop(self, *a)
+
+    def popitem(self):
+        self._san_meta.check()
+        return dict.popitem(self)
+
+    def clear(self):
+        self._san_meta.check()
+        dict.clear(self)
+
+    def update(self, *a, **kw):
+        self._san_meta.check()
+        dict.update(self, *a, **kw)
+
+    def setdefault(self, k, default=None):
+        self._san_meta.check()
+        return dict.setdefault(self, k, default)
+
+
+class GuardedList(list):
+    _san_meta: _Meta
+
+    def __setitem__(self, i, v):
+        self._san_meta.check()
+        list.__setitem__(self, i, v)
+
+    def __delitem__(self, i):
+        self._san_meta.check()
+        list.__delitem__(self, i)
+
+    def __iadd__(self, other):
+        self._san_meta.check()
+        list.extend(self, other)
+        return self
+
+    def append(self, v):
+        self._san_meta.check()
+        list.append(self, v)
+
+    def extend(self, it):
+        self._san_meta.check()
+        list.extend(self, it)
+
+    def insert(self, i, v):
+        self._san_meta.check()
+        list.insert(self, i, v)
+
+    def remove(self, v):
+        self._san_meta.check()
+        list.remove(self, v)
+
+    def pop(self, i=-1):
+        self._san_meta.check()
+        return list.pop(self, i)
+
+    def clear(self):
+        self._san_meta.check()
+        list.clear(self)
+
+    def sort(self, **kw):
+        self._san_meta.check()
+        list.sort(self, **kw)
+
+
+class GuardedSet(set):
+    _san_meta: _Meta
+
+    def add(self, v):
+        self._san_meta.check()
+        set.add(self, v)
+
+    def discard(self, v):
+        self._san_meta.check()
+        set.discard(self, v)
+
+    def remove(self, v):
+        self._san_meta.check()
+        set.remove(self, v)
+
+    def pop(self):
+        self._san_meta.check()
+        return set.pop(self)
+
+    def clear(self):
+        self._san_meta.check()
+        set.clear(self)
+
+    def update(self, *a):
+        self._san_meta.check()
+        set.update(self, *a)
+
+
+_CONTAINER_WRAP = {dict: GuardedDict, list: GuardedList, set: GuardedSet}
+
+
+def _wrap_container(value, owner, cls_name, attr, lock_attr):
+    wrap_cls = _CONTAINER_WRAP.get(type(value))
+    if wrap_cls is None:
+        return value
+    wrapped = wrap_cls(value)
+    wrapped._san_meta = _Meta(owner, cls_name, attr, lock_attr)
+    return wrapped
+
+
+class Instrumentation:
+    """One active patch set. ``state`` is swappable between runs
+    (``set_state``) so the CLI can give every scenario/seed a fresh
+    violation list without re-patching."""
+
+    def __init__(self, state: SanitizerState):
+        self.state = state
+        self._class_patches: List[Tuple[type, Dict[str, object]]] = []
+        self._fn_patches: List[Tuple[object, str, object]] = []
+        self.instrumented_classes: List[str] = []
+        self.annotations: Dict[str, ModuleAnnotations] = {}
+        self._ordered: List[OrderedFn] = []
+
+    def ordered_fns(self) -> List[OrderedFn]:
+        return list(self._ordered)
+
+    def set_state(self, state: SanitizerState) -> SanitizerState:
+        """Swap in a fresh violation sink (per scenario/seed) without
+        re-patching. The ordered-acquire registry travels with the
+        instrumentation, so the new state enforces the same contracts."""
+        state.add_ordered_fns(self._ordered)
+        old, self.state = self.state, state
+        return old
+
+    # -- class patching ------------------------------------------------------
+
+    def instrument_class(self, cls: type, guards: Dict[str, str]) -> None:
+        """Patch one class: wrap lock attrs at assignment, assert guards
+        on attribute writes, wrap guarded containers, and exempt
+        ``__init__`` via the construction set."""
+        instr = self
+        cls_name = cls.__name__
+        lock_attrs = frozenset(guards.values())
+        guard_map = dict(guards)
+
+        saved: Dict[str, object] = {
+            "__setattr__": cls.__dict__.get("__setattr__"),
+            "__init__": cls.__dict__.get("__init__"),
+        }
+        orig_setattr = cls.__setattr__
+        orig_init = cls.__init__
+
+        def __setattr__(self, name, value):
+            if name in lock_attrs:
+                value = wrap_lock(value, f"{cls_name}.{name}", instr.state,
+                                  family=(cls_name, name))
+            if name in guard_map:
+                if id(self) not in _constructing:
+                    instr.state.check_guard_write(
+                        self, cls_name, name, guard_map[name])
+                value = _wrap_container(value, self, cls_name, name,
+                                        guard_map[name])
+            orig_setattr(self, name, value)
+
+        def __init__(self, *a, **kw):
+            _constructing.add(id(self))
+            try:
+                orig_init(self, *a, **kw)
+            finally:
+                _constructing.discard(id(self))
+
+        cls.__setattr__ = __setattr__  # type: ignore[method-assign]
+        cls.__init__ = __init__  # type: ignore[method-assign]
+        self._class_patches.append((cls, saved))
+        self.instrumented_classes.append(cls.__qualname__)
+
+    def instrument_module(self, rel: str,
+                          repo_root: Optional[str] = None) -> None:
+        """Instrument every annotated class of one repo module and
+        register its ordered-acquire helpers."""
+        import importlib
+
+        root = repo_root or repo_root_default()
+        path = os.path.join(root, rel.replace("/", os.sep))
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        anns = parse_annotations_text(text, filename=path)
+        self.annotations[rel] = anns
+        if anns.class_guards:
+            mod = importlib.import_module(_module_name(rel))
+            for cls_name, guards in anns.class_guards.items():
+                cls = getattr(mod, cls_name, None)
+                if isinstance(cls, type):
+                    self.instrument_class(cls, guards)
+        ordered = [OrderedFn(path_suffix=rel, name=fa.name,
+                             lineno=fa.lineno, end_lineno=fa.end_lineno)
+                   for fa in anns.ordered_functions()]
+        if ordered:
+            self._ordered.extend(ordered)
+            self.state.add_ordered_fns(ordered)
+
+    # -- seams ---------------------------------------------------------------
+
+    def _patch_attr(self, obj, name: str, value) -> None:
+        self._fn_patches.append((obj, name, getattr(obj, name)))
+        setattr(obj, name, value)
+
+    def patch_flocks(self) -> None:
+        """Feed Flock acquisition into the lock graph, keyed per lock
+        file; under an explorer, acquires become try/yield loops."""
+        from k8s_dra_driver_tpu.pkg.flock import Flock, FlockTimeoutError
+
+        instr = self
+        nodes: Dict[str, object] = {}
+        nodes_mu = threading.Lock()
+
+        class _FlockNode:
+            __slots__ = ("name", "family", "node_id")
+
+            def __init__(self, name):
+                self.node_id = runtime_mod.next_node_id()
+                self.name = f"{name}#{self.node_id}"
+                self.family = None
+
+        def node_for(path: str):
+            with nodes_mu:
+                n = nodes.get(path)
+                if n is None:
+                    n = nodes[path] = _FlockNode(
+                        f"flock:{os.path.basename(path)}")
+                return n
+
+        orig_acquire = Flock.acquire
+        orig_release = Flock.release
+
+        def acquire(fl, timeout=None):
+            instr.state.note_attempt(node_for(fl.path))
+            ex = instr.state.explorer
+            if ex is not None and ex.drives_current():
+                # Cooperative acquire: single-try/yield so the scheduler
+                # can run the holder — but the caller's timeout still
+                # applies (wall time advances across real switches), so
+                # bounded acquires keep raising FlockTimeoutError under
+                # the explorer instead of retrying forever: PR 7's
+                # best-effort flock-timeout paths stay reachable.
+                deadline = (time.monotonic() + timeout
+                            if timeout is not None else None)
+                while True:
+                    instr.state.yield_point(("flock-acquire", fl.path))
+                    try:
+                        orig_acquire(fl, timeout=0)
+                        break
+                    except FlockTimeoutError:
+                        if (deadline is not None
+                                and time.monotonic() >= deadline):
+                            raise
+                        continue
+            else:
+                orig_acquire(fl, timeout=timeout)
+            instr.state.note_acquire(node_for(fl.path))
+
+        def release(fl):
+            instr.state.note_release(node_for(fl.path))
+            orig_release(fl)
+
+        self._patch_attr(Flock, "acquire", acquire)
+        self._patch_attr(Flock, "release", release)
+
+    def patch_store_queues(self) -> None:
+        """Watch queues created by the store become explorer yield points
+        (put/get boundaries), without touching store code: the store's
+        ``queue`` module reference is swapped for a shim whose Queue is
+        instrumented."""
+        from k8s_dra_driver_tpu.k8s import store as store_mod
+
+        instr = self
+
+        class SanQueue(_queue_mod.Queue):
+            def put_nowait(self, item):
+                instr.state.yield_point(("queue", "put"))
+                return _queue_mod.Queue.put_nowait(self, item)
+
+            def get_nowait(self):
+                instr.state.yield_point(("queue", "get"))
+                return _queue_mod.Queue.get_nowait(self)
+
+            def put(self, item, block=True, timeout=None):
+                instr.state.yield_point(("queue", "put"))
+                return _queue_mod.Queue.put(self, item, block, timeout)
+
+            def get(self, block=True, timeout=None):
+                if not block:
+                    instr.state.yield_point(("queue", "get"))
+                return _queue_mod.Queue.get(self, block, timeout)
+
+        class _QueueShim:
+            Queue = SanQueue
+            Empty = _queue_mod.Empty
+            Full = _queue_mod.Full
+
+        self._patch_attr(store_mod, "queue", _QueueShim)
+
+    def patch_fsync(self) -> None:
+        """WAL fsync boundaries become explorer yield points."""
+        from k8s_dra_driver_tpu.k8s import persist as persist_mod
+
+        instr = self
+
+        def _fsync(fd: int) -> None:
+            instr.state.yield_point(("fsync", ""))
+            os.fsync(fd)
+
+        self._patch_attr(persist_mod, "_fsync", _fsync)
+
+    # -- teardown ------------------------------------------------------------
+
+    def undo(self) -> None:
+        for obj, name, orig in reversed(self._fn_patches):
+            setattr(obj, name, orig)
+        self._fn_patches.clear()
+        for cls, saved in reversed(self._class_patches):
+            for name, orig in saved.items():
+                if orig is None:
+                    try:
+                        delattr(cls, name)
+                    except AttributeError:
+                        pass
+                else:
+                    setattr(cls, name, orig)
+        self._class_patches.clear()
+        self.instrumented_classes.clear()
+
+
+def install(state: Optional[SanitizerState] = None,
+            repo_root: Optional[str] = None,
+            modules: Optional[List[str]] = None) -> Instrumentation:
+    """Activate tpusan: parse annotations, patch every annotated class,
+    and hook the flock/queue/fsync seams. Exactly one installation may be
+    active; ``uninstall()`` restores everything."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("tpusan already installed — uninstall() first")
+    st = state or SanitizerState()
+    instr = Instrumentation(st)
+    try:
+        for rel in (modules if modules is not None
+                    else discover_annotated_modules(repo_root)):
+            instr.instrument_module(rel, repo_root=repo_root)
+        instr.patch_flocks()
+        instr.patch_store_queues()
+        instr.patch_fsync()
+    except BaseException:
+        instr.undo()
+        raise
+    _active = instr
+    return instr
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.undo()
+        _active = None
+
+
+def current() -> Optional[Instrumentation]:
+    return _active
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def env_requested() -> bool:
+    """The suite-wide activation switch (`TPU_SAN=1 pytest ...`)."""
+    return os.environ.get("TPU_SAN", "") == "1"

@@ -1,0 +1,565 @@
+"""tpusan runtime core: instrumented locks, the lock-order graph, and
+runtime guarded-by enforcement.
+
+tpulint (the static half) trusts ``# tpulint: guarded-by=`` annotations
+and lexical structure; this module is the dynamic half that *observes*
+the locking actually happening:
+
+- ``SanLock`` wraps a ``threading.Lock``/``RLock``/``Condition`` behind
+  the exact same interface, recording per-thread acquisition stacks into
+  a global :class:`SanitizerState`.
+- Every acquisition taken while other locks are held adds edges to the
+  **runtime lock-order graph**; any cycle is a potential deadlock and is
+  reported with BOTH witness stacks (the two threads that established
+  the opposing edges).
+- Two locks of the same **family** (same class + attribute — e.g. two
+  store shards' ``mu``) held together outside the one function annotated
+  ``# tpulint: ordered-acquire`` is reported immediately, cycle or not:
+  per-instance lock order is exactly what the annotation exists to pin.
+- ``check_guard_write`` is the runtime **guarded-by** assert: an
+  instrumented attribute write (or container mutation) on a guarded attr
+  must happen on a thread currently holding the instance's named lock —
+  this catches mutation flowing through helpers, callbacks, or dynamic
+  dispatch that the static checker cannot see.
+
+Everything here is inert unless :mod:`..sanitizer.instrument` patched the
+annotated classes — production code never imports this module, so the
+"off" overhead is exactly zero.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Violation kinds (the three detector classes the acceptance pins, plus
+# the explorer's invariant reports).
+LOCK_ORDER_CYCLE = "lock-order-cycle"
+SHARD_FAMILY = "unordered-multi-shard-acquire"
+GUARDED_BY = "guarded-by"
+ATOMICITY = "atomicity"
+
+# Frames kept per witness stack. Deep enough to show the caller chain
+# through store/plugin internals, bounded so reports stay readable.
+STACK_LIMIT = 18
+
+# Graph node identity. NEVER id(lock): a collected lock's reused address
+# would conflate a dead node with a live one and weld phantom cycles into
+# the session-long graph. Every instrumented lock (SanLock or flock node)
+# draws a unique id here instead.
+_NODE_IDS = itertools.count(1)
+
+
+def next_node_id() -> int:
+    return next(_NODE_IDS)
+
+
+def capture_stack(skip: int = 2, limit: int = STACK_LIMIT) -> Tuple[str, ...]:
+    """Formatted stack of the calling thread, sanitizer frames trimmed."""
+    frames = traceback.extract_stack(sys._getframe(skip), limit=limit)
+    return tuple(
+        f"{fr.filename}:{fr.lineno} in {fr.name}: {fr.line or ''}".rstrip()
+        for fr in frames
+    )
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One runtime finding. ``thread``/``stack`` is the thread that
+    tripped the detector; ``other_thread``/``other_stack`` the second
+    witness (the opposing edge's owner, the lock holder, the racing
+    worker) — every report names both."""
+
+    kind: str
+    message: str
+    thread: str
+    stack: Tuple[str, ...]
+    other_thread: str = ""
+    other_stack: Tuple[str, ...] = ()
+
+    def render(self) -> str:
+        out = [f"[{self.kind}] {self.message}",
+               f"  witness 1 — thread {self.thread!r}:"]
+        out.extend(f"    {line}" for line in self.stack)
+        if self.other_thread or self.other_stack:
+            out.append(f"  witness 2 — thread {self.other_thread!r}:")
+            out.extend(f"    {line}" for line in self.other_stack)
+        return "\n".join(out)
+
+
+@dataclass(frozen=True)
+class OrderedFn:
+    """One ``# tpulint: ordered-acquire`` function, as loaded from the
+    shared annotation parser: acquisitions whose call stack passes
+    through it are the sanctioned multi-instance path."""
+
+    path_suffix: str   # repo-relative posix path ("k8s_dra_driver_tpu/k8s/store.py")
+    name: str
+    lineno: int
+    end_lineno: int
+
+
+@dataclass
+class _Edge:
+    """First witness of a lock-order edge a -> b: thread ``thread`` held
+    ``a`` (acquired at ``stack_held``) when it acquired ``b`` (at
+    ``stack_acq``)."""
+
+    a_name: str
+    b_name: str
+    thread: str
+    stack_held: Tuple[str, ...]
+    stack_acq: Tuple[str, ...]
+
+
+class _Held:
+    __slots__ = ("lock", "stack", "count")
+
+    def __init__(self, lock: "SanLock", stack: Tuple[str, ...]):
+        self.lock = lock
+        self.stack = stack
+        self.count = 1
+
+
+class SanitizerState:
+    """Global sanitizer bookkeeping: the lock-order graph, per-thread
+    held stacks, the violation list, and (while a controlled-interleaving
+    run is active) the explorer driving the threads."""
+
+    def __init__(self, capture_stacks: bool = True):
+        self._mu = threading.Lock()
+        self.capture_stacks = capture_stacks
+        self.violations: List[Violation] = []
+        self._edges: Dict[Tuple[int, int], _Edge] = {}
+        self._adj: Dict[int, Set[int]] = {}
+        self._names: Dict[int, str] = {}
+        self._tls = threading.local()
+        self._ordered_fns: List[OrderedFn] = []
+        self._seen_violations: Set[Tuple[str, str]] = set()
+        self.explorer = None  # set by explorer.Explorer while driving
+
+    # -- configuration -------------------------------------------------------
+
+    def add_ordered_fns(self, fns: Sequence[OrderedFn]) -> None:
+        known = set(self._ordered_fns)
+        self._ordered_fns.extend(fn for fn in fns if fn not in known)
+
+    def reset(self) -> None:
+        """Clear findings and the graph between runs (instrumentation and
+        ordered-fn registry stay)."""
+        with self._mu:
+            self.violations.clear()
+            self._edges.clear()
+            self._adj.clear()
+            self._names.clear()
+            self._seen_violations.clear()
+
+    # -- explorer glue -------------------------------------------------------
+
+    def yield_point(self, tag: Tuple[str, str]) -> None:
+        """A controlled-interleaving switch point. No-op unless an
+        explorer is active AND the calling thread is one of its workers."""
+        ex = self.explorer
+        if ex is not None:
+            ex.yield_point(tag)
+
+    # -- held-stack bookkeeping ----------------------------------------------
+
+    def _held(self) -> List[_Held]:
+        stack = getattr(self._tls, "held", None)
+        if stack is None:
+            stack = self._tls.held = []
+        return stack
+
+    def held_by_current(self, lock: "SanLock") -> bool:
+        return any(h.lock is lock for h in self._held())
+
+    def holder_witness(self, lock: "SanLock") -> Tuple[str, Tuple[str, ...]]:
+        """(thread name, acquisition stack) of the lock's current owner,
+        for guarded-by reports ("who actually holds it")."""
+        return lock.owner_witness()
+
+    def note_attempt(self, lock) -> None:
+        """Record lock-order edges at acquire ATTEMPT time (TSan
+        semantics): "holds A, acquiring B" is the ordering fact whether
+        or not the acquire ever succeeds — in an actual deadlock it never
+        does, and edges recorded only on success would miss exactly the
+        cycles that matter most."""
+        held = self._held()
+        if not held or any(h.lock is lock for h in held):
+            return
+        stack = capture_stack(3) if self.capture_stacks else ()
+        entry = _Held(lock, stack)
+        in_ordered = self._in_ordered_scope()
+        tname = threading.current_thread().name
+        with self._mu:
+            self._names[lock.node_id] = lock.name
+            for h in held:
+                self._names[h.lock.node_id] = h.lock.name
+                self._add_edge_locked(h, entry, tname, in_ordered)
+
+    def note_acquire(self, lock: "SanLock") -> None:
+        """Record one successful acquisition by the current thread:
+        reentrant re-acquires only bump a count; first acquires push onto
+        the per-thread held list, add lock-order edges from every lock
+        already held, and run the family + cycle detectors."""
+        held = self._held()
+        for h in held:
+            if h.lock is lock:
+                h.count += 1
+                return
+        stack = capture_stack(3) if self.capture_stacks else ()
+        entry = _Held(lock, stack)
+        if held:
+            in_ordered = self._in_ordered_scope()
+            tname = threading.current_thread().name
+            with self._mu:
+                self._names[lock.node_id] = lock.name
+                for h in held:
+                    self._names[h.lock.node_id] = h.lock.name
+                    self._add_edge_locked(h, entry, tname, in_ordered)
+        held.append(entry)
+
+    def note_release(self, lock: "SanLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is lock:
+                held[i].count -= 1
+                if held[i].count == 0:
+                    del held[i]
+                return
+        # Releasing a lock this thread never noted (acquired before
+        # instrumentation, or handed across threads): nothing to track.
+
+    # -- detectors -----------------------------------------------------------
+
+    def _add_edge_locked(self, outer: _Held, inner: _Held, tname: str,
+                         in_ordered: bool) -> None:
+        a, b = outer.lock.node_id, inner.lock.node_id
+        key = (a, b)
+        if key not in self._edges:
+            self._edges[key] = _Edge(
+                a_name=outer.lock.name, b_name=inner.lock.name,
+                thread=tname, stack_held=outer.stack,
+                stack_acq=inner.stack)
+            self._adj.setdefault(a, set()).add(b)
+        # Family rule: two instances of the same lock family held together
+        # outside the ordered-acquire helper.
+        fam_o, fam_i = outer.lock.family, inner.lock.family
+        if (fam_o is not None and fam_o == fam_i and not in_ordered):
+            self._record_locked(Violation(
+                kind=SHARD_FAMILY,
+                message=(
+                    f"`{inner.lock.name}` acquired while holding "
+                    f"`{outer.lock.name}` — two {fam_o[0]}.{fam_o[1]} locks "
+                    f"held together outside the `# tpulint: ordered-acquire`"
+                    f" helper; two threads disagreeing on instance order "
+                    f"deadlock"),
+                thread=tname, stack=inner.stack,
+                other_thread=tname, other_stack=outer.stack,
+            ), dedup=(SHARD_FAMILY, f"{outer.lock.name}|{inner.lock.name}"))
+        # Cycle detector: can `inner` already reach `outer` through
+        # previously-witnessed edges? Then this new edge closes a cycle.
+        path = self._find_path_locked(b, a)
+        if path is not None:
+            # The first edge of the return path is the opposing witness.
+            opp = self._edges.get((path[0], path[1]))
+            opp_thread = opp.thread if opp else "?"
+            opp_outer = opp.a_name if opp else "?"
+            opp_inner = opp.b_name if opp else "?"
+            cyc = " -> ".join(self._names.get(n, "?") for n in [a, b] + path[1:])
+            self._record_locked(Violation(
+                kind=LOCK_ORDER_CYCLE,
+                message=(
+                    f"lock-order cycle (potential deadlock): {cyc} — this "
+                    f"thread acquired `{inner.lock.name}` while holding "
+                    f"`{outer.lock.name}`; thread {opp_thread!r} previously "
+                    f"acquired `{opp_inner}` while holding `{opp_outer}`"),
+                thread=tname, stack=inner.stack,
+                other_thread=opp_thread if opp else "",
+                other_stack=opp.stack_acq if opp else (),
+            ), dedup=(LOCK_ORDER_CYCLE,
+                      "|".join(sorted((outer.lock.name, inner.lock.name)))))
+
+    def _find_path_locked(self, src: int, dst: int) -> Optional[List[int]]:
+        """DFS path src -> dst over the edge graph, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def check_guard_write(self, owner: object, cls_name: str, attr: str,
+                          lock_attr: str, via: str = "attribute write") -> None:
+        """The runtime guarded-by assert: the instance's named lock must
+        be held by the writing thread."""
+        lock = getattr(owner, lock_attr, None)
+        if not isinstance(lock, SanLock):
+            return  # lock not (yet) wrapped: nothing to assert against
+        if self.held_by_current(lock):
+            return
+        holder, holder_stack = lock.owner_witness()
+        where = (f"currently held by thread {holder!r}" if holder
+                 else "not held by any thread")
+        self.record(Violation(
+            kind=GUARDED_BY,
+            message=(
+                f"{cls_name}.{attr} (guarded-by={lock_attr}) mutated via "
+                f"{via} WITHOUT holding `{lock.name}` ({where}) — torn "
+                f"write under the threaded control plane"),
+            thread=threading.current_thread().name,
+            stack=capture_stack(3) if self.capture_stacks else (),
+            other_thread=holder,
+            other_stack=holder_stack,
+        ), dedup=(GUARDED_BY, f"{cls_name}.{attr}"))
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, v: Violation,
+               dedup: Optional[Tuple[str, str]] = None) -> None:
+        with self._mu:
+            self._record_locked(v, dedup)
+
+    def _record_locked(self, v: Violation,
+                       dedup: Optional[Tuple[str, str]] = None) -> None:
+        if dedup is not None:
+            if dedup in self._seen_violations:
+                return
+            self._seen_violations.add(dedup)
+        self.violations.append(v)
+
+    def render(self) -> str:
+        return "\n\n".join(v.render() for v in self.violations)
+
+    # -- ordered-acquire scope ----------------------------------------------
+
+    def _in_ordered_scope(self) -> bool:
+        """Any frame of the current call stack inside a function the
+        annotations declare ``# tpulint: ordered-acquire``."""
+        if not self._ordered_fns:
+            return False
+        f = sys._getframe(2)
+        while f is not None:
+            co = f.f_code
+            for fn in self._ordered_fns:
+                if (co.co_name == fn.name
+                        and fn.lineno <= co.co_firstlineno <= fn.end_lineno
+                        and co.co_filename.replace("\\", "/")
+                            .endswith(fn.path_suffix)):
+                    return True
+            f = f.f_back
+        return False
+
+
+class SanLock:
+    """Instrumented drop-in for ``threading.Lock``/``RLock``.
+
+    ``family`` identifies the lock's declaration site ``(ClassName,
+    attr)`` so two *instances* of the same shard lock can be recognized;
+    None for one-of-a-kind locks. Under an active explorer, blocking
+    acquires become try-acquire/yield loops so the cooperative scheduler
+    can never wedge on a suspended holder.
+    """
+
+    __slots__ = ("_inner", "name", "family", "_state", "node_id",
+                 "_owner_ident", "_owner_name", "_owner_stack", "_count")
+
+    def __init__(self, inner, name: str, state: SanitizerState,
+                 family: Optional[Tuple[str, str]] = None):
+        self._inner = inner
+        self.node_id = next_node_id()
+        # The #id suffix separates instances that share a declaration
+        # site (all 16 store shards are `_Shard.mu`) in reports.
+        self.name = f"{name}#{self.node_id}"
+        self.family = family
+        self._state = state
+        self._owner_ident: Optional[int] = None
+        self._owner_name = ""
+        self._owner_stack: Tuple[str, ...] = ()
+        self._count = 0
+
+    # -- lock protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = self._state
+        ex = st.explorer
+        if blocking and self._owner_ident != threading.get_ident():
+            st.note_attempt(self)
+        if ex is not None and ex.drives_current() and blocking:
+            # Cooperative acquire: try/yield so the scheduler can run the
+            # holder. The caller's timeout still applies — wall time
+            # advances across real thread switches, so a bounded acquire
+            # keeps its failure path reachable under the explorer instead
+            # of degenerating into an unbounded retry loop.
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None and timeout >= 0 else None)
+            st.yield_point(("acquire", self.name))
+            while not self._inner.acquire(blocking=False):
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                st.yield_point(("acquire-blocked", self.name))
+        else:
+            if not blocking:
+                if not self._inner.acquire(False):
+                    return False
+            elif timeout is not None and timeout >= 0:
+                if not self._inner.acquire(True, timeout):
+                    return False
+            else:
+                self._inner.acquire()
+        self._mark_acquired()
+        return True
+
+    def _mark_acquired(self) -> None:
+        ident = threading.get_ident()
+        if self._owner_ident == ident:
+            self._count += 1
+        else:
+            self._owner_ident = ident
+            self._owner_name = threading.current_thread().name
+            self._count = 1
+            if self._state.capture_stacks:
+                self._owner_stack = capture_stack(3)
+        self._state.note_acquire(self)
+
+    def release(self) -> None:
+        self._mark_released()
+        self._inner.release()
+        self._state.yield_point(("release", self.name))
+
+    def _mark_released(self) -> None:
+        if self._owner_ident == threading.get_ident():
+            self._count -= 1
+            if self._count == 0:
+                self._owner_ident = None
+                self._owner_name = ""
+                self._owner_stack = ()
+        self._state.note_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:  # C RLock has no locked()
+            return self._count > 0
+
+    # Condition-compat hooks (threading.Condition probes these when
+    # handed an existing lock object).
+    def _is_owned(self) -> bool:
+        return self._owner_ident == threading.get_ident() and self._count > 0
+
+    def _release_save(self):
+        count = self._count
+        for _ in range(count):
+            self._mark_released()
+        for _ in range(count):
+            self._inner.release()
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        for _ in range(count):
+            self._inner.acquire()
+            self._mark_acquired()
+
+    # -- sanitizer introspection ---------------------------------------------
+
+    def held_by_current(self) -> bool:
+        return self._is_owned()
+
+    def owner_witness(self) -> Tuple[str, Tuple[str, ...]]:
+        return self._owner_name, self._owner_stack
+
+    def __repr__(self) -> str:
+        return f"<SanLock {self.name} inner={self._inner!r}>"
+
+
+class SanCondition(SanLock):
+    """Instrumented wrapper for a ``threading.Condition``: acquire/release
+    route through SanLock bookkeeping, and ``wait`` correctly drops the
+    held-state for its sleep (the condition releases the inner lock) then
+    re-marks it on wakeup."""
+
+    __slots__ = ()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        st = self._state
+        ex = st.explorer
+        if ex is not None and ex.drives_current():
+            # Cooperative wait: a real inner.wait() would block the
+            # driven worker without yielding, wedging the whole
+            # cooperative run (the would-be notifier never gets
+            # scheduled) until the ExplorerStall watchdog. Model the
+            # sleep as release -> yield -> reacquire and report a legal
+            # spurious wakeup; the caller's predicate loop re-waits (and
+            # so re-yields) until the notifier has actually run.
+            saved = self._count
+            for _ in range(saved):
+                self.release()
+            st.yield_point(("cond-wait", self.name))
+            for _ in range(saved):
+                self.acquire()
+            return True
+        count = self._count
+        for _ in range(count):
+            self._mark_released()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            for _ in range(count):
+                self._mark_acquired()
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # Reimplemented over self.wait so the held-state bookkeeping in
+        # wait() applies (Condition.wait_for would call inner.wait).
+        import time as _time
+
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = _time.monotonic() + timeout
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+def wrap_lock(value, name: str, state: SanitizerState,
+              family: Optional[Tuple[str, str]] = None):
+    """Wrap a threading primitive in its instrumented proxy; anything
+    that isn't a Lock/RLock/Condition passes through untouched."""
+    if isinstance(value, SanLock):
+        return value
+    if hasattr(value, "wait") and hasattr(value, "notify_all"):
+        return SanCondition(value, name, state, family=family)
+    if hasattr(value, "acquire") and hasattr(value, "release"):
+        return SanLock(value, name, state, family=family)
+    return value

@@ -1,0 +1,570 @@
+"""tpusan scenarios: the control plane's hottest concurrent paths driven
+by the interleaving explorer, plus the seeded violation fixtures.
+
+Two registries, both keyed by name and run per-seed from the CLI and the
+test suite:
+
+- ``SCENARIOS`` — REAL code paths (nothing seeded) under adversarial
+  schedules with post-run invariant checks. The unmodified repo must run
+  every scenario clean on every seed (``make race``); an invariant break
+  is recorded as an :data:`ATOMICITY` violation so a future regression
+  fails with witness stacks, not a silent flake.
+
+  1. ``store-churn`` — sharded-store multi-writer churn vs. the batched
+     off-lock watch dispatcher: per-kind oracle contents, no-gap/no-dup
+     per-key watch ordering, EXACT bounded-queue drop accounting, and a
+     fully-retired dispatcher (empty ring) at quiescence.
+  2. ``wal-compact`` — WAL group-commit racing compaction epoch
+     rotation: the surviving (snapshot, wal*) pair must restore
+     fingerprint-TOKEN-identical state.
+  3. ``migration-rollback`` — rebalancer-style checkpoint-aware
+     migration racing a prepare/unprepare churner (both under the pu
+     flock, as the plugins hold it): rollback-to-source leaves exactly
+     the prepared claims' partitions active — no leaked ICI partitions.
+  4. ``events-correlator`` — two EventRecorders (cross-thread correlator
+     state) emitting overlapping series: exactly ONE stored Event per
+     series (the cross-process dedup invariant), sane count bounds, and
+     exact emitted+suppressed accounting per recorder.
+
+- ``FIXTURES`` — seeded violations proving each detector class fires
+  deterministically on ANY seed and at ANY worker count (the fillers):
+  a lock-order cycle between two shard locks taken outside the
+  ``ordered-acquire`` helper, a guarded-by attribute write without the
+  named lock (while another thread holds it — both witnesses named),
+  and the PR-8 lost-wakeup dispatcher bug (non-atomic role retirement)
+  resurfaced and caught by the stranded-ring invariant.
+
+Every scenario builds its objects AFTER ``instrument.install()`` patched
+the classes, so the locks it creates are SanLocks and the explorer owns
+every switch point.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Callable, Dict, List, Tuple
+
+from k8s_dra_driver_tpu.analysis.sanitizer.explorer import explore
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (
+    ATOMICITY,
+    SanitizerState,
+    Violation,
+    capture_stack,
+)
+
+# Worker callables per scenario: (name, fn) pairs.
+_Workers = List[Tuple[str, Callable[[], None]]]
+
+
+def _fillers(state: SanitizerState, n: int) -> _Workers:
+    """No-op workers that only yield: the any-worker-count knob. Their
+    presence perturbs every schedule without touching shared state, so a
+    detector that only fires at one worker count is caught."""
+    def mk(i):
+        def filler():
+            for _ in range(3):
+                state.yield_point(("filler", str(i)))
+        return filler
+    return [(f"filler-{i}", mk(i)) for i in range(n)]
+
+
+def _invariant(state: SanitizerState, ok: bool, message: str,
+               other_thread: str = "",
+               other_stack: Tuple[str, ...] = ()) -> None:
+    if ok:
+        return
+    state.record(Violation(
+        kind=ATOMICITY, message=message,
+        thread=threading.current_thread().name,
+        stack=capture_stack(2),
+        other_thread=other_thread, other_stack=other_stack,
+    ))
+
+
+# -- shared object builders ---------------------------------------------------
+
+
+def _pod(name: str, ns: str = "default"):
+    from k8s_dra_driver_tpu.k8s.core import Pod
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+    return Pod(meta=new_meta(name, ns))
+
+
+def _claim_for_devices(devices, name: str):
+    """Minimal allocated ResourceClaim for the plugin prepare path (the
+    shape tests/test_tpu_plugin.make_claim builds)."""
+    from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
+    from k8s_dra_driver_tpu.k8s.core import (
+        AllocationResult,
+        DeviceRequestAllocationResult,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+
+    claim = ResourceClaim(meta=new_meta(name, "default"))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[
+            DeviceRequestAllocationResult(
+                request=f"r{i}", driver=TPU_DRIVER_NAME,
+                pool="node-0", device=d,
+            )
+            for i, d in enumerate(devices)
+        ],
+        node_name="node-0",
+    )
+    return claim
+
+
+# -- scenario 1: sharded store churn vs. batched dispatcher -------------------
+
+_CHURN_OPS = 18
+_TINY_QUEUE = 4
+
+
+def scenario_store_churn(state: SanitizerState, seed: int,
+                         extra_workers: int = 0) -> None:
+    from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
+    from k8s_dra_driver_tpu.k8s.core import (
+        NODE,
+        POD,
+        RESOURCE_CLAIM,
+        Node,
+        Pod,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError, new_meta
+    import random
+
+    api = APIServer(shards=4)
+    kinds = {POD: Pod, RESOURCE_CLAIM: ResourceClaim, NODE: Node}
+    # Subscribed before any write: min_seq=0, every event matches.
+    full = {k: api.watch(k, maxsize=65536) for k in kinds}
+    tiny = {k: api.watch(k, maxsize=_TINY_QUEUE) for k in kinds}
+    emitted = {k: 0 for k in kinds}  # successful (event-emitting) ops
+
+    def churn(kind, cls, wseed):
+        rng = random.Random(wseed)
+        names = [f"{kind.lower()}-{i}" for i in range(4)]
+        for _ in range(_CHURN_OPS):
+            name = rng.choice(names)
+            r = rng.random()
+            try:
+                if r < 0.5:
+                    api.create(cls(meta=new_meta(name, "default")))
+                elif r < 0.8:
+                    got = api.get(kind, name, "default")
+                    got.meta.labels["touched"] = "1"
+                    api.update(got)
+                else:
+                    api.delete(kind, name, "default")
+                emitted[kind] += 1  # single writer per kind: exact
+            except (NotFoundError, AlreadyExistsError, ConflictError):
+                pass
+
+    workers: _Workers = [
+        (f"writer-{kind}", (lambda k=kind, c=cls, i=i:
+                            churn(k, c, seed * 31 + i)))
+        for i, (kind, cls) in enumerate(kinds.items())
+    ]
+    explore(state, seed, workers + _fillers(state, extra_workers))
+    api.flush_watchers()
+
+    # Dispatcher fully retired: nothing stranded on the ring.
+    with api._ring_mu:
+        _invariant(state, not api._ring and not api._dispatching,
+                   f"dispatch ring not drained at quiescence: "
+                   f"{len(api._ring)} event(s) stranded, "
+                   f"dispatching={api._dispatching} (lost-wakeup class)")
+    drops_expected = 0
+    for kind in kinds:
+        # Full-size subscription: every event, per-key rv never regresses.
+        seen = 0
+        key_rv: Dict[str, int] = {}
+        q = full[kind]
+        while not q.empty():
+            ev = q.get_nowait()
+            seen += 1
+            rv = ev.obj.meta.resource_version
+            _invariant(state, rv >= key_rv.get(ev.obj.meta.name, 0),
+                       f"{kind}/{ev.obj.meta.name}: watch rv went backwards "
+                       f"under batched fan-out")
+            key_rv[ev.obj.meta.name] = rv
+        _invariant(state, seen == emitted[kind],
+                   f"{kind}: unbounded watcher saw {seen} events, "
+                   f"writers emitted {emitted[kind]} (gap or duplicate)")
+        # Tiny stalled subscription: oldest-drop keeps exactly the last
+        # maxsize events; every overflow drops exactly one.
+        kept = tiny[kind].qsize()
+        _invariant(state, kept == min(emitted[kind], _TINY_QUEUE),
+                   f"{kind}: stalled watcher retained {kept}, expected "
+                   f"{min(emitted[kind], _TINY_QUEUE)}")
+        drops_expected += max(0, emitted[kind] - _TINY_QUEUE)
+    _invariant(state, api.stats.watch_events_dropped == drops_expected,
+               f"watch_events_dropped={api.stats.watch_events_dropped} but "
+               f"exactly {drops_expected} events overflowed the stalled "
+               f"subscriptions — drop accounting drifted under batching")
+
+
+# -- scenario 2: WAL group-commit vs. compaction epoch rotation ---------------
+
+
+def scenario_wal_compact(state: SanitizerState, seed: int,
+                         extra_workers: int = 0) -> None:
+    from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
+    from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM, Pod, ResourceClaim
+    from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError, new_meta
+    from k8s_dra_driver_tpu.k8s.persist import StoreWAL, open_persistent_store
+    import random
+
+    with tempfile.TemporaryDirectory(prefix="tpusan-wal-") as tmp:
+        api = APIServer(shards=2)
+        # compact_every low: epoch rotation fires repeatedly INSIDE the
+        # dispatch loop (maybe_compact — the sanctioned path), racing
+        # the other threads' enqueues and flush attempts.
+        wal = StoreWAL(tmp, compact_every=6, fsync=False)
+        api.attach_wal(wal)
+        kinds = {POD: Pod, RESOURCE_CLAIM: ResourceClaim}
+
+        def churn(kind, cls, wseed):
+            rng = random.Random(wseed)
+            names = [f"{kind.lower()}-{i}" for i in range(4)]
+            for _ in range(12):
+                name = rng.choice(names)
+                try:
+                    if rng.random() < 0.6:
+                        api.create(cls(meta=new_meta(name, "default")))
+                    else:
+                        api.delete(kind, name, "default")
+                except (NotFoundError, AlreadyExistsError, ConflictError):
+                    pass
+                api.flush_watchers()  # group-commit records hit the WAL
+
+        def flusher():
+            # A thread whose only job is contending for the dispatcher
+            # role (and therefore the group-commit append + compaction).
+            for _ in range(8):
+                api.flush_watchers()
+                state.yield_point(("scenario", "flusher"))
+
+        workers: _Workers = [
+            (f"writer-{kind}", (lambda k=kind, c=cls, i=i:
+                                churn(k, c, seed * 17 + i)))
+            for i, (kind, cls) in enumerate(kinds.items())
+        ] + [("flusher", flusher)]
+        explore(state, seed, workers + _fillers(state, extra_workers))
+
+        api.flush_watchers()
+        wal.close()
+        restored = open_persistent_store(tmp, shards=2)
+        for kind in kinds:
+            want, got = api.kind_fingerprint(kind), restored.kind_fingerprint(kind)
+            _invariant(state, want == got,
+                       f"{kind}: restore fingerprint token {got} != live "
+                       f"{want} — a WAL record or snapshot row was lost "
+                       f"across the group-commit/compaction race")
+            live = {o.meta.name for o in api.list(kind)}
+            back = {o.meta.name for o in restored.list(kind)}
+            _invariant(state, live == back,
+                       f"{kind}: restored contents diverge: "
+                       f"missing={sorted(live - back)} "
+                       f"extra={sorted(back - live)}")
+        restored._wal.close()
+
+
+# -- scenario 3: migration rollback vs. prepare/unprepare churn ---------------
+
+
+def scenario_migration_rollback(state: SanitizerState, seed: int,
+                                extra_workers: int = 0) -> None:
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.flock import Flock
+    from k8s_dra_driver_tpu.pkg.partitioner import (
+        PartitionManager,
+        StubPartitionClient,
+    )
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    with tempfile.TemporaryDirectory(prefix="tpusan-mig-") as tmp:
+        stub = StubPartitionClient()
+        dev = DeviceState(
+            MockTpuLib("v5e-4"), os.path.join(tmp, "plugin"),
+            cdi_root=os.path.join(tmp, "cdi"),
+            gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+        )
+        dev.partitions = PartitionManager(dev.inventory.host_topology, stub)
+        pu_path = os.path.join(tmp, "plugin", "pu.lock")
+        claim_a = _claim_for_devices(["tpu-subslice-1x2-at-0x0"], "mig-a")
+        claim_b = _claim_for_devices(["tpu-subslice-1x2-at-1x0"], "mig-b")
+
+        def migrator():
+            # The rebalancer's unit: prepare -> migrate_out (checkpoint
+            # persisted, devices released) -> rollback-to-source
+            # re-prepare. Each step under the node's pu flock, exactly
+            # as the kubelet plugins hold it.
+            pu = Flock(pu_path)
+            with pu.hold():
+                dev.prepare(claim_a)
+            with pu.hold():
+                dev.migrate_out(claim_a.uid)
+            with pu.hold():
+                dev.prepare(claim_a)
+
+        def churner():
+            pu = Flock(pu_path)
+            for _ in range(2):
+                with pu.hold():
+                    dev.prepare(claim_b)
+                with pu.hold():
+                    dev.unprepare(claim_b.uid)
+
+        explore(state, seed,
+                [("migrator", migrator), ("churner", churner)]
+                + _fillers(state, extra_workers))
+
+        # Rollback complete, churner quiesced unprepared: exactly the
+        # migrated claim's partition is active, and a restarted plugin
+        # would find zero unknown partitions to destroy.
+        active = stub.active_ids()
+        _invariant(state, len(active) == 1,
+                   f"partition ledger holds {len(active)} active "
+                   f"partition(s) {active} after rollback — expected "
+                   f"exactly claim mig-a's one (leak or lost rollback)")
+        from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+        entries = dev.prepared_claims()
+        _invariant(state,
+                   set(entries) == {claim_a.uid}
+                   and entries[claim_a.uid].state == PREPARE_COMPLETED,
+                   f"checkpoint entries after rollback: "
+                   f"{ {u: e.state for u, e in entries.items()} } — "
+                   f"expected only mig-a at PrepareCompleted")
+
+
+# -- scenario 4: EventRecorder cross-thread correlator state ------------------
+
+
+def scenario_events_correlator(state: SanitizerState, seed: int,
+                               extra_workers: int = 0) -> None:
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import EVENT
+    from k8s_dra_driver_tpu.pkg.events import (
+        EventRecorder,
+        REASON_FAILED_SCHEDULING,
+        REASON_SCHEDULED,
+    )
+
+    api = APIServer(shards=2)
+    pod = api.create(_pod("storm-pod"))
+    # Two recorders sharing one store: the cross-process correlator
+    # shape. Burst high enough that nothing is suppressed — accounting
+    # must then be exact.
+    recs = [EventRecorder(api, "scheduler", burst=1000) for _ in range(2)]
+    attempts = 10
+
+    def emitter(rec, extra_reason):
+        for _ in range(attempts):
+            rec.warning(pod, REASON_FAILED_SCHEDULING, "0/4 nodes feasible")
+        rec.normal(pod, extra_reason, f"bound by {rec.component}")
+
+    explore(state, seed,
+            [("recorder-a", lambda: emitter(recs[0], REASON_SCHEDULED)),
+             ("recorder-b", lambda: emitter(recs[1], REASON_SCHEDULED))]
+            + _fillers(state, extra_workers))
+
+    events = api.list(EVENT, namespace="default")
+    series = {}
+    for ev in events:
+        key = (ev.type, ev.reason, ev.message)
+        series.setdefault(key, []).append(ev)
+    for key, rows in series.items():
+        _invariant(state, len(rows) == 1,
+                   f"series {key} stored {len(rows)} Event rows — two "
+                   f"recorders raced past the deterministic-name dedup")
+    storm = [ev for ev in events if ev.reason == REASON_FAILED_SCHEDULING]
+    _invariant(state, len(storm) == 1 and 2 <= storm[0].count <= 2 * attempts,
+               f"FailedScheduling storm aggregated into "
+               f"{[e.count for e in storm]} (rows={len(storm)}) — expected "
+               f"one row, count in [2, {2 * attempts}]")
+    if storm:
+        _invariant(state,
+                   storm[0].first_timestamp <= storm[0].last_timestamp,
+                   "aggregated Event timestamps regressed "
+                   f"(first={storm[0].first_timestamp} > "
+                   f"last={storm[0].last_timestamp})")
+    for rec in recs:
+        # Nothing may be silently lost: burst=1000 admits every series.
+        total = sum(
+            rec.suppressed_total.value("scheduler", reason)
+            for reason in (REASON_FAILED_SCHEDULING, REASON_SCHEDULED))
+        _invariant(state, total == 0,
+                   f"{total} emissions suppressed despite an "
+                   f"uncontended token bucket (burst=1000)")
+
+
+SCENARIOS: Dict[str, Callable[..., None]] = {
+    "store-churn": scenario_store_churn,
+    "wal-compact": scenario_wal_compact,
+    "migration-rollback": scenario_migration_rollback,
+    "events-correlator": scenario_events_correlator,
+}
+
+
+# -- seeded violation fixtures ------------------------------------------------
+
+
+def fixture_lock_order_cycle(state: SanitizerState, seed: int,
+                             extra_workers: int = 0) -> None:
+    """Two shard locks of one store acquired in OPPOSITE orders by two
+    threads, neither inside the ordered-acquire helper: the family rule
+    fires on the first nested acquire, and the cycle detector closes the
+    A->B / B->A loop with both witness stacks. Under the explorer the
+    try-acquire/yield loops mean even the deadlock-prone schedule
+    completes — the graph, not luck, reports it."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=2)
+    sa, sb = api._shards[0], api._shards[1]
+    ab_done = [False]
+
+    def a_then_b():
+        with sa.mu:
+            state.yield_point(("fixture", "a-holds-a"))
+            with sb.mu:
+                pass
+        ab_done[0] = True
+
+    def b_then_a():
+        # Sequenced after t-ab so the run completes on every seed: a
+        # lock-order graph flags the INVERSION — the actual deadlock
+        # never has to happen (in a deadlocking schedule the explorer's
+        # attempt-time edges still record the cycle before stalling).
+        while not ab_done[0]:
+            state.yield_point(("fixture", "await-ab"))
+        with sb.mu:
+            state.yield_point(("fixture", "b-holds-b"))
+            with sa.mu:
+                pass
+
+    explore(state, seed,
+            [("t-ab", a_then_b), ("t-ba", b_then_a)]
+            + _fillers(state, extra_workers))
+
+
+def fixture_guarded_by_write(state: SanitizerState, seed: int,
+                             extra_workers: int = 0) -> None:
+    """A guarded shard index mutated WITHOUT its shard lock, while the
+    other thread holds that very lock — the write that corrupts a reader
+    mid-scan. The runtime assert names the writer AND the current
+    holder."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=2)
+    shard = api._shards[0]
+    holding = [False]
+    wrote = [False]
+
+    def holder():
+        with shard.mu:
+            holding[0] = True
+            while not wrote[0]:
+                state.yield_point(("fixture", "holder-spin"))
+
+    def rogue_writer():
+        while not holding[0]:
+            state.yield_point(("fixture", "writer-spin"))
+        # Direct index mutation, no lock: exactly what a helper reached
+        # through dynamic dispatch can do behind the static checker's
+        # back.
+        shard.objects[("Pod", "default", "rogue")] = _pod("rogue")
+        wrote[0] = True
+
+    explore(state, seed,
+            [("holder", holder), ("rogue-writer", rogue_writer)]
+            + _fillers(state, extra_workers))
+
+
+def fixture_dispatcher_atomicity(state: SanitizerState, seed: int,
+                                 extra_workers: int = 0) -> None:
+    """Re-seed the PR-8 lost-wakeup bug: a dispatcher that retires its
+    role in TWO steps (empty-check, then flag-clear in a separate
+    critical section). A writer that enqueues inside the window sees
+    ``_dispatching`` still True and walks away; the retiring dispatcher
+    never re-checks — the event strands on the ring. The explorer drives
+    the writer into the window on every seed (coordinated spin), and the
+    stranded-ring invariant reports both threads."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.store import WATCH_DISPATCH_BATCH
+
+    api = APIServer(shards=2)
+    in_gap = [False]
+    enqueued = [False]
+    witness = {}
+
+    def broken_dispatch():
+        with api._ring_mu:
+            if api._dispatching or not api._ring:
+                return
+            api._dispatching = True
+        while True:
+            with api._ring_mu:
+                batch = api._ring[:WATCH_DISPATCH_BATCH]
+                del api._ring[:len(batch)]
+            if not batch:
+                # BUG under test: the empty-check above and this
+                # retirement are separate critical sections.
+                in_gap[0] = True
+                while not enqueued[0]:
+                    state.yield_point(("fixture", "gap"))
+                witness["dispatcher"] = (threading.current_thread().name,
+                                         capture_stack(2))
+                with api._ring_mu:
+                    api._dispatching = False
+                return
+            api._deliver(batch)
+
+    api._dispatch = broken_dispatch
+
+    def first_writer():
+        api.create(_pod("pod-a"))
+
+    def racing_writer():
+        while not in_gap[0]:
+            state.yield_point(("fixture", "writer-spin"))
+        api.create(_pod("pod-b"))  # enqueues; sees _dispatching, leaves
+        witness["writer"] = (threading.current_thread().name,
+                             capture_stack(2))
+        enqueued[0] = True
+
+    explore(state, seed,
+            [("dispatcher", first_writer), ("writer", racing_writer)]
+            + _fillers(state, extra_workers))
+
+    with api._ring_mu:
+        stranded = len(api._ring)
+        dispatching = api._dispatching
+    if stranded and not dispatching:
+        d_name, d_stack = witness.get("dispatcher", ("?", ()))
+        w_name, w_stack = witness.get("writer", ("?", ()))
+        state.record(Violation(
+            kind=ATOMICITY,
+            message=(
+                f"{stranded} watch event(s) stranded on the dispatch ring "
+                f"with no active dispatcher — the dispatcher retired its "
+                f"role non-atomically with the empty check (lost wakeup); "
+                f"the racing writer's event will sit until an unrelated "
+                f"write"),
+            thread=w_name, stack=w_stack,
+            other_thread=d_name, other_stack=d_stack,
+        ))
+
+
+# fixture name -> (callable, violation kind it must produce)
+FIXTURES: Dict[str, Tuple[Callable[..., None], str]] = {
+    "lock-order-cycle": (fixture_lock_order_cycle, "lock-order-cycle"),
+    "guarded-by-write": (fixture_guarded_by_write, "guarded-by"),
+    "dispatcher-atomicity": (fixture_dispatcher_atomicity, "atomicity"),
+}

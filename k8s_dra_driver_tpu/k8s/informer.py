@@ -44,7 +44,7 @@ class Informer:
         self.kind = kind
         self.field_name = field_name
         self.field_namespace = field_namespace
-        self._cache: Dict[str, K8sObject] = {}
+        self._cache: Dict[str, K8sObject] = {}  # tpulint: guarded-by=_mu
         self._mu = threading.RLock()
         self._on_add: List[Handler] = []
         self._on_update: List[Handler] = []

@@ -57,6 +57,13 @@ FORMAT_VERSION = 1
 
 _WAL_NAME = re.compile(r"^wal(?:-(\d+))?\.(\d+)\.jsonl$")
 
+
+def _fsync(fd: int) -> None:
+    """All WAL/snapshot durability funnels through this seam so the
+    concurrency sanitizer (analysis/sanitizer) can interleave thread
+    schedules at fsync boundaries; production behavior is os.fsync."""
+    os.fsync(fd)
+
 # Compact once this many WAL records accumulate past the last snapshot:
 # bounds replay work to one snapshot decode + this many record applies.
 DEFAULT_COMPACT_EVERY = 50_000
@@ -97,17 +104,29 @@ class StoreWAL:
 
     def _file(self, shard_idx: Optional[int]):
         """The current-epoch file handle for one shard (durable mode) or
-        the shared group-commit file (``None``)."""
+        the shared group-commit file (``None``). The open() itself — a
+        blocking filesystem call, first write of each epoch only — runs
+        OUTSIDE ``_mu`` (sleep-under-lock) and installs under a
+        double-check: if compaction rotated the epoch meanwhile, the
+        stale handle is discarded and the lookup retries against the new
+        epoch. Per-key callers are already serialized (group-commit by
+        the single dispatcher, durable by the owning shard's lock), so
+        the same key is never opened twice concurrently."""
         key = -1 if shard_idx is None else shard_idx
         with self._mu:
             f = self._files.get(key)
-            if f is None:
-                name = (f"wal.{self._epoch}.jsonl" if shard_idx is None
-                        else f"wal-{shard_idx}.{self._epoch}.jsonl")
-                f = open(os.path.join(self.dirpath, name), "a",
-                         encoding="utf-8")
-                self._files[key] = f
-            return f
+            if f is not None:
+                return f
+            epoch = self._epoch
+        name = (f"wal.{epoch}.jsonl" if shard_idx is None
+                else f"wal-{shard_idx}.{epoch}.jsonl")
+        nf = open(os.path.join(self.dirpath, name), "a", encoding="utf-8")
+        with self._mu:
+            if self._epoch == epoch and key not in self._files:
+                self._files[key] = nf
+                return nf
+        nf.close()
+        return self._file(shard_idx)
 
     def attach_metrics(self, registry) -> None:
         from k8s_dra_driver_tpu.pkg.metrics import Counter
@@ -143,7 +162,7 @@ class StoreWAL:
         f.write(data)
         f.flush()
         if self.fsync:  # pragma: no cover — durable runs use write_sync
-            os.fsync(f.fileno())
+            _fsync(f.fileno())
         self._note(len(recs), len(data))
 
     def write_sync(self, shard_idx: int, rec) -> None:
@@ -155,7 +174,7 @@ class StoreWAL:
         f = self._file(shard_idx)
         f.write(data)
         f.flush()
-        os.fsync(f.fileno())
+        _fsync(f.fileno())
         self._note(1, len(data))
 
     # -- compaction ----------------------------------------------------------
@@ -193,7 +212,7 @@ class StoreWAL:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f, separators=(",", ":"))
             f.flush()
-            os.fsync(f.fileno())
+            _fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
         for path in glob.glob(os.path.join(self.dirpath, "wal*.jsonl")):
             m = _WAL_NAME.match(os.path.basename(path))

@@ -309,51 +309,62 @@ class APIServer:
         registry is consulted once per kind per batch (not per event),
         then put each matching event with the bounded-queue oldest-drop
         accounting. Only the active dispatcher runs this, so the exact
-        drop counts can't race."""
+        drop counts can't race. Each kind's delivery loop runs UNDER
+        ``_watch_mu`` (puts are non-blocking, so the hold is bounded):
+        ``stop_watch`` serializes against in-flight delivery, which is
+        what guarantees a closed subscription never receives another
+        event — and never has phantom drops counted against it. The
+        pre-fix shape (copy the list, put outside the lock) delivered
+        into queues whose watchers had already unsubscribed mid-batch."""
         by_kind: Dict[str, List[tuple]] = {}
         for entry in batch:
             by_kind.setdefault(entry[1], []).append(entry)
         metrics = self._metrics
         for kind, entries in by_kind.items():
             with self._watch_mu:
-                watchers = list(self._watchers.get(kind, ()))
-            if not watchers:
-                continue
-            for q, name, ns, min_seq in watchers:
-                lost = 0
-                for seq, _, event, _ in entries:
-                    if seq <= min_seq:
-                        continue  # predates this subscription's snapshot
-                    if name is not None and event.obj.meta.name != name:
-                        continue
-                    if ns is not None and event.obj.meta.namespace != ns:
-                        continue
-                    try:
-                        q.put_nowait(event)
-                        continue
-                    except queue.Full:
-                        pass
-                    # Stalled watcher: evict the oldest queued event so the
-                    # queue stays bounded and the newest state still
-                    # arrives. Count exactly the events actually lost — an
-                    # eviction, plus the new event itself if the freed slot
-                    # vanished again (defensive; no other producer exists).
-                    try:
-                        q.get_nowait()
-                        lost += 1
-                    except queue.Empty:
-                        pass  # consumer drained meanwhile: nothing dropped
-                    try:
-                        q.put_nowait(event)
-                    except queue.Full:  # pragma: no cover — no racing producer
-                        lost += 1
-                if lost:
-                    self.stats.watch_events_dropped += lost
-                    if metrics is not None:
-                        metrics["watch_dropped"].inc(kind, by=float(lost))
-            if metrics is not None:
-                metrics["watch_batches"].inc(kind)
-                metrics["watch_batch_events"].inc(kind, by=float(len(entries)))
+                watchers = self._watchers.get(kind, ())
+                if not watchers:
+                    continue
+                self._deliver_kind_locked(kind, entries, watchers, metrics)
+
+    def _deliver_kind_locked(self, kind: str, entries: List[tuple],
+                             watchers, metrics) -> None:
+        # tpulint: holds=_watch_mu (delivery vs stop_watch serialization)
+        for q, name, ns, min_seq in watchers:
+            lost = 0
+            for seq, _, event, _ in entries:
+                if seq <= min_seq:
+                    continue  # predates this subscription's snapshot
+                if name is not None and event.obj.meta.name != name:
+                    continue
+                if ns is not None and event.obj.meta.namespace != ns:
+                    continue
+                try:
+                    q.put_nowait(event)
+                    continue
+                except queue.Full:
+                    pass
+                # Stalled watcher: evict the oldest queued event so the
+                # queue stays bounded and the newest state still
+                # arrives. Count exactly the events actually lost — an
+                # eviction, plus the new event itself if the freed slot
+                # vanished again (defensive; no other producer exists).
+                try:
+                    q.get_nowait()
+                    lost += 1
+                except queue.Empty:
+                    pass  # consumer drained meanwhile: nothing dropped
+                try:
+                    q.put_nowait(event)
+                except queue.Full:  # pragma: no cover — no racing producer
+                    lost += 1
+            if lost:
+                self.stats.watch_events_dropped += lost
+                if metrics is not None:
+                    metrics["watch_dropped"].inc(kind, by=float(lost))
+        if metrics is not None:
+            metrics["watch_batches"].inc(kind)
+            metrics["watch_batch_events"].inc(kind, by=float(len(entries)))
 
     @staticmethod
     def _key(obj: K8sObject) -> _Key:

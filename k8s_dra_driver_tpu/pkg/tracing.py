@@ -113,7 +113,7 @@ class Tracer:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = capacity
         self._mu = threading.Lock()
-        self._spans: List[Span] = []
+        self._spans: List[Span] = []  # tpulint: guarded-by=_mu
         self._local = threading.local()
 
     # -- context -------------------------------------------------------------

@@ -82,7 +82,7 @@ class ExponentialRateLimiter(RateLimiter):
     def __init__(self, base: float = 0.005, cap: float = 1000.0):
         self.base = base
         self.cap = cap
-        self._failures: Dict[Hashable, int] = {}
+        self._failures: Dict[Hashable, int] = {}  # tpulint: guarded-by=_mu
         self._mu = threading.Lock()
 
     def when(self, key: Hashable) -> float:
@@ -161,13 +161,13 @@ class WorkQueue:
         # stays unconditional, series just aren't scraped anywhere.
         self.metrics = WorkQueueMetrics(metrics_registry or Registry())
         self._mu = threading.Condition()
-        self._heap: list[_Scheduled] = []
+        self._heap: list[_Scheduled] = []  # tpulint: guarded-by=_mu
         self._seq = 0
-        self._latest: Dict[Hashable, Any] = {}
-        self._queued: set[Hashable] = set()
-        self._processing: set[Hashable] = set()
-        self._dirty: set[Hashable] = set()  # re-enqueued while processing
-        self._retry_count: Dict[Hashable, int] = {}
+        self._latest: Dict[Hashable, Any] = {}  # tpulint: guarded-by=_mu
+        self._queued: set[Hashable] = set()  # tpulint: guarded-by=_mu
+        self._processing: set[Hashable] = set()  # tpulint: guarded-by=_mu
+        self._dirty: set[Hashable] = set()  # re-enqueued while processing  # tpulint: guarded-by=_mu
+        self._retry_count: Dict[Hashable, int] = {}  # tpulint: guarded-by=_mu
         self._stopped = False
         self._threads: list[threading.Thread] = []
 
@@ -184,6 +184,7 @@ class WorkQueue:
             self._queued.add(key)
             self._push_locked(key, delay)
 
+    # tpulint: holds=_mu (only enqueue/_finish call it, lock held)
     def _push_locked(self, key: Hashable, delay: float) -> None:
         self._seq += 1
         now = time.monotonic()

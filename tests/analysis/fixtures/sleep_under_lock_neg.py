@@ -1,0 +1,47 @@
+"""Negative fixture: blocking calls outside lock scopes, and the
+legitimate under-lock shapes — sleep-under-lock stays quiet."""
+
+import os
+import threading
+import time
+
+
+class Cache:
+    def __init__(self):
+        self._mu = threading.Condition()
+        self._items = {}  # tpulint: guarded-by=_mu
+
+    def put(self, k, v):
+        time.sleep(0.01)            # fine: before taking the lock
+        with self._mu:
+            self._items[k] = v
+            self._mu.notify_all()
+
+    def wait_for_key(self, k):
+        with self._mu:
+            while k not in self._items:
+                self._mu.wait(0.1)  # fine: Condition.wait releases the lock
+            return self._items[k]
+
+    def evict_then_log(self, k):
+        with self._mu:
+            self._items.pop(k, None)
+        time.sleep(0.01)            # fine: after release
+
+    def checkpoint(self, path):
+        data = repr(self._items)
+        f = open(path, "w")         # fine: no lock held
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())        # fine: durability outside the lock
+        f.close()
+
+    def _plain_helper(self, k):
+        # No holds= contract: not a lock region.
+        time.sleep(0.01)            # fine
+        return k
+
+    def copy_under_lock(self, other):
+        with self._mu:
+            # with-items that are not locks don't create a region
+            return dict(self._items)

@@ -1,0 +1,38 @@
+"""Positive fixture: blocking calls inside held-lock regions — every one
+must be flagged by sleep-under-lock."""
+
+import socket
+import threading
+import time
+
+
+class Cache:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}  # tpulint: guarded-by=_mu
+
+    def slow_put(self, k, v):
+        with self._mu:
+            time.sleep(0.1)          # BAD: sleep under the items lock
+            self._items[k] = v
+
+    def fetch_and_put(self, k, sock):
+        with self._mu:
+            data = sock.recv(4096)   # BAD: blocking socket read under lock
+            self._items[k] = data
+
+    def spill(self, k):
+        with self._mu:
+            f = open("/tmp/spill")   # BAD: file open under lock
+            self._items[k] = f.name
+
+    # tpulint: holds=_mu
+    def _locked_helper(self, k):
+        time.sleep(0.5)              # BAD: helper's callers hold the lock
+        self._items[k] = 1
+
+    def flush_under_flock(self, flock, fd):
+        import os
+
+        with flock.hold():
+            os.fsync(fd)             # BAD: fsync inside the flock hold

@@ -1,0 +1,81 @@
+"""Annotation-drift pin: the static checkers and the runtime sanitizer
+read the SAME annotation set through the SAME parser.
+
+tpulint's thread-shared-state/shard-lock checkers and tpusan's
+instrumentation both consume astutil.ModuleAnnotations. If either half
+grew its own parser again, a guard could be enforced statically but not
+dynamically (or vice versa) and the two tools would silently disagree —
+this suite fails instead."""
+
+import ast
+import os
+
+from k8s_dra_driver_tpu.analysis.astutil import (
+    parse_annotations,
+    parse_annotations_text,
+)
+from k8s_dra_driver_tpu.analysis.engine import SourceFile
+from k8s_dra_driver_tpu.analysis.sanitizer import instrument
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+STORE = "k8s_dra_driver_tpu/k8s/store.py"
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def test_static_and_dynamic_halves_see_identical_store_annotations():
+    """The acceptance pin, on the real sharded store: the SourceFile view
+    the checkers use and the raw-text view the sanitizer loads are one
+    and the same annotation set."""
+    text = _read(STORE)
+    static = SourceFile(os.path.join(REPO, STORE), STORE, text).annotations
+    dynamic = parse_annotations_text(text, filename=STORE)
+    assert static == dynamic
+    # And the set is the one PR 8 shipped: the shard buckets plus the
+    # watch/ring/assignment state, with the one ordered-acquire helper.
+    assert static.class_guards["_Shard"] == {
+        "objects": "mu", "by_kind": "mu", "by_kind_ns": "mu", "fp": "mu",
+    }
+    assert static.file_guards["_ring"] == "_ring_mu"
+    assert static.file_guards["_watchers"] == "_watch_mu"
+    assert static.file_guards["_shard_map"] == "_shard_assign_mu"
+    ordered = static.ordered_functions()
+    assert [fa.name for fa in ordered] == ["__enter__"]
+
+
+def test_sanitizer_discovery_covers_every_annotated_module():
+    """Every package module declaring a guard is found by the sanitizer's
+    module discovery, and its parsed annotations match a direct parse —
+    no module can carry annotations only one half sees."""
+    mods = instrument.discover_annotated_modules(REPO)
+    assert STORE in mods
+    assert "k8s_dra_driver_tpu/k8s/persist.py" in mods
+    assert "k8s_dra_driver_tpu/pkg/events.py" in mods
+    assert "k8s_dra_driver_tpu/pkg/workqueue.py" in mods
+    assert "k8s_dra_driver_tpu/k8s/informer.py" in mods
+    assert "k8s_dra_driver_tpu/pkg/tracing.py" in mods
+    for rel in mods:
+        text = _read(rel)
+        anns = parse_annotations_text(text, filename=rel)
+        assert anns == parse_annotations(
+            ast.parse(text, filename=rel), text.splitlines())
+        assert anns.class_guards or anns.file_guards or anns.functions, (
+            f"{rel}: discovered but parses to zero annotations")
+
+
+def test_holds_contract_readable_through_both_halves():
+    """The `holds=` family: the checker-facing fn_holds view and the
+    annotation dataclasses agree on a real helper (_push_locked carries
+    holds=_mu in pkg/workqueue.py)."""
+    rel = "k8s_dra_driver_tpu/pkg/workqueue.py"
+    text = _read(rel)
+    anns = parse_annotations_text(text, filename=rel)
+    tree = ast.parse(text)
+    target = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and n.name == "_push_locked")
+    assert anns.fn_holds(target) == frozenset({"_mu"})

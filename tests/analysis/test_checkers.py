@@ -45,6 +45,8 @@ CASES = [
     ("thread-shared-state", "thread_shared_state_pos.py", 3,
      "thread_shared_state_neg.py"),
     ("shard-lock", "shard_lock_pos.py", 5, "shard_lock_neg.py"),
+    ("sleep-under-lock", "sleep_under_lock_pos.py", 5,
+     "sleep_under_lock_neg.py"),
     ("metrics-docs", "docs_sync_pos.py", 1, "docs_sync_neg.py"),
     ("event-reasons", "docs_sync_pos.py", 2, "docs_sync_neg.py"),
 ]
@@ -78,6 +80,39 @@ def test_cas_purity_names_every_impurity_class():
     for token in ("time.sleep", "metric mutation", "event emission",
                   "nested API write", "I/O"):
         assert token in msgs, f"missing impurity class {token!r}: {msgs}"
+
+
+def test_sleep_under_lock_names_every_blocking_class():
+    msgs = " | ".join(
+        f.message for f in
+        run_rule("sleep-under-lock", "sleep_under_lock_pos.py").findings
+    )
+    for token in ("time.sleep", "blocking socket call", "file I/O (open)",
+                  "holds=", "fsync"):
+        assert token in msgs, f"missing blocking class {token!r}: {msgs}"
+
+
+def test_sleep_under_lock_detects_seeded_sleep_in_store(tmp_path):
+    """Seed a sleep into the real store's create() critical section —
+    the rule must name it; the unmodified store is pinned clean."""
+    src_path = os.path.join(REPO, "k8s_dra_driver_tpu/k8s/store.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "        with shard.mu:\n            key = self._key(obj)",
+        "        with shard.mu:\n            time.sleep(0.1)\n"
+        "            key = self._key(obj)", 1)
+    assert seeded != src
+    seeded = "import time\n" + seeded
+    target = tmp_path / "store.py"
+    target.write_text(seeded)
+    result = run_analysis(paths=[str(target)], repo_root=str(tmp_path),
+                          select=["sleep-under-lock"], baseline_path=None)
+    assert any("time.sleep" in f.message and "shard.mu" in f.message
+               for f in result.findings), [f.render() for f in result.findings]
+    clean = run_analysis(paths=[src_path], repo_root=REPO,
+                         select=["sleep-under-lock"], baseline_path=None)
+    assert not clean.findings, [f.render() for f in clean.findings]
 
 
 def test_lock_order_subrules_all_present():

@@ -1,0 +1,217 @@
+"""tpusan runtime unit tier: SanLock bookkeeping, the lock-order graph's
+cycle and family detectors, runtime guarded-by enforcement, and the
+instrumentation patch/unpatch lifecycle."""
+
+import threading
+
+import pytest
+
+from k8s_dra_driver_tpu.analysis.sanitizer import instrument
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (
+    GUARDED_BY,
+    LOCK_ORDER_CYCLE,
+    SHARD_FAMILY,
+    SanCondition,
+    SanitizerState,
+    SanLock,
+    wrap_lock,
+)
+
+
+def _lock(state, name, family=None):
+    return SanLock(threading.Lock(), name, state, family=family)
+
+
+def test_single_thread_nested_inversion_is_a_cycle():
+    """a->b then b->a — even from ONE thread across time, the graph
+    closes and reports a potential deadlock."""
+    state = SanitizerState()
+    a, b = _lock(state, "a"), _lock(state, "b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    kinds = [v.kind for v in state.violations]
+    assert LOCK_ORDER_CYCLE in kinds
+    v = next(v for v in state.violations if v.kind == LOCK_ORDER_CYCLE)
+    assert v.thread and v.other_thread, "cycle report must name both witnesses"
+    assert "a#" in v.message and "b#" in v.message
+
+
+def test_consistent_order_never_reports():
+    state = SanitizerState()
+    a, b, c = (_lock(state, n) for n in "abc")
+    for _ in range(3):
+        with a, b, c:
+            pass
+        with a, c:
+            pass
+    assert state.violations == []
+
+
+def test_cycle_recorded_at_attempt_time_without_acquisition():
+    """The deadlock schedule itself: a thread holding ``a`` merely
+    ATTEMPTS ``b`` (note_attempt is what acquire() calls before
+    blocking), the opposing thread holds ``b`` and attempts ``a``. The
+    cycle is reported even though neither inner acquire ever succeeds —
+    edges recorded only on success would miss exactly this."""
+    state = SanitizerState()
+    a, b = _lock(state, "a"), _lock(state, "b")
+
+    def t1():
+        with a:
+            state.note_attempt(b)  # the blocked acquire's intent edge
+
+    th = threading.Thread(target=t1, name="t1")
+    th.start()
+    th.join(5)
+    with b:
+        state.note_attempt(a)  # opposing intent from this thread
+    v = next(v for v in state.violations if v.kind == LOCK_ORDER_CYCLE)
+    assert v.thread != v.other_thread and v.other_thread == "t1"
+
+
+def test_family_rule_fires_outside_ordered_scope_only():
+    state = SanitizerState()
+    a = _lock(state, "shard0.mu", family=("_Shard", "mu"))
+    b = _lock(state, "shard1.mu", family=("_Shard", "mu"))
+    with a:
+        with b:
+            pass
+    assert any(v.kind == SHARD_FAMILY for v in state.violations)
+
+
+def test_reentrant_rlock_counts_once():
+    state = SanitizerState()
+    r = SanLock(threading.RLock(), "r", state)
+    other = _lock(state, "o")
+    with r:
+        with r:  # re-acquire: no new node, no edge
+            with other:
+                pass
+    assert not state.violations
+    assert not state.held_by_current(r)
+
+
+def test_condition_wait_drops_held_state():
+    state = SanitizerState()
+    cond = SanCondition(threading.Condition(), "cv", state)
+    woke = []
+
+    def waiter():
+        with cond:
+            cond.wait(2)
+            woke.append(True)
+
+    th = threading.Thread(target=waiter, name="waiter")
+    th.start()
+    # If wait() kept the lock marked held, this acquire on another
+    # thread would still succeed (real Condition releases), but the
+    # sanitizer would believe two threads hold it at once.
+    acquired = cond.acquire(timeout=2)
+    assert acquired
+    cond.notify_all()
+    cond.release()
+    th.join(5)
+    assert woke and not state.violations
+
+
+def test_wrap_lock_passthrough_and_idempotence():
+    state = SanitizerState()
+    wrapped = wrap_lock(threading.Lock(), "x", state)
+    assert isinstance(wrapped, SanLock)
+    assert wrap_lock(wrapped, "x", state) is wrapped
+    assert isinstance(wrap_lock(threading.Condition(), "c", state),
+                      SanCondition)
+    assert wrap_lock("not-a-lock", "n", state) == "not-a-lock"
+
+
+# -- instrumentation lifecycle ------------------------------------------------
+
+
+@pytest.fixture
+def installed():
+    if instrument.enabled():  # TPU_SAN=1 session: reuse, fresh state
+        instr = instrument.current()
+        old = instr.set_state(SanitizerState())
+        yield instr
+        instr.set_state(old)
+        return
+    instr = instrument.install()
+    yield instr
+    instrument.uninstall()
+
+
+def test_install_wraps_store_locks_and_uninstall_restores(installed):
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=2)
+    assert isinstance(api._shards[0].mu, SanLock)
+    assert isinstance(api._ring_mu, SanLock)
+    assert type(api._ring).__name__ == "GuardedList"
+    # Normal operation under instrumentation stays clean.
+    from k8s_dra_driver_tpu.k8s.core import Pod
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+    api.create(Pod(meta=new_meta("p", "default")))
+    assert [v for v in installed.state.violations] == []
+
+
+def test_guarded_write_without_lock_names_both_witnesses(installed):
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=2)
+    shard = api._shards[0]
+    grabbed = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with shard.mu:
+            grabbed.set()
+            release.wait(5)
+
+    th = threading.Thread(target=holder, name="the-holder")
+    th.start()
+    grabbed.wait(5)
+    try:
+        shard.fp["Pod"] = (1, 1)  # guarded-by=mu, lock NOT held here
+    finally:
+        release.set()
+        th.join(5)
+    hits = [v for v in installed.state.violations if v.kind == GUARDED_BY]
+    assert hits, installed.state.render()
+    assert hits[0].other_thread == "the-holder"
+    assert "guarded-by=mu" in hits[0].message
+
+
+def test_init_writes_exempt(installed):
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    APIServer(shards=4)  # every guarded attr assigned in __init__
+    assert installed.state.violations == []
+
+
+def test_ordered_acquire_helper_is_sanctioned(installed):
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=4)
+    with api._locked_all():
+        pass
+    assert not any(v.kind == SHARD_FAMILY
+                   for v in installed.state.violations), (
+        installed.state.render())
+
+
+def test_uninstall_restores_plain_classes():
+    if instrument.enabled():
+        pytest.skip("TPU_SAN=1 session keeps instrumentation active")
+    instr = instrument.install()
+    instrument.uninstall()
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer(shards=2)
+    assert not isinstance(api._shards[0].mu, SanLock)
+    assert type(api._ring) is list
+    assert instr.instrumented_classes == []

@@ -41,3 +41,26 @@ def cpu_devices():
     devs = jax.devices()
     assert devs[0].platform == "cpu" and len(devs) >= 8, devs
     return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def tpusan_session():
+    """``TPU_SAN=1 pytest ...`` runs the whole suite under the runtime
+    concurrency sanitizer (analysis/sanitizer): every annotated lock is
+    instrumented, guarded-by writes are asserted, and the session FAILS
+    at teardown if any violation was recorded. Off by default — the
+    production import graph never touches the sanitizer, so the untagged
+    suite pays zero overhead."""
+    from k8s_dra_driver_tpu.analysis.sanitizer import instrument
+
+    if not instrument.env_requested():
+        yield
+        return
+    instr = instrument.install()
+    try:
+        yield
+    finally:
+        violations = list(instr.state.violations)
+        rendered = instr.state.render()
+        instrument.uninstall()
+    assert not violations, f"tpusan recorded violations:\n{rendered}"

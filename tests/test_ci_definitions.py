@@ -146,6 +146,26 @@ def test_container_image_contract():
         assert hits.strip(), f"image sets {var} but nothing reads it"
 
 
+def test_race_gate_wired_into_verify_and_ci():
+    """`make race` (the tpusan runtime concurrency sanitizer) is a
+    pre-merge gate: a dependency of `make verify` AND run by the
+    basic-checks CI step — a deleted wire must break this pin, not
+    silently drop the sanitizer tier."""
+    with open(os.path.join(REPO, "Makefile"), encoding="utf-8") as f:
+        mk = f.read()
+    race_rule = re.search(r"^race:\n\t(.+)$", mk, flags=re.M)
+    assert race_rule, "Makefile lost the race target"
+    assert "k8s_dra_driver_tpu.analysis.sanitizer" in race_rule.group(1)
+    verify = re.search(r"^verify:(.*)$", mk, flags=re.M)
+    assert verify and "race" in verify.group(1).split(), (
+        "make verify no longer depends on the race gate")
+    with open(os.path.join(STEPS_DIR, "basic-checks.sh"),
+              encoding="utf-8") as f:
+        basic = f.read()
+    assert "k8s_dra_driver_tpu.analysis.sanitizer" in basic, (
+        "hack/ci basic-checks no longer runs tpusan")
+
+
 def test_runner_rejects_unknown_step():
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "hack", "ci", "run-local.sh"), "no-such-step"],

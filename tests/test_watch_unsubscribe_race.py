@@ -1,0 +1,107 @@
+"""Watcher unsubscribe racing the batched off-lock dispatcher.
+
+stop_watch() must be a real barrier: once it returns, the subscription
+is CLOSED — no in-flight fan-out batch may deliver another event into
+its queue (the dispatcher copies the watcher registry per kind per
+batch, so without the `_watch_mu`-held delivery loop a concurrent
+unsubscribe left a window where the closed queue still received events
+and, when full, had phantom drops counted against it). And the
+bounded-queue drop accounting stays EXACT for the subscriptions that
+remain live through the storm."""
+
+import threading
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM, Pod, ResourceClaim
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+WRITES = 150
+TINY = 4
+
+
+def test_unsubscribe_churn_during_two_writer_burst():
+    api = APIServer(shards=4)
+    # One stalled tiny subscription that lives through the whole storm:
+    # the ONLY queue that can overflow, so expected drops are exact.
+    tiny = api.watch(POD, maxsize=TINY)
+    emitted = {POD: 0, RESOURCE_CLAIM: 0}
+    stop_churn = threading.Event()
+    closed: list = []
+    churn_errors: list = []
+
+    def writer(kind, cls):
+        for i in range(WRITES):
+            api.create(cls(meta=new_meta(f"{kind.lower()}-{i}", "default")))
+            emitted[kind] += 1
+
+    def churner():
+        # Subscribe/unsubscribe churn against both bursting kinds. Large
+        # maxsize: these queues must never overflow, so any drop the
+        # store counts is attributable to `tiny` alone.
+        try:
+            while not stop_churn.is_set():
+                for kind in (POD, RESOURCE_CLAIM):
+                    q = api.watch(kind, maxsize=100_000)
+                    api.stop_watch(kind, q)
+                    # Barrier semantics: drained now, it must STAY empty.
+                    while not q.empty():
+                        q.get_nowait()
+                    closed.append(q)
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            churn_errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(POD, Pod), name="writer-pod"),
+        threading.Thread(target=writer, args=(RESOURCE_CLAIM, ResourceClaim),
+                         name="writer-claim"),
+        threading.Thread(target=churner, name="churner-1"),
+        threading.Thread(target=churner, name="churner-2"),
+    ]
+    for t in threads:
+        t.start()
+    threads[0].join()
+    threads[1].join()
+    stop_churn.set()
+    threads[2].join(10)
+    threads[3].join(10)
+    api.flush_watchers()
+
+    assert not churn_errors, churn_errors
+    assert closed, "churners never completed a subscribe/unsubscribe cycle"
+    # 1) No delivery to a closed subscription: every churned queue was
+    # drained right after stop_watch returned and must still be empty
+    # after the full burst flushed.
+    dirty = [i for i, q in enumerate(closed) if not q.empty()]
+    assert not dirty, (
+        f"{len(dirty)} closed subscription(s) received events after "
+        f"stop_watch returned (first at index {dirty[:3]})")
+    # 2) Drop accounting exact: only `tiny` could overflow; oldest-drop
+    # means it lost exactly emitted - retained events.
+    assert tiny.qsize() == TINY
+    expected = emitted[POD] - TINY
+    assert api.stats.watch_events_dropped == expected, (
+        f"dropped={api.stats.watch_events_dropped}, expected {expected} "
+        f"(pod events {emitted[POD]}, tiny retained {tiny.qsize()})")
+
+
+def test_stop_watch_mid_batch_is_a_barrier():
+    """Deterministic single-threaded shape of the race: subscribe, write
+    a burst that is still sitting in the dispatch ring (no dispatcher
+    ran), unsubscribe, then flush. The closed queue gets nothing."""
+    api = APIServer(shards=2)
+    # Park events on the ring by making this thread NOT the dispatcher:
+    # enqueue under a fake active-dispatcher flag, then restore.
+    q = api.watch(POD, maxsize=8)
+    with api._ring_mu:
+        api._dispatching = True  # pretend someone else is dispatching
+    try:
+        for i in range(5):
+            api.create(Pod(meta=new_meta(f"p{i}", "default")))
+        assert q.qsize() == 0, "events delivered while dispatcher parked"
+    finally:
+        with api._ring_mu:
+            api._dispatching = False
+    api.stop_watch(POD, q)
+    api.flush_watchers()
+    assert q.qsize() == 0, "closed subscription received parked events"
+    assert api.stats.watch_events_dropped == 0
