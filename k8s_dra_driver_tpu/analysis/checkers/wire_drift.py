@@ -58,10 +58,14 @@ DEFAULT_SPECS: Tuple[WireKindSpec, ...] = (
             _API_CD: ("ComputeDomain", "ComputeDomainSpec",
                       "ComputeDomainChannelSpec", "ComputeDomainNode",
                       "ComputeDomainPlacement", "ComputeDomainStatus"),
+            "k8s_dra_driver_tpu/pkg/meshgen.py": ("MeshBundle",
+                                                  "MeshDevice"),
             _CONDITION[0]: _CONDITION[1],
         },
-        encoders=("_computedomain_encode", "_conditions_encode"),
-        decoders=("_computedomain_decode", "_conditions_decode"),
+        encoders=("_computedomain_encode", "_meshbundle_encode",
+                  "_conditions_encode"),
+        decoders=("_computedomain_decode", "_meshbundle_decode",
+                  "_conditions_decode"),
     ),
     WireKindSpec(
         kind="ComputeDomainClique",

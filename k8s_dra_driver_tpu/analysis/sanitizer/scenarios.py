@@ -405,11 +405,102 @@ def scenario_events_correlator(state: SanitizerState, seed: int,
                    f"uncontended token bucket (burst=1000)")
 
 
+# -- scenario 5: mesh-bundle re-emit racing the scheduler's placement write ---
+
+
+def scenario_meshgen_reemit(state: SanitizerState, seed: int,
+                            extra_workers: int = 0) -> None:
+    """The cd-controller's status aggregation (which compiles
+    ComputeDomainStatus.meshBundle inside its CAS mutate) racing the
+    scheduler's placement write on the same domain: whatever the
+    interleaving, the quiesced domain must hold the placement AND a bundle
+    compiled against THAT placement at revision exactly 1 — a stale
+    bundle paired with a fresh block, a lost placement, or a self-racing
+    double re-emit are all atomicity violations."""
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainChannelSpec,
+        ComputeDomainPlacement,
+        ComputeDomainSpec,
+    )
+    from k8s_dra_driver_tpu.controller.controller import Controller
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import (
+        Device,
+        DeviceCounterConsumption,
+        ResourceSlice,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+
+    api = APIServer(shards=2)
+    # NOT started: the explorer owns every thread, so the controller's
+    # real code paths (_on_slice_event, _update_status with its CAS
+    # recompile) are driven directly.
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    nodes = [f"mg-node-{i}" for i in range(4)]
+    for n in nodes:
+        rs = ResourceSlice(
+            meta=new_meta(f"slice-{n}"), node_name=n, driver="tpu.google.com",
+            devices=[Device(
+                name=f"tpu-{n}-chip-{i}",
+                attributes={"tpu.google.com/hostTopology": "2x2"},
+                consumes_counters=[DeviceCounterConsumption(
+                    counter_set="tpu-host-chips",
+                    counters={f"chip-{i}": None})],
+            ) for i in range(4)])
+        api.create(rs)
+        ctrl._on_slice_event(rs, deleted=False)
+    api.create(ComputeDomain(
+        meta=new_meta("mg-cd", "default"),
+        spec=ComputeDomainSpec(
+            num_nodes=4,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name="mg-cd-channel"))))
+
+    def scheduler():
+        def mutate(obj):
+            obj.status.placement = ComputeDomainPlacement(
+                ici_domain="mg-slice.0", block_origin="0x0",
+                block_shape="2x2", nodes=list(nodes))
+        api.update_with_retry("ComputeDomain", "mg-cd", "default", mutate)
+
+    def cd_controller():
+        for _ in range(3):
+            ctrl._update_status(api.get("ComputeDomain", "mg-cd", "default"))
+
+    explore(state, seed,
+            [("scheduler", scheduler), ("cd-controller", cd_controller)]
+            + _fillers(state, extra_workers))
+
+    # One post-race aggregation: by now the placement is visible, so the
+    # bundle MUST exist and agree with it.
+    ctrl._update_status(api.get("ComputeDomain", "mg-cd", "default"))
+    fresh = api.get("ComputeDomain", "mg-cd", "default")
+    _invariant(state, fresh.status.placement is not None,
+               "scheduler's placement write lost across the controller's "
+               "status-aggregation CAS")
+    _invariant(state, fresh.status.mesh_bundle is not None,
+               "mesh bundle never compiled despite a recorded placement "
+               "and published host topology")
+    if fresh.status.placement is not None and fresh.status.mesh_bundle is not None:
+        bundle_nodes = {d.node for d in fresh.status.mesh_bundle.device_order}
+        _invariant(state, bundle_nodes == set(fresh.status.placement.nodes),
+                   f"bundle device order names {sorted(bundle_nodes)} but the "
+                   f"recorded placement holds "
+                   f"{sorted(fresh.status.placement.nodes)} — a stale bundle "
+                   f"survived next to a fresh placement")
+        _invariant(state, fresh.status.mesh_bundle.revision == 1,
+                   f"quiesced domain at bundle revision "
+                   f"{fresh.status.mesh_bundle.revision} — identical geometry "
+                   f"must never re-emit (the same_geometry dedup raced)")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
     "migration-rollback": scenario_migration_rollback,
     "events-correlator": scenario_events_correlator,
+    "meshgen-reemit": scenario_meshgen_reemit,
 }
 
 

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE
 from k8s_dra_driver_tpu.k8s.objects import K8sObject
+from k8s_dra_driver_tpu.pkg.meshgen import MeshBundle
 
 COMPUTE_DOMAIN_FINALIZER = "resource.tpu.google.com/computedomain"
 
@@ -98,6 +99,11 @@ class ComputeDomainStatus:
     nodes: List[ComputeDomainNode] = field(default_factory=list)
     conditions: List[Condition] = field(default_factory=list)
     placement: Optional[ComputeDomainPlacement] = None
+    # The compiled Placement→JAX mesh bundle (pkg/meshgen): topology-
+    # aligned device order + axes + partition rules, (re-)emitted by the
+    # controller on placement or link-health change and injected into
+    # claiming containers as TPU_DRA_MESH_BUNDLE by the CDI handler.
+    mesh_bundle: Optional[MeshBundle] = None
 
 
 @dataclass

@@ -44,23 +44,27 @@ from k8s_dra_driver_tpu.k8s.core import (
     COMPUTE_DOMAIN,
     COMPUTE_DOMAIN_CLIQUE,
     DAEMON_SET,
+    ICI_LINK_TAINT_KEY,
     NODE,
     RESOURCE_CLAIM_TEMPLATE,
     RESOURCE_SLICE,
 )
-from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg import meshgen, tracing
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
 from k8s_dra_driver_tpu.pkg.events import (
     EventRecorder,
     REASON_DOMAIN_DEGRADED,
     REASON_DOMAIN_READY,
     REASON_DOMAIN_RECOVERED,
     REASON_DOMAIN_REJECTED,
+    REASON_MESH_BUNDLE_UPDATED,
 )
 from k8s_dra_driver_tpu.pkg.leaderelection import LeaderElector
 from k8s_dra_driver_tpu.pkg.metrics import (
     ComputeDomainStatusMetric,
     Counter,
     Histogram,
+    MeshgenMetrics,
     Registry,
 )
 from k8s_dra_driver_tpu.pkg.sliceconfig import SliceAgentConfig
@@ -118,6 +122,7 @@ class Controller:
         self.slice_config = slice_config or SliceAgentConfig()
         registry = metrics_registry or Registry()
         self.metric = ComputeDomainStatusMetric(registry)
+        self.meshgen_metrics = MeshgenMetrics(registry)
         self.recorder = EventRecorder(api, "cd-controller",
                                       metrics_registry=registry)
         self.reconciles_total = registry.register(Counter(
@@ -155,6 +160,13 @@ class Controller:
         self._taint_mu = threading.Lock()
         self._slice_taints: Dict[str, Tuple[str, bool]] = {}  # slice -> (node, tainted)
         self._tainted_nodes: Dict[str, int] = {}  # node -> tainted-slice count
+        # Mesh-compiler inputs folded from the same slice events (all under
+        # _taint_mu): per-node host topology (sticky — topology never
+        # changes while a node lives) and per-slice dead intra-host ICI
+        # links, so a link-health transition re-enqueues the domains whose
+        # bundle must re-route around it.
+        self._node_host_topo: Dict[str, str] = {}
+        self._slice_links: Dict[str, Tuple[str, frozenset]] = {}  # slice -> (node, {(a,b)})
         self._slice_informer = Informer(api, RESOURCE_SLICE)
         self._slice_informer.add_event_handler(
             on_add=lambda old, new: self._on_slice_event(new, deleted=False),
@@ -230,35 +242,101 @@ class Controller:
             if cd.uid == getattr(clique, "domain_uid", None):
                 self._enqueue(cd)
 
+    @staticmethod
+    def _slice_broken_links(rs) -> frozenset:
+        """Dead intra-host ICI links a slice's taints witness, as host-
+        local chip index pairs. The taint pass marks every device SPANNING
+        a dead link; the 2-chip spanning devices name its endpoints
+        exactly (larger spanners are supersets and carry no extra
+        information)."""
+        links = set()
+        for d in getattr(rs, "devices", []):
+            if not any(t.key == ICI_LINK_TAINT_KEY for t in d.taints):
+                continue
+            bits = placement_lib.chip_bits_of_device(d)
+            if placement_lib.popcount(bits) == 2:
+                a = (bits & -bits).bit_length() - 1
+                b = (bits ^ (1 << a)).bit_length() - 1
+                links.add((min(a, b), max(a, b)))
+        return frozenset(links)
+
     def _on_slice_event(self, rs, deleted: bool) -> None:
-        """Fold one ResourceSlice event into the node->tainted map; enqueue
-        only the domains that span the slice's node, and only when the
-        node's taint verdict actually moved (a quiet republish — pool
+        """Fold one ResourceSlice event into the node->tainted map and the
+        mesh-compiler inputs (host topology, dead ICI links); enqueue only
+        the domains that span the slice's node, and only when the node's
+        taint verdict or link set actually moved (a quiet republish — pool
         generation bump, no taint change — enqueues nothing)."""
         node = getattr(rs, "node_name", "")
         if not node:
             return
         tainted = (not deleted) and any(
             d.taints for d in getattr(rs, "devices", []))
+        links = frozenset() if deleted else self._slice_broken_links(rs)
+        host_topo = ""
+        for d in getattr(rs, "devices", []):
+            host_topo = d.attributes.get("tpu.google.com/hostTopology", "")
+            if host_topo:
+                break
         key = rs.meta.name
         with self._taint_mu:
             prev_node, prev_tainted = self._slice_taints.get(key, ("", False))
+            _, prev_links = self._slice_links.get(key, ("", frozenset()))
+            prev_topo = self._node_host_topo.get(node, "")
             if prev_tainted:
                 self._tainted_nodes[prev_node] = self._tainted_nodes.get(prev_node, 1) - 1
                 if self._tainted_nodes[prev_node] <= 0:
                     del self._tainted_nodes[prev_node]
             if deleted:
                 self._slice_taints.pop(key, None)
+                self._slice_links.pop(key, None)
+                # Last slice of the node gone (node removed): drop its
+                # sticky topology too, or autoscaler churn grows the map
+                # without bound and a reused name serves stale geometry.
+                if all(n != node for n, _ in self._slice_taints.values()):
+                    self._node_host_topo.pop(node, None)
             else:
                 self._slice_taints[key] = (node, tainted)
                 if tainted:
                     self._tainted_nodes[node] = self._tainted_nodes.get(node, 0) + 1
-            changed = prev_tainted != tainted
+                if links or key in self._slice_links:
+                    self._slice_links[key] = (node, links)
+                if host_topo:
+                    self._node_host_topo[node] = host_topo
+            # Topology ARRIVAL is a compile input too: a domain reconciled
+            # before its members' slices folded would otherwise stay
+            # bundle-less until an unrelated taint event (controller
+            # restart: CD reconcile can beat the slice informer's adds).
+            changed = (prev_tainted != tainted or prev_links != links
+                       or (bool(host_topo) and host_topo != prev_topo))
         if not changed:
             return
         for cd in self._cd_informer.list():
-            if any(n.name == node for n in cd.status.nodes):
+            members = {n.name for n in cd.status.nodes}
+            if cd.status.placement is not None:
+                members.update(cd.status.placement.nodes)
+            if node in members:
                 self._enqueue(cd)
+
+    def _mesh_inputs(self, member_nodes) -> Tuple[str, List[Tuple[str, int, int]]]:
+        """(host topology, dead links) for a domain's member set, read
+        from the maps the slice informer maintains. Host topology is
+        whichever member published one (members of one block share a
+        shape); an empty string means no member's slice carried topology
+        attributes and no bundle can compile."""
+        members = set(member_nodes)
+        with self._taint_mu:
+            topo = ""
+            for n in member_nodes:
+                topo = self._node_host_topo.get(n, "")
+                if topo:
+                    break
+            links = sorted({
+                (node, a, b)
+                for node, linkset in self._slice_links.values()
+                if node in members
+                for a, b in linkset
+            })
+        return topo, links
 
     def _reconcile_key(self, key, _obj) -> None:
         namespace, name = key
@@ -480,6 +558,33 @@ class Controller:
         with self._taint_mu:
             return sorted(set(member_names) & self._tainted_nodes.keys())
 
+    def _compile_mesh_bundle(self, placement, prev):
+        """Desired mesh bundle for a domain's recorded placement, evolved
+        from the previous one: identical geometry keeps the old bundle
+        (revision stable — a no-op reconcile must not bump), any change
+        (first placement, link-health transition) compiles a fresh bundle
+        at revision+1. Returns (bundle-or-None, trigger-or-"")."""
+        if placement is None:
+            return None, ""
+        host_topo, links = self._mesh_inputs(placement.nodes)
+        if not host_topo:
+            return prev, ""  # no topology surface: keep whatever exists
+        if prev is not None and prev.matches_inputs(
+                placement.block_shape, host_topo, placement.nodes, links):
+            return prev, ""  # unchanged inputs: skip the compile entirely
+        cand = meshgen.compile_for_placement(
+            placement, host_topo, broken_links=links,
+            revision=(prev.revision if prev is not None else 0) + 1)
+        if cand is None:
+            return prev, ""
+        if prev is not None and prev.same_geometry(cand):
+            return prev, ""
+        trigger = ("link-health" if prev is not None
+                   and [list(b) for b in cand.broken_links]
+                   != [list(b) for b in prev.broken_links]
+                   else "placement")
+        return cand, trigger
+
     def _update_status(self, cd: ComputeDomain) -> None:
         nodes = self._collect_nodes(cd)
         status = self._calculate_global_status(cd, nodes)
@@ -514,28 +619,63 @@ class Controller:
                           "AllDevicesHealthy", "")
         # The scheduler owns status.placement (the chosen host-grid
         # block); the controller's aggregation must carry it, not wipe it.
+        # The controller OWNS status.meshBundle: compiled from the
+        # placement plus the link-health state the slice informer folds,
+        # re-emitted (revision bump) when either moves.
+        bundle, trigger = self._compile_mesh_bundle(
+            fresh.status.placement, fresh.status.mesh_bundle)
         desired = ComputeDomainStatus(status=status, nodes=nodes,
                                       conditions=conds,
                                       placement=copy.deepcopy(
-                                          fresh.status.placement))
+                                          fresh.status.placement),
+                                      mesh_bundle=copy.deepcopy(bundle))
         if fresh.status == desired:
             self.metric.set(cd.namespace, cd.name, status)
+            if bundle is not None:
+                # Gauges, not just build events: a restarted/failed-over
+                # leader must re-export revision + hop scores for stable
+                # domains, not leave the series blank until geometry moves.
+                self.meshgen_metrics.record(cd.namespace, cd.name, bundle)
             return
         was_ready = condition_true(fresh.status.conditions, CD_COND_READY)
         was_degraded = condition_true(fresh.status.conditions, CD_COND_DEGRADED)
+        emitted: Dict[str, object] = {"bundle": bundle, "trigger": trigger}
 
         def mutate(obj):
             # Placement is re-read from the LIVE object, not the pre-read
             # copy: a CAS retry against a scheduler that just recorded the
-            # block must not revert it to the stale (None) value.
+            # block must not revert it to the stale (None) value — and the
+            # bundle recompiles against THAT placement (pure in-memory
+            # compile, safe under the CAS-retry contract).
             new = copy.deepcopy(desired)
             new.placement = copy.deepcopy(obj.status.placement)
+            b, trig = self._compile_mesh_bundle(
+                new.placement, obj.status.mesh_bundle)
+            new.mesh_bundle = copy.deepcopy(b)
+            emitted["bundle"], emitted["trigger"] = b, trig
             obj.status = new
 
         try:
             self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, mutate)
         except NotFoundError:
             return
+        new_bundle, new_trigger = emitted["bundle"], emitted["trigger"]
+        if new_bundle is not None and new_trigger:
+            self.meshgen_metrics.built(
+                cd.namespace, cd.name, new_bundle, new_trigger)
+            self.recorder.normal(
+                fresh, REASON_MESH_BUNDLE_UPDATED,
+                f"mesh bundle rev {new_bundle.revision}: axes "
+                + "x".join(f"{n}={s}" for n, s in zip(
+                    new_bundle.axis_names, new_bundle.axis_sizes))
+                + f", hop score {new_bundle.hop_score} "
+                  f"(naive {new_bundle.naive_hop_score})"
+                + (f", routed around {len(new_bundle.broken_links)} dead "
+                   f"link(s)" if new_bundle.broken_links else ""))
+        elif new_bundle is not None:
+            # Carried-forward bundle (status moved for other reasons):
+            # keep the gauges populated without counting a build.
+            self.meshgen_metrics.record(cd.namespace, cd.name, new_bundle)
         if status == CD_STATUS_READY and not was_ready:
             self.recorder.normal(
                 fresh, REASON_DOMAIN_READY,
@@ -584,6 +724,7 @@ class Controller:
                     pass
         self._remove_node_labels(cd.uid)
         self.metric.forget(cd.namespace, cd.name)
+        self.meshgen_metrics.forget(cd.namespace, cd.name)
 
         def drop_finalizer(obj):
             obj.meta.finalizers = [

@@ -70,6 +70,15 @@ class DeviceTaint:
     effect: str = "NoSchedule"  # or NoExecute
 
 
+# Well-known device-taint keys the tpu kubelet plugin publishes (and the
+# allocator/controller consume). Chip-level silicon faults vs fabric-level
+# link faults keep distinct keys so an operator — and the mesh compiler,
+# which must route AROUND a dead link rather than drop its endpoint chips
+# — can tell them apart.
+UNHEALTHY_TAINT_KEY = "tpu.google.com/unhealthy"
+ICI_LINK_TAINT_KEY = "tpu.google.com/ici-link-unhealthy"
+
+
 @dataclass
 class Counter:
     value: int = 0

@@ -32,6 +32,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainStatus,
 )
 from k8s_dra_driver_tpu.k8s.conditions import Condition
+from k8s_dra_driver_tpu.pkg.meshgen import MeshBundle, MeshDevice
 from k8s_dra_driver_tpu.k8s.core import (
     AllocationResult,
     Container,
@@ -882,6 +883,52 @@ def _deviceclass_decode(doc: Dict[str, Any]) -> DeviceClass:
 # -- ComputeDomain CRDs ------------------------------------------------------
 
 
+def _meshbundle_encode(mb: MeshBundle) -> Dict[str, Any]:
+    """status.meshBundle — the Placement→JAX mesh compiler output. The
+    wire shape matches MeshBundle.to_json_obj (the TPU_DRA_MESH_BUNDLE
+    env uses the same keys), spelled out here so the wire-drift checker
+    sees every field cross the boundary."""
+    return {
+        "revision": mb.revision,
+        "sliceTopology": mb.slice_topology,
+        "hostTopology": mb.host_topology,
+        "processBounds": mb.process_bounds,
+        "axisNames": list(mb.axis_names),
+        "axisSizes": list(mb.axis_sizes),
+        "deviceOrder": [
+            {"node": d.node, "worker": d.worker, "chip": d.chip,
+             "coord": list(d.coord)}
+            for d in mb.device_order
+        ],
+        "partitionRules": [list(r) for r in mb.partition_rules],
+        "hopScore": mb.hop_score,
+        "naiveHopScore": mb.naive_hop_score,
+        "brokenLinks": [list(b) for b in mb.broken_links],
+    }
+
+
+def _meshbundle_decode(doc: Dict[str, Any]) -> MeshBundle:
+    return MeshBundle(
+        revision=int(doc.get("revision", 0)),
+        slice_topology=doc.get("sliceTopology", ""),
+        host_topology=doc.get("hostTopology", ""),
+        process_bounds=doc.get("processBounds", ""),
+        axis_names=[str(a) for a in doc.get("axisNames") or []],
+        axis_sizes=[int(s) for s in doc.get("axisSizes") or []],
+        device_order=[
+            MeshDevice(node=d.get("node", ""),
+                       worker=int(d.get("worker", 0)),
+                       chip=int(d.get("chip", 0)),
+                       coord=tuple(int(c) for c in d.get("coord") or ()))
+            for d in doc.get("deviceOrder") or []
+        ],
+        partition_rules=[list(r) for r in doc.get("partitionRules") or []],
+        hop_score=int(doc.get("hopScore", 0)),
+        naive_hop_score=int(doc.get("naiveHopScore", 0)),
+        broken_links=[list(b) for b in doc.get("brokenLinks") or []],
+    )
+
+
 def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
     spec: Dict[str, Any] = {"numNodes": cd.spec.num_nodes}
     if cd.spec.topology:
@@ -912,6 +959,8 @@ def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
             "blockShape": p.block_shape,
             "nodes": list(p.nodes),
         }
+    if cd.status.mesh_bundle is not None:
+        status["meshBundle"] = _meshbundle_encode(cd.status.mesh_bundle)
     if cd.status.conditions:
         status["conditions"] = _conditions_encode(cd.status.conditions)
     return {"spec": spec, "status": status}
@@ -950,6 +999,10 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
                     nodes=list(status["placement"].get("nodes") or []),
                 )
                 if status.get("placement") else None
+            ),
+            mesh_bundle=(
+                _meshbundle_decode(status["meshBundle"])
+                if status.get("meshBundle") else None
             ),
             conditions=_conditions_decode(status.get("conditions") or []),
         ),

@@ -56,7 +56,19 @@ def _decode_value(tp: Any, value: Any) -> Any:
     if dataclasses.is_dataclass(tp) and isinstance(value, dict):
         return _decode_dataclass(tp, value)
     if origin in (list, tuple):
-        (elem,) = typing.get_args(tp) or (Any,)
+        args = typing.get_args(tp)
+        if origin is tuple and len(args) == 2 and args[1] is Ellipsis:
+            elem = args[0]  # variadic Tuple[X, ...]
+        elif origin is tuple and len(args) > 1:
+            # Heterogeneous Tuple[X, Y, ...]: decode positionally; a wire
+            # arity mismatch is corruption, not something to truncate away.
+            if len(value) != len(args):
+                raise ValueError(
+                    f"expected {len(args)}-tuple on the wire, "
+                    f"got {len(value)} elements")
+            return tuple(_decode_value(a, v) for a, v in zip(args, value))
+        else:
+            elem = args[0] if args else Any
         seq = [_decode_value(elem, v) for v in value]
         return tuple(seq) if origin is tuple else seq
     if origin is dict:

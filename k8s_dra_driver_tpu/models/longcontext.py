@@ -22,7 +22,6 @@ from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_dra_driver_tpu.models.common import (
@@ -37,6 +36,7 @@ from k8s_dra_driver_tpu.models.flagship import (
     SliceProofConfig,
     init_params,
 )
+from k8s_dra_driver_tpu.parallel.mesh import family_mesh
 from k8s_dra_driver_tpu.parallel.ring_attention import ring_attention
 from k8s_dra_driver_tpu.parallel.ulysses import ulysses_attention
 
@@ -128,15 +128,15 @@ def make_longcontext_train_step(
                          "attention; cfg.attention must stay 'einsum' "
                          "(the default)")
     if data_parallel > 1:
-        # sp innermost: ring hops stay on neighbor ICI links; the gradient
-        # allreduce crosses the outer data axis.
-        mesh = Mesh(np.array(devices).reshape(data_parallel, ring),
-                    ("data", seq_axis))
+        # sp innermost: ring hops stay on neighbor ICI links (bundle-
+        # ordered when a mesh bundle is ambient); the gradient allreduce
+        # crosses the outer data axis.
+        mesh = family_mesh(devices, (data_parallel, ring), ("data", seq_axis))
         batch_axis = "data"
         batch_size = batch_size * data_parallel
         batch_spec = P("data", seq_axis)
     else:
-        mesh = Mesh(np.array(devices), (seq_axis,))
+        mesh = family_mesh(devices, (n,), (seq_axis,))
         batch_axis = None
         batch_spec = P(None, seq_axis)
     pspecs = jax.tree.map(lambda _: P(), init_params(cfg, seed=seed))

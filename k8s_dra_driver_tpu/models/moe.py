@@ -23,7 +23,6 @@ from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from k8s_dra_driver_tpu.models.common import (
@@ -36,6 +35,7 @@ from k8s_dra_driver_tpu.models.common import (
     rmsnorm as _rmsnorm,
 )
 from k8s_dra_driver_tpu.parallel.expert import init_moe_params, moe_ffn
+from k8s_dra_driver_tpu.parallel.mesh import family_mesh
 
 Params = Dict[str, Any]
 
@@ -176,14 +176,15 @@ def make_moe_train_step(
             f"must equal device count ({n})"
         )
     if data_parallel > 1:
-        # ep innermost: the a2a dispatch rides neighbor ICI links; the
-        # expert-grad allreduce crosses the outer data axis.
-        mesh = Mesh(np.array(devices).reshape(data_parallel, cfg.n_experts),
-                    ("data", expert_axis))
+        # ep innermost: the a2a dispatch rides neighbor ICI links (bundle-
+        # ordered when a mesh bundle is ambient); the expert-grad allreduce
+        # crosses the outer data axis.
+        mesh = family_mesh(devices, (data_parallel, cfg.n_experts),
+                           ("data", expert_axis))
         batch_axis = "data"
         batch_spec = P(("data", expert_axis), None)
     else:
-        mesh = Mesh(np.array(devices), (expert_axis,))
+        mesh = family_mesh(devices, (n,), (expert_axis,))
         batch_axis = None
         batch_spec = P(expert_axis, None)
     state = make_sharded_state(

@@ -18,7 +18,6 @@ from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from k8s_dra_driver_tpu.models.common import (
@@ -31,6 +30,7 @@ from k8s_dra_driver_tpu.models.common import (
     rmsnorm as _rmsnorm,
 )
 from k8s_dra_driver_tpu.models.flagship import SliceProofConfig, init_params
+from k8s_dra_driver_tpu.parallel.mesh import family_mesh
 from k8s_dra_driver_tpu.parallel.pipeline import pipeline_apply
 
 Params = Dict[str, Any]
@@ -111,14 +111,15 @@ def make_pipelined_train_step(
     if num_microbatches is None:
         num_microbatches = stages  # enough to keep every stage busy
     if data_parallel > 1:
-        # pp innermost: stage hops ride neighbor ICI links; the per-stage
-        # gradient allreduce over data crosses the outer axis.
-        mesh = Mesh(np.array(devices).reshape(data_parallel, stages),
-                    ("data", pipe_axis))
+        # pp innermost: stage hops ride neighbor ICI links (bundle-ordered
+        # when a mesh bundle is ambient); the per-stage gradient allreduce
+        # over data crosses the outer axis.
+        mesh = family_mesh(devices, (data_parallel, stages),
+                           ("data", pipe_axis))
         batch_axis: Optional[str] = "data"
         batch_spec = P("data")
     else:
-        mesh = Mesh(np.array(devices), (pipe_axis,))
+        mesh = family_mesh(devices, (stages,), (pipe_axis,))
         batch_axis = None
         batch_spec = P()  # batch replicated; microbatching splits it
 

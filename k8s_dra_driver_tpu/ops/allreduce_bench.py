@@ -28,15 +28,20 @@ def psum_bandwidth(
 ) -> dict:
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map, revary as _revary
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from k8s_dra_driver_tpu.parallel.mesh import (
+        family_mesh,
+        get_shard_map,
+        revary as _revary,
+    )
 
     shard_map = get_shard_map()
 
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    mesh = Mesh(np.array(devices), ("d",))
+    # Bundle-ordered when TPU_DRA_MESH_BUNDLE is ambient: the ring this
+    # bench times is exactly the chain the compiler makes ICI-adjacent.
+    mesh = family_mesh(devices, (n,), ("d",))
     per_device_elems = int(size_mib * (1 << 20) // 4)
     # Zeros: psum(0) == 0, so chained iterations inside the loop neither
     # overflow nor need a normalization op that would pollute the timing

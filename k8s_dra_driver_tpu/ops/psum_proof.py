@@ -66,12 +66,14 @@ def run_proof(timeout_s: float = 60.0) -> dict:
 
     import numpy as np
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from k8s_dra_driver_tpu.parallel.mesh import get_shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from k8s_dra_driver_tpu.parallel.mesh import family_mesh, get_shard_map
 
     shard_map = get_shard_map()
     devices = jax.devices()  # global: every process's devices
-    mesh = Mesh(np.array(devices), ("d",))
+    # Bundle-ordered when the CDI handler injected TPU_DRA_MESH_BUNDLE
+    # (psum is value-order-independent, so the proof's sum is unchanged).
+    mesh = family_mesh(devices, (len(devices),), ("d",))
     # Every local device contributes this process's (id + 1); the psum is
     # a REAL cross-process collective over the distributed runtime.
     local = jnp.full((jax.local_device_count(), 1),

@@ -1,3 +1,11 @@
 """Mesh/sharding helpers for workloads running on claimed TPU slices."""
 
-from k8s_dra_driver_tpu.parallel.mesh import build_mesh, mesh_from_topology  # noqa: F401
+from k8s_dra_driver_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    family_mesh,
+    load_bundle,
+    match_partition_rules,
+    mesh_from_bundle,
+    mesh_from_topology,
+    synthetic_bundle,
+)

@@ -6,13 +6,31 @@ via CDI-injected env: TPU_TOPOLOGY, TPU_WORKER_ID, ...) onto a
 innermost (fastest-varying) mesh axes correspond to physically adjacent
 chips, so ``psum`` over the model axis rides intra-host ICI links and the
 data axis spans hosts.
+
+Since the Placement→JAX mesh compiler (pkg/meshgen) this module is also
+the client half of the mesh-bundle contract: when the CDI handler injects
+``TPU_DRA_MESH_BUNDLE``, every mesh built here — the bundle-shaped
+``mesh_from_bundle`` and the family-shaped ``family_mesh`` the workload
+tier (models/*) uses — permutes devices into the bundle's topology-
+aligned order first, so mesh-axis neighbors are ICI ring neighbors and
+the order routes around tainted links. Without a bundle everything falls
+back to plain enumeration order, unchanged from before the compiler
+existed.
 """
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+from k8s_dra_driver_tpu.pkg.meshgen import (
+    MESH_BUNDLE_ENV,
+    MeshBundle,
+    compile_bundle,
+)
 
 
 def get_shard_map():
@@ -49,19 +67,155 @@ def revary(x, axis_name):
     return x
 
 
+# -- mesh-bundle consumption (pkg/meshgen client half) ------------------------
+
+
+def load_bundle(env: Optional[dict] = None) -> Optional[MeshBundle]:
+    """The ambient mesh bundle, if the CDI handler injected one. Malformed
+    env degrades to None (enumeration-order fallback), never an exception:
+    a stale bundle must not stop a workload from booting."""
+    raw = (env if env is not None else os.environ).get(MESH_BUNDLE_ENV, "")
+    if not raw:
+        return None
+    try:
+        return MeshBundle.from_json(raw)
+    except Exception:  # noqa: BLE001 — any malformed shape degrades
+        return None
+
+
+def synthetic_bundle(n_devices: int, host_topology: str = "2x2",
+                     broken_links=()) -> MeshBundle:
+    """A mesh bundle for tests/benches without a control plane: n_devices
+    chips as a row of ``host-<i>`` hosts of ``host_topology`` chips —
+    the same compiler (pkg/meshgen) the controller runs, so bundle-aware
+    paths exercise real generated orders."""
+    from k8s_dra_driver_tpu.tpulib.types import topology_chips
+
+    cph = topology_chips(host_topology)
+    if n_devices % cph:
+        raise ValueError(
+            f"n_devices ({n_devices}) must divide by chips/host ({cph})")
+    hosts = n_devices // cph
+    return compile_bundle(f"1x{hosts}", host_topology,
+                          [f"host-{i}" for i in range(hosts)],
+                          broken_links=broken_links)
+
+
+def bundle_device_order(devices: Sequence, bundle: Optional[MeshBundle]) -> list:
+    """Permute enumeration-ordered ``devices`` into the bundle's topology-
+    aligned flat order. A missing or size-mismatched bundle (different
+    claim shape, partial device visibility) keeps enumeration order — the
+    fallback contract."""
+    devices = list(devices)
+    if bundle is None or bundle.num_devices != len(devices):
+        return devices
+    idx = bundle.flat_indices()
+    if sorted(idx) != list(range(len(devices))):
+        return devices  # corrupt permutation: fall back, don't crash
+    return [devices[i] for i in idx]
+
+
+# Default for family_mesh's bundle param: "consult the ambient env".
+# Distinct from None, which callers pass to mean "NO bundle, enumeration
+# order" (e.g. the distrusted-bundle fallback must not reload the same
+# env bundle it just rejected).
+_AMBIENT = object()
+
+
+def family_mesh(devices: Sequence, shape: Sequence[int],
+                axis_names: Sequence[str],
+                bundle=_AMBIENT):
+    """THE mesh constructor for the workload families (flagship dp×tp,
+    long-context dp×sp, pipelined dp×pp, MoE dp×ep): bundle-ordered
+    devices reshaped to ``shape`` with ``axis_names``. Consecutive devices
+    in bundle order are ICI ring neighbors, so whatever the family names
+    its innermost axis, its collectives ride the fastest links; without a
+    bundle this is exactly the old hand-built reshape."""
+    from jax.sharding import Mesh
+
+    n = 1
+    for s in shape:
+        n *= s
+    if n != len(devices):
+        raise ValueError(f"shape {tuple(shape)} needs {n} devices, "
+                         f"have {len(devices)}")
+    ordered = bundle_device_order(
+        devices, load_bundle() if bundle is _AMBIENT else bundle)
+    arr = np.asarray(ordered, dtype=object).reshape(tuple(shape))
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def mesh_from_bundle(devices: Optional[Sequence] = None,
+                     bundle: Optional[MeshBundle] = None):
+    """Build the bundle's own Mesh: axes named and sized to the REAL slice
+    shape of the claimed block (e.g. ('data','model') 4×4 on a v5e-16
+    domain), devices in generated order. Falls back to the enumeration-
+    order dp×tp factorization when no bundle is present — a pod scheduled
+    without the compiler keeps booting."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    bundle = bundle if bundle is not None else load_bundle()
+    axis_prod = 1
+    for s in (bundle.axis_sizes if bundle is not None else ()):
+        axis_prod *= s
+    # An internally inconsistent bundle (axis-size product disagreeing
+    # with its own device order — version skew, hand edits) falls back
+    # like an absent one: the bundle must never stop a workload booting.
+    if (bundle is None or bundle.num_devices != len(devices)
+            or axis_prod != len(devices)):
+        # bundle=None, NOT ambient: the rejected bundle is still in the
+        # env, and the fallback must not apply its device order either.
+        dp, tp = choose_dp_tp(len(devices))
+        return family_mesh(devices, (dp, tp), ("data", "model"), bundle=None)
+    return family_mesh(devices, bundle.axis_sizes, bundle.axis_names,
+                       bundle=bundle)
+
+
+def match_partition_rules(rules, params):
+    """PartitionSpec pytree from (regex, spec) rules over '/'-joined
+    parameter paths — the SNIPPETS ``match_partition_rules`` idiom over
+    ``jax.tree_util`` paths. Scalars replicate; the first matching rule
+    wins; an unmatched leaf raises (bundles ship a catch-all)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def path_str(path) -> str:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    def spec_for(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = path_str(path)
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return P(*spec)
+        raise ValueError(f"partition rule not found for param {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
 def build_mesh(devices: Sequence, dp: int, tp: int, *, axis_names: Tuple[str, str] = ("data", "model")):
     """Build a dp×tp Mesh over ``devices`` (len must equal dp*tp).
 
-    ``model`` is the innermost axis: on real slices consecutive device ids
-    are ICI neighbors, so tensor-parallel collectives stay on the fastest
-    links while data-parallel gradient sync crosses hosts.
+    ``model`` is the innermost axis: in bundle order (or enumeration order
+    on real slices) consecutive devices are ICI neighbors, so tensor-
+    parallel collectives stay on the fastest links while data-parallel
+    gradient sync crosses hosts.
     """
-    from jax.sharding import Mesh
-
     if dp * tp != len(devices):
         raise ValueError(f"dp*tp={dp * tp} != len(devices)={len(devices)}")
-    arr = np.asarray(devices, dtype=object).reshape(dp, tp)
-    return Mesh(arr, axis_names=axis_names)
+    return family_mesh(devices, (dp, tp), axis_names)
 
 
 def choose_dp_tp(n_devices: int, max_tp: int = 8) -> Tuple[int, int]:
@@ -79,8 +233,6 @@ def mesh_from_topology(topology: str, devices: Optional[Sequence] = None):
     Used by workloads that want physically-faithful meshes rather than the
     logical dp×tp view.
     """
-    from jax.sharding import Mesh
-
     dims = tuple(int(d) for d in topology.lower().split("x"))
     n = int(np.prod(dims))
     if devices is None:
@@ -90,5 +242,8 @@ def mesh_from_topology(topology: str, devices: Optional[Sequence] = None):
     if len(devices) < n:
         raise ValueError(f"topology {topology} needs {n} devices, have {len(devices)}")
     names = ("x", "y", "z")[: len(dims)]
-    arr = np.asarray(devices[:n], dtype=object).reshape(dims)
-    return Mesh(arr, axis_names=names)
+    # bundle=None: this function's contract is PHYSICAL x/y/z coordinates
+    # in enumeration order; a re-routed (degraded-link) bundle order would
+    # silently unmoor mesh positions from physical coords. Bundle-aware
+    # callers want mesh_from_bundle.
+    return family_mesh(list(devices)[:n], dims, names, bundle=None)

@@ -71,6 +71,7 @@ REASON_REBALANCE_PLANNED = "RebalancePlanned"
 REASON_CLAIM_MIGRATED = "ClaimMigrated"
 REASON_MIGRATION_FAILED = "MigrationFailed"
 # ComputeDomain controller / daemon
+REASON_MESH_BUNDLE_UPDATED = "MeshBundleUpdated"
 REASON_NODE_JOINED = "NodeJoined"
 REASON_CLIQUE_ASSEMBLED = "CliqueAssembled"
 REASON_DOMAIN_READY = "DomainReady"

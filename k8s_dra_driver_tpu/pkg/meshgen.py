@@ -1,0 +1,505 @@
+"""Placement→JAX mesh compiler.
+
+The control plane computes exact ICI geometry — ``ComputeDomainStatus.
+placement`` records the host-grid block and each host contributes a known
+chip grid — yet a claiming JAX pod that calls ``jax.devices()`` and
+reshapes in enumeration order throws that topology away at the last hop:
+enumeration is host-major, so a ``(data, model)`` reshape scatters
+model-axis neighbors across host boundaries and every tensor-parallel
+collective pays cross-host hops it never needed to.
+
+This module closes the gap as a **pure compiler** (stdlib + tpulib types
+only, no k8s imports): from a domain's placement block, the member hosts'
+topology strings, and the current ICI link-health taints, it emits a
+**mesh bundle** —
+
+- a topology-aligned flat device order: mesh axes map onto the physical
+  chip grid of the block, the innermost (``model``) axis walks
+  ring-adjacent chips along the fastest physical axis and the outer
+  (``data``) axis advances host-major along the slower one, so every
+  mesh-axis neighbor pair is one ICI hop apart when the fabric is whole;
+- named ``jax.sharding.Mesh`` axes sized to the REAL slice shape of the
+  block (not a guessed power-of-two factorization);
+- regex partition rules in the ``match_partition_rules`` style (SNIPPETS
+  exemplar) covering the transformer parameter families the workload tier
+  trains;
+- a deterministic hop-count score of the generated vs naive enumeration
+  order — the quantity ``bench_meshgen`` gates on.
+
+When a ``tpu.google.com/ici-link-unhealthy`` taint lands mid-domain the
+compiler re-routes the innermost ring order around the dead link (each
+data row's collective is its own ring, so rows re-order independently)
+and the controller bumps the bundle revision.
+
+The serialized JSON travels as ``ComputeDomainStatus.meshBundle`` on the
+wire and reaches claiming containers as the ``TPU_DRA_MESH_BUNDLE`` CDI
+env; ``parallel/mesh.py::mesh_from_bundle`` is the client half.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.tpulib.profiles import host_chip_coords
+from k8s_dra_driver_tpu.tpulib.types import (
+    format_topology,
+    parse_topology,
+    topology_chips,
+)
+
+# Env key the CDI channel device injects the serialized bundle under.
+MESH_BUNDLE_ENV = "TPU_DRA_MESH_BUNDLE"
+# Host-grid bounds of the block ("x,y,z" libtpu style), injected alongside.
+PROCESS_BOUNDS_ENV = "TPU_PROCESS_BOUNDS"
+
+# Canonical mesh axis names, outermost first. 2D blocks use the pair;
+# a third effective axis (v4/v5p tori) rides an extra leading name.
+DEFAULT_AXIS_NAMES = ("data", "model")
+
+# Hop cost charged for a unit-distance pair whose direct ICI link is dead:
+# the shortest detour through a neighboring row/column of a ≥2-wide mesh
+# is 3 hops (out, across, back).
+BROKEN_LINK_DETOUR_HOPS = 3
+
+# Ring re-order search is exhaustive up to this group size (6! = 720
+# orders); longer rings fall back to a greedy nearest-neighbor walk. Only
+# groups touching a dead link pay the search at all.
+EXHAUSTIVE_RING_LIMIT = 6
+
+
+@dataclass(frozen=True)
+class MeshDevice:
+    """One chip slot in the bundle's flat device order."""
+
+    node: str                  # member host (placement.nodes entry)
+    worker: int                # index of node in the block (row-major)
+    chip: int                  # host-local chip index
+    coord: Tuple[int, ...]     # chip coordinate within the BLOCK's chip grid
+
+
+@dataclass
+class MeshBundle:
+    """The compiled mesh: everything a claiming pod needs to build a
+    topology-optimal ``jax.sharding.Mesh`` without re-deriving geometry."""
+
+    revision: int = 0
+    slice_topology: str = ""        # chip grid of the BLOCK, e.g. "4x4"
+    host_topology: str = ""         # chips per host, e.g. "2x2"
+    process_bounds: str = ""        # host grid of the block, "2,2,1"
+    axis_names: List[str] = field(default_factory=list)
+    axis_sizes: List[int] = field(default_factory=list)
+    device_order: List[MeshDevice] = field(default_factory=list)
+    # [regex, spec] pairs; spec entries are axis names or None, in the
+    # match_partition_rules convention (None = replicate that dim).
+    partition_rules: List[List[object]] = field(default_factory=list)
+    hop_score: int = 0              # generated order (lower = better)
+    naive_hop_score: int = 0        # enumeration order on the same grid
+    # Dead ICI links the order routes around: ["node", chip_a, chip_b].
+    broken_links: List[List[object]] = field(default_factory=list)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.device_order)
+
+    @property
+    def chips_per_host(self) -> int:
+        return topology_chips(self.host_topology) if self.host_topology else 0
+
+    def flat_indices(self) -> List[int]:
+        """Enumeration indices (worker*chips_per_host + chip) in bundle
+        order — the permutation ``mesh_from_bundle`` applies to the
+        host-major ``jax.devices()`` list."""
+        cph = self.chips_per_host
+        return [d.worker * cph + d.chip for d in self.device_order]
+
+    def remap_workers(self, node_to_worker: Dict[str, int]) -> "MeshBundle":
+        """A copy whose ``worker`` slots follow ``node_to_worker`` — the
+        CDI handler's injection-time rewrite from block position to the
+        clique's CAS-allocated index. ``jax.devices()`` enumerates by
+        process index (= clique index via TPU_WORKER_ID), and clique
+        indices are first-come, so block order only coincides with
+        enumeration order when daemons happened to register in block
+        order; the env copy must carry the RUNTIME indices or
+        ``flat_indices`` permutes the wrong devices. An incomplete
+        mapping or one that is not a permutation of the block's worker
+        slots returns self unchanged (fallback contract: a half-assembled
+        clique degrades to the unremapped bundle, never corrupts it)."""
+        old = {d.worker for d in self.device_order}
+        new = {node_to_worker.get(d.node, -1) for d in self.device_order}
+        if new != old:
+            return self
+        return dataclasses.replace(self, device_order=[
+            MeshDevice(node=d.node, worker=node_to_worker[d.node],
+                       chip=d.chip, coord=d.coord)
+            for d in self.device_order
+        ])
+
+    def matches_inputs(
+        self,
+        block_shape: str,
+        host_topology: str,
+        nodes: Sequence[str],
+        broken_links: Iterable[Sequence] = (),
+    ) -> bool:
+        """True when this bundle was compiled from exactly these inputs —
+        the controller's hot-path no-recompile test. compile_bundle is
+        deterministic, so matching inputs imply identical geometry; a
+        taint-storm reconcile that changes nothing skips device_layout +
+        two hop_score passes per domain. ``broken_links`` must be in the
+        compiler's normalized form (member-filtered, (node, lo, hi),
+        sorted) — what Controller._mesh_inputs produces."""
+        if self.host_topology != host_topology:
+            return False
+        if [list(b) for b in broken_links] != self.broken_links:
+            return False
+        try:
+            grid = block_chip_grid(block_shape, host_topology)
+        except (ValueError, TypeError):
+            return False
+        if format_topology(grid) != self.slice_topology:
+            return False
+        by_worker = {d.worker: d.node for d in self.device_order}
+        if len(by_worker) != len(nodes):
+            return False
+        return [by_worker.get(i) for i in range(len(nodes))] == list(nodes)
+
+    def same_geometry(self, other: "MeshBundle") -> bool:
+        """Content equality ignoring revision and scores — the
+        controller's should-I-re-emit test (a no-op reconcile must not
+        bump the revision)."""
+        return (
+            self.slice_topology == other.slice_topology
+            and self.host_topology == other.host_topology
+            and self.process_bounds == other.process_bounds
+            and self.axis_names == other.axis_names
+            and self.axis_sizes == other.axis_sizes
+            and self.device_order == other.device_order
+            and self.partition_rules == other.partition_rules
+            and self.broken_links == other.broken_links
+        )
+
+    # -- JSON (the env shape; k8swire reuses the same field names) ----------
+
+    def to_json_obj(self) -> dict:
+        return {
+            "revision": self.revision,
+            "sliceTopology": self.slice_topology,
+            "hostTopology": self.host_topology,
+            "processBounds": self.process_bounds,
+            "axisNames": list(self.axis_names),
+            "axisSizes": list(self.axis_sizes),
+            "deviceOrder": [
+                {"node": d.node, "worker": d.worker, "chip": d.chip,
+                 "coord": list(d.coord)}
+                for d in self.device_order
+            ],
+            "partitionRules": [list(r) for r in self.partition_rules],
+            "hopScore": self.hop_score,
+            "naiveHopScore": self.naive_hop_score,
+            "brokenLinks": [list(b) for b in self.broken_links],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_obj(), separators=(",", ":"),
+                          sort_keys=True)
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "MeshBundle":
+        if not isinstance(obj, dict):
+            raise ValueError(f"mesh bundle must be a JSON object, "
+                             f"got {type(obj).__name__}")
+        return cls(
+            revision=int(obj.get("revision", 0)),
+            slice_topology=obj.get("sliceTopology", ""),
+            host_topology=obj.get("hostTopology", ""),
+            process_bounds=obj.get("processBounds", ""),
+            axis_names=[str(a) for a in obj.get("axisNames") or []],
+            axis_sizes=[int(s) for s in obj.get("axisSizes") or []],
+            device_order=[
+                MeshDevice(node=d.get("node", ""),
+                           worker=int(d.get("worker", 0)),
+                           chip=int(d.get("chip", 0)),
+                           coord=tuple(int(c) for c in d.get("coord") or ()))
+                for d in obj.get("deviceOrder") or []
+            ],
+            partition_rules=[list(r) for r in obj.get("partitionRules") or []],
+            hop_score=int(obj.get("hopScore", 0)),
+            naive_hop_score=int(obj.get("naiveHopScore", 0)),
+            broken_links=[list(b) for b in obj.get("brokenLinks") or []],
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "MeshBundle":
+        return cls.from_json_obj(json.loads(text))
+
+
+# -- partition rules ----------------------------------------------------------
+
+
+def default_partition_rules(model_axis: str = "model") -> List[List[object]]:
+    """(regex, spec) pairs over '/'-joined parameter paths for the
+    transformer families the workload tier (models/*) trains: tp shards
+    heads and the FFN hidden dim over the model axis, norms/scalars
+    replicate, and the final catch-all replicates anything novel instead
+    of erroring — the bundle is advisory, so a workload with exotic
+    params still boots."""
+    return [
+        ["wqkv$", [None, None, model_axis, None]],
+        ["wo$", [model_axis, None, None]],
+        ["w1$", [None, model_axis]],
+        ["w2$", [model_axis, None]],
+        ["(embed|unembed)$", [None, None]],
+        ["(ln1|ln2|scale|bias)$", []],
+        [".*", []],
+    ]
+
+
+# -- geometry -----------------------------------------------------------------
+
+
+def block_chip_grid(block_shape: str, host_topology: str) -> Tuple[int, ...]:
+    """Chip-grid dims of a host block: block shape (host units) times the
+    per-host chip shape, both padded with 1s to the larger rank."""
+    b = parse_topology(block_shape)
+    h = parse_topology(host_topology)
+    rank = max(len(b), len(h))
+    b = b + (1,) * (rank - len(b))
+    h = h + (1,) * (rank - len(h))
+    return tuple(bd * hd for bd, hd in zip(b, h))
+
+
+def device_layout(
+    block_shape: str,
+    host_topology: str,
+    nodes: Sequence[str],
+) -> Dict[Tuple[int, ...], MeshDevice]:
+    """Block-grid chip coordinate -> MeshDevice for every chip the block's
+    hosts contribute. ``nodes`` is placement.nodes — row-major over the
+    block, the same order ``iter_host_blocks`` yields, so worker slot i is
+    the i-th block cell."""
+    host_dims = parse_topology(host_topology)
+    block_dims = parse_topology(block_shape)
+    rank = max(len(host_dims), len(block_dims))
+    hd = host_dims + (1,) * (rank - len(host_dims))
+    bd = block_dims + (1,) * (rank - len(block_dims))
+    hosts = list(itertools.product(*(range(d) for d in bd)))
+    if len(nodes) != len(hosts):
+        raise ValueError(
+            f"placement lists {len(nodes)} nodes but block {block_shape} "
+            f"holds {len(hosts)} hosts")
+    out: Dict[Tuple[int, ...], MeshDevice] = {}
+    for worker, hcoord in enumerate(hosts):
+        for chip, ccoord in enumerate(host_chip_coords(host_dims)):
+            cc = tuple(ccoord) + (0,) * (rank - len(ccoord))
+            coord = tuple(h * d + c for h, d, c in zip(hcoord, hd, cc))
+            out[coord] = MeshDevice(node=nodes[worker], worker=worker,
+                                    chip=chip, coord=coord)
+    return out
+
+
+def _manhattan(a: Tuple[int, ...], b: Tuple[int, ...]) -> int:
+    return sum(abs(x - y) for x, y in zip(a, b))
+
+
+def _hop(a: MeshDevice, b: MeshDevice, broken: frozenset) -> int:
+    """ICI hops between two chips of the block, charging the detour cost
+    when the pair's direct link is dead. ``broken`` holds coordinate
+    pairs (frozenset of the two endpoint coords)."""
+    d = _manhattan(a.coord, b.coord)
+    if d == 1 and frozenset((a.coord, b.coord)) in broken:
+        return BROKEN_LINK_DETOUR_HOPS
+    return d
+
+
+def hop_score(order: Sequence[MeshDevice], axis_sizes: Sequence[int],
+              broken: Iterable[frozenset] = ()) -> int:
+    """Deterministic adjacency score of a flat device order laid out as a
+    mesh of ``axis_sizes``: the sum of ICI hops over every pair of
+    mesh-axis neighbors (each undirected edge once). This is the
+    collective cost model at this layer — a psum over one axis chains
+    exactly these neighbor links — and the quantity the bench gate
+    compares."""
+    broken_set = frozenset(broken)
+    sizes = tuple(axis_sizes)
+    n = 1
+    for s in sizes:
+        n *= s
+    if n != len(order):
+        raise ValueError(f"axis sizes {sizes} need {n} devices, "
+                         f"got {len(order)}")
+    strides = []
+    acc = 1
+    for s in reversed(sizes):
+        strides.append(acc)
+        acc *= s
+    strides.reverse()
+
+    def at(idx: Tuple[int, ...]) -> MeshDevice:
+        return order[sum(i * st for i, st in zip(idx, strides))]
+
+    total = 0
+    for idx in itertools.product(*(range(s) for s in sizes)):
+        for ax in range(len(sizes)):
+            if idx[ax] + 1 < sizes[ax]:
+                nxt = list(idx)
+                nxt[ax] += 1
+                total += _hop(at(idx), at(tuple(nxt)), broken_set)
+    return total
+
+
+def naive_order(layout: Dict[Tuple[int, ...], MeshDevice]) -> List[MeshDevice]:
+    """The enumeration order a bundle-less pod gets from jax.devices():
+    host-major (process index), then host-local chip index."""
+    return sorted(layout.values(), key=lambda d: (d.worker, d.chip))
+
+
+def _ring_path(devs: List[MeshDevice], broken: frozenset) -> List[MeshDevice]:
+    """Order one innermost-axis group as the cheapest chain of its chips.
+
+    The group's physical coords are collinear along the fastest axis, so
+    the identity order is an optimal unit-hop chain on a healthy fabric;
+    with a dead link inside the group the row re-orders independently
+    (each data row's collective is its own ring). Cost is (dead links
+    traversed, total hops): a chain that routes AROUND the dead link
+    always beats one that limps across it, even at equal hop count.
+    Exhaustive for small groups, greedy nearest-neighbor beyond
+    EXHAUSTIVE_RING_LIMIT."""
+    def chain_cost(path: Sequence[MeshDevice]) -> Tuple[int, int]:
+        dead = hops = 0
+        for i in range(len(path) - 1):
+            h = _hop(path[i], path[i + 1], broken)
+            hops += h
+            if h == BROKEN_LINK_DETOUR_HOPS and _manhattan(
+                    path[i].coord, path[i + 1].coord) == 1:
+                dead += 1
+        return (dead, hops)
+
+    if chain_cost(devs) == (0, len(devs) - 1):
+        return devs  # already a clean unit-hop chain
+    if len(devs) <= EXHAUSTIVE_RING_LIMIT:
+        return list(min(itertools.permutations(devs), key=chain_cost))
+    remaining = list(devs)
+    path = [remaining.pop(0)]
+    while remaining:
+        nxt = min(remaining,
+                  key=lambda d: chain_cost((path[-1], d)))
+        remaining.remove(nxt)
+        path.append(nxt)
+    return path
+
+
+def generated_order(
+    layout: Dict[Tuple[int, ...], MeshDevice],
+    grid: Tuple[int, ...],
+    inner_axis: int,
+    broken: Iterable[frozenset] = (),
+) -> List[MeshDevice]:
+    """Topology-aligned flat order: outer axes walk the slower physical
+    dims in ascending row-major order, the innermost axis chains
+    ring-adjacent chips along the fastest dim — re-routed per group
+    around dead links."""
+    broken_set = frozenset(broken)
+    outer_axes = [i for i in range(len(grid)) if i != inner_axis]
+    out: List[MeshDevice] = []
+    for outer in itertools.product(*(range(grid[i]) for i in outer_axes)):
+        group = []
+        for j in range(grid[inner_axis]):
+            coord = [0] * len(grid)
+            for ax, v in zip(outer_axes, outer):
+                coord[ax] = v
+            coord[inner_axis] = j
+            group.append(layout[tuple(coord)])
+        out.extend(_ring_path(group, broken_set))
+    return out
+
+
+def _axis_names_for(n_axes: int) -> List[str]:
+    """('data','model') for 2 effective axes, ('model',) for 1; extra
+    leading axes (3D tori) are named replica/replicaN so the trailing
+    pair stays the familiar one."""
+    if n_axes <= len(DEFAULT_AXIS_NAMES):
+        return list(DEFAULT_AXIS_NAMES[-n_axes:])
+    extra = n_axes - len(DEFAULT_AXIS_NAMES)
+    return [("replica" if extra == 1 else f"replica{i}")
+            for i in range(extra)] + list(DEFAULT_AXIS_NAMES)
+
+
+def broken_links_to_coords(
+    layout: Dict[Tuple[int, ...], MeshDevice],
+    broken_links: Iterable[Tuple[str, int, int]],
+) -> List[frozenset]:
+    """Translate (node, chip_a, chip_b) host-local dead links into block
+    chip-coordinate pairs. Links on nodes outside the block are ignored."""
+    by_node_chip = {(d.node, d.chip): d.coord for d in layout.values()}
+    out: List[frozenset] = []
+    for node, a, b in broken_links:
+        ca = by_node_chip.get((node, int(a)))
+        cb = by_node_chip.get((node, int(b)))
+        if ca is not None and cb is not None:
+            out.append(frozenset((ca, cb)))
+    return out
+
+
+def compile_bundle(
+    block_shape: str,
+    host_topology: str,
+    nodes: Sequence[str],
+    broken_links: Iterable[Tuple[str, int, int]] = (),
+    revision: int = 1,
+) -> MeshBundle:
+    """The compiler entry point: placement block + member nodes +
+    link-health taints -> a MeshBundle. Deterministic for identical
+    inputs (the controller's same_geometry dedup depends on it)."""
+    grid = block_chip_grid(block_shape, host_topology)
+    layout = device_layout(block_shape, host_topology, nodes)
+    # Effective mesh axes: unit dims carry no devices and no adjacency, so
+    # they collapse out of the axis list (a 2x2x1-host v4 block is a 2D
+    # mesh); the innermost effective axis is the ring axis.
+    nonunit = [i for i, d in enumerate(grid) if d > 1] or [len(grid) - 1]
+    inner_axis = nonunit[-1]
+    eff_sizes = [grid[i] for i in nonunit]
+    node_set = set(nodes)
+    broken_list = sorted(
+        (str(n), min(int(a), int(b)), max(int(a), int(b)))
+        for n, a, b in broken_links
+        if n in node_set
+    )
+    broken_coords = broken_links_to_coords(layout, broken_list)
+    order = generated_order(layout, grid, inner_axis, broken_coords)
+    naive = naive_order(layout)
+    axis_names = _axis_names_for(len(eff_sizes))
+    bounds = list(parse_topology(block_shape))
+    bounds += [1] * (3 - len(bounds))
+    return MeshBundle(
+        revision=revision,
+        slice_topology=format_topology(grid),
+        host_topology=host_topology,
+        process_bounds=",".join(str(b) for b in bounds),
+        axis_names=axis_names,
+        axis_sizes=eff_sizes,
+        device_order=order,
+        partition_rules=default_partition_rules(axis_names[-1]),
+        hop_score=hop_score(order, eff_sizes, broken_coords),
+        naive_hop_score=hop_score(naive, eff_sizes, broken_coords),
+        broken_links=[list(b) for b in broken_list],
+    )
+
+
+def compile_for_placement(placement, host_topology: str,
+                          broken_links: Iterable[Tuple[str, int, int]] = (),
+                          revision: int = 1) -> Optional[MeshBundle]:
+    """``compile_bundle`` over a ComputeDomainPlacement-shaped object (any
+    object with block_shape/nodes attributes — keeps this module free of
+    api imports). Returns None when the placement is not compilable
+    (malformed shape, node-count mismatch): the caller degrades to no
+    bundle rather than failing its reconcile."""
+    try:
+        return compile_bundle(
+            placement.block_shape, host_topology, list(placement.nodes),
+            broken_links=broken_links, revision=revision)
+    except (ValueError, KeyError, TypeError):
+        return None

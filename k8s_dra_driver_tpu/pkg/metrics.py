@@ -349,6 +349,54 @@ class ComputeDomainStatusMetric:
         self.gauge.forget_matching(namespace=namespace, name=name)
 
 
+# Why the mesh compiler (re-)ran — a closed vocabulary for the counter
+# label: the first compile for a placement vs a link-health transition
+# forcing a re-route.
+MESHGEN_TRIGGERS = ("placement", "link-health")
+
+
+class MeshgenMetrics:
+    """Per-domain Placement→JAX mesh compiler telemetry: how often bundles
+    (re)compile and the hop-count quality of the emitted device order vs
+    the naive enumeration baseline — the same two numbers ``bench_meshgen``
+    gates on, live per domain."""
+
+    def __init__(self, registry: Registry):
+        self.builds_total = registry.register(Counter(
+            "tpu_dra_meshgen_builds_total",
+            "Mesh bundles compiled, by trigger (placement/link-health).",
+            ("trigger",),
+        ))
+        self.revision = registry.register(Gauge(
+            "tpu_dra_meshgen_revision",
+            "Current mesh-bundle revision of a ComputeDomain.",
+            ("namespace", "name"),
+        ))
+        self.hop_score = registry.register(Gauge(
+            "tpu_dra_meshgen_hop_score",
+            "Mesh-axis-neighbor ICI hop count of the domain's device "
+            "order (order=generated|naive).",
+            ("namespace", "name", "order"),
+        ))
+
+    def built(self, namespace: str, name: str, bundle, trigger: str) -> None:
+        if trigger not in MESHGEN_TRIGGERS:
+            raise ValueError(f"unknown meshgen trigger {trigger!r}")
+        self.builds_total.inc(trigger)
+        self.record(namespace, name, bundle)
+
+    def record(self, namespace: str, name: str, bundle) -> None:
+        self.revision.set(namespace, name, value=float(bundle.revision))
+        self.hop_score.set(namespace, name, "generated",
+                           value=float(bundle.hop_score))
+        self.hop_score.set(namespace, name, "naive",
+                           value=float(bundle.naive_hop_score))
+
+    def forget(self, namespace: str, name: str) -> None:
+        self.revision.forget_matching(namespace=namespace, name=name)
+        self.hop_score.forget_matching(namespace=namespace, name=name)
+
+
 def _debug_stacks_text() -> bytes:
     """All live thread stacks, the goroutine-dump half of net/http/pprof."""
     from k8s_dra_driver_tpu.utils.debug import format_stacks

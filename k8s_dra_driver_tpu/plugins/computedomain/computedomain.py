@@ -18,6 +18,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
 from k8s_dra_driver_tpu.daemon.cliquemanager import clique_name
 from k8s_dra_driver_tpu.k8s import APIServer
 from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE, NODE
+from k8s_dra_driver_tpu.pkg.meshgen import MESH_BUNDLE_ENV, PROCESS_BOUNDS_ENV
 from k8s_dra_driver_tpu.tpulib.types import HostInventory
 
 log = logging.getLogger(__name__)
@@ -144,7 +145,7 @@ class ComputeDomainManager:
         hostnames = [m.dns_name or m.ip_address for m in members]
         coordinator = hostnames[0] if hostnames else ""
         port = coordinator_port(cd)
-        return {
+        env = {
             "TPU_WORKER_ID": str(self_info.index),
             "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
             "TPU_TOPOLOGY": self.inventory.slice_topology,
@@ -157,3 +158,19 @@ class ComputeDomainManager:
             "MEGASCALE_SLICE_ID": "0",
             "COMPUTE_DOMAIN_UUID": cd.uid,
         }
+        # The Placement→JAX mesh compiler output, when the controller has
+        # emitted one: the claiming pod boots straight into a topology-
+        # aligned Mesh (parallel/mesh.py::mesh_from_bundle) instead of
+        # reshaping jax.devices() enumeration order. The status bundle's
+        # worker slots are BLOCK positions; the env copy remaps them to
+        # this clique's CAS indices — the order jax.devices() actually
+        # enumerates (process index = TPU_WORKER_ID). Absent bundle =
+        # absent env: the client falls back to enumeration order, so a
+        # cluster without topology attributes keeps working unchanged.
+        bundle = cd.status.mesh_bundle
+        if bundle is not None:
+            bundle = bundle.remap_workers(
+                {m.node_name: m.index for m in members})
+            env[MESH_BUNDLE_ENV] = bundle.to_json()
+            env[PROCESS_BOUNDS_ENV] = bundle.process_bounds
+        return env

@@ -18,7 +18,11 @@ from typing import Dict, List, Optional
 from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME
 from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
 from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM, ResourceClaim
-from k8s_dra_driver_tpu.k8s.core import DeviceTaint
+from k8s_dra_driver_tpu.k8s.core import (
+    DeviceTaint,
+    ICI_LINK_TAINT_KEY,
+    UNHEALTHY_TAINT_KEY,
+)
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.events import (
@@ -49,10 +53,9 @@ log = logging.getLogger(__name__)
 PU_LOCK_TIMEOUT_S = 10.0  # reference budget (driver.go:388,430)
 CLEANUP_INTERVAL_S = 600.0  # reference 10 min (cleanup.go:34-36)
 
-UNHEALTHY_TAINT_KEY = "tpu.google.com/unhealthy"
-# Device is healthy but spans an ICI link that is not: distinct key so an
-# operator can tell silicon faults from fabric faults at a glance.
-ICI_LINK_TAINT_KEY = "tpu.google.com/ici-link-unhealthy"
+# UNHEALTHY_TAINT_KEY / ICI_LINK_TAINT_KEY moved to k8s.core (canonical
+# home next to DeviceTaint, shared with the controller's mesh compiler);
+# re-imported above so existing plugin-side call sites keep working.
 
 
 class TpuDriver:

@@ -330,6 +330,23 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
                 f"Placement: block {p.block_shape}@{p.block_origin} of "
                 f"{p.ici_domain or '<default>'} "
                 f"({','.join(p.nodes)})")
+        if obj.status.mesh_bundle is not None:
+            mb = obj.status.mesh_bundle
+            axes = ",".join(f"{n}={s}" for n, s in
+                            zip(mb.axis_names, mb.axis_sizes))
+            lines.append(
+                f"MeshBundle: rev {mb.revision} axes ({axes}) "
+                f"grid {mb.slice_topology} hops {mb.hop_score} "
+                f"(naive {mb.naive_hop_score})"
+                + (f" routed around {len(mb.broken_links)} dead link(s)"
+                   if mb.broken_links else ""))
+            # Flat device order as worker:chip tokens — the permutation a
+            # claiming pod applies to jax.devices(); truncated so a big
+            # slice doesn't flood the terminal.
+            toks = [f"{d.worker}:{d.chip}" for d in mb.device_order]
+            shown, extra = toks[:32], len(toks) - 32
+            lines.append("  Order: " + " ".join(shown)
+                         + (f" ...(+{extra})" if extra > 0 else ""))
         if obj.status.nodes:
             rows = [["Node", "IciDomain", "Worker", "Status"]]
             for n in obj.status.nodes:

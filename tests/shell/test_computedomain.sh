@@ -12,10 +12,12 @@ for i in 0 1 2 3; do
   kubectl wait pod "worker-$i" -n cd-multi --for=Running --timeout=60
 done
 
-pods_json="$(kubectl get pods -n cd-multi -o json)"
-$PY - <<PYEOF
-import json
-pods = [p for p in json.loads('''$pods_json''') if p["meta"]["name"].startswith("worker-")]
+# Passed via the environment, not interpolated into the Python source:
+# injected_env now carries TPU_DRA_MESH_BUNDLE (JSON-in-JSON), whose \"
+# escapes a string literal would eat.
+PODS_JSON="$(kubectl get pods -n cd-multi -o json)" $PY - <<'PYEOF'
+import json, os
+pods = [p for p in json.loads(os.environ["PODS_JSON"]) if p["meta"]["name"].startswith("worker-")]
 assert len(pods) == 4, [p["meta"]["name"] for p in pods]
 ids = sorted(int(p["injected_env"]["TPU_WORKER_ID"]) for p in pods)
 assert ids == [0, 1, 2, 3], ids
@@ -23,7 +25,16 @@ coords = {p["injected_env"]["MEGASCALE_COORDINATOR_ADDRESS"] for p in pods}
 assert len(coords) == 1, coords
 chans = [d for d in pods[0]["injected_devices"] if d.startswith("/dev/tpu-slice-channels/")]
 assert chans, "no channel devices injected"
-print("workers OK:", ids, "coordinator:", coords.pop())
+# The Placement->JAX mesh bundle rides the same env channel: every worker
+# got the SAME bundle, parseable, sized to the whole 4x4 block.
+bundles = {p["injected_env"]["TPU_DRA_MESH_BUNDLE"] for p in pods}
+assert len(bundles) == 1, "workers disagree on the mesh bundle"
+mb = json.loads(bundles.pop())
+assert len(mb["deviceOrder"]) == 16, mb["axisSizes"]
+assert mb["hopScore"] <= mb["naiveHopScore"], mb
+assert {p["injected_env"]["TPU_PROCESS_BOUNDS"] for p in pods} == {"2,2,1"}
+print("workers OK:", ids, "coordinator:", coords.pop(),
+      "mesh axes:", mb["axisNames"], mb["axisSizes"])
 PYEOF
 
 # Teardown: deleting the CD removes cliques and daemon pods.

@@ -13,10 +13,12 @@ for p in pod0 pod1; do
   kubectl wait pod "$p" -n tpu-test4 --for=Running --timeout=30
 done
 
-pods_json="$(kubectl get pods -n tpu-test4 -o json)"
-$PY - <<PYEOF
-import json
-pods = json.loads('''$pods_json''')
+# Via the environment, not interpolated into the Python source: injected
+# env values can be JSON-in-JSON (mesh bundles), whose \" escapes a
+# string literal would eat.
+PODS_JSON="$(kubectl get pods -n tpu-test4 -o json)" $PY - <<'PYEOF'
+import json, os
+pods = json.loads(os.environ["PODS_JSON"])
 assert len(pods) == 2, [p["meta"]["name"] for p in pods]
 for p in pods:
     ts = p["injected_env"].get("TPU_TIMESLICE_US")
@@ -33,10 +35,9 @@ for p in pod0 pod1; do
 done
 kubectl wait pod hog -n tpu-test7 --for=Failed --timeout=30
 
-premap_json="$(kubectl get pods -n tpu-test7 -o json)"
-$PY - <<PYEOF
-import json
-pods = {p["meta"]["name"]: p for p in json.loads('''$premap_json''')}
+PODS_JSON="$(kubectl get pods -n tpu-test7 -o json)" $PY - <<'PYEOF'
+import json, os
+pods = {p["meta"]["name"]: p for p in json.loads(os.environ["PODS_JSON"])}
 for name in ("pod0", "pod1"):
     env = pods[name]["injected_env"]
     assert env.get("TPU_PREMAPPED_BUFFER_BYTES") == "4294967296", (name, env)

@@ -10,10 +10,12 @@ start_cluster v5e-4 --gates PassthroughSupport=true
 kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test-vfio.yaml"
 kubectl wait pod vm0 -n tpu-test-vfio --for=Running --timeout=30
 
-pod_json="$(kubectl get pods -n tpu-test-vfio -o json)"
-$PY - <<PYEOF
-import json
-pods = json.loads('''$pod_json''')
+# Via the environment, not interpolated into the Python source: injected
+# env values can be JSON-in-JSON (mesh bundles), whose \" escapes a
+# string literal would eat.
+POD_JSON="$(kubectl get pods -n tpu-test-vfio -o json)" $PY - <<'PYEOF'
+import json, os
+pods = json.loads(os.environ["POD_JSON"])
 assert len(pods) == 1, [p["meta"]["name"] for p in pods]
 p = pods[0]
 addr = p["injected_env"].get("TPU_VFIO_PCI_ADDRESS", "")
@@ -63,10 +65,9 @@ start_cluster v5e-4 --gates PassthroughSupport=true,ICIPartitioning=true,Dynamic
 kubectl apply -f "$REPO/demo/specs/quickstart/tpu-test-vfio-part.yaml"
 kubectl wait pod vm-pair -n tpu-test-vfio-part --for=Running --timeout=30
 
-pod_json="$(kubectl get pods -n tpu-test-vfio-part -o json)"
-$PY - <<PYEOF
-import json
-pods = json.loads('''$pod_json''')
+POD_JSON="$(kubectl get pods -n tpu-test-vfio-part -o json)" $PY - <<'PYEOF'
+import json, os
+pods = json.loads(os.environ["POD_JSON"])
 p = pods[0]
 devs = p["injected_devices"]
 groups = [d for d in devs if "/vfio/" in d and "/devices/" not in d

@@ -153,3 +153,71 @@ def test_sim_main_dispatches_describe(srv, capsys, monkeypatch):
     rc = sim_main(["describe", "pod", "web"])
     assert rc == 0
     assert "Phase:  Running (ready)" in capsys.readouterr().out
+
+
+# -- mesh bundle rendering (Placement→JAX mesh compiler) ---------------------
+
+
+def _seed_meshed_cd(api):
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainPlacement,
+        ComputeDomainSpec,
+    )
+    from k8s_dra_driver_tpu.pkg.meshgen import compile_bundle
+
+    nodes = [f"tpu-node-{i}" for i in range(4)]
+    cd = ComputeDomain(meta=new_meta("jax-domain", "grid"),
+                       spec=ComputeDomainSpec(num_nodes=4))
+    cd.status.placement = ComputeDomainPlacement(
+        ici_domain="slice-0", block_origin="0x0", block_shape="2x2",
+        nodes=nodes)
+    cd.status.mesh_bundle = compile_bundle(
+        "2x2", "2x2", nodes, broken_links=[("tpu-node-0", 0, 1)], revision=2)
+    return api.create(cd)
+
+
+def test_describe_computedomain_renders_mesh_bundle():
+    """The generated mesh axes + device order render alongside the
+    existing Placement block (ISSUE satellite)."""
+    api = APIServer()
+    _seed_meshed_cd(api)
+    out = describe_object(api, "ComputeDomain", "jax-domain", "grid")
+    assert "Placement: block 2x2@0x0" in out
+    assert "MeshBundle: rev 2 axes (data=4,model=4) grid 4x4" in out
+    assert "routed around 1 dead link(s)" in out
+    order_lines = [l for l in out.splitlines() if l.startswith("  Order:")]
+    assert len(order_lines) == 1
+    # 16 worker:chip tokens, no truncation marker at this size.
+    assert len(order_lines[0].split()[1:]) == 16
+    assert "...(+" not in order_lines[0]
+
+
+def test_describe_mesh_bundle_order_truncates():
+    api = APIServer()
+    cd = _seed_meshed_cd(api)
+
+    def widen(obj):
+        obj.status.mesh_bundle.device_order = (
+            obj.status.mesh_bundle.device_order * 4)  # 64 tokens
+    api.update_with_retry("ComputeDomain", "jax-domain", "grid", widen)
+    out = describe_object(api, "ComputeDomain", "jax-domain", "grid")
+    line = next(l for l in out.splitlines() if l.startswith("  Order:"))
+    assert "...(+32)" in line
+
+
+def test_cli_get_computedomain_yaml_carries_mesh_bundle(srv, capsys):
+    """`get -o yaml` carries the compiled bundle verbatim — every field,
+    scriptable from the shell tier."""
+    _seed_meshed_cd(srv.api)
+    rc = kubectl_main(["--server", srv.url, "get", "computedomain",
+                       "jax-domain", "-n", "grid", "-o", "yaml"])
+    assert rc == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    mb = doc["status"]["mesh_bundle"]
+    assert mb["revision"] == 2
+    assert mb["axis_names"] == ["data", "model"]
+    assert mb["axis_sizes"] == [4, 4]
+    assert len(mb["device_order"]) == 16
+    assert mb["broken_links"] == [["tpu-node-0", 0, 1]]
+    assert mb["hop_score"] <= mb["naive_hop_score"]

@@ -1,0 +1,808 @@
+"""Placement→JAX mesh compiler (pkg/meshgen) — compiler invariants, the
+wire/env round-trip, the client half (parallel/mesh.py), and the
+controller's emit/re-emit semantics.
+
+The compiler is pure, so most pins are exact: the generated order must be
+a permutation of the enumeration order whose mesh-axis neighbors are ICI
+ring neighbors (hop-count-verified), identical inputs must compile
+identical bundles (the controller's no-op-reconcile dedup depends on it),
+and a dead ICI link must re-route the affected ring group without
+touching the rest of the order.
+"""
+
+import itertools
+import json
+import threading
+
+import pytest
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomain,
+    ComputeDomainPlacement,
+    ComputeDomainSpec,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    Device,
+    DeviceCounterConsumption,
+    DeviceTaint,
+    ICI_LINK_TAINT_KEY,
+    ResourceSlice,
+)
+from k8s_dra_driver_tpu.k8s.k8swire import from_k8s_wire, to_k8s_wire
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.k8s.serialize import from_wire, to_wire
+from k8s_dra_driver_tpu.pkg import meshgen
+from k8s_dra_driver_tpu.pkg.meshgen import (
+    MESH_BUNDLE_ENV,
+    MeshBundle,
+    MeshDevice,
+    compile_bundle,
+    compile_for_placement,
+    default_partition_rules,
+    device_layout,
+    hop_score,
+    naive_order,
+)
+
+V5E16_NODES = ["tpu-node-0", "tpu-node-1", "tpu-node-2", "tpu-node-3"]
+
+
+def v5e16_bundle(broken_links=(), revision=1):
+    """4-host v5e-16: a 2x2 host block of 2x2-chip hosts (4x4 chip grid)."""
+    return compile_bundle("2x2", "2x2", V5E16_NODES,
+                          broken_links=broken_links, revision=revision)
+
+
+# -- geometry / hop-count invariants ------------------------------------------
+
+
+def test_device_layout_tiles_block_grid():
+    layout = device_layout("2x2", "2x2", V5E16_NODES)
+    assert len(layout) == 16
+    assert set(layout) == set(itertools.product(range(4), range(4)))
+    # Worker i is block cell i row-major; each contributes its whole host.
+    by_worker = {}
+    for d in layout.values():
+        by_worker.setdefault(d.worker, set()).add(d.chip)
+    assert by_worker == {i: {0, 1, 2, 3} for i in range(4)}
+    assert layout[(0, 0)].node == "tpu-node-0"
+    assert layout[(3, 3)].node == "tpu-node-3"
+
+
+def test_device_layout_rejects_node_count_mismatch():
+    with pytest.raises(ValueError, match="holds 4 hosts"):
+        device_layout("2x2", "2x2", V5E16_NODES[:3])
+
+
+def test_generated_order_strictly_beats_naive_on_v5e16():
+    """The tentpole quantity: enumeration order pays cross-host hops on
+    every model-axis row boundary; the generated order is ring-adjacent
+    along the fastest axis, host-major across the slower one."""
+    b = v5e16_bundle()
+    assert b.axis_names == ["data", "model"]
+    assert b.axis_sizes == [4, 4]
+    assert b.hop_score < b.naive_hop_score
+    # Every model-axis (innermost) neighbor pair is exactly ONE ICI hop.
+    order = b.device_order
+    for row in range(4):
+        for col in range(3):
+            a = order[row * 4 + col].coord
+            c = order[row * 4 + col + 1].coord
+            assert sum(abs(x - y) for x, y in zip(a, c)) == 1, (row, col)
+
+
+def test_generated_order_is_permutation_and_deterministic():
+    b1, b2 = v5e16_bundle(), v5e16_bundle()
+    assert b1 == b2  # identical inputs -> identical bundle, bit for bit
+    idx = b1.flat_indices()
+    assert sorted(idx) == list(range(16))
+    assert idx != list(range(16))  # genuinely reordered vs enumeration
+
+
+def test_hop_scores_match_recomputation():
+    """The scores stored on the bundle are the bench-gated quantities —
+    they must equal an independent recomputation over the stored order."""
+    b = v5e16_bundle()
+    layout = device_layout("2x2", "2x2", V5E16_NODES)
+    assert b.hop_score == hop_score(b.device_order, b.axis_sizes)
+    assert b.naive_hop_score == hop_score(naive_order(layout), b.axis_sizes)
+
+
+def test_v5e8_generated_no_worse_than_naive():
+    b = compile_bundle("1x2", "2x2", ["n0", "n1"])
+    assert b.axis_sizes == [2, 4]
+    assert b.hop_score <= b.naive_hop_score
+
+
+def test_single_host_block_collapses_unit_dims():
+    b = compile_bundle("1x1", "2x2", ["n0"])
+    assert b.axis_sizes == [2, 2]
+    assert b.axis_names == ["data", "model"]
+    assert b.process_bounds == "1,1,1"
+    assert b.num_devices == 4
+
+
+def test_three_axis_block_gains_replica_axis():
+    b = compile_bundle("2x2x2", "2x2", ["n%d" % i for i in range(8)])
+    assert b.axis_names == ["replica", "data", "model"]
+    assert b.axis_sizes == [4, 4, 2]
+    assert b.num_devices == 32
+
+
+def test_hop_score_rejects_size_mismatch():
+    layout = device_layout("2x2", "2x2", V5E16_NODES)
+    with pytest.raises(ValueError, match="need 8 devices"):
+        hop_score(naive_order(layout), (2, 4))
+
+
+# -- degraded-link re-routing -------------------------------------------------
+
+
+def test_broken_link_rerouted_out_of_ring_order():
+    """A dead intra-host link between ring neighbors re-orders THAT ring
+    group so no mesh-axis-neighbor step crosses the dead link; rows not
+    touching the link keep the clean unit-hop chain."""
+    healthy = v5e16_bundle()
+    # tpu-node-0 chips 0-1 are ring neighbors in row 0 of the block grid.
+    b = v5e16_bundle(broken_links=[("tpu-node-0", 0, 1)])
+    assert b.broken_links == [["tpu-node-0", 0, 1]]
+    assert b.hop_score > healthy.hop_score  # the detour has a real cost
+    assert b.hop_score < b.naive_hop_score  # still beats enumeration
+    dead = {
+        healthy.device_order[0].coord,  # (0,0) / (0,1) in block coords
+    }
+    layout = device_layout("2x2", "2x2", V5E16_NODES)
+    coords = {(d.node, d.chip): d.coord for d in layout.values()}
+    dead = frozenset((coords[("tpu-node-0", 0)], coords[("tpu-node-0", 1)]))
+    # No innermost-axis neighbor step traverses the dead link.
+    for row in range(4):
+        for col in range(3):
+            a = b.device_order[row * 4 + col].coord
+            c = b.device_order[row * 4 + col + 1].coord
+            assert frozenset((a, c)) != dead, (row, col)
+    # Geometry changed vs healthy -> the controller must re-emit.
+    assert not healthy.same_geometry(b)
+
+
+def test_broken_link_on_foreign_node_ignored():
+    b = v5e16_bundle(broken_links=[("not-a-member", 0, 1)])
+    assert b.broken_links == []
+    assert b.same_geometry(v5e16_bundle())
+
+
+def test_matches_inputs_hot_path_dedup():
+    """The controller's no-recompile test: True exactly when every compile
+    input (block shape, host topology, member order, normalized dead-link
+    set) is what the bundle already records."""
+    b = v5e16_bundle()
+    assert b.matches_inputs("2x2", "2x2", V5E16_NODES)
+    assert not b.matches_inputs("1x4", "2x2", V5E16_NODES)
+    assert not b.matches_inputs("2x2", "1x4", V5E16_NODES)
+    assert not b.matches_inputs("2x2", "2x2", list(reversed(V5E16_NODES)))
+    assert not b.matches_inputs("2x2", "2x2", V5E16_NODES[:3])
+    assert not b.matches_inputs("2x2", "2x2", V5E16_NODES,
+                                [("tpu-node-0", 0, 1)])
+    assert not b.matches_inputs("bogus", "2x2", V5E16_NODES)
+    broken = v5e16_bundle(broken_links=[("tpu-node-0", 0, 1)])
+    assert broken.matches_inputs("2x2", "2x2", V5E16_NODES,
+                                 [("tpu-node-0", 0, 1)])
+    assert not broken.matches_inputs("2x2", "2x2", V5E16_NODES)
+
+
+def test_same_geometry_ignores_revision_and_scores():
+    a, b = v5e16_bundle(revision=1), v5e16_bundle(revision=7)
+    assert a.same_geometry(b) and b.same_geometry(a)
+
+
+def test_compile_for_placement_degrades_to_none():
+    p = ComputeDomainPlacement(block_shape="2x2", nodes=["n0"])  # mismatch
+    assert compile_for_placement(p, "2x2") is None
+    p = ComputeDomainPlacement(block_shape="bogus", nodes=V5E16_NODES)
+    assert compile_for_placement(p, "2x2") is None
+
+
+def test_remap_workers_to_clique_indices():
+    """The injection-time rewrite: the status bundle's worker slots are
+    block positions, but jax.devices() enumerates by CLIQUE index (first-
+    come CAS via TPU_WORKER_ID), so the env copy must carry the runtime
+    indices or flat_indices permutes the wrong devices."""
+    b = v5e16_bundle()
+    # Daemons registered in reverse block order.
+    mapping = {n: 3 - i for i, n in enumerate(V5E16_NODES)}
+    r = b.remap_workers(mapping)
+    assert r is not b
+    # Same physical order (nodes/chips/coords untouched), new enum slots.
+    assert [(d.node, d.chip, d.coord) for d in r.device_order] \
+        == [(d.node, d.chip, d.coord) for d in b.device_order]
+    assert all(d.worker == mapping[d.node] for d in r.device_order)
+    assert sorted(r.flat_indices()) == list(range(16))
+    assert r.flat_indices() != b.flat_indices()
+    assert r.revision == b.revision and r.hop_score == b.hop_score
+    # Identity mapping is a no-op in content.
+    ident = b.remap_workers({n: i for i, n in enumerate(V5E16_NODES)})
+    assert ident.device_order == b.device_order
+    # Incomplete mapping / not a permutation of the block slots: self.
+    assert b.remap_workers({V5E16_NODES[0]: 0}) is b
+    assert b.remap_workers({n: 0 for n in V5E16_NODES}) is b
+    assert b.remap_workers(
+        {n: i + 4 for i, n in enumerate(V5E16_NODES)}) is b
+
+
+def test_bootstrap_env_remaps_bundle_workers():
+    """ComputeDomainManager.bootstrap_env injects the bundle with worker
+    slots rewritten to the clique's CAS indices when those differ from
+    block order — every pod gets the SAME remapped bundle."""
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomainClique,
+        ComputeDomainDaemonInfo,
+    )
+    from k8s_dra_driver_tpu.plugins.computedomain.computedomain import (
+        ComputeDomainManager,
+    )
+    from k8s_dra_driver_tpu.tpulib.types import HostInventory, TpuGen
+
+    cd = ComputeDomain(meta=new_meta("cd-remap", "ns1"),
+                       spec=ComputeDomainSpec(num_nodes=4))
+    cd.status.placement = ComputeDomainPlacement(
+        block_shape="2x2", nodes=list(V5E16_NODES))
+    cd.status.mesh_bundle = v5e16_bundle()
+    # Clique indices allocated in REVERSE of block order.
+    clique = ComputeDomainClique(
+        meta=new_meta("clq", "ns1"), domain_uid=cd.uid,
+        nodes=[ComputeDomainDaemonInfo(node_name=n, ip_address=f"10.0.0.{i}",
+                                       index=3 - i, ready=True)
+               for i, n in enumerate(V5E16_NODES)])
+    envs = []
+    for node in V5E16_NODES:
+        mgr = ComputeDomainManager(
+            api=None, node_name=node,
+            inventory=HostInventory(
+                gen=TpuGen.V5E, accelerator_type="v5litepod-16",
+                slice_topology="4x4", host_topology="2x2",
+                worker_id=0, num_hosts=4))
+        envs.append(mgr.bootstrap_env(cd, clique))
+    raws = {e[MESH_BUNDLE_ENV] for e in envs}
+    assert len(raws) == 1
+    injected = MeshBundle.from_json(raws.pop())
+    assert all(d.worker == 3 - V5E16_NODES.index(d.node)
+               for d in injected.device_order)
+    assert sorted(injected.flat_indices()) == list(range(16))
+    # And the status copy stays in block order (the controller's view).
+    assert all(d.worker == V5E16_NODES.index(d.node)
+               for d in cd.status.mesh_bundle.device_order)
+
+
+# -- serialization: env JSON and the k8s wire ---------------------------------
+
+
+def full_bundle():
+    """Every field populated — the wire-drift fixture shape."""
+    return v5e16_bundle(broken_links=[("tpu-node-0", 0, 1)], revision=3)
+
+
+def test_bundle_json_round_trip_exact():
+    b = full_bundle()
+    assert MeshBundle.from_json(b.to_json()) == b
+    # Canonical form: key-sorted, separator-compact (env-stable bytes).
+    assert b.to_json() == json.dumps(b.to_json_obj(), separators=(",", ":"),
+                                     sort_keys=True)
+
+
+def test_bundle_k8s_wire_round_trip_on_computedomain():
+    """status.meshBundle crosses the k8s YAML wire losslessly with every
+    field populated on both sides — the fixture the tpulint wire-drift
+    checker audits (_meshbundle_encode/_meshbundle_decode)."""
+    cd = ComputeDomain(meta=new_meta("cd-wire", "ns1"),
+                       spec=ComputeDomainSpec(num_nodes=4))
+    cd.status.placement = ComputeDomainPlacement(
+        ici_domain="slice-0", block_origin="0x0", block_shape="2x2",
+        nodes=list(V5E16_NODES))
+    cd.status.mesh_bundle = full_bundle()
+    doc = to_k8s_wire(cd)
+    wire = doc["status"]["meshBundle"]
+    # The wire shape IS the env shape: same keys, same values.
+    assert wire == cd.status.mesh_bundle.to_json_obj()
+    back = from_k8s_wire(json.loads(json.dumps(doc)))
+    assert back.status.mesh_bundle == cd.status.mesh_bundle
+    assert back.status.placement == cd.status.placement
+
+
+def test_bundle_store_wire_round_trip():
+    """The store/WAL serializer (serialize.py, the `get -o yaml` shape)
+    carries the bundle dataclass with full fidelity too — an 8192-node
+    WAL restore must not drop compiled bundles."""
+    cd = ComputeDomain(meta=new_meta("cd-wal", "ns1"))
+    cd.status.mesh_bundle = full_bundle()
+    doc = json.loads(json.dumps(to_wire(cd)))
+    back = from_wire(doc)
+    assert back.status.mesh_bundle == cd.status.mesh_bundle
+
+
+def test_absent_bundle_stays_absent_on_wire():
+    cd = ComputeDomain(meta=new_meta("cd-none", "ns1"))
+    doc = to_k8s_wire(cd)
+    assert "meshBundle" not in doc["status"]
+    assert from_k8s_wire(doc).status.mesh_bundle is None
+
+
+# -- client half: parallel/mesh.py --------------------------------------------
+
+
+def test_load_bundle_reads_env_and_degrades():
+    from k8s_dra_driver_tpu.parallel.mesh import load_bundle
+
+    b = full_bundle()
+    assert load_bundle({MESH_BUNDLE_ENV: b.to_json()}) == b
+    assert load_bundle({}) is None
+    assert load_bundle({MESH_BUNDLE_ENV: "not json"}) is None
+    assert load_bundle({MESH_BUNDLE_ENV: "[1,2]"}) is None
+    # Malformed NESTED shapes degrade too (never an exception).
+    assert load_bundle({MESH_BUNDLE_ENV: '{"deviceOrder":[1,2]}'}) is None
+    assert load_bundle({MESH_BUNDLE_ENV: '{"axisSizes":["x"]}'}) is None
+
+
+def test_bundle_device_order_permutes_and_falls_back():
+    from k8s_dra_driver_tpu.parallel.mesh import bundle_device_order
+
+    b = v5e16_bundle()
+    devs = [f"d{i}" for i in range(16)]
+    ordered = bundle_device_order(devs, b)
+    assert sorted(ordered) == sorted(devs)
+    assert ordered == [devs[i] for i in b.flat_indices()]
+    # Fallbacks: no bundle, wrong size, corrupt permutation.
+    assert bundle_device_order(devs, None) == devs
+    assert bundle_device_order(devs[:8], b) == devs[:8]
+    corrupt = MeshBundle.from_json(b.to_json())
+    corrupt.device_order[0] = MeshDevice(node="x", worker=0, chip=1,
+                                         coord=(9, 9))  # duplicate index
+    assert bundle_device_order(devs, corrupt) == devs
+
+
+def test_synthetic_bundle_matches_compiler():
+    from k8s_dra_driver_tpu.parallel.mesh import synthetic_bundle
+
+    b = synthetic_bundle(8)
+    assert b.num_devices == 8
+    assert b.axis_sizes == [2, 4]
+    assert sorted(b.flat_indices()) == list(range(8))
+    assert b.hop_score <= b.naive_hop_score
+    with pytest.raises(ValueError, match="must divide"):
+        synthetic_bundle(6)
+
+
+def test_family_mesh_applies_ambient_bundle(cpu_devices, monkeypatch):
+    from k8s_dra_driver_tpu.parallel.mesh import family_mesh, synthetic_bundle
+
+    b = synthetic_bundle(8)
+    devs = list(cpu_devices[:8])
+    # Explicit bundle and ambient-env bundle must agree.
+    m_explicit = family_mesh(devs, (2, 4), ("data", "model"), bundle=b)
+    monkeypatch.setenv(MESH_BUNDLE_ENV, b.to_json())
+    m_env = family_mesh(devs, (2, 4), ("data", "model"))
+    expect = [devs[i] for i in b.flat_indices()]
+    assert list(m_explicit.devices.flat) == expect
+    assert list(m_env.devices.flat) == expect
+    # Without a bundle: plain enumeration-order reshape (the old shape).
+    monkeypatch.delenv(MESH_BUNDLE_ENV)
+    m_plain = family_mesh(devs, (2, 4), ("data", "model"))
+    assert list(m_plain.devices.flat) == devs
+    with pytest.raises(ValueError, match="needs 8 devices"):
+        family_mesh(devs[:4], (2, 4), ("data", "model"))
+
+
+def test_mesh_from_bundle_and_fallback(cpu_devices, monkeypatch):
+    from k8s_dra_driver_tpu.parallel.mesh import (
+        choose_dp_tp,
+        mesh_from_bundle,
+        synthetic_bundle,
+    )
+
+    devs = list(cpu_devices[:8])
+    b = synthetic_bundle(8)
+    m = mesh_from_bundle(devs, bundle=b)
+    assert m.axis_names == ("data", "model")
+    assert m.devices.shape == (2, 4)
+    assert list(m.devices.flat) == [devs[i] for i in b.flat_indices()]
+    # No bundle anywhere: the enumeration-order dp x tp factorization.
+    monkeypatch.delenv(MESH_BUNDLE_ENV, raising=False)
+    m2 = mesh_from_bundle(devs)
+    assert m2.devices.shape == choose_dp_tp(8)
+    assert list(m2.devices.flat) == devs
+
+
+def test_mesh_from_bundle_inconsistent_axes_falls_back(cpu_devices):
+    """A bundle whose axis-size product disagrees with its own device
+    order (version skew, hand edits) must degrade to the enumeration-
+    order factorization, not crash the booting workload."""
+    from k8s_dra_driver_tpu.parallel.mesh import (
+        choose_dp_tp,
+        mesh_from_bundle,
+        synthetic_bundle,
+    )
+
+    devs = list(cpu_devices[:8])
+    bad = synthetic_bundle(8)
+    bad.axis_sizes = [2, 2]  # product 4 != 8 devices
+    m = mesh_from_bundle(devs, bundle=bad)
+    assert m.devices.shape == choose_dp_tp(8)
+    assert list(m.devices.flat) == devs
+
+
+def test_mesh_from_bundle_rejected_ambient_not_reapplied(
+        cpu_devices, monkeypatch):
+    """When the AMBIENT env bundle is rejected as inconsistent, the
+    fallback must not permute by that same bundle's device order through
+    family_mesh's ambient reload — enumeration order means enumeration
+    order."""
+    from k8s_dra_driver_tpu.parallel.mesh import (
+        choose_dp_tp,
+        mesh_from_bundle,
+        synthetic_bundle,
+    )
+
+    devs = list(cpu_devices[:8])
+    bad = synthetic_bundle(8)
+    bad.axis_sizes = [3, 3]  # product 9 != its own 8 devices
+    monkeypatch.setenv(MESH_BUNDLE_ENV, bad.to_json())
+    m = mesh_from_bundle(devs)
+    assert m.devices.shape == choose_dp_tp(8)
+    assert list(m.devices.flat) == devs  # NOT bad.flat_indices() order
+
+
+def test_match_partition_rules_pytree(cpu_devices):
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_dra_driver_tpu.parallel.mesh import match_partition_rules
+
+    params = {
+        "layers": [{
+            "wqkv": jnp.zeros((2, 4, 8, 16)),
+            "wo": jnp.zeros((8, 16, 4)),
+            "ln1": jnp.zeros((4,)),
+        }],
+        "embed": jnp.zeros((32, 4)),
+        "step": jnp.zeros(()),  # scalar replicates before any rule
+    }
+    specs = match_partition_rules(default_partition_rules("model"), params)
+    assert specs["layers"][0]["wqkv"] == P(None, None, "model", None)
+    assert specs["layers"][0]["wo"] == P("model", None, None)
+    assert specs["layers"][0]["ln1"] == P()
+    assert specs["embed"] == P(None, None)
+    assert specs["step"] == P()
+    with pytest.raises(ValueError, match="not found"):
+        match_partition_rules([["wqkv$", ["model"]]],
+                              {"novel": jnp.zeros((2, 2))})
+
+
+# -- controller emit / re-emit ------------------------------------------------
+
+
+NS = "mesh-ns"
+
+
+def _member_slice(node: str, tainted_link=None) -> ResourceSlice:
+    """A ResourceSlice the way deviceinfo publishes it: per-device topology
+    attributes, and (optionally) an ICI-link taint on the one 2-chip
+    device spanning the dead link — the exact witness the controller's
+    _slice_broken_links decodes back into endpoints."""
+    devices = [Device(
+        name=f"tpu-{node}-chip-{i}",
+        attributes={"tpu.google.com/hostTopology": "2x2",
+                    "tpu.google.com/sliceTopology": "4x4"},
+        consumes_counters=[DeviceCounterConsumption(
+            counter_set="tpu-host-chips", counters={f"chip-{i}": None})],
+    ) for i in range(4)]
+    if tainted_link is not None:
+        a, b = tainted_link
+        devices.append(Device(
+            name=f"tpu-{node}-sub-{a}{b}",
+            attributes={"tpu.google.com/hostTopology": "2x2"},
+            taints=[DeviceTaint(key=ICI_LINK_TAINT_KEY,
+                                value=f"{a}-{b}", effect="NoSchedule")],
+            consumes_counters=[DeviceCounterConsumption(
+                counter_set="tpu-host-chips",
+                counters={f"chip-{a}": None, f"chip-{b}": None})],
+        ))
+    return ResourceSlice(meta=new_meta(f"slice-{node}"), node_name=node,
+                         driver="tpu.google.com", devices=devices)
+
+
+def _controller_cd(api, name="mesh-cd"):
+    from k8s_dra_driver_tpu.api.computedomain import ComputeDomainChannelSpec
+
+    cd = ComputeDomain(
+        meta=new_meta(name, NS),
+        spec=ComputeDomainSpec(
+            num_nodes=4,
+            channel=ComputeDomainChannelSpec(
+                resource_claim_template_name=f"{name}-channel")),
+    )
+    return api.create(cd)
+
+
+def _wait(cond, timeout=15.0, msg="condition"):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_controller_compiles_bundle_from_placement_and_links():
+    """The controller's full loop against a live APIServer: placement write
+    -> bundle rev 1 (MeshBundleUpdated event, metrics); ICI-link taint ->
+    rev 2 routed around the link; heal -> rev 3 clean; a no-op reconcile
+    storm never bumps the revision."""
+    from k8s_dra_driver_tpu.controller.controller import Controller
+
+    api = APIServer()
+    for n in V5E16_NODES:
+        api.create(_member_slice(n))
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = _controller_cd(api)
+
+        def set_placement(obj):
+            obj.status.placement = ComputeDomainPlacement(
+                ici_domain="slice-0", block_origin="0x0", block_shape="2x2",
+                nodes=list(V5E16_NODES))
+        api.update_with_retry("ComputeDomain", cd.name, NS, set_placement)
+
+        def bundle():
+            return api.get("ComputeDomain", cd.name, NS).status.mesh_bundle
+
+        _wait(lambda: bundle() is not None, msg="bundle emitted")
+        b = bundle()
+        assert b.revision == 1
+        assert b.axis_sizes == [4, 4]
+        assert b.broken_links == []
+        assert b.same_geometry(v5e16_bundle())
+        assert ctrl.meshgen_metrics.builds_total.value("placement") == 1
+
+        # Force extra reconciles: geometry unchanged -> revision stable.
+        for i in range(3):
+            def touch(obj, i=i):
+                obj.meta.annotations["touch"] = str(i)
+            api.update_with_retry("ComputeDomain", cd.name, NS, touch)
+        _wait(lambda: api.get("ComputeDomain", cd.name, NS)
+              .meta.annotations.get("touch") == "2", msg="touches seen")
+        assert bundle().revision == 1
+
+        # Dead ICI link on a member -> re-emit rev 2, routed around.
+        tainted = _member_slice("tpu-node-0", tainted_link=(0, 1))
+
+        def taint(obj):
+            obj.devices = tainted.devices
+        api.update_with_retry("ResourceSlice", "slice-tpu-node-0", "", taint)
+        _wait(lambda: bundle().revision == 2, msg="link-health re-emit")
+        b2 = bundle()
+        assert b2.broken_links == [["tpu-node-0", 0, 1]]
+        assert b2.same_geometry(
+            v5e16_bundle(broken_links=[("tpu-node-0", 0, 1)]))
+        assert ctrl.meshgen_metrics.builds_total.value("link-health") == 1
+
+        # Heal -> rev 3, clean geometry again.
+        healthy_rs = _member_slice("tpu-node-0")
+
+        def heal(obj):
+            obj.devices = healthy_rs.devices
+        api.update_with_retry("ResourceSlice", "slice-tpu-node-0", "", heal)
+        _wait(lambda: bundle().revision == 3, msg="heal re-emit")
+        assert bundle().broken_links == []
+
+        events = [e for e in api.list("Event", namespace=NS)
+                  if e.reason == "MeshBundleUpdated"]
+        assert events, "MeshBundleUpdated never narrated"
+        assert any("hop score" in e.message for e in events)
+    finally:
+        ctrl.stop()
+
+
+def test_controller_no_topology_published_keeps_no_bundle():
+    """Members whose slices carry no topology attributes (legacy cluster):
+    the placement lands but no bundle can compile — and nothing crashes."""
+    from k8s_dra_driver_tpu.controller.controller import Controller
+
+    api = APIServer()
+    for n in V5E16_NODES:
+        rs = _member_slice(n)
+        for d in rs.devices:
+            d.attributes = {}
+        api.create(rs)
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = _controller_cd(api, name="legacy-cd")
+
+        def set_placement(obj):
+            obj.status.placement = ComputeDomainPlacement(
+                block_shape="2x2", nodes=list(V5E16_NODES))
+        api.update_with_retry("ComputeDomain", cd.name, NS, set_placement)
+        _wait(lambda: api.get("ComputeDomain", cd.name, NS)
+              .status.placement is not None, msg="placement carried")
+        import time
+
+        time.sleep(0.2)  # give a reconcile the chance to mis-compile
+        assert api.get("ComputeDomain", cd.name, NS).status.mesh_bundle is None
+    finally:
+        ctrl.stop()
+
+
+def test_controller_topology_arriving_after_reconcile_compiles_bundle():
+    """Regression: a domain whose placement reconciled BEFORE any member
+    slice published topology (controller restart ordering) must get its
+    bundle when the topology attributes arrive — topology arrival is a
+    compile-input change, not a quiet republish."""
+    from k8s_dra_driver_tpu.controller.controller import Controller
+
+    api = APIServer()
+    bare = []
+    for n in V5E16_NODES:
+        rs = _member_slice(n)
+        for d in rs.devices:
+            d.attributes = {}
+        bare.append(api.create(rs))
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = _controller_cd(api, name="late-topo-cd")
+
+        def set_placement(obj):
+            obj.status.placement = ComputeDomainPlacement(
+                ici_domain="slice-0", block_origin="0x0", block_shape="2x2",
+                nodes=list(V5E16_NODES))
+        api.update_with_retry("ComputeDomain", cd.name, NS, set_placement)
+        _wait(lambda: api.get("ComputeDomain", cd.name, NS)
+              .status.placement is not None, msg="placement carried")
+        assert api.get("ComputeDomain", cd.name, NS).status.mesh_bundle is None
+
+        # Topology attributes land (deviceinfo catches up): every member's
+        # slice republishes with hostTopology — no taint, no link change.
+        for n in V5E16_NODES:
+            full = _member_slice(n)
+
+            def publish(obj, devices=full.devices):
+                obj.devices = devices
+            api.update_with_retry("ResourceSlice", f"slice-{n}", "", publish)
+        _wait(lambda: api.get("ComputeDomain", cd.name, NS)
+              .status.mesh_bundle is not None, msg="bundle after late topo")
+        assert api.get("ComputeDomain", cd.name, NS) \
+            .status.mesh_bundle.same_geometry(v5e16_bundle())
+    finally:
+        ctrl.stop()
+
+
+def test_controller_restart_repopulates_meshgen_gauges():
+    """Regression: a fresh controller (failover — empty metrics registry)
+    reconciling a domain whose bundle is already compiled and unchanged
+    must re-export the revision/hop gauges without counting a build."""
+    from k8s_dra_driver_tpu.controller.controller import Controller
+
+    api = APIServer()
+    for n in V5E16_NODES:
+        api.create(_member_slice(n))
+    cd = _controller_cd(api, name="steady-cd")
+
+    def seed(obj):
+        obj.status.placement = ComputeDomainPlacement(
+            ici_domain="slice-0", block_origin="0x0", block_shape="2x2",
+            nodes=list(V5E16_NODES))
+        obj.status.mesh_bundle = v5e16_bundle()
+    api.update_with_retry("ComputeDomain", cd.name, NS, seed)
+
+    ctrl = Controller(api, cleanup_interval_s=3600)  # the NEW leader
+    ctrl.start()
+    try:
+        _wait(lambda: ctrl.meshgen_metrics.revision.value(NS, "steady-cd")
+              == 1.0, msg="gauges repopulated")
+        assert ctrl.meshgen_metrics.hop_score.value(
+            NS, "steady-cd", "generated") == float(v5e16_bundle().hop_score)
+        assert api.get("ComputeDomain", cd.name, NS) \
+            .status.mesh_bundle.revision == 1  # no spurious rebuild
+        assert ctrl.meshgen_metrics.builds_total.value("placement") == 0
+    finally:
+        ctrl.stop()
+
+
+def test_controller_reemit_races_placement_write():
+    """The CAS-retry contract: a controller status aggregation racing the
+    scheduler's placement write must converge with bundle and placement
+    CONSISTENT — the mutate closure recompiles against the live placement,
+    never pairing a stale bundle with a fresh block (run under tpusan via
+    TPU_SAN=1; the sanitized suite asserts no lock violations on the
+    store seams this race exercises)."""
+    from k8s_dra_driver_tpu.controller.controller import Controller
+
+    api = APIServer()
+    for n in V5E16_NODES:
+        api.create(_member_slice(n))
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = _controller_cd(api, name="race-cd")
+        _wait(lambda: api.get("ComputeDomain", cd.name, NS)
+              .meta.finalizers != [], msg="finalizer")
+
+        def write_placement():
+            def mutate(obj):
+                obj.status.placement = ComputeDomainPlacement(
+                    ici_domain="slice-0", block_origin="0x0",
+                    block_shape="2x2", nodes=list(V5E16_NODES))
+            api.update_with_retry("ComputeDomain", cd.name, NS, mutate)
+
+        def poke_status():
+            # Drive concurrent status aggregations through the real
+            # reconcile path while the placement write lands.
+            for _ in range(5):
+                ctrl._update_status(api.get("ComputeDomain", cd.name, NS))
+
+        t1 = threading.Thread(target=write_placement)
+        t2 = threading.Thread(target=poke_status)
+        t1.start(); t2.start()
+        t1.join(); t2.join()
+
+        def consistent():
+            fresh = api.get("ComputeDomain", cd.name, NS)
+            return (fresh.status.placement is not None
+                    and fresh.status.mesh_bundle is not None)
+
+        _wait(consistent, msg="bundle caught up with racing placement")
+        fresh = api.get("ComputeDomain", cd.name, NS)
+        # The bundle was compiled against THE recorded placement: its
+        # device order names exactly the placement's nodes, in block order.
+        order_nodes = [d.node for d in fresh.status.mesh_bundle.device_order]
+        assert sorted(set(order_nodes)) == sorted(fresh.status.placement.nodes)
+        assert fresh.status.mesh_bundle.revision >= 1
+    finally:
+        ctrl.stop()
+
+
+# -- bench gate + committed artifact ------------------------------------------
+
+
+def test_bench_meshgen_hop_gate():
+    """Acceptance: bench_meshgen's pure half shows generated-order hop
+    count <= naive on every topology and STRICTLY better on v5e-16 (the
+    same gate `make bench-smoke` hard-asserts)."""
+    import bench
+
+    out = bench.bench_meshgen(assert_budget=True, families=False)
+    assert out["meshgen_hop_gate"] == "pass"
+    assert (out["meshgen_hop_v5e16_generated"]
+            < out["meshgen_hop_v5e16_naive"])
+    assert out["meshgen_hop_v5e8_generated"] <= out["meshgen_hop_v5e8_naive"]
+    assert (out["meshgen_hop_v5e16_degraded_generated"]
+            <= out["meshgen_hop_v5e16_degraded_naive"])
+
+
+def test_multichip_r06_artifact_committed():
+    """MULTICHIP_r06 (nine families in mesh-bundle order) is committed,
+    green, and tail-parseable the same way every previous round's artifact
+    is — the next round's parity check depends on the line format."""
+    import os
+    import re
+
+    import bench
+
+    path = os.path.join(os.path.dirname(os.path.abspath(bench.__file__)),
+                        "MULTICHIP_r06.json")
+    assert os.path.exists(path), "MULTICHIP_r06.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["ok"] is True and doc["rc"] == 0 and doc["n_devices"] == 8
+    assert doc["order"] == "mesh-bundle"
+    losses = re.findall(r"train step loss=([0-9.]+)", doc["tail"])
+    assert len(losses) == 9, doc["tail"]
+    # The hop evidence rode along and passed.
+    assert doc["meshgen"]["meshgen_hop_gate"] == "pass"
+    assert (doc["meshgen"]["meshgen_hop_v5e16_generated"]
+            < doc["meshgen"]["meshgen_hop_v5e16_naive"])
+    # Strict parity: same process, only the device order differed.
+    assert doc["loss_parity"], "parity block missing"
+    assert all(p["vs_naive"] <= 1e-3 for p in doc["loss_parity"].values())
