@@ -1121,6 +1121,234 @@ def _r05_family_losses(path: str = "MULTICHIP_r05.json") -> dict:
     return out
 
 
+def bench_telemetry(storm_claims: int = 64, iters: int = 110, runs: int = 2,
+                    rollup_nodes: int = 1024, assert_budget: bool = False) -> dict:
+    """Fleet telemetry plane cost benchmark (docs/reference/telemetry.md).
+
+    Three hard gates (``assert_budget=True`` in make bench-smoke):
+
+    (a) **Prepare-storm overhead** — a 64-claim batched prepare/unprepare
+        storm through the real plugin pipeline, with the telemetry
+        sampling thread at 100 ms (~100x a real node's interval; every
+        batch overlaps a sample) vs sampling off: p99 batch wall time
+        with sampling on must be within 5% of off. The sampler shares NO
+        lock with the prepare paths — holding one would stall batches a
+        whole interval and blow the gate instantly. iters > 100 so p99
+        is a real order statistic (not an alias of max; the
+        bench_claim_to_running recipe) and min-of-runs damps container
+        noise.
+    (b) **Rollup scale** — one aggregation pass over ``rollup_nodes``
+        synthetic node views (4 chips each, one prepared claim per node,
+        domains of 4 hosts) must finish inside a hard wall budget and
+        issue ZERO store list() calls (membership rides the watch-fed
+        cache; claim targets come off the node views).
+    (c) **Quantized change gating** — constant load across repeated
+        rollup passes produces EXACTLY ONE status write (the first
+        summary); steady utilization must not churn resourceVersions.
+    """
+    import os
+
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+    from k8s_dra_driver_tpu.tpulib.profiles import SliceProfile
+    from k8s_dra_driver_tpu.tpulib.types import TpuGen
+    from tests.test_tpu_plugin import make_claim
+
+    out: dict = {}
+
+    # -- (a) prepare storm, sampling on vs off ------------------------------
+    side = 1
+    while side * side < storm_claims:
+        side *= 2
+    topo = f"{side}x{side}"
+    profile = SliceProfile(
+        name=f"bench-v5e-{side * side}x1", gen=TpuGen.V5E,
+        accelerator_type=f"v5litepod-{side * side}",
+        slice_topology=topo, host_topology=topo,
+    )
+
+    # Checkpoint fsyncs through this container's 9p root stall for
+    # 100-700 ms at random (the bench_scale parallel-fsync probe's
+    # finding); that noise dwarfs any sampler effect and lands on
+    # whichever mode is unlucky. The gate measures the SAMPLER, so the
+    # plugin dirs go on tmpfs where fsync is deterministic.
+    shm = "/dev/shm" if os.access("/dev/shm", os.W_OK) else None
+
+    def storm_p99(sampling_interval: float) -> float:
+        lat = []
+        with tempfile.TemporaryDirectory(dir=shm) as tmp:
+            lib = MockTpuLib(profile)
+            lib.set_load_trace("bursty:seed=7,period=3,duty=0.5")
+            driver = TpuDriver(
+                api=APIServer(), node_name="bench-node", tpulib=lib,
+                plugin_dir=os.path.join(tmp, "plugin"),
+                cdi_root=os.path.join(tmp, "cdi"),
+                telemetry_interval_s=sampling_interval,
+            )
+            driver.start()
+            try:
+                for it in range(iters):
+                    claims = [
+                        make_claim([f"tpu-{i}"], name=f"tel-{it}-{i}")
+                        for i in range(storm_claims)
+                    ]
+                    t0 = time.perf_counter()
+                    res = driver.prepare_resource_claims(claims)
+                    lat.append(time.perf_counter() - t0)
+                    errs = [r for r in res.values()
+                            if isinstance(r, Exception)]
+                    assert not errs, errs[0]
+                    driver.unprepare_resource_claims(
+                        [c.uid for c in claims])
+            finally:
+                driver.shutdown()
+        return sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    # 100 ms is ~100x more aggressive than a real node's 10 s interval,
+    # and every ~100 ms storm batch still overlaps a sample. The gate
+    # proves the sampler shares no prepare-path lock (a lock-holding
+    # sampler stalls a batch a whole interval, blowing 5% instantly) —
+    # not that a kHz busy-loop is free under the GIL.
+    #
+    # Measurement: interleaved (off, on) PAIRS, overhead = the best
+    # pair's p99 ratio. Container CPU noise is one-sided (stalls) and
+    # phase-local — two sequential mode phases hand whole-run drift to
+    # whichever mode is unlucky — while a genuinely lock-sharing sampler
+    # stalls batches in EVERY pair (>=1 full interval >> 5%), so it can
+    # never produce one clean pair.
+    p99_off = p99_on = None
+    overhead = None
+    for _ in range(runs):
+        off = storm_p99(0.0)
+        on = storm_p99(0.1)
+        ratio = on / off - 1.0
+        if overhead is None or ratio < overhead:
+            overhead, p99_off, p99_on = ratio, off, on
+    out["telemetry_storm_claims"] = storm_claims
+    out["telemetry_storm_p99_off_ms"] = round(p99_off * 1e3, 3)
+    out["telemetry_storm_p99_on_ms"] = round(p99_on * 1e3, 3)
+    out["telemetry_storm_overhead_pct"] = round(overhead * 100.0, 2)
+    if assert_budget:
+        assert overhead <= 0.05, (
+            f"sampling added {overhead * 100:.1f}% p99 to the "
+            f"{storm_claims}-claim prepare storm (gate: <=5%) — a "
+            f"sampler is blocking the prepare path")
+
+    # -- (b) rollup pass at rollup_nodes ------------------------------------
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainNode,
+        ComputeDomainSpec,
+    )
+    from k8s_dra_driver_tpu.k8s.core import ResourceClaim
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.pkg.metrics import Registry
+    from k8s_dra_driver_tpu.pkg.telemetry import (
+        ClaimChips,
+        NodeView,
+        TelemetryAggregator,
+        WindowStats,
+    )
+
+    api = APIServer()
+    hosts_per_domain = 4
+    for i in range(rollup_nodes):
+        api.create(ResourceClaim(meta=new_meta(f"claim-{i}", "default")))
+    for d in range(rollup_nodes // hosts_per_domain):
+        cd = ComputeDomain(meta=new_meta(f"cd-{d}", "default"),
+                           spec=ComputeDomainSpec(num_nodes=hosts_per_domain))
+        cd.status.nodes = [
+            ComputeDomainNode(name=f"node-{d * hosts_per_domain + j}")
+            for j in range(hosts_per_domain)
+        ]
+        api.create(cd)
+    agg = TelemetryAggregator(api, Registry())
+    stats = WindowStats(count=120, last=0.6, min=0.55, max=0.7, mean=0.6,
+                        p95=0.65, span_seconds=119.0)
+    views = [
+        NodeView(
+            node=f"node-{i}",
+            duty={c: stats for c in range(4)},
+            hbm_used={c: stats for c in range(4)},
+            hbm_total={c: 16 << 30 for c in range(4)},
+            link_util=stats,
+            claims=[ClaimChips(uid=f"uid-{i}", name=f"claim-{i}",
+                               namespace="default", chips=(0, 1, 2, 3))],
+        )
+        for i in range(rollup_nodes)
+    ]
+    agg.rollup(1.0, views)          # first pass: writes every summary
+    lists_before = api.stats.list_calls
+    t0 = time.perf_counter()
+    res = agg.rollup(2.0, views)    # steady pass: the gated one
+    rollup_wall = time.perf_counter() - t0
+    lists_during = api.stats.list_calls - lists_before
+    agg.close()
+    out["telemetry_rollup_nodes"] = rollup_nodes
+    out["telemetry_rollup_claims"] = res.claims_seen
+    out["telemetry_rollup_domains"] = res.domains_seen
+    out["telemetry_rollup_wall_ms"] = round(rollup_wall * 1e3, 3)
+    out["telemetry_rollup_store_lists"] = lists_during
+    out["telemetry_rollup_steady_writes"] = res.status_writes
+    if assert_budget:
+        assert res.claims_seen == rollup_nodes and \
+            res.domains_seen == rollup_nodes // hosts_per_domain, (
+                f"rollup joined {res.claims_seen} claims / "
+                f"{res.domains_seen} domains, expected "
+                f"{rollup_nodes} / {rollup_nodes // hosts_per_domain}")
+        assert lists_during == 0, (
+            f"rollup pass issued {lists_during} store list() calls — "
+            f"membership must ride the watch-fed cache")
+        assert rollup_wall <= 2.0, (
+            f"{rollup_nodes}-node rollup pass took {rollup_wall:.2f}s "
+            f"(budget 2.0s)")
+        assert res.status_writes == 0, (
+            f"steady-state rollup issued {res.status_writes} status "
+            f"writes — the change gate leaked")
+
+    # -- (c) constant load -> exactly one status write -----------------------
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+
+    api2 = APIServer()
+    api2.create(ResourceClaim(meta=new_meta("steady", "default")))
+    agg2 = TelemetryAggregator(api2, Registry())
+    with tempfile.TemporaryDirectory() as tmp:
+        lib = MockTpuLib("v5e-4")
+        lib.set_load_trace("constant:level=0.62")
+        dev = DeviceState(lib, os.path.join(tmp, "plugin"),
+                          cdi_root=os.path.join(tmp, "cdi"),
+                          gates=fg.parse(""))
+        from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+            DeviceHealthMonitor,
+        )
+
+        mon = DeviceHealthMonitor("node-0", dev.allocatable, tpulib=lib)
+        lib.register_workload("steady-uid", (0, 1, 2, 3))
+        writes_per_pass = []
+        for tick in range(1, 13):
+            mon.sample(now=float(tick))
+            stats_by_sig = mon.window_stats()
+            view = NodeView(
+                node="node-0",
+                duty=stats_by_sig["duty"], hbm_used=stats_by_sig["hbm"],
+                hbm_total=mon.hbm_totals(), link_util=mon.link_utilization(),
+                claims=[ClaimChips(uid="steady-uid", name="steady",
+                                   namespace="default", chips=(0, 1, 2, 3))],
+            )
+            writes_per_pass.append(
+                agg2.rollup(float(tick), [view]).status_writes)
+    agg2.close()
+    out["telemetry_constant_load_writes"] = sum(writes_per_pass)
+    if assert_budget:
+        assert sum(writes_per_pass) == 1 and writes_per_pass[0] == 1, (
+            f"constant load wrote status {sum(writes_per_pass)} times "
+            f"(per pass: {writes_per_pass}) — quantized change gating "
+            f"must write exactly the first summary")
+    return out
+
+
 def bench_meshgen(assert_budget: bool = False, families: bool = True) -> dict:
     """Placement→JAX mesh compiler benchmark (docs/reference/meshgen.md).
 
@@ -1598,6 +1826,10 @@ def main() -> None:
         # parity bundle-vs-naive order, never-worse step time where the
         # fabric is real (capability-skipped on CPU runners).
         result.update(bench_meshgen(assert_budget=True))
+        # Telemetry-plane gates: <=5% p99 prepare-storm overhead with the
+        # sampling thread on, 1024-node rollup pass inside budget with
+        # zero store list() calls, constant load -> exactly 1 status write.
+        result.update(bench_telemetry(assert_budget=True))
         print(json.dumps(result))
         return
     result = bench_prepare_latency()
@@ -1639,6 +1871,12 @@ def main() -> None:
         result.update(bench_meshgen())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["meshgen_error"] = str(e)[:200]
+    try:
+        # Fleet telemetry: sampling overhead on the prepare storm, rollup
+        # pass cost at 1024 nodes, quantized change-gate write counts.
+        result.update(bench_telemetry())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["telemetry_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

@@ -11,7 +11,13 @@ Two failure modes the metric surface (PR 2/4) is vulnerable to:
 - a label value built from an f-string over an unbounded source (claim
   uids, messages, node names from user input) explodes series
   cardinality; label values must come from closed vocabularies, with
-  free-form detail in logs/events instead.
+  free-form detail in logs/events instead;
+- a label *name* that is a uid (PR 11's telemetry rule): uids are
+  unbounded across an object's lifetime churn — a gauge family on the
+  shared registry labeled by claim uid grows one series per claim ever
+  prepared. Rollup gauges key on claim name+namespace (bounded, LRU-
+  evicted like the event correlator's per-object state) and put the uid
+  in the log/trace instead.
 """
 
 from __future__ import annotations
@@ -35,6 +41,14 @@ from k8s_dra_driver_tpu.analysis.engine import (
 _LABELLED_CALLS = {"inc", "set", "observe"}
 # Keyword args of metric calls that carry the measurement, not a label.
 _VALUE_KWARGS = {"value", "by", "amount"}
+# Declared label names that mean "one series per object ever seen" —
+# unbounded on the shared registry no matter how the values are built.
+_UNBOUNDED_LABEL_NAMES = {"uid", "uuid"}
+
+
+def _is_uid_label(name: str) -> bool:
+    n = name.lower()
+    return n in _UNBOUNDED_LABEL_NAMES or n.endswith(("_uid", "_uuid"))
 
 
 @register_checker
@@ -90,20 +104,45 @@ class MetricDisciplineChecker(Checker):
                     node: ast.Call) -> List[Finding]:
         if not name.startswith("tpu_dra_"):
             return []
+        findings: List[Finding] = []
         parent = sf.parents.get(node)
         registered = (
             isinstance(parent, ast.Call)
             and isinstance(parent.func, ast.Attribute)
             and parent.func.attr == "register"
         )
-        if registered:
+        if not registered:
+            findings.append(self.finding(
+                sf, node,
+                f"metric {name!r} constructed outside "
+                f"registry.register() — the series never reaches /metrics "
+                f"and dodges shared-registry dedup",
+            ))
+        for label in self._declared_labels(node):
+            if _is_uid_label(label):
+                findings.append(self.finding(
+                    sf, node,
+                    f"metric {name!r} declares uid label {label!r} — one "
+                    f"series per object ever seen is unbounded on the "
+                    f"shared registry; key on name+namespace (LRU-"
+                    f"evicted) and put the uid in the log/trace",
+                ))
+        return findings
+
+    @staticmethod
+    def _declared_labels(node: ast.Call) -> List[str]:
+        """Literal label names of a metric constructor: the third
+        positional (after name, help) or the ``label_names`` keyword
+        (pkg.metrics' real parameter; ``labels`` accepted for
+        wrapper APIs)."""
+        labels_arg = node.args[2] if len(node.args) > 2 else None
+        for kw in node.keywords:
+            if kw.arg in ("label_names", "labels"):
+                labels_arg = kw.value
+        if not isinstance(labels_arg, (ast.Tuple, ast.List)):
             return []
-        return [self.finding(
-            sf, node,
-            f"metric {name!r} constructed outside "
-            f"registry.register() — the series never reaches /metrics "
-            f"and dodges shared-registry dedup",
-        )]
+        return [el.value for el in labels_arg.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)]
 
     def _check_labels(self, sf: SourceFile, node: ast.Call,
                       bindings: set) -> List[Finding]:

@@ -7,7 +7,7 @@ Two passes, both across every requested seed:
    detector class's violation, with both witness threads named, on EVERY
    seed and at every filler-worker count. A detector that stops firing is
    as broken as a lock that stops locking.
-2. **Scenario sweep** — the four real concurrent paths run under the
+2. **Scenario sweep** — the real concurrent paths run under the
    interleaving explorer and must be VIOLATION-FREE: any finding here is
    a real concurrency bug (or a regression of a fixed one) and fails the
    build with both witness stacks.

@@ -25,6 +25,14 @@ test suite:
      state) emitting overlapping series: exactly ONE stored Event per
      series (the cross-process dedup invariant), sane count bounds, and
      exact emitted+suppressed accounting per recorder.
+  5. ``meshgen-reemit`` — the cd-controller's status-aggregation CAS
+     (mesh-bundle recompile) racing the scheduler's placement write:
+     quiesced domain pairs the placement with a bundle compiled against
+     it at revision exactly 1.
+  6. ``telemetry-sample-vs-prepare`` — the telemetry sampler racing a
+     batched prepare/unprepare churner under the pu flock: no guarded-by
+     violations, no chip-set snapshot torn across a prepare, empty
+     mirror/workload registry at quiescence.
 
 - ``FIXTURES`` — seeded violations proving each detector class fires
   deterministically on ANY seed and at ANY worker count (the fillers):
@@ -495,12 +503,108 @@ def scenario_meshgen_reemit(state: SanitizerState, seed: int,
                    f"must never re-emit (the same_geometry dedup raced)")
 
 
+# -- scenario 6: telemetry sampling racing a batched prepare/unprepare --------
+
+
+def scenario_telemetry_sample_vs_prepare(state: SanitizerState, seed: int,
+                                         extra_workers: int = 0) -> None:
+    """The node agent's telemetry sampler (ring pushes + the
+    prepared-claim → chip-set mirror read) racing a batched
+    prepare/unprepare churner holding the pu flock: the sampler must
+    never block on a prepare-path lock (the guarded-by asserts catch any
+    structural drift) and every ``prepared_chipsets()`` snapshot must be
+    internally consistent — a claim's FULL chip set or nothing, never a
+    half-written entry torn across a chip-set change."""
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.flock import Flock
+    from k8s_dra_driver_tpu.pkg.partitioner import (
+        PartitionManager,
+        StubPartitionClient,
+    )
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+        DeviceHealthMonitor,
+        DeviceState,
+    )
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    with tempfile.TemporaryDirectory(prefix="tpusan-tel-") as tmp:
+        lib = MockTpuLib("v5e-4")
+        lib.set_load_trace("constant:level=0.7")
+        dev = DeviceState(
+            lib, os.path.join(tmp, "plugin"),
+            cdi_root=os.path.join(tmp, "cdi"),
+            gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+        )
+        dev.partitions = PartitionManager(dev.inventory.host_topology,
+                                          StubPartitionClient())
+        monitor = DeviceHealthMonitor("node-0", dev.allocatable, tpulib=lib)
+        pu_path = os.path.join(tmp, "plugin", "pu.lock")
+        claim_a = _claim_for_devices(["tpu-subslice-1x2-at-0x0"], "tel-a")
+        claim_b = _claim_for_devices(["tpu-subslice-1x2-at-1x0"], "tel-b")
+
+        # Ground truth: each claim's FULL chip set, recorded from a solo
+        # prepare before the race — the only values a snapshot may hold.
+        expected: Dict[str, Tuple[int, ...]] = {}
+        for claim in (claim_a, claim_b):
+            dev.prepare(claim)
+            expected[claim.uid] = dev.prepared_chipsets()[claim.uid][2]
+            dev.unprepare(claim.uid)
+        _invariant(state, expected[claim_a.uid] and expected[claim_b.uid]
+                   and not (set(expected[claim_a.uid])
+                            & set(expected[claim_b.uid])),
+                   f"fixture claims must hold disjoint non-empty chip sets, "
+                   f"got {expected}")
+
+        def sampler():
+            t = 1.0
+            for _ in range(8):
+                monitor.sample(now=t)
+                t += 1.0
+                snap = dev.prepared_chipsets()
+                for uid, (_, _, chips) in snap.items():
+                    _invariant(state, chips == expected.get(uid),
+                               f"claim {uid} snapshot holds chips {chips}, "
+                               f"expected the full set {expected.get(uid)} — "
+                               f"sample tore across a chip-set change")
+                monitor.window_stats()
+                state.yield_point(("scenario", "sampler"))
+
+        def churner(claim, wseed):
+            pu = Flock(pu_path)
+            for _ in range(3):
+                with pu.hold():
+                    dev.prepare(claim)
+                state.yield_point(("scenario", f"churn-{wseed}"))
+                with pu.hold():
+                    dev.unprepare(claim.uid)
+
+        explore(state, seed,
+                [("sampler", sampler),
+                 ("churner-a", lambda: churner(claim_a, "a")),
+                 ("churner-b", lambda: churner(claim_b, "b"))]
+                + _fillers(state, extra_workers))
+
+        # Quiesced: both churners ended unprepared, so the mirror and the
+        # mock's workload registry must both be empty (no leaked joins).
+        _invariant(state, not dev.prepared_chipsets(),
+                   f"chip-set mirror still holds "
+                   f"{dev.prepared_chipsets()} after all claims unprepared")
+        _invariant(state, not lib.workloads(),
+                   f"mock workload registry still holds {lib.workloads()} "
+                   f"after all claims unprepared")
+        # The sampler kept sampling throughout: rings actually filled.
+        _invariant(state, monitor.samples_taken >= 8,
+                   f"sampler took {monitor.samples_taken} samples, "
+                   f"expected all 8")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
     "migration-rollback": scenario_migration_rollback,
     "events-correlator": scenario_events_correlator,
     "meshgen-reemit": scenario_meshgen_reemit,
+    "telemetry-sample-vs-prepare": scenario_telemetry_sample_vs_prepare,
 }
 
 

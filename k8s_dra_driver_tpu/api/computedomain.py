@@ -14,7 +14,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from k8s_dra_driver_tpu.k8s.conditions import Condition
-from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, COMPUTE_DOMAIN_CLIQUE
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    COMPUTE_DOMAIN_CLIQUE,
+    UtilizationSummary,
+)
 from k8s_dra_driver_tpu.k8s.objects import K8sObject
 from k8s_dra_driver_tpu.pkg.meshgen import MeshBundle
 
@@ -104,6 +108,10 @@ class ComputeDomainStatus:
     # controller on placement or link-health change and injected into
     # claiming containers as TPU_DRA_MESH_BUNDLE by the CDI handler.
     mesh_bundle: Optional[MeshBundle] = None
+    # Windowed utilization roll-up over the domain's member hosts, written
+    # by the telemetry aggregator (quantized + change-gated like the
+    # claim-level summary); carries the domain ICI utilization p95.
+    utilization: Optional[UtilizationSummary] = None
 
 
 @dataclass

@@ -624,11 +624,17 @@ class Controller:
         # re-emitted (revision bump) when either moves.
         bundle, trigger = self._compile_mesh_bundle(
             fresh.status.placement, fresh.status.mesh_bundle)
+        # status.utilization is owned by the telemetry aggregator and is
+        # change-gated there: if the aggregation wiped it here, steady
+        # load would never be re-written and the summary would vanish on
+        # the first reconcile after a rollup (same silent-loss class the
+        # placement carry above guards against).
         desired = ComputeDomainStatus(status=status, nodes=nodes,
                                       conditions=conds,
                                       placement=copy.deepcopy(
                                           fresh.status.placement),
-                                      mesh_bundle=copy.deepcopy(bundle))
+                                      mesh_bundle=copy.deepcopy(bundle),
+                                      utilization=fresh.status.utilization)
         if fresh.status == desired:
             self.metric.set(cd.namespace, cd.name, status)
             if bundle is not None:
@@ -649,6 +655,7 @@ class Controller:
             # compile, safe under the CAS-retry contract).
             new = copy.deepcopy(desired)
             new.placement = copy.deepcopy(obj.status.placement)
+            new.utilization = obj.status.utilization
             b, trig = self._compile_mesh_bundle(
                 new.placement, obj.status.mesh_bundle)
             new.mesh_bundle = copy.deepcopy(b)

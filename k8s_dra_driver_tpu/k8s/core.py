@@ -168,6 +168,28 @@ class Event(K8sObject):
     last_timestamp: float = 0.0
 
 
+# -- utilization telemetry ---------------------------------------------------
+
+@dataclass
+class UtilizationSummary:
+    """Compact window roll-up the telemetry plane CASes onto ResourceClaim
+    and ComputeDomain status (`utilizationSummary` on the wire): the p95s
+    of the sampling window, quantized at write time so steady load does
+    not churn resourceVersions or watch fan-out. Equality is the change
+    gate's comparison, so it covers CONTENT only: ``updated_at`` (a
+    timestamp) and ``window_seconds``/``samples`` (which grow every tick
+    while the ring fills — comparing them would make even constant load
+    write status once per sample for a whole window) are excluded."""
+
+    window_seconds: float = field(default=0.0, compare=False)
+    samples: int = field(default=0, compare=False)
+    duty_cycle_p95: float = 0.0        # [0, 1]
+    hbm_used_p95_bytes: int = 0
+    hbm_total_bytes: int = 0
+    ici_utilization_p95: float = 0.0   # [0, 1]; domains only, 0 for claims
+    updated_at: float = field(default=0.0, compare=False)
+
+
 # -- kinds ------------------------------------------------------------------
 
 @dataclass
@@ -180,6 +202,10 @@ class ResourceClaim(K8sObject):
     # Typed lifecycle conditions (Allocated, Prepared), mirrored from the
     # scheduler/kubelet the way claim.status.conditions carries them upstream.
     conditions: List[Condition] = field(default_factory=list)
+    # Windowed utilization roll-up written by the telemetry aggregator
+    # (status.utilizationSummary upstream-style); None until the claim's
+    # chips produced a full first summary.
+    utilization: Optional[UtilizationSummary] = None
 
 
 CLAIM_COND_ALLOCATED = "Allocated"

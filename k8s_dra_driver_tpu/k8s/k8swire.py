@@ -59,6 +59,7 @@ from k8s_dra_driver_tpu.k8s.core import (
     ResourceClaimTemplate,
     ResourcePool,
     ResourceSlice,
+    UtilizationSummary,
     RegisteredWebhook,
     ValidatingWebhookConfiguration,
     WebhookClientConfig,
@@ -622,7 +623,42 @@ def _claim_encode(rc: ResourceClaim, version: str = "v1") -> Dict[str, Any]:
         ]
     if rc.conditions:
         status["conditions"] = _conditions_encode(rc.conditions)
+    if rc.utilization is not None:
+        status["utilizationSummary"] = _utilization_encode(rc.utilization)
     return {"spec": spec, "status": status}
+
+
+# -- utilization summary ------------------------------------------------------
+#
+# Shared by ResourceClaim.status and ComputeDomain.status: the telemetry
+# aggregator's quantized window roll-up. Wire shape mirrors the dataclass
+# field-for-field so the wire-drift checker audits both directions.
+
+
+def _utilization_encode(u: UtilizationSummary) -> Dict[str, Any]:
+    return {
+        "windowSeconds": u.window_seconds,
+        "samples": u.samples,
+        "dutyCycleP95": u.duty_cycle_p95,
+        "hbmUsedP95Bytes": u.hbm_used_p95_bytes,
+        "hbmTotalBytes": u.hbm_total_bytes,
+        "iciUtilizationP95": u.ici_utilization_p95,
+        "updatedAt": u.updated_at,
+    }
+
+
+def _utilization_decode(doc: Optional[Dict[str, Any]]) -> Optional[UtilizationSummary]:
+    if not doc:
+        return None
+    return UtilizationSummary(
+        window_seconds=float(doc.get("windowSeconds", 0.0)),
+        samples=int(doc.get("samples", 0)),
+        duty_cycle_p95=float(doc.get("dutyCycleP95", 0.0)),
+        hbm_used_p95_bytes=int(doc.get("hbmUsedP95Bytes", 0)),
+        hbm_total_bytes=int(doc.get("hbmTotalBytes", 0)),
+        ici_utilization_p95=float(doc.get("iciUtilizationP95", 0.0)),
+        updated_at=float(doc.get("updatedAt", 0.0)),
+    )
 
 
 def _alloc_node_name(alloc_doc: Dict[str, Any]) -> str:
@@ -664,6 +700,7 @@ def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
             for c in status.get("reservedFor") or []
         ],
         conditions=_conditions_decode(status.get("conditions") or []),
+        utilization=_utilization_decode(status.get("utilizationSummary")),
     )
 
 
@@ -961,6 +998,8 @@ def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
         }
     if cd.status.mesh_bundle is not None:
         status["meshBundle"] = _meshbundle_encode(cd.status.mesh_bundle)
+    if cd.status.utilization is not None:
+        status["utilizationSummary"] = _utilization_encode(cd.status.utilization)
     if cd.status.conditions:
         status["conditions"] = _conditions_encode(cd.status.conditions)
     return {"spec": spec, "status": status}
@@ -1004,6 +1043,7 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
                 _meshbundle_decode(status["meshBundle"])
                 if status.get("meshBundle") else None
             ),
+            utilization=_utilization_decode(status.get("utilizationSummary")),
             conditions=_conditions_decode(status.get("conditions") or []),
         ),
     )

@@ -70,6 +70,8 @@ REASON_DEVICE_RECOVERED = "DeviceRecovered"
 REASON_REBALANCE_PLANNED = "RebalancePlanned"
 REASON_CLAIM_MIGRATED = "ClaimMigrated"
 REASON_MIGRATION_FAILED = "MigrationFailed"
+# SLO layer (pkg/slo.py burn-rate evaluator)
+REASON_SLO_BURN_RATE = "SLOBurnRate"
 # ComputeDomain controller / daemon
 REASON_MESH_BUNDLE_UPDATED = "MeshBundleUpdated"
 REASON_NODE_JOINED = "NodeJoined"

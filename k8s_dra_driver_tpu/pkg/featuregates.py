@@ -103,6 +103,13 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         "instead of re-running its claim storm.",
     ),
     FeatureSpec(
+        "FleetTelemetry", False, Stage.ALPHA,
+        "Sample per-chip HBM/duty-cycle/power/ICI counters into bounded "
+        "ring-buffer time series, roll them up to per-claim and per-"
+        "ComputeDomain utilization summaries, and evaluate SLO burn-rate "
+        "rules over them.",
+    ),
+    FeatureSpec(
         "LiveRepack", False, Stage.ALPHA,
         "Run the online defragmentation rebalancer: migrate small-subslice "
         "claims (cordon -> checkpoint-aware unprepare -> re-place -> "

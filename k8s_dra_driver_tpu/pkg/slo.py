@@ -1,0 +1,246 @@
+"""SLO recording rules with multi-window burn-rate alerting.
+
+The telemetry plane's top layer: declared objectives are evaluated
+against observation streams (claim p95 duty cycle, domain ICI
+utilization, scheduler time-to-running — anything that produces
+``(time, value)`` pairs), and error-budget *burn rates* are alerted the
+SRE-workbook way: an alert fires only when BOTH windows of a
+(long, short) pair burn above threshold — the long window proves the
+problem is sustained, the short window proves it is still happening, so
+a recovered incident stops alerting immediately and a brief blip never
+alerts at all.
+
+Definitions: a sample is **bad** when its value crosses the objective's
+``bound`` in direction ``op``; the **burn rate** over a window is
+``bad_fraction / (1 - target)`` — burn 1.0 consumes exactly the error
+budget the objective allows, burn N consumes it N times too fast.
+
+Surfaced three ways, one per consumer:
+
+- ``tpu_dra_slo_burn_rate{slo,window}`` — the max effective burn
+  (min of the pair, worst subject) per window pair, for dashboards;
+- ``tpu_dra_slo_violation_minutes_total{slo}`` — budget-burning wall
+  minutes, for reports;
+- ``SLOBurnRate`` warning Events on the violating subject (claim,
+  domain, node), deduplicated by the recorder correlator like every
+  other event in the driver.
+
+Per-subject observation history is deque-bounded by the longest window
+and subject state is LRU-bounded — no unbounded growth, mirroring the
+event correlator's discipline.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.pkg.events import REASON_SLO_BURN_RATE
+
+# Classic SRE pairs (fast burn: 1h/5m, slow burn: 6h/30m). Sim-scale
+# deployments pass their own pairs sized to the virtual clock.
+DEFAULT_WINDOWS: Tuple[Tuple[float, float], ...] = (
+    (3600.0, 300.0),
+    (21600.0, 1800.0),
+)
+DEFAULT_BURN_THRESHOLD = 2.0
+# Hard cap on samples per (slo, subject) — backstop for pathological
+# observe rates; the time-based pruning is the real bound.
+MAX_SAMPLES_PER_SUBJECT = 8192
+MAX_SUBJECTS = 1024
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One declared objective: ``target`` fraction of samples must stay
+    on the good side of ``bound``."""
+
+    name: str
+    description: str = ""
+    target: float = 0.99
+    bound: float = 0.0
+    op: str = "gt"  # value is BAD when value > bound ("gt") / < bound ("lt")
+    windows: Tuple[Tuple[float, float], ...] = DEFAULT_WINDOWS
+    burn_threshold: float = DEFAULT_BURN_THRESHOLD
+
+    def is_bad(self, value: float) -> bool:
+        return value > self.bound if self.op == "gt" else value < self.bound
+
+    def __post_init__(self):
+        if self.op not in ("gt", "lt"):
+            raise ValueError(f"SLO {self.name}: op must be gt|lt, not {self.op!r}")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO {self.name}: target must be in (0, 1)")
+
+
+@dataclass
+class BurnAlert:
+    slo: str
+    subject: Tuple[str, str]        # (namespace, name) or ("", node)
+    burn_rate: float                # effective (min of the firing pair)
+    window: Tuple[float, float]     # the pair that fired
+
+
+@dataclass
+class _SubjectState:
+    samples: Deque[Tuple[float, bool]] = field(
+        default_factory=lambda: deque(maxlen=MAX_SAMPLES_PER_SUBJECT))
+    ref: object = None              # involved-object for the Event
+
+
+class SLOEvaluator:
+    """Evaluates declared objectives over observed sample streams."""
+
+    def __init__(self, metrics_registry, recorder=None,
+                 max_subjects: int = MAX_SUBJECTS):
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge
+
+        self.recorder = recorder
+        self.max_subjects = max_subjects
+        self._mu = threading.Lock()
+        self._objectives: Dict[str, SLObjective] = {}  # tpulint: guarded-by=_mu
+        self._subjects: Dict[Tuple[str, Tuple[str, str]], _SubjectState] = {}  # tpulint: guarded-by=_mu
+        self._last_eval_t: Optional[float] = None  # tpulint: guarded-by=_mu
+        self._window_labels: Dict[Tuple[float, float], str] = {}  # tpulint: guarded-by=_mu
+        r = metrics_registry
+        self.burn_gauge = r.register(Gauge(
+            "tpu_dra_slo_burn_rate",
+            "Max effective error-budget burn rate per SLO and window pair "
+            "(min of the long/short pair, worst subject).",
+            ("slo", "window")))
+        self.violation_minutes = r.register(Counter(
+            "tpu_dra_slo_violation_minutes_total",
+            "Wall minutes an SLO spent burning above its alert threshold.",
+            ("slo",)))
+
+    def add(self, objective: SLObjective) -> SLObjective:
+        with self._mu:
+            self._objectives[objective.name] = objective
+            # Window labels rendered ONCE here: the gauge's label
+            # vocabulary is exactly the declared window pairs (bounded),
+            # never a per-sample formatted value.
+            for pair in objective.windows:
+                self._window_labels.setdefault(
+                    pair, f"{pair[0]:g}/{pair[1]:g}")
+        return objective
+
+    def objectives(self) -> List[SLObjective]:
+        with self._mu:
+            return list(self._objectives.values())
+
+    # -- ingestion -----------------------------------------------------------
+
+    def observe(self, slo: str, now: float, value: float,
+                subject: Tuple[str, str] = ("", ""), ref=None) -> None:
+        """Record one sample for (slo, subject). ``ref`` is the k8s object
+        (or ObjectReference) an alert Event should be filed against."""
+        with self._mu:
+            obj = self._objectives.get(slo)
+            if obj is None:
+                raise KeyError(f"unknown SLO {slo!r}")
+            key = (slo, subject)
+            state = self._subjects.get(key)
+            if state is None:
+                state = self._subjects[key] = _SubjectState()
+                self._trim_subjects_locked()
+            else:
+                # LRU touch.
+                self._subjects[key] = self._subjects.pop(key)
+            if ref is not None:
+                state.ref = ref
+            state.samples.append((now, obj.is_bad(value)))
+            self._prune_locked(obj, state, now)
+
+    def _prune_locked(self, obj: SLObjective, state: _SubjectState,
+                      now: float) -> None:
+        horizon = now - max(w[0] for w in obj.windows)
+        while state.samples and state.samples[0][0] < horizon:
+            state.samples.popleft()
+
+    def _trim_subjects_locked(self) -> None:
+        # tpulint: holds=_mu (LRU evict; every caller holds the lock)
+        while len(self._subjects) > self.max_subjects:
+            self._subjects.pop(next(iter(self._subjects)))
+
+    # -- evaluation ----------------------------------------------------------
+
+    @staticmethod
+    def _burn(samples: Deque[Tuple[float, bool]], now: float,
+              window: float, target: float) -> float:
+        total = bad = 0
+        lo = now - window
+        for t, is_bad in samples:
+            if t >= lo:
+                total += 1
+                bad += is_bad
+        if total == 0:
+            return 0.0
+        return (bad / total) / max(1e-9, 1.0 - target)
+
+    def evaluate(self, now: float) -> List[BurnAlert]:
+        """One evaluation pass: recompute burn rates for every subject,
+        publish the gauges, accumulate violation minutes, and emit one
+        (deduplicated) SLOBurnRate event per currently-alerting subject."""
+        alerts: List[BurnAlert] = []
+        with self._mu:
+            dt_min = ((now - self._last_eval_t) / 60.0
+                      if self._last_eval_t is not None else 0.0)
+            self._last_eval_t = now
+            # Every declared (slo, window) pair publishes EVERY pass,
+            # 0.0 when nothing burns: a subject whose samples aged out
+            # (claim unprepared, incident over) must not leave the last
+            # alert-level burn stuck on /metrics forever.
+            worst: Dict[Tuple[str, Tuple[float, float]], float] = {
+                (name, pair): 0.0
+                for name, obj in self._objectives.items()
+                for pair in obj.windows
+            }
+            burning_slos: Dict[str, bool] = {o: False for o in self._objectives}
+            for (slo, subject), state in self._subjects.items():
+                obj = self._objectives.get(slo)
+                if obj is None or not state.samples:
+                    continue
+                self._prune_locked(obj, state, now)
+                for pair in obj.windows:
+                    long_w, short_w = pair
+                    effective = min(
+                        self._burn(state.samples, now, long_w, obj.target),
+                        self._burn(state.samples, now, short_w, obj.target))
+                    key = (slo, pair)
+                    worst[key] = max(worst.get(key, 0.0), effective)
+                    if effective >= obj.burn_threshold:
+                        burning_slos[slo] = True
+                        alerts.append(BurnAlert(
+                            slo=slo, subject=subject,
+                            burn_rate=effective, window=pair))
+            for (slo, pair), burn in worst.items():
+                self.burn_gauge.set(
+                    slo, self._window_labels[pair], value=burn)
+            for slo, burning in burning_slos.items():
+                if burning and dt_min > 0:
+                    self.violation_minutes.inc(slo, by=dt_min)
+            # Event refs resolved under the lock, emission after release
+            # (the recorder does its own locking + API writes).
+            to_emit = []
+            if self.recorder is not None:
+                seen = set()
+                for a in alerts:
+                    if (a.slo, a.subject) in seen:
+                        continue  # one event per subject even if both pairs fire
+                    seen.add((a.slo, a.subject))
+                    state = self._subjects.get((a.slo, a.subject))
+                    if state is not None and state.ref is not None:
+                        obj = self._objectives[a.slo]
+                        to_emit.append((state.ref, a, obj))
+        for ref, a, obj in to_emit:
+            # Message carries no live numbers: repeats of one sustained
+            # violation must dedup into ONE Event with a rising count.
+            self.recorder.warning(
+                ref, REASON_SLO_BURN_RATE,
+                f"SLO {a.slo}: error budget burning >= "
+                f"{obj.burn_threshold:g}x over the {a.window[0]:g}s/"
+                f"{a.window[1]:g}s windows (objective {obj.target:g}, "
+                f"bound {obj.bound:g} {obj.op})")
+        return alerts

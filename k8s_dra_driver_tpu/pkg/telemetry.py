@@ -1,0 +1,471 @@
+"""Fleet telemetry: bounded time series, window stats, and the rollup
+aggregator joining per-chip samples to claims and ComputeDomains.
+
+Three layers share this module:
+
+- :class:`RingSeries` / :class:`WindowStats` — the node agent's bounded
+  per-chip ring buffers (fixed-size arrays, last N samples, min/max/mean/
+  p95 over the window; no unbounded growth anywhere).
+- :class:`TelemetryAggregator` — the control-plane rollup: joins node
+  samples against each node's prepared-claim → chip-set mapping into
+  per-claim and per-ComputeDomain gauges (``tpu_dra_claim_*``,
+  ``tpu_dra_domain_ici_utilization``) and writes quantized, change-gated
+  :class:`~k8s_dra_driver_tpu.k8s.core.UtilizationSummary` docs onto
+  ResourceClaim and ComputeDomain status. Gauge label sets are bounded:
+  series key on claim *name*+namespace (never uids) and are LRU-bounded
+  + forgotten when the claim stops being prepared, the same discipline
+  the event correlator applies to its per-object state.
+- ``parse_metrics_text`` — the mini exposition parser ``tpu-kubectl top
+  nodes`` uses to read per-chip gauges off a /metrics scrape (the same
+  grammar the scrape-parser test fixture pins).
+
+The aggregator issues ZERO store ``list()`` calls per rollup pass: claim
+targets come from the node views (checkpoint mirrors), domain membership
+rides a watch-fed cache bootstrapped once at construction — the
+``bench_telemetry`` 1024-node gate pins that invariant.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    RESOURCE_CLAIM,
+    UtilizationSummary,
+)
+from k8s_dra_driver_tpu.k8s.objects import ConflictError, NotFoundError
+from k8s_dra_driver_tpu.tpulib.loadtrace import percentile
+
+# Defaults. 120 samples at the sim's 1 s virtual tick = a 2-minute window;
+# a real node at 10 s intervals sees 20 minutes.
+DEFAULT_WINDOW_SAMPLES = 120
+# Quantization steps: steady load must round to the SAME summary pass
+# after pass, so status writes (and watch fan-out) happen only on real
+# movement. Duty/ICI in 1% steps, HBM in 64 MiB steps.
+DUTY_QUANTUM = 0.01
+HBM_QUANTUM_BYTES = 64 << 20
+# Aggregator keeps per-claim/domain gauge + change-gate state for at most
+# this many objects (LRU evict beyond it, like the event correlator).
+MAX_TRACKED_OBJECTS = 4096
+
+
+@dataclass(frozen=True)
+class WindowStats:
+    """Summary statistics over one ring window."""
+
+    count: int = 0
+    last: float = 0.0
+    min: float = 0.0
+    max: float = 0.0
+    mean: float = 0.0
+    p95: float = 0.0
+    span_seconds: float = 0.0   # newest sample time - oldest
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"count": self.count, "last": self.last, "min": self.min,
+                "max": self.max, "mean": self.mean, "p95": self.p95,
+                "span_seconds": self.span_seconds}
+
+    @staticmethod
+    def from_dict(doc: Dict[str, float]) -> "WindowStats":
+        return WindowStats(
+            count=int(doc.get("count", 0)), last=float(doc.get("last", 0.0)),
+            min=float(doc.get("min", 0.0)), max=float(doc.get("max", 0.0)),
+            mean=float(doc.get("mean", 0.0)), p95=float(doc.get("p95", 0.0)),
+            span_seconds=float(doc.get("span_seconds", 0.0)))
+
+
+class RingSeries:
+    """Fixed-capacity (time, value) ring. Push is O(1); ``stats()`` walks
+    only the ring (bounded) with the running sum kept streaming so the
+    mean never rescans. NOT thread-safe — owners serialize access under
+    their own telemetry lock (the sampler's contract: that lock is never
+    one the prepare paths hold)."""
+
+    __slots__ = ("cap", "_times", "_values", "_n", "_idx", "_sum")
+
+    def __init__(self, cap: int = DEFAULT_WINDOW_SAMPLES):
+        if cap <= 0:
+            raise ValueError("ring capacity must be positive")
+        self.cap = cap
+        self._times = [0.0] * cap
+        self._values = [0.0] * cap
+        self._n = 0
+        self._idx = 0
+        self._sum = 0.0
+
+    def push(self, t: float, v: float) -> None:
+        if self._n == self.cap:
+            self._sum -= self._values[self._idx]
+        else:
+            self._n += 1
+        self._times[self._idx] = t
+        self._values[self._idx] = float(v)
+        self._sum += float(v)
+        self._idx = (self._idx + 1) % self.cap
+
+    def __len__(self) -> int:
+        return self._n
+
+    def values(self) -> List[float]:
+        """Window contents, oldest first."""
+        if self._n < self.cap:
+            return self._values[:self._n]
+        return self._values[self._idx:] + self._values[:self._idx]
+
+    def times(self) -> List[float]:
+        if self._n < self.cap:
+            return self._times[:self._n]
+        return self._times[self._idx:] + self._times[:self._idx]
+
+    def stats(self) -> WindowStats:
+        if self._n == 0:
+            return WindowStats()
+        vals = self.values()
+        ts = self.times()
+        return WindowStats(
+            count=self._n, last=vals[-1], min=min(vals), max=max(vals),
+            mean=self._sum / self._n, p95=percentile(vals, 0.95),
+            span_seconds=max(0.0, ts[-1] - ts[0]))
+
+
+# -- quantization -------------------------------------------------------------
+
+
+def quantize_summary(s: UtilizationSummary,
+                     duty_quantum: float = DUTY_QUANTUM,
+                     hbm_quantum: int = HBM_QUANTUM_BYTES) -> UtilizationSummary:
+    """Round a summary onto the write grid: two summaries of the same
+    steady load are equal after quantization, so the change gate holds
+    status writes at zero."""
+    def qf(v: float, q: float) -> float:
+        return round(round(v / q) * q, 6)
+
+    return replace(
+        s,
+        # window_seconds/samples are display metadata, excluded from the
+        # dataclass equality the change gate compares (they grow every
+        # tick while the ring fills); rounded here only so the doc the
+        # gate DOES write carries stable-looking values.
+        window_seconds=float(int(round(s.window_seconds))),
+        duty_cycle_p95=qf(s.duty_cycle_p95, duty_quantum),
+        ici_utilization_p95=qf(s.ici_utilization_p95, duty_quantum),
+        hbm_used_p95_bytes=int(round(s.hbm_used_p95_bytes / hbm_quantum))
+        * hbm_quantum,
+    )
+
+
+# -- node views ---------------------------------------------------------------
+
+
+@dataclass
+class ClaimChips:
+    """One prepared claim on one node: the join key the rollup uses."""
+
+    uid: str
+    name: str
+    namespace: str
+    chips: Tuple[int, ...]
+
+
+@dataclass
+class NodeView:
+    """Everything the aggregator needs from one node for one pass: the
+    monitor's window stats (per chip, per signal) and the prepared-claim
+    → chip-set mapping. Built from in-memory snapshots — never from store
+    scans or the checkpoint flock."""
+
+    node: str
+    duty: Dict[int, WindowStats] = field(default_factory=dict)
+    hbm_used: Dict[int, WindowStats] = field(default_factory=dict)
+    hbm_total: Dict[int, int] = field(default_factory=dict)
+    link_util: WindowStats = field(default_factory=WindowStats)
+    claims: List[ClaimChips] = field(default_factory=list)
+
+
+def _mean(vals: Iterable[float]) -> float:
+    vals = list(vals)
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+@dataclass
+class RollupResult:
+    claims_seen: int = 0
+    domains_seen: int = 0
+    status_writes: int = 0
+    duration_s: float = 0.0
+
+
+class TelemetryAggregator:
+    """Per-claim / per-ComputeDomain rollup over node telemetry views.
+
+    ``rollup(now, views)`` is one aggregation pass; call it from the sim's
+    telemetry pass or a controller loop. Claim and domain gauges key on
+    (namespace, name) — bounded vocabularies — and are forgotten as soon
+    as the object leaves the prepared set (plus an LRU cap as backstop)."""
+
+    def __init__(self, api, metrics_registry,
+                 max_tracked: int = MAX_TRACKED_OBJECTS,
+                 duty_quantum: float = DUTY_QUANTUM,
+                 hbm_quantum: int = HBM_QUANTUM_BYTES):
+        from k8s_dra_driver_tpu.pkg.metrics import Gauge
+
+        self.api = api
+        self.duty_quantum = duty_quantum
+        self.hbm_quantum = hbm_quantum
+        self.max_tracked = max_tracked
+        r = metrics_registry
+        self.claim_hbm = r.register(Gauge(
+            "tpu_dra_claim_hbm_used_bytes",
+            "HBM bytes in use across a prepared claim's chips.",
+            ("namespace", "name")))
+        self.claim_duty = r.register(Gauge(
+            "tpu_dra_claim_duty_cycle",
+            "Mean compute duty cycle across a prepared claim's chips (0-1).",
+            ("namespace", "name")))
+        self.domain_ici = r.register(Gauge(
+            "tpu_dra_domain_ici_utilization",
+            "Mean ICI link utilization across a ComputeDomain's member "
+            "hosts (0-1).",
+            ("namespace", "name")))
+        self.rollup_seconds = r.register(Gauge(
+            "tpu_dra_telemetry_rollup_seconds",
+            "Wall time of the last telemetry aggregation pass."))
+        self.rollup_status_writes = r.register(Gauge(
+            "tpu_dra_telemetry_status_writes",
+            "Status CAS writes issued by the last rollup pass (change-"
+            "gated: 0 at steady load)."))
+        # Change gates: (ns, name) -> last quantized summary written (or
+        # observed on the object), LRU-ordered dicts bounded at max_tracked.
+        self._written_claims: Dict[Tuple[str, str], UtilizationSummary] = {}
+        self._written_domains: Dict[Tuple[str, str], UtilizationSummary] = {}
+        # Watch-fed domain membership cache: (ns, name) -> member node
+        # names. One bootstrap listing at construction; after that, only
+        # watch events mutate it — rollup passes never list().
+        self._domains: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+        self._domain_watch = api.watch(COMPUTE_DOMAIN, maxsize=65536)
+        for cd in api.list(COMPUTE_DOMAIN):
+            self._ingest_domain("ADDED", cd)
+        self.total_status_writes = 0  # lifetime counter, bench/test hook
+
+    def close(self) -> None:
+        self.api.stop_watch(COMPUTE_DOMAIN, self._domain_watch)
+
+    def claim_summaries(self) -> Dict[Tuple[str, str], UtilizationSummary]:
+        """(namespace, name) -> last quantized summary per tracked claim —
+        what the SLO recording rules consume each pass."""
+        return dict(self._written_claims)
+
+    def domain_summaries(self) -> Dict[Tuple[str, str], UtilizationSummary]:
+        return dict(self._written_domains)
+
+    # -- domain cache --------------------------------------------------------
+
+    def _ingest_domain(self, ev_type: str, cd) -> None:
+        key = (cd.meta.namespace, cd.meta.name)
+        if ev_type == "DELETED":
+            self._domains.pop(key, None)
+            self._written_domains.pop(key, None)
+            self.domain_ici.forget_matching(namespace=key[0], name=key[1])
+            return
+        placement = getattr(cd.status, "placement", None)
+        if placement is not None and placement.nodes:
+            members = tuple(placement.nodes)
+        else:
+            members = tuple(n.name for n in cd.status.nodes)
+        self._domains[key] = members
+
+    def _drain_domain_watch(self) -> None:
+        import queue as _q
+
+        while True:
+            try:
+                ev = self._domain_watch.get_nowait()
+            except _q.Empty:
+                return
+            self._ingest_domain(ev.type, ev.obj)
+
+    # -- rollup --------------------------------------------------------------
+
+    def rollup(self, now: float, views: List[NodeView]) -> RollupResult:
+        t0 = time.perf_counter()
+        self._drain_domain_watch()
+        res = RollupResult()
+        by_node = {v.node: v for v in views}
+
+        # Per-claim rollup: a claim's chips live on exactly one node.
+        seen_claims = set()
+        for view in views:
+            for cc in view.claims:
+                key = (cc.namespace, cc.name)
+                duty = [view.duty[i] for i in cc.chips if i in view.duty]
+                hbm = [view.hbm_used[i] for i in cc.chips if i in view.hbm_used]
+                if not duty or not hbm:
+                    continue  # no telemetry yet for these chips
+                seen_claims.add(key)
+                res.claims_seen += 1
+                duty_mean = _mean(s.last for s in duty)
+                hbm_last = sum(s.last for s in hbm)
+                self.claim_duty.set(cc.namespace, cc.name, value=duty_mean)
+                self.claim_hbm.set(cc.namespace, cc.name, value=hbm_last)
+                summary = UtilizationSummary(
+                    window_seconds=_mean(s.span_seconds for s in duty),
+                    samples=min(s.count for s in duty),
+                    duty_cycle_p95=_mean(s.p95 for s in duty),
+                    hbm_used_p95_bytes=int(sum(s.p95 for s in hbm)),
+                    hbm_total_bytes=sum(
+                        view.hbm_total.get(i, 0) for i in cc.chips),
+                    updated_at=now,
+                )
+                res.status_writes += self._write_claim(key, summary)
+
+        # Per-domain rollup over member hosts present in this pass's views.
+        seen_domains = set()
+        for key, members in self._domains.items():
+            mviews = [by_node[m] for m in members if m in by_node]
+            if not mviews:
+                continue
+            all_duty = [s for v in mviews for s in v.duty.values()]
+            if not all_duty or all(s.count == 0 for s in all_duty):
+                continue
+            seen_domains.add(key)
+            res.domains_seen += 1
+            ici_last = _mean(v.link_util.last for v in mviews)
+            self.domain_ici.set(key[0], key[1], value=ici_last)
+            summary = UtilizationSummary(
+                window_seconds=_mean(s.span_seconds for s in all_duty),
+                samples=min(s.count for s in all_duty),
+                duty_cycle_p95=_mean(s.p95 for s in all_duty),
+                hbm_used_p95_bytes=int(sum(
+                    s.p95 for v in mviews for s in v.hbm_used.values())),
+                hbm_total_bytes=sum(
+                    t for v in mviews for t in v.hbm_total.values()),
+                ici_utilization_p95=_mean(v.link_util.p95 for v in mviews),
+                updated_at=now,
+            )
+            res.status_writes += self._write_domain(key, summary)
+
+        self._forget_stale(self._written_claims, seen_claims,
+                           (self.claim_duty, self.claim_hbm))
+        self._lru_trim(self._written_claims)
+        self._lru_trim(self._written_domains)
+        res.duration_s = time.perf_counter() - t0
+        self.rollup_seconds.set(value=res.duration_s)
+        self.rollup_status_writes.set(value=float(res.status_writes))
+        self.total_status_writes += res.status_writes
+        return res
+
+    # -- write paths ---------------------------------------------------------
+
+    def _write_claim(self, key: Tuple[str, str],
+                     summary: UtilizationSummary) -> int:
+        q = quantize_summary(summary, self.duty_quantum, self.hbm_quantum)
+        prev = self._written_claims.get(key)
+        if prev is not None:
+            # LRU touch.
+            self._written_claims.pop(key, None)
+        self._written_claims[key] = q
+        if prev == q:
+            return 0
+
+        def mutate(obj, s=q):
+            obj.utilization = s
+
+        try:
+            self.api.update_with_retry(RESOURCE_CLAIM, key[1], key[0], mutate)
+        except (NotFoundError, ConflictError):
+            self._written_claims.pop(key, None)
+            return 0
+        return 1
+
+    def _write_domain(self, key: Tuple[str, str],
+                      summary: UtilizationSummary) -> int:
+        q = quantize_summary(summary, self.duty_quantum, self.hbm_quantum)
+        prev = self._written_domains.get(key)
+        if prev is not None:
+            self._written_domains.pop(key, None)
+        self._written_domains[key] = q
+        if prev == q:
+            return 0
+
+        def mutate(obj, s=q):
+            obj.status.utilization = s
+
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, key[1], key[0], mutate)
+        except (NotFoundError, ConflictError):
+            self._written_domains.pop(key, None)
+            return 0
+        return 1
+
+    def _forget_stale(self, written: Dict, seen: set, gauges) -> None:
+        for key in [k for k in written if k not in seen]:
+            written.pop(key, None)
+            for g in gauges:
+                g.forget_matching(namespace=key[0], name=key[1])
+
+    def _lru_trim(self, written: Dict) -> None:
+        while len(written) > self.max_tracked:
+            written.pop(next(iter(written)))
+
+
+# -- exposition parsing (tpu-kubectl top nodes) -------------------------------
+
+
+def parse_metrics_text(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Parse Prometheus text exposition into
+    ``{metric: {((label, value), ...): sample}}`` — the subset the mini
+    scrape-parser fixture pins (HELP/TYPE skipped, escaped label values
+    unescaped)."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            labels_raw, _, value_raw = rest.rpartition("}")
+            labels = tuple(sorted(_parse_labels(labels_raw)))
+        else:
+            name, _, value_raw = line.partition(" ")
+            labels = ()
+        try:
+            value = float(value_raw.strip().split()[0])
+        except (ValueError, IndexError):
+            continue
+        out.setdefault(name.strip(), {})[labels] = value
+    return out
+
+
+def _parse_labels(raw: str) -> List[Tuple[str, str]]:
+    labels: List[Tuple[str, str]] = []
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.find("=", i)
+        if eq < 0:
+            break
+        key = raw[i:eq].strip().lstrip(",").strip()
+        j = eq + 1
+        if j >= n or raw[j] != '"':
+            break
+        j += 1
+        buf = []
+        while j < n:
+            c = raw[j]
+            if c == "\\" and j + 1 < n:
+                nxt = raw[j + 1]
+                buf.append("\n" if nxt == "n" else nxt)
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        labels.append((key, "".join(buf)))
+        i = j + 1
+    return labels

@@ -119,6 +119,14 @@ HEALTH_GAUGE_VALUES = {
     ChipHealth.UNHEALTHY: 2.0,
 }
 
+# Telemetry-derived link degradation: a link whose error-counter RATE
+# (errors/s, window mean) crosses the threshold is reported DEGRADED into
+# the existing taint machinery; it heals (back to HEALTHY) only after the
+# rate falls below the hysteresis floor, so a rate hovering at the
+# threshold doesn't flap taints every sample.
+LINK_DEGRADE_ERRORS_PER_S = 1.0
+LINK_HEAL_ERRORS_PER_S = 0.5
+
 
 def link_id(a: int, b: int) -> str:
     """Stable per-host id for the ICI link between two local chips."""
@@ -149,8 +157,13 @@ class DeviceHealthMonitor:
     scraper sees the failed link, not just its downstream taints."""
 
     def __init__(self, node_name: str, allocatable: Dict[str, "AllocatableDevice"],
-                 metrics_registry=None):
-        from k8s_dra_driver_tpu.pkg.metrics import Gauge, Registry
+                 metrics_registry=None, tpulib=None,
+                 hbm_by_chip: Optional[Dict[int, int]] = None,
+                 link_gbps: float = 45.0,
+                 window_samples: Optional[int] = None,
+                 state_path: Optional[str] = None):
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
+        from k8s_dra_driver_tpu.pkg.telemetry import DEFAULT_WINDOW_SAMPLES
 
         self.node_name = node_name
         self._allocatable = allocatable
@@ -163,6 +176,54 @@ class DeviceHealthMonitor:
             "(0=healthy, 1=degraded, 2=unhealthy).",
             ("node", "kind", "id"),
         ))
+        # -- telemetry sampling state ------------------------------------
+        # All sampling state lives under its own mutex: sample() never
+        # takes the DeviceState mutex, the pu flock, or the checkpoint
+        # flock, so a slow prepare batch can never stall the sampler (nor
+        # the reverse).
+        self.tpulib = tpulib
+        self._hbm_by_chip = dict(hbm_by_chip or {})
+        self._link_gbps = link_gbps
+        self._tel_mu = threading.Lock()
+        self._window = window_samples or DEFAULT_WINDOW_SAMPLES
+        self._state_path = state_path
+        self.state_save_interval_s = 30.0
+        self._last_state_save: Optional[float] = None
+        self._duty_series: Dict[int, "RingSeries"] = {}  # tpulint: guarded-by=_tel_mu
+        self._hbm_series: Dict[int, "RingSeries"] = {}  # tpulint: guarded-by=_tel_mu
+        self._power_series: Dict[int, "RingSeries"] = {}  # tpulint: guarded-by=_tel_mu
+        self._link_util_series: Optional["RingSeries"] = None  # tpulint: guarded-by=_tel_mu
+        self._link_err_rate: Dict[Tuple[int, int], "RingSeries"] = {}  # tpulint: guarded-by=_tel_mu
+        self._last_link_counters: Dict[Tuple[int, int], Tuple[int, int, int]] = {}  # tpulint: guarded-by=_tel_mu
+        self._last_sample_t: Optional[float] = None  # tpulint: guarded-by=_tel_mu
+        # Lock-free publish for the prepare path: sample() swaps in a
+        # fresh immutable snapshot (atomic attribute store under the
+        # GIL), so last_sample() never touches _tel_mu — a mid-sample
+        # prepare batch must not wait out a 192-series ring update just
+        # to stamp span attributes.
+        self._last_snapshot: Dict[str, Dict[int, float]] = {"duty": {}, "hbm": {}}
+        self._seeded_stats: Dict[str, Dict] = {}  # tpulint: guarded-by=_tel_mu
+        self._telemetry_degraded: set = set()  # tpulint: guarded-by=_tel_mu
+        self.samples_taken = 0
+        self.chip_hbm_used = registry.register(Gauge(
+            "tpu_dra_chip_hbm_used_bytes",
+            "HBM bytes in use per chip (last sample).", ("node", "chip")))
+        self.chip_duty = registry.register(Gauge(
+            "tpu_dra_chip_duty_cycle",
+            "Compute duty cycle per chip, 0-1 (last sample).",
+            ("node", "chip")))
+        self.chip_power = registry.register(Gauge(
+            "tpu_dra_chip_power_watts",
+            "Power draw per chip in watts (last sample).", ("node", "chip")))
+        self.ici_tx = registry.register(Counter(
+            "tpu_dra_ici_link_tx_total",
+            "Cumulative ICI link transmit bytes.", ("node", "link")))
+        self.ici_rx = registry.register(Counter(
+            "tpu_dra_ici_link_rx_total",
+            "Cumulative ICI link receive bytes.", ("node", "link")))
+        self.ici_errors = registry.register(Counter(
+            "tpu_dra_ici_link_errors_total",
+            "Cumulative ICI link error count.", ("node", "link")))
 
     # -- transitions ---------------------------------------------------------
 
@@ -229,6 +290,231 @@ class DeviceHealthMonitor:
             name for name, dev in self._allocatable.items()
             if a in dev.chip_indices and b in dev.chip_indices
         )
+
+    # -- telemetry sampling ---------------------------------------------------
+
+    def sample(self, now: Optional[float] = None) -> List[HealthDelta]:
+        """Take one telemetry sample: read tpulib counters, push the ring
+        buffers, publish the per-chip gauges and link counters, and
+        threshold link error rates into DEGRADED/HEALTHY transitions
+        (returned as HealthDeltas for the driver's taint/event chain).
+
+        Never blocks on prepare-path locks — only ``_tel_mu`` and the
+        tpulib's own counter lock are taken. A tpulib without counters
+        (or one returning []) is a no-op."""
+        if self.tpulib is None or not hasattr(self.tpulib, "read_counters"):
+            return []
+        counters = self.tpulib.read_counters(now=now)
+        if not counters:
+            return []
+        transitions: List[Tuple[Tuple[int, int], ChipHealth]] = []
+        with self._tel_mu:
+            from k8s_dra_driver_tpu.pkg.telemetry import RingSeries
+
+            t = counters[0].timestamp
+            dt = (t - self._last_sample_t
+                  if self._last_sample_t is not None else 0.0)
+            self._last_sample_t = t
+            link_utils: List[float] = []
+            for c in counters:
+                for series_map, value in (
+                    (self._duty_series, c.duty_cycle),
+                    (self._hbm_series, float(c.hbm_used_bytes)),
+                    (self._power_series, c.power_watts),
+                ):
+                    series_map.setdefault(
+                        c.index, RingSeries(self._window)).push(t, value)
+                self.chip_duty.set(self.node_name, str(c.index),
+                                   value=c.duty_cycle)
+                self.chip_hbm_used.set(self.node_name, str(c.index),
+                                       value=float(c.hbm_used_bytes))
+                self.chip_power.set(self.node_name, str(c.index),
+                                    value=c.power_watts)
+                if c.hbm_total_bytes:
+                    self._hbm_by_chip.setdefault(c.index, c.hbm_total_bytes)
+                for lc in c.links:
+                    key = (min(lc.a, lc.b), max(lc.a, lc.b))
+                    lid = link_id(lc.a, lc.b)
+                    prev = self._last_link_counters.get(key)
+                    self._last_link_counters[key] = (
+                        lc.tx_bytes, lc.rx_bytes, lc.errors)
+                    if prev is None or dt <= 0:
+                        continue
+                    d_tx = max(0, lc.tx_bytes - prev[0])
+                    d_err = max(0, lc.errors - prev[2])
+                    self.ici_tx.inc(self.node_name, lid, by=float(d_tx))
+                    self.ici_rx.inc(self.node_name, lid,
+                                    by=float(max(0, lc.rx_bytes - prev[1])))
+                    self.ici_errors.inc(self.node_name, lid, by=float(d_err))
+                    cap_bps = self._link_gbps * 1e9 / 8.0
+                    link_utils.append(min(1.0, (d_tx / dt) / cap_bps)
+                                      if cap_bps else 0.0)
+                    err_series = self._link_err_rate.setdefault(
+                        key, RingSeries(self._window))
+                    err_series.push(t, d_err / dt)
+                    rate = err_series.stats().mean
+                    degraded = key in self._telemetry_degraded
+                    if not degraded and rate > LINK_DEGRADE_ERRORS_PER_S:
+                        self._telemetry_degraded.add(key)
+                        transitions.append((key, ChipHealth.DEGRADED))
+                    elif degraded and rate < LINK_HEAL_ERRORS_PER_S:
+                        self._telemetry_degraded.discard(key)
+                        transitions.append((key, ChipHealth.HEALTHY))
+            if link_utils:
+                if self._link_util_series is None:
+                    self._link_util_series = RingSeries(self._window)
+                self._link_util_series.push(
+                    t, sum(link_utils) / len(link_utils))
+            self.samples_taken += 1
+        self._last_snapshot = {
+            "duty": {c.index: c.duty_cycle for c in counters},
+            "hbm": {c.index: float(c.hbm_used_bytes) for c in counters},
+        }
+        deltas = []
+        for (a, b), health in transitions:
+            # A link the fabric already reported broken stays whatever the
+            # watcher said; telemetry only drives its own degradations.
+            # BOTH directions skip: a DEGRADED write would downgrade the
+            # UNHEALTHY ledger entry, after which the error rate falling
+            # would clear a link the fabric still reports dead. The
+            # rate bookkeeping is undone so the degradation re-applies
+            # if the fabric later heals while the rate is still high.
+            if self._links.get((a, b)) == ChipHealth.UNHEALTHY:
+                if health == ChipHealth.DEGRADED:
+                    with self._tel_mu:
+                        self._telemetry_degraded.discard((a, b))
+                continue
+            delta = self.set_link(a, b, health)
+            if delta is not None:
+                deltas.append(delta)
+        return deltas
+
+    def window_stats(self) -> Dict[str, Dict[int, "WindowStats"]]:
+        """Snapshot of per-chip window statistics by signal — the rollup
+        aggregator's input. Falls back to restart-seeded stats until the
+        first live sample, so gauges and rollups never report zero just
+        because the plugin restarted mid-window."""
+        from k8s_dra_driver_tpu.pkg.telemetry import WindowStats
+
+        with self._tel_mu:
+            if not self._duty_series and self._seeded_stats:
+                return {
+                    sig: {int(i): WindowStats.from_dict(d)
+                          for i, d in per_chip.items()}
+                    for sig, per_chip in self._seeded_stats.items()
+                    if sig in ("duty", "hbm", "power")
+                }
+            return {
+                "duty": {i: s.stats() for i, s in self._duty_series.items()},
+                "hbm": {i: s.stats() for i, s in self._hbm_series.items()},
+                "power": {i: s.stats() for i, s in self._power_series.items()},
+            }
+
+    def last_sample(self) -> Dict[str, Dict[int, float]]:
+        """Last-sampled duty/HBM per chip — the prepare-path span
+        attributes' read. LOCK-FREE: reads the immutable snapshot
+        sample() swaps in, so a prepare batch never waits on a sample
+        in flight (bench_telemetry's 5% storm gate is exactly this
+        edge); falls back to the restart seed before the first live
+        sample."""
+        snap = self._last_snapshot
+        if snap["duty"]:
+            return snap
+        seeded = self._seeded_stats
+        if seeded:
+            return {
+                "duty": {int(i): float(d.get("last", 0.0))
+                         for i, d in seeded.get("duty", {}).items()},
+                "hbm": {int(i): float(d.get("last", 0.0))
+                        for i, d in seeded.get("hbm", {}).items()},
+            }
+        return snap
+
+    def link_utilization(self) -> "WindowStats":
+        from k8s_dra_driver_tpu.pkg.telemetry import WindowStats
+
+        with self._tel_mu:
+            if self._link_util_series is not None:
+                return self._link_util_series.stats()
+            seeded = self._seeded_stats.get("link_util")
+            if seeded:
+                return WindowStats.from_dict(seeded)
+            return WindowStats()
+
+    def hbm_totals(self) -> Dict[int, int]:
+        with self._tel_mu:
+            return dict(self._hbm_by_chip)
+
+    # -- restart re-seed ------------------------------------------------------
+
+    def telemetry_state(self) -> Dict:
+        """Window metadata worth surviving a restart: last per-chip window
+        stats + link utilization. Ring contents are NOT persisted (they
+        refill within one window); what matters is that gauges and
+        rollups keep reporting last-known values instead of zero."""
+        with self._tel_mu:
+            doc: Dict = {"t": self._last_sample_t}
+            for sig, series in (("duty", self._duty_series),
+                                ("hbm", self._hbm_series),
+                                ("power", self._power_series)):
+                doc[sig] = {str(i): s.stats().as_dict()
+                            for i, s in series.items()}
+            if self._link_util_series is not None:
+                doc["link_util"] = self._link_util_series.stats().as_dict()
+            return doc
+
+    def save_telemetry_state(self, force: bool = False) -> None:
+        """Persist the restart seed — throttled: the seed only has to be
+        fresh to within one save interval (a restart then re-publishes
+        values at most that stale), so the sampling loop doesn't pay a
+        JSON dump + rename every tick."""
+        if not self._state_path:
+            return
+        now = time.monotonic()
+        if not force and self._last_state_save is not None and \
+                now - self._last_state_save < self.state_save_interval_s:
+            return
+        self._last_state_save = now
+        import json
+
+        doc = self.telemetry_state()
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._state_path)
+
+    def load_telemetry_state(self) -> bool:
+        """Re-seed window metadata from the persisted file (plugin
+        restart): republishes the per-chip gauges at their last-known
+        values and keeps window_stats() serving the previous window until
+        live samples replace it. Returns True when a seed was loaded."""
+        if not self._state_path or not os.path.exists(self._state_path):
+            return False
+        import json
+
+        try:
+            with open(self._state_path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            log.warning("unreadable telemetry seed %s; starting cold",
+                        self._state_path)
+            return False
+        with self._tel_mu:
+            self._seeded_stats = {
+                sig: doc.get(sig, {}) for sig in ("duty", "hbm", "power")
+            }
+            if doc.get("link_util"):
+                self._seeded_stats["link_util"] = doc["link_util"]
+        for chip, stats in (doc.get("duty") or {}).items():
+            self.chip_duty.set(self.node_name, str(chip),
+                               value=float(stats.get("last", 0.0)))
+        for chip, stats in (doc.get("hbm") or {}).items():
+            self.chip_hbm_used.set(self.node_name, str(chip),
+                                   value=float(stats.get("last", 0.0)))
+        for chip, stats in (doc.get("power") or {}).items():
+            self.chip_power.set(self.node_name, str(chip),
+                                value=float(stats.get("last", 0.0)))
+        return True
 
 
 @dataclass
@@ -302,6 +588,15 @@ class DeviceState:
                 )
             self.partitions = PartitionManager(host_topology, client)
         self._mutex = threading.Lock()
+        # In-memory mirror of the PREPARE_COMPLETED claim -> chip-set map,
+        # under its OWN lock so telemetry rollup reads it without touching
+        # the checkpoint flock or the prepare mutex (sampling must never
+        # wait on a prepare batch). Whole-entry replacement keeps every
+        # snapshot internally consistent — a reader sees a claim's full
+        # chip set or nothing, never a torn half (tpusan scenario
+        # telemetry-sample-vs-prepare pins this).
+        self._claims_mu = threading.Lock()
+        self._claim_chips: Dict[str, Tuple[str, str, Tuple[int, ...]]] = {}  # tpulint: guarded-by=_claims_mu
         # Crash-injection seam for the batched pipeline (see FAULT_* above).
         self.fault_hook: Optional[Callable[[str], None]] = None
         # Observability seam: called with the stale PreparedClaim entry
@@ -331,6 +626,36 @@ class DeviceState:
             dropped = self.sharing.reconcile(completed)
         if dropped:
             log.warning("dropped %d orphaned sharing record(s) at startup", dropped)
+        # Seed the telemetry mirror (and the mock's workload registry) from
+        # the surviving checkpoint: restart must not zero per-claim load.
+        for uid, entry in self._store.get().claims.items():
+            if entry.state == PREPARE_COMPLETED:
+                chips = tuple(sorted(
+                    {i for d in entry.devices for i in d.chip_indices}))
+                self._note_claim_telemetry(
+                    uid, entry.name, entry.namespace, chips)
+
+    # -- telemetry join surface ----------------------------------------------
+
+    def _note_claim_telemetry(self, uid: str, name: str, namespace: str,
+                              chips: Tuple[int, ...]) -> None:
+        with self._claims_mu:
+            self._claim_chips[uid] = (name, namespace, tuple(sorted(chips)))
+        if hasattr(self.tpulib, "register_workload"):
+            self.tpulib.register_workload(uid, chips)
+
+    def _drop_claim_telemetry(self, uid: str) -> None:
+        with self._claims_mu:
+            self._claim_chips.pop(uid, None)
+        if hasattr(self.tpulib, "unregister_workload"):
+            self.tpulib.unregister_workload(uid)
+
+    def prepared_chipsets(self) -> Dict[str, Tuple[str, str, Tuple[int, ...]]]:
+        """uid -> (name, namespace, chips) for every PREPARE_COMPLETED
+        claim — the rollup aggregator's join key, served from the mirror
+        (no checkpoint load, no flock)."""
+        with self._claims_mu:
+            return dict(self._claim_chips)
 
     def _get_checkpoint(self) -> Checkpoint:
         return self._store.get()
@@ -391,6 +716,7 @@ class DeviceState:
                                 "claim %s has a stale PrepareStarted entry; rolling back", uid)
                             self._rollback(entry)
                             del cp.claims[uid]
+                            self._drop_claim_telemetry(uid)
                             dirty = True
                             if self.recovery_hook is not None:
                                 self.recovery_hook(entry)
@@ -406,6 +732,7 @@ class DeviceState:
                                      "entry; clearing and re-preparing", uid)
                             self._rollback(entry)
                             del cp.claims[uid]
+                            self._drop_claim_telemetry(uid)
                             dirty = True
                         requested = self._allocated_device_names(claim)
                         want = self._validate_no_overlap(cp, uid, requested)
@@ -508,6 +835,9 @@ class DeviceState:
                     entry.devices = got
                     entry.state = PREPARE_COMPLETED
                     entry.completed_at = time.time()
+                    self._note_claim_telemetry(
+                        uid, claim.name, claim.namespace,
+                        tuple(sorted({i for d in got for i in d.chip_indices})))
                     out[uid] = PrepareResult(
                         claim_uid=uid,
                         cdi_device_ids=[i for d in got for i in d.cdi_device_ids],
@@ -549,6 +879,7 @@ class DeviceState:
                         self._rollback(entry)
                         self.cdi.delete_claim_spec_file(uid)
                         del cp.claims[uid]
+                        self._drop_claim_telemetry(uid)
                         dirty = True
                         out[uid] = None
                     except Exception as e:  # noqa: BLE001 — per-claim contract
@@ -595,6 +926,7 @@ class DeviceState:
                 self._fire_fault(FAULT_MIGRATION_CHECKPOINTED)
                 self._rollback(entry)
                 self.cdi.delete_claim_spec_file(claim_uid)
+                self._drop_claim_telemetry(claim_uid)
                 return replace(entry, devices=list(entry.devices))
 
     def end_migration(self, claim_uid: str) -> None:

@@ -72,6 +72,7 @@ class TpuDriver:
         driver_name: str = TPU_DRIVER_NAME,
         ignored_health_states: frozenset = frozenset(),
         vfio=None,
+        telemetry_interval_s: float = 0.0,
     ):
         self.api = api
         self.node_name = node_name
@@ -85,9 +86,20 @@ class TpuDriver:
         self.metrics = DRARequestMetrics(driver=driver_name, registry=registry)
         self.recorder = EventRecorder(api, "tpu-kubelet-plugin",
                                       metrics_registry=registry)
+        inv = self.state.inventory
         self.health = DeviceHealthMonitor(
             node_name, self.state.allocatable, metrics_registry=registry,
+            tpulib=tpulib,
+            hbm_by_chip={c.index: c.hbm_bytes for c in inv.chips},
+            link_gbps=(inv.links[0].gbps if inv.links else 45.0),
+            state_path=os.path.join(plugin_dir, "telemetry.json"),
         )
+        # interval <= 0 disables the sampling thread (unit tests, and the
+        # sim — which drives sample_telemetry() synchronously per pass so
+        # its telemetry is deterministic). The thread never runs under the
+        # pu flock or the DeviceState mutex.
+        self._telemetry_interval = telemetry_interval_s
+        self._telemetry_thread: Optional[threading.Thread] = None
         self._pu_lock = Flock(os.path.join(plugin_dir, "pu.lock"))
         self._pool_generation = 1
         # Serializes slice publishes between the main thread and the health
@@ -139,7 +151,16 @@ class TpuDriver:
                 self.state.tpulib.watch_health(self._on_health_event)
             if hasattr(self.state.tpulib, "watch_link_health"):
                 self.state.tpulib.watch_link_health(self._on_link_health_event)
+        # Telemetry restart re-seed: last-known window metadata republishes
+        # the per-chip gauges before the first live sample, so a restarted
+        # plugin never reports a zero fleet until its window refills.
+        self.health.load_telemetry_state()
         self.publish_resources()
+        if self._telemetry_interval > 0:
+            self._telemetry_thread = threading.Thread(
+                target=self._telemetry_loop, name="telemetry-sampler",
+                daemon=True)
+            self._telemetry_thread.start()
         if self._cleanup_interval > 0:
             # interval <= 0 disables the timer thread entirely: a sim
             # running thousands of in-process plugins cannot afford one
@@ -158,7 +179,36 @@ class TpuDriver:
             self.state.tpulib.stop_health_watch()
         if self._cleanup_thread:
             self._cleanup_thread.join(timeout=5)
+        if self._telemetry_thread:
+            self._telemetry_thread.join(timeout=5)
+        # Final seed write (thread or externally-driven sampling alike) so
+        # a restart republishes the freshest window, not one a whole
+        # throttle interval old.
+        if self.health.samples_taken:
+            self.health.save_telemetry_state(force=True)
         self._registered = False
+
+    # -- telemetry sampling ---------------------------------------------------
+
+    def _telemetry_loop(self) -> None:
+        while not self._stop.wait(self._telemetry_interval):
+            try:
+                self.sample_telemetry()
+            except Exception:  # noqa: BLE001 — sampling must not kill the plugin
+                log.exception("telemetry sample failed")
+
+    def sample_telemetry(self, now: Optional[float] = None) -> int:
+        """One sampling tick: read counters into the ring buffers/gauges,
+        persist the window-metadata seed, and feed any telemetry-derived
+        link-degradation transition through the same taint/event chain
+        the health watcher uses. Returns the number of health deltas."""
+        deltas = self.health.sample(now=now)
+        self.health.save_telemetry_state()
+        for delta in deltas:
+            self._record_health_event(delta)
+        if deltas:
+            self.publish_resources()
+        return len(deltas)
 
     def healthy(self) -> bool:
         """gRPC healthcheck analog (health.go:39-148)."""
@@ -281,6 +331,24 @@ class TpuDriver:
                 out = {c.uid: e for c in claims}
             failed = sum(1 for r in out.values() if isinstance(r, Exception))
             sp.attrs["failed_claims"] = failed
+            # Telemetry context: which chips each claim landed on and what
+            # those chips were doing (duty/HBM, last sample) at prepare
+            # time — the attributes that let a trace answer "what was the
+            # chip doing when this claim arrived".
+            chip_sets = {
+                uid: sorted({i for d in r.devices for i in d.chip_indices})
+                for uid, r in out.items() if isinstance(r, PrepareResult)
+            }
+            if chip_sets:
+                sp.attrs["chip_sets"] = chip_sets
+                last = self.health.last_sample()
+                touched = sorted({i for c in chip_sets.values() for i in c})
+                sp.attrs["duty_at_prepare"] = {
+                    str(i): round(last["duty"][i], 4)
+                    for i in touched if i in last["duty"]}
+                sp.attrs["hbm_at_prepare"] = {
+                    str(i): int(last["hbm"][i])
+                    for i in touched if i in last["hbm"]}
         self.metrics.record_claim_errors("PrepareResourceClaims", failed)
         for claim in claims:
             r = out.get(claim.uid)
@@ -305,6 +373,11 @@ class TpuDriver:
                     "dra.unprepare_batch", driver=self.driver_name,
                     batch_size=len(claim_uids),
                     claim_uids=list(claim_uids)) as sp:
+            held = self.state.prepared_chipsets()
+            chip_sets = {uid: list(held[uid][2]) for uid in claim_uids
+                         if uid in held}
+            if chip_sets:
+                sp.attrs["chip_sets"] = chip_sets
             try:
                 with self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
                                         trace_name="pu_flock"):

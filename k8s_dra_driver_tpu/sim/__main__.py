@@ -177,11 +177,12 @@ def main(argv=None) -> int:
     # (`python -m k8s_dra_driver_tpu.sim --port ...`) working unchanged.
     if argv and argv[0] == "trace":
         return trace_main(argv[1:])
-    if argv and argv[0] in ("describe", "get"):
-        # `sim describe computedomain <name>` — the kubectl verbs against a
-        # running sim apiserver (--server / $TPU_KUBECTL_SERVER), so the
-        # debugging loop (status + conditions + deduped events) closes
-        # without a second CLI.
+    if argv and argv[0] in ("describe", "get", "top"):
+        # `sim describe computedomain <name>` / `sim top computedomains` —
+        # the kubectl verbs against a running sim apiserver (--server /
+        # $TPU_KUBECTL_SERVER), so the debugging loop (status + conditions
+        # + deduped events + utilization tables) closes without a second
+        # CLI.
         from k8s_dra_driver_tpu.sim.kubectl import main as kubectl_main
 
         return kubectl_main(argv)

@@ -68,6 +68,7 @@ from k8s_dra_driver_tpu.k8s.core import (
     Node,
     POD,
     Pod,
+    ObjectReference,
     RESOURCE_CLAIM,
     RESOURCE_CLAIM_TEMPLATE,
     RESOURCE_SLICE,
@@ -109,6 +110,17 @@ CHAOS_CHIP_HEALTH_ANNOTATION = "sim.tpu.google.com/chip-health"
 # link between two host-local chips, driving the link-taint / DeviceDegraded
 # / DomainDegraded chain from outside the process.
 CHAOS_LINK_HEALTH_ANNOTATION = "sim.tpu.google.com/link-health"
+# Synthetic load: the annotation value is a tpulib.loadtrace spec
+# ("bursty:seed=3,period=60", "constant:level=0.99", ...) installed into
+# the node's mock tpulib — prepared chips then follow the trace, and the
+# telemetry plane (sampler -> rollup -> SLO) sees realistic utilization
+# without hardware. Empty value clears the trace.
+CHAOS_LOAD_TRACE_ANNOTATION = "sim.tpu.google.com/load-trace"
+# Sustained ICI error injection: "0-1=50" drives 50 errors/s onto the
+# link between chips 0 and 1 — the telemetry sampler's error-rate
+# threshold must degrade exactly the spanning devices via the existing
+# taint chain. "0-1=0" clears.
+CHAOS_LINK_ERRORS_ANNOTATION = "sim.tpu.google.com/link-errors"
 
 # Comma-list env keys whose values union when a pod holds several claims
 # (each claim's CDI spec names only its own chips).
@@ -239,7 +251,50 @@ class SimCluster:
         self.nodes: Dict[str, SimNode] = {}
         self._chaos_applied: Dict[str, str] = {}  # node -> last annotation value
         self._chaos_link_applied: Dict[str, str] = {}
+        self._chaos_trace_applied: Dict[str, str] = {}
+        self._chaos_link_err_applied: Dict[str, str] = {}
         self._gc_prev_claim_uids: set = set()
+        # -- fleet telemetry (FleetTelemetry gate) --------------------------
+        # The sim drives sampling synchronously on a virtual clock
+        # (telemetry_clock advances telemetry_dt per step), so traces,
+        # window stats, and SLO burn rates are deterministic per seed —
+        # no wall-clock dependence anywhere in the pipeline.
+        self.telemetry = None
+        self.slo = None
+        self.telemetry_clock = 0.0
+        self.telemetry_dt = 1.0
+        self._pods_seen_running: Set[str] = set()
+        # uid -> telemetry_clock at first sight: time-to-running is
+        # measured on the VIRTUAL clock (ticks a pod waited), never
+        # wall time — the telemetry pipeline's determinism contract.
+        self._pod_first_seen_tick: Dict[str, float] = {}
+        if self.gates.enabled("FleetTelemetry"):
+            from k8s_dra_driver_tpu.pkg.slo import SLOEvaluator, SLObjective
+            from k8s_dra_driver_tpu.pkg.telemetry import TelemetryAggregator
+
+            self.telemetry_recorder = EventRecorder(
+                self.api, "telemetry", metrics_registry=self.metrics_registry)
+            self.telemetry = TelemetryAggregator(
+                self.api, self.metrics_registry)
+            self.slo = SLOEvaluator(self.metrics_registry,
+                                    recorder=self.telemetry_recorder)
+            # Recording rules sized to the virtual second; tests/operators
+            # replace them via slo.add() before the first step.
+            self.slo.add(SLObjective(
+                name="claim-duty-cycle",
+                description="claim window duty-cycle p95 below overload",
+                target=0.90, bound=0.95, op="gt",
+                windows=((60.0, 15.0), (240.0, 60.0))))
+            self.slo.add(SLObjective(
+                name="domain-ici-utilization",
+                description="domain ICI utilization p95 below saturation",
+                target=0.90, bound=0.90, op="gt",
+                windows=((60.0, 15.0), (240.0, 60.0))))
+            self.slo.add(SLObjective(
+                name="scheduler-time-to-running",
+                description="pod time-to-running under the serving bound",
+                target=0.95, bound=30.0, op="gt",
+                windows=((120.0, 30.0),)))
         # -- dirty-set state fed by the watch streams -----------------------
         # Subscribed before any object is created below, so the cluster's
         # own bootstrap (nodes, device classes, published slices) arrives
@@ -400,6 +455,8 @@ class SimCluster:
                 agent.shutdown()
             node.tpu_driver.shutdown()
             node.cd_driver.shutdown()
+        if self.telemetry is not None:
+            self.telemetry.close()
         self.controller.stop()
         for kind, q in self._watch_queues.items():
             self.api.stop_watch(kind, q)
@@ -467,7 +524,28 @@ class SimCluster:
                 self._sched_dirty.discard(key)
                 self._sched_backlog.discard(key)
                 self._kubelet_dirty.discard(key)
+                self._pods_seen_running.discard(obj.uid)
+                self._pod_first_seen_tick.pop(obj.uid, None)
                 return
+            if self.slo is not None:
+                self._pod_first_seen_tick.setdefault(
+                    obj.uid, self.telemetry_clock)
+            if (self.slo is not None and obj.phase == "Running"
+                    and obj.uid not in self._pods_seen_running):
+                # SLO recording rule input: time-to-running straight off
+                # the watch stream (one observation per pod lifetime),
+                # measured in VIRTUAL seconds since the pod was first
+                # seen — wall time would make seeded runs host-dependent.
+                self._pods_seen_running.add(obj.uid)
+                first = self._pod_first_seen_tick.pop(
+                    obj.uid, self.telemetry_clock)
+                latency = max(0.0, self.telemetry_clock - first)
+                self.slo.observe(
+                    "scheduler-time-to-running", self.telemetry_clock,
+                    latency, subject=(obj.meta.namespace, obj.meta.name),
+                    ref=ObjectReference(kind=POD, name=obj.meta.name,
+                                        namespace=obj.meta.namespace,
+                                        uid=obj.uid))
             if obj.phase == "Pending":
                 self._sched_dirty.add(key)
             else:
@@ -514,6 +592,7 @@ class SimCluster:
         self.controller.drain(timeout=5)
         self._kubelet_pass()
         self._rebalance_pass()
+        self._telemetry_pass()
 
     def _resolve_tpu_plugin(self, node_name: str):
         node = self.nodes.get(node_name)
@@ -1416,6 +1495,82 @@ class SimCluster:
                         log.exception("chaos: set_link_health(%d,%d) failed on %s",
                                       a, b, node_obj.meta.name)
                 self._chaos_link_applied[node_obj.meta.name] = link_value
+            trace_value = node_obj.meta.annotations.get(
+                CHAOS_LOAD_TRACE_ANNOTATION, "")
+            if trace_value != self._chaos_trace_applied.get(node_obj.meta.name, ""):
+                from k8s_dra_driver_tpu.tpulib.loadtrace import LoadTraceError
+
+                try:
+                    sim_node.tpulib.set_load_trace(trace_value or None)
+                except LoadTraceError:
+                    log.warning("chaos: bad load-trace spec %r on %s",
+                                trace_value, node_obj.meta.name)
+                self._chaos_trace_applied[node_obj.meta.name] = trace_value
+            err_value = node_obj.meta.annotations.get(
+                CHAOS_LINK_ERRORS_ANNOTATION, "")
+            if err_value != self._chaos_link_err_applied.get(node_obj.meta.name, ""):
+                for tok in filter(None, (t.strip() for t in err_value.split(","))):
+                    pair, _, rate_s = tok.partition("=")
+                    try:
+                        a_s, _, b_s = pair.partition("-")
+                        a, b = int(a_s), int(b_s)
+                        rate = float(rate_s)
+                    except ValueError:
+                        log.warning("chaos: bad link-errors token %r on %s",
+                                    tok, node_obj.meta.name)
+                        continue
+                    sim_node.tpulib.set_link_error_rate(a, b, rate)
+                self._chaos_link_err_applied[node_obj.meta.name] = err_value
+
+    # -- fleet telemetry ---------------------------------------------------------
+
+    def _telemetry_pass(self) -> None:
+        """One telemetry tick: advance the virtual clock, sample every
+        node's monitor, roll samples up to claims/domains, and evaluate
+        the SLO rules. No-op unless the FleetTelemetry gate is on."""
+        if self.telemetry is None:
+            return
+        self.telemetry_clock += self.telemetry_dt
+        now = self.telemetry_clock
+        views = []
+        for name, node in self.nodes.items():
+            node.tpu_driver.sample_telemetry(now=now)
+            views.append(self.node_telemetry_view(name))
+        self.telemetry.rollup(now, views)
+        for (ns, cname), s in self.telemetry.claim_summaries().items():
+            self.slo.observe(
+                "claim-duty-cycle", now, s.duty_cycle_p95,
+                subject=(ns, cname),
+                ref=ObjectReference(kind=RESOURCE_CLAIM, name=cname,
+                                    namespace=ns))
+        for (ns, dname), s in self.telemetry.domain_summaries().items():
+            self.slo.observe(
+                "domain-ici-utilization", now, s.ici_utilization_p95,
+                subject=(ns, dname),
+                ref=ObjectReference(kind=COMPUTE_DOMAIN, name=dname,
+                                    namespace=ns))
+        self.slo.evaluate(now)
+
+    def node_telemetry_view(self, name: str):
+        """The aggregator's per-node input, built from in-memory monitor
+        and checkpoint-mirror snapshots (zero store reads)."""
+        from k8s_dra_driver_tpu.pkg.telemetry import ClaimChips, NodeView
+
+        node = self.nodes[name]
+        mon = node.tpu_driver.health
+        stats = mon.window_stats()
+        return NodeView(
+            node=name,
+            duty=stats.get("duty", {}),
+            hbm_used=stats.get("hbm", {}),
+            hbm_total=mon.hbm_totals(),
+            link_util=mon.link_utilization(),
+            claims=[
+                ClaimChips(uid=uid, name=n, namespace=ns, chips=chips)
+                for uid, (n, ns, chips)
+                in node.tpu_driver.state.prepared_chipsets().items()
+            ],
+        )
 
     # -- pod-deletion driven unprepare -------------------------------------------------
 

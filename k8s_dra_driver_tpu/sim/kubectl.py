@@ -189,6 +189,7 @@ _KIND_ALIASES = {
     "node": "Node", "nodes": "Node",
     "event": "Event", "events": "Event", "ev": "Event",
     "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
+    "claim": "ResourceClaim", "claims": "ResourceClaim",
     "resourceclaimtemplate": "ResourceClaimTemplate",
     "resourceclaimtemplates": "ResourceClaimTemplate",
     "resourceslice": "ResourceSlice", "resourceslices": "ResourceSlice",
@@ -262,6 +263,31 @@ def _table(rows: List[List[str]], indent: str = "  ") -> List[str]:
     ]
 
 
+def _pct(v: float) -> str:
+    return f"{100.0 * v:.0f}%"
+
+
+def _gib(b: float) -> str:
+    return f"{b / 2**30:.1f}Gi"
+
+
+def _utilization_lines(u) -> List[str]:
+    """The UTILIZATION section `describe` renders for claims/domains
+    carrying a telemetry summary."""
+    if u is None:
+        return []
+    lines = [
+        "Utilization:",
+        f"  Duty p95:  {_pct(u.duty_cycle_p95)} over "
+        f"{u.window_seconds:.0f}s window ({u.samples} samples)",
+        f"  HBM p95:   {_gib(u.hbm_used_p95_bytes)} / "
+        f"{_gib(u.hbm_total_bytes)}",
+    ]
+    if u.ici_utilization_p95 > 0:
+        lines.append(f"  ICI p95:   {_pct(u.ici_utilization_p95)}")
+    return lines
+
+
 def _conditions_lines(conditions, now: float) -> List[str]:
     if not conditions:
         return []
@@ -319,6 +345,7 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
             lines.append("Allocated on: <pending>")
         for r in obj.reserved_for:
             lines.append(f"Reserved for: {r.kind}/{r.name}")
+        lines += _utilization_lines(obj.utilization)
         lines += _conditions_lines(obj.conditions, time.time())
     elif obj.kind == "ComputeDomain":
         lines += [f"NumNodes:  {obj.spec.num_nodes}",
@@ -352,6 +379,7 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
             for n in obj.status.nodes:
                 rows.append([n.name, n.ici_domain, str(n.worker_id), n.status])
             lines += ["Nodes:"] + _table(rows)
+        lines += _utilization_lines(obj.status.utilization)
         lines += _conditions_lines(obj.status.conditions, time.time())
     elif obj.kind == "Node":
         from k8s_dra_driver_tpu.rebalancer.controller import (
@@ -372,6 +400,81 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
                 f"devices={len(s.devices)}"
                 + (f" tainted=[{','.join(tainted)}]" if tainted else ""))
     return lines
+
+
+# -- top ---------------------------------------------------------------------
+#
+# `tpu-kubectl top nodes|claims|computedomains`: sorted utilization tables.
+# Claims and domains read their utilizationSummary straight off status;
+# nodes aggregate the per-chip gauges from a /metrics scrape (the sim's
+# --metrics-port, or any node's MetricsServer).
+
+
+def top_claim_rows(objs: List[K8sObject]) -> List[List[str]]:
+    rows = [["NAMESPACE", "NAME", "DUTY-P95", "HBM-P95", "HBM-TOTAL",
+             "WINDOW", "SAMPLES"]]
+    ranked = sorted(
+        (o for o in objs if getattr(o, "utilization", None) is not None),
+        key=lambda o: -o.utilization.duty_cycle_p95)
+    for o in ranked:
+        u = o.utilization
+        rows.append([o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
+                     _gib(u.hbm_used_p95_bytes), _gib(u.hbm_total_bytes),
+                     f"{u.window_seconds:.0f}s", str(u.samples)])
+    return rows
+
+
+def top_domain_rows(objs: List[K8sObject]) -> List[List[str]]:
+    rows = [["NAMESPACE", "NAME", "DUTY-P95", "HBM-P95", "ICI-P95",
+             "WINDOW", "SAMPLES"]]
+    ranked = sorted(
+        (o for o in objs if o.status.utilization is not None),
+        key=lambda o: -o.status.utilization.duty_cycle_p95)
+    for o in ranked:
+        u = o.status.utilization
+        rows.append([o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
+                     _gib(u.hbm_used_p95_bytes), _pct(u.ici_utilization_p95),
+                     f"{u.window_seconds:.0f}s", str(u.samples)])
+    return rows
+
+
+def top_node_rows(metrics_text: str) -> List[List[str]]:
+    """Aggregate the per-chip telemetry gauges of one scrape into a
+    per-node table (one scrape of the sim's shared registry covers the
+    whole fleet — every node plugin exposes on it)."""
+    from k8s_dra_driver_tpu.pkg.telemetry import parse_metrics_text
+
+    samples = parse_metrics_text(metrics_text)
+
+    def by_node(metric: str) -> Dict[str, List[float]]:
+        out: Dict[str, List[float]] = {}
+        for labels, value in samples.get(metric, {}).items():
+            node = dict(labels).get("node", "")
+            if node:
+                out.setdefault(node, []).append(value)
+        return out
+
+    duty = by_node("tpu_dra_chip_duty_cycle")
+    hbm = by_node("tpu_dra_chip_hbm_used_bytes")
+    power = by_node("tpu_dra_chip_power_watts")
+    errors = by_node("tpu_dra_ici_link_errors_total")
+    rows = [["NODE", "CHIPS", "DUTY", "HBM-USED", "POWER", "ICI-ERRS"]]
+    ranked = sorted(duty, key=lambda n: -(sum(duty[n]) / len(duty[n])))
+    for node in ranked:
+        d = duty[node]
+        rows.append([
+            node, str(len(d)), _pct(sum(d) / len(d)),
+            _gib(sum(hbm.get(node, []))),
+            f"{sum(power.get(node, [])):.0f}W",
+            f"{sum(errors.get(node, [])):.0f}",
+        ])
+    return rows
+
+
+def _print_table(rows: List[List[str]]) -> None:
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
 
 
 def describe_object(api, kind: str, name: str, namespace: str = "") -> str:
@@ -429,6 +532,18 @@ def main(argv=None) -> int:
     p_desc.add_argument("name")
     p_desc.add_argument("-n", "--namespace", default=None)
 
+    p_top = sub.add_parser(
+        "top",
+        help="sorted utilization tables (nodes from a /metrics scrape, "
+        "claims/computedomains from their status utilizationSummary)")
+    p_top.add_argument("kind", help="nodes | claims | computedomains")
+    p_top.add_argument("-n", "--namespace", default=None)
+    p_top.add_argument("-A", "--all-namespaces", action="store_true")
+    p_top.add_argument("--metrics-url",
+                       default=os.environ.get("TPU_KUBECTL_METRICS", ""),
+                       help="base URL of a /metrics endpoint (required for "
+                       "`top nodes`) [TPU_KUBECTL_METRICS]")
+
     p_del = sub.add_parser("delete")
     p_del.add_argument("kind")
     p_del.add_argument("name")
@@ -466,6 +581,32 @@ def main(argv=None) -> int:
         return 0
 
     kind = _resolve_kind(args.kind)
+    if args.cmd == "top":
+        if kind == "Node":
+            if not args.metrics_url:
+                raise SystemExit(
+                    "error: top nodes reads per-chip gauges from a scrape; "
+                    "pass --metrics-url (or TPU_KUBECTL_METRICS)")
+            import urllib.request
+
+            url = args.metrics_url.rstrip("/")
+            if not url.endswith("/metrics"):
+                url += "/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                _print_table(top_node_rows(resp.read().decode()))
+            return 0
+        if kind not in ("ResourceClaim", "ComputeDomain"):
+            raise SystemExit(
+                "error: top supports nodes, claims, and computedomains")
+        if getattr(args, "all_namespaces", False):
+            list_ns = args.namespace
+        else:
+            list_ns = args.namespace or "default"
+        objs = api.list(kind, namespace=list_ns)
+        _print_table(top_claim_rows(objs) if kind == "ResourceClaim"
+                     else top_domain_rows(objs))
+        return 0
+
     if args.cmd == "get":
         if args.name and getattr(args, "all_namespaces", False):
             # kubectl refuses this combination too: a name lookup is

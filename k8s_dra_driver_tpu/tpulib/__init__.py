@@ -16,12 +16,19 @@ seam for CPU-only CI. Here the same split is:
 """
 
 from k8s_dra_driver_tpu.tpulib.types import (  # noqa: F401
+    ChipCounters,
     ChipHealth,
     ChipInfo,
     HostInventory,
+    LinkCounters,
     SubslicePlacement,
     SubsliceProfile,
     TpuGen,
+)
+from k8s_dra_driver_tpu.tpulib.loadtrace import (  # noqa: F401
+    LoadTrace,
+    LoadTraceError,
+    parse_load_trace,
 )
 from k8s_dra_driver_tpu.tpulib.profiles import GENS, PROFILES, SliceProfile  # noqa: F401
 from k8s_dra_driver_tpu.tpulib.lib import ALT_TPU_TOPOLOGY_ENV, TpuLib, new_tpulib  # noqa: F401

@@ -10,9 +10,9 @@ code change (SURVEY.md §4.2).
 from __future__ import annotations
 
 import os
-from typing import Optional, Protocol, runtime_checkable
+from typing import List, Optional, Protocol, runtime_checkable
 
-from k8s_dra_driver_tpu.tpulib.types import ChipHealth, HostInventory
+from k8s_dra_driver_tpu.tpulib.types import ChipCounters, ChipHealth, HostInventory
 
 ALT_TPU_TOPOLOGY_ENV = "ALT_TPU_TOPOLOGY"
 
@@ -20,6 +20,14 @@ ALT_TPU_TOPOLOGY_ENV = "ALT_TPU_TOPOLOGY"
 @runtime_checkable
 class TpuLib(Protocol):
     def enumerate(self) -> HostInventory: ...
+
+    def read_counters(self, now: Optional[float] = None) -> List[ChipCounters]:
+        """Per-chip utilization counters (HBM used/total, compute duty
+        cycle, power draw, per-ICI-link tx/rx/error counters) at sample
+        time ``now`` (default: the backend's own clock). A backend with
+        no counter source returns ``[]`` — samplers treat that as "no
+        telemetry", never as zero load."""
+        ...
 
 
 def using_mock_tpulib(env: Optional[dict] = None) -> bool:

@@ -1,0 +1,143 @@
+"""Parameterized synthetic load traces for the mock tpulib.
+
+Sim clusters need realistic utilization without real hardware: the
+``sim.tpu.google.com/load-trace`` chaos annotation carries a trace spec
+the mock backend turns into per-chip counters, the same way the
+chip/link-health annotations drive the health chain. Three generator
+families, all **deterministic from their seed** — the telemetry e2e
+compares measured p95s against ground truth recomputed from the very
+same generator, so no wall-clock randomness is allowed anywhere:
+
+- ``constant:level=0.6`` — flat load at ``level``.
+- ``diurnal:period=240,low=0.1,high=0.9,phase=0`` — sinusoidal
+  day/night cycle over ``period`` seconds.
+- ``bursty:seed=3,period=60,base=0.15,peak=0.95,duty=0.3`` — square
+  bursts: each ``period``-second slot is either a burst (``peak``) or
+  quiet (``base``); whether slot *k* bursts is a pure hash of
+  ``(seed, k)`` thinned to the ``duty`` fraction.
+
+``value(t)`` is the compute duty cycle in [0, 1] at trace-time ``t``;
+``hbm_fraction(t)`` derives the HBM footprint from it (weights stay
+resident, so there is a floor under the activations that track duty).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+LOAD_TRACE_KINDS = ("constant", "diurnal", "bursty")
+
+# HBM model: resident fraction (weights/optimizer state) plus an
+# activation share that tracks instantaneous duty.
+HBM_FLOOR_FRACTION = 0.30
+HBM_ACTIVE_FRACTION = 0.55
+
+
+class LoadTraceError(ValueError):
+    pass
+
+
+def _slot_hash(seed: int, slot: int) -> float:
+    """Uniform [0,1) from (seed, slot), stable across processes (no
+    PYTHONHASHSEED dependence)."""
+    h = hashlib.sha1(f"{seed}:{slot}".encode(), usedforsecurity=False)
+    return int.from_bytes(h.digest()[:8], "big") / 2**64
+
+
+@dataclass(frozen=True)
+class LoadTrace:
+    """One parsed trace spec. Frozen so a trace can key caches and be
+    shared across chips without copy."""
+
+    kind: str = "constant"
+    seed: int = 0
+    level: float = 0.6       # constant
+    period: float = 240.0    # diurnal / bursty slot length
+    low: float = 0.1         # diurnal trough
+    high: float = 0.9        # diurnal crest
+    phase: float = 0.0       # diurnal offset seconds
+    base: float = 0.15       # bursty quiet level
+    peak: float = 0.95       # bursty burst level
+    duty: float = 0.3        # bursty fraction of slots bursting
+    spec: str = field(default="", compare=False)
+
+    def value(self, t: float) -> float:
+        """Compute duty cycle in [0, 1] at trace-time ``t`` seconds."""
+        if self.kind == "constant":
+            return _clamp(self.level)
+        if self.kind == "diurnal":
+            x = 0.5 - 0.5 * math.cos(2 * math.pi * (t + self.phase) / self.period)
+            return _clamp(self.low + (self.high - self.low) * x)
+        slot = int(t // self.period)
+        bursting = _slot_hash(self.seed, slot) < self.duty
+        return _clamp(self.peak if bursting else self.base)
+
+    def hbm_fraction(self, t: float) -> float:
+        """Fraction of HBM in use at ``t``: resident floor + activations."""
+        return _clamp(HBM_FLOOR_FRACTION + HBM_ACTIVE_FRACTION * self.value(t))
+
+    def ground_truth(self, times: List[float]) -> Tuple[float, float]:
+        """(duty p95, hbm-fraction p95) over exactly ``times`` — what a
+        sampler reading this trace at those instants must converge to;
+        the telemetry e2e's oracle."""
+        if not times:
+            return 0.0, 0.0
+        return (percentile([self.value(t) for t in times], 0.95),
+                percentile([self.hbm_fraction(t) for t in times], 0.95))
+
+
+def _clamp(v: float) -> float:
+    return min(1.0, max(0.0, v))
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile on a copy; the one rule shared by the ring
+    buffers, the rollup summaries, and the trace ground truth so they can
+    be compared exactly."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
+    return ordered[rank]
+
+
+_FLOAT_PARAMS = {"level", "period", "low", "high", "phase", "base", "peak",
+                 "duty"}
+
+
+def parse_load_trace(spec: str) -> LoadTrace:
+    """Parse an annotation value like ``bursty:seed=3,period=60``.
+
+    Unknown kinds/params and malformed numbers raise :class:`LoadTraceError`
+    (the chaos pass logs and skips, mirroring the health annotations'
+    bad-token handling)."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise LoadTraceError("empty load-trace spec")
+    kind, _, rest = spec.partition(":")
+    kind = kind.strip().lower()
+    if kind not in LOAD_TRACE_KINDS:
+        raise LoadTraceError(
+            f"unknown load-trace kind {kind!r}; known: {LOAD_TRACE_KINDS}")
+    params: Dict[str, float] = {}
+    seed = 0
+    for tok in filter(None, (t.strip() for t in rest.split(","))):
+        key, eq, val = tok.partition("=")
+        key = key.strip().lower()
+        if not eq:
+            raise LoadTraceError(f"malformed load-trace param {tok!r}")
+        try:
+            if key == "seed":
+                seed = int(val)
+            elif key in _FLOAT_PARAMS:
+                params[key] = float(val)
+            else:
+                raise LoadTraceError(f"unknown load-trace param {key!r}")
+        except ValueError as e:
+            raise LoadTraceError(f"bad load-trace value {tok!r}") from e
+    if params.get("period", 240.0) <= 0:
+        raise LoadTraceError("load-trace period must be > 0")
+    return LoadTrace(kind=kind, seed=seed, spec=spec, **params)

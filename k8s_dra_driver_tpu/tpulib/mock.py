@@ -10,8 +10,11 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from k8s_dra_driver_tpu.tpulib.loadtrace import LoadTrace, parse_load_trace
 from k8s_dra_driver_tpu.tpulib.profiles import (
     GENS,
     PROFILES,
@@ -21,16 +24,28 @@ from k8s_dra_driver_tpu.tpulib.profiles import (
     host_grid_coord,
 )
 from k8s_dra_driver_tpu.tpulib.types import (
+    ChipCounters,
     ChipHealth,
     ChipInfo,
     HostInventory,
     IciLink,
+    LinkCounters,
     parse_topology,
 )
 
 ALT_TPU_WORKER_ID_ENV = "ALT_TPU_WORKER_ID"
 ALT_TPU_SLICE_UID_ENV = "ALT_TPU_SLICE_UID"
 ALT_TPU_UNHEALTHY_CHIPS_ENV = "ALT_TPU_UNHEALTHY_CHIPS"
+# Load trace seam, the env twin of the sim.tpu.google.com/load-trace
+# annotation (tests that build the lib directly set this instead).
+ALT_TPU_LOAD_TRACE_ENV = "ALT_TPU_LOAD_TRACE"
+
+# Load applied to chips with a registered workload when no trace is set:
+# a plausibly-busy steady state, so prepared chips never read as idle.
+DEFAULT_BUSY_TRACE = LoadTrace(kind="constant", level=0.6)
+# Duty floor on idle chips (background runtime activity, never exactly 0).
+IDLE_DUTY = 0.01
+IDLE_HBM_FRACTION = 0.02
 
 
 def _host_block_origin(profile: SliceProfile, worker_id: int) -> Tuple[int, ...]:
@@ -86,6 +101,26 @@ class MockTpuLib:
         self._health_listeners: List = []
         self._link_health: Dict[Tuple[int, int], ChipHealth] = {}
         self._link_listeners: List = []
+        # -- telemetry state (all guarded: counters are read from sampler
+        # threads while prepare paths register workloads) ------------------
+        self._tel_mu = threading.Lock()
+        self._load_trace: Optional[LoadTrace] = None  # tpulint: guarded-by=_tel_mu
+        trace_spec = env.get(ALT_TPU_LOAD_TRACE_ENV, "")
+        if trace_spec:
+            self._load_trace = parse_load_trace(trace_spec)
+        self._workloads: Dict[str, Tuple[int, ...]] = {}  # tpulint: guarded-by=_tel_mu
+        self._link_error_rates: Dict[Tuple[int, int], float] = {}  # tpulint: guarded-by=_tel_mu
+        # Per-link cumulative accumulators: [tx, rx, errors], advanced by
+        # rate * dt at every read so counters integrate the load between
+        # sampling instants (the hardware-counter contract).
+        self._link_acc: Dict[Tuple[int, int], List[float]] = {}  # tpulint: guarded-by=_tel_mu
+        self._counters_last_t: Optional[float] = None  # tpulint: guarded-by=_tel_mu
+        # Static per-profile topology, computed once: read_counters must
+        # not rebuild the coordinate map per sample inside _tel_mu.
+        _host_dims = parse_topology(self.profile.host_topology)
+        self._counter_chips = len(host_chip_coords(_host_dims))
+        self._counter_link_pairs = self._host_link_pairs(
+            self._counter_chips, _host_dims)
 
     # -- health injection ---------------------------------------------------
 
@@ -115,6 +150,124 @@ class MockTpuLib:
 
     def link_health(self) -> Dict[Tuple[int, int], ChipHealth]:
         return dict(self._link_health)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def set_load_trace(self, trace: "Optional[LoadTrace | str]") -> None:
+        """Install the synthetic load generator (a LoadTrace, a spec
+        string, or None to clear) — the load-trace chaos annotation's
+        target. Applies to chips with a registered workload; idle chips
+        stay at the idle floor regardless."""
+        if isinstance(trace, str):
+            trace = parse_load_trace(trace)
+        with self._tel_mu:
+            self._load_trace = trace
+
+    def load_trace(self) -> Optional[LoadTrace]:
+        with self._tel_mu:
+            return self._load_trace
+
+    def register_workload(self, owner: str, chip_indices) -> None:
+        """Mark ``chip_indices`` busy on behalf of ``owner`` (a claim uid:
+        the plugin registers at PrepareCompleted, unregisters at
+        unprepare/rollback) so counters reflect what is actually placed."""
+        with self._tel_mu:
+            self._workloads[owner] = tuple(sorted(chip_indices))
+
+    def unregister_workload(self, owner: str) -> None:
+        with self._tel_mu:
+            self._workloads.pop(owner, None)
+
+    def workloads(self) -> Dict[str, Tuple[int, ...]]:
+        with self._tel_mu:
+            return dict(self._workloads)
+
+    def set_link_error_rate(self, a: int, b: int, errors_per_s: float) -> None:
+        """Inject a sustained ICI error rate on one link (order
+        insensitive; 0 clears) — the fault the telemetry sampler must
+        threshold into link *degradation*, distinct from the hard
+        set_link_health kill."""
+        key = (min(a, b), max(a, b))
+        with self._tel_mu:
+            if errors_per_s <= 0:
+                self._link_error_rates.pop(key, None)
+            else:
+                self._link_error_rates[key] = float(errors_per_s)
+
+    def read_counters(self, now: Optional[float] = None) -> List[ChipCounters]:
+        """Synthesize per-chip counters at trace-time ``now``.
+
+        Busy chips (any registered workload) follow the installed load
+        trace (or DEFAULT_BUSY_TRACE); idle chips sit at the idle floor.
+        Link tx/rx/error counters are cumulative: each read advances the
+        accumulators by rate x elapsed-trace-time, so two reads bracket
+        the integrated traffic between them."""
+        if now is None:
+            now = time.time()
+        inv_gen = GENS[self.profile.gen]
+        n_chips = self._counter_chips
+        # Lock hold is the accumulator arithmetic ONLY: the prepare path
+        # takes this same mutex per claim (register_workload), so object
+        # construction for chips x links must not serialize against it
+        # (bench_telemetry's prepare-storm gate measures exactly that).
+        with self._tel_mu:
+            busy = {i for chips in self._workloads.values() for i in chips}
+            trace = self._load_trace or DEFAULT_BUSY_TRACE
+            last_t = self._counters_last_t
+            dt = max(0.0, now - last_t) if last_t is not None else 0.0
+            self._counters_last_t = now
+            load = trace.value(now)
+            hbm_frac = trace.hbm_fraction(now)
+            # Advance cumulative link accumulators. A link carries
+            # collective traffic when both endpoints are busy.
+            link_snap: List[Tuple[int, int, int, int, int]] = []
+            for (a, b) in self._counter_link_pairs:
+                acc = self._link_acc.setdefault((a, b), [0.0, 0.0, 0.0])
+                if dt > 0:
+                    active = a in busy and b in busy
+                    util = load if active else 0.0
+                    byte_rate = util * inv_gen.ici_gbps_per_link * 1e9 / 8.0
+                    acc[0] += byte_rate * dt
+                    acc[1] += byte_rate * dt
+                    acc[2] += self._link_error_rates.get((a, b), 0.0) * dt
+                link_snap.append((a, b, int(acc[0]), int(acc[1]), int(acc[2])))
+        links_by_chip: Dict[int, List[LinkCounters]] = {}
+        for a, b, tx, rx, errs in link_snap:
+            links_by_chip.setdefault(a, []).append(LinkCounters(
+                a=a, b=b, tx_bytes=tx, rx_bytes=rx, errors=errs))
+        out: List[ChipCounters] = []
+        for idx in range(n_chips):
+            if idx in busy:
+                duty = load
+                used = int(hbm_frac * inv_gen.hbm_bytes)
+            else:
+                duty = IDLE_DUTY
+                used = int(IDLE_HBM_FRACTION * inv_gen.hbm_bytes)
+            power = (inv_gen.idle_watts
+                     + (inv_gen.peak_watts - inv_gen.idle_watts) * duty)
+            out.append(ChipCounters(
+                index=idx, timestamp=now,
+                hbm_used_bytes=used, hbm_total_bytes=inv_gen.hbm_bytes,
+                duty_cycle=duty, power_watts=power,
+                links=tuple(links_by_chip.get(idx, ())),
+            ))
+        return out
+
+    @staticmethod
+    def _host_link_pairs(n_chips: int, host_dims) -> List[Tuple[int, int]]:
+        """Intra-host ICI link endpoints as (a, b) host-local index pairs,
+        a < b — the same adjacency _intra_host_links derives in coords."""
+        coords = host_chip_coords(host_dims)
+        index_of = {c: i for i, c in enumerate(coords)}
+        pairs: List[Tuple[int, int]] = []
+        for c, i in index_of.items():
+            for axis in range(len(host_dims)):
+                nb = list(c)
+                nb[axis] += 1
+                j = index_of.get(tuple(nb))
+                if j is not None:
+                    pairs.append((min(i, j), max(i, j)))
+        return sorted(set(pairs))
 
     # -- enumeration --------------------------------------------------------
 

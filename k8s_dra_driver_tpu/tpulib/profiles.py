@@ -29,13 +29,17 @@ GiB = 1024**3
 
 GENS: Dict[TpuGen, GenSpec] = {
     TpuGen.V4: GenSpec(TpuGen.V4, hbm_bytes=32 * GiB, cores_per_chip=2,
-                       topology_dims=3, peak_bf16_tflops=275.0, ici_gbps_per_link=50.0),
+                       topology_dims=3, peak_bf16_tflops=275.0, ici_gbps_per_link=50.0,
+                       idle_watts=55.0, peak_watts=192.0),
     TpuGen.V5E: GenSpec(TpuGen.V5E, hbm_bytes=16 * GiB, cores_per_chip=1,
-                        topology_dims=2, peak_bf16_tflops=197.0, ici_gbps_per_link=45.0),
+                        topology_dims=2, peak_bf16_tflops=197.0, ici_gbps_per_link=45.0,
+                        idle_watts=40.0, peak_watts=170.0),
     TpuGen.V5P: GenSpec(TpuGen.V5P, hbm_bytes=95 * GiB, cores_per_chip=2,
-                        topology_dims=3, peak_bf16_tflops=459.0, ici_gbps_per_link=90.0),
+                        topology_dims=3, peak_bf16_tflops=459.0, ici_gbps_per_link=90.0,
+                        idle_watts=90.0, peak_watts=350.0),
     TpuGen.V6E: GenSpec(TpuGen.V6E, hbm_bytes=32 * GiB, cores_per_chip=1,
-                        topology_dims=2, peak_bf16_tflops=918.0, ici_gbps_per_link=90.0),
+                        topology_dims=2, peak_bf16_tflops=918.0, ici_gbps_per_link=90.0,
+                        idle_watts=60.0, peak_watts=260.0),
 }
 
 

@@ -17,6 +17,7 @@ import logging
 import os
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from k8s_dra_driver_tpu.tpulib.profiles import GENS, compute_subslice_profiles
@@ -180,6 +181,45 @@ class RealTpuLib:
             return ChipHealth.HEALTHY if rc == 0 else ChipHealth.UNHEALTHY
         path = os.path.join(self.dev_root, f"accel{index}")
         return ChipHealth.HEALTHY if os.path.exists(path) else ChipHealth.UNHEALTHY
+
+    # -- utilization counters (libtpu runtime-metrics shim stubs) -----------
+
+    def read_counters(self, now: Optional[float] = None) -> List["ChipCounters"]:
+        """Per-chip HBM/duty/power/ICI counters from the native shim.
+
+        The native seam is ``tpulib_read_counters`` (one JSON doc, same
+        buffer-resize protocol as enumerate); until native/tpulib.cc grows
+        it — it needs the libtpu runtime-metrics API or the device-tree
+        performance counters, neither of which exists in this container —
+        the symbol is absent and this returns ``[]``: "no telemetry", which
+        samplers must treat as no data rather than zero load."""
+        from k8s_dra_driver_tpu.tpulib.types import ChipCounters
+
+        if self._lib is None or not hasattr(self._lib, "tpulib_read_counters"):
+            return []
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tpulib_read_counters(self.dev_root.encode(), buf, cap)
+            if n < 0:
+                needed = -n
+                if needed <= cap:
+                    log.warning("tpulib_read_counters error: %r", buf.value[:200])
+                    return []
+                cap = needed
+                continue
+            docs = json.loads(buf.value.decode()).get("chips", [])
+            ts = now if now is not None else time.time()
+            return [
+                ChipCounters(
+                    index=int(d["index"]), timestamp=ts,
+                    hbm_used_bytes=int(d.get("hbm_used_bytes", 0)),
+                    hbm_total_bytes=int(d.get("hbm_total_bytes", 0)),
+                    duty_cycle=float(d.get("duty_cycle", 0.0)),
+                    power_watts=float(d.get("power_watts", 0.0)),
+                )
+                for d in docs
+            ]
 
     # -- health events (NVML event-set analog) -------------------------------
 

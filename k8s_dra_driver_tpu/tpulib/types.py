@@ -40,6 +40,8 @@ class GenSpec:
     topology_dims: int          # 2 for v5e/v6e meshes, 3 for v4/v5p tori
     peak_bf16_tflops: float
     ici_gbps_per_link: float    # per-direction per-link
+    idle_watts: float = 50.0    # per-chip draw at zero duty
+    peak_watts: float = 200.0   # per-chip draw at full duty
 
 
 @dataclass(frozen=True)
@@ -61,6 +63,40 @@ class ChipInfo:
     def uuid(self) -> str:
         """Stable canonical identity, GPU-UUID analog."""
         return f"tpu-{self.gen.value}-{self.serial}"
+
+
+@dataclass(frozen=True)
+class LinkCounters:
+    """Cumulative traffic/error counters for one intra-host ICI link,
+    keyed by host-local chip endpoints (``a < b``). tx/rx are monotone
+    byte counters; ``errors`` is the monotone CRC/replay error counter
+    whose *rate* the health monitor thresholds into link degradation."""
+
+    a: int
+    b: int
+    tx_bytes: int = 0
+    rx_bytes: int = 0
+    errors: int = 0
+
+    @property
+    def link_id(self) -> str:
+        return f"{min(self.a, self.b)}-{max(self.a, self.b)}"
+
+
+@dataclass(frozen=True)
+class ChipCounters:
+    """One chip's utilization counters at a sampling instant — the
+    ``read_counters`` unit. Gauges (hbm/duty/power) are instantaneous;
+    the per-link counters are cumulative so samplers compute rates from
+    deltas like any hardware counter consumer."""
+
+    index: int                   # host-local chip index
+    timestamp: float             # trace/sample time the values describe
+    hbm_used_bytes: int = 0
+    hbm_total_bytes: int = 0
+    duty_cycle: float = 0.0      # [0, 1] compute duty over the last tick
+    power_watts: float = 0.0
+    links: Tuple[LinkCounters, ...] = ()  # links this chip terminates (a == index)
 
 
 @dataclass(frozen=True)

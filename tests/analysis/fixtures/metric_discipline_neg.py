@@ -5,6 +5,12 @@ constructions, closed-vocabulary labels."""
 def setup(registry, Counter, kind):
     ok = registry.register(Counter("tpu_dra_fixture_quiet_total", "help",
                                    ("kind",)))
+    # name+namespace is the sanctioned bounded join key (rollup gauges);
+    # "fluid"/"druid" must not trip the uid-label substring rule.
+    registry.register(Counter("tpu_dra_fixture_rollup_total", "help",
+                              ("namespace", "name")))
+    registry.register(Counter("tpu_dra_fixture_odd_names_total", "help",
+                              label_names=("fluid", "druid")))
     ok.inc(kind)          # label from a variable: assumed bounded
     ok.inc("Pod")         # literal label
     msg = f"prepared {kind}"  # f-strings outside metric calls are fine

@@ -9,4 +9,10 @@ def setup(registry, Counter, Histogram, claim_uid):
     ok.inc(f"claim-{claim_uid}")             # unbounded label
     hist = registry.register(Histogram("tpu_dra_fixture_seconds", "help"))
     hist.observe(0.5, f"node-{claim_uid}")   # unbounded label
-    return orphan
+    by_uid = registry.register(Counter(
+        "tpu_dra_fixture_by_uid_total", "help",
+        ("claim_uid",)))                     # uid label name: unbounded family
+    tele = registry.register(Counter(
+        "tpu_dra_fixture_tele_total", "help",
+        label_names=("node", "uid")))             # uid via the label_names kwarg
+    return orphan, by_uid, tele

@@ -36,7 +36,7 @@ CASES = [
     ("cas-purity", "cas_purity_pos.py", 5, "cas_purity_neg.py"),
     ("lock-order", "lock_order_pos.py", 4, "lock_order_neg.py"),
     ("store-scan", "store_scan_pos.py", 3, "store_scan_neg.py"),
-    ("metric-discipline", "metric_discipline_pos.py", 3,
+    ("metric-discipline", "metric_discipline_pos.py", 5,
      "metric_discipline_neg.py"),
     ("event-discipline", "event_discipline_pos.py", 4,
      "event_discipline_neg.py"),

@@ -553,6 +553,45 @@ def test_controller_status_updates_converge():
         ctrl.stop()
 
 
+def test_controller_reconcile_preserves_utilization():
+    """Regression: the status aggregation rebuilds ComputeDomainStatus
+    wholesale — it must CARRY the telemetry aggregator's utilization
+    summary (like placement), not wipe it. The aggregator is change-
+    gated, so a wiped summary under steady load would never come back."""
+    from k8s_dra_driver_tpu.k8s.core import UtilizationSummary
+
+    api = APIServer()
+    ctrl = Controller(api, cleanup_interval_s=3600)
+    ctrl.start()
+    try:
+        cd = make_cd(api)
+        wait_for(
+            lambda: COMPUTE_DOMAIN_FINALIZER
+            in api.get("ComputeDomain", cd.name, NS).meta.finalizers,
+            msg="finalizer",
+        )
+        summary = UtilizationSummary(
+            window_seconds=120.0, samples=120, duty_cycle_p95=0.8,
+            hbm_used_p95_bytes=1 << 30, hbm_total_bytes=16 << 30,
+            ici_utilization_p95=0.5, updated_at=1.0)
+
+        def write(obj):
+            obj.status.utilization = summary
+        api.update_with_retry("ComputeDomain", cd.name, NS, write)
+        # The write above re-enqueues the CD; the reconcile must not
+        # clear the summary (and the steady state must stop writing).
+        time.sleep(0.5)
+        live = api.get("ComputeDomain", cd.name, NS)
+        assert live.status.utilization == summary, \
+            "controller reconcile wiped status.utilization"
+        rv1 = live.meta.resource_version
+        time.sleep(0.4)
+        assert api.get("ComputeDomain", cd.name, NS).meta.resource_version \
+            == rv1, "CD churned after the utilization write"
+    finally:
+        ctrl.stop()
+
+
 def test_node_label_conflict_between_domains(cd_env):
     api, _, driver, _ = cd_env
     cd_a = make_cd(api, name="cd-a")

@@ -1,0 +1,326 @@
+"""Node-agent telemetry sampling: rings + gauges, error-rate link
+degradation with hysteresis, the restart re-seed, and the prepare-path
+trace attributes.
+
+The monitor half of docs/reference/telemetry.md: `sample()` reads tpulib
+counters into bounded rings and publishes the per-chip gauges; a link
+whose window-mean error RATE crosses the threshold degrades through the
+existing taint machinery (and heals only below the hysteresis floor); a
+restarted plugin re-seeds last-known window metadata so gauges never
+report a zero fleet while the ring refills.
+"""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.pkg import featuregates as fg
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.plugins.tpu.allocatable import enumerate_allocatable
+from k8s_dra_driver_tpu.plugins.tpu.device_state import (
+    LINK_DEGRADE_ERRORS_PER_S,
+    LINK_HEAL_ERRORS_PER_S,
+    DeviceHealthMonitor,
+)
+from k8s_dra_driver_tpu.plugins.tpu.driver import TpuDriver
+from k8s_dra_driver_tpu.tpulib import ChipHealth, MockTpuLib
+
+from tests.test_tpu_plugin import make_claim
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+def _monitor(trace="constant:level=0.6", state_path=None, window=None):
+    lib = MockTpuLib("v5e-4")
+    if trace:
+        lib.set_load_trace(trace)
+    allocatable = enumerate_allocatable(lib.enumerate(), with_subslices=True)
+    reg = Registry()
+    mon = DeviceHealthMonitor("n0", allocatable, metrics_registry=reg,
+                              tpulib=lib, state_path=state_path,
+                              window_samples=window)
+    return mon, lib, reg
+
+
+# -- sampling -----------------------------------------------------------------
+
+
+def test_sample_fills_rings_and_gauges():
+    mon, lib, reg = _monitor()
+    lib.register_workload("c1", (0, 1))
+    for t in range(1, 6):
+        assert mon.sample(now=float(t)) == []
+    assert mon.samples_taken == 5
+    stats = mon.window_stats()
+    assert stats["duty"][0].count == 5
+    assert stats["duty"][0].last == 0.6
+    assert stats["duty"][2].last < 0.1          # idle floor
+    assert stats["hbm"][0].last > 0
+    text = reg.expose()
+    assert 'tpu_dra_chip_duty_cycle{node="n0",chip="0"} 0.6' in text
+    assert 'tpu_dra_chip_power_watts{node="n0",chip="0"}' in text
+    # Cumulative link counters made it out as counters.
+    assert "tpu_dra_ici_link_tx_total" in text
+    # hbm totals learned from the counters themselves.
+    assert mon.hbm_totals()[0] == 16 << 30
+
+
+def test_sample_without_counters_is_noop():
+    lib = MockTpuLib("v5e-4")
+    allocatable = enumerate_allocatable(lib.enumerate(), with_subslices=True)
+    mon = DeviceHealthMonitor("n0", allocatable, metrics_registry=Registry())
+    assert mon.sample(now=1.0) == []            # no tpulib wired
+    assert mon.samples_taken == 0
+    assert mon.window_stats() == {"duty": {}, "hbm": {}, "power": {}}
+
+
+def test_last_sample_is_cheap_read():
+    mon, lib, _ = _monitor()
+    lib.register_workload("c1", (0,))
+    assert mon.last_sample() == {"duty": {}, "hbm": {}}
+    mon.sample(now=1.0)
+    last = mon.last_sample()
+    assert last["duty"][0] == 0.6
+    assert last["hbm"][0] > 0
+
+
+def test_link_utilization_window():
+    mon, lib, _ = _monitor()
+    lib.register_workload("c1", (0, 1, 2, 3))   # every link busy
+    for t in range(1, 5):
+        mon.sample(now=float(t))
+    lu = mon.link_utilization()
+    assert lu.count == 3                        # first sample has no delta
+    assert 0.0 < lu.last <= 1.0
+
+
+# -- error-rate degradation ---------------------------------------------------
+
+
+def test_error_rate_degrades_link_with_hysteresis():
+    mon, lib, reg = _monitor(window=4)
+    lib.register_workload("c1", (0, 1))
+    lib.set_link_error_rate(0, 1, LINK_DEGRADE_ERRORS_PER_S * 10)
+    deltas = []
+    for t in range(1, 6):
+        deltas += mon.sample(now=float(t))
+    assert [d for d in deltas if d.kind == "link" and d.id == "0-1"], (
+        "sustained error rate above threshold must degrade the link")
+    assert mon.broken_links()[(0, 1)] == ChipHealth.DEGRADED
+    # Spanning devices tainted, endpoint chips stay schedulable.
+    tainted = mon.tainted_devices()
+    assert tainted and all(v == "link" for v in tainted.values())
+    assert "tpu-0" not in tainted and "tpu-1" not in tainted
+    assert 'tpu_dra_device_health{node="n0",kind="link",id="0-1"} 1.0' \
+        in reg.expose()
+
+    # Rate hovers between heal and degrade thresholds: NO flap.
+    lib.set_link_error_rate(0, 1, (LINK_HEAL_ERRORS_PER_S
+                                   + LINK_DEGRADE_ERRORS_PER_S) / 2)
+    flap = []
+    for t in range(6, 12):
+        flap += mon.sample(now=float(t))
+    assert flap == [], "hysteresis band must not flap the taint"
+    assert mon.broken_links()[(0, 1)] == ChipHealth.DEGRADED
+
+    # Rate collapses: heals back through the same delta chain.
+    lib.set_link_error_rate(0, 1, 0.0)
+    heals = []
+    for t in range(12, 20):
+        heals += mon.sample(now=float(t))
+    assert [d for d in heals if d.id == "0-1"]
+    assert (0, 1) not in mon.broken_links()
+    assert not mon.tainted_devices()
+
+
+def test_telemetry_never_heals_fabric_reported_failures():
+    """A link the health watcher hard-killed stays UNHEALTHY even when
+    the error-rate telemetry looks clean — telemetry only drives its own
+    degradations."""
+    mon, lib, _ = _monitor()
+    lib.register_workload("c1", (0, 1))
+    mon.set_link(0, 1, ChipHealth.UNHEALTHY)    # fabric watcher's verdict
+    for t in range(1, 8):
+        mon.sample(now=float(t))                # zero error rate
+    assert mon.broken_links()[(0, 1)] == ChipHealth.UNHEALTHY
+
+
+def test_telemetry_never_downgrades_fabric_reported_failures():
+    """Regression: a HIGH error rate must not DEGRADE (downgrade) a
+    fabric-killed link either — a 2->1 overwrite would let the rate
+    falling later clear a link the fabric still reports dead. And once
+    the fabric heals, a still-high rate re-applies the degradation."""
+    mon, lib, _ = _monitor(window=4)
+    lib.register_workload("c1", (0, 1))
+    mon.set_link(0, 1, ChipHealth.UNHEALTHY)
+    lib.set_link_error_rate(0, 1, LINK_DEGRADE_ERRORS_PER_S * 10)
+    for t in range(1, 8):
+        mon.sample(now=float(t))                # rate far above threshold
+    assert mon.broken_links()[(0, 1)] == ChipHealth.UNHEALTHY
+    # Rate collapses while the fabric is still dead: STILL unhealthy.
+    lib.set_link_error_rate(0, 1, 0.0)
+    for t in range(8, 16):
+        mon.sample(now=float(t))
+    assert mon.broken_links()[(0, 1)] == ChipHealth.UNHEALTHY
+    # Fabric heals but the error rate climbs back: telemetry degrades.
+    mon.set_link(0, 1, ChipHealth.HEALTHY)
+    lib.set_link_error_rate(0, 1, LINK_DEGRADE_ERRORS_PER_S * 10)
+    for t in range(16, 24):
+        mon.sample(now=float(t))
+    assert mon.broken_links()[(0, 1)] == ChipHealth.DEGRADED
+
+
+# -- restart re-seed ----------------------------------------------------------
+
+
+def test_restart_reseed_serves_last_window(tmp_path):
+    state = str(tmp_path / "telemetry.json")
+    mon, lib, _ = _monitor(state_path=state)
+    lib.register_workload("c1", (0, 1))
+    for t in range(1, 8):
+        mon.sample(now=float(t))
+    mon.save_telemetry_state(force=True)
+    before = mon.window_stats()
+
+    # Fresh monitor, same state file: pre-sample gauges republish and
+    # window_stats serves the seeded window instead of zeros.
+    mon2, lib2, reg2 = _monitor(state_path=state)
+    assert mon2.load_telemetry_state()
+    seeded = mon2.window_stats()
+    assert seeded["duty"][0].p95 == before["duty"][0].p95
+    assert seeded["duty"][0].count == before["duty"][0].count
+    assert mon2.link_utilization().count > 0
+    assert 'tpu_dra_chip_duty_cycle{node="n0",chip="0"} 0.6' in reg2.expose()
+    assert mon2.last_sample()["duty"][0] == 0.6
+
+    # First live sample replaces the seed.
+    lib2.register_workload("c1", (0, 1))
+    mon2.sample(now=100.0)
+    assert mon2.window_stats()["duty"][0].count == 1
+
+
+def test_reseed_missing_or_corrupt_starts_cold(tmp_path):
+    state = str(tmp_path / "telemetry.json")
+    mon, _, _ = _monitor(state_path=state)
+    assert not mon.load_telemetry_state()       # no file yet
+    with open(state, "w") as f:
+        f.write("{not json")
+    assert not mon.load_telemetry_state()       # unreadable -> cold start
+    assert mon.window_stats() == {"duty": {}, "hbm": {}, "power": {}}
+
+
+def test_save_throttle(tmp_path):
+    state = str(tmp_path / "telemetry.json")
+    mon, lib, _ = _monitor(state_path=state)
+    lib.register_workload("c1", (0,))
+    mon.sample(now=1.0)
+    mon.save_telemetry_state()                  # first save writes
+    mtime = os.path.getmtime(state)
+    mon.sample(now=2.0)
+    mon.save_telemetry_state()                  # throttled: no write
+    assert os.path.getmtime(state) == mtime
+    mon.save_telemetry_state(force=True)        # force bypasses
+    assert os.path.exists(state)
+
+
+# -- driver integration -------------------------------------------------------
+
+
+def test_driver_restart_reseeds_telemetry(tmp_path):
+    """THE restart pin (ISSUE satellite): a restarted plugin republishes
+    last-known telemetry instead of reporting a zero fleet until its
+    first full window."""
+    reg = Registry()
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    lib.set_load_trace("constant:level=0.7")
+    kw = dict(api=api, node_name="n0", tpulib=lib,
+              plugin_dir=str(tmp_path / "plugin"),
+              cdi_root=str(tmp_path / "cdi"), gates=fg.parse(""))
+    driver = TpuDriver(metrics_registry=reg, **kw)
+    driver.start()
+    claim = make_claim(["tpu-0"])
+    driver.prepare_resource_claims([claim])
+    for t in range(1, 6):
+        driver.sample_telemetry(now=float(t))
+    driver.shutdown()                           # force-saves the seed
+
+    reg2 = Registry()
+    lib2 = MockTpuLib("v5e-4")
+    driver2 = TpuDriver(metrics_registry=reg2, tpulib=lib2, **{
+        k: v for k, v in kw.items() if k != "tpulib"})
+    driver2.start()
+    try:
+        assert 'tpu_dra_chip_duty_cycle{node="n0",chip="0"} 0.7' \
+            in reg2.expose(), "restart must republish last-known gauges"
+        stats = driver2.health.window_stats()
+        assert stats["duty"][0].count == 5      # seeded window metadata
+    finally:
+        driver2.shutdown()
+
+
+def test_driver_sample_feeds_taint_chain(tmp_path):
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    lib.set_load_trace("constant:level=0.5")
+    driver = TpuDriver(api=api, node_name="n0", tpulib=lib,
+                       plugin_dir=str(tmp_path / "plugin"),
+                       cdi_root=str(tmp_path / "cdi"), gates=fg.parse(""))
+    driver.start()
+    try:
+        claim = make_claim(["tpu-subslice-2x1-at-0x0"])  # chips 0+1 busy
+        driver.prepare_resource_claims([claim])
+        lib.set_link_error_rate(0, 1, 100.0)
+        deltas = 0
+        for t in range(1, 8):
+            deltas += driver.sample_telemetry(now=float(t))
+        assert deltas >= 1
+        from k8s_dra_driver_tpu.k8s.core import RESOURCE_SLICE
+
+        slices = api.list(RESOURCE_SLICE)
+        tainted = [d.name for s in slices for d in s.devices if d.taints]
+        assert tainted, "degraded link must reach the published slice"
+        assert "tpu-0" not in tainted and "tpu-1" not in tainted
+    finally:
+        driver.shutdown()
+
+
+def test_prepare_spans_carry_chip_telemetry(tmp_path):
+    from k8s_dra_driver_tpu.pkg.tracing import get_tracer
+
+    api = APIServer()
+    lib = MockTpuLib("v5e-4")
+    lib.set_load_trace("constant:level=0.8")
+    driver = TpuDriver(api=api, node_name="n0", tpulib=lib,
+                       plugin_dir=str(tmp_path / "plugin"),
+                       cdi_root=str(tmp_path / "cdi"), gates=fg.parse(""))
+    driver.start()
+    try:
+        warm = make_claim(["tpu-1"], name="warm")
+        driver.prepare_resource_claims([warm])
+        driver.sample_telemetry(now=1.0)        # chips have telemetry now
+
+        claim = make_claim(["tpu-0"], name="traced")
+        tracer = get_tracer()
+        tracer.clear()
+        driver.prepare_resource_claims([claim])
+        spans = [s for s in tracer.spans() if s.name == "dra.prepare_batch"]
+        assert spans
+        sp = spans[-1]
+        assert sp.attrs["chip_sets"] == {claim.uid: [0]}
+        assert sp.attrs["duty_at_prepare"]["0"] < 0.1   # idle at landing
+        assert "0" in sp.attrs["hbm_at_prepare"]
+
+        tracer.clear()
+        driver.unprepare_resource_claims([claim.uid, warm.uid])
+        spans = [s for s in tracer.spans()
+                 if s.name == "dra.unprepare_batch"]
+        assert spans and claim.uid in spans[-1].attrs["chip_sets"]
+    finally:
+        driver.shutdown()

@@ -1,0 +1,203 @@
+"""Synthetic load traces + the mock tpulib's counter synthesis.
+
+Pins that every generator is deterministic from its parameters (the
+telemetry e2e recomputes ground truth from the same generator), that the
+annotation grammar rejects garbage loudly, and that MockTpuLib turns
+registered workloads + a trace into hardware-shaped counters: busy chips
+follow the trace, idle chips sit at the floor, link counters are
+cumulative and integrate rate x dt between reads.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.tpulib.loadtrace import (
+    HBM_ACTIVE_FRACTION,
+    HBM_FLOOR_FRACTION,
+    LoadTrace,
+    LoadTraceError,
+    parse_load_trace,
+    percentile,
+)
+from k8s_dra_driver_tpu.tpulib.mock import (
+    ALT_TPU_LOAD_TRACE_ENV,
+    IDLE_DUTY,
+    IDLE_HBM_FRACTION,
+)
+from k8s_dra_driver_tpu.tpulib.profiles import GENS
+from k8s_dra_driver_tpu.tpulib.types import TpuGen
+
+
+# -- parsing ------------------------------------------------------------------
+
+
+def test_parse_each_kind():
+    c = parse_load_trace("constant:level=0.8")
+    assert c.kind == "constant" and c.level == 0.8
+    d = parse_load_trace("diurnal:period=120,low=0.2,high=0.8,phase=30")
+    assert (d.kind, d.period, d.low, d.high, d.phase) == \
+        ("diurnal", 120.0, 0.2, 0.8, 30.0)
+    b = parse_load_trace("bursty:seed=3,period=60,base=0.1,peak=0.9,duty=0.25")
+    assert (b.kind, b.seed, b.duty) == ("bursty", 3, 0.25)
+    # Bare kind: defaults apply.
+    assert parse_load_trace("constant").level == 0.6
+    # Spec is preserved for debugging but excluded from equality.
+    assert parse_load_trace("constant:level=0.8") == c
+
+
+@pytest.mark.parametrize("bad", [
+    "", "  ", "sawtooth:level=1", "constant:level", "constant:wat=1",
+    "bursty:seed=x", "diurnal:period=0", "diurnal:period=-5",
+    "constant:level=NaN-ish",
+])
+def test_parse_rejects_garbage(bad):
+    with pytest.raises(LoadTraceError):
+        parse_load_trace(bad)
+
+
+# -- generators ---------------------------------------------------------------
+
+
+def test_constant_and_clamp():
+    assert LoadTrace(kind="constant", level=0.6).value(123.4) == 0.6
+    assert LoadTrace(kind="constant", level=7.0).value(0) == 1.0
+    assert LoadTrace(kind="constant", level=-1.0).value(0) == 0.0
+
+
+def test_diurnal_cycle():
+    t = LoadTrace(kind="diurnal", period=100.0, low=0.1, high=0.9, phase=0.0)
+    assert t.value(0.0) == pytest.approx(0.1)        # trough at phase 0
+    assert t.value(50.0) == pytest.approx(0.9)       # crest mid-period
+    assert t.value(100.0) == pytest.approx(0.1)      # periodic
+    vals = [t.value(x / 10.0) for x in range(1000)]
+    assert min(vals) >= 0.1 - 1e-9 and max(vals) <= 0.9 + 1e-9
+
+
+def test_bursty_deterministic_and_two_level():
+    t = LoadTrace(kind="bursty", seed=3, period=10.0, base=0.2, peak=0.9,
+                  duty=0.3)
+    vals = [t.value(float(x)) for x in range(500)]
+    assert set(vals) == {0.2, 0.9}
+    # Same seed -> identical trace from a fresh instance (cross-process
+    # stability is the whole point of the sha1 slot hash).
+    again = LoadTrace(kind="bursty", seed=3, period=10.0, base=0.2,
+                      peak=0.9, duty=0.3)
+    assert [again.value(float(x)) for x in range(500)] == vals
+    # Different seed -> different burst schedule.
+    other = LoadTrace(kind="bursty", seed=4, period=10.0, base=0.2,
+                      peak=0.9, duty=0.3)
+    assert [other.value(float(x)) for x in range(500)] != vals
+    # Burst fraction tracks duty over many slots.
+    slots = [t.value(s * 10.0) for s in range(2000)]
+    frac = sum(1 for v in slots if v == 0.9) / len(slots)
+    assert 0.2 < frac < 0.4
+
+
+def test_hbm_fraction_floor_plus_activations():
+    t = LoadTrace(kind="constant", level=0.5)
+    assert t.hbm_fraction(0) == pytest.approx(
+        HBM_FLOOR_FRACTION + HBM_ACTIVE_FRACTION * 0.5)
+
+
+def test_ground_truth_matches_percentile():
+    t = LoadTrace(kind="bursty", seed=7, period=5.0)
+    times = [float(i) for i in range(120)]
+    duty_p95, hbm_p95 = t.ground_truth(times)
+    assert duty_p95 == percentile([t.value(x) for x in times], 0.95)
+    assert hbm_p95 == percentile([t.hbm_fraction(x) for x in times], 0.95)
+    assert t.ground_truth([]) == (0.0, 0.0)
+
+
+# -- mock counters ------------------------------------------------------------
+
+
+def _mock(trace=None):
+    lib = MockTpuLib("v5e-4")
+    if trace:
+        lib.set_load_trace(trace)
+    return lib
+
+
+def test_counters_idle_floor_without_workloads():
+    lib = _mock("constant:level=0.9")
+    counters = lib.read_counters(now=10.0)
+    assert len(counters) == 4
+    gen = GENS[TpuGen.V5E]
+    for c in counters:
+        assert c.duty_cycle == IDLE_DUTY
+        assert c.hbm_used_bytes == int(IDLE_HBM_FRACTION * gen.hbm_bytes)
+        assert c.hbm_total_bytes == gen.hbm_bytes
+        assert c.timestamp == 10.0
+
+
+def test_counters_busy_chips_follow_trace():
+    lib = _mock("constant:level=0.75")
+    lib.register_workload("claim-1", (0, 1))
+    counters = {c.index: c for c in lib.read_counters(now=5.0)}
+    gen = GENS[TpuGen.V5E]
+    assert counters[0].duty_cycle == 0.75 and counters[1].duty_cycle == 0.75
+    assert counters[2].duty_cycle == IDLE_DUTY
+    # Power interpolates idle->peak with duty.
+    want = gen.idle_watts + (gen.peak_watts - gen.idle_watts) * 0.75
+    assert counters[0].power_watts == pytest.approx(want)
+    assert counters[2].power_watts == pytest.approx(
+        gen.idle_watts + (gen.peak_watts - gen.idle_watts) * IDLE_DUTY)
+    lib.unregister_workload("claim-1")
+    assert all(c.duty_cycle == IDLE_DUTY
+               for c in lib.read_counters(now=6.0))
+
+
+def test_link_counters_cumulative_and_gated_on_both_endpoints():
+    lib = _mock("constant:level=0.5")
+    # v5e-4 host is a 2x2 grid: links 0-1, 0-2, 1-3, 2-3.
+    lib.register_workload("claim-1", (0, 1))   # only link 0-1 fully busy
+    lib.read_counters(now=0.0)                 # baseline read
+    by_link = {}
+    for c in lib.read_counters(now=10.0):
+        for lc in c.links:
+            by_link[(lc.a, lc.b)] = lc
+    gen = GENS[TpuGen.V5E]
+    want_bytes = int(0.5 * gen.ici_gbps_per_link * 1e9 / 8.0 * 10.0)
+    assert by_link[(0, 1)].tx_bytes == pytest.approx(want_bytes, rel=1e-6)
+    assert by_link[(0, 2)].tx_bytes == 0       # endpoint 2 idle
+    # Counters are monotone: a later read only grows them.
+    later = {}
+    for c in lib.read_counters(now=20.0):
+        for lc in c.links:
+            later[(lc.a, lc.b)] = lc
+    assert later[(0, 1)].tx_bytes > by_link[(0, 1)].tx_bytes
+    assert later[(0, 1)].link_id == "0-1"
+
+
+def test_link_error_injection_accumulates():
+    lib = _mock("constant:level=0.5")
+    lib.set_link_error_rate(0, 1, 50.0)
+    lib.read_counters(now=0.0)
+    errs = {(-1, -1): 0}
+    for c in lib.read_counters(now=2.0):
+        for lc in c.links:
+            errs[(lc.a, lc.b)] = lc.errors
+    assert errs[(0, 1)] == 100                  # 50/s x 2s
+    assert errs[(0, 2)] == 0
+    lib.set_link_error_rate(1, 0, 0.0)          # order-insensitive clear
+    for c in lib.read_counters(now=4.0):
+        for lc in c.links:
+            if (lc.a, lc.b) == (0, 1):
+                assert lc.errors == 100         # frozen, still cumulative
+
+
+def test_load_trace_env_seam():
+    lib = MockTpuLib("v5e-4", env={ALT_TPU_LOAD_TRACE_ENV:
+                                   "constant:level=0.33"})
+    lib.register_workload("w", (0,))
+    counters = {c.index: c for c in lib.read_counters(now=1.0)}
+    assert counters[0].duty_cycle == 0.33
+    assert lib.load_trace().level == 0.33
+
+
+def test_bad_spec_via_set_load_trace_raises():
+    lib = _mock()
+    with pytest.raises(LoadTraceError):
+        lib.set_load_trace("nope:x=1")
+    lib.set_load_trace(None)                    # clearing is fine
+    assert lib.load_trace() is None

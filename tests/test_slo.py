@@ -1,0 +1,178 @@
+"""SLO burn-rate evaluator: rule math, multi-window AND, event dedup.
+
+Pins docs/reference/telemetry.md's SLO layer: burn rate =
+bad_fraction / (1 - target) per window, an alert needs BOTH windows of a
+(long, short) pair above threshold, violation minutes accumulate only
+while burning, SLOBurnRate events dedup through the recorder correlator,
+and per-subject state is time- and LRU-bounded.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import EVENT, ResourceClaim
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg.events import REASON_SLO_BURN_RATE, EventRecorder
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.pkg.slo import SLOEvaluator, SLObjective
+
+
+def _evaluator(recorder=None, **kw):
+    return SLOEvaluator(Registry(), recorder=recorder, **kw)
+
+
+WINDOWS = ((100.0, 20.0),)
+
+
+def _objective(**kw):
+    defaults = dict(name="duty", target=0.90, bound=0.95, op="gt",
+                    windows=WINDOWS, burn_threshold=2.0)
+    defaults.update(kw)
+    return SLObjective(**defaults)
+
+
+def test_objective_validation():
+    assert _objective().is_bad(0.96) and not _objective().is_bad(0.95)
+    lt = _objective(name="ttr", op="lt", bound=5.0)
+    assert lt.is_bad(4.0) and not lt.is_bad(5.0)
+    with pytest.raises(ValueError):
+        _objective(op="between")
+    with pytest.raises(ValueError):
+        _objective(target=1.0)
+    with pytest.raises(ValueError):
+        _objective(target=0.0)
+
+
+def test_observe_unknown_slo_raises():
+    ev = _evaluator()
+    with pytest.raises(KeyError):
+        ev.observe("nope", 1.0, 0.5)
+
+
+def test_burn_rate_math():
+    """20 samples in the window, 4 bad, target 0.90: burn =
+    (4/20) / 0.10 = 2.0 exactly."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(20):
+        value = 0.99 if i % 5 == 0 else 0.5   # 4 of 20 bad
+        ev.observe("duty", 80.0 + i, value, subject=("ns", "c"))
+    alerts = ev.evaluate(100.0)
+    # Both the 100s window (all 20 samples) and the 20s window (samples
+    # at t>=80... all 20) burn at 2.0 -> fires at threshold.
+    assert alerts and alerts[0].burn_rate == pytest.approx(2.0)
+    assert ev.burn_gauge.value("duty", "100/20") == pytest.approx(2.0)
+
+
+def test_burn_gauge_decays_after_subject_goes_quiet():
+    """Regression: the burn gauge must fall back to 0 once a subject's
+    samples age out (claim unprepared, incident over) — the last
+    alert-level value must not stick on /metrics forever."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(20):
+        ev.observe("duty", 80.0 + i, 0.99, subject=("ns", "c"))  # all bad
+    assert ev.evaluate(100.0)
+    assert ev.burn_gauge.value("duty", "100/20") == pytest.approx(10.0)
+    # No further observations; everything ages past the longest window.
+    assert ev.evaluate(300.0) == []
+    assert ev.burn_gauge.value("duty", "100/20") == 0.0
+
+
+def test_alert_requires_both_windows():
+    """Long window still polluted, short window recovered: no alert —
+    the incident is over and alerting must stop immediately."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(50):
+        ev.observe("duty", float(i), 0.99, subject=("ns", "c"))   # all bad
+    for i in range(50, 100):
+        ev.observe("duty", float(i), 0.50, subject=("ns", "c"))   # recovered
+    alerts = ev.evaluate(100.0)
+    assert alerts == []
+    # And the gauge publishes the (low) effective burn, not the long
+    # window's scary one.
+    assert ev.burn_gauge.value("duty", "100/20") == 0.0
+
+
+def test_blip_never_alerts():
+    """One bad sample in an otherwise clean stream: the short window may
+    spike but the long window stays calm -> no alert."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(99):
+        ev.observe("duty", float(i), 0.5, subject=("ns", "c"))
+    ev.observe("duty", 99.0, 0.99, subject=("ns", "c"))
+    assert ev.evaluate(100.0) == []
+
+
+def test_violation_minutes_accumulate_only_while_burning():
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(160):
+        ev.observe("duty", float(i), 0.99, subject=("ns", "c"))
+    ev.evaluate(100.0)                      # first eval: dt unknown -> 0
+    ev.evaluate(160.0)                      # 1 minute burning
+    assert ev.violation_minutes.value("duty") == pytest.approx(1.0)
+    # Recovery: stream turns good, burn drops, minutes freeze.
+    for i in range(160, 260):
+        ev.observe("duty", float(i), 0.5, subject=("ns", "c"))
+    ev.evaluate(260.0)
+    ev.evaluate(320.0)
+    assert ev.violation_minutes.value("duty") == pytest.approx(1.0)
+
+
+def test_burnrate_event_dedup():
+    """A sustained violation across many evaluate() passes lands as ONE
+    stored SLOBurnRate Event with a rising count — the message carries no
+    live numbers precisely so the correlator can aggregate it."""
+    api = APIServer()
+    claim = api.create(ResourceClaim(meta=new_meta("hot", "default")))
+    rec = EventRecorder(api, "telemetry", burst=1000)
+    ev = _evaluator(recorder=rec)
+    ev.add(_objective())
+    for tick in range(100):
+        ev.observe("duty", float(tick), 0.99, subject=("default", "hot"),
+                   ref=claim)
+    for t in (100.0, 101.0, 102.0, 103.0):
+        assert ev.evaluate(t), "sustained overload must keep alerting"
+    events = [e for e in api.list(EVENT, namespace="default")
+              if e.reason == REASON_SLO_BURN_RATE]
+    assert len(events) == 1, [e.message for e in events]
+    assert events[0].count == 4
+    assert "duty" in events[0].message
+
+
+def test_one_event_per_subject_even_if_both_pairs_fire():
+    api = APIServer()
+    claim = api.create(ResourceClaim(meta=new_meta("hot", "default")))
+    rec = EventRecorder(api, "telemetry", burst=1000)
+    ev = _evaluator(recorder=rec)
+    ev.add(_objective(windows=((100.0, 20.0), (50.0, 10.0))))
+    for tick in range(100):
+        ev.observe("duty", float(tick), 0.99, subject=("default", "hot"),
+                   ref=claim)
+    alerts = ev.evaluate(100.0)
+    assert len(alerts) == 2                 # both pairs above threshold
+    events = [e for e in api.list(EVENT, namespace="default")
+              if e.reason == REASON_SLO_BURN_RATE]
+    assert len(events) == 1 and events[0].count == 1
+
+
+def test_history_pruned_to_longest_window():
+    ev = _evaluator()
+    ev.add(_objective(windows=((30.0, 10.0),)))
+    for i in range(200):
+        ev.observe("duty", float(i), 0.5, subject=("ns", "c"))
+    state = ev._subjects[("duty", ("ns", "c"))]
+    assert all(t >= 199.0 - 30.0 for t, _ in state.samples)
+
+
+def test_subject_lru_bound():
+    ev = _evaluator(max_subjects=4)
+    ev.add(_objective())
+    for i in range(10):
+        ev.observe("duty", 1.0, 0.5, subject=("ns", f"c{i}"))
+    assert len(ev._subjects) <= 4
+    # Most recent subjects survive.
+    assert ("duty", ("ns", "c9")) in ev._subjects

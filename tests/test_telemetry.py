@@ -1,0 +1,381 @@
+"""Fleet telemetry plane: ring buffers, quantized rollup, wire shape.
+
+Pins the tentpole invariants of docs/reference/telemetry.md:
+
+- RingSeries is bounded and its stats stream (no rescan for the mean);
+- quantized change gating — constant load produces EXACTLY ONE status
+  write, the first summary, and zero forever after;
+- the rollup joins node views to claim/domain gauges and summaries with
+  ZERO store list() calls per pass (domain membership rides the watch);
+- claim/domain gauge series key on namespace+name, are forgotten when
+  the object leaves the prepared set, and are LRU-bounded;
+- `utilizationSummary` round-trips the k8s wire on BOTH kinds and a WAL
+  restore with summaries present is fingerprint-token-identical;
+- the mini exposition parser `top nodes` uses reads escaped labels.
+"""
+
+import math
+
+import pytest
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    ComputeDomain,
+    ComputeDomainNode,
+    ComputeDomainPlacement,
+    ComputeDomainSpec,
+    ComputeDomainStatus,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    RESOURCE_CLAIM,
+    ResourceClaim,
+    UtilizationSummary,
+)
+from k8s_dra_driver_tpu.k8s.k8swire import from_k8s_wire, to_k8s_wire
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.pkg.telemetry import (
+    ClaimChips,
+    NodeView,
+    RingSeries,
+    TelemetryAggregator,
+    WindowStats,
+    parse_metrics_text,
+    quantize_summary,
+)
+from k8s_dra_driver_tpu.tpulib.loadtrace import percentile
+
+
+# -- ring buffers -------------------------------------------------------------
+
+
+def test_ring_bounded_and_ordered():
+    r = RingSeries(cap=4)
+    for i in range(10):
+        r.push(float(i), float(i) * 10)
+    assert len(r) == 4
+    assert r.values() == [60.0, 70.0, 80.0, 90.0]   # oldest first
+    assert r.times() == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_ring_stats_streaming_mean_and_p95():
+    r = RingSeries(cap=100)
+    vals = [float(i % 7) for i in range(250)]  # wraps 2.5x
+    for i, v in enumerate(vals):
+        r.push(float(i), v)
+    window = vals[-100:]
+    s = r.stats()
+    assert s.count == 100
+    assert s.last == window[-1]
+    assert s.min == min(window) and s.max == max(window)
+    assert math.isclose(s.mean, sum(window) / 100)
+    assert s.p95 == percentile(window, 0.95)
+    assert s.span_seconds == 99.0
+
+
+def test_ring_empty_and_validation():
+    assert RingSeries(3).stats() == WindowStats()
+    with pytest.raises(ValueError):
+        RingSeries(0)
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 0.95) == 0.0
+    assert percentile([7.0], 0.95) == 7.0
+    # Nearest-rank on 20 ordered values: p95 is the 19th (index 18).
+    vals = [float(i) for i in range(20)]
+    assert percentile(vals, 0.95) == 18.0
+    assert percentile(list(reversed(vals)), 0.95) == 18.0  # sorts a copy
+
+
+def test_window_stats_dict_roundtrip():
+    s = WindowStats(count=12, last=0.5, min=0.1, max=0.9, mean=0.45,
+                    p95=0.88, span_seconds=11.0)
+    assert WindowStats.from_dict(s.as_dict()) == s
+
+
+# -- quantization -------------------------------------------------------------
+
+
+def test_quantize_rounds_to_grid():
+    s = UtilizationSummary(duty_cycle_p95=0.6449, ici_utilization_p95=0.128,
+                           hbm_used_p95_bytes=(64 << 20) * 3 + 12345,
+                           window_seconds=13.7, samples=9)
+    q = quantize_summary(s)
+    assert q.duty_cycle_p95 == 0.64
+    assert q.ici_utilization_p95 == 0.13
+    assert q.hbm_used_p95_bytes == (64 << 20) * 3
+    assert q.window_seconds == 14.0
+
+
+def test_summary_equality_is_content_only():
+    """The change gate compares content: updated_at, window_seconds, and
+    samples (which grow every tick while the ring fills) are excluded —
+    with them included, even constant load would write status once per
+    sample for a whole window."""
+    a = UtilizationSummary(duty_cycle_p95=0.5, hbm_used_p95_bytes=1 << 30,
+                           window_seconds=10.0, samples=10, updated_at=1.0)
+    b = UtilizationSummary(duty_cycle_p95=0.5, hbm_used_p95_bytes=1 << 30,
+                           window_seconds=11.0, samples=11, updated_at=2.0)
+    assert a == b
+    assert a != UtilizationSummary(duty_cycle_p95=0.51,
+                                   hbm_used_p95_bytes=1 << 30)
+
+
+# -- rollup -------------------------------------------------------------------
+
+
+def _stats(last=0.6, p95=0.65, count=120, span=119.0):
+    return WindowStats(count=count, last=last, min=last, max=p95,
+                       mean=last, p95=p95, span_seconds=span)
+
+
+def _view(node="node-0", claim="c0", uid="u0", chips=(0, 1), duty=0.6,
+          hbm=4 << 30, link=0.3):
+    return NodeView(
+        node=node,
+        duty={i: _stats(duty, duty) for i in chips},
+        hbm_used={i: _stats(float(hbm), float(hbm)) for i in chips},
+        hbm_total={i: 16 << 30 for i in chips},
+        link_util=_stats(link, link),
+        claims=[ClaimChips(uid=uid, name=claim, namespace="default",
+                           chips=tuple(chips))],
+    )
+
+
+def _mk_api_with_claim(name="c0"):
+    api = APIServer()
+    api.create(ResourceClaim(meta=new_meta(name, "default")))
+    return api
+
+
+def test_rollup_claim_gauges_and_summary():
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    res = agg.rollup(1.0, [_view(duty=0.6, hbm=4 << 30)])
+    assert res.claims_seen == 1 and res.status_writes == 1
+    assert agg.claim_duty.value("default", "c0") == 0.6
+    assert agg.claim_hbm.value("default", "c0") == 2 * (4 << 30)  # 2 chips
+    got = api.get(RESOURCE_CLAIM, "c0", "default").utilization
+    assert got is not None
+    assert got.duty_cycle_p95 == 0.6
+    assert got.hbm_total_bytes == 2 * (16 << 30)
+    agg.close()
+
+
+def test_rollup_constant_load_writes_exactly_once():
+    """THE quantization pin: constant load -> one status write total,
+    zero on every later pass, even while window metadata still grows."""
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    writes = []
+    for tick in range(1, 11):
+        view = _view(duty=0.62, hbm=4 << 30)
+        # Window metadata grows as a filling ring would.
+        view.duty = {i: _stats(0.62, 0.62, count=tick, span=tick - 1.0)
+                     for i in (0, 1)}
+        writes.append(agg.rollup(float(tick), [view]).status_writes)
+    assert writes[0] == 1 and sum(writes) == 1, writes
+    agg.close()
+
+
+def test_rollup_write_on_real_movement_only():
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    assert agg.rollup(1.0, [_view(duty=0.60)]).status_writes == 1
+    # Sub-quantum wiggle: 0.602 rounds to the same 1% bucket as 0.60.
+    assert agg.rollup(2.0, [_view(duty=0.602)]).status_writes == 0
+    # A real move crosses the bucket.
+    assert agg.rollup(3.0, [_view(duty=0.75)]).status_writes == 1
+    agg.close()
+
+
+def test_rollup_zero_store_lists_per_pass():
+    api = _mk_api_with_claim()
+    cd = ComputeDomain(meta=new_meta("d0", "default"),
+                       spec=ComputeDomainSpec(num_nodes=1))
+    cd.status.nodes = [ComputeDomainNode(name="node-0")]
+    api.create(cd)
+    agg = TelemetryAggregator(api, Registry())  # bootstrap list happens here
+    before = api.stats.list_calls
+    for tick in range(1, 5):
+        res = agg.rollup(float(tick), [_view()])
+    assert res.domains_seen == 1
+    assert api.stats.list_calls == before, (
+        "rollup passes must ride the watch-fed caches, never list()")
+    agg.close()
+
+
+def test_rollup_domain_membership_via_watch():
+    """A domain created AFTER the aggregator exists reaches the rollup
+    through its watch — no relist."""
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    assert agg.rollup(1.0, [_view(link=0.4)]).domains_seen == 0
+    cd = ComputeDomain(meta=new_meta("late", "default"),
+                       spec=ComputeDomainSpec(num_nodes=1))
+    cd.status.nodes = [ComputeDomainNode(name="node-0")]
+    api.create(cd)
+    res = agg.rollup(2.0, [_view(link=0.4)])
+    assert res.domains_seen == 1
+    assert agg.domain_ici.value("default", "late") == 0.4
+    got = api.get(COMPUTE_DOMAIN, "late", "default").status.utilization
+    assert got is not None and got.ici_utilization_p95 == 0.4
+    # Placement membership (when recorded) wins over status.nodes.
+    def set_placement(obj):
+        obj.status.placement = ComputeDomainPlacement(
+            ici_domain="s0", nodes=["elsewhere"])
+    api.update_with_retry(COMPUTE_DOMAIN, "late", "default", set_placement)
+    assert agg.rollup(3.0, [_view(link=0.4)]).domains_seen == 0
+    agg.close()
+
+
+def test_rollup_forgets_departed_claims():
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    agg.rollup(1.0, [_view()])
+    assert agg.claim_duty.value("default", "c0") == 0.6
+    # Claim unprepared: the node view no longer carries it.
+    empty = _view()
+    empty.claims = []
+    agg.rollup(2.0, [empty])
+    assert ("default", "c0") not in agg.claim_summaries()
+    assert agg.claim_duty.value("default", "c0") == 0.0  # series forgotten
+
+
+def test_rollup_lru_bound_on_tracked_claims():
+    api = APIServer()
+    for i in range(12):
+        api.create(ResourceClaim(meta=new_meta(f"c{i}", "default")))
+    agg = TelemetryAggregator(api, Registry(), max_tracked=8)
+    views = [_view(node=f"n{i}", claim=f"c{i}", uid=f"u{i}")
+             for i in range(12)]
+    agg.rollup(1.0, views)
+    assert len(agg.claim_summaries()) <= 8
+    agg.close()
+
+
+def test_rollup_skips_chips_without_telemetry():
+    """A claim whose chips have produced no samples yet is skipped, not
+    reported as zero load."""
+    api = _mk_api_with_claim()
+    agg = TelemetryAggregator(api, Registry())
+    view = _view()
+    view.duty = {}
+    view.hbm_used = {}
+    res = agg.rollup(1.0, [view])
+    assert res.claims_seen == 0 and res.status_writes == 0
+    assert agg.claim_duty.value("default", "c0") == 0.0
+    agg.close()
+
+
+def test_rollup_survives_deleted_claim():
+    """The object vanishing between join and CAS is a skip, not a crash."""
+    api = APIServer()  # claim never exists
+    agg = TelemetryAggregator(api, Registry())
+    res = agg.rollup(1.0, [_view(claim="ghost", uid="g0")])
+    assert res.status_writes == 0
+    # And the gate state was dropped, so a recreated claim writes fresh.
+    assert ("default", "ghost") not in agg.claim_summaries()
+    agg.close()
+
+
+# -- wire + WAL ---------------------------------------------------------------
+
+
+def _roundtrip(obj):
+    wire = to_k8s_wire(obj)
+    back = to_k8s_wire(from_k8s_wire(wire))
+    assert wire == back, f"unstable k8s wire for {obj.kind}"
+    return from_k8s_wire(wire)
+
+
+def _summary():
+    return UtilizationSummary(
+        window_seconds=119.0, samples=120, duty_cycle_p95=0.64,
+        hbm_used_p95_bytes=6 << 30, hbm_total_bytes=32 << 30,
+        ici_utilization_p95=0.22, updated_at=1234.5)
+
+
+def _assert_summary_fields(got):
+    want = _summary()
+    for f in ("window_seconds", "samples", "duty_cycle_p95",
+              "hbm_used_p95_bytes", "hbm_total_bytes",
+              "ici_utilization_p95", "updated_at"):
+        assert getattr(got, f) == getattr(want, f), f
+
+
+def test_wire_claim_utilization_roundtrip():
+    rc = ResourceClaim(meta=new_meta("c", "ns"), utilization=_summary())
+    wire = to_k8s_wire(rc)
+    doc = wire["status"]["utilizationSummary"]
+    assert doc == {"windowSeconds": 119.0, "samples": 120,
+                   "dutyCycleP95": 0.64, "hbmUsedP95Bytes": 6 << 30,
+                   "hbmTotalBytes": 32 << 30, "iciUtilizationP95": 0.22,
+                   "updatedAt": 1234.5}
+    _assert_summary_fields(_roundtrip(rc).utilization)
+    # Absent summary stays absent (no empty stanza on the wire).
+    bare = to_k8s_wire(ResourceClaim(meta=new_meta("c2", "ns")))
+    assert "utilizationSummary" not in bare.get("status", {})
+
+
+def test_wire_computedomain_utilization_roundtrip():
+    cd = ComputeDomain(
+        meta=new_meta("dom", "ns"), spec=ComputeDomainSpec(num_nodes=2),
+        status=ComputeDomainStatus(status="Ready", utilization=_summary()))
+    wire = to_k8s_wire(cd)
+    assert wire["status"]["utilizationSummary"]["iciUtilizationP95"] == 0.22
+    _assert_summary_fields(_roundtrip(cd).status.utilization)
+
+
+def test_wal_restore_fingerprint_identical_with_summaries(tmp_path):
+    """Summaries written by the rollup survive a WAL restart with
+    fingerprint-TOKEN-identical state on both kinds."""
+    from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
+
+    d = str(tmp_path)
+    api = open_persistent_store(d)
+    api.create(ResourceClaim(meta=new_meta("c0", "default")))
+    cd = ComputeDomain(meta=new_meta("d0", "default"),
+                       spec=ComputeDomainSpec(num_nodes=1))
+    cd.status.nodes = [ComputeDomainNode(name="node-0")]
+    api.create(cd)
+    agg = TelemetryAggregator(api, Registry())
+    assert agg.rollup(1.0, [_view()]).status_writes == 2
+    agg.close()
+    tokens = {k: api.kind_fingerprint(k)
+              for k in (RESOURCE_CLAIM, COMPUTE_DOMAIN)}
+    api._wal.close()
+
+    restored = open_persistent_store(d)
+    for kind, want in tokens.items():
+        assert restored.kind_fingerprint(kind) == want
+    back = restored.get(RESOURCE_CLAIM, "c0", "default").utilization
+    assert back is not None and back.duty_cycle_p95 == 0.6
+    back_cd = restored.get(COMPUTE_DOMAIN, "d0", "default").status.utilization
+    assert back_cd is not None and back_cd.ici_utilization_p95 == 0.3
+    restored._wal.close()
+
+
+# -- exposition parser --------------------------------------------------------
+
+
+def test_parse_metrics_text():
+    text = '\n'.join([
+        "# HELP tpu_dra_chip_duty_cycle x",
+        "# TYPE tpu_dra_chip_duty_cycle gauge",
+        'tpu_dra_chip_duty_cycle{node="n0",chip="0"} 0.5',
+        'tpu_dra_chip_duty_cycle{node="n0",chip="1"} 0.75',
+        'tpu_dra_chip_duty_cycle{node="we\\"ird\\\\n\\nx",chip="0"} 1',
+        "tpu_dra_store_shards 16",
+        "garbage line without a value x",
+        "",
+    ])
+    out = parse_metrics_text(text)
+    duty = out["tpu_dra_chip_duty_cycle"]
+    assert duty[(("chip", "0"), ("node", "n0"))] == 0.5
+    assert duty[(("chip", "1"), ("node", "n0"))] == 0.75
+    assert duty[(("chip", "0"), ("node", 'we"ird\\n\nx'))] == 1.0
+    assert out["tpu_dra_store_shards"][()] == 16.0
+    assert "garbage" not in out

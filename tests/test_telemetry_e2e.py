@@ -1,0 +1,278 @@
+"""Fleet telemetry e2e — the ISSUE 11 acceptance scenario.
+
+A 4-host v5e-16 ComputeDomain runs under a seeded bursty load trace:
+
+1. `tpu-kubectl top computedomains` (and the domain's status
+   utilizationSummary) shows duty-cycle/HBM p95 matching the trace
+   generator's own ground truth within quantization — the sampler, ring
+   buffers, rollup, and CLI all agree with the generator they measure.
+2. An injected sustained overload trips `SLOBurnRate`: one deduplicated
+   Event per violating subject with a rising count, and the burn-rate /
+   violation-minutes metrics appear on the scrape.
+3. An injected ICI error-rate ramp degrades EXACTLY the spanning
+   devices of that link via the existing taint chain — endpoint chips
+   stay schedulable.
+
+Plus the surfacing tier on the same cluster: `describe` renders the
+UTILIZATION section, `top nodes` aggregates a real /metrics scrape
+(MetricsServer on the cluster-shared registry — one scrape covers the
+whole sim fleet, the `--metrics-port` satellite pin), and `top claims`
+ranks by duty.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    ICI_LINK_TAINT_KEY,
+    NODE,
+    POD,
+    RESOURCE_SLICE,
+    UNHEALTHY_TAINT_KEY,
+)
+from k8s_dra_driver_tpu.k8s.httpapi import HTTPAPIServer
+from k8s_dra_driver_tpu.pkg.events import (
+    REASON_DEVICE_DEGRADED,
+    REASON_SLO_BURN_RATE,
+    events_for,
+)
+from k8s_dra_driver_tpu.pkg.metrics import MetricsServer
+from k8s_dra_driver_tpu.pkg.telemetry import (
+    DEFAULT_WINDOW_SAMPLES,
+    DUTY_QUANTUM,
+    HBM_QUANTUM_BYTES,
+    parse_metrics_text,
+)
+from k8s_dra_driver_tpu.sim.cluster import (
+    CHAOS_LINK_ERRORS_ANNOTATION,
+    CHAOS_LOAD_TRACE_ANNOTATION,
+    SimCluster,
+)
+from k8s_dra_driver_tpu.sim.kubectl import (
+    describe_object,
+    load_manifests,
+    main as kubectl_main,
+)
+from k8s_dra_driver_tpu.tpulib.loadtrace import parse_load_trace
+from k8s_dra_driver_tpu.tpulib.profiles import GENS
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+CD_MANIFEST = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: grid}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: jax-domain, namespace: grid}
+spec:
+  numNodes: 4
+  channel:
+    resourceClaimTemplate: {name: jax-domain-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: grid}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+WORKER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: worker-%(i)d, namespace: grid}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: jax-domain-channel}
+"""
+
+# Bursty but never SLO-violating: peak 0.85 stays under the claim-duty
+# bound (0.95) and the domain-ICI bound (0.90), so phase 1 produces a
+# rich utilization signal with ZERO burn alerts.
+BURSTY = "bursty:seed=3,period=8,base=0.1,peak=0.85,duty=0.4"
+# Sustained overload: above both bounds on every sample.
+OVERLOAD = "constant:level=0.99"
+
+
+def _annotate_all_nodes(sim, key, value):
+    for name in list(sim.nodes):
+        def mutate(obj, v=value):
+            obj.meta.annotations[key] = v
+        sim.api.update_with_retry(NODE, name, "", mutate)
+
+
+def _window_times(sim, n=DEFAULT_WINDOW_SAMPLES):
+    """The trace-times of the samples currently in every full ring: the
+    sim pushes one sample per telemetry tick at telemetry_clock, which
+    advances telemetry_dt per pass."""
+    end = sim.telemetry_clock
+    dt = sim.telemetry_dt
+    return [end - (n - 1 - i) * dt for i in range(n)]
+
+
+def test_fleet_telemetry_acceptance(tmp_path, capsys):
+    sim = SimCluster(
+        workdir=str(tmp_path), profile="v5e-16",
+        gates="FleetTelemetry=true,TPUDeviceHealthCheck=true")
+    sim.start()
+    try:
+        for obj in load_manifests(CD_MANIFEST):
+            sim.api.create(obj)
+        for i in range(4):
+            for obj in load_manifests(WORKER % {"i": i}):
+                sim.api.create(obj)
+        sim.settle(max_steps=40)
+        workers = sim.api.list(POD, namespace="grid")
+        assert len(workers) == 4
+        assert all(p.phase == "Running" for p in workers), [
+            (p.meta.name, p.phase) for p in workers]
+
+        # ---- phase 1: seeded bursty trace vs generator ground truth ----
+        _annotate_all_nodes(sim, CHAOS_LOAD_TRACE_ANNOTATION, BURSTY)
+        sim.step()  # chaos pass installs the trace into every mock tpulib
+        # Fill every ring completely with post-trace samples so the
+        # window is EXACTLY the generator's output at known times.
+        for _ in range(DEFAULT_WINDOW_SAMPLES + 2):
+            sim._telemetry_pass()
+
+        cd = sim.api.get(COMPUTE_DOMAIN, "jax-domain", "grid")
+        u = cd.status.utilization
+        assert u is not None, "domain never got a utilizationSummary"
+        # samples/window_seconds are display metadata OUTSIDE the change
+        # gate: the stored doc is the last *quantized-change* write, so
+        # steady load stops churning resourceVersions (pinned exactly in
+        # test_telemetry.py::test_rollup_constant_load_writes_exactly_once).
+        assert u.samples >= 1
+
+        trace = parse_load_trace(BURSTY)
+        duty_truth, hbm_frac_truth = trace.ground_truth(_window_times(sim))
+        # All 16 member chips run the same trace, so the domain p95 is
+        # the per-chip p95 — equal to ground truth within quantization.
+        assert abs(u.duty_cycle_p95 - duty_truth) <= DUTY_QUANTUM, \
+            (u.duty_cycle_p95, duty_truth)
+        hbm_per_chip = GENS["v5e"].hbm_bytes
+        hbm_truth = int(hbm_frac_truth * hbm_per_chip) * 16
+        assert abs(u.hbm_used_p95_bytes - hbm_truth) <= HBM_QUANTUM_BYTES, \
+            (u.hbm_used_p95_bytes, hbm_truth)
+        assert u.hbm_total_bytes == hbm_per_chip * 16
+        # ICI utilization follows the same trace (mock links carry
+        # load-proportional traffic; monitor divides by the same gbps).
+        assert abs(u.ici_utilization_p95 - duty_truth) <= 2 * DUTY_QUANTUM, \
+            (u.ici_utilization_p95, duty_truth)
+
+        # Claims carry their own summaries, same truth per host.
+        for claim_key, s in sim.telemetry.claim_summaries().items():
+            assert abs(s.duty_cycle_p95 - duty_truth) <= DUTY_QUANTUM, \
+                (claim_key, s.duty_cycle_p95)
+
+        # Bursty-but-in-SLO load must not alert.
+        assert not [e for e in sim.api.list("Event", namespace="grid")
+                    if e.reason == REASON_SLO_BURN_RATE]
+
+        # ---- surfacing: describe + top over the real CLI ----
+        out = describe_object(sim.api, COMPUTE_DOMAIN, "jax-domain", "grid")
+        assert "Utilization:" in out and "Duty p95" in out
+
+        srv = HTTPAPIServer(api=sim.api).start()
+        metrics_srv = MetricsServer(sim.metrics_registry)
+        metrics_srv.start()
+        try:
+            rc = kubectl_main(["--server", srv.url,
+                               "top", "computedomains", "-n", "grid"])
+            assert rc == 0
+            top_out = capsys.readouterr().out
+            assert "jax-domain" in top_out
+            assert f"{100.0 * u.duty_cycle_p95:.0f}%" in top_out
+
+            rc = kubectl_main(["--server", srv.url,
+                               "top", "claims", "-n", "grid"])
+            assert rc == 0
+            claims_out = capsys.readouterr().out
+            for i in range(4):
+                assert f"worker-{i}-tpus" in claims_out
+
+            # One scrape of the shared registry covers the WHOLE fleet
+            # (the `sim run --metrics-port` satellite): every node's
+            # per-chip gauges are present, and `top nodes` renders them.
+            url = f"http://127.0.0.1:{metrics_srv.port}"
+            rc = kubectl_main(["--server", srv.url,
+                               "top", "nodes", "--metrics-url", url])
+            assert rc == 0
+            nodes_out = capsys.readouterr().out
+            for name in sim.nodes:
+                assert name in nodes_out
+            parsed = parse_metrics_text(sim.metrics_registry.expose())
+            scraped_nodes = {dict(labels)["node"]
+                             for labels in parsed["tpu_dra_chip_duty_cycle"]}
+            assert scraped_nodes == set(sim.nodes)
+        finally:
+            metrics_srv.stop()
+            srv.stop()
+
+        # ---- phase 2: sustained overload trips SLOBurnRate ----
+        _annotate_all_nodes(sim, CHAOS_LOAD_TRACE_ANNOTATION, OVERLOAD)
+        sim.step()
+        for _ in range(60):
+            sim._telemetry_pass()
+
+        burn_events = [e for e in sim.api.list("Event", namespace="grid")
+                       if e.reason == REASON_SLO_BURN_RATE]
+        assert burn_events, "sustained overload never tripped SLOBurnRate"
+        # Deduplicated: one Event row per (subject, message), count rising.
+        by_subject = {}
+        for e in burn_events:
+            key = (e.involved_object.name, e.message)
+            assert key not in by_subject, f"duplicate event series for {key}"
+            by_subject[key] = e
+        assert any(e.count >= 2 for e in burn_events), \
+            "sustained violation did not aggregate into a rising count"
+
+        parsed = parse_metrics_text(sim.metrics_registry.expose())
+        burns = [v for labels, v in parsed["tpu_dra_slo_burn_rate"].items()
+                 if dict(labels)["slo"] == "claim-duty-cycle"]
+        assert burns and max(burns) >= 2.0, burns
+        minutes = parsed["tpu_dra_slo_violation_minutes_total"]
+        assert any(v > 0 for v in minutes.values()), minutes
+
+        # ---- phase 3: ICI error ramp degrades exactly the spanning link ----
+        victim = next(iter(sorted(sim.nodes)))
+
+        def ramp(obj):
+            obj.meta.annotations[CHAOS_LINK_ERRORS_ANNOTATION] = "0-1=30"
+        sim.api.update_with_retry(NODE, victim, "", ramp)
+        sim.step()
+        for _ in range(10):
+            sim._telemetry_pass()
+        sim.settle(max_steps=5)
+
+        rs = next(s for s in sim.api.list(RESOURCE_SLICE)
+                  if s.node_name == victim and s.driver == "tpu.google.com")
+        allocatable = sim.nodes[victim].tpu_driver.state.allocatable
+        spanning = {name for name, dev in allocatable.items()
+                    if {0, 1} <= set(dev.chip_indices)}
+        tainted = {d.name for d in rs.devices
+                   if any(t.key == ICI_LINK_TAINT_KEY for t in d.taints)}
+        assert spanning, "profile has no device spanning chips 0-1"
+        assert tainted == spanning, (tainted, spanning)
+        # Endpoint chips stay schedulable: no chip-level unhealthy taints.
+        assert not any(t.key == UNHEALTHY_TAINT_KEY
+                       for d in rs.devices for t in d.taints)
+        node = sim.api.get(NODE, victim)
+        degraded = [e for e in events_for(sim.api, node)
+                    if e.reason == REASON_DEVICE_DEGRADED]
+        assert degraded and "ICI link 0-1" in degraded[-1].message
+        assert (f'tpu_dra_device_health{{node="{victim}",kind="link",id="0-1"}} 1'
+                in sim.metrics_registry.expose())
+    finally:
+        sim.stop()
