@@ -33,10 +33,13 @@ bench:
 # 2048-node scale-out gate (p99 claim-to-running budget, >=2x durable
 # sharded-vs-single-lock write throughput with 8 writer threads, zero
 # watch-ordering violations, fingerprint-identical WAL restore;
-# BENCH_SCALE_NODES overrides the node count — full runs use 8192).
-# Capped at 10 min.
+# BENCH_SCALE_NODES overrides the node count — full runs use 8192) +
+# the 1024-node serving-autoscaler day (SLO violation minutes and
+# wasted chip-hours vs the static baseline, zero burst flaps, zero
+# steady-state store lists; BENCH_AUTOSCALER_NODES overrides).
+# Capped at 15 min (the autoscaler day adds ~2.5 min at 1024 nodes).
 bench-smoke:
-	timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --smoke
+	timeout -k 10 900 env JAX_PLATFORMS=cpu python bench.py --smoke
 
 # Pre-merge gate: the tpulint invariant analyzer (which subsumes the
 # metrics-docs and event-reasons checks), the tpusan runtime concurrency

@@ -68,6 +68,18 @@ DEFAULT_SPECS: Tuple[WireKindSpec, ...] = (
                   "_conditions_decode"),
     ),
     WireKindSpec(
+        kind="ServingGroup",
+        dataclasses={
+            "k8s_dra_driver_tpu/api/servinggroup.py": (
+                "ServingGroup", "ServingGroupSpec", "ServingGroupStatus",
+                "ServingReplicaTemplate", "ServingSLO", "ServingTraffic",
+                "ServingScalingPolicy", "ServingTrafficStatus"),
+            _CONDITION[0]: _CONDITION[1],
+        },
+        encoders=("_servinggroup_encode", "_conditions_encode"),
+        decoders=("_servinggroup_decode", "_conditions_decode"),
+    ),
+    WireKindSpec(
         kind="ComputeDomainClique",
         dataclasses={
             _API_CD: ("ComputeDomainClique", "ComputeDomainDaemonInfo"),

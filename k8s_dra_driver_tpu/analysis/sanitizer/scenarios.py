@@ -33,6 +33,12 @@ test suite:
      batched prepare/unprepare churner under the pu flock: no guarded-by
      violations, no chip-set snapshot torn across a prepare, empty
      mirror/workload registry at quiescence.
+  7. ``autoscaler-scaledown-vs-consolidation`` — the serving
+     autoscaler's scale-down drain racing an energy-consolidation
+     migration on the SAME replica claim: the atomic cordon CAS
+     (``rebalancer.controller.try_cordon``) must hand the replica to
+     exactly one actor — never a double-migration, never a leaked ICI
+     partition, whichever side wins on whichever seed.
 
 - ``FIXTURES`` — seeded violations proving each detector class fires
   deterministically on ANY seed and at ANY worker count (the fillers):
@@ -598,6 +604,152 @@ def scenario_telemetry_sample_vs_prepare(state: SanitizerState, seed: int,
                    f"expected all 8")
 
 
+# -- scenario 7: autoscaler scale-down racing energy consolidation ------------
+
+
+def scenario_autoscaler_scaledown_vs_consolidation(
+        state: SanitizerState, seed: int, extra_workers: int = 0) -> None:
+    """The serving autoscaler's scale-down drain and the rebalancer's
+    energy-consolidation pass both want the same replica claim: the
+    drain retires it (delete + unprepare), the consolidator migrates it
+    to a busier host. Exactly one may win — the atomic cordon CAS is the
+    arbiter — and whichever side wins, the partition ledgers must agree
+    with the surviving state: a retired replica leaves ZERO active
+    partitions, a migrated one leaves exactly its partition on the
+    target. Both the double-migration and the leaked-partition failure
+    mode were reachable before try_cordon (the old blind cordon write
+    raced between the planner's snapshot and the annotation CAS)."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM
+    from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.flock import Flock
+    from k8s_dra_driver_tpu.pkg.partitioner import (
+        PartitionManager,
+        StubPartitionClient,
+    )
+    from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.rebalancer.controller import (
+        release_cordon,
+        try_cordon,
+    )
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    api = APIServer(shards=2)
+    with tempfile.TemporaryDirectory(prefix="tpusan-as-") as tmp:
+        stubs = {}
+        devs = {}
+        pu_paths = {}
+        for node in ("node-0", "node-1"):
+            stub = StubPartitionClient()
+            dev = DeviceState(
+                MockTpuLib("v5e-4"), os.path.join(tmp, node, "plugin"),
+                cdi_root=os.path.join(tmp, node, "cdi"),
+                gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+            )
+            dev.partitions = PartitionManager(dev.inventory.host_topology,
+                                              stub)
+            stubs[node], devs[node] = stub, dev
+            pu_paths[node] = os.path.join(tmp, node, "plugin", "pu.lock")
+        claim = _claim_for_devices(["tpu-subslice-1x2-at-0x0"], "sg-rep-0")
+        api.create(claim)
+        api.create(_pod("sg-rep-0"))
+        with Flock(pu_paths["node-0"]).hold():
+            devs["node-0"].prepare(claim)
+        outcomes: Dict[str, bool] = {}
+
+        def scaler():
+            # ServingGroupController._drain_replica's shape: cordon
+            # atomically, then retire the replica (delete pod + claim,
+            # unprepare frees the chips for the consolidator).
+            c = api.try_get(RESOURCE_CLAIM, "sg-rep-0", "default")
+            if c is None or not try_cordon(api, c, owner="autoscaler"):
+                return
+            outcomes["scaled"] = True
+            for kind, name in ((POD, "sg-rep-0"),
+                               (RESOURCE_CLAIM, "sg-rep-0")):
+                try:
+                    api.delete(kind, name, "default")
+                except NotFoundError:
+                    pass
+            state.yield_point(("scenario", "scaler"))
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].unprepare(claim.uid)
+
+        def consolidator():
+            # RebalanceController._migrate_unit's shape: cordon, migrate
+            # out of the emptiest host, prepare on the busier target,
+            # re-point the allocation, close the migration, uncordon.
+            c = api.try_get(RESOURCE_CLAIM, "sg-rep-0", "default")
+            if c is None or not try_cordon(api, c, owner="rebalancer"):
+                return
+            outcomes["migrated"] = True
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].migrate_out(claim.uid)
+            state.yield_point(("scenario", "consolidator"))
+            with Flock(pu_paths["node-1"]).hold():
+                devs["node-1"].prepare(claim)
+
+            def repoint(obj):
+                obj.allocation.node_name = "node-1"
+            try:
+                api.update_with_retry(RESOURCE_CLAIM, "sg-rep-0", "default",
+                                      repoint)
+            except NotFoundError:
+                pass
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].end_migration(claim.uid)
+            release_cordon(api, c)
+
+        explore(state, seed,
+                [("scaler", scaler), ("consolidator", consolidator)]
+                + _fillers(state, extra_workers))
+
+        _invariant(state, len(outcomes) == 1,
+                   f"cordon CAS admitted {sorted(outcomes)} — the same "
+                   f"replica was handled by both the scale-down drain and "
+                   f"the consolidation migration")
+        active_total = sum(len(s.active_ids()) for s in stubs.values())
+        if outcomes.get("scaled"):
+            _invariant(state, active_total == 0,
+                       f"retired replica left {active_total} active "
+                       f"partition(s) across the ledgers — leak")
+            _invariant(state,
+                       not devs["node-0"].prepared_claims()
+                       and not devs["node-1"].prepared_claims(),
+                       "retired replica left checkpoint entries behind")
+            _invariant(state,
+                       api.try_get(RESOURCE_CLAIM, "sg-rep-0",
+                                   "default") is None,
+                       "retired replica's claim survived the drain")
+        elif outcomes.get("migrated"):
+            _invariant(state,
+                       not stubs["node-0"].active_ids()
+                       and len(stubs["node-1"].active_ids()) == 1,
+                       f"migrated replica's ledgers read "
+                       f"src={stubs['node-0'].active_ids()} "
+                       f"dst={stubs['node-1'].active_ids()} — expected the "
+                       f"one partition on the target only")
+            entries = devs["node-1"].prepared_claims()
+            _invariant(state,
+                       not devs["node-0"].prepared_claims()
+                       and set(entries) == {claim.uid}
+                       and entries[claim.uid].state == PREPARE_COMPLETED,
+                       "migrated replica's checkpoints inconsistent "
+                       "(source entry not closed or target not completed)")
+            live = api.try_get(RESOURCE_CLAIM, "sg-rep-0", "default")
+            from k8s_dra_driver_tpu.rebalancer.controller import (
+                CORDON_ANNOTATION,
+            )
+            _invariant(state,
+                       live is not None
+                       and CORDON_ANNOTATION not in live.meta.annotations
+                       and live.allocation.node_name == "node-1",
+                       "migrated claim lost, still cordoned, or not "
+                       "re-pointed at the target")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -605,6 +757,8 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
     "events-correlator": scenario_events_correlator,
     "meshgen-reemit": scenario_meshgen_reemit,
     "telemetry-sample-vs-prepare": scenario_telemetry_sample_vs_prepare,
+    "autoscaler-scaledown-vs-consolidation":
+        scenario_autoscaler_scaledown_vs_consolidation,
 }
 
 

@@ -1,0 +1,170 @@
+"""ServingGroup CRD: the serving workload class the autoscaler scales.
+
+The "millions of users" story needs a workload that looks like production
+inference, not batch training: N identical replicas, each one pod plus
+one subslice ResourceClaim, fronted by a QPS stream and judged by a
+latency SLO. A ServingGroup declares exactly that — the replica template,
+the current per-replica subslice *tier* (``spec.profile``, chosen from
+the ordered ``spec.tiers``), the traffic model the sim engine drives,
+the latency/duty objectives, and the scaling policy knobs (cooldowns,
+stabilization window, tier thresholds) the autoscaler honors.
+
+The split of responsibilities mirrors a real HPA stack:
+
+- the **traffic engine** (autoscaler/traffic.py) senses: QPS from the
+  trace, per-replica utilization and latency from the queueing model,
+  written back as quantized change-gated ``status.traffic`` and observed
+  into the SLO evaluator;
+- the **controller** (autoscaler/controller.py) actuates: stamps replica
+  pods+claims to ``spec.replicas``, garbage-collects scale-downs, and
+  moves ``spec.replicas``/``spec.profile`` under policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.k8s.conditions import Condition
+from k8s_dra_driver_tpu.k8s.objects import K8sObject
+
+SERVING_GROUP = "ServingGroup"
+
+# Labels stamped on every replica pod AND its claim: the group label is
+# how the traffic engine / autoscaler watch-feed their caches (no store
+# scans), the tier label is how a rolling re-tier tells old-tier replicas
+# from their replacements.
+SERVING_GROUP_LABEL = "serving.tpu.google.com/group"
+SERVING_TIER_LABEL = "serving.tpu.google.com/tier"
+# Replica slot index annotation (indices are reused lowest-free so names
+# stay stable across scale cycles).
+SERVING_REPLICA_ANNOTATION = "serving.tpu.google.com/replica-index"
+
+# The empty tier: one whole chip via the plain TPU device class (the
+# smallest unit the allocator hands out without DynamicSubslice).
+TIER_SINGLE_CHIP = ""
+
+
+def tier_chips(profile: str) -> int:
+    """Chips per replica at a tier: the subslice profile's area, or 1 for
+    the single-chip tier."""
+    if not profile:
+        return 1
+    dims = [int(d) for d in profile.lower().split("x")]
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+@dataclass
+class ServingSLO:
+    """Declared objectives. ``latency_p95_ms`` is the page bound the
+    traffic engine normalizes against (observed/bound > 1 is a bad
+    sample); ``duty_bound`` rides the existing claim-duty SLO."""
+
+    latency_p95_ms: float = 50.0
+    duty_bound: float = 0.95
+
+
+@dataclass
+class ServingTraffic:
+    """The sim traffic model. ``trace`` is a tpulib.loadtrace spec
+    (diurnal/bursty/playback); generator kinds scale to ``peak_qps``,
+    playback samples are raw QPS. ``qps_per_chip`` is the replica's
+    service capacity per chip at duty 1.0; ``base_latency_ms`` the
+    unloaded service time the M/M/1-style latency curve grows from."""
+
+    trace: str = ""
+    peak_qps: float = 100.0
+    qps_per_chip: float = 10.0
+    base_latency_ms: float = 10.0
+
+
+@dataclass
+class ServingScalingPolicy:
+    """Autoscaler knobs (docs/reference/autoscaling.md). All times are
+    VIRTUAL seconds — the telemetry clock, never wall time."""
+
+    min_replicas: int = 1
+    max_replicas: int = 64
+    # Size replicas so predicted per-replica utilization sits here.
+    target_duty: float = 0.6
+    # Scale-up reacts fast (bounded only by its own cooldown); scale-down
+    # additionally waits out the stabilization window: the effective
+    # desired count is the MAX over the window, so a bursty trace never
+    # flaps (classic HPA stabilization semantics).
+    scale_up_cooldown_s: float = 15.0
+    scale_down_cooldown_s: float = 60.0
+    stabilization_window_s: float = 120.0
+    # Vertical policy: down-tier when the group's observed duty p95 stays
+    # under this for the stabilization window (and no latency alert).
+    down_tier_duty: float = 0.25
+    tier_cooldown_s: float = 300.0
+
+
+@dataclass
+class ServingReplicaTemplate:
+    """Per-replica pod shape (one container; the claim is generated)."""
+
+    image: str = "serving"
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServingGroupSpec:
+    replicas: int = 1
+    # Current per-replica subslice tier ("1x1", "1x2", "2x2", ... or ""
+    # for a single whole chip). The autoscaler moves this within `tiers`.
+    profile: str = TIER_SINGLE_CHIP
+    # Ordered smallest-first tiers vertical scaling may choose from;
+    # empty disables vertical scaling.
+    tiers: List[str] = field(default_factory=list)
+    template: ServingReplicaTemplate = field(
+        default_factory=ServingReplicaTemplate)
+    slo: ServingSLO = field(default_factory=ServingSLO)
+    traffic: ServingTraffic = field(default_factory=ServingTraffic)
+    policy: ServingScalingPolicy = field(default_factory=ServingScalingPolicy)
+
+
+@dataclass
+class ServingTrafficStatus:
+    """The traffic engine's last quantized sample, change-gated like
+    UtilizationSummary so steady load never churns resourceVersions
+    (``updated_at`` is display metadata outside the equality gate)."""
+
+    qps: float = 0.0
+    latency_ms: float = 0.0
+    # observed latency / declared bound; > 1.0 is an SLO violation.
+    latency_ratio: float = 0.0
+    # offered per-replica utilization (rho) clamped to [0, 1].
+    utilization: float = 0.0
+    ready_replicas: int = 0
+    updated_at: float = field(default=0.0, compare=False)
+
+
+@dataclass
+class ServingGroupStatus:
+    desired_replicas: int = 0
+    ready_replicas: int = 0
+    # Tier actually stamped on current replicas (trails spec.profile
+    # while a rolling re-tier is in flight).
+    profile: str = TIER_SINGLE_CHIP
+    # Virtual timestamps of the last scaling actions (cooldown anchors).
+    last_scale_up: float = 0.0
+    last_scale_down: float = 0.0
+    last_retier: float = 0.0
+    traffic: Optional[ServingTrafficStatus] = None
+    conditions: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class ServingGroup(K8sObject):
+    kind: str = SERVING_GROUP
+    spec: ServingGroupSpec = field(default_factory=ServingGroupSpec)
+    status: ServingGroupStatus = field(default_factory=ServingGroupStatus)
+
+
+def replica_capacity_qps(spec: ServingGroupSpec) -> float:
+    """QPS one replica serves at duty 1.0."""
+    return max(1e-9, spec.traffic.qps_per_chip * tier_chips(spec.profile))

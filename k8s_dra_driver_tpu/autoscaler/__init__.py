@@ -1,0 +1,34 @@
+"""SLO-driven serving autoscaler (docs/reference/autoscaling.md).
+
+- :mod:`k8s_dra_driver_tpu.autoscaler.traffic` — the sim traffic engine:
+  per-ServingGroup QPS traces through a queueing model into the
+  telemetry plane (sensing).
+- :mod:`k8s_dra_driver_tpu.autoscaler.controller` — the ServingGroup
+  controller: replica stamping, scale-down GC, horizontal + vertical
+  scaling closed on SLO burn-rate alerts and utilization rollups
+  (actuation).
+"""
+
+from k8s_dra_driver_tpu.autoscaler.controller import (
+    ScaleDecision,
+    ServingGroupController,
+)
+from k8s_dra_driver_tpu.autoscaler.traffic import (
+    GroupSample,
+    SERVING_LATENCY_SLO,
+    TrafficEngine,
+    group_qps,
+    model_latency_ms,
+    offered_utilization,
+)
+
+__all__ = [
+    "GroupSample",
+    "SERVING_LATENCY_SLO",
+    "ScaleDecision",
+    "ServingGroupController",
+    "TrafficEngine",
+    "group_qps",
+    "model_latency_ms",
+    "offered_utilization",
+]

@@ -1,0 +1,574 @@
+"""ServingGroupController: replica stamping plus the closed scaling loop.
+
+The actuating half of the serving loop. Every virtual tick, fed by the
+traffic engine's samples, the SLO evaluator's ``active_alerts()``
+snapshot, and the telemetry rollup's claim summaries, the controller:
+
+**Decides** (per group, under policy):
+
+- *Horizontal up* — demand-tracking: whenever the demand-sized count
+  (``ceil(qps / (capacity x target_duty))``) exceeds ``spec.replicas``,
+  raise it (bounded by ``max_replicas`` and the scale-up cooldown). An
+  active ``serving-latency`` burn alert additionally forces at least
+  one extra replica per tick even when the demand formula claims
+  capacity is adequate — the SLO keeps stepping the group up until the
+  incident clears. The resulting replica storm is identical-shaped
+  claims, so PR 8's gang admission resolves the whole batch against
+  ONE feasibility computation.
+- *Horizontal down* — the demand count must stay below ``spec.replicas``
+  for the WHOLE stabilization window (the effective desired count is
+  the max over the window — classic HPA semantics, so a bursty trace
+  never flaps), the scale-down cooldown must have passed, and no alert
+  may be active. Reclaimed chips are freed through the normal
+  unprepare path; with the rebalancer's energy mode on they consolidate
+  onto fewer hosts (``tpu_dra_reclaimable_hosts`` rises).
+- *Vertical down-tier* — observed duty p95 across the group's claims
+  sustained under ``down_tier_duty`` moves ``spec.profile`` one step
+  down ``spec.tiers``; replicas then roll to the new tier (surge first,
+  drain after), riding the same cordon protocol as the live-repack
+  migration unit so the rebalancer and the autoscaler never double-
+  handle one replica.
+- Decisions blocked by cooldown or stabilization emit ``ScaleDeferred``.
+
+**Reconciles**: stamps replica pods + claims to ``spec.replicas`` at
+``spec.profile`` (indices reused lowest-free), garbage-collects
+scale-downs (victims on the emptiest hosts first, cordon-acquired
+atomically via :func:`rebalancer.controller.try_cordon`), drains
+replicas of deleted groups, and rolls old-tier replicas out once their
+replacements are Running.
+
+Every decision runs under a tracing span and narrates through
+``ScaleUp`` / ``ScaleDown`` / ``ScaleDeferred`` events whose messages
+carry no live numbers — a sustained trough is ONE ScaleDown series with
+a rising count. Zero store ``list()`` calls in the steady-state pass:
+everything reads the traffic engine's watch-fed caches.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from k8s_dra_driver_tpu.api.servinggroup import (
+    SERVING_GROUP,
+    SERVING_GROUP_LABEL,
+    SERVING_REPLICA_ANNOTATION,
+    SERVING_TIER_LABEL,
+    ServingGroup,
+    replica_capacity_qps,
+)
+from k8s_dra_driver_tpu.autoscaler.traffic import (
+    SERVING_LATENCY_SLO,
+    GroupSample,
+    TrafficEngine,
+)
+from k8s_dra_driver_tpu.controller.templates import DEVICE_CLASS_TPU
+from k8s_dra_driver_tpu.k8s.core import (
+    Container,
+    DeviceRequest,
+    POD,
+    Pod,
+    PodResourceClaimRef,
+    RESOURCE_CLAIM,
+    ResourceClaim,
+    UtilizationSummary,
+)
+from k8s_dra_driver_tpu.k8s.objects import (
+    AlreadyExistsError,
+    NotFoundError,
+    new_meta,
+)
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_SCALE_DEFERRED,
+    REASON_SCALE_DOWN,
+    REASON_SCALE_UP,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
+from k8s_dra_driver_tpu.rebalancer.controller import (
+    release_cordon,
+    try_cordon,
+)
+
+log = logging.getLogger(__name__)
+
+# Subslice device class (sim/chart name): tier profiles select by the
+# published profile attribute, the same CEL shape the rebalancer's
+# demand detector recognizes.
+DEVICE_CLASS_SUBSLICE = "subslice.tpu.google.com"
+TPU_ATTR_DOMAIN = "tpu.google.com"
+
+# Event messages are CONSTANT per (reason, cause): the correlator dedups
+# a sustained condition into one Event row with a rising count.
+MSG_SCALE_UP = ("scaling up: demand above the target utilization or a "
+                "serving-latency burn alert is active")
+MSG_SCALE_DOWN = ("scaling down: demand stayed below the target "
+                  "utilization for the whole stabilization window")
+MSG_TIER_DOWN = ("down-tiering replica subslice profile: observed duty "
+                 "sustained below the down-tier threshold")
+MSG_DEFERRED = ("scale decision deferred by cooldown, stabilization "
+                "window, or an active burn alert")
+
+_Key = Tuple[str, str]
+
+# Safety margin on the SLO floor: scale-down never targets a count whose
+# predicted utilization sits closer than this to the latency-violating
+# rho (ratio 1.0 at rho = 1 - base/bound in the M/M/1 model).
+SLO_FLOOR_MARGIN = 0.95
+
+
+@dataclass
+class ScaleDecision:
+    """One group's verdict for one tick (returned for tests/bench)."""
+
+    key: _Key
+    direction: str = "none"   # up | down | tier-down | deferred | none
+    desired: int = 0          # demand-sized replica count (pre-policy)
+    applied: int = 0          # spec.replicas after this tick
+
+
+class ServingGroupController:
+    """Owns actuation; senses through the shared :class:`TrafficEngine`
+    caches. ``clock`` is the VIRTUAL clock (the sim's telemetry clock) —
+    wall time never enters a scaling decision."""
+
+    def __init__(self, api, metrics_registry: Registry,
+                 engine: TrafficEngine,
+                 recorder: Optional[EventRecorder] = None):
+        self.api = api
+        self.engine = engine
+        self.recorder = recorder or EventRecorder(
+            api, "autoscaler", metrics_registry=metrics_registry)
+        r = metrics_registry
+        self.desired_gauge = r.register(Gauge(
+            "tpu_dra_autoscaler_desired_replicas",
+            "Demand-sized replica count per ServingGroup "
+            "(ceil(qps / (capacity x target_duty)), pre-policy).",
+            ("namespace", "name")))
+        self.ready_gauge = r.register(Gauge(
+            "tpu_dra_autoscaler_ready_replicas",
+            "Ready replicas per ServingGroup.",
+            ("namespace", "name")))
+        self.scale_total = r.register(Counter(
+            "tpu_dra_autoscaler_scale_total",
+            "Scaling decisions applied or deferred, by direction "
+            "(up / down / tier-down / deferred).",
+            ("direction",)))
+        self.pass_seconds = r.register(Gauge(
+            "tpu_dra_autoscaler_pass_seconds",
+            "Wall time of the last autoscaler pass."))
+        # (ns, name) -> recent (t, demand-desired) history; the scale-down
+        # stabilization window reads its max.
+        self._desired_history: Dict[_Key, Deque[Tuple[float, int]]] = {}
+        # (ns, name) -> virtual time this controller first saw the group:
+        # scale-down is gated on a FULL stabilization window of
+        # observation, so a freshly created (or freshly re-adopted after
+        # controller restart) pre-provisioned group is never torn down on
+        # a single low sample.
+        self._first_seen: Dict[_Key, float] = {}
+
+    # -- the pass ------------------------------------------------------------
+
+    def step(self, now: float, samples: Dict[_Key, GroupSample],
+             alerts=None,
+             claim_summaries: Optional[Dict[_Key, UtilizationSummary]] = None,
+             ) -> List[ScaleDecision]:
+        """One autoscaler tick. ``alerts`` is the SLO evaluator's
+        ``active_alerts()`` snapshot (already filtered to this pass);
+        ``claim_summaries`` the telemetry rollup's per-claim summaries
+        (vertical re-tier and victim ranking read them)."""
+        t0 = time.perf_counter()
+        decisions: List[ScaleDecision] = []
+        alerting: Set[_Key] = {
+            a.subject for a in (alerts or ())
+            if a.slo == SERVING_LATENCY_SLO
+        }
+        with tracing.span("autoscaler.pass") as sp:
+            for key, sample in samples.items():
+                try:
+                    decisions.append(self._step_group(
+                        key, sample, now, key in alerting,
+                        claim_summaries or {}))
+                except Exception:  # noqa: BLE001 — one bad group must not stall the fleet
+                    log.exception("autoscaler pass failed for %s/%s", *key)
+            # Replicas whose group vanished: drain (no ownerRef GC path
+            # covers ServingGroups) — and drop their decision history,
+            # or a churn of short-lived groups grows it without bound.
+            for pod in self.engine.orphan_replicas():
+                self._drain_replica(pod)
+            for key in [k for k in self._desired_history
+                        if k not in samples]:
+                del self._desired_history[key]
+                self._first_seen.pop(key, None)
+            sp.attrs["groups"] = len(samples)
+            sp.attrs["scaled"] = sum(
+                1 for d in decisions if d.direction in ("up", "down"))
+        self.pass_seconds.set(value=time.perf_counter() - t0)
+        return decisions
+
+    def _step_group(self, key: _Key, sample: GroupSample, now: float,
+                    alerting: bool,
+                    claim_summaries: Dict[_Key, UtilizationSummary],
+                    ) -> ScaleDecision:
+        group = sample.group
+        spec = group.spec
+        policy = spec.policy
+        cap = replica_capacity_qps(spec)
+        demand = math.ceil(sample.qps / max(1e-9, cap * policy.target_duty))
+        desired = max(policy.min_replicas,
+                      min(policy.max_replicas, demand))
+        self.desired_gauge.set(key[0], key[1], value=float(desired))
+        self.ready_gauge.set(key[0], key[1], value=float(sample.ready))
+        first_seen = self._first_seen.setdefault(key, now)
+        hist = self._desired_history.setdefault(key, deque())
+        hist.append((now, desired))
+        horizon = now - policy.stabilization_window_s
+        while hist and hist[0][0] < horizon:
+            hist.popleft()
+        stabilized = max(d for _, d in hist)
+        # Down-gates only open after a full window of observation AND
+        # cooldown measured from observation start, not the virtual
+        # epoch — an operator's pre-provisioned headroom survives the
+        # first low tick.
+        observed_long_enough = (
+            now - first_seen >= policy.stabilization_window_s)
+        down_cooldown_ok = (
+            now - max(group.status.last_scale_down, first_seen)
+            >= policy.scale_down_cooldown_s)
+        decision = ScaleDecision(key=key, desired=desired,
+                                 applied=spec.replicas)
+
+        cur = spec.replicas
+        # Scale-up is demand-tracking (demand > current) — a slow ramp
+        # never waits for the SLO to burn. An active burn alert
+        # ADDITIONALLY forces at least one replica even when the demand
+        # formula claims capacity is adequate (a too-tight target_duty,
+        # a mis-sized policy): the alert path keeps stepping until the
+        # incident clears, which is what "closed on the SLO" means.
+        # The latency model's own floor: the replica count below which
+        # predicted utilization crosses the ratio-1.0 point
+        # (rho = 1 - base/bound in M/M/1), with a safety margin. Scale-
+        # down never goes under it — that is what keeps the alert-built
+        # capacity from being torn down into a fresh incident (the
+        # overshoot/undershoot limit cycle a pure demand formula with a
+        # too-tight target_duty produces).
+        rho_safe = max(0.05, SLO_FLOOR_MARGIN * (
+            1.0 - spec.traffic.base_latency_ms
+            / max(1e-9, spec.slo.latency_p95_ms)))
+        slo_floor = math.ceil(sample.qps / max(1e-9, cap * rho_safe))
+        # An active alert forces at least one extra replica ONLY while
+        # the current sample still violates: the burn alert is a
+        # trailing indicator (its short window drains over several
+        # ticks), and stepping on a recovered sample would overshoot all
+        # the way to max_replicas before the alert clears.
+        push = alerting and sample.latency_ratio > 1.0
+        # `demand` (unclamped) gates the branch so wanting more than
+        # max_replicas surfaces as a deferral, not silence; `desired`
+        # (clamped) covers the min-replicas floor on an undersized group.
+        if demand > cur or desired > cur or push:
+            target = min(policy.max_replicas,
+                         max(desired, cur + 1 if push else 0))
+            if target <= cur:
+                # Clamped by max_replicas while still wanting up.
+                self._defer(group, decision)
+            elif (now - group.status.last_scale_up
+                    >= policy.scale_up_cooldown_s):
+                self._apply_scale(group, target, now, up=True)
+                decision.direction, decision.applied = "up", target
+            else:
+                self._defer(group, decision)
+        elif stabilized < cur:
+            target = max(policy.min_replicas, stabilized,
+                         min(slo_floor, policy.max_replicas))
+            if target >= cur:
+                pass  # the SLO floor holds the alert-built capacity
+            elif not alerting and observed_long_enough and down_cooldown_ok:
+                self._apply_scale(group, target, now, up=False)
+                decision.direction, decision.applied = "down", target
+            else:
+                self._defer(group, decision)
+        elif desired < cur:
+            # Wants down, but the stabilization window still remembers
+            # higher demand — the anti-flap path a bursty trace exercises.
+            self._defer(group, decision)
+        if decision.direction in ("none",) and self._maybe_down_tier(
+                group, sample, now, alerting, claim_summaries):
+            decision.direction = "tier-down"
+        self._reconcile(key, now)
+        return decision
+
+    def _defer(self, group: ServingGroup, decision: ScaleDecision) -> None:
+        decision.direction = "deferred"
+        self.scale_total.inc("deferred")
+        self.recorder.normal(group, REASON_SCALE_DEFERRED, MSG_DEFERRED)
+
+    def _apply_scale(self, group: ServingGroup, target: int, now: float,
+                     up: bool) -> None:
+        with tracing.span("autoscaler.scale", group=group.key,
+                          direction="up" if up else "down", target=target):
+            def mutate(obj, target=target, now=now, up=up):
+                obj.spec.replicas = target
+                obj.status.desired_replicas = target
+                if up:
+                    obj.status.last_scale_up = now
+                else:
+                    obj.status.last_scale_down = now
+            try:
+                updated = self.api.update_with_retry(
+                    SERVING_GROUP, group.meta.name, group.meta.namespace,
+                    mutate)
+            except NotFoundError:
+                return
+            # The engine cache must see the new spec before reconcile.
+            self.engine.ingest_local(SERVING_GROUP, "MODIFIED", updated)
+        self.scale_total.inc("up" if up else "down")
+        self.recorder.normal(group, REASON_SCALE_UP if up
+                             else REASON_SCALE_DOWN,
+                             MSG_SCALE_UP if up else MSG_SCALE_DOWN)
+
+    # -- vertical ------------------------------------------------------------
+
+    def _maybe_down_tier(self, group: ServingGroup, sample: GroupSample,
+                         now: float, alerting: bool,
+                         claim_summaries: Dict[_Key, UtilizationSummary],
+                         ) -> bool:
+        spec = group.spec
+        policy = spec.policy
+        if alerting or not spec.tiers:
+            return False
+        try:
+            idx = spec.tiers.index(spec.profile)
+        except ValueError:
+            return False
+        if idx == 0:
+            return False  # already the smallest tier
+        if now - group.status.last_retier < policy.tier_cooldown_s:
+            return False
+        # Observed duty p95 across the group's replica claims (telemetry
+        # rollup ground truth, not the model): every replica must be
+        # measurably idle for a full window before shrinking its slice.
+        duties = []
+        for pod in self.engine.replicas(sample.key):
+            claim = self.engine.claim_for(pod)
+            if claim is None:
+                continue
+            s = claim_summaries.get((claim.meta.namespace, claim.meta.name))
+            if s is not None:
+                duties.append(s.duty_cycle_p95)
+        if not duties or len(duties) < sample.ready:
+            return False
+        if max(duties) >= policy.down_tier_duty:
+            return False
+        new_tier = spec.tiers[idx - 1]
+        with tracing.span("autoscaler.retier", group=group.key,
+                          tier=new_tier):
+            def mutate(obj, new_tier=new_tier, now=now):
+                obj.spec.profile = new_tier
+                obj.status.last_retier = now
+            try:
+                updated = self.api.update_with_retry(
+                    SERVING_GROUP, group.meta.name, group.meta.namespace,
+                    mutate)
+            except NotFoundError:
+                return False
+            self.engine.ingest_local(SERVING_GROUP, "MODIFIED", updated)
+        self.scale_total.inc("tier-down")
+        self.recorder.normal(group, REASON_SCALE_DOWN, MSG_TIER_DOWN)
+        return True
+
+    # -- reconcile -----------------------------------------------------------
+
+    def _reconcile(self, key: _Key, now: float) -> None:
+        """Stamp replicas to (spec.replicas, spec.profile): create
+        missing current-tier replicas, drain excess (emptiest hosts
+        first), and roll old-tier replicas out once their replacements
+        are Running."""
+        group = self.engine.groups().get(key)
+        if group is None:
+            return
+        spec = group.spec
+        pods = self.engine.replicas(key)
+        cur_tier = [p for p in pods
+                    if p.meta.labels.get(SERVING_TIER_LABEL, "")
+                    == spec.profile]
+        cur_names = {p.meta.name for p in cur_tier}
+        old_tier = [p for p in pods if p.meta.name not in cur_names]
+        ready_cur = [p for p in cur_tier if self.engine.replica_ready(p)]
+        missing = spec.replicas - len(cur_tier)
+        if missing > 0:
+            used = self._used_indices(pods)
+            for _ in range(missing):
+                idx = self._lowest_free(used)
+                used.add(idx)
+                self._create_replica(group, idx, spec.profile)
+        elif missing < 0:
+            for pod in self._victims(cur_tier, -missing):
+                self._drain_replica(pod)
+        if old_tier and len(ready_cur) >= spec.replicas:
+            # Surge satisfied: replacements are serving, the old tier can
+            # go. Rolling by whole tier is safe — the drains are cordon-
+            # guarded, so a concurrent consolidation pass never touches
+            # the same replica.
+            drained_all = True
+            for pod in old_tier:
+                drained_all = self._drain_replica(pod) and drained_all
+            if drained_all:
+                old_tier = []
+        elif old_tier and (now - group.status.last_retier
+                           > spec.policy.stabilization_window_s):
+            # Surge stalled: the new tier has waited a full stabilization
+            # window without reaching spec.replicas — on a capacity-tight
+            # cluster the old tier is HOLDING the chips the replacements
+            # need. Yield capacity one old replica per pass (the smaller
+            # profile always fits in the chips a bigger one frees), so
+            # the roll degrades to a rolling replace instead of wedging
+            # in surge forever.
+            for pod in self._victims(old_tier, 1):
+                self._drain_replica(pod)
+        # Change-gated status sync: desired follows spec; the stamped
+        # profile follows spec.profile once no old-tier replica remains.
+        sync_profile = (not old_tier
+                        and group.status.profile != spec.profile)
+        if group.status.desired_replicas != spec.replicas or sync_profile:
+            def sync(obj, replicas=spec.replicas, profile=spec.profile,
+                     sync_profile=sync_profile):
+                obj.status.desired_replicas = replicas
+                if sync_profile:
+                    obj.status.profile = profile
+            try:
+                updated = self.api.update_with_retry(
+                    SERVING_GROUP, group.meta.name, group.meta.namespace,
+                    sync)
+                self.engine.ingest_local(SERVING_GROUP, "MODIFIED", updated)
+            except NotFoundError:
+                pass
+
+    @staticmethod
+    def _used_indices(pods: List[Pod]) -> Set[int]:
+        out: Set[int] = set()
+        for p in pods:
+            try:
+                out.add(int(p.meta.annotations.get(
+                    SERVING_REPLICA_ANNOTATION, "-1")))
+            except ValueError:
+                continue
+        out.discard(-1)
+        return out
+
+    @staticmethod
+    def _lowest_free(used: Set[int]) -> int:
+        idx = 0
+        while idx in used:
+            idx += 1
+        return idx
+
+    def _victims(self, pods: List[Pod], count: int) -> List[Pod]:
+        """Emptiest replicas first: not-yet-ready before serving ones,
+        then fewest serving claims on the replica's node (the chips the
+        energy consolidator reclaims fastest), name tie-break."""
+        fill = self.engine.serving_node_fill()
+
+        def rank(pod: Pod):
+            claim = self.engine.claim_for(pod)
+            node = (claim.allocation.node_name
+                    if claim is not None and claim.allocation is not None
+                    else "")
+            return (self.engine.replica_ready(pod),
+                    fill.get(node, 0), pod.meta.name)
+
+        return sorted(pods, key=rank)[:count]
+
+    def _tier_requests(self, profile: str) -> List[DeviceRequest]:
+        if not profile:
+            return [DeviceRequest(name="tpus",
+                                  device_class_name=DEVICE_CLASS_TPU,
+                                  count=1)]
+        return [DeviceRequest(
+            name="tpus", device_class_name=DEVICE_CLASS_SUBSLICE, count=1,
+            cel_selectors=[
+                f'device.attributes["{TPU_ATTR_DOMAIN}"].profile '
+                f'== "{profile}"'])]
+
+    def _create_replica(self, group: ServingGroup, index: int,
+                        tier: str) -> None:
+        ns = group.meta.namespace
+        labels = {SERVING_GROUP_LABEL: group.meta.name,
+                  SERVING_TIER_LABEL: tier}
+        pod_name = f"{group.meta.name}-rep-{index}"
+        claim_name = f"{pod_name}-tpus"
+        with tracing.span("autoscaler.replica.create", pod=pod_name,
+                          tier=tier):
+            try:
+                self.api.create(ResourceClaim(
+                    meta=new_meta(claim_name, ns, labels=dict(labels)),
+                    requests=self._tier_requests(tier)))
+            except AlreadyExistsError:
+                pass  # crash-retry: the pod create below is idempotent too
+            pod = Pod(
+                meta=new_meta(pod_name, ns, labels=dict(labels)),
+                containers=[Container(name="serving",
+                                      image=group.spec.template.image,
+                                      env=dict(group.spec.template.env))],
+                resource_claims=[PodResourceClaimRef(
+                    name="tpus", resource_claim_name=claim_name)],
+            )
+            pod.meta.annotations[SERVING_REPLICA_ANNOTATION] = str(index)
+            pod.add_owner(group)
+            try:
+                created = self.api.create(pod)
+            except AlreadyExistsError:
+                # Crash-retry: the pod survived a half-completed prior
+                # attempt — fall through so the claim still gets its
+                # ownerRef (skipping here would strand an owner-less
+                # claim past the pod's GC).
+                created = self.api.try_get(POD, pod_name, ns)
+                if created is None:
+                    return
+            # Pod owns the claim so ownerRef GC collects it with the pod
+            # even when the drain path is skipped (group deletion).
+            def own(obj, created=created):
+                obj.add_owner(created)
+            try:
+                self.api.update_with_retry(RESOURCE_CLAIM, claim_name, ns, own)
+            except NotFoundError:
+                pass
+            self.engine.ingest_local(POD, "ADDED", created)
+
+    def _drain_replica(self, pod: Pod) -> bool:
+        """Retire one replica: cordon its claim atomically (losing the
+        race to a live-repack migration skips — retry next tick), then
+        delete pod + claim; the unprepare happens through the normal
+        claim GC, freeing the chips for the energy consolidator. Any
+        failure after the cordon was acquired releases it on the way
+        out — a half-drained replica must stay drainable on the next
+        tick, not read as someone else's in-flight migration forever."""
+        with tracing.span("autoscaler.replica.drain", pod=pod.key):
+            claim = self.engine.claim_for(pod)
+            if claim is not None and not try_cordon(self.api, claim,
+                                                    owner="autoscaler"):
+                return False  # mid-migration: the rebalancer owns it now
+            try:
+                try:
+                    self.api.delete(POD, pod.meta.name, pod.meta.namespace)
+                except NotFoundError:
+                    pass
+                self.engine.ingest_local(POD, "DELETED", pod)
+                if claim is not None:
+                    try:
+                        self.api.delete(RESOURCE_CLAIM, claim.meta.name,
+                                        claim.meta.namespace)
+                    except NotFoundError:
+                        # Already collected: nothing left to uncordon.
+                        pass
+                    self.engine.ingest_local(RESOURCE_CLAIM, "DELETED", claim)
+                return True
+            except Exception:  # noqa: BLE001 — transient API failure: undo the cordon, retry next tick
+                log.exception("drain of %s failed mid-way", pod.key)
+                if claim is not None:
+                    release_cordon(self.api, claim)
+                return False

@@ -1,0 +1,381 @@
+"""Sim traffic engine: QPS traces through a queueing model into telemetry.
+
+The sensing half of the serving loop. Each ServingGroup declares a
+traffic model (a ``tpulib.loadtrace`` spec — diurnal, bursty, or a
+recorded ``playback`` trace — plus capacity constants); every virtual
+tick the engine:
+
+1. evaluates the group's QPS at trace-time ``now`` (generator kinds
+   scale to ``peak_qps``; playback samples are raw QPS);
+2. spreads it across the group's READY replicas and runs a simple
+   M/M/1-style latency model: offered per-replica utilization
+   ``rho = qps / (ready x capacity)``, latency ``base / (1 - rho)``
+   (saturating when rho >= 1);
+3. feeds per-replica duty into the mock tpulib's workload-registration
+   path (``set_workload_load`` per claim uid), so PR 11's chip counters,
+   claim rollups, and ``top`` output reflect serving load with a
+   deterministic ground truth — the generator itself;
+4. observes ``latency / declared bound`` into the SLO evaluator's
+   ``serving-latency`` objective (bound 1.0: a ratio above 1 is a bad
+   sample), whose burn-rate alerts the autoscaler closes on;
+5. writes a quantized, change-gated ``status.traffic`` doc (steady load
+   never churns resourceVersions — the telemetry plane's discipline).
+
+Zero store ``list()`` calls per pass: groups, replica pods, and claims
+ride watch-fed caches bootstrapped once at construction, exactly like
+the telemetry aggregator (bench_autoscaler pins the invariant).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue as _queue
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.api.servinggroup import (
+    SERVING_GROUP,
+    SERVING_GROUP_LABEL,
+    ServingGroup,
+    ServingTrafficStatus,
+    replica_capacity_qps,
+)
+from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM, Pod, ResourceClaim
+from k8s_dra_driver_tpu.k8s.objects import ConflictError, NotFoundError
+from k8s_dra_driver_tpu.tpulib.loadtrace import (
+    LoadTrace,
+    LoadTraceError,
+    parse_load_trace,
+)
+
+log = logging.getLogger(__name__)
+
+# The shared serving-latency objective: every group observes its
+# normalized latency (observed / declared bound) against bound 1.0, so
+# one SLO name covers groups with different absolute bounds and the burn
+# gauge's label vocabulary stays fixed.
+SERVING_LATENCY_SLO = "serving-latency"
+SERVING_LATENCY_TARGET = 0.90
+SERVING_LATENCY_BURN_THRESHOLD = 2.0
+# Window pair in TICKS, scaled by the virtual tick length at lazy
+# registration so a bench running 300 s ticks alerts after the same
+# number of observations as the 1 s-tick e2e.
+SERVING_LATENCY_WINDOW_TICKS = (30.0, 10.0)
+
+# Saturated-queue latency clamp: with rho >= 1 the M/M/1 queue grows
+# without bound; the model reports base x this factor (the "page is on
+# fire" plateau) instead of a division by zero.
+SATURATED_LATENCY_FACTOR = 1000.0
+
+# status.traffic quantization steps (the change-gate grid).
+QPS_QUANTUM = 0.1
+LATENCY_MS_QUANTUM = 0.1
+RATIO_QUANTUM = 0.01
+
+_Key = Tuple[str, str]
+
+
+def group_qps(trace: LoadTrace, peak_qps: float, t: float) -> float:
+    """QPS at trace-time ``t``: playback samples are raw QPS, generator
+    kinds are duty curves in [0, 1] scaled to ``peak_qps``."""
+    if trace.kind == "playback":
+        return max(0.0, trace.raw_value(t))
+    return max(0.0, peak_qps * trace.value(t))
+
+
+def offered_utilization(qps: float, ready: int, capacity_qps: float) -> float:
+    """Per-replica offered utilization (rho). Infinite with no replica
+    serving — the model's way of saying every request is failing."""
+    if ready <= 0:
+        return math.inf
+    return qps / (ready * capacity_qps)
+
+
+def model_latency_ms(base_ms: float, rho: float) -> float:
+    """M/M/1 mean latency ``base / (1 - rho)``, saturating at
+    ``base x SATURATED_LATENCY_FACTOR`` once the queue stops draining."""
+    if rho >= 0.999:
+        return base_ms * SATURATED_LATENCY_FACTOR
+    return base_ms / (1.0 - rho)
+
+
+@dataclass
+class GroupSample:
+    """One group's traffic verdict for one tick — what the autoscaler
+    consumes next to the SLO alert snapshot."""
+
+    key: _Key
+    group: ServingGroup
+    qps: float = 0.0
+    ready: int = 0
+    rho: float = 0.0            # offered per-replica utilization (may be inf)
+    duty: float = 0.0           # rho clamped to [0, 1]: the chips' duty
+    latency_ms: float = 0.0
+    latency_ratio: float = 0.0  # latency / declared bound; > 1 violates
+
+
+class TrafficEngine:
+    """``claim_load_sink(node, claim_uid, duty)`` installs one replica's
+    duty into that node's mock tpulib (None node entries are skipped) —
+    the seam the sim wires to ``MockTpuLib.set_workload_load``."""
+
+    def __init__(self, api, metrics_registry, slo_evaluator,
+                 claim_load_sink: Callable[[str, str, float], None]):
+        from k8s_dra_driver_tpu.k8s.informer import INFORMER_WATCH_QUEUE_MAXSIZE
+        from k8s_dra_driver_tpu.pkg.metrics import Gauge
+
+        self.api = api
+        self.slo = slo_evaluator
+        self.claim_load_sink = claim_load_sink
+        r = metrics_registry
+        self.qps_gauge = r.register(Gauge(
+            "tpu_dra_autoscaler_group_qps",
+            "Offered load (QPS) per ServingGroup, from the traffic model.",
+            ("namespace", "name")))
+        self.ratio_gauge = r.register(Gauge(
+            "tpu_dra_autoscaler_group_latency_ratio",
+            "Modeled serving latency over the declared p95 bound per "
+            "ServingGroup (> 1.0 violates the SLO).",
+            ("namespace", "name")))
+        self.util_gauge = r.register(Gauge(
+            "tpu_dra_autoscaler_group_utilization",
+            "Offered per-replica utilization (rho, clamped to [0, 1]) per "
+            "ServingGroup.",
+            ("namespace", "name")))
+        # Watch-fed caches, one bootstrap listing each at construction;
+        # passes never list(). Replica pods are indexed by their group
+        # key (the label is the cache admission test anyway), so the
+        # per-tick lookups are O(replicas-of-group), not O(all pods).
+        self._groups: Dict[_Key, ServingGroup] = {}
+        self._pods_by_group: Dict[_Key, Dict[str, Pod]] = {}
+        self._claims: Dict[_Key, ResourceClaim] = {}
+        self._traces: Dict[str, LoadTrace] = {}       # spec string -> parsed
+        self._written: Dict[_Key, ServingTrafficStatus] = {}  # change gates
+        # Groups that have had at least one ready replica: the SLO only
+        # starts judging a group once it has ever served — a cold-start
+        # bring-up is not an incident, a later drop to zero replicas IS.
+        self._served: set = set()
+        self._slo_registered = False
+        self._watches = {
+            SERVING_GROUP: api.watch(SERVING_GROUP,
+                                     maxsize=INFORMER_WATCH_QUEUE_MAXSIZE),
+            POD: api.watch(POD, maxsize=INFORMER_WATCH_QUEUE_MAXSIZE),
+            RESOURCE_CLAIM: api.watch(RESOURCE_CLAIM,
+                                      maxsize=INFORMER_WATCH_QUEUE_MAXSIZE),
+        }
+        for sg in api.list(SERVING_GROUP):
+            self._ingest(SERVING_GROUP, "ADDED", sg)
+        for pod in api.list(POD):
+            self._ingest(POD, "ADDED", pod)
+        for claim in api.list(RESOURCE_CLAIM):
+            self._ingest(RESOURCE_CLAIM, "ADDED", claim)
+
+    def close(self) -> None:
+        for kind, q in self._watches.items():
+            self.api.stop_watch(kind, q)
+
+    # -- caches --------------------------------------------------------------
+
+    def _ingest(self, kind: str, ev_type: str, obj) -> None:
+        key = (obj.meta.namespace, obj.meta.name)
+        if kind == SERVING_GROUP:
+            if ev_type == "DELETED":
+                self._groups.pop(key, None)
+                self._written.pop(key, None)
+                self._served.discard(key)
+                for g in (self.qps_gauge, self.ratio_gauge, self.util_gauge):
+                    g.forget_matching(namespace=key[0], name=key[1])
+                return
+            self._groups[key] = obj
+            return
+        # Pods/claims: only the serving fleet (group-labeled) is cached,
+        # so a big batch cluster doesn't grow the serving caches.
+        gname = obj.meta.labels.get(SERVING_GROUP_LABEL)
+        if not gname:
+            return
+        if kind == POD:
+            gkey = (obj.meta.namespace, gname)
+            bucket = self._pods_by_group.setdefault(gkey, {})
+            if ev_type == "DELETED":
+                bucket.pop(obj.meta.name, None)
+                if not bucket:
+                    self._pods_by_group.pop(gkey, None)
+            else:
+                bucket[obj.meta.name] = obj
+            return
+        if ev_type == "DELETED":
+            self._claims.pop(key, None)
+        else:
+            self._claims[key] = obj
+
+    def ingest_local(self, kind: str, ev_type: str, obj) -> None:
+        """Apply a write this process just made to the caches without
+        waiting for the watch echo — the controller's read-your-writes
+        path (the echo arrives later and is idempotent)."""
+        self._ingest(kind, ev_type, obj)
+
+    def drain(self) -> None:
+        for kind, q in self._watches.items():
+            while True:
+                try:
+                    ev = q.get_nowait()
+                except _queue.Empty:
+                    break
+                self._ingest(kind, ev.type, ev.obj)
+
+    # -- read-side views (the controller shares these caches) ----------------
+
+    def groups(self) -> Dict[_Key, ServingGroup]:
+        return dict(self._groups)
+
+    def replicas(self, key: _Key) -> List[Pod]:
+        """Live replica pods of one group, name-sorted."""
+        return sorted(self._pods_by_group.get(key, {}).values(),
+                      key=lambda p: p.meta.name)
+
+    def orphan_replicas(self) -> List[Pod]:
+        """Replica pods whose ServingGroup no longer exists — the
+        controller drains these (there is no ownerRef GC for groups)."""
+        return [
+            p for gkey, bucket in self._pods_by_group.items()
+            if gkey not in self._groups
+            for p in bucket.values()
+        ]
+
+    def claim_for(self, pod: Pod) -> Optional[ResourceClaim]:
+        for ref in pod.resource_claims:
+            if ref.resource_claim_name:
+                c = self._claims.get((pod.meta.namespace,
+                                      ref.resource_claim_name))
+                if c is not None:
+                    return c
+        return None
+
+    def serving_node_fill(self) -> Dict[str, int]:
+        """Allocated serving claims per node — the scale-down victim
+        ranking's emptiest-host signal (cache-fed, no store scan)."""
+        fill: Dict[str, int] = {}
+        for c in self._claims.values():
+            if c.allocation is not None and c.allocation.node_name:
+                fill[c.allocation.node_name] = (
+                    fill.get(c.allocation.node_name, 0) + 1)
+        return fill
+
+    @staticmethod
+    def replica_ready(pod: Pod) -> bool:
+        return pod.phase == "Running" and pod.ready and not pod.deleting
+
+    # -- the pass ------------------------------------------------------------
+
+    def _trace_for(self, spec: str) -> Optional[LoadTrace]:
+        if not spec:
+            return None
+        trace = self._traces.get(spec)
+        if trace is None:
+            try:
+                trace = parse_load_trace(spec)
+            except LoadTraceError as e:
+                log.warning("serving trace %r rejected: %s", spec, e)
+                # Negative-cache as a flat zero so one bad spec does not
+                # re-parse (and re-log) every tick.
+                trace = LoadTrace(kind="constant", level=0.0, spec=spec)
+            self._traces[spec] = trace
+        return trace
+
+    def _ensure_slo(self, dt: float) -> None:
+        if self._slo_registered or self.slo is None:
+            return
+        if not self.slo.has(SERVING_LATENCY_SLO):
+            from k8s_dra_driver_tpu.pkg.slo import SLObjective
+
+            long_w, short_w = SERVING_LATENCY_WINDOW_TICKS
+            self.slo.add(SLObjective(
+                name=SERVING_LATENCY_SLO,
+                description="modeled serving latency within the declared "
+                            "per-group p95 bound (normalized ratio)",
+                target=SERVING_LATENCY_TARGET, bound=1.0, op="gt",
+                windows=((long_w * dt, short_w * dt),),
+                burn_threshold=SERVING_LATENCY_BURN_THRESHOLD))
+        self._slo_registered = True
+
+    def step(self, now: float, dt: float = 1.0) -> Dict[_Key, GroupSample]:
+        """One traffic tick over every ServingGroup."""
+        self.drain()
+        self._ensure_slo(dt)
+        samples: Dict[_Key, GroupSample] = {}
+        for key, group in self._groups.items():
+            samples[key] = self._step_group(key, group, now)
+        return samples
+
+    def _step_group(self, key: _Key, group: ServingGroup,
+                    now: float) -> GroupSample:
+        spec = group.spec
+        trace = self._trace_for(spec.traffic.trace)
+        qps = group_qps(trace, spec.traffic.peak_qps, now) if trace else 0.0
+        pods = self.replicas(key)
+        ready = [p for p in pods if self.replica_ready(p)]
+        cap = replica_capacity_qps(spec)
+        rho = offered_utilization(qps, len(ready), cap)
+        duty = min(1.0, max(0.0, 0.0 if math.isinf(rho) else rho))
+        latency = model_latency_ms(spec.traffic.base_latency_ms,
+                                   min(rho, 1.0))
+        ratio = latency / max(1e-9, spec.slo.latency_p95_ms)
+        sample = GroupSample(key=key, group=group, qps=qps, ready=len(ready),
+                             rho=rho, duty=duty, latency_ms=latency,
+                             latency_ratio=ratio)
+        # Per-replica duty into the workload-registration path: counters
+        # on the replica's chips now follow the serving model. A replica
+        # that is NOT ready serves nothing — its duty is written as 0 so
+        # a ready→unready transition (node drain, failed probe) cannot
+        # leave the last serving duty stuck on still-prepared chips while
+        # the same QPS is redistributed to the survivors (double-count).
+        for pod in pods:
+            claim = self.claim_for(pod)
+            if (claim is None or not claim.uid
+                    or claim.allocation is None
+                    or not claim.allocation.node_name):
+                continue
+            self.claim_load_sink(
+                claim.allocation.node_name, claim.uid,
+                duty if self.replica_ready(pod) else 0.0)
+        if sample.ready > 0:
+            self._served.add(key)
+        if (self.slo is not None and trace is not None
+                and (sample.ready > 0 or key in self._served)):
+            self.slo.observe(SERVING_LATENCY_SLO, now, ratio, subject=key,
+                             ref=group)
+        self.qps_gauge.set(key[0], key[1], value=qps)
+        self.ratio_gauge.set(key[0], key[1], value=ratio)
+        self.util_gauge.set(key[0], key[1], value=duty)
+        self._write_status(key, sample, now)
+        return sample
+
+    # -- status --------------------------------------------------------------
+
+    def _write_status(self, key: _Key, s: GroupSample, now: float) -> None:
+        def q(v: float, step: float) -> float:
+            if math.isinf(v):
+                v = 10.0 / RATIO_QUANTUM  # render saturation finitely
+            return round(round(v / step) * step, 6)
+
+        doc = ServingTrafficStatus(
+            qps=q(s.qps, QPS_QUANTUM),
+            latency_ms=q(s.latency_ms, LATENCY_MS_QUANTUM),
+            latency_ratio=q(s.latency_ratio, RATIO_QUANTUM),
+            utilization=q(s.duty, RATIO_QUANTUM),
+            ready_replicas=s.ready,
+            updated_at=now,
+        )
+        prev = self._written.get(key)
+        self._written[key] = doc
+        if prev == doc:
+            return
+
+        def mutate(obj, doc=doc):
+            obj.status.traffic = doc
+            obj.status.ready_replicas = doc.ready_replicas
+        try:
+            self.api.update_with_retry(SERVING_GROUP, key[1], key[0], mutate)
+        except (NotFoundError, ConflictError):
+            self._written.pop(key, None)

@@ -31,6 +31,16 @@ from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainSpec,
     ComputeDomainStatus,
 )
+from k8s_dra_driver_tpu.api.servinggroup import (
+    ServingGroup,
+    ServingGroupSpec,
+    ServingGroupStatus,
+    ServingReplicaTemplate,
+    ServingScalingPolicy,
+    ServingSLO,
+    ServingTraffic,
+    ServingTrafficStatus,
+)
 from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.pkg.meshgen import MeshBundle, MeshDevice
 from k8s_dra_driver_tpu.k8s.core import (
@@ -83,6 +93,7 @@ RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
     "DeviceClass": ("resource.k8s.io/v1", "deviceclasses", False),
     "ComputeDomain": ("resource.tpu.google.com/v1beta1", "computedomains", True),
     "ComputeDomainClique": ("resource.tpu.google.com/v1beta1", "computedomaincliques", True),
+    "ServingGroup": ("resource.tpu.google.com/v1beta1", "servinggroups", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
     "ValidatingWebhookConfiguration": (
         "admissionregistration.k8s.io/v1", "validatingwebhookconfigurations",
@@ -1049,6 +1060,133 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
     )
 
 
+def _servinggroup_encode(sg: ServingGroup) -> Dict[str, Any]:
+    """resource.tpu.google.com/v1beta1 ServingGroup. Spelled out
+    field-for-field so the wire-drift checker audits the whole object
+    graph on both sides."""
+    s = sg.spec
+    spec: Dict[str, Any] = {
+        "replicas": s.replicas,
+        "profile": s.profile,
+        "template": {
+            "image": s.template.image,
+            "env": dict(s.template.env),
+        },
+        "slo": {
+            "latencyP95Ms": s.slo.latency_p95_ms,
+            "dutyBound": s.slo.duty_bound,
+        },
+        "traffic": {
+            "trace": s.traffic.trace,
+            "peakQps": s.traffic.peak_qps,
+            "qpsPerChip": s.traffic.qps_per_chip,
+            "baseLatencyMs": s.traffic.base_latency_ms,
+        },
+        "policy": {
+            "minReplicas": s.policy.min_replicas,
+            "maxReplicas": s.policy.max_replicas,
+            "targetDuty": s.policy.target_duty,
+            "scaleUpCooldownSeconds": s.policy.scale_up_cooldown_s,
+            "scaleDownCooldownSeconds": s.policy.scale_down_cooldown_s,
+            "stabilizationWindowSeconds": s.policy.stabilization_window_s,
+            "downTierDuty": s.policy.down_tier_duty,
+            "tierCooldownSeconds": s.policy.tier_cooldown_s,
+        },
+    }
+    if s.tiers:
+        spec["tiers"] = list(s.tiers)
+    st = sg.status
+    status: Dict[str, Any] = {
+        "desiredReplicas": st.desired_replicas,
+        "readyReplicas": st.ready_replicas,
+        "profile": st.profile,
+    }
+    if st.last_scale_up:
+        status["lastScaleUp"] = st.last_scale_up
+    if st.last_scale_down:
+        status["lastScaleDown"] = st.last_scale_down
+    if st.last_retier:
+        status["lastRetier"] = st.last_retier
+    if st.traffic is not None:
+        t = st.traffic
+        status["traffic"] = {
+            "qps": t.qps,
+            "latencyMs": t.latency_ms,
+            "latencyRatio": t.latency_ratio,
+            "utilization": t.utilization,
+            "readyReplicas": t.ready_replicas,
+            "updatedAt": t.updated_at,
+        }
+    if st.conditions:
+        status["conditions"] = _conditions_encode(st.conditions)
+    return {"spec": spec, "status": status}
+
+
+def _servinggroup_decode(doc: Dict[str, Any]) -> ServingGroup:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    tmpl = spec.get("template") or {}
+    slo = spec.get("slo") or {}
+    traffic = spec.get("traffic") or {}
+    policy = spec.get("policy") or {}
+    tdoc = status.get("traffic")
+    return ServingGroup(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        spec=ServingGroupSpec(
+            replicas=int(spec.get("replicas", 1)),
+            profile=spec.get("profile", ""),
+            tiers=[str(t) for t in spec.get("tiers") or []],
+            template=ServingReplicaTemplate(
+                image=tmpl.get("image", "serving"),
+                env={k: str(v) for k, v in (tmpl.get("env") or {}).items()},
+            ),
+            slo=ServingSLO(
+                latency_p95_ms=float(slo.get("latencyP95Ms", 50.0)),
+                duty_bound=float(slo.get("dutyBound", 0.95)),
+            ),
+            traffic=ServingTraffic(
+                trace=traffic.get("trace", ""),
+                peak_qps=float(traffic.get("peakQps", 100.0)),
+                qps_per_chip=float(traffic.get("qpsPerChip", 10.0)),
+                base_latency_ms=float(traffic.get("baseLatencyMs", 10.0)),
+            ),
+            policy=ServingScalingPolicy(
+                min_replicas=int(policy.get("minReplicas", 1)),
+                max_replicas=int(policy.get("maxReplicas", 64)),
+                target_duty=float(policy.get("targetDuty", 0.6)),
+                scale_up_cooldown_s=float(
+                    policy.get("scaleUpCooldownSeconds", 15.0)),
+                scale_down_cooldown_s=float(
+                    policy.get("scaleDownCooldownSeconds", 60.0)),
+                stabilization_window_s=float(
+                    policy.get("stabilizationWindowSeconds", 120.0)),
+                down_tier_duty=float(policy.get("downTierDuty", 0.25)),
+                tier_cooldown_s=float(policy.get("tierCooldownSeconds", 300.0)),
+            ),
+        ),
+        status=ServingGroupStatus(
+            desired_replicas=int(status.get("desiredReplicas", 0)),
+            ready_replicas=int(status.get("readyReplicas", 0)),
+            profile=status.get("profile", ""),
+            last_scale_up=float(status.get("lastScaleUp", 0.0)),
+            last_scale_down=float(status.get("lastScaleDown", 0.0)),
+            last_retier=float(status.get("lastRetier", 0.0)),
+            traffic=(
+                ServingTrafficStatus(
+                    qps=float(tdoc.get("qps", 0.0)),
+                    latency_ms=float(tdoc.get("latencyMs", 0.0)),
+                    latency_ratio=float(tdoc.get("latencyRatio", 0.0)),
+                    utilization=float(tdoc.get("utilization", 0.0)),
+                    ready_replicas=int(tdoc.get("readyReplicas", 0)),
+                    updated_at=float(tdoc.get("updatedAt", 0.0)),
+                )
+                if tdoc else None
+            ),
+            conditions=_conditions_decode(status.get("conditions") or []),
+        ),
+    )
+
+
 def _clique_encode(cl: ComputeDomainClique) -> Dict[str, Any]:
     return {
         "domainUid": cl.domain_uid,
@@ -1186,6 +1324,7 @@ _ENCODERS = {
     "DeviceClass": _deviceclass_encode,
     "ComputeDomain": _computedomain_encode,
     "ComputeDomainClique": _clique_encode,
+    "ServingGroup": _servinggroup_encode,
     "Lease": _lease_encode,
     "ValidatingWebhookConfiguration": _vwc_encode,
 }
@@ -1201,6 +1340,7 @@ _DECODERS = {
     "DeviceClass": _deviceclass_decode,
     "ComputeDomain": _computedomain_decode,
     "ComputeDomainClique": _clique_decode,
+    "ServingGroup": _servinggroup_decode,
     "Lease": _lease_decode,
     "ValidatingWebhookConfiguration": _vwc_decode,
 }

@@ -18,6 +18,7 @@ from k8s_dra_driver_tpu.k8s.objects import K8sObject
 # Importing for side effect: registers every kind as a K8sObject subclass.
 import k8s_dra_driver_tpu.k8s.core  # noqa: F401
 import k8s_dra_driver_tpu.api.computedomain  # noqa: F401
+import k8s_dra_driver_tpu.api.servinggroup  # noqa: F401
 
 
 def _all_subclasses(cls: type) -> list[type]:

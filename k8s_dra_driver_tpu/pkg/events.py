@@ -72,6 +72,12 @@ REASON_CLAIM_MIGRATED = "ClaimMigrated"
 REASON_MIGRATION_FAILED = "MigrationFailed"
 # SLO layer (pkg/slo.py burn-rate evaluator)
 REASON_SLO_BURN_RATE = "SLOBurnRate"
+# Serving autoscaler (autoscaler/controller.py). Messages carry no live
+# replica counts, so one sustained trough dedups into ONE ScaleDown
+# series with a rising count instead of a row per decision.
+REASON_SCALE_UP = "ScaleUp"
+REASON_SCALE_DOWN = "ScaleDown"
+REASON_SCALE_DEFERRED = "ScaleDeferred"
 # ComputeDomain controller / daemon
 REASON_MESH_BUNDLE_UPDATED = "MeshBundleUpdated"
 REASON_NODE_JOINED = "NodeJoined"

@@ -110,6 +110,15 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         "rules over them.",
     ),
     FeatureSpec(
+        "ServingAutoscaler", False, Stage.ALPHA,
+        "Run the serving-fleet loop: the sim traffic engine drives per-"
+        "ServingGroup QPS traces through a queueing model into the "
+        "telemetry plane, and the autoscaler controller closes horizontal "
+        "(spec.replicas) and vertical (subslice tier) scaling on SLO "
+        "burn-rate alerts and utilization rollups.",
+        requires=("FleetTelemetry",),
+    ),
+    FeatureSpec(
         "LiveRepack", False, Stage.ALPHA,
         "Run the online defragmentation rebalancer: migrate small-subslice "
         "claims (cordon -> checkpoint-aware unprepare -> re-place -> "

@@ -83,6 +83,21 @@ class BurnAlert:
     window: Tuple[float, float]     # the pair that fired
 
 
+@dataclass(frozen=True)
+class ActiveAlert:
+    """One (slo, subject) incident currently firing, as the controller-
+    facing ``active_alerts()`` snapshot reports it: worst burn across the
+    firing window pairs, and when THIS incident started (``since``
+    carries over between evaluations while the subject keeps firing, and
+    resets once it recovers)."""
+
+    slo: str
+    subject: Tuple[str, str]
+    burn_rate: float
+    window: Tuple[float, float]
+    since: float
+
+
 @dataclass
 class _SubjectState:
     samples: Deque[Tuple[float, bool]] = field(
@@ -104,6 +119,11 @@ class SLOEvaluator:
         self._subjects: Dict[Tuple[str, Tuple[str, str]], _SubjectState] = {}  # tpulint: guarded-by=_mu
         self._last_eval_t: Optional[float] = None  # tpulint: guarded-by=_mu
         self._window_labels: Dict[Tuple[float, float], str] = {}  # tpulint: guarded-by=_mu
+        # Incidents firing as of the last evaluate() — the structured
+        # snapshot scaling controllers consume instead of re-scraping
+        # gauges. Keyed so `since` survives across passes while the
+        # subject keeps firing; recovered incidents drop immediately.
+        self._active: Dict[Tuple[str, Tuple[str, str]], ActiveAlert] = {}  # tpulint: guarded-by=_mu
         r = metrics_registry
         self.burn_gauge = r.register(Gauge(
             "tpu_dra_slo_burn_rate",
@@ -129,6 +149,20 @@ class SLOEvaluator:
     def objectives(self) -> List[SLObjective]:
         with self._mu:
             return list(self._objectives.values())
+
+    def has(self, name: str) -> bool:
+        with self._mu:
+            return name in self._objectives
+
+    def active_alerts(self) -> List[ActiveAlert]:
+        """Incidents firing as of the last :meth:`evaluate` pass — the
+        consumer-facing snapshot (subject, worst effective burn, firing
+        window pair, since-timestamp). Controllers (the serving
+        autoscaler) act on this instead of re-scraping burn gauges;
+        recovered incidents are gone from the very next snapshot."""
+        with self._mu:
+            return sorted(self._active.values(),
+                          key=lambda a: (a.slo, a.subject))
 
     # -- ingestion -----------------------------------------------------------
 
@@ -215,6 +249,21 @@ class SLOEvaluator:
                         alerts.append(BurnAlert(
                             slo=slo, subject=subject,
                             burn_rate=effective, window=pair))
+            # Structured incident snapshot for controllers: one entry per
+            # firing (slo, subject) with the worst effective burn and a
+            # stable `since`; anything not firing THIS pass drops — a
+            # recovered incident disappears immediately.
+            fresh: Dict[Tuple[str, Tuple[str, str]], ActiveAlert] = {}
+            for a in alerts:
+                key = (a.slo, a.subject)
+                prev = self._active.get(key)
+                since = prev.since if prev is not None else now
+                cur = fresh.get(key)
+                if cur is None or a.burn_rate > cur.burn_rate:
+                    fresh[key] = ActiveAlert(
+                        slo=a.slo, subject=a.subject, burn_rate=a.burn_rate,
+                        window=a.window, since=since)
+            self._active = fresh
             for (slo, pair), burn in worst.items():
                 self.burn_gauge.set(
                     slo, self._window_labels[pair], value=burn)

@@ -77,6 +77,69 @@ MODE_ENERGY = "energy"
 # Claim annotation marking an in-flight migration: the planner skips
 # cordoned claims, so two controllers (or two passes) never double-migrate.
 CORDON_ANNOTATION = "rebalancer.tpu.google.com/cordoned"
+
+
+def try_cordon(api, claim, owner: str = "true") -> bool:
+    """Atomically acquire the migration cordon on one claim.
+
+    The CAS closure only claims an object that is un-cordoned OR already
+    cordoned by the SAME ``owner``, so of N distinct actors racing on
+    one claim exactly one wins — the seam that keeps the rebalancer's
+    consolidation pass and the serving autoscaler's scale-down drain
+    (both of which move/retire claims) from ever double-handling one
+    replica. Same-owner re-acquisition is deliberate: an actor that
+    crashed between its cordon and its follow-through must be able to
+    resume its own half-done work instead of reading its stale mark as
+    someone else's in-flight migration forever. ``owner`` therefore
+    names an actor ROLE, and mutual exclusion WITHIN a role is the
+    role's own deployment contract (one leader-elected rebalancer, one
+    autoscaler per cluster — the same single-instance assumption both
+    controllers already rest on). Returns False when the claim is
+    cordoned by a different owner or gone."""
+    def mutate(obj, owner=owner):
+        cur = obj.meta.annotations.get(CORDON_ANNOTATION)
+        if cur == owner:
+            # Same-owner re-acquisition: already ours, nothing to write.
+            raise _CordonNoWrite(won=True)
+        if cur is not None:
+            # A losing attempt must not write: the no-op update would
+            # still bump resourceVersion and fan out a MODIFIED event —
+            # per-tick churn on every contended claim while a drain
+            # retries against an in-flight migration.
+            raise _CordonNoWrite(won=False)
+        obj.meta.annotations[CORDON_ANNOTATION] = owner
+
+    try:
+        api.update_with_retry(RESOURCE_CLAIM, claim.meta.name,
+                              claim.meta.namespace, mutate)
+    except _CordonNoWrite as verdict:
+        return verdict.won
+    except NotFoundError:
+        return False
+    return True
+
+
+class _CordonNoWrite(Exception):
+    """Raised from the cordon CAS closures to abort WITHOUT writing;
+    carries the acquisition verdict."""
+
+    def __init__(self, won: bool):
+        super().__init__()
+        self.won = won
+
+
+def release_cordon(api, claim) -> None:
+    """Drop the migration cordon (no-op — and no write — when the claim
+    is gone or not cordoned)."""
+    def mutate(obj):
+        if CORDON_ANNOTATION not in obj.meta.annotations:
+            raise _CordonNoWrite(won=False)
+        obj.meta.annotations.pop(CORDON_ANNOTATION, None)
+    try:
+        api.update_with_retry(RESOURCE_CLAIM, claim.meta.name,
+                              claim.meta.namespace, mutate)
+    except (_CordonNoWrite, NotFoundError):
+        pass
 # Node annotation the energy mode sets on fully-idle hosts — the
 # drain-ready marker `describe node` renders.
 DRAIN_READY_ANNOTATION = "rebalancer.tpu.google.com/drain-ready"
@@ -489,10 +552,28 @@ class RebalanceController:
             dst_plugin = self.resolve_plugin(target)
             if dst_plugin is None:
                 return "skip"
-            if not self._take_token():
-                return "no-token"
             sp.attrs["target"] = target
-            self._set_cordon(claims, True)
+            # Atomic cordon acquisition BEFORE the budget token: of the
+            # distinct actor roles racing on a claim (this rebalancer,
+            # the autoscaler's scale-down drain) exactly one wins; a
+            # second rebalancer instance is excluded by leader election,
+            # not by the cordon (same-owner re-acquisition is the
+            # crash-resume path). Losing any claim of the unit means
+            # another role owns part of it — back off whole, costing
+            # neither a cordon nor a token (a drain storm must not burn
+            # the migration budget on units that were never ours).
+            acquired = []
+            for c in claims:
+                if try_cordon(self.api, c, owner="rebalancer"):
+                    acquired.append(c)
+                    continue
+                for got in acquired:
+                    release_cordon(self.api, got)
+                return "skip"
+            if not self._take_token():
+                for got in acquired:
+                    release_cordon(self.api, got)
+                return "no-token"
             try:
                 ok = self._move(unit, claims, allocs, src_plugin,
                                 dst_plugin, target)

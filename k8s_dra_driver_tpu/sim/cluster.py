@@ -136,7 +136,7 @@ _WATCHED_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
 # wait_for(): everything the control loops read or write.
 _QUIESCENCE_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
                      RESOURCE_CLAIM_TEMPLATE, COMPUTE_DOMAIN,
-                     COMPUTE_DOMAIN_CLIQUE)
+                     COMPUTE_DOMAIN_CLIQUE, "ServingGroup")
 
 _PodKey = Tuple[str, str]  # (namespace, name)
 
@@ -295,6 +295,28 @@ class SimCluster:
                 description="pod time-to-running under the serving bound",
                 target=0.95, bound=30.0, op="gt",
                 windows=((120.0, 30.0),)))
+        # -- serving loop (ServingAutoscaler gate, requires FleetTelemetry):
+        # traffic engine (sensing) + ServingGroup controller (actuation),
+        # both driven synchronously off the telemetry tick.
+        self.serving = None
+        self.autoscaler = None
+        if self.gates.enabled("ServingAutoscaler"):
+            # Dependency check up front: the loop is meaningless without
+            # the telemetry plane it closes on.
+            fg.validate_feature_gates(self.gates)
+            from k8s_dra_driver_tpu.autoscaler import (
+                ServingGroupController,
+                TrafficEngine,
+            )
+
+            self.serving = TrafficEngine(
+                self.api, self.metrics_registry, self.slo,
+                claim_load_sink=self._install_claim_load)
+            self.autoscaler = ServingGroupController(
+                self.api, self.metrics_registry, self.serving,
+                recorder=EventRecorder(
+                    self.api, "autoscaler",
+                    metrics_registry=self.metrics_registry))
         # -- dirty-set state fed by the watch streams -----------------------
         # Subscribed before any object is created below, so the cluster's
         # own bootstrap (nodes, device classes, published slices) arrives
@@ -455,6 +477,8 @@ class SimCluster:
                 agent.shutdown()
             node.tpu_driver.shutdown()
             node.cd_driver.shutdown()
+        if self.serving is not None:
+            self.serving.close()
         if self.telemetry is not None:
             self.telemetry.close()
         self.controller.stop()
@@ -1525,13 +1549,19 @@ class SimCluster:
     # -- fleet telemetry ---------------------------------------------------------
 
     def _telemetry_pass(self) -> None:
-        """One telemetry tick: advance the virtual clock, sample every
-        node's monitor, roll samples up to claims/domains, and evaluate
-        the SLO rules. No-op unless the FleetTelemetry gate is on."""
+        """One telemetry tick: advance the virtual clock, drive the
+        serving traffic engine (its per-replica loads must land BEFORE
+        sampling so this tick's counters reflect this tick's traffic),
+        sample every node's monitor, roll samples up to claims/domains,
+        evaluate the SLO rules, and run the autoscaler on the fresh
+        alert snapshot. No-op unless the FleetTelemetry gate is on."""
         if self.telemetry is None:
             return
         self.telemetry_clock += self.telemetry_dt
         now = self.telemetry_clock
+        serving_samples = None
+        if self.serving is not None:
+            serving_samples = self.serving.step(now, dt=self.telemetry_dt)
         views = []
         for name, node in self.nodes.items():
             node.tpu_driver.sample_telemetry(now=now)
@@ -1550,6 +1580,23 @@ class SimCluster:
                 ref=ObjectReference(kind=COMPUTE_DOMAIN, name=dname,
                                     namespace=ns))
         self.slo.evaluate(now)
+        if self.autoscaler is not None and serving_samples is not None:
+            # Closed loop: scale on the snapshot the evaluation above
+            # just refreshed; the resulting replica storm admits through
+            # the scheduler's gang admission on the NEXT step.
+            self.autoscaler.step(
+                now, serving_samples,
+                alerts=self.slo.active_alerts(),
+                claim_summaries=self.telemetry.claim_summaries())
+
+    def _install_claim_load(self, node_name: str, claim_uid: str,
+                            duty: float) -> None:
+        """TrafficEngine sink: per-replica duty into the node's mock
+        tpulib workload registry (unknown nodes are skipped — the claim
+        may be mid-migration)."""
+        node = self.nodes.get(node_name)
+        if node is not None:
+            node.tpulib.set_workload_load(claim_uid, duty)
 
     def node_telemetry_view(self, name: str):
         """The aggregator's per-node input, built from in-memory monitor

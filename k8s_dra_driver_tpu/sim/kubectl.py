@@ -138,11 +138,22 @@ def _compute_domain(doc: Dict[str, Any]) -> ComputeDomain:
     )
 
 
+def _serving_group(doc: Dict[str, Any]):
+    """ServingGroup manifests reuse the real k8s wire decoder (the YAML
+    keys ARE the wire keys); only namespace defaulting is kubectl's."""
+    from k8s_dra_driver_tpu.k8s.k8swire import from_k8s_wire
+
+    obj = from_k8s_wire({**doc, "kind": "ServingGroup"})
+    obj.meta = _meta(doc)
+    return obj
+
+
 _KIND_BUILDERS = {
     "Pod": _pod,
     "ResourceClaim": _claim,
     "ResourceClaimTemplate": _claim_template,
     "ComputeDomain": _compute_domain,
+    "ServingGroup": _serving_group,
     "Job": _job,
 }
 
@@ -199,6 +210,8 @@ _KIND_ALIASES = {
     "cd": "ComputeDomain",
     "computedomainclique": "ComputeDomainClique",
     "computedomaincliques": "ComputeDomainClique",
+    "servinggroup": "ServingGroup", "servinggroups": "ServingGroup",
+    "sg": "ServingGroup",
 }
 
 
@@ -236,6 +249,11 @@ def _summary_row(obj: K8sObject) -> List[str]:
     elif obj.kind == "Event":
         extra = (f"{getattr(obj, 'type', '')}/{getattr(obj, 'reason', '')} "
                  f"x{getattr(obj, 'count', 1)}")
+    elif obj.kind == "ServingGroup":
+        st = getattr(obj, "status", None)
+        ready = getattr(st, "ready_replicas", 0)
+        extra = (f"{ready}/{obj.spec.replicas} ready"
+                 + (f" @{obj.spec.profile}" if obj.spec.profile else ""))
     return [obj.namespace or "-", obj.meta.name, extra]
 
 
@@ -381,6 +399,38 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
             lines += ["Nodes:"] + _table(rows)
         lines += _utilization_lines(obj.status.utilization)
         lines += _conditions_lines(obj.status.conditions, time.time())
+    elif obj.kind == "ServingGroup":
+        s, st = obj.spec, obj.status
+        lines += [
+            f"Replicas:  {st.ready_replicas} ready / {s.replicas} desired"
+            + (f" (demand {st.desired_replicas})"
+               if st.desired_replicas != s.replicas else ""),
+            f"Profile:   {s.profile or '<single chip>'}"
+            + (f" (tiers: {', '.join(t or '<single chip>' for t in s.tiers)})"
+               if s.tiers else ""),
+            f"SLO:       latency p95 <= {s.slo.latency_p95_ms:g}ms, "
+            f"duty <= {s.slo.duty_bound:g}",
+            f"Traffic:   {s.traffic.trace or '<none>'} "
+            f"(peak {s.traffic.peak_qps:g} qps, "
+            f"{s.traffic.qps_per_chip:g} qps/chip)",
+        ]
+        if st.traffic is not None:
+            t = st.traffic
+            lines.append(
+                f"Observed:  {t.qps:g} qps, latency {t.latency_ms:g}ms "
+                f"({t.latency_ratio:.2f}x bound), "
+                f"utilization {_pct(t.utilization)}")
+        scale_notes = []
+        if st.last_scale_up:
+            scale_notes.append(f"up @{st.last_scale_up:g}s")
+        if st.last_scale_down:
+            scale_notes.append(f"down @{st.last_scale_down:g}s")
+        if st.last_retier:
+            scale_notes.append(f"retier @{st.last_retier:g}s")
+        if scale_notes:
+            lines.append("LastScale: " + ", ".join(scale_notes)
+                         + " (virtual clock)")
+        lines += _conditions_lines(st.conditions, time.time())
     elif obj.kind == "Node":
         from k8s_dra_driver_tpu.rebalancer.controller import (
             DRAIN_READY_ANNOTATION,
@@ -435,6 +485,26 @@ def top_domain_rows(objs: List[K8sObject]) -> List[List[str]]:
         rows.append([o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
                      _gib(u.hbm_used_p95_bytes), _pct(u.ici_utilization_p95),
                      f"{u.window_seconds:.0f}s", str(u.samples)])
+    return rows
+
+
+def top_servinggroup_rows(objs: List[K8sObject]) -> List[List[str]]:
+    """`top servinggroups`: ranked by latency pressure (ratio of the
+    declared bound), the row an operator scans when pages fire."""
+    rows = [["NAMESPACE", "NAME", "READY", "REPLICAS", "PROFILE", "QPS",
+             "UTIL", "LAT-RATIO"]]
+    with_traffic = [o for o in objs
+                    if getattr(o.status, "traffic", None) is not None]
+    ranked = sorted(with_traffic,
+                    key=lambda o: -o.status.traffic.latency_ratio)
+    for o in ranked:
+        t = o.status.traffic
+        rows.append([
+            o.namespace or "-", o.meta.name,
+            str(o.status.ready_replicas), str(o.spec.replicas),
+            o.spec.profile or "chip", f"{t.qps:g}",
+            _pct(t.utilization), f"{t.latency_ratio:.2f}",
+        ])
     return rows
 
 
@@ -536,7 +606,8 @@ def main(argv=None) -> int:
         "top",
         help="sorted utilization tables (nodes from a /metrics scrape, "
         "claims/computedomains from their status utilizationSummary)")
-    p_top.add_argument("kind", help="nodes | claims | computedomains")
+    p_top.add_argument("kind",
+                       help="nodes | claims | computedomains | servinggroups")
     p_top.add_argument("-n", "--namespace", default=None)
     p_top.add_argument("-A", "--all-namespaces", action="store_true")
     p_top.add_argument("--metrics-url",
@@ -595,16 +666,21 @@ def main(argv=None) -> int:
             with urllib.request.urlopen(url, timeout=10) as resp:
                 _print_table(top_node_rows(resp.read().decode()))
             return 0
-        if kind not in ("ResourceClaim", "ComputeDomain"):
+        if kind not in ("ResourceClaim", "ComputeDomain", "ServingGroup"):
             raise SystemExit(
-                "error: top supports nodes, claims, and computedomains")
+                "error: top supports nodes, claims, computedomains, and "
+                "servinggroups")
         if getattr(args, "all_namespaces", False):
             list_ns = args.namespace
         else:
             list_ns = args.namespace or "default"
         objs = api.list(kind, namespace=list_ns)
-        _print_table(top_claim_rows(objs) if kind == "ResourceClaim"
-                     else top_domain_rows(objs))
+        if kind == "ResourceClaim":
+            _print_table(top_claim_rows(objs))
+        elif kind == "ComputeDomain":
+            _print_table(top_domain_rows(objs))
+        else:
+            _print_table(top_servinggroup_rows(objs))
         return 0
 
     if args.cmd == "get":

@@ -15,20 +15,32 @@ same generator, so no wall-clock randomness is allowed anywhere:
   bursts: each ``period``-second slot is either a burst (``peak``) or
   quiet (``base``); whether slot *k* bursts is a pure hash of
   ``(seed, k)`` thinned to the ``duty`` fraction.
+- ``playback:file=/path/trace.json,loop=1`` — replay a recorded trace:
+  the JSON file holds ``[{"t": seconds, "v": value}, ...]`` samples
+  (``"qps"`` accepted as an alias for ``"v"``), linearly interpolated
+  between sample times. Before the first sample the first value holds;
+  past the last sample the last value holds, or with ``loop=1`` time
+  wraps modulo the recorded span. Real traffic traces (QPS exports)
+  drive the serving traffic engine through exactly this kind — samples
+  load ONCE at parse time, so the frozen trace stays deterministic and
+  hashable like the generator kinds.
 
 ``value(t)`` is the compute duty cycle in [0, 1] at trace-time ``t``;
-``hbm_fraction(t)`` derives the HBM footprint from it (weights stay
+``raw_value(t)`` is the same curve unclamped (playback samples may be
+raw QPS, which the traffic engine consumes directly);
+``hbm_fraction(t)`` derives the HBM footprint from duty (weights stay
 resident, so there is a floor under the activations that track duty).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
-LOAD_TRACE_KINDS = ("constant", "diurnal", "bursty")
+LOAD_TRACE_KINDS = ("constant", "diurnal", "bursty", "playback")
 
 # HBM model: resident fraction (weights/optimizer state) plus an
 # activation share that tracks instantaneous duty.
@@ -62,18 +74,55 @@ class LoadTrace:
     base: float = 0.15       # bursty quiet level
     peak: float = 0.95       # bursty burst level
     duty: float = 0.3        # bursty fraction of slots bursting
+    loop: float = 0.0        # playback: 1 = wrap time modulo the span
+    # Playback samples, (t, v) sorted by t — loaded once at parse time so
+    # the frozen trace stays hashable and file reads never hit value().
+    points: Tuple[Tuple[float, float], ...] = ()
+    file: str = field(default="", compare=False)
     spec: str = field(default="", compare=False)
 
     def value(self, t: float) -> float:
         """Compute duty cycle in [0, 1] at trace-time ``t`` seconds."""
+        return _clamp(self.raw_value(t))
+
+    def raw_value(self, t: float) -> float:
+        """The trace curve at ``t``, unclamped: generator kinds already
+        live in [0, 1], playback samples keep their recorded units (raw
+        QPS traces feed the serving traffic engine through this)."""
         if self.kind == "constant":
-            return _clamp(self.level)
+            return self.level
         if self.kind == "diurnal":
             x = 0.5 - 0.5 * math.cos(2 * math.pi * (t + self.phase) / self.period)
-            return _clamp(self.low + (self.high - self.low) * x)
+            return self.low + (self.high - self.low) * x
+        if self.kind == "playback":
+            return self._interpolate(t)
         slot = int(t // self.period)
         bursting = _slot_hash(self.seed, slot) < self.duty
-        return _clamp(self.peak if bursting else self.base)
+        return self.peak if bursting else self.base
+
+    def _interpolate(self, t: float) -> float:
+        pts = self.points
+        if not pts:
+            return 0.0
+        t0, tn = pts[0][0], pts[-1][0]
+        if self.loop and tn > t0:
+            t = t0 + (t - t0) % (tn - t0)
+        if t <= t0:
+            return pts[0][1]
+        if t >= tn:
+            return pts[-1][1]
+        # Bisect the sorted sample times, then lerp the bracket.
+        lo, hi = 0, len(pts) - 1
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if pts[mid][0] <= t:
+                lo = mid
+            else:
+                hi = mid
+        (ta, va), (tb, vb) = pts[lo], pts[hi]
+        if tb <= ta:
+            return vb
+        return va + (vb - va) * (t - ta) / (tb - ta)
 
     def hbm_fraction(self, t: float) -> float:
         """Fraction of HBM in use at ``t``: resident floor + activations."""
@@ -105,7 +154,41 @@ def percentile(values: List[float], q: float) -> float:
 
 
 _FLOAT_PARAMS = {"level", "period", "low", "high", "phase", "base", "peak",
-                 "duty"}
+                 "duty", "loop"}
+
+
+def load_playback_points(path: str) -> Tuple[Tuple[float, float], ...]:
+    """Load and validate a playback trace file: a JSON array of
+    ``{"t": seconds, "v": value}`` objects (``"qps"`` accepted for
+    ``"v"``; bare ``[t, v]`` pairs too). Samples are sorted by time;
+    duplicate times keep the last value (the export-tool convention)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise LoadTraceError(f"cannot read playback trace {path!r}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise LoadTraceError(f"playback trace {path!r} is not JSON: {e}") from e
+    if isinstance(doc, dict):
+        doc = doc.get("samples", None)
+    if not isinstance(doc, list) or not doc:
+        raise LoadTraceError(
+            f"playback trace {path!r} must be a non-empty JSON array of "
+            f"samples (or {{\"samples\": [...]}})")
+    by_t: Dict[float, float] = {}
+    for i, item in enumerate(doc):
+        try:
+            if isinstance(item, dict):
+                t = float(item["t"])
+                v = float(item["v"] if "v" in item else item["qps"])
+            else:
+                t, v = float(item[0]), float(item[1])
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            raise LoadTraceError(
+                f"playback trace {path!r} sample #{i} malformed: {item!r}"
+            ) from e
+        by_t[t] = v
+    return tuple(sorted(by_t.items()))
 
 
 def parse_load_trace(spec: str) -> LoadTrace:
@@ -124,6 +207,7 @@ def parse_load_trace(spec: str) -> LoadTrace:
             f"unknown load-trace kind {kind!r}; known: {LOAD_TRACE_KINDS}")
     params: Dict[str, float] = {}
     seed = 0
+    file_path = ""
     for tok in filter(None, (t.strip() for t in rest.split(","))):
         key, eq, val = tok.partition("=")
         key = key.strip().lower()
@@ -132,6 +216,8 @@ def parse_load_trace(spec: str) -> LoadTrace:
         try:
             if key == "seed":
                 seed = int(val)
+            elif key == "file":
+                file_path = val.strip()
             elif key in _FLOAT_PARAMS:
                 params[key] = float(val)
             else:
@@ -140,4 +226,11 @@ def parse_load_trace(spec: str) -> LoadTrace:
             raise LoadTraceError(f"bad load-trace value {tok!r}") from e
     if params.get("period", 240.0) <= 0:
         raise LoadTraceError("load-trace period must be > 0")
+    if kind == "playback":
+        if not file_path:
+            raise LoadTraceError("playback trace needs file=<path>")
+        return LoadTrace(kind=kind, seed=seed, spec=spec, file=file_path,
+                         points=load_playback_points(file_path), **params)
+    if file_path:
+        raise LoadTraceError(f"file= only applies to playback, not {kind!r}")
     return LoadTrace(kind=kind, seed=seed, spec=spec, **params)

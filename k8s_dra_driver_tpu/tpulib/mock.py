@@ -109,6 +109,10 @@ class MockTpuLib:
         if trace_spec:
             self._load_trace = parse_load_trace(trace_spec)
         self._workloads: Dict[str, Tuple[int, ...]] = {}  # tpulint: guarded-by=_tel_mu
+        # Per-workload duty override (serving traffic engine): a registered
+        # workload with an explicit load follows it instead of the node
+        # trace, so two replicas on one host can run at different duty.
+        self._workload_loads: Dict[str, float] = {}  # tpulint: guarded-by=_tel_mu
         self._link_error_rates: Dict[Tuple[int, int], float] = {}  # tpulint: guarded-by=_tel_mu
         # Per-link cumulative accumulators: [tx, rx, errors], advanced by
         # rate * dt at every read so counters integrate the load between
@@ -177,10 +181,29 @@ class MockTpuLib:
     def unregister_workload(self, owner: str) -> None:
         with self._tel_mu:
             self._workloads.pop(owner, None)
+            self._workload_loads.pop(owner, None)
+
+    def set_workload_load(self, owner: str, duty: Optional[float]) -> None:
+        """Install a per-workload duty override in [0, 1] (None clears).
+        The serving traffic engine's feed: per-replica utilization from
+        the queueing model lands here per claim uid, so chip counters —
+        and everything telemetry rolls up from them — reflect serving
+        load with a deterministic ground truth. Unknown owners are
+        accepted (the engine may race a prepare); the override applies
+        once the workload registers."""
+        with self._tel_mu:
+            if duty is None:
+                self._workload_loads.pop(owner, None)
+            else:
+                self._workload_loads[owner] = min(1.0, max(0.0, float(duty)))
 
     def workloads(self) -> Dict[str, Tuple[int, ...]]:
         with self._tel_mu:
             return dict(self._workloads)
+
+    def workload_loads(self) -> Dict[str, float]:
+        with self._tel_mu:
+            return dict(self._workload_loads)
 
     def set_link_error_rate(self, a: int, b: int, errors_per_s: float) -> None:
         """Inject a sustained ICI error rate on one link (order
@@ -217,15 +240,26 @@ class MockTpuLib:
             dt = max(0.0, now - last_t) if last_t is not None else 0.0
             self._counters_last_t = now
             load = trace.value(now)
-            hbm_frac = trace.hbm_fraction(now)
+            # Per-chip duty: a workload with an explicit load override
+            # (serving traffic engine) pins its chips to that duty; chips
+            # shared by several overridden workloads take the max.
+            chip_loads: Dict[int, float] = {}
+            for owner, chips in self._workloads.items():
+                ov = self._workload_loads.get(owner)
+                if ov is None:
+                    continue
+                for i in chips:
+                    chip_loads[i] = max(chip_loads.get(i, 0.0), ov)
             # Advance cumulative link accumulators. A link carries
-            # collective traffic when both endpoints are busy.
+            # collective traffic when both endpoints are busy, at the
+            # slower endpoint's duty.
             link_snap: List[Tuple[int, int, int, int, int]] = []
             for (a, b) in self._counter_link_pairs:
                 acc = self._link_acc.setdefault((a, b), [0.0, 0.0, 0.0])
                 if dt > 0:
                     active = a in busy and b in busy
-                    util = load if active else 0.0
+                    util = (min(chip_loads.get(a, load), chip_loads.get(b, load))
+                            if active else 0.0)
                     byte_rate = util * inv_gen.ici_gbps_per_link * 1e9 / 8.0
                     acc[0] += byte_rate * dt
                     acc[1] += byte_rate * dt
@@ -235,11 +269,19 @@ class MockTpuLib:
         for a, b, tx, rx, errs in link_snap:
             links_by_chip.setdefault(a, []).append(LinkCounters(
                 a=a, b=b, tx_bytes=tx, rx_bytes=rx, errors=errs))
+        from k8s_dra_driver_tpu.tpulib.loadtrace import (
+            HBM_ACTIVE_FRACTION,
+            HBM_FLOOR_FRACTION,
+        )
+
         out: List[ChipCounters] = []
         for idx in range(n_chips):
             if idx in busy:
-                duty = load
-                used = int(hbm_frac * inv_gen.hbm_bytes)
+                duty = chip_loads.get(idx, load)
+                # Same HBM model the traces use: resident floor plus an
+                # activation share tracking instantaneous duty.
+                used = int((HBM_FLOOR_FRACTION + HBM_ACTIVE_FRACTION * duty)
+                           * inv_gen.hbm_bytes)
             else:
                 duty = IDLE_DUTY
                 used = int(IDLE_HBM_FRACTION * inv_gen.hbm_bytes)

@@ -28,6 +28,100 @@ from k8s_dra_driver_tpu.tpulib.profiles import GENS
 from k8s_dra_driver_tpu.tpulib.types import TpuGen
 
 
+# -- playback traces ----------------------------------------------------------
+
+
+def _playback_file(tmp_path, samples, name="trace.json"):
+    import json
+
+    p = tmp_path / name
+    p.write_text(json.dumps(samples))
+    return str(p)
+
+
+def test_playback_round_trip_and_interpolation(tmp_path):
+    """Samples written to a JSON file come back exactly at sample times
+    and linearly interpolated between them — the trace-file contract the
+    serving traffic engine replays real QPS exports through."""
+    path = _playback_file(tmp_path, [
+        {"t": 0, "qps": 100.0}, {"t": 10, "qps": 200.0},
+        {"t": 30, "qps": 0.0},
+    ])
+    tr = parse_load_trace(f"playback:file={path}")
+    assert tr.kind == "playback"
+    # Exact at sample times.
+    assert tr.raw_value(0) == 100.0
+    assert tr.raw_value(10) == 200.0
+    assert tr.raw_value(30) == 0.0
+    # Linear between.
+    assert tr.raw_value(5) == pytest.approx(150.0)
+    assert tr.raw_value(20) == pytest.approx(100.0)
+    # Hold before first / after last by default.
+    assert tr.raw_value(-5) == 100.0
+    assert tr.raw_value(99) == 0.0
+    # value() is the clamped duty view of the same curve.
+    assert tr.value(5) == 1.0  # 150 clamps to 1
+
+
+def test_playback_determinism_and_equality(tmp_path):
+    """Two parses of the same file are equal (the frozen-trace cache
+    key), and re-evaluating any time twice gives identical values —
+    nothing in playback touches wall clock or randomness."""
+    path = _playback_file(tmp_path, [[0, 0.2], [50, 0.8], [100, 0.3]])
+    a = parse_load_trace(f"playback:file={path}")
+    b = parse_load_trace(f"playback:file={path}")
+    assert a == b and hash(a) == hash(b)
+    times = [0.0, 12.3, 49.9, 50.0, 77.7, 100.0, 123.4]
+    assert [a.raw_value(t) for t in times] == [b.raw_value(t) for t in times]
+    assert a.ground_truth(times) == b.ground_truth(times)
+
+
+def test_playback_loop_wraps_modulo_span(tmp_path):
+    path = _playback_file(tmp_path, [[0, 0.0], [100, 1.0]])
+    tr = parse_load_trace(f"playback:file={path},loop=1")
+    assert tr.raw_value(150) == pytest.approx(tr.raw_value(50))
+    assert tr.raw_value(250) == pytest.approx(tr.raw_value(50))
+    held = parse_load_trace(f"playback:file={path}")
+    assert held.raw_value(150) == 1.0  # no loop: hold last
+
+
+def test_playback_sorts_and_dedups_sample_times(tmp_path):
+    path = _playback_file(tmp_path, [[50, 0.5], [0, 0.1], [50, 0.9]])
+    tr = parse_load_trace(f"playback:file={path}")
+    assert tr.points == ((0.0, 0.1), (50.0, 0.9))  # sorted, last wins
+
+
+def test_playback_accepts_dict_and_single_sample(tmp_path):
+    path = _playback_file(tmp_path, {"samples": [{"t": 5, "v": 0.4}]})
+    tr = parse_load_trace(f"playback:file={path}")
+    assert tr.raw_value(0) == 0.4 and tr.raw_value(100) == 0.4
+
+
+@pytest.mark.parametrize("bad", [
+    "playback:",                       # no file
+    "playback:file=/does/not/exist",   # unreadable
+    "constant:file=/tmp/x",            # file= on a generator kind
+])
+def test_playback_rejects_bad_specs(bad):
+    with pytest.raises(LoadTraceError):
+        parse_load_trace(bad)
+
+
+def test_playback_rejects_bad_files(tmp_path):
+    notjson = tmp_path / "bad.json"
+    notjson.write_text("{nope")
+    with pytest.raises(LoadTraceError):
+        parse_load_trace(f"playback:file={notjson}")
+    empty = tmp_path / "empty.json"
+    empty.write_text("[]")
+    with pytest.raises(LoadTraceError):
+        parse_load_trace(f"playback:file={empty}")
+    malformed = tmp_path / "mal.json"
+    malformed.write_text('[{"t": 1}]')
+    with pytest.raises(LoadTraceError):
+        parse_load_trace(f"playback:file={malformed}")
+
+
 # -- parsing ------------------------------------------------------------------
 
 

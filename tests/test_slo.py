@@ -176,3 +176,67 @@ def test_subject_lru_bound():
     assert len(ev._subjects) <= 4
     # Most recent subjects survive.
     assert ("duty", ("ns", "c9")) in ev._subjects
+
+
+# -- active_alerts(): the controller-facing incident snapshot -----------------
+
+
+def test_active_alerts_snapshot_and_since_stability():
+    """Firing incidents appear in active_alerts() with a `since` pinned
+    to the FIRST evaluation that saw them, stable across later passes
+    while the incident persists."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(20):
+        ev.observe("duty", 80.0 + i, 0.99, subject=("ns", "hot"))
+    assert ev.active_alerts() == []          # nothing evaluated yet
+    ev.evaluate(100.0)
+    alerts = ev.active_alerts()
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert (a.slo, a.subject) == ("duty", ("ns", "hot"))
+    assert a.burn_rate >= 2.0 and a.since == 100.0
+    # Still burning two passes later: same incident, same since.
+    ev.observe("duty", 101.0, 0.99, subject=("ns", "hot"))
+    ev.evaluate(101.0)
+    ev.observe("duty", 102.0, 0.99, subject=("ns", "hot"))
+    ev.evaluate(102.0)
+    again = ev.active_alerts()
+    assert len(again) == 1 and again[0].since == 100.0
+
+
+def test_active_alerts_recovered_incident_disappears_immediately():
+    """The satellite pin: a recovered incident is gone from the very
+    next snapshot — the autoscaler must never scale on stale alerts."""
+    ev = _evaluator()
+    ev.add(_objective())
+    for i in range(20):
+        ev.observe("duty", 80.0 + i, 0.99, subject=("ns", "hot"))
+    ev.evaluate(100.0)
+    assert ev.active_alerts()
+    # Recovery: the short window fills with good samples, so the
+    # multi-window AND stops the alert immediately.
+    for i in range(25):
+        ev.observe("duty", 100.0 + i, 0.1, subject=("ns", "hot"))
+    ev.evaluate(125.0)
+    assert ev.active_alerts() == []
+    # Re-offending later is a NEW incident with a fresh since.
+    for i in range(30):
+        ev.observe("duty", 126.0 + i, 0.99, subject=("ns", "hot"))
+    ev.evaluate(156.0)
+    fresh = ev.active_alerts()
+    assert len(fresh) == 1 and fresh[0].since == 156.0
+
+
+def test_active_alerts_one_entry_per_subject_worst_burn():
+    """A subject firing on BOTH window pairs collapses to one snapshot
+    entry carrying the worst effective burn."""
+    ev = _evaluator()
+    ev.add(_objective(windows=((100.0, 20.0), (50.0, 10.0))))
+    for i in range(100):
+        ev.observe("duty", float(i), 0.99, subject=("ns", "hot"))
+    alerts = ev.evaluate(100.0)
+    assert len(alerts) == 2                  # both pairs fire
+    snapshot = ev.active_alerts()
+    assert len(snapshot) == 1
+    assert snapshot[0].burn_rate == max(a.burn_rate for a in alerts)

@@ -1,0 +1,284 @@
+"""Traffic engine: queueing model, workload-load feed, SLO observation.
+
+Pins the sensing half of the serving loop against hand-computed math:
+QPS evaluation (generator vs playback), the M/M/1 latency curve and its
+saturation plateau, the per-replica duty feed into the mock tpulib's
+workload registry (chip counters must follow the model exactly), the
+quantized change-gated status.traffic writes, and the serving-latency
+SLO observations a saturated group turns into burn alerts.
+"""
+
+import math
+
+import pytest
+
+from k8s_dra_driver_tpu.api.servinggroup import (
+    SERVING_GROUP,
+    SERVING_GROUP_LABEL,
+    ServingGroup,
+    ServingGroupSpec,
+    ServingSLO,
+    ServingTraffic,
+)
+from k8s_dra_driver_tpu.autoscaler.traffic import (
+    SATURATED_LATENCY_FACTOR,
+    SERVING_LATENCY_SLO,
+    TrafficEngine,
+    group_qps,
+    model_latency_ms,
+    offered_utilization,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    AllocationResult,
+    DeviceRequestAllocationResult,
+    POD,
+    Pod,
+    PodResourceClaimRef,
+    RESOURCE_CLAIM,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.objects import fresh_uid, new_meta
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+from k8s_dra_driver_tpu.pkg.slo import SLOEvaluator
+from k8s_dra_driver_tpu.tpulib import MockTpuLib
+from k8s_dra_driver_tpu.tpulib.loadtrace import parse_load_trace
+
+
+# -- pure model math ----------------------------------------------------------
+
+
+def test_group_qps_generator_scales_to_peak():
+    tr = parse_load_trace("constant:level=0.5")
+    assert group_qps(tr, 800.0, 0.0) == 400.0
+
+
+def test_group_qps_playback_is_raw(tmp_path):
+    import json
+
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps([[0, 123.0], [10, 321.0]]))
+    tr = parse_load_trace(f"playback:file={p}")
+    # peak_qps is ignored for playback: samples ARE qps.
+    assert group_qps(tr, 1.0, 0.0) == 123.0
+    assert group_qps(tr, 999.0, 10.0) == 321.0
+
+
+def test_offered_utilization_and_latency_curve():
+    assert offered_utilization(120.0, 2, 100.0) == pytest.approx(0.6)
+    assert math.isinf(offered_utilization(10.0, 0, 100.0))
+    assert model_latency_ms(10.0, 0.0) == 10.0
+    assert model_latency_ms(10.0, 0.6) == pytest.approx(25.0)
+    assert model_latency_ms(10.0, 0.8) == pytest.approx(50.0)
+    # Saturation plateau, not a division blow-up.
+    assert model_latency_ms(10.0, 1.0) == 10.0 * SATURATED_LATENCY_FACTOR
+    assert model_latency_ms(10.0, 5.0) == 10.0 * SATURATED_LATENCY_FACTOR
+
+
+# -- mock tpulib workload-load feed -------------------------------------------
+
+
+def test_set_workload_load_overrides_node_trace():
+    lib = MockTpuLib("v5e-4")
+    lib.set_load_trace("constant:level=0.9")
+    lib.register_workload("a", (0, 1))
+    lib.register_workload("b", (2,))
+    lib.set_workload_load("a", 0.35)
+    counters = {c.index: c for c in lib.read_counters(now=5.0)}
+    # Overridden workload's chips follow the override...
+    assert counters[0].duty_cycle == pytest.approx(0.35)
+    assert counters[1].duty_cycle == pytest.approx(0.35)
+    # ...while non-overridden busy chips keep the node trace.
+    assert counters[2].duty_cycle == pytest.approx(0.9)
+    # Clearing restores the trace; unregister drops the override too.
+    lib.set_workload_load("a", None)
+    counters = {c.index: c for c in lib.read_counters(now=6.0)}
+    assert counters[0].duty_cycle == pytest.approx(0.9)
+    lib.set_workload_load("b", 0.5)
+    lib.unregister_workload("b")
+    assert lib.workload_loads() == {}
+
+
+# -- engine over a fake cluster ----------------------------------------------
+
+
+def _group(name="chat", ns="serve", replicas=2, qps_per_chip=100.0,
+           trace="constant:level=0.3", peak=400.0, bound_ms=50.0):
+    return ServingGroup(
+        meta=new_meta(name, ns),
+        spec=ServingGroupSpec(
+            replicas=replicas,
+            traffic=ServingTraffic(trace=trace, peak_qps=peak,
+                                   qps_per_chip=qps_per_chip,
+                                   base_latency_ms=10.0),
+            slo=ServingSLO(latency_p95_ms=bound_ms)))
+
+
+def _replica(api, group, idx, node="node-0", ready=True):
+    """One Running replica pod + allocated claim, as the controller
+    stamps and the sim runs them."""
+    ns, gname = group.meta.namespace, group.meta.name
+    labels = {SERVING_GROUP_LABEL: gname}
+    claim = ResourceClaim(
+        meta=new_meta(f"{gname}-rep-{idx}-tpus", ns, labels=dict(labels)))
+    claim.meta.uid = fresh_uid()
+    claim.allocation = AllocationResult(
+        devices=[DeviceRequestAllocationResult(
+            request="tpus", driver="tpu.google.com", pool=node,
+            device="tpu-0")],
+        node_name=node)
+    api.create(claim)
+    pod = Pod(meta=new_meta(f"{gname}-rep-{idx}", ns, labels=dict(labels)),
+              node_name=node, phase="Running" if ready else "Pending",
+              ready=ready,
+              resource_claims=[PodResourceClaimRef(
+                  name="tpus", resource_claim_name=claim.meta.name)])
+    api.create(pod)
+    return claim
+
+
+class _Sink:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, node, uid, duty):
+        self.calls.append((node, uid, duty))
+
+
+def _engine(api, slo=None):
+    sink = _Sink()
+    eng = TrafficEngine(api, Registry(), slo, claim_load_sink=sink)
+    return eng, sink
+
+
+def test_engine_senses_and_feeds_workload_loads():
+    api = APIServer()
+    group = api.create(_group())          # 0.3 * 400 = 120 qps
+    c0 = _replica(api, group, 0, node="node-0")
+    c1 = _replica(api, group, 1, node="node-1")
+    # Allocated but not ready (preparing / gone unready): its chips must
+    # read duty 0, not a stale share — the load went to the survivors.
+    c2 = _replica(api, group, 2, node="node-2", ready=False)
+    eng, sink = _engine(api)
+    try:
+        samples = eng.step(1.0)
+        s = samples[("serve", "chat")]
+        # 120 qps over 2 ready replicas at 100 qps/chip: rho 0.6.
+        assert s.ready == 2
+        assert s.rho == pytest.approx(0.6)
+        assert s.latency_ms == pytest.approx(25.0)
+        assert s.latency_ratio == pytest.approx(0.5)
+        assert sorted(sink.calls) == sorted([
+            ("node-0", c0.uid, pytest.approx(0.6)),
+            ("node-1", c1.uid, pytest.approx(0.6)),
+            ("node-2", c2.uid, 0.0)])
+    finally:
+        eng.close()
+
+
+def test_engine_status_writes_are_change_gated():
+    api = APIServer()
+    api.create(_group(trace="constant:level=0.3"))
+    eng, _ = _engine(api)
+    try:
+        eng.step(1.0)
+        sg = api.get(SERVING_GROUP, "chat", "serve")
+        assert sg.status.traffic is not None
+        assert sg.status.traffic.qps == pytest.approx(120.0)
+        rv = sg.meta.resource_version
+        # Constant load: every further tick rounds to the same doc and
+        # must not write (resourceVersion frozen).
+        for t in range(2, 12):
+            eng.step(float(t))
+        assert api.get(SERVING_GROUP, "chat",
+                       "serve").meta.resource_version == rv
+    finally:
+        eng.close()
+
+
+def test_engine_outage_saturates_and_observes_slo():
+    """Losing every replica AFTER the group served is an incident: the
+    SLO burns. (A never-yet-serving group is a cold start and must NOT
+    burn — pinned below.)"""
+    api = APIServer()
+    group = api.create(_group())
+    _replica(api, group, 0)
+    slo = SLOEvaluator(Registry())
+    eng, _ = _engine(api, slo=slo)
+    try:
+        eng.step(1.0)                      # served once
+        api.delete(POD, "chat-rep-0", "serve")
+        api.delete(RESOURCE_CLAIM, "chat-rep-0-tpus", "serve")
+        for t in range(2, 40):
+            eng.step(float(t))
+            alerts = slo.evaluate(float(t))
+        assert alerts, "an outage after serving must burn"
+        assert {a.slo for a in slo.active_alerts()} == {SERVING_LATENCY_SLO}
+        assert slo.active_alerts()[0].subject == ("serve", "chat")
+        sg = api.get(SERVING_GROUP, "chat", "serve")
+        assert sg.status.traffic.latency_ratio > 1.0
+    finally:
+        eng.close()
+
+
+def test_engine_cold_start_never_burns():
+    api = APIServer()
+    api.create(_group())                   # no replica has ever served
+    slo = SLOEvaluator(Registry())
+    eng, _ = _engine(api, slo=slo)
+    try:
+        for t in range(1, 40):
+            eng.step(float(t))
+            slo.evaluate(float(t))
+        assert slo.active_alerts() == []
+    finally:
+        eng.close()
+
+
+def test_engine_caches_are_watch_fed_zero_lists():
+    api = APIServer()
+    group = api.create(_group())
+    _replica(api, group, 0)
+    eng, _ = _engine(api)
+    try:
+        eng.step(1.0)
+        before = api.stats.list_calls
+        for t in range(2, 8):
+            eng.step(float(t))
+        assert api.stats.list_calls == before, \
+            "traffic passes must never list() the store"
+        # New replica arrives purely via the watch stream.
+        _replica(api, group, 1)
+        s = eng.step(8.0)[("serve", "chat")]
+        assert s.ready == 2
+        assert api.stats.list_calls == before
+    finally:
+        eng.close()
+
+
+def test_engine_bad_trace_is_negative_cached_zero_qps():
+    api = APIServer()
+    api.create(_group(trace="nosuch:kind=1"))
+    eng, sink = _engine(api)
+    try:
+        s = eng.step(1.0)[("serve", "chat")]
+        assert s.qps == 0.0 and sink.calls == []
+        eng.step(2.0)  # second tick: no re-parse crash, still flat
+    finally:
+        eng.close()
+
+
+def test_engine_group_delete_forgets_gauges():
+    api = APIServer()
+    api.create(_group())
+    eng, _ = _engine(api)
+    try:
+        eng.step(1.0)
+        assert eng.qps_gauge.value("serve", "chat") == pytest.approx(120.0)
+        api.delete(SERVING_GROUP, "chat", "serve")
+        eng.step(2.0)
+        # forget_matching dropped the series: value() reads back 0.
+        assert eng.qps_gauge.value("serve", "chat") == 0.0
+        assert eng.groups() == {}
+    finally:
+        eng.close()
