@@ -36,7 +36,9 @@ bench:
 # BENCH_SCALE_NODES overrides the node count — full runs use 8192) +
 # the 1024-node serving-autoscaler day (SLO violation minutes and
 # wasted chip-hours vs the static baseline, zero burst flaps, zero
-# steady-state store lists; BENCH_AUTOSCALER_NODES overrides).
+# steady-state store lists; BENCH_AUTOSCALER_NODES overrides) + the
+# elastic-domain gate (ten seeded kill/heal cycles at 64 nodes: p99
+# time-to-healed in virtual seconds, zero rollbacks, zero leaks).
 # Capped at 15 min (the autoscaler day adds ~2.5 min at 1024 nodes).
 bench-smoke:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu python bench.py --smoke

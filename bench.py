@@ -513,6 +513,191 @@ spec:
     return out
 
 
+def bench_elastic(num_nodes: int = 64, cycles: int = 10, seed: int = 7,
+                  heal_budget_vs: float = 30.0, grow_budget_vs: float = 60.0,
+                  assert_budget: bool = False) -> dict:
+    """Elastic ComputeDomains benchmark (docs/reference/elastic-domains.md):
+    a 64-node v5e-16 sim runs one assembled 4-host domain through
+    ``cycles`` seeded kill/heal cycles — a seeded member host goes down
+    via the node-down chaos annotation, the domain must heal to 3 hosts
+    (full resize epoch: quiesce, re-place, recompiled bundle, restarted
+    workers), then the host returns and the domain must grow back to 4.
+
+    Time-to-healed is measured in VIRTUAL seconds (sim steps), so the
+    gate is deterministic per seed. Hard gates (``assert_budget=True`` in
+    make bench-smoke): p99 time-to-healed under ``heal_budget_vs``, every
+    grow-back under ``grow_budget_vs``, zero rolled-back epochs, and zero
+    leaked state across all ten cycles — no ICI partition anywhere the
+    prepared claims don't account for and no MigrationCheckpoint residue."""
+    import os
+    import random
+
+    from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, NODE, POD
+    from k8s_dra_driver_tpu.plugins.checkpoint import (
+        MIGRATION_CHECKPOINTED,
+        PREPARE_COMPLETED,
+    )
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.cluster import CHAOS_NODE_DOWN_ANNOTATION
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    manifest = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: grid}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: dom, namespace: grid}
+spec:
+  numNodes: 4
+  channel:
+    resourceClaimTemplate: {name: dom-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: grid}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+    worker = """
+apiVersion: v1
+kind: Pod
+metadata: {name: dom-worker-%(i)d, namespace: grid}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: dom-channel}
+"""
+
+    def leaked(sim) -> str:
+        for name, node in sim.nodes.items():
+            state = node.tpu_driver.state
+            entries = state.prepared_claims()
+            if any(e.state == MIGRATION_CHECKPOINTED
+                   for e in entries.values()):
+                return f"{name}: MigrationCheckpoint residue"
+            subslices = sum(
+                1 for e in entries.values()
+                if e.state == PREPARE_COMPLETED
+                and any(d.device_type == "subslice" for d in e.devices))
+            if len(state.partitions.active_partitions()) != subslices:
+                return f"{name}: partition ledger != prepared claims"
+        return ""
+
+    rng = random.Random(seed)
+    heal_vs: list = []
+    grow_vs: list = []
+    leaks: list = []
+    with tempfile.TemporaryDirectory() as tmp:
+        # Channel prepare needs the kernel channel class (or the mock
+        # seam); outside pytest nothing installed it, so point devcaps at
+        # an empty mock /proc/devices — the env-only bootstrap path the
+        # CPU test tier uses.
+        from k8s_dra_driver_tpu.pkg import devcaps
+
+        proc_devices = os.path.join(tmp, "proc_devices")
+        with open(proc_devices, "w", encoding="utf-8") as f:
+            f.write("Character devices:\n")
+        devcaps.configure_proc_devices_path(proc_devices)
+        sim = SimCluster(
+            workdir=tmp, profile="v5e-16", num_hosts=num_nodes,
+            gates=("ElasticComputeDomains=true,ICIPartitioning=true,"
+                   "DynamicSubslice=true"))
+        sim.start()
+        try:
+            for obj in load_manifests(manifest):
+                sim.api.create(obj)
+            for i in range(4):
+                for obj in load_manifests(worker % {"i": i}):
+                    sim.api.create(obj)
+
+            def domain():
+                return sim.api.get(COMPUTE_DOMAIN, "dom", "grid")
+
+            assert sim.wait_for(
+                lambda s: domain().status.status == "Ready"
+                and domain().status.placement is not None, max_steps=60), \
+                "domain never assembled"
+
+            def set_down(node, down):
+                def mutate(obj, down=down):
+                    if down:
+                        obj.meta.annotations[
+                            CHAOS_NODE_DOWN_ANNOTATION] = "true"
+                    else:
+                        obj.meta.annotations.pop(
+                            CHAOS_NODE_DOWN_ANNOTATION, None)
+                sim.api.update_with_retry(NODE, node, "", mutate)
+
+            def run_until(pred, budget_vs: float) -> float:
+                t0 = sim.sim_time
+                while sim.sim_time - t0 <= budget_vs:
+                    if pred():
+                        return sim.sim_time - t0
+                    sim.step()
+                return float("inf")
+
+            for cycle in range(cycles):
+                cd = domain()
+                epoch0 = cd.status.epoch
+                members = list(cd.status.placement.nodes)
+                victim = members[rng.randrange(len(members))]
+                victim_idx = members.index(victim)
+                set_down(victim, True)
+                heal_vs.append(run_until(
+                    lambda: domain().status.epoch == epoch0 + 1
+                    and domain().status.status == "Ready"
+                    and domain().status.resize is None, heal_budget_vs))
+                set_down(victim, False)
+                grow_vs.append(run_until(
+                    lambda: domain().status.epoch == epoch0 + 2
+                    and domain().status.status == "Ready"
+                    and domain().status.resize is None, grow_budget_vs))
+                # Re-create the evicted worker, Job-controller style, and
+                # let the cluster settle before the next kill.
+                if sim.api.try_get(POD, f"dom-worker-{victim_idx}",
+                                   "grid") is None:
+                    for obj in load_manifests(worker % {"i": victim_idx}):
+                        sim.api.create(obj)
+                sim.settle(max_steps=20)
+                why = leaked(sim)
+                if why:
+                    leaks.append(f"cycle {cycle}: {why}")
+            rolled_back = sum(
+                sim.elastic.metrics.epochs_total.value(t, "rolled_back")
+                for t in ("spec", "heal", "grow"))
+        finally:
+            devcaps.configure_proc_devices_path(None)
+            sim.stop()
+
+    finite_heals = [v for v in heal_vs if v != float("inf")]
+    heal_sorted = sorted(heal_vs)
+    p99 = heal_sorted[min(len(heal_sorted) - 1,
+                          int(0.99 * len(heal_sorted)))]
+    out = {
+        "elastic_nodes": num_nodes,
+        "elastic_cycles": cycles,
+        "elastic_heal_vs_p50": heal_sorted[len(heal_sorted) // 2],
+        "elastic_heal_vs_p99": p99,
+        "elastic_heal_timeouts": len(heal_vs) - len(finite_heals),
+        "elastic_grow_timeouts": sum(1 for v in grow_vs
+                                     if v == float("inf")),
+        "elastic_rolled_back_epochs": rolled_back,
+        "elastic_leaks": leaks,
+    }
+    if assert_budget:
+        assert out["elastic_heal_timeouts"] == 0, out
+        assert out["elastic_grow_timeouts"] == 0, out
+        assert out["elastic_heal_vs_p99"] <= heal_budget_vs, out
+        assert out["elastic_rolled_back_epochs"] == 0, out
+        assert not leaks, out
+    return out
+
+
 def bench_store_throughput(writer_threads: int = 8, ops_per_thread: int = 3000,
                            watchers_per_kind: int = 2,
                            durable_ops_per_thread: int = 400) -> dict:
@@ -2015,6 +2200,11 @@ def main() -> None:
         # largest-free-profile capacity on a fragmented 16-node cluster
         # with zero failed migrations.
         result.update(bench_rebalance(num_nodes=16, assert_budget=True))
+        # Elastic-domain gate: ten seeded kill/heal cycles on a 64-node
+        # sim — p99 time-to-healed under the virtual-seconds budget,
+        # every grow-back completes, zero rolled-back epochs, zero
+        # leaked partitions / MigrationCheckpoint residue.
+        result.update(bench_elastic(assert_budget=True))
         # Scale-out gates (BENCH_SCALE_NODES, default 2048 in CI): hard
         # p99 claim-to-running budget, >=2x durable sharded-vs-single-lock
         # write throughput with 8 writer threads, zero watch-ordering
@@ -2064,6 +2254,12 @@ def main() -> None:
         result.update(bench_rebalance())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["rebalance_error"] = str(e)[:200]
+    try:
+        # Elastic domains: seeded kill/heal cycles, virtual-seconds
+        # time-to-healed distribution, leak accounting.
+        result.update(bench_elastic())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["elastic_error"] = str(e)[:200]
     try:
         # Control-plane scale-out: 2048/4096/8192-node claim storms with
         # p50/p99 claim-to-running, threaded store write throughput

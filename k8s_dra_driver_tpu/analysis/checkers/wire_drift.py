@@ -57,14 +57,17 @@ DEFAULT_SPECS: Tuple[WireKindSpec, ...] = (
         dataclasses={
             _API_CD: ("ComputeDomain", "ComputeDomainSpec",
                       "ComputeDomainChannelSpec", "ComputeDomainNode",
-                      "ComputeDomainPlacement", "ComputeDomainStatus"),
+                      "ComputeDomainPlacement", "ComputeDomainResize",
+                      "ComputeDomainStatus"),
             "k8s_dra_driver_tpu/pkg/meshgen.py": ("MeshBundle",
                                                   "MeshDevice"),
             _CONDITION[0]: _CONDITION[1],
         },
-        encoders=("_computedomain_encode", "_meshbundle_encode",
+        encoders=("_computedomain_encode", "_placement_encode",
+                  "_resize_encode", "_meshbundle_encode",
                   "_conditions_encode"),
-        decoders=("_computedomain_decode", "_meshbundle_decode",
+        decoders=("_computedomain_decode", "_placement_decode",
+                  "_resize_decode", "_meshbundle_decode",
                   "_conditions_decode"),
     ),
     WireKindSpec(

@@ -750,6 +750,154 @@ def scenario_autoscaler_scaledown_vs_consolidation(
                        "re-pointed at the target")
 
 
+# -- scenario 8: resize epoch racing a live-repack migration ------------------
+
+
+def scenario_resize_vs_rebalancer(
+        state: SanitizerState, seed: int, extra_workers: int = 0) -> None:
+    """An elastic resize epoch quiesces a domain worker's claim on an
+    overlapping host at the same moment the rebalancer's repack wants to
+    migrate it away. Exactly one may win — the owner-tagged cordon CAS
+    (owner="resize" vs owner="rebalancer") is the arbiter — and whichever
+    side wins, the ledgers must agree with the surviving state: a
+    quiesce-then-restart leaves the claim PREPARE_COMPLETED on its source
+    with its partition re-carved there; a migration leaves exactly its
+    partition on the target. Before try_cordon both the double-handle and
+    the leaked-partition failure modes were reachable."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM
+    from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.flock import Flock
+    from k8s_dra_driver_tpu.pkg.partitioner import (
+        PartitionManager,
+        StubPartitionClient,
+    )
+    from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.rebalancer.controller import (
+        release_cordon,
+        try_cordon,
+    )
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    api = APIServer(shards=2)
+    with tempfile.TemporaryDirectory(prefix="tpusan-rz-") as tmp:
+        stubs = {}
+        devs = {}
+        pu_paths = {}
+        for node in ("node-0", "node-1"):
+            stub = StubPartitionClient()
+            dev = DeviceState(
+                MockTpuLib("v5e-4"), os.path.join(tmp, node, "plugin"),
+                cdi_root=os.path.join(tmp, node, "cdi"),
+                gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+            )
+            dev.partitions = PartitionManager(dev.inventory.host_topology,
+                                              stub)
+            stubs[node], devs[node] = stub, dev
+            pu_paths[node] = os.path.join(tmp, node, "plugin", "pu.lock")
+        claim = _claim_for_devices(["tpu-subslice-1x2-at-0x0"], "dom-w-0")
+        api.create(claim)
+        api.create(_pod("dom-w-0"))
+        with Flock(pu_paths["node-0"]).hold():
+            devs["node-0"].prepare(claim)
+        outcomes: Dict[str, bool] = {}
+
+        def resizer():
+            # ElasticDomainController's quiesce->restart shape: cordon
+            # atomically (owner="resize"), MigrationCheckpoint the claim,
+            # then re-prepare it on the SAME node into the new geometry
+            # and release the cordon (the finalize step).
+            c = api.try_get(RESOURCE_CLAIM, "dom-w-0", "default")
+            if c is None or not try_cordon(api, c, owner="resize"):
+                return
+            outcomes["resized"] = True
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].migrate_out(claim.uid)
+            state.yield_point(("scenario", "resizer"))
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].prepare(claim)
+            release_cordon(api, c)
+
+        def repacker():
+            # RebalanceController._migrate_unit's shape: cordon, migrate
+            # off node-0, prepare on node-1, re-point, close, uncordon.
+            c = api.try_get(RESOURCE_CLAIM, "dom-w-0", "default")
+            if c is None or not try_cordon(api, c, owner="rebalancer"):
+                return
+            outcomes["migrated"] = True
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].migrate_out(claim.uid)
+            state.yield_point(("scenario", "repacker"))
+            with Flock(pu_paths["node-1"]).hold():
+                devs["node-1"].prepare(claim)
+
+            def repoint(obj):
+                obj.allocation.node_name = "node-1"
+            try:
+                api.update_with_retry(RESOURCE_CLAIM, "dom-w-0", "default",
+                                      repoint)
+            except NotFoundError:
+                pass
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].end_migration(claim.uid)
+            release_cordon(api, c)
+
+        explore(state, seed,
+                [("resizer", resizer), ("repacker", repacker)]
+                + _fillers(state, extra_workers))
+
+        _invariant(state, len(outcomes) == 1,
+                   f"cordon CAS admitted {sorted(outcomes)} — the same "
+                   f"worker claim was handled by both the resize epoch "
+                   f"and the repack migration")
+        from k8s_dra_driver_tpu.rebalancer.controller import (
+            CORDON_ANNOTATION,
+        )
+        live = api.try_get(RESOURCE_CLAIM, "dom-w-0", "default")
+        _invariant(state,
+                   live is not None
+                   and CORDON_ANNOTATION not in live.meta.annotations,
+                   "winner left the claim cordoned after finishing")
+        if outcomes.get("resized"):
+            _invariant(state,
+                       len(stubs["node-0"].active_ids()) == 1
+                       and not stubs["node-1"].active_ids(),
+                       f"resized claim's ledgers read "
+                       f"src={stubs['node-0'].active_ids()} "
+                       f"dst={stubs['node-1'].active_ids()} — expected its "
+                       f"one partition back on the source only")
+            entries = devs["node-0"].prepared_claims()
+            _invariant(state,
+                       set(entries) == {claim.uid}
+                       and entries[claim.uid].state == PREPARE_COMPLETED
+                       and not devs["node-1"].prepared_claims(),
+                       "resized claim not PREPARE_COMPLETED on its source")
+            _invariant(state,
+                       live is not None
+                       and live.allocation.node_name == "node-0",
+                       "resized claim's allocation moved off its source")
+        elif outcomes.get("migrated"):
+            _invariant(state,
+                       not stubs["node-0"].active_ids()
+                       and len(stubs["node-1"].active_ids()) == 1,
+                       f"migrated claim's ledgers read "
+                       f"src={stubs['node-0'].active_ids()} "
+                       f"dst={stubs['node-1'].active_ids()} — expected the "
+                       f"one partition on the target only")
+            entries = devs["node-1"].prepared_claims()
+            _invariant(state,
+                       not devs["node-0"].prepared_claims()
+                       and set(entries) == {claim.uid}
+                       and entries[claim.uid].state == PREPARE_COMPLETED,
+                       "migrated claim's checkpoints inconsistent")
+            _invariant(state,
+                       live is not None
+                       and live.allocation.node_name == "node-1",
+                       "migrated claim not re-pointed at the target")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -759,6 +907,7 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
     "telemetry-sample-vs-prepare": scenario_telemetry_sample_vs_prepare,
     "autoscaler-scaledown-vs-consolidation":
         scenario_autoscaler_scaledown_vs_consolidation,
+    "resize-vs-rebalancer": scenario_resize_vs_rebalancer,
 }
 
 

@@ -56,6 +56,21 @@ CD_COND_VALIDATED = "Validated"   # spec passed domain-bounds validation
 CD_COND_READY = "Ready"           # required member nodes registered + ready
 CD_COND_DEGRADED = "Degraded"     # a member node publishes unhealthy devices
 
+# Resize-epoch state-machine phases (ElasticComputeDomains). The record
+# lives in ``ComputeDomainStatus.resize`` while an epoch is in flight and
+# is CAS-persisted BEFORE each side-effecting step, so a crashed/restored
+# controller resumes (or rolls back) instead of forgetting a half-resized
+# domain. Absent record = no epoch in flight.
+RESIZE_QUIESCING = "Quiescing"     # survivors' claims -> MigrationCheckpoint
+RESIZE_PLACING = "Placing"         # new placement being computed/recorded
+RESIZE_RESTARTING = "Restarting"   # awaiting recompiled bundle + re-prepare
+
+# Why an epoch started — recorded on the resize record and on the
+# DomainResizing/DomainHealed events.
+RESIZE_TRIGGER_SPEC = "spec"       # operator edited spec.numNodes
+RESIZE_TRIGGER_HEAL = "heal"       # member lease expired (host failure)
+RESIZE_TRIGGER_GROW = "grow"       # healed domain growing back toward spec
+
 
 @dataclass
 class ComputeDomainChannelSpec:
@@ -98,11 +113,38 @@ class ComputeDomainPlacement:
 
 
 @dataclass
+class ComputeDomainResize:
+    """One in-flight resize epoch: the phase pointer plus everything
+    rollback needs (the prior placement and desired size, verbatim) and
+    everything resume needs (the planned new placement, computed once at
+    epoch start so a crash between quiesce and re-place replays the SAME
+    decision instead of re-planning against drifted state)."""
+
+    phase: str = ""                    # RESIZE_* constant
+    trigger: str = ""                  # RESIZE_TRIGGER_* constant
+    target_nodes: int = 0              # membership this epoch drives to
+    lost_nodes: List[str] = field(default_factory=list)  # expired members
+    new_placement: Optional[ComputeDomainPlacement] = None
+    prior_placement: Optional[ComputeDomainPlacement] = None
+    prior_desired: int = 0
+    attempts: int = 0                  # bounded-retry counter (this target)
+    started_at: float = 0.0            # orchestrator clock at epoch start
+
+
+@dataclass
 class ComputeDomainStatus:
     status: str = CD_STATUS_NOT_READY
     nodes: List[ComputeDomainNode] = field(default_factory=list)
     conditions: List[Condition] = field(default_factory=list)
     placement: Optional[ComputeDomainPlacement] = None
+    # Elastic membership (ElasticComputeDomains): ``epoch`` counts
+    # completed resize transitions (0 = never resized), ``desired_nodes``
+    # is the CURRENT epoch's membership target — equal to spec.numNodes
+    # normally, smaller after a host-failure heal until the host returns
+    # (0 = follow spec). ``resize`` is the in-flight epoch record.
+    epoch: int = 0
+    desired_nodes: int = 0
+    resize: Optional[ComputeDomainResize] = None
     # The compiled Placement→JAX mesh bundle (pkg/meshgen): topology-
     # aligned device order + axes + partition rules, (re-)emitted by the
     # controller on placement or link-health change and injected into
@@ -142,6 +184,12 @@ class ComputeDomainClique(K8sObject):
     domain_uid: str = ""
     ici_domain: str = ""
     nodes: List[ComputeDomainDaemonInfo] = field(default_factory=list)
+    # node name -> the worker index it held when it was deregistered
+    # (lease expiry / heal-shrink). A re-joining node reclaims its former
+    # slot when still free, so resize-epoch rollback — and any workload
+    # keyed on TPU_WORKER_ID — sees the SAME worker identity across an
+    # agent restart instead of a freshly CAS-allocated one.
+    released: Dict[str, int] = field(default_factory=dict)
 
     def node_info(self, node_name: str) -> Optional[ComputeDomainDaemonInfo]:
         for n in self.nodes:

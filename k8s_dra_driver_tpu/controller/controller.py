@@ -137,7 +137,7 @@ class Controller:
             buckets=WORKQUEUE_SECONDS_BUCKETS,
         ))
         self._queue = WorkQueue(
-            self._reconcile_key, default_controller_rate_limiter(),
+            self._reconcile_key, default_controller_rate_limiter(registry),
             name="cd-controller", metrics_registry=registry,
         )
         self._cd_informer = Informer(api, COMPUTE_DOMAIN)
@@ -536,7 +536,11 @@ class Controller:
 
     def _calculate_global_status(self, cd: ComputeDomain, nodes: List[ComputeDomainNode]) -> str:
         ready = [n for n in nodes if n.status == CD_STATUS_READY]
-        want = cd.spec.num_nodes
+        # Elastic domains: the CURRENT epoch's membership target (set by
+        # the resize orchestrator — smaller than spec.numNodes after a
+        # heal-shrink) governs readiness, so a healed 3-host domain
+        # reports Ready instead of waiting forever for its dead fourth.
+        want = cd.status.desired_nodes or cd.spec.num_nodes
         if want > 0:
             return CD_STATUS_READY if len(ready) >= want else CD_STATUS_NOT_READY
         # Size-follows-workload: ready when at least one node exists and all
@@ -587,7 +591,6 @@ class Controller:
 
     def _update_status(self, cd: ComputeDomain) -> None:
         nodes = self._collect_nodes(cd)
-        status = self._calculate_global_status(cd, nodes)
         # Only write on change: an unconditional write emits MODIFIED, which
         # re-enqueues this CD, which writes again — a full-speed loop.
         # Conditions are evolved from the live object so lastTransitionTime
@@ -595,8 +598,12 @@ class Controller:
         fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
         if fresh is None:
             return
+        # Readiness judged against the LIVE desired_nodes: the resize
+        # orchestrator may have moved the membership target since this
+        # reconcile's informer copy was taken.
+        status = self._calculate_global_status(fresh, nodes)
         ready_count = sum(1 for n in nodes if n.status == CD_STATUS_READY)
-        want = cd.spec.num_nodes or len(nodes)
+        want = fresh.status.desired_nodes or cd.spec.num_nodes or len(nodes)
         degraded_nodes = self._degraded_member_nodes({n.name for n in nodes})
         conds = copy.deepcopy(fresh.status.conditions)
         set_condition(conds, CD_COND_VALIDATED, CONDITION_TRUE,
@@ -629,12 +636,19 @@ class Controller:
         # load would never be re-written and the summary would vanish on
         # the first reconcile after a rollup (same silent-loss class the
         # placement carry above guards against).
+        # epoch / desired_nodes / resize are owned by the resize
+        # orchestrator (controller/elastic.py); like placement and
+        # utilization, the aggregation must carry them, never wipe them.
         desired = ComputeDomainStatus(status=status, nodes=nodes,
                                       conditions=conds,
                                       placement=copy.deepcopy(
                                           fresh.status.placement),
                                       mesh_bundle=copy.deepcopy(bundle),
-                                      utilization=fresh.status.utilization)
+                                      utilization=fresh.status.utilization,
+                                      epoch=fresh.status.epoch,
+                                      desired_nodes=fresh.status.desired_nodes,
+                                      resize=copy.deepcopy(
+                                          fresh.status.resize))
         if fresh.status == desired:
             self.metric.set(cd.namespace, cd.name, status)
             if bundle is not None:
@@ -652,10 +666,16 @@ class Controller:
             # copy: a CAS retry against a scheduler that just recorded the
             # block must not revert it to the stale (None) value — and the
             # bundle recompiles against THAT placement (pure in-memory
-            # compile, safe under the CAS-retry contract).
+            # compile, safe under the CAS-retry contract). The elastic
+            # fields ride the same rule: a resize orchestrator mid-epoch
+            # must never have its phase pointer reverted by a racing
+            # aggregation.
             new = copy.deepcopy(desired)
             new.placement = copy.deepcopy(obj.status.placement)
             new.utilization = obj.status.utilization
+            new.epoch = obj.status.epoch
+            new.desired_nodes = obj.status.desired_nodes
+            new.resize = copy.deepcopy(obj.status.resize)
             b, trig = self._compile_mesh_bundle(
                 new.placement, obj.status.mesh_bundle)
             new.mesh_bundle = copy.deepcopy(b)
@@ -729,6 +749,7 @@ class Controller:
                     self.api.delete(COMPUTE_DOMAIN_CLIQUE, clique.name, clique.namespace)
                 except NotFoundError:
                     pass
+        self._delete_agent_leases(cd.uid, cd.namespace)
         self._remove_node_labels(cd.uid)
         self.metric.forget(cd.namespace, cd.name)
         self.meshgen_metrics.forget(cd.namespace, cd.name)
@@ -742,6 +763,23 @@ class Controller:
             self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace, drop_finalizer)
         except NotFoundError:
             pass
+
+    def _delete_agent_leases(self, cd_uid: str,
+                             namespace: Optional[str] = None) -> None:
+        """Drop the slice agents' liveness Leases for a domain (named
+        ``slice-agent.<uid>.<node>``) — a killed agent cannot delete its
+        own, so domain teardown and the orphan sweep must."""
+        from k8s_dra_driver_tpu.pkg.leaderelection import LEASE
+
+        prefix = f"slice-agent.{cd_uid}."
+        leases = (self.api.list(LEASE, namespace=namespace)
+                  if namespace else self.api.list(LEASE))
+        for ls in leases:
+            if ls.meta.name.startswith(prefix):
+                try:
+                    self.api.delete(LEASE, ls.meta.name, ls.namespace)
+                except NotFoundError:
+                    pass
 
     def _remove_node_labels(self, cd_uid: str) -> None:
         for node in self.api.list(NODE, label_selector={COMPUTE_DOMAIN_NODE_LABEL: cd_uid}):
@@ -773,6 +811,23 @@ class Controller:
             if clique.domain_uid and clique.domain_uid not in live_uids:
                 try:
                     self.api.delete(COMPUTE_DOMAIN_CLIQUE, clique.name, clique.namespace)
+                    removed += 1
+                except NotFoundError:
+                    pass
+        from k8s_dra_driver_tpu.pkg.leaderelection import LEASE
+
+        for ls in self.api.list(LEASE):
+            if not ls.meta.name.startswith("slice-agent."):
+                continue
+            # Name shape: slice-agent.<uid>.<node>. The uid (uuid hex)
+            # never contains a dot, but NODE names can (FQDNs) — split
+            # from the LEFT or a dotted node name corrupts the uid and
+            # the sweep eats live domains' leases.
+            rest = ls.meta.name[len("slice-agent."):]
+            uid = rest.split(".", 1)[0]
+            if uid and uid not in live_uids:
+                try:
+                    self.api.delete(LEASE, ls.meta.name, ls.namespace)
                     removed += 1
                 except NotFoundError:
                     pass

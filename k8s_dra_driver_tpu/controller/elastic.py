@@ -1,0 +1,792 @@
+"""ElasticDomainController — controller-orchestrated resize epochs.
+
+ComputeDomain membership becomes a first-class mutable dimension, driven
+by two signals:
+
+- **operator intent**: editing ``spec.numNodes`` on a placed domain;
+- **failure**: a member's slice-agent liveness Lease expires (the node
+  went down), triggering a heal-shrink to the survivors — and, once the
+  host returns (its agent re-registers and its lease renews), a grow
+  epoch back toward ``spec.numNodes``.
+
+Each transition is one **resize epoch**, a crash-resumable state machine
+persisted in ``ComputeDomainStatus.resize`` (every phase pointer is
+CAS-written BEFORE its side effects, so a controller restarted from the
+WAL resumes — or rolls back — a half-done epoch instead of forgetting it):
+
+    (detect) --> Quiescing --> Placing --> Restarting --> (epoch += 1)
+                     |            |            |
+                     +------- rollback to the prior placement ----------+
+
+- **Quiescing**: every surviving worker's claims are cordoned with the
+  owner-tagged cordon CAS (``rebalancer.try_cordon(owner="resize")`` — of
+  the resize epoch and a live-repack migration racing on an overlapping
+  host, exactly one wins) and checkpointed through the same
+  ``MigrationCheckpoint`` handshake live repack uses: state fsync'd
+  before any release, so leaked ICI partitions are impossible by
+  construction. Worker pods on dead hosts are deleted (the kubelet
+  eviction analog); their claims fall to ownerRef GC.
+- **Placing**: the new membership — chosen at epoch start: shrink keeps
+  the survivors' most compact sub-block (falling back to a row-major
+  chain when no axis-aligned sub-block of the target size exists), grow
+  claims adjacent hosts via ``placement.iter_host_blocks`` preferring
+  blocks containing the current members — is recorded in ONE CAS along
+  with ``desired_nodes`` and the phase pointer.
+- **Restarting**: stale clique members are deregistered (their worker
+  slot is remembered for an idempotent re-join), added nodes get the
+  domain's node label (the DaemonSet follows), the controller's meshgen
+  path recompiles the bundle for the NEW geometry at a bumped revision,
+  and the surviving worker pods restart into it (re-prepare clears the
+  MigrationCheckpoint entries and re-materializes the CDI env).
+
+Any mid-epoch failure — or a stalled phase — rolls back to the exact
+prior placement: quiesced claims re-prepare on their source nodes, the
+prior placement/desired size is restored, and the next attempt waits out
+a capped-exponential deterministic-jitter backoff (``pkg.backoff``).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set
+
+from k8s_dra_driver_tpu.api.computedomain import (
+    CD_STATUS_REJECTED,
+    ComputeDomain,
+    ComputeDomainPlacement,
+    ComputeDomainResize,
+    RESIZE_PLACING,
+    RESIZE_QUIESCING,
+    RESIZE_RESTARTING,
+    RESIZE_TRIGGER_GROW,
+    RESIZE_TRIGGER_HEAL,
+    RESIZE_TRIGGER_SPEC,
+    COMPUTE_DOMAIN_NODE_LABEL,
+)
+from k8s_dra_driver_tpu.api.configs import TPU_DRIVER_NAME, channel_domain_uid
+from k8s_dra_driver_tpu.daemon.agent import agent_lease_name
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    COMPUTE_DOMAIN_CLIQUE,
+    NODE,
+    POD,
+    RESOURCE_CLAIM,
+)
+from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.backoff import Backoff, BackoffMetrics
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_DOMAIN_DEGRADED,
+    REASON_DOMAIN_HEALED,
+    REASON_DOMAIN_RESIZING,
+    REASON_RESIZE_FAILED,
+)
+from k8s_dra_driver_tpu.pkg.leaderelection import LEASE
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
+from k8s_dra_driver_tpu.plugins.checkpoint import MIGRATION_CHECKPOINTED
+from k8s_dra_driver_tpu.rebalancer.controller import (
+    release_cordon,
+    try_cordon,
+)
+
+log = logging.getLogger(__name__)
+
+# Owner tag for the atomic cordon CAS — distinct from the rebalancer's and
+# the autoscaler's, so of the actor roles racing on one claim exactly one
+# wins (same-owner re-acquisition is this controller's crash-resume path).
+CORDON_OWNER = "resize"
+
+# Virtual-seconds envelope for the time-to-healed histogram: 1s .. ~4min.
+RESIZE_SECONDS_BUCKETS = tuple(float(2 ** k) for k in range(9))
+
+
+@dataclass
+class ElasticConfig:
+    """Policy knobs (docs/reference/elastic-domains.md)."""
+
+    # Extra grace past a lease's own duration before a member counts lost.
+    lease_grace_s: float = 0.0
+    # Backoff between failed epoch attempts on one (domain, target).
+    backoff_base_s: float = 2.0
+    backoff_cap_s: float = 60.0
+    # A phase making no progress for this long rolls the epoch back (a
+    # bundle that never recompiles, an agent that never re-registers).
+    stall_timeout_s: float = 120.0
+
+
+class ElasticMetrics:
+    def __init__(self, registry: Registry):
+        self.epochs_total = registry.register(Counter(
+            "tpu_dra_resize_epochs_total",
+            "Resize epochs finished, by trigger (spec/heal/grow) and "
+            "outcome (completed/rolled_back).",
+            ("trigger", "outcome")))
+        self.domain_epoch = registry.register(Gauge(
+            "tpu_dra_domain_epoch",
+            "Completed resize epochs per ComputeDomain (0 = never "
+            "resized).",
+            ("namespace", "domain")))
+        self.time_to_healed = registry.register(Histogram(
+            "tpu_dra_resize_time_to_healed_seconds",
+            "Start-to-completion latency of resize epochs on the "
+            "orchestrator clock (virtual seconds in the sim), by trigger.",
+            ("trigger",),
+            buckets=RESIZE_SECONDS_BUCKETS))
+
+
+def _prepared(plugin) -> Dict[str, object]:
+    """The plugin's checkpoint view: the TPU plugin keeps it behind
+    ``.state`` (DeviceState), the compute-domain plugin exposes it
+    directly — ONE probe for that seam, not three copies."""
+    if hasattr(plugin, "state"):
+        return plugin.state.prepared_claims()
+    return plugin.prepared_claims()
+
+
+@dataclass
+class _Unit:
+    """One domain worker: the consumer pod plus its claims, keyed to the
+    node the claims are allocated on."""
+
+    pod: object
+    node: str
+    tpu_claims: List[object]
+    channel_claims: List[object]
+
+    @property
+    def claims(self) -> List[object]:
+        return self.tpu_claims + self.channel_claims
+
+
+class _EpochAbort(Exception):
+    """Raised inside an epoch step to trigger rollback with a reason."""
+
+
+class ElasticDomainController:
+    """``plugin_resolver(node_name)`` must return an object exposing the
+    kubelet-plugin surface (prepare_resource_claims / migrate_claim_out /
+    migrate_claim_end) for LIVE nodes and None for unknown or down ones —
+    the same seam the rebalancer uses. ``cd_plugin_resolver`` is the
+    compute-domain-plugin half (channel claims)."""
+
+    def __init__(
+        self,
+        api,
+        allocator,
+        plugin_resolver: Callable[[str], object],
+        cd_plugin_resolver: Callable[[str], object],
+        config: Optional[ElasticConfig] = None,
+        metrics_registry: Optional[Registry] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.allocator = allocator
+        self.resolve_plugin = plugin_resolver
+        self.resolve_cd_plugin = cd_plugin_resolver
+        self.config = config or ElasticConfig()
+        registry = metrics_registry or Registry()
+        self.metrics = ElasticMetrics(registry)
+        self.recorder = EventRecorder(api, "elastic-domains",
+                                      metrics_registry=registry)
+        self.clock = clock
+        self.backoff = Backoff(
+            base=self.config.backoff_base_s, cap=self.config.backoff_cap_s,
+            jitter=0.2, clock=clock,
+            metrics=BackoffMetrics(registry), source="resize")
+        # Epochs currently in flight, as of the last step() — the sim
+        # folds this into its quiescence token so a waiting phase (bundle
+        # recompile, agent re-join) keeps the clock stepping.
+        self.in_flight = 0
+
+    # -- pass entry -----------------------------------------------------------
+
+    def step(self) -> int:
+        """One orchestration pass; returns how many domains advanced an
+        epoch phase (0 on a quiet cluster). One listing per kind per
+        pass — per-domain work reads the shared snapshot."""
+        domains = [cd for cd in self.api.list(COMPUTE_DOMAIN)
+                   if not cd.deleting
+                   and cd.status.status != CD_STATUS_REJECTED
+                   and cd.spec.num_nodes > 1
+                   and cd.status.placement is not None]
+        if not domains:
+            self.in_flight = 0
+            return 0
+        self.in_flight = sum(1 for cd in domains
+                             if cd.status.resize is not None)
+        leases = {(ls.namespace, ls.meta.name): ls
+                  for ls in self.api.list(LEASE)}
+        claims = self.api.list(RESOURCE_CLAIM)
+        pods_by_uid = {p.uid: p for p in self.api.list(POD)}
+        advanced = 0
+        for cd in domains:
+            units = self._worker_units(cd, claims, pods_by_uid)
+            try:
+                if cd.status.resize is not None:
+                    advanced += self._advance(cd, units)
+                else:
+                    advanced += self._detect(cd, units, leases)
+            except Exception:  # noqa: BLE001 — one domain must not wedge the pass
+                log.exception("elastic pass failed for %s", cd.key)
+        return advanced
+
+    def pending_retries(self) -> int:
+        """Backoff-blocked epoch attempts — folded into the sim's
+        quiescence token so a deterministic run keeps stepping while a
+        retry is still owed instead of settling early."""
+        return self.backoff.pending()
+
+    # -- snapshot helpers -----------------------------------------------------
+
+    @staticmethod
+    def _worker_units(cd, claims, pods_by_uid) -> List[_Unit]:
+        """The domain's worker pods with their claims, from the pass's
+        shared claim/pod listings (no per-domain scans)."""
+        by_pod: Dict[str, _Unit] = {}
+        channel_uids = set()
+        for c in claims:
+            if channel_domain_uid(c) != cd.uid:
+                continue
+            for r in c.reserved_for:
+                if r.kind != POD:
+                    continue
+                pod = pods_by_uid.get(r.uid)
+                if pod is None:
+                    continue
+                unit = by_pod.setdefault(pod.uid, _Unit(
+                    pod=pod, node=pod.node_name, tpu_claims=[],
+                    channel_claims=[]))
+                unit.channel_claims.append(c)
+                channel_uids.add(c.uid)
+        if not by_pod:
+            return []
+        for c in claims:
+            if c.uid in channel_uids or c.allocation is None:
+                continue
+            if not any(r.driver == TPU_DRIVER_NAME
+                       for r in c.allocation.devices):
+                continue
+            for r in c.reserved_for:
+                if r.kind == POD and r.uid in by_pod:
+                    by_pod[r.uid].tpu_claims.append(c)
+        return list(by_pod.values())
+
+    def _member_lost(self, cd, node: str, leases) -> bool:
+        """A member counts lost when its slice agent's liveness lease
+        exists and has expired (plus grace). A missing lease is NOT
+        failure — agents create theirs at startup, so absence means
+        'not started yet', and teardown deletes it cleanly."""
+        ls = leases.get((cd.namespace, agent_lease_name(cd.uid, node)))
+        if ls is None:
+            return False
+        return (self.clock() - ls.renewed_at
+                > ls.lease_duration_s + self.config.lease_grace_s)
+
+    # -- detection ------------------------------------------------------------
+
+    def _detect(self, cd: ComputeDomain, units, leases) -> int:
+        placement = cd.status.placement
+        current = list(placement.nodes)
+        lost = [n for n in current if self._member_lost(cd, n, leases)]
+        spec_target = cd.spec.num_nodes
+        if lost:
+            target = len(current) - len(lost)
+            trigger = RESIZE_TRIGGER_HEAL
+            if target < 1:
+                # Every member is dead: nothing to shrink TO. Narrate once
+                # per backoff period and wait for a host to return.
+                key = (cd.uid, 0)
+                if self.backoff.ready(key):
+                    self.backoff.failure(key)
+                    self.recorder.warning(
+                        cd, REASON_RESIZE_FAILED,
+                        "cannot heal: every member host's lease expired")
+                return 0
+        elif spec_target != len(current):
+            target = spec_target
+            trigger = (RESIZE_TRIGGER_GROW if target > len(current)
+                       else RESIZE_TRIGGER_SPEC)
+            # A grow right after a heal is the host-returned recovery
+            # path; require the epoch machinery to be the one that shrank
+            # us OR an explicit spec edit — both land here.
+        else:
+            return 0
+        key = (cd.uid, target)
+        if not self.backoff.ready(key):
+            return 0
+        new_placement = self._plan_membership(cd, current, lost, target)
+        if new_placement is None:
+            # No feasible geometry (grow with no free adjacent block):
+            # wait for capacity/churn — the rebalancer's demand signal,
+            # not a failure of this controller.
+            return 0
+        return self._start_epoch(cd, units, trigger, target, lost,
+                                 new_placement)
+
+    # -- membership planning --------------------------------------------------
+
+    def _plan_membership(self, cd, current: List[str], lost: List[str],
+                         target: int) -> Optional[ComputeDomainPlacement]:
+        """The new membership geometry, decided ONCE at epoch start and
+        recorded on the resize record so a crash replays the same
+        decision. Shrink prefers the most compact axis-aligned sub-block
+        of the survivors (``iter_host_blocks`` yields compact-first),
+        degrading to a row-major chain (1-D block) when none of the
+        target size exists — e.g. 3 survivors of a 2x2 block. Grow claims
+        adjacent hosts via the same enumeration, preferring the block
+        that keeps the most current members."""
+        placement = cd.status.placement
+        survivors = [n for n in current if n not in lost]
+        topologies = self.allocator.node_topologies()
+        if target <= len(survivors):
+            block = next(placement_lib.iter_host_blocks(
+                topologies, survivors, target), None)
+            if block is not None:
+                return ComputeDomainPlacement(
+                    ici_domain=block.ici_domain,
+                    block_origin=block.origin_str,
+                    block_shape=block.shape_str,
+                    nodes=list(block.nodes))
+            kept = survivors[:target]
+            if not kept:
+                return None
+            # Row-major chain: no axis-aligned sub-block of this size
+            # exists among the survivors (3 of a 2x2 block), so the
+            # domain degrades to a 1xN host chain — a rectangular grid
+            # meshgen still compiles, trading block adjacency for
+            # availability until the host returns.
+            return ComputeDomainPlacement(
+                ici_domain=placement.ici_domain,
+                block_origin=placement.block_origin,
+                block_shape=f"1x{len(kept)}",
+                nodes=kept)
+        # Grow: survivors plus fully-free live hosts, best block = most
+        # current members kept (ties: the enumeration's compact-first
+        # deterministic order).
+        overview = self.allocator.placement_overview(TPU_DRIVER_NAME)
+        candidates = list(survivors)
+        for name, entry in sorted(overview.items()):
+            if name in survivors or entry["used_mask"]:
+                continue
+            if self.resolve_plugin(name) is None:
+                continue  # unknown or down host
+            candidates.append(name)
+        best = None
+        best_kept = -1
+        for block in placement_lib.iter_host_blocks(
+                topologies, candidates, target):
+            kept = len(set(block.nodes) & set(survivors))
+            if kept > best_kept:
+                best, best_kept = block, kept
+                if kept == len(survivors):
+                    break
+        if best is None or best_kept < len(survivors):
+            # Never grow through a block that evicts current members —
+            # that is a migration (the rebalancer's job), not a resize.
+            return None
+        return ComputeDomainPlacement(
+            ici_domain=best.ici_domain, block_origin=best.origin_str,
+            block_shape=best.shape_str, nodes=list(best.nodes))
+
+    # -- epoch start ----------------------------------------------------------
+
+    def _start_epoch(self, cd, units, trigger: str, target: int,
+                     lost: List[str], new_placement) -> int:
+        """Cordon first, record second: the owner-tagged cordon CAS on
+        every live unit claim is the arbitration point against the
+        rebalancer — losing ANY claim means another actor is mid-flight
+        on this domain's hosts, so back off whole without writing."""
+        live_units = [u for u in units if u.node not in lost]
+        acquired = []
+        for u in live_units:
+            for c in u.claims:
+                if try_cordon(self.api, c, owner=CORDON_OWNER):
+                    acquired.append(c)
+                    continue
+                for got in acquired:
+                    release_cordon(self.api, got)
+                self.backoff.failure((cd.uid, target))
+                return 0
+        prior = copy.deepcopy(cd.status.placement)
+        record = ComputeDomainResize(
+            phase=RESIZE_QUIESCING, trigger=trigger, target_nodes=target,
+            lost_nodes=list(lost),
+            new_placement=new_placement,
+            prior_placement=prior,
+            prior_desired=cd.status.desired_nodes or len(prior.nodes),
+            attempts=self.backoff.failures((cd.uid, target)) + 1,
+            started_at=self.clock(),
+        )
+
+        def mutate(obj, record=record):
+            if obj.status.resize is None:
+                obj.status.resize = copy.deepcopy(record)
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace,
+                                       mutate)
+        except NotFoundError:
+            for got in acquired:
+                release_cordon(self.api, got)
+            return 0
+        if trigger == RESIZE_TRIGGER_HEAL:
+            self.recorder.warning(
+                cd, REASON_DOMAIN_DEGRADED,
+                "member host lease(s) expired: " + ",".join(sorted(lost)))
+        self.recorder.normal(
+            cd, REASON_DOMAIN_RESIZING,
+            f"resize epoch started ({trigger}): {len(prior.nodes)} -> "
+            f"{target} hosts")
+        fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
+        if fresh is not None and fresh.status.resize is not None:
+            return self._advance(fresh, units)
+        return 1
+
+    # -- epoch advance --------------------------------------------------------
+
+    def _advance(self, cd: ComputeDomain, units) -> int:
+        r = cd.status.resize
+        with tracing.span("resize.advance", domain=cd.key, phase=r.phase,
+                          target=r.target_nodes, trigger=r.trigger):
+            try:
+                if (self.clock() - r.started_at
+                        > self.config.stall_timeout_s):
+                    raise _EpochAbort(
+                        f"epoch stalled in {r.phase} past "
+                        f"{self.config.stall_timeout_s:g}s")
+                if r.phase == RESIZE_QUIESCING:
+                    return self._phase_quiesce(cd, units)
+                if r.phase == RESIZE_PLACING:
+                    return self._phase_place(cd)
+                if r.phase == RESIZE_RESTARTING:
+                    return self._phase_restart(cd, units)
+                raise _EpochAbort(f"unknown resize phase {r.phase!r}")
+            except _EpochAbort as e:
+                self._rollback(cd, units, str(e))
+                return 1
+            except Exception as e:  # noqa: BLE001 — any escape rolls back; leaked partitions are impossible (MigrationCheckpoint is fsync'd before release)
+                log.exception("resize epoch for %s failed in %s",
+                              cd.key, r.phase)
+                self._rollback(cd, units, f"{r.phase}: {e}")
+                return 1
+
+    def _survivor_units(self, cd, units) -> List[_Unit]:
+        r = cd.status.resize
+        keep = set(r.new_placement.nodes) if r.new_placement else set()
+        return [u for u in units if u.node in keep]
+
+    def _phase_quiesce(self, cd, units) -> int:
+        """Survivors' claims -> MigrationCheckpoint (idempotent: entries
+        already checkpointed are skipped, so a WAL-restored controller
+        re-runs this phase safely); dead/removed members' worker pods are
+        deleted. Then the phase pointer moves."""
+        r = cd.status.resize
+        keep = set(r.new_placement.nodes)
+        for u in self._survivor_units(cd, units):
+            tpu = self.resolve_plugin(u.node)
+            cdp = self.resolve_cd_plugin(u.node)
+            if tpu is None or cdp is None:
+                raise _EpochAbort(f"survivor node {u.node} has no live "
+                                  f"plugin; cannot quiesce")
+            self._quiesce_claims(tpu, u.tpu_claims)
+            self._quiesce_claims(cdp, u.channel_claims)
+        self._fire_fault("resize:quiesced")
+        # Workers on dead or removed hosts: delete the pods (kubelet
+        # eviction analog); ownerRef GC collects their generated claims
+        # and frees the capacity.
+        for u in units:
+            if u.node in keep:
+                continue
+            try:
+                self.api.delete(POD, u.pod.meta.name, u.pod.namespace)
+            except NotFoundError:
+                pass
+        self._set_phase(cd, RESIZE_PLACING)
+        return 1
+
+    @staticmethod
+    def _quiesce_claims(plugin, claims) -> None:
+        prepared = _prepared(plugin)
+        for c in claims:
+            entry = prepared.get(c.uid)
+            if entry is None:
+                continue  # never prepared here (pod still pending)
+            if entry.state == MIGRATION_CHECKPOINTED:
+                continue  # resume path: already quiesced
+            plugin.migrate_claim_out(c.uid)
+
+    def _phase_place(self, cd) -> int:
+        """Record the new geometry: placement + desired_nodes + phase in
+        ONE CAS — the point of no return for this epoch (rollback from
+        later phases restores the prior placement the record carries)."""
+        def mutate(obj):
+            r = obj.status.resize
+            if r is None or r.phase != RESIZE_PLACING:
+                return
+            obj.status.placement = copy.deepcopy(r.new_placement)
+            obj.status.desired_nodes = r.target_nodes
+            r.phase = RESIZE_RESTARTING
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace,
+                                       mutate)
+        except NotFoundError:
+            return 0
+        self._fire_fault("resize:placed")
+        return 1
+
+    def _phase_restart(self, cd, units) -> int:
+        """Converge the runtime onto the new geometry: clique membership
+        first (stale members deregistered with their slot remembered,
+        added nodes labeled so the DaemonSet follows), then wait for the
+        meshgen recompile, then restart surviving workers into it, then
+        finalize. Every step here is idempotent — this phase re-enters
+        every pass until the completion predicate holds."""
+        r = cd.status.resize
+        keep = set(r.new_placement.nodes)
+        self._sync_clique_membership(cd, keep)
+        self._sync_node_labels(cd, keep, set(r.prior_placement.nodes),
+                               set(r.lost_nodes))
+        bundle = cd.status.mesh_bundle
+        if bundle is None or {d.node for d in bundle.device_order} != keep:
+            return 0  # meshgen hasn't recompiled for the new geometry yet
+        # Restart survivors whose claims are still checkpoint-quiesced:
+        # dropping the pod to Pending makes the kubelet re-run the
+        # (idempotent) prepare, which clears the MigrationCheckpoint
+        # entries and re-materializes the CDI env from the NEW bundle.
+        waiting = False
+        for u in self._survivor_units(cd, units):
+            tpu = self.resolve_plugin(u.node)
+            cdp = self.resolve_cd_plugin(u.node)
+            if tpu is None or cdp is None:
+                raise _EpochAbort(f"survivor node {u.node} lost its plugin "
+                                  f"mid-restart")
+            quiesced = any(
+                e.state == MIGRATION_CHECKPOINTED
+                for plugin in (tpu, cdp)
+                for uid, e in _prepared(plugin).items()
+                if uid in {c.uid for c in u.claims})
+            if quiesced:
+                waiting = True
+                self._rebind_pod(u)
+                continue
+            pod = self.api.try_get(POD, u.pod.meta.name, u.pod.namespace)
+            if pod is None or pod.phase != "Running":
+                waiting = True
+        if waiting or not self._members_ready(cd, keep):
+            return 0
+        self._finalize(cd, units)
+        return 1
+
+    def _members_ready(self, cd, keep: Set[str]) -> bool:
+        ready = {n.name for n in cd.status.nodes
+                 if n.status == "Ready"}
+        return keep <= ready
+
+    def _sync_clique_membership(self, cd, keep: Set[str]) -> None:
+        """Deregister clique members outside the new placement; their
+        worker slot is recorded in the clique's released map so a
+        returning host re-joins into the SAME slot (the idempotent
+        re-join contract rollback depends on)."""
+        for clique in self.api.list(COMPUTE_DOMAIN_CLIQUE,
+                                    namespace=cd.namespace):
+            if clique.domain_uid != cd.uid:
+                continue
+            stale = [n.node_name for n in clique.nodes
+                     if n.node_name not in keep]
+            if not stale:
+                continue
+
+            def mutate(obj, stale=stale):
+                for name in stale:
+                    info = obj.node_info(name)
+                    if info is not None and info.index >= 0:
+                        obj.released[name] = info.index
+                obj.nodes = [n for n in obj.nodes
+                             if n.node_name not in stale]
+            try:
+                self.api.update_with_retry(
+                    COMPUTE_DOMAIN_CLIQUE, clique.name, clique.namespace,
+                    mutate)
+            except NotFoundError:
+                continue
+
+    def _sync_node_labels(self, cd, keep: Set[str], prior: Set[str],
+                          lost: Set[str]) -> None:
+        """Grow: plant the domain label on ADDED nodes so the slice-agent
+        DaemonSet follows before any workload lands there. Operator-shrunk
+        HEALTHY nodes lose theirs (the DaemonSet leaves with the member).
+        Dead members keep their label deliberately — a returning host's
+        agent restarts immediately and its re-join is what the grow-back
+        path waits on."""
+        for name in sorted(keep - prior):
+            def mutate(node, uid=cd.uid):
+                current = node.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL)
+                if current is None:
+                    node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL] = uid
+            try:
+                self.api.update_with_retry(NODE, name, "", mutate)
+            except NotFoundError:
+                continue
+        for name in sorted(prior - keep - lost):
+            def unlabel(node, uid=cd.uid):
+                if node.meta.labels.get(COMPUTE_DOMAIN_NODE_LABEL) == uid:
+                    del node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL]
+            try:
+                self.api.update_with_retry(NODE, name, "", unlabel)
+            except NotFoundError:
+                continue
+
+    def _rebind_pod(self, unit: _Unit) -> None:
+        """Drop a survivor worker to Pending so the kubelet re-prepares.
+        Change-gated on the live pod (a pod already Pending is not
+        re-written every pass while the prepare retries)."""
+        live = self.api.try_get(POD, unit.pod.meta.name, unit.pod.namespace)
+        if live is None or live.phase == "Pending":
+            return
+
+        def mutate(obj):
+            obj.phase = "Pending"
+            obj.ready = False
+        try:
+            self.api.update_with_retry(POD, unit.pod.meta.name,
+                                       unit.pod.namespace, mutate)
+        except NotFoundError:
+            pass
+
+    def _release_our_cordons(self, claims) -> None:
+        """Release ONLY cordons this controller owns: release_cordon is
+        owner-blind, and stripping another actor's in-flight cordon
+        (a rebalancer migration on a claim this epoch never acquired)
+        would re-open exactly the double-handle race the owner-tagged
+        CAS exists to prevent."""
+        from k8s_dra_driver_tpu.rebalancer.controller import (
+            CORDON_ANNOTATION,
+        )
+
+        for c in claims:
+            live = self.api.try_get(RESOURCE_CLAIM, c.meta.name, c.namespace)
+            if (live is not None
+                    and live.meta.annotations.get(CORDON_ANNOTATION)
+                    == CORDON_OWNER):
+                release_cordon(self.api, live)
+
+    def _finalize(self, cd, units) -> None:
+        """Side effects FIRST, record-clear LAST: a crash between them
+        leaves the Restarting record in place and this phase re-enters
+        idempotently — clearing the record first would strand released-
+        but-unreleased cordons with no resume pointer."""
+        r = cd.status.resize
+        key = (cd.uid, r.target_nodes)
+        for u in self._survivor_units(cd, units):
+            self._release_our_cordons(u.claims)
+
+        def mutate(obj):
+            rec = obj.status.resize
+            if rec is None:
+                return
+            obj.status.epoch += 1
+            obj.status.desired_nodes = rec.target_nodes
+            obj.status.resize = None
+        try:
+            self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace,
+                                       mutate)
+        except NotFoundError:
+            return
+        self.backoff.reset(key)
+        elapsed = max(0.0, self.clock() - r.started_at)
+        self.metrics.epochs_total.inc(r.trigger, "completed")
+        self.metrics.time_to_healed.observe(r.trigger, value=elapsed)
+        fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
+        if fresh is not None:
+            self.metrics.domain_epoch.set(cd.namespace, cd.name,
+                                          value=float(fresh.status.epoch))
+        self.recorder.normal(
+            cd, REASON_DOMAIN_HEALED,
+            f"resize epoch complete ({r.trigger}): domain now spans "
+            f"{r.target_nodes} host(s)")
+
+    # -- rollback -------------------------------------------------------------
+
+    def _rollback(self, cd, units, why: str) -> None:
+        """Restore the exact prior epoch: prior placement + desired size
+        back in one CAS (the meshgen path recompiles the bundle back),
+        quiesced survivor claims re-prepared on their source nodes (the
+        prepare path clears MigrationCheckpoint entries and re-activates
+        the source partitions — the ledger reads exactly as before), all
+        cordons released, and the next attempt paced by the backoff."""
+        r = cd.status.resize
+        key = (cd.uid, r.target_nodes if r is not None else 0)
+        with tracing.span("resize.rollback", domain=cd.key, why=why):
+            # Side effects FIRST (all idempotent), record-clear LAST: a
+            # crash mid-rollback leaves the phase record in place, the
+            # next pass retries the phase, fails the same way, and rolls
+            # back again — nothing is ever stranded without a resume
+            # pointer.
+            for u in units:
+                tpu = self.resolve_plugin(u.node)
+                cdp = self.resolve_cd_plugin(u.node)
+                for plugin, claims in ((tpu, u.tpu_claims),
+                                       (cdp, u.channel_claims)):
+                    if plugin is None:
+                        continue
+                    self._restore_claims(plugin, claims)
+                self._rebind_pod(u)
+                self._release_our_cordons(u.claims)
+
+            def mutate(obj):
+                rec = obj.status.resize
+                if rec is None:
+                    return
+                if rec.prior_placement is not None:
+                    obj.status.placement = copy.deepcopy(rec.prior_placement)
+                obj.status.desired_nodes = rec.prior_desired
+                obj.status.resize = None
+            try:
+                self.api.update_with_retry(COMPUTE_DOMAIN, cd.name,
+                                           cd.namespace, mutate)
+            except NotFoundError:
+                return
+        self.backoff.failure(key)
+        self.metrics.epochs_total.inc(
+            r.trigger if r is not None else "", "rolled_back")
+        self.recorder.warning(
+            cd, REASON_RESIZE_FAILED,
+            f"resize epoch rolled back to the prior placement: {why}")
+
+    def _restore_claims(self, plugin, claims) -> None:
+        prepared = _prepared(plugin)
+        quiesced = [c for c in claims
+                    if prepared.get(c.uid) is not None
+                    and prepared[c.uid].state == MIGRATION_CHECKPOINTED]
+        if not quiesced:
+            return
+        fresh = [self.api.try_get(RESOURCE_CLAIM, c.meta.name, c.namespace)
+                 for c in quiesced]
+        results = plugin.prepare_resource_claims(
+            [c for c in fresh if c is not None])
+        for uid, res in results.items():
+            if isinstance(res, Exception):
+                # The pod's kubelet retry loop owns recovery from here;
+                # the checkpoint holds no migration entry either way.
+                log.error("rollback re-prepare of %s failed: %s", uid, res)
+
+    # -- phase bookkeeping ----------------------------------------------------
+
+    def _set_phase(self, cd, phase: str) -> None:
+        def mutate(obj, phase=phase):
+            if obj.status.resize is not None:
+                obj.status.resize.phase = phase
+        self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace,
+                                   mutate)
+
+    # Crash-injection seam (tests raise from here to simulate a controller
+    # dying between phases; same shape as the plugins' fault hooks).
+    fault_hook: Optional[Callable[[str], None]] = None
+
+    def _fire_fault(self, point: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(point)

@@ -16,12 +16,14 @@ import logging
 import os
 import sys
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager
 from k8s_dra_driver_tpu.daemon.podmanager import PodManager
 from k8s_dra_driver_tpu.daemon.process import ProcessManager
-from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s import APIServer, NotFoundError
+from k8s_dra_driver_tpu.k8s.objects import new_meta
 from k8s_dra_driver_tpu.pkg import featuregates as fg
 from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.events import (
@@ -30,9 +32,22 @@ from k8s_dra_driver_tpu.pkg.events import (
     REASON_NODE_JOINED,
     find_compute_domain_by_uid,
 )
+from k8s_dra_driver_tpu.pkg.leaderelection import LEASE, Lease
 from k8s_dra_driver_tpu.tpulib.lib import TpuLib
 
 log = logging.getLogger(__name__)
+
+# Default liveness-lease duration for a slice agent. The agent renews at
+# a third of this; an expiry is the control plane's host-failure signal
+# (the node-heartbeat Lease analog) — what triggers a heal-shrink resize
+# epoch under ElasticComputeDomains.
+DEFAULT_AGENT_LEASE_S = 30.0
+
+
+def agent_lease_name(domain_uid: str, node_name: str) -> str:
+    """The per-(domain, node) liveness Lease, stored in the domain's
+    namespace beside its cliques."""
+    return f"slice-agent.{domain_uid}.{node_name}"
 
 # A real deployment runs the native bootstrap worker; tests and single-host
 # runs use this inert stand-in (sleeps forever, exits cleanly on SIGTERM).
@@ -62,6 +77,8 @@ class SliceAgent:
         pod_namespace: str = "",
         isolation: str = "domain",
         metrics_registry=None,
+        clock: Callable[[], float] = time.time,
+        lease_duration_s: float = DEFAULT_AGENT_LEASE_S,
     ):
         if not domain_uid:
             raise ValueError("domain_uid (COMPUTE_DOMAIN_UUID) is required")
@@ -109,6 +126,12 @@ class SliceAgent:
         # stale read can never overwrite a newer verdict (the reference
         # serializes via a latest-wins workqueue key, podmanager.go:76-82).
         self._sync_mu = threading.Lock()
+        # Liveness lease: renewed by the run loop, read by the elastic
+        # controller — its expiry IS the host-failure trigger, so a hard
+        # kill (node down) is observable without any dying-gasp write.
+        self.clock = clock
+        self.lease_duration_s = lease_duration_s
+        self._lease_renewed = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -147,10 +170,44 @@ class SliceAgent:
                     self.clique.register(self.node_name, self.pod_ip,
                                          dns_name=self.dns_name)
             sp.attrs["index"] = self.index
+            self._renew_lease(force=True)
             if self.pod_manager is not None:
                 self.pod_manager.add_clique_label(self.ici_domain)
                 self.pod_manager.start()
             self.sync()
+
+    # -- liveness lease ------------------------------------------------------
+
+    @property
+    def lease_name(self) -> str:
+        return agent_lease_name(self.domain_uid, self.node_name)
+
+    def _renew_lease(self, force: bool = False) -> None:
+        """Create-or-renew this agent's liveness Lease. Renewed at a third
+        of the duration (kubelet heartbeat cadence); never raises — a
+        missed renewal is retried next sync, and only sustained silence
+        (a dead host) expires the lease."""
+        now = self.clock()
+        if not force and now - self._lease_renewed < self.lease_duration_s / 3:
+            return
+        try:
+            existing = self.api.try_get(LEASE, self.lease_name, self.namespace)
+            if existing is None:
+                self.api.create(Lease(
+                    meta=new_meta(self.lease_name, self.namespace),
+                    holder=self.node_name, acquired_at=now, renewed_at=now,
+                    lease_duration_s=self.lease_duration_s,
+                ))
+            else:
+                def renew(obj, now=now):
+                    obj.holder = self.node_name
+                    obj.renewed_at = now
+                    obj.lease_duration_s = self.lease_duration_s
+                self.api.update_with_retry(
+                    LEASE, self.lease_name, self.namespace, renew)
+            self._lease_renewed = now
+        except Exception as e:  # noqa: BLE001 — liveness must not kill the loop
+            log.debug("lease renewal for %s failed: %s", self.lease_name, e)
 
     def _event_target(self):
         """The ComputeDomain the uid names (resolved once), falling back to
@@ -191,7 +248,10 @@ class SliceAgent:
         with self._sync_mu:
             if self.clique is not None and self.pod_manager is not None:
                 ready = self.pod_manager.pod_ready()
-                self.clique.set_ready(self.node_name, ready)
+                try:
+                    self.clique.set_ready(self.node_name, ready)
+                except NotFoundError:
+                    return  # deregistered mid-flight; the sync loop re-joins
         if ready and self.clique is not None and not self._assembled_announced:
             self._announce_assembled(self.clique.members())
 
@@ -200,6 +260,7 @@ class SliceAgent:
         readiness. Deterministic for tests; run_forever() loops it."""
         if self.idle or self.clique is None:
             return
+        self._renew_lease()
         with tracing.span("clique.sync", domain=self.domain_uid,
                           node=self.node_name) as sp:
             members = self.clique.members()
@@ -220,7 +281,23 @@ class SliceAgent:
                     else self.check()
                 )
                 sp.attrs["ready"] = ready
-                self.clique.set_ready(self.node_name, ready)
+                try:
+                    self.clique.set_ready(self.node_name, ready)
+                except NotFoundError:
+                    # Our clique entry vanished — a resize epoch
+                    # deregistered this node (lease expired) while we were
+                    # alive or restarting. Re-join: the released-index
+                    # memory gives back the same worker slot, and the next
+                    # sync publishes readiness normally.
+                    log.info("%s deregistered from clique %s; re-joining",
+                             self.node_name, self.ici_domain)
+                    self.index = self.clique.register(self.node_name,
+                                                      self.pod_ip)
+                    if self.gates.enabled("SliceAgentsWithDNSNames"):
+                        # dns embeds the (possibly reclaimed) index, which
+                        # only exists post-register.
+                        self.clique.register(self.node_name, self.pod_ip,
+                                             dns_name=self.dns_name)
             if ready and not self._assembled_announced:
                 # Refetched: this pass's `members` predates our own
                 # set_ready, and the announcement should count it.
@@ -249,6 +326,9 @@ class SliceAgent:
         self._thread.start()
 
     def shutdown(self) -> None:
+        """Graceful stop: readiness withdrawn and the liveness lease
+        deleted, so a clean teardown never masquerades as a host failure
+        (lease expiry) to the elastic controller."""
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
@@ -259,6 +339,24 @@ class SliceAgent:
                 self.clique.set_ready(self.node_name, False)
         except Exception as e:  # noqa: BLE001 — API may already be gone
             log.debug("clique ready=false on shutdown failed: %s", e)
+        try:
+            self.api.delete(LEASE, self.lease_name, self.namespace)
+        except NotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — API may already be gone
+            log.debug("lease delete on shutdown failed: %s", e)
+        self.process.stop()
+
+    def kill(self) -> None:
+        """Hard stop — the node-down case: the run loop and child die with
+        NO dying-gasp API writes (a dead host cannot write). The clique
+        entry and the liveness lease are left as-is; the lease simply
+        stops renewing and its expiry is the failure signal."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self.pod_manager is not None:
+            self.pod_manager.stop()
         self.process.stop()
 
     # -- peer config ---------------------------------------------------------

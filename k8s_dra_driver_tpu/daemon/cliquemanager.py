@@ -67,7 +67,18 @@ class CliqueManager:
                         continue
                 return info.index
             used = set(clique.used_indices())
-            index = next(i for i in range(len(clique.nodes) + 1) if i not in used)
+            # Idempotent re-join: a node deregistered earlier (lease
+            # expiry, heal-shrink) reclaims the index it held — recorded
+            # in the clique's released map — as long as it is still free.
+            # Same node -> same worker slot across restarts, which the
+            # resize-epoch rollback (and anything keyed on TPU_WORKER_ID)
+            # depends on. A taken slot degrades to normal allocation.
+            prefer = clique.released.get(node_name)
+            if prefer is not None and prefer >= 0 and prefer not in used:
+                index = prefer
+            else:
+                index = next(i for i in range(len(clique.nodes) + 1)
+                             if i not in used)
             info = ComputeDomainDaemonInfo(
                 node_name=node_name,
                 ip_address=ip_address,
@@ -76,6 +87,7 @@ class CliqueManager:
                 ready=False,
             )
             clique.nodes.append(info)
+            clique.released.pop(node_name, None)
             try:
                 self.api.update(clique)
             except ConflictError:
@@ -112,9 +124,14 @@ class CliqueManager:
             if clique is None:
                 return
             before = len(clique.nodes)
+            gone = clique.node_info(node_name)
             clique.nodes = [n for n in clique.nodes if n.node_name != node_name]
             if len(clique.nodes) == before:
                 return
+            if gone is not None and gone.index >= 0:
+                # Remember the slot so a re-join of the SAME node gets it
+                # back (see register); a different node never inherits it.
+                clique.released[node_name] = gone.index
             try:
                 self.api.update(clique)
                 return

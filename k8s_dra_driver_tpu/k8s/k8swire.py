@@ -28,6 +28,7 @@ from k8s_dra_driver_tpu.api.computedomain import (
     ComputeDomainDaemonInfo,
     ComputeDomainNode,
     ComputeDomainPlacement,
+    ComputeDomainResize,
     ComputeDomainSpec,
     ComputeDomainStatus,
 )
@@ -977,6 +978,62 @@ def _meshbundle_decode(doc: Dict[str, Any]) -> MeshBundle:
     )
 
 
+def _placement_encode(p) -> Dict[str, Any]:
+    """ComputeDomainPlacement wire doc — shared by status.placement and
+    the resize record's prior/new placement snapshots."""
+    return {
+        "iciDomain": p.ici_domain,
+        "blockOrigin": p.block_origin,
+        "blockShape": p.block_shape,
+        "nodes": list(p.nodes),
+    }
+
+
+def _placement_decode(doc) -> Optional[ComputeDomainPlacement]:
+    if not doc:
+        return None
+    return ComputeDomainPlacement(
+        ici_domain=doc.get("iciDomain", ""),
+        block_origin=doc.get("blockOrigin", ""),
+        block_shape=doc.get("blockShape", ""),
+        nodes=list(doc.get("nodes") or []),
+    )
+
+
+def _resize_encode(r: ComputeDomainResize) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "phase": r.phase,
+        "trigger": r.trigger,
+        "targetNodes": r.target_nodes,
+        "attempts": r.attempts,
+        "startedAt": r.started_at,
+        "priorDesired": r.prior_desired,
+    }
+    if r.lost_nodes:
+        doc["lostNodes"] = list(r.lost_nodes)
+    if r.new_placement is not None:
+        doc["newPlacement"] = _placement_encode(r.new_placement)
+    if r.prior_placement is not None:
+        doc["priorPlacement"] = _placement_encode(r.prior_placement)
+    return doc
+
+
+def _resize_decode(doc) -> Optional[ComputeDomainResize]:
+    if not doc:
+        return None
+    return ComputeDomainResize(
+        phase=doc.get("phase", ""),
+        trigger=doc.get("trigger", ""),
+        target_nodes=int(doc.get("targetNodes", 0)),
+        lost_nodes=list(doc.get("lostNodes") or []),
+        new_placement=_placement_decode(doc.get("newPlacement")),
+        prior_placement=_placement_decode(doc.get("priorPlacement")),
+        prior_desired=int(doc.get("priorDesired", 0)),
+        attempts=int(doc.get("attempts", 0)),
+        started_at=float(doc.get("startedAt", 0.0)),
+    )
+
+
 def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
     spec: Dict[str, Any] = {"numNodes": cd.spec.num_nodes}
     if cd.spec.topology:
@@ -1000,13 +1057,13 @@ def _computedomain_encode(cd: ComputeDomain) -> Dict[str, Any]:
             for n in cd.status.nodes
         ]
     if cd.status.placement is not None:
-        p = cd.status.placement
-        status["placement"] = {
-            "iciDomain": p.ici_domain,
-            "blockOrigin": p.block_origin,
-            "blockShape": p.block_shape,
-            "nodes": list(p.nodes),
-        }
+        status["placement"] = _placement_encode(cd.status.placement)
+    if cd.status.epoch:
+        status["epoch"] = cd.status.epoch
+    if cd.status.desired_nodes:
+        status["desiredNodes"] = cd.status.desired_nodes
+    if cd.status.resize is not None:
+        status["resize"] = _resize_encode(cd.status.resize)
     if cd.status.mesh_bundle is not None:
         status["meshBundle"] = _meshbundle_encode(cd.status.mesh_bundle)
     if cd.status.utilization is not None:
@@ -1041,15 +1098,10 @@ def _computedomain_decode(doc: Dict[str, Any]) -> ComputeDomain:
                 )
                 for n in status.get("nodes") or []
             ],
-            placement=(
-                ComputeDomainPlacement(
-                    ici_domain=status["placement"].get("iciDomain", ""),
-                    block_origin=status["placement"].get("blockOrigin", ""),
-                    block_shape=status["placement"].get("blockShape", ""),
-                    nodes=list(status["placement"].get("nodes") or []),
-                )
-                if status.get("placement") else None
-            ),
+            placement=_placement_decode(status.get("placement")),
+            epoch=int(status.get("epoch", 0)),
+            desired_nodes=int(status.get("desiredNodes", 0)),
+            resize=_resize_decode(status.get("resize")),
             mesh_bundle=(
                 _meshbundle_decode(status["meshBundle"])
                 if status.get("meshBundle") else None
@@ -1201,6 +1253,7 @@ def _clique_encode(cl: ComputeDomainClique) -> Dict[str, Any]:
             }
             for n in cl.nodes
         ],
+        "released": {k: v for k, v in sorted(cl.released.items())},
     }
 
 
@@ -1219,6 +1272,8 @@ def _clique_decode(doc: Dict[str, Any]) -> ComputeDomainClique:
             )
             for n in doc.get("nodes") or []
         ],
+        released={str(k): int(v)
+                  for k, v in (doc.get("released") or {}).items()},
     )
 
 

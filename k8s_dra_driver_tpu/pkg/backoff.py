@@ -1,0 +1,150 @@
+"""One retry-backoff policy for every retry loop in the control plane.
+
+Before this module each retrying actor rolled its own loop: the workqueue
+had an exponential limiter plus a *random* jitter wrapper, the rebalancer
+retried failed migrations at full pass rate, and ad-hoc ``for _ in
+range(n)`` loops hid everywhere. This consolidates the policy:
+
+- **Capped exponential**: delay ``base * 2^k`` growing per consecutive
+  failure of a key, capped at ``cap``. ``first_free=True`` (the
+  pass-driven loops) makes the FIRST failure free — a single transient
+  error retries on the very next pass, the clamp only kicks in once a
+  key is *repeatedly* failing; ``first_free=False`` keeps the k8s
+  ItemExponentialFailureRateLimiter shape (first failure already waits
+  ``base``) for the workqueue path.
+- **Deterministic jitter**: the classic thundering-herd scaling factor in
+  ``[1-jitter, 1+jitter]``, derived from a CRC of ``(key, attempt)``
+  instead of an RNG. Two actors retrying different keys still
+  decorrelate, but a seeded sim run — and a test asserting on retry
+  timing — reproduces exactly.
+- **Per-key reset on success**: one success forgets the key's failure
+  history entirely (the k8s rate-limiter ``Forget`` contract).
+
+Every computed delay is observed into the shared
+``tpu_dra_retry_backoff_seconds`` histogram (label: ``source``), so an
+operator can see *which* retry loop is spinning from one scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Hashable, Optional
+
+from k8s_dra_driver_tpu.pkg.metrics import Histogram, Registry
+
+# Envelope sized for retry delays: 10ms .. ~10min.
+BACKOFF_SECONDS_BUCKETS = tuple(0.01 * (4 ** k) for k in range(9))
+
+
+class BackoffMetrics:
+    """The shared backoff histogram; get-or-create on the registry so
+    every adopting loop (workqueue, rebalancer, resize orchestrator)
+    lands series in ONE family, split by ``source``."""
+
+    def __init__(self, registry: Registry):
+        self.backoff_seconds = registry.register(Histogram(
+            "tpu_dra_retry_backoff_seconds",
+            "Computed retry-backoff delays, by retry-loop source "
+            "(workqueue name, rebalancer, resize).",
+            ("source",),
+            buckets=BACKOFF_SECONDS_BUCKETS,
+        ))
+
+
+def deterministic_jitter(key: Hashable, attempt: int, jitter: float) -> float:
+    """Scaling factor in [1-jitter, 1+jitter], a pure function of
+    (key, attempt) — reproducible across runs, decorrelated across keys."""
+    if jitter <= 0.0:
+        return 1.0
+    h = zlib.crc32(f"{key!r}:{attempt}".encode())
+    frac = (h % 10_000) / 10_000.0            # [0, 1)
+    return 1.0 + jitter * (2.0 * frac - 1.0)
+
+
+class Backoff:
+    """Per-key capped-exponential backoff with eligibility tracking.
+
+    Two usage styles, sharing one failure ledger:
+
+    - ``failure(key) -> delay``: record a failure and get the next delay
+      (what a delayed queue feeds its scheduler) — the workqueue style.
+    - ``failure(key)`` then ``ready(key)``: record failures and poll
+      eligibility against ``clock`` — the pass-driven style (rebalancer,
+      resize orchestrator), where the actor visits the key every pass
+      and must *skip* it until the backoff elapsed.
+
+    ``reset(key)`` on success forgets everything about the key.
+    Thread-safe.
+    """
+
+    def __init__(
+        self,
+        base: float = 1.0,
+        cap: float = 600.0,
+        jitter: float = 0.2,
+        clock: Callable[[], float] = time.monotonic,
+        metrics: Optional[BackoffMetrics] = None,
+        source: str = "",
+        first_free: bool = True,
+    ):
+        if base < 0 or cap < 0:
+            raise ValueError(f"base/cap must be >= 0, got {base}/{cap}")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.base = base
+        self.cap = cap
+        self.jitter = jitter
+        self.clock = clock
+        self.metrics = metrics
+        self.source = source
+        self.first_free = first_free
+        self._mu = threading.Lock()
+        self._failures: Dict[Hashable, int] = {}  # tpulint: guarded-by=_mu
+        self._eligible_at: Dict[Hashable, float] = {}  # tpulint: guarded-by=_mu
+
+    def delay_for(self, key: Hashable, failures: int) -> float:
+        """The pure policy: delay after the ``failures``-th consecutive
+        failure of ``key``, jittered and capped."""
+        exponent = failures - 2 if self.first_free else failures - 1
+        if exponent < 0:
+            return 0.0
+        raw = min(self.base * (2.0 ** exponent), self.cap)
+        return min(raw * deterministic_jitter(key, failures, self.jitter),
+                   self.cap)
+
+    def failure(self, key: Hashable) -> float:
+        """Record one failure; returns (and observes) the delay before the
+        key should be retried."""
+        with self._mu:
+            n = self._failures.get(key, 0) + 1
+            self._failures[key] = n
+            delay = self.delay_for(key, n)
+            self._eligible_at[key] = self.clock() + delay
+        if self.metrics is not None:
+            self.metrics.backoff_seconds.observe(self.source, value=delay)
+        return delay
+
+    def ready(self, key: Hashable) -> bool:
+        """True when the key may be retried now (or was never failed)."""
+        with self._mu:
+            at = self._eligible_at.get(key)
+        return at is None or self.clock() >= at
+
+    def pending(self) -> int:
+        """How many keys are currently backoff-blocked — the signal a
+        deterministic sim folds into its quiescence token so it keeps
+        stepping while a retry is still owed."""
+        now = self.clock()
+        with self._mu:
+            return sum(1 for at in self._eligible_at.values() if at > now)
+
+    def failures(self, key: Hashable) -> int:
+        with self._mu:
+            return self._failures.get(key, 0)
+
+    def reset(self, key: Hashable) -> None:
+        with self._mu:
+            self._failures.pop(key, None)
+            self._eligible_at.pop(key, None)

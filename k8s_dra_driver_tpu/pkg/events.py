@@ -78,6 +78,10 @@ REASON_SLO_BURN_RATE = "SLOBurnRate"
 REASON_SCALE_UP = "ScaleUp"
 REASON_SCALE_DOWN = "ScaleDown"
 REASON_SCALE_DEFERRED = "ScaleDeferred"
+# Elastic ComputeDomains (controller/elastic.py resize epochs)
+REASON_DOMAIN_RESIZING = "DomainResizing"
+REASON_DOMAIN_HEALED = "DomainHealed"
+REASON_RESIZE_FAILED = "ResizeFailed"
 # ComputeDomain controller / daemon
 REASON_MESH_BUNDLE_UPDATED = "MeshBundleUpdated"
 REASON_NODE_JOINED = "NodeJoined"

@@ -119,6 +119,15 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         requires=("FleetTelemetry",),
     ),
     FeatureSpec(
+        "ElasticComputeDomains", False, Stage.ALPHA,
+        "Make ComputeDomain membership mutable: controller-orchestrated "
+        "resize epochs driven by spec.numNodes edits and slice-agent "
+        "lease expiry (host failure) — quiesce via MigrationCheckpoint, "
+        "re-place against the bitmask tables, recompile the mesh bundle, "
+        "restart workers, with full rollback on mid-epoch failure.",
+        requires=("ComputeDomainCliques",),
+    ),
+    FeatureSpec(
         "LiveRepack", False, Stage.ALPHA,
         "Run the online defragmentation rebalancer: migrate small-subslice "
         "claims (cordon -> checkpoint-aware unprepare -> re-place -> "

@@ -3,7 +3,11 @@
 A de-duplicating delayed queue with pluggable per-item rate limiters, used by
 every reconcile loop in the framework (controller, compute-domain managers,
 cleanup managers). Failed items are retried with exponential backoff; jitter
-decorrelates retry storms across nodes.
+decorrelates retry storms across nodes. The default limiters delegate to the
+consolidated ``pkg.backoff.Backoff`` policy (capped exponential,
+DETERMINISTIC jitter, per-item reset on success), so every retry delay in
+the control plane lands in the shared ``tpu_dra_retry_backoff_seconds``
+histogram.
 
 Reference behavior: /root/reference/pkg/workqueue/workqueue.go:49-67
 (prep/unprep 5s->10m exponential limiters) and jitterlimiter.go:31-66
@@ -20,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Hashable, Optional
 
+from k8s_dra_driver_tpu.pkg.backoff import Backoff, BackoffMetrics
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
 
 log = logging.getLogger(__name__)
@@ -119,13 +124,47 @@ class JitterRateLimiter(RateLimiter):
         self.inner.forget(key)
 
 
-def default_controller_rate_limiter() -> RateLimiter:
-    return JitterRateLimiter(ExponentialRateLimiter(base=0.005, cap=1000.0))
+class BackoffRateLimiter(RateLimiter):
+    """RateLimiter over the consolidated ``pkg.backoff.Backoff`` policy:
+    capped exponential with deterministic (key, attempt)-derived jitter —
+    the k8s exponential+jitter pair without the RNG, so seeded runs and
+    retry-timing tests reproduce exactly. ``source`` labels the shared
+    ``tpu_dra_retry_backoff_seconds`` histogram series."""
+
+    def __init__(self, base: float, cap: float, jitter: float = 0.2,
+                 metrics_registry: Optional[Registry] = None,
+                 source: str = "workqueue"):
+        self.backoff = Backoff(
+            base=base, cap=cap, jitter=jitter,
+            metrics=BackoffMetrics(metrics_registry or Registry()),
+            source=source,
+            # k8s ItemExponentialFailureRateLimiter shape: the first
+            # failure already waits `base` (the queue retries eagerly
+            # enough at the 5ms controller default; the 5s prepare
+            # limiter MUST hold even the first retry back).
+            first_free=False,
+        )
+
+    def when(self, key: Hashable) -> float:
+        return self.backoff.failure(key)
+
+    def forget(self, key: Hashable) -> None:
+        self.backoff.reset(key)
 
 
-def prepare_unprepare_rate_limiter() -> RateLimiter:
+def default_controller_rate_limiter(
+        metrics_registry: Optional[Registry] = None) -> RateLimiter:
+    return BackoffRateLimiter(base=0.005, cap=1000.0,
+                              metrics_registry=metrics_registry,
+                              source="workqueue")
+
+
+def prepare_unprepare_rate_limiter(
+        metrics_registry: Optional[Registry] = None) -> RateLimiter:
     """The reference's dedicated prepare/unprepare limiter: 5s -> 10min."""
-    return JitterRateLimiter(ExponentialRateLimiter(base=5.0, cap=600.0))
+    return BackoffRateLimiter(base=5.0, cap=600.0,
+                              metrics_registry=metrics_registry,
+                              source="workqueue-prepare")
 
 
 @dataclass(order=True)

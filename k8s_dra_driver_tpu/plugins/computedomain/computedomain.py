@@ -145,8 +145,16 @@ class ComputeDomainManager:
         hostnames = [m.dns_name or m.ip_address for m in members]
         coordinator = hostnames[0] if hostnames else ""
         port = coordinator_port(cd)
+        # Worker ids are the DENSE RANK of each member's CAS index, not the
+        # raw index: after an elastic heal deregisters a dead member the
+        # surviving indices have a hole (e.g. {0,2,3}), and jax.distributed
+        # with num_processes=N requires process ids 0..N-1. Rank-of-index
+        # equals the raw index whenever indices are dense (every
+        # pre-elastic domain), so nothing changes for the steady state,
+        # and enumeration order (sorted by index) is preserved.
+        ranks = {m.node_name: rank for rank, m in enumerate(members)}
         env = {
-            "TPU_WORKER_ID": str(self_info.index),
+            "TPU_WORKER_ID": str(ranks[self.node_name]),
             "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
             "TPU_TOPOLOGY": self.inventory.slice_topology,
             "TPU_ACCELERATOR_TYPE": self.inventory.accelerator_type,
@@ -169,8 +177,7 @@ class ComputeDomainManager:
         # cluster without topology attributes keeps working unchanged.
         bundle = cd.status.mesh_bundle
         if bundle is not None:
-            bundle = bundle.remap_workers(
-                {m.node_name: m.index for m in members})
+            bundle = bundle.remap_workers(ranks)
             env[MESH_BUNDLE_ENV] = bundle.to_json()
             env[PROCESS_BOUNDS_ENV] = bundle.process_bounds
         return env

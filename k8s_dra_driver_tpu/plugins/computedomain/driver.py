@@ -286,6 +286,53 @@ class ComputeDomainDriver:
                     sess.save()
                     self.cdi.delete_claim_spec_file(claim_uid)
 
+    def migrate_claim_out(self, claim_uid: str) -> PreparedClaim:
+        """Checkpoint-aware release for resize/migration quiesce — the
+        channel/daemon half of the MigrationCheckpoint handshake both
+        kubelet plugins now share. The state transition is fsync'd BEFORE
+        the CDI spec is removed (the channel plugin's only node-side
+        artifact), so a crash mid-quiesce leaves an entry the next Prepare
+        clears and re-prepares fresh (the branch _prepare_batch already
+        carries). Same pu-flock-then-mutex order as every other path."""
+        with tracing.span("dra.migrate_out", driver=self.driver_name,
+                          claim_uid=claim_uid), \
+                self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                   trace_name="pu_flock"):
+            with self._mutex:
+                with self._store.session() as sess:
+                    cp = sess.checkpoint
+                    entry = cp.claims.get(claim_uid)
+                    if entry is None:
+                        raise RetryableError(
+                            f"claim {claim_uid} has no checkpoint entry on "
+                            f"this node; nothing to migrate")
+                    if entry.state != PREPARE_COMPLETED:
+                        raise RetryableError(
+                            f"claim {claim_uid} is {entry.state}, not "
+                            f"{PREPARE_COMPLETED}; refusing to migrate")
+                    entry.state = MIGRATION_CHECKPOINTED
+                    entry.migration_started_at = time.time()
+                    sess.save()
+                    self.cdi.delete_claim_spec_file(claim_uid)
+                    return entry
+
+    def migrate_claim_end(self, claim_uid: str) -> None:
+        """Drop the MigrationCheckpoint entry once the claim completed on
+        its destination (or the same-node re-prepare cleared it already);
+        idempotent, a no-op for claims in any other state."""
+        with tracing.span("dra.migrate_end", driver=self.driver_name,
+                          claim_uid=claim_uid), \
+                self._pu_lock.hold(timeout=PU_LOCK_TIMEOUT_S,
+                                   trace_name="pu_flock"):
+            with self._mutex:
+                with self._store.session() as sess:
+                    cp = sess.checkpoint
+                    entry = cp.claims.get(claim_uid)
+                    if (entry is not None
+                            and entry.state == MIGRATION_CHECKPOINTED):
+                        del cp.claims[claim_uid]
+                        sess.save()
+
     def expire_aborted(self) -> int:
         """Drop expired PrepareAborted tombstones (cleanup loop tier,
         reference cleanup.go:35-37). Returns count removed. Same

@@ -51,6 +51,7 @@ from k8s_dra_driver_tpu.k8s.core import (
 from k8s_dra_driver_tpu.k8s.objects import NotFoundError
 from k8s_dra_driver_tpu.pkg import placement as placement_lib
 from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.backoff import Backoff, BackoffMetrics
 from k8s_dra_driver_tpu.pkg.events import (
     EventRecorder,
     REASON_CLAIM_MIGRATED,
@@ -159,6 +160,13 @@ class RebalancerConfig:
     # cannot turn the rebalancer into its own churn storm.
     migration_burst: int = 16
     migration_refill_per_s: float = 1.0
+    # Per-unit retry pacing after a failed/rolled-back migration
+    # (pkg.backoff: capped exponential, deterministic jitter, reset on
+    # success). The first retry is immediate — only a unit that keeps
+    # failing backs off, so a persistent fault can't make the controller
+    # re-roll the same migration at full pass rate forever.
+    retry_backoff_base_s: float = 2.0
+    retry_backoff_cap_s: float = 60.0
 
 
 class RebalancerMetrics:
@@ -213,6 +221,14 @@ class RebalanceController:
         self.clock = clock
         self._tokens = float(self.config.migration_burst)
         self._tokens_at = clock()
+        # Consolidated retry pacing (pkg.backoff) keyed by migration unit:
+        # a unit whose migration failed skips passes until its delay
+        # elapsed; success forgets the history.
+        self.retry_backoff = Backoff(
+            base=self.config.retry_backoff_base_s,
+            cap=self.config.retry_backoff_cap_s,
+            jitter=0.2, clock=clock,
+            metrics=BackoffMetrics(registry), source="rebalancer")
         # Last pass's per-node largest-free reading — the cheap "did the
         # fragmentation signal move" gate.
         self._last_frag: Optional[tuple] = None
@@ -521,8 +537,25 @@ class RebalanceController:
                       min_used: Optional[int] = None,
                       received: Optional[Set[str]] = None) -> str:
         """One full migration with rollback. Returns "migrated", "failed"
-        (rolled back / no destination), "skip" (stale plan), or "no-token"
-        (budget exhausted before anything was touched)."""
+        (rolled back / no destination), "skip" (stale plan or
+        backoff-paced), or "no-token" (budget exhausted before anything
+        was touched)."""
+        retry_key = (unit.pod_namespace, unit.pod_name)
+        if not self.retry_backoff.ready(retry_key):
+            return "skip"  # failed recently: wait out the backoff
+        outcome = self._migrate_unit_inner(unit, views, forbidden, required,
+                                           min_used=min_used,
+                                           received=received)
+        if outcome == "failed":
+            self.retry_backoff.failure(retry_key)
+        elif outcome == "migrated":
+            self.retry_backoff.reset(retry_key)
+        return outcome
+
+    def _migrate_unit_inner(self, unit, views: Dict[str, NodeView],
+                            forbidden: Set[str], required: bool,
+                            min_used: Optional[int] = None,
+                            received: Optional[Set[str]] = None) -> str:
         with tracing.span("rebalance.migrate", pod=f"{unit.pod_namespace}/"
                           f"{unit.pod_name}", source=unit.node) as sp:
             claims = []

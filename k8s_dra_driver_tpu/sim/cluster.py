@@ -121,6 +121,14 @@ CHAOS_LOAD_TRACE_ANNOTATION = "sim.tpu.google.com/load-trace"
 # threshold must degrade exactly the spanning devices via the existing
 # taint chain. "0-1=0" clears.
 CHAOS_LINK_ERRORS_ANNOTATION = "sim.tpu.google.com/link-errors"
+# Host failure: "true" hard-kills the node's slice agents (no dying-gasp
+# API writes — their liveness leases simply stop renewing and expire),
+# marks the node unreachable (kubelet/scheduler/GC/plugin-resolver all
+# skip it), and so drives the ElasticComputeDomains heal path from
+# outside the process. Clearing the annotation "returns" the host: its
+# agents restart, re-join their cliques (same worker slot), and the
+# domain grows back.
+CHAOS_NODE_DOWN_ANNOTATION = "sim.tpu.google.com/node-down"
 
 # Comma-list env keys whose values union when a pod holds several claims
 # (each claim's CDI spec names only its own chips).
@@ -212,6 +220,7 @@ class SimCluster:
         metrics_registry: Optional[Registry] = None,
         rebalancer_config=None,
         persist_dir: Optional[str] = None,
+        elastic_config=None,
     ):
         """``loopback_agents=True`` registers slice agents with their real
         harness address (127.0.0.1 — everything runs in this process), so
@@ -253,7 +262,23 @@ class SimCluster:
         self._chaos_link_applied: Dict[str, str] = {}
         self._chaos_trace_applied: Dict[str, str] = {}
         self._chaos_link_err_applied: Dict[str, str] = {}
+        self._chaos_down_applied: Dict[str, str] = {}
+        # Hosts currently failed by the node-down chaos annotation: their
+        # plugins resolve to None, the kubelet/GC/agent passes skip them,
+        # and the scheduler never places onto them — the in-process
+        # approximation of a machine that stopped answering.
+        self._down_nodes: Set[str] = set()
         self._gc_prev_claim_uids: set = set()
+        # Virtual wall clock: one second per step, independent of the
+        # telemetry gate — slice-agent liveness leases and the resize
+        # orchestrator's backoff/stall timers run on it, so failure
+        # detection and heal latency are deterministic per seed.
+        self.sim_time = 0.0
+        self.sim_dt = 1.0
+        # Sim-tier agent leases expire fast (3 virtual seconds) so a heal
+        # starts within a few steps of a kill; real deployments keep the
+        # 30s default.
+        self.agent_lease_s = 3.0
         # -- fleet telemetry (FleetTelemetry gate) --------------------------
         # The sim drives sampling synchronously on a virtual clock
         # (telemetry_clock advances telemetry_dt per step), so traces,
@@ -368,6 +393,29 @@ class SimCluster:
                 plugin_resolver=self._resolve_tpu_plugin,
                 config=rebalancer_config or RebalancerConfig(),
                 metrics_registry=self.metrics_registry,
+                # Virtual clock: token-bucket refill and per-unit retry
+                # backoff advance one second per step, deterministically.
+                clock=lambda: self.sim_time,
+            )
+        # Elastic ComputeDomains: resize-epoch orchestration, enabled by
+        # the gate or an explicit ElasticConfig (tests tune lease grace,
+        # backoff, and the stall timeout).
+        self.elastic = None
+        if (elastic_config is not None
+                or self.gates.enabled("ElasticComputeDomains")):
+            from k8s_dra_driver_tpu.controller.elastic import (
+                ElasticConfig,
+                ElasticDomainController,
+            )
+
+            self.elastic = ElasticDomainController(
+                api=self.api,
+                allocator=self.allocator,
+                plugin_resolver=self._resolve_tpu_plugin,
+                cd_plugin_resolver=self._resolve_cd_plugin,
+                config=elastic_config or ElasticConfig(),
+                metrics_registry=self.metrics_registry,
+                clock=lambda: self.sim_time,
             )
         self._install_device_classes()
         lib_probe = MockTpuLib(profile, worker_id=0)
@@ -607,6 +655,7 @@ class SimCluster:
 
     def step(self) -> None:
         """One pass of every emulated control loop."""
+        self.sim_time += self.sim_dt
         self.controller.drain(timeout=5)
         self._chaos_pass()
         self._gc_pass()
@@ -615,12 +664,33 @@ class SimCluster:
         self._agent_pass()
         self.controller.drain(timeout=5)
         self._kubelet_pass()
+        self._elastic_pass()
         self._rebalance_pass()
         self._telemetry_pass()
 
     def _resolve_tpu_plugin(self, node_name: str):
         node = self.nodes.get(node_name)
-        return node.tpu_driver if node else None
+        if node is None or node_name in self._down_nodes:
+            return None  # unknown, or failed by node-down chaos
+        return node.tpu_driver
+
+    def _resolve_cd_plugin(self, node_name: str):
+        node = self.nodes.get(node_name)
+        if node is None or node_name in self._down_nodes:
+            return None
+        return node.cd_driver
+
+    def _elastic_pass(self) -> None:
+        """Resize-epoch orchestration, after the kubelet pass (quiesce and
+        restart see settled claim state) and BEFORE the rebalancer, so a
+        starting epoch's owner-tagged cordons land first when both want
+        the same hosts."""
+        if self.elastic is None:
+            return
+        try:
+            self.elastic.step()
+        except Exception:  # noqa: BLE001 — resize is best-effort per pass; a bad pass must not kill the sim
+            log.exception("elastic pass failed")
 
     def _rebalance_pass(self) -> None:
         """Live repack, after the kubelet pass so migrations see settled
@@ -642,7 +712,24 @@ class SimCluster:
         fp = getattr(self.api, "kind_fingerprint", None)
         if fp is None:
             return (object(),)  # unknown backend: tokens never equal
-        return tuple(fp(kind) for kind in _QUIESCENCE_KINDS)
+        token = tuple(fp(kind) for kind in _QUIESCENCE_KINDS)
+        # Backoff-paced retries are pending work that writes NOTHING until
+        # the delay elapses: fold the virtual clock in while any are owed
+        # so settle()/wait_for() keep stepping instead of declaring the
+        # cluster quiet two steps before the retry fires.
+        pending = 0
+        if self.rebalancer is not None:
+            pending += self.rebalancer.retry_backoff.pending()
+        if self.elastic is not None:
+            # In-flight epochs and downed hosts are pending work too: a
+            # lease quietly expiring, a bundle recompile, or a stall
+            # timeout all need further steps to surface.
+            pending += self.elastic.pending_retries()
+            pending += self.elastic.in_flight
+            pending += len(self._down_nodes)
+        if pending:
+            token += (pending, int(self.sim_time))
+        return token
 
     def settle(self, max_steps: int = 20) -> None:
         """Step until every pod reached a terminal-ish state, the cluster
@@ -879,7 +966,8 @@ class SimCluster:
                 if feasible is not None:
                     cached = True
                     self.allocator.note_feasible_cached(len(feasible))
-                    candidates = [n for n in feasible if n in self.nodes]
+                    candidates = [n for n in feasible if n in self.nodes
+                                  and n not in self._down_nodes]
                     feasible_note = (f"feasibility filter admitted "
                                      f"{len(candidates)}/{len(self.nodes)} nodes")
                     candidates = self._steer_domain_candidates(
@@ -918,8 +1006,9 @@ class SimCluster:
             chosen = chosen_node
         if not chosen:
             if candidates is None:
-                # No claims and no pin (a plain pod): any node will do.
-                candidates = sorted(self.nodes)
+                # No claims and no pin (a plain pod): any live node will do.
+                candidates = sorted(n for n in self.nodes
+                                    if n not in self._down_nodes)
             if not candidates:
                 # Nowhere to put it (no nodes yet): park it so a NODE
                 # event retries, instead of dropping it as 'bound'.
@@ -980,7 +1069,8 @@ class SimCluster:
         adm = self._admission
         if adm is not None and shape is not None:
             adm.feasible[shape] = feasible
-        candidates = [n for n in feasible if n in self.nodes]
+        candidates = [n for n in feasible if n in self.nodes
+                      and n not in self._down_nodes]
         note = (f"feasibility filter admitted "
                 f"{len(candidates)}/{len(self.nodes)} nodes")
         # Multi-host ComputeDomain workers: steer onto the domain's
@@ -1212,8 +1302,8 @@ class SimCluster:
         """Run one kubelet sync for a bound pod; True when the pod reached
         a terminal phase (Running/Failed) and needs no more kubelet work."""
         node = self.nodes.get(pod.node_name)
-        if node is None:
-            return False
+        if node is None or pod.node_name in self._down_nodes:
+            return False  # no kubelet answering on a failed host
         try:
             claims = self._ensure_claims_for_pod(pod)
         except AllocationError:
@@ -1303,8 +1393,8 @@ class SimCluster:
         self._drain_events()
         for (node_name, pod_name), pod in list(self._agent_pods.items()):
             node = self.nodes.get(node_name)
-            if node is None:
-                continue
+            if node is None or node_name in self._down_nodes:
+                continue  # no kubelet to start containers on a dead host
             existing = node.agents.get(pod_name)
             if existing is not None:
                 # Same name but a different pod uid means the old pod was
@@ -1335,7 +1425,7 @@ class SimCluster:
             cd = self._domain_by_uid(
                 env.get("COMPUTE_DOMAIN_UUID", ""),
                 namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace))
-            expected_nodes = cd.spec.num_nodes if cd is not None else 0
+            expected_nodes = self._expected_members(cd)
             agent = SliceAgent(
                 api=self.api,
                 namespace=env.get("COMPUTE_DOMAIN_NAMESPACE", pod.namespace),
@@ -1350,6 +1440,10 @@ class SimCluster:
                 pod_name=env.get("POD_NAME", ""),
                 pod_namespace=env.get("POD_NAMESPACE", ""),
                 metrics_registry=self.metrics_registry,
+                # Liveness leases on the virtual clock: a killed agent's
+                # lease expires a few steps later, deterministically.
+                clock=lambda: self.sim_time,
+                lease_duration_s=self.agent_lease_s,
             )
             agent.startup()
             agent._sim_pod_uid = pod.uid  # restart detection on DS recreate
@@ -1357,6 +1451,8 @@ class SimCluster:
             node.agents[pod_name] = agent
         # Sync all agents; mark their pods ready per probe result.
         for node in self.nodes.values():
+            if node.name in self._down_nodes:
+                continue
             for pod_name, agent in list(node.agents.items()):
                 ns = getattr(agent, "_sim_pod_ns", "default")
                 pod = self.api.try_get(POD, pod_name, ns)
@@ -1366,6 +1462,14 @@ class SimCluster:
                     agent.shutdown()
                     del node.agents[pod_name]
                     continue
+                # Elastic membership: the expected member count follows
+                # the LIVE placement (a healed 3-host domain must report
+                # ready with 3 members, not wait for its dead fourth).
+                cd = self._domain_by_uid(agent.domain_uid,
+                                         namespace=agent.namespace)
+                want = self._expected_members(cd)
+                if want and agent.expected_nodes != want:
+                    agent.expected_nodes = want
                 agent.sync()
                 ready = agent.check()
                 if pod.ready == ready and pod.phase == "Running":
@@ -1378,6 +1482,17 @@ class SimCluster:
                     self.api.update_with_retry(POD, pod.meta.name, pod.namespace, set_ready)
                 except NotFoundError:
                     pass
+
+    @staticmethod
+    def _expected_members(cd) -> int:
+        """How many clique members a domain's agents should wait for: the
+        recorded placement's size once one exists (the resize orchestrator
+        moves it), spec.numNodes before placement, 0 = follow the slice."""
+        if cd is None:
+            return 0
+        if cd.status.placement is not None and cd.status.placement.nodes:
+            return len(cd.status.placement.nodes)
+        return cd.spec.num_nodes
 
     def _teardown_pod(self, pod: Pod) -> None:
         node = self.nodes.get(pod.node_name)
@@ -1452,6 +1567,10 @@ class SimCluster:
         if not vanished and not unconsumed:
             return
         for node in self.nodes.values():
+            if node.name in self._down_nodes:
+                # A dead host runs no cleanup; its stale prepared state is
+                # swept when the node returns (kubelet-restart semantics).
+                continue
             for plugin in (node.tpu_driver, node.cd_driver):
                 prepared = (
                     plugin.state.prepared_claims() if hasattr(plugin, "state")
@@ -1477,6 +1596,7 @@ class SimCluster:
         if not self._chaos_dirty:
             return
         self._chaos_dirty = False
+        returned: List[str] = []
         for node_obj in self.api.list(NODE):
             sim_node = self.nodes.get(node_obj.meta.name)
             if sim_node is None:
@@ -1545,6 +1665,49 @@ class SimCluster:
                         continue
                     sim_node.tpulib.set_link_error_rate(a, b, rate)
                 self._chaos_link_err_applied[node_obj.meta.name] = err_value
+            down_value = node_obj.meta.annotations.get(
+                CHAOS_NODE_DOWN_ANNOTATION, "")
+            if down_value != self._chaos_down_applied.get(
+                    node_obj.meta.name, ""):
+                name = node_obj.meta.name
+                if down_value.strip().lower() in ("true", "1"):
+                    # Hard kill: agents die with NO API writes (leases
+                    # stop renewing and expire — the failure signal); the
+                    # node stops answering everywhere else via the
+                    # _down_nodes membership checks.
+                    self._down_nodes.add(name)
+                    for agent in sim_node.agents.values():
+                        agent.kill()
+                    sim_node.agents.clear()
+                else:
+                    # Host returned: the agent pass restarts its agents
+                    # from the (still-present) DaemonSet pods; stale
+                    # prepared state from before the failure is swept
+                    # below, kubelet-restart style.
+                    self._down_nodes.discard(name)
+                    returned.append(name)
+                self._chaos_down_applied[name] = down_value
+        if returned:
+            # One listing for every returned node's stale sweep (a claim
+            # deleted while the host was down must release its devices and
+            # partitions now that the "kubelet" is back).
+            live_uids = {c.uid for c in self.api.list(RESOURCE_CLAIM)}
+            for name in returned:
+                sim_node = self.nodes.get(name)
+                if sim_node is None:
+                    continue
+                try:
+                    sim_node.tpu_driver.cleanup_stale_claims()
+                except Exception:  # noqa: BLE001 — sweep retried by the normal gc pass
+                    log.exception("stale sweep on returned node %s failed",
+                                  name)
+                stale = [uid for uid, e
+                         in sim_node.cd_driver.prepared_claims().items()
+                         if uid not in live_uids
+                         and e.state != PREPARE_ABORTED]
+                if stale:
+                    sim_node.cd_driver.unprepare_resource_claims(stale)
+            self._gc_dirty = True
 
     # -- fleet telemetry ---------------------------------------------------------
 

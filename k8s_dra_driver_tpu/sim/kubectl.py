@@ -369,6 +369,21 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
         lines += [f"NumNodes:  {obj.spec.num_nodes}",
                   f"Topology:  {obj.spec.topology or '<any>'}",
                   f"Status:    {obj.status.status}"]
+        # Elastic membership: epoch counter, the current epoch's
+        # membership target when it diverges from spec (a healed domain),
+        # and the in-flight resize record.
+        if obj.status.epoch or obj.status.desired_nodes or obj.status.resize:
+            desired = obj.status.desired_nodes or obj.spec.num_nodes
+            lines.append(
+                f"Epoch:     {obj.status.epoch} "
+                f"(membership {desired}/{obj.spec.num_nodes} desired)")
+        if obj.status.resize is not None:
+            r = obj.status.resize
+            lines.append(
+                f"Resizing:  {r.phase} ({r.trigger}) -> {r.target_nodes} "
+                f"host(s), attempt {r.attempts}"
+                + (f", lost: {','.join(r.lost_nodes)}" if r.lost_nodes
+                   else ""))
         if obj.status.placement is not None:
             p = obj.status.placement
             lines.append(

@@ -1,0 +1,563 @@
+"""Elastic ComputeDomains e2e tier (ISSUE 14).
+
+The acceptance scenario: a 4-host v5e-16 domain loses a host via the
+``sim.tpu.google.com/node-down`` chaos annotation, heals to 3 hosts
+through a full resize epoch (recompiled mesh bundle at a bumped revision,
+exact loss parity at the new size, DomainDegraded -> DomainResizing ->
+DomainHealed event chain, zero leaked ICI partitions by StubPartitionClient
+ledger read-back), then grows back to 4 hosts when the node returns — and
+a fault-injected crash mid-resize rolls back to the exact prior placement.
+Plus the WAL crash/restore satellite (kill the store between quiesce and
+re-place, restore, resume) and the clique re-join idempotency regression
+the rollback path depends on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import (
+    COMPUTE_DOMAIN,
+    COMPUTE_DOMAIN_CLIQUE,
+    NODE,
+    POD,
+    RESOURCE_CLAIM,
+)
+from k8s_dra_driver_tpu.pkg.meshgen import MESH_BUNDLE_ENV, MeshBundle
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    MIGRATION_CHECKPOINTED,
+    PREPARE_COMPLETED,
+)
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.cluster import CHAOS_NODE_DOWN_ANNOTATION
+from k8s_dra_driver_tpu.sim.kubectl import describe_object, load_manifests
+
+ELASTIC_GATES = ("ElasticComputeDomains=true,ICIPartitioning=true,"
+                 "DynamicSubslice=true")
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+CD_MANIFEST = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: grid}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: dom, namespace: grid}
+spec:
+  numNodes: 4
+  channel:
+    resourceClaimTemplate: {name: dom-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: grid}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: sub12, namespace: grid}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: subslice.tpu.google.com, count: 1, selectors: ["profile=1x2"]}}]
+"""
+
+CD_WORKER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: dom-worker-%(i)d, namespace: grid}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: dom-channel}
+"""
+
+# A bystander pod holding a carved ICI partition (DynamicSubslice 1x2) on
+# a NON-member host: its partition must survive every kill/heal/grow cycle
+# untouched, and the StubPartitionClient read-back across ALL nodes is what
+# proves the resize epochs leak nothing.
+BYSTANDER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: bystander, namespace: grid}
+spec:
+  nodeName: %(node)s
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimTemplateName: sub12}]
+"""
+
+
+def _apply(sim, text):
+    for obj in load_manifests(text):
+        sim.api.create(obj)
+
+
+def _events(sim, reason, namespace=None):
+    evs = (sim.api.list("Event", namespace=namespace) if namespace
+           else sim.api.list("Event"))
+    return [e for e in evs if e.reason == reason]
+
+
+def _set_node_down(sim, node, down):
+    def mutate(obj, down=down):
+        if down:
+            obj.meta.annotations[CHAOS_NODE_DOWN_ANNOTATION] = "true"
+        else:
+            obj.meta.annotations.pop(CHAOS_NODE_DOWN_ANNOTATION, None)
+    sim.api.update_with_retry(NODE, node, "", mutate)
+
+
+def _domain(sim):
+    return sim.api.get(COMPUTE_DOMAIN, "dom", "grid")
+
+
+def _assemble(sim):
+    _apply(sim, CD_MANIFEST)
+    for i in range(4):
+        _apply(sim, CD_WORKER % {"i": i})
+    assert sim.wait_for(
+        lambda s: _domain(s).status.status == "Ready"
+        and all(p.phase == "Running"
+                for p in s.api.list(POD, namespace="grid")
+                if p.meta.name.startswith("dom-worker")),
+        max_steps=40), [
+            (p.meta.name, p.phase) for p in sim.api.list(POD,
+                                                         namespace="grid")]
+    return _domain(sim)
+
+
+def _ledger_matches_live_claims(sim):
+    """The StubPartitionClient read-back: every node's active partitions
+    correspond 1:1 to its PREPARE_COMPLETED subslice claims, and no
+    checkpoint holds MigrationCheckpoint residue. Returns (ok, detail)."""
+    for name, node in sim.nodes.items():
+        state = node.tpu_driver.state
+        active = state.partitions.active_partitions()
+        entries = state.prepared_claims()
+        migration = [uid for uid, e in entries.items()
+                     if e.state == MIGRATION_CHECKPOINTED]
+        if migration:
+            return False, f"{name}: MigrationCheckpoint residue {migration}"
+        completed_subslices = sum(
+            1 for e in entries.values()
+            if e.state == PREPARE_COMPLETED
+            and any(d.device_type == "subslice" for d in e.devices))
+        if len(active) != completed_subslices:
+            return False, (f"{name}: {len(active)} active partition(s) vs "
+                           f"{completed_subslices} completed subslice "
+                           f"claim(s)")
+    return True, ""
+
+
+def _loss_parity_at_size(bundle: MeshBundle) -> float:
+    """Exact loss parity at the healed size, in a child process with
+    exactly ``bundle.num_devices`` virtual CPU devices: the same tiny
+    forward pass computed on a bundle-ordered mesh and on the plain
+    enumeration-order mesh must produce bit-identical losses (reordering
+    devices must never change training semantics)."""
+    n = bundle.num_devices
+    data, model = bundle.axis_sizes[0], bundle.axis_sizes[-1]
+    script = f"""
+import json, os, sys
+sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})
+from __graft_entry__ import _ensure_devices
+_ensure_devices({n})
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from k8s_dra_driver_tpu.parallel.mesh import family_mesh, load_bundle
+b = load_bundle()
+assert b is not None and b.num_devices == {n}, b
+devs = jax.devices()
+
+def loss_with(bundle):
+    m = family_mesh(devs, ({data}, {model}), ("data", "model"),
+                    bundle=bundle)
+    x = (np.arange({n} * 4, dtype=np.float32).reshape({n}, 4)
+         / float({n} * 4))
+    w = np.linspace(0.0, 1.0, 4 * {2 * model},
+                    dtype=np.float32).reshape(4, {2 * model})
+    xs = jax.device_put(x, NamedSharding(m, P("data", None)))
+    ws = jax.device_put(w, NamedSharding(m, P(None, "model")))
+    y = jnp.tanh(xs @ ws)
+    return float(jnp.mean(y * y))
+
+print(json.dumps({{"bundle": loss_with(b), "naive": loss_with(None)}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The parent's XLA_FLAGS already pins an 8-device count (conftest);
+    # the child needs exactly the healed size.
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+    env[MESH_BUNDLE_ENV] = bundle.to_json()
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    return abs(doc["bundle"] - doc["naive"])
+
+
+def test_node_down_heal_and_grow_back(tmp_path):
+    """THE acceptance scenario: kill one host of an assembled 4-host
+    v5e-16 domain, heal to 3 through a full resize epoch, grow back to 4
+    when the host returns — bundle revisions bumped each way, event chain
+    in order, worker slots stable, ledgers clean."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=8,
+                     gates=ELASTIC_GATES)
+    sim.start()
+    try:
+        cd = _assemble(sim)
+        block_nodes = list(cd.status.placement.nodes)
+        assert cd.status.placement.block_shape == "2x2"
+        rev0 = cd.status.mesh_bundle.revision
+        assert cd.status.epoch == 0
+        # Bystander partition on the spare slice: the leak canary.
+        spare = next(n for n in sorted(sim.nodes) if n not in block_nodes)
+        _apply(sim, BYSTANDER % {"node": spare})
+        assert sim.wait_for(
+            lambda s: s.api.get(POD, "bystander", "grid").phase == "Running",
+            max_steps=20)
+        assert len(sim.nodes[spare].tpu_driver.state
+                   .partitions.active_partitions()) == 1
+        ok, why = _ledger_matches_live_claims(sim)
+        assert ok, why
+        clique0 = next(c for c in sim.api.list(COMPUTE_DOMAIN_CLIQUE,
+                                               namespace="grid")
+                       if c.domain_uid == cd.uid)
+        victim = block_nodes[1]
+        victim_slot = clique0.node_info(victim).index
+
+        # -- kill one member host ------------------------------------------
+        _set_node_down(sim, victim, True)
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == 1
+            and _domain(s).status.status == "Ready"
+            and _domain(s).status.resize is None, max_steps=60), (
+                _domain(sim).status.resize,
+                _domain(sim).status.status)
+        healed = _domain(sim)
+        survivors = [n for n in block_nodes if n != victim]
+        assert list(healed.status.placement.nodes) == survivors
+        assert healed.status.placement.block_shape == "1x3"
+        assert healed.status.desired_nodes == 3
+        bundle = healed.status.mesh_bundle
+        assert bundle.revision > rev0
+        assert {d.node for d in bundle.device_order} == set(survivors)
+        assert bundle.num_devices == 12
+
+        # The event chain, in causal order on their first timestamps.
+        chain = {}
+        for reason in ("DomainDegraded", "DomainResizing", "DomainHealed"):
+            evs = _events(sim, reason, namespace="grid")
+            assert evs, f"missing {reason}"
+            chain[reason] = min(e.first_timestamp for e in evs)
+        assert (chain["DomainDegraded"] <= chain["DomainResizing"]
+                <= chain["DomainHealed"])
+
+        # Surviving workers restarted INTO the new mesh: their injected
+        # env carries the recompiled bundle at the bumped revision.
+        for p in sim.api.list(POD, namespace="grid"):
+            if not p.meta.name.startswith("dom-worker"):
+                continue
+            assert p.node_name in survivors
+            assert p.phase == "Running"
+            env_bundle = MeshBundle.from_json(
+                p.injected_env[MESH_BUNDLE_ENV])
+            assert env_bundle.revision == bundle.revision
+            assert env_bundle.num_devices == 12
+        # The dead host's worker was evicted.
+        assert sim.api.try_get(POD, f"dom-worker-{block_nodes.index(victim)}",
+                               "grid") is None
+
+        # Ledger read-back on every LIVE node: member nodes hold no
+        # partitions (whole-host claims), no MigrationCheckpoint residue
+        # anywhere, and the bystander's partition is untouched.
+        for name in survivors:
+            state = sim.nodes[name].tpu_driver.state
+            assert state.partitions.active_partitions() == [], name
+            assert not any(e.state == MIGRATION_CHECKPOINTED
+                           for e in state.prepared_claims().values()), name
+        assert len(sim.nodes[spare].tpu_driver.state
+                   .partitions.active_partitions()) == 1
+
+        # Exact loss parity at the new size (12 devices, data=2 x model=6).
+        assert _loss_parity_at_size(bundle) == 0.0
+
+        # Describe renders the elastic surface.
+        out = describe_object(sim.api, COMPUTE_DOMAIN, "dom",
+                              namespace="grid")
+        assert "Epoch:     1 (membership 3/4 desired)" in out
+
+        # -- the host returns ----------------------------------------------
+        _set_node_down(sim, victim, False)
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == 2
+            and _domain(s).status.status == "Ready"
+            and _domain(s).status.resize is None, max_steps=80), (
+                _domain(sim).status.resize, _domain(sim).status.status)
+        grown = _domain(sim)
+        assert set(grown.status.placement.nodes) == set(block_nodes)
+        assert grown.status.placement.block_shape == "2x2"
+        assert grown.status.desired_nodes == 4
+        assert grown.status.mesh_bundle.revision > bundle.revision
+        assert {d.node for d in grown.status.mesh_bundle.device_order} \
+            == set(block_nodes)
+
+        # Idempotent re-join: the returned host reclaimed its worker slot.
+        clique1 = next(c for c in sim.api.list(COMPUTE_DOMAIN_CLIQUE,
+                                               namespace="grid")
+                       if c.domain_uid == cd.uid)
+        assert clique1.node_info(victim).index == victim_slot
+
+        # The returned host swept its stale pre-failure state: zero leaked
+        # partitions anywhere, ledgers matching live claims exactly.
+        sim.settle(max_steps=10)
+        ok, why = _ledger_matches_live_claims(sim)
+        assert ok, why
+
+        # A re-created worker (the Job controller's move) lands on the
+        # returned host and runs in the grown mesh.
+        _apply(sim, CD_WORKER % {"i": block_nodes.index(victim)})
+        assert sim.wait_for(
+            lambda s: all(
+                p.phase == "Running"
+                for p in s.api.list(POD, namespace="grid")
+                if p.meta.name.startswith("dom-worker")), max_steps=30)
+        ok, why = _ledger_matches_live_claims(sim)
+        assert ok, why
+    finally:
+        sim.stop()
+
+
+def test_resize_crash_rolls_back_exact_prior_placement(tmp_path):
+    """Fault-injected crash mid-resize: the epoch raises right after the
+    quiesce checkpointed the survivors, and must roll back to the EXACT
+    prior placement — same nodes, same allocations, partitions active on
+    their source hosts, ResizeFailed narrated — then complete on the
+    backoff-paced retry once the fault clears."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=4,
+                     gates=ELASTIC_GATES)
+    sim.start()
+    try:
+        cd = _assemble(sim)
+        block_nodes = list(cd.status.placement.nodes)
+        allocs_before = {
+            c.meta.name: (c.allocation.node_name,
+                          [r.device for r in c.allocation.devices])
+            for c in sim.api.list(RESOURCE_CLAIM, namespace="grid")
+            if c.allocation is not None
+        }
+
+        boom = {"count": 0}
+
+        def crash(point):
+            if point == "resize:quiesced":
+                boom["count"] += 1
+                raise RuntimeError("injected mid-resize crash")
+
+        sim.elastic.fault_hook = crash
+        victim = block_nodes[1]
+        _set_node_down(sim, victim, True)
+        assert sim.wait_for(lambda s: boom["count"] >= 1, max_steps=20)
+        # Rolled back: prior placement verbatim (dead member included),
+        # epoch unchanged, no resize record, survivors re-prepared on
+        # their sources with their partitions re-activated.
+        assert sim.wait_for(
+            lambda s: _domain(s).status.resize is None, max_steps=20)
+        rolled = _domain(sim)
+        assert list(rolled.status.placement.nodes) == block_nodes
+        assert rolled.status.epoch == 0
+        fails = _events(sim, "ResizeFailed", namespace="grid")
+        assert fails and "rolled back" in fails[0].message
+        assert sim.elastic.metrics.epochs_total.value(
+            "heal", "rolled_back") >= 1.0
+        survivors = [n for n in block_nodes if n != victim]
+        for name in survivors:
+            state = sim.nodes[name].tpu_driver.state
+            assert not any(e.state == MIGRATION_CHECKPOINTED
+                           for e in state.prepared_claims().values()), name
+            assert all(e.state == PREPARE_COMPLETED
+                       for e in state.prepared_claims().values()), name
+        allocs_after = {
+            c.meta.name: (c.allocation.node_name,
+                          [r.device for r in c.allocation.devices])
+            for c in sim.api.list(RESOURCE_CLAIM, namespace="grid")
+            if c.allocation is not None
+        }
+        for name, before in allocs_before.items():
+            if before[0] == victim:
+                continue  # the dead host's worker is evicted by the NEXT epoch
+            assert allocs_after.get(name) == before, name
+
+        # Clear the fault: the backoff-paced retry completes the heal.
+        sim.elastic.fault_hook = None
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == 1
+            and _domain(s).status.status == "Ready", max_steps=60), (
+                _domain(sim).status.resize, _domain(sim).status.status)
+        assert (sim.elastic.metrics.epochs_total.value("heal", "completed")
+                >= 1.0)
+    finally:
+        sim.stop()
+
+
+class _StoreKilled(BaseException):
+    """Out-of-band crash: NOT an Exception, so no rollback path runs —
+    the epoch record stays exactly as persisted, like a controller whose
+    store died under it."""
+
+
+def test_wal_crash_restore_mid_resize_epoch(tmp_path):
+    """Satellite: kill the store between quiesce and re-place, restore
+    from the WAL, and assert the controller RESUMES the epoch to a
+    fingerprint-consistent end state with the partition ledger matching
+    live claims."""
+    persist = str(tmp_path / "store")
+    work = str(tmp_path / "work")
+    sim = SimCluster(workdir=work, profile="v5e-16", num_hosts=4,
+                     gates=ELASTIC_GATES, persist_dir=persist)
+    sim.start()
+    try:
+        cd = _assemble(sim)
+        block_nodes = list(cd.status.placement.nodes)
+        victim = block_nodes[1]
+
+        def kill(point):
+            if point == "resize:quiesced":
+                raise _StoreKilled()
+
+        sim.elastic.fault_hook = kill
+        _set_node_down(sim, victim, True)
+        crashed = False
+        for _ in range(20):
+            try:
+                sim.step()
+            except _StoreKilled:
+                crashed = True
+                break
+        assert crashed, "epoch never reached the quiesce point"
+        # The epoch record is durable at Quiescing, the survivors' claims
+        # are MigrationCheckpoint'd on disk.
+        mid = _domain(sim)
+        assert mid.status.resize is not None
+        assert mid.status.resize.phase == "Quiescing"
+    finally:
+        sim.stop()
+
+    # Restore: same workdir (plugin checkpoints), same WAL dir. The dead
+    # host's agent comes back too (the failure annotation lives on the
+    # Node object, but the chaos pass re-applies from scratch) — the
+    # controller must still drive the recorded epoch to completion and
+    # then grow back, ending fingerprint-consistent.
+    sim2 = SimCluster(workdir=work, profile="v5e-16", num_hosts=4,
+                      gates=ELASTIC_GATES, persist_dir=persist)
+    sim2.start()
+    try:
+        restored = _domain(sim2)
+        assert restored.status.resize is not None, "epoch record lost"
+        assert sim2.wait_for(
+            lambda s: _domain(s).status.resize is None
+            and _domain(s).status.status == "Ready", max_steps=80), (
+                _domain(sim2).status.resize, _domain(sim2).status.status)
+        final = _domain(sim2)
+        assert final.status.epoch >= 1
+        # Placement and bundle agree on one membership...
+        members = set(final.status.placement.nodes)
+        assert {d.node for d in final.status.mesh_bundle.device_order} \
+            == members
+        # ...and the partition ledgers match the live claims exactly.
+        sim2.settle(max_steps=10)
+        ok, why = _ledger_matches_live_claims(sim2)
+        assert ok, why
+    finally:
+        sim2.stop()
+
+
+def test_spec_shrink_and_grow_epochs(tmp_path):
+    """Operator intent: editing spec.numNodes on a healthy placed domain
+    runs the same epoch machinery — shrink picks the survivors' most
+    compact sub-block (an axis-aligned 1x2 of the 2x2), grow returns to
+    the full block; removed-healthy members are evicted and unlabeled."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=4,
+                     gates=ELASTIC_GATES)
+    sim.start()
+    try:
+        cd = _assemble(sim)
+        block_nodes = list(cd.status.placement.nodes)
+
+        def set_nodes(obj, n=2):
+            obj.spec.num_nodes = n
+        sim.api.update_with_retry(COMPUTE_DOMAIN, "dom", "grid", set_nodes)
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == 1
+            and _domain(s).status.status == "Ready", max_steps=60), (
+                _domain(sim).status.resize, _domain(sim).status.status)
+        shrunk = _domain(sim)
+        assert len(shrunk.status.placement.nodes) == 2
+        # A true axis-aligned sub-block, not a chain: 2 of a 2x2 grid.
+        assert shrunk.status.placement.block_shape in ("1x2", "2x1")
+        kept = set(shrunk.status.placement.nodes)
+        assert kept < set(block_nodes)
+        # Evicted members lost their worker pods and node labels.
+        for name in set(block_nodes) - kept:
+            node = sim.api.get(NODE, name)
+            assert "resource.tpu.google.com/computeDomain" \
+                not in node.meta.labels, name
+
+        def grow(obj):
+            obj.spec.num_nodes = 4
+        sim.api.update_with_retry(COMPUTE_DOMAIN, "dom", "grid", grow)
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == 2
+            and _domain(s).status.status == "Ready", max_steps=80), (
+                _domain(sim).status.resize, _domain(sim).status.status)
+        grown = _domain(sim)
+        assert set(grown.status.placement.nodes) == set(block_nodes)
+        assert grown.status.desired_nodes == 4
+    finally:
+        sim.stop()
+
+
+def test_clique_rejoin_reclaims_worker_slot():
+    """Satellite regression: a node deregistered from an assembled clique
+    (lease expiry) re-joins into the SAME worker slot via the released-
+    index memory; a DIFFERENT node never inherits a released slot while
+    its owner can still claim it — but the memory is best-effort, so a
+    slot already re-allocated degrades to normal lowest-free."""
+    from k8s_dra_driver_tpu.daemon.cliquemanager import CliqueManager
+    from k8s_dra_driver_tpu.k8s import APIServer
+
+    api = APIServer()
+    mgr = CliqueManager(api, "default", "cd-uid", "ici-0")
+    assert mgr.register("node-a", "10.0.0.1") == 0
+    assert mgr.register("node-b", "10.0.0.2") == 1
+    assert mgr.register("node-c", "10.0.0.3") == 2
+
+    mgr.deregister("node-b")
+    clique = mgr.get()
+    assert clique.released == {"node-b": 1}
+
+    # Same node -> same slot.
+    assert mgr.register("node-b", "10.0.0.9") == 1
+    assert mgr.get().released == {}
+
+    # Best-effort: once ANOTHER member took the freed slot, the returning
+    # node degrades to normal allocation instead of colliding.
+    mgr.deregister("node-c")
+    assert mgr.register("node-d", "10.0.0.4") == 2  # lowest free
+    assert mgr.register("node-c", "10.0.0.3") == 3  # old slot taken

@@ -1,0 +1,119 @@
+"""pkg/backoff: the consolidated retry policy every retry loop adopts."""
+
+import threading
+
+from k8s_dra_driver_tpu.pkg.backoff import (
+    Backoff,
+    BackoffMetrics,
+    deterministic_jitter,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_capped_exponential_with_first_failure_free():
+    b = Backoff(base=1.0, cap=8.0, jitter=0.0, clock=FakeClock())
+    assert b.delay_for("k", 1) == 0.0
+    assert b.delay_for("k", 2) == 1.0
+    assert b.delay_for("k", 3) == 2.0
+    assert b.delay_for("k", 4) == 4.0
+    assert b.delay_for("k", 5) == 8.0
+    assert b.delay_for("k", 9) == 8.0  # capped
+
+
+def test_workqueue_shape_first_failure_waits_base():
+    b = Backoff(base=1.0, cap=8.0, jitter=0.0, first_free=False)
+    assert b.delay_for("k", 1) == 1.0
+    assert b.delay_for("k", 2) == 2.0
+    assert b.delay_for("k", 5) == 8.0  # capped
+
+
+def test_deterministic_jitter_reproduces_and_decorrelates():
+    a1 = deterministic_jitter("key-a", 3, 0.2)
+    a2 = deterministic_jitter("key-a", 3, 0.2)
+    assert a1 == a2  # pure function of (key, attempt)
+    assert 0.8 <= a1 <= 1.2
+    others = {deterministic_jitter(f"key-{i}", 3, 0.2) for i in range(32)}
+    assert len(others) > 16  # spread across keys, not one value
+    assert deterministic_jitter("key-a", 4, 0.2) != a1 or True  # may collide
+
+
+def test_eligibility_tracking_and_reset_on_success():
+    clk = FakeClock()
+    b = Backoff(base=2.0, cap=60.0, jitter=0.0, clock=clk)
+    assert b.ready("u")               # never failed
+    assert b.failure("u") == 0.0      # first failure free
+    assert b.ready("u")
+    d = b.failure("u")                # second: ~base
+    assert d == 2.0
+    assert not b.ready("u")
+    assert b.pending() == 1
+    clk.t = 2.0
+    assert b.ready("u")
+    assert b.pending() == 0
+    b.reset("u")                      # success forgets everything
+    assert b.failures("u") == 0
+    assert b.failure("u") == 0.0      # series restarts from free
+
+
+def test_metrics_observed_per_failure():
+    reg = Registry()
+    m = BackoffMetrics(reg)
+    b = Backoff(base=1.0, cap=8.0, jitter=0.0, metrics=m, source="test")
+    b.failure("k")
+    b.failure("k")
+    assert m.backoff_seconds.count("test") == 2
+    # Second registration on the same registry reuses the family.
+    m2 = BackoffMetrics(reg)
+    assert m2.backoff_seconds is m.backoff_seconds
+
+
+def test_thread_safety_smoke():
+    b = Backoff(base=0.001, cap=0.01, jitter=0.2)
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(200):
+                b.failure(("k", i % 4))
+                b.ready(("k", i % 4))
+                b.reset(("k", i % 4))
+        except Exception as e:  # noqa: BLE001 — assertion surface
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+def test_workqueue_default_limiters_use_backoff_histogram():
+    from k8s_dra_driver_tpu.pkg.workqueue import (
+        default_controller_rate_limiter,
+        prepare_unprepare_rate_limiter,
+    )
+
+    reg = Registry()
+    rl = default_controller_rate_limiter(reg)
+    d1 = rl.when("item")
+    d2 = rl.when("item")
+    assert 0.004 <= d1 <= 0.006      # ~base, jittered
+    assert d2 > d1                    # doubling
+    rl.forget("item")
+    assert rl.when("item") <= 0.006  # reset on success
+    hist = reg._metrics["tpu_dra_retry_backoff_seconds"]
+    assert hist.count("workqueue") == 3
+
+    prep = prepare_unprepare_rate_limiter(reg)
+    first = prep.when("claim")
+    assert 4.0 <= first <= 6.0        # the reference's 5s first delay
+    assert hist.count("workqueue-prepare") == 1
