@@ -38,10 +38,14 @@ bench:
 # wasted chip-hours vs the static baseline, zero burst flaps, zero
 # steady-state store lists; BENCH_AUTOSCALER_NODES overrides) + the
 # elastic-domain gate (ten seeded kill/heal cycles at 64 nodes: p99
-# time-to-healed in virtual seconds, zero rollbacks, zero leaks).
-# Capped at 15 min (the autoscaler day adds ~2.5 min at 1024 nodes).
+# time-to-healed in virtual seconds, zero rollbacks, zero leaks) + the
+# contention-plane gate (2048-node mixed-tenant churn storm: WFQ Jain
+# fairness vs the FIFO baseline, per-tier p99 time-to-running with
+# preemption strictly below no-preemption, zero half-assembled domains;
+# BENCH_PREEMPT_NODES overrides). Capped at 30 min (the preempt A/B
+# adds ~8.5 min at 2048 nodes).
 bench-smoke:
-	timeout -k 10 900 env JAX_PLATFORMS=cpu python bench.py --smoke
+	timeout -k 10 1800 env JAX_PLATFORMS=cpu python bench.py --smoke
 
 # Pre-merge gate: the tpulint invariant analyzer (which subsumes the
 # metrics-docs and event-reasons checks), the tpusan runtime concurrency

@@ -698,6 +698,257 @@ spec:
     return out
 
 
+def bench_preempt(num_nodes: int = 2048, churn_rounds: int = 5,
+                  churn_every: int = 12, churn_count: int = 64,
+                  high_pods: int = 16, num_domains: int = 2,
+                  assert_budget: bool = False) -> dict:
+    """Contention-plane benchmark (docs/reference/preemption.md): a
+    mixed-tenant churn storm on a 2048-node v5e-16 fleet, run twice on
+    an identical workload — FIFO baseline (no contention plane) vs
+    WFQ + checkpoint-aware preemption (`ContentionPolicy`).
+
+    The workload: four equal-weight batch tenants each pin one
+    whole-host pod to every node (4x overcommit per node — exactly one
+    can win each host), then churn retires and replaces running pods
+    every ``churn_every`` virtual steps while a high-tier tenant
+    (TenantQuota priorityFloor) submits ``high_pods`` whole-host claims
+    and ``num_domains`` 4-host ComputeDomains mid-storm.
+
+    Headlines and hard gates (``assert_budget=True`` in make
+    bench-smoke):
+
+    - **Jain's fairness index** over per-tenant Running counts at full
+      contention: >= 0.8 with WFQ vs <= 0.5 for the FIFO baseline
+      (alphabetical admission starves the later tenants entirely);
+    - **per-tier p99 time-to-running** in VIRTUAL steps: the high tier
+      under preemption strictly below the no-preemption baseline
+      (which waits for churn to free hosts);
+    - **zero half-assembled domains** in the contention run: every
+      ComputeDomain ends Ready with all workers Running (eviction frees
+      whole contiguous blocks or nothing);
+    - zero failed/rolled-back evictions.
+
+    ``BENCH_PREEMPT_NODES`` (env) overrides the node count."""
+    import os
+
+    from k8s_dra_driver_tpu.k8s.core import (
+        COMPUTE_DOMAIN,
+        Container,
+        POD,
+        Pod,
+        PodResourceClaimRef,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.scheduling.wfq import jain_index
+    from k8s_dra_driver_tpu.sim import SimCluster
+    from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+    num_nodes = int(os.environ.get("BENCH_PREEMPT_NODES", num_nodes))
+    tenants = ("ten-a", "ten-b", "ten-c", "ten-d")
+
+    def whole_rct(ns):
+        return f"""
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {{name: whole, namespace: {ns}}}
+spec:
+  spec:
+    devices:
+      requests: [{{name: t, exactly: {{deviceClassName: tpu.google.com, allocationMode: All}}}}]
+"""
+
+    prod_quota = """
+apiVersion: resource.tpu.google.com/v1beta1
+kind: TenantQuota
+metadata: {name: default, namespace: prod}
+spec:
+  weight: 1
+  priorityFloor: 100
+"""
+
+    def make_pod(name, ns, node=""):
+        pod = Pod(meta=new_meta(name, ns),
+                  containers=[Container(name="c", image="x")],
+                  resource_claims=[PodResourceClaimRef(
+                      name="t", resource_claim_template_name="whole")],
+                  node_name=node)
+        return pod
+
+    cd_manifest = """
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: dom-%(i)d, namespace: prod}
+spec:
+  numNodes: 4
+  channel:
+    resourceClaimTemplate: {name: dom-%(i)d-channel}
+"""
+    cd_worker = """
+apiVersion: v1
+kind: Pod
+metadata: {name: dom-%(i)d-worker-%(w)d, namespace: prod}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole}
+  - {name: channel, resourceClaimTemplateName: dom-%(i)d-channel}
+"""
+
+    def run(contention: bool) -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            # Channel prepare needs the kernel channel class (or the
+            # mock seam) — same env-only bootstrap as bench_elastic.
+            from k8s_dra_driver_tpu.pkg import devcaps
+
+            proc_devices = os.path.join(tmp, "proc_devices")
+            with open(proc_devices, "w", encoding="utf-8") as f:
+                f.write("Character devices:\n")
+            devcaps.configure_proc_devices_path(proc_devices)
+            sim = SimCluster(
+                workdir=tmp, profile="v5e-16", num_hosts=num_nodes,
+                gates="ContentionPolicy=true" if contention else "")
+            sim.start()
+            try:
+                for ns in tenants + ("prod",):
+                    for obj in load_manifests(whole_rct(ns)):
+                        sim.api.create(obj)
+                for obj in load_manifests(prod_quota):
+                    sim.api.create(obj)
+                # Fill: one whole-host pod per tenant PINNED per node —
+                # 4x overcommit, exactly one winner per host. Pinning is
+                # ROTATED a quarter-fleet per tenant so the admission
+                # ORDER (not the layout) decides who wins each host:
+                # FIFO's alphabetical sweep hands every host to the
+                # first tenant; WFQ's interleave splits them evenly.
+                serial = [0]
+                off = max(1, num_nodes // len(tenants))
+                for i, ns in enumerate(tenants):
+                    for j in range(num_nodes):
+                        node = (j + i * off) % num_nodes
+                        sim.api.create(make_pod(
+                            f"p-{j:05d}", ns, node=f"tpu-node-{node}"))
+                sim.settle(max_steps=60)
+                running = {
+                    ns: sum(1 for p in sim.api.list(POD, namespace=ns)
+                            if p.phase == "Running")
+                    for ns in tenants
+                }
+                jain = jain_index(running.values())
+                # High-tier demand + churn storm.
+                created_at = {}
+                t0 = sim.sim_time
+                for i in range(high_pods):
+                    name = f"vip-{i:03d}"
+                    sim.api.create(make_pod(name, "prod"))
+                    created_at[name] = sim.sim_time
+                for i in range(num_domains):
+                    for obj in load_manifests(cd_manifest % {"i": i}):
+                        sim.api.create(obj)
+                    for w in range(4):
+                        for obj in load_manifests(
+                                cd_worker % {"i": i, "w": w}):
+                            sim.api.create(obj)
+                            created_at[f"dom-{i}-worker-{w}"] = sim.sim_time
+                high_done = {}
+                rng_round = 0
+                total_steps = churn_rounds * churn_every + 2 * churn_every
+                for step_i in range(total_steps):
+                    sim.step()
+                    for p in sim.api.list(POD, namespace="prod"):
+                        if (p.phase == "Running"
+                                and p.meta.name not in high_done):
+                            high_done[p.meta.name] = (
+                                sim.sim_time - created_at[p.meta.name])
+                    if len(high_done) == len(created_at):
+                        break
+                    if (step_i + 1) % churn_every == 0 \
+                            and rng_round < churn_rounds:
+                        # Churn: retire running batch pods round-robin
+                        # across tenants and replace them on the same
+                        # hosts (new names -> fresh claims).
+                        rng_round += 1
+                        per_tenant = churn_count // len(tenants)
+                        for ns in tenants:
+                            victims = [
+                                p for p in sim.api.list(POD, namespace=ns)
+                                if p.phase == "Running"
+                            ][:per_tenant]
+                            for p in victims:
+                                sim.delete_pod(p.meta.name, ns)
+                                serial[0] += 1
+                                sim.api.create(make_pod(
+                                    f"r-{serial[0]:05d}", ns,
+                                    node=p.node_name))
+                cap = float(total_steps)
+                lat = [high_done.get(n, cap) for n in created_at]
+                lat.sort()
+                p50 = lat[len(lat) // 2]
+                p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+                domains = sim.api.list(COMPUTE_DOMAIN, namespace="prod")
+                half = 0
+                for cd in domains:
+                    workers = [p for p in sim.api.list(POD, namespace="prod")
+                               if p.meta.name.startswith(
+                                   f"{cd.name}-worker")]
+                    ready = cd.status.status == "Ready" and all(
+                        p.phase == "Running" for p in workers)
+                    started = any(p.phase == "Running" for p in workers)
+                    if not ready and started:
+                        half += 1
+                out = {
+                    "running_per_tenant": running,
+                    "jain": round(jain, 3),
+                    "high_p50_vs": p50,
+                    "high_p99_vs": p99,
+                    "high_censored": sum(1 for v in lat if v >= cap),
+                    "half_assembled": half,
+                    "domains_ready": sum(
+                        1 for cd in domains
+                        if cd.status.status == "Ready"),
+                }
+                if sim.preemption is not None:
+                    m = sim.preemption.metrics
+                    out["evicted"] = m.preemptions_total.value("evicted")
+                    out["evict_failed"] = m.preemptions_total.value("failed")
+                return out
+            finally:
+                devcaps.configure_proc_devices_path(None)
+                sim.stop()
+
+    t0 = time.perf_counter()
+    fifo = run(contention=False)
+    wfq = run(contention=True)
+    out = {
+        "preempt_nodes": num_nodes,
+        "preempt_fifo_jain": fifo["jain"],
+        "preempt_wfq_jain": wfq["jain"],
+        "preempt_fifo_high_p99_vs": fifo["high_p99_vs"],
+        "preempt_wfq_high_p99_vs": wfq["high_p99_vs"],
+        "preempt_fifo_high_censored": fifo["high_censored"],
+        "preempt_wfq_high_censored": wfq["high_censored"],
+        "preempt_half_assembled": wfq["half_assembled"],
+        "preempt_domains_ready": wfq["domains_ready"],
+        "preempt_evictions": wfq.get("evicted", 0.0),
+        "preempt_failed_evictions": wfq.get("evict_failed", 0.0),
+        "preempt_wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if assert_budget:
+        # Fairness: equal-weight tenants share within Jain >= 0.8 under
+        # WFQ; the FIFO baseline starves the alphabetical tail to <= 0.5.
+        assert out["preempt_wfq_jain"] >= 0.8, out
+        assert out["preempt_fifo_jain"] <= 0.5, out
+        # Per-tier latency: the high tier's p99 time-to-running under
+        # preemption is STRICTLY below the no-preemption baseline.
+        assert (out["preempt_wfq_high_p99_vs"]
+                < out["preempt_fifo_high_p99_vs"]), out
+        # Every domain in the contention run fully assembles or never
+        # starts — no half-assembled domains, ever.
+        assert out["preempt_half_assembled"] == 0, out
+        assert out["preempt_domains_ready"] == num_domains, out
+        assert out["preempt_failed_evictions"] == 0, out
+    return out
+
+
 def bench_store_throughput(writer_threads: int = 8, ops_per_thread: int = 3000,
                            watchers_per_kind: int = 2,
                            durable_ops_per_thread: int = 400) -> dict:
@@ -2205,6 +2456,11 @@ def main() -> None:
         # every grow-back completes, zero rolled-back epochs, zero
         # leaked partitions / MigrationCheckpoint residue.
         result.update(bench_elastic(assert_budget=True))
+        # Contention-plane gates (BENCH_PREEMPT_NODES, default 2048):
+        # WFQ Jain >= 0.8 vs FIFO <= 0.5 across equal-weight tenants,
+        # high-tier p99 time-to-running strictly below the no-preemption
+        # baseline, zero half-assembled domains, zero failed evictions.
+        result.update(bench_preempt(assert_budget=True))
         # Scale-out gates (BENCH_SCALE_NODES, default 2048 in CI): hard
         # p99 claim-to-running budget, >=2x durable sharded-vs-single-lock
         # write throughput with 8 writer threads, zero watch-ordering
@@ -2260,6 +2516,12 @@ def main() -> None:
         result.update(bench_elastic())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["elastic_error"] = str(e)[:200]
+    try:
+        # Contention plane: mixed-tenant churn storm, FIFO vs
+        # WFQ+preemption (fairness index, per-tier time-to-running).
+        result.update(bench_preempt())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["preempt_error"] = str(e)[:200]
     try:
         # Control-plane scale-out: 2048/4096/8192-node claim storms with
         # p50/p99 claim-to-running, threaded store write throughput

@@ -16,5 +16,6 @@ from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
     thread_shared_state,
     shard_lock,
     sleep_under_lock,
+    cordon_discipline,
     docs_sync,
 )

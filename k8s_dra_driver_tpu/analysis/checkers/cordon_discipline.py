@@ -1,0 +1,113 @@
+"""cordon-cas: evictions/migrations acquire cordons ONLY via the CAS.
+
+The owner-tagged cordon annotation
+(``rebalancer.tpu.google.com/cordoned``) is the arbiter between every
+actor that moves or retires claims — the rebalancer, the autoscaler's
+scale-down drain, the elastic resize orchestrator, and the preemption
+engine. Its exclusion guarantee holds only because every acquisition
+goes through ``try_cordon`` (a compare-and-swap that loses cleanly to a
+foreign owner) and every release through ``release_cordon``. A raw
+annotation write on any of those paths — ``obj.meta.annotations[KEY] =
+...`` or ``.pop(KEY)`` outside the two sanctioned functions — silently
+reintroduces the blind-cordon TOCTOU the CAS closed: two actors both
+"win", one double-handles the claim, and the partition ledger loses.
+
+Scope: the controllers that participate in the protocol (rebalancer/,
+autoscaler/, scheduling/, controller/). The two sanctioned functions
+live in ``rebalancer/controller.py`` and are recognized by name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+CORDON_VALUE = "rebalancer.tpu.google.com/cordoned"
+CORDON_NAMES = {"CORDON_ANNOTATION"}
+SANCTIONED_FUNCS = {"try_cordon", "release_cordon"}
+
+
+def _is_cordon_key(node: ast.AST) -> bool:
+    """Does this subscript/argument name the cordon annotation — by the
+    CORDON_ANNOTATION constant or its literal value?"""
+    if isinstance(node, ast.Constant) and node.value == CORDON_VALUE:
+        return True
+    if isinstance(node, ast.Name) and node.id in CORDON_NAMES:
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in CORDON_NAMES:
+        return True
+    return False
+
+
+def _is_annotations_attr(node: ast.AST) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "annotations"
+
+
+def _enclosing_functions(node: ast.AST, parents) -> List[str]:
+    """Every def on the node's ancestor chain, innermost first — the
+    CAS implementations write through nested mutate() closures, so the
+    sanction check must see the whole chain."""
+    out: List[str] = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur.name)
+        cur = parents.get(cur)
+    return out
+
+
+@register_checker
+class CordonDisciplineChecker(Checker):
+    rule = "cordon-cas"
+    description = ("cordon acquisition/release only via the owner-tagged "
+                   "try_cordon/release_cordon CAS — no raw cordon-"
+                   "annotation writes on eviction/migration paths")
+    hint = ("call rebalancer.controller.try_cordon(api, claim, owner=...) "
+            "to acquire and release_cordon(api, claim) to release; a raw "
+            "annotation write reopens the blind-cordon double-handle race")
+    scope = ("k8s_dra_driver_tpu/rebalancer/",
+             "k8s_dra_driver_tpu/autoscaler/",
+             "k8s_dra_driver_tpu/scheduling/",
+             "k8s_dra_driver_tpu/controller/")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            hit = None
+            # obj.meta.annotations[CORDON_ANNOTATION] = ... (Store ctx)
+            # and `del obj.meta.annotations[CORDON_ANNOTATION]`.
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))
+                    and _is_annotations_attr(node.value)
+                    and _is_cordon_key(node.slice)):
+                hit = ("raw cordon-annotation write "
+                       "(subscript assignment/delete)")
+            # obj.meta.annotations.pop(CORDON_ANNOTATION, ...) /
+            # .setdefault(CORDON_ANNOTATION, ...) / .update({...}) with
+            # the cordon key anywhere in the args.
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("pop", "setdefault", "update")
+                  and _is_annotations_attr(node.func.value)
+                  and any(_is_cordon_key(a) for a in ast.walk(node)
+                          if a is not node)):
+                hit = f"raw cordon-annotation .{node.func.attr}()"
+            if hit is None:
+                continue
+            if any(fn in SANCTIONED_FUNCS
+                   for fn in _enclosing_functions(node, sf.parents)):
+                continue  # the CAS implementation itself
+            findings.append(self.finding(
+                sf, node,
+                f"{hit} outside try_cordon/release_cordon — cordons are "
+                f"owner-tagged CAS state; a raw write double-handles the "
+                f"claim against the other actor roles",
+            ))
+        return findings

@@ -30,12 +30,13 @@ from k8s_dra_driver_tpu.analysis.engine import (
 class StoreScanChecker(Checker):
     rule = "store-scan"
     description = ("no store/api list() scans inside per-item loops in "
-                   "sim/, controller/, and autoscaler/ — hoist the scan "
-                   "or use the PR 3 indexes")
+                   "sim/, controller/, autoscaler/, and scheduling/ — "
+                   "hoist the scan or use the PR 3 indexes")
     hint = ("hoist the list() above the loop (one scan, filter in "
             "Python), or use try_get/feasibility indexes")
     scope = ("k8s_dra_driver_tpu/sim/", "k8s_dra_driver_tpu/controller/",
-             "k8s_dra_driver_tpu/autoscaler/")
+             "k8s_dra_driver_tpu/autoscaler/",
+             "k8s_dra_driver_tpu/scheduling/")
 
     def check_file(self, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []

@@ -83,6 +83,15 @@ DEFAULT_SPECS: Tuple[WireKindSpec, ...] = (
         decoders=("_servinggroup_decode", "_conditions_decode"),
     ),
     WireKindSpec(
+        kind="TenantQuota",
+        dataclasses={
+            "k8s_dra_driver_tpu/api/tenantquota.py": (
+                "TenantQuota", "TenantQuotaSpec", "TenantQuotaStatus"),
+        },
+        encoders=("_tenantquota_encode",),
+        decoders=("_tenantquota_decode",),
+    ),
+    WireKindSpec(
         kind="ComputeDomainClique",
         dataclasses={
             _API_CD: ("ComputeDomainClique", "ComputeDomainDaemonInfo"),

@@ -898,6 +898,158 @@ def scenario_resize_vs_rebalancer(
                        "migrated claim not re-pointed at the target")
 
 
+def scenario_preempt_vs_rebalancer(
+        state: SanitizerState, seed: int, extra_workers: int = 0) -> None:
+    """A preemption eviction races a defrag migration over the SAME
+    victim unit. Exactly one may win — the owner-tagged cordon CAS
+    (owner="preempt" vs owner="rebalancer") is the arbiter — and
+    whichever side wins, the ledgers must agree with the surviving
+    state: an eviction leaves the claim deallocated with NO partition
+    and NO prepared entry anywhere (checkpointed out, requeued); a
+    migration leaves exactly its partition on the target with the
+    allocation re-pointed. Without try_cordon both the double-handle
+    and the leaked-partition failure modes are reachable."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM
+    from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+    from k8s_dra_driver_tpu.pkg import featuregates as fg
+    from k8s_dra_driver_tpu.pkg.flock import Flock
+    from k8s_dra_driver_tpu.pkg.partitioner import (
+        PartitionManager,
+        StubPartitionClient,
+    )
+    from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_COMPLETED
+    from k8s_dra_driver_tpu.plugins.tpu.device_state import DeviceState
+    from k8s_dra_driver_tpu.rebalancer.controller import (
+        release_cordon,
+        try_cordon,
+    )
+    from k8s_dra_driver_tpu.scheduling.preemption import CORDON_OWNER_PREEMPT
+    from k8s_dra_driver_tpu.tpulib import MockTpuLib
+
+    api = APIServer(shards=2)
+    with tempfile.TemporaryDirectory(prefix="tpusan-pe-") as tmp:
+        stubs = {}
+        devs = {}
+        pu_paths = {}
+        for node in ("node-0", "node-1"):
+            stub = StubPartitionClient()
+            dev = DeviceState(
+                MockTpuLib("v5e-4"), os.path.join(tmp, node, "plugin"),
+                cdi_root=os.path.join(tmp, node, "cdi"),
+                gates=fg.parse("ICIPartitioning=true,DynamicSubslice=true"),
+            )
+            dev.partitions = PartitionManager(dev.inventory.host_topology,
+                                              stub)
+            stubs[node], devs[node] = stub, dev
+            pu_paths[node] = os.path.join(tmp, node, "plugin", "pu.lock")
+        claim = _claim_for_devices(["tpu-subslice-1x2-at-0x0"], "victim-0")
+        api.create(claim)
+        api.create(_pod("victim-0"))
+        with Flock(pu_paths["node-0"]).hold():
+            devs["node-0"].prepare(claim)
+        outcomes: Dict[str, bool] = {}
+
+        def preemptor():
+            # PreemptionController._evict's shape: cordon atomically
+            # (owner="preempt"), MigrationCheckpoint the claim out,
+            # deallocate it via the API (requeue), close the entry,
+            # release the cordon.
+            c = api.try_get(RESOURCE_CLAIM, "victim-0", "default")
+            if c is None or not try_cordon(api, c,
+                                           owner=CORDON_OWNER_PREEMPT):
+                return
+            outcomes["preempted"] = True
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].migrate_out(claim.uid)
+            state.yield_point(("scenario", "preemptor"))
+
+            def clear(obj):
+                obj.allocation = None
+            try:
+                api.update_with_retry(RESOURCE_CLAIM, "victim-0", "default",
+                                      clear)
+            except NotFoundError:
+                pass
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].end_migration(claim.uid)
+            release_cordon(api, c)
+
+        def repacker():
+            # RebalanceController._migrate_unit's shape: cordon, migrate
+            # off node-0, prepare on node-1, re-point, close, uncordon.
+            c = api.try_get(RESOURCE_CLAIM, "victim-0", "default")
+            if c is None or not try_cordon(api, c, owner="rebalancer"):
+                return
+            outcomes["migrated"] = True
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].migrate_out(claim.uid)
+            state.yield_point(("scenario", "repacker"))
+            with Flock(pu_paths["node-1"]).hold():
+                devs["node-1"].prepare(claim)
+
+            def repoint(obj):
+                obj.allocation.node_name = "node-1"
+            try:
+                api.update_with_retry(RESOURCE_CLAIM, "victim-0", "default",
+                                      repoint)
+            except NotFoundError:
+                pass
+            with Flock(pu_paths["node-0"]).hold():
+                devs["node-0"].end_migration(claim.uid)
+            release_cordon(api, c)
+
+        explore(state, seed,
+                [("preemptor", preemptor), ("repacker", repacker)]
+                + _fillers(state, extra_workers))
+
+        _invariant(state, len(outcomes) == 1,
+                   f"cordon CAS admitted {sorted(outcomes)} — the same "
+                   f"victim claim was handled by both the preemption "
+                   f"eviction and the repack migration")
+        from k8s_dra_driver_tpu.rebalancer.controller import (
+            CORDON_ANNOTATION,
+        )
+        live = api.try_get(RESOURCE_CLAIM, "victim-0", "default")
+        _invariant(state,
+                   live is not None
+                   and CORDON_ANNOTATION not in live.meta.annotations,
+                   "winner left the claim cordoned after finishing")
+        if outcomes.get("preempted"):
+            _invariant(state,
+                       not stubs["node-0"].active_ids()
+                       and not stubs["node-1"].active_ids(),
+                       f"evicted claim's ledgers read "
+                       f"src={stubs['node-0'].active_ids()} "
+                       f"dst={stubs['node-1'].active_ids()} — expected no "
+                       f"partition anywhere after checkpoint-out")
+            _invariant(state,
+                       not devs["node-0"].prepared_claims()
+                       and not devs["node-1"].prepared_claims(),
+                       "evicted claim left checkpoint residue")
+            _invariant(state,
+                       live is not None and live.allocation is None,
+                       "evicted claim still allocated")
+        elif outcomes.get("migrated"):
+            _invariant(state,
+                       not stubs["node-0"].active_ids()
+                       and len(stubs["node-1"].active_ids()) == 1,
+                       f"migrated claim's ledgers read "
+                       f"src={stubs['node-0'].active_ids()} "
+                       f"dst={stubs['node-1'].active_ids()} — expected the "
+                       f"one partition on the target only")
+            entries = devs["node-1"].prepared_claims()
+            _invariant(state,
+                       not devs["node-0"].prepared_claims()
+                       and set(entries) == {claim.uid}
+                       and entries[claim.uid].state == PREPARE_COMPLETED,
+                       "migrated claim's checkpoints inconsistent")
+            _invariant(state,
+                       live is not None
+                       and live.allocation.node_name == "node-1",
+                       "migrated claim not re-pointed at the target")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -908,6 +1060,7 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
     "autoscaler-scaledown-vs-consolidation":
         scenario_autoscaler_scaledown_vs_consolidation,
     "resize-vs-rebalancer": scenario_resize_vs_rebalancer,
+    "preempt-vs-rebalancer": scenario_preempt_vs_rebalancer,
 }
 
 

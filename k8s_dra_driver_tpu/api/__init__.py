@@ -25,6 +25,12 @@ from k8s_dra_driver_tpu.api.configs import (  # noqa: F401
     nonstrict_decode,
     strict_decode,
 )
+from k8s_dra_driver_tpu.api.tenantquota import (  # noqa: F401
+    TENANT_QUOTA,
+    TenantQuota,
+    TenantQuotaSpec,
+    TenantQuotaStatus,
+)
 from k8s_dra_driver_tpu.api.computedomain import (  # noqa: F401
     COMPUTE_DOMAIN_FINALIZER,
     ComputeDomain,

@@ -1,0 +1,78 @@
+"""TenantQuota CRD: the per-namespace contract of the contention plane.
+
+Everything below the contention plane treats tenants as cooperating:
+gang admission and the rebalancer make room by *moving* claims, but one
+namespace's claim storm can still starve another indefinitely. A
+TenantQuota names the namespace's share of the fleet explicitly:
+
+- ``spec.weight`` — the namespace's weighted-fair-queuing share. The
+  scheduler's dirty-batch admission orders pending work by virtual-time
+  fair queuing over these weights (``scheduling/wfq.py``), so a tenant
+  with weight 2 admits twice the chip-work per unit of contention as a
+  tenant with weight 1, regardless of how many claims each submits.
+- ``spec.chipQuota`` — hard cap on chips the namespace may hold
+  allocated at once (0 = unlimited). Over-quota claims park
+  unschedulable with a per-tenant reason (``QuotaExceeded``) and
+  re-admit when usage drops or the quota is raised.
+- ``spec.priorityFloor`` — the namespace's default priority tier: every
+  pod/claim in the namespace is treated as AT LEAST this tier (a
+  workload may declare a higher ``priorityTier`` on its own pod/claim;
+  it can never demote below the floor). Tiers drive checkpoint-aware
+  preemption: a higher-tier claim that parks unschedulable may evict
+  strictly-lower-tier victims (``scheduling/preemption.py``).
+
+One TenantQuota per namespace (the object's own namespace is the
+tenant); when several exist the first by name wins, matching how
+ResourceQuota scopes resolve. Status is written change-gated by the
+scheduler's contention manager once per pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from k8s_dra_driver_tpu.k8s.objects import K8sObject
+
+TENANT_QUOTA = "TenantQuota"
+
+# Tier vocabulary: plain non-negative ints compare naturally ("never
+# evict equal-or-higher tiers" is `victim_tier < preemptor_tier`).
+# These names are conventions for manifests/docs, not an enum — any
+# int >= 0 is a valid tier.
+TIER_BEST_EFFORT = 0
+TIER_STANDARD = 50
+TIER_HIGH = 100
+
+
+@dataclass
+class TenantQuotaSpec:
+    # Weighted-fair-queuing share; clamped to a small positive epsilon
+    # by the queue so a zero/negative weight cannot divide by zero.
+    weight: float = 1.0
+    # Max chips the namespace may hold allocated at once; 0 = unlimited.
+    chip_quota: int = 0
+    # Minimum (and default) priority tier for the namespace's workloads.
+    priority_floor: int = 0
+
+
+@dataclass
+class TenantQuotaStatus:
+    """Scheduler-written observability: what the contention manager
+    currently accounts to this tenant. Quantized + change-gated (steady
+    state writes nothing); ``updated_at`` is display metadata outside
+    the equality gate, like UtilizationSummary's."""
+
+    chips_used: int = 0
+    pods_pending: int = 0
+    # WFQ virtual finish time (rounded) — how far ahead of the global
+    # virtual clock this tenant's admitted work has pushed it. A tenant
+    # far ahead of its peers waits; one behind is owed service.
+    virtual_time: float = 0.0
+    updated_at: float = field(default=0.0, compare=False)
+
+
+@dataclass
+class TenantQuota(K8sObject):
+    kind: str = TENANT_QUOTA
+    spec: TenantQuotaSpec = field(default_factory=TenantQuotaSpec)
+    status: TenantQuotaStatus = field(default_factory=TenantQuotaStatus)

@@ -51,7 +51,7 @@ import math
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Dict, List, Optional, Set, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 from k8s_dra_driver_tpu.api.servinggroup import (
     SERVING_GROUP,
@@ -60,6 +60,7 @@ from k8s_dra_driver_tpu.api.servinggroup import (
     SERVING_TIER_LABEL,
     ServingGroup,
     replica_capacity_qps,
+    tier_chips,
 )
 from k8s_dra_driver_tpu.autoscaler.traffic import (
     SERVING_LATENCY_SLO,
@@ -139,11 +140,21 @@ class ServingGroupController:
 
     def __init__(self, api, metrics_registry: Registry,
                  engine: TrafficEngine,
-                 recorder: Optional[EventRecorder] = None):
+                 recorder: Optional[EventRecorder] = None,
+                 headroom_fn: Optional[Callable[[], float]] = None,
+                 tenant_weight_fn: Optional[Callable[[str], float]] = None):
         self.api = api
         self.engine = engine
         self.recorder = recorder or EventRecorder(
             api, "autoscaler", metrics_registry=metrics_registry)
+        # Multi-group fairness hooks: when the fleet's free-chip
+        # headroom cannot satisfy the SUM of desired scale-ups this
+        # tick, apportion it across groups by tenant weight (weighted
+        # max-min water-filling) instead of first-writer-wins; clamped
+        # losers surface as ScaleDeferred. None = unconstrained (the
+        # pre-contention behavior).
+        self.headroom_fn = headroom_fn
+        self.tenant_weight_fn = tenant_weight_fn
         r = metrics_registry
         self.desired_gauge = r.register(Gauge(
             "tpu_dra_autoscaler_desired_replicas",
@@ -188,12 +199,15 @@ class ServingGroupController:
             a.subject for a in (alerts or ())
             if a.slo == SERVING_LATENCY_SLO
         }
+        allowances = self._fair_up_allowances(samples, alerting)
         with tracing.span("autoscaler.pass") as sp:
             for key, sample in samples.items():
                 try:
                     decisions.append(self._step_group(
                         key, sample, now, key in alerting,
-                        claim_summaries or {}))
+                        claim_summaries or {},
+                        max_up=(allowances.get(key)
+                                if allowances is not None else None)))
                 except Exception:  # noqa: BLE001 — one bad group must not stall the fleet
                     log.exception("autoscaler pass failed for %s/%s", *key)
             # Replicas whose group vanished: drain (no ownerRef GC path
@@ -211,17 +225,86 @@ class ServingGroupController:
         self.pass_seconds.set(value=time.perf_counter() - t0)
         return decisions
 
-    def _step_group(self, key: _Key, sample: GroupSample, now: float,
-                    alerting: bool,
-                    claim_summaries: Dict[_Key, UtilizationSummary],
-                    ) -> ScaleDecision:
-        group = sample.group
-        spec = group.spec
+    @staticmethod
+    def _up_target(spec, sample: GroupSample, alerting: bool):
+        """THE scale-up formula — the single copy both the fairness
+        pre-pass and _step_group call, so they can never drift. Returns
+        (demand, desired, push, wants_up, target); ``wants_up`` with
+        ``target <= spec.replicas`` means clamped-while-wanting (the
+        deferral case)."""
         policy = spec.policy
         cap = replica_capacity_qps(spec)
         demand = math.ceil(sample.qps / max(1e-9, cap * policy.target_duty))
         desired = max(policy.min_replicas,
                       min(policy.max_replicas, demand))
+        push = alerting and sample.latency_ratio > 1.0
+        cur = spec.replicas
+        # `demand` (unclamped) gates the branch so wanting more than
+        # max_replicas surfaces as a deferral, not silence; `desired`
+        # (clamped) covers the min-replicas floor on an undersized group.
+        wants_up = demand > cur or desired > cur or push
+        if wants_up:
+            target = min(policy.max_replicas,
+                         max(desired, cur + 1 if push else 0))
+        else:
+            target = cur
+        return demand, desired, push, wants_up, target
+
+    def _fair_up_allowances(
+            self, samples: Dict[_Key, GroupSample],
+            alerting: Set[_Key]) -> Optional[Dict[_Key, int]]:
+        """Per-group replica allowance for this tick's scale-ups, or
+        None when unconstrained. Only engages when the summed chip
+        demand exceeds the fleet's free-chip headroom: then capacity is
+        apportioned across groups by tenant weight (weighted max-min),
+        so a heavy group's storm cannot take every last chip first —
+        the clamped groups defer visibly instead of silently losing."""
+        if self.headroom_fn is None:
+            return None
+        demands: Dict[_Key, float] = {}
+        chips_per_replica: Dict[_Key, int] = {}
+        for key, sample in samples.items():
+            spec = sample.group.spec
+            _, _, _, _, target = self._up_target(
+                spec, sample, key in alerting)
+            delta = max(0, target - spec.replicas)
+            if delta:
+                chips = max(1, tier_chips(spec.profile))
+                demands[key] = float(delta * chips)
+                chips_per_replica[key] = chips
+        if not demands:
+            return None
+        try:
+            headroom = max(0.0, float(self.headroom_fn()))
+        except Exception:  # noqa: BLE001 — a headroom probe failure must not stall scaling
+            log.exception("headroom probe failed; scaling unconstrained")
+            return None
+        if sum(demands.values()) <= headroom:
+            return None
+        from k8s_dra_driver_tpu.scheduling.wfq import fair_apportion
+
+        weights = {
+            key: (self.tenant_weight_fn(key[0])
+                  if self.tenant_weight_fn is not None else 1.0)
+            for key in demands
+        }
+        grants = fair_apportion(demands, weights, headroom)
+        return {key: int(grants[key] // chips_per_replica[key])
+                for key in demands}
+
+    def _step_group(self, key: _Key, sample: GroupSample, now: float,
+                    alerting: bool,
+                    claim_summaries: Dict[_Key, UtilizationSummary],
+                    max_up: Optional[int] = None,
+                    ) -> ScaleDecision:
+        group = sample.group
+        spec = group.spec
+        policy = spec.policy
+        cap = replica_capacity_qps(spec)
+        # THE one copy of the scale-up formula (shared with the
+        # fairness pre-pass — see _up_target).
+        demand, desired, push, wants_up, up_target = self._up_target(
+            spec, sample, alerting)
         self.desired_gauge.set(key[0], key[1], value=float(desired))
         self.ready_gauge.set(key[0], key[1], value=float(sample.ready))
         first_seen = self._first_seen.setdefault(key, now)
@@ -261,20 +344,21 @@ class ServingGroupController:
             1.0 - spec.traffic.base_latency_ms
             / max(1e-9, spec.slo.latency_p95_ms)))
         slo_floor = math.ceil(sample.qps / max(1e-9, cap * rho_safe))
-        # An active alert forces at least one extra replica ONLY while
-        # the current sample still violates: the burn alert is a
-        # trailing indicator (its short window drains over several
-        # ticks), and stepping on a recovered sample would overshoot all
-        # the way to max_replicas before the alert clears.
-        push = alerting and sample.latency_ratio > 1.0
-        # `demand` (unclamped) gates the branch so wanting more than
-        # max_replicas surfaces as a deferral, not silence; `desired`
-        # (clamped) covers the min-replicas floor on an undersized group.
-        if demand > cur or desired > cur or push:
-            target = min(policy.max_replicas,
-                         max(desired, cur + 1 if push else 0))
+        # An active alert (`push` in _up_target) forces at least one
+        # extra replica ONLY while the current sample still violates:
+        # the burn alert is a trailing indicator (its short window
+        # drains over several ticks), and stepping on a recovered
+        # sample would overshoot all the way to max_replicas before the
+        # alert clears.
+        if wants_up:
+            target = up_target
+            if max_up is not None:
+                # Multi-group fairness: this tick's weighted share of
+                # the fleet headroom caps the step; the rest defers.
+                target = min(target, cur + max_up)
             if target <= cur:
-                # Clamped by max_replicas while still wanting up.
+                # Clamped by max_replicas (or the fairness share) while
+                # still wanting up.
                 self._defer(group, decision)
             elif (now - group.status.last_scale_up
                     >= policy.scale_up_cooldown_s):

@@ -700,6 +700,14 @@ class ElasticDomainController:
         elapsed = max(0.0, self.clock() - r.started_at)
         self.metrics.epochs_total.inc(r.trigger, "completed")
         self.metrics.time_to_healed.observe(r.trigger, value=elapsed)
+        if self.heal_observer is not None:
+            # SLO-plane feed: time-to-healed as a burn-rate objective
+            # (pkg/slo.py TIME_TO_HEALED_SLO). Best-effort — the SLO
+            # layer must never fail a finalize.
+            try:
+                self.heal_observer(r.trigger, elapsed, cd)
+            except Exception:  # noqa: BLE001 — observability must not break the epoch
+                log.exception("heal observer failed for %s", cd.key)
         fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
         if fresh is not None:
             self.metrics.domain_epoch.set(cd.namespace, cd.name,
@@ -786,6 +794,10 @@ class ElasticDomainController:
     # Crash-injection seam (tests raise from here to simulate a controller
     # dying between phases; same shape as the plugins' fault hooks).
     fault_hook: Optional[Callable[[str], None]] = None
+
+    # SLO-plane sink for completed epochs: (trigger, elapsed_s, domain).
+    # The sim wires this to observe TIME_TO_HEALED_SLO on its evaluator.
+    heal_observer: Optional[Callable[[str, float, object], None]] = None
 
     def _fire_fault(self, point: str) -> None:
         if self.fault_hook is not None:

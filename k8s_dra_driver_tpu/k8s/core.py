@@ -197,6 +197,10 @@ class ResourceClaim(K8sObject):
     kind: str = RESOURCE_CLAIM
     requests: List[DeviceRequest] = field(default_factory=list)
     config: List[DeviceClaimConfig] = field(default_factory=list)
+    # Contention-plane priority tier (spec.priorityTier on the wire).
+    # The effective tier is max(claim, consumer pod, namespace
+    # TenantQuota floor); see docs/reference/preemption.md.
+    priority_tier: int = 0
     allocation: Optional[AllocationResult] = None
     reserved_for: List[ResourceClaimConsumer] = field(default_factory=list)
     # Typed lifecycle conditions (Allocated, Prepared), mirrored from the
@@ -315,6 +319,9 @@ class Pod(K8sObject):
     node_name: str = ""
     containers: List[Container] = field(default_factory=list)
     resource_claims: List[PodResourceClaimRef] = field(default_factory=list)
+    # Contention-plane priority tier (spec.priorityTier on the wire);
+    # defaulted/raised by the namespace's TenantQuota priorityFloor.
+    priority_tier: int = 0
     phase: str = "Pending"
     pod_ip: str = ""
     ready: bool = False

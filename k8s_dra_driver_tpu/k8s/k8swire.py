@@ -42,6 +42,11 @@ from k8s_dra_driver_tpu.api.servinggroup import (
     ServingTraffic,
     ServingTrafficStatus,
 )
+from k8s_dra_driver_tpu.api.tenantquota import (
+    TenantQuota,
+    TenantQuotaSpec,
+    TenantQuotaStatus,
+)
 from k8s_dra_driver_tpu.k8s.conditions import Condition
 from k8s_dra_driver_tpu.pkg.meshgen import MeshBundle, MeshDevice
 from k8s_dra_driver_tpu.k8s.core import (
@@ -95,6 +100,7 @@ RESOURCE_MAP: Dict[str, Tuple[str, str, bool]] = {
     "ComputeDomain": ("resource.tpu.google.com/v1beta1", "computedomains", True),
     "ComputeDomainClique": ("resource.tpu.google.com/v1beta1", "computedomaincliques", True),
     "ServingGroup": ("resource.tpu.google.com/v1beta1", "servinggroups", True),
+    "TenantQuota": ("resource.tpu.google.com/v1beta1", "tenantquotas", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
     "ValidatingWebhookConfiguration": (
         "admissionregistration.k8s.io/v1", "validatingwebhookconfigurations",
@@ -355,6 +361,8 @@ def _pod_encode(p: Pod) -> Dict[str, Any]:
         spec["nodeName"] = p.node_name
     if p.resource_claims:
         spec["resourceClaims"] = _claim_refs_encode(p.resource_claims)
+    if p.priority_tier:
+        spec["priorityTier"] = p.priority_tier
     conditions = [{"type": c.type, "status": c.status} for c in p.conditions]
     if p.ready and not any(c["type"] == "Ready" for c in conditions):
         conditions.append({"type": "Ready", "status": "True"})
@@ -379,6 +387,7 @@ def _pod_decode(doc: Dict[str, Any]) -> Pod:
         node_name=spec.get("nodeName", ""),
         containers=[_container_decode(c) for c in spec.get("containers") or []],
         resource_claims=_claim_refs_decode(spec.get("resourceClaims") or []),
+        priority_tier=int(spec.get("priorityTier", 0)),
         phase=status.get("phase", "Pending"),
         pod_ip=status.get("podIP", ""),
         ready=ready,
@@ -596,12 +605,14 @@ def _configs_decode(docs: List[Dict[str, Any]], source: str) -> List[DeviceClaim
 
 
 def _claim_encode(rc: ResourceClaim, version: str = "v1") -> Dict[str, Any]:
-    spec = {
+    spec: Dict[str, Any] = {
         "devices": {
             "requests": _requests_encode(rc.requests, version),
             "config": _configs_encode(rc.config),
         }
     }
+    if rc.priority_tier:
+        spec["priorityTier"] = rc.priority_tier
     status: Dict[str, Any] = {}
     if rc.allocation:
         alloc: Dict[str, Any] = {
@@ -704,6 +715,7 @@ def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
         meta=_meta_decode(doc.get("metadata") or {}),
         requests=_requests_decode(devices.get("requests") or []),
         config=_configs_decode(devices.get("config") or [], source="claim"),
+        priority_tier=int(spec.get("priorityTier", 0)),
         allocation=allocation,
         reserved_for=[
             ResourceClaimConsumer(
@@ -1239,6 +1251,45 @@ def _servinggroup_decode(doc: Dict[str, Any]) -> ServingGroup:
     )
 
 
+def _tenantquota_encode(tq: TenantQuota) -> Dict[str, Any]:
+    """resource.tpu.google.com/v1beta1 TenantQuota. Spelled out
+    field-for-field so the wire-drift checker audits both sides."""
+    s = tq.spec
+    spec: Dict[str, Any] = {
+        "weight": s.weight,
+        "chipQuota": s.chip_quota,
+        "priorityFloor": s.priority_floor,
+    }
+    st = tq.status
+    status: Dict[str, Any] = {
+        "chipsUsed": st.chips_used,
+        "podsPending": st.pods_pending,
+        "virtualTime": st.virtual_time,
+    }
+    if st.updated_at:
+        status["updatedAt"] = st.updated_at
+    return {"spec": spec, "status": status}
+
+
+def _tenantquota_decode(doc: Dict[str, Any]) -> TenantQuota:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return TenantQuota(
+        meta=_meta_decode(doc.get("metadata") or {}),
+        spec=TenantQuotaSpec(
+            weight=float(spec.get("weight", 1.0)),
+            chip_quota=int(spec.get("chipQuota", 0)),
+            priority_floor=int(spec.get("priorityFloor", 0)),
+        ),
+        status=TenantQuotaStatus(
+            chips_used=int(status.get("chipsUsed", 0)),
+            pods_pending=int(status.get("podsPending", 0)),
+            virtual_time=float(status.get("virtualTime", 0.0)),
+            updated_at=float(status.get("updatedAt", 0.0)),
+        ),
+    )
+
+
 def _clique_encode(cl: ComputeDomainClique) -> Dict[str, Any]:
     return {
         "domainUid": cl.domain_uid,
@@ -1380,6 +1431,7 @@ _ENCODERS = {
     "ComputeDomain": _computedomain_encode,
     "ComputeDomainClique": _clique_encode,
     "ServingGroup": _servinggroup_encode,
+    "TenantQuota": _tenantquota_encode,
     "Lease": _lease_encode,
     "ValidatingWebhookConfiguration": _vwc_encode,
 }
@@ -1396,6 +1448,7 @@ _DECODERS = {
     "ComputeDomain": _computedomain_decode,
     "ComputeDomainClique": _clique_decode,
     "ServingGroup": _servinggroup_decode,
+    "TenantQuota": _tenantquota_decode,
     "Lease": _lease_decode,
     "ValidatingWebhookConfiguration": _vwc_decode,
 }

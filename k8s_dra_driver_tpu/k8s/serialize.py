@@ -19,6 +19,7 @@ from k8s_dra_driver_tpu.k8s.objects import K8sObject
 import k8s_dra_driver_tpu.k8s.core  # noqa: F401
 import k8s_dra_driver_tpu.api.computedomain  # noqa: F401
 import k8s_dra_driver_tpu.api.servinggroup  # noqa: F401
+import k8s_dra_driver_tpu.api.tenantquota  # noqa: F401
 
 
 def _all_subclasses(cls: type) -> list[type]:

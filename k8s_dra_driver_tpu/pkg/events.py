@@ -78,6 +78,12 @@ REASON_SLO_BURN_RATE = "SLOBurnRate"
 REASON_SCALE_UP = "ScaleUp"
 REASON_SCALE_DOWN = "ScaleDown"
 REASON_SCALE_DEFERRED = "ScaleDeferred"
+# Contention plane (scheduling/: WFQ admission + checkpoint-aware
+# preemption). Messages carry no live numbers so a sustained condition
+# dedups into ONE series with a rising count.
+REASON_PREEMPTED = "Preempted"
+REASON_PREEMPTION_FAILED = "PreemptionFailed"
+REASON_QUOTA_EXCEEDED = "QuotaExceeded"
 # Elastic ComputeDomains (controller/elastic.py resize epochs)
 REASON_DOMAIN_RESIZING = "DomainResizing"
 REASON_DOMAIN_HEALED = "DomainHealed"

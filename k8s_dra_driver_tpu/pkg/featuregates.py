@@ -128,6 +128,15 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         requires=("ComputeDomainCliques",),
     ),
     FeatureSpec(
+        "ContentionPolicy", False, Stage.ALPHA,
+        "Run the multi-tenant contention plane: weighted-fair-queuing "
+        "admission over TenantQuota weights with per-tenant chip quotas "
+        "and starvation aging, plus checkpoint-aware preemption — a "
+        "higher-tier claim that parks unschedulable evicts strictly-"
+        "lower-tier victims through the owner-tagged cordon CAS and the "
+        "MigrationCheckpoint-guarded unprepare path.",
+    ),
+    FeatureSpec(
         "LiveRepack", False, Stage.ALPHA,
         "Run the online defragmentation rebalancer: migrate small-subslice "
         "claims (cordon -> checkpoint-aware unprepare -> re-place -> "

@@ -51,6 +51,32 @@ DEFAULT_BURN_THRESHOLD = 2.0
 MAX_SAMPLES_PER_SUBJECT = 8192
 MAX_SUBJECTS = 1024
 
+# Elastic-domain heal latency as a burn-rate objective: fed from the
+# same completed-epoch observations behind the
+# ``tpu_dra_resize_time_to_healed_seconds`` histogram (the elastic
+# controller's heal_observer hook). A fleet whose domains heal slower
+# than the bound burns error budget and pages like any other SLO.
+TIME_TO_HEALED_SLO = "domain-time-to-healed"
+
+
+def heal_time_objective(
+    bound_s: float = 30.0,
+    target: float = 0.95,
+    windows: Tuple[Tuple[float, float], ...] = ((120.0, 30.0),),
+    burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+) -> SLObjective:
+    """The declared time-to-healed objective (virtual seconds in the
+    sim). Heals are rare events, so the default window pair is sized
+    like the scheduler-time-to-running rule rather than the dense
+    telemetry ones; operators/tests re-declare via :meth:`SLOEvaluator.
+    add` with their own bound."""
+    return SLObjective(
+        name=TIME_TO_HEALED_SLO,
+        description="resize epochs (heal/grow/spec) complete under the "
+                    "time-to-healed bound",
+        target=target, bound=bound_s, op="gt",
+        windows=windows, burn_threshold=burn_threshold)
+
 
 @dataclass(frozen=True)
 class SLObjective:

@@ -32,7 +32,6 @@ and marks fully-idle hosts drain-ready via a Node annotation that
 from __future__ import annotations
 
 import logging
-import re
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
@@ -317,29 +316,17 @@ class RebalanceController:
                 uniq.append((profile, obj))
         return uniq, list(domains.values())
 
-    # The common CEL shape selecting a subslice profile by equality, e.g.
-    # device.attributes["tpu.google.com"].profile == "2x2". Anything more
-    # elaborate (ranges, disjunctions) is not reverse-engineered — the
-    # claim simply yields no profile target (documented limitation).
-    _CEL_PROFILE = re.compile(
-        r"""profile["'\]]*\s*==\s*["']([\w]+)["']""")
-
-    @classmethod
-    def _request_profile(cls, req) -> Optional[str]:
+    @staticmethod
+    def _request_profile(req) -> Optional[str]:
         """The placement-table profile one device request demands, or None
         when fragmentation cannot be what blocks it (plain count-based
-        single-chip requests fit any free chip)."""
+        single-chip requests fit any free chip). The selector parse is
+        shared with the contention plane (scheduling.tiers)."""
+        from k8s_dra_driver_tpu.scheduling.tiers import request_profile
+
         if req.allocation_mode == "All":
             return WHOLE_HOST
-        for sel in req.selectors:
-            key, _, value = sel.partition("=")
-            if key.strip() == "profile" and value:
-                return value.strip()
-        for expr in getattr(req, "cel_selectors", ()):
-            m = cls._CEL_PROFILE.search(expr)
-            if m:
-                return m.group(1)
-        return None
+        return request_profile(req)
 
     # -- the pass -------------------------------------------------------------
 
@@ -773,18 +760,13 @@ class RebalanceController:
     # -- cordon / rebind ------------------------------------------------------
 
     def _set_cordon(self, claims, on: bool) -> None:
-        with tracing.span("rebalance.cordon" if on else "rebalance.uncordon"):
+        """Release the unit's cordons (acquisition goes through the
+        owner-tagged try_cordon CAS only — the ``cordon-cas`` tpulint
+        rule rejects any raw annotation write on this path)."""
+        assert not on, "cordons are acquired via try_cordon only"
+        with tracing.span("rebalance.uncordon"):
             for c in claims:
-                def mutate(obj, on=on):
-                    if on:
-                        obj.meta.annotations[CORDON_ANNOTATION] = "true"
-                    else:
-                        obj.meta.annotations.pop(CORDON_ANNOTATION, None)
-                try:
-                    self.api.update_with_retry(
-                        RESOURCE_CLAIM, c.meta.name, c.namespace, mutate)
-                except NotFoundError:
-                    continue
+                release_cordon(self.api, c)
 
     def _rebind_pod(self, unit, target: str) -> None:
         """Point the consumer pod at its claims' new home. Phase drops back

@@ -52,6 +52,11 @@ class MigrationUnit:
     node: str
     claim_keys: Tuple[Tuple[str, str], ...]  # (namespace, name), sorted
     chip_mask: int                           # union over the unit's claims
+    # Effective contention tier (max over the pod and its claims, with
+    # the namespace floor applied) — 0 unless the caller supplied a
+    # ``unit_tier`` hook to build_node_views. The preemption planner
+    # never evicts a unit whose tier >= the preemptor's.
+    tier: int = 0
 
     @property
     def num_chips(self) -> int:
@@ -112,17 +117,21 @@ def profile_placeable(views: Dict[str, NodeView], profile: str) -> bool:
 
 
 def plan_profile(views: Dict[str, NodeView],
-                 profile: str) -> Optional[RepackPlan]:
+                 profile: str,
+                 rank=None) -> Optional[RepackPlan]:
     """Minimal migration set restoring one placement of ``profile``.
 
     Returns None when the profile is already placeable (nothing to do) or
     no placement can be freed by migration alone (every candidate overlaps
     a pinned chip). The chosen placement minimizes (blocking units, chips
     moved), with node-name and placement-index tie-breaks for
-    determinism."""
+    determinism. With ``rank`` (unit -> int, the preemption planner's
+    victim-priority hook) the highest rank in the blocking set leads the
+    cost tuple: a set of strictly-cheaper victims always beats a smaller
+    set containing a dearer one."""
     if profile_placeable(views, profile):
         return None
-    best: Optional[Tuple[Tuple[int, int, str, int], NodeView, int,
+    best: Optional[Tuple[Tuple[int, int, int, str, int], NodeView, int,
                          List[MigrationUnit]]] = None
     for name in sorted(views):
         view = views[name]
@@ -133,7 +142,8 @@ def plan_profile(views: Dict[str, NodeView],
             blockers = [u for u in view.units if u.chip_mask & mask]
             if not blockers:
                 continue  # free placement would have been caught above
-            cost = (len(blockers),
+            cost = (max(rank(u) for u in blockers) if rank else 0,
+                    len(blockers),
                     sum(u.num_chips for u in blockers), name, idx)
             if best is None or cost < best[0]:
                 best = (cost, view, mask, blockers)
@@ -152,7 +162,8 @@ def plan_profile(views: Dict[str, NodeView],
 def plan_domain_block(views: Dict[str, NodeView],
                       topologies: Dict[str, dict],
                       num_nodes: int,
-                      target: str = "") -> Optional[RepackPlan]:
+                      target: str = "",
+                      rank=None) -> Optional[RepackPlan]:
     """Minimal migration set vacating a contiguous host-grid block of
     ``num_nodes`` whole-host-capable hosts within one ICI domain.
 
@@ -160,8 +171,9 @@ def plan_domain_block(views: Dict[str, NodeView],
     available (no taints) and no pinned claim sits on it; among
     qualifying blocks the one with the fewest blocking units wins (ties:
     fewest chips moved, then the deterministic iter_host_blocks order).
-    Returns None when a fully-free block already exists — the scheduler
-    places the domain itself — or no block can be vacated."""
+    ``rank`` leads the cost like :func:`plan_profile`'s. Returns None
+    when a fully-free block already exists — the scheduler places the
+    domain itself — or no block can be vacated."""
     candidates = []
     for name, view in sorted(views.items()):
         if not _profile_placements(view, WHOLE_HOST):
@@ -169,7 +181,7 @@ def plan_domain_block(views: Dict[str, NodeView],
         if view.pinned_mask:
             continue  # immovable claim: block is not vacatable
         candidates.append(name)
-    best: Optional[Tuple[Tuple[int, int, int], object,
+    best: Optional[Tuple[Tuple[int, int, int, int], object,
                          List[MigrationUnit]]] = None
     for order, block in enumerate(placement_lib.iter_host_blocks(
             topologies, candidates, num_nodes)):
@@ -178,7 +190,8 @@ def plan_domain_block(views: Dict[str, NodeView],
             blockers.extend(views[node].units)
         if not blockers:
             return None  # a free block exists: nothing to repack
-        cost = (len(blockers), sum(u.num_chips for u in blockers), order)
+        cost = (max(rank(u) for u in blockers) if rank else 0,
+                len(blockers), sum(u.num_chips for u in blockers), order)
         if best is None or cost < best[0]:
             best = (cost, block, blockers)
     if best is None:
@@ -239,13 +252,16 @@ def build_node_views(
     tpu_driver_name: str,
     device_types: Dict[Tuple[str, str], str],
     is_cordoned,
+    unit_tier=None,
 ) -> Dict[str, NodeView]:
     """Assemble per-node views from the allocator's placement overview
     plus one claim/pod listing.
 
     ``device_types``: (node, device name) -> published ``type`` attribute
     (tpu/subslice/vfio/...) so passthrough devices pin their chips.
-    ``is_cordoned``: claim -> bool (the controller's cordon annotation)."""
+    ``is_cordoned``: claim -> bool (the controller's cordon annotation).
+    ``unit_tier``: optional (pod, claims) -> int stamping each unit's
+    contention tier (the preemption planner's victim-priority input)."""
     views: Dict[str, NodeView] = {
         node: NodeView(name=node, tables=entry["tables"],
                        available=entry["available"],
@@ -316,6 +332,8 @@ def build_node_views(
             claim_keys=tuple(sorted((c.meta.namespace, c.meta.name)
                                     for c, _, _ in items)),
             chip_mask=unit_mask,
+            tier=(unit_tier(pod, [c for c, _, _ in items])
+                  if unit_tier else 0),
         ))
     for view in views.values():
         view.units.sort(key=lambda u: (u.pod_namespace, u.pod_name))

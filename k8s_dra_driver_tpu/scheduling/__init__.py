@@ -1,0 +1,44 @@
+"""Multi-tenant contention plane: priority tiers, weighted fair queuing,
+and checkpoint-aware preemption.
+
+Three halves closed into one loop (docs/reference/preemption.md):
+
+- **Priority + tenancy API** — the ``TenantQuota`` kind
+  (``api/tenantquota.py``: per-namespace weight, chip quota, priority
+  floor) plus the ``priorityTier`` field on claims and pods.
+- **Weighted fair queuing in admission** — the sim scheduler's
+  dirty-batch admission orders pending work by virtual-time fair
+  queuing over tenant weights (``wfq.py``, pure), enforces per-tenant
+  chip quotas (over-quota claims park with a reason), and ages starved
+  work so a light tenant can never wait forever behind a heavy one's
+  backlog (``manager.py``).
+- **Preemption engine** — a higher-tier claim that parks unschedulable
+  triggers a planner pass that scores minimal blocking sets by victim
+  priority and checkpoints strictly-lower-tier victims out through the
+  shared ``evict_unit`` path: owner-tagged cordon CAS (owner =
+  ``preempt``), MigrationCheckpoint-guarded unprepare, requeue as
+  Pending with the tenant's WFQ accounting preserved, full rollback on
+  any mid-eviction failure (``preemption.py``).
+"""
+
+from k8s_dra_driver_tpu.scheduling.wfq import (  # noqa: F401
+    FairQueue,
+    PendingItem,
+    fair_apportion,
+    jain_index,
+)
+from k8s_dra_driver_tpu.scheduling.tiers import (  # noqa: F401
+    claim_chip_cost,
+    effective_tier,
+    profile_chips,
+    request_profile,
+)
+from k8s_dra_driver_tpu.scheduling.manager import (  # noqa: F401
+    ContentionConfig,
+    ContentionManager,
+)
+from k8s_dra_driver_tpu.scheduling.preemption import (  # noqa: F401
+    CORDON_OWNER_PREEMPT,
+    PreemptionConfig,
+    PreemptionController,
+)

@@ -1,0 +1,281 @@
+"""ContentionManager: the scheduler-side half of the contention plane.
+
+Owns the WFQ queue, the per-tenant quota/tier configuration (read from
+TenantQuota objects once per scheduler pass), the pending-wait tracking
+that drives starvation aging, and the change-gated TenantQuota status
+write-back. The sim scheduler calls:
+
+- :meth:`begin_pass` at the top of a dirty-batch pass (one TenantQuota
+  listing + per-tenant chip usage derived from the claim listing);
+- :meth:`order` to turn the dirty Pending set into the WFQ admission
+  order;
+- :meth:`quota_veto` per pod before probing nodes — an over-quota
+  tenant's pod parks unschedulable with a ``QuotaExceeded`` event
+  instead of consuming feasibility work;
+- :meth:`charge` when a pod binds (advances the tenant's virtual time);
+- :meth:`end_pass` to publish gauges and write TenantQuota status.
+
+Eviction (``preemption.py``) notifies :meth:`note_evicted` so a victim's
+aging clock restarts — the tenant's WFQ virtual time is deliberately
+NOT touched: the deficit survives requeue, which is what makes
+preemption fairness-neutral.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.api.tenantquota import TENANT_QUOTA, TenantQuota
+from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_QUOTA_EXCEEDED,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
+from k8s_dra_driver_tpu.scheduling.tiers import claim_chip_cost, effective_tier
+from k8s_dra_driver_tpu.scheduling.wfq import (
+    DEFAULT_AGING_AFTER_S,
+    FairQueue,
+    PendingItem,
+)
+
+log = logging.getLogger(__name__)
+
+_Key = Tuple[str, str]
+
+# Constant event message: a tenant pinned at its quota for an hour is
+# ONE QuotaExceeded series with a rising count, not a row per pass.
+MSG_QUOTA_EXCEEDED = ("namespace chip quota exceeded; pod parked until "
+                     "usage drops or the TenantQuota is raised")
+
+
+@dataclass
+class ContentionConfig:
+    """Policy knobs (docs/reference/preemption.md)."""
+
+    # Pending work older than this jumps every non-aged bucket.
+    aging_after_s: float = DEFAULT_AGING_AFTER_S
+    # Write TenantQuota status once per pass (change-gated).
+    status_writeback: bool = True
+
+
+class ContentionMetrics:
+    def __init__(self, registry: Registry):
+        self.admitted_total = registry.register(Counter(
+            "tpu_dra_wfq_admitted_total",
+            "Pods admitted through WFQ ordering, by tenant namespace.",
+            ("namespace",)))
+        self.parked_total = registry.register(Counter(
+            "tpu_dra_wfq_parked_total",
+            "Pods parked by per-tenant quota enforcement, by namespace.",
+            ("namespace",)))
+        self.aged_total = registry.register(Counter(
+            "tpu_dra_wfq_aged_admissions_total",
+            "Admission-order picks that went first because the item "
+            "crossed the starvation-aging threshold."))
+        self.virtual_time = registry.register(Gauge(
+            "tpu_dra_wfq_virtual_time",
+            "WFQ virtual finish time per tenant namespace (how far "
+            "ahead of the global virtual clock its admitted work sits).",
+            ("namespace",)))
+        self.pending = registry.register(Gauge(
+            "tpu_dra_wfq_pending_pods",
+            "Pending pods per tenant namespace as of the last "
+            "scheduler pass.",
+            ("namespace",)))
+
+
+class ContentionManager:
+    def __init__(self, api, metrics_registry: Optional[Registry] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 config: Optional[ContentionConfig] = None,
+                 whole_host_chips: int = 4,
+                 clock: Callable[[], float] = None):
+        self.api = api
+        self.config = config or ContentionConfig()
+        registry = metrics_registry or Registry()
+        self.metrics = ContentionMetrics(registry)
+        self.recorder = recorder or EventRecorder(
+            api, "contention", metrics_registry=registry)
+        self.clock = clock or (lambda: 0.0)
+        self.whole_host_chips = whole_host_chips
+        self.queue = FairQueue(aging_after_s=self.config.aging_after_s)
+        # Pass-scoped state refreshed by begin_pass().
+        self._quotas: Dict[str, TenantQuota] = {}
+        self._usage: Dict[str, int] = {}       # ns -> chips allocated
+        self._pending: Dict[str, int] = {}     # ns -> pending pods this pass
+        # (ns, pod) -> virtual time first seen pending; cleared on
+        # admit/delete/evict so aging measures CONTINUOUS starvation.
+        self._first_pending: Dict[_Key, float] = {}
+
+    # -- pass lifecycle -------------------------------------------------------
+
+    def refresh_quotas(self) -> None:
+        """Reload the TenantQuota config (one listing). Cheap enough to
+        run standalone — the preemption pass uses it to decide whether
+        any tiered demand can even exist before paying for the claim
+        listing."""
+        quotas: Dict[str, TenantQuota] = {}
+        for q in sorted(self.api.list(TENANT_QUOTA),
+                        key=lambda q: (q.meta.namespace, q.meta.name)):
+            # First-by-name wins when a namespace holds several.
+            quotas.setdefault(q.meta.namespace, q)
+        self._quotas = quotas
+        for ns, q in quotas.items():
+            self.queue.set_weight(ns, q.spec.weight)
+
+    def begin_pass(self, claims=None) -> None:
+        """Refresh quota/weight config and per-tenant chip usage. One
+        TenantQuota listing; ``claims`` is the caller's claim listing
+        when it already holds one (None lists here — still once per
+        pass, never per pod)."""
+        self.refresh_quotas()
+        if claims is None:
+            from k8s_dra_driver_tpu.k8s.core import RESOURCE_CLAIM
+
+            claims = self.api.list(RESOURCE_CLAIM)
+        usage: Dict[str, int] = {}
+        for c in claims:
+            if c.allocation is None:
+                continue
+            ns = c.meta.namespace
+            usage[ns] = usage.get(ns, 0) + claim_chip_cost(
+                c, self.whole_host_chips)
+        self._usage = usage
+        self._pending = {}
+
+    def end_pass(self) -> None:
+        """Publish per-tenant gauges and write TenantQuota status
+        (quantized + change-gated: a steady pass writes nothing)."""
+        for ns in set(self._quotas) | set(self._usage) | set(self._pending):
+            self.metrics.virtual_time.set(ns, value=self.queue.vtime(ns))
+            self.metrics.pending.set(
+                ns, value=float(self._pending.get(ns, 0)))
+        if not self.config.status_writeback:
+            return
+        now = self.clock()
+        for ns, q in self._quotas.items():
+            chips = int(self._usage.get(ns, 0))
+            pending = int(self._pending.get(ns, 0))
+            vtime = round(self.queue.vtime(ns), 1)
+            st = q.status
+            if (st.chips_used == chips and st.pods_pending == pending
+                    and st.virtual_time == vtime):
+                continue
+
+            def sync(obj, chips=chips, pending=pending, vtime=vtime,
+                     now=now):
+                obj.status.chips_used = chips
+                obj.status.pods_pending = pending
+                obj.status.virtual_time = vtime
+                obj.status.updated_at = now
+            try:
+                self.api.update_with_retry(
+                    TENANT_QUOTA, q.meta.name, q.meta.namespace, sync)
+            except NotFoundError:
+                continue
+
+    # -- configuration views --------------------------------------------------
+
+    def quota_for(self, namespace: str) -> Optional[TenantQuota]:
+        return self._quotas.get(namespace)
+
+    def weight_for(self, namespace: str) -> float:
+        q = self._quotas.get(namespace)
+        return q.spec.weight if q is not None else 1.0
+
+    def floor_for(self, namespace: str) -> int:
+        q = self._quotas.get(namespace)
+        return q.spec.priority_floor if q is not None else 0
+
+    def tier_of(self, pod, claims) -> int:
+        ns = pod.meta.namespace if pod is not None else ""
+        return effective_tier(pod, claims, self.floor_for(ns))
+
+    # -- admission ordering ---------------------------------------------------
+
+    def order(self, pods: List, now: float,
+              cost_of: Callable[[object], float],
+              claims_of: Optional[Callable[[object], list]] = None,
+              ) -> List[_Key]:
+        """WFQ admission order for one dirty batch of Pending pods.
+        ``cost_of`` estimates a pod's chip cost and ``claims_of``
+        resolves its already-existing claims (the cluster resolves
+        claim templates — this module never re-implements that); claim-
+        declared tiers count toward the ordering tier when resolvable."""
+        items: List[PendingItem] = []
+        for pod in pods:
+            key = (pod.meta.namespace, pod.meta.name)
+            first = self._first_pending.setdefault(key, now)
+            self._pending[pod.meta.namespace] = (
+                self._pending.get(pod.meta.namespace, 0) + 1)
+            items.append(PendingItem(
+                tenant=pod.meta.namespace,
+                key=key,
+                cost=max(0.0, float(cost_of(pod))),
+                tier=self.tier_of(
+                    pod, claims_of(pod) if claims_of is not None else ()),
+                waited_s=max(0.0, now - first),
+            ))
+        ordered = self.queue.order(items)
+        for it in ordered:
+            if self.queue.aged(it):
+                self.metrics.aged_total.inc()
+        return [it.key for it in ordered]
+
+    # -- quota enforcement ----------------------------------------------------
+
+    def quota_blocked(self, pod, claims) -> bool:
+        """Pure check (no events/metrics): would admitting this pod's
+        not-yet-allocated claims push its tenant over the chip quota?
+        The preemption engine uses this to skip quota-blocked demand —
+        evicting victims for chips the quota forbids using is waste."""
+        ns = pod.meta.namespace
+        q = self._quotas.get(ns)
+        if q is None or q.spec.chip_quota <= 0:
+            return False
+        demand = sum(claim_chip_cost(c, self.whole_host_chips)
+                     for c in claims if c.allocation is None)
+        return self._usage.get(ns, 0) + demand > q.spec.chip_quota
+
+    def quota_veto(self, pod, claims) -> Optional[str]:
+        """None when the pod fits its tenant's chip quota; otherwise a
+        human reason (the pod parks unschedulable). Counts only the
+        pod's not-yet-allocated claims — an allocated claim is already
+        in the usage baseline."""
+        if not self.quota_blocked(pod, claims):
+            return None
+        ns = pod.meta.namespace
+        q = self._quotas[ns]
+        demand = sum(claim_chip_cost(c, self.whole_host_chips)
+                     for c in claims if c.allocation is None)
+        used = self._usage.get(ns, 0)
+        self.metrics.parked_total.inc(ns)
+        self.recorder.warning(pod, REASON_QUOTA_EXCEEDED, MSG_QUOTA_EXCEEDED)
+        return (f"tenant {ns!r} over chip quota: {used} used + {demand} "
+                f"requested > {q.spec.chip_quota} allowed")
+
+    # -- accounting -----------------------------------------------------------
+
+    def charge(self, pod, newly_allocated_chips: float) -> None:
+        """A pod bound: advance its tenant's virtual time by the chips
+        this pass actually allocated for it, fold the chips into the
+        pass usage (quota sees in-pass commitments), and clear its
+        aging clock."""
+        ns = pod.meta.namespace
+        self.queue.charge(ns, newly_allocated_chips)
+        self._usage[ns] = (self._usage.get(ns, 0)
+                           + int(newly_allocated_chips))
+        self._first_pending.pop((ns, pod.meta.name), None)
+        self.metrics.admitted_total.inc(ns)
+
+    def note_evicted(self, key: _Key) -> None:
+        """A preemption victim requeued: its aging clock restarts (it
+        just received service), but the tenant's WFQ virtual time is
+        NOT rolled back — the deficit is preserved across eviction."""
+        self._first_pending.pop(key, None)
+
+    def note_gone(self, key: _Key) -> None:
+        self._first_pending.pop(key, None)

@@ -1,0 +1,631 @@
+"""PreemptionController: checkpoint-aware eviction for higher-tier demand.
+
+The third half of the contention plane. When a claim with an effective
+priority tier above its would-be victims parks unschedulable (no free
+placement / no contiguous host block), this controller plans the
+minimal *blocking set by victim priority* over the same bitmask node
+views the rebalancer uses, and evicts each victim unit through the
+generalized ``evict_unit`` path — the rebalancer's migration unit with
+the re-place half replaced by a requeue:
+
+    owner-tagged cordon CAS (owner="preempt")
+    -> checkpoint-aware unprepare on the source
+       (DeviceState.migrate_out: state fsync'd BEFORE any release, so a
+       crash can never leak an ICI partition)
+    -> requeue the pod as Pending (node cleared) with its claims
+       deallocated — the tenant's WFQ virtual time is preserved, so
+       eviction is fairness-neutral
+    -> close the MigrationCheckpoint entries -> uncordon.
+
+Any mid-eviction failure rolls back to the exact prior placement: the
+source re-prepare clears the MigrationCheckpoint entries and re-carves
+the original partitions, the allocations are restored verbatim, and the
+pod stays bound where it was.
+
+Victim selection invariants (docs/reference/preemption.md):
+
+- a unit is evictable only when its effective tier is STRICTLY below
+  the preemptor's — equal-or-higher tiers are untouchable;
+- assembled ComputeDomains are untouchable by construction (their
+  workers carry channel claims, which pin the unit in the shared
+  planner's movability rules);
+- units cordoned by ANY owner (an in-flight rebalancer migration, a
+  resize epoch, an autoscaler drain) are excluded — and symmetrically,
+  those actors' planners skip units cordoned ``preempt``.
+
+Evictions are budgeted (per-pass cap + token bucket), per-unit retries
+are paced by ``pkg/backoff``, and the controller narrates through
+``Preempted`` / ``PreemptionFailed`` events.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from k8s_dra_driver_tpu.api.configs import (
+    TPU_DRIVER_NAME,
+    channel_domain_uid,
+)
+from k8s_dra_driver_tpu.k8s.conditions import CONDITION_FALSE, set_condition
+from k8s_dra_driver_tpu.k8s.core import (
+    CLAIM_COND_ALLOCATED,
+    COMPUTE_DOMAIN,
+    POD,
+    RESOURCE_CLAIM,
+)
+from k8s_dra_driver_tpu.k8s.objects import NotFoundError
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.backoff import Backoff, BackoffMetrics
+from k8s_dra_driver_tpu.pkg.events import (
+    EventRecorder,
+    REASON_PREEMPTED,
+    REASON_PREEMPTION_FAILED,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
+from k8s_dra_driver_tpu.rebalancer.controller import (
+    CORDON_ANNOTATION,
+    release_cordon,
+    try_cordon,
+)
+from k8s_dra_driver_tpu.rebalancer.planner import (
+    WHOLE_HOST,
+    NodeView,
+    build_node_views,
+    plan_domain_block,
+    plan_profile,
+    profile_placeable,
+)
+from k8s_dra_driver_tpu.scheduling.tiers import request_profile
+
+log = logging.getLogger(__name__)
+
+# Owner tag for the atomic cordon CAS — distinct from "rebalancer",
+# "autoscaler", and "resize", so of the actor roles racing on one claim
+# exactly one wins (same-owner re-acquisition is this controller's
+# crash-resume path).
+CORDON_OWNER_PREEMPT = "preempt"
+
+# Constant messages: a victim evicted (or an eviction failing) twice
+# dedups into one Event series with a rising count.
+MSG_PREEMPTED = ("claim checkpointed out and requeued by the preemption "
+                 "engine to admit higher-priority demand")
+
+
+@dataclass
+class PreemptionConfig:
+    """Policy knobs (docs/reference/preemption.md)."""
+
+    # Hard cap on victim units evicted in one pass.
+    max_evictions_per_pass: int = 8
+    # Token bucket across passes: a tier storm cannot turn the
+    # preemption engine into its own churn storm.
+    eviction_burst: int = 32
+    eviction_refill_per_s: float = 2.0
+    # Per-unit retry pacing after a failed/rolled-back eviction.
+    retry_backoff_base_s: float = 2.0
+    retry_backoff_cap_s: float = 60.0
+
+
+class PreemptionMetrics:
+    def __init__(self, registry: Registry):
+        self.preemptions_total = registry.register(Counter(
+            "tpu_dra_preemptions_total",
+            "Victim-unit evictions attempted, by outcome "
+            "(evicted / failed — failed includes rolled-back).",
+            ("outcome",)))
+        self.victim_chips_total = registry.register(Counter(
+            "tpu_dra_preemption_victim_chips_total",
+            "Chips freed by completed evictions."))
+        self.deferred_total = registry.register(Counter(
+            "tpu_dra_preemption_deferred_total",
+            "Planned evictions deferred by the per-pass cap or the "
+            "token-bucket budget."))
+        self.last_pass = registry.register(Gauge(
+            "tpu_dra_preemption_last_pass_evictions",
+            "Victim units evicted by the last preemption pass "
+            "(0 when no higher-tier demand was parked)."))
+
+
+class PreemptionController:
+    """``plugin_resolver(node_name)`` returns the node's TpuDriver (the
+    object exposing prepare_resource_claims / migrate_claim_out /
+    migrate_claim_end), or None for unknown/down nodes — the same seam
+    the rebalancer and the elastic orchestrator use. ``manager`` is the
+    ContentionManager supplying tiers, quotas, and the WFQ bookkeeping
+    hooks."""
+
+    def __init__(
+        self,
+        api,
+        allocator,
+        plugin_resolver: Callable[[str], object],
+        manager,
+        config: Optional[PreemptionConfig] = None,
+        metrics_registry: Optional[Registry] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.api = api
+        self.allocator = allocator
+        self.resolve_plugin = plugin_resolver
+        self.manager = manager
+        self.config = config or PreemptionConfig()
+        registry = metrics_registry or Registry()
+        self.metrics = PreemptionMetrics(registry)
+        self.recorder = EventRecorder(api, "preemption",
+                                      metrics_registry=registry)
+        self.clock = clock
+        self._tokens = float(self.config.eviction_burst)
+        self._tokens_at = clock()
+        self.retry_backoff = Backoff(
+            base=self.config.retry_backoff_base_s,
+            cap=self.config.retry_backoff_cap_s,
+            jitter=0.2, clock=clock,
+            metrics=BackoffMetrics(registry), source="preemption")
+
+    # Crash-injection seam (tests raise from here to simulate the
+    # controller dying mid-eviction; same shape as the plugins' hooks).
+    fault_hook: Optional[Callable[[str], None]] = None
+
+    def _fire_fault(self, point: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(point)
+
+    # -- budget ---------------------------------------------------------------
+
+    def _take_token(self) -> bool:
+        now = self.clock()
+        self._tokens = min(
+            float(self.config.eviction_burst),
+            self._tokens + max(0.0, now - self._tokens_at)
+            * self.config.eviction_refill_per_s)
+        self._tokens_at = now
+        if self._tokens < 1.0:
+            return False
+        self._tokens -= 1.0
+        return True
+
+    # -- the pass -------------------------------------------------------------
+
+    def step(self) -> int:
+        """One preemption pass; returns how many victim units were
+        evicted. One claim + pod + domain listing per pass."""
+        with tracing.span("preempt.pass") as sp:
+            pods_by_uid = {p.uid: p for p in self.api.list(POD)}
+            self.manager.refresh_quotas()
+            # Cheap pre-gate: tiered demand needs a Pending pod that is
+            # tiered by its own spec or its namespace floor. Without
+            # one, skip the claim listing + view build entirely — the
+            # common quiet-cluster (and pure-WFQ) case. Documented
+            # asymmetry: a tier declared ONLY on a claim still protects
+            # it as a victim and raises the pod's effective tier inside
+            # the full pass, but does not by itself trigger one — tier
+            # the pod or the namespace floor to demand preemption
+            # (docs/reference/preemption.md).
+            if not any(p.phase == "Pending"
+                       and (p.priority_tier > 0
+                            or self.manager.floor_for(p.meta.namespace) > 0)
+                       for p in pods_by_uid.values()):
+                self.metrics.last_pass.set(value=0.0)
+                return 0
+            claims = list(self.api.list(RESOURCE_CLAIM))
+            self.manager.begin_pass(claims)
+            demand = self._demand_targets(claims, pods_by_uid)
+            if not demand:
+                self.metrics.last_pass.set(value=0.0)
+                return 0
+            overview = self.allocator.placement_overview(TPU_DRIVER_NAME)
+            device_types = {
+                (node, name): t
+                for node, entry in overview.items()
+                for name, t in entry["dev_type"].items()
+            }
+            views = build_node_views(
+                overview, claims, pods_by_uid, TPU_DRIVER_NAME, device_types,
+                is_cordoned=lambda c: CORDON_ANNOTATION in c.meta.annotations,
+                unit_tier=self.manager.tier_of,
+            )
+            evicted = 0
+            budget = self.config.max_evictions_per_pass
+            rank = (lambda u: u.tier)
+            # Highest-tier demand plans first. Consumption (evicted
+            # units removed, freed placements marked reserved for their
+            # preemptor) is applied to BOTH the per-target filtered
+            # copies and the shared base views, so a storm of k
+            # same-shape pending claims frees k distinct placements in
+            # one pass AND a later target can never double-count a spot
+            # an earlier target reserved or re-plan its victims.
+            for tier, kind, payload, involved in sorted(
+                    demand, key=lambda d: (-d[0], str(d[3]))):
+                if evicted >= budget:
+                    break
+                filtered = self._filter_views(views, tier)
+                if kind == "profile":
+                    profile, count = payload
+                    remaining = count
+                    while remaining > 0 and evicted < budget:
+                        spot = self._reserve_free_placement(
+                            filtered, profile)
+                        if spot is not None:
+                            # A free placement already exists (or one
+                            # just got freed): that pending claim needs
+                            # no eviction — reserve it for them.
+                            node, mask = spot
+                            views[node].used_mask |= mask
+                            remaining -= 1
+                            continue
+                        plan = plan_profile(filtered, profile, rank=rank)
+                        if plan is None:
+                            break  # nothing evictable for this shape
+                        got = self._execute(plan, budget - evicted)
+                        evicted += got
+                        if got < len(plan.units):
+                            break  # stuck or out of budget mid-plan
+                        self._consume_plan(filtered, plan)
+                        self._consume_plan(views, plan)
+                        remaining -= 1
+                else:
+                    num_nodes, cd = payload
+                    plan = plan_domain_block(
+                        filtered, self.allocator.node_topologies(),
+                        num_nodes, rank=rank,
+                        target=f"host block for ComputeDomain {cd.key} "
+                               f"({num_nodes} nodes)")
+                    got = self._execute(plan, budget - evicted)
+                    evicted += got
+                    if plan is not None and got == len(plan.units):
+                        self._consume_plan(views, plan)
+            sp.attrs["evicted"] = evicted
+            self.metrics.last_pass.set(value=float(evicted))
+            return evicted
+
+    @staticmethod
+    def _reserve_free_placement(views: Dict[str, NodeView],
+                                profile: str):
+        """Mark one currently-free placement of ``profile`` as used in
+        the given views and return ``(node, mask)`` (None when no free
+        placement exists) — the accounting that stops one pending
+        claim's free spot from being counted against every other
+        pending claim of the same shape. Callers mirror the mark into
+        the base views."""
+        if not profile_placeable(views, profile):
+            return None
+        for name in sorted(views):
+            view = views[name]
+            if profile == WHOLE_HOST:
+                indices = (view.tables.whole_host_index,)
+            else:
+                indices = view.tables.by_profile.get(profile, ())
+            for idx in indices:
+                if not (view.available >> idx) & 1:
+                    continue
+                mask = view.tables.placements[idx].mask
+                if not (mask & view.used_mask):
+                    view.used_mask |= mask
+                    return name, mask
+        return None
+
+    @staticmethod
+    def _consume_plan(views: Dict[str, NodeView], plan) -> None:
+        """Fold an executed plan into a view dict: evicted units vanish
+        (their chips free), and the freed placement reads as used —
+        reserved for the preemptor it was freed for."""
+        named = {(u.pod_namespace, u.pod_name) for u in plan.units}
+        for node in plan.nodes:
+            view = views.get(node)
+            if view is None:
+                continue
+            for u in list(view.units):
+                if (u.pod_namespace, u.pod_name) in named:
+                    view.units.remove(u)
+                    view.used_mask &= ~u.chip_mask
+            if plan.placement_mask:
+                view.used_mask |= plan.placement_mask
+            else:
+                # Domain-block plans reserve the whole host.
+                view.used_mask |= view.tables.placements[
+                    view.tables.whole_host_index].mask
+
+    # -- demand detection -----------------------------------------------------
+
+    def _demand_targets(self, all_claims, pods_by_uid):
+        """Parked higher-tier demand: Pending pods whose claims cannot
+        allocate, with an effective tier above zero (tier-0 demand never
+        preempts — victims must be STRICTLY lower). Over-quota tenants
+        are skipped: their pods are blocked by policy, not capacity, and
+        evicting for them would free chips the quota forbids using.
+        Returns [(tier, kind, payload, involved)]."""
+        targets = []
+        domains_by_uid = {cd.uid: cd
+                          for cd in self.api.list(COMPUTE_DOMAIN)}
+        claims_by_key = {(c.meta.namespace, c.meta.name): c
+                         for c in all_claims}
+        # (tier, profile) -> [count, first involved claim]: a storm of k
+        # same-shape pending claims is ONE target that frees k
+        # placements, not k passes.
+        profiles: Dict[Tuple[int, str], list] = {}
+        seen_domains: Set[str] = set()
+        for pod in pods_by_uid.values():
+            if pod.phase != "Pending":
+                continue
+            claims = []
+            for ref in pod.resource_claims:
+                name = (ref.resource_claim_name
+                        or f"{pod.meta.name}-{ref.name}")
+                c = claims_by_key.get((pod.meta.namespace, name))
+                if c is not None:
+                    claims.append(c)
+            if not claims or all(c.allocation is not None for c in claims):
+                continue
+            tier = self.manager.tier_of(pod, claims)
+            if tier <= 0:
+                continue
+            if self.manager.quota_blocked(pod, claims):
+                continue
+            cd = None
+            for c in claims:
+                uid = channel_domain_uid(c)
+                if uid:
+                    cd = domains_by_uid.get(uid)
+                    break
+            if cd is not None and cd.spec.num_nodes > 1:
+                if cd.uid not in seen_domains:
+                    seen_domains.add(cd.uid)
+                    targets.append(
+                        (tier, "domain", (cd.spec.num_nodes, cd), cd))
+                continue
+            for c in claims:
+                if c.allocation is not None:
+                    continue
+                for req in c.requests:
+                    profile = (WHOLE_HOST if req.allocation_mode == "All"
+                               else request_profile(req))
+                    if profile is None:
+                        continue  # count-based: fragmentation-free shape
+                    entry = profiles.setdefault((tier, profile), [0, c])
+                    # A count=k profile request needs k placements, not
+                    # one (mode=All carries no count).
+                    entry[0] += (1 if req.allocation_mode == "All"
+                                 else max(1, req.count))
+        for (tier, profile), (count, involved) in profiles.items():
+            targets.append((tier, "profile", (profile, count), involved))
+        return targets
+
+    # -- tier filtering -------------------------------------------------------
+
+    @staticmethod
+    def _filter_views(views: Dict[str, NodeView],
+                      preemptor_tier: int) -> Dict[str, NodeView]:
+        """Per-target copies where equal-or-higher-tier units are
+        immovable: their chips fold into the pinned mask, so no plan can
+        ever name them as victims."""
+        out: Dict[str, NodeView] = {}
+        for name, v in views.items():
+            evictable = [u for u in v.units if u.tier < preemptor_tier]
+            pinned = v.pinned_mask
+            for u in v.units:
+                if u.tier >= preemptor_tier:
+                    pinned |= u.chip_mask
+            out[name] = NodeView(
+                name=v.name, tables=v.tables, available=v.available,
+                used_mask=v.used_mask, pinned_mask=pinned, units=evictable)
+        return out
+
+    # -- plan execution -------------------------------------------------------
+
+    def _execute(self, plan, budget: int) -> int:
+        if plan is None or not plan.units or budget <= 0:
+            return 0
+        evicted = 0
+        for i, unit in enumerate(plan.units):
+            if evicted >= budget:
+                self.metrics.deferred_total.inc(
+                    by=float(len(plan.units) - i))
+                break
+            outcome = self._evict_unit(unit)
+            if outcome == "no-token":
+                self.metrics.deferred_total.inc(
+                    by=float(len(plan.units) - i))
+                break
+            if outcome == "evicted":
+                evicted += 1
+            else:
+                # One stuck victim means this placement cannot be freed
+                # this pass; don't churn the remaining units for nothing.
+                break
+        return evicted
+
+    def _evict_unit(self, unit) -> str:
+        retry_key = (unit.pod_namespace, unit.pod_name)
+        if not self.retry_backoff.ready(retry_key):
+            return "skip"  # failed recently: wait out the backoff
+        outcome = self._evict_unit_inner(unit)
+        if outcome == "failed":
+            self.retry_backoff.failure(retry_key)
+        elif outcome == "evicted":
+            self.retry_backoff.reset(retry_key)
+        return outcome
+
+    def _evict_unit_inner(self, unit) -> str:
+        with tracing.span("preempt.evict",
+                          pod=f"{unit.pod_namespace}/{unit.pod_name}",
+                          source=unit.node) as sp:
+            claims = []
+            for ns, name in unit.claim_keys:
+                c = self.api.try_get(RESOURCE_CLAIM, name, ns)
+                if (c is None or c.allocation is None
+                        or c.allocation.node_name != unit.node):
+                    return "skip"  # stale plan: the world moved on
+                claims.append(c)
+            pod = self.api.try_get(POD, unit.pod_name, unit.pod_namespace)
+            if pod is None or pod.node_name != unit.node:
+                return "skip"
+            src_plugin = self.resolve_plugin(unit.node)
+            if src_plugin is None:
+                return "skip"
+            # Atomic cordon BEFORE the budget token, exactly like the
+            # rebalancer: losing any claim means another role owns part
+            # of the unit — back off whole, costing neither.
+            acquired = []
+            for c in claims:
+                if try_cordon(self.api, c, owner=CORDON_OWNER_PREEMPT):
+                    acquired.append(c)
+                    continue
+                for got in acquired:
+                    release_cordon(self.api, got)
+                return "skip"
+            if not self._take_token():
+                for got in acquired:
+                    release_cordon(self.api, got)
+                return "no-token"
+            sp.attrs["chips"] = unit.num_chips
+            try:
+                ok = self._evict(unit, claims, src_plugin)
+            except Exception:  # noqa: BLE001 — one bad unit must not kill the pass
+                # _evict is rollback-safe internally; anything reaching
+                # here escaped its guarded windows. Count it failed and
+                # let the pass continue — the next pass's refetch plus
+                # checkpoint recovery own any residue.
+                log.exception("eviction of %s/%s failed unexpectedly",
+                              unit.pod_namespace, unit.pod_name)
+                self._release(claims)
+                self.metrics.preemptions_total.inc("failed")
+                return "failed"
+            return "evicted" if ok else "failed"
+
+    # -- the eviction itself --------------------------------------------------
+
+    def _evict(self, unit, claims, src_plugin) -> bool:
+        """checkpoint-aware unprepare -> requeue pod -> deallocate ->
+        close checkpoint entries -> uncordon, rolling back to the exact
+        source placement on any failure."""
+        old_allocs = {c.uid: c.allocation for c in claims}
+        migrated_out: List[str] = []
+        with tracing.span("preempt.unprepare", node=unit.node):
+            try:
+                for c in claims:
+                    src_plugin.migrate_claim_out(c.uid)
+                    migrated_out.append(c.uid)
+            except Exception as e:  # noqa: BLE001 — roll straight back
+                log.warning("migrate_out of %s failed: %s",
+                            unit.pod_name, e)
+                self._restore_source(unit, claims, src_plugin)
+                self._record_failure(claims, unit,
+                                     f"source unprepare: {e}")
+                self._release(claims)
+                return False
+        try:
+            self._fire_fault("quiesced")
+            # Requeue FIRST, deallocate after: a crash between the two
+            # leaves a Pending pod whose still-allocated claims steer it
+            # back to its source node — a benign revert the ordinary
+            # scheduler/kubelet loop completes (re-prepare clears the
+            # MigrationCheckpoint entries), never a stranded pod.
+            self._requeue_pod(unit)
+            for c in claims:
+                def clear(obj):
+                    obj.allocation = None
+                    set_condition(obj.conditions, CLAIM_COND_ALLOCATED,
+                                  CONDITION_FALSE, "Preempted",
+                                  "deallocated by the preemption engine")
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, clear)
+                except NotFoundError:
+                    continue
+        except Exception as e:  # noqa: BLE001 — source already unprepared: ANY escape must restore it
+            log.exception("unexpected error mid-eviction of %s/%s",
+                          unit.pod_namespace, unit.pod_name)
+            self._rollback(unit, claims, old_allocs, src_plugin,
+                           f"unexpected mid-eviction error: {e}")
+            return False
+        # Past this point the eviction HAS succeeded: the closing steps
+        # are individually best-effort, mirroring the rebalancer's
+        # post-success discipline.
+        for uid in migrated_out:
+            try:
+                src_plugin.migrate_claim_end(uid)
+            except Exception:  # noqa: BLE001 — benign residue: the entry holds no devices and clears on the next prepare/unprepare/restart
+                log.exception("migrate_claim_end(%s) on %s failed", uid,
+                              unit.node)
+        self._release(claims)
+        if self.manager is not None:
+            self.manager.note_evicted((unit.pod_namespace, unit.pod_name))
+        for c in claims:
+            self.recorder.warning(c, REASON_PREEMPTED, MSG_PREEMPTED)
+        self.metrics.preemptions_total.inc("evicted")
+        self.metrics.victim_chips_total.inc(by=float(unit.num_chips))
+        return True
+
+    def _requeue_pod(self, unit) -> None:
+        """Drop the victim pod back to Pending with no node: the
+        scheduler re-places it wherever room exists, ordered by its
+        tenant's PRESERVED WFQ position (aging restarts — it just ran)."""
+        with tracing.span("preempt.requeue", pod=unit.pod_name):
+            def mutate(obj):
+                obj.node_name = ""
+                obj.phase = "Pending"
+                obj.ready = False
+            try:
+                self.api.update_with_retry(
+                    POD, unit.pod_name, unit.pod_namespace, mutate)
+            except NotFoundError:
+                pass
+
+    # -- rollback -------------------------------------------------------------
+
+    def _rollback(self, unit, claims, old_allocs, src_plugin,
+                  why: str) -> None:
+        """Mid-eviction failure: restore the SOURCE placement exactly —
+        allocations verbatim, pod bound back, source re-prepare clearing
+        the MigrationCheckpoint entries and re-carving the original
+        partitions."""
+        with tracing.span("preempt.rollback", pod=unit.pod_name):
+            for c in claims:
+                def restore(obj, alloc=old_allocs.get(c.uid)):
+                    obj.allocation = alloc
+                try:
+                    self.api.update_with_retry(
+                        RESOURCE_CLAIM, c.meta.name, c.namespace, restore)
+                except NotFoundError:
+                    continue
+            self._restore_source(unit, claims, src_plugin)
+
+            def rebind(obj, node=unit.node):
+                obj.node_name = node
+                obj.phase = "Pending"  # kubelet re-prepares, then Running
+                obj.ready = False
+            try:
+                self.api.update_with_retry(
+                    POD, unit.pod_name, unit.pod_namespace, rebind)
+            except NotFoundError:
+                pass
+        self._record_failure(claims, unit, why)
+        self._release(claims)
+
+    def _restore_source(self, unit, claims, src_plugin) -> None:
+        """Re-prepare the claims on their source node; the prepare path
+        clears MigrationCheckpoint entries, so after this the checkpoint
+        and the partition ledger read exactly as before the eviction."""
+        fresh = [self.api.try_get(RESOURCE_CLAIM, c.meta.name, c.namespace)
+                 for c in claims]
+        results = src_plugin.prepare_resource_claims(
+            [c for c in fresh if c is not None])
+        for uid, r in results.items():
+            if isinstance(r, Exception):
+                log.error("rollback re-prepare of %s on %s failed: %s",
+                          uid, unit.node, r)
+
+    def _record_failure(self, claims, unit, why: str) -> None:
+        for c in claims:
+            self.recorder.warning(
+                c, REASON_PREEMPTION_FAILED,
+                f"eviction off {unit.node} failed; claim rolled back to "
+                f"its source placement: {why}")
+        self.metrics.preemptions_total.inc("failed")
+
+    def _release(self, claims) -> None:
+        for c in claims:
+            release_cordon(self.api, c)

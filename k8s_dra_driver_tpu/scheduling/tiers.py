@@ -1,0 +1,81 @@
+"""Tier and chip-cost helpers shared across the contention plane.
+
+Dependency-light on purpose: the rebalancer's demand detector imports
+:func:`request_profile` from here (one copy of the CEL profile-equality
+reverse-parse), and this module never imports the rebalancer or the
+scheduling controllers — so the import graph stays acyclic.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+# The common CEL shape selecting a subslice profile by equality, e.g.
+# device.attributes["tpu.google.com"].profile == "2x2". Anything more
+# elaborate (ranges, disjunctions) is not reverse-engineered — the
+# request simply yields no profile (documented limitation).
+_CEL_PROFILE = re.compile(r"""profile["'\]]*\s*==\s*["']([\w]+)["']""")
+
+
+def request_profile(req) -> Optional[str]:
+    """The subslice profile one device request demands via the common
+    selector shapes (legacy ``profile=2x2`` or the CEL equality), or
+    None when the request is count-based."""
+    if req.allocation_mode == "All":
+        return None  # whole host: callers handle mode=All themselves
+    for sel in req.selectors:
+        key, _, value = sel.partition("=")
+        if key.strip() == "profile" and value:
+            return value.strip()
+    for expr in getattr(req, "cel_selectors", ()):
+        m = _CEL_PROFILE.search(expr)
+        if m:
+            return m.group(1)
+    return None
+
+
+def profile_chips(profile: str) -> int:
+    """Chip area of a subslice profile string ("2x2" -> 4); 1 for the
+    empty/unparseable profile."""
+    if not profile:
+        return 1
+    out = 1
+    for d in profile.lower().split("x"):
+        try:
+            out *= max(1, int(d))
+        except ValueError:
+            return 1
+    return out
+
+
+def claim_chip_cost(claim, whole_host_chips: int) -> int:
+    """Chips one claim will consume once allocated — the WFQ service
+    cost and the quota unit. mode=All counts the whole host; profile
+    requests their area; plain requests their device count. Channel /
+    daemon requests (no chips) cost 0 via count only when count-based.
+    """
+    total = 0
+    for req in claim.requests:
+        if req.allocation_mode == "All":
+            total += max(1, whole_host_chips)
+            continue
+        profile = request_profile(req)
+        if profile is not None:
+            total += profile_chips(profile) * max(1, req.count)
+        else:
+            total += max(0, req.count)
+    return total
+
+
+def effective_tier(pod, claims, floor: int = 0) -> int:
+    """The contention tier admission and preemption act on: the max of
+    the pod's declared tier, every claim's declared tier, and the
+    namespace's TenantQuota priority floor. A workload can raise itself
+    above its namespace floor, never demote below it."""
+    tier = max(0, int(floor))
+    if pod is not None:
+        tier = max(tier, int(getattr(pod, "priority_tier", 0)))
+    for c in claims or ():
+        tier = max(tier, int(getattr(c, "priority_tier", 0)))
+    return tier

@@ -136,15 +136,16 @@ UNION_ENV_KEYS = {"TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES"}
 
 # Kinds whose watch streams drive the dirty sets. RESOURCE_SLICE /
 # RESOURCE_CLAIM_TEMPLATE / DEVICE_CLASS events carry no per-object work of
-# their own but mean previously-unschedulable pods may now fit.
+# their own but mean previously-unschedulable pods may now fit;
+# TenantQuota edits (a raised chip quota, a weight change) do too.
 _WATCHED_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
-                  RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS)
+                  RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS, "TenantQuota")
 
 # Kinds whose fingerprints define "nothing is moving" for settle()/
 # wait_for(): everything the control loops read or write.
 _QUIESCENCE_KINDS = (POD, RESOURCE_CLAIM, DAEMON_SET, NODE, RESOURCE_SLICE,
                      RESOURCE_CLAIM_TEMPLATE, COMPUTE_DOMAIN,
-                     COMPUTE_DOMAIN_CLIQUE, "ServingGroup")
+                     COMPUTE_DOMAIN_CLIQUE, "ServingGroup", "TenantQuota")
 
 _PodKey = Tuple[str, str]  # (namespace, name)
 
@@ -221,6 +222,8 @@ class SimCluster:
         rebalancer_config=None,
         persist_dir: Optional[str] = None,
         elastic_config=None,
+        contention_config=None,
+        preemption_config=None,
     ):
         """``loopback_agents=True`` registers slice agents with their real
         harness address (127.0.0.1 — everything runs in this process), so
@@ -420,6 +423,7 @@ class SimCluster:
         self._install_device_classes()
         lib_probe = MockTpuLib(profile, worker_id=0)
         self._profile_hosts = lib_probe.profile.num_hosts
+        self._host_chips = len(lib_probe.enumerate().chips)
         n = num_hosts if num_hosts is not None else self._profile_hosts
         if n % self._profile_hosts:
             raise ValueError(
@@ -429,6 +433,48 @@ class SimCluster:
             )
         for w in range(n):
             self._add_node(f"tpu-node-{w}", w)
+        # -- contention plane (ContentionPolicy gate / explicit configs):
+        # WFQ admission ordering + per-tenant quotas in the scheduler
+        # pass, plus the checkpoint-aware preemption engine. Constructed
+        # last: the manager's chip costing needs the probed host size.
+        self.contention = None
+        self.preemption = None
+        if (contention_config is not None or preemption_config is not None
+                or self.gates.enabled("ContentionPolicy")):
+            from k8s_dra_driver_tpu.scheduling import (
+                ContentionManager,
+                PreemptionController,
+            )
+
+            self.contention = ContentionManager(
+                self.api, metrics_registry=self.metrics_registry,
+                recorder=self.sched_recorder,
+                config=contention_config,
+                whole_host_chips=self._host_chips,
+                clock=lambda: self.sim_time,
+            )
+            self.preemption = PreemptionController(
+                api=self.api,
+                allocator=self.allocator,
+                plugin_resolver=self._resolve_tpu_plugin,
+                manager=self.contention,
+                config=preemption_config,
+                metrics_registry=self.metrics_registry,
+                clock=lambda: self.sim_time,
+            )
+        # Satellite loop closures wired once everything exists: the
+        # elastic orchestrator's heal latency feeds the SLO plane, and
+        # the serving autoscaler's multi-group scale-up apportions fleet
+        # headroom by tenant weight instead of first-writer-wins.
+        if self.elastic is not None and self.slo is not None:
+            from k8s_dra_driver_tpu.pkg.slo import heal_time_objective
+
+            self.slo.add(heal_time_objective())
+            self.elastic.heal_observer = self._observe_heal
+        if self.autoscaler is not None:
+            self.autoscaler.headroom_fn = self._fleet_free_chips
+            if self.contention is not None:
+                self.autoscaler.tenant_weight_fn = self.contention.weight_for
 
     # -- bootstrap -------------------------------------------------------------
 
@@ -598,6 +644,10 @@ class SimCluster:
                 self._kubelet_dirty.discard(key)
                 self._pods_seen_running.discard(obj.uid)
                 self._pod_first_seen_tick.pop(obj.uid, None)
+                if self.contention is not None:
+                    # Drop the WFQ aging clock: a deleted-then-recreated
+                    # name must not inherit the old pod's starvation.
+                    self.contention.note_gone(key)
                 return
             if self.slo is not None:
                 self._pod_first_seen_tick.setdefault(
@@ -623,6 +673,10 @@ class SimCluster:
             else:
                 self._sched_dirty.discard(key)
                 self._sched_backlog.discard(key)
+                if self.contention is not None:
+                    # Left Pending (bound/failed): the aging clock ends;
+                    # a future requeue starts a fresh wait.
+                    self.contention.note_gone(key)
             if obj.node_name and obj.phase not in ("Running", "Failed"):
                 self._kubelet_dirty.add(key)
             elif obj.phase in ("Running", "Failed"):
@@ -642,8 +696,10 @@ class SimCluster:
             self._chaos_dirty = True
             self._ds_dirty = True
             self._retry_backlog()
-        elif kind in (RESOURCE_SLICE, RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS):
-            # Capacity / matching rules changed: unschedulable pods may fit.
+        elif kind in (RESOURCE_SLICE, RESOURCE_CLAIM_TEMPLATE, DEVICE_CLASS,
+                      "TenantQuota"):
+            # Capacity / matching rules / tenant quotas changed:
+            # unschedulable (incl. quota-parked) pods may now fit.
             self._retry_backlog()
 
     def _retry_backlog(self) -> None:
@@ -665,6 +721,7 @@ class SimCluster:
         self.controller.drain(timeout=5)
         self._kubelet_pass()
         self._elastic_pass()
+        self._preemption_pass()
         self._rebalance_pass()
         self._telemetry_pass()
 
@@ -691,6 +748,21 @@ class SimCluster:
             self.elastic.step()
         except Exception:  # noqa: BLE001 — resize is best-effort per pass; a bad pass must not kill the sim
             log.exception("elastic pass failed")
+
+    def _preemption_pass(self) -> None:
+        """Checkpoint-aware preemption, after the elastic pass (a resize
+        epoch's owner-tagged cordons land first when both want the same
+        hosts) and BEFORE the rebalancer, so higher-tier demand evicts
+        ahead of defrag migration over the same units (the cordon CAS
+        arbitrates any overlap — tpusan's preempt-vs-rebalancer
+        scenario). Disabled (None) unless the ContentionPolicy gate or
+        an explicit config turned the contention plane on."""
+        if self.preemption is None:
+            return
+        try:
+            self.preemption.step()
+        except Exception:  # noqa: BLE001 — preemption is best-effort per pass; a bad pass must not kill the sim
+            log.exception("preemption pass failed")
 
     def _rebalance_pass(self) -> None:
         """Live repack, after the kubelet pass so migrations see settled
@@ -720,6 +792,8 @@ class SimCluster:
         pending = 0
         if self.rebalancer is not None:
             pending += self.rebalancer.retry_backoff.pending()
+        if self.preemption is not None:
+            pending += self.preemption.retry_backoff.pending()
         if self.elastic is not None:
             # In-flight epochs and downed hosts are pending work too: a
             # lease quietly expiring, a bundle recompile, or a stall
@@ -878,6 +952,10 @@ class SimCluster:
                 self._scheduler_pass_inner()
             finally:
                 self._admission = None
+                if self.contention is not None:
+                    # Publish per-tenant gauges + change-gated
+                    # TenantQuota status for whatever this pass admitted.
+                    self.contention.end_pass()
                 self.allocator.end_pass()
                 # Per-pass allocator decisions ride on the span: nodes
                 # probed, plans cached vs compiled, commits/rollbacks.
@@ -886,7 +964,7 @@ class SimCluster:
     def _scheduler_pass_inner(self) -> None:
         self._drain_events()
         work, self._sched_dirty = self._sched_dirty, set()
-        pending = sorted(work)
+        pending = self._admission_order(work)
         try:
             while pending:
                 key = pending.pop(0)
@@ -907,6 +985,58 @@ class SimCluster:
             self._sched_dirty.update(pending)
             raise
 
+    def _admission_order(self, work: Set[_PodKey]) -> List[_PodKey]:
+        """Admission order for one dirty batch: plain sorted keys, or —
+        with the contention plane on — weighted-fair-queuing order over
+        tenant weights (aged-first, then tier, then virtual finish; see
+        scheduling/wfq.py). Keys whose pod is gone or no longer Pending
+        keep their sorted slot at the tail: the pass loop's own re-fetch
+        discards them."""
+        if self.contention is None or not work:
+            return sorted(work)
+        pods = []
+        leftover = []
+        for key in sorted(work):
+            pod = self.api.try_get(POD, key[1], key[0])
+            if pod is not None and pod.phase == "Pending":
+                pods.append(pod)
+            else:
+                leftover.append(key)
+        self.contention.begin_pass()
+        ordered = self.contention.order(
+            pods, now=self.sim_time, cost_of=self._pod_chip_cost,
+            claims_of=self._pod_existing_claims)
+        return ordered + leftover
+
+    def _pod_existing_claims(self, pod: Pod) -> List[ResourceClaim]:
+        """A pod's already-existing claims, read-only (generated claims
+        that haven't been created yet simply don't contribute — the
+        authoritative creation stays in _ensure_claims_for_pod)."""
+        out: List[ResourceClaim] = []
+        for ref in pod.resource_claims:
+            name = ref.resource_claim_name or f"{pod.meta.name}-{ref.name}"
+            obj = self.api.try_get(RESOURCE_CLAIM, name, pod.namespace)
+            if obj is not None:
+                out.append(obj)
+        return out
+
+    def _pod_chip_cost(self, pod: Pod) -> float:
+        """WFQ service cost of one pending pod: chips across its claim
+        refs, resolving generated claims' templates read-only."""
+        from k8s_dra_driver_tpu.scheduling.tiers import claim_chip_cost
+
+        total = 0.0
+        for ref in pod.resource_claims:
+            name = ref.resource_claim_name or f"{pod.meta.name}-{ref.name}"
+            obj = self.api.try_get(RESOURCE_CLAIM, name, pod.namespace)
+            if obj is None and ref.resource_claim_template_name:
+                obj = self.api.try_get(
+                    RESOURCE_CLAIM_TEMPLATE,
+                    ref.resource_claim_template_name, pod.namespace)
+            if obj is not None:
+                total += claim_chip_cost(obj, self._host_chips)
+        return total
+
     def _schedule_pod(self, pod: Pod) -> str:
         """Schedule one Pending pod; returns 'bound', 'unschedulable', or
         'failed'. Probes only allocator-feasible nodes, most-free-first;
@@ -921,6 +1051,14 @@ class SimCluster:
             self.sched_recorder.warning(pod, REASON_FAILED_SCHEDULING, str(e))
             return "unschedulable"
         unallocated = [c for c in claims.values() if c.allocation is None]
+        if self.contention is not None and unallocated:
+            veto = self.contention.quota_veto(pod, list(claims.values()))
+            if veto:
+                # Parked by tenant policy, not capacity: a TenantQuota
+                # edit or falling usage re-admits via the backlog.
+                self.sched_recorder.warning(
+                    pod, REASON_FAILED_SCHEDULING, veto)
+                return "unschedulable"
         allocated_nodes = {
             c.allocation.node_name for c in claims.values()
             if c.allocation is not None and c.allocation.node_name
@@ -1047,6 +1185,11 @@ class SimCluster:
                 )
             except NotFoundError:
                 pass
+        if self.contention is not None and unallocated:
+            from k8s_dra_driver_tpu.scheduling.tiers import claim_chip_cost
+
+            self.contention.charge(pod, sum(
+                claim_chip_cost(c, self._host_chips) for c in unallocated))
         return "bound"
 
     def _fresh_candidates(self, pod: Pod, unallocated, shape: Optional[tuple],
@@ -1751,6 +1894,33 @@ class SimCluster:
                 now, serving_samples,
                 alerts=self.slo.active_alerts(),
                 claim_summaries=self.telemetry.claim_summaries())
+
+    def _observe_heal(self, trigger: str, elapsed: float, cd) -> None:
+        """ElasticDomainController.heal_observer sink: completed resize
+        epochs feed the time-to-healed burn-rate objective so a fleet
+        that heals too slowly pages like any other SLO (the
+        ``tpu_dra_resize_time_to_healed_seconds`` histogram remains the
+        raw surface)."""
+        from k8s_dra_driver_tpu.pkg.slo import TIME_TO_HEALED_SLO
+
+        if self.slo is None:
+            return
+        self.slo.observe(
+            TIME_TO_HEALED_SLO, self.telemetry_clock, elapsed,
+            subject=(cd.namespace, cd.name),
+            ref=ObjectReference(kind=COMPUTE_DOMAIN, name=cd.name,
+                                namespace=cd.namespace, uid=cd.uid))
+
+    def _fleet_free_chips(self) -> float:
+        """Unallocated chips fleet-wide — the autoscaler's multi-group
+        fairness hook compares the sum of desired scale-ups against this
+        headroom before apportioning by tenant weight."""
+        overview = self.allocator.placement_overview(TPU_DRIVER_NAME)
+        free = 0
+        for entry in overview.values():
+            free += self._host_chips - placement_lib.popcount(
+                entry["used_mask"])
+        return float(max(0, free))
 
     def _install_claim_load(self, node_name: str, claim_uid: str,
                             duty: float) -> None:

@@ -66,7 +66,8 @@ def _pod(doc: Dict[str, Any]) -> Pod:
         for rc in spec.get("resourceClaims", [])
     ]
     return Pod(meta=_meta(doc), containers=containers, resource_claims=claims,
-               node_name=spec.get("nodeName", ""))
+               node_name=spec.get("nodeName", ""),
+               priority_tier=int(spec.get("priorityTier", 0)))
 
 
 def _claim(doc: Dict[str, Any]) -> ResourceClaim:
@@ -75,6 +76,7 @@ def _claim(doc: Dict[str, Any]) -> ResourceClaim:
         meta=_meta(doc),
         requests=_device_requests(spec),
         config=_device_configs(spec),
+        priority_tier=int(spec.get("priorityTier", 0)),
     )
 
 
@@ -148,12 +150,22 @@ def _serving_group(doc: Dict[str, Any]):
     return obj
 
 
+def _tenant_quota(doc: Dict[str, Any]):
+    """TenantQuota manifests go through the real k8s wire decoder too."""
+    from k8s_dra_driver_tpu.k8s.k8swire import from_k8s_wire
+
+    obj = from_k8s_wire({**doc, "kind": "TenantQuota"})
+    obj.meta = _meta(doc)
+    return obj
+
+
 _KIND_BUILDERS = {
     "Pod": _pod,
     "ResourceClaim": _claim,
     "ResourceClaimTemplate": _claim_template,
     "ComputeDomain": _compute_domain,
     "ServingGroup": _serving_group,
+    "TenantQuota": _tenant_quota,
     "Job": _job,
 }
 
@@ -212,6 +224,8 @@ _KIND_ALIASES = {
     "computedomaincliques": "ComputeDomainClique",
     "servinggroup": "ServingGroup", "servinggroups": "ServingGroup",
     "sg": "ServingGroup",
+    "tenantquota": "TenantQuota", "tenantquotas": "TenantQuota",
+    "tq": "TenantQuota",
 }
 
 
@@ -254,6 +268,13 @@ def _summary_row(obj: K8sObject) -> List[str]:
         ready = getattr(st, "ready_replicas", 0)
         extra = (f"{ready}/{obj.spec.replicas} ready"
                  + (f" @{obj.spec.profile}" if obj.spec.profile else ""))
+    elif obj.kind == "TenantQuota":
+        quota = (str(obj.spec.chip_quota) if obj.spec.chip_quota
+                 else "unlimited")
+        extra = (f"weight={obj.spec.weight:g} "
+                 f"chips={obj.status.chips_used}/{quota}"
+                 + (f" tier>={obj.spec.priority_floor}"
+                    if obj.spec.priority_floor else ""))
     return [obj.namespace or "-", obj.meta.name, extra]
 
 
@@ -446,6 +467,16 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
             lines.append("LastScale: " + ", ".join(scale_notes)
                          + " (virtual clock)")
         lines += _conditions_lines(st.conditions, time.time())
+    elif obj.kind == "TenantQuota":
+        s, st = obj.spec, obj.status
+        lines += [
+            f"Weight:       {s.weight:g} (WFQ share)",
+            f"ChipQuota:    {s.chip_quota if s.chip_quota else '<unlimited>'}",
+            f"PriorityFloor: {s.priority_floor}",
+            f"ChipsUsed:    {st.chips_used}",
+            f"Pending:      {st.pods_pending} pod(s)",
+            f"VirtualTime:  {st.virtual_time:g}",
+        ]
     elif obj.kind == "Node":
         from k8s_dra_driver_tpu.rebalancer.controller import (
             DRAIN_READY_ANNOTATION,

@@ -1,0 +1,53 @@
+"""tpulint fixture: cordon-cas must stay QUIET — sanctioned CAS
+implementations, reads, and unrelated annotation writes."""
+
+CORDON_ANNOTATION = "rebalancer.tpu.google.com/cordoned"
+OTHER_ANNOTATION = "rebalancer.tpu.google.com/drain-ready"
+
+
+class _CordonNoWrite(Exception):
+    def __init__(self, won):
+        super().__init__()
+        self.won = won
+
+
+def try_cordon(api, claim, owner="true"):
+    # THE sanctioned acquisition CAS: writes allowed here (including
+    # through the nested mutate closure).
+    def mutate(obj, owner=owner):
+        cur = obj.meta.annotations.get(CORDON_ANNOTATION)
+        if cur == owner:
+            raise _CordonNoWrite(won=True)
+        if cur is not None:
+            raise _CordonNoWrite(won=False)
+        obj.meta.annotations[CORDON_ANNOTATION] = owner
+
+    api.update_with_retry("ResourceClaim", claim.meta.name,
+                          claim.meta.namespace, mutate)
+    return True
+
+
+def release_cordon(api, claim):
+    def mutate(obj):
+        if CORDON_ANNOTATION not in obj.meta.annotations:
+            raise _CordonNoWrite(won=False)
+        obj.meta.annotations.pop(CORDON_ANNOTATION, None)
+    api.update_with_retry("ResourceClaim", claim.meta.name,
+                          claim.meta.namespace, mutate)
+
+
+class GoodActor:
+    def acquire(self, api, claim):
+        return try_cordon(api, claim, owner="preempt")
+
+    def is_cordoned(self, claim):
+        # Reads are fine.
+        return CORDON_ANNOTATION in claim.meta.annotations
+
+    def owner_of(self, claim):
+        return claim.meta.annotations.get(CORDON_ANNOTATION)
+
+    def mark_drain_ready(self, node):
+        # Writes to OTHER annotations are fine.
+        node.meta.annotations[OTHER_ANNOTATION] = "true"
+        node.meta.annotations.pop(OTHER_ANNOTATION, None)

@@ -1,0 +1,29 @@
+"""tpulint fixture: cordon-cas MUST fire — raw cordon-annotation writes
+outside try_cordon/release_cordon."""
+
+CORDON_ANNOTATION = "rebalancer.tpu.google.com/cordoned"
+
+
+class BadEvictor:
+    def blind_cordon(self, claim):
+        # Raw write by constant name: the blind-cordon TOCTOU.
+        claim.meta.annotations[CORDON_ANNOTATION] = "true"
+
+    def blind_cordon_literal(self, claim):
+        # Raw write by the literal annotation key.
+        claim.meta.annotations["rebalancer.tpu.google.com/cordoned"] = "me"
+
+    def blind_release(self, claim):
+        # Raw .pop() outside release_cordon.
+        claim.meta.annotations.pop(CORDON_ANNOTATION, None)
+
+    def blind_release_in_cas(self, api, claim):
+        def mutate(obj):
+            # Nested closure named mutate — but NOT inside the
+            # sanctioned functions, so it still fires.
+            del obj.meta.annotations[CORDON_ANNOTATION]
+        api.update_with_retry("ResourceClaim", claim.meta.name,
+                              claim.namespace, mutate)
+
+    def blind_setdefault(self, claim):
+        claim.meta.annotations.setdefault(CORDON_ANNOTATION, "true")

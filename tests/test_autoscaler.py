@@ -554,3 +554,75 @@ def test_metrics_families_exposed():
             assert fam in text, fam
     finally:
         h.close()
+
+
+# -- multi-group fairness (contention-plane satellite) ------------------------
+
+
+def _group_in(ns, name, replicas=1):
+    g = ServingGroup(
+        meta=new_meta(name, ns),
+        spec=ServingGroupSpec(
+            replicas=replicas,
+            traffic=ServingTraffic(trace="constant:level=1.0",
+                                   peak_qps=400.0, qps_per_chip=100.0,
+                                   base_latency_ms=10.0),
+            slo=ServingSLO(latency_p95_ms=50.0),
+            policy=ServingScalingPolicy(
+                min_replicas=1, max_replicas=16, target_duty=0.6,
+                scale_up_cooldown_s=2.0, scale_down_cooldown_s=5.0,
+                stabilization_window_s=10.0)))
+    return g
+
+
+def test_scale_up_apportioned_by_tenant_weight_under_headroom():
+    """When the fleet cannot satisfy the sum of desired scale-ups, the
+    headroom splits by tenant weight (weighted max-min) instead of
+    first-writer-wins: the heavy tenant's group steps up with its share,
+    the light tenant's group defers visibly (ScaleDeferred)."""
+    api = APIServer()
+    registry = Registry()
+    api.create(_group_in("heavy", "h-chat"))
+    api.create(_group_in("light", "l-chat"))
+    engine = TrafficEngine(api, registry, None,
+                           claim_load_sink=lambda n, u, d: None)
+    weights = {"heavy": 3.0, "light": 1.0}
+    ctl = ServingGroupController(
+        api, registry, engine,
+        headroom_fn=lambda: 3.0,
+        tenant_weight_fn=lambda ns: weights.get(ns, 1.0))
+    try:
+        samples = engine.step(10.0)
+        assert set(samples) == {("heavy", "h-chat"), ("light", "l-chat")}
+        decisions = {d.key: d for d in ctl.step(10.0, samples)}
+        # Both want 7 replicas (400 qps / (100 * 0.6)); 3 free chips
+        # split 3:1 -> heavy gets 2 more replicas, light gets 0.
+        heavy = decisions[("heavy", "h-chat")]
+        light = decisions[("light", "l-chat")]
+        assert heavy.direction == "up" and heavy.applied == 3
+        assert light.direction == "deferred"
+        deferred = [e for e in api.list(EVENT, namespace="light")
+                    if e.reason == REASON_SCALE_DEFERRED]
+        assert deferred, "the clamped loser must surface as ScaleDeferred"
+    finally:
+        engine.close()
+
+
+def test_scale_up_unconstrained_when_headroom_suffices():
+    """Headroom above the summed demand leaves every group's step
+    untouched — the fairness hook only engages under contention."""
+    api = APIServer()
+    registry = Registry()
+    api.create(_group_in("heavy", "h-chat"))
+    api.create(_group_in("light", "l-chat"))
+    engine = TrafficEngine(api, registry, None,
+                           claim_load_sink=lambda n, u, d: None)
+    ctl = ServingGroupController(api, registry, engine,
+                                 headroom_fn=lambda: 1000.0)
+    try:
+        samples = engine.step(10.0)
+        decisions = {d.key: d for d in ctl.step(10.0, samples)}
+        assert decisions[("heavy", "h-chat")].applied == 7
+        assert decisions[("light", "l-chat")].applied == 7
+    finally:
+        engine.close()

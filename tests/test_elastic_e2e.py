@@ -561,3 +561,52 @@ def test_clique_rejoin_reclaims_worker_slot():
     mgr.deregister("node-c")
     assert mgr.register("node-d", "10.0.0.4") == 2  # lowest free
     assert mgr.register("node-c", "10.0.0.3") == 3  # old slot taken
+
+
+def test_heal_latency_feeds_slo_plane_with_deduped_incident(tmp_path):
+    """Satellite (ISSUE 15): time-to-healed is a burn-rate objective.
+    With FleetTelemetry on, every completed resize epoch observes its
+    latency into the ``domain-time-to-healed`` SLO; declaring a bound
+    tighter than the real heal latency must trip a deduplicated
+    SLOBurnRate incident on the domain, and the burn gauge must appear
+    on the scrape."""
+    from k8s_dra_driver_tpu.pkg.slo import (
+        TIME_TO_HEALED_SLO,
+        heal_time_objective,
+    )
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=8,
+                     gates=ELASTIC_GATES + ",FleetTelemetry=true")
+    sim.start()
+    try:
+        # The default objective (30 virtual s) is wired by the sim;
+        # tighten it so a perfectly ordinary ~7-step heal reads as a
+        # violation the burn-rate machinery must catch.
+        assert sim.slo.has(TIME_TO_HEALED_SLO)
+        sim.slo.add(heal_time_objective(
+            bound_s=1.0, target=0.5, windows=((60.0, 15.0),),
+            burn_threshold=1.0))
+        cd = _assemble(sim)
+        victim = cd.status.placement.nodes[0]
+        epoch0 = cd.status.epoch
+        _set_node_down(sim, victim, True)
+        assert sim.wait_for(
+            lambda s: _domain(s).status.epoch == epoch0 + 1
+            and _domain(s).status.status == "Ready", max_steps=60)
+        # A few telemetry passes evaluate the freshly-observed sample.
+        for _ in range(3):
+            sim.step()
+        incidents = [e for e in _events(sim, "SLOBurnRate",
+                                        namespace="grid")
+                     if TIME_TO_HEALED_SLO in e.message]
+        assert len(incidents) == 1, [
+            (e.meta.name, e.message) for e in incidents]
+        assert incidents[0].involved_object.name == "dom"
+        assert incidents[0].count >= 1
+        text = sim.metrics_registry.expose()
+        assert f'tpu_dra_slo_burn_rate{{slo="{TIME_TO_HEALED_SLO}"' in text
+        alerts = [a for a in sim.slo.active_alerts()
+                  if a.slo == TIME_TO_HEALED_SLO]
+        assert alerts and alerts[0].subject == ("grid", "dom")
+    finally:
+        sim.stop()

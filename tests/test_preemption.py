@@ -1,0 +1,376 @@
+"""Unit + small-sim tier for the contention plane: tier-aware victim
+planning, WFQ admission ordering in the scheduler, per-tenant quota
+parking, eviction mechanics, and the cordon-owner mutual-exclusion
+regression (rebalancer never touches owner="preempt" units and vice
+versa, crashed-owner re-acquisition included)."""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.pkg import placement as placement_lib
+from k8s_dra_driver_tpu.rebalancer.controller import (
+    CORDON_ANNOTATION,
+    release_cordon,
+    try_cordon,
+)
+from k8s_dra_driver_tpu.rebalancer.planner import (
+    MigrationUnit,
+    NodeView,
+    WHOLE_HOST,
+    plan_profile,
+)
+from k8s_dra_driver_tpu.scheduling.preemption import CORDON_OWNER_PREEMPT
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+def _view(name, used=0, pinned=0, units=(), topo="2x2"):
+    tables = placement_lib.tables_for(topo)
+    return NodeView(name=name, tables=tables,
+                    available=tables.all_placements_bitmap,
+                    used_mask=used, pinned_mask=pinned, units=list(units))
+
+
+def _unit(name, node, mask, tier=0, ns="default"):
+    return MigrationUnit(pod_namespace=ns, pod_name=name, pod_uid=f"u-{name}",
+                         node=node, claim_keys=((ns, f"{name}-claim"),),
+                         chip_mask=mask, tier=tier)
+
+
+def _apply(sim, text):
+    for obj in load_manifests(text):
+        sim.api.create(obj)
+
+
+def _events(sim, reason, namespace=None):
+    evs = (sim.api.list("Event", namespace=namespace) if namespace
+           else sim.api.list("Event"))
+    return [e for e in evs if e.reason == reason]
+
+
+SINGLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: single, namespace: %(ns)s}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+
+WHOLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: %(ns)s}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+
+def _pod(name, ns, rct="single", tier=0, node=""):
+    tier_line = f"\n  priorityTier: {tier}" if tier else ""
+    node_line = f"\n  nodeName: {node}" if node else ""
+    return f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: {name}, namespace: {ns}}}
+spec:{tier_line}{node_line}
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: {rct}}}]
+"""
+
+
+def _quota(ns, weight=1.0, chip_quota=0, floor=0):
+    return f"""
+apiVersion: resource.tpu.google.com/v1beta1
+kind: TenantQuota
+metadata: {{name: default, namespace: {ns}}}
+spec:
+  weight: {weight}
+  chipQuota: {chip_quota}
+  priorityFloor: {floor}
+"""
+
+
+# -- planner: victim-priority ranking -----------------------------------------
+
+
+def test_plan_profile_rank_prefers_cheapest_victims():
+    """With a rank, a TWO-unit tier-0 set beats a ONE-unit tier-10 set:
+    the highest victim priority leads the cost."""
+    views = {
+        "n0": _view("n0", used=0b0011,
+                    units=[_unit("a", "n0", 0b0001, tier=0),
+                           _unit("b", "n0", 0b0010, tier=0)]),
+        "n1": _view("n1", used=0b0100,
+                    units=[_unit("c", "n1", 0b0100, tier=10)]),
+    }
+    plan = plan_profile(views, WHOLE_HOST, rank=lambda u: u.tier)
+    assert plan.nodes == ("n0",)
+    assert [u.pod_name for u in plan.units] == ["a", "b"]
+    # Without rank the one-unit set wins (the rebalancer's behavior,
+    # unchanged by the new parameter).
+    assert plan_profile(views, WHOLE_HOST).nodes == ("n1",)
+
+
+# -- cordon owner mutual exclusion (satellite regression) ---------------------
+
+
+def test_cordon_owner_exclusion_and_crash_resume(tmp_path):
+    """try_cordon semantics across the four actor roles: a foreign owner
+    always loses, the same owner re-acquires its own (possibly crashed)
+    cordon, and release reopens the claim."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=1)
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT % {"ns": "default"})
+        _apply(sim, _pod("w", "default", node="tpu-node-0"))
+        sim.settle(max_steps=10)
+        claim = next(c for c in sim.api.list(RESOURCE_CLAIM,
+                                             namespace="default"))
+        assert try_cordon(sim.api, claim, owner=CORDON_OWNER_PREEMPT)
+        # Crashed-owner re-acquisition: preempt resumes its own cordon.
+        assert try_cordon(sim.api, claim, owner=CORDON_OWNER_PREEMPT)
+        # Every other role loses while preempt holds it.
+        for owner in ("rebalancer", "autoscaler", "resize"):
+            assert not try_cordon(sim.api, claim, owner=owner)
+        release_cordon(sim.api, claim)
+        assert try_cordon(sim.api, claim, owner="rebalancer")
+        assert not try_cordon(sim.api, claim, owner=CORDON_OWNER_PREEMPT)
+    finally:
+        sim.stop()
+
+
+def test_rebalancer_never_selects_preempt_cordoned_unit(tmp_path):
+    """A unit cordoned owner="preempt" is pinned in the rebalancer's
+    node views (and symmetrically the preemption planner pins
+    rebalancer-cordoned units): the shared is_cordoned verdict is
+    owner-blind by design."""
+    from k8s_dra_driver_tpu.rebalancer import (
+        MODE_ENERGY,
+        RebalanceController,
+        RebalancerConfig,
+    )
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2,
+                     rebalancer_config=RebalancerConfig(
+                         mode=MODE_ENERGY, max_migrations_per_pass=8))
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT % {"ns": "default"})
+        # One single on each host: energy mode would consolidate them.
+        _apply(sim, _pod("w0", "default", node="tpu-node-0"))
+        _apply(sim, _pod("w1", "default", node="tpu-node-1"))
+        for _ in range(3):
+            sim._chaos_pass()
+            sim._gc_pass()
+            sim._scheduler_pass()
+            sim._kubelet_pass()
+        pods = {p.meta.name: p for p in sim.api.list(POD,
+                                                     namespace="default")}
+        assert all(p.phase == "Running" for p in pods.values())
+        # Preemption holds w0's claim (a crashed eviction, say).
+        claim0 = sim.api.get(RESOURCE_CLAIM, "w-t".replace("w-t", "w0-t"),
+                             "default")
+        assert try_cordon(sim.api, claim0, owner=CORDON_OWNER_PREEMPT)
+        views, _, _ = sim.rebalancer._snapshot()
+        all_units = [u for v in views.values() for u in v.units]
+        assert all(u.pod_name != "w0" for u in all_units), all_units
+        # The energy pass therefore leaves w0 where it is.
+        sim.rebalancer.step()
+        assert sim.api.get(POD, "w0", "default").node_name == "tpu-node-0"
+        live = sim.api.get(RESOURCE_CLAIM, claim0.meta.name, "default")
+        assert (live.meta.annotations[CORDON_ANNOTATION]
+                == CORDON_OWNER_PREEMPT)
+    finally:
+        sim.stop()
+
+
+# -- WFQ admission in the sim scheduler ---------------------------------------
+
+
+def test_wfq_admission_shares_capacity_fairly(tmp_path):
+    """Two equal-weight tenants flood 8 single-chip pods each into an
+    8-chip fleet. Plain FIFO (sorted keys) hands everything to the
+    alphabetically-first tenant; WFQ splits it 4/4."""
+    def run(gates):
+        sim = SimCluster(workdir=str(tmp_path / gates.replace("=", "-")),
+                         profile="v5e-4", num_hosts=2, gates=gates)
+        sim.start()
+        try:
+            for ns in ("tenant-a", "tenant-b"):
+                _apply(sim, SINGLE_RCT % {"ns": ns})
+                for i in range(8):
+                    _apply(sim, _pod(f"p-{i:02d}", ns))
+            sim.settle(max_steps=30)
+            running = {}
+            for ns in ("tenant-a", "tenant-b"):
+                running[ns] = sum(
+                    1 for p in sim.api.list(POD, namespace=ns)
+                    if p.phase == "Running")
+            return running
+        finally:
+            sim.stop()
+
+    fifo = run("")
+    assert fifo == {"tenant-a": 8, "tenant-b": 0}, fifo
+    wfq = run("ContentionPolicy=true")
+    assert wfq == {"tenant-a": 4, "tenant-b": 4}, wfq
+
+
+def test_wfq_weights_bias_admission(tmp_path):
+    """Weight 3 vs 1 over 8 chips: the heavy tenant admits 6, the light
+    2 — throughput proportional to the declared TenantQuota weights."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, _quota("tenant-a", weight=3.0))
+        _apply(sim, _quota("tenant-b", weight=1.0))
+        for ns in ("tenant-a", "tenant-b"):
+            _apply(sim, SINGLE_RCT % {"ns": ns})
+            for i in range(8):
+                _apply(sim, _pod(f"p-{i:02d}", ns))
+        sim.settle(max_steps=30)
+        counts = {ns: sum(1 for p in sim.api.list(POD, namespace=ns)
+                          if p.phase == "Running")
+                  for ns in ("tenant-a", "tenant-b")}
+        assert counts == {"tenant-a": 6, "tenant-b": 2}, counts
+    finally:
+        sim.stop()
+
+
+def test_quota_parks_and_readmits_on_raise(tmp_path):
+    """chipQuota=2 parks the tenant's third pod with a QuotaExceeded
+    event and a TenantQuota status write; raising the quota re-admits
+    it through the watch-driven backlog."""
+    from k8s_dra_driver_tpu.api.tenantquota import TENANT_QUOTA
+
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=1,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, _quota("team", chip_quota=2))
+        _apply(sim, SINGLE_RCT % {"ns": "team"})
+        for i in range(3):
+            _apply(sim, _pod(f"p-{i}", "team"))
+        sim.settle(max_steps=20)
+        pods = {p.meta.name: p for p in sim.api.list(POD, namespace="team")}
+        phases = sorted(p.phase for p in pods.values())
+        assert phases == ["Pending", "Running", "Running"], phases
+        assert _events(sim, "QuotaExceeded", namespace="team")
+        tq = sim.api.get(TENANT_QUOTA, "default", "team")
+        assert tq.status.chips_used == 2
+        assert tq.status.pods_pending >= 1
+
+        def raise_quota(obj):
+            obj.spec.chip_quota = 8
+        sim.api.update_with_retry(TENANT_QUOTA, "default", "team",
+                                  raise_quota)
+        sim.settle(max_steps=20)
+        assert all(p.phase == "Running"
+                   for p in sim.api.list(POD, namespace="team"))
+    finally:
+        sim.stop()
+
+
+# -- preemption in the sim ----------------------------------------------------
+
+
+def test_high_tier_evicts_low_tier_singles(tmp_path):
+    """Both hosts full of tier-0 singles; a tier-100 whole-host claim
+    arrives. The preemption engine evicts exactly one host's four
+    victims (checkpointed out, requeued Pending, WFQ deficit intact),
+    the preemptor runs there, and nothing is left cordoned."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=2,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT % {"ns": "batch"})
+        _apply(sim, WHOLE_RCT % {"ns": "prod"})
+        for i in range(8):
+            _apply(sim, _pod(f"small-{i}", "batch"))
+        sim.settle(max_steps=20)
+        assert all(p.phase == "Running"
+                   for p in sim.api.list(POD, namespace="batch"))
+
+        _apply(sim, _pod("vip", "prod", rct="whole", tier=100))
+        sim.settle(max_steps=30)
+
+        vip = sim.api.get(POD, "vip", "prod")
+        assert vip.phase == "Running", vip.meta.annotations
+        m = sim.preemption.metrics
+        assert m.preemptions_total.value("evicted") == 4.0
+        assert m.preemptions_total.value("failed") == 0.0
+        batch = list(sim.api.list(POD, namespace="batch"))
+        assert sum(1 for p in batch if p.phase == "Running") == 4
+        evicted = [p for p in batch if p.phase == "Pending"]
+        assert len(evicted) == 4
+        for p in evicted:
+            assert p.node_name == ""
+        assert len(_events(sim, "Preempted", namespace="batch")) == 4
+        # No cordon residue, no claims stuck mid-checkpoint.
+        for c in sim.api.list(RESOURCE_CLAIM, namespace="batch"):
+            assert CORDON_ANNOTATION not in c.meta.annotations
+        for node in sim.nodes.values():
+            from k8s_dra_driver_tpu.plugins.checkpoint import (
+                MIGRATION_CHECKPOINTED,
+            )
+            assert not any(
+                e.state == MIGRATION_CHECKPOINTED
+                for e in node.tpu_driver.state.prepared_claims().values())
+    finally:
+        sim.stop()
+
+
+def test_equal_tier_is_never_evicted(tmp_path):
+    """Victims at the SAME tier as the demand are untouchable: the
+    whole-host claim stays parked and zero evictions happen."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=1,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, _quota("batch", floor=100))
+        _apply(sim, SINGLE_RCT % {"ns": "batch"})
+        _apply(sim, WHOLE_RCT % {"ns": "prod"})
+        for i in range(4):
+            _apply(sim, _pod(f"small-{i}", "batch"))
+        sim.settle(max_steps=20)
+        _apply(sim, _pod("vip", "prod", rct="whole", tier=100))
+        sim.settle(max_steps=20)
+        assert sim.api.get(POD, "vip", "prod").phase == "Pending"
+        assert sim.preemption.metrics.preemptions_total.value("evicted") == 0.0
+        assert all(p.phase == "Running"
+                   for p in sim.api.list(POD, namespace="batch"))
+    finally:
+        sim.stop()
+
+
+def test_quota_blocked_demand_does_not_preempt(tmp_path):
+    """A high-tier tenant OVER ITS OWN QUOTA never triggers eviction:
+    the demand is blocked by policy, not capacity."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=1,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, _quota("prod", chip_quota=2))
+        _apply(sim, SINGLE_RCT % {"ns": "batch"})
+        _apply(sim, WHOLE_RCT % {"ns": "prod"})
+        for i in range(4):
+            _apply(sim, _pod(f"small-{i}", "batch"))
+        sim.settle(max_steps=20)
+        _apply(sim, _pod("vip", "prod", rct="whole", tier=100))
+        sim.settle(max_steps=20)
+        assert sim.api.get(POD, "vip", "prod").phase == "Pending"
+        assert sim.preemption.metrics.preemptions_total.value("evicted") == 0.0
+    finally:
+        sim.stop()

@@ -1,0 +1,349 @@
+"""Contention-plane e2e tier.
+
+THE acceptance scenario (ISSUE 15): a 64-node v5e-16 sim where scattered
+low-priority v5e-1 claims block every 2x2 host block; a high-priority
+4-host ComputeDomain arrives, the preemption engine checkpoints the
+minimal victim set out (MigrationCheckpoint discipline — state fsync'd
+before any release), the victims requeue as Pending and eventually
+re-place, the domain assembles on the vacated block with its chips
+tiling the full slice grid, and the partition ledger reads back with
+zero leaks. Plus: fault-injected crash mid-eviction rolling back to the
+EXACT prior placement (allocation, devices, partition ids verbatim) with
+a deduplicated PreemptionFailed event, completing after the fault
+clears."""
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s.core import COMPUTE_DOMAIN, POD, RESOURCE_CLAIM
+from k8s_dra_driver_tpu.plugins.checkpoint import (
+    MIGRATION_CHECKPOINTED,
+    PREPARE_COMPLETED,
+)
+from k8s_dra_driver_tpu.rebalancer.controller import CORDON_ANNOTATION
+from k8s_dra_driver_tpu.sim import SimCluster
+from k8s_dra_driver_tpu.sim.kubectl import load_manifests
+from k8s_dra_driver_tpu.tpulib.types import parse_topology
+
+
+@pytest.fixture(autouse=True)
+def boot_id(tmp_path, monkeypatch):
+    p = tmp_path / "boot_id"
+    p.write_text("boot-1\n")
+    monkeypatch.setenv("ALT_TPU_BOOT_ID_PATH", str(p))
+
+
+SINGLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: single, namespace: batch}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, count: 1}}]
+"""
+
+SUBSLICE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: sub12, namespace: batch}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: subslice.tpu.google.com, count: 1, selectors: ["profile=1x2"]}}]
+"""
+
+WHOLE_RCT = """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole, namespace: prod}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+PROD_QUOTA = """
+apiVersion: resource.tpu.google.com/v1beta1
+kind: TenantQuota
+metadata: {name: default, namespace: prod}
+spec:
+  weight: 1
+  priorityFloor: 100
+"""
+
+CD_MANIFEST = """
+apiVersion: v1
+kind: Namespace
+metadata: {name: prod}
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata: {name: vip-dom, namespace: prod}
+spec:
+  numNodes: 4
+  channel:
+    resourceClaimTemplate: {name: vip-dom-channel}
+---
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-host, namespace: prod}
+spec:
+  spec:
+    devices:
+      requests: [{name: tpus, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+"""
+
+CD_WORKER = """
+apiVersion: v1
+kind: Pod
+metadata: {name: vip-dom-worker-%(i)d, namespace: prod}
+spec:
+  containers: [{name: jax, image: x}]
+  resourceClaims:
+  - {name: tpus, resourceClaimTemplateName: whole-host}
+  - {name: channel, resourceClaimTemplateName: vip-dom-channel}
+"""
+
+
+def _pinned_pod(name, node, rct="single", ns="batch", tier=0):
+    tier_line = f"\n  priorityTier: {tier}" if tier else ""
+    return f"""
+apiVersion: v1
+kind: Pod
+metadata: {{name: {name}, namespace: {ns}}}
+spec:{tier_line}
+  nodeName: {node}
+  containers: [{{name: c, image: x}}]
+  resourceClaims: [{{name: t, resourceClaimTemplateName: {rct}}}]
+"""
+
+
+def _apply(sim, text):
+    for obj in load_manifests(text):
+        sim.api.create(obj)
+
+
+def _events(sim, reason, namespace=None):
+    evs = (sim.api.list("Event", namespace=namespace) if namespace
+           else sim.api.list("Event"))
+    return [e for e in evs if e.reason == reason]
+
+
+def _worker_chip_coords(sim, pod) -> set:
+    coords = set()
+    node = sim.nodes[pod.node_name]
+    by_index = {c.index: c for c in node.tpulib.enumerate().chips}
+    for claim in sim.api.list(RESOURCE_CLAIM, namespace=pod.namespace):
+        if not any(r.uid == pod.uid for r in claim.reserved_for):
+            continue
+        if claim.allocation is None:
+            continue
+        for r in claim.allocation.devices:
+            if r.driver != "tpu.google.com":
+                continue
+            dev = node.tpu_driver.state.allocatable[r.device]
+            for idx in dev.chip_indices:
+                coords.add(tuple(by_index[idx].coords))
+    return coords
+
+
+def _assert_no_leaks(sim):
+    """Ledger read-back: no MigrationCheckpoint residue anywhere, and
+    every node's active ICI partitions match its prepared subslice
+    claims exactly."""
+    for name, node in sim.nodes.items():
+        state = node.tpu_driver.state
+        entries = state.prepared_claims()
+        assert not any(e.state == MIGRATION_CHECKPOINTED
+                       for e in entries.values()), name
+        subslices = sum(
+            1 for e in entries.values()
+            if e.state == PREPARE_COMPLETED
+            and any(d.device_type == "subslice" for d in e.devices))
+        if state.partitions is None:
+            # No partitioner (ICIPartitioning off): a subslice prepare
+            # would have failed loudly, so zero entries proves no leak.
+            assert subslices == 0, name
+        else:
+            assert (len(state.partitions.active_partitions())
+                    == subslices), name
+
+
+def test_high_priority_domain_evicts_scattered_singles(tmp_path):
+    """THE acceptance scenario: 64 v5e-16 hosts (16 slices of 4), every
+    slice's 2x2 block broken by two scattered tier-0 v5e-1 claims. A
+    tier-100 4-host domain (TenantQuota priorityFloor) parks; the
+    preemption engine evicts EXACTLY one block's two blockers, the
+    domain assembles there tiling the full 4x4 chip grid, the victims
+    requeue and re-place onto the remaining capacity, and the ledgers
+    read back clean."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-16", num_hosts=64,
+                     gates="ContentionPolicy=true")
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT)
+        small = []
+        for s in range(16):
+            for j, node in enumerate(
+                    (f"tpu-node-{4 * s}", f"tpu-node-{4 * s + 1}")):
+                name = f"small-{s}-{j}"
+                _apply(sim, _pinned_pod(name, node))
+                small.append(name)
+        sim.settle(max_steps=40)
+        pods = {p.meta.name: p for p in sim.api.list(POD, namespace="batch")}
+        assert all(pods[n].phase == "Running" for n in small)
+
+        _apply(sim, PROD_QUOTA)
+        _apply(sim, CD_MANIFEST)
+        for i in range(4):
+            _apply(sim, CD_WORKER % {"i": i})
+        assert sim.wait_for(
+            lambda s: s.api.get(COMPUTE_DOMAIN, "vip-dom", "prod")
+            .status.status == "Ready", max_steps=60), [
+                (p.meta.name, p.phase)
+                for p in sim.api.list(POD, namespace="prod")]
+
+        # Minimality: exactly one block's two blockers were evicted.
+        m = sim.preemption.metrics
+        assert m.preemptions_total.value("evicted") == 2.0
+        assert m.preemptions_total.value("failed") == 0.0
+        assert len(_events(sim, "Preempted", namespace="batch")) == 2
+
+        # The domain landed on a full 2x2 host block within one ICI
+        # domain, chips tiling the entire 4x4 slice grid.
+        cd = sim.api.get(COMPUTE_DOMAIN, "vip-dom", "prod")
+        assert cd.status.placement is not None
+        assert cd.status.placement.block_shape == "2x2"
+        block_nodes = set(cd.status.placement.nodes)
+        workers = [p for p in sim.api.list(POD, namespace="prod")
+                   if p.meta.name.startswith("vip-dom-worker")]
+        assert {p.node_name for p in workers} == block_nodes
+        coords = set()
+        for p in workers:
+            got = _worker_chip_coords(sim, p)
+            assert len(got) == 4, (p.meta.name, got)
+            coords |= got
+        dims = parse_topology("4x4")
+        mask = 0
+        for c in coords:
+            mask |= 1 << (c[0] * dims[1] + c[1])
+        assert mask == (1 << (dims[0] * dims[1])) - 1, bin(mask)
+
+        # Victims requeued AND eventually re-placed: every small pod
+        # runs again (plenty of free chips remain on non-block hosts),
+        # off the domain's block.
+        sim.settle(max_steps=40)
+        pods = {p.meta.name: p for p in sim.api.list(POD, namespace="batch")}
+        assert all(pods[n].phase == "Running" for n in small), [
+            (n, pods[n].phase) for n in small
+            if pods[n].phase != "Running"]
+        assert all(pods[n].node_name not in block_nodes for n in small)
+
+        # Nothing cordoned, nothing leaked.
+        for c in sim.api.list(RESOURCE_CLAIM, namespace="batch"):
+            assert CORDON_ANNOTATION not in c.meta.annotations
+        _assert_no_leaks(sim)
+    finally:
+        sim.stop()
+
+
+def test_eviction_crash_rolls_back_to_exact_prior_placement(tmp_path):
+    """Fault-injected crash between the checkpoint-out and the requeue:
+    the victim must roll back to its EXACT prior placement — same node,
+    same devices, original ICI partition active, pod Running — with a
+    deduplicated PreemptionFailed event; clearing the fault lets the
+    paced retry complete, the victim re-places with its partition carved
+    on the new host, and the high-tier demand runs on the freed node."""
+    sim = SimCluster(workdir=str(tmp_path), profile="v5e-4", num_hosts=3,
+                     gates=("ContentionPolicy=true,ICIPartitioning=true,"
+                            "DynamicSubslice=true"))
+    sim.start()
+    try:
+        _apply(sim, SINGLE_RCT)
+        _apply(sim, SUBSLICE_RCT)
+        # node0: the cheapest victim (a 1x2 subslice holding an ICI
+        # partition). node1: two singles (2 units). node2: a whole-host
+        # pod (1 unit but 4 chips).
+        _apply(sim, _pinned_pod("victim", "tpu-node-0", rct="sub12"))
+        _apply(sim, _pinned_pod("one-a", "tpu-node-1"))
+        _apply(sim, _pinned_pod("one-b", "tpu-node-1"))
+        _apply(sim, """
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata: {name: whole-b, namespace: batch}
+spec:
+  spec:
+    devices:
+      requests: [{name: t, exactly: {deviceClassName: tpu.google.com, allocationMode: All}}]
+""")
+        _apply(sim, _pinned_pod("full", "tpu-node-2", rct="whole-b"))
+        sim.settle(max_steps=20)
+        assert all(p.phase == "Running"
+                   for p in sim.api.list(POD, namespace="batch"))
+
+        src_state = sim.nodes["tpu-node-0"].tpu_driver.state
+        dst_state = sim.nodes["tpu-node-1"].tpu_driver.state
+        src_parts_before = [p.id for p in
+                            src_state.partitions.active_partitions()]
+        assert src_parts_before, "subslice prepare must hold a partition"
+        victim_claim = next(
+            c for c in sim.api.list(RESOURCE_CLAIM, namespace="batch")
+            if c.meta.name.startswith("victim"))
+        devices_before = [r.device for r in victim_claim.allocation.devices]
+
+        def crash(point):
+            if point == "quiesced":
+                raise RuntimeError("injected eviction crash")
+
+        sim.preemption.fault_hook = crash
+
+        _apply(sim, WHOLE_RCT)
+        _apply(sim, """
+apiVersion: v1
+kind: Pod
+metadata: {name: big, namespace: prod}
+spec:
+  priorityTier: 100
+  containers: [{name: c, image: x}]
+  resourceClaims: [{name: t, resourceClaimTemplateName: whole}]
+""")
+        for _ in range(6):
+            sim.step()
+        failed = sim.preemption.metrics.preemptions_total.value("failed")
+        assert failed >= 2.0, failed
+
+        # Rolled back to the exact source placement.
+        claim = sim.api.get(RESOURCE_CLAIM, victim_claim.meta.name, "batch")
+        assert claim.allocation.node_name == "tpu-node-0"
+        assert [r.device for r in claim.allocation.devices] == devices_before
+        assert [p.id for p in src_state.partitions.active_partitions()] \
+            == src_parts_before
+        assert victim_claim.uid in src_state.prepared_claims()
+        assert (src_state.prepared_claims()[victim_claim.uid].state
+                == PREPARE_COMPLETED)
+        pod = sim.api.get(POD, "victim", "batch")
+        assert pod.node_name == "tpu-node-0"
+        assert pod.phase == "Running"
+        fails = _events(sim, "PreemptionFailed", namespace="batch")
+        assert len(fails) == 1, [(e.meta.name, e.message) for e in fails]
+        assert fails[0].count >= 2
+        assert "rolled back to its source placement" in fails[0].message
+
+        # Clear the fault: the paced retry completes — the victim is
+        # requeued, re-places on node1 with its partition carved there,
+        # and the high-tier demand runs on the freed node0.
+        sim.preemption.fault_hook = None
+        sim.settle(max_steps=40)
+        big = sim.api.get(POD, "big", "prod")
+        assert big.phase == "Running", big.meta.annotations
+        assert big.node_name == "tpu-node-0"
+        victim_pod = sim.api.get(POD, "victim", "batch")
+        assert victim_pod.phase == "Running"
+        assert victim_pod.node_name == "tpu-node-1"
+        assert src_state.partitions.active_partitions() == []
+        assert [p.profile for p in
+                dst_state.partitions.active_partitions()] == ["1x2"]
+        assert len(_events(sim, "Preempted", namespace="batch")) == 1
+        _assert_no_leaks(sim)
+    finally:
+        sim.stop()

@@ -1,0 +1,203 @@
+"""Pure unit tier for the WFQ core (scheduling/wfq.py) and the tier/cost
+helpers (scheduling/tiers.py): proportional-share ordering, starvation
+aging, tier precedence, deficit preservation, weighted max-min
+apportionment, and the Jain index the bench gates on."""
+
+import pytest
+
+from k8s_dra_driver_tpu.scheduling.wfq import (
+    FairQueue,
+    PendingItem,
+    fair_apportion,
+    jain_index,
+)
+from k8s_dra_driver_tpu.scheduling.tiers import (
+    claim_chip_cost,
+    effective_tier,
+    profile_chips,
+    request_profile,
+)
+
+
+def _items(tenant, n, cost=1.0, tier=0, waited=0.0):
+    return [PendingItem(tenant=tenant, key=(tenant, f"p-{tenant}-{i:03d}"),
+                        cost=cost, tier=tier, waited_s=waited)
+            for i in range(n)]
+
+
+# -- ordering -----------------------------------------------------------------
+
+
+def test_equal_weights_interleave_round_robin():
+    """Two equal-weight tenants flooding identical work interleave
+    1:1 — neither's alphabetical position matters."""
+    q = FairQueue()
+    ordered = q.order(_items("a", 4) + _items("b", 4))
+    tenants = [it.tenant for it in ordered]
+    assert tenants == ["a", "b", "a", "b", "a", "b", "a", "b"]
+
+
+def test_weight_two_gets_twice_the_slots():
+    """Weight 2 vs weight 1: in any admission prefix the heavy tenant
+    holds ~2/3 of the slots (virtual finish advances half as fast)."""
+    q = FairQueue()
+    q.set_weight("heavy", 2.0)
+    q.set_weight("light", 1.0)
+    ordered = q.order(_items("heavy", 12) + _items("light", 12))
+    first_nine = [it.tenant for it in ordered[:9]]
+    assert first_nine.count("heavy") == 6
+    assert first_nine.count("light") == 3
+
+
+def test_cost_counts_not_item_count():
+    """Fairness is chip-throughput, not claim count: a tenant submitting
+    4-chip claims admits 1 for every 4 single-chip claims of a peer."""
+    q = FairQueue()
+    ordered = q.order(_items("big", 4, cost=4.0) + _items("small", 16))
+    # After the first big item (finish vtime 4), four smalls (1..4) tie
+    # and key order resolves; over the first 10 picks big gets 2.
+    prefix = [it.tenant for it in ordered[:10]]
+    assert prefix.count("big") == 2, prefix
+
+
+def test_higher_tier_orders_first():
+    q = FairQueue()
+    ordered = q.order(_items("t0", 3, tier=0) + _items("hi", 2, tier=100))
+    assert [it.tenant for it in ordered[:2]] == ["hi", "hi"]
+
+
+def test_aged_item_jumps_even_higher_tiers():
+    """Starvation aging beats tiers: a starved tier-0 item orders ahead
+    of fresh tier-100 arrivals."""
+    q = FairQueue(aging_after_s=60.0)
+    starved = [PendingItem(tenant="old", key=("old", "p"), cost=1.0,
+                           tier=0, waited_s=120.0)]
+    ordered = q.order(_items("hi", 3, tier=100) + starved)
+    assert ordered[0].tenant == "old"
+
+
+def test_charge_preserves_deficit_across_requeue():
+    """The eviction contract: a tenant whose work was admitted (charged)
+    stays behind an idle peer even after its pod is requeued — nothing
+    resets the virtual clock."""
+    q = FairQueue()
+    q.charge("greedy", 16.0)
+    assert q.vtime("greedy") == pytest.approx(16.0)
+    ordered = q.order(_items("greedy", 2) + _items("patient", 2))
+    assert [it.tenant for it in ordered] == [
+        "patient", "patient", "greedy", "greedy"]
+
+
+def test_idle_tenant_gets_no_banked_credit():
+    """Joining late starts from the global floor (SFQ start rule), not
+    virtual zero: an absent tenant cannot build up unbounded credit."""
+    q = FairQueue()
+    for _ in range(10):
+        q.charge("busy", 1.0)
+    # global floor follows admitted start times (vtime 9 at the last).
+    assert q.vtime("newcomer") >= 9.0
+
+
+def test_order_is_deterministic():
+    q1, q2 = FairQueue(), FairQueue()
+    items = _items("b", 5) + _items("a", 5, cost=2.0)
+    assert [i.key for i in q1.order(items)] == \
+        [i.key for i in q2.order(list(reversed(items)))]
+
+
+# -- fair_apportion -----------------------------------------------------------
+
+
+def test_apportion_satisfies_all_when_capacity_suffices():
+    grants = fair_apportion({"a": 3, "b": 5}, {}, capacity=10)
+    assert grants == {"a": 3.0, "b": 5.0}
+
+
+def test_apportion_splits_by_weight_under_contention():
+    grants = fair_apportion({"a": 100, "b": 100},
+                            {"a": 3.0, "b": 1.0}, capacity=40)
+    assert grants["a"] == pytest.approx(30.0)
+    assert grants["b"] == pytest.approx(10.0)
+
+
+def test_apportion_redistributes_unused_share():
+    """A small demand's leftover share water-fills to the others."""
+    grants = fair_apportion({"a": 5, "b": 100, "c": 100},
+                            {}, capacity=65)
+    assert grants["a"] == pytest.approx(5.0)
+    assert grants["b"] == pytest.approx(30.0)
+    assert grants["c"] == pytest.approx(30.0)
+
+
+def test_apportion_zero_capacity():
+    grants = fair_apportion({"a": 5}, {}, capacity=0)
+    assert grants == {"a": 0.0}
+
+
+# -- jain_index ---------------------------------------------------------------
+
+
+def test_jain_even_shares_is_one():
+    assert jain_index([5, 5, 5, 5]) == pytest.approx(1.0)
+
+
+def test_jain_one_hog_is_one_over_n():
+    assert jain_index([10, 0, 0, 0]) == pytest.approx(0.25)
+
+
+def test_jain_degenerate_inputs():
+    assert jain_index([]) == 1.0
+    assert jain_index([0, 0]) == 1.0
+
+
+# -- tiers / cost helpers -----------------------------------------------------
+
+
+class _Req:
+    def __init__(self, mode="ExactCount", count=1, selectors=(),
+                 cel=()):
+        self.allocation_mode = mode
+        self.count = count
+        self.selectors = list(selectors)
+        self.cel_selectors = list(cel)
+
+
+class _Claim:
+    def __init__(self, requests, tier=0):
+        self.requests = requests
+        self.priority_tier = tier
+
+
+class _Pod:
+    def __init__(self, tier=0):
+        self.priority_tier = tier
+
+
+def test_request_profile_shapes():
+    assert request_profile(_Req(selectors=["profile=2x2"])) == "2x2"
+    assert request_profile(_Req(cel=[
+        'device.attributes["tpu.google.com"].profile == "1x2"'])) == "1x2"
+    assert request_profile(_Req()) is None
+    assert request_profile(_Req(mode="All")) is None
+
+
+def test_profile_chips():
+    assert profile_chips("2x2") == 4
+    assert profile_chips("1x2") == 2
+    assert profile_chips("") == 1
+    assert profile_chips("bogus") == 1
+
+
+def test_claim_chip_cost():
+    assert claim_chip_cost(_Claim([_Req(mode="All")]), 4) == 4
+    assert claim_chip_cost(_Claim([_Req(selectors=["profile=2x2"])]), 4) == 4
+    assert claim_chip_cost(_Claim([_Req(count=3)]), 4) == 3
+    assert claim_chip_cost(
+        _Claim([_Req(count=1), _Req(selectors=["profile=1x2"])]), 8) == 3
+
+
+def test_effective_tier_max_of_pod_claims_floor():
+    assert effective_tier(_Pod(0), [_Claim([], tier=0)], floor=0) == 0
+    assert effective_tier(_Pod(10), [_Claim([], tier=50)], floor=25) == 50
+    assert effective_tier(_Pod(0), [], floor=100) == 100
+    assert effective_tier(None, None, floor=7) == 7
