@@ -62,7 +62,8 @@ tpulint:
 
 # tpusan — tpulint's runtime half (k8s_dra_driver_tpu/analysis/sanitizer):
 # seeded-fixture self-test (every detector class must fire on every seed,
-# naming both witness threads) + the four control-plane concurrency
+# naming both witness threads — including write-after-publish on the
+# zero-copy store's freeze seam) + the control-plane concurrency
 # scenarios driven by the interleaving explorer (must run clean). Run the
 # whole pytest suite sanitized with `TPU_SAN=1 make test-tier1`.
 race:

@@ -17,5 +17,6 @@ from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
     shard_lock,
     sleep_under_lock,
     cordon_discipline,
+    snapshot_mutation,
     docs_sync,
 )

@@ -1,0 +1,282 @@
+"""snapshot-mutation: objects handed out by the API are frozen snapshots.
+
+The zero-copy store publishes immutable snapshots: ``api.get`` /
+``api.try_get`` / ``api.list`` (and informer listers, and watch event
+``.obj`` payloads) return the stored object itself, not a private copy.
+Mutating one corrupts every other reader's view — at runtime the freeze
+seal raises FrozenSnapshotError, but only on the path that actually
+executes. This rule finds the pattern statically: attribute assignment,
+``del``, augmented assignment, or a container-mutator call rooted at a
+name bound from a snapshot-returning read.
+
+Sanctioned escapes, which all stop the tracking:
+
+- ``copy=True`` on the read (the explicit private-mutable-copy opt-out),
+- rebinding through ``.deepcopy()`` / ``copy.deepcopy`` / ``thaw(...)``
+  / ``.thaw()``,
+- the working object inside an ``update_with_retry`` mutate closure
+  (the closure parameter is a thawed copy-on-write copy, never a name
+  this rule tracks).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from k8s_dra_driver_tpu.analysis.astutil import MUTATORS, call_chain, receiver_chain
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    SourceFile,
+    register_checker,
+)
+
+# Read methods that hand out published snapshots when called on an
+# API-ish receiver.
+_SNAPSHOT_READS = {"get", "try_get", "list", "list_and_watch"}
+# Receiver-name fragments that mark a call as an API/cache read rather
+# than, say, ``dict.get``. Deliberately the same loose style cas-purity
+# uses: checkers match idiom, not types.
+_API_RECV_FRAGMENTS = ("api", "store", "informer", "lister", "client", "cache")
+# Names whose ``.obj`` attribute is a watch event payload.
+_EVENT_NAMES = ("ev", "evt", "event")
+# Rebinding through these severs tracking (the value is a private copy).
+_COPYING_CALLS = {"deepcopy", "thaw"}
+
+
+def _is_true(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _Scope:
+    """One function (or module) body's tracked snapshot bindings."""
+
+    def __init__(self) -> None:
+        self.snapshots: Set[str] = set()   # names bound to snapshots
+        self.lists: Set[str] = set()       # names bound to snapshot LISTS
+
+
+@register_checker
+class SnapshotMutationChecker(Checker):
+    rule = "snapshot-mutation"
+    description = ("no attribute writes or container mutations on objects "
+                   "handed out by api.get/try_get/list, informer listers, "
+                   "or watch events — they are shared frozen snapshots")
+    hint = ("mutate inside an update_with_retry closure (copy-on-write), "
+            "or take a private copy first: read with copy=True, or rebind "
+            "through .deepcopy()/thaw()")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        self._walk_body(sf, sf.tree.body, _Scope(), findings)
+        return findings
+
+    # -- snapshot sources ----------------------------------------------------
+
+    @staticmethod
+    def _is_snapshot_read(call: ast.Call) -> Optional[str]:
+        """'obj' for single-object reads, 'list' for list reads, None
+        when the call is not a snapshot source (including copy=True)."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr not in _SNAPSHOT_READS:
+            return None
+        recv = receiver_chain(call).lower()
+        if not any(frag in recv for frag in _API_RECV_FRAGMENTS):
+            return None
+        for kw in call.keywords:
+            if kw.arg == "copy" and _is_true(kw.value):
+                return None
+        return "list" if attr == "list" else "obj"
+
+    def _classify(self, expr: ast.AST, scope: _Scope) -> Optional[str]:
+        """What binding ``expr`` produces: 'obj', 'list', or None."""
+        if isinstance(expr, ast.Call):
+            chain = call_chain(expr)
+            last = chain.rsplit(".", 1)[-1]
+            if last in _COPYING_CALLS:
+                return None  # private copy: tracking severed
+            kind = self._is_snapshot_read(expr)
+            if kind is not None:
+                return kind
+            return None
+        if isinstance(expr, ast.Attribute) and expr.attr == "obj":
+            root = _root_name(expr.value)
+            if root is not None and (root in _EVENT_NAMES
+                                     or root.endswith("_ev")
+                                     or root.endswith("_event")):
+                return "obj"
+            return None
+        if isinstance(expr, ast.Subscript):
+            root = _root_name(expr.value)
+            if root in scope.lists:
+                return "obj"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in scope.snapshots:
+                return "obj"
+            if expr.id in scope.lists:
+                return "list"
+            return None
+        return None
+
+    # -- ordered body walk ---------------------------------------------------
+
+    def _walk_body(self, sf: SourceFile, body: Iterable[ast.stmt],
+                   scope: _Scope, findings: List[Finding]) -> None:
+        for stmt in body:
+            self._walk_stmt(sf, stmt, scope, findings)
+
+    def _walk_stmt(self, sf: SourceFile, stmt: ast.stmt, scope: _Scope,
+                   findings: List[Finding]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Fresh scope: closures over outer snapshot names are rare
+            # and re-tracked when the inner function re-reads; a nested
+            # def's params are never snapshots.
+            self._walk_body(sf, stmt.body, _Scope(), findings)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self._walk_body(sf, stmt.body, _Scope(), findings)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._check_mutations(sf, stmt, scope, findings)
+            kind = self._classify(stmt.value, scope)
+            for tgt in stmt.targets:
+                self._bind(tgt, kind, scope)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._check_mutations(sf, stmt, scope, findings)
+            if stmt.value is not None and isinstance(stmt.target, ast.Name):
+                self._bind(stmt.target, self._classify(stmt.value, scope),
+                           scope)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_mutations(sf, stmt, scope, findings)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Attribute):
+                    root = _root_name(tgt)
+                    if root in scope.snapshots:
+                        findings.append(self.finding(
+                            sf, tgt,
+                            f"del on attribute of snapshot '{root}' "
+                            f"(published snapshots are frozen)"))
+            return
+        if isinstance(stmt, ast.For):
+            iter_kind = self._classify(stmt.iter, scope)
+            if iter_kind == "list":
+                self._bind(stmt.target, "obj", scope)
+            self._check_mutations(sf, stmt.iter, scope, findings)
+            self._walk_body(sf, stmt.body, scope, findings)
+            self._walk_body(sf, stmt.orelse, scope, findings)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_mutations(sf, stmt.test, scope, findings)
+            self._walk_body(sf, stmt.body, scope, findings)
+            self._walk_body(sf, stmt.orelse, scope, findings)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._check_mutations(sf, item.context_expr, scope, findings)
+            self._walk_body(sf, stmt.body, scope, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(sf, stmt.body, scope, findings)
+            for h in stmt.handlers:
+                self._walk_body(sf, h.body, scope, findings)
+            self._walk_body(sf, stmt.orelse, scope, findings)
+            self._walk_body(sf, stmt.finalbody, scope, findings)
+            return
+        # Expression statements and everything else: scan for mutator
+        # calls and walrus bindings.
+        self._check_mutations(sf, stmt, scope, findings)
+
+    def _bind(self, target: ast.AST, kind: Optional[str],
+              scope: _Scope) -> None:
+        if isinstance(target, ast.Name):
+            scope.snapshots.discard(target.id)
+            scope.lists.discard(target.id)
+            if kind == "obj":
+                scope.snapshots.add(target.id)
+            elif kind == "list":
+                scope.lists.add(target.id)
+        elif isinstance(target, ast.Tuple):
+            # list_and_watch returns (objs, queue): first element is the
+            # snapshot list, the rest untracked.
+            for i, elt in enumerate(target.elts):
+                self._bind(elt, kind if i == 0 else None, scope)
+
+    # -- mutation sites ------------------------------------------------------
+
+    def _check_mutations(self, sf: SourceFile, node: ast.AST, scope: _Scope,
+                         findings: List[Finding]) -> None:
+        if not scope.snapshots and not scope.lists:
+            # Still record walrus bindings inside the expression.
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.NamedExpr):
+                    self._bind(sub.target, self._classify(sub.value, scope),
+                               scope)
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr):
+                self._bind(sub.target, self._classify(sub.value, scope),
+                           scope)
+            elif isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for tgt in targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(tgt)
+                        if root in scope.snapshots:
+                            findings.append(self.finding(
+                                sf, tgt,
+                                f"attribute write on snapshot '{root}' "
+                                f"(published snapshots are frozen)"))
+                        elif root in scope.lists:
+                            findings.append(self.finding(
+                                sf, tgt,
+                                f"item write on snapshot list '{root}' "
+                                f"(list() hands out shared references)"))
+            elif isinstance(sub, ast.Call):
+                self._check_mutator_call(sf, sub, scope, findings)
+
+    def _check_mutator_call(self, sf: SourceFile, call: ast.Call,
+                            scope: _Scope, findings: List[Finding]) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        if call.func.attr not in MUTATORS:
+            return
+        root = _root_name(call.func.value)
+        if root is None:
+            return
+        if root in scope.snapshots:
+            # obj.nodes.append(x), obj.labels.update(...) — but a bare
+            # tracked LIST name's own .append is only a local-list edit
+            # when the list was rebound; snapshots stay flagged.
+            findings.append(self.finding(
+                sf, call,
+                f"container mutation {call_chain(call)} on snapshot "
+                f"'{root}' (published snapshots are frozen)"))
+        elif root in scope.lists and isinstance(call.func.value,
+                                                (ast.Attribute,
+                                                 ast.Subscript)):
+            # pods[0].containers.append(...) / mutating through an
+            # element of a snapshot list. A plain ``pods.append(x)`` on
+            # the returned list object itself is NOT flagged: list()
+            # returns a fresh list; only the elements are shared.
+            findings.append(self.finding(
+                sf, call,
+                f"container mutation {call_chain(call)} through snapshot "
+                f"list '{root}' (elements are shared frozen snapshots)"))

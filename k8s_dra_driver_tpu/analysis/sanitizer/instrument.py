@@ -430,6 +430,68 @@ class Instrumentation:
 
         self._patch_attr(persist_mod, "_fsync", _fsync)
 
+    def patch_frozen_mutations(self) -> None:
+        """The zero-copy store's freeze seam becomes the
+        write-after-publish detector. ``freeze()`` (wrapped in both the
+        objects module and the store's imported binding) records which
+        thread published each snapshot; ``_frozen_mutation_hook`` — the
+        production no-op called immediately before FrozenSnapshotError —
+        reports the mutating thread as witness 1 and the publisher as
+        witness 2. Publish boundaries are also explorer yield points, so
+        the interleaving scheduler can drive a reader between a CAS
+        commit and its watch fan-out."""
+        from k8s_dra_driver_tpu.k8s import objects as objects_mod
+        from k8s_dra_driver_tpu.k8s import store as store_mod
+
+        instr = self
+        # id(snapshot) -> (publishing thread, publish stack). Keyed by id:
+        # fine for sanitizer runs (bounded below); a reused id after GC
+        # could at worst misattribute witness 2 of an already-fatal
+        # violation, never invent or hide one.
+        publishers: Dict[int, Tuple[str, Tuple[str, ...]]] = {}
+        pub_mu = threading.Lock()
+        orig_freeze = objects_mod.freeze
+
+        def freeze(obj):
+            out = orig_freeze(obj)
+            rec = (threading.current_thread().name,
+                   runtime_mod.capture_stack(2)
+                   if instr.state.capture_stacks else ())
+            with pub_mu:
+                if len(publishers) > 65536:
+                    publishers.clear()
+                publishers[id(out)] = rec
+            instr.state.yield_point(("freeze", type(obj).__name__))
+            return out
+
+        def hook(obj, op: str) -> None:
+            if runtime_mod.frozen_mutation_expected():
+                return  # a test deliberately poking the seal
+            with pub_mu:
+                pub = publishers.get(id(obj))
+            pub_thread, pub_stack = pub if pub else ("", ())
+            instr.state.record(runtime_mod.Violation(
+                kind=runtime_mod.WRITE_AFTER_PUBLISH,
+                message=(
+                    f"attempted `{op}` on a published store snapshot "
+                    f"({type(obj).__name__}) — zero-copy reads hand out "
+                    f"references; mutate a working copy instead (an "
+                    f"update_with_retry closure, .thaw(), or .deepcopy())"),
+                thread=threading.current_thread().name,
+                stack=(runtime_mod.capture_stack(3)
+                       if instr.state.capture_stacks else ()),
+                other_thread=pub_thread,
+                other_stack=pub_stack,
+            ), dedup=(runtime_mod.WRITE_AFTER_PUBLISH,
+                      f"{type(obj).__name__}.{op}"))
+
+        self._patch_attr(objects_mod, "_frozen_mutation_hook", hook)
+        # store.py binds `freeze` at import time — patch BOTH namespaces
+        # so every publish path (create/update/CAS commit/informer cache
+        # fill) records its thread.
+        self._patch_attr(objects_mod, "freeze", freeze)
+        self._patch_attr(store_mod, "freeze", freeze)
+
     # -- teardown ------------------------------------------------------------
 
     def undo(self) -> None:
@@ -467,6 +529,7 @@ def install(state: Optional[SanitizerState] = None,
         instr.patch_flocks()
         instr.patch_store_queues()
         instr.patch_fsync()
+        instr.patch_frozen_mutations()
     except BaseException:
         instr.undo()
         raise

@@ -37,12 +37,17 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-# Violation kinds (the three detector classes the acceptance pins, plus
-# the explorer's invariant reports).
+# Violation kinds (the detector classes the acceptance pins, plus the
+# explorer's invariant reports).
 LOCK_ORDER_CYCLE = "lock-order-cycle"
 SHARD_FAMILY = "unordered-multi-shard-acquire"
 GUARDED_BY = "guarded-by"
 ATOMICITY = "atomicity"
+# A mutation attempt on a published (frozen) store snapshot — the
+# sharing bug the zero-copy store turns into an error at runtime. The
+# instrumented freeze seam (instrument.patch_frozen_mutations) records
+# the mutating thread AND the thread that published the snapshot.
+WRITE_AFTER_PUBLISH = "write-after-publish"
 
 # Frames kept per witness stack. Deep enough to show the caller chain
 # through store/plugin internals, bounded so reports stay readable.
@@ -57,6 +62,32 @@ _NODE_IDS = itertools.count(1)
 
 def next_node_id() -> int:
     return next(_NODE_IDS)
+
+
+# Threads inside an `expect_frozen_mutation()` block are deliberately
+# poking a sealed snapshot (tests asserting FrozenSnapshotError): the
+# write-after-publish detector must not count the probe as a finding.
+_expected_frozen_tls = threading.local()
+
+
+class expect_frozen_mutation:
+    """Context manager marking a DELIBERATE frozen-snapshot mutation —
+    a test asserting that the seal holds. Inside the block the sanitized
+    suite's write-after-publish detector stays quiet; the
+    FrozenSnapshotError itself still raises."""
+
+    def __enter__(self):
+        _expected_frozen_tls.depth = getattr(
+            _expected_frozen_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _expected_frozen_tls.depth -= 1
+        return False
+
+
+def frozen_mutation_expected() -> bool:
+    return getattr(_expected_frozen_tls, "depth", 0) > 0
 
 
 def capture_stack(skip: int = 2, limit: int = STACK_LIMIT) -> Tuple[str, ...]:

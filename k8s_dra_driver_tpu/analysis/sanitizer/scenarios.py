@@ -39,14 +39,29 @@ test suite:
      (``rebalancer.controller.try_cordon``) must hand the replica to
      exactly one actor — never a double-migration, never a leaked ICI
      partition, whichever side wins on whichever seed.
+  8. ``resize-vs-rebalancer`` — an elastic resize epoch's quiesce racing
+     the rebalancer's repack over an overlapping host: the owner-tagged
+     cordon CAS arbitrates, and whichever side wins the ledgers must
+     agree with the surviving state.
+  9. ``preempt-vs-rebalancer`` — a preemption eviction racing a defrag
+     migration over the SAME victim unit: eviction leaves no partition
+     and no prepared entry anywhere; migration leaves exactly its
+     partition on the target.
+  10. ``store-frozen-readers`` — the zero-copy read contract: a writer's
+      copy-on-write CAS commits racing the reference-handout watch
+      fan-out and a telemetry ``get()`` pass over the same object; every
+      handout must be a frozen snapshot and no CAS commit may be lost.
 
 - ``FIXTURES`` — seeded violations proving each detector class fires
   deterministically on ANY seed and at ANY worker count (the fillers):
   a lock-order cycle between two shard locks taken outside the
   ``ordered-acquire`` helper, a guarded-by attribute write without the
   named lock (while another thread holds it — both witnesses named),
-  and the PR-8 lost-wakeup dispatcher bug (non-atomic role retirement)
-  resurfaced and caught by the stranded-ring invariant.
+  the PR-8 lost-wakeup dispatcher bug (non-atomic role retirement)
+  resurfaced and caught by the stranded-ring invariant, and a rogue
+  reader mutating a published store snapshot in place — caught by the
+  instrumented freeze seam as ``write-after-publish`` with the mutator
+  AND the publishing ``freeze()`` both named.
 
 Every scenario builds its objects AFTER ``instrument.install()`` patched
 the classes, so the locks it creates are SanLocks and the explorer owns
@@ -170,7 +185,7 @@ def scenario_store_churn(state: SanitizerState, seed: int,
                 if r < 0.5:
                     api.create(cls(meta=new_meta(name, "default")))
                 elif r < 0.8:
-                    got = api.get(kind, name, "default")
+                    got = api.get(kind, name, "default", copy=True)
                     got.meta.labels["touched"] = "1"
                     api.update(got)
                 else:
@@ -1050,6 +1065,82 @@ def scenario_preempt_vs_rebalancer(
                        "migrated claim not re-pointed at the target")
 
 
+# -- scenario 10: writer CAS racing frozen-reference readers ------------------
+
+
+def scenario_store_frozen_readers(state: SanitizerState, seed: int,
+                                  extra_workers: int = 0) -> None:
+    """The zero-copy read contract under race: a writer CAS-updating one
+    pod (copy-on-write commit, re-freeze, structural sharing) while the
+    batched watch fan-out delivers REFERENCES to a subscriber and a
+    telemetry-style pass reads the SAME published object via ``get()``.
+    Every consumer stays on the reference-handout path — a clean run
+    proves no consumer mutates a snapshot (the instrumented freeze seam
+    would report write-after-publish with both witnesses) and that every
+    handed-out object is actually frozen (an unfrozen escape would be a
+    torn-read hazard, reported as an atomicity violation)."""
+    import queue as queue_mod
+
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.k8s.objects import is_frozen
+
+    api = APIServer(shards=2)
+    api.create(_pod("frozen-pod"))
+    q = api.watch(POD, maxsize=65536)
+    updates = 6
+
+    def writer():
+        for i in range(updates):
+            def mutate(obj, i=i):
+                # The CAS hands a thawed working copy: mutation here is
+                # the sanctioned path. Commit re-freezes and publishes.
+                obj.meta.annotations["gen"] = str(i)
+                obj.phase = "Running" if i % 2 else "Pending"
+            api.update_with_retry(POD, "frozen-pod", "default", mutate)
+            api.flush_watchers()
+
+    def watcher():
+        seen = 0
+        while seen < updates:
+            try:
+                ev = q.get_nowait()
+            except queue_mod.Empty:
+                state.yield_point(("scenario", "watch-wait"))
+                continue
+            seen += 1
+            # Read-only consumption of the shared reference (the
+            # informer/telemetry consumer shape).
+            _ = (ev.obj.phase, ev.obj.meta.annotations.get("gen"))
+            _invariant(state, is_frozen(ev.obj),
+                       f"watch fan-out delivered an UNFROZEN object "
+                       f"(rv={ev.obj.meta.resource_version}) — a consumer "
+                       f"could mutate the store's published state in place")
+
+    def telemetry_reader():
+        for _ in range(2 * updates):
+            got = api.get(POD, "frozen-pod", "default")
+            # Aggregation-style reads over the snapshot's sub-objects.
+            _ = (got.phase, dict(got.meta.labels),
+                 got.meta.annotations.get("gen"))
+            _invariant(state, is_frozen(got),
+                       "get() handed out an UNFROZEN reference on the "
+                       "zero-copy read path")
+            state.yield_point(("scenario", "telemetry-read"))
+
+    explore(state, seed,
+            [("writer", writer), ("watcher", watcher),
+             ("telemetry", telemetry_reader)]
+            + _fillers(state, extra_workers))
+
+    api.flush_watchers()
+    final = api.get(POD, "frozen-pod", "default")
+    _invariant(state, final.meta.annotations.get("gen") == str(updates - 1),
+               f"final snapshot holds gen={final.meta.annotations.get('gen')}"
+               f" after {updates} CAS commits — a copy-on-write commit was "
+               f"lost across the race")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -1061,6 +1152,7 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
         scenario_autoscaler_scaledown_vs_consolidation,
     "resize-vs-rebalancer": scenario_resize_vs_rebalancer,
     "preempt-vs-rebalancer": scenario_preempt_vs_rebalancer,
+    "store-frozen-readers": scenario_store_frozen_readers,
 }
 
 
@@ -1213,9 +1305,46 @@ def fixture_dispatcher_atomicity(state: SanitizerState, seed: int,
         ))
 
 
+def fixture_write_after_publish(state: SanitizerState, seed: int,
+                                extra_workers: int = 0) -> None:
+    """A rogue consumer mutates a published snapshot in place — the exact
+    bug class the zero-copy reference handout makes possible. A publisher
+    creates a pod (the store's ``freeze()`` publishes the snapshot and the
+    instrumented seam records it as witness), then a rogue reader fetches
+    the reference via ``get()`` and writes ``.phase`` directly instead of
+    going through a working copy. The seal still raises
+    ``FrozenSnapshotError``, and the detector must name BOTH threads: the
+    mutator and the publishing ``freeze()``."""
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import POD
+    from k8s_dra_driver_tpu.k8s.objects import FrozenSnapshotError
+
+    api = APIServer(shards=2)
+    published = [False]
+
+    def publisher():
+        api.create(_pod("seeded"))
+        published[0] = True
+
+    def rogue():
+        while not published[0]:
+            state.yield_point(("fixture", "rogue-spin"))
+        got = api.get(POD, "seeded", "default")
+        try:
+            got.phase = "Running"  # tpulint: disable=snapshot-mutation -- the seeded violation itself: this fixture exists to prove the runtime detector catches what a suppressed static finding would hide
+        except FrozenSnapshotError:
+            pass  # the seal holds; the detector recorded the violation
+
+    explore(state, seed,
+            [("publisher", publisher), ("rogue", rogue)]
+            + _fillers(state, extra_workers))
+
+
 # fixture name -> (callable, violation kind it must produce)
 FIXTURES: Dict[str, Tuple[Callable[..., None], str]] = {
     "lock-order-cycle": (fixture_lock_order_cycle, "lock-order-cycle"),
     "guarded-by-write": (fixture_guarded_by_write, "guarded-by"),
     "dispatcher-atomicity": (fixture_dispatcher_atomicity, "atomicity"),
+    "write-after-publish": (fixture_write_after_publish,
+                            "write-after-publish"),
 }

@@ -102,7 +102,7 @@ class CliqueManager:
 
     def set_ready(self, node_name: str, ready: bool, attempts: int = 20) -> None:
         for _ in range(attempts):
-            clique = self._get()
+            clique = self._get(copy=True)
             if clique is None:
                 raise NotFoundError(f"clique {self.name} missing")
             info = clique.node_info(node_name)
@@ -120,7 +120,7 @@ class CliqueManager:
 
     def deregister(self, node_name: str, attempts: int = 20) -> None:
         for _ in range(attempts):
-            clique = self._get()
+            clique = self._get(copy=True)
             if clique is None:
                 return
             before = len(clique.nodes)
@@ -160,12 +160,15 @@ class CliqueManager:
         info = clique.node_info(node_name)
         return bool(info and info.ready)
 
-    def _get(self) -> Optional[ComputeDomainClique]:
-        obj = self.api.try_get(COMPUTE_DOMAIN_CLIQUE, self.name, self.namespace)
+    def _get(self, copy: bool = False) -> Optional[ComputeDomainClique]:
+        # copy=True hands back a mutable working copy for the CAS loops;
+        # the read-only accessors take the free reference handout.
+        obj = self.api.try_get(COMPUTE_DOMAIN_CLIQUE, self.name,
+                               self.namespace, copy=copy)
         return obj  # type: ignore[return-value]
 
     def _get_or_create(self) -> ComputeDomainClique:
-        obj = self._get()
+        obj = self._get(copy=True)
         if obj is not None:
             return obj
         clique = ComputeDomainClique(
@@ -177,6 +180,6 @@ class CliqueManager:
             self.api.create(clique)
         except Exception as e:  # noqa: BLE001 — racing creator; re-read below
             log.debug("clique %s create lost the race: %s", self.name, e)
-        got = self._get()
+        got = self._get(copy=True)
         assert got is not None
         return got

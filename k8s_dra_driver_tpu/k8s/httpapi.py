@@ -310,12 +310,17 @@ class RemoteAPIServer:
     def create(self, obj: K8sObject) -> K8sObject:
         return from_wire(self._request("POST", "/objects", to_wire(obj)))
 
-    def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
+    def get(self, kind: str, name: str, namespace: str = "",
+            copy: bool = False) -> K8sObject:
+        # ``copy`` is signature parity with the in-process store's
+        # zero-copy reads: wire deserialization already yields a private
+        # mutable object, so there is nothing further to copy.
         return from_wire(
             self._request("GET", f"/objects/{kind}" + self._q(name=name, ns=namespace))
         )
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[K8sObject]:
+    def try_get(self, kind: str, name: str, namespace: str = "",
+                copy: bool = False) -> Optional[K8sObject]:
         try:
             return self.get(kind, name, namespace)
         except NotFoundError:
@@ -326,6 +331,7 @@ class RemoteAPIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[K8sObject]:
         labels = json.dumps(label_selector) if label_selector else None
         doc = self._request(

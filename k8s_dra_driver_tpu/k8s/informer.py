@@ -140,17 +140,26 @@ class Informer:
                 log.exception("informer handler failed for %s", new.key)
 
     # -- lister ------------------------------------------------------------
+    #
+    # The cache holds the store's published frozen snapshots, so listers
+    # hand out references for free — same contract as APIServer.get/list.
+    # copy=True is the explicit opt-out for a caller that wants a private
+    # mutable copy.
 
-    def get(self, name: str, namespace: str = "") -> Optional[K8sObject]:
+    def get(self, name: str, namespace: str = "",
+            copy: bool = False) -> Optional[K8sObject]:
         key = f"{namespace}/{name}" if namespace else name
         with self._mu:
             obj = self._cache.get(key)
-            return obj.deepcopy() if obj else None
+        if obj is not None and copy:
+            return obj.deepcopy()
+        return obj
 
     def list(
         self,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[K8sObject]:
         with self._mu:
             out = []
@@ -161,6 +170,6 @@ class Informer:
                     obj.meta.labels.get(k) == v for k, v in label_selector.items()
                 ):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj.deepcopy() if copy else obj)
             out.sort(key=lambda o: o.key)
             return out

@@ -285,13 +285,17 @@ class KubernetesAPIServer:
         path = self._path(obj.kind, obj.meta.namespace)
         return from_k8s_wire(self._request("POST", path, self._to_wire(obj)))
 
-    def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
+    def get(self, kind: str, name: str, namespace: str = "",
+            copy: bool = False) -> K8sObject:
+        # ``copy`` is signature parity with the in-process store's
+        # zero-copy reads: wire deserialization already yields a private
+        # mutable object, so there is nothing further to copy.
         return from_k8s_wire(
             self._request("GET", self._path(kind, namespace, name))
         )
 
-    def try_get(self, kind: str, name: str,
-                namespace: str = "") -> Optional[K8sObject]:
+    def try_get(self, kind: str, name: str, namespace: str = "",
+                copy: bool = False) -> Optional[K8sObject]:
         try:
             return self.get(kind, name, namespace)
         except NotFoundError:
@@ -302,6 +306,7 @@ class KubernetesAPIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[K8sObject]:
         path = self._path(kind, namespace or "")
         params = {}

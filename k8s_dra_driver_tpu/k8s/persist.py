@@ -69,11 +69,19 @@ def _fsync(fd: int) -> None:
 DEFAULT_COMPACT_EVERY = 50_000
 
 
-def _encode_rec(seq: int, op: str, key, obj, fp) -> str:
-    return json.dumps({
-        "seq": seq, "op": op, "key": list(key), "fp": list(fp),
-        "obj": None if obj is None else serialize.to_wire(obj),
-    }, separators=(",", ":"))
+def _encode_rec(seq: int, op: str, key, obj, fp) -> Tuple[str, int]:
+    """One WAL record line, serialize-once: the object body is
+    ``serialize.wire_json`` — computed once per published snapshot and
+    cached on the frozen instance, so the group-commit record, a durable
+    re-log, and the next compaction all splice the SAME string instead of
+    re-walking the object graph. Returns ``(line, shared_bytes)`` where
+    ``shared_bytes`` counts body bytes served from the cache."""
+    head = json.dumps({"seq": seq, "op": op, "key": list(key),
+                       "fp": list(fp)}, separators=(",", ":"))
+    if obj is None:
+        return head[:-1] + ',"obj":null}', 0
+    body, reused = serialize.wire_json(obj)
+    return head[:-1] + ',"obj":' + body + "}", len(body) if reused else 0
 
 
 class StoreWAL:
@@ -138,44 +146,65 @@ class StoreWAL:
             "bytes": registry.register(Counter(
                 "tpu_dra_wal_bytes_total",
                 "Bytes appended to the store write-ahead log.")),
+            "record_bytes": registry.register(Counter(
+                "tpu_dra_wal_record_bytes_total",
+                "WAL bytes by append path (bytes-per-record = this over "
+                "tpu_dra_wal_records_total, per path).",
+                label_names=("path",))),
             "snapshots": registry.register(Counter(
                 "tpu_dra_wal_snapshots_total",
                 "Snapshot compactions of the store write-ahead log.")),
+            "shared_bytes": registry.register(Counter(
+                "tpu_dra_store_snapshot_shared_bytes",
+                "Encoded bytes served from the per-snapshot cached wire "
+                "encoding (serialize-once) instead of re-serializing the "
+                "object graph — WAL records, snapshot compaction.")),
         }
 
-    def _note(self, records: int, nbytes: int) -> None:
+    def _note(self, records: int, nbytes: int, shared: int = 0,
+              path: str = "group") -> None:
         with self._mu:
             self._since_snapshot += records
         if self._metrics is not None:
             self._metrics["records"].inc(by=float(records))
             self._metrics["bytes"].inc(by=float(nbytes))
+            self._metrics["record_bytes"].inc(path, by=float(nbytes))
+            if shared:
+                self._metrics["shared_bytes"].inc(by=float(shared))
 
     # -- append paths --------------------------------------------------------
 
     def append(self, recs) -> None:
         """Group-commit: records drained from the dispatch ring by the
         single active dispatcher. Each rec is ``(seq, op, key, obj, fp)``
-        with ``obj`` the shared immutable event deepcopy (serialized
-        here, off every shard lock)."""
-        data = "\n".join(_encode_rec(*rec) for rec in recs) + "\n"
+        with ``obj`` the published frozen snapshot itself (serialized
+        once here, off every shard lock; the encoding is cached on the
+        snapshot for compaction and any later re-log to reuse)."""
+        lines, shared = [], 0
+        for rec in recs:
+            line, reused = _encode_rec(*rec)
+            lines.append(line)
+            shared += reused
+        data = "\n".join(lines) + "\n"
         f = self._file(None)
         f.write(data)
         f.flush()
         if self.fsync:  # pragma: no cover — durable runs use write_sync
             _fsync(f.fileno())
-        self._note(len(recs), len(data))
+        self._note(len(recs), len(data), shared, path="group")
 
     def write_sync(self, shard_idx: int, rec) -> None:
         """Durable append: serialize, write, and fsync ONE record into the
         owning shard's file before the caller's write returns. The caller
         holds that shard's lock, which is what serializes this file;
         fsync releases the GIL, so shards flush in parallel."""
-        data = _encode_rec(*rec) + "\n"
+        line, shared = _encode_rec(*rec)
+        data = line + "\n"
         f = self._file(shard_idx)
         f.write(data)
         f.flush()
         _fsync(f.fileno())
-        self._note(1, len(data))
+        self._note(1, len(data), shared, path="durable")
 
     # -- compaction ----------------------------------------------------------
 
@@ -200,17 +229,28 @@ class StoreWAL:
                 self._files.clear()
                 self._epoch += 1
                 self._since_snapshot = 0
-        doc = {
+        head = json.dumps({
             "version": FORMAT_VERSION,
             "epoch": self._epoch,
             "watermark": state["watermark"],
             "rv": state["rv"],
             "fps": {kind: list(fp) for kind, fp in state["fps"].items()},
-            "objects": [serialize.to_wire(o) for o in state["objects"]],
-        }
+        }, separators=(",", ":"))
+        # Serialize-once: each stored object is a frozen snapshot whose
+        # wire encoding was (or is now) computed exactly once and cached
+        # on the instance — the snapshot body splices those strings, so a
+        # compaction after a group-commit epoch re-serializes nothing.
+        bodies, shared = [], 0
+        for o in state["objects"]:
+            s, reused = serialize.wire_json(o)
+            bodies.append(s)
+            if reused:
+                shared += len(s)
+        if self._metrics is not None and shared:
+            self._metrics["shared_bytes"].inc(by=float(shared))
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, separators=(",", ":"))
+            f.write(head[:-1] + ',"objects":[' + ",".join(bodies) + "]}")
             f.flush()
             _fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)

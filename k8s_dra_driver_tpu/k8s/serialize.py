@@ -10,8 +10,9 @@ K8sObject subclasses, so new kinds serialize without codec changes.
 from __future__ import annotations
 
 import dataclasses
+import json
 import typing
-from typing import Any, Dict, Optional, Type
+from typing import Any, Dict, Optional, Tuple, Type
 
 from k8s_dra_driver_tpu.k8s.objects import K8sObject
 
@@ -46,6 +47,29 @@ _REGISTRY = kind_registry()
 
 def to_wire(obj: K8sObject) -> Dict[str, Any]:
     return dataclasses.asdict(obj)
+
+
+def wire_json(obj: K8sObject) -> Tuple[str, bool]:
+    """Compact JSON wire encoding of one object — **serialized once per
+    published snapshot**. Frozen store snapshots are immutable, so the
+    first encoding is cached on the instance (``_wire_json``, dropped by
+    thaw/deepcopy) and every later consumer — the WAL record, durable
+    group-commit, snapshot compaction, the HTTP watch stream — reuses the
+    same string. Returns ``(encoding, reused)``; ``reused`` is True when
+    the cached encoding was served without re-serializing (the
+    ``tpu_dra_store_snapshot_shared_bytes`` accounting seam)."""
+    d = getattr(obj, "__dict__", None)
+    if d is not None:
+        cached = d.get("_wire_json")
+        if cached is not None:
+            return cached, True
+    s = json.dumps(to_wire(obj), separators=(",", ":"))
+    if d is not None and d.get("_sealed"):
+        # Direct slot write: the cache is seal bookkeeping, not content
+        # (sealed __setattr__ would reject it). Benign if two threads
+        # race — both compute the identical string.
+        d["_wire_json"] = s
+    return s, False
 
 
 def _decode_value(tp: Any, value: Any) -> Any:

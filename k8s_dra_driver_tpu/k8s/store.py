@@ -2,8 +2,14 @@
 
 Semantics kept faithful to the pieces the driver depends on:
 
-- create/update/delete return deep copies; callers never share memory with
-  the store (a real API server serializes through the wire).
+- Stored objects are **immutable published snapshots**: every write
+  freezes the object graph at publish time (k8s.objects.freeze), so
+  get()/list()/watch fan-out hand out *references* — zero copies on the
+  read path. Mutating a handed-out snapshot raises FrozenSnapshotError;
+  the isolation a real API server gets from serializing through the wire
+  is enforced by the seal instead of bought with a deepcopy per read.
+  ``copy=True`` on get/list is the explicit opt-out for callers that
+  want a private mutable copy.
 - update() is CAS on metadata.resourceVersion → ConflictError on mismatch.
   This is what the daemon's clique index allocation relies on
   (/root/reference/cmd/compute-domain-daemon/cdclique.go:350-372).
@@ -52,6 +58,29 @@ Multi-shard reads (orphan GC, persistence snapshots) go through ONE
 canonical ordered-acquire helper (``_locked_all``) — pinned by the
 tpulint ``shard-lock`` rule so no other code path can ever hold two shard
 locks and deadlock against it.
+
+Zero-copy write path (the 16k/32k-node work):
+
+- **One copy per write.** create/update deepcopy the caller's object
+  once (the defensive copy-in — callers keep mutable ownership of what
+  they passed), stamp it, freeze it, and that single frozen snapshot IS
+  the stored object, the returned object, the watch ``shared`` copy and
+  the WAL record's source — the pre-freeze path's three copies per
+  write collapse to one. ``update_with_retry`` is the copy-on-write
+  seam: the mutator receives a thawed working copy of the current
+  snapshot and commit freezes it back (``_owned`` skips even the
+  copy-in — the working copy is already private).
+- **Structural sharing across revisions.** Before freezing, commit
+  compares each top-level field (and the metadata's label/annotation
+  containers) against the prior revision and adopts the prior's frozen
+  sub-object when equal — a status-only update shares spec/metadata
+  sub-objects with the previous revision by identity, so the freeze
+  walk short-circuits and the per-snapshot wire-encoding cache
+  (k8s.serialize.wire_json) is the only serialization the WAL and
+  compaction ever pay per revision.
+- **copy_reads=True** is the copy-always A/B baseline for bench_scale:
+  reads deepcopy on the way out and every watch event is staged as a
+  fresh copy — the pre-zero-copy cost model, flag-gated.
 """
 
 from __future__ import annotations
@@ -63,13 +92,17 @@ import zlib
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+import copy as _copy
+
 from k8s_dra_driver_tpu.k8s.objects import (
     AlreadyExistsError,
     ConflictError,
     K8sObject,
     NotFoundError,
+    freeze,
     fresh_uid,
     now,
+    thaw,
 )
 
 
@@ -118,6 +151,17 @@ class StoreStats:
     objects_scanned_naive: int = 0
     objects_returned: int = 0
     watch_events_dropped: int = 0
+    # Zero-copy accounting: ``copies_avoided`` counts read-path handouts
+    # served as references (get/list objects + watch events staged without
+    # a shared copy); ``read_copies`` counts deepcopies actually performed
+    # on the read path (``copy=True`` opt-outs, or every handout in the
+    # flag-gated copy-always baseline) — the bench's ZERO-read-copy
+    # settle gate reads it; ``write_copies`` counts the write path's
+    # defensive copy-ins (one per create/update, plus each
+    # update_with_retry working copy).
+    copies_avoided: int = 0
+    read_copies: int = 0
+    write_copies: int = 0
 
     def snapshot(self) -> Dict[str, int]:
         return {
@@ -126,6 +170,9 @@ class StoreStats:
             "objects_scanned_naive": self.objects_scanned_naive,
             "objects_returned": self.objects_returned,
             "watch_events_dropped": self.watch_events_dropped,
+            "copies_avoided": self.copies_avoided,
+            "read_copies": self.read_copies,
+            "write_copies": self.write_copies,
         }
 
 
@@ -161,13 +208,18 @@ class _Shard:
 
 class APIServer:
     def __init__(self, shards: int = DEFAULT_STORE_SHARDS,
-                 batch_fanout: bool = True) -> None:
+                 batch_fanout: bool = True,
+                 copy_reads: bool = False) -> None:
         """``shards=1`` is the single-lock baseline (every kind behind one
         lock — the pre-scale-out behavior, kept for the bench_scale A/B);
         ``batch_fanout=False`` keeps delivery off-lock but dispatches one
-        event at a time (the non-batched fallback path)."""
+        event at a time (the non-batched fallback path);
+        ``copy_reads=True`` is the copy-always baseline — reads deepcopy
+        on the way out and watch events are staged as fresh copies, the
+        pre-zero-copy cost model kept for the bench_scale A/B."""
         if shards < 1:
             raise ApiValueError(f"shards must be >= 1, got {shards}")
+        self._copy_reads = copy_reads
         self._shards: List[_Shard] = [_Shard(i) for i in range(shards)]
         # Sticky kind -> shard assignments (see _shard): reads are
         # lock-free dict lookups; assignment serializes on its own lock.
@@ -304,6 +356,24 @@ class APIServer:
         while the thread that wrote it is descheduled)."""
         self._dispatch()
 
+    def watch_backlog(self) -> int:
+        """Events staged in the ring plus events delivered to subscriber
+        queues but not yet consumed. Nonzero means some subscriber's
+        cached view (an informer, a pass queue) still lags the store —
+        the sim's quiescence detection must treat that as pending work,
+        because a consumer thread that merely hasn't been scheduled yet
+        can flip cluster state the moment it runs. (Zero-copy fan-out
+        made writes fast enough to finish whole settle loops before the
+        OS schedules a single informer thread; 'no API writes for two
+        steps' alone no longer implies nothing more can happen.)"""
+        with self._ring_mu:
+            total = len(self._ring)
+        with self._watch_mu:
+            for watchers in self._watchers.values():
+                for q, _, _, _ in watchers:
+                    total += q.qsize()
+        return total
+
     def _deliver(self, batch: List[tuple]) -> None:
         """Fan one ring batch out to the watchers: group by kind so the
         registry is consulted once per kind per batch (not per event),
@@ -409,13 +479,23 @@ class APIServer:
         # tpulint: holds=mu (write-path internal; every caller holds the
         # writing shard's lock)
         """Stage one write's watch event (and WAL record) from inside the
-        shard lock. ``shared`` is the single immutable deepcopy every
-        watcher (and the WAL serializer) receives. Group-commit WAL
-        records ride the ring and are appended off-lock by the
-        dispatcher; durable (fsync) records are flushed to the shard's
-        own log file HERE, before the write returns — fsync releases the
-        GIL, so shards flush in parallel while the single-lock baseline
-        serializes every flush."""
+        shard lock. ``shared`` is the frozen stored snapshot itself —
+        every watcher (and the WAL serializer, via the snapshot's cached
+        wire encoding) receives the same reference; nothing is copied.
+        In the copy-always baseline (``copy_reads=True``) the event is
+        instead staged as one fresh mutable deepcopy, the pre-zero-copy
+        cost model. Group-commit WAL records ride the ring and are
+        appended off-lock by the dispatcher; durable (fsync) records are
+        flushed to the shard's own log file HERE, before the write
+        returns — fsync releases the GIL, so shards flush in parallel
+        while the single-lock baseline serializes every flush."""
+        if self._copy_reads:
+            self.stats.read_copies += 1
+            shared = shared.deepcopy()
+        else:
+            self.stats.copies_avoided += 1
+            if self._metrics is not None:
+                self._metrics["copies_avoided"].inc("watch")
         wal = self._wal
         durable = wal is not None and wal.fsync
         rec = None if (wal is None or durable) else (op, key, shared, fp)
@@ -433,32 +513,48 @@ class APIServer:
             key = self._key(obj)
             if key in shard.objects:
                 raise AlreadyExistsError(f"{key} already exists")
+            # The write path's ONE copy: the defensive copy-in (the
+            # caller keeps mutable ownership of what it passed). The
+            # stamped, frozen snapshot is then stored, returned, AND
+            # staged for every watcher + the WAL — nothing else copies.
             stored = obj.deepcopy()
+            self.stats.write_copies += 1
             stored.meta.uid = stored.meta.uid or fresh_uid()
             stored.meta.resource_version = self._next_rv()
             stored.meta.generation = 1
             stored.meta.creation_timestamp = stored.meta.creation_timestamp or now()
             stored.meta.deletion_timestamp = None
+            freeze(stored)
             self._index_add(shard, key, stored)
             fp = self._fp_mutate(shard, obj.kind, +1, stored.meta.resource_version)
-            out = stored.deepcopy()
-            shared = stored.deepcopy()  # ONE copy: every watcher + the WAL
-            self._write_event(shard, obj.kind, "ADDED", shared, "PUT", key, fp)
+            self._write_event(shard, obj.kind, "ADDED", stored, "PUT", key, fp)
         self._dispatch()
-        return out
+        return stored
 
-    def get(self, kind: str, name: str, namespace: str = "") -> K8sObject:
+    def get(self, kind: str, name: str, namespace: str = "",
+            copy: bool = False) -> K8sObject:
+        """Read one object. Returns the frozen published snapshot itself
+        (zero-copy); ``copy=True`` is the explicit opt-out returning a
+        thawed private copy for callers that mutate."""
         shard = self._shard(kind)
         with shard.mu:
             key = (kind, namespace, name)
             try:
-                return shard.objects[key].deepcopy()
+                obj = shard.objects[key]
             except KeyError:
                 raise NotFoundError(f"{key} not found") from None
+            if copy or self._copy_reads:
+                self.stats.read_copies += 1
+                return obj.deepcopy()
+            self.stats.copies_avoided += 1
+            if self._metrics is not None:
+                self._metrics["copies_avoided"].inc("get")
+            return obj
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[K8sObject]:
+    def try_get(self, kind: str, name: str, namespace: str = "",
+                copy: bool = False) -> Optional[K8sObject]:
         try:
-            return self.get(kind, name, namespace)
+            return self.get(kind, name, namespace, copy=copy)
         except NotFoundError:
             return None
 
@@ -486,7 +582,13 @@ class APIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[K8sObject]:
+        """List a kind (namespace/label filtered). The returned list is
+        fresh but its elements are the frozen published snapshots
+        themselves (zero-copy); ``copy=True`` deepcopies each element
+        out for callers that mutate."""
+        do_copy = copy or self._copy_reads
         shard = self._shard(kind)
         with shard.mu:
             if namespace is None:
@@ -501,17 +603,56 @@ class APIServer:
                 obj = bucket[key]
                 if not _match_labels(obj, label_selector):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj.deepcopy() if do_copy else obj)
             self.stats.objects_returned += len(out)
+            if do_copy:
+                self.stats.read_copies += len(out)
+            else:
+                self.stats.copies_avoided += len(out)
             if self._metrics is not None:
                 self._metrics["list_total"].inc()
                 self._metrics["scanned_total"].inc(by=float(len(bucket)))
                 self._metrics["returned_total"].inc(by=float(len(out)))
+                if not do_copy and out:
+                    self._metrics["copies_avoided"].inc(
+                        "list", by=float(len(out)))
             return out
 
-    def update(self, obj: K8sObject) -> K8sObject:
+    @staticmethod
+    def _share_unchanged(stored: K8sObject, prior: K8sObject) -> None:
+        # tpulint: holds=mu (write-path internal; every caller locks)
+        """Structural sharing across revisions: adopt the PRIOR frozen
+        revision's sub-objects into the not-yet-frozen ``stored`` wherever
+        the field compares equal — a status-only update then shares its
+        spec (and label/annotation containers) with the previous revision
+        by identity. The freeze walk short-circuits on the shared frozen
+        subtrees, and the duplicate trees from the copy-in are released
+        immediately instead of living once per revision — at 16k nodes
+        the store holds one spec per object, not one per status write."""
+        if type(stored) is not type(prior):
+            return
+        nd, pd = stored.__dict__, prior.__dict__
+        for name, pval in pd.items():
+            if name.startswith("_") or name in ("kind", "meta"):
+                continue
+            nval = nd.get(name)
+            if nval is not None and nval is not pval and nval == pval:
+                nd[name] = pval
+        # metadata itself always differs (fresh resourceVersion), but its
+        # containers usually don't:
+        nm, pm = stored.meta.__dict__, prior.meta.__dict__
+        for name in ("labels", "annotations", "finalizers",
+                     "owner_references"):
+            nval, pval = nm.get(name), pm.get(name)
+            if nval is not None and nval is not pval and nval == pval:
+                nm[name] = pval
+
+    def update(self, obj: K8sObject, _owned: bool = False) -> K8sObject:
         """CAS write. The stored object is replaced wholesale; finalizer
-        removal on a deleting object completes its deletion."""
+        removal on a deleting object completes its deletion. ``_owned``
+        (internal, the update_with_retry copy-on-write commit) marks
+        ``obj`` as a private working copy the store may freeze in place
+        instead of copying in."""
         shard = self._shard(obj.kind)
         with shard.mu:
             key = self._key(obj)
@@ -523,30 +664,32 @@ class APIServer:
                     f"{key}: resourceVersion {obj.meta.resource_version} != "
                     f"{cur.meta.resource_version}"
                 )
-            stored = obj.deepcopy()
+            if _owned:
+                stored = obj
+            else:
+                stored = obj.deepcopy()  # the ONE defensive copy-in
+                self.stats.write_copies += 1
             stored.meta.uid = cur.meta.uid
             stored.meta.creation_timestamp = cur.meta.creation_timestamp
             stored.meta.deletion_timestamp = cur.meta.deletion_timestamp
             stored.meta.resource_version = self._next_rv()
             stored.meta.generation = cur.meta.generation + 1
+            self._share_unchanged(stored, cur)
+            freeze(stored)
             if stored.meta.deletion_timestamp is not None and not stored.meta.finalizers:
                 self._index_drop(shard, key)
                 fp = self._fp_mutate(shard, obj.kind, -1,
                                      stored.meta.resource_version)
-                shared = stored.deepcopy()
-                self._write_event(shard, obj.kind, "DELETED", shared,
+                self._write_event(shard, obj.kind, "DELETED", stored,
                                   "DEL", key, fp)
-                out = stored.deepcopy()
             else:
                 self._index_add(shard, key, stored)
                 fp = self._fp_mutate(shard, obj.kind, 0,
                                      stored.meta.resource_version)
-                shared = stored.deepcopy()
-                self._write_event(shard, obj.kind, "MODIFIED", shared,
+                self._write_event(shard, obj.kind, "MODIFIED", stored,
                                   "PUT", key, fp)
-                out = stored.deepcopy()
         self._dispatch()
-        return out
+        return stored
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         shard = self._shard(kind)
@@ -557,17 +700,26 @@ class APIServer:
                 raise NotFoundError(f"{key} not found")
             if cur.meta.finalizers:
                 if cur.meta.deletion_timestamp is None:
-                    cur.meta.deletion_timestamp = now()
-                    cur.meta.resource_version = self._next_rv()
-                    fp = self._fp_mutate(shard, kind, 0, cur.meta.resource_version)
-                    self._write_event(shard, kind, "MODIFIED", cur.deepcopy(),
+                    # Copy-on-write, not copy: shallow-copy the frozen
+                    # snapshot (copy.copy drops the seal and shares every
+                    # frozen sub-object), replace only the metadata, and
+                    # publish the re-frozen revision.
+                    stored = _copy.copy(cur)
+                    stored.meta = thaw(cur.meta)
+                    stored.meta.deletion_timestamp = now()
+                    stored.meta.resource_version = self._next_rv()
+                    freeze(stored)
+                    self._index_add(shard, key, stored)
+                    fp = self._fp_mutate(shard, kind, 0,
+                                         stored.meta.resource_version)
+                    self._write_event(shard, kind, "MODIFIED", stored,
                                       "PUT", key, fp)
                 else:
                     return
             else:
                 self._index_drop(shard, key)
                 fp = self._fp_mutate(shard, kind, -1)
-                self._write_event(shard, kind, "DELETED", cur.deepcopy(),
+                self._write_event(shard, kind, "DELETED", cur,
                                   "DEL", key, fp)
         self._dispatch()
 
@@ -589,7 +741,13 @@ class APIServer:
                 "across all list() calls.")),
             "returned_total": registry.register(Counter(
                 "tpu_dra_store_list_objects_returned_total",
-                "Objects deepcopied out of list() calls.")),
+                "Objects returned from list() calls (reference handouts "
+                "on the zero-copy path).")),
+            "copies_avoided": registry.register(Counter(
+                "tpu_dra_store_copies_avoided_total",
+                "Read-path deep copies avoided by handing out frozen "
+                "snapshot references, by path (get / list / watch).",
+                label_names=("path",))),
             "objects": registry.register(Gauge(
                 "tpu_dra_store_objects",
                 "Objects currently stored, by kind.",
@@ -640,13 +798,20 @@ class APIServer:
         self, kind: str, name: str, namespace: str, mutate: Callable[[K8sObject], None],
         attempts: int = 10,
     ) -> K8sObject:
-        """Get-mutate-update loop absorbing CAS conflicts."""
+        """Get-mutate-update loop absorbing CAS conflicts — the store's
+        copy-on-write seam: the mutator receives a thawed private working
+        copy of the current published snapshot, and the commit freezes it
+        back in place (``_owned``), structurally sharing every sub-object
+        the mutation left untouched with the prior revision."""
         last: Optional[ConflictError] = None
         for _ in range(attempts):
-            obj = self.get(kind, name, namespace)
-            mutate(obj)
+            work = self.get(kind, name, namespace)
+            if work.frozen:  # copy_reads mode already handed out a copy
+                work = work.thaw()
+            self.stats.write_copies += 1
+            mutate(work)
             try:
-                return self.update(obj)
+                return self.update(work, _owned=True)
             except ConflictError as e:
                 last = e
         raise last  # type: ignore[misc]
@@ -675,7 +840,10 @@ class APIServer:
         """Atomic snapshot + subscription — informer bootstrap. Holding the
         kind's shard lock across [subscribe, list] means no same-kind write
         is in flight: everything at or below the subscription watermark is
-        in the listing, everything above it reaches the queue."""
+        in the listing, everything above it reaches the queue. The
+        bootstrap listing is a reference handout like any other read —
+        the pre-freeze path deepcopied every object once per subscriber,
+        which at 16k nodes made each new informer a full-store copy."""
         shard = self._shard(kind)
         with shard.mu:
             q = self.watch(kind, name, namespace, maxsize=maxsize)
@@ -715,16 +883,18 @@ class APIServer:
 
     def dump_state(self) -> dict:
         """Consistent whole-store dump for the persistence snapshot: every
-        stored object (live references — the caller serializes under the
-        lock or treats them as frozen), the per-kind fingerprint tokens,
-        and the ring watermark separating already-snapshotted writes from
-        WAL records still in flight. Taken under the ordered all-shard
-        lock so no write is ever half-visible."""
+        stored object (the frozen snapshots themselves — immutable, so
+        safe to serialize after the locks drop, and each carries its
+        cached wire encoding so compaction re-serializes nothing), the
+        per-kind fingerprint tokens, and the ring watermark separating
+        already-snapshotted writes from WAL records still in flight.
+        Taken under the ordered all-shard lock so no write is ever
+        half-visible."""
         with self._locked_all():
             objects = []
             fps: Dict[str, Tuple[int, int]] = {}
             for shard in self._shards:
-                objects.extend(o.deepcopy() for o in shard.objects.values())
+                objects.extend(shard.objects.values())
                 fps.update(shard.fp)
             with self._ring_mu:
                 watermark = self._ring_seq
@@ -744,7 +914,7 @@ class APIServer:
                     raise ApiValueError("load_state on a non-empty store")
             for obj in objects:
                 shard = self._shard(obj.kind)
-                self._index_add(shard, self._key(obj), obj.deepcopy())
+                self._index_add(shard, self._key(obj), freeze(obj.deepcopy()))
             for kind, token in fps.items():
                 self._shard(kind).fp[kind] = (int(token[0]), int(token[1]))
             self._rv_counter = itertools.count(rv + 1)

@@ -82,7 +82,8 @@ class LeaderElector:
 
     def try_acquire_or_renew(self) -> bool:
         now = time.time()
-        lease = self.api.try_get(LEASE, self.lease_name, self.namespace)
+        lease = self.api.try_get(LEASE, self.lease_name, self.namespace,
+                                 copy=True)
         if lease is None:
             try:
                 self.api.create(Lease(
@@ -107,7 +108,8 @@ class LeaderElector:
             return False
 
     def release(self) -> None:
-        lease = self.api.try_get(LEASE, self.lease_name, self.namespace)
+        lease = self.api.try_get(LEASE, self.lease_name, self.namespace,
+                                 copy=True)
         if lease is not None and lease.holder == self.identity:
             lease.holder = ""
             lease.renewed_at = 0.0

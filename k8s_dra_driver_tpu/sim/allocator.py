@@ -326,6 +326,13 @@ class Allocator:
             "classes": {},  # DeviceClass name -> (driver, attrs, cel)
             "plans": {},  # content key -> (driver, _MatchPlan)
             "stats": _pass_stats(),
+            # id(result) -> (result, scores): placement scores of probes
+            # made this pass, observed once at commit(). Held OFF the
+            # AllocationResult itself — the result is installed verbatim
+            # into the stored claim and frozen at publish, so it must not
+            # carry mutable allocator bookkeeping. The strong ref pins the
+            # id against reuse; unclaimed entries die with the pass.
+            "pending_scores": {},
             "t0": time.perf_counter(),
         }
 
@@ -374,10 +381,9 @@ class Allocator:
         if self._pass_snapshot is not None and alloc is not None:
             self._pass_snapshot["allocations"].append(alloc)
             self._pass_snapshot["stats"]["commits"] += 1
-            scores = getattr(alloc, "_placement_scores", None)
-            if scores is not None:
-                del alloc._placement_scores  # observe exactly once
-                for score in scores:
+            entry = self._pass_snapshot["pending_scores"].pop(id(alloc), None)
+            if entry is not None and entry[0] is alloc:  # observe exactly once
+                for score in entry[1]:
                     self.metrics.placement_score.observe(value=score)
             self._accrue(self._pass_snapshot["consumed"],
                          self._pass_snapshot["index"], alloc, +1)
@@ -1064,11 +1070,12 @@ class Allocator:
                     )
                 )
         result = AllocationResult(devices=picked, node_name=node_name)
-        if chosen_scores:
+        if chosen_scores and self._pass_snapshot is not None:
             # Observed at commit(), never here: a successful probe the
             # caller then abandons (a sibling claim failed on this node,
             # or an outside-a-pass probe that is never committed) was not
             # "chosen", and the same claim re-probed elsewhere must not
             # double-count.
-            result._placement_scores = chosen_scores
+            self._pass_snapshot["pending_scores"][id(result)] = (
+                result, chosen_scores)
         return result

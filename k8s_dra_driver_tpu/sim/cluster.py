@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -776,6 +777,35 @@ class SimCluster:
         except Exception:  # noqa: BLE001 — repack is best-effort; a bad pass must not kill the sim
             log.exception("rebalance pass failed")
 
+    def _informer_backlog(self) -> int:
+        """Watch events delivered but not yet consumed by informer
+        threads (agents' single-pod informers, controller caches) — NOT
+        counting the sim's own pass queues, which by design drain at the
+        top of the next pass. Nonzero means some cache still lags the
+        store, so the cluster cannot be quiescent regardless of what the
+        kind fingerprints say."""
+        backlog = getattr(self.api, "watch_backlog", None)
+        if backlog is None:
+            return 0
+        own = sum(q.qsize() for q in self._watch_queues.values())
+        return max(0, backlog() - own)
+
+    def _yield_to_consumers(self, budget_s: float = 0.05) -> None:
+        """Give informer consumer threads the GIL until their queues
+        drain (bounded). The zero-copy store made steps fast enough that
+        a whole settle loop can finish before the OS ever schedules an
+        agent's informer thread — the step loop then reads a stale cache
+        and declares quiescence while a delivered event sits unconsumed
+        (the daemon keeps publishing ready=False off a pod snapshot one
+        revision behind the store)."""
+        if not self._informer_backlog():
+            return
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            time.sleep(0.001)
+            if not self._informer_backlog():
+                return
+
     def _quiescence_token(self) -> tuple:
         """O(1) change-detection over every kind the control loops touch.
         Two steps with identical tokens mean the second step wrote nothing
@@ -801,6 +831,9 @@ class SimCluster:
             pending += self.elastic.pending_retries()
             pending += self.elastic.in_flight
             pending += len(self._down_nodes)
+        # Unconsumed watch deliveries are pending work in exactly the same
+        # sense: the consumer thread will act on them, just hasn't run yet.
+        pending += self._informer_backlog()
         if pending:
             token += (pending, int(self.sim_time))
         return token
@@ -815,6 +848,7 @@ class SimCluster:
         pod_fp = None
         for _ in range(max_steps):
             self.step()
+            self._yield_to_consumers()
             fp = getattr(self.api, "kind_fingerprint", None)
             cur_pod_fp = fp(POD) if fp else None
             if cur_pod_fp is None or cur_pod_fp != pod_fp:
@@ -840,6 +874,7 @@ class SimCluster:
             if predicate(self):
                 return True
             self.step()
+            self._yield_to_consumers()
             token = self._quiescence_token()
             quiet = quiet + 1 if token == prev else 0
             prev = token

@@ -1,0 +1,59 @@
+"""tpulint fixture: snapshot-mutation must stay QUIET — sanctioned shapes."""
+
+import copy
+
+from some_objects import thaw  # fixture-local; the rule matches names
+
+
+def copy_opt_out(api):
+    pod = api.get("Pod", "p", "ns", copy=True)
+    pod.phase = "Running"              # private mutable copy: fine
+
+
+def deepcopy_rebind(api):
+    pod = api.get("Pod", "p", "ns").deepcopy()
+    pod.phase = "Running"              # rebound through deepcopy: fine
+
+    cd = api.try_get("ComputeDomain", "d", "ns")
+    cd = cd.deepcopy()
+    cd.status.status = "Ready"         # rebinding severs tracking
+
+
+def thaw_rebind(api):
+    clique = api.get("ComputeDomainClique", "c", "ns")
+    clique = thaw(clique)
+    clique.nodes.append(object())      # thawed working copy: fine
+
+    node = copy.deepcopy(api.get("Node", "n0"))
+    node.unschedulable = True          # copy.deepcopy: fine
+
+
+def cas_closure(api):
+    def mutate(obj):
+        obj.phase = "Running"          # closure param is the COW copy
+
+    api.update_with_retry("Pod", "p", "ns", mutate)
+    api.update_with_retry("Pod", "q", "ns",
+                          mutate=lambda obj: setattr(obj, "ready", True))
+
+
+def reads_are_fine(api, informer):
+    pod = api.get("Pod", "p", "ns")
+    phase = pod.phase                  # reads never fire
+    names = [p.meta.name for p in api.list("Pod")]
+    cached = informer.get("n0")
+    local = {"phase": phase, "names": names, "cached": cached}
+    local["phase"] = "Pending"         # plain dict, not a snapshot
+    return local
+
+
+def fresh_list_is_private(api):
+    pods = api.list("Pod", namespace="ns")
+    pods.append(object())              # the list ITSELF is a fresh handout
+    pods.sort(key=id)
+    return pods
+
+
+def dict_get_not_api(d):
+    obj = d.get("k")                   # dict.get: receiver is not API-ish
+    return obj

@@ -1,0 +1,40 @@
+"""tpulint fixture: snapshot-mutation MUST fire — every mutation class."""
+
+
+def direct_attr_write(api):
+    pod = api.get("Pod", "p", "ns")
+    pod.phase = "Running"              # 1: attribute write on a snapshot
+
+
+def try_get_nested_write(api):
+    cd = api.try_get("ComputeDomain", "d", "ns")
+    cd.status.status = "Ready"         # 2: nested attribute write
+
+
+def container_mutation(api):
+    clique = api.get("ComputeDomainClique", "c", "ns")
+    clique.nodes.append(object())      # 3: container mutator on a snapshot
+    clique.released.pop("n0", None)    # 4: another mutator
+
+
+def list_element_write(api):
+    pods = api.list("Pod", namespace="ns")
+    pods[0].ready = True               # 5: item write through the list
+    for p in pods:
+        p.node_name = "n1"             # 6: loop element is a snapshot too
+
+
+def informer_lister(informer):
+    node = informer.get("n0")
+    node.unschedulable = True          # 7: informer cache is shared
+
+
+def watch_event_payload(ev):
+    obj = ev.obj
+    obj.meta.labels["x"] = "y"         # 8: event payload is the snapshot
+
+
+def augassign_and_del(api):
+    claim = api.get("ResourceClaim", "c", "ns")
+    claim.generation += 1              # 9: augmented assignment
+    del claim.status                   # 10: attribute delete

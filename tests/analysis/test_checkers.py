@@ -48,6 +48,8 @@ CASES = [
     ("sleep-under-lock", "sleep_under_lock_pos.py", 5,
      "sleep_under_lock_neg.py"),
     ("cordon-cas", "cordon_cas_pos.py", 5, "cordon_cas_neg.py"),
+    ("snapshot-mutation", "snapshot_mutation_pos.py", 10,
+     "snapshot_mutation_neg.py"),
     ("metrics-docs", "docs_sync_pos.py", 1, "docs_sync_neg.py"),
     ("event-reasons", "docs_sync_pos.py", 2, "docs_sync_neg.py"),
 ]

@@ -160,7 +160,7 @@ def test_incremental_matches_fresh_pass(api):
     end_state = _normalize(alloc._consumed_for_node("n0"))
     alloc.end_pass()
 
-    stored = api.get("ResourceClaim", claim.meta.name, "default")
+    stored = api.get("ResourceClaim", claim.meta.name, "default", copy=True)
     stored.allocation = a
     api.update(stored)
     alloc.begin_pass()

@@ -389,7 +389,7 @@ def test_controller_teardown_on_delete():
             msg="finalizer",
         )
         # Simulate plugin having labeled the node and a clique existing.
-        node = api.get("Node", "n0")
+        node = api.get("Node", "n0", copy=True)
         node.meta.labels[COMPUTE_DOMAIN_NODE_LABEL] = cd.uid
         api.update(node)
         CliqueManager(api, NS, cd.uid, "slice-z.0").register("n0", "10.0.0.1")

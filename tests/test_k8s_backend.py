@@ -432,7 +432,7 @@ def test_kube_status_subresource_split(kube):
     assert back.status.status == "Ready"
     # The conformance server enforces the split: a raw main-resource PUT
     # (no /status leg) must NOT change status.
-    raw = store.get("ComputeDomain", "cd", "ns")
+    raw = store.get("ComputeDomain", "cd", "ns", copy=True)
     raw.status.status = "NotReady"
     import urllib.request, json as _json  # noqa: E401
     wire = to_k8s_wire(raw)

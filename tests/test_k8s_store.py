@@ -21,6 +21,11 @@ def make_pod(name, ns="default", **kw):
 
 
 def test_create_get_roundtrip_and_isolation():
+    from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (
+        expect_frozen_mutation,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import FrozenSnapshotError
+
     api = APIServer()
     p = make_pod("a")
     created = api.create(p)
@@ -29,8 +34,16 @@ def test_create_get_roundtrip_and_isolation():
     p.node_name = "mutated"
     got = api.get("Pod", "a", "default")
     assert got.node_name == ""
-    # Mutating what get() returned doesn't either.
-    got.node_name = "also-mutated"
+    # get() hands out the published snapshot itself: frozen — mutating
+    # it raises instead of silently diverging. (The poke is deliberate,
+    # so the sanitized run's write-after-publish detector stays quiet.)
+    with expect_frozen_mutation():
+        with pytest.raises(FrozenSnapshotError):
+            got.node_name = "also-mutated"
+    assert api.get("Pod", "a", "default").node_name == ""
+    # copy=True is the explicit opt-out: a private mutable copy.
+    mine = api.get("Pod", "a", "default", copy=True)
+    mine.node_name = "scratch"
     assert api.get("Pod", "a", "default").node_name == ""
 
 
@@ -45,8 +58,8 @@ def test_create_duplicate_rejected():
 def test_update_cas_conflict():
     api = APIServer()
     api.create(make_pod("a"))
-    fresh = api.get("Pod", "a", "default")
-    stale = api.get("Pod", "a", "default")
+    fresh = api.get("Pod", "a", "default", copy=True)
+    stale = api.get("Pod", "a", "default", copy=True)
     fresh.node_name = "n1"
     api.update(fresh)
     stale.node_name = "n2"
@@ -83,7 +96,7 @@ def test_finalizer_deletion_dance():
     api.create(make_pod("a", finalizers=["dra.tpu.google.com/finalizer"]))
     api.delete("Pod", "a", "default")
     # Still present, now deleting.
-    obj = api.get("Pod", "a", "default")
+    obj = api.get("Pod", "a", "default", copy=True)
     assert obj.deleting
     # Second delete is a no-op.
     api.delete("Pod", "a", "default")
@@ -182,7 +195,7 @@ def test_allocator_slice_cache_invalidates_on_slice_change():
     alloc.end_pass()
 
     # Republish with every chip tainted: the next pass must see it.
-    live = api.get(RESOURCE_SLICE, rs.meta.name)
+    live = api.get(RESOURCE_SLICE, rs.meta.name, copy=True)
     for d in live.devices:
         d.taints = [DeviceTaint(key="health", effect="NoSchedule")]
     api.update(live)
@@ -195,7 +208,7 @@ def test_watch_stream():
     api = APIServer()
     q = api.watch("Pod")
     api.create(make_pod("a"))
-    obj = api.get("Pod", "a", "default")
+    obj = api.get("Pod", "a", "default", copy=True)
     obj.node_name = "n"
     api.update(obj)
     api.delete("Pod", "a", "default")
@@ -219,7 +232,7 @@ def test_informer_cache_handlers_and_lister():
         assert inf.wait_for_cache_sync()
         assert adds == ["pre"]
         api.create(make_pod("post"))
-        obj = api.get("Pod", "post", "default")
+        obj = api.get("Pod", "post", "default", copy=True)
         obj.node_name = "n9"
         api.update(obj)
         api.delete("Pod", "post", "default")

@@ -154,12 +154,12 @@ def test_feasible_excludes_full_and_tainted_nodes():
     assert r is not None
     alloc.end_pass()
     # Persist the allocation so the next pass's snapshot sees n0 as full.
-    stored = api.get("ResourceClaim", "fill", "default")
+    stored = api.get("ResourceClaim", "fill", "default", copy=True)
     stored.allocation = r
     api.update(stored)
 
     # Taint every chip on n1 (the health -> republish chain's output).
-    rs = api.get(RESOURCE_SLICE, "n1-tpu.google.com")
+    rs = api.get(RESOURCE_SLICE, "n1-tpu.google.com", copy=True)
     for d in rs.devices:
         d.taints = [DeviceTaint(key="unhealthy", effect="NoSchedule")]
     api.update(rs)

@@ -71,7 +71,7 @@ def test_randomized_workload_matches_brute_force_oracle():
                 assert key in shadow  # duplicate create rejected
         elif op < 0.75:
             if key in shadow:
-                obj = api.get(kind, name, ns)
+                obj = api.get(kind, name, ns, copy=True)
                 labels = rng.choice(LABELS)
                 obj.meta.labels = dict(labels)
                 api.update(obj)
@@ -119,7 +119,7 @@ def test_fingerprint_tracks_finalizer_deletion_dance():
     fp2 = api.kind_fingerprint("Pod")
     assert fp2 != fp1
     assert len(api.list("Pod")) == 1
-    obj = api.get("Pod", "a", "default")
+    obj = api.get("Pod", "a", "default", copy=True)
     obj.meta.finalizers = []
     api.update(obj)  # finalizer dropped -> actually removed
     fp3 = api.kind_fingerprint("Pod")

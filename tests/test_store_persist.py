@@ -41,7 +41,7 @@ def _workload(api):
     api.create(Node(meta=new_meta("n0")))
     for i in range(0, 20, 3):
         api.delete(POD, f"p{i}", "default")
-    p = api.get(POD, "p1", "default")
+    p = api.get(POD, "p1", "default", copy=True)
     p.node_name = "n0"
     api.update(p)
     # Finalizer dance: deleting-but-present state must survive restart.

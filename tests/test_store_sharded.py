@@ -55,7 +55,7 @@ def _churn(api, kind, seed, ops, log):
                 api.create(obj)
                 log.append(("PUT", name))
             elif r < 0.8:
-                got = api.get(kind, name, "default")
+                got = api.get(kind, name, "default", copy=True)
                 got.meta.labels["touched"] = "1"
                 api.update(got)
                 log.append(("PUT", name))
@@ -211,7 +211,7 @@ def test_single_lock_baseline_flag_serves_full_api():
     api = APIServer(shards=1)
     q = api.watch(POD)
     api.create(Pod(meta=new_meta("a", "default")))
-    obj = api.get(POD, "a", "default")
+    obj = api.get(POD, "a", "default", copy=True)
     obj.node_name = "n"
     api.update(obj)
     api.delete(POD, "a", "default")

@@ -1,0 +1,341 @@
+"""Zero-copy store contract: frozen reference handouts on every read path.
+
+The scale-out read path hands out the published snapshot ITSELF — get(),
+list(), watch fan-out, informer bootstrap and cache all return references,
+not copies. These tests pin the contract that makes that safe:
+
+- every handed-out object is a sealed frozen snapshot, and EVERY mutation
+  vector (attribute set/delete, dict and list mutators, nested sub-object
+  writes) raises ``FrozenSnapshotError`` from every access path,
+- the explicit opt-outs (``copy=True``, ``.thaw()``, ``.deepcopy()``)
+  return private mutable copies that cannot reach the published state,
+- copy-on-write commits structurally share unchanged sub-objects with the
+  prior revision by IDENTITY (a status-only update does not duplicate the
+  spec),
+- WAL records splice the serialize-once cached encoding and the restore
+  is fingerprint-token-identical,
+- randomized threaded churn with zero-copy readers at shards=1/8/16
+  performs ZERO read-path copies and never hands out an unfrozen object.
+
+Deliberate seal pokes are wrapped in ``expect_frozen_mutation`` so a
+``TPU_SAN=1`` sanitized run of this suite stays clean: the sanitizer's
+write-after-publish detector must stay quiet for asserted-on mutations.
+"""
+
+import random
+import threading
+
+import pytest
+
+from k8s_dra_driver_tpu.analysis.sanitizer.runtime import (
+    expect_frozen_mutation,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import (
+    NODE,
+    POD,
+    RESOURCE_CLAIM,
+    AllocationResult,
+    DeviceRequest,
+    DeviceRequestAllocationResult,
+    Node,
+    Pod,
+    ResourceClaim,
+)
+from k8s_dra_driver_tpu.k8s.informer import Informer
+from k8s_dra_driver_tpu.k8s.objects import (
+    FrozenSnapshotError,
+    is_frozen,
+    new_meta,
+)
+from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
+from k8s_dra_driver_tpu.k8s.serialize import wire_json
+
+
+def _pod(name, **labels):
+    return Pod(meta=new_meta(name, "default", labels=labels or {"app": "x"}),
+               phase="Pending")
+
+
+# Every mutation vector a consumer could aim at a handed-out snapshot.
+# Each must raise FrozenSnapshotError — the seal covers attribute writes,
+# deletes, and all container mutators, on the object AND its sub-objects.
+MUTATIONS = [
+    ("attr-set", lambda o: setattr(o, "phase", "Running")),
+    ("attr-del", lambda o: delattr(o, "phase")),
+    ("meta-attr-set", lambda o: setattr(o.meta, "name", "hijack")),
+    ("label-setitem", lambda o: o.meta.labels.__setitem__("k", "v")),
+    ("label-delitem", lambda o: o.meta.labels.__delitem__("app")),
+    ("label-pop", lambda o: o.meta.labels.pop("app")),
+    ("label-popitem", lambda o: o.meta.labels.popitem()),
+    ("label-clear", lambda o: o.meta.labels.clear()),
+    ("label-update", lambda o: o.meta.labels.update({"a": "b"})),
+    ("label-setdefault", lambda o: o.meta.labels.setdefault("z", "1")),
+    ("fin-append", lambda o: o.meta.finalizers.append("f")),
+    ("fin-extend", lambda o: o.meta.finalizers.extend(["f"])),
+    ("fin-insert", lambda o: o.meta.finalizers.insert(0, "f")),
+    ("fin-setitem", lambda o: o.meta.finalizers.__setitem__(0, "f")),
+    ("fin-sort", lambda o: o.meta.finalizers.sort()),
+    ("fin-reverse", lambda o: o.meta.finalizers.reverse()),
+    ("fin-clear", lambda o: o.meta.finalizers.clear()),
+    ("cond-append", lambda o: o.conditions.append(None)),
+]
+
+
+def _assert_sealed(obj):
+    """The handed-out reference is frozen and every mutation vector
+    bounces. The pokes are DELIBERATE (we assert the seal holds), so
+    they are marked expected for the sanitized-suite detector."""
+    assert is_frozen(obj), f"read path handed out an unfrozen {obj.key}"
+    assert is_frozen(obj.meta)
+    for name, poke in MUTATIONS:
+        with expect_frozen_mutation():
+            with pytest.raises(FrozenSnapshotError):
+                poke(obj)
+    # The seal is an AttributeError subclass: callers that defensively
+    # `except AttributeError` around dynamic attr writes keep working.
+    with expect_frozen_mutation():
+        with pytest.raises(AttributeError):
+            obj.phase = "Running"
+
+
+def test_every_read_path_hands_out_sealed_snapshots():
+    api = APIServer(shards=4)
+    q = api.watch(POD)
+    inf = Informer(api, POD)
+
+    created = api.create(_pod("p0"))
+    _assert_sealed(created)  # create() returns the published snapshot
+
+    _assert_sealed(api.get(POD, "p0", "default"))
+    (listed,) = api.list(POD)
+    _assert_sealed(listed)
+
+    ev = q.get(timeout=5)
+    assert ev.type == "ADDED"
+    _assert_sealed(ev.obj)
+
+    # Informer bootstrap (list_and_watch reference handout) + lister.
+    inf.start()
+    try:
+        assert inf.wait_for_cache_sync()
+        cached = inf.get("p0", "default")
+        _assert_sealed(cached)
+        (from_list,) = inf.list()
+        _assert_sealed(from_list)
+        # The cache holds the SAME published snapshot the store serves —
+        # a reference, not a per-informer copy.
+        assert cached is api.get(POD, "p0", "default")
+
+        # Event-driven cache path: a CAS commit must land the NEW frozen
+        # revision in the cache (still by reference).
+        api.update_with_retry(POD, "p0", "default",
+                              lambda p: setattr(p, "phase", "Running"))
+        api.flush_watchers()
+        fresh = api.get(POD, "p0", "default")
+        for _ in range(200):
+            got = inf.get("p0", "default")
+            if got is fresh:
+                break
+            threading.Event().wait(0.01)
+        assert inf.get("p0", "default") is fresh
+        _assert_sealed(fresh)
+    finally:
+        inf.stop()
+
+
+def test_opt_outs_return_private_mutable_copies():
+    api = APIServer(shards=2)
+    api.create(_pod("p0"))
+
+    published = api.get(POD, "p0", "default")
+    for work in (api.get(POD, "p0", "default", copy=True),
+                 api.list(POD, copy=True)[0],
+                 published.thaw(),
+                 published.deepcopy()):
+        assert not is_frozen(work)
+        assert work is not published
+        work.phase = "Running"
+        work.meta.labels["scratch"] = "1"
+        work.meta.finalizers.append("f")
+    # None of that reached the published snapshot.
+    again = api.get(POD, "p0", "default")
+    assert again is published
+    assert again.phase == "Pending"
+    assert "scratch" not in again.meta.labels
+    assert not again.meta.finalizers
+
+
+def test_status_only_cas_shares_spec_by_identity():
+    api = APIServer(shards=2)
+    api.create(ResourceClaim(
+        meta=new_meta("c0", "default", labels={"tier": "gold"}),
+        requests=[DeviceRequest(name="tpu", device_class_name="tpu.google.com",
+                                count=4)],
+    ))
+    prior = api.get(RESOURCE_CLAIM, "c0", "default")
+
+    def allocate(claim):
+        claim.allocation = AllocationResult(
+            devices=[DeviceRequestAllocationResult(
+                request="tpu", driver="tpu.google.com", pool="n0",
+                device="chip-0")],
+            node_name="n0",
+        )
+
+    committed = api.update_with_retry(RESOURCE_CLAIM, "c0", "default",
+                                      allocate)
+    assert committed is api.get(RESOURCE_CLAIM, "c0", "default")
+    assert committed is not prior
+    assert committed.meta.resource_version > prior.meta.resource_version
+
+    # The status write landed...
+    assert committed.allocation.node_name == "n0"
+    assert prior.allocation is None  # ...and the prior revision is intact.
+
+    # ...and every untouched sub-object is shared BY IDENTITY with the
+    # prior frozen revision: one spec per object, not one per status
+    # write. (Equality would pass for a deep copy; `is` pins sharing.)
+    assert committed.requests is prior.requests
+    assert committed.requests[0] is prior.requests[0]
+    assert committed.meta.labels is prior.meta.labels
+    assert committed.meta.annotations is prior.meta.annotations
+    assert is_frozen(committed.requests)
+
+    # A second status-only pass shares the same spec again.
+    again = api.update_with_retry(
+        RESOURCE_CLAIM, "c0", "default",
+        lambda c: setattr(c.allocation, "node_name", "n1"))
+    assert again.requests is prior.requests
+    assert again.meta.labels is prior.meta.labels
+
+
+def test_wal_records_reuse_cached_encoding_and_restore_is_identical(tmp_path):
+    d = str(tmp_path / "store")
+    api = open_persistent_store(d, shards=4)
+    for i in range(16):
+        api.create(_pod(f"p{i}", idx=str(i)))
+    for i in range(0, 16, 2):
+        api.update_with_retry(POD, f"p{i}", "default",
+                              lambda p: setattr(p, "phase", "Running"))
+    for i in range(12, 16):
+        api.delete(POD, f"p{i}", "default")
+    api.create(Node(meta=new_meta("n0")))
+    api.flush_watchers()  # drain group-commit so every record is on disk
+
+    # Serialize-once: the WAL append already encoded each published
+    # snapshot and cached the string on the frozen instance — a second
+    # consumer (compaction, the HTTP watch stream, this call) reuses it.
+    got = api.get(POD, "p0", "default")
+    body, reused = wire_json(got)
+    assert reused, "published snapshot should carry its cached encoding"
+    body2, reused2 = wire_json(got)
+    assert reused2 and body2 is body
+    # The cache dies with the seal: a working copy re-encodes fresh.
+    _, reused_thawed = wire_json(got.thaw())
+    assert not reused_thawed
+
+    fps = {k: api.kind_fingerprint(k) for k in (POD, NODE, RESOURCE_CLAIM)}
+    contents = {o.key: (o.meta.resource_version, o.phase)
+                for o in api.list(POD)}
+
+    restored = open_persistent_store(d, shards=4)
+    try:
+        assert {k: restored.kind_fingerprint(k)
+                for k in (POD, NODE, RESOURCE_CLAIM)} == fps
+        assert {o.key: (o.meta.resource_version, o.phase)
+                for o in restored.list(POD)} == contents
+        # The restore republishes: handouts are sealed references again.
+        back = restored.get(POD, "p0", "default")
+        _assert_sealed(back)
+        assert back.phase == "Running"
+    finally:
+        restored._wal.close()
+
+
+@pytest.mark.parametrize("shards", [1, 8, 16])
+def test_threaded_churn_on_the_reference_handout_path(shards):
+    """Writers churn three kinds through create/CAS/delete while reader
+    threads hammer the zero-copy get()/list() path: every handout is a
+    sealed snapshot with internally consistent metadata, and at the end
+    the store performed ZERO read-path deep copies — the 16k-node settle
+    gate's invariant, exercised under real threads at every shard
+    layout."""
+    api = APIServer(shards=shards)
+    kinds = {
+        POD: lambda name: _pod(name),
+        RESOURCE_CLAIM: lambda name: ResourceClaim(
+            meta=new_meta(name, "default"),
+            requests=[DeviceRequest(name="tpu", count=1)]),
+        NODE: lambda name: Node(meta=new_meta(name)),
+    }
+    stop = threading.Event()
+    errors = []
+
+    def writer(kind, make, seed):
+        rng = random.Random(seed)
+        names = [f"{kind.lower()}-{i}" for i in range(6)]
+        ns = "default" if kind != NODE else ""
+        try:
+            for _ in range(150):
+                name = rng.choice(names)
+                r = rng.random()
+                try:
+                    if r < 0.5:
+                        api.create(make(name))
+                    elif r < 0.8:
+                        api.update_with_retry(
+                            kind, name, ns,
+                            lambda o: o.meta.labels.__setitem__(
+                                "gen", str(rng.random())))
+                    else:
+                        api.delete(kind, name, ns)
+                except Exception as e:
+                    if type(e).__name__ not in ("NotFoundError",
+                                                "AlreadyExistsError"):
+                        raise
+        except Exception as e:  # noqa: BLE001 — surfaced in the main thread
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def reader(kind, seed):
+        rng = random.Random(seed)
+        ns = "default" if kind != NODE else ""
+        try:
+            while not stop.is_set():
+                for obj in api.list(kind, namespace=ns or None):
+                    if not is_frozen(obj):
+                        raise AssertionError(
+                            f"unfrozen handout from list(): {obj.key}")
+                    assert obj.meta.resource_version > 0
+                got = api.try_get(kind, f"{kind.lower()}-{rng.randrange(6)}",
+                                  ns)
+                if got is not None and not is_frozen(got):
+                    raise AssertionError(
+                        f"unfrozen handout from get(): {got.key}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=writer, args=(k, mk, i))
+               for i, (k, mk) in enumerate(kinds.items())]
+    threads += [threading.Thread(target=reader, args=(k, 100 + i))
+                for i, k in enumerate(kinds)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+
+    # The entire run — hundreds of list() sweeps and gets across three
+    # kinds — handed out references only.
+    assert api.stats.read_copies == 0
+    assert api.stats.copies_avoided > 0
+    for kind in kinds:
+        ns = "default" if kind != NODE else None
+        for obj in api.list(kind, namespace=ns):
+            assert is_frozen(obj)
+    for pod in api.list(POD, namespace="default"):
+        _assert_sealed(pod)
