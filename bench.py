@@ -1963,6 +1963,196 @@ def bench_telemetry(storm_claims: int = 64, iters: int = 110, runs: int = 2,
     return out
 
 
+def bench_history(rollup_nodes: int = 1024, passes: int = 101, runs: int = 2,
+                  decision_objects: int = 100, decisions_each: int = 100,
+                  explain_iters: int = 200,
+                  assert_budget: bool = False) -> dict:
+    """Flight-recorder cost benchmark (docs/reference/history.md).
+
+    Three hard gates (``assert_budget=True`` in make bench-smoke):
+
+    (a) **Recorder overhead** — the ``rollup_nodes``-node telemetry
+        rollup pass (the bench_telemetry storm shape, steady load after
+        one warm pass) with the HistoryStore attached vs detached: p99
+        per-pass wall with the recorder on must be within 5% of off.
+        The recorder feed is change-gated (telemetry's HISTORY_QUANTUM
+        discipline), so the steady path the gate measures is one dict
+        probe per series — a recorder that pushes (or serializes, or
+        locks) per sample per pass costs ~10 us x 3k series and blows
+        the gate instantly. Measured as interleaved (off, on) pairs,
+        overhead = the best pair's ratio — the bench_telemetry noise
+        discipline.
+    (b) **Explain latency** — with ``decision_objects * decisions_each``
+        DecisionRecords retained (the 10k-decision point) plus events
+        and a full raw+1m telemetry ring, ``explain_object`` p99 must
+        stay under a hard 50 ms budget, and retention must be exact
+        (nothing silently trimmed below the declared caps).
+    (c) **Restore fingerprint** — a WAL'd store must reopen
+        fingerprint-identical after close, and again after a
+        checkpoint+reopen cycle (segments folded into the snapshot) —
+        restart keeps history, byte-for-byte of retained state.
+    """
+    import os
+
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainNode,
+        ComputeDomainSpec,
+    )
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.core import Pod, ResourceClaim
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.pkg.events import EventRecorder, REASON_SCHEDULED
+    from k8s_dra_driver_tpu.pkg.history import RULE_SCHED_BIND, HistoryStore
+    from k8s_dra_driver_tpu.pkg.metrics import Registry
+    from k8s_dra_driver_tpu.pkg.telemetry import (
+        ClaimChips,
+        NodeView,
+        TelemetryAggregator,
+        WindowStats,
+    )
+    from k8s_dra_driver_tpu.sim.kubectl import explain_object
+
+    out: dict = {}
+    shm = "/dev/shm" if os.access("/dev/shm", os.W_OK) else None
+    hosts_per_domain = 4
+
+    # -- (a) rollup storm, recorder on vs off --------------------------------
+
+    def build_views():
+        api = APIServer()
+        for i in range(rollup_nodes):
+            api.create(ResourceClaim(meta=new_meta(f"claim-{i}", "default")))
+        for d in range(rollup_nodes // hosts_per_domain):
+            cd = ComputeDomain(
+                meta=new_meta(f"cd-{d}", "default"),
+                spec=ComputeDomainSpec(num_nodes=hosts_per_domain))
+            cd.status.nodes = [
+                ComputeDomainNode(name=f"node-{d * hosts_per_domain + j}")
+                for j in range(hosts_per_domain)
+            ]
+            api.create(cd)
+        stats = WindowStats(count=120, last=0.6, min=0.55, max=0.7,
+                            mean=0.6, p95=0.65, span_seconds=119.0)
+        views = [
+            NodeView(
+                node=f"node-{i}",
+                duty={c: stats for c in range(4)},
+                hbm_used={c: stats for c in range(4)},
+                hbm_total={c: 16 << 30 for c in range(4)},
+                link_util=stats,
+                claims=[ClaimChips(uid=f"uid-{i}", name=f"claim-{i}",
+                                   namespace="default", chips=(0, 1, 2, 3))],
+            )
+            for i in range(rollup_nodes)
+        ]
+        return api, views
+
+    def rollup_p99(with_history: bool) -> float:
+        api, views = build_views()
+        agg = TelemetryAggregator(api, Registry())
+        with tempfile.TemporaryDirectory(dir=shm) as tmp:
+            if with_history:
+                agg.history = HistoryStore(os.path.join(tmp, "history"))
+            # Warm pass: first sight of every series pushes it (and, off,
+            # writes every summary) — the gate measures steady state.
+            agg.rollup(1.0, views)
+            lat = []
+            for p in range(passes):
+                t0 = time.perf_counter()
+                agg.rollup(float(p + 2), views)
+                lat.append(time.perf_counter() - t0)
+            if agg.history is not None:
+                agg.history.close()
+            agg.close()
+        return sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    overhead = p99_off = p99_on = None
+    for _ in range(runs):
+        off = rollup_p99(False)
+        on = rollup_p99(True)
+        ratio = on / off - 1.0
+        if overhead is None or ratio < overhead:
+            overhead, p99_off, p99_on = ratio, off, on
+    out["history_rollup_nodes"] = rollup_nodes
+    out["history_rollup_p99_off_ms"] = round(p99_off * 1e3, 3)
+    out["history_rollup_p99_on_ms"] = round(p99_on * 1e3, 3)
+    out["history_overhead_pct"] = round(overhead * 100.0, 2)
+    if assert_budget:
+        assert overhead <= 0.05, (
+            f"flight recorder added {overhead * 100:.1f}% p99 to the "
+            f"{rollup_nodes}-node rollup storm (gate: <=5%) — a per-push "
+            f"lock or I/O stall is on the telemetry hot path")
+
+    # -- (b) explain p99 at 10k retained decisions ---------------------------
+    api = APIServer()
+    hist = HistoryStore(None)
+    api.history = hist
+    recorder = EventRecorder(api, "bench")
+    total = decision_objects * decisions_each
+    for i in range(decision_objects):
+        pod = Pod(meta=new_meta(f"p{i}", "default"))
+        api.create(pod)
+        recorder.normal(pod, REASON_SCHEDULED, f"assigned to node-{i % 64}")
+    for j in range(decisions_each):
+        for i in range(decision_objects):
+            hist.decide(
+                controller="scheduler", rule=RULE_SCHED_BIND,
+                outcome="bound", kind="Pod", namespace="default",
+                name=f"p{i}", message=f"pass {j}",
+                inputs={"node": f"node-{j % 64}"}, now=float(j))
+    # A hot claim with a full raw ring + 1m tier keeps the sparkline
+    # path inside the measured loop.
+    claim = ResourceClaim(meta=new_meta("hot-claim", "default"))
+    api.create(claim)
+    for k in range(480):
+        hist.push("claim-duty/default/hot-claim", float(k), (k % 10) / 10.0)
+    lat = []
+    for it in range(explain_iters):
+        kind, name = (("ResourceClaim", "hot-claim") if it % 10 == 0
+                      else ("Pod", f"p{it % decision_objects}"))
+        t0 = time.perf_counter()
+        explain_object(api, kind, name, "default")
+        lat.append(time.perf_counter() - t0)
+    p99_explain = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+    out["history_decisions_retained"] = hist.decision_count()
+    out["history_explain_p99_ms"] = round(p99_explain * 1e3, 3)
+    if assert_budget:
+        assert hist.decision_count() == total, (
+            f"{hist.decision_count()} decisions retained of {total} "
+            f"recorded — trimmed below the declared caps")
+        assert p99_explain <= 0.05, (
+            f"explain p99 {p99_explain * 1e3:.1f}ms at {total} retained "
+            f"decisions (budget 50ms) — the timeline walk left O(1) "
+            f"per-object land")
+
+    # -- (c) restore fingerprint ---------------------------------------------
+    with tempfile.TemporaryDirectory(dir=shm) as tmp:
+        d = os.path.join(tmp, "history")
+        h1 = HistoryStore(d)
+        for k in range(300):
+            h1.push("node-duty/bench-0", float(k), (k % 8) / 8.0)
+        for j in range(40):
+            h1.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                      outcome="bound", kind="Pod", namespace="default",
+                      name="fp-pod", message=f"pass {j}", now=float(j))
+        fp1 = h1.fingerprint()
+        h1.close()
+        h2 = HistoryStore(d)
+        fp2 = h2.fingerprint()
+        h2.checkpoint()
+        h2.close()
+        h3 = HistoryStore(d)
+        fp3 = h3.fingerprint()
+        h3.close()
+    out["history_restore_fingerprint_ok"] = (fp1 == fp2 == fp3)
+    if assert_budget:
+        assert fp1 == fp2 == fp3, (
+            f"restore fingerprint drifted: {fp1[:12]} -> {fp2[:12]} -> "
+            f"{fp3[:12]} — replay/checkpoint is not state-identical")
+    return out
+
+
 def bench_autoscaler(num_nodes: int = 1024, tick_s: float = 300.0,
                      assert_budget: bool = False) -> dict:
     """Serving autoscaler closed-loop benchmark (docs/reference/
@@ -2658,6 +2848,11 @@ def main() -> None:
         # sampling thread on, 1024-node rollup pass inside budget with
         # zero store list() calls, constant load -> exactly 1 status write.
         result.update(bench_telemetry(assert_budget=True))
+        # Flight-recorder gates: <=5% p99 overhead on the 1024-node
+        # rollup storm with the HistoryStore attached, explain p99 under
+        # 50ms at 10k retained decisions (exact retention), WAL restore
+        # fingerprint-identical across close/reopen and checkpoint.
+        result.update(bench_history(assert_budget=True))
         # Serving-autoscaler gates (24h-compressed diurnal+burst day at
         # 1024 nodes, BENCH_AUTOSCALER_NODES overrides): SLO violation
         # minutes strictly below the static baseline, wasted chip-hours
@@ -2724,6 +2919,13 @@ def main() -> None:
         result.update(bench_telemetry())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["telemetry_error"] = str(e)[:200]
+    try:
+        # Flight recorder: rollup-storm overhead with the HistoryStore
+        # attached, explain latency at 10k retained decisions, WAL
+        # restore fingerprint consistency.
+        result.update(bench_history())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["history_error"] = str(e)[:200]
     try:
         # Serving autoscaler: closed-loop vs static allocation over the
         # compressed 24h day (violation minutes, wasted chip-hours,

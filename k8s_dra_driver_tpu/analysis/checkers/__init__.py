@@ -12,6 +12,7 @@ from k8s_dra_driver_tpu.analysis.checkers import (  # noqa: F401
     wire_drift,
     metric_discipline,
     event_discipline,
+    decision_discipline,
     swallowed_exceptions,
     thread_shared_state,
     shard_lock,

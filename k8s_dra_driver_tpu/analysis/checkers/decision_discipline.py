@@ -1,0 +1,147 @@
+"""decision-discipline: flight-recorder rule ids only via RULE_* constants.
+
+The PR 17 flight recorder (``pkg/history.py``) keys every controller
+DecisionRecord on a ``rule`` id — the string operators grep, alert on,
+and ``tpu-kubectl explain`` renders. The catalog lives in ONE place:
+
+- every ``decide(...)`` call must pass ``rule=`` as a ``RULE_*``
+  constant reference, never an inline string (an inline id forks the
+  catalog silently and breaks the explain/docs cross-reference);
+- ``RULE_*`` constants are defined only in ``pkg/history.py``;
+- rule id values follow the ``component/kebab-action`` shape
+  (``scheduler/bind``, ``preemption/evict-lower-tier``) so the explain
+  column groups by emitting controller;
+- every rule id is catalogued in ``docs/reference/history.md``
+  (collect/finalize, the metrics-docs discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Tuple
+
+from k8s_dra_driver_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    register_checker,
+)
+
+_IMPL = "k8s_dra_driver_tpu/pkg/history.py"
+_DOC = "docs/reference/history.md"
+_RULE_NAME = re.compile(r"^RULE_[A-Z0-9_]+$")
+_RULE_VALUE = re.compile(r"^[a-z0-9]+(-[a-z0-9]+)*/[a-z0-9]+(-[a-z0-9]+)*$")
+
+
+def _iter_rule_constants(
+    tree: ast.AST,
+) -> Iterator[Tuple[str, str, int]]:
+    """Every ``RULE_* = "<literal>"`` assignment: (name, value, line)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id.startswith("RULE_"):
+                yield tgt.id, node.value.value, node.lineno
+                break
+
+
+def _rule_kwarg(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "rule":
+            return kw.value
+    return None
+
+
+def _terminal_name(expr: ast.AST) -> str:
+    """The identifier a Name/Attribute reference resolves through:
+    ``RULE_EVICT`` and ``history.RULE_EVICT`` both -> ``RULE_EVICT``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+@register_checker
+class DecisionDisciplineChecker(Checker):
+    rule = "decision-discipline"
+    description = ("flight-recorder decide() rule ids only via RULE_* "
+                   "constants from pkg/history.py, component/kebab-action "
+                   "shaped, catalogued in docs/reference/history.md")
+    hint = ("pass rule=RULE_<X> imported from pkg/history.py (add the "
+            "constant there and catalogue it in docs/reference/history.md)")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        if sf.rel == _IMPL:
+            return findings
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "decide"):
+                continue
+            rule_node = _rule_kwarg(node)
+            if rule_node is None:
+                continue  # positional misuse fails at runtime (kw-only)
+            if (isinstance(rule_node, ast.Constant)
+                    and isinstance(rule_node.value, str)):
+                findings.append(self.finding(
+                    sf, rule_node,
+                    f"inline decision rule id {rule_node.value!r} — use a "
+                    f"RULE_* constant from pkg/history.py so the catalog "
+                    f"and explain/docs cross-references stay the single "
+                    f"source"))
+                continue
+            name = _terminal_name(rule_node)
+            if name and not _RULE_NAME.match(name):
+                findings.append(self.finding(
+                    sf, rule_node,
+                    f"decision rule passed through {name!r} — pass the "
+                    f"RULE_* constant directly at the decide() call site "
+                    f"so provenance stays greppable"))
+        return findings
+
+    def collect(self, sf: SourceFile):
+        # The lint engine's own RULE_* constants (RULE_SUPPRESSION,
+        # RULE_PARSE, checker rule ids) are a different namespace.
+        if sf.rel.startswith("k8s_dra_driver_tpu/analysis/"):
+            return None
+        rules = list(_iter_rule_constants(sf.tree))
+        return rules or None
+
+    def finalize(self, project: Project, facts) -> List[Finding]:
+        body = project.read(_DOC)
+        findings: List[Finding] = []
+        if body is None:
+            return [self.finding(_DOC, 1, f"{_DOC} missing")]
+        declared = 0
+        for rel, rules in facts:
+            for name, value, lineno in rules:
+                declared += 1
+                if rel != _IMPL:
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"rule constant {name} defined outside "
+                        f"pkg/history.py — the decision-rule catalog has "
+                        f"one home"))
+                if not _RULE_VALUE.match(value):
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"rule id {value!r} is not component/kebab-action "
+                        f"shaped (e.g. 'scheduler/bind')"))
+                if f"`{value}`" not in body:
+                    findings.append(self.finding(
+                        rel, lineno,
+                        f"rule id {value!r} missing from the {_DOC} "
+                        f"catalog"))
+        if _IMPL in project.analyzed and not declared:
+            findings.append(self.finding(
+                _IMPL, 1,
+                "no RULE_* constants found in a package-wide run — "
+                "scanner broken?"))
+        return findings

@@ -36,7 +36,10 @@ class StoreScanChecker(Checker):
             "Python), or use try_get/feasibility indexes")
     scope = ("k8s_dra_driver_tpu/sim/", "k8s_dra_driver_tpu/controller/",
              "k8s_dra_driver_tpu/autoscaler/",
-             "k8s_dra_driver_tpu/scheduling/")
+             "k8s_dra_driver_tpu/scheduling/",
+             # The flight recorder feeds every pass and the explain path
+             # walks the store per command — same hot-loop discipline.
+             "k8s_dra_driver_tpu/pkg/history.py")
 
     def check_file(self, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []

@@ -51,6 +51,11 @@ test suite:
       copy-on-write CAS commits racing the reference-handout watch
       fan-out and a telemetry ``get()`` pass over the same object; every
       handout must be a frozen snapshot and no CAS commit may be lost.
+  11. ``history-rollover-vs-explain`` — the flight recorder's writer
+      (raw-ring rollover, 1m/10m bucket seals, decision appends) racing
+      an explain-shaped reader walking query()/decisions_for(): no torn
+      bucket ever escapes, point/decision order stays monotonic, and the
+      LRU bounds hold mid-churn.
 
 - ``FIXTURES`` — seeded violations proving each detector class fires
   deterministically on ANY seed and at ANY worker count (the fillers):
@@ -1141,6 +1146,82 @@ def scenario_store_frozen_readers(state: SanitizerState, seed: int,
                f"lost across the race")
 
 
+# -- scenario 11: history tier rollover vs. explain query ---------------------
+
+
+def scenario_history_rollover_vs_explain(state: SanitizerState, seed: int,
+                                         extra_workers: int = 0) -> None:
+    """The PR 17 flight recorder under race: a telemetry-shaped writer
+    pushing samples that roll the raw ring and seal 1m/10m buckets (plus
+    DecisionRecords on one pod) while an explain-shaped reader walks
+    ``query()``/``decisions_for()``/``series_names()`` concurrently. A
+    clean run proves no torn bucket escapes the lock (count >= 1 and
+    min <= mean <= max with p95 inside [min, max] on every observed
+    bucket), point and decision order stay monotonic, and the series-LRU
+    and raw-ring bounds hold mid-churn — bounded memory is an invariant
+    here, not a hope."""
+    from k8s_dra_driver_tpu.pkg.history import (
+        HistoryStore,
+        RULE_SCHED_BIND,
+    )
+
+    h = HistoryStore(None, raw_capacity=16, max_series=4)
+    pushes = 18
+
+    def writer():
+        for i in range(pushes):
+            t = i * 13.0  # crosses a 1m bucket edge every ~5 pushes
+            h.push(f"duty/{i % 6}", t, (i % 10) / 10.0)  # LRU churn
+            h.push("duty/hot", t, (i % 7) / 7.0)
+            if i % 3 == 0:
+                h.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                         outcome="bound", kind="Pod", namespace="default",
+                         name="explain-pod", message=f"pass {i}", now=t)
+            state.yield_point(("scenario", "history-push"))
+
+    def explainer():
+        for _ in range(pushes):
+            for res in ("raw", "1m", "10m"):
+                last_t = None
+                for p in h.query("duty/hot", resolution=res):
+                    _invariant(
+                        state, last_t is None or p["t"] >= last_t,
+                        f"{res} points observed out of order "
+                        f"({last_t} then {p['t']}) — a reader saw a "
+                        f"half-rolled ring")
+                    last_t = p["t"]
+                    if res != "raw":
+                        _invariant(
+                            state,
+                            p["count"] >= 1
+                            and p["min"] <= p["mean"] <= p["max"]
+                            and p["min"] <= p["p95"] <= p["max"],
+                            f"torn {res} bucket escaped the lock: {p}")
+            _invariant(state, len(h.series_names()) <= 4,
+                       "series LRU bound exceeded mid-churn")
+            decs = h.decisions_for("Pod", "default", "explain-pod")
+            times = [d.time for d in decs]
+            _invariant(state, times == sorted(times),
+                       f"decision history not oldest-first: {times}")
+            _invariant(state,
+                       all(d.rule == RULE_SCHED_BIND for d in decs),
+                       "a decision record was torn across append")
+            state.yield_point(("scenario", "explain-walk"))
+
+    explore(state, seed,
+            [("writer", writer), ("explainer", explainer)]
+            + _fillers(state, extra_workers))
+
+    _invariant(state, len(h.query("duty/hot")) <= 16,
+               "raw ring exceeded its capacity at quiescence")
+    _invariant(state, len(h.series_names()) <= 4,
+               "series LRU bound exceeded at quiescence")
+    want = len(range(0, pushes, 3))
+    _invariant(state, h.decision_count() == want,
+               f"{h.decision_count()} decisions retained after {want} "
+               f"appends — a record was lost or duplicated across the race")
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -1153,6 +1234,7 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
     "resize-vs-rebalancer": scenario_resize_vs_rebalancer,
     "preempt-vs-rebalancer": scenario_preempt_vs_rebalancer,
     "store-frozen-readers": scenario_store_frozen_readers,
+    "history-rollover-vs-explain": scenario_history_rollover_vs_explain,
 }
 
 

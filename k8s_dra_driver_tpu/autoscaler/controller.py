@@ -90,6 +90,12 @@ from k8s_dra_driver_tpu.pkg.events import (
     REASON_SCALE_DOWN,
     REASON_SCALE_UP,
 )
+from k8s_dra_driver_tpu.pkg.history import (
+    RULE_SCALE_DEFER,
+    RULE_SCALE_DOWN,
+    RULE_SCALE_TIER_DOWN,
+    RULE_SCALE_UP,
+)
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
 from k8s_dra_driver_tpu.rebalancer.controller import (
     release_cordon,
@@ -155,6 +161,10 @@ class ServingGroupController:
         # pre-contention behavior).
         self.headroom_fn = headroom_fn
         self.tenant_weight_fn = tenant_weight_fn
+        # Optional flight recorder (pkg/history.py HistoryStore): every
+        # scale verdict (up/down/tier-down/deferred) lands there with
+        # the numbers it fired on — qps, demand, stabilized max, floor.
+        self.history = None
         r = metrics_registry
         self.desired_gauge = r.register(Gauge(
             "tpu_dra_autoscaler_desired_replicas",
@@ -359,13 +369,28 @@ class ServingGroupController:
             if target <= cur:
                 # Clamped by max_replicas (or the fairness share) while
                 # still wanting up.
-                self._defer(group, decision)
+                self._defer(group, decision, now,
+                            "scale-up clamped by max_replicas or the "
+                            "fairness share while demand wants more",
+                            {"qps": round(sample.qps, 3), "demand": demand,
+                             "replicas": cur, "max_up": max_up})
             elif (now - group.status.last_scale_up
                     >= policy.scale_up_cooldown_s):
                 self._apply_scale(group, target, now, up=True)
                 decision.direction, decision.applied = "up", target
+                if self.history is not None:
+                    self.history.decide(
+                        controller="autoscaler", rule=RULE_SCALE_UP,
+                        outcome="scaled-up", obj=group,
+                        message=f"replicas {cur} -> {target}",
+                        inputs={"qps": round(sample.qps, 3),
+                                "demand": demand, "desired": desired,
+                                "alerting": alerting, "max_up": max_up},
+                        now=now)
             else:
-                self._defer(group, decision)
+                self._defer(group, decision, now, "scale-up cooldown",
+                            {"qps": round(sample.qps, 3), "demand": demand,
+                             "target": target, "replicas": cur})
         elif stabilized < cur:
             target = max(policy.min_replicas, stabilized,
                          min(slo_floor, policy.max_replicas))
@@ -374,22 +399,48 @@ class ServingGroupController:
             elif not alerting and observed_long_enough and down_cooldown_ok:
                 self._apply_scale(group, target, now, up=False)
                 decision.direction, decision.applied = "down", target
+                if self.history is not None:
+                    self.history.decide(
+                        controller="autoscaler", rule=RULE_SCALE_DOWN,
+                        outcome="scaled-down", obj=group,
+                        message=f"replicas {cur} -> {target}",
+                        inputs={"qps": round(sample.qps, 3),
+                                "stabilized": stabilized,
+                                "slo_floor": slo_floor,
+                                "desired": desired},
+                        now=now)
             else:
-                self._defer(group, decision)
+                self._defer(group, decision, now,
+                            "scale-down gated by alert / observation "
+                            "window / cooldown",
+                            {"qps": round(sample.qps, 3),
+                             "stabilized": stabilized, "target": target,
+                             "replicas": cur, "alerting": alerting})
         elif desired < cur:
             # Wants down, but the stabilization window still remembers
             # higher demand — the anti-flap path a bursty trace exercises.
-            self._defer(group, decision)
+            self._defer(group, decision, now,
+                        "stabilization window remembers higher demand",
+                        {"qps": round(sample.qps, 3), "desired": desired,
+                         "stabilized": stabilized, "replicas": cur})
         if decision.direction in ("none",) and self._maybe_down_tier(
                 group, sample, now, alerting, claim_summaries):
             decision.direction = "tier-down"
         self._reconcile(key, now)
         return decision
 
-    def _defer(self, group: ServingGroup, decision: ScaleDecision) -> None:
+    def _defer(self, group: ServingGroup, decision: ScaleDecision,
+               now: float = 0.0, why: str = "",
+               inputs: Optional[Dict[str, object]] = None) -> None:
         decision.direction = "deferred"
         self.scale_total.inc("deferred")
         self.recorder.normal(group, REASON_SCALE_DEFERRED, MSG_DEFERRED)
+        if self.history is not None:
+            self.history.decide(
+                controller="autoscaler", rule=RULE_SCALE_DEFER,
+                outcome="deferred", obj=group,
+                message=why or MSG_DEFERRED,
+                inputs=dict(inputs or {}), now=now)
 
     def _apply_scale(self, group: ServingGroup, target: int, now: float,
                      up: bool) -> None:
@@ -463,6 +514,15 @@ class ServingGroupController:
             self.engine.ingest_local(SERVING_GROUP, "MODIFIED", updated)
         self.scale_total.inc("tier-down")
         self.recorder.normal(group, REASON_SCALE_DOWN, MSG_TIER_DOWN)
+        if self.history is not None:
+            self.history.decide(
+                controller="autoscaler", rule=RULE_SCALE_TIER_DOWN,
+                outcome="tier-down", obj=group,
+                message=f"replica profile -> {new_tier}",
+                inputs={"duty_p95_max": round(max(duties), 4),
+                        "down_tier_duty": policy.down_tier_duty,
+                        "new_tier": new_tier},
+                now=now)
         return True
 
     # -- reconcile -----------------------------------------------------------

@@ -86,6 +86,12 @@ from k8s_dra_driver_tpu.pkg.events import (
     REASON_DOMAIN_RESIZING,
     REASON_RESIZE_FAILED,
 )
+from k8s_dra_driver_tpu.pkg.history import (
+    RULE_RESIZE_HEALED,
+    RULE_RESIZE_PHASE,
+    RULE_RESIZE_ROLLBACK,
+    RULE_RESIZE_START,
+)
 from k8s_dra_driver_tpu.pkg.leaderelection import LEASE
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Histogram, Registry
 from k8s_dra_driver_tpu.plugins.checkpoint import MIGRATION_CHECKPOINTED
@@ -194,6 +200,10 @@ class ElasticDomainController:
         self.recorder = EventRecorder(api, "elastic-domains",
                                       metrics_registry=registry)
         self.clock = clock
+        # Optional flight recorder (pkg/history.py HistoryStore): every
+        # epoch transition emits a DecisionRecord with the inputs that
+        # drove it (trigger, lost hosts, target geometry).
+        self.history = None
         self.backoff = Backoff(
             base=self.config.backoff_base_s, cap=self.config.backoff_cap_s,
             jitter=0.2, clock=clock,
@@ -441,6 +451,17 @@ class ElasticDomainController:
             cd, REASON_DOMAIN_RESIZING,
             f"resize epoch started ({trigger}): {len(prior.nodes)} -> "
             f"{target} hosts")
+        if self.history is not None:
+            self.history.decide(
+                controller="elastic", rule=RULE_RESIZE_START,
+                outcome="epoch-started", obj=cd,
+                message=(f"resize epoch ({trigger}): {len(prior.nodes)} -> "
+                         f"{target} hosts"),
+                inputs={"trigger": trigger, "target_nodes": target,
+                        "lost_nodes": sorted(lost),
+                        "prior_nodes": len(prior.nodes),
+                        "attempt": record.attempts},
+                now=self.clock())
         fresh = self.api.try_get(COMPUTE_DOMAIN, cd.name, cd.namespace)
         if fresh is not None and fresh.status.resize is not None:
             return self._advance(fresh, units)
@@ -535,6 +556,16 @@ class ElasticDomainController:
                                        mutate)
         except NotFoundError:
             return 0
+        if self.history is not None:
+            self.history.decide(
+                controller="elastic", rule=RULE_RESIZE_PHASE,
+                outcome=RESIZE_RESTARTING, obj=cd,
+                message=("new placement committed; restarting survivors "
+                         "onto the new geometry"),
+                inputs={"phase_from": RESIZE_PLACING,
+                        "phase_to": RESIZE_RESTARTING,
+                        "target_nodes": cd.status.resize.target_nodes},
+                now=self.clock())
         self._fire_fault("resize:placed")
         return 1
 
@@ -716,6 +747,17 @@ class ElasticDomainController:
             cd, REASON_DOMAIN_HEALED,
             f"resize epoch complete ({r.trigger}): domain now spans "
             f"{r.target_nodes} host(s)")
+        if self.history is not None:
+            self.history.decide(
+                controller="elastic", rule=RULE_RESIZE_HEALED,
+                outcome="healed", obj=cd,
+                message=(f"resize epoch complete ({r.trigger}): domain "
+                         f"now spans {r.target_nodes} host(s)"),
+                inputs={"trigger": r.trigger,
+                        "target_nodes": r.target_nodes,
+                        "elapsed_s": round(elapsed, 3),
+                        "attempt": r.attempts},
+                now=self.clock())
 
     # -- rollback -------------------------------------------------------------
 
@@ -764,6 +806,17 @@ class ElasticDomainController:
         self.recorder.warning(
             cd, REASON_RESIZE_FAILED,
             f"resize epoch rolled back to the prior placement: {why}")
+        if self.history is not None:
+            self.history.decide(
+                controller="elastic", rule=RULE_RESIZE_ROLLBACK,
+                outcome="rolled-back", obj=cd,
+                message=f"resize epoch rolled back: {why}",
+                inputs={"why": why,
+                        "trigger": r.trigger if r is not None else "",
+                        "phase": r.phase if r is not None else "",
+                        "target_nodes": (r.target_nodes
+                                         if r is not None else 0)},
+                now=self.clock())
 
     def _restore_claims(self, plugin, claims) -> None:
         prepared = _prepared(plugin)
@@ -790,6 +843,15 @@ class ElasticDomainController:
                 obj.status.resize.phase = phase
         self.api.update_with_retry(COMPUTE_DOMAIN, cd.name, cd.namespace,
                                    mutate)
+        if self.history is not None and cd.status.resize is not None:
+            self.history.decide(
+                controller="elastic", rule=RULE_RESIZE_PHASE,
+                outcome=phase, obj=cd,
+                message=f"resize epoch advanced to {phase}",
+                inputs={"phase_from": cd.status.resize.phase,
+                        "phase_to": phase,
+                        "target_nodes": cd.status.resize.target_nodes},
+                now=self.clock())
 
     # Crash-injection seam (tests raise from here to simulate a controller
     # dying between phases; same shape as the plugins' fault hooks).

@@ -166,6 +166,9 @@ class Event(K8sObject):
     count: int = 1
     first_timestamp: float = 0.0
     last_timestamp: float = 0.0
+    # Trace of the latest occurrence — links a describe/explain row to the
+    # /debug/traces span set that produced it (empty when none was active).
+    trace_id: str = ""  # tpulint: disable=wire-drift -- sim-only provenance link, not corev1 wire data
 
 
 # -- utilization telemetry ---------------------------------------------------

@@ -18,6 +18,13 @@ Routes:
     DELETE /objects/{kind}?name=&ns=    delete (finalizer-aware)
     GET    /watch/{kind}                JSON-lines event stream
     GET    /healthz
+    GET    /history/series              flight-recorder series names
+    GET    /history/query?series=&resolution=&window=|lo=&hi=
+    GET    /history/decisions?kind=&ns=&name=&limit=
+
+The /history routes are served only when the hosted APIServer carries a
+``history`` attribute (the sim wires its HistoryStore there); they 404
+otherwise so clients can tell "no recorder" from "empty history".
 """
 
 from __future__ import annotations
@@ -116,6 +123,8 @@ class _Handler(BaseHTTPRequestHandler):
                     name=q.get("name", [None])[0],
                     namespace=q.get("ns", [None])[0],
                 )
+            elif len(parts) == 2 and parts[0] == "history":
+                self._history_route(parts[1], q)
             else:
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
@@ -173,6 +182,39 @@ class _Handler(BaseHTTPRequestHandler):
             # Malformed labels= JSON / invalid body must not tear down the
             # connection without a JSON error document.
             self._send_json(400, {"error": "BadRequest", "message": str(e)})
+
+    # -- flight recorder -----------------------------------------------------
+
+    def _history_route(self, what: str, q: Dict[str, List[str]]) -> None:
+        """Query surface for the pkg/history.py HistoryStore the sim
+        attaches to its APIServer. float()/query() raising ValueError is
+        handled by do_GET's 400 path — malformed window/resolution never
+        tears the connection down."""
+        hist = getattr(self.api, "history", None)
+        if hist is None:
+            self._send_json(404, {"error": "NoRoute",
+                                  "message": "no history store attached"})
+        elif what == "series":
+            self._send_json(200, {"series": hist.series_names()})
+        elif what == "query":
+            series = q.get("series", [""])[0]
+            resolution = q.get("resolution", ["raw"])[0]
+            window = None
+            if "lo" in q and "hi" in q:
+                window = (float(q["lo"][0]), float(q["hi"][0]))
+            elif "window" in q:
+                window = float(q["window"][0])
+            pts = hist.query(series, window=window, resolution=resolution)
+            self._send_json(200, {"series": series,
+                                  "resolution": resolution, "points": pts})
+        elif what == "decisions":
+            recs = hist.decisions_for(
+                q.get("kind", [""])[0], q.get("ns", [""])[0],
+                q.get("name", [""])[0],
+                limit=int(q.get("limit", ["0"])[0]))
+            self._send_json(200, {"items": [r.to_doc() for r in recs]})
+        else:
+            self._send_json(404, {"error": "NoRoute", "message": self.path})
 
     # -- watch streaming ----------------------------------------------------
 
@@ -269,6 +311,45 @@ def serve_api(api: Optional[APIServer] = None, host: str = "127.0.0.1",
 # -- client -----------------------------------------------------------------
 
 
+class _RemoteHistory:
+    """Client half of the /history routes: the HistoryStore query
+    surface (series_names / query / decisions_for) over the wire, so
+    ``tpu-kubectl explain`` and ``top --history`` run unmodified against
+    a remote sim."""
+
+    def __init__(self, client: "RemoteAPIServer"):
+        self._client = client
+
+    def series_names(self) -> List[str]:
+        doc = self._client._request("GET", "/history/series")
+        return list(doc.get("series", []))
+
+    def query(self, series: str, window=None,
+              resolution: str = "raw") -> List[dict]:
+        params = {"series": series, "resolution": resolution}
+        if isinstance(window, tuple):
+            params["lo"], params["hi"] = window
+        elif window is not None:
+            params["window"] = window
+        doc = self._client._request(
+            "GET", "/history/query" + self._client._q(**params))
+        return doc.get("points", [])
+
+    def decisions_for(self, kind: str, namespace: str, name: str,
+                      window=None, limit: int = 0) -> list:
+        from k8s_dra_driver_tpu.pkg.history import DecisionRecord
+
+        doc = self._client._request(
+            "GET", "/history/decisions" + self._client._q(
+                kind=kind, ns=namespace, name=name,
+                limit=limit if limit else None))
+        recs = [DecisionRecord.from_doc(d) for d in doc.get("items", [])]
+        if window is not None:
+            lo, hi = window
+            recs = [r for r in recs if lo <= r.time <= hi]
+        return recs
+
+
 class RemoteAPIServer:
     """Client-side APIServer over the HTTP wire — drop-in for k8s.APIServer
     (create/get/try_get/list/update/delete/update_with_retry/watch/
@@ -306,6 +387,17 @@ class RemoteAPIServer:
         return ("?" + urllib.parse.urlencode(q)) if q else ""
 
     # -- interface ----------------------------------------------------------
+
+    @property
+    def history(self) -> Optional[_RemoteHistory]:
+        """Remote view of the server-side flight recorder, or None when
+        the server has no HistoryStore attached (one probe round-trip —
+        kubectl resolves this once per command, not per row)."""
+        try:
+            self._request("GET", "/history/series")
+        except ApiError:
+            return None
+        return _RemoteHistory(self)
 
     def create(self, obj: K8sObject) -> K8sObject:
         return from_wire(self._request("POST", "/objects", to_wire(obj)))

@@ -231,8 +231,12 @@ class EventRecorder:
         now = self.clock()
         name = event_name(ref, type_, reason, message)
         ns = event_namespace(ref)
+        from k8s_dra_driver_tpu.pkg import tracing
+
+        ctx = tracing.current()
+        trace_id = ctx.trace_id if ctx is not None else ""
         # Aggregation first: a dedup hit is an update, costs no token.
-        if self._bump_existing(name, ns, now):
+        if self._bump_existing(name, ns, now, trace_id):
             self.emitted_total.inc(self.component, reason)
             return self.api.try_get(EVENT, name, ns)
         if not self._take_token(ref, now):
@@ -255,20 +259,26 @@ class EventRecorder:
             count=1,
             first_timestamp=now,
             last_timestamp=now,
+            trace_id=trace_id,
         )
         try:
             created = self.api.create(ev)
         except AlreadyExistsError:
             # Cross-process race on the deterministic name: fold into it.
-            self._bump_existing(name, ns, now)
+            self._bump_existing(name, ns, now, trace_id)
             created = self.api.try_get(EVENT, name, ns)
         self.emitted_total.inc(self.component, reason)
         return created
 
-    def _bump_existing(self, name: str, ns: str, now: float) -> bool:
+    def _bump_existing(self, name: str, ns: str, now: float,
+                       trace_id: str = "") -> bool:
         def bump(obj):
             obj.count += 1
             obj.last_timestamp = max(obj.last_timestamp, now)
+            if trace_id:
+                # Latest occurrence wins: an aggregated series links the
+                # most recent causal trace, matching lastTimestamp.
+                obj.trace_id = trace_id
         try:
             self.api.update_with_retry(EVENT, name, ns, bump)
             return True

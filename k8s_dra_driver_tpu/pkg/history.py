@@ -1,0 +1,708 @@
+"""Flight recorder: multi-resolution telemetry history + decision provenance.
+
+Every observability layer before this one is *instantaneous*: the gauges
+and window-stat rings forget the past beyond one window, and the acting
+controllers (scheduler, WFQ admission, autoscaler, rebalancer,
+preemption, elastic resize) leave only deduped Events behind. This
+module is the queryable past both the MISO-style right-sizing
+recommender and the predictive serving forecaster presuppose:
+
+- :class:`HistoryStore` — a fixed-memory multi-resolution time-series
+  store. Every pushed sample lands in a raw ring and is simultaneously
+  downsampled into 1-minute and 10-minute bucket tiers with streaming
+  min/max/mean/p95 per bucket (p95 over a bounded per-bucket reservoir).
+  Series are LRU-bounded; nothing grows without bound.
+- :class:`DecisionRecord` — structured provenance for every controller
+  action: the triggering object+revision, the observed inputs the rule
+  fired on (qps, rho, burn rates, blocking set, ...), the ``RULE_*`` id
+  that fired, the outcome, and the active trace id. Stored as a bounded
+  per-object history so ``tpu-kubectl explain`` can merge them with the
+  object's Events into one causal timeline.
+- WAL-style segment persistence under the existing persist_dir: appends
+  go to jsonl segments, ``checkpoint()`` folds them into one atomic
+  snapshot (StoreWAL's discipline: numeric segment order, torn-tail
+  tolerance on replay), so a restarted sim keeps the fleet's past and
+  ``fingerprint()`` proves the restore byte-faithful.
+- ``query(series, window, resolution)`` — the read contract the
+  forecaster/recommender (and ``explain`` / ``top --history``) consume.
+
+Rule ids are the closed ``RULE_*`` vocabulary below; the tpulint
+``decision-discipline`` checker pins call sites to the constants and the
+catalog to ``docs/reference/history.md``, exactly like event reasons.
+
+Clock discipline: callers stamp samples and decisions with THEIR clock
+(the sim's virtual clocks — determinism contract); ``wall`` on a
+DecisionRecord is the only wall-clock field and exists solely so explain
+can merge decisions with (wall-stamped) Events on one axis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
+
+from k8s_dra_driver_tpu.tpulib.loadtrace import percentile
+
+log = logging.getLogger(__name__)
+
+# -- rule catalog (docs/reference/history.md is the operator-facing copy) ----
+# DecisionRecord.rule takes ONLY these constants (tpulint:
+# decision-discipline). Format: "<controller>/<rule-that-fired>".
+
+# Scheduler admission (sim scheduler pass)
+RULE_SCHED_BIND = "scheduler/bind"
+RULE_SCHED_PARK = "scheduler/park-unschedulable"
+# WFQ / tenant-quota admission (scheduling/manager.py)
+RULE_WFQ_PARK_QUOTA = "wfq/park-quota-exceeded"
+# Serving autoscaler (autoscaler/controller.py)
+RULE_SCALE_UP = "autoscaler/scale-up"
+RULE_SCALE_DOWN = "autoscaler/scale-down"
+RULE_SCALE_DEFER = "autoscaler/scale-deferred"
+RULE_SCALE_TIER_DOWN = "autoscaler/tier-down"
+# Live-repack rebalancer (rebalancer/controller.py)
+RULE_MIGRATE = "rebalancer/migrate"
+RULE_MIGRATE_FAILED = "rebalancer/migrate-failed"
+# Checkpoint-aware preemption (scheduling/preemption.py)
+RULE_EVICT = "preemption/evict-lower-tier"
+RULE_EVICT_FAILED = "preemption/evict-failed"
+# Elastic ComputeDomains (controller/elastic.py resize epochs)
+RULE_RESIZE_START = "elastic/resize-epoch-start"
+RULE_RESIZE_PHASE = "elastic/resize-phase"
+RULE_RESIZE_HEALED = "elastic/resize-healed"
+RULE_RESIZE_ROLLBACK = "elastic/resize-rollback"
+
+# -- bounds ------------------------------------------------------------------
+
+# Raw tier: the last N pushed samples per series (at the sim's 1 s tick,
+# four virtual minutes; a real node at 10 s intervals sees 40 minutes).
+RAW_CAPACITY = 240
+# Downsampled tiers: (name, bucket width seconds, buckets retained).
+# 1m * 180 = 3 h; 10m * 288 = 48 h of retained history per series.
+TIERS: Tuple[Tuple[str, float, int], ...] = (
+    ("1m", 60.0, 180),
+    ("10m", 600.0, 288),
+)
+RESOLUTIONS = ("raw",) + tuple(name for name, _, _ in TIERS)
+# Bounded per-open-bucket reservoir for the exact p95; past it, new
+# samples still stream min/max/mean but p95 covers the first N.
+BUCKET_RESERVOIR = 128
+# Per-store LRU bound on distinct series (same cap discipline as the
+# telemetry aggregator and event correlator).
+MAX_SERIES = 4096
+# Decision history: bounded per involved object, LRU-bounded objects.
+MAX_DECISIONS_PER_OBJECT = 256
+MAX_DECISION_OBJECTS = 4096
+# Segment rotation: past this many appended records a fresh segment
+# starts; past MAX_SEGMENTS the store checkpoints (snapshot + truncate).
+SEGMENT_MAX_RECORDS = 65536
+MAX_SEGMENTS = 4
+
+_SNAPSHOT_NAME = "snapshot.json"
+_SEGMENT_RE = re.compile(r"^seg\.(\d+)\.jsonl$")
+
+_ObjKey = Tuple[str, str, str]
+
+
+def series_name(*parts: str) -> str:
+    """Canonical series id: slash-joined path, e.g.
+    ``claim-duty/default/my-claim`` — what query()/explain address."""
+    return "/".join(p for p in parts if p != "")
+
+
+# -- decision records ---------------------------------------------------------
+
+
+@dataclass
+class DecisionRecord:
+    """One controller decision: what acted, on which object revision,
+    from which observed inputs, under which rule, with what outcome."""
+
+    time: float                    # caller's (virtual) clock
+    controller: str                # scheduler | autoscaler | preemption | ...
+    rule: str                      # a RULE_* constant
+    outcome: str                   # bound | parked | evicted | scaled | ...
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    revision: int = 0              # object resourceVersion when acted on
+    message: str = ""
+    inputs: Dict[str, Any] = field(default_factory=dict)
+    trace_id: str = ""
+    wall: float = 0.0              # wall clock, ONLY for merging with Events
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {
+            "time": self.time, "controller": self.controller,
+            "rule": self.rule, "outcome": self.outcome, "kind": self.kind,
+            "namespace": self.namespace, "name": self.name,
+            "revision": self.revision, "message": self.message,
+            "inputs": self.inputs, "trace_id": self.trace_id,
+            "wall": self.wall,
+        }
+
+    @staticmethod
+    def from_doc(doc: Dict[str, Any]) -> "DecisionRecord":
+        return DecisionRecord(
+            time=float(doc.get("time", 0.0)),
+            controller=str(doc.get("controller", "")),
+            rule=str(doc.get("rule", "")),
+            outcome=str(doc.get("outcome", "")),
+            kind=str(doc.get("kind", "")),
+            namespace=str(doc.get("namespace", "")),
+            name=str(doc.get("name", "")),
+            revision=int(doc.get("revision", 0)),
+            message=str(doc.get("message", "")),
+            inputs=dict(doc.get("inputs", {})),
+            trace_id=str(doc.get("trace_id", "")),
+            wall=float(doc.get("wall", 0.0)),
+        )
+
+
+# -- buckets ------------------------------------------------------------------
+
+
+class _Bucket:
+    """One open downsample bucket: streaming min/max/mean plus a bounded
+    reservoir for the p95. Sealed into a plain stats dict when the clock
+    crosses its right edge."""
+
+    __slots__ = ("start", "count", "vmin", "vmax", "total", "reservoir")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.count = 0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.total = 0.0
+        self.reservoir: List[float] = []
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        self.total += v
+        if len(self.reservoir) < BUCKET_RESERVOIR:
+            self.reservoir.append(v)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "t": self.start,
+            "count": self.count,
+            "min": self.vmin,
+            "max": self.vmax,
+            "mean": self.total / max(1, self.count),
+            "p95": percentile(self.reservoir, 0.95) if self.reservoir else 0.0,
+        }
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {"start": self.start, "count": self.count, "min": self.vmin,
+                "max": self.vmax, "total": self.total,
+                "reservoir": list(self.reservoir)}
+
+    @staticmethod
+    def from_doc(doc: Dict[str, Any]) -> "_Bucket":
+        b = _Bucket(float(doc["start"]))
+        b.count = int(doc["count"])
+        b.vmin = float(doc["min"])
+        b.vmax = float(doc["max"])
+        b.total = float(doc["total"])
+        b.reservoir = [float(v) for v in doc.get("reservoir", [])]
+        return b
+
+
+class _Tier:
+    __slots__ = ("width", "cap", "open", "sealed")
+
+    def __init__(self, width: float, cap: int):
+        self.width = width
+        self.cap = cap
+        self.open: Optional[_Bucket] = None
+        self.sealed: Deque[Dict[str, float]] = deque(maxlen=cap)
+
+    def add(self, t: float, v: float) -> None:
+        start = (t // self.width) * self.width
+        if self.open is None:
+            self.open = _Bucket(start)
+        elif start > self.open.start:
+            self.sealed.append(self.open.stats())
+            self.open = _Bucket(start)
+        # Late samples (start < open.start) fold into the open bucket:
+        # pushes ride monotonic virtual clocks, so this only absorbs
+        # clock-domain skew instead of re-opening sealed history.
+        self.open.add(v)
+
+    def points(self) -> List[Dict[str, float]]:
+        out = list(self.sealed)
+        if self.open is not None and self.open.count:
+            out.append(self.open.stats())
+        return out
+
+
+class _Series:
+    __slots__ = ("raw", "tiers")
+
+    def __init__(self, raw_capacity: int):
+        self.raw: Deque[Tuple[float, float]] = deque(maxlen=raw_capacity)
+        self.tiers: Dict[str, _Tier] = {
+            name: _Tier(width, cap) for name, width, cap in TIERS
+        }
+
+    def push(self, t: float, v: float) -> None:
+        self.raw.append((t, v))
+        for tier in self.tiers.values():
+            tier.add(t, v)
+
+
+# -- the store ----------------------------------------------------------------
+
+
+class HistoryStore:
+    """Fixed-memory flight recorder with optional segment persistence.
+
+    ``dirpath=None`` keeps everything in memory (tests, short-lived
+    tools). With a directory, appends land in jsonl segments and
+    ``checkpoint()``/``close()`` fold them into one atomic snapshot the
+    next open restores — ``fingerprint()`` before close equals
+    ``fingerprint()`` after reopen (the bench_history gate).
+
+    Thread-safe: one mutex over the series and decision maps; queries
+    snapshot under the lock so a concurrent writer can never hand a
+    reader a torn bucket (the ``history-rollover-vs-explain`` tpusan
+    scenario drives exactly that interleaving)."""
+
+    def __init__(self, dirpath: Optional[str] = None, *,
+                 metrics_registry=None,
+                 raw_capacity: int = RAW_CAPACITY,
+                 max_series: int = MAX_SERIES,
+                 max_decisions_per_object: int = MAX_DECISIONS_PER_OBJECT,
+                 max_decision_objects: int = MAX_DECISION_OBJECTS,
+                 segment_max_records: int = SEGMENT_MAX_RECORDS,
+                 max_segments: int = MAX_SEGMENTS,
+                 clock: Callable[[], float] = lambda: 0.0):
+        self.dirpath = dirpath
+        self.raw_capacity = raw_capacity
+        self.max_series = max_series
+        self.max_decisions_per_object = max_decisions_per_object
+        self.max_decision_objects = max_decision_objects
+        self.segment_max_records = segment_max_records
+        self.max_segments = max_segments
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._series: Dict[str, _Series] = {}  # tpulint: guarded-by=_mu
+        self._decisions: Dict[_ObjKey, Deque[DecisionRecord]] = {}  # tpulint: guarded-by=_mu
+        self._seg_file = None  # tpulint: guarded-by=_mu
+        self._seg_epoch = 0  # tpulint: guarded-by=_mu
+        self._seg_records = 0  # tpulint: guarded-by=_mu
+        self.restored_samples = 0
+        self.restored_decisions = 0
+        self._samples_total = self._decisions_total = None
+        self._series_gauge = None
+        if metrics_registry is not None:
+            from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge
+
+            self._samples_total = metrics_registry.register(Counter(
+                "tpu_dra_history_samples_total",
+                "Telemetry samples recorded into the history store."))
+            self._decisions_total = metrics_registry.register(Counter(
+                "tpu_dra_history_decisions_total",
+                "Controller DecisionRecords recorded, by controller.",
+                ("controller",)))
+            self._series_gauge = metrics_registry.register(Gauge(
+                "tpu_dra_history_series",
+                "Distinct time series currently retained by the history "
+                "store (LRU-bounded)."))
+        if dirpath is not None:
+            os.makedirs(dirpath, exist_ok=True)
+            with self._mu:
+                self._restore_locked()
+                self._open_segment_locked()
+
+    # -- ingest --------------------------------------------------------------
+
+    def push(self, series: str, t: float, v: float) -> None:
+        """Record one sample. O(1): raw ring append + one open-bucket
+        update per tier, plus a buffered segment line when persisting."""
+        v = float(v)
+        with self._mu:
+            s = self._series.get(series)
+            created = s is None
+            if created:
+                s = self._series[series] = _Series(self.raw_capacity)
+                self._trim_series_locked()
+            else:
+                # LRU touch.
+                self._series[series] = self._series.pop(series)
+            s.push(t, v)
+            self._append_locked({"k": "s", "s": series, "t": t, "v": v})
+            nseries = len(self._series)
+        if self._samples_total is not None:
+            self._samples_total.inc()
+            if created:
+                # Set only on membership change — a per-push gauge write
+                # doubles the recorder's metrics cost for a static value.
+                self._series_gauge.set(value=float(nseries))
+
+    def record(self, rec: DecisionRecord) -> DecisionRecord:
+        """Store one DecisionRecord under its involved object (bounded
+        per object, object set LRU-bounded)."""
+        key: _ObjKey = (rec.kind, rec.namespace, rec.name)
+        with self._mu:
+            dq = self._decisions.get(key)
+            if dq is None:
+                dq = self._decisions[key] = deque(
+                    maxlen=self.max_decisions_per_object)
+                self._trim_decisions_locked()
+            else:
+                self._decisions[key] = self._decisions.pop(key)
+            dq.append(rec)
+            self._append_locked({"k": "d", **rec.to_doc()})
+        if self._decisions_total is not None:
+            self._decisions_total.inc(rec.controller)
+        return rec
+
+    def decide(self, *, controller: str, rule: str, outcome: str,
+               obj=None, kind: str = "", namespace: str = "", name: str = "",
+               revision: int = 0, message: str = "",
+               inputs: Optional[Dict[str, Any]] = None,
+               now: Optional[float] = None) -> Optional[DecisionRecord]:
+        """Convenience wrapper every controller calls: resolves the
+        involved object's identity+revision from ``obj`` (a K8sObject or
+        anything with .meta), the active trace id from the ambient span,
+        and never raises — provenance must not break control flow."""
+        try:
+            from k8s_dra_driver_tpu.pkg import tracing
+
+            if obj is not None:
+                meta = getattr(obj, "meta", None)
+                kind = kind or getattr(obj, "kind", "") or type(obj).__name__
+                if meta is not None:
+                    namespace = namespace or getattr(meta, "namespace", "")
+                    name = name or getattr(meta, "name", "")
+                    revision = revision or getattr(meta, "resource_version", 0)
+                else:
+                    namespace = namespace or getattr(obj, "namespace", "")
+                    name = name or getattr(obj, "name", "")
+            ctx = tracing.current()
+            rec = DecisionRecord(
+                time=self.clock() if now is None else now,
+                controller=controller, rule=rule, outcome=outcome,
+                kind=kind, namespace=namespace, name=name,
+                revision=int(revision), message=message,
+                inputs=dict(inputs or {}),
+                trace_id=ctx.trace_id if ctx else "",
+                wall=time.time(),
+            )
+            return self.record(rec)
+        except Exception:  # noqa: BLE001 — provenance is fire-and-forget, like the event recorder
+            log.exception("decision record (%s/%s) dropped", controller, rule)
+            return None
+
+    # -- queries -------------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._series)
+
+    def query(self, series: str,
+              window: Optional[Union[float, Tuple[float, float]]] = None,
+              resolution: str = "raw") -> List[Dict[str, float]]:
+        """Points for one series. ``resolution`` is ``raw`` (points
+        ``{"t", "value"}``) or a tier name (buckets ``{"t", "count",
+        "min", "max", "mean", "p95"}``). ``window`` is either ``(lo,
+        hi)`` absolute bounds or a float W meaning the last W seconds
+        relative to the newest retained point; None returns everything
+        retained at that resolution. The forecaster/recommender
+        contract — and what explain/top render from."""
+        if resolution not in RESOLUTIONS:
+            raise ValueError(
+                f"unknown resolution {resolution!r}; want one of {RESOLUTIONS}")
+        with self._mu:
+            s = self._series.get(series)
+            if s is None:
+                return []
+            if resolution == "raw":
+                pts = [{"t": t, "value": v} for t, v in s.raw]
+            else:
+                pts = s.tiers[resolution].points()
+        if window is None or not pts:
+            return pts
+        if isinstance(window, (int, float)):
+            hi = pts[-1]["t"]
+            lo = hi - float(window)
+        else:
+            lo, hi = float(window[0]), float(window[1])
+        return [p for p in pts if lo <= p["t"] <= hi]
+
+    def decisions_for(self, kind: str, namespace: str, name: str,
+                      window: Optional[Tuple[float, float]] = None,
+                      limit: int = 0) -> List[DecisionRecord]:
+        """The bounded decision history of one object, oldest first."""
+        with self._mu:
+            dq = self._decisions.get((kind, namespace, name))
+            out = list(dq) if dq else []
+        if window is not None:
+            lo, hi = window
+            out = [r for r in out if lo <= r.time <= hi]
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def decision_count(self) -> int:
+        with self._mu:
+            return sum(len(dq) for dq in self._decisions.values())
+
+    # -- bounds --------------------------------------------------------------
+
+    def _trim_series_locked(self) -> None:
+        # tpulint: holds=_mu (LRU evict; callers hold the lock)
+        while len(self._series) > self.max_series:
+            self._series.pop(next(iter(self._series)))
+
+    def _trim_decisions_locked(self) -> None:
+        # tpulint: holds=_mu
+        while len(self._decisions) > self.max_decision_objects:
+            self._decisions.pop(next(iter(self._decisions)))
+
+    # -- persistence ---------------------------------------------------------
+
+    def _append_locked(self, doc: Dict[str, Any]) -> None:
+        # tpulint: holds=_mu
+        if self._seg_file is None:
+            return
+        self._seg_file.write(json.dumps(doc, separators=(",", ":")) + "\n")
+        self._seg_records += 1
+        if self._seg_records >= self.segment_max_records:
+            self._rotate_locked()
+
+    def _segments_locked(self) -> List[Tuple[int, str]]:
+        # tpulint: holds=_mu
+        out = []
+        try:
+            names = os.listdir(self.dirpath)
+        except OSError:
+            return []
+        for n in names:
+            m = _SEGMENT_RE.match(n)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.dirpath, n)))
+        # Numeric epoch order, never lexicographic (seg.10 after seg.9).
+        return sorted(out)
+
+    def _open_segment_locked(self) -> None:
+        # tpulint: holds=_mu
+        segs = self._segments_locked()
+        self._seg_epoch = (segs[-1][0] + 1) if segs else 1
+        path = os.path.join(self.dirpath, f"seg.{self._seg_epoch}.jsonl")
+        self._seg_file = open(path, "a", encoding="utf-8")  # tpulint: disable=sleep-under-lock -- cold path: one open per 65536-record rotation
+        self._seg_records = 0
+
+    def _rotate_locked(self) -> None:
+        # tpulint: holds=_mu
+        self._seg_file.close()
+        self._seg_file = None
+        if len(self._segments_locked()) >= self.max_segments:
+            # Fold everything into one snapshot so replay stays short
+            # and old segments never pile up.
+            self._checkpoint_locked()
+        self._open_segment_locked()
+
+    def _restore_locked(self) -> None:
+        # tpulint: holds=_mu
+        snap = os.path.join(self.dirpath, _SNAPSHOT_NAME)
+        if os.path.exists(snap):
+            try:
+                with open(snap, "r", encoding="utf-8") as f:  # tpulint: disable=sleep-under-lock -- construction-time restore, no contenders yet
+                    self._load_state_locked(json.load(f))
+            except (OSError, ValueError, KeyError):
+                log.exception("history snapshot unreadable; replaying "
+                              "segments only")
+        for _, path in self._segments_locked():
+            try:
+                with open(path, "r", encoding="utf-8") as f:  # tpulint: disable=sleep-under-lock -- construction-time replay, no contenders yet
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            doc = json.loads(line)
+                        except ValueError:
+                            break  # torn tail: everything before it counts
+                        self._replay_locked(doc)
+            except OSError:
+                continue
+
+    def _replay_locked(self, doc: Dict[str, Any]) -> None:
+        # tpulint: holds=_mu
+        if doc.get("k") == "s":
+            name = doc["s"]
+            s = self._series.get(name)
+            if s is None:
+                s = self._series[name] = _Series(self.raw_capacity)
+                self._trim_series_locked()
+            else:
+                self._series[name] = self._series.pop(name)
+            s.push(float(doc["t"]), float(doc["v"]))
+            self.restored_samples += 1
+        elif doc.get("k") == "d":
+            rec = DecisionRecord.from_doc(doc)
+            key: _ObjKey = (rec.kind, rec.namespace, rec.name)
+            dq = self._decisions.get(key)
+            if dq is None:
+                dq = self._decisions[key] = deque(
+                    maxlen=self.max_decisions_per_object)
+                self._trim_decisions_locked()
+            else:
+                self._decisions[key] = self._decisions.pop(key)
+            dq.append(rec)
+            self.restored_decisions += 1
+
+    # -- snapshot / fingerprint ----------------------------------------------
+
+    def _state_doc_locked(self) -> Dict[str, Any]:
+        # tpulint: holds=_mu
+        series_doc: Dict[str, Any] = {}
+        for name, s in self._series.items():
+            series_doc[name] = {
+                "raw": [[t, v] for t, v in s.raw],
+                "tiers": {
+                    tname: {
+                        "open": (tier.open.to_doc()
+                                 if tier.open is not None else None),
+                        "sealed": list(tier.sealed),
+                    }
+                    for tname, tier in s.tiers.items()
+                },
+            }
+        return {
+            "version": 1,
+            "series": series_doc,
+            "decisions": [
+                [list(key), [r.to_doc() for r in dq]]
+                for key, dq in self._decisions.items()
+            ],
+        }
+
+    def _load_state_locked(self, doc: Dict[str, Any]) -> None:
+        # tpulint: holds=_mu
+        for name, sdoc in doc.get("series", {}).items():
+            s = _Series(self.raw_capacity)
+            for t, v in sdoc.get("raw", []):
+                s.raw.append((float(t), float(v)))
+            for tname, tdoc in sdoc.get("tiers", {}).items():
+                tier = s.tiers.get(tname)
+                if tier is None:
+                    continue  # tier layout changed across versions
+                if tdoc.get("open") is not None:
+                    tier.open = _Bucket.from_doc(tdoc["open"])
+                for b in tdoc.get("sealed", []):
+                    tier.sealed.append(b)
+            self._series[name] = s
+            self._trim_series_locked()
+        for key, docs in doc.get("decisions", []):
+            dq = deque((DecisionRecord.from_doc(d) for d in docs),
+                       maxlen=self.max_decisions_per_object)
+            self._decisions[tuple(key)] = dq
+            self._trim_decisions_locked()
+
+    def fingerprint(self) -> str:
+        """Stable digest of the full retained state (series rings, tier
+        buckets, decisions). Equal before close and after reopen — the
+        bench_history restore gate pins it."""
+        with self._mu:
+            doc = self._state_doc_locked()
+        payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha1(payload.encode(),
+                            usedforsecurity=False).hexdigest()
+
+    def checkpoint(self) -> None:
+        """Fold segments into one atomic snapshot (write-temp + rename,
+        the StoreWAL compaction discipline) and start a fresh segment."""
+        if self.dirpath is None:
+            return
+        with self._mu:
+            if self._seg_file is not None:
+                self._seg_file.close()
+                self._seg_file = None
+            self._checkpoint_locked()
+            self._open_segment_locked()
+
+    def _checkpoint_locked(self) -> None:
+        # tpulint: holds=_mu
+        doc = self._state_doc_locked()
+        tmp = os.path.join(self.dirpath, _SNAPSHOT_NAME + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:  # tpulint: disable=sleep-under-lock -- explicit checkpoint/rotation fold: durability IS the point; callers are shutdown/rare-rotate
+            json.dump(doc, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())  # tpulint: disable=sleep-under-lock -- snapshot must be durable before segment unlink
+        os.replace(tmp, os.path.join(self.dirpath, _SNAPSHOT_NAME))
+        for _, path in self._segments_locked():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def sync(self) -> None:
+        """Flush buffered segment appends to the OS (no fsync — the
+        snapshot is the durable artifact; segments are best-effort tail)."""
+        with self._mu:
+            if self._seg_file is not None:
+                self._seg_file.flush()
+
+    def close(self) -> None:
+        if self.dirpath is None:
+            return
+        with self._mu:
+            if self._seg_file is not None:
+                self._seg_file.close()
+                self._seg_file = None
+            self._checkpoint_locked()
+
+
+# -- rendering helpers (explain / top --history) ------------------------------
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[float], width: int = 48) -> str:
+    """Unicode sparkline over ``values`` downsampled to ``width`` slots
+    (mean per slot), normalized min..max — the telemetry strip under an
+    explain timeline."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # Mean-pool into exactly `width` slots.
+        pooled = []
+        n = len(vals)
+        for i in range(width):
+            lo = i * n // width
+            hi = max(lo + 1, (i + 1) * n // width)
+            chunk = vals[lo:hi]
+            pooled.append(sum(chunk) / len(chunk))
+        vals = pooled
+    vmin, vmax = min(vals), max(vals)
+    span = vmax - vmin
+    if span <= 0:
+        return SPARK_CHARS[0] * len(vals)
+    return "".join(
+        SPARK_CHARS[min(len(SPARK_CHARS) - 1,
+                        int((v - vmin) / span * len(SPARK_CHARS)))]
+        for v in vals)

@@ -469,8 +469,19 @@ class MetricsServer:
                     return _debug_vars_json(), "application/json"
                 # /debug/traces stays valid even under a custom --pprof-path
                 # prefix: the docs and the sim `trace --url` client promise
-                # that URL unconditionally.
+                # that URL unconditionally. ?trace_id= / ?name= narrow the
+                # dump to one trace / one span name (what an `explain` row
+                # deep-links); spansDropped rides every payload either way.
                 if path in (traces_path, "/debug/traces"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    trace_id = q.get("trace_id", [None])[0]
+                    name = q.get("name", [None])[0]
+                    if trace_id is not None or name is not None:
+                        spans = tracer_ref.spans(trace_id=trace_id, name=name)
+                        return (tracer_ref.export_chrome_json(spans),
+                                "application/json")
                     return tracer_ref.export_chrome_json(), "application/json"
                 if path in ("", "/metrics"):
                     return (registry_ref.expose().encode(),

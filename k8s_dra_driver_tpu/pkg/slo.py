@@ -140,6 +140,11 @@ class SLOEvaluator:
 
         self.recorder = recorder
         self.max_subjects = max_subjects
+        # Optional flight-recorder sink (pkg/history.py HistoryStore):
+        # when set, every evaluate() pass also pushes per-(slo, window)
+        # burn-rate series so explain can show the burn leading up to a
+        # controller decision. Set once at wiring, before first use.
+        self.history = None
         self._mu = threading.Lock()
         self._objectives: Dict[str, SLObjective] = {}  # tpulint: guarded-by=_mu
         self._subjects: Dict[Tuple[str, Tuple[str, str]], _SubjectState] = {}  # tpulint: guarded-by=_mu
@@ -293,6 +298,13 @@ class SLOEvaluator:
             for (slo, pair), burn in worst.items():
                 self.burn_gauge.set(
                     slo, self._window_labels[pair], value=burn)
+            # Series names resolved under the lock (window labels are
+            # guarded state); pushes issued after release — the history
+            # store does its own locking.
+            history_pushes = ([
+                (f"slo-burn/{slo}/{self._window_labels[pair]}", burn)
+                for (slo, pair), burn in worst.items()
+            ] if self.history is not None else [])
             for slo, burning in burning_slos.items():
                 if burning and dt_min > 0:
                     self.violation_minutes.inc(slo, by=dt_min)
@@ -309,6 +321,8 @@ class SLOEvaluator:
                     if state is not None and state.ref is not None:
                         obj = self._objectives[a.slo]
                         to_emit.append((state.ref, a, obj))
+        for series, burn in history_pushes:
+            self.history.push(series, now, burn)
         for ref, a, obj in to_emit:
             # Message carries no live numbers: repeats of one sustained
             # violation must dedup into ONE Event with a rising count.

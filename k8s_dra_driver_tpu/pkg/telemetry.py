@@ -52,6 +52,13 @@ HBM_QUANTUM_BYTES = 64 << 20
 # Aggregator keeps per-claim/domain gauge + change-gate state for at most
 # this many objects (LRU evict beyond it, like the event correlator).
 MAX_TRACKED_OBJECTS = 4096
+# Flight-recorder feed gate: a history sample lands only when the value
+# moved at least this much (ratio series: duty/ICI; HBM gates on the
+# same relative step) or the keepalive elapsed — a steady series costs
+# one dict probe per rollup pass, the same quantized-change discipline
+# that keeps steady status writes at zero.
+HISTORY_QUANTUM = 0.005
+HISTORY_KEEPALIVE_S = 300.0
 
 
 @dataclass(frozen=True)
@@ -252,6 +259,18 @@ class TelemetryAggregator:
         for cd in api.list(COMPUTE_DOMAIN):
             self._ingest_domain("ADDED", cd)
         self.total_status_writes = 0  # lifetime counter, bench/test hook
+        # Optional flight-recorder sink (pkg/history.py HistoryStore):
+        # when set, every rollup pass also pushes node/claim/domain
+        # series into the multi-resolution history tiers — the series
+        # `tpu-kubectl explain` sparklines and `top --history` read.
+        self.history = None
+        # Recorder change gates (HISTORY_QUANTUM / HISTORY_KEEPALIVE_S):
+        # node -> (duty, t); claim uid -> (duty, hbm, t); domain key ->
+        # (ici, t). Probed inline on the rollup hot path — no helper
+        # call, no series-string build on the skip path.
+        self._hist_node: Dict[str, Tuple[float, float]] = {}
+        self._hist_claim: Dict[str, Tuple[float, float, float]] = {}
+        self._hist_domain: Dict[Tuple[str, str], Tuple[float, float]] = {}
 
     def close(self) -> None:
         self.api.stop_watch(COMPUTE_DOMAIN, self._domain_watch)
@@ -299,8 +318,26 @@ class TelemetryAggregator:
         by_node = {v.node: v for v in views}
 
         # Per-claim rollup: a claim's chips live on exactly one node.
+        # Recorder locals hoisted out of the loop: the change-gated skip
+        # path (steady load) must cost one dict probe per series, not an
+        # attribute walk + method call per view.
+        hist = self.history
+        hq, hka = HISTORY_QUANTUM, HISTORY_KEEPALIVE_S
+        hist_node, hist_claim = self._hist_node, self._hist_claim
+        hist_node_get, hist_claim_get = hist_node.get, hist_claim.get
         seen_claims = set()
         for view in views:
+            if hist is not None and view.duty:
+                dvals = view.duty
+                d = 0.0
+                for s in dvals.values():
+                    d += s.last
+                d /= len(dvals)
+                g = hist_node_get(view.node)
+                if (g is None or d - g[0] >= hq or g[0] - d >= hq
+                        or now - g[1] >= hka):
+                    hist_node[view.node] = (d, now)
+                    hist.push(f"node-duty/{view.node}", now, d)
             for cc in view.claims:
                 key = (cc.namespace, cc.name)
                 duty = [view.duty[i] for i in cc.chips if i in view.duty]
@@ -313,6 +350,23 @@ class TelemetryAggregator:
                 hbm_last = sum(s.last for s in hbm)
                 self.claim_duty.set(cc.namespace, cc.name, value=duty_mean)
                 self.claim_hbm.set(cc.namespace, cc.name, value=hbm_last)
+                if hist is not None:
+                    # Gate tuple: (duty, hbm, hbm tolerance, t) — the
+                    # relative-step tolerance is precomputed at push so
+                    # the skip path is compares only.
+                    g = hist_claim_get(cc.uid)
+                    if (g is None
+                            or duty_mean - g[0] >= hq
+                            or g[0] - duty_mean >= hq
+                            or hbm_last - g[1] >= g[2]
+                            or g[1] - hbm_last >= g[2]
+                            or now - g[3] >= hka):
+                        hist_claim[cc.uid] = (
+                            duty_mean, hbm_last, hq * (hbm_last or 1.0), now)
+                        hist.push(f"claim-duty/{cc.namespace}/{cc.name}",
+                                  now, duty_mean)
+                        hist.push(f"claim-hbm/{cc.namespace}/{cc.name}",
+                                  now, hbm_last)
                 summary = UtilizationSummary(
                     window_seconds=_mean(s.span_seconds for s in duty),
                     samples=min(s.count for s in duty),
@@ -337,6 +391,12 @@ class TelemetryAggregator:
             res.domains_seen += 1
             ici_last = _mean(v.link_util.last for v in mviews)
             self.domain_ici.set(key[0], key[1], value=ici_last)
+            if hist is not None:
+                g = self._hist_domain.get(key)
+                if (g is None or ici_last - g[0] >= hq
+                        or g[0] - ici_last >= hq or now - g[1] >= hka):
+                    self._hist_domain[key] = (ici_last, now)
+                    hist.push(f"domain-ici/{key[0]}/{key[1]}", now, ici_last)
             summary = UtilizationSummary(
                 window_seconds=_mean(s.span_seconds for s in all_duty),
                 samples=min(s.count for s in all_duty),
@@ -354,6 +414,12 @@ class TelemetryAggregator:
                            (self.claim_duty, self.claim_hbm))
         self._lru_trim(self._written_claims)
         self._lru_trim(self._written_domains)
+        # Recorder gate dicts shadow live objects only; nuke-and-repush
+        # (one extra sample per series) beats per-entry LRU bookkeeping
+        # on the hot path.
+        for gate in (self._hist_node, self._hist_claim, self._hist_domain):
+            if len(gate) > 4 * self.max_tracked:
+                gate.clear()
         res.duration_s = time.perf_counter() - t0
         self.rollup_seconds.set(value=res.duration_s)
         self.rollup_status_writes.set(value=float(res.status_writes))

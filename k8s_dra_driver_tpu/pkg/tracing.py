@@ -114,7 +114,11 @@ class Tracer:
         self.capacity = capacity
         self._mu = threading.Lock()
         self._spans: List[Span] = []  # tpulint: guarded-by=_mu
+        self._dropped = 0  # tpulint: guarded-by=_mu
+        self._dropped_reported = 0  # tpulint: guarded-by=_mu
         self._local = threading.local()
+        self._dropped_total = None   # Counter once attach_metrics() runs
+        self._utilization_gauge = None
 
     # -- context -------------------------------------------------------------
 
@@ -163,17 +167,67 @@ class Tracer:
                 self._spans.append(sp)
                 if len(self._spans) > self.capacity:
                     # Amortized trim: drop the oldest tenth in one slice
-                    # instead of popping per append.
+                    # instead of popping per append. Every span dropped
+                    # here is ACCOUNTED — silent loss made post-hoc
+                    # debugging lie about what the ring ever held.
                     del self._spans[: max(1, self.capacity // 10)]
+                    self._dropped += max(1, self.capacity // 10)
+                report = 0
+                if self._dropped_total is not None:
+                    report = self._dropped - self._dropped_reported
+                    self._dropped_reported = self._dropped
+                utilization = len(self._spans) / max(1, self.capacity)
+            if report:
+                self._dropped_total.inc(by=float(report))
+            if self._utilization_gauge is not None:
+                self._utilization_gauge.set(value=utilization)
+
+    # -- metrics -------------------------------------------------------------
+
+    def attach_metrics(self, registry) -> None:
+        """Register the span-loss accounting on ``registry`` (get-or-
+        create, so re-attaching the same registry is idempotent)."""
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge
+
+        self._dropped_total = registry.register(Counter(
+            "tpu_dra_trace_spans_dropped_total",
+            "Finished spans evicted from the bounded trace ring to make "
+            "room for newer ones (each was silently lost before this "
+            "counter existed)."))
+        self._utilization_gauge = registry.register(Gauge(
+            "tpu_dra_trace_ring_utilization",
+            "Fill fraction of the bounded span ring (0-1); sawtooths "
+            "between 0.9 and 1.0 once eviction starts."))
+        with self._mu:
+            # Backfill only drops not yet reported: re-attaching the
+            # same registry must not double-count the backlog.
+            backlog = self._dropped - self._dropped_reported
+            self._dropped_reported = self._dropped
+            utilization = len(self._spans) / max(1, self.capacity)
+        if backlog:
+            self._dropped_total.inc(by=float(backlog))
+        self._utilization_gauge.set(value=utilization)
+
+    def dropped_count(self) -> int:
+        """Spans evicted from the ring since construction."""
+        with self._mu:
+            return self._dropped
+
+    def utilization(self) -> float:
+        with self._mu:
+            return len(self._spans) / max(1, self.capacity)
 
     # -- reads ---------------------------------------------------------------
 
-    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+    def spans(self, trace_id: Optional[str] = None,
+              name: Optional[str] = None) -> List[Span]:
         with self._mu:
             snap = list(self._spans)
-        if trace_id is None:
-            return snap
-        return [s for s in snap if s.trace_id == trace_id]
+        if trace_id is not None:
+            snap = [s for s in snap if s.trace_id == trace_id]
+        if name is not None:
+            snap = [s for s in snap if s.name == name]
+        return snap
 
     def traces_for_claim(self, claim_uid: str) -> List[Span]:
         """Every span of every trace that touched ``claim_uid`` — the
@@ -196,6 +250,9 @@ class Tracer:
             spans = self.spans()
         return {
             "displayTimeUnit": "ms",
+            # Ring-eviction accounting rides the payload so a dump that
+            # LOOKS complete declares what it no longer holds.
+            "spansDropped": self.dropped_count(),
             "traceEvents": [s.to_chrome_event() for s in spans],
         }
 

@@ -218,6 +218,9 @@ class RebalanceController:
         self.recorder = EventRecorder(api, "rebalancer",
                                       metrics_registry=registry)
         self.clock = clock
+        # Optional flight recorder (pkg/history.py HistoryStore):
+        # migrations and rollbacks record per-victim-pod decisions.
+        self.history = None
         self._tokens = float(self.config.migration_burst)
         self._tokens_at = clock()
         # Consolidated retry pacing (pkg.backoff) keyed by migration unit:
@@ -705,6 +708,18 @@ class RebalanceController:
             self.recorder.normal(
                 c, REASON_CLAIM_MIGRATED,
                 f"live repack migrated claim from {source} to {target}")
+        if self.history is not None:
+            from k8s_dra_driver_tpu.pkg.history import RULE_MIGRATE
+
+            self.history.decide(
+                controller="rebalancer", rule=RULE_MIGRATE,
+                outcome="migrated", kind=POD,
+                namespace=unit.pod_namespace, name=unit.pod_name,
+                message=f"live repack moved unit {source} -> {target}",
+                inputs={"source": source, "target": target,
+                        "chips": unit.num_chips,
+                        "claims": sorted(c.meta.name for c in claims)},
+                now=self.clock())
         self.metrics.migrations_total.inc("migrated")
         return True
 
@@ -755,6 +770,16 @@ class RebalanceController:
                 c, REASON_MIGRATION_FAILED,
                 f"live repack migration off {unit.node} failed; claim "
                 f"rolled back to its source placement: {why}")
+        if self.history is not None:
+            from k8s_dra_driver_tpu.pkg.history import RULE_MIGRATE_FAILED
+
+            self.history.decide(
+                controller="rebalancer", rule=RULE_MIGRATE_FAILED,
+                outcome="rolled-back", kind=POD,
+                namespace=unit.pod_namespace, name=unit.pod_name,
+                message=f"migration off {unit.node} failed: {why}",
+                inputs={"source": unit.node, "chips": unit.num_chips},
+                now=self.clock())
         self.metrics.migrations_total.inc("failed")
 
     # -- cordon / rebind ------------------------------------------------------

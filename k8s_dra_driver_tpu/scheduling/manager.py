@@ -101,6 +101,9 @@ class ContentionManager:
             api, "contention", metrics_registry=registry)
         self.clock = clock or (lambda: 0.0)
         self.whole_host_chips = whole_host_chips
+        # Optional flight recorder (pkg/history.py HistoryStore): quota
+        # parks emit DecisionRecords with the WFQ numbers they fired on.
+        self.history = None
         self.queue = FairQueue(aging_after_s=self.config.aging_after_s)
         # Pass-scoped state refreshed by begin_pass().
         self._quotas: Dict[str, TenantQuota] = {}
@@ -254,6 +257,18 @@ class ContentionManager:
         used = self._usage.get(ns, 0)
         self.metrics.parked_total.inc(ns)
         self.recorder.warning(pod, REASON_QUOTA_EXCEEDED, MSG_QUOTA_EXCEEDED)
+        if self.history is not None:
+            from k8s_dra_driver_tpu.pkg.history import RULE_WFQ_PARK_QUOTA
+
+            self.history.decide(
+                controller="wfq", rule=RULE_WFQ_PARK_QUOTA,
+                outcome="parked", obj=pod,
+                message=f"tenant {ns!r} over chip quota",
+                inputs={"used": used, "demand": demand,
+                        "quota": q.spec.chip_quota,
+                        "weight": q.spec.weight,
+                        "virtual_time": round(self.queue.vtime(ns), 3)},
+                now=self.clock())
         return (f"tenant {ns!r} over chip quota: {used} used + {demand} "
                 f"requested > {q.spec.chip_quota} allowed")
 

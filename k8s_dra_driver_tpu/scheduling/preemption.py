@@ -64,6 +64,7 @@ from k8s_dra_driver_tpu.pkg.events import (
     REASON_PREEMPTED,
     REASON_PREEMPTION_FAILED,
 )
+from k8s_dra_driver_tpu.pkg.history import RULE_EVICT, RULE_EVICT_FAILED
 from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge, Registry
 from k8s_dra_driver_tpu.rebalancer.controller import (
     CORDON_ANNOTATION,
@@ -157,6 +158,10 @@ class PreemptionController:
         self.recorder = EventRecorder(api, "preemption",
                                       metrics_registry=registry)
         self.clock = clock
+        # Optional flight recorder (pkg/history.py HistoryStore): plan-
+        # level decisions on the demanding object (with the blocking
+        # set) and per-victim eviction records both land here.
+        self.history = None
         self._tokens = float(self.config.eviction_burst)
         self._tokens_at = clock()
         self.retry_backoff = Backoff(
@@ -259,10 +264,11 @@ class PreemptionController:
                         plan = plan_profile(filtered, profile, rank=rank)
                         if plan is None:
                             break  # nothing evictable for this shape
-                        got = self._execute(plan, budget - evicted)
+                        got = self._execute(plan, budget - evicted, tier)
                         evicted += got
                         if got < len(plan.units):
                             break  # stuck or out of budget mid-plan
+                        self._note_plan(involved, tier, plan, profile)
                         self._consume_plan(filtered, plan)
                         self._consume_plan(views, plan)
                         remaining -= 1
@@ -273,9 +279,11 @@ class PreemptionController:
                         num_nodes, rank=rank,
                         target=f"host block for ComputeDomain {cd.key} "
                                f"({num_nodes} nodes)")
-                    got = self._execute(plan, budget - evicted)
+                    got = self._execute(plan, budget - evicted, tier)
                     evicted += got
                     if plan is not None and got == len(plan.units):
+                        self._note_plan(involved, tier, plan,
+                                        f"{num_nodes}-node block")
                         self._consume_plan(views, plan)
             sp.attrs["evicted"] = evicted
             self.metrics.last_pass.set(value=float(evicted))
@@ -415,16 +423,39 @@ class PreemptionController:
 
     # -- plan execution -------------------------------------------------------
 
-    def _execute(self, plan, budget: int) -> int:
+    def _note_plan(self, involved, tier: int, plan, target: str) -> None:
+        """Plan-level provenance on the DEMANDING object: which victims
+        blocked it (the blocking set) and under what rank inputs — the
+        victim side gets its own per-unit records inside ``_evict``."""
+        if self.history is None or plan is None:
+            return
+        self.history.decide(
+            controller="preemption", rule=RULE_EVICT,
+            outcome="blocking-set-evicted", obj=involved,
+            message=f"evicted {len(plan.units)} lower-tier unit(s) "
+                    f"blocking {target}",
+            inputs={"preemptor_tier": tier,
+                    "blocking_set": sorted(
+                        f"{u.pod_namespace}/{u.pod_name}"
+                        for u in plan.units),
+                    "victim_tiers": sorted(u.tier for u in plan.units),
+                    "nodes": sorted(plan.nodes)},
+            now=self.clock())
+
+    def _execute(self, plan, budget: int, preemptor_tier: int = 0) -> int:
         if plan is None or not plan.units or budget <= 0:
             return 0
+        # The full blocking set rides into each per-victim decision so
+        # `explain pod/<victim>` shows the rank context it lost under.
+        blocking = tuple(sorted(f"{u.pod_namespace}/{u.pod_name}"
+                                for u in plan.units))
         evicted = 0
         for i, unit in enumerate(plan.units):
             if evicted >= budget:
                 self.metrics.deferred_total.inc(
                     by=float(len(plan.units) - i))
                 break
-            outcome = self._evict_unit(unit)
+            outcome = self._evict_unit(unit, preemptor_tier, blocking)
             if outcome == "no-token":
                 self.metrics.deferred_total.inc(
                     by=float(len(plan.units) - i))
@@ -437,18 +468,20 @@ class PreemptionController:
                 break
         return evicted
 
-    def _evict_unit(self, unit) -> str:
+    def _evict_unit(self, unit, preemptor_tier: int = 0,
+                    blocking: tuple = ()) -> str:
         retry_key = (unit.pod_namespace, unit.pod_name)
         if not self.retry_backoff.ready(retry_key):
             return "skip"  # failed recently: wait out the backoff
-        outcome = self._evict_unit_inner(unit)
+        outcome = self._evict_unit_inner(unit, preemptor_tier, blocking)
         if outcome == "failed":
             self.retry_backoff.failure(retry_key)
         elif outcome == "evicted":
             self.retry_backoff.reset(retry_key)
         return outcome
 
-    def _evict_unit_inner(self, unit) -> str:
+    def _evict_unit_inner(self, unit, preemptor_tier: int = 0,
+                          blocking: tuple = ()) -> str:
         with tracing.span("preempt.evict",
                           pod=f"{unit.pod_namespace}/{unit.pod_name}",
                           source=unit.node) as sp:
@@ -482,7 +515,8 @@ class PreemptionController:
                 return "no-token"
             sp.attrs["chips"] = unit.num_chips
             try:
-                ok = self._evict(unit, claims, src_plugin)
+                ok = self._evict(unit, claims, src_plugin, preemptor_tier,
+                                 blocking)
             except Exception:  # noqa: BLE001 — one bad unit must not kill the pass
                 # _evict is rollback-safe internally; anything reaching
                 # here escaped its guarded windows. Count it failed and
@@ -497,7 +531,8 @@ class PreemptionController:
 
     # -- the eviction itself --------------------------------------------------
 
-    def _evict(self, unit, claims, src_plugin) -> bool:
+    def _evict(self, unit, claims, src_plugin,
+               preemptor_tier: int = 0, blocking: tuple = ()) -> bool:
         """checkpoint-aware unprepare -> requeue pod -> deallocate ->
         close checkpoint entries -> uncordon, rolling back to the exact
         source placement on any failure."""
@@ -555,6 +590,20 @@ class PreemptionController:
             self.manager.note_evicted((unit.pod_namespace, unit.pod_name))
         for c in claims:
             self.recorder.warning(c, REASON_PREEMPTED, MSG_PREEMPTED)
+        if self.history is not None:
+            self.history.decide(
+                controller="preemption", rule=RULE_EVICT,
+                outcome="evicted", kind=POD,
+                namespace=unit.pod_namespace, name=unit.pod_name,
+                message=f"evicted off {unit.node} for tier-"
+                        f"{preemptor_tier} demand, requeued Pending",
+                inputs={"node": unit.node, "chips": unit.num_chips,
+                        "victim_tier": unit.tier,
+                        "preemptor_tier": preemptor_tier,
+                        "blocking_set": list(blocking),
+                        "claims": sorted(
+                            f"{ns}/{n}" for ns, n in unit.claim_keys)},
+                now=self.clock())
         self.metrics.preemptions_total.inc("evicted")
         self.metrics.victim_chips_total.inc(by=float(unit.num_chips))
         return True
@@ -624,6 +673,15 @@ class PreemptionController:
                 c, REASON_PREEMPTION_FAILED,
                 f"eviction off {unit.node} failed; claim rolled back to "
                 f"its source placement: {why}")
+        if self.history is not None:
+            self.history.decide(
+                controller="preemption", rule=RULE_EVICT_FAILED,
+                outcome="rolled-back", kind=POD,
+                namespace=unit.pod_namespace, name=unit.pod_name,
+                message=f"eviction off {unit.node} failed: {why}",
+                inputs={"node": unit.node, "chips": unit.num_chips,
+                        "victim_tier": unit.tier},
+                now=self.clock())
         self.metrics.preemptions_total.inc("failed")
 
     def _release(self, claims) -> None:

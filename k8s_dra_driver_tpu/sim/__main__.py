@@ -177,7 +177,7 @@ def main(argv=None) -> int:
     # (`python -m k8s_dra_driver_tpu.sim --port ...`) working unchanged.
     if argv and argv[0] == "trace":
         return trace_main(argv[1:])
-    if argv and argv[0] in ("describe", "get", "top"):
+    if argv and argv[0] in ("describe", "explain", "get", "top"):
         # `sim describe computedomain <name>` / `sim top computedomains` —
         # the kubectl verbs against a running sim apiserver (--server /
         # $TPU_KUBECTL_SERVER), so the debugging loop (status + conditions

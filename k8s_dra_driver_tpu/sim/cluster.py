@@ -87,6 +87,11 @@ from k8s_dra_driver_tpu.pkg.events import (
     REASON_FAILED_SCHEDULING,
     REASON_SCHEDULED,
 )
+from k8s_dra_driver_tpu.pkg.history import (
+    HistoryStore,
+    RULE_SCHED_BIND,
+    RULE_SCHED_PARK,
+)
 from k8s_dra_driver_tpu.pkg.metrics import Registry
 from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_ABORTED
 from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
@@ -234,6 +239,7 @@ class SimCluster:
         ``SliceAgentsWithDNSNames=false`` so clique members publish the
         raw address instead of sim-only DNS names."""
         self.gates = fg.parse(gates)
+        self._history_dir = None
         if api is None and (persist_dir is not None
                             or self.gates.enabled("StorePersistence")):
             # WAL+snapshot-backed store: a restarted sim replays the
@@ -241,8 +247,12 @@ class SimCluster:
             # bootstrap below tolerates already-present Nodes/classes.
             from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
 
-            api = open_persistent_store(
-                persist_dir or os.path.join(workdir, "store"))
+            store_dir = persist_dir or os.path.join(workdir, "store")
+            api = open_persistent_store(store_dir)
+            # Flight-recorder history persists beside the store WAL, so a
+            # restarted sim keeps the fleet's telemetry past and every
+            # pre-restart DecisionRecord `explain` needs.
+            self._history_dir = os.path.join(store_dir, "history")
         self.api = api if api is not None else APIServer()
         self.workdir = workdir
         self.loopback_agents = loopback_agents
@@ -252,6 +262,21 @@ class SimCluster:
         self.metrics_registry = metrics_registry or Registry()
         if hasattr(self.api, "attach_metrics"):
             self.api.attach_metrics(self.metrics_registry)
+        # Flight recorder (pkg/history.py): always on like tracing —
+        # controllers write DecisionRecords through it, the telemetry
+        # plane pushes series into its downsample tiers, and
+        # `tpu-kubectl explain` / the future forecaster+recommender read
+        # it back. Persistent only when the store itself persists.
+        self.history = HistoryStore(
+            self._history_dir, metrics_registry=self.metrics_registry,
+            clock=lambda: self.sim_time)
+        # In-process query seam: explain/top reach history through the
+        # api handle (remote clients get the same attribute from
+        # RemoteAPIServer over /history/*).
+        self.api.history = self.history
+        # Span-loss accounting for the process-default tracer rides the
+        # cluster registry (idempotent across clusters in one process).
+        tracing.get_tracer().attach_metrics(self.metrics_registry)
         self.allocator = Allocator(self.api,
                                    metrics_registry=self.metrics_registry)
         # Event plane: the emulated scheduler and the allocator verdicts
@@ -305,8 +330,10 @@ class SimCluster:
                 self.api, "telemetry", metrics_registry=self.metrics_registry)
             self.telemetry = TelemetryAggregator(
                 self.api, self.metrics_registry)
+            self.telemetry.history = self.history
             self.slo = SLOEvaluator(self.metrics_registry,
                                     recorder=self.telemetry_recorder)
+            self.slo.history = self.history
             # Recording rules sized to the virtual second; tests/operators
             # replace them via slo.add() before the first step.
             self.slo.add(SLObjective(
@@ -476,6 +503,12 @@ class SimCluster:
             self.autoscaler.headroom_fn = self._fleet_free_chips
             if self.contention is not None:
                 self.autoscaler.tenant_weight_fn = self.contention.weight_for
+        # Decision provenance: every acting controller records through
+        # the one flight recorder, so `explain` merges them all.
+        for actor in (self.autoscaler, self.rebalancer, self.elastic,
+                      self.contention, self.preemption):
+            if actor is not None:
+                actor.history = self.history
 
     # -- bootstrap -------------------------------------------------------------
 
@@ -579,6 +612,9 @@ class SimCluster:
         self.controller.stop()
         for kind, q in self._watch_queues.items():
             self.api.stop_watch(kind, q)
+        # Fold the flight recorder's segments into one snapshot so the
+        # next run restores history from a single decode.
+        self.history.close()
         wal = getattr(self.api, "_wal", None)
         if wal is not None:
             # Final compaction: the next run restores from one snapshot
@@ -1201,6 +1237,14 @@ class SimCluster:
                 pod, REASON_SCHEDULED,
                 f"assigned {pod.key} to {chosen}"
                 + (f" ({feasible_note})" if feasible_note else ""))
+            self.history.decide(
+                controller="scheduler", rule=RULE_SCHED_BIND,
+                outcome="bound", obj=pod,
+                message=f"assigned to {chosen}",
+                inputs={"node": chosen,
+                        "claims": sorted(c.meta.name for c in claims.values()),
+                        "feasibility": feasible_note},
+                now=self.sim_time)
         # Every consumer of a claim is recorded (shared claims have
         # several); unprepare only happens when the last one is gone.
         from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer
@@ -1425,6 +1469,14 @@ class SimCluster:
         self.sched_recorder.warning(
             pod, REASON_FAILED_SCHEDULING,
             f"0/{total} nodes can place the pod: {detail}")
+        self.history.decide(
+            controller="scheduler", rule=RULE_SCHED_PARK,
+            outcome="parked", obj=pod,
+            message=f"0/{total} nodes can place the pod",
+            inputs={"nodes": total,
+                    "reject_reasons": dict(sorted(reasons.items())[:8]),
+                    "claims": sorted(c.meta.name for c in unallocated)},
+            now=self.sim_time)
         for c in unallocated:
             self.alloc_recorder.warning(
                 c, REASON_ALLOCATION_FAILED,

@@ -506,31 +506,54 @@ def _describe_body(api, obj: K8sObject) -> List[str]:
 # --metrics-port, or any node's MetricsServer).
 
 
-def top_claim_rows(objs: List[K8sObject]) -> List[List[str]]:
+def _history_cols(history, series: str) -> List[str]:
+    """[MEAN-1M, P95-1M] off the flight recorder's one-minute tier: the
+    mean of the retained bucket means and the worst retained bucket p95
+    — hours of lookback where the status summary holds one window."""
+    pts = history.query(series, resolution="1m") if history is not None else []
+    if not pts:
+        return ["-", "-"]
+    mean = sum(p["mean"] for p in pts) / len(pts)
+    return [_pct(mean), _pct(max(p["p95"] for p in pts))]
+
+
+def top_claim_rows(objs: List[K8sObject], history=None) -> List[List[str]]:
     rows = [["NAMESPACE", "NAME", "DUTY-P95", "HBM-P95", "HBM-TOTAL",
              "WINDOW", "SAMPLES"]]
+    if history is not None:
+        rows[0] += ["MEAN-1M", "P95-1M"]
     ranked = sorted(
         (o for o in objs if getattr(o, "utilization", None) is not None),
         key=lambda o: -o.utilization.duty_cycle_p95)
     for o in ranked:
         u = o.utilization
-        rows.append([o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
-                     _gib(u.hbm_used_p95_bytes), _gib(u.hbm_total_bytes),
-                     f"{u.window_seconds:.0f}s", str(u.samples)])
+        row = [o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
+               _gib(u.hbm_used_p95_bytes), _gib(u.hbm_total_bytes),
+               f"{u.window_seconds:.0f}s", str(u.samples)]
+        if history is not None:
+            row += _history_cols(
+                history, f"claim-duty/{o.namespace}/{o.meta.name}")
+        rows.append(row)
     return rows
 
 
-def top_domain_rows(objs: List[K8sObject]) -> List[List[str]]:
+def top_domain_rows(objs: List[K8sObject], history=None) -> List[List[str]]:
     rows = [["NAMESPACE", "NAME", "DUTY-P95", "HBM-P95", "ICI-P95",
              "WINDOW", "SAMPLES"]]
+    if history is not None:
+        rows[0] += ["ICI-MEAN-1M", "ICI-P95-1M"]
     ranked = sorted(
         (o for o in objs if o.status.utilization is not None),
         key=lambda o: -o.status.utilization.duty_cycle_p95)
     for o in ranked:
         u = o.status.utilization
-        rows.append([o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
-                     _gib(u.hbm_used_p95_bytes), _pct(u.ici_utilization_p95),
-                     f"{u.window_seconds:.0f}s", str(u.samples)])
+        row = [o.namespace or "-", o.meta.name, _pct(u.duty_cycle_p95),
+               _gib(u.hbm_used_p95_bytes), _pct(u.ici_utilization_p95),
+               f"{u.window_seconds:.0f}s", str(u.samples)]
+        if history is not None:
+            row += _history_cols(
+                history, f"domain-ici/{o.namespace}/{o.meta.name}")
+        rows.append(row)
     return rows
 
 
@@ -593,6 +616,111 @@ def _print_table(rows: List[List[str]]) -> None:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
 
 
+# -- explain -----------------------------------------------------------------
+#
+# `tpu-kubectl explain <kind> <name>`: the merged causal timeline of one
+# object — deduplicated Events and flight-recorder DecisionRecords
+# (pkg/history.py) in one wall-clock order, every row linking its trace id,
+# plus a telemetry sparkline rendered off the recorder's downsampled tiers.
+# Works against the in-process sim (`sim explain`) and over the wire
+# (RemoteAPIServer.history -> /history routes) identically.
+
+
+def _compact(v: Any, cap: int = 64) -> str:
+    s = str(v)
+    return s if len(s) <= cap else s[: cap - 3] + "..."
+
+
+def _spark_series_for(api, obj: K8sObject) -> str:
+    """The telemetry series explain charts for one object. A Pod has no
+    series of its own — chart the claim reserved for it (its chips)."""
+    if obj.kind == "Node":
+        return f"node-duty/{obj.meta.name}"
+    if obj.kind == "ResourceClaim":
+        return f"claim-duty/{obj.namespace}/{obj.meta.name}"
+    if obj.kind == "ComputeDomain":
+        return f"domain-ici/{obj.namespace}/{obj.meta.name}"
+    if obj.kind == "Pod":
+        for c in sorted(api.list("ResourceClaim", namespace=obj.namespace),
+                        key=lambda c: c.meta.name):
+            if any(r.kind == "Pod" and r.name == obj.meta.name
+                   for r in getattr(c, "reserved_for", [])):
+                return f"claim-duty/{c.namespace}/{c.meta.name}"
+    return ""
+
+
+def explain_timeline_rows(api, obj: K8sObject, decisions,
+                          now: float) -> List[List[str]]:
+    """The merged TIME/SOURCE/WHAT/TRACE rows, oldest first. Events and
+    decisions both carry wall timestamps (DecisionRecord.wall exists for
+    exactly this merge — its ``time`` field is the caller's virtual
+    clock, disjoint from Event timestamps)."""
+    from k8s_dra_driver_tpu.pkg.events import events_for
+
+    merged: List[tuple] = []
+    for ev in events_for(api, obj):
+        what = f"{ev.type}/{ev.reason}"
+        if ev.count > 1:
+            what += f" x{ev.count}"
+        merged.append((ev.last_timestamp, 0, [
+            _age(ev.last_timestamp, now),
+            f"event/{ev.source or '-'}",
+            what + f": {ev.message}",
+            getattr(ev, "trace_id", "") or "-",
+        ]))
+    for r in decisions:
+        what = f"{r.rule} -> {r.outcome}: {r.message}"
+        if r.inputs:
+            what += (" [" + " ".join(f"{k}={_compact(v)}"
+                                     for k, v in sorted(r.inputs.items()))
+                     + "]")
+        merged.append((r.wall, 1, [
+            _age(r.wall, now), r.controller, what, r.trace_id or "-"]))
+    merged.sort(key=lambda t: (t[0], t[1]))
+    return [row for _, _, row in merged]
+
+
+def explain_object(api, kind: str, name: str, namespace: str = "") -> str:
+    """Render the `explain` view: identity, the merged Event+Decision
+    causal timeline, and the telemetry sparkline. ``api`` needs only
+    get/list plus an optional ``history`` attribute (the sim's
+    HistoryStore, or RemoteAPIServer's /history adapter; None degrades
+    to an events-only timeline)."""
+    from k8s_dra_driver_tpu.pkg.history import sparkline
+
+    obj = api.get(kind, name, namespace)
+    now = time.time()
+    hist = getattr(api, "history", None)
+    decisions = (hist.decisions_for(kind, obj.namespace or "", obj.meta.name)
+                 if hist is not None else [])
+    lines = [f"Name:       {obj.meta.name}"]
+    if obj.meta.namespace:
+        lines.append(f"Namespace:  {obj.meta.namespace}")
+    lines.append(f"Kind:       {obj.kind}")
+    rows = explain_timeline_rows(api, obj, decisions, now)
+    if rows:
+        lines += ["Timeline:"] + _table(
+            [["TIME", "SOURCE", "WHAT", "TRACE"]] + rows)
+    else:
+        lines.append("Timeline:   <none>")
+    series = _spark_series_for(api, obj) if hist is not None else ""
+    if series:
+        pts = hist.query(series, resolution="1m")
+        vals = [p["mean"] for p in pts]
+        label = "1m tier"
+        if not vals:
+            vals = [p["value"] for p in hist.query(series)]
+            label = "raw"
+        if vals:
+            lines.append(f"Telemetry:  {series} ({label}, "
+                         f"{len(vals)} points)")
+            lines.append(f"  {sparkline(vals)}  "
+                         f"[{min(vals):.3f} .. {max(vals):.3f}]")
+    if hist is None:
+        lines.append("(no flight recorder attached: events only)")
+    return "\n".join(lines)
+
+
 def describe_object(api, kind: str, name: str, namespace: str = "") -> str:
     """Render the `kubectl describe` view: identity, kind-specific status,
     conditions, and the deduplicated Event table."""
@@ -648,6 +776,14 @@ def main(argv=None) -> int:
     p_desc.add_argument("name")
     p_desc.add_argument("-n", "--namespace", default=None)
 
+    p_explain = sub.add_parser(
+        "explain",
+        help="merged causal timeline for one object: events + controller "
+        "decision records + telemetry sparkline, each row with its trace id")
+    p_explain.add_argument("kind")
+    p_explain.add_argument("name")
+    p_explain.add_argument("-n", "--namespace", default=None)
+
     p_top = sub.add_parser(
         "top",
         help="sorted utilization tables (nodes from a /metrics scrape, "
@@ -660,6 +796,9 @@ def main(argv=None) -> int:
                        default=os.environ.get("TPU_KUBECTL_METRICS", ""),
                        help="base URL of a /metrics endpoint (required for "
                        "`top nodes`) [TPU_KUBECTL_METRICS]")
+    p_top.add_argument("--history", action="store_true",
+                       help="add MEAN-1M/P95-1M columns from the flight "
+                       "recorder's downsampled one-minute tier")
 
     p_del = sub.add_parser("delete")
     p_del.add_argument("kind")
@@ -721,10 +860,14 @@ def main(argv=None) -> int:
         else:
             list_ns = args.namespace or "default"
         objs = api.list(kind, namespace=list_ns)
+        hist = getattr(api, "history", None) if args.history else None
+        if args.history and hist is None:
+            raise SystemExit("error: --history needs a server with a flight "
+                             "recorder attached (sim --persist or default)")
         if kind == "ResourceClaim":
-            _print_table(top_claim_rows(objs))
+            _print_table(top_claim_rows(objs, history=hist))
         elif kind == "ComputeDomain":
-            _print_table(top_domain_rows(objs))
+            _print_table(top_domain_rows(objs, history=hist))
         else:
             _print_table(top_servinggroup_rows(objs))
         return 0
@@ -768,6 +911,11 @@ def main(argv=None) -> int:
 
     if args.cmd == "describe":
         print(describe_object(
+            api, kind, args.name, _default_namespace(kind, args.namespace or "")))
+        return 0
+
+    if args.cmd == "explain":
+        print(explain_object(
             api, kind, args.name, _default_namespace(kind, args.namespace or "")))
         return 0
 

@@ -1,0 +1,14 @@
+"""tpulint fixture: decision-discipline must stay quiet — RULE_*
+constants referenced directly (bare or module-qualified), no local
+constant definitions, unrelated decide()-less calls untouched."""
+
+from k8s_dra_driver_tpu.pkg import history
+from k8s_dra_driver_tpu.pkg.history import RULE_SCHED_BIND
+
+
+def act(store, pod):
+    store.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                 outcome="bound", obj=pod)
+    store.decide(controller="scheduler", rule=history.RULE_SCHED_PARK,
+                 outcome="parked", obj=pod)
+    store.record(pod)  # not a decide() call

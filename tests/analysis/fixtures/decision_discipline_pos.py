@@ -1,0 +1,13 @@
+"""tpulint fixture: decision-discipline MUST fire — inline rule ids,
+rules passed through non-RULE_* names, constants forked outside
+pkg/history.py (one malformed, none catalogued in history.md)."""
+
+RULE_LOCAL = "fixture/local-rule"      # outside pkg/history.py + not in doc
+RULE_BAD = "NotKebabShaped"            # + not component/kebab-action
+
+
+def act(history, pod, chosen_rule):
+    history.decide(controller="fixture", rule="scheduler/bind",
+                   outcome="bound", obj=pod)             # inline string id
+    history.decide(controller="fixture", rule=chosen_rule,
+                   outcome="bound", obj=pod)             # laundered name

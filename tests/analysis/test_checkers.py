@@ -40,6 +40,8 @@ CASES = [
      "metric_discipline_neg.py"),
     ("event-discipline", "event_discipline_pos.py", 4,
      "event_discipline_neg.py"),
+    ("decision-discipline", "decision_discipline_pos.py", 5,
+     "decision_discipline_neg.py"),
     ("swallowed-exceptions", "swallowed_exceptions_pos.py", 3,
      "swallowed_exceptions_neg.py"),
     ("thread-shared-state", "thread_shared_state_pos.py", 3,

@@ -1,0 +1,387 @@
+"""Flight-recorder unit tier (pkg/history.py): multi-resolution tiers,
+decision provenance, bounds, persistence, the telemetry change gate, the
+/history HTTP routes, and Event trace-id stamping."""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import Pod, ResourceClaim
+from k8s_dra_driver_tpu.k8s.httpapi import HTTPAPIServer, RemoteAPIServer
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.pkg.events import EventRecorder, REASON_SCHEDULED
+from k8s_dra_driver_tpu.pkg.history import (
+    RAW_CAPACITY,
+    RULE_EVICT,
+    RULE_SCHED_BIND,
+    DecisionRecord,
+    HistoryStore,
+    sparkline,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+
+
+# -- tiers / query ------------------------------------------------------------
+
+
+def test_push_downsamples_into_tiers_with_coherent_stats():
+    h = HistoryStore(None)
+    # 130 one-second samples: crosses two 1m bucket boundaries.
+    for i in range(130):
+        h.push("s", float(i), float(i % 10))
+    raw = h.query("s")
+    assert len(raw) == 130
+    assert [p["t"] for p in raw] == sorted(p["t"] for p in raw)
+    m1 = h.query("s", resolution="1m")
+    assert len(m1) == 3  # two sealed + the open bucket
+    for b in m1:
+        assert b["count"] >= 1
+        assert b["min"] <= b["mean"] <= b["max"]
+        assert b["min"] <= b["p95"] <= b["max"]
+    assert m1[0]["count"] == 60 and m1[1]["count"] == 60
+    assert m1[0]["min"] == 0.0 and m1[0]["max"] == 9.0
+    m10 = h.query("s", resolution="10m")
+    assert len(m10) == 1 and m10[0]["count"] == 130
+
+
+def test_query_window_forms_and_bad_resolution():
+    h = HistoryStore(None)
+    for i in range(100):
+        h.push("s", float(i), float(i))
+    # Float window: last W seconds relative to the newest point.
+    assert [p["t"] for p in h.query("s", window=4.0)] == \
+        [95.0, 96.0, 97.0, 98.0, 99.0]
+    # (lo, hi) absolute bounds, inclusive.
+    assert [p["t"] for p in h.query("s", window=(10.0, 12.0))] == \
+        [10.0, 11.0, 12.0]
+    assert h.query("missing") == []
+    with pytest.raises(ValueError):
+        h.query("s", resolution="5s")
+
+
+def test_raw_ring_and_series_lru_bounds():
+    h = HistoryStore(None, raw_capacity=8, max_series=3)
+    for i in range(20):
+        h.push("a", float(i), 1.0)
+    assert len(h.query("a")) == 8
+    assert h.query("a")[0]["t"] == 12.0
+    for name in ("b", "c", "d"):  # touches a; b/c/d fill then evict
+        h.push(name, 0.0, 1.0)
+    h.push("a", 20.0, 1.0)  # a stays warm through the LRU touch
+    h.push("e", 0.0, 1.0)
+    names = h.series_names()
+    assert len(names) == 3
+    assert "a" in names and "e" in names and "b" not in names
+
+
+# -- decisions ----------------------------------------------------------------
+
+
+def test_decide_resolves_identity_revision_and_trace():
+    h = HistoryStore(None)
+    pod = Pod(meta=new_meta("web", "default"))
+    pod.meta.resource_version = 7
+    with tracing.span("test.pass"):
+        ctx = tracing.current()
+        rec = h.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                       outcome="bound", obj=pod, message="m",
+                       inputs={"node": "n0"}, now=3.0)
+    assert rec.kind == "Pod" and rec.namespace == "default"
+    assert rec.name == "web" and rec.revision == 7
+    assert rec.trace_id == ctx.trace_id and rec.trace_id
+    assert rec.time == 3.0 and rec.wall > 0
+    got = h.decisions_for("Pod", "default", "web")
+    assert got == [rec]
+    # Outside any span the trace id is empty, not an error.
+    rec2 = h.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                    outcome="bound", obj=pod, now=4.0)
+    assert rec2.trace_id == ""
+
+
+def test_decide_never_raises():
+    h = HistoryStore(None)
+
+    class Hostile:
+        @property
+        def meta(self):
+            raise RuntimeError("boom")
+
+    assert h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+                    obj=Hostile()) is None
+    assert h.decision_count() == 0
+
+
+def test_decision_bounds_per_object_and_object_lru():
+    h = HistoryStore(None, max_decisions_per_object=4,
+                     max_decision_objects=2)
+    for j in range(10):
+        h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+                 kind="Pod", namespace="ns", name="p0",
+                 message=f"m{j}", now=float(j))
+    recs = h.decisions_for("Pod", "ns", "p0")
+    assert [r.message for r in recs] == ["m6", "m7", "m8", "m9"]
+    h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+             kind="Pod", namespace="ns", name="p1", now=0.0)
+    h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+             kind="Pod", namespace="ns", name="p2", now=0.0)
+    assert h.decisions_for("Pod", "ns", "p0") == []  # LRU-evicted
+    assert h.decisions_for("Pod", "ns", "p2") != []
+
+
+def test_decisions_for_window_and_limit():
+    h = HistoryStore(None)
+    for j in range(6):
+        h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+                 kind="Pod", namespace="ns", name="p",
+                 message=f"m{j}", now=float(j))
+    assert [r.message for r in
+            h.decisions_for("Pod", "ns", "p", window=(2.0, 4.0))] == \
+        ["m2", "m3", "m4"]
+    assert [r.message for r in
+            h.decisions_for("Pod", "ns", "p", limit=2)] == ["m4", "m5"]
+    assert h.decision_count() == 6
+
+
+def test_decision_record_doc_roundtrip():
+    rec = DecisionRecord(time=1.0, controller="c", rule=RULE_EVICT,
+                         outcome="o", kind="Pod", namespace="ns", name="p",
+                         revision=3, message="m", inputs={"a": [1, 2]},
+                         trace_id="t", wall=2.0)
+    doc = rec.to_doc()
+    json.dumps(doc)  # wire-serializable
+    assert DecisionRecord.from_doc(doc) == rec
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_metrics_count_samples_decisions_and_series():
+    reg = Registry()
+    h = HistoryStore(None, metrics_registry=reg)
+    for i in range(5):
+        h.push("a", float(i), 1.0)
+    h.push("b", 0.0, 1.0)
+    h.decide(controller="scheduler", rule=RULE_SCHED_BIND, outcome="bound",
+             kind="Pod", namespace="ns", name="p", now=0.0)
+    h.decide(controller="preemption", rule=RULE_EVICT, outcome="evicted",
+             kind="Pod", namespace="ns", name="p", now=0.0)
+    text = reg.expose()
+    assert 'tpu_dra_history_samples_total 6' in text
+    assert 'tpu_dra_history_decisions_total{controller="scheduler"} 1' in text
+    assert 'tpu_dra_history_decisions_total{controller="preemption"} 1' in text
+    assert 'tpu_dra_history_series 2' in text
+
+
+# -- persistence --------------------------------------------------------------
+
+
+def test_fingerprint_survives_close_reopen_and_checkpoint(tmp_path):
+    d = str(tmp_path / "hist")
+    h1 = HistoryStore(d)
+    for i in range(300):  # crosses the raw ring so restore replays tiers
+        h1.push("node-duty/n0", float(i), (i % 7) / 10.0)
+    for j in range(5):
+        h1.decide(controller="c", rule=RULE_EVICT, outcome="o",
+                  kind="Pod", namespace="ns", name="p",
+                  message=f"m{j}", now=float(j))
+    fp1 = h1.fingerprint()
+    h1.close()
+    h2 = HistoryStore(d)
+    assert h2.fingerprint() == fp1
+    assert len(h2.query("node-duty/n0")) == RAW_CAPACITY
+    assert [r.message for r in h2.decisions_for("Pod", "ns", "p")] == \
+        [f"m{j}" for j in range(5)]
+    h2.checkpoint()
+    h2.close()
+    assert HistoryStore(d).fingerprint() == fp1
+
+
+def test_crash_restore_replays_segments_without_snapshot(tmp_path):
+    """Reopen WITHOUT close() — the crash path: state comes back purely
+    from the WAL segment replay, counted in the restored_* counters."""
+    d = str(tmp_path / "hist")
+    h1 = HistoryStore(d)
+    for i in range(50):
+        h1.push("s", float(i), (i % 7) / 10.0)
+    for j in range(5):
+        h1.decide(controller="c", rule=RULE_EVICT, outcome="o",
+                  kind="Pod", namespace="ns", name="p",
+                  message=f"m{j}", now=float(j))
+    h1.sync()  # flushed appends, no snapshot fold
+    h2 = HistoryStore(d)
+    assert h2.restored_samples == 50 and h2.restored_decisions == 5
+    assert h2.fingerprint() == h1.fingerprint()
+
+
+def test_segment_rotation_bounds_disk(tmp_path):
+    d = str(tmp_path / "hist")
+    h = HistoryStore(d, segment_max_records=10, max_segments=2)
+    for i in range(100):
+        h.push("s", float(i), 1.0)
+    segs = [f for f in os.listdir(d) if f.startswith("seg.")]
+    assert 1 <= len(segs) <= 2  # older segments folded into the snapshot
+    fp = h.fingerprint()
+    h.close()
+    assert HistoryStore(d, segment_max_records=10,
+                        max_segments=2).fingerprint() == fp
+
+
+def test_restore_tolerates_torn_segment_tail(tmp_path):
+    d = str(tmp_path / "hist")
+    h = HistoryStore(d)
+    h.push("s", 1.0, 0.5)
+    h.decide(controller="c", rule=RULE_EVICT, outcome="o",
+             kind="Pod", namespace="ns", name="p", now=1.0)
+    h.sync()  # crash: segment flushed, never folded
+    seg = max(f for f in os.listdir(d) if f.startswith("seg."))
+    with open(os.path.join(d, seg), "a") as f:
+        f.write('{"k": "s", "s": "torn", "t": 2.0')  # torn mid-write
+    h2 = HistoryStore(d)
+    assert h2.query("s") == [{"t": 1.0, "value": 0.5}]
+    assert len(h2.decisions_for("Pod", "ns", "p")) == 1
+    assert h2.query("torn") == []
+
+
+# -- sparkline ----------------------------------------------------------------
+
+
+def test_sparkline_shape():
+    assert sparkline([]) == ""
+    flat = sparkline([0.5, 0.5, 0.5])
+    assert len(flat) == 3 and len(set(flat)) == 1
+    ramp = sparkline([float(i) for i in range(8)])
+    assert ramp[0] < ramp[-1]
+    assert len(sparkline([float(i) for i in range(200)], width=48)) == 48
+
+
+# -- telemetry change gate ----------------------------------------------------
+
+
+def _gate_fixtures():
+    from tests.test_telemetry import _view  # the telemetry tier's builder
+
+    api = APIServer()
+    api.create(ResourceClaim(meta=new_meta("c0", "default")))
+    from k8s_dra_driver_tpu.pkg.telemetry import TelemetryAggregator
+
+    agg = TelemetryAggregator(api, Registry())
+    agg.history = HistoryStore(None)
+    return api, agg, _view
+
+
+def test_rollup_feed_is_change_gated():
+    _, agg, _view = _gate_fixtures()
+    try:
+        for now in (1.0, 2.0, 3.0):
+            agg.rollup(now, [_view(duty=0.6)])
+        # Steady series push exactly once — the recorder must not grow
+        # on unchanged load (the bench_history ≤5% overhead gate).
+        assert len(agg.history.query("claim-duty/default/c0")) == 1
+        assert len(agg.history.query("node-duty/node-0")) == 1
+        agg.rollup(4.0, [_view(duty=0.8)])  # moved >= quantum
+        pts = agg.history.query("claim-duty/default/c0")
+        assert [(p["t"], p["value"]) for p in pts] == [(1.0, 0.6), (4.0, 0.8)]
+        agg.rollup(5.0, [_view(duty=0.8004)])  # sub-quantum wiggle: gated
+        assert len(agg.history.query("claim-duty/default/c0")) == 2
+    finally:
+        agg.history.close()
+        agg.close()
+
+
+def test_rollup_feed_keepalive_repushes_steady_series():
+    from k8s_dra_driver_tpu.pkg.telemetry import HISTORY_KEEPALIVE_S
+
+    _, agg, _view = _gate_fixtures()
+    try:
+        agg.rollup(1.0, [_view(duty=0.6)])
+        agg.rollup(2.0, [_view(duty=0.6)])
+        late = 2.0 + HISTORY_KEEPALIVE_S
+        agg.rollup(late, [_view(duty=0.6)])
+        pts = agg.history.query("claim-duty/default/c0")
+        assert [p["t"] for p in pts] == [1.0, late]
+    finally:
+        agg.history.close()
+        agg.close()
+
+
+# -- HTTP routes / remote parity ----------------------------------------------
+
+
+def _decorated_api():
+    api = APIServer()
+    api.create(Pod(meta=new_meta("web", "default")))
+    hist = HistoryStore(None)
+    for i in range(10):
+        hist.push("node-duty/n0", float(i), i / 10.0)
+    with tracing.span("test.pass"):
+        hist.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                    outcome="bound", kind="Pod", namespace="default",
+                    name="web", message="m", inputs={"node": "n0"}, now=5.0)
+    api.history = hist
+    return api, hist
+
+
+def test_history_routes_and_remote_adapter_parity():
+    api, hist = _decorated_api()
+    srv = HTTPAPIServer(api=api).start()
+    try:
+        remote = RemoteAPIServer(srv.url)
+        rh = remote.history
+        assert rh is not None
+        assert rh.series_names() == hist.series_names()
+        assert rh.query("node-duty/n0") == hist.query("node-duty/n0")
+        assert rh.query("node-duty/n0", window=3.0) == \
+            hist.query("node-duty/n0", window=3.0)
+        assert rh.query("node-duty/n0", window=(2.0, 4.0),
+                        resolution="raw") == \
+            hist.query("node-duty/n0", window=(2.0, 4.0))
+        assert rh.query("node-duty/n0", resolution="1m") == \
+            hist.query("node-duty/n0", resolution="1m")
+        assert rh.decisions_for("Pod", "default", "web") == \
+            hist.decisions_for("Pod", "default", "web")
+        assert rh.decisions_for("Pod", "default", "web", limit=1) == \
+            hist.decisions_for("Pod", "default", "web", limit=1)
+    finally:
+        srv.stop()
+        hist.close()
+
+
+def test_history_routes_404_without_store():
+    import urllib.error
+    import urllib.request
+
+    srv = HTTPAPIServer(api=APIServer()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/history/series", timeout=5)
+        assert ei.value.code == 404
+        assert b"no history store attached" in ei.value.read()
+        # And the probing property resolves to None, so kubectl degrades
+        # to an events-only explain instead of erroring per row.
+        assert RemoteAPIServer(srv.url).history is None
+    finally:
+        srv.stop()
+
+
+# -- event trace stamping -----------------------------------------------------
+
+
+def test_event_trace_id_stamped_and_bumped_to_latest_span():
+    api = APIServer()
+    pod = api.create(Pod(meta=new_meta("web", "default")))
+    rec = EventRecorder(api, "scheduler")
+    with tracing.span("pass.one"):
+        first = tracing.current().trace_id
+        rec.normal(pod, REASON_SCHEDULED, "assigned to n0")
+    ev = [e for e in api.list("Event", namespace="default")
+          if e.reason == REASON_SCHEDULED][0]
+    assert ev.trace_id == first
+    with tracing.span("pass.two"):
+        second = tracing.current().trace_id
+        rec.normal(pod, REASON_SCHEDULED, "assigned to n0")
+    ev = api.get("Event", ev.meta.name, "default")
+    assert ev.count == 2
+    assert ev.trace_id == second  # latest occurrence wins on dedup

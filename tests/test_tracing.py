@@ -322,3 +322,80 @@ def test_json_log_formatter_includes_trace_id():
     doc = json.loads(fmt.format(record))
     assert doc["trace_id"] == sp.trace_id
     assert doc["span_id"] == sp.span_id
+
+
+# -- span-loss accounting + query filters (the PR 17 satellites) --------------
+
+
+def test_spans_filters_by_trace_id_and_name():
+    t = Tracer()
+    with t.span("alpha") as a:
+        with t.span("beta"):
+            pass
+    with t.span("alpha") as b:
+        pass
+    assert {s.span_id for s in t.spans(trace_id=a.trace_id)} == \
+        {s.span_id for s in t.spans() if s.trace_id == a.trace_id}
+    assert len(t.spans(trace_id=a.trace_id)) == 2
+    # name= is an EXACT span-name match, not a prefix.
+    assert {s.trace_id for s in t.spans(name="alpha")} == \
+        {a.trace_id, b.trace_id}
+    assert t.spans(name="alph") == []
+    assert [s.name for s in t.spans(trace_id=b.trace_id, name="beta")] == []
+
+
+def test_dropped_spans_metrics_and_payload_accounting():
+    reg = Registry()
+    t = Tracer(capacity=4)
+    t.attach_metrics(reg)
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    assert t.dropped_count() == 6
+    assert reg.expose().count("tpu_dra_trace_spans_dropped_total 6") == 1
+    assert "tpu_dra_trace_ring_utilization 1" in reg.expose()
+    # The export declares its losses even when it LOOKS complete.
+    assert t.export_chrome()["spansDropped"] == 6
+    # Re-attaching the same registry must not double-count the backlog.
+    t.attach_metrics(reg)
+    assert "tpu_dra_trace_spans_dropped_total 6" in reg.expose()
+
+
+def test_debug_traces_query_filters_and_methods():
+    tracer = Tracer()
+    with tracer.span("scheduler.pass") as a:
+        with tracer.span("scheduler.bind"):
+            pass
+    with tracer.span("preempt.pass"):
+        pass
+    srv = MetricsServer(Registry(), port=0, tracer=tracer)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/debug/traces"
+
+        def fetch(qs=""):
+            return json.loads(
+                urllib.request.urlopen(base + qs, timeout=5).read())
+
+        assert len(fetch()["traceEvents"]) == 3
+        by_trace = fetch(f"?trace_id={a.trace_id}")
+        assert {ev["name"] for ev in by_trace["traceEvents"]} == \
+            {"scheduler.pass", "scheduler.bind"}
+        assert "spansDropped" in by_trace  # loss accounting rides filters too
+        by_name = fetch("?name=preempt.pass")
+        assert [ev["name"] for ev in by_name["traceEvents"]] == \
+            ["preempt.pass"]
+        assert fetch("?name=preempt")["traceEvents"] == []  # exact, not prefix
+        # The mini HTTP tier's contracts hold on filtered URLs: HEAD
+        # answers headers-only, non-GET methods answer 405 with Allow.
+        req = urllib.request.Request(f"{base}?name=preempt.pass",
+                                     method="HEAD")
+        resp = urllib.request.urlopen(req, timeout=5)
+        assert resp.status == 200 and resp.read() == b""
+        req = urllib.request.Request(base, data=b"x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 405
+        assert exc.value.headers["Allow"] == "GET, HEAD"
+    finally:
+        srv.stop()
