@@ -42,7 +42,13 @@ bench:
 # contention-plane gate (2048-node mixed-tenant churn storm: WFQ Jain
 # fairness vs the FIFO baseline, per-tier p99 time-to-running with
 # preemption strictly below no-preemption, zero half-assembled domains;
-# BENCH_PREEMPT_NODES overrides). Capped at 30 min (the preempt A/B
+# BENCH_PREEMPT_NODES overrides) + the federation gate (1024-pod storm
+# through the WAL replication stream: lag p99 within BENCH_FED_LAG_P99_MS
+# with zero replica-side watch-ordering violations, fingerprint-token-
+# identical convergence after a mid-storm partition heals, promote()
+# serving writes after leader kill, >=BENCH_FED_OFFLOAD_MIN_X leader
+# read-path reduction with lists routed to the follower, global placement
+# p99 under BENCH_FED_PLACE_P99_MS). Capped at 30 min (the preempt A/B
 # adds ~8.5 min at 2048 nodes).
 bench-smoke:
 	timeout -k 10 1800 env JAX_PLATFORMS=cpu python bench.py --smoke

@@ -1157,6 +1157,210 @@ def bench_store_throughput(writer_threads: int = 8, ops_per_thread: int = 3000,
     }
 
 
+def bench_federation(storm_pods: int = 1024,
+                     assert_budget: bool = False) -> dict:
+    """Federated-fleet perf + chaos e2e (docs/reference/federation.md).
+
+    One leader persistent store + one ReplicaStore following its WAL
+    through the real tail/bootstrap/apply path, measured four ways:
+
+    - **replication lag** under a ``storm_pods``-pod write storm: each
+      write stamps a monotonic timestamp; a watch subscriber ON THE
+      REPLICA diffs at dequeue. Gates lag p99 within
+      ``BENCH_FED_LAG_P99_MS`` and ZERO ordering violations (per
+      subscription, delivered resourceVersions non-decreasing — the
+      replicated fan-out must keep the same guarantee the local store
+      gives).
+    - **partition chaos** mid-storm: the link is severed while writes
+      continue, healed, and the follower must converge
+      fingerprint-TOKEN-identical (the persistence restore equality) by
+      resuming at its watermark — no duplicates, no gaps.
+    - **leader kill**: promote() must leave a writable store that
+      answers read-your-write immediately (serving capacity survives
+      failover).
+    - **read offload A/B**: an identical list workload run against the
+      leader vs routed to the follower; gates the leader's list-call
+      reduction at >= ``BENCH_FED_OFFLOAD_MIN_X`` (default 2x — in
+      practice the offloaded leg leaves the leader at ~zero reads).
+
+    Plus **cross-cluster placement latency**: GlobalScheduler.place()
+    p99 over two clusters, gated by ``BENCH_FED_PLACE_P99_MS``."""
+    import os
+    import queue as queue_mod
+    import threading
+
+    from k8s_dra_driver_tpu.federation import (
+        ClusterView,
+        GlobalScheduler,
+        PlacementRequest,
+        ReplicaStore,
+        ReplicationSource,
+    )
+    from k8s_dra_driver_tpu.k8s.core import POD, Pod
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.k8s.persist import open_persistent_store
+    from k8s_dra_driver_tpu.sim.federation import _PartitionableSource
+
+    lag_budget_ms = float(os.environ.get("BENCH_FED_LAG_P99_MS", "1500"))
+    place_budget_ms = float(os.environ.get("BENCH_FED_PLACE_P99_MS", "50"))
+    offload_min_x = float(os.environ.get("BENCH_FED_OFFLOAD_MIN_X", "2.0"))
+
+    result: dict = {"fed_storm_pods": storm_pods}
+    with tempfile.TemporaryDirectory(prefix="bench-fed-") as tmp:
+        leader = open_persistent_store(tmp, compact_every=500_000)
+        link = _PartitionableSource(ReplicationSource(leader))
+        replica = ReplicaStore(link, cluster="bench-follower").start()
+
+        # Replica-side watch: the subscriber sees events only after a
+        # record crossed WAL -> tail -> apply -> follower fan-out, so the
+        # dequeue diff IS end-to-end replication lag.
+        rq = replica.api.watch(POD, maxsize=4 * storm_pods + 64)
+        lags: list = []
+        order_violations = [0]
+        consumed = [0]
+        stop = threading.Event()
+
+        def consume():
+            last_rv = 0
+            while not (stop.is_set() and rq.empty()):
+                try:
+                    ev = rq.get(timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                consumed[0] += 1
+                t = ev.obj.meta.annotations.get("t")
+                if t is not None:
+                    lags.append(time.perf_counter() - float(t))
+                rv = ev.obj.meta.resource_version
+                if rv < last_rv:
+                    order_violations[0] += 1
+                else:
+                    last_rv = rv
+
+        consumer = threading.Thread(target=consume, daemon=True)
+        consumer.start()
+
+        def wait_converged(timeout_s: float = 60.0) -> bool:
+            leader.flush_watchers()
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if (replica.api.kind_fingerprint(POD)
+                        == leader.kind_fingerprint(POD)):
+                    return True
+                time.sleep(0.01)
+            return False
+
+        # -- storm with a mid-storm partition --------------------------------
+        cut_at, heal_at = storm_pods // 3, 2 * storm_pods // 3
+        t0 = time.perf_counter()
+        for i in range(storm_pods):
+            if i == cut_at:
+                link.partition()
+            elif i == heal_at:
+                link.heal()
+            meta = new_meta(f"storm-{i}", "default")
+            meta.annotations["t"] = repr(time.perf_counter())
+            leader.create(Pod(meta=meta))
+        storm_wall = time.perf_counter() - t0
+        converged = wait_converged()
+        drain_wall = time.perf_counter() - t0
+        stop.set()
+        consumer.join(timeout=30)
+        lags.sort()
+        st = replica.status()
+        result.update({
+            "fed_storm_write_wall_s": round(storm_wall, 3),
+            "fed_storm_drain_wall_s": round(drain_wall, 3),
+            "fed_replication_lag_p99_ms": round(
+                lags[int(0.99 * (len(lags) - 1))] * 1e3 if lags else 0.0, 1),
+            "fed_replication_order_violations": order_violations[0],
+            "fed_replica_events_delivered": consumed[0],
+            "fed_converged_after_partition": converged,
+            "fed_replica_resyncs": st["resyncs"],
+            "fed_replica_reconnects": st["reconnects"],
+            "fed_replica_watermark": st["watermark"],
+        })
+        replica.api.stop_watch(POD, rq)
+
+        # -- read offload A/B ------------------------------------------------
+        # Same list workload, leader-routed vs follower-routed; the gate
+        # is the leader's own read-path counter, not wall time (wall
+        # conflates the two stores' cache states).
+        read_rounds = 200
+        base = leader.stats.list_calls
+        for _ in range(read_rounds):
+            leader.list(POD)
+        leader_only = leader.stats.list_calls - base
+        base = leader.stats.list_calls
+        for _ in range(read_rounds):
+            replica.api.list(POD)
+        leader_offloaded = leader.stats.list_calls - base
+        reduction = leader_only / max(1.0, float(leader_offloaded))
+        result.update({
+            "fed_offload_leader_lists_baseline": leader_only,
+            "fed_offload_leader_lists_offloaded": leader_offloaded,
+            "fed_offload_reduction_x": round(min(reduction, 1e6), 1),
+        })
+
+        # -- leader kill / failover ------------------------------------------
+        link.partition()
+        promoted = replica.promote()
+        meta = new_meta("post-failover", "default")
+        promoted.create(Pod(meta=meta))
+        failover_ok = (not promoted.read_only
+                       and promoted.try_get(POD, "post-failover",
+                                            "default") is not None)
+        result["fed_failover_write_ok"] = failover_ok
+        leader._wal.close()
+
+    # -- cross-cluster placement latency -------------------------------------
+    sched = GlobalScheduler([
+        ClusterView(name="region-a", free_chips=lambda: 4096, weight=1.0),
+        ClusterView(name="region-b", free_chips=lambda: 4096, weight=2.0),
+    ])
+    place_rounds = 200
+    durations = []
+    placed = unplaced = 0
+    for r in range(place_rounds):
+        reqs = [PlacementRequest(name=f"d{r}-{j}", chips=4 * (1 + j % 4))
+                for j in range(8)]
+        t0 = time.perf_counter()
+        res = sched.place(reqs)
+        durations.append(time.perf_counter() - t0)
+        placed += len(res.placements)
+        unplaced += len(res.unplaced)
+    durations.sort()
+    result.update({
+        "fed_place_rounds": place_rounds,
+        "fed_place_p99_ms": round(
+            durations[int(0.99 * (len(durations) - 1))] * 1e3, 3),
+        "fed_placed": placed,
+        "fed_unplaced": unplaced,
+    })
+
+    if assert_budget:
+        lag_p99 = result["fed_replication_lag_p99_ms"]
+        assert lag_p99 <= lag_budget_ms, (
+            f"replication lag p99 {lag_p99}ms exceeds budget "
+            f"{lag_budget_ms}ms under the {storm_pods}-pod storm")
+        assert result["fed_replication_order_violations"] == 0, (
+            f"{result['fed_replication_order_violations']} watch-ordering "
+            f"violations on the replica — replicated fan-out broke the "
+            f"per-subscription rv guarantee")
+        assert result["fed_converged_after_partition"], (
+            "follower did not converge fingerprint-token-identical after "
+            "the mid-storm partition healed")
+        assert result["fed_failover_write_ok"], (
+            "promoted replica failed to serve a write after leader kill")
+        assert reduction >= offload_min_x, (
+            f"follower read offload cut leader list traffic only "
+            f"{reduction:.1f}x (< {offload_min_x}x)")
+        assert result["fed_place_p99_ms"] <= place_budget_ms, (
+            f"cross-cluster placement p99 {result['fed_place_p99_ms']}ms "
+            f"exceeds budget {place_budget_ms}ms")
+    return result
+
+
 def bench_zero_copy_reads(num_objects: int = 8192, list_iters: int = 20,
                           subscribers: int = 8, churn: int = 512) -> dict:
     """Reference-handout vs copy-always read-path A/B at 8192-object
@@ -2859,6 +3063,14 @@ def main() -> None:
         # >=30% below it, zero flaps on the bursty segment, zero store
         # list() calls across a steady-state step.
         result.update(bench_autoscaler(assert_budget=True))
+        # Federation gates (1024-pod storm through the WAL stream): lag
+        # p99 within BENCH_FED_LAG_P99_MS with zero replica-side watch
+        # ordering violations, fingerprint-token-identical convergence
+        # after a mid-storm partition heals, promote() serving a write
+        # after leader kill, >=2x leader read-path reduction with the
+        # list workload routed to the follower, placement p99 under
+        # BENCH_FED_PLACE_P99_MS.
+        result.update(bench_federation(assert_budget=True))
         print(json.dumps(result))
         return
     result = bench_prepare_latency()
@@ -2933,6 +3145,13 @@ def main() -> None:
         result.update(bench_autoscaler())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["autoscaler_error"] = str(e)[:200]
+    try:
+        # Federated fleet: WAL-streamed replication lag/ordering under a
+        # 1024-pod storm, partition/heal convergence, leader-kill
+        # failover, follower read offload A/B, global placement latency.
+        result.update(bench_federation())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["federation_error"] = str(e)[:200]
     try:
         result.update(bench_claim_to_running())
     except Exception as e:  # noqa: BLE001 — extras are best-effort

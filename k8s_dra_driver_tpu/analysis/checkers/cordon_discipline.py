@@ -75,7 +75,10 @@ class CordonDisciplineChecker(Checker):
     scope = ("k8s_dra_driver_tpu/rebalancer/",
              "k8s_dra_driver_tpu/autoscaler/",
              "k8s_dra_driver_tpu/scheduling/",
-             "k8s_dra_driver_tpu/controller/")
+             "k8s_dra_driver_tpu/controller/",
+             # Cross-cluster placement/spill must not side-step the CAS
+             # either when it starts moving claims between regions.
+             "k8s_dra_driver_tpu/federation/")
 
     def check_file(self, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []

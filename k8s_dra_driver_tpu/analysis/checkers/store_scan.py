@@ -37,6 +37,9 @@ class StoreScanChecker(Checker):
     scope = ("k8s_dra_driver_tpu/sim/", "k8s_dra_driver_tpu/controller/",
              "k8s_dra_driver_tpu/autoscaler/",
              "k8s_dra_driver_tpu/scheduling/",
+             # The global scheduler and replica apply path run per
+             # placement round / per WAL record — same hot-loop bar.
+             "k8s_dra_driver_tpu/federation/",
              # The flight recorder feeds every pass and the explain path
              # walks the store per command — same hot-loop discipline.
              "k8s_dra_driver_tpu/pkg/history.py")

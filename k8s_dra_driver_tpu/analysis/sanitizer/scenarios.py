@@ -309,6 +309,103 @@ def scenario_wal_compact(state: SanitizerState, seed: int,
         restored._wal.close()
 
 
+# -- scenario 2b: replication tail racing compaction epoch rotation -----------
+
+
+def scenario_replication_tail_vs_compaction(state: SanitizerState, seed: int,
+                                            extra_workers: int = 0) -> None:
+    """A follower tailing the leader's WAL (federation/replication.py
+    fetch sweeps + the real ReplicaStore bootstrap/apply path) while
+    writers churn and low-water compaction rotates epochs underneath it.
+    The follower must converge fingerprint-token identical whether a
+    given record reached it via the stream or via a re-snapshot handoff
+    (compaction folding records away before the tail saw them)."""
+    import json as _json
+    import random
+
+    from k8s_dra_driver_tpu.federation.replication import (
+        ReplicaStore,
+        ReplicationSource,
+    )
+    from k8s_dra_driver_tpu.k8s import APIServer, ConflictError, NotFoundError
+    from k8s_dra_driver_tpu.k8s.core import POD, RESOURCE_CLAIM, Pod, ResourceClaim
+    from k8s_dra_driver_tpu.k8s.objects import AlreadyExistsError, new_meta
+    from k8s_dra_driver_tpu.k8s.persist import StoreWAL
+
+    with tempfile.TemporaryDirectory(prefix="tpusan-repl-") as tmp:
+        api = APIServer(shards=2)
+        # compact_every low: epochs rotate repeatedly mid-tail, so the
+        # follower keeps hitting both resume-at-watermark and the
+        # compacted-past-me re-snapshot handoff.
+        wal = StoreWAL(tmp, compact_every=6, fsync=False)
+        api.attach_wal(wal)
+        src = ReplicationSource(api, wal)
+        rep = ReplicaStore(src, shards=2, cluster="san")
+        with rep._mu:
+            rep._watermarks[-1] = 0
+        kinds = {POD: Pod, RESOURCE_CLAIM: ResourceClaim}
+
+        def churn(kind, cls, wseed):
+            rng = random.Random(wseed)
+            names = [f"{kind.lower()}-{i}" for i in range(4)]
+            for _ in range(10):
+                name = rng.choice(names)
+                try:
+                    if rng.random() < 0.6:
+                        api.create(cls(meta=new_meta(name, "default")))
+                    else:
+                        api.delete(kind, name, "default")
+                except (NotFoundError, AlreadyExistsError, ConflictError):
+                    pass
+                api.flush_watchers()
+
+        def follow_once():
+            # One supervisor round of the follower, single-stepped: the
+            # exact resync rule ReplicaStore._tail_one enforces when the
+            # source answers SNAPSHOT, driven through the REAL bootstrap
+            # (snapshot diff-apply) and _apply (seq-watermark) paths.
+            with rep._mu:
+                wm = rep._watermarks.get(-1, 0)
+            snap_w, _ = src._snapshot_head()
+            if wm < snap_w:
+                rep._bootstrap()  # takes rep._mu itself
+                with rep._mu:
+                    rep._watermarks[-1] = wm = max(
+                        rep._watermarks.get(-1, 0), rep._bootstrap_watermark)
+            lines, _ = src.fetch(-1, wm)
+            for line in lines:
+                rep._apply(-1, _json.loads(line))
+
+        def tailer():
+            for _ in range(12):
+                follow_once()
+                state.yield_point(("scenario", "tailer"))
+
+        workers: _Workers = [
+            (f"writer-{kind}", (lambda k=kind, c=cls, i=i:
+                                churn(k, c, seed * 23 + i)))
+            for i, (kind, cls) in enumerate(kinds.items())
+        ] + [("tailer", tailer)]
+        explore(state, seed, workers + _fillers(state, extra_workers))
+
+        api.flush_watchers()
+        follow_once()  # final drain: everything written is now on disk
+        for kind in kinds:
+            want = api.kind_fingerprint(kind)
+            got = rep.api.kind_fingerprint(kind)
+            _invariant(state, want == got,
+                       f"{kind}: follower fingerprint token {got} != "
+                       f"leader {want} — the tail/compaction race lost or "
+                       f"duplicated a replicated record")
+            live = {o.meta.name for o in api.list(kind)}
+            back = {o.meta.name for o in rep.api.list(kind)}
+            _invariant(state, live == back,
+                       f"{kind}: follower contents diverge: "
+                       f"missing={sorted(live - back)} "
+                       f"extra={sorted(back - live)}")
+        wal.close()
+
+
 # -- scenario 3: migration rollback vs. prepare/unprepare churn ---------------
 
 
@@ -1225,6 +1322,7 @@ def scenario_history_rollover_vs_explain(state: SanitizerState, seed: int,
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
+    "replication-tail-vs-compaction": scenario_replication_tail_vs_compaction,
     "migration-rollback": scenario_migration_rollback,
     "events-correlator": scenario_events_correlator,
     "meshgen-reemit": scenario_meshgen_reemit,

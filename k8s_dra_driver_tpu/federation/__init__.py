@@ -1,0 +1,33 @@
+"""Federated fleet: WAL-streamed replication and cross-cluster placement.
+
+``replication`` turns one cluster's store into a streamable change feed
+(leader :class:`ReplicationSource`) and keeps a full follower store
+converged from it (:class:`ReplicaStore`) so reads, scrapes and
+``tpu-kubectl`` offload to a replica; ``scheduler`` places workloads
+across clusters by fleet headroom and spills serving traffic when a
+region's SLO burns. See ``docs/reference/federation.md``.
+"""
+
+from k8s_dra_driver_tpu.federation.replication import (
+    ReplicaStore,
+    ReplicationError,
+    ReplicationSource,
+)
+from k8s_dra_driver_tpu.federation.scheduler import (
+    ClusterView,
+    GlobalScheduler,
+    Placement,
+    PlacementRequest,
+    PlacementResult,
+)
+
+__all__ = [
+    "ClusterView",
+    "GlobalScheduler",
+    "Placement",
+    "PlacementRequest",
+    "PlacementResult",
+    "ReplicaStore",
+    "ReplicationError",
+    "ReplicationSource",
+]

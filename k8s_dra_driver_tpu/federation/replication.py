@@ -1,0 +1,663 @@
+"""WAL-streamed store replication: leader source + follower replica.
+
+The PR 8 write-ahead log already IS an ordered, fingerprint-tokened
+change stream — every mutation appends ``{"seq","op","key","fp","obj"}``
+with the object body spliced from the snapshot's cached wire encoding
+(serialize-once, k8s.serialize.wire_json). Replication therefore never
+invents a second change feed: the leader-side :class:`ReplicationSource`
+*tails the WAL files on disk* and forwards the raw record lines, and the
+follower-side :class:`ReplicaStore` applies them through the store's
+normal publish/freeze path (``APIServer.apply_replicated``), so the
+replica's informers, watch fan-out, telemetry rollups and ``tpu-kubectl``
+all run unmodified against it.
+
+Protocol (transport-agnostic; k8s.httpapi carries it over chunked HTTP):
+
+- ``status()`` — current epoch, ring watermark (the global dispatch-ring
+  sequence), snapshot watermark, stream ids (-1 = the shared group-commit
+  file; durable mode streams one file per shard) and the per-kind
+  fingerprint tokens.
+- ``snapshot()`` — the leader's on-disk snapshot document (the exact
+  format ``k8s.persist`` writes and replays): bootstrap AND resync are
+  the restore path, not a third code path.
+- ``tail(stream, from_seq)`` — raw WAL record lines with seq strictly
+  above ``from_seq``, then live-tailing. Control lines:
+  ``{"ctl": "SNAPSHOT", ...}`` (the follower's watermark predates the
+  leader's snapshot — those records are compacted away; re-bootstrap),
+  ``{"ctl": "HEARTBEAT", "watermark": N}`` (keepalive + the leader's
+  head position, the follower's lag denominator).
+
+Watermark semantics: the dispatch-ring ``seq`` is globally monotone and
+every record carries it, so "resume at the watermark" is exact — a
+reconnecting follower asks for ``from_seq = last applied`` and can
+neither duplicate (seq <= watermark is skipped) nor gap (every record
+above the snapshot watermark still lives in an on-disk epoch file until
+a compaction folds it into the snapshot, and a follower older than the
+snapshot watermark is told to re-bootstrap). Epoch rotation mid-tail is
+seamless: the tail drains the rotated file to EOF (a POSIX unlink does
+not invalidate an open descriptor), then switches to the next epoch.
+The per-kind fingerprint tokens ride every record and are installed
+verbatim, so leader and converged follower are fingerprint-TOKEN
+identical — the same O(1) equality the restore acceptance test pins.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.persist import (
+    SNAPSHOT_FILE,
+    StoreWAL,
+    discover_wal_files,
+)
+from k8s_dra_driver_tpu.k8s.serialize import from_wire
+from k8s_dra_driver_tpu.k8s.store import APIServer
+
+log = logging.getLogger(__name__)
+
+# Tail cadence: how often the source re-polls its files for new bytes and
+# the ceiling on control-line silence (heartbeats let a blocked reader
+# notice a stop/partition within one beat).
+TAIL_POLL_S = 0.02
+TAIL_HEARTBEAT_S = 1.0
+
+# Follower supervisor: reconnect backoff after a severed stream.
+RECONNECT_BACKOFF_S = 0.2
+
+# Records of head-vs-applied lag past which the follower is considered
+# lagging (ReplicaLagging event through the injected recorder).
+DEFAULT_LAG_ALERT_RECORDS = 5000
+
+
+class ReplicationError(RuntimeError):
+    """A WAL stream violated the protocol (corrupt mid-file record)."""
+
+
+class ReplicationSource:
+    """Leader half: serves snapshot handoffs and tails WAL files.
+
+    Attach to the hosting store as ``api.replication = source`` — the
+    HTTPAPIServer probes exactly that attribute (the same 404-degrade
+    seam as ``api.history``) to decide whether the ``/replication/*``
+    routes exist. The source only ever READS the leader: snapshot bytes
+    come off disk, record lines are forwarded verbatim (the spliced
+    cached encodings — the object graph is never re-walked here), and
+    the one mutation it may trigger is an initial ``wal.compact`` when
+    no snapshot exists yet."""
+
+    def __init__(self, api: APIServer, wal: Optional[StoreWAL] = None):
+        self._api = api
+        self._wal = wal if wal is not None else api._wal
+        if self._wal is None:
+            raise ValueError("ReplicationSource needs a store with an "
+                             "attached WAL (open_persistent_store)")
+        self._metrics = None
+
+    # -- wiring --------------------------------------------------------------
+
+    @property
+    def dirpath(self) -> str:
+        return self._wal.dirpath
+
+    def attach_metrics(self, registry) -> None:
+        from k8s_dra_driver_tpu.pkg.metrics import Counter
+
+        self._metrics = {
+            "records": registry.register(Counter(
+                "tpu_dra_replication_stream_records_total",
+                "WAL records streamed to replication followers, by "
+                "stream (-1 = the shared group-commit file).",
+                label_names=("stream",))),
+            "snapshots": registry.register(Counter(
+                "tpu_dra_replication_snapshots_served_total",
+                "Snapshot handoffs served to bootstrapping or resyncing "
+                "followers.")),
+        }
+
+    # -- protocol ------------------------------------------------------------
+
+    def _ring_watermark(self) -> int:
+        with self._api._ring_mu:
+            return self._api._ring_seq
+
+    def _snapshot_head(self) -> Tuple[int, int]:
+        """(snapshot watermark, snapshot epoch) from the on-disk snapshot
+        head, or (0, 0) when none exists. Reads only the head line's
+        fields — the objects array is not materialized here."""
+        path = os.path.join(self.dirpath, SNAPSHOT_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return (0, 0)
+        return (int(doc.get("watermark", 0)), int(doc.get("epoch", 0)))
+
+    def status(self) -> dict:
+        snap_w, snap_epoch = self._snapshot_head()
+        if self._wal.fsync:
+            streams = list(range(len(self._api._shards)))
+        else:
+            streams = [-1]
+        with self._api._locked_all():
+            fps = {}
+            for shard in self._api._shards:
+                fps.update(shard.fp)
+        return {
+            "epoch": self._wal._epoch,
+            "watermark": self._ring_watermark(),
+            "snapshot_watermark": snap_w,
+            "snapshot_epoch": snap_epoch,
+            "streams": streams,
+            "fps": {kind: list(fp) for kind, fp in fps.items()},
+        }
+
+    def snapshot(self) -> dict:
+        """The snapshot document for a bootstrap/resync handoff. One is
+        guaranteed to exist (open_persistent_store compacts at open); a
+        bare StoreWAL attach without one triggers a single compaction so
+        the handoff always has a restore point."""
+        path = os.path.join(self.dirpath, SNAPSHOT_FILE)
+        if not os.path.exists(path):
+            self._wal.compact(self._api)
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if self._metrics is not None:
+            self._metrics["snapshots"].inc()
+        return doc
+
+    def fetch(self, stream: int, from_seq: int) -> Tuple[List[str], int]:
+        """One non-blocking sweep: every currently-complete record line
+        for ``stream`` with seq > ``from_seq``, in order, plus the new
+        watermark. The bounded sibling of :meth:`tail` for tests and the
+        sanitizer's explored schedules (no sleeps, no threads)."""
+        out: List[str] = []
+        last = from_seq
+        for epoch, shard, path in discover_wal_files(self.dirpath):
+            if shard != stream:
+                continue
+            for line, complete in _read_lines(path):
+                if not complete:
+                    break  # torn/in-flight tail: next sweep retries
+                seq = _record_seq(line)
+                if seq <= last:
+                    continue
+                out.append(line)
+                last = seq
+        if self._metrics is not None and out:
+            self._metrics["records"].inc(str(stream), by=float(len(out)))
+        return out, last
+
+    def tail(self, stream: int, from_seq: int,
+             stop: Optional[threading.Event] = None,
+             poll_s: float = TAIL_POLL_S,
+             heartbeat_s: float = TAIL_HEARTBEAT_S) -> Iterator[str]:
+        """Stream raw record lines for one WAL stream from ``from_seq``,
+        live-tailing until ``stop`` is set. Yields control lines (see
+        module docstring) interleaved; record lines are the on-disk bytes
+        verbatim. Epoch rotation is followed (drain old epoch to EOF,
+        switch to the next); a follower older than the on-disk snapshot
+        is handed ``{"ctl": "SNAPSHOT"}`` and the stream ends."""
+        snap_w, _ = self._snapshot_head()
+        if from_seq < snap_w:
+            yield json.dumps({"ctl": "SNAPSHOT", "watermark": snap_w})
+            return
+        last = from_seq
+        done_epoch = -1          # epochs fully consumed for this stream
+        cur: Optional[Tuple[int, str]] = None   # (epoch, path) being tailed
+        fobj = None
+        buf = ""
+        last_beat = time.monotonic()
+        try:
+            while stop is None or not stop.is_set():
+                progressed = False
+                if fobj is None:
+                    for epoch, shard, path in discover_wal_files(self.dirpath):
+                        if shard == stream and epoch > done_epoch:
+                            cur = (epoch, path)
+                            fobj = open(path, encoding="utf-8")
+                            buf = ""
+                            break
+                if fobj is not None:
+                    chunk = fobj.read()
+                    if chunk:
+                        buf += chunk
+                        lines = buf.split("\n")
+                        buf = lines.pop()  # empty iff chunk ended on "\n"
+                        for line in lines:
+                            if not line.strip():
+                                continue
+                            seq = _record_seq(line)
+                            if seq <= last:
+                                continue
+                            last = seq
+                            progressed = True
+                            if self._metrics is not None:
+                                self._metrics["records"].inc(str(stream))
+                            yield line
+                    else:
+                        rotated = self._wal._epoch > cur[0]
+                        if rotated and not buf:
+                            fobj.close()
+                            fobj, done_epoch = None, cur[0]
+                            continue
+                        if rotated and buf:
+                            # A rotated epoch can never complete its
+                            # partial last line: it is a crash artifact
+                            # (torn tail). Same policy as replay: drop it
+                            # loudly and move on.
+                            log.warning(
+                                "dropping torn tail (%d bytes) at end of "
+                                "rotated WAL epoch %d stream %d",
+                                len(buf), cur[0], stream)
+                            fobj.close()
+                            fobj, done_epoch, buf = None, cur[0], ""
+                            continue
+                if not progressed:
+                    nowm = time.monotonic()
+                    if nowm - last_beat >= heartbeat_s:
+                        last_beat = nowm
+                        yield json.dumps({"ctl": "HEARTBEAT",
+                                          "watermark": self._ring_watermark()})
+                    if stop is not None:
+                        stop.wait(poll_s)
+                    else:
+                        time.sleep(poll_s)
+        finally:
+            if fobj is not None:
+                fobj.close()
+
+
+def _read_lines(path: str) -> Iterator[Tuple[str, bool]]:
+    """Yield (line, complete) for one WAL file; the final element is
+    marked incomplete when the file does not end in a newline (torn or
+    in-flight append)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = f.read()
+    except OSError:
+        return
+    if not data:
+        return
+    complete_tail = data.endswith("\n")
+    lines = data.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        yield line, (i < len(lines) - 1) or complete_tail
+
+
+def _record_seq(line: str) -> int:
+    """The seq of one raw record line. Parses the JSON head only via the
+    standard decoder; a complete line that does not parse is corruption,
+    not a torn tail, and must fail loudly (the torn-tail case never
+    reaches here — incomplete lines are held back by the tailer)."""
+    try:
+        return int(json.loads(line)["seq"])
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        raise ReplicationError(
+            f"corrupt WAL record line ({e}): {line[:120]!r}") from None
+
+
+class ReplicaStore:
+    """Follower half: a full APIServer kept converged with a leader by
+    applying its WAL stream through ``apply_replicated``.
+
+    ``source`` is anything implementing the protocol trio
+    status()/snapshot()/tail() — the in-process
+    :class:`ReplicationSource` or ``k8s.httpapi.RemoteReplicationSource``
+    over the wire. The replica's ``api`` is ``read_only`` (mutating verbs
+    raise ReadOnlyStoreError) until :meth:`promote` flips it writable on
+    leader failover. The replica hangs itself off the store as
+    ``api.replica`` — the watermark-stamping seam tpu-kubectl and the
+    ``/replica/watermark`` HTTP route read."""
+
+    def __init__(self, source, shards: Optional[int] = None,
+                 cluster: str = "follower",
+                 poll_s: float = TAIL_POLL_S,
+                 metrics_registry=None,
+                 recorder=None,
+                 lag_alert_records: int = DEFAULT_LAG_ALERT_RECORDS,
+                 clock: Callable[[], float] = time.time):
+        from k8s_dra_driver_tpu.k8s.store import DEFAULT_STORE_SHARDS
+
+        self.source = source
+        self.cluster = cluster
+        self.poll_s = poll_s
+        self.recorder = recorder
+        self.lag_alert_records = lag_alert_records
+        self.clock = clock
+        self.api = APIServer(shards=shards or DEFAULT_STORE_SHARDS)
+        self.api.read_only = True
+        self.api.replica = self
+        self._mu = threading.Lock()
+        self._watermarks: Dict[int, int] = {}  # tpulint: guarded-by=_mu
+        self._head = 0  # tpulint: guarded-by=_mu (leader watermark last seen)
+        self._applied = 0  # tpulint: guarded-by=_mu
+        self._resyncs = 0  # tpulint: guarded-by=_mu
+        self._reconnects = 0  # tpulint: guarded-by=_mu
+        self._lagging = False  # tpulint: guarded-by=_mu
+        self.promoted = False
+        self._stop = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        self._metrics = None
+        if metrics_registry is not None:
+            self.attach_metrics(metrics_registry)
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_metrics(self, registry) -> None:
+        from k8s_dra_driver_tpu.pkg.metrics import (
+            REPLICATION_LATENCY_BUCKETS,
+            Counter,
+            Gauge,
+            Histogram,
+        )
+
+        self._metrics = {
+            "applied": registry.register(Counter(
+                "tpu_dra_replication_applied_total",
+                "Replicated WAL records applied to this replica store, "
+                "by op (PUT/DEL).",
+                label_names=("op",))),
+            "apply_latency": registry.register(Histogram(
+                "tpu_dra_replication_apply_seconds",
+                "Per-record apply cost on the replica (wire decode + "
+                "store install + watch fan-out).",
+                buckets=REPLICATION_LATENCY_BUCKETS)),
+            "watermark": registry.register(Gauge(
+                "tpu_dra_replication_watermark",
+                "Highest leader WAL sequence applied, by stream.",
+                label_names=("stream",))),
+            "lag": registry.register(Gauge(
+                "tpu_dra_replication_lag_records",
+                "Leader head watermark minus this replica's applied "
+                "watermark (records the replica still has to apply).")),
+            "resyncs": registry.register(Counter(
+                "tpu_dra_replication_resyncs_total",
+                "Snapshot re-bootstraps (first bootstrap, or the leader "
+                "compacted past this replica's watermark).")),
+            "reconnects": registry.register(Counter(
+                "tpu_dra_replication_reconnects_total",
+                "Severed replication streams re-established (partition "
+                "heal, leader restart).")),
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def watermark(self) -> int:
+        """Highest leader WAL seq applied across streams — what follower
+        answers are stamped with so staleness is visible."""
+        with self._mu:
+            return max(self._watermarks.values(), default=0)
+
+    def lag_records(self) -> int:
+        with self._mu:
+            return max(0, self._head - max(self._watermarks.values(),
+                                           default=0))
+
+    def status(self) -> dict:
+        with self._mu:
+            applied_w = max(self._watermarks.values(), default=0)
+            return {
+                "cluster": self.cluster,
+                "watermark": applied_w,
+                "watermarks": {str(s): w
+                               for s, w in sorted(self._watermarks.items())},
+                "head": self._head,
+                "lag_records": max(0, self._head - applied_w),
+                "applied": self._applied,
+                "resyncs": self._resyncs,
+                "reconnects": self._reconnects,
+                "promoted": self.promoted,
+            }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, bootstrap: bool = True) -> "ReplicaStore":
+        """Bootstrap from the leader snapshot (synchronously, so callers
+        observe a populated replica on return) and start the streaming
+        supervisor."""
+        if bootstrap:
+            self._bootstrap()
+        self._supervisor = threading.Thread(
+            target=self._run, name=f"replica-{self.cluster}", daemon=True)
+        self._supervisor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=10)
+            self._supervisor = None
+
+    def promote(self) -> APIServer:
+        """Leader failover: stop replicating, flip the store writable,
+        and resume the rv counter past everything replicated. The
+        FailoverStarted/FailoverCompleted events land in the replica's
+        OWN store (the leader may be gone — that is why we are here)."""
+        from k8s_dra_driver_tpu.pkg.events import (
+            REASON_FAILOVER_COMPLETED,
+            REASON_FAILOVER_STARTED,
+        )
+
+        self.stop()
+        self.api.read_only = False
+        rec = self._failover_recorder()
+        if rec is not None:
+            rec.normal(self._cluster_ref(), REASON_FAILOVER_STARTED,
+                       f"promoting replica of cluster "
+                       f"{self.cluster!r} at watermark {self.watermark()}")
+        self.api.resume_rv()
+        self.promoted = True
+        if rec is not None:
+            rec.normal(self._cluster_ref(), REASON_FAILOVER_COMPLETED,
+                       f"replica {self.cluster!r} serving writes "
+                       f"(watermark {self.watermark()})")
+        return self.api
+
+    def _failover_recorder(self):
+        try:
+            from k8s_dra_driver_tpu.pkg.events import EventRecorder
+
+            return EventRecorder(self.api, "federation", clock=self.clock)
+        except Exception:  # noqa: BLE001 — telemetry must not block failover
+            log.exception("failover event recorder unavailable")
+            return None
+
+    def _cluster_ref(self):
+        from k8s_dra_driver_tpu.k8s.core import ObjectReference
+
+        return ObjectReference(kind="Cluster", name=self.cluster,
+                               namespace="", uid="")
+
+    # -- bootstrap / resync --------------------------------------------------
+
+    def _bootstrap(self) -> None:
+        """Snapshot handoff, applied as a DIFF against current replica
+        contents: unchanged revisions (same stamped resourceVersion) are
+        skipped, changed/new objects are upserted, local keys absent from
+        the snapshot get synthesized deletes — so a RE-bootstrap (resync
+        after the leader compacted past us) keeps the replica's informers
+        and watch subscribers alive instead of tearing the store down.
+        Fingerprint tokens then land wholesale, token-identical to the
+        snapshot head."""
+        doc = self.source.snapshot()
+        watermark = int(doc.get("watermark", 0))
+        fps = {k: (int(v[0]), int(v[1]))
+               for k, v in doc.get("fps", {}).items()}
+        live: set = set()
+        for obj_doc in doc.get("objects", ()):
+            obj = from_wire(obj_doc)
+            key = (obj.kind, obj.meta.namespace, obj.meta.name)
+            live.add(key)
+            cur = self.api.try_get(key[0], key[2], key[1])
+            if (cur is not None
+                    and cur.meta.resource_version == obj.meta.resource_version):
+                continue
+            self.api.apply_replicated("PUT", obj, key, None)
+            self._count_apply("PUT")
+        # One pass over the replica's own shards (it owns them — nothing
+        # else writes a read-only store) instead of a per-kind list().
+        with self.api._locked_all():
+            local_keys = [k for shard in self.api._shards
+                          for k in shard.objects]
+        for key in local_keys:
+            if key not in live:
+                self.api.apply_replicated("DEL", None, key, None)
+                self._count_apply("DEL")
+        self.api.install_fingerprints(fps)
+        with self._mu:
+            self._resyncs += 1
+            self._head = max(self._head, watermark)
+            for s in list(self._watermarks) or []:
+                self._watermarks[s] = max(self._watermarks[s], watermark)
+            self._bootstrap_watermark = watermark
+        if self._metrics is not None:
+            self._metrics["resyncs"].inc()
+        log.info("replica %s bootstrapped: %d objects, watermark %d",
+                 self.cluster, len(doc.get("objects", ())), watermark)
+
+    # -- streaming -----------------------------------------------------------
+
+    def _run(self) -> None:
+        backoff = RECONNECT_BACKOFF_S
+        first_round = True
+        while not self._stop.is_set():
+            try:
+                st = self.source.status()
+            except Exception:  # noqa: BLE001 — partition/leader-down: retry
+                self._stop.wait(backoff)
+                continue
+            if not first_round:
+                # A round is starting after a severed one: the stream is
+                # re-established (counted here, where the leader answered
+                # again — not per failed probe during a partition).
+                with self._mu:
+                    self._reconnects += 1
+                if self._metrics is not None:
+                    self._metrics["reconnects"].inc()
+            first_round = False
+            streams = [int(s) for s in st.get("streams") or [-1]]
+            with self._mu:
+                self._head = max(self._head, int(st.get("watermark", 0)))
+                base = getattr(self, "_bootstrap_watermark", 0)
+                for s in streams:
+                    self._watermarks.setdefault(s, base)
+            round_stop = threading.Event()
+            need_resync = threading.Event()
+            threads = [
+                threading.Thread(
+                    target=self._tail_one, args=(s, round_stop, need_resync),
+                    name=f"replica-{self.cluster}-tail-{s}", daemon=True)
+                for s in streams
+            ]
+            for t in threads:
+                t.start()
+            # Monitor: poll leader head for the lag gauge until any tail
+            # exits (error/partition) or we are stopped.
+            while (not self._stop.is_set() and not round_stop.is_set()
+                   and any(t.is_alive() for t in threads)):
+                round_stop.wait(TAIL_HEARTBEAT_S)
+                self._poll_head()
+            round_stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            if self._stop.is_set():
+                return
+            if need_resync.is_set():
+                try:
+                    self._bootstrap()
+                except Exception:  # noqa: BLE001 — retry next round
+                    log.exception("replica %s resync failed; retrying",
+                                  self.cluster)
+            self._stop.wait(backoff)
+
+    def _poll_head(self) -> None:
+        try:
+            st = self.source.status()
+        except Exception:  # noqa: BLE001 — head poll is best-effort
+            return
+        with self._mu:
+            self._head = max(self._head, int(st.get("watermark", 0)))
+        self._note_lag()
+
+    def _tail_one(self, stream: int, round_stop: threading.Event,
+                  need_resync: threading.Event) -> None:
+        with self._mu:
+            from_seq = self._watermarks.get(stream, 0)
+        try:
+            for line in self.source.tail(stream, from_seq, stop=round_stop):
+                doc = json.loads(line) if isinstance(line, str) else line
+                ctl = doc.get("ctl")
+                if ctl == "SNAPSHOT":
+                    need_resync.set()
+                    round_stop.set()
+                    return
+                if ctl == "HEARTBEAT":
+                    with self._mu:
+                        self._head = max(self._head,
+                                         int(doc.get("watermark", 0)))
+                    self._note_lag()
+                    continue
+                self._apply(stream, doc)
+        except Exception as e:  # noqa: BLE001 — severed stream: supervisor retries
+            if not round_stop.is_set() and not self._stop.is_set():
+                # Expected under partition/leader-down — one line, no
+                # traceback (the supervisor reconnects; a stack here
+                # reads like a crash in chaos/bench output).
+                log.warning("replica %s stream %d severed (%s); will "
+                            "reconnect", self.cluster, stream, e)
+        finally:
+            round_stop.set()
+
+    def _apply(self, stream: int, rec: dict) -> None:
+        seq = int(rec["seq"])
+        with self._mu:
+            if seq <= self._watermarks.get(stream, 0):
+                return  # duplicate after reconnect replay
+        t0 = time.perf_counter()
+        obj_doc = rec.get("obj")
+        obj = from_wire(obj_doc) if obj_doc is not None else None
+        fp = rec.get("fp") or (0, 0)
+        self.api.apply_replicated(rec["op"], obj, tuple(rec["key"]),
+                                  (int(fp[0]), int(fp[1])))
+        if self._metrics is not None:
+            self._metrics["apply_latency"].observe(
+                value=time.perf_counter() - t0)
+        with self._mu:
+            self._watermarks[stream] = seq
+            self._head = max(self._head, seq)
+        self._count_apply(rec["op"], stream=stream, seq=seq)
+        self._note_lag()
+
+    def _count_apply(self, op: str, stream: Optional[int] = None,
+                     seq: Optional[int] = None) -> None:
+        with self._mu:
+            self._applied += 1
+        if self._metrics is not None:
+            self._metrics["applied"].inc(op)
+            if stream is not None and seq is not None:
+                self._metrics["watermark"].set(str(stream), value=float(seq))
+
+    def _note_lag(self) -> None:
+        lag = self.lag_records()
+        if self._metrics is not None:
+            self._metrics["lag"].set(value=float(lag))
+        with self._mu:
+            was = self._lagging
+            self._lagging = lag > self.lag_alert_records
+            fire = self._lagging and not was
+        if fire and self.recorder is not None:
+            from k8s_dra_driver_tpu.pkg.events import REASON_REPLICA_LAGGING
+
+            self.recorder.warning(
+                self._cluster_ref(), REASON_REPLICA_LAGGING,
+                f"replica {self.cluster!r} is {lag} WAL records behind "
+                f"the leader head")

@@ -1,0 +1,265 @@
+"""Cross-cluster global scheduler: fleet-level placement + SLO spill.
+
+One cluster is one failure domain; the fleet is several clusters (each
+its own store/allocator/autoscaler stack, possibly a read replica of a
+peer) federated behind this thin placement layer. It deliberately does
+NOT re-implement per-cluster scheduling — node fit, topology tiers, and
+queue discipline stay inside each cluster's allocator. The global layer
+answers exactly two questions:
+
+1. **Which cluster takes this workload?** ``place()`` apportions the
+   demanded chips across clusters with the same weighted max-min
+   water-filling the in-cluster WFQ uses (``scheduling.fair_apportion``
+   — demand = per-cluster free headroom, weight = the operator's
+   per-cluster weight), then greedily packs requests largest-first into
+   the granted budgets. Headroom comes from the same callable contract
+   the autoscaler's ``headroom_fn`` uses, so the sim, a live allocator
+   overview, or a telemetry rollup all plug in unchanged.
+
+2. **When do we spill serving traffic?** ``spill()`` watches a
+   cluster's SLO evaluator; while error-budget burn alerts fire it
+   shifts a burn-proportional fraction of serving traffic to the
+   healthiest peer (max headroom), so a follower region absorbs load
+   precisely when the local region is eating its budget.
+
+Placement decisions land in the history store
+(``controller="federation"``) so ``tpu-kubectl explain`` can answer
+*why* a domain runs where it runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from k8s_dra_driver_tpu.pkg.history import RULE_FED_PLACE, RULE_FED_SPILL
+from k8s_dra_driver_tpu.scheduling import fair_apportion
+
+log = logging.getLogger(__name__)
+
+# Spill is proportional to how hard the worst alert burns: burn 1.0 is
+# break-even (no spill), SPILL_FULL_BURN and beyond shifts MAX_SPILL of
+# traffic. Linear in between — smooth handoff, no flapping cliff.
+SPILL_FULL_BURN = 10.0
+MAX_SPILL = 0.9
+
+
+@dataclass
+class ClusterView:
+    """One cluster as the global scheduler sees it.
+
+    ``free_chips`` follows the autoscaler ``headroom_fn`` contract: a
+    zero-arg callable returning currently-unallocated chips (the sim
+    wires ``SimCluster._fleet_free_chips``; production wires the
+    allocator's placement overview). ``slo`` is an optional
+    ``pkg.slo.SLOEvaluator`` whose ``active_alerts()`` drives serving
+    spill. ``api`` is whatever answers reads for the cluster — the
+    leader store, a ``ReplicaStore.api``, or a ``RemoteAPIServer``."""
+
+    name: str
+    api: object = None
+    free_chips: Callable[[], int] = lambda: 0
+    weight: float = 1.0
+    slo: object = None
+
+
+@dataclass(frozen=True)
+class PlacementRequest:
+    """One workload asking the fleet for room."""
+
+    name: str
+    chips: int
+    kind: str = "ComputeDomain"
+    namespace: str = "default"
+
+
+@dataclass(frozen=True)
+class Placement:
+    request: PlacementRequest
+    cluster: str
+
+
+@dataclass
+class PlacementResult:
+    placements: List[Placement] = field(default_factory=list)
+    unplaced: List[PlacementRequest] = field(default_factory=list)
+    headroom: Dict[str, int] = field(default_factory=dict)
+
+    def cluster_of(self, name: str) -> Optional[str]:
+        for p in self.placements:
+            if p.request.name == name:
+                return p.cluster
+        return None
+
+
+class GlobalScheduler:
+    """Fleet-level placement over :class:`ClusterView` rows."""
+
+    def __init__(self, clusters: Sequence[ClusterView],
+                 recorder=None, history=None,
+                 metrics_registry=None,
+                 clock: Callable[[], float] = time.time):
+        if not clusters:
+            raise ValueError("GlobalScheduler needs at least one cluster")
+        names = [c.name for c in clusters]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate cluster names: {names}")
+        self.clusters: Dict[str, ClusterView] = {c.name: c for c in clusters}
+        self.recorder = recorder
+        self.history = history
+        self.clock = clock
+        self._metrics = None
+        if metrics_registry is not None:
+            self.attach_metrics(metrics_registry)
+
+    def attach_metrics(self, registry) -> None:
+        from k8s_dra_driver_tpu.pkg.metrics import Counter, Gauge
+
+        self._metrics = {
+            "headroom": registry.register(Gauge(
+                "tpu_dra_federation_headroom_chips",
+                "Free chips per federated cluster at the last placement "
+                "or spill evaluation.",
+                label_names=("cluster",))),
+            "placements": registry.register(Counter(
+                "tpu_dra_federation_placements_total",
+                "Cross-cluster placement decisions, by target cluster "
+                "and outcome (placed/unplaced).",
+                label_names=("cluster", "outcome"))),
+            "spill": registry.register(Gauge(
+                "tpu_dra_federation_spill_fraction",
+                "Fraction of serving traffic spilling away from a "
+                "burning cluster toward its healthiest peer.",
+                label_names=("cluster",))),
+        }
+
+    # -- headroom ------------------------------------------------------------
+
+    def headroom(self) -> Dict[str, int]:
+        """Free chips per cluster right now. A cluster whose headroom
+        callable raises (partitioned, leader down) reports 0 — it simply
+        attracts no placements until it answers again."""
+        out: Dict[str, int] = {}
+        for name, c in self.clusters.items():
+            try:
+                out[name] = max(0, int(c.free_chips()))
+            except Exception:  # noqa: BLE001 — unreachable cluster = no room
+                log.warning("cluster %s headroom probe failed", name,
+                            exc_info=True)
+                out[name] = 0
+        if self._metrics is not None:
+            for name, free in out.items():
+                self._metrics["headroom"].set(name, value=float(free))
+        return out
+
+    # -- placement -----------------------------------------------------------
+
+    def place(self, requests: Sequence[PlacementRequest]) -> PlacementResult:
+        """Place each request on exactly one cluster.
+
+        Budgeting is the WFQ water-fill: the demanded chip total is
+        apportioned across clusters (demand = headroom, weight =
+        operator weight), so no cluster is asked for more than it has
+        free and a weighted cluster soaks proportionally more of the
+        fleet's load. Packing is greedy largest-first into the budgets
+        (whole requests never split across clusters — a ComputeDomain's
+        ICI mesh lives in one failure domain), with a best-fit fallback
+        onto raw headroom so a request bigger than its fair share still
+        lands when some cluster has genuine room."""
+        result = PlacementResult(headroom=self.headroom())
+        budgets = fair_apportion(
+            demands={n: float(h) for n, h in result.headroom.items()},
+            weights={n: c.weight for n, c in self.clusters.items()},
+            capacity=float(sum(r.chips for r in requests)),
+        )
+        remaining = dict(result.headroom)
+        for req in sorted(requests, key=lambda r: (-r.chips, r.name)):
+            target = self._pick(req.chips, budgets, remaining)
+            if target is None:
+                result.unplaced.append(req)
+                self._note(req, None, result.headroom)
+                continue
+            budgets[target] = budgets.get(target, 0.0) - req.chips
+            remaining[target] -= req.chips
+            result.placements.append(Placement(request=req, cluster=target))
+            self._note(req, target, result.headroom)
+        return result
+
+    def _pick(self, chips: int, budgets: Dict[str, float],
+              remaining: Dict[str, int]) -> Optional[str]:
+        # First choice: the cluster with the most unused fair-share
+        # budget that can actually hold the request.
+        fits = [n for n, free in remaining.items() if free >= chips]
+        if not fits:
+            return None
+        by_budget = sorted(fits, key=lambda n: (-budgets.get(n, 0.0), n))
+        if budgets.get(by_budget[0], 0.0) >= chips:
+            return by_budget[0]
+        # Fallback: best fit on raw headroom (tightest cluster that
+        # holds it) — fair share is advisory once budgets run dry.
+        return min(fits, key=lambda n: (remaining[n], n))
+
+    def _note(self, req: PlacementRequest, cluster: Optional[str],
+              headroom: Dict[str, int]) -> None:
+        outcome = f"placed:{cluster}" if cluster else "unplaced"
+        if self._metrics is not None:
+            self._metrics["placements"].inc(cluster or "none",
+                                            "placed" if cluster
+                                            else "unplaced")
+        if self.history is not None:
+            self.history.decide(
+                controller="federation", rule=RULE_FED_PLACE, outcome=outcome,
+                kind=req.kind, namespace=req.namespace, name=req.name,
+                message=(f"{req.chips} chips -> {cluster}" if cluster else
+                         f"{req.chips} chips unplaced: no cluster has room"),
+                inputs={"chips": req.chips, "headroom": dict(headroom)},
+                now=self.clock())
+
+    # -- serving spill -------------------------------------------------------
+
+    def spill(self, cluster: str) -> Tuple[float, Optional[str]]:
+        """(fraction, target): how much of ``cluster``'s serving traffic
+        should run against a peer right now, and which peer. Zero while
+        the local SLO holds (or no evaluator is wired); while burn
+        alerts fire the fraction climbs linearly with the worst burn
+        rate (break-even burn 1.0 → 0, ``SPILL_FULL_BURN`` →
+        ``MAX_SPILL``) and the target is the peer with the most free
+        chips. No peer with headroom → no spill: degraded local serving
+        beats sending traffic to a full cluster."""
+        view = self.clusters[cluster]
+        burn = 0.0
+        if view.slo is not None:
+            try:
+                alerts = view.slo.active_alerts()
+            except Exception:  # noqa: BLE001 — SLO eval must not break spill
+                alerts = []
+            burn = max((a.burn_rate for a in alerts), default=0.0)
+        frac = 0.0
+        if burn > 1.0:
+            frac = min(MAX_SPILL,
+                       MAX_SPILL * (burn - 1.0) / (SPILL_FULL_BURN - 1.0))
+        target: Optional[str] = None
+        if frac > 0.0:
+            peers = {n: h for n, h in self.headroom().items()
+                     if n != cluster and h > 0}
+            if peers:
+                target = max(sorted(peers), key=lambda n: peers[n])
+            else:
+                frac = 0.0
+        if self._metrics is not None:
+            self._metrics["spill"].set(cluster, value=frac)
+        if frac > 0.0 and self.history is not None:
+            self.history.decide(
+                controller="federation", rule=RULE_FED_SPILL,
+                outcome=f"spill:{target}",
+                kind="Cluster", name=cluster,
+                message=(f"burn {burn:.2f}: spilling "
+                         f"{math.floor(frac * 100)}% of serving traffic "
+                         f"to {target}"),
+                inputs={"burn_rate": burn, "fraction": frac,
+                        "target": target},
+                now=self.clock())
+        return frac, target

@@ -21,10 +21,20 @@ Routes:
     GET    /history/series              flight-recorder series names
     GET    /history/query?series=&resolution=&window=|lo=&hi=
     GET    /history/decisions?kind=&ns=&name=&limit=
+    GET    /replication/status          leader replication head + streams
+    GET    /replication/snapshot        bootstrap/resync snapshot document
+    GET    /replication/wal?stream=&from=  chunked WAL record stream
+    GET    /replica/watermark           follower staleness stamp
 
 The /history routes are served only when the hosted APIServer carries a
 ``history`` attribute (the sim wires its HistoryStore there); they 404
-otherwise so clients can tell "no recorder" from "empty history".
+otherwise so clients can tell "no recorder" from "empty history". The
+/replication routes use the same seam on ``api.replication`` (a
+``federation.ReplicationSource`` — only a persistent leader store has
+one), and /replica/watermark on ``api.replica`` (a follower's
+``federation.ReplicaStore``), so one server binary serves leader,
+follower, or plain in-memory stores and clients probe capability by
+route. Followers are read-only: mutating verbs answer 403 ``ReadOnly``.
 """
 
 from __future__ import annotations
@@ -48,7 +58,7 @@ from k8s_dra_driver_tpu.k8s.objects import (
     NotFoundError,
 )
 from k8s_dra_driver_tpu.k8s.serialize import from_wire, to_wire
-from k8s_dra_driver_tpu.k8s.store import APIServer, WatchEvent
+from k8s_dra_driver_tpu.k8s.store import APIServer, ReadOnlyStoreError, WatchEvent
 
 log = logging.getLogger(__name__)
 
@@ -56,11 +66,13 @@ _ERROR_STATUS = {
     NotFoundError: 404,
     AlreadyExistsError: 409,
     ConflictError: 409,
+    ReadOnlyStoreError: 403,
 }
 _ERROR_CODE = {
     NotFoundError: "NotFound",
     AlreadyExistsError: "AlreadyExists",
     ConflictError: "Conflict",
+    ReadOnlyStoreError: "ReadOnly",
 }
 _CODE_ERROR = {v: k for k, v in _ERROR_CODE.items()}
 
@@ -125,6 +137,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif len(parts) == 2 and parts[0] == "history":
                 self._history_route(parts[1], q)
+            elif len(parts) == 2 and parts[0] == "replication":
+                self._replication_route(parts[1], q)
+            elif parts == ["replica", "watermark"]:
+                self._replica_route()
             else:
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
@@ -215,6 +231,56 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"items": [r.to_doc() for r in recs]})
         else:
             self._send_json(404, {"error": "NoRoute", "message": self.path})
+
+    # -- replication ---------------------------------------------------------
+
+    def _replication_route(self, what: str, q: Dict[str, List[str]]) -> None:
+        """Leader half of WAL-streamed replication (federation/). Gated
+        on ``api.replication`` the same way /history gates on
+        ``api.history`` — a store without an attached ReplicationSource
+        404s, so followers can tell "not a replicable leader" apart from
+        transport failures."""
+        repl = getattr(self.api, "replication", None)
+        if repl is None:
+            self._send_json(404, {"error": "NoRoute",
+                                  "message": "no replication source attached"})
+        elif what == "status":
+            self._send_json(200, repl.status())
+        elif what == "snapshot":
+            self._send_json(200, repl.snapshot())
+        elif what == "wal":
+            stream = int(q.get("stream", ["-1"])[0])
+            from_seq = int(q.get("from", ["0"])[0])
+            self._stream_wal(repl, stream, from_seq)
+        else:
+            self._send_json(404, {"error": "NoRoute", "message": self.path})
+
+    def _stream_wal(self, repl, stream: int, from_seq: int) -> None:
+        """Chunked JSON-lines tail of one WAL stream: raw record lines
+        forwarded verbatim (the on-disk bytes already splice the cached
+        wire encodings — nothing is re-serialized here), with the
+        source's HEARTBEAT/SNAPSHOT control lines interleaved. Ends when
+        the server stops or the client goes away (heartbeat writes
+        surface dead sockets, same as watch streams)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for line in repl.tail(stream, from_seq, stop=self.stopping):
+            data = (line + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+    def _replica_route(self) -> None:
+        """Follower staleness stamp: the applied replication watermark
+        (and lag bookkeeping) of the ReplicaStore hosting this store, or
+        404 when this server is not a replica."""
+        replica = getattr(self.api, "replica", None)
+        if replica is None:
+            self._send_json(404, {"error": "NoRoute",
+                                  "message": "not a replica store"})
+        else:
+            self._send_json(200, replica.status())
 
     # -- watch streaming ----------------------------------------------------
 
@@ -399,6 +465,16 @@ class RemoteAPIServer:
             return None
         return _RemoteHistory(self)
 
+    def replica_status(self) -> Optional[dict]:
+        """The server's follower staleness stamp (applied replication
+        watermark, lag, promotion state), or None when it is not a read
+        replica — kubectl probes this once per command to stamp follower
+        answers."""
+        try:
+            return self._request("GET", "/replica/watermark")
+        except ApiError:
+            return None
+
     def create(self, obj: K8sObject) -> K8sObject:
         return from_wire(self._request("POST", "/objects", to_wire(obj)))
 
@@ -575,6 +651,56 @@ class RemoteAPIServer:
             for obj in objs:
                 known.setdefault((obj.namespace or "", obj.meta.name), obj)
         return objs, q
+
+
+class RemoteReplicationSource:
+    """Client half of the /replication routes: the same
+    status()/snapshot()/tail() trio as ``federation.ReplicationSource``,
+    so a ``ReplicaStore`` follows a leader over the wire with no code
+    differences from the in-process case.
+
+    ``tail`` reads the chunked JSON-lines stream and yields the raw
+    lines (record lines verbatim, control lines included) — the caller
+    parses, exactly as with the local source. The read timeout is well
+    above the leader's heartbeat cadence, so a partitioned or dead
+    leader surfaces as an exception within ``timeout`` seconds and the
+    follower's supervisor reconnects; a set ``stop`` event ends the
+    stream within one heartbeat."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, path: str) -> dict:
+        with urllib.request.urlopen(self.base_url + path,
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def status(self) -> dict:
+        return self._request("/replication/status")
+
+    def snapshot(self) -> dict:
+        return self._request("/replication/snapshot")
+
+    def tail(self, stream: int, from_seq: int,
+             stop: Optional[threading.Event] = None):
+        url = (self.base_url
+               + f"/replication/wal?stream={stream}&from={from_seq}")
+        resp = urllib.request.urlopen(url, timeout=self.timeout)
+        try:
+            # http.client undoes the chunked framing; readline gives back
+            # the JSON lines the server wrote. Heartbeats arrive every
+            # TAIL_HEARTBEAT_S, so this loop re-checks ``stop`` at least
+            # that often and a silent wire trips the socket timeout.
+            while stop is None or not stop.is_set():
+                raw = resp.readline()
+                if not raw:
+                    return  # leader closed the stream (shutdown)
+                line = raw.decode().strip()
+                if line:
+                    yield line
+        finally:
+            resp.close()
 
 
 def main(argv=None) -> int:

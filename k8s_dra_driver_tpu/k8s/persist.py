@@ -43,19 +43,68 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import re
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from k8s_dra_driver_tpu.k8s import serialize
 from k8s_dra_driver_tpu.k8s.store import APIServer, DEFAULT_STORE_SHARDS
+
+log = logging.getLogger(__name__)
 
 SNAPSHOT_FILE = "snapshot.json"
 FORMAT_VERSION = 1
 
 _WAL_NAME = re.compile(r"^wal(?:-(\d+))?\.(\d+)\.jsonl$")
+
+
+# Paths already warned about by discover_wal_files' zero-length skip —
+# dedup only; never consulted for correctness.
+_warned_empty: set = set()
+
+
+def discover_wal_files(dirpath: str,
+                       include_empty: bool = False) -> List[Tuple[int, int, str]]:
+    """The ONE place WAL files are discovered on disk: every
+    ``wal[-<shard>].<epoch>.jsonl`` under ``dirpath`` as
+    ``(epoch, shard, path)`` tuples in NUMERIC (epoch, shard) order —
+    lexicographic glob order would replay epoch 10 before epoch 9 at
+    every digit-length boundary, resurrecting stale values when a crash
+    mid-compaction left two epochs on disk. A key lives in one shard, so
+    epoch-then-shard ordering is per-key write order. ``shard`` is -1
+    for the shared group-commit file.
+
+    Zero-length strays (a crash between open() and the first append, or
+    a copy truncated mid-transfer) are skipped LOUDLY — they carry no
+    records, but silently globbing them up has historically masked
+    half-copied replication/restore directories. ``include_empty=True``
+    (compaction's deletion sweep) returns them so old-epoch cleanup
+    still removes the husks. The warning fires once per path — the
+    replication tailer re-sweeps several times a second and a freshly
+    rotated epoch is legitimately empty until its first append."""
+    out: List[Tuple[int, int, str]] = []
+    for path in glob.glob(os.path.join(dirpath, "wal*.jsonl")):
+        m = _WAL_NAME.match(os.path.basename(path))
+        if m is None:
+            continue
+        entry = (int(m.group(2)), int(m.group(1) or -1), path)
+        try:
+            empty = os.path.getsize(path) == 0
+        except OSError:
+            continue  # unlinked mid-scan (compaction racing discovery)
+        if empty and not include_empty:
+            if path not in _warned_empty:
+                _warned_empty.add(path)
+                log.warning("skipping zero-length WAL file %s (crash between "
+                            "open and first append, or a truncated copy)",
+                            path)
+            continue
+        out.append(entry)
+    out.sort()
+    return out
 
 
 def _fsync(fd: int) -> None:
@@ -99,8 +148,8 @@ class StoreWAL:
         self.fsync = fsync
         self._mu = threading.Lock()
         self._epoch = 1 + max(
-            (int(m.group(2)) for m in map(_WAL_NAME.match,
-                                          os.listdir(dirpath)) if m),
+            (epoch for epoch, _, _ in discover_wal_files(dirpath,
+                                                         include_empty=True)),
             default=0)
         self._files: Dict[int, object] = {}  # tpulint: guarded-by=_mu
         self._since_snapshot = 0  # tpulint: guarded-by=_mu
@@ -254,9 +303,9 @@ class StoreWAL:
             f.flush()
             _fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
-        for path in glob.glob(os.path.join(self.dirpath, "wal*.jsonl")):
-            m = _WAL_NAME.match(os.path.basename(path))
-            if m and int(m.group(2)) < self._epoch:
+        for epoch, _, path in discover_wal_files(self.dirpath,
+                                                 include_empty=True):
+            if epoch < self._epoch:
                 os.unlink(path)
         if self._metrics is not None:
             self._metrics["snapshots"].inc()
@@ -298,18 +347,7 @@ def _load_disk_state(dirpath: str) -> Tuple[Dict[tuple, dict],
                    obj_doc.get("meta", {}).get("namespace", ""),
                    obj_doc.get("meta", {}).get("name", ""))
             objects[key] = obj_doc
-    wal_paths = []
-    for path in glob.glob(os.path.join(dirpath, "wal*.jsonl")):
-        m = _WAL_NAME.match(os.path.basename(path))
-        if m is None:
-            continue
-        # NUMERIC (epoch, shard) order — lexicographic glob order would
-        # replay epoch 10 before epoch 9 at every digit-length boundary,
-        # resurrecting stale values when a crash mid-compaction left two
-        # epochs on disk. A key lives in one shard, so epoch-then-shard
-        # ordering is per-key write order.
-        wal_paths.append((int(m.group(2)), int(m.group(1) or -1), path))
-    for _, _, path in sorted(wal_paths):
+    for _, _, path in discover_wal_files(dirpath):
         with open(path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()

@@ -96,6 +96,7 @@ import copy as _copy
 
 from k8s_dra_driver_tpu.k8s.objects import (
     AlreadyExistsError,
+    ApiError,
     ConflictError,
     K8sObject,
     NotFoundError,
@@ -104,6 +105,13 @@ from k8s_dra_driver_tpu.k8s.objects import (
     now,
     thaw,
 )
+
+
+class ReadOnlyStoreError(ApiError):
+    """Raised by the mutating verbs of a store serving as a read replica
+    (federation/replication.py): clients must route writes to the leader.
+    ``apply_replicated`` — the replication stream's install path — is the
+    only sanctioned mutation until ``read_only`` is cleared (failover)."""
 
 
 @dataclass(frozen=True)
@@ -252,6 +260,10 @@ class APIServer:
         self._dispatching = False  # tpulint: guarded-by=_ring_mu
         self._batch_fanout = batch_fanout
         self._wal = None  # set by attach_wal()
+        # Read-replica mode (federation): the mutating verbs refuse with
+        # ReadOnlyStoreError while the replication stream installs state
+        # through apply_replicated. Cleared by failover promotion.
+        self.read_only = False
 
     # -- internal ----------------------------------------------------------
 
@@ -291,6 +303,12 @@ class APIServer:
 
     def _next_rv(self) -> int:
         return next(self._rv_counter)
+
+    def _check_writable(self) -> None:
+        if self.read_only:
+            raise ReadOnlyStoreError(
+                "store is a read replica: route writes to the leader "
+                "cluster (or promote this replica first)")
 
     def _enqueue(self, kind: str, event: WatchEvent, wal_rec=None) -> int:
         # tpulint: holds=mu (write-path internal; every caller holds the
@@ -506,6 +524,7 @@ class APIServer:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: K8sObject) -> K8sObject:
+        self._check_writable()
         if not obj.kind or not obj.meta.name:
             raise ApiValueError("object needs kind and metadata.name")
         shard = self._shard(obj.kind)
@@ -653,6 +672,7 @@ class APIServer:
         (internal, the update_with_retry copy-on-write commit) marks
         ``obj`` as a private working copy the store may freeze in place
         instead of copying in."""
+        self._check_writable()
         shard = self._shard(obj.kind)
         with shard.mu:
             key = self._key(obj)
@@ -692,6 +712,7 @@ class APIServer:
         return stored
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._check_writable()
         shard = self._shard(kind)
         with shard.mu:
             key = (kind, namespace, name)
@@ -918,6 +939,80 @@ class APIServer:
             for kind, token in fps.items():
                 self._shard(kind).fp[kind] = (int(token[0]), int(token[1]))
             self._rv_counter = itertools.count(rv + 1)
+
+    # -- replication support -------------------------------------------------
+
+    def apply_replicated(self, op: str, obj: Optional[K8sObject], key,
+                         fp: Optional[Tuple[int, int]] = None) -> None:
+        """Install ONE replicated WAL record (federation/replication.py).
+
+        Unlike create/update/delete this preserves the LEADER's stamps
+        verbatim — resourceVersion, uid, generation, timestamps arrive on
+        ``obj`` (decoded from the record's spliced wire encoding) and the
+        per-kind fingerprint token is installed as carried (``fp``; None
+        leaves the current token, the snapshot diff-apply path installs
+        tokens wholesale afterwards). Watch events are emitted through the
+        normal off-lock fan-out, so informers, telemetry rollups and
+        tpu-kubectl watch a replica exactly as they watch a leader; a WAL
+        attached to THIS store re-logs the record (durable replica) via
+        the snapshot's cached wire encoding. Permitted while ``read_only``
+        — it is the replication stream's sanctioned mutation path. ``obj``
+        may be None only for DEL (a delete replayed against a key the
+        snapshot never contained)."""
+        kind = str(key[0])
+        k: _Key = (kind, str(key[1]), str(key[2]))
+        shard = self._shard(kind)
+        with shard.mu:
+            if op == "PUT":
+                if obj is None:
+                    raise ApiValueError(f"replicated PUT for {k} carries "
+                                        f"no object body")
+                etype = "MODIFIED" if k in shard.objects else "ADDED"
+                stored = obj if obj.frozen else freeze(obj)
+                self._index_add(shard, k, stored)
+            else:
+                cur = shard.objects.get(k)
+                stored = obj if obj is not None else cur
+                if cur is not None:
+                    self._index_drop(shard, k)
+                etype = "DELETED"
+            if fp is not None:
+                token = (int(fp[0]), int(fp[1]))
+                shard.fp[kind] = token
+            else:
+                token = shard.fp.get(kind, (0, 0))
+            if self._metrics is not None:
+                self._metrics["objects"].set(kind, value=float(token[0]))
+                self._metrics["shard_writes"].inc(str(shard.idx))
+            if stored is not None:
+                if not stored.frozen:
+                    freeze(stored)
+                self._write_event(shard, kind, etype, stored, op, k, token)
+        self._dispatch()
+
+    def install_fingerprints(self, fps: Dict[str, Tuple[int, int]]) -> None:
+        """Install per-kind fingerprint tokens verbatim (the replication
+        snapshot handoff: objects were diff-applied first, then the
+        tokens land wholesale so the replica's change-detection state is
+        token-identical to the leader's snapshot)."""
+        for kind, token in fps.items():
+            shard = self._shard(kind)
+            with shard.mu:
+                shard.fp[kind] = (int(token[0]), int(token[1]))
+                if self._metrics is not None:
+                    self._metrics["objects"].set(kind,
+                                                 value=float(token[0]))
+
+    def resume_rv(self, rv: Optional[int] = None) -> None:
+        """Restart the resourceVersion counter past ``rv`` (default: the
+        highest rv any fingerprint token carries). Failover promotion
+        calls this so a promoted replica's first write stamps a version
+        above everything it replicated."""
+        if rv is None:
+            with self._locked_all():
+                rv = max((fp[1] for s in self._shards
+                          for fp in s.fp.values()), default=0)
+        self._rv_counter = itertools.count(int(rv) + 1)
 
 
 class _AllShardsLocked:

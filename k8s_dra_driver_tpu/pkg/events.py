@@ -96,6 +96,12 @@ REASON_DOMAIN_READY = "DomainReady"
 REASON_DOMAIN_DEGRADED = "DomainDegraded"
 REASON_DOMAIN_RECOVERED = "DomainRecovered"
 REASON_DOMAIN_REJECTED = "DomainRejected"
+# Federation (federation/replication.py): replica lag fires against the
+# fleet recorder (the follower store is read-only); the failover pair
+# lands in the promoted replica's OWN store — the leader may be gone.
+REASON_REPLICA_LAGGING = "ReplicaLagging"
+REASON_FAILOVER_STARTED = "FailoverStarted"
+REASON_FAILOVER_COMPLETED = "FailoverCompleted"
 
 # Correlator defaults, scaled from client-go's EventCorrelator (burst 25,
 # refill 1 token / 5 min per object-and-source).

@@ -103,6 +103,13 @@ FEATURES: Tuple[FeatureSpec, ...] = (
         "instead of re-running its claim storm.",
     ),
     FeatureSpec(
+        "FederatedFleet", False, Stage.ALPHA,
+        "Attach a WAL-streaming ReplicationSource to the persistent store "
+        "so read replicas in other clusters can follow it (federation/), "
+        "and serve the /replication HTTP routes.",
+        requires=("StorePersistence",),
+    ),
+    FeatureSpec(
         "FleetTelemetry", False, Stage.ALPHA,
         "Sample per-chip HBM/duty-cycle/power/ICI counters into bounded "
         "ring-buffer time series, roll them up to per-claim and per-"

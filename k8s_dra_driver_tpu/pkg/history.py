@@ -88,6 +88,9 @@ RULE_RESIZE_START = "elastic/resize-epoch-start"
 RULE_RESIZE_PHASE = "elastic/resize-phase"
 RULE_RESIZE_HEALED = "elastic/resize-healed"
 RULE_RESIZE_ROLLBACK = "elastic/resize-rollback"
+# Cross-cluster global scheduler (federation/scheduler.py)
+RULE_FED_PLACE = "federation/place"
+RULE_FED_SPILL = "federation/spill"
 
 # -- bounds ------------------------------------------------------------------
 

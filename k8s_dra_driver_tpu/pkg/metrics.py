@@ -126,6 +126,13 @@ DRA_DURATION_BUCKETS: Tuple[float, ...] = tuple(0.05 * (2**k) for k in range(9))
 # the duration buckets so both histograms read on one grid.
 PREPARE_BATCH_SIZE_BUCKETS: Tuple[float, ...] = tuple(float(2**k) for k in range(9))
 
+# Sub-second envelope for the replication/federation hot paths (WAL
+# record apply latency, cross-cluster placement): 0.5ms * 2^k for
+# k=0..10 (0.5ms .. 512ms) — the DRA envelope starts at 50ms and would
+# fold the entire replication budget into its first bucket.
+REPLICATION_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    0.0005 * (2**k) for k in range(11))
+
 
 class Histogram(_Metric):
     kind = "histogram"

@@ -253,6 +253,14 @@ class SimCluster:
             # restarted sim keeps the fleet's telemetry past and every
             # pre-restart DecisionRecord `explain` needs.
             self._history_dir = os.path.join(store_dir, "history")
+            if self.gates.enabled("FederatedFleet"):
+                # Leader half of WAL-streamed replication: followers in
+                # other clusters tail this store's WAL (federation/).
+                # The HTTPAPIServer probes exactly this attribute to
+                # serve the /replication routes.
+                from k8s_dra_driver_tpu.federation import ReplicationSource
+
+                api.replication = ReplicationSource(api)
         self.api = api if api is not None else APIServer()
         self.workdir = workdir
         self.loopback_agents = loopback_agents
@@ -262,6 +270,9 @@ class SimCluster:
         self.metrics_registry = metrics_registry or Registry()
         if hasattr(self.api, "attach_metrics"):
             self.api.attach_metrics(self.metrics_registry)
+        repl = getattr(self.api, "replication", None)
+        if repl is not None:
+            repl.attach_metrics(self.metrics_registry)
         # Flight recorder (pkg/history.py): always on like tracing —
         # controllers write DecisionRecords through it, the telemetry
         # plane pushes series into its downsample tiers, and

@@ -1,0 +1,252 @@
+"""Multi-cluster sim harness: a federated fleet with chaos hooks.
+
+:class:`FederatedFleet` stands up the full federation stack in-process:
+
+- a **leader** :class:`~k8s_dra_driver_tpu.sim.cluster.SimCluster` with
+  persistence + the ``FederatedFleet`` gate (so its store carries a
+  ``ReplicationSource``),
+- a **read replica** (:class:`~k8s_dra_driver_tpu.federation.ReplicaStore`)
+  following the leader's WAL through a partitionable link,
+- optionally a **follower-region** SimCluster with its own hardware
+  (spill capacity — where serving traffic lands when the leader region's
+  SLO burns or the leader dies),
+- a :class:`~k8s_dra_driver_tpu.federation.GlobalScheduler` spanning
+  both regions, with decision provenance in the leader's flight recorder.
+
+Chaos follows the sim's annotation idiom — suites drive failures through
+the API like any other state, no reaching into the process:
+
+- ``sim.tpu.google.com/replication-partition: "true"`` on the leader's
+  designated federation node severs the replication link (streams error,
+  the follower reconnect-loops); clearing it heals the link and the
+  follower resumes AT ITS WATERMARK — no duplicates, no gaps.
+- ``sim.tpu.google.com/leader-down: "true"`` kills the leader region:
+  the replica is promoted (read-only -> writable, FailoverStarted/
+  FailoverCompleted) and keeps the fleet's serving surface alive.
+
+``fleet.step()`` pumps both clusters and applies pending chaos.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+from k8s_dra_driver_tpu.federation import (
+    ClusterView,
+    GlobalScheduler,
+    ReplicaStore,
+    ReplicationSource,
+)
+from k8s_dra_driver_tpu.k8s.core import NODE
+from k8s_dra_driver_tpu.sim.cluster import SimCluster
+
+log = logging.getLogger(__name__)
+
+# Chaos annotations (see module docstring). They live on the leader's
+# nodes so kubectl-driven suites can flip them; the fleet harness sweeps
+# them each step().
+CHAOS_REPLICATION_PARTITION_ANNOTATION = \
+    "sim.tpu.google.com/replication-partition"
+CHAOS_LEADER_DOWN_ANNOTATION = "sim.tpu.google.com/leader-down"
+
+LEADER_GATES = "StorePersistence=true,FederatedFleet=true"
+
+
+class PartitionedError(OSError):
+    """The chaos-injected replication link failure."""
+
+
+class _PartitionableSource:
+    """Wraps a replication source with a breakable link: while
+    partitioned every protocol call (and every in-flight tail, at its
+    next yield — within one heartbeat) raises, exactly what a severed
+    TCP stream looks like to the follower's supervisor."""
+
+    def __init__(self, inner: ReplicationSource):
+        self.inner = inner
+        self._partitioned = threading.Event()
+
+    def partition(self) -> None:
+        self._partitioned.set()
+
+    def heal(self) -> None:
+        self._partitioned.clear()
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partitioned.is_set()
+
+    def _check(self) -> None:
+        if self._partitioned.is_set():
+            raise PartitionedError("replication link partitioned (chaos)")
+
+    def status(self) -> dict:
+        self._check()
+        return self.inner.status()
+
+    def snapshot(self) -> dict:
+        self._check()
+        return self.inner.snapshot()
+
+    def tail(self, stream: int, from_seq: int,
+             stop: Optional[threading.Event] = None):
+        self._check()
+        for line in self.inner.tail(stream, from_seq, stop=stop):
+            self._check()
+            yield line
+
+
+class FederatedFleet:
+    """Leader cluster + read replica (+ optional follower region) +
+    global scheduler, wired for chaos. See the module docstring."""
+
+    def __init__(self, workdir: str, profile: str = "v5e-16",
+                 leader_hosts: Optional[int] = None,
+                 follower_hosts: Optional[int] = None,
+                 follower_region: bool = True,
+                 gates: str = "",
+                 leader_weight: float = 1.0,
+                 follower_weight: float = 1.0):
+        extra = f",{gates}" if gates else ""
+        self.leader = SimCluster(os.path.join(workdir, "leader"),
+                                 profile=profile, num_hosts=leader_hosts,
+                                 gates=LEADER_GATES + extra)
+        if getattr(self.leader.api, "replication", None) is None:
+            raise RuntimeError("leader store has no ReplicationSource — "
+                               "FederatedFleet gate not applied?")
+        self.link = _PartitionableSource(self.leader.api.replication)
+        # Replica lag alerts go to the LEADER's event plane (the replica
+        # store is read-only); the failover pair self-records.
+        from k8s_dra_driver_tpu.pkg.events import EventRecorder
+
+        self.replica = ReplicaStore(
+            self.link, cluster="leader-replica",
+            metrics_registry=self.leader.metrics_registry,
+            recorder=EventRecorder(self.leader.api, "federation"))
+        self.replica.start()
+        self.follower: Optional[SimCluster] = None
+        if follower_region:
+            self.follower = SimCluster(os.path.join(workdir, "follower"),
+                                       profile=profile,
+                                       num_hosts=follower_hosts,
+                                       gates=gates)
+        views: List[ClusterView] = [ClusterView(
+            name="leader", api=self.leader.api,
+            free_chips=self.leader._fleet_free_chips,
+            weight=leader_weight, slo=self.leader.slo)]
+        if self.follower is not None:
+            views.append(ClusterView(
+                name="follower", api=self.follower.api,
+                free_chips=self.follower._fleet_free_chips,
+                weight=follower_weight, slo=self.follower.slo))
+        self.scheduler = GlobalScheduler(
+            views, history=self.leader.history,
+            metrics_registry=self.leader.metrics_registry)
+        self.leader_alive = True
+        self._stopped = False
+
+    # -- chaos ---------------------------------------------------------------
+
+    def partition_replication(self) -> None:
+        self.link.partition()
+
+    def heal_replication(self) -> None:
+        self.link.heal()
+
+    def kill_leader(self):
+        """Leader region dies: stop its control plane and promote the
+        replica so the fleet keeps a serving surface (reads immediately;
+        writes once promotion flips the store writable). Returns the
+        promoted store."""
+        if not self.leader_alive:
+            return self.replica.api
+        self.leader_alive = False
+        self.link.partition()  # the dead leader is unreachable too
+        self.leader.stop()
+        api = self.replica.promote()
+        # The promoted store takes over as the leader view for placement:
+        # reads and writes land there while the old region is gone.
+        self.scheduler.clusters["leader"].api = api
+        log.info("leader killed; replica promoted at watermark %d",
+                 self.replica.watermark())
+        return api
+
+    def _chaos_pass(self) -> None:
+        """Honor the chaos annotations on the leader's nodes (skipped
+        once the leader is dead — there is nobody left to read)."""
+        if not self.leader_alive:
+            return
+        want_partition = False
+        want_down = False
+        for node in self.leader.api.list(NODE):
+            ann = node.meta.annotations or {}
+            if ann.get(CHAOS_REPLICATION_PARTITION_ANNOTATION) == "true":
+                want_partition = True
+            if ann.get(CHAOS_LEADER_DOWN_ANNOTATION) == "true":
+                want_down = True
+        if want_down:
+            self.kill_leader()
+            return
+        if want_partition and not self.link.partitioned:
+            log.info("chaos: partitioning replication link")
+            self.link.partition()
+        elif not want_partition and self.link.partitioned:
+            log.info("chaos: healing replication link")
+            self.link.heal()
+
+    # -- pumping -------------------------------------------------------------
+
+    def step(self) -> None:
+        self._chaos_pass()
+        if self.leader_alive:
+            self.leader.step()
+        if self.follower is not None:
+            self.follower.step()
+
+    def settle(self, max_steps: int = 20) -> None:
+        if self.leader_alive:
+            self.leader.settle(max_steps)
+        if self.follower is not None:
+            self.follower.settle(max_steps)
+
+    def converged(self) -> bool:
+        """Fingerprint-token identity between leader and replica for
+        every kind the leader carries — the same O(1) equality the
+        persistence restore tests pin."""
+        if not self.leader_alive:
+            return False
+        with self.leader.api._locked_all():
+            kinds = set()
+            for shard in self.leader.api._shards:
+                kinds.update(shard.fp)
+        return all(self.replica.api.kind_fingerprint(k)
+                   == self.leader.api.kind_fingerprint(k) for k in kinds)
+
+    def wait_converged(self, timeout_s: float = 10.0,
+                       poll_s: float = 0.02) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        if self.leader_alive:
+            self.leader.api.flush_watchers()
+        while time.monotonic() < deadline:
+            if self.converged():
+                return True
+            time.sleep(poll_s)
+        return self.converged()
+
+    def headroom(self) -> Dict[str, int]:
+        return self.scheduler.headroom()
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self.replica.stop()
+        if self.leader_alive:
+            self.leader.stop()
+        if self.follower is not None:
+            self.follower.stop()

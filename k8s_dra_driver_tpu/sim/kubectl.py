@@ -236,6 +236,28 @@ def _resolve_kind(token: str) -> str:
     return kind
 
 
+def _resolve_cluster(token: str) -> str:
+    """``--cluster`` accepts a URL directly or a name defined in the
+    TPU_KUBECTL_CLUSTERS env ("name=url,name2=url2" — the kubeconfig
+    analog), so `get`/`top`/`explain` run against leader or follower
+    identically by switching one flag."""
+    import os
+
+    if token.startswith(("http://", "https://")):
+        return token
+    clusters = {}
+    for entry in os.environ.get("TPU_KUBECTL_CLUSTERS", "").split(","):
+        if "=" in entry:
+            name, _, url = entry.partition("=")
+            clusters[name.strip()] = url.strip()
+    url = clusters.get(token)
+    if url is None:
+        known = ", ".join(sorted(clusters)) or "none defined"
+        raise SystemExit(f"error: unknown cluster {token!r} "
+                         f"(TPU_KUBECTL_CLUSTERS: {known})")
+    return url
+
+
 _CLUSTER_SCOPED = {"Node", "DeviceClass", "ResourceSlice"}
 
 
@@ -756,6 +778,11 @@ def main(argv=None) -> int:
                                      description="kubectl-style CLI for the TPU DRA stack")
     parser.add_argument("--server", default=os.environ.get("TPU_KUBECTL_SERVER", ""),
                         help="API server URL [TPU_KUBECTL_SERVER]")
+    parser.add_argument("--cluster", default="",
+                        help="route to a federated cluster: a name from "
+                        "TPU_KUBECTL_CLUSTERS (\"name=url,name2=url2\") or a "
+                        "URL. Follower answers are stamped (stderr) with "
+                        "their replication watermark so staleness is visible")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p_apply = sub.add_parser("apply")
@@ -821,9 +848,23 @@ def main(argv=None) -> int:
     p_ann.add_argument("-n", "--namespace", default="")
 
     args = parser.parse_args(argv)
+    if args.cluster:
+        args.server = _resolve_cluster(args.cluster)
     if not args.server:
         raise SystemExit("error: --server (or TPU_KUBECTL_SERVER) is required")
     api = RemoteAPIServer(args.server)
+    if args.cluster:
+        # Staleness stamp for read-replica answers: every row a follower
+        # prints is only as fresh as its applied replication watermark.
+        # Stderr keeps `-o json` parseable; leaders stamp nothing.
+        rs = api.replica_status()
+        if rs is not None:
+            import sys as _sys
+
+            print(f"# cluster {args.cluster}: read replica at replication "
+                  f"watermark {rs.get('watermark', 0)} "
+                  f"(lag {rs.get('lag_records', 0)} records)",
+                  file=_sys.stderr)
 
     if args.cmd == "apply":
         if args.filename == "-":  # kubectl semantics: manifests on stdin

@@ -1,0 +1,477 @@
+"""Federation unit surface: WAL streaming, replica apply, global placement.
+
+The acceptance bar mirrors persistence's: a converged follower is
+fingerprint-TOKEN identical to the leader, a reconnecting follower
+resumes exactly at its watermark (no duplicate, no gap), torn tails are
+held back while in-flight and dropped loudly once their epoch rotates,
+and a follower older than the leader's snapshot re-bootstraps through
+the normal restore path. Cross-cluster placement reuses the WFQ
+water-fill and records provenance under the federation rules."""
+
+import json
+import logging
+import os
+import threading
+import types
+
+import pytest
+
+from k8s_dra_driver_tpu.federation import (
+    ClusterView,
+    GlobalScheduler,
+    PlacementRequest,
+    ReplicaStore,
+    ReplicationError,
+    ReplicationSource,
+)
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.core import EVENT, POD, Pod
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.k8s.persist import (
+    discover_wal_files,
+    open_persistent_store,
+)
+from k8s_dra_driver_tpu.k8s.store import ReadOnlyStoreError
+from k8s_dra_driver_tpu.pkg.history import RULE_FED_PLACE, RULE_FED_SPILL
+
+
+def _leader(tmp_path, **kw):
+    kw.setdefault("compact_every", 100_000)
+    return open_persistent_store(str(tmp_path / "leader"), **kw)
+
+
+def _pods(api, n, prefix="p", start=0):
+    for i in range(start, start + n):
+        api.create(Pod(meta=new_meta(f"{prefix}{i}", "default")))
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- WAL discovery (the one shared helper) -----------------------------------
+
+
+def test_discover_wal_files_numeric_order_and_zero_length_skip(
+        tmp_path, caplog):
+    d = str(tmp_path)
+    # Lexicographic order would put epoch 10 before epoch 9.
+    for name in ("wal.9.jsonl", "wal.10.jsonl", "wal-1.9.jsonl"):
+        with open(os.path.join(d, name), "w") as f:
+            f.write('{"seq": 1}\n')
+    stray = os.path.join(d, "wal.11.jsonl")
+    open(stray, "w").close()  # zero-length: crash between open and append
+    with caplog.at_level(logging.WARNING):
+        found = discover_wal_files(d)
+    assert [(e, s) for e, s, _ in found] == [(9, -1), (9, 1), (10, -1)]
+    assert any("zero-length WAL file" in r.message for r in caplog.records)
+    # The warning is loud ONCE per path — a tailer re-sweeping several
+    # times a second must not spam it.
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        discover_wal_files(d)
+    assert not any("zero-length" in r.message for r in caplog.records)
+    # Compaction's deletion sweep still sees the husk.
+    with_empty = discover_wal_files(d, include_empty=True)
+    assert stray in [p for _, _, p in with_empty]
+
+
+# -- source: fetch / tail edge cases -----------------------------------------
+
+
+def test_fetch_resumes_at_watermark_no_dup_no_gap(tmp_path):
+    api = _leader(tmp_path)
+    src = ReplicationSource(api)
+    _pods(api, 10)
+    first, w = src.fetch(-1, 0)
+    assert len(first) == 10
+    seqs = [json.loads(ln)["seq"] for ln in first]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 10
+    assert w == max(seqs)
+    # Reconnect semantics: asking from the watermark returns exactly the
+    # new records — nothing replayed, nothing missing.
+    _pods(api, 5, start=10)
+    second, w2 = src.fetch(-1, w)
+    seqs2 = [json.loads(ln)["seq"] for ln in second]
+    assert len(second) == 5 and min(seqs2) > w
+    assert sorted(set(seqs + seqs2)) == list(range(min(seqs), w2 + 1))
+    api._wal.close()
+
+
+def test_fetch_holds_back_torn_tail_until_completed(tmp_path):
+    api = _leader(tmp_path)
+    src = ReplicationSource(api)
+    _pods(api, 3)
+    _, w = src.fetch(-1, 0)
+    files = [p for _, s, p in discover_wal_files(src.dirpath) if s == -1]
+    rec = json.dumps({"seq": w + 1, "op": "DEL",
+                      "key": ["Pod", "default", "p0"], "fp": [2, w + 1],
+                      "obj": None})
+    with open(files[-1], "a") as f:
+        f.write(rec[: len(rec) // 2])  # in-flight append: no newline
+    held, w_held = src.fetch(-1, w)
+    assert held == [] and w_held == w  # incomplete line held back
+    with open(files[-1], "a") as f:
+        f.write(rec[len(rec) // 2:] + "\n")
+    done, w_done = src.fetch(-1, w)
+    assert [json.loads(ln)["seq"] for ln in done] == [w + 1]
+    assert w_done == w + 1
+    api._wal.close()
+
+
+def test_corrupt_complete_line_fails_loudly(tmp_path):
+    api = _leader(tmp_path)
+    src = ReplicationSource(api)
+    _pods(api, 1)
+    files = [p for _, s, p in discover_wal_files(src.dirpath) if s == -1]
+    with open(files[-1], "a") as f:
+        f.write("{this is not json}\n")  # complete (newline) but corrupt
+    with pytest.raises(ReplicationError, match="corrupt WAL record"):
+        src.fetch(-1, 0)
+    api._wal.close()
+
+
+def _collect_tail(src, stream, from_seq, want_records, timeout=10.0):
+    """Drive src.tail() until ``want_records`` record lines arrived (or a
+    SNAPSHOT ctl ends the stream); returns (records, ctls)."""
+    records, ctls = [], []
+    stop = threading.Event()
+
+    def run():
+        for line in src.tail(stream, from_seq, stop=stop,
+                             poll_s=0.002, heartbeat_s=0.05):
+            doc = json.loads(line)
+            if "ctl" in doc:
+                ctls.append(doc)
+                if doc["ctl"] == "SNAPSHOT":
+                    return
+                continue
+            records.append(doc)
+            if len(records) >= want_records:
+                stop.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    stop.set()
+    t.join(timeout=2)
+    assert not t.is_alive(), "tail did not stop"
+    return records, ctls
+
+
+def test_tail_follows_epoch_rotation_mid_stream(tmp_path):
+    """Epoch rotation racing an active tail: the tailer drains the
+    rotated file to EOF (open fd survives the unlink), switches to the
+    new epoch, and the merged stream has every seq exactly once."""
+    api = _leader(tmp_path)
+    src = ReplicationSource(api)
+    _pods(api, 8)
+    got = []
+    stop = threading.Event()
+    started = threading.Event()
+
+    def run():
+        for line in src.tail(-1, 0, stop=stop, poll_s=0.002,
+                             heartbeat_s=0.05):
+            doc = json.loads(line)
+            if "ctl" in doc:
+                continue
+            got.append(doc["seq"])
+            started.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        wait_for(started.is_set, msg="tail consuming pre-rotation records")
+        api._wal.compact(api)  # rotates the epoch, deletes the old file
+        _pods(api, 8, start=8)
+        wait_for(lambda: len(got) >= 16, msg="records across the rotation")
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert got == sorted(got) and len(set(got)) == len(got)
+    assert len(got) == 16
+    api._wal.close()
+
+
+def test_tail_drops_torn_tail_in_rotated_epoch_loudly(tmp_path, caplog):
+    """A rotated epoch can never complete its partial last line — it is
+    a crash artifact. The tailer drops it with a warning and moves to
+    the next epoch without stalling or raising."""
+    d = str(tmp_path / "wal")
+    os.makedirs(d)
+    rec = lambda seq: json.dumps(  # noqa: E731 — local record factory
+        {"seq": seq, "op": "PUT", "key": ["Pod", "default", f"p{seq}"],
+         "fp": [seq, seq], "obj": None})
+    with open(os.path.join(d, "wal.0.jsonl"), "w") as f:
+        f.write(rec(1) + "\n" + rec(2) + "\n" + rec(3)[:20])  # torn tail
+    with open(os.path.join(d, "wal.1.jsonl"), "w") as f:
+        f.write(rec(4) + "\n")
+    api = APIServer(shards=2)
+    wal = types.SimpleNamespace(dirpath=d, _epoch=1, fsync=False)
+    src = ReplicationSource(api, wal)
+    with caplog.at_level(logging.WARNING):
+        records, _ = _collect_tail(src, -1, 0, want_records=3)
+    assert [r["seq"] for r in records] == [1, 2, 4]  # 3 dropped, no stall
+    assert any("dropping torn tail" in r.message for r in caplog.records)
+
+
+def test_tail_hands_snapshot_ctl_to_stale_follower(tmp_path):
+    """A follower whose watermark predates the leader snapshot cannot be
+    caught up from files (those records were compacted away): it gets
+    one SNAPSHOT control line and the stream ends."""
+    api = _leader(tmp_path)
+    src = ReplicationSource(api)
+    _pods(api, 6)
+    api._wal.compact(api)  # folds everything into the snapshot
+    records, ctls = _collect_tail(src, -1, 0, want_records=1, timeout=5)
+    assert records == []
+    assert ctls and ctls[0]["ctl"] == "SNAPSHOT"
+    assert ctls[0]["watermark"] == src.status()["snapshot_watermark"]
+    api._wal.close()
+
+
+# -- replica store -----------------------------------------------------------
+
+
+def test_replica_converges_and_is_read_only(tmp_path):
+    api = _leader(tmp_path)
+    _pods(api, 12)
+    rep = ReplicaStore(ReplicationSource(api), cluster="r1").start()
+    try:
+        # Bootstrap is synchronous: the snapshot contents are visible on
+        # return; live records then stream in.
+        _pods(api, 4, start=12)
+        wait_for(lambda: (rep.api.kind_fingerprint(POD)
+                          == api.kind_fingerprint(POD)),
+                 msg="fingerprint-token convergence")
+        assert {p.meta.name for p in rep.api.list(POD)} \
+            == {p.meta.name for p in api.list(POD)}
+        # Leader stamps arrive verbatim — same rv on both sides.
+        assert (rep.api.get(POD, "p0", "default").meta.resource_version
+                == api.get(POD, "p0", "default").meta.resource_version)
+        with pytest.raises(ReadOnlyStoreError):
+            rep.api.create(Pod(meta=new_meta("nope", "default")))
+        assert rep.watermark() > 0
+        assert rep.status()["lag_records"] == 0
+    finally:
+        rep.stop()
+        api._wal.close()
+
+
+def test_replica_watch_and_informer_see_replicated_stream(tmp_path):
+    """The whole point of applying through the normal publish path: a
+    watch subscriber on the REPLICA sees ADDED/DELETED for leader-side
+    mutations, unmodified."""
+    api = _leader(tmp_path)
+    rep = ReplicaStore(ReplicationSource(api), cluster="r2").start()
+    q = rep.api.watch(POD)
+    try:
+        _pods(api, 3)
+        api.delete(POD, "p1", "default")
+        events = []
+
+        def drained():
+            while not q.empty():
+                events.append(q.get_nowait())
+            types_ = [e.type for e in events]
+            return types_.count("ADDED") == 3 and "DELETED" in types_
+
+        wait_for(drained, msg="replicated watch events")
+    finally:
+        rep.api.stop_watch(POD, q)
+        rep.stop()
+        api._wal.close()
+
+
+def test_replica_rebootstraps_when_leader_compacts_past_it(tmp_path):
+    """Partition long enough for the leader to compact past the
+    follower's watermark: reconnect gets SNAPSHOT, the follower resyncs
+    through the restore path, and informers survive (diff-apply, not a
+    store teardown)."""
+    api = _leader(tmp_path)
+    _pods(api, 5)
+    rep = ReplicaStore(ReplicationSource(api), cluster="r3").start()
+    try:
+        wait_for(lambda: (rep.api.kind_fingerprint(POD)
+                          == api.kind_fingerprint(POD)), msg="initial sync")
+        resyncs = rep.status()["resyncs"]
+        rep.stop()  # the "partition": follower off the stream entirely
+        _pods(api, 5, start=5)
+        api.delete(POD, "p0", "default")
+        api._wal.compact(api)  # leader moves its snapshot past the follower
+        _pods(api, 2, start=10)
+        rep._stop.clear()
+        rep.start(bootstrap=False)  # reconnect path, not a fresh bootstrap
+        wait_for(lambda: (rep.api.kind_fingerprint(POD)
+                          == api.kind_fingerprint(POD)),
+                 msg="post-compaction resync")
+        st = rep.status()
+        assert st["resyncs"] > resyncs
+        assert rep.api.try_get(POD, "p0", "default") is None  # diff DEL
+    finally:
+        rep.stop()
+        api._wal.close()
+
+
+def test_promote_flips_writable_and_records_failover(tmp_path):
+    api = _leader(tmp_path)
+    _pods(api, 3)
+    rep = ReplicaStore(ReplicationSource(api), cluster="r4").start()
+    wait_for(lambda: rep.watermark() > 0, msg="replica caught up")
+    promoted = rep.promote()
+    api._wal.close()
+    assert promoted is rep.api and rep.promoted
+    assert not promoted.read_only
+    # Failover events land in the replica's OWN store — the leader may
+    # be gone, that is why promote ran.
+    reasons = {e.reason for e in promoted.list(EVENT)}
+    assert {"FailoverStarted", "FailoverCompleted"} <= reasons
+    # rv continuity: post-failover writes never reuse a replicated rv.
+    top = max(p.meta.resource_version for p in promoted.list(POD))
+    fresh = promoted.create(Pod(meta=new_meta("fresh", "default")))
+    assert fresh.meta.resource_version > top
+
+
+def test_apply_replicated_preserves_leader_stamps(tmp_path):
+    rep = APIServer(shards=2)
+    rep.read_only = True
+    meta = new_meta("x", "ns")
+    meta.resource_version = 41
+    meta.uid = "uid-from-leader"
+    obj = Pod(meta=meta)
+    rep.apply_replicated("PUT", obj, (POD, "ns", "x"), (1, 41))
+    got = rep.get(POD, "x", "ns")
+    assert got.meta.resource_version == 41 and got.meta.uid == "uid-from-leader"
+    assert rep.kind_fingerprint(POD) == (1, 41)
+    rep.apply_replicated("DEL", None, (POD, "ns", "x"), (0, 42))
+    assert rep.try_get(POD, "x", "ns") is None
+    assert rep.kind_fingerprint(POD) == (0, 42)
+
+
+# -- kubectl --cluster routing -----------------------------------------------
+
+
+def test_resolve_cluster_urls_names_and_unknown(monkeypatch):
+    from k8s_dra_driver_tpu.sim.kubectl import _resolve_cluster
+
+    assert _resolve_cluster("http://h:1") == "http://h:1"
+    monkeypatch.setenv("TPU_KUBECTL_CLUSTERS",
+                       "leader=http://h:1, follower = http://h:2")
+    assert _resolve_cluster("follower") == "http://h:2"
+    with pytest.raises(SystemExit, match="follower, leader"):
+        _resolve_cluster("staging")
+
+
+# -- global scheduler --------------------------------------------------------
+
+
+class _Decisions:
+    def __init__(self):
+        self.rows = []
+
+    def decide(self, **kw):
+        self.rows.append(kw)
+
+
+def _views(a=64, b=32, wa=1.0, wb=1.0):
+    return [
+        ClusterView(name="a", free_chips=lambda: a, weight=wa),
+        ClusterView(name="b", free_chips=lambda: b, weight=wb),
+    ]
+
+
+def test_place_packs_within_headroom_and_records_provenance():
+    hist = _Decisions()
+    sched = GlobalScheduler(_views(a=64, b=32), history=hist)
+    reqs = [PlacementRequest(name=f"d{i}", chips=c)
+            for i, c in enumerate((48, 16, 16, 8))]
+    res = sched.place(reqs)
+    assert not res.unplaced
+    placed_chips = {"a": 0, "b": 0}
+    for p in res.placements:
+        placed_chips[p.cluster] += p.request.chips
+    assert placed_chips["a"] <= 64 and placed_chips["b"] <= 32
+    assert res.cluster_of("d0") == "a"  # only a holds 48 chips
+    assert all(r["rule"] == RULE_FED_PLACE and r["controller"] == "federation"
+               for r in hist.rows)
+    assert all("headroom" in r["inputs"] for r in hist.rows)
+
+
+def test_place_reports_unplaced_when_no_cluster_has_room():
+    sched = GlobalScheduler(_views(a=16, b=8))
+    res = sched.place([PlacementRequest(name="big", chips=64),
+                       PlacementRequest(name="ok", chips=8)])
+    assert [r.name for r in res.unplaced] == ["big"]
+    assert res.cluster_of("ok") is not None
+
+
+def test_place_weight_skews_fair_share():
+    # Equal headroom; b's weight 3x — the water-fill should send the
+    # bulk of an even request load to b.
+    sched = GlobalScheduler(_views(a=64, b=64, wa=1.0, wb=3.0))
+    res = sched.place([PlacementRequest(name=f"d{i}", chips=8)
+                       for i in range(8)])
+    per = {"a": 0, "b": 0}
+    for p in res.placements:
+        per[p.cluster] += p.request.chips
+    assert per["b"] > per["a"]
+
+
+def test_headroom_probe_failure_means_zero_not_crash():
+    def boom():
+        raise ConnectionError("partitioned")
+
+    sched = GlobalScheduler([
+        ClusterView(name="dead", free_chips=boom),
+        ClusterView(name="ok", free_chips=lambda: 16),
+    ])
+    assert sched.headroom() == {"dead": 0, "ok": 16}
+    res = sched.place([PlacementRequest(name="d", chips=8)])
+    assert res.cluster_of("d") == "ok"
+
+
+class _Alert:
+    def __init__(self, burn):
+        self.burn_rate = burn
+
+
+class _SLO:
+    def __init__(self, burn):
+        self._burn = burn
+
+    def active_alerts(self):
+        return [_Alert(self._burn)] if self._burn else []
+
+
+def test_spill_is_burn_proportional_with_max_headroom_target():
+    hist = _Decisions()
+    slo = _SLO(burn=5.5)
+    sched = GlobalScheduler([
+        ClusterView(name="hot", free_chips=lambda: 0, slo=slo),
+        ClusterView(name="small", free_chips=lambda: 8),
+        ClusterView(name="big", free_chips=lambda: 64),
+    ], history=hist)
+    frac, target = sched.spill("hot")
+    # Linear: burn 1.0 -> 0, SPILL_FULL_BURN (10) -> MAX_SPILL (0.9).
+    assert frac == pytest.approx(0.9 * 4.5 / 9.0)
+    assert target == "big"
+    assert hist.rows and hist.rows[0]["rule"] == RULE_FED_SPILL
+    # Healthy SLO: no spill, no decision row.
+    slo._burn = 0.0
+    assert sched.spill("hot") == (0.0, None)
+
+
+def test_spill_refuses_when_no_peer_has_headroom():
+    sched = GlobalScheduler([
+        ClusterView(name="hot", free_chips=lambda: 4, slo=_SLO(burn=20.0)),
+        ClusterView(name="full", free_chips=lambda: 0),
+    ])
+    assert sched.spill("hot") == (0.0, None)
