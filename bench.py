@@ -2357,6 +2357,168 @@ def bench_history(rollup_nodes: int = 1024, passes: int = 101, runs: int = 2,
     return out
 
 
+def bench_observability(storm_claims: int = 1024, batch: int = 16,
+                        runs: int = 2, explain_iters: int = 40,
+                        assert_budget: bool = False) -> dict:
+    """Fleet-lens cost benchmark (docs/reference/history.md, PR 19).
+
+    Three hard gates (``assert_budget=True`` in make bench-smoke):
+
+    (a) **Analyzer overhead** — a ``storm_claims``-claim prepare storm
+        (create -> allocate -> prepare -> bind -> Running, five store
+        writes per claim, ``batch`` claims per pass) with the
+        ClaimLifecycleAnalyzer stepping each pass vs detached: p99
+        per-pass wall with the analyzer on must be within 5% of off.
+        The analyzer rides the watch stream (footprint status writes
+        off here — that write is a once-per-claim publication, not
+        observation cost, and is pinned separately by the unit tier);
+        an analyzer that lists, copies, or locks per object per pass
+        blows the gate. Interleaved (off, on) pairs, best ratio — the
+        bench_telemetry noise discipline.
+    (b) **Cross-cluster explain latency** — ``explain --all-clusters``
+        against TWO live HTTP clusters (one holding the object +
+        trace-stamped decisions, the peer stitching by trace id) must
+        hold p99 <= 250 ms including every fan-out round-trip.
+    (c) **Zero steady-state lists** — across the whole storm and the
+        profile publications the analyzer must issue ZERO store list()
+        calls past its construction bootstrap (counter-verified, the
+        store-scan lint's runtime twin).
+    """
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.conditions import Condition
+    from k8s_dra_driver_tpu.k8s.core import (
+        CLAIM_COND_PREPARED,
+        POD,
+        RESOURCE_CLAIM,
+        AllocationResult,
+        Pod,
+        ResourceClaim,
+        ResourceClaimConsumer,
+    )
+    from k8s_dra_driver_tpu.k8s.httpapi import HTTPAPIServer
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.pkg import tracing
+    from k8s_dra_driver_tpu.pkg.history import RULE_SCHED_BIND, HistoryStore
+    from k8s_dra_driver_tpu.pkg.lifecycle import ClaimLifecycleAnalyzer
+    from k8s_dra_driver_tpu.sim.kubectl import explain_all_clusters
+
+    out: dict = {}
+
+    # -- (a) + (c): prepare storm, analyzer on vs off ------------------------
+
+    def storm_passes(with_analyzer: bool):
+        api = APIServer()
+        analyzer = None
+        if with_analyzer:
+            analyzer = ClaimLifecycleAnalyzer(api, history=HistoryStore(None),
+                                              write_footprint=False)
+        base_lists = api.stats.list_calls
+        lat = []
+        t = 0.0
+        for start in range(0, storm_claims, batch):
+            t0 = time.perf_counter()
+            for i in range(start, min(start + batch, storm_claims)):
+                name, pod = f"c{i}", f"c{i}-pod"
+                api.create(ResourceClaim(meta=new_meta(name, "default")))
+                created = api.create(Pod(meta=new_meta(pod, "default"),
+                                         node_name=f"n{i % 64}"))
+                api.update_with_retry(
+                    RESOURCE_CLAIM, name, "default",
+                    lambda o, c=created: (
+                        setattr(o, "allocation",
+                                AllocationResult(node_name=c.node_name)),
+                        o.reserved_for.append(ResourceClaimConsumer(
+                            kind="Pod", name=c.meta.name,
+                            uid=c.meta.uid))))
+                api.update_with_retry(
+                    RESOURCE_CLAIM, name, "default",
+                    lambda o: o.conditions.append(Condition(
+                        type=CLAIM_COND_PREPARED, status="True")))
+                api.update_with_retry(
+                    POD, pod, "default",
+                    lambda o: setattr(o, "phase", "Running"))
+            if analyzer is not None:
+                t += 1.0
+                analyzer.step(t)
+            lat.append(time.perf_counter() - t0)
+        profiled = analyzer.profiled_total if analyzer else 0
+        extra_lists = api.stats.list_calls - base_lists
+        if analyzer is not None:
+            analyzer.close()
+        p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+        return p99, profiled, extra_lists
+
+    overhead = p99_off = p99_on = None
+    profiled = steady_lists = 0
+    for _ in range(runs):
+        off, _, _ = storm_passes(False)
+        on, profiled, steady_lists = storm_passes(True)
+        ratio = on / off - 1.0
+        if overhead is None or ratio < overhead:
+            overhead, p99_off, p99_on = ratio, off, on
+    out["lens_storm_claims"] = storm_claims
+    out["lens_storm_p99_off_ms"] = round(p99_off * 1e3, 3)
+    out["lens_storm_p99_on_ms"] = round(p99_on * 1e3, 3)
+    out["lens_analyzer_overhead_pct"] = round(overhead * 100.0, 2)
+    out["lens_analyzer_profiled"] = profiled
+    out["lens_analyzer_steady_lists"] = steady_lists
+    if assert_budget:
+        assert profiled == storm_claims, (
+            f"{profiled} of {storm_claims} storm claims profiled — the "
+            f"watch-driven milestone chain dropped completions")
+        assert overhead <= 0.05, (
+            f"lifecycle analyzer added {overhead * 100:.1f}% p99 to the "
+            f"{storm_claims}-claim prepare storm (gate: <=5%) — a scan "
+            f"or per-object copy is riding the watch drain")
+        assert steady_lists == 0, (
+            f"analyzer issued {steady_lists} store list() call(s) past "
+            f"construction — the zero-steady-state-scan contract broke")
+
+    # -- (b) explain --all-clusters vs two live HTTP clusters ----------------
+
+    api_a, api_b = APIServer(), APIServer()
+    hist_a, hist_b = HistoryStore(None), HistoryStore(None)
+    api_a.history, api_b.history = hist_a, hist_b
+    claim = ResourceClaim(meta=new_meta("lens-claim", "default"))
+    with tracing.span("bench.lens") as sp:
+        tracing.inject_context(claim.meta.annotations, sp.context)
+        api_a.create(claim)
+        for j in range(200):
+            hist_a.decide(controller="scheduler", rule=RULE_SCHED_BIND,
+                          outcome="bound", kind="ResourceClaim",
+                          namespace="default", name="lens-claim",
+                          message=f"pass {j}", now=float(j))
+        # The peer holds same-trace decisions only — the stitch target.
+        for j in range(50):
+            hist_b.decide(controller="federation", rule=RULE_SCHED_BIND,
+                          outcome="bound", kind="Pod", namespace="default",
+                          name="peer-pod", message=f"peer {j}", now=float(j))
+    srv_a = HTTPAPIServer(api_a).start()
+    srv_b = HTTPAPIServer(api_b).start()
+    try:
+        clusters = {"east": srv_a.url, "west": srv_b.url}
+        explain_all_clusters(clusters, "ResourceClaim", "lens-claim",
+                             namespace="default")  # warm connections
+        lat = []
+        for _ in range(explain_iters):
+            t0 = time.perf_counter()
+            explain_all_clusters(clusters, "ResourceClaim", "lens-claim",
+                                 namespace="default")
+            lat.append(time.perf_counter() - t0)
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+    p99_fan = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+    out["lens_explain_fanout_clusters"] = 2
+    out["lens_explain_fanout_p99_ms"] = round(p99_fan * 1e3, 3)
+    if assert_budget:
+        assert p99_fan <= 0.25, (
+            f"explain --all-clusters p99 {p99_fan * 1e3:.0f}ms against two "
+            f"live HTTP clusters (budget 250ms) — a per-row round-trip or "
+            f"an unbounded decision pull is in the fan-out")
+    return out
+
+
 def bench_autoscaler(num_nodes: int = 1024, tick_s: float = 300.0,
                      assert_budget: bool = False) -> dict:
     """Serving autoscaler closed-loop benchmark (docs/reference/
@@ -3057,6 +3219,11 @@ def main() -> None:
         # 50ms at 10k retained decisions (exact retention), WAL restore
         # fingerprint-identical across close/reopen and checkpoint.
         result.update(bench_history(assert_budget=True))
+        # Fleet-lens gates: lifecycle-analyzer <=5% p99 overhead on the
+        # 1024-claim prepare storm with zero steady-state store list()
+        # calls and every storm claim profiled, explain --all-clusters
+        # p99 <=250ms against two live HTTP clusters.
+        result.update(bench_observability(assert_budget=True))
         # Serving-autoscaler gates (24h-compressed diurnal+burst day at
         # 1024 nodes, BENCH_AUTOSCALER_NODES overrides): SLO violation
         # minutes strictly below the static baseline, wasted chip-hours
@@ -3138,6 +3305,12 @@ def main() -> None:
         result.update(bench_history())
     except Exception as e:  # noqa: BLE001 — extras are best-effort
         result["history_error"] = str(e)[:200]
+    try:
+        # Fleet lens: lifecycle-analyzer overhead on the prepare storm,
+        # cross-cluster explain fan-out latency, steady-state lists.
+        result.update(bench_observability())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        result["observability_error"] = str(e)[:200]
     try:
         # Serving autoscaler: closed-loop vs static allocation over the
         # compressed 24h day (violation minutes, wasted chip-hours,

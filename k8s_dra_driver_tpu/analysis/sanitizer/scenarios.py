@@ -56,6 +56,17 @@ test suite:
       an explain-shaped reader walking query()/decisions_for(): no torn
       bucket ever escapes, point/decision order stays monotonic, and the
       LRU bounds hold mid-churn.
+  12. ``replication-tail-vs-compaction`` — a follower tailing the
+      leader's WAL while writers churn and low-water compaction rotates
+      epochs underneath it: the follower converges fingerprint-token
+      identical whether a record arrived via stream or re-snapshot.
+  13. ``critical-path-vs-replication-apply`` — the claim critical-path
+      analyzer's step()/breakdown() racing a replication-apply writer
+      installing leader-stamped milestone writes (apply_replicated, the
+      follower's WAL install path) on the SAME claims in shuffled
+      order: every finished profile keeps non-negative phases summing
+      EXACTLY to claim-to-running, exactly one profile publishes per
+      claim, and the zero-steady-state-list() contract holds mid-race.
 
 - ``FIXTURES`` — seeded violations proving each detector class fires
   deterministically on ANY seed and at ANY worker count (the fillers):
@@ -1319,6 +1330,162 @@ def scenario_history_rollover_vs_explain(state: SanitizerState, seed: int,
                f"appends — a record was lost or duplicated across the race")
 
 
+def scenario_critical_path_vs_replication_apply(
+        state: SanitizerState, seed: int, extra_workers: int = 0) -> None:
+    """The PR 19 critical-path profiler under race: a replication-apply
+    writer installs leader-stamped claim/pod milestone writes
+    (``apply_replicated`` — the follower's WAL install path, preserving
+    the leader's resourceVersions verbatim) on a handful of claims in a
+    seed-shuffled order, while the analyzer's ``step()`` drains its
+    watch queues and an explain-shaped reader walks ``breakdown()``
+    concurrently. A clean run proves no torn phase ever escapes: every
+    finished profile carries non-negative phases over the closed
+    vocabulary summing EXACTLY to claim-to-running (the running-max
+    chain holds whatever interleaving the apply stream landed in),
+    exactly one profile publishes per claim, the store's list() counter
+    never moves after construction (the zero-steady-state-scan contract
+    the bench gate measures), and the tracked maps stay bounded."""
+    import random
+
+    from k8s_dra_driver_tpu.k8s import APIServer
+    from k8s_dra_driver_tpu.k8s.conditions import Condition
+    from k8s_dra_driver_tpu.k8s.core import (
+        CLAIM_COND_PREPARED,
+        POD,
+        RESOURCE_CLAIM,
+        AllocationResult,
+        Pod,
+        ResourceClaim,
+        ResourceClaimConsumer,
+    )
+    from k8s_dra_driver_tpu.k8s.objects import new_meta
+    from k8s_dra_driver_tpu.pkg.history import (
+        RULE_LIFECYCLE_PROFILE,
+        HistoryStore,
+    )
+    from k8s_dra_driver_tpu.pkg.lifecycle import (
+        CLAIM_PHASES,
+        ClaimLifecycleAnalyzer,
+    )
+
+    api = APIServer()
+    hist = HistoryStore(None)
+    analyzer = ClaimLifecycleAnalyzer(api, history=hist,
+                                      write_footprint=False)
+    base_lists = api.stats.list_calls
+    names = [f"c{i}" for i in range(3)]
+
+    def stamp(obj, uid, rv):
+        obj.meta.uid = uid
+        obj.meta.resource_version = rv
+        return obj
+
+    def put(obj):
+        key = (obj.kind, obj.meta.namespace, obj.meta.name)
+        api.apply_replicated("PUT", obj, key, None)
+
+    def claim_writes(name):
+        """The five leader writes of one claim's life, as the WAL
+        carries them (rv monotone per object, content cumulative)."""
+        uid, puid = f"uid-{name}", f"uid-{name}-pod"
+        pod = f"{name}-pod"
+        alloc = AllocationResult(node_name="n0")
+        prep = Condition(type=CLAIM_COND_PREPARED, status="True")
+        res = ResourceClaimConsumer(kind="Pod", name=pod, uid=puid)
+        return [
+            put_fn for put_fn in (
+                lambda: put(stamp(ResourceClaim(
+                    meta=new_meta(name, "default")), uid, 1)),
+                lambda: put(stamp(ResourceClaim(
+                    meta=new_meta(name, "default"), allocation=alloc,
+                    reserved_for=[res]), uid, 2)),
+                lambda: put(stamp(ResourceClaim(
+                    meta=new_meta(name, "default"), allocation=alloc,
+                    reserved_for=[res], conditions=[prep]), uid, 3)),
+                lambda: put(stamp(Pod(
+                    meta=new_meta(pod, "default"), node_name="n0"),
+                    puid, 1)),
+                lambda: put(stamp(Pod(
+                    meta=new_meta(pod, "default"), node_name="n0",
+                    phase="Running"), puid, 2)),
+            )
+        ]
+
+    def applier():
+        rng = random.Random(seed * 37 + 1)
+        # Interleave the claims' write chains into one shuffled stream:
+        # per-object order stays monotone (it is on the real WAL), but
+        # cross-object order — and pod-before-claim — is adversarial.
+        chains = [claim_writes(n) for n in names]
+        while any(chains):
+            live = [c for c in chains if c]
+            c = rng.choice(live)
+            c.pop(0)()
+            state.yield_point(("scenario", "replication-apply"))
+
+    def stepper():
+        for t in range(1, 24):
+            analyzer.step(float(t))
+            state.yield_point(("scenario", "analyzer-step"))
+
+    def reader():
+        for _ in range(24):
+            for name in names:
+                prof = analyzer.breakdown("default", name)
+                if prof is None:
+                    continue
+                _invariant(
+                    state,
+                    set(prof.phase_seconds) == set(CLAIM_PHASES),
+                    f"{name}: torn phase vocabulary "
+                    f"{sorted(prof.phase_seconds)}")
+                _invariant(
+                    state,
+                    all(v >= 0.0 for v in prof.phase_seconds.values()),
+                    f"{name}: negative phase escaped the running-max "
+                    f"chain: {prof.phase_seconds}")
+                _invariant(
+                    state,
+                    abs(sum(prof.phase_seconds.values())
+                        - prof.total_seconds) < 1e-9,
+                    f"{name}: phase sum {sum(prof.phase_seconds.values())}"
+                    f" != total {prof.total_seconds} — a half-finalized "
+                    f"profile was handed out")
+            state.yield_point(("scenario", "breakdown-read"))
+
+    explore(state, seed,
+            [("applier", applier), ("stepper", stepper),
+             ("reader", reader)] + _fillers(state, extra_workers))
+    api.flush_watchers()
+    analyzer.step(100.0)
+    for name in names:
+        prof = analyzer.breakdown("default", name)
+        _invariant(state, prof is not None,
+                   f"{name} never profiled after full milestone chain")
+        if prof is not None:
+            _invariant(
+                state,
+                abs(sum(prof.phase_seconds.values())
+                    - prof.total_seconds) < 1e-9,
+                f"{name}: finished profile torn: {prof.phase_seconds} "
+                f"vs total {prof.total_seconds}")
+        recs = [r for r in hist.decisions_for(RESOURCE_CLAIM, "default",
+                                              name)
+                if r.rule == RULE_LIFECYCLE_PROFILE]
+        _invariant(state, len(recs) == 1,
+                   f"{name}: {len(recs)} lifecycle decisions published "
+                   f"(exactly-once per claim violated)")
+    _invariant(state, api.stats.list_calls == base_lists,
+               f"analyzer issued {api.stats.list_calls - base_lists} "
+               f"store list() call(s) past construction — the "
+               f"zero-steady-state-scan contract broke under race")
+    counts = analyzer.tracked_counts()
+    _invariant(state, counts["claims"] <= len(names)
+               and counts["pods"] <= len(names),
+               f"tracked maps unbounded under churn: {counts}")
+    analyzer.close()
+
+
 SCENARIOS: Dict[str, Callable[..., None]] = {
     "store-churn": scenario_store_churn,
     "wal-compact": scenario_wal_compact,
@@ -1333,6 +1500,8 @@ SCENARIOS: Dict[str, Callable[..., None]] = {
     "preempt-vs-rebalancer": scenario_preempt_vs_rebalancer,
     "store-frozen-readers": scenario_store_frozen_readers,
     "history-rollover-vs-explain": scenario_history_rollover_vs_explain,
+    "critical-path-vs-replication-apply":
+        scenario_critical_path_vs_replication_apply,
 }
 
 

@@ -8,6 +8,11 @@ across clusters by fleet headroom and spills serving traffic when a
 region's SLO burns. See ``docs/reference/federation.md``.
 """
 
+from k8s_dra_driver_tpu.federation.query import (
+    federation_status_rows,
+    inject_cluster_label,
+    merge_metrics_texts,
+)
 from k8s_dra_driver_tpu.federation.replication import (
     ReplicaStore,
     ReplicationError,
@@ -30,4 +35,7 @@ __all__ = [
     "ReplicaStore",
     "ReplicationError",
     "ReplicationSource",
+    "federation_status_rows",
+    "inject_cluster_label",
+    "merge_metrics_texts",
 ]

@@ -1,0 +1,111 @@
+"""Global query plane: fleet-wide metric and status aggregation.
+
+The federation layer's read side. Every cluster already exposes the
+same surfaces — /metrics text, /replica/watermark staleness, /history
+flight-recorder routes — so the fleet-wide view is a *merge*, not a new
+protocol: scrape each peer, stamp every sample with a ``cluster``
+label, and let the existing consumers (``tpu-kubectl top
+--all-clusters``, the /federation/metrics HTTP route, dashboards) read
+the union exactly as they read one cluster.
+
+Pure text/dict transforms live here (stdlib only, no HTTP): the HTTP
+fan-out stays in ``k8s.httpapi`` and ``sim.kubectl`` where the
+transports already are.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# Label injected into every merged sample. A peer that already carries
+# a label with this name keeps its own value (it knows better).
+CLUSTER_LABEL = "cluster"
+
+
+def _escape_label_value(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def inject_cluster_label(text: str, cluster: str) -> str:
+    """Rewrite one cluster's Prometheus text exposition so every sample
+    carries ``cluster="<name>"``. Comment lines (# HELP / # TYPE) pass
+    through untouched; malformed lines pass through untouched too — an
+    aggregator must degrade, never censor."""
+    label = f'{CLUSTER_LABEL}="{_escape_label_value(cluster)}"'
+    out: List[str] = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            out.append(line)
+            continue
+        brace = stripped.find("{")
+        if brace >= 0:
+            close = stripped.rfind("}")
+            if close <= brace:
+                out.append(line)  # malformed: forward verbatim
+                continue
+            inner = stripped[brace + 1:close]
+            if f'{CLUSTER_LABEL}="' in inner:
+                out.append(line)
+                continue
+            merged = f"{label},{inner}" if inner else label
+            out.append(stripped[:brace] + "{" + merged + "}"
+                       + stripped[close + 1:])
+        else:
+            # Bare `name value`: split on first whitespace.
+            name, _, rest = stripped.partition(" ")
+            if not rest:
+                out.append(line)
+                continue
+            out.append(f"{name}{{{label}}} {rest}")
+    return "\n".join(out) + "\n"
+
+
+def merge_metrics_texts(texts: Dict[str, str]) -> str:
+    """Merge per-cluster scrapes into one exposition: each cluster's
+    samples get the ``cluster`` label; duplicate # HELP/# TYPE headers
+    (every peer emits the same families) are kept once, first writer
+    wins."""
+    seen_comments: set = set()
+    out: List[str] = []
+    for cluster in sorted(texts):
+        for line in inject_cluster_label(texts[cluster],
+                                         cluster).splitlines():
+            if line.startswith("#"):
+                if line in seen_comments:
+                    continue
+                seen_comments.add(line)
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def federation_status_rows(
+        statuses: Dict[str, Optional[dict]],
+        now: Optional[float] = None) -> List[List[str]]:
+    """`tpu-kubectl federation status` table rows from per-peer
+    /replica/watermark answers (None = the peer answered but is not a
+    replica; missing entries are the caller's SKIPPED rows). Columns:
+    PEER, ROLE, WATERMARK, LAG, RECONNECTS, LAST-HEARTBEAT."""
+    rows: List[List[str]] = []
+    for peer in sorted(statuses):
+        st = statuses[peer]
+        if st is None:
+            rows.append([peer, "leader", "-", "-", "-", "-"])
+            continue
+        beat = st.get("last_heartbeat", 0.0) or 0.0
+        if now is not None and beat > 0.0:
+            heartbeat = f"{max(0.0, now - beat):.1f}s ago"
+        elif beat > 0.0:
+            heartbeat = f"@{beat:.1f}"
+        else:
+            heartbeat = "never"
+        role = "promoted" if st.get("promoted") else "replica"
+        rows.append([
+            peer, role,
+            str(st.get("watermark", 0)),
+            str(st.get("lag_records", 0)),
+            str(st.get("reconnects", 0)),
+            heartbeat,
+        ])
+    return rows

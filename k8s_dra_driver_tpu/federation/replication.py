@@ -323,6 +323,7 @@ class ReplicaStore:
                  poll_s: float = TAIL_POLL_S,
                  metrics_registry=None,
                  recorder=None,
+                 history=None,
                  lag_alert_records: int = DEFAULT_LAG_ALERT_RECORDS,
                  clock: Callable[[], float] = time.time):
         from k8s_dra_driver_tpu.k8s.store import DEFAULT_STORE_SHARDS
@@ -331,6 +332,10 @@ class ReplicaStore:
         self.cluster = cluster
         self.poll_s = poll_s
         self.recorder = recorder
+        # Optional flight recorder for the failover DecisionRecord
+        # (federation/failover). The fleet harness wires the leader's
+        # history store; standalone replicas run without one.
+        self.history = history
         self.lag_alert_records = lag_alert_records
         self.clock = clock
         self.api = APIServer(shards=shards or DEFAULT_STORE_SHARDS)
@@ -343,6 +348,7 @@ class ReplicaStore:
         self._resyncs = 0  # tpulint: guarded-by=_mu
         self._reconnects = 0  # tpulint: guarded-by=_mu
         self._lagging = False  # tpulint: guarded-by=_mu
+        self._last_heartbeat = 0.0  # tpulint: guarded-by=_mu (clock time)
         self.promoted = False
         self._stop = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
@@ -416,7 +422,23 @@ class ReplicaStore:
                 "resyncs": self._resyncs,
                 "reconnects": self._reconnects,
                 "promoted": self.promoted,
+                "last_heartbeat": self._last_heartbeat,
             }
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the leader last answered (heartbeat line,
+        status poll, or applied record), or None before first contact.
+        The `tpu-kubectl federation status` freshness column."""
+        with self._mu:
+            if self._last_heartbeat <= 0.0:
+                return None
+            last = self._last_heartbeat
+        return max(0.0, self.clock() - last)
+
+    def _mark_heartbeat(self) -> None:
+        now = self.clock()
+        with self._mu:
+            self._last_heartbeat = max(self._last_heartbeat, now)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -442,24 +464,47 @@ class ReplicaStore:
         and resume the rv counter past everything replicated. The
         FailoverStarted/FailoverCompleted events land in the replica's
         OWN store (the leader may be gone — that is why we are here)."""
+        from k8s_dra_driver_tpu.pkg import tracing
         from k8s_dra_driver_tpu.pkg.events import (
             REASON_FAILOVER_COMPLETED,
             REASON_FAILOVER_STARTED,
         )
+        from k8s_dra_driver_tpu.pkg.history import RULE_FED_FAILOVER
 
         self.stop()
         self.api.read_only = False
-        rec = self._failover_recorder()
-        if rec is not None:
-            rec.normal(self._cluster_ref(), REASON_FAILOVER_STARTED,
-                       f"promoting replica of cluster "
-                       f"{self.cluster!r} at watermark {self.watermark()}")
-        self.api.resume_rv()
-        self.promoted = True
-        if rec is not None:
-            rec.normal(self._cluster_ref(), REASON_FAILOVER_COMPLETED,
-                       f"replica {self.cluster!r} serving writes "
-                       f"(watermark {self.watermark()})")
+        # One failover trace: the Started/Completed events and the
+        # federation/failover DecisionRecord all carry its id, so a
+        # cross-cluster explain stitches the whole promotion — which
+        # cluster, at what watermark — into one causal chain.
+        with tracing.span("federation.failover", cluster=self.cluster,
+                          watermark=self.watermark()):
+            rec = self._failover_recorder()
+            if rec is not None:
+                rec.normal(self._cluster_ref(), REASON_FAILOVER_STARTED,
+                           f"promoting replica of cluster "
+                           f"{self.cluster!r} at watermark "
+                           f"{self.watermark()}")
+            self.api.resume_rv()
+            self.promoted = True
+            if rec is not None:
+                rec.normal(self._cluster_ref(), REASON_FAILOVER_COMPLETED,
+                           f"replica {self.cluster!r} serving writes "
+                           f"(watermark {self.watermark()})")
+            if self.history is not None:
+                try:
+                    self.history.decide(
+                        controller="federation", rule=RULE_FED_FAILOVER,
+                        outcome="promoted",
+                        kind="Cluster", name=self.cluster,
+                        message=(f"replica {self.cluster!r} promoted to "
+                                 f"writable at watermark "
+                                 f"{self.watermark()}"),
+                        inputs={"watermark": self.watermark(),
+                                "applied": self.status()["applied"]},
+                        now=self.clock())
+                except Exception:  # noqa: BLE001 — provenance must not block failover
+                    log.exception("failover decision record failed")
         return self.api
 
     def _failover_recorder(self):
@@ -584,6 +629,7 @@ class ReplicaStore:
             st = self.source.status()
         except Exception:  # noqa: BLE001 — head poll is best-effort
             return
+        self._mark_heartbeat()
         with self._mu:
             self._head = max(self._head, int(st.get("watermark", 0)))
         self._note_lag()
@@ -601,6 +647,7 @@ class ReplicaStore:
                     round_stop.set()
                     return
                 if ctl == "HEARTBEAT":
+                    self._mark_heartbeat()
                     with self._mu:
                         self._head = max(self._head,
                                          int(doc.get("watermark", 0)))
@@ -634,6 +681,7 @@ class ReplicaStore:
         with self._mu:
             self._watermarks[stream] = seq
             self._head = max(self._head, seq)
+        self._mark_heartbeat()
         self._count_apply(rec["op"], stream=stream, seq=seq)
         self._note_lag()
 

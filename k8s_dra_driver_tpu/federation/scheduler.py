@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from k8s_dra_driver_tpu.pkg import tracing
 from k8s_dra_driver_tpu.pkg.history import RULE_FED_PLACE, RULE_FED_SPILL
 from k8s_dra_driver_tpu.scheduling import fair_apportion
 
@@ -87,6 +88,13 @@ class PlacementResult:
     placements: List[Placement] = field(default_factory=list)
     unplaced: List[PlacementRequest] = field(default_factory=list)
     headroom: Dict[str, int] = field(default_factory=dict)
+    # The fleet-level trace this placement round ran under. Stamp it
+    # onto the objects you create from the placements
+    # (tracing.inject_context) and the target cluster's scheduler binds
+    # under the same trace — the cross-cluster causal chain explain
+    # stitches back together.
+    trace_id: str = ""
+    span_context: Optional[tracing.SpanContext] = None
 
     def cluster_of(self, name: str) -> Optional[str]:
         for p in self.placements:
@@ -111,6 +119,9 @@ class GlobalScheduler:
         self.recorder = recorder
         self.history = history
         self.clock = clock
+        # Context of the most recent spill decision that fired — what a
+        # caller applying the spill stamps onto the migrated workload.
+        self.last_spill_context: Optional[tracing.SpanContext] = None
         self._metrics = None
         if metrics_registry is not None:
             self.attach_metrics(metrics_registry)
@@ -169,23 +180,33 @@ class GlobalScheduler:
         ICI mesh lives in one failure domain), with a best-fit fallback
         onto raw headroom so a request bigger than its fair share still
         lands when some cluster has genuine room."""
-        result = PlacementResult(headroom=self.headroom())
-        budgets = fair_apportion(
-            demands={n: float(h) for n, h in result.headroom.items()},
-            weights={n: c.weight for n, c in self.clusters.items()},
-            capacity=float(sum(r.chips for r in requests)),
-        )
-        remaining = dict(result.headroom)
-        for req in sorted(requests, key=lambda r: (-r.chips, r.name)):
-            target = self._pick(req.chips, budgets, remaining)
-            if target is None:
-                result.unplaced.append(req)
-                self._note(req, None, result.headroom)
-                continue
-            budgets[target] = budgets.get(target, 0.0) - req.chips
-            remaining[target] -= req.chips
-            result.placements.append(Placement(request=req, cluster=target))
-            self._note(req, target, result.headroom)
+        # One span per placement round: the DecisionRecords written in
+        # _note() inherit its trace id, and callers propagate it onto
+        # the placed objects (result.span_context) so the target
+        # cluster's bind/prepare spans join the same fleet-level trace.
+        with tracing.span("federation.place",
+                          clusters=sorted(self.clusters),
+                          requests=len(requests)) as sp:
+            result = PlacementResult(headroom=self.headroom(),
+                                     trace_id=sp.trace_id,
+                                     span_context=sp.context)
+            budgets = fair_apportion(
+                demands={n: float(h) for n, h in result.headroom.items()},
+                weights={n: c.weight for n, c in self.clusters.items()},
+                capacity=float(sum(r.chips for r in requests)),
+            )
+            remaining = dict(result.headroom)
+            for req in sorted(requests, key=lambda r: (-r.chips, r.name)):
+                target = self._pick(req.chips, budgets, remaining)
+                if target is None:
+                    result.unplaced.append(req)
+                    self._note(req, None, result.headroom)
+                    continue
+                budgets[target] = budgets.get(target, 0.0) - req.chips
+                remaining[target] -= req.chips
+                result.placements.append(
+                    Placement(request=req, cluster=target))
+                self._note(req, target, result.headroom)
         return result
 
     def _pick(self, chips: int, budgets: Dict[str, float],
@@ -251,15 +272,24 @@ class GlobalScheduler:
                 frac = 0.0
         if self._metrics is not None:
             self._metrics["spill"].set(cluster, value=frac)
-        if frac > 0.0 and self.history is not None:
-            self.history.decide(
-                controller="federation", rule=RULE_FED_SPILL,
-                outcome=f"spill:{target}",
-                kind="Cluster", name=cluster,
-                message=(f"burn {burn:.2f}: spilling "
-                         f"{math.floor(frac * 100)}% of serving traffic "
-                         f"to {target}"),
-                inputs={"burn_rate": burn, "fraction": frac,
-                        "target": target},
-                now=self.clock())
+        if frac > 0.0:
+            # The spill decision opens the fleet-level trace: its id
+            # lands on the DecisionRecord, and last_spill_context lets
+            # the caller stamp the spilled workload's annotations
+            # (tracing.inject_context) so the receiving cluster's bind
+            # joins the same trace across the replication boundary.
+            with tracing.span("federation.spill", cluster=cluster,
+                              target=target, burn=round(burn, 3)) as sp:
+                self.last_spill_context = sp.context
+                if self.history is not None:
+                    self.history.decide(
+                        controller="federation", rule=RULE_FED_SPILL,
+                        outcome=f"spill:{target}",
+                        kind="Cluster", name=cluster,
+                        message=(f"burn {burn:.2f}: spilling "
+                                 f"{math.floor(frac * 100)}% of serving "
+                                 f"traffic to {target}"),
+                        inputs={"burn_rate": burn, "fraction": frac,
+                                "target": target},
+                        now=self.clock())
         return frac, target

@@ -193,6 +193,24 @@ class UtilizationSummary:
     updated_at: float = field(default=0.0, compare=False)
 
 
+@dataclass
+class ObservedFootprint:
+    """What a claim's lifecycle actually cost, written once by the
+    critical-path profiler (`status.observedFootprint` on the wire) so
+    a recommender can right-size the next instance of the workload
+    straight off the object. Values are quantized at write time (same
+    change-gate discipline as UtilizationSummary); ``updated_at`` is
+    excluded from equality so a re-profile that lands on identical
+    quantized values writes nothing."""
+
+    # Phase name -> seconds on the claim's critical path (virtual clock),
+    # quantized; keys are the lifecycle analyzer's closed phase vocabulary.
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
+    peak_hbm_bytes: int = 0
+    duty_p95: float = 0.0              # [0, 1]
+    updated_at: float = field(default=0.0, compare=False)
+
+
 # -- kinds ------------------------------------------------------------------
 
 @dataclass
@@ -213,6 +231,10 @@ class ResourceClaim(K8sObject):
     # (status.utilizationSummary upstream-style); None until the claim's
     # chips produced a full first summary.
     utilization: Optional[UtilizationSummary] = None
+    # Critical-path profile written once by the lifecycle analyzer when
+    # the claim's consumer reaches Running (status.observedFootprint);
+    # the recommender's input signal.
+    observed_footprint: Optional[ObservedFootprint] = None
 
 
 CLAIM_COND_ALLOCATED = "Allocated"

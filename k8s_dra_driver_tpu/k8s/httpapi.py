@@ -20,11 +20,15 @@ Routes:
     GET    /healthz
     GET    /history/series              flight-recorder series names
     GET    /history/query?series=&resolution=&window=|lo=&hi=
-    GET    /history/decisions?kind=&ns=&name=&limit=
+    GET    /history/decisions?kind=&ns=&name=&limit= (or ?trace_id=a,b)
     GET    /replication/status          leader replication head + streams
     GET    /replication/snapshot        bootstrap/resync snapshot document
     GET    /replication/wal?stream=&from=  chunked WAL record stream
     GET    /replica/watermark           follower staleness stamp
+    GET    /metrics                     Prometheus text exposition
+    GET    /debug/traces?trace_id=&name=  Chrome trace export
+    GET    /federation/metrics          fleet-merged exposition
+                                        (cluster label per sample)
 
 The /history routes are served only when the hosted APIServer carries a
 ``history`` attribute (the sim wires its HistoryStore there); they 404
@@ -35,6 +39,10 @@ one), and /replica/watermark on ``api.replica`` (a follower's
 ``federation.ReplicaStore``), so one server binary serves leader,
 follower, or plain in-memory stores and clients probe capability by
 route. Followers are read-only: mutating verbs answer 403 ``ReadOnly``.
+The same seam gates /metrics on ``api.metrics_registry`` and
+/federation/metrics on ``api.federation_peers`` (name -> base-url map);
+replica answers additionally carry the machine-readable staleness
+header pair ``X-Replication-Watermark`` / ``X-Replication-Lag``.
 """
 
 from __future__ import annotations
@@ -94,8 +102,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self._staleness_headers()
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_text(self, status: int, body: bytes,
+                   content_type: str = "text/plain; version=0.0.4") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self._staleness_headers()
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _staleness_headers(self) -> None:
+        """Machine-readable staleness on EVERY replica answer: the
+        applied replication watermark and the record lag behind the
+        leader head, as an X-header pair — so scripted consumers get
+        what the kubectl stderr stamp tells humans. Absent (not zero)
+        on non-replica servers."""
+        replica = getattr(self.api, "replica", None)
+        if replica is not None:
+            self.send_header("X-Replication-Watermark",
+                             str(replica.watermark()))
+            self.send_header("X-Replication-Lag",
+                             str(replica.lag_records()))
 
     def _send_error_obj(self, e: Exception) -> None:
         status = _ERROR_STATUS.get(type(e), 500)
@@ -141,6 +172,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._replication_route(parts[1], q)
             elif parts == ["replica", "watermark"]:
                 self._replica_route()
+            elif parts == ["metrics"]:
+                self._metrics_route()
+            elif parts == ["debug", "traces"]:
+                self._traces_route(q)
+            elif parts == ["federation", "metrics"]:
+                self._federation_metrics_route()
             else:
                 self._send_json(404, {"error": "NoRoute", "message": self.path})
         except ApiError as e:
@@ -224,10 +261,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"series": series,
                                   "resolution": resolution, "points": pts})
         elif what == "decisions":
-            recs = hist.decisions_for(
-                q.get("kind", [""])[0], q.get("ns", [""])[0],
-                q.get("name", [""])[0],
-                limit=int(q.get("limit", ["0"])[0]))
+            trace_ids = q.get("trace_id", [""])[0]
+            if trace_ids:
+                # Trace-stitching read: every retained decision stamped
+                # with one of the (comma-separated) trace ids, whatever
+                # object it was recorded against.
+                recs = hist.decisions_by_trace(
+                    trace_ids.split(","),
+                    limit=int(q.get("limit", ["0"])[0]))
+            else:
+                recs = hist.decisions_for(
+                    q.get("kind", [""])[0], q.get("ns", [""])[0],
+                    q.get("name", [""])[0],
+                    limit=int(q.get("limit", ["0"])[0]))
             self._send_json(200, {"items": [r.to_doc() for r in recs]})
         else:
             self._send_json(404, {"error": "NoRoute", "message": self.path})
@@ -281,6 +327,62 @@ class _Handler(BaseHTTPRequestHandler):
                                   "message": "not a replica store"})
         else:
             self._send_json(200, replica.status())
+
+    # -- observability -------------------------------------------------------
+
+    def _metrics_route(self) -> None:
+        """Prometheus text exposition for the registry hanging off the
+        hosted store (``api.metrics_registry`` — the same capability
+        seam as history/replication: absent registry 404s)."""
+        registry = getattr(self.api, "metrics_registry", None)
+        if registry is None:
+            self._send_json(404, {"error": "NoRoute",
+                                  "message": "no metrics registry attached"})
+        else:
+            self._send_text(200, registry.expose().encode())
+
+    def _traces_route(self, q: Dict[str, List[str]]) -> None:
+        """Chrome trace-event export of the process-default span ring,
+        mirroring MetricsServer's /debug/traces so a cluster served only
+        through this API still answers ``sim trace --cluster``. Accepts
+        the same trace_id=/name= narrowing."""
+        from k8s_dra_driver_tpu.pkg import tracing
+
+        tracer = tracing.get_tracer()
+        spans = tracer.spans(trace_id=q.get("trace_id", [None])[0],
+                             name=q.get("name", [None])[0])
+        self._send_text(200, tracer.export_chrome_json(spans),
+                        content_type="application/json")
+
+    def _federation_metrics_route(self) -> None:
+        """The global query plane's aggregation route: scrape every
+        federated peer's /metrics and re-emit the union with a
+        ``cluster`` label injected into each sample, so one Prometheus
+        target covers the fleet. Gated on ``api.federation_peers``
+        (a name -> base-url map the fleet harness attaches); unreachable
+        peers are skipped — a partitioned region must not blank the
+        whole fleet's scrape."""
+        from k8s_dra_driver_tpu.federation.query import merge_metrics_texts
+
+        peers = getattr(self.api, "federation_peers", None)
+        if not peers:
+            self._send_json(404, {"error": "NoRoute",
+                                  "message": "no federation peers attached"})
+            return
+        texts: Dict[str, str] = {}
+        unreachable: List[str] = []
+        for name in sorted(peers):
+            try:
+                with urllib.request.urlopen(
+                        peers[name].rstrip("/") + "/metrics",
+                        timeout=5.0) as resp:
+                    texts[name] = resp.read().decode()
+            except (OSError, urllib.error.URLError):
+                unreachable.append(name)
+        body = merge_metrics_texts(texts)
+        for name in unreachable:
+            body += f"# cluster {name}: unreachable\n"
+        self._send_text(200, body.encode())
 
     # -- watch streaming ----------------------------------------------------
 
@@ -415,6 +517,19 @@ class _RemoteHistory:
             recs = [r for r in recs if lo <= r.time <= hi]
         return recs
 
+    def decisions_by_trace(self, trace_ids, limit: int = 0) -> list:
+        from k8s_dra_driver_tpu.pkg.history import DecisionRecord
+
+        want = sorted({t for t in trace_ids if t})
+        if not want:
+            return []
+        doc = self._client._request(
+            "GET", "/history/decisions" + self._client._q(
+                trace_id=",".join(want),
+                limit=limit if limit else None))
+        recs = [DecisionRecord.from_doc(d) for d in doc.get("items", [])]
+        return recs
+
 
 class RemoteAPIServer:
     """Client-side APIServer over the HTTP wire — drop-in for k8s.APIServer
@@ -426,8 +541,26 @@ class RemoteAPIServer:
         self.timeout = timeout
         self._watch_stops: Dict[int, threading.Event] = {}
         self._watch_known: Dict[int, Dict[Tuple[str, str], K8sObject]] = {}
+        # Machine-readable staleness from the last response's
+        # X-Replication-Watermark / X-Replication-Lag header pair:
+        # {"watermark": int, "lag_records": int}, or None when the
+        # server is not a replica. Consumers (kubectl -o json) read
+        # this instead of paying the /replica/watermark round-trip.
+        self.last_staleness: Optional[Dict[str, int]] = None
 
     # -- plumbing ----------------------------------------------------------
+
+    def _note_staleness(self, headers) -> None:
+        wm = headers.get("X-Replication-Watermark")
+        lag = headers.get("X-Replication-Lag")
+        if wm is None:
+            self.last_staleness = None
+            return
+        try:
+            self.last_staleness = {"watermark": int(wm),
+                                   "lag_records": int(lag or 0)}
+        except ValueError:
+            self.last_staleness = None
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
@@ -437,7 +570,23 @@ class RemoteAPIServer:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                self._note_staleness(resp.headers)
                 return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            doc = {}
+            try:
+                doc = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                pass
+            err_cls = _CODE_ERROR.get(doc.get("error", ""), ApiError)
+            raise err_cls(doc.get("message", str(e))) from None
+
+    def _request_text(self, path: str) -> str:
+        try:
+            with urllib.request.urlopen(self.base_url + path,
+                                        timeout=self.timeout) as resp:
+                self._note_staleness(resp.headers)
+                return resp.read().decode()
         except urllib.error.HTTPError as e:
             doc = {}
             try:
@@ -473,6 +622,32 @@ class RemoteAPIServer:
         try:
             return self._request("GET", "/replica/watermark")
         except ApiError:
+            return None
+
+    def metrics_text(self) -> Optional[str]:
+        """The server's Prometheus text exposition, or None when no
+        metrics registry is attached (the `top --all-clusters` scrape)."""
+        try:
+            return self._request_text("/metrics")
+        except ApiError:
+            return None
+
+    def federation_metrics_text(self) -> Optional[str]:
+        """The fleet-merged exposition from /federation/metrics, or
+        None when this server has no federation peers attached."""
+        try:
+            return self._request_text("/federation/metrics")
+        except ApiError:
+            return None
+
+    def debug_traces(self, trace_id: Optional[str] = None,
+                     name: Optional[str] = None) -> Optional[dict]:
+        """The server's Chrome trace export (/debug/traces), or None
+        when the route is absent — `sim trace --cluster` routing."""
+        try:
+            return json.loads(self._request_text(
+                "/debug/traces" + self._q(trace_id=trace_id, name=name)))
+        except (ApiError, json.JSONDecodeError):
             return None
 
     def create(self, obj: K8sObject) -> K8sObject:

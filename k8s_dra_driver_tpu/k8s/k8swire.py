@@ -65,6 +65,7 @@ from k8s_dra_driver_tpu.k8s.core import (
     DeviceTaint,
     Node,
     NodeTaint,
+    ObservedFootprint,
     OpaqueDeviceConfig,
     Pod,
     PodCondition,
@@ -648,6 +649,8 @@ def _claim_encode(rc: ResourceClaim, version: str = "v1") -> Dict[str, Any]:
         status["conditions"] = _conditions_encode(rc.conditions)
     if rc.utilization is not None:
         status["utilizationSummary"] = _utilization_encode(rc.utilization)
+    if rc.observed_footprint is not None:
+        status["observedFootprint"] = _footprint_encode(rc.observed_footprint)
     return {"spec": spec, "status": status}
 
 
@@ -680,6 +683,28 @@ def _utilization_decode(doc: Optional[Dict[str, Any]]) -> Optional[UtilizationSu
         hbm_used_p95_bytes=int(doc.get("hbmUsedP95Bytes", 0)),
         hbm_total_bytes=int(doc.get("hbmTotalBytes", 0)),
         ici_utilization_p95=float(doc.get("iciUtilizationP95", 0.0)),
+        updated_at=float(doc.get("updatedAt", 0.0)),
+    )
+
+
+def _footprint_encode(f: ObservedFootprint) -> Dict[str, Any]:
+    return {
+        "phaseSeconds": {k: f.phase_seconds[k]
+                         for k in sorted(f.phase_seconds)},
+        "peakHbmBytes": f.peak_hbm_bytes,
+        "dutyP95": f.duty_p95,
+        "updatedAt": f.updated_at,
+    }
+
+
+def _footprint_decode(doc: Optional[Dict[str, Any]]) -> Optional[ObservedFootprint]:
+    if not doc:
+        return None
+    return ObservedFootprint(
+        phase_seconds={str(k): float(v)
+                       for k, v in (doc.get("phaseSeconds") or {}).items()},
+        peak_hbm_bytes=int(doc.get("peakHbmBytes", 0)),
+        duty_p95=float(doc.get("dutyP95", 0.0)),
         updated_at=float(doc.get("updatedAt", 0.0)),
     )
 
@@ -725,6 +750,7 @@ def _claim_decode(doc: Dict[str, Any]) -> ResourceClaim:
         ],
         conditions=_conditions_decode(status.get("conditions") or []),
         utilization=_utilization_decode(status.get("utilizationSummary")),
+        observed_footprint=_footprint_decode(status.get("observedFootprint")),
     )
 
 

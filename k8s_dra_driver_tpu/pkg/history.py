@@ -91,6 +91,11 @@ RULE_RESIZE_ROLLBACK = "elastic/resize-rollback"
 # Cross-cluster global scheduler (federation/scheduler.py)
 RULE_FED_PLACE = "federation/place"
 RULE_FED_SPILL = "federation/spill"
+# Leader failover (federation/replication.py promote())
+RULE_FED_FAILOVER = "federation/failover"
+# Critical-path profiler (pkg/lifecycle.py): one record per claim whose
+# consumer reached Running, inputs carrying the per-phase breakdown.
+RULE_LIFECYCLE_PROFILE = "lifecycle/claim-profiled"
 
 # -- bounds ------------------------------------------------------------------
 
@@ -464,6 +469,26 @@ class HistoryStore:
         if window is not None:
             lo, hi = window
             out = [r for r in out if lo <= r.time <= hi]
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def decisions_by_trace(self, trace_ids,
+                           limit: int = 0) -> List[DecisionRecord]:
+        """Every retained decision stamped with one of ``trace_ids``,
+        wall-ordered oldest first — the cross-cluster trace-stitching
+        read: ``explain --all-clusters`` collects an object's own trace
+        ids, then pulls in the fleet-level records (spill, placement,
+        failover) that share them but were recorded against OTHER
+        objects (Cluster/..., the consumer Pod), so the causal chain
+        survives the object-keyed index."""
+        want = {t for t in trace_ids if t}
+        if not want:
+            return []
+        with self._mu:
+            out = [r for dq in self._decisions.values() for r in dq
+                   if r.trace_id in want]
+        out.sort(key=lambda r: (r.wall, r.time))
         if limit > 0:
             out = out[-limit:]
         return out

@@ -1,0 +1,400 @@
+"""Claim critical-path profiler: where a claim's wall-clock actually goes.
+
+The flight recorder (pkg/history) answers *why* a controller acted;
+nothing answers *what the claim's latency was spent on* — queue wait vs
+allocation vs prepare vs waiting for the workload to come up. The
+:class:`ClaimLifecycleAnalyzer` reconstructs that breakdown per claim
+from the watch stream (plus the DecisionRecords and events already in
+the history store for provenance), with the same hot-path discipline as
+the telemetry aggregator:
+
+- **zero store ``list()`` calls in steady state** — one bootstrap
+  listing per kind at construction, then only watch events mutate the
+  tracked state (the ``bench_observability`` gate pins the invariant);
+- **bounded memory** — per-claim state and finished profiles are
+  LRU-capped at :data:`MAX_TRACKED`;
+- **quantized, change-gated writes** — the
+  :class:`~k8s_dra_driver_tpu.k8s.core.ObservedFootprint` written onto
+  ResourceClaim status rounds onto a grid first, so a re-profile of the
+  same workload shape writes nothing.
+
+Phase model — milestones observed off the watch stream, forced monotone
+by running max so the per-phase durations ALWAYS sum exactly to the
+claim-to-running total regardless of store write ordering:
+
+| phase | from -> to |
+|---|---|
+| ``pending``   | claim created -> consumer pod bound to a node |
+| ``admitted``  | pod bound -> claim allocation written |
+| ``allocated`` | allocation -> claim condition Prepared |
+| ``prepared``  | Prepared -> consumer pod phase Running |
+
+Multi-host domains add two fleet-level phases observed per
+ComputeDomain: ``domain-assembly`` (domain created -> status Ready) and
+``meshgen-ready`` (Ready -> first compiled mesh bundle).
+
+Each completed profile publishes four ways: the
+``tpu_dra_lifecycle_phase_seconds{phase}`` histogram, a
+``lifecycle-phase/<phase>`` history series (so ``top --history`` and
+sparklines read fleet drift), one ``lifecycle/claim-profiled``
+DecisionRecord whose inputs carry the breakdown (so ``tpu-kubectl
+explain`` shows it on the claim's own timeline), and the quantized
+``observedFootprint`` status write the ROADMAP's recommender reads.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from k8s_dra_driver_tpu.k8s.core import (
+    CLAIM_COND_PREPARED,
+    COMPUTE_DOMAIN,
+    POD,
+    RESOURCE_CLAIM,
+    ObservedFootprint,
+)
+from k8s_dra_driver_tpu.k8s.objects import ConflictError, NotFoundError
+from k8s_dra_driver_tpu.pkg.history import RULE_LIFECYCLE_PROFILE
+
+# Closed phase vocabulary (metric label + footprint keys + docs table).
+PHASE_PENDING = "pending"
+PHASE_ADMITTED = "admitted"
+PHASE_ALLOCATED = "allocated"
+PHASE_PREPARED = "prepared"
+CLAIM_PHASES = (PHASE_PENDING, PHASE_ADMITTED, PHASE_ALLOCATED,
+                PHASE_PREPARED)
+PHASE_DOMAIN_ASSEMBLY = "domain-assembly"
+PHASE_MESHGEN_READY = "meshgen-ready"
+ALL_PHASES = CLAIM_PHASES + (PHASE_DOMAIN_ASSEMBLY, PHASE_MESHGEN_READY)
+
+# Per-object state / finished-profile caps (LRU beyond, like the
+# telemetry aggregator and event correlator).
+MAX_TRACKED = 4096
+
+# Footprint quantization: phase durations in quarter-(virtual-)seconds
+# so identical workload shapes CAS the same doc and the change gate
+# holds re-profile writes at zero. Duty/HBM reuse the telemetry grid.
+PHASE_QUANTUM_S = 0.25
+
+# Virtual-seconds histogram envelope: 0.25 * 2^k, k=0..10 (0.25 s ..
+# 256 s) — a sim tick is 1 s, a full multi-host assembly tens of ticks.
+LIFECYCLE_PHASE_BUCKETS: Tuple[float, ...] = tuple(
+    0.25 * (2**k) for k in range(11))
+
+
+def _quantize_phase(v: float) -> float:
+    return round(round(v / PHASE_QUANTUM_S) * PHASE_QUANTUM_S, 6)
+
+
+@dataclass
+class _ClaimTrack:
+    """Milestones observed for one live claim (virtual clock)."""
+
+    namespace: str
+    name: str
+    uid: str
+    created_t: float
+    bound_t: Optional[float] = None
+    allocated_t: Optional[float] = None
+    prepared_t: Optional[float] = None
+    running_t: Optional[float] = None
+    consumers: Tuple[str, ...] = ()   # reserving pod uids
+    profiled: bool = False
+
+
+@dataclass
+class _PodTrack:
+    bound_t: Optional[float] = None
+    running_t: Optional[float] = None
+
+
+@dataclass
+class _DomainTrack:
+    created_t: float
+    ready_t: Optional[float] = None
+    mesh_t: Optional[float] = None
+
+
+@dataclass
+class ClaimProfile:
+    """One finished critical-path breakdown — what ``explain --latency``
+    renders and the footprint write serializes."""
+
+    namespace: str
+    name: str
+    uid: str
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
+    total_seconds: float = 0.0
+    completed_at: float = 0.0
+
+
+class ClaimLifecycleAnalyzer:
+    """Watch-fed per-claim lifecycle reconstruction. ``step(now)`` drains
+    the watches and finalizes any claim whose consumer reached Running;
+    call it from the sim step (or a controller loop) — it never blocks
+    and never lists."""
+
+    def __init__(self, api, history=None, metrics_registry=None,
+                 write_footprint: bool = True):
+        self.api = api
+        self.history = history
+        self.write_footprint = write_footprint
+        self._mu = threading.Lock()
+        self._claims: Dict[str, _ClaimTrack] = {}        # tpulint: guarded-by=_mu
+        self._pods: Dict[str, _PodTrack] = {}            # tpulint: guarded-by=_mu
+        self._pod_claims: Dict[str, Tuple[str, ...]] = {}  # tpulint: guarded-by=_mu
+        self._domains: Dict[str, _DomainTrack] = {}      # tpulint: guarded-by=_mu
+        self._profiles: Dict[Tuple[str, str], ClaimProfile] = {}  # tpulint: guarded-by=_mu
+        self.profiled_total = 0
+        self.phase_seconds = None
+        if metrics_registry is not None:
+            from k8s_dra_driver_tpu.pkg.metrics import Histogram
+
+            self.phase_seconds = metrics_registry.register(Histogram(
+                "tpu_dra_lifecycle_phase_seconds",
+                "Per-claim critical-path phase durations (virtual "
+                "seconds) reconstructed by the lifecycle analyzer.",
+                ("phase",),
+                buckets=LIFECYCLE_PHASE_BUCKETS))
+        # Watch-first, then bootstrap: any event raced in between is
+        # absorbed idempotently (milestones only ever latch earlier
+        # observations; re-observing an ADDED is a no-op).
+        self._claim_watch = api.watch(RESOURCE_CLAIM, maxsize=65536)
+        self._pod_watch = api.watch(POD, maxsize=65536)
+        self._domain_watch = api.watch(COMPUTE_DOMAIN, maxsize=65536)
+        now0 = 0.0
+        with self._mu:
+            for rc in api.list(RESOURCE_CLAIM):
+                self._ingest_claim_locked("ADDED", rc, now0)
+            for pod in api.list(POD):
+                self._ingest_pod_locked("ADDED", pod, now0)
+            for cd in api.list(COMPUTE_DOMAIN):
+                self._ingest_domain_locked("ADDED", cd, now0)
+
+    def close(self) -> None:
+        self.api.stop_watch(RESOURCE_CLAIM, self._claim_watch)
+        self.api.stop_watch(POD, self._pod_watch)
+        self.api.stop_watch(COMPUTE_DOMAIN, self._domain_watch)
+
+    # -- ingestion (watch stream) ---------------------------------------------
+
+    def step(self, now: float) -> int:
+        """Drain the watch queues, stamping transitions observed this
+        pass at ``now`` (the virtual clock), then finalize and publish
+        any claim that completed. Returns profiles published."""
+        import queue as _q
+
+        done = []
+        with self._mu:  # tpulint: holds=_mu
+            for watch, ingest in (
+                    (self._claim_watch, self._ingest_claim_locked),
+                    (self._pod_watch, self._ingest_pod_locked),
+                    (self._domain_watch, self._ingest_domain_locked)):
+                while True:
+                    try:
+                        ev = watch.get_nowait()
+                    except _q.Empty:
+                        break
+                    ingest(ev.type, ev.obj, now)
+            for tr in self._claims.values():
+                if not tr.profiled and tr.running_t is not None:
+                    tr.profiled = True
+                    done.append(self._finalize_locked(tr, now))
+            self._trim_locked()
+        for profile, footprint in done:
+            self._publish(profile, footprint, now)
+        return len(done)
+
+    def _ingest_claim_locked(self, ev_type: str, rc,
+                             now: float) -> None:  # tpulint: holds=_mu
+        uid = rc.meta.uid
+        if ev_type == "DELETED":
+            self._claims.pop(uid, None)
+            return
+        tr = self._claims.get(uid)
+        if tr is None:
+            tr = self._claims[uid] = _ClaimTrack(
+                namespace=rc.meta.namespace, name=rc.meta.name, uid=uid,
+                created_t=now)
+        if tr.allocated_t is None and rc.allocation is not None:
+            tr.allocated_t = now
+        if tr.prepared_t is None and any(
+                c.type == CLAIM_COND_PREPARED and c.status == "True"
+                for c in rc.conditions):
+            tr.prepared_t = now
+        if rc.reserved_for:
+            tr.consumers = tuple(r.uid for r in rc.reserved_for)
+            for pod_uid in tr.consumers:
+                known = self._pod_claims.get(pod_uid, ())
+                if uid not in known:
+                    self._pod_claims[pod_uid] = known + (uid,)
+                pt = self._pods.get(pod_uid)
+                if pt is not None:
+                    self._adopt_pod_times_locked(tr, pt)
+
+    def _ingest_pod_locked(self, ev_type: str, pod,
+                           now: float) -> None:  # tpulint: holds=_mu
+        uid = pod.meta.uid
+        if ev_type == "DELETED":
+            self._pods.pop(uid, None)
+            self._pod_claims.pop(uid, None)
+            return
+        pt = self._pods.get(uid)
+        if pt is None:
+            pt = self._pods[uid] = _PodTrack()
+        if pt.bound_t is None and pod.node_name:
+            pt.bound_t = now
+        if pt.running_t is None and pod.phase == "Running":
+            pt.running_t = now
+        for claim_uid in self._pod_claims.get(uid, ()):
+            tr = self._claims.get(claim_uid)
+            if tr is not None:
+                self._adopt_pod_times_locked(tr, pt)
+
+    @staticmethod
+    def _adopt_pod_times_locked(tr: _ClaimTrack, pt: _PodTrack) -> None:
+        if tr.bound_t is None and pt.bound_t is not None:
+            tr.bound_t = pt.bound_t
+        if tr.running_t is None and pt.running_t is not None:
+            tr.running_t = pt.running_t
+
+    def _ingest_domain_locked(self, ev_type: str, cd,
+                              now: float) -> None:  # tpulint: holds=_mu
+        uid = cd.meta.uid
+        if ev_type == "DELETED":
+            self._domains.pop(uid, None)
+            return
+        dt = self._domains.get(uid)
+        if dt is None:
+            dt = self._domains[uid] = _DomainTrack(created_t=now)
+        status = getattr(cd, "status", None)
+        if dt.ready_t is None and getattr(status, "status", "") == "Ready":
+            dt.ready_t = now
+            self._observe_domain_phase(PHASE_DOMAIN_ASSEMBLY,
+                                       now - dt.created_t, now)
+        if dt.mesh_t is None and getattr(status, "mesh_bundle", None) is not None:
+            dt.mesh_t = now
+            self._observe_domain_phase(PHASE_MESHGEN_READY,
+                                       now - (dt.ready_t or dt.created_t), now)
+
+    def _observe_domain_phase(self, phase: str, dur: float,
+                              now: float) -> None:
+        dur = max(0.0, dur)
+        if self.phase_seconds is not None:
+            self.phase_seconds.observe(phase, value=dur)
+        if self.history is not None:
+            self.history.push(f"lifecycle-phase/{phase}", now, dur)
+
+    # -- finalize + publish ---------------------------------------------------
+
+    def _finalize_locked(self, tr: _ClaimTrack,
+                         now: float):  # tpulint: holds=_mu
+        # Running-max over the milestone chain: a store write order that
+        # lands allocation before bind (the sim does) clamps a phase to
+        # zero instead of double-counting, so the sum is EXACTLY
+        # running_t - created_t.
+        edges = [tr.created_t, tr.bound_t, tr.allocated_t, tr.prepared_t,
+                 tr.running_t]
+        mono = []
+        hi = tr.created_t
+        for t in edges:
+            hi = max(hi, t if t is not None else hi)
+            mono.append(hi)
+        phases = {
+            phase: mono[i + 1] - mono[i]
+            for i, phase in enumerate(CLAIM_PHASES)
+        }
+        total = mono[-1] - mono[0]
+        profile = ClaimProfile(
+            namespace=tr.namespace, name=tr.name, uid=tr.uid,
+            phase_seconds=phases, total_seconds=total, completed_at=now)
+        key = (tr.namespace, tr.name)
+        self._profiles.pop(key, None)  # LRU touch
+        self._profiles[key] = profile
+        footprint = None
+        if self.write_footprint:
+            footprint = self._footprint_locked(profile)
+        return profile, footprint
+
+    def _footprint_locked(self, profile: ClaimProfile) -> ObservedFootprint:
+        from k8s_dra_driver_tpu.pkg.telemetry import (
+            DUTY_QUANTUM,
+            HBM_QUANTUM_BYTES,
+        )
+        from k8s_dra_driver_tpu.tpulib.loadtrace import percentile
+
+        peak_hbm = 0
+        duty_p95 = 0.0
+        if self.history is not None:
+            ns, name = profile.namespace, profile.name
+            hbm_pts = self.history.query(f"claim-hbm/{ns}/{name}")
+            if hbm_pts:
+                peak_hbm = int(max(p["value"] for p in hbm_pts))
+            duty_pts = self.history.query(f"claim-duty/{ns}/{name}")
+            if duty_pts:
+                duty_p95 = percentile(
+                    [p["value"] for p in duty_pts], 0.95)
+        return ObservedFootprint(
+            phase_seconds={k: _quantize_phase(v)
+                           for k, v in profile.phase_seconds.items()},
+            peak_hbm_bytes=int(round(peak_hbm / HBM_QUANTUM_BYTES))
+            * HBM_QUANTUM_BYTES,
+            duty_p95=round(round(duty_p95 / DUTY_QUANTUM) * DUTY_QUANTUM, 6),
+            updated_at=profile.completed_at,
+        )
+
+    def _publish(self, profile: ClaimProfile,
+                 footprint: Optional[ObservedFootprint],
+                 now: float) -> None:
+        self.profiled_total += 1
+        if self.phase_seconds is not None:
+            for phase, dur in profile.phase_seconds.items():
+                self.phase_seconds.observe(phase, value=dur)
+        if self.history is not None:
+            for phase, dur in profile.phase_seconds.items():
+                self.history.push(f"lifecycle-phase/{phase}", now, dur)
+            inputs = {phase: round(dur, 3)
+                      for phase, dur in profile.phase_seconds.items()}
+            inputs["total"] = round(profile.total_seconds, 3)
+            self.history.decide(
+                controller="lifecycle", rule=RULE_LIFECYCLE_PROFILE,
+                outcome="profiled", kind=RESOURCE_CLAIM,
+                namespace=profile.namespace, name=profile.name,
+                message=f"claim-to-running {profile.total_seconds:.1f}s",
+                inputs=inputs, now=now)
+        if footprint is None:
+            return
+
+        def mutate(obj, f=footprint):
+            # Change gate rides dataclass equality (updated_at excluded):
+            # identical quantized values leave the object untouched.
+            if obj.observed_footprint != f:
+                obj.observed_footprint = f
+
+        try:
+            self.api.update_with_retry(
+                RESOURCE_CLAIM, profile.name, profile.namespace, mutate)
+        except (NotFoundError, ConflictError):
+            pass
+
+    # -- reads ---------------------------------------------------------------
+
+    def breakdown(self, namespace: str, name: str) -> Optional[ClaimProfile]:
+        """The finished profile for a claim, or None if its consumer has
+        not reached Running (or it aged out of the LRU)."""
+        with self._mu:
+            return self._profiles.get((namespace, name))
+
+    def tracked_counts(self) -> Dict[str, int]:
+        with self._mu:
+            return {"claims": len(self._claims), "pods": len(self._pods),
+                    "domains": len(self._domains),
+                    "profiles": len(self._profiles)}
+
+    def _trim_locked(self) -> None:
+        for d in (self._claims, self._pods, self._pod_claims,
+                  self._domains, self._profiles):
+            while len(d) > MAX_TRACKED:
+                d.pop(next(iter(d)))

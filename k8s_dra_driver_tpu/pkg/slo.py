@@ -59,6 +59,34 @@ MAX_SUBJECTS = 1024
 TIME_TO_HEALED_SLO = "domain-time-to-healed"
 
 
+# Cross-cluster replication lag as a burn-rate objective: fed from the
+# follower's head-minus-applied record lag (``ReplicaStore.
+# lag_records()``), observed by the fleet harness each step. A partition
+# drives lag above the bound, both windows burn, and the alert decays to
+# zero after heal exactly like every other SLO here — no special-cased
+# replication alarms.
+REPLICATION_LAG_SLO = "replication-lag"
+
+
+def replication_lag_objective(
+    bound_records: float = 100.0,
+    target: float = 0.95,
+    windows: Tuple[Tuple[float, float], ...] = ((120.0, 30.0),),
+    burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+) -> SLObjective:
+    """The declared replication-lag objective: ``target`` of lag samples
+    must stay at or under ``bound_records`` WAL records behind the
+    leader head. Window pair sized like the heal-time rule (sim-scale
+    virtual seconds); production fleets re-declare with wall-clock
+    pairs."""
+    return SLObjective(
+        name=REPLICATION_LAG_SLO,
+        description="follower replication lag stays under the record "
+                    "bound (leader head minus applied watermark)",
+        target=target, bound=bound_records, op="gt",
+        windows=windows, burn_threshold=burn_threshold)
+
+
 def heal_time_objective(
     bound_s: float = 30.0,
     target: float = 0.95,

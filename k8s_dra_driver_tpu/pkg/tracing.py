@@ -49,6 +49,18 @@ DEFAULT_CAPACITY = 8192
 ATTR_CLAIM_UID = "claim_uid"
 ATTR_CLAIM_UIDS = "claim_uids"
 
+# Attribute naming the cluster a span ran in. Stamped by the federation
+# layer so a merged cross-cluster Chrome export still says where each
+# span happened (`/debug/traces` cross-links).
+ATTR_CLUSTER = "cluster"
+
+# Cross-boundary propagation: a trace context stamped onto an object's
+# annotations survives WAL replication and kind-agnostic copies, so a
+# follower-region controller picking the object up can parent its spans
+# (and therefore its DecisionRecords/Events) under the fleet-level
+# decision that routed the object there. Format: "<trace_id>:<span_id>".
+TRACE_CONTEXT_ANNOTATION = "tpu.google.com/trace-context"
+
 
 def _new_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
@@ -278,6 +290,35 @@ def span(name: str, parent: Optional[SpanContext] = None, **attrs: Any):
     component in one binary shares one ring buffer, like one /metrics
     registry)."""
     return _default_tracer.span(name, parent=parent, **attrs)
+
+
+# -- cross-boundary propagation (object annotations) ---------------------------
+
+
+def inject_context(annotations: Dict[str, str],
+                   ctx: Optional[SpanContext] = None) -> Dict[str, str]:
+    """Stamp ``ctx`` (default: this thread's active span) into an
+    annotation map so the trace follows the object — across the store,
+    across the replication WAL, across clusters. No-op without a
+    context. Returns the map for chaining."""
+    if ctx is None:
+        ctx = _default_tracer.current()
+    if ctx is not None:
+        annotations[TRACE_CONTEXT_ANNOTATION] = \
+            f"{ctx.trace_id}:{ctx.span_id}"
+    return annotations
+
+
+def extract_context(
+        annotations: Optional[Dict[str, str]]) -> Optional[SpanContext]:
+    """The inverse of :func:`inject_context`: the propagated parent
+    context carried by an object's annotations, or None. Malformed
+    values are ignored (an annotation is user-writable state)."""
+    raw = (annotations or {}).get(TRACE_CONTEXT_ANNOTATION, "")
+    trace_id, sep, span_id = raw.partition(":")
+    if not sep or not trace_id or not span_id:
+        return None
+    return SpanContext(trace_id=trace_id, span_id=span_id)
 
 
 # -- log correlation ----------------------------------------------------------

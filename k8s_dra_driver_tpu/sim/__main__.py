@@ -134,13 +134,22 @@ def trace_main(argv=None) -> int:
     parser.add_argument("--url", default="",
                         help="base URL of a running MetricsServer (its "
                         "/debug/traces is fetched), e.g. http://127.0.0.1:9090")
+    parser.add_argument("--cluster", default="",
+                        help="route to a federated cluster: a name from "
+                        "TPU_KUBECTL_CLUSTERS (\"name=url,...\") or a URL — "
+                        "the cluster's API server /debug/traces is fetched")
     parser.add_argument("--format", choices=("timeline", "chrome"),
                         default="timeline",
                         help="timeline: human-readable; chrome: filtered "
                         "trace-event JSON for Perfetto/chrome://tracing")
     args = parser.parse_args(argv)
+    if args.cluster:
+        from k8s_dra_driver_tpu.sim.kubectl import _resolve_cluster
+
+        args.url = _resolve_cluster(args.cluster)
     if bool(args.input) == bool(args.url):
-        parser.error("exactly one of --input or --url is required")
+        parser.error("exactly one of --input or --url "
+                     "(or --cluster) is required")
 
     if args.input:
         with open(args.input, "r", encoding="utf-8") as f:

@@ -92,6 +92,7 @@ from k8s_dra_driver_tpu.pkg.history import (
     RULE_SCHED_BIND,
     RULE_SCHED_PARK,
 )
+from k8s_dra_driver_tpu.pkg.lifecycle import ClaimLifecycleAnalyzer
 from k8s_dra_driver_tpu.pkg.metrics import Registry
 from k8s_dra_driver_tpu.plugins.checkpoint import PREPARE_ABORTED
 from k8s_dra_driver_tpu.plugins.computedomain.computedomain import RetryableError
@@ -285,6 +286,16 @@ class SimCluster:
         # api handle (remote clients get the same attribute from
         # RemoteAPIServer over /history/*).
         self.api.history = self.history
+        # Critical-path profiler: watch-fed (zero steady-state lists),
+        # feeds the tpu_dra_lifecycle_phase_seconds histogram, the
+        # lifecycle-phase/* history series, a lifecycle/claim-profiled
+        # DecisionRecord per completed claim, and the quantized
+        # observedFootprint status write. Exposed on the api handle so
+        # `explain --latency` finds it next to history.
+        self.lifecycle = ClaimLifecycleAnalyzer(
+            self.api, history=self.history,
+            metrics_registry=self.metrics_registry)
+        self.api.lifecycle = self.lifecycle
         # Span-loss accounting for the process-default tracer rides the
         # cluster registry (idempotent across clusters in one process).
         tracing.get_tracer().attach_metrics(self.metrics_registry)
@@ -772,6 +783,7 @@ class SimCluster:
         self._preemption_pass()
         self._rebalance_pass()
         self._telemetry_pass()
+        self.lifecycle.step(self.sim_time)
 
     def _resolve_tpu_plugin(self, node_name: str):
         node = self.nodes.get(node_name)
@@ -1235,8 +1247,14 @@ class SimCluster:
                 return "unschedulable"
             chosen = candidates[0]
         if pod.node_name != chosen:
+            # A pod carrying a propagated trace context (stamped by the
+            # global scheduler when a placement/spill routed it here)
+            # binds under that fleet-level trace, so cross-cluster
+            # explain stitches the spill -> bind chain on one trace id.
             with tracing.span(
-                    "scheduler.bind", pod=pod.key, node=chosen,
+                    "scheduler.bind",
+                    parent=tracing.extract_context(pod.meta.annotations),
+                    pod=pod.key, node=chosen,
                     claim_uids=[c.uid for c in claims.values()]):
                 def bind(obj, chosen=chosen):
                     obj.node_name = chosen
@@ -1244,18 +1262,19 @@ class SimCluster:
                     self.api.update_with_retry(POD, pod.meta.name, pod.namespace, bind)
                 except NotFoundError:
                     return "bound"
-            self.sched_recorder.normal(
-                pod, REASON_SCHEDULED,
-                f"assigned {pod.key} to {chosen}"
-                + (f" ({feasible_note})" if feasible_note else ""))
-            self.history.decide(
-                controller="scheduler", rule=RULE_SCHED_BIND,
-                outcome="bound", obj=pod,
-                message=f"assigned to {chosen}",
-                inputs={"node": chosen,
-                        "claims": sorted(c.meta.name for c in claims.values()),
-                        "feasibility": feasible_note},
-                now=self.sim_time)
+                self.sched_recorder.normal(
+                    pod, REASON_SCHEDULED,
+                    f"assigned {pod.key} to {chosen}"
+                    + (f" ({feasible_note})" if feasible_note else ""))
+                self.history.decide(
+                    controller="scheduler", rule=RULE_SCHED_BIND,
+                    outcome="bound", obj=pod,
+                    message=f"assigned to {chosen}",
+                    inputs={"node": chosen,
+                            "claims": sorted(c.meta.name
+                                             for c in claims.values()),
+                            "feasibility": feasible_note},
+                    now=self.sim_time)
         # Every consumer of a claim is recorded (shared claims have
         # several); unprepare only happens when the last one is gone.
         from k8s_dra_driver_tpu.k8s.core import ResourceClaimConsumer

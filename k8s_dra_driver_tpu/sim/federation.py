@@ -125,7 +125,8 @@ class FederatedFleet:
         self.replica = ReplicaStore(
             self.link, cluster="leader-replica",
             metrics_registry=self.leader.metrics_registry,
-            recorder=EventRecorder(self.leader.api, "federation"))
+            recorder=EventRecorder(self.leader.api, "federation"),
+            history=self.leader.history)
         self.replica.start()
         self.follower: Optional[SimCluster] = None
         if follower_region:
@@ -145,8 +146,18 @@ class FederatedFleet:
         self.scheduler = GlobalScheduler(
             views, history=self.leader.history,
             metrics_registry=self.leader.metrics_registry)
+        # Replication lag as a first-class SLO (FleetTelemetry gate on
+        # the leader): every fleet.step() feeds the replica's record lag
+        # into the leader's evaluator, so a partition burns the error
+        # budget through the same multi-window machinery as every other
+        # objective and the alert decays to zero after heal.
+        if self.leader.slo is not None:
+            from k8s_dra_driver_tpu.pkg.slo import replication_lag_objective
+
+            self.leader.slo.add(replication_lag_objective())
         self.leader_alive = True
         self._stopped = False
+        self._servers: Dict[str, object] = {}
 
     # -- chaos ---------------------------------------------------------------
 
@@ -205,6 +216,31 @@ class FederatedFleet:
             self.leader.step()
         if self.follower is not None:
             self.follower.step()
+        self._observe_replication_lag()
+
+    def _observe_replication_lag(self) -> None:
+        """Feed the leader-head-minus-replica-applied record lag into
+        the leader's SLO evaluator (declared in __init__). Evaluated by
+        the leader's own telemetry pass next step — no extra machinery.
+
+        The lag is computed on the LEADER side (its own WAL head vs the
+        replica's applied watermark): a fully partitioned replica cannot
+        see the head growing, so its self-reported ``lag_records()``
+        flatlines at the moment of the cut — exactly when the objective
+        must burn."""
+        if not self.leader_alive or self.leader.slo is None:
+            return
+        from k8s_dra_driver_tpu.k8s.core import ObjectReference
+        from k8s_dra_driver_tpu.pkg.slo import REPLICATION_LAG_SLO
+
+        head = int(self.leader.api.replication.status().get("watermark", 0))
+        lag = max(0, head - self.replica.watermark())
+        self.leader.slo.observe(
+            REPLICATION_LAG_SLO, self.leader.telemetry_clock,
+            float(lag),
+            subject=("", self.replica.cluster),
+            ref=ObjectReference(kind="Cluster", name=self.replica.cluster,
+                                namespace="", uid=""))
 
     def settle(self, max_steps: int = 20) -> None:
         if self.leader_alive:
@@ -241,10 +277,48 @@ class FederatedFleet:
     def headroom(self) -> Dict[str, int]:
         return self.scheduler.headroom()
 
+    # -- HTTP serving (the fleet lens) ---------------------------------------
+
+    def serve_http(self) -> Dict[str, str]:
+        """Stand the fleet's query plane up over HTTP: one HTTPAPIServer
+        per cluster surface (leader, its read replica, the follower
+        region when present). Attaches each cluster's metrics registry
+        for /metrics, and the full peer url map on every api for
+        /federation/metrics — so ANY cluster answers the fleet-merged
+        scrape. Returns {name: base_url}, the TPU_KUBECTL_CLUSTERS
+        vocabulary for ``tpu-kubectl --all-clusters``. Idempotent."""
+        from k8s_dra_driver_tpu.k8s.httpapi import HTTPAPIServer
+
+        if self._servers:
+            return self.cluster_urls()
+        self.leader.api.metrics_registry = self.leader.metrics_registry
+        # The replica shares the leader's registry (it was wired with it
+        # at construction) — serving it from the replica keeps the
+        # scrape alive through leader death.
+        self.replica.api.metrics_registry = self.leader.metrics_registry
+        surfaces = {"leader": self.leader.api,
+                    "leader-replica": self.replica.api}
+        if self.follower is not None:
+            self.follower.api.metrics_registry = \
+                self.follower.metrics_registry
+            surfaces["follower"] = self.follower.api
+        for name, api in surfaces.items():
+            self._servers[name] = HTTPAPIServer(api).start()
+        urls = self.cluster_urls()
+        for api in surfaces.values():
+            api.federation_peers = dict(urls)
+        return urls
+
+    def cluster_urls(self) -> Dict[str, str]:
+        return {name: srv.url for name, srv in self._servers.items()}
+
     def stop(self) -> None:
         if self._stopped:
             return
         self._stopped = True
+        for srv in self._servers.values():
+            srv.stop()
+        self._servers.clear()
         self.replica.stop()
         if self.leader_alive:
             self.leader.stop()

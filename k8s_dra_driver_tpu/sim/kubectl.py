@@ -236,20 +236,28 @@ def _resolve_kind(token: str) -> str:
     return kind
 
 
-def _resolve_cluster(token: str) -> str:
-    """``--cluster`` accepts a URL directly or a name defined in the
-    TPU_KUBECTL_CLUSTERS env ("name=url,name2=url2" — the kubeconfig
-    analog), so `get`/`top`/`explain` run against leader or follower
-    identically by switching one flag."""
+def _cluster_map() -> Dict[str, str]:
+    """The TPU_KUBECTL_CLUSTERS env ("name=url,name2=url2" — the
+    kubeconfig analog) parsed into {name: base_url}. Empty when unset —
+    fan-out commands turn that into a hard error so a typo'd env var
+    never silently narrows the fleet to nothing."""
     import os
 
-    if token.startswith(("http://", "https://")):
-        return token
-    clusters = {}
+    clusters: Dict[str, str] = {}
     for entry in os.environ.get("TPU_KUBECTL_CLUSTERS", "").split(","):
         if "=" in entry:
             name, _, url = entry.partition("=")
             clusters[name.strip()] = url.strip()
+    return clusters
+
+
+def _resolve_cluster(token: str) -> str:
+    """``--cluster`` accepts a URL directly or a name defined in
+    TPU_KUBECTL_CLUSTERS, so `get`/`top`/`explain` run against leader or
+    follower identically by switching one flag."""
+    if token.startswith(("http://", "https://")):
+        return token
+    clusters = _cluster_map()
     url = clusters.get(token)
     if url is None:
         known = ", ".join(sorted(clusters)) or "none defined"
@@ -632,6 +640,54 @@ def top_node_rows(metrics_text: str) -> List[List[str]]:
     return rows
 
 
+def top_rows_all_clusters(clusters: Dict[str, str], kind: str,
+                          namespace=None,
+                          history: bool = False) -> List[List[str]]:
+    """`top ... --all-clusters`: every federated cluster's utilization
+    table under one header with a leading CLUSTER column. Nodes scrape
+    each cluster's /metrics route; claims/domains/servinggroups list
+    each cluster's store. Dark or capability-less peers degrade to
+    SKIPPED rows."""
+    from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+
+    def one_cluster(capi) -> Optional[List[List[str]]]:
+        """One peer's table, or None when it lacks the capability. One
+        list per cluster — each iteration scans a DIFFERENT store."""
+        if kind == "Node":
+            text = capi.metrics_text()
+            return None if text is None else top_node_rows(text)
+        objs = capi.list(kind, namespace=namespace)
+        hist = capi.history if history else None
+        if kind == "ResourceClaim":
+            return top_claim_rows(objs, history=hist)
+        if kind == "ComputeDomain":
+            return top_domain_rows(objs, history=hist)
+        return top_servinggroup_rows(objs)
+
+    out: List[List[str]] = []
+    skipped: List[tuple] = []
+    for cname in sorted(clusters):
+        try:
+            rows = one_cluster(RemoteAPIServer(clusters[cname]))
+        except OSError as exc:
+            skipped.append((cname, f"unreachable: {exc}"))
+            continue
+        if rows is None:
+            skipped.append((cname, "no metrics registry attached"))
+            continue
+        if not out:
+            out.append(["CLUSTER"] + rows[0])
+        for row in rows[1:]:
+            out.append([cname] + row)
+    if not out:
+        out = [["CLUSTER", "STATUS", "DETAIL"]]
+    width = len(out[0])
+    for cname, reason in skipped:
+        row = [cname, "SKIPPED", reason]
+        out.append(row[:width] + ["-"] * max(0, width - len(row)))
+    return out
+
+
 def _print_table(rows: List[List[str]]) -> None:
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
@@ -671,25 +727,29 @@ def _spark_series_for(api, obj: K8sObject) -> str:
     return ""
 
 
-def explain_timeline_rows(api, obj: K8sObject, decisions,
-                          now: float) -> List[List[str]]:
-    """The merged TIME/SOURCE/WHAT/TRACE rows, oldest first. Events and
-    decisions both carry wall timestamps (DecisionRecord.wall exists for
-    exactly this merge — its ``time`` field is the caller's virtual
-    clock, disjoint from Event timestamps)."""
+def explain_timeline_entries(api, obj, decisions,
+                             now: float) -> List[tuple]:
+    """``(wall, priority, [TIME, SOURCE, WHAT, TRACE])`` tuples, oldest
+    first. Events and decisions both carry wall timestamps
+    (DecisionRecord.wall exists for exactly this merge — its ``time``
+    field is the caller's virtual clock, disjoint from Event
+    timestamps). The wall key stays exposed so the cross-cluster merge
+    can interleave several clusters' entries into one order; ``obj``
+    may be None (decisions-only — e.g. the object lives on a peer)."""
     from k8s_dra_driver_tpu.pkg.events import events_for
 
     merged: List[tuple] = []
-    for ev in events_for(api, obj):
-        what = f"{ev.type}/{ev.reason}"
-        if ev.count > 1:
-            what += f" x{ev.count}"
-        merged.append((ev.last_timestamp, 0, [
-            _age(ev.last_timestamp, now),
-            f"event/{ev.source or '-'}",
-            what + f": {ev.message}",
-            getattr(ev, "trace_id", "") or "-",
-        ]))
+    if obj is not None:
+        for ev in events_for(api, obj):
+            what = f"{ev.type}/{ev.reason}"
+            if ev.count > 1:
+                what += f" x{ev.count}"
+            merged.append((ev.last_timestamp, 0, [
+                _age(ev.last_timestamp, now),
+                f"event/{ev.source or '-'}",
+                what + f": {ev.message}",
+                getattr(ev, "trace_id", "") or "-",
+            ]))
     for r in decisions:
         what = f"{r.rule} -> {r.outcome}: {r.message}"
         if r.inputs:
@@ -699,15 +759,66 @@ def explain_timeline_rows(api, obj: K8sObject, decisions,
         merged.append((r.wall, 1, [
             _age(r.wall, now), r.controller, what, r.trace_id or "-"]))
     merged.sort(key=lambda t: (t[0], t[1]))
-    return [row for _, _, row in merged]
+    return merged
 
 
-def explain_object(api, kind: str, name: str, namespace: str = "") -> str:
+def explain_timeline_rows(api, obj: K8sObject, decisions,
+                          now: float) -> List[List[str]]:
+    """The merged TIME/SOURCE/WHAT/TRACE rows, oldest first."""
+    return [row for _, _, row
+            in explain_timeline_entries(api, obj, decisions, now)]
+
+
+def lifecycle_breakdown_lines(api, kind: str, namespace: str,
+                              name: str) -> List[str]:
+    """`explain --latency`: the claim's critical-path phase breakdown.
+    In-process the lifecycle analyzer's finished profile is
+    authoritative; over the wire the same numbers ride the
+    ``lifecycle/claim-profiled`` DecisionRecord's inputs (already served
+    by /history/decisions), so remote explain needs no extra route.
+    Empty when the claim has not been profiled (consumer not Running
+    yet) or the kind is not a claim."""
+    if kind != "ResourceClaim":
+        return []
+    from k8s_dra_driver_tpu.pkg.history import RULE_LIFECYCLE_PROFILE
+    from k8s_dra_driver_tpu.pkg.lifecycle import ALL_PHASES
+
+    phases: Dict[str, float] = {}
+    total = None
+    analyzer = getattr(api, "lifecycle", None)
+    profile = (analyzer.breakdown(namespace, name)
+               if analyzer is not None else None)
+    if profile is not None:
+        phases = dict(profile.phase_seconds)
+        total = profile.total_seconds
+    else:
+        hist = getattr(api, "history", None)
+        for r in (hist.decisions_for(kind, namespace, name)
+                  if hist is not None else []):
+            if r.rule == RULE_LIFECYCLE_PROFILE:
+                phases = {k: float(v) for k, v in r.inputs.items()
+                          if k != "total"}
+                total = float(r.inputs.get("total", 0.0))
+    if total is None:
+        return []
+    rows = [["PHASE", "SECONDS"]]
+    for phase in ALL_PHASES:
+        if phase in phases:
+            rows.append([phase, f"{phases[phase]:.2f}"])
+    for phase in sorted(set(phases) - set(ALL_PHASES)):
+        rows.append([phase, f"{phases[phase]:.2f}"])
+    rows.append(["total", f"{total:.2f}"])
+    return ["Latency:"] + _table(rows)
+
+
+def explain_object(api, kind: str, name: str, namespace: str = "",
+                   latency: bool = False) -> str:
     """Render the `explain` view: identity, the merged Event+Decision
     causal timeline, and the telemetry sparkline. ``api`` needs only
     get/list plus an optional ``history`` attribute (the sim's
     HistoryStore, or RemoteAPIServer's /history adapter; None degrades
-    to an events-only timeline)."""
+    to an events-only timeline). ``latency`` appends the critical-path
+    phase breakdown for claims."""
     from k8s_dra_driver_tpu.pkg.history import sparkline
 
     obj = api.get(kind, name, namespace)
@@ -715,6 +826,21 @@ def explain_object(api, kind: str, name: str, namespace: str = "") -> str:
     hist = getattr(api, "history", None)
     decisions = (hist.decisions_for(kind, obj.namespace or "", obj.meta.name)
                  if hist is not None else [])
+    # A workload stamped with a fleet-level trace context (a spilled or
+    # globally-placed object) gets its timeline stitched: decisions
+    # recorded against other objects under the same trace join in.
+    from k8s_dra_driver_tpu.pkg import tracing
+    ctx = tracing.extract_context(obj.meta.annotations)
+    if hist is not None and ctx is not None:
+        ids = {ctx.trace_id} | {r.trace_id for r in decisions if r.trace_id}
+        seen = {(r.wall, r.controller, r.name, r.outcome) for r in decisions}
+        try:
+            extra = hist.decisions_by_trace(sorted(ids))
+        except AttributeError:  # pre-stitching history surface
+            extra = []
+        decisions = decisions + [
+            r for r in extra
+            if (r.wall, r.controller, r.name, r.outcome) not in seen]
     lines = [f"Name:       {obj.meta.name}"]
     if obj.meta.namespace:
         lines.append(f"Namespace:  {obj.meta.namespace}")
@@ -725,6 +851,11 @@ def explain_object(api, kind: str, name: str, namespace: str = "") -> str:
             [["TIME", "SOURCE", "WHAT", "TRACE"]] + rows)
     else:
         lines.append("Timeline:   <none>")
+    if latency:
+        lat = lifecycle_breakdown_lines(
+            api, kind, obj.namespace or "", obj.meta.name)
+        lines += lat or ["Latency:    <not profiled — claim's consumer "
+                         "has not reached Running>"]
     series = _spark_series_for(api, obj) if hist is not None else ""
     if series:
         pts = hist.query(series, resolution="1m")
@@ -740,6 +871,105 @@ def explain_object(api, kind: str, name: str, namespace: str = "") -> str:
                          f"[{min(vals):.3f} .. {max(vals):.3f}]")
     if hist is None:
         lines.append("(no flight recorder attached: events only)")
+    return "\n".join(lines)
+
+
+def explain_all_clusters(clusters: Dict[str, str], kind: str, name: str,
+                         namespace: str = "",
+                         latency: bool = False) -> str:
+    """`explain --all-clusters`: fan out over every federated cluster's
+    /history + event surfaces and merge the per-cluster timelines into
+    ONE wall-ordered causal view, each row stamped with the cluster it
+    came from and that cluster's replication staleness. A peer that is
+    unreachable or predates the flight recorder (404 "no history store
+    attached") degrades to a loud SKIPPED row — the fleet view must
+    never fail whole because one region is dark."""
+    from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+    from k8s_dra_driver_tpu.pkg import tracing
+
+    now = time.time()
+    merged: List[tuple] = []
+    skipped: List[List[str]] = []
+    latency_lines: List[str] = []
+    reachable: List[tuple] = []   # (cluster, client, history, staleness)
+    seen_decisions: set = set()
+    trace_ids: set = set()
+    for cname in sorted(clusters):
+        capi = RemoteAPIServer(clusters[cname])
+        try:
+            hist = capi.history
+        except OSError as exc:
+            skipped.append(["-", cname, "-", "SKIPPED",
+                            f"unreachable: {exc}", "-"])
+            continue
+        if hist is None:
+            skipped.append(["-", cname, "-", "SKIPPED",
+                            "no history store attached "
+                            "(pre-flight-recorder peer)", "-"])
+            continue
+        rs = capi.replica_status()
+        staleness = (f"wm={rs.get('watermark', 0)}"
+                     f"/lag={rs.get('lag_records', 0)}"
+                     if rs is not None else "fresh")
+        reachable.append((cname, capi, hist, staleness))
+        obj = capi.try_get(kind, name, namespace)
+        if obj is not None:
+            # A workload moved across the fleet carries its originating
+            # trace in an annotation (tracing.inject_context) — the seed
+            # for the cross-cluster stitch below.
+            ctx = tracing.extract_context(obj.meta.annotations)
+            if ctx is not None:
+                trace_ids.add(ctx.trace_id)
+        decisions = hist.decisions_for(kind, namespace, name)
+        for r in decisions:
+            seen_decisions.add((cname, r.wall, r.controller, r.name,
+                                r.outcome))
+            if r.trace_id:
+                trace_ids.add(r.trace_id)
+        for wall, pri, row in explain_timeline_entries(
+                capi, obj, decisions, now):
+            if row[3] != "-":
+                trace_ids.add(row[3])
+            merged.append((wall, pri,
+                           [row[0], cname, staleness] + row[1:]))
+        if latency and not latency_lines:
+            latency_lines = lifecycle_breakdown_lines(
+                capi, kind, namespace, name)
+    # Second pass — trace stitching: pull in every cluster's decisions
+    # that share the object's trace ids but were recorded against OTHER
+    # objects (federation/spill on Cluster/..., scheduler/bind on the
+    # consumer Pod), so the fleet-level causal chain appears on the
+    # object's own timeline.
+    if trace_ids:
+        for cname, capi, hist, staleness in reachable:
+            try:
+                extra = hist.decisions_by_trace(sorted(trace_ids))
+            except (OSError, AttributeError):
+                continue
+            fresh = [r for r in extra
+                     if (cname, r.wall, r.controller, r.name, r.outcome)
+                     not in seen_decisions]
+            for wall, pri, row in explain_timeline_entries(
+                    capi, None, fresh, now):
+                merged.append((wall, pri,
+                               [row[0], cname, staleness] + row[1:]))
+    merged.sort(key=lambda t: (t[0], t[1]))
+    queried = len(reachable)
+    lines = [f"Name:       {name}"]
+    if namespace:
+        lines.append(f"Namespace:  {namespace}")
+    lines += [f"Kind:       {kind}",
+              f"Clusters:   {queried} queried, {len(skipped)} skipped"]
+    rows = [row for _, _, row in merged] + skipped
+    if rows:
+        lines += ["Timeline:"] + _table(
+            [["TIME", "CLUSTER", "STALENESS", "SOURCE", "WHAT", "TRACE"]]
+            + rows)
+    else:
+        lines.append("Timeline:   <none>")
+    if latency:
+        lines += latency_lines or [
+            "Latency:    <not profiled on any reachable cluster>"]
     return "\n".join(lines)
 
 
@@ -810,6 +1040,14 @@ def main(argv=None) -> int:
     p_explain.add_argument("kind")
     p_explain.add_argument("name")
     p_explain.add_argument("-n", "--namespace", default=None)
+    p_explain.add_argument("--all-clusters", action="store_true",
+                           help="fan out over TPU_KUBECTL_CLUSTERS and "
+                           "merge every cluster's timeline into one "
+                           "wall-ordered view with per-cluster provenance "
+                           "and replication staleness")
+    p_explain.add_argument("--latency", action="store_true",
+                           help="append the claim's critical-path phase "
+                           "breakdown (lifecycle analyzer)")
 
     p_top = sub.add_parser(
         "top",
@@ -826,6 +1064,17 @@ def main(argv=None) -> int:
     p_top.add_argument("--history", action="store_true",
                        help="add MEAN-1M/P95-1M columns from the flight "
                        "recorder's downsampled one-minute tier")
+    p_top.add_argument("--all-clusters", action="store_true",
+                       help="fan out over TPU_KUBECTL_CLUSTERS: one table "
+                       "with a CLUSTER column (nodes scrape each "
+                       "cluster's /metrics)")
+
+    p_fed = sub.add_parser(
+        "federation",
+        help="fleet-level views over TPU_KUBECTL_CLUSTERS")
+    p_fed.add_argument("verb", choices=("status",),
+                       help="status: per-peer replication watermark, lag, "
+                       "reconnects, and last heartbeat")
 
     p_del = sub.add_parser("delete")
     p_del.add_argument("kind")
@@ -850,9 +1099,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cluster:
         args.server = _resolve_cluster(args.cluster)
-    if not args.server:
+    # Fan-out commands address the fleet through TPU_KUBECTL_CLUSTERS,
+    # not one --server.
+    fanout = getattr(args, "all_clusters", False) or args.cmd == "federation"
+    if not args.server and not fanout:
         raise SystemExit("error: --server (or TPU_KUBECTL_SERVER) is required")
-    api = RemoteAPIServer(args.server)
+    api = RemoteAPIServer(args.server) if args.server else None
     if args.cluster:
         # Staleness stamp for read-replica answers: every row a follower
         # prints is only as fresh as its applied replication watermark.
@@ -865,6 +1117,33 @@ def main(argv=None) -> int:
                   f"watermark {rs.get('watermark', 0)} "
                   f"(lag {rs.get('lag_records', 0)} records)",
                   file=_sys.stderr)
+
+    if args.cmd == "federation":
+        clusters = _cluster_map()
+        if not clusters:
+            raise SystemExit(
+                "error: federation status needs TPU_KUBECTL_CLUSTERS "
+                "(\"name=url,name2=url2\")")
+        from k8s_dra_driver_tpu.federation.query import (
+            federation_status_rows,
+        )
+
+        statuses: Dict[str, Any] = {}
+        skipped_rows = []
+        for cname in sorted(clusters):
+            capi = RemoteAPIServer(clusters[cname])
+            try:
+                statuses[cname] = capi.replica_status()
+            except OSError as exc:
+                skipped_rows.append(
+                    [cname, "SKIPPED", f"unreachable: {exc}",
+                     "-", "-", "-"])
+        rows = [["PEER", "ROLE", "WATERMARK", "LAG", "RECONNECTS",
+                 "LAST-HEARTBEAT"]]
+        rows += federation_status_rows(statuses, now=_time.time())
+        rows += skipped_rows
+        _print_table(rows)
+        return 0
 
     if args.cmd == "apply":
         if args.filename == "-":  # kubectl semantics: manifests on stdin
@@ -879,6 +1158,24 @@ def main(argv=None) -> int:
 
     kind = _resolve_kind(args.kind)
     if args.cmd == "top":
+        if getattr(args, "all_clusters", False):
+            clusters = _cluster_map()
+            if not clusters:
+                raise SystemExit(
+                    "error: --all-clusters needs TPU_KUBECTL_CLUSTERS "
+                    "(\"name=url,name2=url2\")")
+            if kind not in ("Node", "ResourceClaim", "ComputeDomain",
+                            "ServingGroup"):
+                raise SystemExit(
+                    "error: top supports nodes, claims, computedomains, "
+                    "and servinggroups")
+            if getattr(args, "all_namespaces", False):
+                list_ns = args.namespace
+            else:
+                list_ns = args.namespace or "default"
+            _print_table(top_rows_all_clusters(
+                clusters, kind, namespace=list_ns, history=args.history))
+            return 0
         if kind == "Node":
             if not args.metrics_url:
                 raise SystemExit(
@@ -932,7 +1229,19 @@ def main(argv=None) -> int:
                 list_ns = args.namespace or "default"
             objs = api.list(kind, namespace=list_ns)
         if args.output == "json":
-            print(json.dumps([to_wire(o) for o in objs], indent=1, sort_keys=True))
+            docs = [to_wire(o) for o in objs]
+            if api.last_staleness is not None:
+                # Read-replica answer: wrap in an envelope carrying the
+                # machine-readable staleness stamp (the X-Replication-*
+                # header pair the list/get just returned). Fresh servers
+                # keep the historical plain-array shape so existing
+                # `... -o json | python -c "json.load..."` pipelines are
+                # untouched.
+                print(json.dumps(
+                    {"items": docs, "staleness": api.last_staleness},
+                    indent=1, sort_keys=True))
+            else:
+                print(json.dumps(docs, indent=1, sort_keys=True))
         elif args.output == "yaml":
             # A single named object renders as one document (scriptable
             # `get cd x -o yaml | yq .status.conditions`); lists as a
@@ -956,8 +1265,18 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "explain":
-        print(explain_object(
-            api, kind, args.name, _default_namespace(kind, args.namespace or "")))
+        ns = _default_namespace(kind, args.namespace or "")
+        if args.all_clusters:
+            clusters = _cluster_map()
+            if not clusters:
+                raise SystemExit(
+                    "error: --all-clusters needs TPU_KUBECTL_CLUSTERS "
+                    "(\"name=url,name2=url2\")")
+            print(explain_all_clusters(clusters, kind, args.name, ns,
+                                       latency=args.latency))
+        else:
+            print(explain_object(api, kind, args.name, ns,
+                                 latency=args.latency))
         return 0
 
     if args.cmd == "delete":

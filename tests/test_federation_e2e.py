@@ -249,3 +249,142 @@ def test_fleet_global_scheduler_spreads_across_regions(tmp_path):
         assert rows and rows[-1].controller == "federation"
     finally:
         fleet.stop()
+
+
+def test_flagship_spill_trace_stitching_latency_and_slo(tmp_path, monkeypatch,
+                                                        capsys):
+    """ISSUE 19 flagship: a partition burns the replication-lag SLO, the
+    GlobalScheduler spills a ServingGroup replica to the follower region
+    under a fleet-level trace, and `tpu-kubectl explain --all-clusters`
+    against the LEADER's cluster map reconstructs the full causal chain
+    (spill decision on the leader -> bind/prepare/Running on the
+    follower) in one wall-ordered timeline; the spilled claim's
+    `--latency` phase sum matches the claim-to-running total; the burn
+    alert is deduped while firing and decays to zero after heal."""
+    from k8s_dra_driver_tpu.k8s.core import (
+        RESOURCE_CLAIM,
+        Container,
+        DeviceRequest,
+        PodResourceClaimRef,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_tpu.pkg import tracing
+    from k8s_dra_driver_tpu.pkg.history import (
+        RULE_FED_SPILL,
+        RULE_SCHED_BIND,
+    )
+    from k8s_dra_driver_tpu.pkg.slo import REPLICATION_LAG_SLO
+    from k8s_dra_driver_tpu.sim import kubectl
+    from k8s_dra_driver_tpu.sim.federation import FederatedFleet
+
+    fleet = FederatedFleet(str(tmp_path), follower_region=True,
+                           gates="FleetTelemetry=true")
+    try:
+        assert fleet.leader.slo is not None, "FleetTelemetry gate missing"
+        fleet.settle()
+        assert fleet.wait_converged(), "fleet did not converge at start"
+
+        # ---- partition + write storm: lag exceeds the 100-record bound ----
+        fleet.partition_replication()
+        _pods(fleet.leader.api, 120, prefix="lag-")
+
+        def lag_alerts():
+            return [a for a in fleet.leader.slo.active_alerts()
+                    if a.slo == REPLICATION_LAG_SLO]
+
+        for _ in range(60):
+            fleet.step()
+            if lag_alerts():
+                break
+        alerts = lag_alerts()
+        assert alerts, "replication-lag burn alert never fired"
+        assert len(alerts) == 1, "burn alert not deduped per (slo, subject)"
+        since = alerts[0].since
+        fleet.step()
+        again = lag_alerts()
+        assert len(again) == 1 and again[0].since == since, \
+            "incident identity did not carry across evaluations"
+
+        # ---- the spill decision opens the fleet-level trace ----
+        frac, target = fleet.scheduler.spill("leader")
+        assert frac > 0.0 and target == "follower"
+        ctx = fleet.scheduler.last_spill_context
+        assert ctx is not None and ctx.trace_id
+        spills = [r for r in fleet.leader.history.decisions_for(
+            "Cluster", "", "leader") if r.rule == RULE_FED_SPILL]
+        assert spills and spills[-1].trace_id == ctx.trace_id
+
+        # ---- apply the spill: one ServingGroup replica on the follower,
+        # stamped with the spill context so its bind joins the trace ----
+        claim = ResourceClaim(
+            meta=new_meta("sg-web-rep-0-tpus", "default"),
+            requests=[DeviceRequest(name="tpus",
+                                    device_class_name="tpu.google.com",
+                                    count=1)])
+        tracing.inject_context(claim.meta.annotations, ctx)
+        fleet.follower.api.create(claim)
+        spilled = Pod(
+            meta=new_meta("sg-web-rep-0", "default"),
+            containers=[Container(name="serving", image="srv")],
+            resource_claims=[PodResourceClaimRef(
+                name="tpus", resource_claim_name="sg-web-rep-0-tpus")])
+        tracing.inject_context(spilled.meta.annotations, ctx)
+        fleet.follower.api.create(spilled)
+        wait_for(lambda: (fleet.step() or fleet.follower.api.get(
+            POD, "sg-web-rep-0", "default").phase == "Running"),
+            timeout=30, msg="spilled replica Running on follower")
+        binds = [r for r in fleet.follower.history.decisions_by_trace(
+            [ctx.trace_id]) if r.rule == RULE_SCHED_BIND]
+        assert binds, "follower bind did not join the spill trace"
+
+        # ---- heal; profile the spilled claim (consumer Running) ----
+        fleet.heal_replication()
+        wait_for(lambda: (fleet.step() or fleet.follower.lifecycle.breakdown(
+            "default", "sg-web-rep-0-tpus") is not None),
+            timeout=30, msg="lifecycle profile for the spilled claim")
+
+        # ---- the lens: explain --all-clusters against the leader's map ----
+        urls = fleet.serve_http()
+        monkeypatch.setenv("TPU_KUBECTL_CLUSTERS", ",".join(
+            f"{n}={u}" for n, u in sorted(urls.items())))
+        assert kubectl.main(["explain", "resourceclaim", "sg-web-rep-0-tpus",
+                             "--all-clusters", "--latency"]) == 0
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        spill_at = next(i for i, ln in enumerate(lines)
+                        if RULE_FED_SPILL in ln)
+        bind_at = next(i for i, ln in enumerate(lines)
+                       if RULE_SCHED_BIND in ln)
+        assert spill_at < bind_at, "timeline not wall-ordered across clusters"
+        assert "leader" in lines[spill_at] and "follower" in lines[bind_at], \
+            "per-cluster provenance missing"
+        # One trace id ties the chain across the replication boundary.
+        assert ctx.trace_id in lines[spill_at]
+        assert ctx.trace_id in lines[bind_at]
+        # Latency: the phase sum matches claim-to-running within rounding.
+        lat = lines[lines.index(next(ln for ln in lines
+                                     if ln.startswith("Latency:"))):]
+        phases = {}
+        total = None
+        for ln in lat:
+            parts = ln.split()
+            if len(parts) == 2 and parts[0] != "PHASE":
+                try:
+                    val = float(parts[1])
+                except ValueError:
+                    continue
+                if parts[0] == "total":
+                    total = val
+                else:
+                    phases[parts[0]] = val
+        assert total is not None and phases
+        assert sum(phases.values()) == pytest.approx(total, abs=0.05)
+
+        # ---- decay: the incident clears after heal ----
+        for _ in range(120):
+            if not lag_alerts():
+                break
+            fleet.step()
+        assert not lag_alerts(), "burn alert did not decay after heal"
+    finally:
+        fleet.stop()

@@ -1,0 +1,225 @@
+"""Fleet-wide lens: the global query plane + cross-cluster stitching.
+
+Three tiers:
+
+1. Pure transforms (federation/query.py): cluster-label injection,
+   multi-scrape merge, federation-status rows — no HTTP.
+2. Wire plumbing against a served FederatedFleet: the staleness header
+   pair, the -o json staleness envelope, /metrics and
+   /federation/metrics routes, decisions_by_trace over the wire.
+3. kubectl fan-out degradation (the ISSUE's satellite): a partitioned
+   peer or a pre-flight-recorder peer yields a loud SKIPPED row, never
+   a whole-command failure."""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.federation.query import (
+    federation_status_rows,
+    inject_cluster_label,
+    merge_metrics_texts,
+)
+from k8s_dra_driver_tpu.k8s.core import ResourceClaim
+from k8s_dra_driver_tpu.k8s.httpapi import RemoteAPIServer
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg import tracing
+from k8s_dra_driver_tpu.sim import kubectl
+from k8s_dra_driver_tpu.sim.federation import FederatedFleet
+
+
+# -- tier 1: pure transforms -------------------------------------------------
+
+
+def test_inject_cluster_label_bare_and_braced():
+    text = ("# HELP x help\n"
+            "# TYPE x gauge\n"
+            "x 1.0\n"
+            'y{chip="0"} 2.0\n')
+    out = inject_cluster_label(text, "west")
+    assert '# HELP x help' in out
+    assert 'x{cluster="west"} 1.0' in out
+    assert 'y{cluster="west",chip="0"} 2.0' in out
+
+
+def test_inject_cluster_label_existing_label_wins():
+    out = inject_cluster_label('x{cluster="east"} 1\n', "west")
+    assert 'cluster="east"' in out
+    assert 'cluster="west"' not in out
+
+
+def test_inject_cluster_label_malformed_passes_through():
+    out = inject_cluster_label("}{garbage\n", "west")
+    assert "}{garbage" in out
+
+
+def test_merge_metrics_texts_dedups_headers_sorts_clusters():
+    merged = merge_metrics_texts({
+        "b": "# HELP x h\nx 2\n",
+        "a": "# HELP x h\nx 1\n",
+    })
+    lines = merged.splitlines()
+    assert lines.count("# HELP x h") == 1
+    assert lines.index('x{cluster="a"} 1') < lines.index('x{cluster="b"} 2')
+
+
+def test_federation_status_rows_roles_and_heartbeat():
+    rows = federation_status_rows({
+        "leader": None,
+        "replica": {"watermark": 42, "lag_records": 3, "reconnects": 1,
+                    "promoted": False, "last_heartbeat": 90.0},
+        "promoted": {"watermark": 7, "lag_records": 0, "reconnects": 0,
+                     "promoted": True, "last_heartbeat": 0.0},
+    }, now=100.0)
+    by_peer = {r[0]: r for r in rows}
+    assert by_peer["leader"][1:] == ["leader", "-", "-", "-", "-"]
+    assert by_peer["replica"][1] == "replica"
+    assert by_peer["replica"][2] == "42"
+    assert by_peer["replica"][5] == "10.0s ago"
+    assert by_peer["promoted"][1] == "promoted"
+    assert by_peer["promoted"][5] == "never"
+
+
+# -- tier 2/3: a served fleet ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    fl = FederatedFleet(str(tmp_path_factory.mktemp("lens")),
+                        follower_region=True)
+    try:
+        # A claim on the leader so explain/top have something to read,
+        # plus one trace-stamped decision for the stitching read.
+        fl.leader.api.create(ResourceClaim(meta=new_meta("probe", "default")))
+        with tracing.span("lens.test"):
+            ctx = tracing.current()
+            fl.leader.history.decide(
+                controller="test", rule="RULE_SCHED_BIND", outcome="ok",
+                kind="ResourceClaim", namespace="default", name="probe")
+        for _ in range(3):
+            fl.step()
+        assert fl.wait_converged(timeout_s=10.0)
+        urls = fl.serve_http()
+        yield fl, urls, ctx.trace_id
+    finally:
+        fl.stop()
+
+
+@pytest.fixture
+def clusters_env(fleet, monkeypatch):
+    _, urls, _ = fleet
+    monkeypatch.setenv("TPU_KUBECTL_CLUSTERS", ",".join(
+        f"{name}={url}" for name, url in sorted(urls.items())))
+    return urls
+
+
+def test_replica_answers_carry_staleness_headers(fleet):
+    _, urls, _ = fleet
+    replica = RemoteAPIServer(urls["leader-replica"])
+    replica.list("ResourceClaim", namespace="default")
+    assert replica.last_staleness is not None
+    assert set(replica.last_staleness) == {"watermark", "lag_records"}
+    assert replica.last_staleness["watermark"] > 0
+    leader = RemoteAPIServer(urls["leader"])
+    leader.list("ResourceClaim", namespace="default")
+    assert leader.last_staleness is None
+
+
+def test_kubectl_json_envelope_only_on_stale_answers(fleet, clusters_env,
+                                                     capsys):
+    kubectl.main(["--cluster", "leader-replica", "get", "resourceclaims",
+                  "-o", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert isinstance(doc, dict)
+    assert {o["meta"]["name"] for o in doc["items"]} >= {"probe"}
+    assert doc["staleness"]["watermark"] > 0
+    kubectl.main(["--cluster", "leader", "get", "resourceclaims",
+                  "-o", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert isinstance(doc, list)  # wire-compat: leaders stay a bare array
+
+
+def test_metrics_routes_per_cluster_and_federated(fleet):
+    _, urls, _ = fleet
+    leader = RemoteAPIServer(urls["leader"])
+    text = leader.metrics_text()
+    assert text and "# HELP" in text
+    fed = leader.federation_metrics_text()
+    assert 'cluster="leader"' in fed
+    assert 'cluster="follower"' in fed
+    # Any peer answers the fleet-merged scrape, not just the leader.
+    follower = RemoteAPIServer(urls["follower"])
+    assert 'cluster="leader"' in follower.federation_metrics_text()
+
+
+def test_decisions_by_trace_over_the_wire(fleet):
+    _, urls, trace_id = fleet
+    hist = RemoteAPIServer(urls["leader"]).history
+    assert hist is not None
+    recs = hist.decisions_by_trace([trace_id])
+    assert recs and all(r.trace_id == trace_id for r in recs)
+    assert recs[0].name == "probe"
+    assert hist.decisions_by_trace([]) == []
+    assert hist.decisions_by_trace(["no-such-trace"]) == []
+
+
+def test_federation_status_cli(fleet, clusters_env, capsys):
+    assert kubectl.main(["federation", "status"]) == 0
+    out = capsys.readouterr().out
+    assert "PEER" in out and "WATERMARK" in out
+    lines = {ln.split()[0]: ln for ln in out.splitlines()[1:] if ln.strip()}
+    assert "leader-replica" in lines and "replica" in lines["leader-replica"]
+    assert "leader" in lines and "follower" in lines
+
+
+def test_top_all_clusters(fleet, clusters_env, capsys):
+    assert kubectl.main(["top", "claims", "--all-clusters"]) == 0
+    out = capsys.readouterr().out
+    assert "CLUSTER" in out and "DUTY-P95" in out
+    assert kubectl.main(["top", "nodes", "--all-clusters"]) == 0
+    out = capsys.readouterr().out
+    assert "CLUSTER" in out
+
+
+def test_explain_all_clusters_merges_and_degrades(fleet, clusters_env,
+                                                  monkeypatch, capsys):
+    """The fan-out degradation satellite: an unreachable peer and a
+    history-less peer (the read replica serves no /history routes — a
+    pre-flight-recorder surface) each produce a loud SKIPPED row while
+    the reachable clusters still merge."""
+    _, urls, _ = fleet
+    monkeypatch.setenv("TPU_KUBECTL_CLUSTERS", ",".join(
+        [f"{n}={u}" for n, u in sorted(urls.items())]
+        + ["ghost=http://127.0.0.1:1"]))
+    assert kubectl.main(["explain", "resourceclaim", "probe",
+                         "--all-clusters"]) == 0
+    out = capsys.readouterr().out
+    assert "Clusters:" in out and "skipped" in out
+    assert "SKIPPED" in out
+    assert "unreachable" in out             # the dead port
+    assert "pre-flight-recorder" in out     # the history-less replica
+    assert "RULE_SCHED_BIND" in out         # leader rows still merged
+
+
+def test_explain_all_clusters_latency_not_profiled(fleet, clusters_env,
+                                                   capsys):
+    assert kubectl.main(["explain", "resourceclaim", "probe",
+                         "--all-clusters", "--latency"]) == 0
+    out = capsys.readouterr().out
+    assert "Latency:" in out
+
+
+def test_cluster_map_parses_env(monkeypatch):
+    monkeypatch.setenv("TPU_KUBECTL_CLUSTERS",
+                       "a=http://x:1, b=http://y:2")
+    assert kubectl._cluster_map() == {"a": "http://x:1", "b": "http://y:2"}
+    monkeypatch.delenv("TPU_KUBECTL_CLUSTERS")
+    assert kubectl._cluster_map() == {}
+
+
+def test_federation_status_requires_clusters_env(monkeypatch):
+    monkeypatch.delenv("TPU_KUBECTL_CLUSTERS", raising=False)
+    monkeypatch.delenv("TPU_KUBECTL_SERVER", raising=False)
+    with pytest.raises(SystemExit):
+        kubectl.main(["federation", "status"])

@@ -1,0 +1,277 @@
+"""Claim critical-path profiler (pkg/lifecycle.py) unit tier.
+
+Pins the analyzer's contracts: phase durations always sum EXACTLY to
+the claim-to-running total (running-max monotonicity, whatever order
+the store writes landed in), zero store list() calls after the
+construction bootstrap, the quantized observedFootprint status write
+with its change gate, bounded tracking state, and the four publication
+surfaces (histogram, history series, DecisionRecord, status)."""
+
+import queue
+
+import pytest
+
+from k8s_dra_driver_tpu.k8s import APIServer
+from k8s_dra_driver_tpu.k8s.conditions import Condition
+from k8s_dra_driver_tpu.k8s.core import (
+    CLAIM_COND_PREPARED,
+    POD,
+    RESOURCE_CLAIM,
+    AllocationResult,
+    Pod,
+    ResourceClaim,
+    ResourceClaimConsumer,
+)
+from k8s_dra_driver_tpu.k8s.objects import new_meta
+from k8s_dra_driver_tpu.pkg.history import (
+    RULE_LIFECYCLE_PROFILE,
+    HistoryStore,
+)
+from k8s_dra_driver_tpu.pkg.lifecycle import (
+    ALL_PHASES,
+    CLAIM_PHASES,
+    MAX_TRACKED,
+    ClaimLifecycleAnalyzer,
+)
+from k8s_dra_driver_tpu.pkg.metrics import Registry
+
+
+@pytest.fixture
+def stack():
+    api = APIServer()
+    hist = HistoryStore(None)
+    reg = Registry()
+    analyzer = ClaimLifecycleAnalyzer(api, history=hist,
+                                      metrics_registry=reg)
+    yield api, hist, reg, analyzer
+    analyzer.close()
+
+
+def _claim(api, name="c1"):
+    return api.create(ResourceClaim(meta=new_meta(name, "default")))
+
+
+def _pod(api, name="p1"):
+    return api.create(Pod(meta=new_meta(name, "default")))
+
+
+def _reserve(api, claim, pod):
+    api.update_with_retry(
+        RESOURCE_CLAIM, claim.meta.name, "default",
+        lambda o: o.reserved_for.append(ResourceClaimConsumer(
+            kind="Pod", name=pod.meta.name, uid=pod.meta.uid)))
+
+
+def _drive_to_running(api, analyzer, claim, pod,
+                      t_bind=1.0, t_alloc=2.0, t_prepared=4.0,
+                      t_running=8.0):
+    """Walk the milestone chain, stepping the analyzer at each virtual
+    timestamp so transitions are observed at known times."""
+    analyzer.step(0.0)
+    _reserve(api, claim, pod)
+    api.update_with_retry(POD, pod.meta.name, "default",
+                          lambda o: setattr(o, "node_name", "n0"))
+    analyzer.step(t_bind)
+    api.update_with_retry(
+        RESOURCE_CLAIM, claim.meta.name, "default",
+        lambda o: setattr(o, "allocation", AllocationResult(node_name="n0")))
+    analyzer.step(t_alloc)
+    api.update_with_retry(
+        RESOURCE_CLAIM, claim.meta.name, "default",
+        lambda o: o.conditions.append(
+            Condition(type=CLAIM_COND_PREPARED, status="True")))
+    analyzer.step(t_prepared)
+    api.update_with_retry(POD, pod.meta.name, "default",
+                          lambda o: setattr(o, "phase", "Running"))
+    return analyzer.step(t_running)
+
+
+def test_phases_sum_exactly_to_total(stack):
+    api, hist, reg, analyzer = stack
+    claim, pod = _claim(api), _pod(api)
+    published = _drive_to_running(api, analyzer, claim, pod)
+    assert published == 1
+    prof = analyzer.breakdown("default", "c1")
+    assert prof is not None
+    assert set(prof.phase_seconds) == set(CLAIM_PHASES)
+    assert sum(prof.phase_seconds.values()) == pytest.approx(
+        prof.total_seconds)
+    # The milestones landed at 1/2/4/8 against creation at 0.
+    assert prof.phase_seconds == {
+        "pending": 1.0, "admitted": 1.0, "allocated": 2.0, "prepared": 4.0}
+    assert prof.total_seconds == 8.0
+
+
+def test_out_of_order_milestones_stay_monotone(stack):
+    """A store write order that lands allocation before bind (the sim
+    does exactly this) must clamp, not double-count: the sum is still
+    EXACTLY claim-to-running."""
+    api, hist, reg, analyzer = stack
+    claim, pod = _claim(api), _pod(api)
+    analyzer.step(0.0)
+    # Allocation observed FIRST (t=1), bind only at t=3.
+    api.update_with_retry(
+        RESOURCE_CLAIM, claim.meta.name, "default",
+        lambda o: setattr(o, "allocation", AllocationResult(node_name="n0")))
+    analyzer.step(1.0)
+    _reserve(api, claim, pod)
+    api.update_with_retry(POD, pod.meta.name, "default",
+                          lambda o: setattr(o, "node_name", "n0"))
+    analyzer.step(3.0)
+    api.update_with_retry(POD, pod.meta.name, "default",
+                          lambda o: setattr(o, "phase", "Running"))
+    assert analyzer.step(5.0) == 1
+    prof = analyzer.breakdown("default", "c1")
+    assert all(v >= 0.0 for v in prof.phase_seconds.values())
+    assert sum(prof.phase_seconds.values()) == pytest.approx(
+        prof.total_seconds)
+    assert prof.total_seconds == 5.0
+
+
+def test_zero_store_lists_in_steady_state(stack):
+    """The hot-path discipline the bench gate pins: after the bootstrap
+    listing at construction, the analyzer never calls api.list()."""
+    api, hist, reg, analyzer = stack
+    base = api.stats.list_calls
+    claim, pod = _claim(api), _pod(api)
+    _drive_to_running(api, analyzer, claim, pod)
+    for t in range(9, 30):
+        analyzer.step(float(t))
+    analyzer.breakdown("default", "c1")
+    assert api.stats.list_calls == base
+
+
+def test_publishes_all_four_surfaces(stack):
+    api, hist, reg, analyzer = stack
+    claim, pod = _claim(api), _pod(api)
+    _drive_to_running(api, analyzer, claim, pod)
+    # 1. Histogram.
+    text = reg.expose()
+    assert "tpu_dra_lifecycle_phase_seconds" in text
+    assert 'phase="prepared"' in text
+    # 2. History series per phase.
+    names = hist.series_names()
+    for phase in CLAIM_PHASES:
+        assert f"lifecycle-phase/{phase}" in names
+    # 3. DecisionRecord with the breakdown in inputs.
+    recs = [r for r in hist.decisions_for(RESOURCE_CLAIM, "default", "c1")
+            if r.rule == RULE_LIFECYCLE_PROFILE]
+    assert recs
+    assert recs[-1].inputs["total"] == 8.0
+    assert recs[-1].inputs["prepared"] == 4.0
+    # 4. Quantized observedFootprint on status.
+    rc = api.get(RESOURCE_CLAIM, "c1", "default")
+    assert rc.observed_footprint is not None
+    assert rc.observed_footprint.phase_seconds["prepared"] == 4.0
+
+
+def test_footprint_change_gate_writes_once(stack):
+    """Re-stepping after the profile published must not churn the
+    claim's resourceVersion: the quantized footprint compares equal and
+    the change gate holds the write at zero."""
+    api, hist, reg, analyzer = stack
+    claim, pod = _claim(api), _pod(api)
+    _drive_to_running(api, analyzer, claim, pod)
+    rv = api.get(RESOURCE_CLAIM, "c1", "default").meta.resource_version
+    for t in range(9, 20):
+        analyzer.step(float(t))
+    assert api.get(RESOURCE_CLAIM, "c1",
+                   "default").meta.resource_version == rv
+
+
+def test_profile_published_once_per_claim(stack):
+    api, hist, reg, analyzer = stack
+    claim, pod = _claim(api), _pod(api)
+    assert _drive_to_running(api, analyzer, claim, pod) == 1
+    for t in range(9, 15):
+        assert analyzer.step(float(t)) == 0
+    assert analyzer.profiled_total == 1
+
+
+def test_deleted_claim_drops_tracking(stack):
+    api, hist, reg, analyzer = stack
+    _claim(api)
+    analyzer.step(0.0)
+    assert analyzer.tracked_counts()["claims"] == 1
+    api.delete(RESOURCE_CLAIM, "c1", "default")
+    analyzer.step(1.0)
+    assert analyzer.tracked_counts()["claims"] == 0
+
+
+def test_tracking_is_bounded():
+    api = APIServer()
+    analyzer = ClaimLifecycleAnalyzer(api, write_footprint=False)
+    try:
+        for i in range(MAX_TRACKED + 64):
+            api.create(ResourceClaim(meta=new_meta(f"c{i}", "default")))
+        analyzer.step(0.0)
+        assert analyzer.tracked_counts()["claims"] <= MAX_TRACKED
+    finally:
+        analyzer.close()
+
+
+def test_bootstrap_absorbs_preexisting_objects():
+    """Objects created BEFORE the analyzer exist via the construction
+    bootstrap (watch-first-then-list), and a later completion still
+    profiles."""
+    api = APIServer()
+    hist = HistoryStore(None)
+    claim = api.create(ResourceClaim(meta=new_meta("old", "default")))
+    pod = api.create(Pod(meta=new_meta("oldpod", "default")))
+    analyzer = ClaimLifecycleAnalyzer(api, history=hist)
+    try:
+        api.update_with_retry(
+            RESOURCE_CLAIM, "old", "default",
+            lambda o: o.reserved_for.append(ResourceClaimConsumer(
+                kind="Pod", name="oldpod", uid=pod.meta.uid)))
+        api.update_with_retry(POD, "oldpod", "default",
+                              lambda o: setattr(o, "node_name", "n0"))
+        api.update_with_retry(POD, "oldpod", "default",
+                              lambda o: setattr(o, "phase", "Running"))
+        assert analyzer.step(2.0) == 1
+        prof = analyzer.breakdown("default", "old")
+        assert prof is not None and prof.total_seconds == 2.0
+    finally:
+        analyzer.close()
+
+
+def test_domain_phases_observed():
+    """Multi-host fleet phases: domain-assembly (create -> Ready) and
+    meshgen-ready (Ready -> first mesh bundle) land on the histogram
+    and the history series without any claim involved."""
+    from k8s_dra_driver_tpu.api.computedomain import (
+        ComputeDomain,
+        ComputeDomainStatus,
+    )
+
+    api = APIServer()
+    hist = HistoryStore(None)
+    reg = Registry()
+    analyzer = ClaimLifecycleAnalyzer(api, history=hist,
+                                      metrics_registry=reg)
+    try:
+        api.create(ComputeDomain(meta=new_meta("d0", "default")))
+        analyzer.step(0.0)
+        api.update_with_retry(
+            "ComputeDomain", "d0", "default",
+            lambda o: setattr(o, "status", ComputeDomainStatus(
+                status="Ready")))
+        analyzer.step(3.0)
+        assert "lifecycle-phase/domain-assembly" in hist.series_names()
+        pts = hist.query("lifecycle-phase/domain-assembly")
+        assert pts and pts[-1]["value"] == 3.0
+    finally:
+        analyzer.close()
+
+
+def test_phase_vocabulary_is_closed():
+    assert set(CLAIM_PHASES) <= set(ALL_PHASES)
+    assert len(ALL_PHASES) == len(set(ALL_PHASES))
+
+
+def test_watch_queues_drained_nonblocking(stack):
+    """step() never blocks on an empty queue."""
+    api, hist, reg, analyzer = stack
+    with pytest.raises(queue.Empty):
+        analyzer._claim_watch.get_nowait()
+    assert analyzer.step(1.0) == 0
